@@ -1,0 +1,68 @@
+"""mxnet_tpu — a TPU-native deep-learning framework with MXNet's capabilities.
+
+A brand-new framework (NOT a port) with the API surface of Apache MXNet
+(reference: leezu/mxnet), designed tpu-first on jax/XLA: the async
+dependency engine maps to XLA's async dispatch, ``hybridize`` maps to a
+jit-compiled executable cache, KVStore maps to SPMD collectives over a
+device mesh. See SURVEY.md for the full blueprint.
+
+Usage mirrors the reference::
+
+    import mxnet_tpu as mx
+    x = mx.np.ones((2, 3), ctx=mx.tpu())
+    net = mx.gluon.nn.Dense(10)
+    net.initialize()
+    with mx.autograd.record():
+        y = net(x)
+"""
+__version__ = "0.1.0"
+
+from . import base
+from .base import MXNetError
+from .context import (Context, cpu, cpu_pinned, gpu, tpu, current_context,
+                      num_gpus, num_tpus)
+from . import engine
+from . import ndarray
+from . import ndarray as nd
+from .ndarray import NDArray
+from . import numpy as np  # noqa: A004 - mirrors mx.np
+from . import npx
+from . import autograd
+from .ndarray import random
+from . import util
+from .util import set_np, is_np_array, is_np_shape
+
+# Subpackages that may import heavier deps load lazily via __getattr__.
+_LAZY = {
+    "gluon": ".gluon",
+    "optimizer": ".optimizer",
+    "metric": ".metric",
+    "initializer": ".initializer",
+    "init": ".initializer",
+    "kvstore": ".kvstore",
+    "io": ".io",
+    "image": ".image",
+    "recordio": ".recordio",
+    "profiler": ".profiler",
+    "amp": ".amp",
+    "parallel": ".parallel",
+    "test_utils": ".test_utils",
+    "runtime": ".runtime",
+    "lr_scheduler": ".lr_scheduler",
+    "callback": ".callback",
+    "model": ".model",
+}
+
+
+def __getattr__(name):
+    if name in _LAZY:
+        import importlib
+        mod = importlib.import_module(_LAZY[name], __name__)
+        globals()[name] = mod
+        return mod
+    raise AttributeError(f"module 'mxnet_tpu' has no attribute {name!r}")
+
+
+def waitall() -> None:
+    """Block until all asynchronous device work completes."""
+    engine.waitall()

@@ -1,0 +1,201 @@
+"""Autograd tape internals (shared by ndarray and autograd packages).
+
+Reference parity (leezu/mxnet): ``src/imperative/imperative.cc``
+(``Imperative::RecordOp`` / ``Imperative::Backward``) and the ``AGInfo``
+node attachments. The reference records an NNVM node per imperative op and
+builds a backward graph with the nnvm Gradient pass; here each recorded op
+stores the ``jax.vjp`` pullback of its functional form, and ``backward``
+walks the tape in reverse topological order accumulating cotangents.
+
+This module holds only the tape data structures and thread-local mode state;
+the user-facing API (``record``/``pause``/``backward``/``grad``) lives in
+``mxnet_tpu/autograd``.
+"""
+from __future__ import annotations
+
+import threading
+from typing import Any, Callable, List, Optional, Sequence, Tuple
+
+import jax
+import jax.numpy as jnp
+
+__all__ = [
+    "TapeNode", "is_recording", "is_training", "set_recording",
+    "set_training", "backward_arrays",
+]
+
+
+class _ModeState(threading.local):
+    def __init__(self) -> None:
+        self.recording = False
+        self.training = False
+
+
+_MODE = _ModeState()
+
+
+def is_recording() -> bool:
+    return _MODE.recording
+
+
+def is_training() -> bool:
+    return _MODE.training
+
+
+def set_recording(flag: bool) -> bool:
+    prev, _MODE.recording = _MODE.recording, flag
+    return prev
+
+
+def set_training(flag: bool) -> bool:
+    prev, _MODE.training = _MODE.training, flag
+    return prev
+
+
+class TapeNode:
+    """One recorded op: inputs, output metadata, and the vjp pullback.
+
+    ``vjp_fn`` maps output cotangents -> input cotangents (the analog of the
+    reference's per-op ``FGradient`` subgraph, but computed by jax).
+    """
+
+    __slots__ = ("name", "vjp_fn", "inputs", "out_avals", "out_arrays",
+                 "consumed")
+
+    def __init__(self, name: str, vjp_fn: Callable,
+                 inputs: Sequence[Any],
+                 out_avals: Sequence[Tuple[Tuple[int, ...], Any]]) -> None:
+        self.name = name
+        self.vjp_fn = vjp_fn
+        self.inputs = list(inputs)          # NDArray refs (keep alive)
+        self.out_avals = list(out_avals)    # [(shape, dtype), ...]
+        self.out_arrays: List[Any] = []     # weakrefs to output NDArrays
+        self.consumed = False
+
+    def n_out(self) -> int:
+        return len(self.out_avals)
+
+
+def _toposort(heads: Sequence[Any]) -> List[TapeNode]:
+    """Reverse-topological order of tape nodes reachable from ``heads``."""
+    order: List[TapeNode] = []
+    seen = set()
+
+    # Iterative DFS (deep models overflow Python recursion otherwise).
+    stack: List[Tuple[TapeNode, int]] = []
+    for h in heads:
+        node = h._ag_node
+        if node is not None and id(node) not in seen:
+            seen.add(id(node))
+            stack.append((node, 0))
+        while stack:
+            node, idx = stack.pop()
+            children = [x._ag_node for x in node.inputs
+                        if getattr(x, "_ag_node", None) is not None]
+            if idx < len(children):
+                stack.append((node, idx + 1))
+                child = children[idx]
+                if id(child) not in seen:
+                    seen.add(id(child))
+                    stack.append((child, 0))
+            else:
+                order.append(node)
+    return order[::-1]  # heads-first
+
+
+def backward_arrays(heads: Sequence[Any],
+                    head_grads: Optional[Sequence[Any]] = None,
+                    retain_graph: bool = False,
+                    variables: Optional[Sequence[Any]] = None
+                    ) -> Optional[List[Any]]:
+    """Run reverse-mode accumulation from ``heads``.
+
+    When ``variables`` is None, gradients are written into each attached
+    leaf's ``.grad`` honoring ``grad_req`` ('write' overwrites, 'add'
+    accumulates) — the reference's ``Imperative::Backward`` contract. When
+    ``variables`` is given, returns grads w.r.t. those arrays instead
+    (``autograd.grad``).
+    """
+    from .base import MXNetError
+
+    heads = list(heads)
+    for h in heads:
+        if h._ag_node is None:
+            raise MXNetError(
+                "cannot differentiate a head that was not computed while "
+                "autograd was recording (did you forget autograd.record()?)")
+
+    # Seed cotangents.
+    cots: dict = {}  # id(NDArray._data-slot key) -> jax array; keyed by array wrapper id
+
+    def _add_cot(arr: Any, value: Any) -> None:
+        key = id(arr)
+        if key in cots:
+            cots[key] = cots[key] + value
+        else:
+            cots[key] = value
+
+    if head_grads is None:
+        head_grads = [None] * len(heads)
+    for h, hg in zip(heads, head_grads):
+        if hg is None:
+            seed = jnp.ones(h.shape, dtype=h.dtype)
+        else:
+            seed = hg._data if hasattr(hg, "_data") else jnp.asarray(hg)
+        _add_cot(h, seed)
+
+    order = _toposort(heads)
+
+    # Map node -> the output NDArrays it produced. Outputs hold a reference
+    # to their node; we need the reverse to gather cotangents, so each
+    # NDArray carries (_ag_node, _ag_out_idx) and nodes carry weak output
+    # list via the arrays seen at accumulation time. We reconstruct from
+    # heads + node input links: every cotangent is keyed by the NDArray
+    # wrapper, and nodes learn their outputs when those wrappers were
+    # created (stored on the node).
+    for node in order:
+        if node.consumed:
+            raise MXNetError(
+                f"tape node {node.name} was already consumed by a previous "
+                f"backward; pass retain_graph=True to backward() to allow "
+                f"multiple backward passes over the same graph")
+        outs = node.out_arrays
+        out_cots = []
+        for arr_ref, (shape, dtype) in zip(outs, node.out_avals):
+            arr = arr_ref() if callable(arr_ref) else arr_ref
+            c = cots.get(id(arr)) if arr is not None else None
+            if c is None:
+                c = jnp.zeros(shape, dtype=dtype)
+            out_cots.append(c)
+        payload = tuple(out_cots) if node.n_out() > 1 else out_cots[0]
+        in_cots = node.vjp_fn(payload)
+        if not retain_graph:
+            node.vjp_fn = None
+            node.consumed = True
+        for x, c in zip(node.inputs, in_cots):
+            if c is None:
+                continue
+            _add_cot(x, c)
+
+    if variables is not None:
+        result = []
+        for v in variables:
+            c = cots.get(id(v))
+            if c is None:
+                c = jnp.zeros(v.shape, dtype=v.dtype)
+            result.append(c)
+        return result
+
+    # Write into attached leaves — only after ALL nodes have contributed,
+    # since a leaf feeding several ops accumulates across them.
+    leaves: dict = {}
+    for node in order:
+        for x in node.inputs:
+            if x._grad_req != "null":
+                leaves[id(x)] = x
+    for h in heads:  # a head can itself be an attached leaf
+        if h._grad_req != "null":
+            leaves.setdefault(id(h), h)
+    for x in leaves.values():
+        x._write_grad(cots.get(id(x)))
+    return None

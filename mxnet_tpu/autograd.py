@@ -1,0 +1,174 @@
+"""Autograd — ``record`` / ``pause`` / ``backward`` / ``grad`` / ``Function``.
+
+Reference parity (leezu/mxnet): ``python/mxnet/autograd.py`` over the C API
+``MXAutograd*`` functions, backed by ``src/imperative/imperative.cc``. Tape
+internals live in ``mxnet_tpu/_tape.py`` (vjp-based TapeNodes instead of
+NNVM gradient subgraphs).
+"""
+from __future__ import annotations
+
+from typing import Any, List, Optional, Sequence, Union
+
+import jax
+import jax.numpy as jnp
+
+from ._tape import (TapeNode, backward_arrays, is_recording, is_training,
+                    set_recording, set_training)
+from .base import MXNetError
+from .ndarray.ndarray import NDArray
+
+__all__ = ["record", "pause", "train_mode", "predict_mode", "is_recording",
+           "is_training", "set_recording", "set_training", "backward",
+           "grad", "mark_variables", "Function"]
+
+
+class _RecordingStateScope:
+    """Scope that sets recording/training flags and restores them on exit."""
+
+    def __init__(self, is_record: Optional[bool], train: Optional[bool]) -> None:
+        self._enter_record = is_record
+        self._enter_train = train
+        self._prev_record: Optional[bool] = None
+        self._prev_train: Optional[bool] = None
+
+    def __enter__(self) -> None:
+        if self._enter_record is not None:
+            self._prev_record = set_recording(self._enter_record)
+        if self._enter_train is not None:
+            self._prev_train = set_training(self._enter_train)
+
+    def __exit__(self, *exc: Any) -> None:
+        if self._prev_record is not None:
+            set_recording(self._prev_record)
+        if self._prev_train is not None:
+            set_training(self._prev_train)
+
+
+def record(train_mode: bool = True) -> _RecordingStateScope:  # noqa: D401
+    """Scope recording ops onto the autograd tape (``autograd.record``)."""
+    return _RecordingStateScope(True, train_mode)
+
+
+def pause(train_mode: bool = False) -> _RecordingStateScope:
+    """Scope suspending recording (``autograd.pause``)."""
+    return _RecordingStateScope(False, train_mode)
+
+
+def train_mode() -> _RecordingStateScope:
+    """Scope forcing training behavior of ops (dropout active)."""
+    return _RecordingStateScope(None, True)
+
+
+def predict_mode() -> _RecordingStateScope:
+    """Scope forcing inference behavior of ops."""
+    return _RecordingStateScope(None, False)
+
+
+def mark_variables(variables: Sequence[NDArray],
+                   gradients: Sequence[NDArray],
+                   grad_reqs: Union[str, Sequence[str]] = "write") -> None:
+    """Attach gradient buffers to variables (``MXAutogradMarkVariables``)."""
+    if isinstance(grad_reqs, str):
+        grad_reqs = [grad_reqs] * len(variables)
+    for v, g, req in zip(variables, gradients, grad_reqs):
+        v._grad_req = req
+        v._grad = g
+
+
+def _as_list(x: Any) -> List[Any]:
+    if isinstance(x, (list, tuple)):
+        return list(x)
+    return [x]
+
+
+def backward(heads: Union[NDArray, Sequence[NDArray]],
+             head_grads: Optional[Union[NDArray, Sequence[Optional[NDArray]]]] = None,
+             retain_graph: bool = False, train_mode: bool = True) -> None:
+    """Compute gradients of ``heads`` w.r.t. attached variables."""
+    heads = _as_list(heads)
+    head_grads = _as_list(head_grads) if head_grads is not None else None
+    backward_arrays(heads, head_grads, retain_graph=retain_graph)
+
+
+def grad(heads: Union[NDArray, Sequence[NDArray]],
+         variables: Union[NDArray, Sequence[NDArray]],
+         head_grads: Optional[Sequence[NDArray]] = None,
+         retain_graph: Optional[bool] = None, create_graph: bool = False,
+         train_mode: bool = True) -> Union[NDArray, List[NDArray]]:
+    """Return gradients of heads w.r.t. ``variables`` (``autograd.grad``)."""
+    if create_graph:
+        raise MXNetError(
+            "create_graph=True (higher-order imperative autograd) is not "
+            "supported; differentiate a hybridized block instead, where "
+            "arbitrary-order gradients compose through jax.grad")
+    single = isinstance(variables, NDArray)
+    heads_l = _as_list(heads)
+    vars_l = _as_list(variables)
+    retain = retain_graph if retain_graph is not None else create_graph
+    raws = backward_arrays(heads_l,
+                           _as_list(head_grads) if head_grads is not None else None,
+                           retain_graph=retain, variables=vars_l)
+    outs = [NDArray(r, _wrap=True) for r in raws]
+    return outs[0] if single else outs
+
+
+def get_symbol(x: NDArray) -> None:
+    raise MXNetError("symbol extraction from the tape is not supported; use "
+                     "HybridBlock.export for a serialized graph")
+
+
+class Function:
+    """Custom differentiable function with user-defined backward.
+
+    Reference parity: ``mxnet.autograd.Function`` (CustomFunction op).
+
+    Subclass and implement ``forward(self, *inputs)`` and
+    ``backward(self, *output_grads)`` operating on NDArrays; call the
+    instance to apply it.
+    """
+
+    def __init__(self) -> None:
+        self._saved: tuple = ()
+
+    def save_for_backward(self, *arrays: NDArray) -> None:
+        self._saved = arrays
+
+    @property
+    def saved_tensors(self) -> tuple:
+        return self._saved
+
+    def forward(self, *inputs: NDArray) -> Any:
+        raise NotImplementedError
+
+    def backward(self, *output_grads: NDArray) -> Any:
+        raise NotImplementedError
+
+    def __call__(self, *inputs: NDArray) -> Any:
+        with pause():
+            outputs = self.forward(*inputs)
+        single = not isinstance(outputs, (list, tuple))
+        outs = [outputs] if single else list(outputs)
+
+        if is_recording() and any(x._on_tape for x in inputs
+                                  if isinstance(x, NDArray)):
+            fn = self
+
+            def vjp_fn(cots: Any) -> tuple:
+                cot_list = [cots] if single else list(cots)
+                with pause():
+                    in_grads = fn.backward(
+                        *[NDArray(c, _wrap=True) for c in cot_list])
+                if not isinstance(in_grads, (list, tuple)):
+                    in_grads = [in_grads]
+                return tuple(g._data if isinstance(g, NDArray) else g
+                             for g in in_grads)
+
+            nd_inputs = [x for x in inputs if isinstance(x, NDArray)]
+            avals = [(o.shape, o.dtype) for o in outs]
+            node = TapeNode(type(self).__name__, vjp_fn, nd_inputs, avals)
+            import weakref
+            node.out_arrays = [weakref.ref(o) for o in outs]
+            for i, o in enumerate(outs):
+                o._ag_node = node
+                o._ag_out_idx = i
+        return outs[0] if single else tuple(outs)

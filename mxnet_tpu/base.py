@@ -1,0 +1,108 @@
+"""Base utilities: error types, env-var config tier, registry helpers.
+
+Reference parity (leezu/mxnet):
+  - ``python/mxnet/base.py`` (MXNetError, _LIB ctypes bootstrap)
+  - ``3rdparty/dmlc-core`` env handling (``dmlc::GetEnv``) -> :func:`getenv`
+  - ``src/c_api/c_api_error.cc`` error trampoline -> here errors are native
+    Python exceptions; async device errors surface at sync points
+    (see ``mxnet_tpu/engine.py``).
+
+The env-var tier mirrors the reference's ``MXNET_*`` runtime config surface
+(SURVEY.md section 5.6 tier 1).
+"""
+from __future__ import annotations
+
+import os
+import threading
+from typing import Any, Callable, Dict, Optional
+
+__all__ = [
+    "MXNetError",
+    "NotImplementedForSymbol",
+    "getenv",
+    "register_env",
+    "list_env",
+    "classproperty",
+]
+
+
+class MXNetError(RuntimeError):
+    """Default error thrown by framework operations.
+
+    Mirrors ``mxnet.base.MXNetError``. Errors raised inside asynchronously
+    dispatched device computations are re-raised from sync points
+    (``wait_to_read`` / ``asnumpy`` / ``waitall``), matching the reference
+    engine's rethrow-at-sync semantics
+    (``src/engine/threaded_engine.cc`` exception handling).
+    """
+
+
+class NotImplementedForSymbol(MXNetError):
+    """Raised when an imperative-only API is used under symbolic tracing."""
+
+    def __init__(self, function: Any, *args: Any) -> None:
+        super().__init__(
+            f"Function {getattr(function, '__name__', function)} is not "
+            f"supported under hybridize tracing."
+        )
+
+
+# ---------------------------------------------------------------------------
+# Env-var config tier (reference: docs/.../env_var.md, ~80 MXNET_* vars)
+# ---------------------------------------------------------------------------
+
+_ENV_REGISTRY: Dict[str, Dict[str, Any]] = {}
+_ENV_LOCK = threading.Lock()
+
+
+def register_env(name: str, default: Any, doc: str = "") -> None:
+    """Register a recognized ``MXNET_*`` environment variable with default+doc.
+
+    Powers :func:`list_env` (the analog of the reference's env_var.md page).
+    """
+    with _ENV_LOCK:
+        _ENV_REGISTRY[name] = {"default": default, "doc": doc}
+
+
+def getenv(name: str, default: Any = None, typ: Optional[type] = None) -> Any:
+    """Read an environment variable with type coercion (``dmlc::GetEnv``)."""
+    if name in _ENV_REGISTRY and default is None:
+        default = _ENV_REGISTRY[name]["default"]
+    raw = os.environ.get(name)
+    if raw is None:
+        return default
+    if typ is None:
+        typ = type(default) if default is not None else str
+    if typ is bool:
+        return raw.lower() in ("1", "true", "yes", "on")
+    try:
+        return typ(raw)
+    except (TypeError, ValueError):
+        return default
+
+
+def list_env() -> Dict[str, Dict[str, Any]]:
+    """Return the registered env-var config surface (name -> default/doc)."""
+    with _ENV_LOCK:
+        return {k: dict(v) for k, v in _ENV_REGISTRY.items()}
+
+
+# Core runtime vars (more are registered at their use sites).
+register_env("MXNET_ENGINE_TYPE", "ThreadedEnginePerDevice",
+             "Execution mode: 'NaiveEngine' forces synchronous per-op "
+             "execution (block_until_ready after every op) for debugging; "
+             "anything else uses async XLA dispatch.")
+register_env("MXNET_EXEC_BULK_EXEC_TRAIN", 1,
+             "Enable bulked execution (jit) of hybridized training graphs.")
+register_env("MXNET_ENFORCE_DETERMINISM", 0,
+             "Restrict to deterministic kernels.")
+
+
+class classproperty:  # noqa: N801 - decorator naming
+    """Read-only class-level property helper."""
+
+    def __init__(self, fget: Callable[[Any], Any]) -> None:
+        self.fget = fget
+
+    def __get__(self, obj: Any, owner: Optional[type] = None) -> Any:
+        return self.fget(owner)

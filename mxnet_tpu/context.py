@@ -1,0 +1,149 @@
+"""Device context: ``cpu()`` / ``tpu()`` (with ``gpu()`` as a compat alias).
+
+Reference parity (leezu/mxnet): ``python/mxnet/context.py`` (``Context``,
+``mx.cpu()``, ``mx.gpu()``, ``current_context``, ``num_gpus``). The reference
+pins NDArrays to CUDA devices; here a Context resolves to a ``jax.Device``
+and placement is via ``jax.device_put``. ``gpu(i)`` aliases the accelerator
+(TPU) so reference-era scripts keep working.
+"""
+from __future__ import annotations
+
+import threading
+from typing import List, Optional
+
+import jax
+
+__all__ = [
+    "Context", "cpu", "gpu", "tpu", "current_context", "num_gpus", "num_tpus",
+    "cpu_pinned",
+]
+
+_ACCEL_TYPES = ("tpu", "gpu", "cuda", "rocm", "axon")
+
+
+def _accel_devices() -> List["jax.Device"]:
+    """All non-CPU jax devices (TPU chips; empty on CPU-only hosts)."""
+    try:
+        devs = jax.devices()
+    except RuntimeError:
+        return []
+    return [d for d in devs if d.platform != "cpu"]
+
+
+class Context:
+    """A device context, hashable and comparable.
+
+    Parameters
+    ----------
+    device_type : str
+        One of ``'cpu'``, ``'tpu'``, ``'gpu'`` (alias of tpu),
+        ``'cpu_pinned'``, ``'cpu_shared'`` (aliases of cpu).
+    device_id : int
+        Index within devices of that type.
+    """
+
+    devtype2str = {1: "cpu", 2: "tpu", 3: "cpu_pinned", 5: "cpu_shared"}
+    devstr2type = {"cpu": 1, "tpu": 2, "gpu": 2, "cuda": 2,
+                   "cpu_pinned": 3, "cpu_shared": 5}
+
+    _default_ctx = threading.local()
+
+    def __init__(self, device_type: str, device_id: int = 0) -> None:
+        if device_type not in self.devstr2type:
+            raise ValueError(f"unknown device type {device_type!r}")
+        self.device_typeid = self.devstr2type[device_type]
+        self.device_id = device_id
+
+    @property
+    def device_type(self) -> str:
+        return self.devtype2str[self.device_typeid]
+
+    # -- jax resolution ----------------------------------------------------
+    @property
+    def jax_device(self) -> "jax.Device":
+        """Resolve to the concrete ``jax.Device`` backing this context."""
+        if self.device_typeid == 2:
+            accel = _accel_devices()
+            if not accel:
+                # CPU fallback keeps ctx=tpu code runnable on CPU-only hosts
+                # (mirrors the reference's graceful "GPU not enabled" UX but
+                # non-fatally, since XLA:CPU runs the same programs).
+                cpus = [d for d in jax.devices() if d.platform == "cpu"]
+                return cpus[min(self.device_id, len(cpus) - 1)]
+            return accel[self.device_id % len(accel)]
+        cpus = [d for d in jax.devices("cpu")] if _has_cpu_backend() else jax.devices()
+        return cpus[min(self.device_id, len(cpus) - 1)]
+
+    # -- equality / hashing ------------------------------------------------
+    def __eq__(self, other: object) -> bool:
+        return (isinstance(other, Context)
+                and self.device_typeid == other.device_typeid
+                and self.device_id == other.device_id)
+
+    def __hash__(self) -> int:
+        return hash((self.device_typeid, self.device_id))
+
+    def __repr__(self) -> str:
+        return f"{self.device_type}({self.device_id})"
+
+    def __str__(self) -> str:
+        return self.__repr__()
+
+    def __enter__(self) -> "Context":
+        if not hasattr(Context._default_ctx, "stack"):
+            Context._default_ctx.stack = []
+        Context._default_ctx.stack.append(self)
+        return self
+
+    def __exit__(self, *exc: object) -> None:
+        Context._default_ctx.stack.pop()
+
+    @classmethod
+    def default_ctx(cls) -> "Context":
+        stack = getattr(cls._default_ctx, "stack", None)
+        if stack:
+            return stack[-1]
+        return cpu()
+
+
+def _has_cpu_backend() -> bool:
+    try:
+        jax.devices("cpu")
+        return True
+    except RuntimeError:
+        return False
+
+
+def cpu(device_id: int = 0) -> Context:
+    """Return a CPU context (reference: ``mx.cpu()``)."""
+    return Context("cpu", device_id)
+
+
+def cpu_pinned(device_id: int = 0) -> Context:
+    """Pinned-memory CPU context; alias of cpu under XLA (no pinned pools)."""
+    return Context("cpu_pinned", device_id)
+
+
+def tpu(device_id: int = 0) -> Context:
+    """Return a TPU context — the accelerator context of this framework."""
+    return Context("tpu", device_id)
+
+
+def gpu(device_id: int = 0) -> Context:
+    """Compat alias for :func:`tpu` so reference-era scripts run unchanged."""
+    return Context("gpu", device_id)
+
+
+def num_tpus() -> int:
+    """Number of visible TPU chips (reference analog: ``mx.context.num_gpus``)."""
+    return len(_accel_devices())
+
+
+def num_gpus() -> int:
+    """Compat alias of :func:`num_tpus`."""
+    return num_tpus()
+
+
+def current_context() -> Context:
+    """The default context (innermost ``with ctx:`` scope, else cpu)."""
+    return Context.default_ctx()

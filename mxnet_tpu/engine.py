@@ -1,0 +1,113 @@
+"""Execution-engine semantics over JAX's asynchronous dispatch.
+
+Reference parity (leezu/mxnet): ``src/engine/threaded_engine*.cc``,
+``include/mxnet/engine.h``. The reference's dependency engine exists so that
+Python returns immediately while kernels run on device streams, with
+correctness enforced by read/write var lists. XLA/PJRT gives the same
+contract natively: every dispatched computation is asynchronous, ordered per
+device stream, with data dependencies tracked by buffer futures. The
+"engine" therefore shrinks to:
+
+  * :func:`waitall`  — barrier on all outstanding device work
+    (``Engine::WaitForAll`` / ``mx.nd.waitall``).
+  * per-array ``wait_to_read`` — ``block_until_ready``
+    (``Engine::WaitForVar``).
+  * :func:`is_naive` — ``MXNET_ENGINE_TYPE=NaiveEngine`` forces a block
+    after every op, the reference's standard first debugging step for
+    suspected async races (SURVEY.md section 5.2).
+
+Async errors: XLA poisons dependent buffers; blocking re-raises the original
+error. :func:`_sync_and_translate` converts those into :class:`MXNetError`
+at sync points, matching the reference's rethrow-at-sync behavior
+(``src/engine/threaded_engine.cc`` OnCompleteStatic exception path).
+"""
+from __future__ import annotations
+
+import weakref
+from typing import Any, Dict, Iterable
+
+import jax
+
+from .base import MXNetError, getenv
+
+__all__ = ["waitall", "is_naive", "set_bulk_size", "bulk"]
+
+# Weak registry of live device arrays so waitall() can provide a true
+# barrier. jax arrays are weakref-able but unhashable, so this is an
+# id-keyed dict of weakrefs, swept when it grows past a bound.
+_LIVE: Dict[int, "weakref.ref"] = {}
+_SWEEP_AT = 4096
+
+
+def is_naive() -> bool:
+    """True when MXNET_ENGINE_TYPE=NaiveEngine (fully synchronous mode)."""
+    return getenv("MXNET_ENGINE_TYPE", "ThreadedEnginePerDevice") == "NaiveEngine"
+
+
+def track(arr: Any) -> Any:
+    """Register a device array with the engine; blocks if in naive mode."""
+    try:
+        _LIVE[id(arr)] = weakref.ref(arr)
+    except TypeError:  # plain numpy scalars etc. need no tracking
+        pass
+    if len(_LIVE) > _SWEEP_AT:
+        for k in [k for k, r in _LIVE.items() if r() is None]:
+            del _LIVE[k]
+    if is_naive():
+        _sync_and_translate(arr)
+    return arr
+
+
+def _sync_and_translate(arr: Any) -> Any:
+    """Block on ``arr``; translate device-side errors into MXNetError."""
+    try:
+        return jax.block_until_ready(arr)
+    except MXNetError:
+        raise
+    except Exception as exc:  # XLA raises XlaRuntimeError and friends
+        raise MXNetError(str(exc)) from exc
+
+
+def waitall() -> None:
+    """Block until all pushed device work completes (``mx.nd.waitall``)."""
+    for key, ref in list(_LIVE.items()):
+        arr = ref()
+        if arr is not None:
+            _sync_and_translate(arr)
+        _LIVE.pop(key, None)
+
+
+def wait(arrs: Iterable[Any]) -> None:
+    for a in arrs:
+        _sync_and_translate(a)
+
+
+# ---------------------------------------------------------------------------
+# Bulking knobs (reference: MXNET_EXEC_BULK_EXEC_* + Engine::bulk_size).
+# Under XLA, "bulking" is jit fusion; these exist for API parity and to let
+# callers scope a hint. They are accepted and recorded, not load-bearing.
+# ---------------------------------------------------------------------------
+
+_bulk_size = 15
+
+
+def set_bulk_size(size: int) -> int:
+    """Set the bulk-execution segment-size hint; returns the previous value."""
+    global _bulk_size
+    prev, _bulk_size = _bulk_size, size
+    return prev
+
+
+class bulk:
+    """Context manager scoping a bulk-size hint (``mx.engine.bulk``)."""
+
+    def __init__(self, size: int) -> None:
+        self._size = size
+        self._prev = None
+
+    def __enter__(self) -> "bulk":
+        self._prev = set_bulk_size(self._size)
+        return self
+
+    def __exit__(self, *exc: Any) -> None:
+        set_bulk_size(self._prev)

@@ -1,0 +1,15 @@
+"""``mx.nd`` — the imperative NDArray namespace.
+
+Reference parity: ``python/mxnet/ndarray/`` — NDArray class, generated op
+namespace, random, legacy aliases. The numpy-semantics namespace ``mx.np``
+reuses these same ops (see ``mxnet_tpu/numpy``).
+"""
+from .ndarray import NDArray, from_jax, waitall
+from .ops import *  # noqa: F401,F403
+from .ops import __all__ as _ops_all
+from . import ops
+from . import random
+from .register import get_op, list_ops, register_op, invoke
+
+__all__ = ["NDArray", "from_jax", "waitall", "random",
+           "get_op", "list_ops", "register_op"] + list(_ops_all)

@@ -1,0 +1,211 @@
+"""Random sampling ops over a splittable threefry PRNG.
+
+Reference parity (leezu/mxnet): ``src/operator/random/sample_op.*`` and
+``src/common/random_generator.*`` (philox/curand per-thread generators),
+python ``mxnet/ndarray/random.py``.
+
+Design (tpu-first): adopts jax's counter-based threefry keys (documented
+break from philox — same statistical family, different streams). A global
+key is held per process; every eager sample splits it (the analog of the
+reference's per-op ``FResourceRequest::kParallelRandom`` states). Under
+hybridize tracing, the key is threaded through the traced function as an
+input so compiled graphs stay pure (see gluon/block.py CachedOp).
+"""
+from __future__ import annotations
+
+import threading
+from typing import Any, Optional
+
+import jax
+import jax.numpy as jnp
+
+from ..base import getenv, register_env
+from .ndarray import NDArray, from_jax
+from .register import invoke
+
+__all__ = ["seed", "uniform", "normal", "randn", "randint", "gamma",
+           "exponential", "poisson", "bernoulli", "multinomial", "choice",
+           "shuffle", "beta", "laplace", "gumbel", "rand", "current_key",
+           "split_key", "trace_key_scope"]
+
+register_env("MXNET_RANDOM_SEED", 0, "Initial global PRNG seed.")
+
+
+class _RngState(threading.local):
+    def __init__(self) -> None:
+        self.key = jax.random.PRNGKey(getenv("MXNET_RANDOM_SEED", 0))
+        # During hybridize tracing, ops must draw subkeys from the traced
+        # key input rather than the concrete global key.
+        self.trace_key: Optional[Any] = None
+        self.trace_count = 0
+
+
+_STATE = _RngState()
+
+
+def seed(seed_state: int, ctx: Any = "all") -> None:
+    """Reset the global PRNG (``mx.random.seed``)."""
+    _STATE.key = jax.random.PRNGKey(int(seed_state))
+
+
+def current_key() -> Any:
+    return _STATE.key
+
+
+def split_key() -> Any:
+    """Draw a fresh subkey (eager) or fold from the traced key (tracing)."""
+    if _STATE.trace_key is not None:
+        _STATE.trace_count += 1
+        return jax.random.fold_in(_STATE.trace_key, _STATE.trace_count)
+    _STATE.key, sub = jax.random.split(_STATE.key)
+    return sub
+
+
+class trace_key_scope:
+    """Bind a traced PRNG key for the duration of a hybridize trace."""
+
+    def __init__(self, key: Any) -> None:
+        self._key = key
+
+    def __enter__(self) -> None:
+        self._prev = (_STATE.trace_key, _STATE.trace_count)
+        _STATE.trace_key, _STATE.trace_count = self._key, 0
+
+    def __exit__(self, *exc: Any) -> None:
+        _STATE.trace_key, _STATE.trace_count = self._prev
+
+
+def _shape(shape) -> tuple:
+    if shape is None:
+        return ()
+    if isinstance(shape, int):
+        return (shape,)
+    return tuple(shape)
+
+
+def _sample(name: str, fn, ctx=None) -> NDArray:
+    out = fn(split_key())
+    nd = from_jax(out)
+    from .. import engine
+    engine.track(out)
+    return nd
+
+
+def uniform(low=0.0, high=1.0, shape=None, dtype="float32", ctx=None, **kw):
+    """Uniform samples in [low, high) (``mx.nd.random.uniform``)."""
+    shp = _shape(shape)
+    return _sample("uniform",
+                   lambda k: jax.random.uniform(k, shp, dtype=dtype,
+                                                minval=low, maxval=high), ctx)
+
+
+def rand(*shape, ctx=None, dtype="float32"):
+    return uniform(0.0, 1.0, shape=shape, dtype=dtype, ctx=ctx)
+
+
+def normal(loc=0.0, scale=1.0, shape=None, dtype="float32", ctx=None, **kw):
+    shp = _shape(shape)
+    return _sample("normal",
+                   lambda k: loc + scale * jax.random.normal(k, shp, dtype=dtype),
+                   ctx)
+
+
+def randn(*shape, loc=0.0, scale=1.0, dtype="float32", ctx=None):
+    return normal(loc, scale, shape=shape, dtype=dtype, ctx=ctx)
+
+
+def randint(low, high=None, shape=None, dtype="int32", ctx=None, **kw):
+    if high is None:
+        low, high = 0, low
+    shp = _shape(shape)
+    return _sample("randint",
+                   lambda k: jax.random.randint(k, shp, low, high, dtype=dtype),
+                   ctx)
+
+
+def gamma(alpha=1.0, beta=1.0, shape=None, dtype="float32", ctx=None, **kw):
+    shp = _shape(shape)
+    return _sample("gamma",
+                   lambda k: jax.random.gamma(k, alpha, shp, dtype=dtype) * beta,
+                   ctx)
+
+
+def exponential(scale=1.0, shape=None, dtype="float32", ctx=None, **kw):
+    shp = _shape(shape)
+    return _sample("exponential",
+                   lambda k: jax.random.exponential(k, shp, dtype=dtype) * scale,
+                   ctx)
+
+
+def poisson(lam=1.0, shape=None, dtype="float32", ctx=None, **kw):
+    shp = _shape(shape)
+    return _sample("poisson",
+                   lambda k: jax.random.poisson(k, lam, shp).astype(dtype), ctx)
+
+
+def bernoulli(prob=0.5, shape=None, dtype="float32", ctx=None, **kw):
+    shp = _shape(shape)
+    return _sample("bernoulli",
+                   lambda k: jax.random.bernoulli(k, prob, shp).astype(dtype),
+                   ctx)
+
+
+def beta(a=1.0, b=1.0, shape=None, dtype="float32", ctx=None, **kw):
+    shp = _shape(shape)
+    return _sample("beta",
+                   lambda k: jax.random.beta(k, a, b, shp).astype(dtype), ctx)
+
+
+def laplace(loc=0.0, scale=1.0, shape=None, dtype="float32", ctx=None, **kw):
+    shp = _shape(shape)
+    return _sample("laplace",
+                   lambda k: loc + scale * jax.random.laplace(k, shp, dtype=dtype),
+                   ctx)
+
+
+def gumbel(loc=0.0, scale=1.0, shape=None, dtype="float32", ctx=None, **kw):
+    shp = _shape(shape)
+    return _sample("gumbel",
+                   lambda k: loc + scale * jax.random.gumbel(k, shp, dtype=dtype),
+                   ctx)
+
+
+def multinomial(data, shape=1, get_prob=False, dtype="int32", **kw):
+    """Sample category indices from (batched) probability rows; with
+    ``get_prob=True`` also return the log-probability of each draw
+    (``mx.nd.random.multinomial`` — REINFORCE-style usage)."""
+    n = shape if isinstance(shape, int) else int(jnp.prod(jnp.array(shape)))
+    probs = data._data if isinstance(data, NDArray) else jnp.asarray(data)
+    logits = jnp.log(jnp.maximum(probs, 1e-38))
+    k = split_key()
+    squeeze = isinstance(shape, int) and shape == 1
+    if logits.ndim == 1:
+        out = jax.random.categorical(k, logits, shape=(n,))
+        logp = jax.nn.log_softmax(logits)[out]
+        if squeeze:
+            out, logp = out[0], logp[0]
+    else:
+        out = jax.random.categorical(k, logits[:, None, :], axis=-1,
+                                     shape=(logits.shape[0], n))
+        logp = jnp.take_along_axis(jax.nn.log_softmax(logits, axis=-1),
+                                   out, axis=1)
+        if squeeze:
+            out, logp = out[:, 0], logp[:, 0]
+    if get_prob:
+        return from_jax(out.astype(dtype)), from_jax(logp)
+    return from_jax(out.astype(dtype))
+
+
+def choice(a, size=None, replace=True, p=None, ctx=None):
+    aa = a._data if isinstance(a, NDArray) else a
+    pp = p._data if isinstance(p, NDArray) else p
+    shp = _shape(size)
+    return _sample("choice",
+                   lambda k: jax.random.choice(k, aa, shp, replace=replace, p=pp),
+                   ctx)
+
+
+def shuffle(data):
+    """Random permutation along the first axis (``mx.nd.random.shuffle``)."""
+    arr = data._data if isinstance(data, NDArray) else jnp.asarray(data)
+    return from_jax(jax.random.permutation(split_key(), arr, axis=0))

@@ -1,0 +1,91 @@
+"""Op invocation core and registry.
+
+Reference parity (leezu/mxnet): the NNVM registry + imperative dispatch —
+``NNVM_REGISTER_OP`` / ``Imperative::Invoke`` / ``PushFCompute``
+(``src/imperative/imperative_utils.h``) and the Python generated-op layer
+(``python/mxnet/ndarray/register.py``).
+
+Design (tpu-first): every op is a pure function over jax arrays. Imperative
+execution dispatches it directly (jax's C++ eager path + async device
+streams stand in for the ThreadedEngine). When autograd is recording and an
+input is on the tape, the op executes under ``jax.vjp`` and a TapeNode holds
+the pullback. Under hybridize, the same Python op functions run with tracers
+inside one ``jax.jit`` — the analog of CachedOp bulking, with XLA doing the
+fusion the reference got from pointwise-fusion RTC codegen.
+"""
+from __future__ import annotations
+
+import functools
+import weakref
+from typing import Any, Callable, Dict, List, Optional, Sequence
+
+import jax
+import jax.numpy as jnp
+
+from .. import engine
+from .._tape import TapeNode, is_recording
+
+__all__ = ["invoke", "register_op", "get_op", "list_ops", "wrap_out"]
+
+# name -> {"fn": public python fn, "doc": ...}
+_OP_REGISTRY: Dict[str, Dict[str, Any]] = {}
+
+
+def register_op(name: str, fn: Callable, doc: str = "") -> Callable:
+    """Register a public op under ``name`` (NNVM_REGISTER_OP analog)."""
+    _OP_REGISTRY[name] = {"fn": fn, "doc": doc or (fn.__doc__ or "")}
+    return fn
+
+
+def get_op(name: str) -> Callable:
+    """Look up a registered op by name (``mx.nd.op``-style access)."""
+    return _OP_REGISTRY[name]["fn"]
+
+
+def list_ops() -> List[str]:
+    """All registered op names (``MXListAllOpNames`` analog)."""
+    return sorted(_OP_REGISTRY)
+
+
+def _ndarray_cls():
+    from .ndarray import NDArray
+    return NDArray
+
+
+def wrap_out(data: Any, ctx=None) -> Any:
+    """Wrap a raw jax array (or tracer) into an NDArray and track it."""
+    NDArray = _ndarray_cls()
+    out = NDArray(data, ctx=ctx, _wrap=True)
+    engine.track(data)
+    return out
+
+
+def invoke(name: str, impl: Callable, inputs: Sequence[Any],
+           ctx=None) -> Any:
+    """Execute op ``impl`` over NDArray ``inputs``; handle autograd.
+
+    ``impl`` takes the raw arrays positionally (attrs must already be bound
+    into the closure) and returns one array or a tuple of arrays.
+    """
+    arrays = [x._data for x in inputs]
+
+    record = is_recording() and any(x._on_tape for x in inputs)
+    if record:
+        outs, vjp_fn = jax.vjp(impl, *arrays)
+    else:
+        outs = impl(*arrays)
+
+    single = not isinstance(outs, (tuple, list))
+    outs_t = (outs,) if single else tuple(outs)
+
+    wrapped = [wrap_out(o, ctx=ctx) for o in outs_t]
+
+    if record:
+        avals = [(tuple(o.shape), o.dtype) for o in outs_t]
+        node = TapeNode(name, vjp_fn, inputs, avals)
+        node.out_arrays = [weakref.ref(w) for w in wrapped]
+        for i, w in enumerate(wrapped):
+            w._ag_node = node
+            w._ag_out_idx = i
+
+    return wrapped[0] if single else tuple(wrapped)

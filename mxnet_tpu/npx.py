@@ -1,0 +1,20 @@
+"""``mx.npx`` — numpy-extension namespace (NN ops + runtime utilities).
+
+Reference parity: ``python/mxnet/numpy_extension/`` — operators outside the
+NumPy standard (conv, pooling, norms, sequence ops) plus ``set_np`` and
+device helpers.
+"""
+from .ops.nn import *  # noqa: F401,F403
+from .ops.nn import __all__ as _nn_all
+from .util import set_np, reset_np, is_np_array, is_np_shape, use_np
+from .context import cpu, gpu, tpu, num_gpus, num_tpus, current_context
+from .ndarray.ndarray import waitall
+from .ndarray.ops import (one_hot, topk, pad, arange, reshape,  # noqa: F401
+                          gather_nd, scatter_nd)
+
+__all__ = list(_nn_all) + [
+    "set_np", "reset_np", "is_np_array", "is_np_shape", "use_np",
+    "cpu", "gpu", "tpu", "num_gpus", "num_tpus", "current_context",
+    "waitall", "one_hot", "topk", "pad", "arange", "reshape", "gather_nd",
+    "scatter_nd",
+]

@@ -1,0 +1,36 @@
+"""``mx.np`` — numpy-semantics array namespace (the primary user API).
+
+Reference parity: ``python/mxnet/numpy/`` (multiarray.py etc., the 2.x
+NumPy interface that the leezu fork's era standardized on). Shares the one
+op registry with ``mx.nd`` — same NDArray type, same functions — per the
+"one op set, two execution modes" design (SURVEY.md section 0).
+"""
+import numpy as _onp
+
+from ..ndarray.ndarray import NDArray as ndarray  # noqa: N813
+from ..ndarray.ndarray import NDArray, from_jax
+from ..ndarray.ops import *  # noqa: F401,F403
+from ..ndarray.ops import __all__ as _ops_all
+from ..ndarray import random  # noqa: F401
+
+# dtype aliases / constants
+float16 = _onp.float16
+float32 = _onp.float32
+float64 = _onp.float64
+bfloat16 = "bfloat16"
+int8 = _onp.int8
+int16 = _onp.int16
+int32 = _onp.int32
+int64 = _onp.int64
+uint8 = _onp.uint8
+bool_ = _onp.bool_
+pi = _onp.pi
+e = _onp.e
+inf = _onp.inf
+nan = _onp.nan
+newaxis = None
+dtype = _onp.dtype
+
+__all__ = ["ndarray", "NDArray", "from_jax", "random", "float16", "float32",
+           "float64", "bfloat16", "int8", "int16", "int32", "int64", "uint8",
+           "bool_", "pi", "e", "inf", "nan", "newaxis", "dtype"] + list(_ops_all)

@@ -1,0 +1,8 @@
+"""Functional neural-net op library (``mx.npx``-style extensions).
+
+Reference parity: ``src/operator/nn/*`` — see ``nn.py``.
+"""
+from .nn import *  # noqa: F401,F403
+from .nn import __all__ as _nn_all
+
+__all__ = list(_nn_all)

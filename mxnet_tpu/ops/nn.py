@@ -1,0 +1,665 @@
+"""Neural-network operators: conv, pooling, norms, activations, embedding.
+
+Reference parity (leezu/mxnet): ``src/operator/nn/`` — Convolution
+(cudnn_convolution-inl.h), FullyConnected, BatchNorm, LayerNorm, GroupNorm,
+Pooling, Activation, Softmax, Dropout, Embedding — and assorted
+``src/operator/tensor`` NN helpers (``pick``, ``SequenceMask``).
+
+Design (tpu-first): everything lowers to ``jax.lax`` convolution/reduce-window
+/dot primitives that XLA tiles onto the MXU; there are no per-backend kernel
+variants (cuDNN/MKLDNN dispatch collapses into XLA). Layouts accept the
+reference's NCHW default but NHWC is supported and preferred on TPU; XLA's
+layout assignment handles the rest. Dropout draws from the splittable
+threefry stream (``ndarray/random.py``), active only in autograd train mode,
+matching reference mode semantics (``mxnet.autograd.is_training``).
+"""
+from __future__ import annotations
+
+from typing import Any, Optional, Sequence, Tuple, Union
+
+import jax
+import jax.numpy as jnp
+from jax import lax
+
+from .._tape import is_training
+from ..ndarray.ndarray import NDArray
+from ..ndarray.ops import _as_nd
+from ..ndarray.register import invoke, register_op
+from ..ndarray import random as _random
+
+__all__ = [
+    "activation", "relu", "leaky_relu", "prelu", "elu", "selu", "gelu",
+    "silu", "swish", "mish", "softrelu", "softsign", "hard_sigmoid",
+    "hard_swish", "log_sigmoid",
+    "softmax", "log_softmax", "masked_softmax", "masked_log_softmax",
+    "fully_connected", "convolution", "deconvolution", "pooling",
+    "adaptive_avg_pool2d", "batch_norm", "layer_norm", "group_norm",
+    "instance_norm", "rms_norm", "l2_normalization", "lrn",
+    "dropout", "embedding", "pick", "sequence_mask", "sequence_last",
+    "sequence_reverse", "topk_mask", "smooth_l1",
+]
+
+
+def _pair(v, n):
+    if isinstance(v, int):
+        return (v,) * n
+    return tuple(v)
+
+
+# ---------------------------------------------------------------------------
+# Activations (reference: src/operator/nn/activation.cc, leaky_relu.cc,
+# contrib gelu; python gluon.nn.activations)
+# ---------------------------------------------------------------------------
+
+_ACT_FNS = {
+    "relu": jax.nn.relu,
+    "sigmoid": jax.nn.sigmoid,
+    "log_sigmoid": jax.nn.log_sigmoid,
+    "tanh": jnp.tanh,
+    "softrelu": jax.nn.softplus,
+    "softplus": jax.nn.softplus,
+    "softsign": jax.nn.soft_sign,
+    "gelu": jax.nn.gelu,
+    "silu": jax.nn.silu,
+    "swish": jax.nn.silu,
+    "mish": jax.nn.mish,
+    "identity": lambda x: x,
+}
+
+
+def activation(data, act_type: str = "relu"):
+    """Apply a named activation (reference: ``Activation`` op)."""
+    fn = _ACT_FNS[act_type]
+    return invoke(f"activation_{act_type}", fn, (_as_nd(data),))
+
+
+def relu(data):
+    return invoke("relu", jax.nn.relu, (_as_nd(data),))
+
+
+def leaky_relu(data, slope: float = 0.25, act_type: str = "leaky"):
+    s = slope
+    if act_type in ("leaky", "rrelu"):
+        return invoke("leaky_relu", lambda x: jax.nn.leaky_relu(x, s),
+                      (_as_nd(data),))
+    if act_type == "elu":
+        return elu(data, s)
+    if act_type == "gelu":
+        return invoke("gelu", jax.nn.gelu, (_as_nd(data),))
+    if act_type == "selu":
+        return selu(data)
+    raise ValueError(f"unknown leaky_relu act_type {act_type}")
+
+
+def prelu(data, gamma):
+    def impl(x, g):
+        return jnp.where(x >= 0, x, g * x)
+    return invoke("prelu", impl, (_as_nd(data), _as_nd(gamma)))
+
+
+def elu(data, alpha: float = 1.0):
+    a = alpha
+    return invoke("elu", lambda x: jax.nn.elu(x, a), (_as_nd(data),))
+
+
+def selu(data):
+    return invoke("selu", jax.nn.selu, (_as_nd(data),))
+
+
+def gelu(data, approximate: bool = False):
+    ap = approximate
+    return invoke("gelu", lambda x: jax.nn.gelu(x, approximate=ap),
+                  (_as_nd(data),))
+
+
+def silu(data):
+    return invoke("silu", jax.nn.silu, (_as_nd(data),))
+
+
+swish = silu
+
+
+def mish(data):
+    return invoke("mish", jax.nn.mish, (_as_nd(data),))
+
+
+def softrelu(data):
+    return invoke("softrelu", jax.nn.softplus, (_as_nd(data),))
+
+
+def softsign(data):
+    return invoke("softsign", jax.nn.soft_sign, (_as_nd(data),))
+
+
+def log_sigmoid(data):
+    return invoke("log_sigmoid", jax.nn.log_sigmoid, (_as_nd(data),))
+
+
+def hard_sigmoid(data, alpha: float = 0.2, beta: float = 0.5):
+    a, b = alpha, beta
+    return invoke("hard_sigmoid", lambda x: jnp.clip(a * x + b, 0.0, 1.0),
+                  (_as_nd(data),))
+
+
+def hard_swish(data):
+    return invoke("hard_swish", lambda x: x * jnp.clip(x / 6.0 + 0.5, 0.0, 1.0),
+                  (_as_nd(data),))
+
+
+# ---------------------------------------------------------------------------
+# Softmax family (reference: src/operator/nn/softmax.cc)
+# ---------------------------------------------------------------------------
+
+def softmax(data, axis: int = -1, temperature: Optional[float] = None,
+            length=None):
+    ax, t = axis, temperature
+    if length is not None:
+        return masked_softmax(data, _length_mask(data, length, axis), axis)
+    def impl(x):
+        if t is not None and t != 1.0:
+            x = x / t
+        return jax.nn.softmax(x, axis=ax)
+    return invoke("softmax", impl, (_as_nd(data),))
+
+
+def log_softmax(data, axis: int = -1, temperature: Optional[float] = None):
+    ax, t = axis, temperature
+    def impl(x):
+        if t is not None and t != 1.0:
+            x = x / t
+        return jax.nn.log_softmax(x, axis=ax)
+    return invoke("log_softmax", impl, (_as_nd(data),))
+
+
+def _length_mask(data, length, axis):
+    nd = _as_nd(data)
+    L = nd.shape[axis]
+    ln = _as_nd(length)
+    def impl(l):
+        ar = jnp.arange(L)
+        shape = [1] * len(nd.shape)
+        shape[axis] = L
+        ar = ar.reshape(shape)
+        ll = l.reshape(l.shape + (1,) * (len(nd.shape) - l.ndim))
+        return ar < ll
+    return invoke("length_mask", impl, (ln,))
+
+
+def masked_softmax(data, mask, axis: int = -1):
+    ax = axis
+    def impl(x, m):
+        neg = jnp.finfo(x.dtype).min if jnp.issubdtype(x.dtype, jnp.floating) \
+            else -1e9
+        x = jnp.where(m, x, neg)
+        out = jax.nn.softmax(x, axis=ax)
+        return jnp.where(m, out, 0.0)
+    return invoke("masked_softmax", impl, (_as_nd(data), _as_nd(mask)))
+
+
+def masked_log_softmax(data, mask, axis: int = -1):
+    ax = axis
+    def impl(x, m):
+        neg = jnp.finfo(x.dtype).min
+        x = jnp.where(m, x, neg)
+        return jax.nn.log_softmax(x, axis=ax)
+    return invoke("masked_log_softmax", impl, (_as_nd(data), _as_nd(mask)))
+
+
+# ---------------------------------------------------------------------------
+# FullyConnected (reference: src/operator/nn/fully_connected.cc — cuBLAS gemm;
+# here an MXU matmul)
+# ---------------------------------------------------------------------------
+
+def fully_connected(data, weight, bias=None, num_hidden: Optional[int] = None,
+                    no_bias: bool = False, flatten: bool = True):
+    """y = x · Wᵀ + b with reference weight layout (num_hidden, in_units)."""
+    fl = flatten
+    inputs = [_as_nd(data), _as_nd(weight)]
+    has_bias = bias is not None and not no_bias
+    if has_bias:
+        inputs.append(_as_nd(bias))
+
+    def impl(x, w, *b):
+        if fl and x.ndim > 2:
+            x = x.reshape(x.shape[0], -1)
+        y = jnp.matmul(x, w.T)
+        if b:
+            y = y + b[0]
+        return y
+
+    return invoke("fully_connected", impl, tuple(inputs))
+
+
+# ---------------------------------------------------------------------------
+# Convolution (reference: src/operator/nn/convolution.cc + cudnn autotune;
+# XLA picks conv algorithms natively, so the CuDNNAlgoReg cache disappears)
+# ---------------------------------------------------------------------------
+
+_CONV_DIMNUMS = {
+    ("NCW",): ("NCW", "OIW", "NCW"),
+    ("NWC",): ("NWC", "WIO", "NWC"),
+    ("NCHW",): ("NCHW", "OIHW", "NCHW"),
+    ("NHWC",): ("NHWC", "HWIO", "NHWC"),
+    ("NCDHW",): ("NCDHW", "OIDHW", "NCDHW"),
+    ("NDHWC",): ("NDHWC", "DHWIO", "NDHWC"),
+}
+
+
+def convolution(data, weight, bias=None, kernel=None, stride=None, dilate=None,
+                pad=None, num_filter: int = 0, num_group: int = 1,
+                no_bias: bool = False, layout: str = "NCHW"):
+    """N-D convolution. Weight layout follows ``layout`` (OIHW for NCHW)."""
+    nd_data = _as_nd(data)
+    ndim = nd_data.ndim - 2
+    stride = _pair(stride or 1, ndim)
+    dilate = _pair(dilate or 1, ndim)
+    pad = _pair(pad if pad is not None else 0, ndim)
+    dn = _CONV_DIMNUMS[(layout,)]
+    groups = num_group
+    padding = [(p, p) for p in pad]
+
+    inputs = [nd_data, _as_nd(weight)]
+    has_bias = bias is not None and not no_bias
+    if has_bias:
+        inputs.append(_as_nd(bias))
+    chan_axis = layout.index("C")
+
+    def impl(x, w, *b):
+        y = lax.conv_general_dilated(
+            x, w, window_strides=stride, padding=padding,
+            rhs_dilation=dilate, dimension_numbers=dn,
+            feature_group_count=groups,
+            preferred_element_type=jnp.float32
+            if x.dtype == jnp.bfloat16 else None)
+        y = y.astype(x.dtype)
+        if b:
+            shape = [1] * y.ndim
+            shape[chan_axis] = b[0].shape[0]
+            y = y + b[0].reshape(shape)
+        return y
+
+    return invoke("convolution", impl, tuple(inputs))
+
+
+def deconvolution(data, weight, bias=None, kernel=None, stride=None,
+                  dilate=None, pad=None, adj=None, num_filter: int = 0,
+                  num_group: int = 1, no_bias: bool = True,
+                  layout: str = "NCHW"):
+    """Transposed convolution (reference: src/operator/nn/deconvolution.cc)."""
+    nd_data = _as_nd(data)
+    ndim = nd_data.ndim - 2
+    stride = _pair(stride or 1, ndim)
+    dilate = _pair(dilate or 1, ndim)
+    pad = _pair(pad if pad is not None else 0, ndim)
+    dn = _CONV_DIMNUMS[(layout,)]
+    groups = num_group
+    inputs = [nd_data, _as_nd(weight)]
+    has_bias = bias is not None and not no_bias
+    if has_bias:
+        inputs.append(_as_nd(bias))
+    chan_axis = layout.index("C")
+    padding = [(d * (k - 1) - p, d * (k - 1) - p)
+               for k, p, d in zip(_pair(kernel, ndim), pad, dilate)] \
+        if kernel is not None else [(0, 0)] * ndim
+
+    def impl(x, w, *b):
+        # gradient-of-conv formulation: lhs_dilation implements the stride
+        y = lax.conv_general_dilated(
+            x, jnp.swapaxes(w, 0, 1) if dn[1].startswith("OI")
+            else w, window_strides=(1,) * ndim,
+            padding=padding, lhs_dilation=stride, rhs_dilation=dilate,
+            dimension_numbers=dn, feature_group_count=groups,
+            transpose_kernel=True)
+        if b:
+            shape = [1] * y.ndim
+            shape[chan_axis] = b[0].shape[0]
+            y = y + b[0].reshape(shape)
+        return y
+
+    return invoke("deconvolution", impl, tuple(inputs))
+
+
+# ---------------------------------------------------------------------------
+# Pooling (reference: src/operator/nn/pooling.cc → lax.reduce_window)
+# ---------------------------------------------------------------------------
+
+def pooling(data, kernel=None, pool_type: str = "max", stride=None, pad=None,
+            global_pool: bool = False, count_include_pad: bool = True,
+            layout: str = "NCHW"):
+    nd_data = _as_nd(data)
+    ndim = nd_data.ndim - 2
+    spatial_axes = [i for i, c in enumerate(layout) if c not in "NC"]
+
+    if global_pool:
+        axes = tuple(spatial_axes)
+        if pool_type == "max":
+            return invoke("global_max_pool",
+                          lambda x: jnp.max(x, axis=axes, keepdims=True),
+                          (nd_data,))
+        return invoke("global_avg_pool",
+                      lambda x: jnp.mean(x, axis=axes, keepdims=True),
+                      (nd_data,))
+
+    kernel = _pair(kernel, ndim)
+    stride = _pair(stride or kernel, ndim)
+    pad = _pair(pad if pad is not None else 0, ndim)
+
+    window = [1] * nd_data.ndim
+    strides = [1] * nd_data.ndim
+    padding = [(0, 0)] * nd_data.ndim
+    for ax, k, s, p in zip(spatial_axes, kernel, stride, pad):
+        window[ax], strides[ax], padding[ax] = k, s, (p, p)
+    window, strides = tuple(window), tuple(strides)
+    pt, cip = pool_type, count_include_pad
+
+    def impl(x):
+        if pt == "max":
+            init = -jnp.inf if jnp.issubdtype(x.dtype, jnp.floating) \
+                else jnp.iinfo(x.dtype).min
+            return lax.reduce_window(x, init, lax.max, window, strides, padding)
+        if pt in ("avg", "sum"):
+            s = lax.reduce_window(x, 0.0, lax.add, window, strides, padding)
+            if pt == "sum":
+                return s
+            if cip:
+                denom = 1
+                for k in kernel:
+                    denom *= k
+                return s / denom
+            ones = jnp.ones_like(x)
+            cnt = lax.reduce_window(ones, 0.0, lax.add, window, strides, padding)
+            return s / cnt
+        if pt == "lp":
+            s = lax.reduce_window(jnp.abs(x) ** 2, 0.0, lax.add, window,
+                                  strides, padding)
+            return jnp.sqrt(s)
+        raise ValueError(f"unknown pool_type {pt}")
+
+    return invoke(f"pooling_{pt}", impl, (nd_data,))
+
+
+def adaptive_avg_pool2d(data, output_size: Union[int, Tuple[int, int]] = 1,
+                        layout: str = "NCHW"):
+    """contrib.AdaptiveAvgPooling2D analog (common for squeeze-excite)."""
+    out = _pair(output_size, 2)
+    nd_data = _as_nd(data)
+    h_ax, w_ax = layout.index("H"), layout.index("W")
+    H, W = nd_data.shape[h_ax], nd_data.shape[w_ax]
+    if H % out[0] or W % out[1]:
+        raise ValueError("adaptive pool requires divisible spatial dims")
+    kh, kw = H // out[0], W // out[1]
+
+    def impl(x):
+        window = [1] * x.ndim
+        window[h_ax], window[w_ax] = kh, kw
+        s = lax.reduce_window(x, 0.0, lax.add, tuple(window), tuple(window),
+                              [(0, 0)] * x.ndim)
+        return s / (kh * kw)
+
+    return invoke("adaptive_avg_pool2d", impl, (nd_data,))
+
+
+# ---------------------------------------------------------------------------
+# Normalization (reference: batch_norm.cc, layer_norm.cc w/ fast CUDA path,
+# group_norm.cc, instance_norm.cc, l2_normalization.cc)
+# ---------------------------------------------------------------------------
+
+def batch_norm(data, gamma, beta, running_mean, running_var,
+               eps: float = 1e-5, momentum: float = 0.9,
+               fix_gamma: bool = False, use_global_stats: bool = False,
+               axis: int = 1, training: Optional[bool] = None):
+    """BatchNorm forward. Returns (out, batch_mean, batch_var).
+
+    The moving-stat update is done by the caller (gluon BatchNorm layer)
+    outside the tape — the reference mutates aux states inside the op; a
+    functional XLA op cannot, so the layer owns that side effect.
+    """
+    ax, ep, fg = axis, eps, fix_gamma
+    train = is_training() if training is None else training
+    use_batch_stats = train and not use_global_stats
+
+    nd = _as_nd(data)
+    red_axes = tuple(i for i in range(nd.ndim) if i != ax)
+
+    def impl(x, g, b, rm, rv):
+        shape = [1] * x.ndim
+        shape[ax] = x.shape[ax]
+        if use_batch_stats:
+            mean = jnp.mean(x, axis=red_axes)
+            var = jnp.var(x, axis=red_axes)
+        else:
+            mean, var = rm, rv
+        gg = jnp.ones_like(g) if fg else g
+        inv = lax.rsqrt(var + ep)
+        out = (x - mean.reshape(shape)) * (inv * gg).reshape(shape) \
+            + b.reshape(shape)
+        return out, mean, var
+
+    return invoke("batch_norm", impl,
+                  (nd, _as_nd(gamma), _as_nd(beta),
+                   _as_nd(running_mean), _as_nd(running_var)))
+
+
+def layer_norm(data, gamma, beta, axis: int = -1, eps: float = 1e-5):
+    ax, ep = axis, eps
+    def impl(x, g, b):
+        mean = jnp.mean(x, axis=ax, keepdims=True)
+        var = jnp.var(x, axis=ax, keepdims=True)
+        out = (x - mean) * lax.rsqrt(var + ep)
+        shape = [1] * x.ndim
+        shape[ax] = x.shape[ax]
+        return out * g.reshape(shape) + b.reshape(shape)
+    return invoke("layer_norm", impl,
+                  (_as_nd(data), _as_nd(gamma), _as_nd(beta)))
+
+
+def rms_norm(data, gamma, axis: int = -1, eps: float = 1e-6):
+    """RMSNorm (beyond-reference; standard in modern LLM blocks)."""
+    ax, ep = axis, eps
+    def impl(x, g):
+        ms = jnp.mean(jnp.square(x), axis=ax, keepdims=True)
+        shape = [1] * x.ndim
+        shape[ax] = x.shape[ax]
+        return x * lax.rsqrt(ms + ep) * g.reshape(shape)
+    return invoke("rms_norm", impl, (_as_nd(data), _as_nd(gamma)))
+
+
+def group_norm(data, gamma, beta, num_groups: int = 1, eps: float = 1e-5):
+    """GroupNorm over NC... layout (reference: src/operator/nn/group_norm.cc)."""
+    ng, ep = num_groups, eps
+    def impl(x, g, b):
+        N, C = x.shape[0], x.shape[1]
+        rest = x.shape[2:]
+        xg = x.reshape((N, ng, C // ng) + rest)
+        axes = tuple(range(2, xg.ndim))
+        mean = jnp.mean(xg, axis=axes, keepdims=True)
+        var = jnp.var(xg, axis=axes, keepdims=True)
+        xg = (xg - mean) * lax.rsqrt(var + ep)
+        x = xg.reshape(x.shape)
+        shape = [1, C] + [1] * len(rest)
+        return x * g.reshape(shape) + b.reshape(shape)
+    return invoke("group_norm", impl,
+                  (_as_nd(data), _as_nd(gamma), _as_nd(beta)))
+
+
+def instance_norm(data, gamma, beta, eps: float = 1e-5):
+    ep = eps
+    def impl(x, g, b):
+        axes = tuple(range(2, x.ndim))
+        mean = jnp.mean(x, axis=axes, keepdims=True)
+        var = jnp.var(x, axis=axes, keepdims=True)
+        out = (x - mean) * lax.rsqrt(var + ep)
+        shape = [1, x.shape[1]] + [1] * (x.ndim - 2)
+        return out * g.reshape(shape) + b.reshape(shape)
+    return invoke("instance_norm", impl,
+                  (_as_nd(data), _as_nd(gamma), _as_nd(beta)))
+
+
+def l2_normalization(data, eps: float = 1e-10, mode: str = "instance"):
+    ep, md = eps, mode
+    def impl(x):
+        if md == "instance":
+            axes = tuple(range(1, x.ndim))
+        elif md == "channel":
+            axes = (1,)
+        else:  # spatial
+            axes = tuple(range(2, x.ndim))
+        n = jnp.sqrt(jnp.sum(jnp.square(x), axis=axes, keepdims=True) + ep)
+        return x / n
+    return invoke("l2_normalization", impl, (_as_nd(data),))
+
+
+def lrn(data, alpha: float = 1e-4, beta: float = 0.75, knorm: float = 2.0,
+        nsize: int = 5):
+    """Local response norm (reference: src/operator/nn/lrn.cc)."""
+    a, b, k, n = alpha, beta, knorm, nsize
+    def impl(x):
+        sq = jnp.square(x)
+        # sum over channel window: pad channel axis then reduce_window
+        window = [1, n] + [1] * (x.ndim - 2)
+        pads = [(0, 0), (n // 2, n // 2)] + [(0, 0)] * (x.ndim - 2)
+        s = lax.reduce_window(sq, 0.0, lax.add, tuple(window),
+                              (1,) * x.ndim, pads)
+        return x / jnp.power(k + a / n * s, b)
+    return invoke("lrn", impl, (_as_nd(data),))
+
+
+# ---------------------------------------------------------------------------
+# Dropout (reference: src/operator/nn/dropout.cc)
+# ---------------------------------------------------------------------------
+
+def dropout(data, p: float = 0.5, mode: str = "training", axes=None,
+            training: Optional[bool] = None):
+    train = is_training() if training is None else training
+    if (not train and mode != "always") or p <= 0.0:
+        return _as_nd(data)
+    rate, axs = p, axes
+    key = _random.split_key()
+    def impl(x):
+        shape = list(x.shape)
+        if axs:
+            # variational dropout: mask is SHARED along the listed axes
+            # (mask dim = 1 there), matching the reference's Dropout(axes=)
+            for ax in axs:
+                shape[ax] = 1
+        keep = jax.random.bernoulli(key, 1.0 - rate, tuple(shape))
+        return jnp.where(keep, x / (1.0 - rate), 0.0).astype(x.dtype)
+    return invoke("dropout", impl, (_as_nd(data),))
+
+
+# ---------------------------------------------------------------------------
+# Embedding / indexing helpers (reference: indexing_op.cc Embedding, pick)
+# ---------------------------------------------------------------------------
+
+def embedding(data, weight, input_dim: Optional[int] = None,
+              output_dim: Optional[int] = None, dtype=None,
+              sparse_grad: bool = False):
+    """Table lookup: out[i...] = weight[data[i...]]."""
+    def impl(idx, w):
+        return jnp.take(w, idx.astype(jnp.int32), axis=0)
+    # weight first in grad order matters not; inputs order = (data, weight)
+    return invoke("embedding", impl, (_as_nd(data), _as_nd(weight)))
+
+
+def pick(data, index, axis: int = -1, keepdims: bool = False,
+         mode: str = "clip"):
+    ax, kd = axis, keepdims
+    def impl(x, i):
+        i = jnp.expand_dims(i.astype(jnp.int32), ax)
+        out = jnp.take_along_axis(x, i, axis=ax)
+        return out if kd else jnp.squeeze(out, axis=ax)
+    return invoke("pick", impl, (_as_nd(data), _as_nd(index)))
+
+
+# ---------------------------------------------------------------------------
+# Sequence ops (reference: sequence_mask.cc / last.cc / reverse.cc — the
+# building blocks of the era's long-sequence handling, SURVEY.md 5.7)
+# ---------------------------------------------------------------------------
+
+def sequence_mask(data, sequence_length=None, use_sequence_length: bool = False,
+                  value: float = 0.0, axis: int = 0):
+    if not use_sequence_length or sequence_length is None:
+        return _as_nd(data)
+    v, ax = value, axis
+    nd = _as_nd(data)
+    T = nd.shape[ax]
+    def impl(x, sl):
+        ar = jnp.arange(T)
+        if ax == 0:  # (T, N, ...)
+            mask = ar[:, None] < sl[None, :]
+        else:        # (N, T, ...)
+            mask = ar[None, :] < sl[:, None]
+        mask = mask.reshape(mask.shape + (1,) * (x.ndim - 2))
+        return jnp.where(mask, x, v)
+    return invoke("sequence_mask", impl, (nd, _as_nd(sequence_length)))
+
+
+def sequence_last(data, sequence_length=None, use_sequence_length: bool = False,
+                  axis: int = 0):
+    nd = _as_nd(data)
+    ax = axis
+    if not use_sequence_length or sequence_length is None:
+        idx = nd.shape[ax] - 1
+        def impl(x):
+            return lax.index_in_dim(x, idx, axis=ax, keepdims=False)
+        return invoke("sequence_last", impl, (nd,))
+    def impl2(x, sl):
+        last = (sl.astype(jnp.int32) - 1)
+        if ax == 0:
+            xt = jnp.moveaxis(x, 0, 1)  # (N, T, ...)
+        else:
+            xt = x
+        idx = last.reshape((-1,) + (1,) * (xt.ndim - 1))
+        out = jnp.take_along_axis(xt, idx, axis=1)
+        return jnp.squeeze(out, axis=1)
+    return invoke("sequence_last", impl2, (nd, _as_nd(sequence_length)))
+
+
+def sequence_reverse(data, sequence_length=None,
+                     use_sequence_length: bool = False, axis: int = 0):
+    nd = _as_nd(data)
+    ax = axis
+    if not use_sequence_length or sequence_length is None:
+        def impl(x):
+            return jnp.flip(x, axis=ax)
+        return invoke("sequence_reverse", impl, (nd,))
+    T = nd.shape[ax]
+    def impl2(x, sl):
+        ar = jnp.arange(T)
+        sl = sl.astype(jnp.int32)
+        # per-batch index: reverse within [0, len), identity beyond
+        if ax == 0:
+            idx = jnp.where(ar[:, None] < sl[None, :],
+                            sl[None, :] - 1 - ar[:, None], ar[:, None])
+            return jnp.take_along_axis(
+                x, idx.reshape(idx.shape + (1,) * (x.ndim - 2)), axis=0)
+        idx = jnp.where(ar[None, :] < sl[:, None],
+                        sl[:, None] - 1 - ar[None, :], ar[None, :])
+        return jnp.take_along_axis(
+            x, idx.reshape(idx.shape + (1,) * (x.ndim - 2)), axis=1)
+    return invoke("sequence_reverse", impl2, (nd, _as_nd(sequence_length)))
+
+
+def topk_mask(data, k: int, axis: int = -1):
+    kk, ax = k, axis
+    def impl(x):
+        xm = jnp.moveaxis(x, ax, -1)
+        thresh = jax.lax.top_k(xm, kk)[0][..., -1:]
+        mask = xm >= thresh
+        return jnp.moveaxis(mask, -1, ax)
+    return invoke("topk_mask", impl, (_as_nd(data),))
+
+
+def smooth_l1(data, scalar: float = 1.0):
+    """Smooth-L1 (reference: src/operator/tensor/elemwise_unary_op)."""
+    s = scalar
+    def impl(x):
+        s2 = s * s
+        return jnp.where(jnp.abs(x) < 1.0 / s2,
+                         0.5 * s2 * jnp.square(x),
+                         jnp.abs(x) - 0.5 / s2)
+    return invoke("smooth_l1", impl, (_as_nd(data),))
+
+
+for _name in __all__:
+    register_op(_name, globals()[_name])

@@ -1,0 +1,169 @@
+"""Testing toolkit — the framework's own test primitives.
+
+Reference parity (leezu/mxnet): ``python/mxnet/test_utils.py`` —
+``assert_almost_equal`` with per-dtype tolerances, ``check_numeric_gradient``
+(finite differences vs autograd), ``check_consistency`` (cross-context
+comparison: here cpu vs tpu), ``rand_ndarray``, ``default_context``.
+"""
+from __future__ import annotations
+
+import os
+from typing import Any, Callable, Dict, List, Optional, Sequence
+
+import numpy as _np
+
+from .context import Context, cpu, tpu
+from .ndarray.ndarray import NDArray
+
+__all__ = [
+    "default_context", "set_default_context", "assert_almost_equal",
+    "almost_equal", "same", "rand_ndarray", "rand_shape_nd",
+    "check_numeric_gradient", "check_consistency", "default_rtols",
+]
+
+_DEFAULT_CTX: Optional[Context] = None
+
+# per-dtype tolerance maps (reference: test_utils.py default_rtols/atols)
+_RTOLS: Dict[Any, float] = {
+    _np.dtype(_np.float16): 1e-2, _np.dtype(_np.float32): 1e-4,
+    _np.dtype(_np.float64): 1e-6, _np.dtype(_np.int32): 0,
+    _np.dtype(_np.int64): 0, _np.dtype(_np.bool_): 0,
+}
+_ATOLS: Dict[Any, float] = {
+    _np.dtype(_np.float16): 1e-2, _np.dtype(_np.float32): 1e-5,
+    _np.dtype(_np.float64): 1e-8, _np.dtype(_np.int32): 0,
+    _np.dtype(_np.int64): 0, _np.dtype(_np.bool_): 0,
+}
+
+
+def default_rtols() -> Dict[Any, float]:
+    return dict(_RTOLS)
+
+
+def default_context() -> Context:
+    """The context tests run on; switch via MXNET_TEST_CTX=tpu."""
+    global _DEFAULT_CTX
+    if _DEFAULT_CTX is None:
+        name = os.environ.get("MXNET_TEST_CTX", "cpu")
+        _DEFAULT_CTX = tpu() if name in ("tpu", "gpu") else cpu()
+    return _DEFAULT_CTX
+
+
+def set_default_context(ctx: Context) -> None:
+    global _DEFAULT_CTX
+    _DEFAULT_CTX = ctx
+
+
+def _to_numpy(x: Any) -> _np.ndarray:
+    if isinstance(x, NDArray):
+        return x.asnumpy()
+    return _np.asarray(x)
+
+
+def same(a: Any, b: Any) -> bool:
+    return _np.array_equal(_to_numpy(a), _to_numpy(b))
+
+
+def almost_equal(a: Any, b: Any, rtol: Optional[float] = None,
+                 atol: Optional[float] = None, equal_nan: bool = False) -> bool:
+    a, b = _to_numpy(a), _to_numpy(b)
+    rtol = rtol if rtol is not None else _RTOLS.get(a.dtype, 1e-5)
+    atol = atol if atol is not None else _ATOLS.get(a.dtype, 1e-6)
+    return _np.allclose(a, b, rtol=rtol, atol=atol, equal_nan=equal_nan)
+
+
+def assert_almost_equal(a: Any, b: Any, rtol: Optional[float] = None,
+                        atol: Optional[float] = None,
+                        names: Sequence[str] = ("a", "b"),
+                        equal_nan: bool = False) -> None:
+    """Assert allclose with per-dtype default tolerances."""
+    a_np, b_np = _to_numpy(a), _to_numpy(b)
+    rtol = rtol if rtol is not None else _RTOLS.get(a_np.dtype, 1e-5)
+    atol = atol if atol is not None else _ATOLS.get(a_np.dtype, 1e-6)
+    if _np.allclose(a_np, b_np, rtol=rtol, atol=atol, equal_nan=equal_nan):
+        return
+    diff = _np.abs(a_np.astype(_np.float64) - b_np.astype(_np.float64))
+    denom = _np.abs(b_np.astype(_np.float64)) + atol
+    idx = _np.unravel_index(_np.argmax(diff / denom), diff.shape)
+    raise AssertionError(
+        f"{names[0]} and {names[1]} differ beyond rtol={rtol} atol={atol}: "
+        f"max rel-violation at {idx}: {a_np[idx]} vs {b_np[idx]} "
+        f"(abs diff {diff[idx]})")
+
+
+def rand_shape_nd(ndim: int, dim: int = 10) -> tuple:
+    return tuple(_np.random.randint(1, dim + 1, size=ndim))
+
+
+def rand_ndarray(shape: Sequence[int], ctx: Optional[Context] = None,
+                 dtype: Any = "float32", low: float = -1.0,
+                 high: float = 1.0) -> NDArray:
+    data = _np.random.uniform(low, high, size=tuple(shape)).astype(dtype)
+    return NDArray(data, ctx=ctx or default_context())
+
+
+def check_numeric_gradient(fn: Callable[..., NDArray],
+                           inputs: Sequence[NDArray],
+                           eps: float = 1e-3, rtol: float = 1e-2,
+                           atol: float = 1e-3) -> None:
+    """Compare autograd gradients against central finite differences.
+
+    The reference's gatekeeper test for every op's FGradient
+    (``python/mxnet/test_utils.py check_numeric_gradient``). ``fn`` maps
+    NDArrays to a single NDArray output; gradients are checked for each
+    input in float64-free finite differences with seed cotangent of ones.
+    """
+    from . import autograd
+
+    inputs = [x if isinstance(x, NDArray) else NDArray(x) for x in inputs]
+    for x in inputs:
+        x.attach_grad()
+    with autograd.record():
+        out = fn(*inputs)
+    out.backward()
+    analytic = [x.grad.asnumpy() for x in inputs]
+
+    for i, x in enumerate(inputs):
+        base = x.asnumpy().astype(_np.float64)
+        num = _np.zeros_like(base)
+        flat = base.reshape(-1)
+        num_flat = num.reshape(-1)
+        for j in range(flat.size):
+            orig = flat[j]
+            flat[j] = orig + eps
+            fp = fn(*[NDArray(base.reshape(x.shape).astype(x.dtype))
+                      if k == i else inputs[k]
+                      for k in range(len(inputs))]).asnumpy().sum()
+            flat[j] = orig - eps
+            fm = fn(*[NDArray(base.reshape(x.shape).astype(x.dtype))
+                      if k == i else inputs[k]
+                      for k in range(len(inputs))]).asnumpy().sum()
+            flat[j] = orig
+            num_flat[j] = (fp - fm) / (2 * eps)
+        assert_almost_equal(analytic[i], num, rtol=rtol, atol=atol,
+                            names=(f"autograd_grad[{i}]", f"numeric_grad[{i}]"))
+
+
+def check_consistency(fn: Callable[..., NDArray],
+                      inputs_np: Sequence[_np.ndarray],
+                      ctx_list: Optional[Sequence[Context]] = None,
+                      dtypes: Sequence[str] = ("float32",),
+                      rtol: Optional[float] = None,
+                      atol: Optional[float] = None) -> None:
+    """Run ``fn`` across contexts/dtypes and cross-compare outputs.
+
+    The reference's THE cross-backend primitive (cpu/gpu/fp16 there;
+    cpu/tpu/bf16 here).
+    """
+    results = []
+    for ctx in (ctx_list or [cpu(), default_context()]):
+        for dt in dtypes:
+            args = [NDArray(a.astype(dt), ctx=ctx) for a in inputs_np]
+            results.append((ctx, dt, fn(*args).asnumpy()))
+    ref = results[0][2]
+    for ctx, dt, out in results[1:]:
+        assert_almost_equal(
+            ref.astype(_np.float32), out.astype(_np.float32),
+            rtol=rtol if rtol is not None else _RTOLS.get(_np.dtype(dt), 1e-3),
+            atol=atol if atol is not None else _ATOLS.get(_np.dtype(dt), 1e-4),
+            names=("reference", f"{ctx}/{dt}"))

@@ -1,0 +1,40 @@
+"""Utility helpers (``python/mxnet/util.py`` parity: set_np and friends)."""
+from __future__ import annotations
+
+_np_shape = True  # numpy semantics are the default and only mode
+_np_array = True
+
+
+def set_np(shape: bool = True, array: bool = True, dtype: bool = False) -> None:
+    """Enable numpy semantics (``mx.npx.set_np``). Always on here."""
+    global _np_shape, _np_array
+    _np_shape, _np_array = shape, array
+
+
+def reset_np() -> None:
+    set_np(True, True)
+
+
+def is_np_shape() -> bool:
+    return _np_shape
+
+
+def is_np_array() -> bool:
+    return _np_array
+
+
+def use_np(func):
+    """Decorator parity shim — numpy semantics are always active."""
+    return func
+
+
+def np_shape(active: bool = True):
+    class _Scope:
+        def __enter__(self):
+            return self
+        def __exit__(self, *a):
+            return False
+    return _Scope()
+
+
+np_array = np_shape

@@ -1,0 +1,34 @@
+"""Test config: force an 8-device virtual CPU mesh before jax initializes.
+
+Mirrors the reference's test strategy of running the op suite on a default
+context switched by environment (SURVEY.md section 4): tests run on XLA:CPU
+with 8 virtual devices so sharding/collective paths are exercised without
+TPU hardware (the driver separately dry-runs multi-chip compilation).
+"""
+import os
+
+# Must happen before jax backend initialization.
+flags = os.environ.get("XLA_FLAGS", "")
+if "xla_force_host_platform_device_count" not in flags:
+    os.environ["XLA_FLAGS"] = (
+        flags + " --xla_force_host_platform_device_count=8").strip()
+
+import jax  # noqa: E402
+
+# The image's sitecustomize pins JAX_PLATFORMS=axon (the TPU tunnel); tests
+# must run on the virtual CPU mesh instead.
+jax.config.update("jax_platforms", "cpu")
+
+import pytest  # noqa: E402
+
+
+@pytest.fixture(autouse=True)
+def _fixed_seed():
+    """Seed all RNGs per test (reference: tests/python/unittest/common.py
+    with_seed); export MXNET_TEST_SEED to repro."""
+    seed = int(os.environ.get("MXNET_TEST_SEED", "42"))
+    import numpy as np
+    import mxnet_tpu as mx
+    np.random.seed(seed)
+    mx.random.seed(seed)
+    yield

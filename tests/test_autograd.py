@@ -1,0 +1,223 @@
+"""Autograd tape (reference analog: tests/python/unittest/test_autograd.py)."""
+import numpy as onp
+import pytest
+
+import mxnet_tpu as mx
+from mxnet_tpu import autograd as ag
+from mxnet_tpu.test_utils import (assert_almost_equal, check_numeric_gradient,
+                                  rand_ndarray)
+
+
+def test_simple_backward():
+    x = mx.np.array([1.0, 2.0, 3.0])
+    x.attach_grad()
+    with ag.record():
+        y = (x * x).sum()
+    y.backward()
+    assert_almost_equal(x.grad, onp.array([2.0, 4.0, 6.0]))
+
+
+def test_chain_and_broadcast():
+    x = rand_ndarray((3, 4))
+    w = rand_ndarray((4, 2))
+    x.attach_grad(); w.attach_grad()
+    with ag.record():
+        y = mx.np.dot(x, w)
+        z = (y * y).mean()
+    z.backward()
+    # dz/dy = 2y/6 ; dz/dx = dz/dy @ w.T
+    y_np = x.asnumpy() @ w.asnumpy()
+    dy = 2 * y_np / y_np.size
+    assert_almost_equal(x.grad, dy @ w.asnumpy().T, rtol=1e-4, atol=1e-5)
+    assert_almost_equal(w.grad, x.asnumpy().T @ dy, rtol=1e-4, atol=1e-5)
+
+
+def test_grad_req_add():
+    x = mx.np.array([1.0, 2.0])
+    x.attach_grad(grad_req="add")
+    for _ in range(3):
+        with ag.record():
+            y = (2 * x).sum()
+        y.backward()
+    assert_almost_equal(x.grad, onp.array([6.0, 6.0]))
+
+
+def test_grad_req_write_overwrites():
+    x = mx.np.array([1.0, 2.0])
+    x.attach_grad()
+    for _ in range(3):
+        with ag.record():
+            y = (2 * x).sum()
+        y.backward()
+    assert_almost_equal(x.grad, onp.array([2.0, 2.0]))
+
+
+def test_not_recorded_raises():
+    x = mx.np.array([1.0])
+    x.attach_grad()
+    y = x * 2  # outside record()
+    with pytest.raises(mx.MXNetError):
+        y.backward()
+
+
+def test_pause_scope():
+    x = mx.np.array([2.0])
+    x.attach_grad()
+    with ag.record():
+        y = x * x
+        with ag.pause():
+            z = x * 10  # not recorded
+        w = y + z.detach()
+    w.backward()
+    assert_almost_equal(x.grad, onp.array([4.0]))
+    assert ag.is_recording() is False
+
+
+def test_head_gradient():
+    x = mx.np.array([1.0, 2.0])
+    x.attach_grad()
+    with ag.record():
+        y = x * 3
+    y.backward(mx.np.array([1.0, 10.0]))
+    assert_almost_equal(x.grad, onp.array([3.0, 30.0]))
+
+
+def test_autograd_grad_api():
+    x = mx.np.array([3.0])
+    with ag.record():
+        x.attach_grad()
+        y = x * x * x
+    (g,) = ag.grad(y, [x])
+    assert_almost_equal(g, onp.array([27.0]))
+
+
+def test_multi_output_op():
+    x = rand_ndarray((4, 6))
+    x.attach_grad()
+    with ag.record():
+        parts = mx.np.split(x, 2, axis=1)
+        loss = (parts[0] * 2 + parts[1] * 3).sum()
+    loss.backward()
+    expected = onp.concatenate([onp.full((4, 3), 2.0), onp.full((4, 3), 3.0)],
+                               axis=1)
+    assert_almost_equal(x.grad, expected)
+
+
+def test_shared_input_accumulates():
+    x = mx.np.array([2.0])
+    x.attach_grad()
+    with ag.record():
+        y = x * x + x * 3  # x used by two ops
+    y.backward()
+    assert_almost_equal(x.grad, onp.array([7.0]))
+
+
+def test_retain_graph():
+    x = mx.np.array([2.0])
+    x.attach_grad()
+    with ag.record():
+        y = x * x
+    y.backward(retain_graph=True)
+    y.backward()
+    assert_almost_equal(x.grad, onp.array([4.0]))
+    # third backward without retain fails
+    with pytest.raises(mx.MXNetError):
+        y.backward()
+
+
+def test_training_modes():
+    assert not ag.is_training()
+    with ag.record():
+        assert ag.is_training()
+    with ag.record(train_mode=False):
+        assert not ag.is_training()
+    with ag.train_mode():
+        assert ag.is_training()
+    with ag.predict_mode():
+        assert not ag.is_training()
+
+
+def test_dropout_respects_mode():
+    x = mx.np.ones((100,))
+    out_pred = mx.npx.dropout(x, 0.5)
+    assert_almost_equal(out_pred, onp.ones(100))  # inactive outside train
+    with ag.record():
+        out_train = mx.npx.dropout(x, 0.5)
+    n = out_train.asnumpy()
+    assert (n == 0).sum() > 10  # some dropped
+    assert abs(n.mean() - 1.0) < 0.3  # inverted scaling
+
+
+def test_custom_function():
+    class Sigmoid(ag.Function):
+        def forward(self, x):
+            y = 1.0 / (1.0 + mx.np.exp(-x))
+            self.save_for_backward(y)
+            return y
+
+        def backward(self, dy):
+            (y,) = self.saved_tensors
+            return dy * y * (1 - y)
+
+    x = mx.np.array([0.0, 1.0, -1.0])
+    x.attach_grad()
+    f = Sigmoid()
+    with ag.record():
+        y = f(x)
+    y.backward()
+    s = 1 / (1 + onp.exp(-x.asnumpy()))
+    assert_almost_equal(y, s, rtol=1e-5, atol=1e-6)
+    assert_almost_equal(x.grad, s * (1 - s), rtol=1e-5, atol=1e-6)
+
+
+def test_numeric_gradient_primitives():
+    check_numeric_gradient(lambda x: (x * x).sum(), [rand_ndarray((3, 2))])
+    check_numeric_gradient(lambda x: mx.np.exp(x).sum(), [rand_ndarray((4,))])
+    check_numeric_gradient(
+        lambda a, b: mx.np.dot(a, b).sum(),
+        [rand_ndarray((3, 4)), rand_ndarray((4, 2))])
+    check_numeric_gradient(
+        lambda x: mx.npx.softmax(x, axis=-1).sum(), [rand_ndarray((2, 5))])
+
+
+def test_deep_chain_no_recursion_limit():
+    x = mx.np.array([1.0])
+    x.attach_grad()
+    with ag.record():
+        y = x
+        for _ in range(2000):
+            y = y + 0.001
+    y.backward()
+    assert_almost_equal(x.grad, onp.array([1.0]))
+
+
+def test_grad_buffer_in_place():
+    """grad_req='write' must update the buffer allocated by attach_grad."""
+    x = mx.np.array([1.0, 2.0])
+    x.attach_grad()
+    g = x.grad
+    with ag.record():
+        y = (x * 3).sum()
+    y.backward()
+    assert_almost_equal(g, onp.array([3.0, 3.0]))  # held ref sees the update
+    assert g is x.grad
+
+
+def test_as_in_context_differentiable():
+    x = mx.np.array([1.0, 2.0])
+    x.attach_grad()
+    with ag.record():
+        z = x * 2
+        w = z.as_in_context(mx.cpu(1))
+        loss = (w * 3).sum()
+    loss.backward()
+    assert_almost_equal(x.grad, onp.array([6.0, 6.0]))
+
+
+def test_variational_dropout_axes():
+    x = mx.np.ones((4, 5))
+    with ag.record():
+        out = mx.npx.dropout(x, 0.5, axes=(0,))  # mask shared along axis 0
+    n = out.asnumpy()
+    # every column is constant across axis 0
+    assert (n == n[0:1, :]).all()
