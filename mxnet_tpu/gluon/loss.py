@@ -1,0 +1,357 @@
+"""Loss blocks (reference: ``python/mxnet/gluon/loss.py``).
+
+The full zoo: L1/L2, SoftmaxCrossEntropy, SigmoidBinaryCrossEntropy,
+KLDiv, Huber, Hinge, SquaredHinge, Logistic, Triplet, Cosine, PoissonNLL,
+CTC. Same weighting conventions: ``sample_weight`` broadcasting via
+``_apply_weighting``, per-sample mean over non-batch axes.
+"""
+from __future__ import annotations
+
+from typing import Any, Optional
+
+import jax.numpy as jnp
+
+from .. import npx
+from ..ndarray.ndarray import NDArray
+from ..ndarray import ops
+from ..ndarray.register import invoke
+from .block import HybridBlock
+
+__all__ = ["Loss", "L2Loss", "L1Loss", "SoftmaxCrossEntropyLoss",
+           "SoftmaxCELoss", "SigmoidBinaryCrossEntropyLoss", "SigmoidBCELoss",
+           "KLDivLoss", "HuberLoss", "HingeLoss", "SquaredHingeLoss",
+           "LogisticLoss", "TripletLoss", "CosineEmbeddingLoss",
+           "PoissonNLLLoss", "CTCLoss"]
+
+
+def _apply_weighting(loss: NDArray, weight: Optional[float],
+                     sample_weight: Optional[NDArray]) -> NDArray:
+    if sample_weight is not None:
+        loss = loss * sample_weight
+    if weight is not None and weight != 1.0:
+        loss = loss * weight
+    return loss
+
+
+def _batch_mean(loss: NDArray, batch_axis: int) -> NDArray:
+    axes = tuple(i for i in range(loss.ndim) if i != batch_axis)
+    return loss.mean(axis=axes) if axes else loss
+
+
+class Loss(HybridBlock):
+    """Base loss block."""
+
+    def __init__(self, weight: Optional[float] = 1.0, batch_axis: int = 0,
+                 **kwargs: Any) -> None:
+        super().__init__(**kwargs)
+        self._weight = weight
+        self._batch_axis = batch_axis
+
+    def __repr__(self) -> str:
+        return (f"{type(self).__name__}(batch_axis={self._batch_axis}, "
+                f"w={self._weight})")
+
+
+class L2Loss(Loss):
+    """0.5 * (pred - label)^2, mean over non-batch axes."""
+
+    def forward(self, pred: NDArray, label: NDArray,
+                sample_weight: Optional[NDArray] = None) -> NDArray:
+        label = label.reshape(pred.shape)
+        loss = ops.square(label - pred) * 0.5
+        loss = _apply_weighting(loss, self._weight, sample_weight)
+        return _batch_mean(loss, self._batch_axis)
+
+
+class L1Loss(Loss):
+    def forward(self, pred: NDArray, label: NDArray,
+                sample_weight: Optional[NDArray] = None) -> NDArray:
+        label = label.reshape(pred.shape)
+        loss = ops.abs(label - pred)
+        loss = _apply_weighting(loss, self._weight, sample_weight)
+        return _batch_mean(loss, self._batch_axis)
+
+
+class SoftmaxCrossEntropyLoss(Loss):
+    """Softmax + CE fused for numerical stability (reference:
+    SoftmaxCrossEntropyLoss; the fusion mirrors ``softmax_cross_entropy``)."""
+
+    def __init__(self, axis: int = -1, sparse_label: bool = True,
+                 from_logits: bool = False, weight: Optional[float] = 1.0,
+                 batch_axis: int = 0, **kwargs: Any) -> None:
+        super().__init__(weight, batch_axis, **kwargs)
+        self._axis = axis
+        self._sparse_label = sparse_label
+        self._from_logits = from_logits
+
+    def forward(self, pred: NDArray, label: NDArray,
+                sample_weight: Optional[NDArray] = None) -> NDArray:
+        if not self._from_logits:
+            pred = npx.log_softmax(pred, axis=self._axis)
+        if self._sparse_label:
+            loss = -npx.pick(pred, label, axis=self._axis, keepdims=False)
+        else:
+            label = label.reshape(pred.shape)
+            loss = -(pred * label).sum(axis=self._axis, keepdims=False)
+        loss = _apply_weighting(loss, self._weight, sample_weight)
+        return _batch_mean(loss, self._batch_axis)
+
+
+SoftmaxCELoss = SoftmaxCrossEntropyLoss
+
+
+class SigmoidBinaryCrossEntropyLoss(Loss):
+    def __init__(self, from_sigmoid: bool = False,
+                 weight: Optional[float] = 1.0, batch_axis: int = 0,
+                 **kwargs: Any) -> None:
+        super().__init__(weight, batch_axis, **kwargs)
+        self._from_sigmoid = from_sigmoid
+
+    def forward(self, pred: NDArray, label: NDArray,
+                sample_weight: Optional[NDArray] = None,
+                pos_weight: Optional[NDArray] = None) -> NDArray:
+        label = label.reshape(pred.shape)
+        if not self._from_sigmoid:
+            # max(x,0) - x*z + log(1+exp(-|x|)), the stable form
+            def impl(x, z):
+                base = jnp.maximum(x, 0) - x * z + \
+                    jnp.log1p(jnp.exp(-jnp.abs(x)))
+                return base
+            loss = invoke("sigmoid_bce", impl, (pred, label))
+            if pos_weight is not None:
+                # rescale positive-term contribution
+                lsig = npx.log_sigmoid(pred)
+                extra = (pos_weight - 1) * label * (-lsig)
+                loss = loss + extra
+        else:
+            eps = 1e-12
+            one_m = (1.0 - pred + eps).log()
+            if pos_weight is None:
+                loss = -((pred + eps).log() * label + one_m * (1 - label))
+            else:
+                loss = -((pred + eps).log() * label * pos_weight
+                         + one_m * (1 - label))
+        loss = _apply_weighting(loss, self._weight, sample_weight)
+        return _batch_mean(loss, self._batch_axis)
+
+
+SigmoidBCELoss = SigmoidBinaryCrossEntropyLoss
+
+
+class KLDivLoss(Loss):
+    def __init__(self, from_logits: bool = True, axis: int = -1,
+                 weight: Optional[float] = 1.0, batch_axis: int = 0,
+                 **kwargs: Any) -> None:
+        super().__init__(weight, batch_axis, **kwargs)
+        self._from_logits = from_logits
+        self._axis = axis
+
+    def forward(self, pred: NDArray, label: NDArray,
+                sample_weight: Optional[NDArray] = None) -> NDArray:
+        if not self._from_logits:
+            pred = npx.log_softmax(pred, axis=self._axis)
+        loss = label * ((label + 1e-12).log() - pred)
+        loss = _apply_weighting(loss, self._weight, sample_weight)
+        return _batch_mean(loss, self._batch_axis)
+
+
+class HuberLoss(Loss):
+    def __init__(self, rho: float = 1.0, weight: Optional[float] = 1.0,
+                 batch_axis: int = 0, **kwargs: Any) -> None:
+        super().__init__(weight, batch_axis, **kwargs)
+        self._rho = rho
+
+    def forward(self, pred: NDArray, label: NDArray,
+                sample_weight: Optional[NDArray] = None) -> NDArray:
+        label = label.reshape(pred.shape)
+        err = ops.abs(label - pred)
+        rho = self._rho
+        loss = ops.where(err > rho, err - 0.5 * rho,
+                         (0.5 / rho) * ops.square(err))
+        loss = _apply_weighting(loss, self._weight, sample_weight)
+        return _batch_mean(loss, self._batch_axis)
+
+
+class HingeLoss(Loss):
+    def __init__(self, margin: float = 1.0, weight: Optional[float] = 1.0,
+                 batch_axis: int = 0, **kwargs: Any) -> None:
+        super().__init__(weight, batch_axis, **kwargs)
+        self._margin = margin
+
+    def forward(self, pred: NDArray, label: NDArray,
+                sample_weight: Optional[NDArray] = None) -> NDArray:
+        label = label.reshape(pred.shape)
+        loss = (self._margin - pred * label).clip(0.0, None)
+        loss = _apply_weighting(loss, self._weight, sample_weight)
+        return _batch_mean(loss, self._batch_axis)
+
+
+class SquaredHingeLoss(HingeLoss):
+    def forward(self, pred: NDArray, label: NDArray,
+                sample_weight: Optional[NDArray] = None) -> NDArray:
+        label = label.reshape(pred.shape)
+        loss = ops.square((self._margin - pred * label).clip(0.0, None))
+        loss = _apply_weighting(loss, self._weight, sample_weight)
+        return _batch_mean(loss, self._batch_axis)
+
+
+class LogisticLoss(Loss):
+    def __init__(self, label_format: str = "signed",
+                 weight: Optional[float] = 1.0, batch_axis: int = 0,
+                 **kwargs: Any) -> None:
+        super().__init__(weight, batch_axis, **kwargs)
+        self._label_format = label_format
+
+    def forward(self, pred: NDArray, label: NDArray,
+                sample_weight: Optional[NDArray] = None) -> NDArray:
+        label = label.reshape(pred.shape)
+        if self._label_format == "binary":
+            label = 2 * label - 1
+        def impl(x):
+            return jnp.log1p(jnp.exp(-jnp.abs(x))) + jnp.maximum(-x, 0)
+        loss = invoke("logistic", impl, (pred * label,))
+        loss = _apply_weighting(loss, self._weight, sample_weight)
+        return _batch_mean(loss, self._batch_axis)
+
+
+class TripletLoss(Loss):
+    def __init__(self, margin: float = 1.0, weight: Optional[float] = 1.0,
+                 batch_axis: int = 0, **kwargs: Any) -> None:
+        super().__init__(weight, batch_axis, **kwargs)
+        self._margin = margin
+
+    def forward(self, pred: NDArray, positive: NDArray,
+                negative: NDArray,
+                sample_weight: Optional[NDArray] = None) -> NDArray:
+        axes = tuple(range(1, pred.ndim))
+        d_pos = ops.square(pred - positive).sum(axis=axes)
+        d_neg = ops.square(pred - negative).sum(axis=axes)
+        loss = (d_pos - d_neg + self._margin).clip(0.0, None)
+        return _apply_weighting(loss, self._weight, sample_weight)
+
+
+class CosineEmbeddingLoss(Loss):
+    def __init__(self, margin: float = 0.0, weight: Optional[float] = 1.0,
+                 batch_axis: int = 0, **kwargs: Any) -> None:
+        super().__init__(weight, batch_axis, **kwargs)
+        self._margin = margin
+
+    def forward(self, input1: NDArray, input2: NDArray, label: NDArray,
+                sample_weight: Optional[NDArray] = None) -> NDArray:
+        margin = self._margin
+        def impl(a, b, lab):
+            a2 = a.reshape(a.shape[0], -1)
+            b2 = b.reshape(b.shape[0], -1)
+            cos = (a2 * b2).sum(-1) / (
+                jnp.linalg.norm(a2, axis=-1) *
+                jnp.linalg.norm(b2, axis=-1) + 1e-12)
+            lab = lab.reshape(-1)
+            return jnp.where(lab > 0, 1 - cos,
+                             jnp.maximum(cos - margin, 0.0))
+        loss = invoke("cosine_embedding", impl, (input1, input2, label))
+        return _apply_weighting(loss, self._weight, sample_weight)
+
+
+class PoissonNLLLoss(Loss):
+    def __init__(self, from_logits: bool = True,
+                 compute_full: bool = False, weight: Optional[float] = 1.0,
+                 batch_axis: int = 0, **kwargs: Any) -> None:
+        super().__init__(weight, batch_axis, **kwargs)
+        self._from_logits = from_logits
+        self._compute_full = compute_full
+
+    def forward(self, pred: NDArray, target: NDArray,
+                sample_weight: Optional[NDArray] = None,
+                epsilon: float = 1e-8) -> NDArray:
+        target = target.reshape(pred.shape)
+        if self._from_logits:
+            loss = pred.exp() - target * pred
+        else:
+            loss = pred - target * (pred + epsilon).log()
+        if self._compute_full:
+            import math
+            # Stirling approximation of log(target!)
+            stirling = (target * target.log() - target
+                        + 0.5 * (2 * math.pi * target).log())
+            loss = loss + ops.where(target > 1, stirling,
+                                    ops.zeros_like(target))
+        loss = _apply_weighting(loss, self._weight, sample_weight)
+        return loss.mean()
+
+
+class CTCLoss(Loss):
+    """Connectionist temporal classification (reference: warp-ctc-backed
+    ``CTCLoss``). Implemented as a log-domain dynamic program over
+    ``lax.scan`` — compiler-friendly, fully on device."""
+
+    def __init__(self, layout: str = "NTC", label_layout: str = "NT",
+                 weight: Optional[float] = 1.0, **kwargs: Any) -> None:
+        super().__init__(weight, 0, **kwargs)
+        self._layout = layout
+        self._label_layout = label_layout
+
+    def forward(self, pred: NDArray, label: NDArray,
+                pred_lengths: Optional[NDArray] = None,
+                label_lengths: Optional[NDArray] = None,
+                sample_weight: Optional[NDArray] = None) -> NDArray:
+        import jax
+        from jax import lax
+        layout = self._layout
+
+        def impl(logits, labels, *lens):
+            if layout == "TNC":
+                logits = jnp.swapaxes(logits, 0, 1)  # -> NTC
+            N, T, C = logits.shape
+            L = labels.shape[1]
+            logp = jax.nn.log_softmax(logits, axis=-1)
+            blank = 0
+            labels = labels.astype(jnp.int32)
+            if lens:
+                plen = lens[0].astype(jnp.int32)
+                llen = lens[1].astype(jnp.int32) if len(lens) > 1 else \
+                    jnp.full((N,), L, jnp.int32)
+            else:
+                plen = jnp.full((N,), T, jnp.int32)
+                llen = (labels != blank).sum(axis=1).astype(jnp.int32) \
+                    if True else jnp.full((N,), L, jnp.int32)
+            # extended label seq: blank, l1, blank, l2, ... blank (2L+1)
+            S = 2 * L + 1
+            ext = jnp.full((N, S), blank, jnp.int32)
+            ext = ext.at[:, 1::2].set(labels)
+            neg_inf = -1e30
+            alpha0 = jnp.full((N, S), neg_inf)
+            alpha0 = alpha0.at[:, 0].set(logp[:, 0, blank])
+            alpha0 = alpha0.at[:, 1].set(
+                jnp.take_along_axis(logp[:, 0, :], ext[:, 1:2], axis=1)[:, 0])
+            same_as_prev2 = jnp.concatenate(
+                [jnp.ones((N, 2), bool),
+                 ext[:, 2:] == ext[:, :-2]], axis=1)
+
+            def step(alpha, t):
+                a_prev = alpha
+                a1 = jnp.concatenate(
+                    [jnp.full((N, 1), neg_inf), a_prev[:, :-1]], axis=1)
+                a2 = jnp.concatenate(
+                    [jnp.full((N, 2), neg_inf), a_prev[:, :-2]], axis=1)
+                a2 = jnp.where(same_as_prev2, neg_inf, a2)
+                merged = jnp.logaddexp(jnp.logaddexp(a_prev, a1), a2)
+                emit = jnp.take_along_axis(logp[:, t, :], ext, axis=1)
+                new_alpha = merged + emit
+                # freeze past end-of-sequence
+                new_alpha = jnp.where((t < plen)[:, None], new_alpha, a_prev)
+                return new_alpha, None
+
+            alpha, _ = lax.scan(step, alpha0, jnp.arange(1, T))
+            end = 2 * llen  # index of final blank
+            last = jnp.take_along_axis(alpha, end[:, None], axis=1)[:, 0]
+            last2 = jnp.take_along_axis(
+                alpha, jnp.maximum(end - 1, 0)[:, None], axis=1)[:, 0]
+            return -jnp.logaddexp(last, last2)
+
+        inputs = [pred, label]
+        if pred_lengths is not None:
+            inputs.append(pred_lengths)
+            if label_lengths is not None:
+                inputs.append(label_lengths)
+        loss = invoke("ctc_loss", impl, tuple(inputs))
+        return _apply_weighting(loss, self._weight, sample_weight)

@@ -1,0 +1,107 @@
+"""Activation blocks (reference: ``python/mxnet/gluon/nn/activations.py``)."""
+from __future__ import annotations
+
+from typing import Any
+
+from ... import npx
+from ...ndarray.ndarray import NDArray
+from ..block import HybridBlock
+from ..parameter import Parameter
+
+__all__ = ["Activation", "LeakyReLU", "PReLU", "ELU", "SELU", "GELU",
+           "Swish", "SiLU", "Mish", "HardSigmoid", "HardSwish"]
+
+
+class Activation(HybridBlock):
+    """Named activation (``nn.Activation('relu'|'sigmoid'|'tanh'|...)``)."""
+
+    def __init__(self, activation: str, **kwargs: Any) -> None:
+        super().__init__(**kwargs)
+        self._act = activation
+
+    def forward(self, x: NDArray) -> NDArray:
+        return npx.activation(x, self._act)
+
+    def __repr__(self) -> str:
+        return f"Activation({self._act})"
+
+
+class LeakyReLU(HybridBlock):
+    def __init__(self, alpha: float = 0.01, **kwargs: Any) -> None:
+        super().__init__(**kwargs)
+        self._alpha = alpha
+
+    def forward(self, x: NDArray) -> NDArray:
+        return npx.leaky_relu(x, slope=self._alpha)
+
+
+class PReLU(HybridBlock):
+    def __init__(self, alpha_initializer: Any = "constant",
+                 in_channels: int = 1, **kwargs: Any) -> None:
+        super().__init__(**kwargs)
+        from ... import initializer
+        init = initializer.Constant(0.25) \
+            if alpha_initializer == "constant" else alpha_initializer
+        self.alpha = Parameter("alpha", shape=(in_channels,), init=init)
+
+    def forward(self, x: NDArray) -> NDArray:
+        if not self.alpha.is_initialized:
+            self.alpha._finish_deferred_init(self.alpha.shape)
+        return npx.prelu(x, self.alpha.data())
+
+
+class ELU(HybridBlock):
+    def __init__(self, alpha: float = 1.0, **kwargs: Any) -> None:
+        super().__init__(**kwargs)
+        self._alpha = alpha
+
+    def forward(self, x: NDArray) -> NDArray:
+        return npx.elu(x, self._alpha)
+
+
+class SELU(HybridBlock):
+    def forward(self, x: NDArray) -> NDArray:
+        return npx.selu(x)
+
+
+class GELU(HybridBlock):
+    def __init__(self, approximation: str = "erf", **kwargs: Any) -> None:
+        super().__init__(**kwargs)
+        self._approx = approximation != "erf"
+
+    def forward(self, x: NDArray) -> NDArray:
+        return npx.gelu(x, approximate=self._approx)
+
+
+class Swish(HybridBlock):
+    def __init__(self, beta: float = 1.0, **kwargs: Any) -> None:
+        super().__init__(**kwargs)
+        self._beta = beta
+
+    def forward(self, x: NDArray) -> NDArray:
+        if self._beta == 1.0:
+            return npx.silu(x)
+        return x * npx.activation(x * self._beta, "sigmoid")
+
+
+SiLU = Swish
+
+
+class Mish(HybridBlock):
+    def forward(self, x: NDArray) -> NDArray:
+        return npx.mish(x)
+
+
+class HardSigmoid(HybridBlock):
+    def __init__(self, alpha: float = 0.2, beta: float = 0.5,
+                 **kwargs: Any) -> None:
+        super().__init__(**kwargs)
+        self._alpha, self._beta = alpha, beta
+
+    def forward(self, x: NDArray) -> NDArray:
+        return npx.hard_sigmoid(x, self._alpha, self._beta)
+
+
+class HardSwish(HybridBlock):
+    def forward(self, x: NDArray) -> NDArray:
+        return npx.hard_swish(x)

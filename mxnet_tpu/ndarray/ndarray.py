@@ -66,7 +66,7 @@ class NDArray:
     """
 
     __slots__ = ("_data", "_ctx", "_ag_node", "_ag_out_idx", "_grad",
-                 "_grad_req", "__weakref__")
+                 "_grad_req", "_fresh_grad", "__weakref__")
 
     # numpy interop priority (beats np.ndarray in mixed expressions)
     __array_priority__ = 1000.0
@@ -89,6 +89,7 @@ class NDArray:
         self._ag_out_idx = 0
         self._grad = None
         self._grad_req = "null"
+        self._fresh_grad = False
 
     # ------------------------------------------------------------------
     # Basic properties
@@ -243,6 +244,7 @@ class NDArray:
             self._grad._data = self._grad._data + cot
         else:
             self._grad._data = cot
+        self._fresh_grad = True  # staleness marker read by Trainer
         engine.track(self._grad._data)
 
     def backward(self, out_grad: Optional["NDArray"] = None,

@@ -82,7 +82,7 @@ def invoke(name: str, impl: Callable, inputs: Sequence[Any],
 
     if record:
         avals = [(tuple(o.shape), o.dtype) for o in outs_t]
-        node = TapeNode(name, vjp_fn, inputs, avals)
+        node = TapeNode(name, vjp_fn, inputs, avals, out_is_tuple=not single)
         node.out_arrays = [weakref.ref(w) for w in wrapped]
         for i, w in enumerate(wrapped):
             w._ag_node = node

@@ -1,0 +1,125 @@
+"""Binary parameter serialization — the ``.params`` file format.
+
+Reference parity (leezu/mxnet): ``NDArray::Save/Load``
+(``src/ndarray/ndarray.cc`` — dmlc::Stream binary with magic + payload;
+C API ``MXNDArraySave/Load``). This is a fresh TPU-era container with the
+same role and usage pattern (named dense tensors, one file, mmap-friendly
+aligned payloads); the reference's exact on-disk layout is CUDA-era
+internal and is NOT reproduced.
+
+Format (little-endian):
+  magic:   8 bytes  b"MXTPU001"
+  count:   uint64
+  per tensor:
+    name_len uint32, name utf-8
+    dtype_len uint32, dtype utf-8 (numpy dtype str, e.g. "<f4", "bfloat16")
+    ndim uint32, shape int64 * ndim
+    pad to 64-byte alignment
+    data raw bytes (C-order)
+"""
+from __future__ import annotations
+
+import struct
+from typing import Any, Dict, List, Optional, Sequence, Union
+
+import numpy as _np
+
+from .base import MXNetError
+from .context import Context
+from .ndarray.ndarray import NDArray
+
+__all__ = ["save_params", "load_params", "save", "load"]
+
+_MAGIC = b"MXTPU001"
+_ALIGN = 64
+
+
+def _np_of(arr: Any) -> _np.ndarray:
+    if isinstance(arr, NDArray):
+        # bfloat16 has no numpy dtype; view as uint16 with tagged dtype
+        data = arr._data
+        if str(data.dtype) == "bfloat16":
+            import ml_dtypes
+            return _np.asarray(data).view(_np.uint16), "bfloat16"
+        return arr.asnumpy(), None
+    return _np.asarray(arr), None
+
+
+def save_params(filename: str, params: Dict[str, Any]) -> None:
+    """Save a dict of name->NDArray to ``filename`` (.params format)."""
+    with open(filename, "wb") as f:
+        f.write(_MAGIC)
+        f.write(struct.pack("<Q", len(params)))
+        for name, arr in params.items():
+            npa = arr.asnumpy() if isinstance(arr, NDArray) else _np.asarray(arr)
+            dtype_str = str(npa.dtype.str) if npa.dtype != _np.dtype("V2") \
+                else "bfloat16"
+            if isinstance(arr, NDArray) and "bfloat16" in str(arr.dtype):
+                import ml_dtypes  # noqa: F401 - numpy gains bfloat16 support
+                npa = _np.asarray(arr._data)
+                dtype_str = "bfloat16"
+            nb = name.encode("utf-8")
+            db = dtype_str.encode("utf-8")
+            f.write(struct.pack("<I", len(nb))); f.write(nb)
+            f.write(struct.pack("<I", len(db))); f.write(db)
+            f.write(struct.pack("<I", npa.ndim))
+            for s in npa.shape:
+                f.write(struct.pack("<q", s))
+            pos = f.tell()
+            pad = (-pos) % _ALIGN
+            f.write(b"\0" * pad)
+            f.write(npa.tobytes(order="C"))
+
+
+def load_params(filename: str, ctx: Optional[Context] = None
+                ) -> Dict[str, NDArray]:
+    """Load a .params file into a dict of name->NDArray."""
+    with open(filename, "rb") as f:
+        magic = f.read(8)
+        if magic != _MAGIC:
+            raise MXNetError(
+                f"{filename} is not a mxnet_tpu .params file "
+                f"(bad magic {magic!r})")
+        (count,) = struct.unpack("<Q", f.read(8))
+        out: Dict[str, NDArray] = {}
+        for _ in range(count):
+            (nlen,) = struct.unpack("<I", f.read(4))
+            name = f.read(nlen).decode("utf-8")
+            (dlen,) = struct.unpack("<I", f.read(4))
+            dtype_str = f.read(dlen).decode("utf-8")
+            (ndim,) = struct.unpack("<I", f.read(4))
+            shape = tuple(struct.unpack("<q", f.read(8))[0]
+                          for _ in range(ndim))
+            pos = f.tell()
+            f.read((-pos) % _ALIGN)
+            if dtype_str == "bfloat16":
+                import ml_dtypes
+                dt = _np.dtype(ml_dtypes.bfloat16)
+            else:
+                dt = _np.dtype(dtype_str)
+            n_items = 1
+            for s in shape:
+                n_items *= s
+            buf = f.read(n_items * dt.itemsize)
+            npa = _np.frombuffer(buf, dtype=dt).reshape(shape)
+            out[name] = NDArray(npa, ctx=ctx)
+        return out
+
+
+def save(filename: str,
+         data: Union[NDArray, Sequence[NDArray], Dict[str, NDArray]]) -> None:
+    """``mx.nd.save`` parity: save list (keys "arg:0"...) or dict."""
+    if isinstance(data, NDArray):
+        data = {"0": data}
+    elif isinstance(data, (list, tuple)):
+        data = {str(i): a for i, a in enumerate(data)}
+    save_params(filename, data)
+
+
+def load(filename: str) -> Union[List[NDArray], Dict[str, NDArray]]:
+    """``mx.nd.load`` parity: returns a list when keys are 0..n-1."""
+    d = load_params(filename)
+    keys = list(d)
+    if keys and all(k.isdigit() for k in keys):
+        return [d[str(i)] for i in range(len(keys))]
+    return d

@@ -22,6 +22,7 @@ import jax.numpy as jnp
 from jax import lax
 
 from .._tape import is_training
+from ..base import MXNetError
 from ..ndarray.ndarray import NDArray
 from ..ndarray.ops import _as_nd
 from ..ndarray.register import invoke, register_op
@@ -293,23 +294,38 @@ def deconvolution(data, weight, bias=None, kernel=None, stride=None,
     pad = _pair(pad if pad is not None else 0, ndim)
     dn = _CONV_DIMNUMS[(layout,)]
     groups = num_group
+    adj = _pair(adj if adj is not None else 0, ndim)
     inputs = [nd_data, _as_nd(weight)]
     has_bias = bias is not None and not no_bias
     if has_bias:
         inputs.append(_as_nd(bias))
     chan_axis = layout.index("C")
-    padding = [(d * (k - 1) - p, d * (k - 1) - p)
-               for k, p, d in zip(_pair(kernel, ndim), pad, dilate)] \
+    # output_padding (adj) extends the high side: out = (in-1)*s - 2p +
+    # d*(k-1) + 1 + adj, matching the reference's Deconvolution adj param
+    padding = [(d * (k - 1) - p, d * (k - 1) - p + a)
+               for k, p, d, a in zip(_pair(kernel, ndim), pad, dilate, adj)] \
         if kernel is not None else [(0, 0)] * ndim
 
+    if groups != 1:
+        raise MXNetError(
+            "deconvolution with num_group > 1 is not implemented; "
+            "use num_group=1 or a grouped conv + resize")
+
     def impl(x, w, *b):
-        # gradient-of-conv formulation: lhs_dilation implements the stride
+        # gradient-of-conv formulation: lhs_dilation implements the
+        # stride; the kernel is spatially flipped with in/out channel
+        # axes swapped (reference deconv weight layout is (in, out, k...))
+        if dn[1].startswith("OI"):        # w: (in, out, spatial...)
+            wk = jnp.swapaxes(w, 0, 1)    # -> (out, in, spatial...)
+            spatial = tuple(range(2, wk.ndim))
+        else:                             # w: (spatial..., out, in)
+            wk = jnp.swapaxes(w, -1, -2)  # -> (spatial..., in, out)
+            spatial = tuple(range(0, wk.ndim - 2))
+        wk = jnp.flip(wk, axis=spatial)
         y = lax.conv_general_dilated(
-            x, jnp.swapaxes(w, 0, 1) if dn[1].startswith("OI")
-            else w, window_strides=(1,) * ndim,
+            x, wk, window_strides=(1,) * ndim,
             padding=padding, lhs_dilation=stride, rhs_dilation=dilate,
-            dimension_numbers=dn, feature_group_count=groups,
-            transpose_kernel=True)
+            dimension_numbers=dn, feature_group_count=1)
         if b:
             shape = [1] * y.ndim
             shape[chan_axis] = b[0].shape[0]
@@ -414,11 +430,12 @@ def batch_norm(data, gamma, beta, running_mean, running_var,
     outside the tape — the reference mutates aux states inside the op; a
     functional XLA op cannot, so the layer owns that side effect.
     """
-    ax, ep, fg = axis, eps, fix_gamma
+    nd = _as_nd(data)
+    ax = axis % nd.ndim  # normalize negative axis (e.g. -1 for NHWC)
+    ep, fg = eps, fix_gamma
     train = is_training() if training is None else training
     use_batch_stats = train and not use_global_stats
 
-    nd = _as_nd(data)
     red_axes = tuple(i for i in range(nd.ndim) if i != ax)
 
     def impl(x, g, b, rm, rv):

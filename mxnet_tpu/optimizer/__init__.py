@@ -1,0 +1,14 @@
+"""Optimizer registry and implementations.
+
+Reference parity: ``python/mxnet/optimizer/optimizer.py`` +
+``src/operator/optimizer_op.cc`` / ``src/operator/contrib/adamw.cc``.
+"""
+from .optimizer import (Optimizer, register, create, SGD, NAG, Adam, AdamW,
+                        LAMB, LARS, RMSProp, AdaGrad, AdaDelta, Adamax, Ftrl,
+                        FTML, Signum, SGLD, DCASGD, LBSGD, Updater,
+                        get_updater)
+
+__all__ = ["Optimizer", "register", "create", "SGD", "NAG", "Adam", "AdamW",
+           "LAMB", "LARS", "RMSProp", "AdaGrad", "AdaDelta", "Adamax",
+           "Ftrl", "FTML", "Signum", "SGLD", "DCASGD", "LBSGD", "Updater",
+           "get_updater"]

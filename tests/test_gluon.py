@@ -1,0 +1,277 @@
+"""Gluon blocks (reference analog: tests/python/unittest/test_gluon.py)."""
+import numpy as onp
+import pytest
+
+import mxnet_tpu as mx
+from mxnet_tpu import autograd as ag
+from mxnet_tpu.gluon import nn
+from mxnet_tpu.test_utils import assert_almost_equal, rand_ndarray
+
+
+def test_dense_deferred_init():
+    net = nn.Dense(4)
+    net.initialize()
+    x = mx.np.ones((2, 3))
+    y = net(x)
+    assert y.shape == (2, 4)
+    assert net.weight.shape == (4, 3)
+    assert net.bias.shape == (4,)
+
+
+def test_dense_explicit_in_units():
+    net = nn.Dense(5, in_units=7, activation="relu")
+    net.initialize()
+    y = net(mx.np.ones((3, 7)))
+    assert y.shape == (3, 5)
+    assert (y.asnumpy() >= 0).all()
+
+
+def test_collect_params_paths():
+    net = nn.HybridSequential()
+    net.add(nn.Dense(4, in_units=3), nn.Dense(2, in_units=4))
+    params = net.collect_params()
+    keys = list(params)
+    assert any("weight" in k for k in keys)
+    assert len(params) == 4
+
+
+def test_sequential_forward():
+    net = nn.HybridSequential()
+    net.add(nn.Dense(8, activation="tanh"), nn.Dropout(0.0), nn.Dense(1))
+    net.initialize()
+    y = net(mx.np.ones((4, 3)))
+    assert y.shape == (4, 1)
+    assert len(net) == 3
+    assert isinstance(net[0], nn.Dense)
+
+
+def test_hybridize_equivalence():
+    """Imperative vs hybridized outputs must match — the reference's own
+    core equivalence test pattern."""
+    net = nn.HybridSequential()
+    net.add(nn.Dense(16, activation="relu"), nn.Dense(8, activation="tanh"),
+            nn.Dense(3))
+    net.initialize()
+    x = rand_ndarray((5, 10))
+    y_imp = net(x)
+    net.hybridize()
+    y_hyb = net(x)
+    assert_almost_equal(y_imp, y_hyb, rtol=1e-5, atol=1e-5)
+    # second call hits the executable cache
+    y_hyb2 = net(x)
+    assert_almost_equal(y_hyb, y_hyb2)
+
+
+def test_hybridized_training_grads_match():
+    def make_net():
+        net = nn.HybridSequential()
+        net.add(nn.Dense(6, activation="relu", in_units=4), nn.Dense(2, in_units=6))
+        return net
+
+    mx.random.seed(7)
+    net_a = make_net(); net_a.initialize()
+    mx.random.seed(7)
+    net_b = make_net(); net_b.initialize()
+    net_b.hybridize()
+
+    x = rand_ndarray((3, 4))
+    for net in (net_a, net_b):
+        with ag.record():
+            loss = (net(x) ** 2).sum()
+        loss.backward()
+    for (ka, pa), (kb, pb) in zip(net_a.collect_params().items(),
+                                  net_b.collect_params().items()):
+        assert_almost_equal(pa.data().grad, pb.data().grad,
+                            rtol=1e-4, atol=1e-5, names=(ka, kb))
+
+
+def test_trainer_sgd_step():
+    net = nn.Dense(1, in_units=2, use_bias=False)
+    net.initialize(init=mx.initializer.Constant(1.0))
+    trainer = mx.gluon.Trainer(net.collect_params(), "sgd",
+                               {"learning_rate": 0.1})
+    x = mx.np.array([[1.0, 2.0], [3.0, 4.0]])
+    with ag.record():
+        loss = net(x).sum()
+    loss.backward()
+    trainer.step(batch_size=2)
+    # grad wrt w = sum over batch of x = [4, 6]; w <- 1 - 0.1*([4,6]/2)
+    assert_almost_equal(net.weight.data(), onp.array([[0.8, 0.7]]),
+                        rtol=1e-5, atol=1e-6)
+
+
+def test_batchnorm_train_vs_eval():
+    bn = nn.BatchNorm(in_channels=3)
+    bn.initialize()
+    x = rand_ndarray((8, 3, 4, 4), low=1.0, high=3.0)
+    with ag.record():
+        y_train = bn(x)
+    n = y_train.asnumpy()
+    assert abs(n.mean(axis=(0, 2, 3))).max() < 1e-4  # normalized per channel
+    # running stats moved toward batch stats
+    assert bn.running_mean.data().asnumpy().sum() != 0
+    y_eval = bn(x)  # uses running stats
+    assert y_eval.shape == x.shape
+
+
+def test_layernorm_groupnorm():
+    ln = nn.LayerNorm()
+    ln.initialize()
+    x = rand_ndarray((4, 10))
+    y = ln(x)
+    n = y.asnumpy()
+    assert abs(n.mean(axis=-1)).max() < 1e-5
+    assert abs(n.std(axis=-1) - 1).max() < 1e-2
+
+    gn = nn.GroupNorm(num_groups=2, in_channels=4)
+    gn.initialize()
+    y2 = gn(rand_ndarray((2, 4, 5)))
+    assert y2.shape == (2, 4, 5)
+
+
+def test_embedding():
+    emb = nn.Embedding(10, 4)
+    emb.initialize()
+    idx = mx.np.array([1, 3, 1], dtype="int32")
+    y = emb(idx)
+    assert y.shape == (3, 4)
+    assert_almost_equal(y[0], y[2])
+
+
+def test_conv2d_shapes():
+    conv = nn.Conv2D(8, kernel_size=3, padding=1)
+    conv.initialize()
+    x = rand_ndarray((2, 3, 16, 16))
+    y = conv(x)
+    assert y.shape == (2, 8, 16, 16)
+    assert conv.weight.shape == (8, 3, 3, 3)
+
+    convs = nn.Conv2D(4, kernel_size=3, strides=2)
+    convs.initialize()
+    assert convs(x).shape == (2, 4, 7, 7)
+
+
+def test_pooling_layers():
+    x = rand_ndarray((2, 3, 8, 8))
+    assert nn.MaxPool2D()(x).shape == (2, 3, 4, 4)
+    assert nn.AvgPool2D(pool_size=4)(x).shape == (2, 3, 2, 2)
+    assert nn.GlobalAvgPool2D()(x).shape == (2, 3, 1, 1)
+    assert_almost_equal(nn.GlobalMaxPool2D()(x).squeeze((2, 3)),
+                        x.asnumpy().max(axis=(2, 3)), rtol=1e-6, atol=1e-6)
+
+
+def test_losses():
+    from mxnet_tpu.gluon import loss as gloss
+    pred = rand_ndarray((4, 5))
+    label = mx.np.array([0, 1, 2, 3], dtype="int32")
+    l = gloss.SoftmaxCrossEntropyLoss()(pred, label)
+    assert l.shape == (4,)
+    expected = -onp.log(
+        onp.exp(pred.asnumpy()) /
+        onp.exp(pred.asnumpy()).sum(-1, keepdims=True))[
+        onp.arange(4), label.asnumpy()]
+    assert_almost_equal(l, expected, rtol=1e-4, atol=1e-5)
+
+    p2 = rand_ndarray((4, 3))
+    t2 = rand_ndarray((4, 3))
+    l2 = gloss.L2Loss()(p2, t2)
+    assert_almost_equal(l2, 0.5 * ((p2.asnumpy() - t2.asnumpy()) ** 2).mean(-1),
+                        rtol=1e-5, atol=1e-6)
+    l1 = gloss.L1Loss()(p2, t2)
+    assert_almost_equal(l1, abs(p2.asnumpy() - t2.asnumpy()).mean(-1),
+                        rtol=1e-5, atol=1e-6)
+
+    sig = gloss.SigmoidBinaryCrossEntropyLoss()
+    lbl = mx.np.array([[0.0, 1.0, 1.0]])
+    out = sig(mx.np.array([[0.5, -0.5, 2.0]]), lbl)
+    x = onp.array([[0.5, -0.5, 2.0]]); z = lbl.asnumpy()
+    ref = (onp.maximum(x, 0) - x * z + onp.log1p(onp.exp(-abs(x)))).mean(-1)
+    assert_almost_equal(out, ref, rtol=1e-5, atol=1e-6)
+
+
+def test_save_load_parameters(tmp_path):
+    net = nn.HybridSequential()
+    net.add(nn.Dense(4, in_units=3), nn.Dense(2, in_units=4))
+    net.initialize()
+    f = str(tmp_path / "model.params")
+    net.save_parameters(f)
+
+    net2 = nn.HybridSequential()
+    net2.add(nn.Dense(4, in_units=3), nn.Dense(2, in_units=4))
+    net2.load_parameters(f)
+    x = rand_ndarray((2, 3))
+    assert_almost_equal(net(x), net2(x))
+
+
+def test_parameter_setattr_grad_req():
+    net = nn.Dense(2, in_units=2)
+    net.initialize()
+    net.collect_params().setattr("grad_req", "null")
+    assert net.weight.grad_req == "null"
+
+
+def test_activations_blocks():
+    x = mx.np.array([-2.0, 0.0, 2.0])
+    assert (nn.Activation("relu")(x).asnumpy() == [0, 0, 2]).all()
+    assert nn.LeakyReLU(0.1)(x).asnumpy()[0] == pytest.approx(-0.2)
+    assert nn.ELU()(x).shape == (3,)
+    assert nn.GELU()(x).shape == (3,)
+    assert nn.SELU()(x).shape == (3,)
+    assert nn.Swish()(x).shape == (3,)
+    prelu = nn.PReLU()
+    prelu.initialize()
+    assert prelu(x).asnumpy()[0] == pytest.approx(-0.5)
+
+
+def test_block_repr_and_summary():
+    net = nn.HybridSequential()
+    net.add(nn.Dense(4, in_units=3))
+    r = repr(net)
+    assert "Dense" in r
+    s = net.summary()
+    assert "Total params" in s
+
+
+def test_conv2d_transpose_output_padding():
+    """stride-2 transposed conv with output_padding=1 doubles spatial dims."""
+    dc = nn.Conv2DTranspose(4, kernel_size=3, strides=(2, 2), padding=(1, 1),
+                            output_padding=(1, 1), in_channels=3)
+    dc.initialize()
+    y = dc(rand_ndarray((2, 3, 7, 7)))
+    assert y.shape == (2, 4, 14, 14)
+
+
+def test_batchnorm_channels_last_axis():
+    bn = nn.BatchNorm(axis=-1)
+    bn.initialize()
+    x = rand_ndarray((4, 5, 6, 3))  # NHWC
+    with ag.record():
+        y = bn(x)
+    assert bn.gamma.shape == (3,)
+    n = y.asnumpy()
+    assert abs(n.mean(axis=(0, 1, 2))).max() < 1e-4
+
+
+def test_zero_grad_clears_nan():
+    net = nn.Dense(1, in_units=1)
+    net.initialize()
+    g = net.weight.data().grad
+    g._data = (mx.np.full(g.shape, onp.nan))._data
+    net.collect_params().zero_grad()
+    assert net.weight.data().grad.asnumpy().sum() == 0.0
+
+
+def test_trainer_varying_batch_size():
+    """rescale_grad must track batch_size across steps (no stale closure)."""
+    net = nn.Dense(1, in_units=1, use_bias=False)
+    net.initialize(init=mx.initializer.Constant(0.0))
+    tr = mx.gluon.Trainer(net.collect_params(), "sgd", {"learning_rate": 1.0})
+    x = mx.np.array([[1.0]])
+    for bs in (1, 4):
+        with ag.record():
+            loss = net(x).sum()
+        loss.backward()
+        w_before = net.weight.data().item()
+        tr.step(batch_size=bs)
+        delta = net.weight.data().item() - w_before
+        assert abs(delta + 1.0 / bs) < 1e-6, (bs, delta)

@@ -1,0 +1,150 @@
+"""Optimizers: fused jitted updates vs pure-numpy reference math
+(reference analog: tests/python/unittest/test_optimizer.py, which checks
+the fused C++ ops against python reference implementations)."""
+import numpy as onp
+import pytest
+
+import mxnet_tpu as mx
+from mxnet_tpu import optimizer as opt
+from mxnet_tpu.test_utils import assert_almost_equal
+
+
+def _run(optimizer, w0, grads):
+    w = mx.np.array(w0.copy())
+    state = optimizer.create_state(0, w)
+    for g in grads:
+        state = optimizer.update(0, w, mx.np.array(g), state)
+    return w.asnumpy()
+
+
+def test_sgd_matches_numpy():
+    w0 = onp.array([1.0, -2.0, 3.0], dtype="float32")
+    grads = [onp.array([0.1, 0.2, -0.3], dtype="float32")] * 3
+    got = _run(opt.SGD(learning_rate=0.1, momentum=0.9, wd=0.01), w0, grads)
+
+    w = w0.copy(); m = onp.zeros_like(w)
+    for g in grads:
+        g = g + 0.01 * w
+        m = 0.9 * m + g
+        w = w - 0.1 * m
+    assert_almost_equal(got, w, rtol=1e-5, atol=1e-6)
+
+
+def test_sgd_no_momentum():
+    w0 = onp.array([1.0, 2.0], dtype="float32")
+    g = onp.array([0.5, -0.5], dtype="float32")
+    got = _run(opt.SGD(learning_rate=0.2), w0, [g])
+    assert_almost_equal(got, w0 - 0.2 * g, rtol=1e-6, atol=1e-7)
+
+
+def test_adam_matches_numpy():
+    w0 = onp.array([0.5, -0.5], dtype="float32")
+    grads = [onp.array([0.1, -0.2], dtype="float32"),
+             onp.array([-0.3, 0.4], dtype="float32")]
+    got = _run(opt.Adam(learning_rate=0.01), w0, grads)
+
+    w = w0.copy(); m = onp.zeros_like(w); v = onp.zeros_like(w)
+    b1, b2, eps = 0.9, 0.999, 1e-8
+    for t, g in enumerate(grads, 1):
+        m = b1 * m + (1 - b1) * g
+        v = b2 * v + (1 - b2) * g * g
+        lr = 0.01 * onp.sqrt(1 - b2 ** t) / (1 - b1 ** t)
+        w = w - lr * m / (onp.sqrt(v) + eps)
+    assert_almost_equal(got, w, rtol=1e-5, atol=1e-6)
+
+
+def test_adamw_decoupled_decay():
+    """wd must NOT enter the moment estimates (leezu's adamw contract)."""
+    w0 = onp.array([1.0], dtype="float32")
+    g = onp.array([0.0], dtype="float32")
+    got = _run(opt.AdamW(learning_rate=0.1, wd=0.5), w0, [g])
+    # zero grad => moments stay 0; only decay applies: w -= lr*wd*w
+    assert_almost_equal(got, onp.array([1.0 - 0.1 * 0.5]),
+                        rtol=1e-6, atol=1e-7)
+
+
+def test_rmsprop_adagrad_adadelta_run():
+    w0 = onp.random.uniform(-1, 1, 4).astype("float32")
+    grads = [onp.random.uniform(-1, 1, 4).astype("float32") for _ in range(3)]
+    for o in (opt.RMSProp(), opt.RMSProp(centered=True), opt.AdaGrad(),
+              opt.AdaDelta(), opt.Adamax(), opt.Ftrl(), opt.FTML(),
+              opt.Signum(), opt.NAG(momentum=0.9), opt.LARS(),
+              opt.LAMB(), opt.DCASGD()):
+        got = _run(o, w0, grads)
+        assert got.shape == w0.shape
+        assert onp.isfinite(got).all(), type(o).__name__
+
+
+def test_lamb_trust_ratio():
+    w0 = onp.array([3.0, 4.0], dtype="float32")  # norm 5
+    g = onp.array([0.06, 0.08], dtype="float32")
+    got = _run(opt.LAMB(learning_rate=0.1, bias_correction=True), w0, [g])
+    assert onp.isfinite(got).all()
+    assert not onp.allclose(got, w0)
+
+
+def test_clip_and_rescale():
+    o = opt.SGD(learning_rate=1.0, rescale_grad=0.5, clip_gradient=0.1)
+    w = mx.np.array([0.0])
+    state = o.create_state(0, w)
+    o.update(0, w, mx.np.array([10.0]), state)
+    # 10*0.5=5 clipped to 0.1 => w = -0.1
+    assert_almost_equal(w.asnumpy(), onp.array([-0.1]), rtol=1e-6, atol=1e-7)
+
+
+def test_lr_scheduler_integration():
+    from mxnet_tpu.lr_scheduler import FactorScheduler, CosineScheduler, \
+        MultiFactorScheduler, PolyScheduler
+    s = FactorScheduler(step=2, factor=0.5, base_lr=1.0)
+    assert s(0) == 1.0 and s(2) == 0.5 and s(4) == 0.25
+    s2 = MultiFactorScheduler(step=[3, 6], factor=0.1, base_lr=1.0)
+    assert s2(2) == 1.0 and abs(s2(4) - 0.1) < 1e-9 and abs(s2(7) - 0.01) < 1e-9
+    s3 = CosineScheduler(max_update=10, base_lr=1.0, final_lr=0.0)
+    assert s3(0) == 1.0 and abs(s3(10)) < 1e-9
+    s4 = PolyScheduler(max_update=10, base_lr=1.0, warmup_steps=2,
+                       warmup_begin_lr=0.0)
+    assert s4(1) < 1.0  # warming up
+    o = opt.SGD(learning_rate=1.0, lr_scheduler=FactorScheduler(
+        step=1, factor=0.5, base_lr=1.0))
+    w = mx.np.array([0.0]); st = o.create_state(0, w)
+    st = o.update(0, w, mx.np.array([1.0]), st)
+    assert o.learning_rate == 0.5  # after 1 update
+
+
+def test_multi_precision_master_weights():
+    o = opt.SGD(learning_rate=0.1, momentum=0.9, multi_precision=True)
+    w = mx.np.array([1.0, 2.0]).astype("bfloat16")
+    state = o.create_state_multi_precision(0, w)
+    assert isinstance(state, tuple) and len(state) == 2  # (master, (mom,))
+    g = mx.np.array([0.1, 0.1]).astype("bfloat16")
+    state = o.update_multi_precision(0, w, g, state)
+    assert "bfloat16" in str(w.dtype)
+    master = state[0]
+    assert str(master.dtype) == "float32"
+
+
+def test_optimizer_registry():
+    o = opt.create("adam", learning_rate=0.003)
+    assert isinstance(o, opt.Adam)
+    assert o.learning_rate == pytest.approx(0.003)
+    with pytest.raises(mx.MXNetError):
+        opt.create("nonexistent")
+
+
+def test_trainer_save_load_states(tmp_path):
+    from mxnet_tpu.gluon import nn
+    net = nn.Dense(2, in_units=2)
+    net.initialize()
+    tr = mx.gluon.Trainer(net.collect_params(), "adam")
+    x = mx.np.ones((1, 2))
+    with mx.autograd.record():
+        loss = net(x).sum()
+    loss.backward()
+    tr.step(1)
+    f = str(tmp_path / "trainer.states")
+    tr.save_states(f)
+
+    tr2 = mx.gluon.Trainer(net.collect_params(), "adam")
+    tr2.load_states(f)
+    assert tr2._optimizer.num_update == tr._optimizer.num_update
+    assert set(tr2._states) == set(tr._states)
