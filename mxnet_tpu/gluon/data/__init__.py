@@ -1,0 +1,12 @@
+"""``gluon.data`` — datasets, samplers, DataLoader (reference:
+``python/mxnet/gluon/data/``)."""
+from .dataset import ArrayDataset, Dataset, SimpleDataset
+from .sampler import (BatchSampler, FilterSampler, IntervalSampler,
+                      RandomSampler, Sampler, SequentialSampler)
+from .dataloader import DataLoader, default_batchify_fn
+from . import vision
+
+__all__ = ["ArrayDataset", "Dataset", "SimpleDataset", "BatchSampler",
+           "FilterSampler", "IntervalSampler", "RandomSampler", "Sampler",
+           "SequentialSampler", "DataLoader", "default_batchify_fn",
+           "vision"]

@@ -1,0 +1,204 @@
+"""DataLoader — batched, shuffled, multi-worker data loading.
+
+Reference parity (leezu/mxnet): ``python/mxnet/gluon/data/dataloader.py`` —
+``DataLoader(dataset, batch_size, shuffle, sampler, last_batch,
+batch_sampler, batchify_fn, num_workers, pin_memory, thread_pool,
+prefetch)``.
+
+Design (tpu-first): the reference forks worker processes and ships
+NDArrays back through POSIX shared memory (``cpu_shared_storage_manager``).
+Here workers produce **numpy** batches (host memory) in a persistent
+``multiprocessing`` pool with index-order prefetch, and the main process
+uploads to device — matching jax's host-to-device model where the transfer
+wants one contiguous pinned buffer per batch. ``thread_pool=True`` uses
+threads (for datasets that are not fork-safe). The engine's atfork concern
+(reference ``src/initialize.cc ForkHandler``) does not apply: workers never
+touch the device.
+"""
+from __future__ import annotations
+
+import multiprocessing
+import multiprocessing.pool
+import threading
+from collections import deque
+from typing import Any, Callable, List, Optional, Sequence
+
+import numpy as _np
+
+from ...base import MXNetError
+from ...ndarray.ndarray import NDArray
+from .dataset import Dataset
+from .sampler import BatchSampler, RandomSampler, Sampler, SequentialSampler
+
+__all__ = ["DataLoader", "default_batchify_fn", "default_mp_batchify_fn"]
+
+
+def _as_numpy(sample: Any) -> Any:
+    if isinstance(sample, NDArray):
+        return sample.asnumpy()
+    return sample
+
+
+def default_batchify_fn(data: Sequence[Any]) -> Any:
+    """Stack samples into a batch (reference: default_batchify_fn)."""
+    first = data[0]
+    if isinstance(first, tuple):
+        return tuple(default_batchify_fn([d[i] for d in data])
+                     for i in range(len(first)))
+    if isinstance(first, NDArray):
+        from ...ndarray import ops
+        return ops.stack(list(data), axis=0)
+    arrs = [_np.asarray(_as_numpy(d)) for d in data]
+    return NDArray(_np.stack(arrs, axis=0))
+
+
+default_mp_batchify_fn = default_batchify_fn
+
+
+# worker globals installed by the pool initializer (fork start method)
+_WORKER_DATASET: Optional[Dataset] = None
+_WORKER_BATCHIFY: Optional[Callable] = None
+
+
+def _worker_init(dataset: Dataset, batchify_fn: Callable) -> None:
+    global _WORKER_DATASET, _WORKER_BATCHIFY
+    _WORKER_DATASET = dataset
+    _WORKER_BATCHIFY = batchify_fn
+
+
+def _np_batchify(samples: List[Any]) -> Any:
+    """Batchify to plain numpy inside workers (NDArrays don't cross the
+    process boundary; numpy pickles via shared pages on fork+POSIX)."""
+    first = samples[0]
+    if isinstance(first, tuple):
+        return tuple(_np_batchify([s[i] for s in samples])
+                     for i in range(len(first)))
+    return _np.stack([_np.asarray(_as_numpy(s)) for s in samples], axis=0)
+
+
+def _batch_to_np(batch: Any) -> Any:
+    """Convert a batch (possibly NDArrays from a custom batchify_fn) to
+    numpy so it crosses the process boundary."""
+    if isinstance(batch, (tuple, list)):
+        return type(batch)(_batch_to_np(b) for b in batch)
+    if isinstance(batch, dict):
+        return {k: _batch_to_np(v) for k, v in batch.items()}
+    if isinstance(batch, NDArray):
+        return batch.asnumpy()
+    return batch
+
+
+def _worker_fn(indices: List[int]) -> Any:
+    samples = [_WORKER_DATASET[i] for i in indices]
+    if _WORKER_BATCHIFY is not None:
+        return _batch_to_np(_WORKER_BATCHIFY(samples))
+    return _np_batchify(samples)
+
+
+def _to_ndarray(batch: Any) -> Any:
+    if isinstance(batch, tuple):
+        return tuple(_to_ndarray(b) for b in batch)
+    if isinstance(batch, NDArray):
+        return batch
+    return NDArray(batch)
+
+
+class DataLoader:
+    def __init__(self, dataset: Dataset, batch_size: Optional[int] = None,
+                 shuffle: bool = False, sampler: Optional[Sampler] = None,
+                 last_batch: Optional[str] = None,
+                 batch_sampler: Optional[BatchSampler] = None,
+                 batchify_fn: Optional[Callable] = None,
+                 num_workers: int = 0, pin_memory: bool = False,
+                 prefetch: Optional[int] = None,
+                 thread_pool: bool = False, timeout: int = 120) -> None:
+        self._dataset = dataset
+        self._pin_memory = pin_memory
+        self._thread_pool = thread_pool
+        self._timeout = timeout
+
+        if batch_sampler is None:
+            if batch_size is None:
+                raise MXNetError("batch_size is required when batch_sampler "
+                                 "is not given")
+            if sampler is None:
+                sampler = RandomSampler(len(dataset)) if shuffle \
+                    else SequentialSampler(len(dataset))
+            elif shuffle:
+                raise MXNetError("shuffle must be False with custom sampler")
+            batch_sampler = BatchSampler(sampler, batch_size,
+                                         last_batch or "keep")
+        elif (batch_size is not None or shuffle or sampler is not None
+              or last_batch is not None):
+            raise MXNetError("batch_size/shuffle/sampler/last_batch must not "
+                             "be set when batch_sampler is given")
+        self._batch_sampler = batch_sampler
+        self._num_workers = max(0, num_workers)
+        self._prefetch = max(0, prefetch if prefetch is not None
+                             else 2 * self._num_workers)
+        self._custom_batchify = batchify_fn  # None => fast numpy default
+        self._batchify_fn = batchify_fn or default_batchify_fn
+        self._pool = None
+        if self._num_workers > 0:
+            if thread_pool:
+                self._pool = multiprocessing.pool.ThreadPool(
+                    self._num_workers)
+            else:
+                # fork (reference behavior): zero-copy dataset inheritance.
+                # CAVEAT: forking a process whose JAX runtime already spun
+                # up threads can in principle deadlock a child mid-malloc;
+                # workers here never call into jax, which makes this rare
+                # in practice, but pass thread_pool=True for a fork-free
+                # loader if your dataset is GIL-friendly (pure numpy/PIL).
+                ctx = multiprocessing.get_context("fork")
+                self._pool = ctx.Pool(
+                    self._num_workers,
+                    initializer=_worker_init,
+                    initargs=(self._dataset, self._custom_batchify))
+
+    def __iter__(self):
+        if self._pool is None:
+            for indices in self._batch_sampler:
+                yield self._batchify_fn([self._dataset[i] for i in indices])
+            return
+
+        # async prefetch: keep up to `prefetch` outstanding batch jobs
+        pending: deque = deque()
+        batches = iter(self._batch_sampler)
+
+        def submit():
+            try:
+                indices = next(batches)
+            except StopIteration:
+                return False
+            if self._thread_pool:
+                def thread_job(idx):
+                    samples = [self._dataset[i] for i in idx]
+                    if self._custom_batchify is not None:
+                        return self._custom_batchify(samples)
+                    return _np_batchify(samples)
+                job = self._pool.apply_async(thread_job, (indices,))
+            else:
+                job = self._pool.apply_async(_worker_fn, (indices,))
+            pending.append(job)
+            return True
+
+        for _ in range(self._prefetch or 1):
+            if not submit():
+                break
+        while pending:
+            job = pending.popleft()
+            batch = job.get(self._timeout)
+            submit()
+            yield _to_ndarray(batch)
+
+    def __len__(self) -> int:
+        return len(self._batch_sampler)
+
+    def __del__(self) -> None:
+        pool = getattr(self, "_pool", None)  # __init__ may have raised early
+        if pool is not None:
+            try:
+                pool.terminate()
+            except Exception:
+                pass  # interpreter shutdown: modules already torn down

@@ -1,0 +1,135 @@
+"""Datasets (reference: ``python/mxnet/gluon/data/dataset.py``)."""
+from __future__ import annotations
+
+from typing import Any, Callable, List, Sequence
+
+from ...ndarray.ndarray import NDArray
+
+__all__ = ["Dataset", "SimpleDataset", "ArrayDataset"]
+
+
+class Dataset:
+    """Abstract dataset: ``__getitem__`` + ``__len__``."""
+
+    def __getitem__(self, idx: int) -> Any:
+        raise NotImplementedError
+
+    def __len__(self) -> int:
+        raise NotImplementedError
+
+    def filter(self, fn: Callable[[Any], bool]) -> "SimpleDataset":
+        return SimpleDataset([s for s in self if fn(s)])
+
+    def shard(self, num_shards: int, index: int) -> "_ShardedDataset":
+        return _ShardedDataset(self, num_shards, index)
+
+    def take(self, count: int) -> "_TakenDataset":
+        return _TakenDataset(self, count)
+
+    def sample(self, sampler) -> "_SampledDataset":
+        return _SampledDataset(self, sampler)
+
+    def transform(self, fn: Callable, lazy: bool = True) -> "Dataset":
+        """Return a dataset with ``fn`` applied to each sample."""
+        trans = _LazyTransformDataset(self, fn)
+        if lazy:
+            return trans
+        return SimpleDataset([trans[i] for i in range(len(trans))])
+
+    def transform_first(self, fn: Callable, lazy: bool = True) -> "Dataset":
+        """Apply ``fn`` to the first element of each (data, label) sample;
+        bare (non-tuple) samples pass through fn directly."""
+        def first(*sample):
+            if len(sample) == 1:
+                return fn(sample[0])
+            return (fn(sample[0]), *sample[1:])
+        return self.transform(first, lazy)
+
+    def __iter__(self):
+        for i in range(len(self)):
+            yield self[i]
+
+
+class SimpleDataset(Dataset):
+    def __init__(self, data: Sequence[Any]) -> None:
+        self._data = data
+
+    def __getitem__(self, idx: int) -> Any:
+        return self._data[idx]
+
+    def __len__(self) -> int:
+        return len(self._data)
+
+
+class ArrayDataset(Dataset):
+    """Zip of equal-length arrays: sample i = (a[i], b[i], ...)."""
+
+    def __init__(self, *args: Any) -> None:
+        assert args, "needs at least one array"
+        self._length = len(args[0])
+        for a in args:
+            assert len(a) == self._length, "all arrays must share length"
+        self._data = list(args)
+
+    def __getitem__(self, idx: int) -> Any:
+        if len(self._data) == 1:
+            return self._data[0][idx]
+        return tuple(d[idx] for d in self._data)
+
+    def __len__(self) -> int:
+        return self._length
+
+
+class _LazyTransformDataset(Dataset):
+    def __init__(self, dataset: Dataset, fn: Callable) -> None:
+        self._dataset = dataset
+        self._fn = fn
+
+    def __getitem__(self, idx: int) -> Any:
+        sample = self._dataset[idx]
+        if isinstance(sample, tuple):
+            return self._fn(*sample)
+        return self._fn(sample)
+
+    def __len__(self) -> int:
+        return len(self._dataset)
+
+
+class _ShardedDataset(Dataset):
+    def __init__(self, dataset: Dataset, num_shards: int, index: int) -> None:
+        self._dataset = dataset
+        self._num = num_shards
+        self._index = index
+
+    def __getitem__(self, idx: int) -> Any:
+        return self._dataset[idx * self._num + self._index]
+
+    def __len__(self) -> int:
+        n = len(self._dataset)
+        return (n - self._index + self._num - 1) // self._num
+
+
+class _TakenDataset(Dataset):
+    def __init__(self, dataset: Dataset, count: int) -> None:
+        self._dataset = dataset
+        self._count = min(count, len(dataset))
+
+    def __getitem__(self, idx: int) -> Any:
+        if idx >= self._count:
+            raise IndexError(idx)
+        return self._dataset[idx]
+
+    def __len__(self) -> int:
+        return self._count
+
+
+class _SampledDataset(Dataset):
+    def __init__(self, dataset: Dataset, sampler) -> None:
+        self._dataset = dataset
+        self._indices = list(sampler)
+
+    def __getitem__(self, idx: int) -> Any:
+        return self._dataset[self._indices[idx]]
+
+    def __len__(self) -> int:
+        return len(self._indices)

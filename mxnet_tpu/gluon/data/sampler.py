@@ -1,0 +1,107 @@
+"""Samplers (reference: ``python/mxnet/gluon/data/sampler.py``)."""
+from __future__ import annotations
+
+import math
+import random as _pyrandom
+from typing import Iterator, List
+
+__all__ = ["Sampler", "SequentialSampler", "RandomSampler", "BatchSampler",
+           "IntervalSampler", "FilterSampler"]
+
+
+class Sampler:
+    def __iter__(self) -> Iterator:
+        raise NotImplementedError
+
+    def __len__(self) -> int:
+        raise NotImplementedError
+
+
+class SequentialSampler(Sampler):
+    def __init__(self, length: int, start: int = 0) -> None:
+        self._length = length
+        self._start = start
+
+    def __iter__(self):
+        return iter(range(self._start, self._start + self._length))
+
+    def __len__(self) -> int:
+        return self._length
+
+
+class RandomSampler(Sampler):
+    def __init__(self, length: int) -> None:
+        self._length = length
+
+    def __iter__(self):
+        indices = list(range(self._length))
+        _pyrandom.shuffle(indices)
+        return iter(indices)
+
+    def __len__(self) -> int:
+        return self._length
+
+
+class IntervalSampler(Sampler):
+    def __init__(self, length: int, interval: int, rollover: bool = True) -> None:
+        self._length = length
+        self._interval = interval
+        self._rollover = rollover
+
+    def __iter__(self):
+        starts = range(self._interval) if self._rollover else [0]
+        for start in starts:
+            for i in range(start, self._length, self._interval):
+                yield i
+
+    def __len__(self) -> int:
+        return self._length
+
+
+class FilterSampler(Sampler):
+    def __init__(self, fn, dataset) -> None:
+        self._indices = [i for i in range(len(dataset)) if fn(dataset[i])]
+
+    def __iter__(self):
+        return iter(self._indices)
+
+    def __len__(self) -> int:
+        return len(self._indices)
+
+
+class BatchSampler(Sampler):
+    """Wrap a sampler into batches; last_batch in {'keep','discard',
+    'rollover'} (reference semantics)."""
+
+    def __init__(self, sampler: Sampler, batch_size: int,
+                 last_batch: str = "keep") -> None:
+        self._sampler = sampler
+        self._batch_size = batch_size
+        self._last_batch = last_batch
+        self._prev: List[int] = []
+
+    def __iter__(self):
+        batch, self._prev = self._prev, []
+        for i in self._sampler:
+            batch.append(i)
+            if len(batch) == self._batch_size:
+                yield batch
+                batch = []
+        if batch:
+            if self._last_batch == "keep":
+                yield batch
+            elif self._last_batch == "discard":
+                return
+            elif self._last_batch == "rollover":
+                self._prev = batch
+            else:
+                raise ValueError(
+                    f"last_batch must be keep/discard/rollover, "
+                    f"got {self._last_batch}")
+
+    def __len__(self) -> int:
+        if self._last_batch == "keep":
+            return math.ceil(len(self._sampler) / self._batch_size)
+        if self._last_batch == "discard":
+            return len(self._sampler) // self._batch_size
+        return (len(self._sampler) + len(self._prev)) // self._batch_size

@@ -1,0 +1,9 @@
+"""``gluon.data.vision`` — datasets and transforms."""
+from . import transforms
+from .datasets import (MNIST, CIFAR10, CIFAR100, FashionMNIST,
+                       ImageFolderDataset, ImageRecordDataset,
+                       SyntheticImageDataset)
+
+__all__ = ["transforms", "MNIST", "CIFAR10", "CIFAR100", "FashionMNIST",
+           "ImageFolderDataset", "ImageRecordDataset",
+           "SyntheticImageDataset"]

@@ -1,0 +1,280 @@
+"""Vision datasets (reference: ``python/mxnet/gluon/data/vision/datasets.py``).
+
+MNIST/FashionMNIST (idx files), CIFAR10/100 (binary batches),
+ImageFolderDataset (PIL decode), ImageRecordDataset (recordio), and a
+SyntheticImageDataset for benchmarking without data on disk. Downloads are
+not possible in this environment (zero egress): datasets read from a local
+``root`` and raise a clear error naming the expected files when absent.
+"""
+from __future__ import annotations
+
+import gzip
+import os
+import pickle
+import struct
+import tarfile
+from typing import Any, Callable, List, Optional, Tuple
+
+import numpy as _np
+
+from ....base import MXNetError
+from ..dataset import ArrayDataset, Dataset
+
+__all__ = ["MNIST", "FashionMNIST", "CIFAR10", "CIFAR100",
+           "ImageFolderDataset", "ImageRecordDataset",
+           "SyntheticImageDataset"]
+
+
+def _read_idx_images(path: str) -> _np.ndarray:
+    opener = gzip.open if path.endswith(".gz") else open
+    with opener(path, "rb") as f:
+        magic, n, rows, cols = struct.unpack(">IIII", f.read(16))
+        if magic != 2051:
+            raise MXNetError(f"{path}: bad idx image magic {magic}")
+        data = _np.frombuffer(f.read(), dtype=_np.uint8)
+        return data.reshape(n, rows, cols, 1)
+
+
+def _read_idx_labels(path: str) -> _np.ndarray:
+    opener = gzip.open if path.endswith(".gz") else open
+    with opener(path, "rb") as f:
+        magic, n = struct.unpack(">II", f.read(8))
+        if magic != 2049:
+            raise MXNetError(f"{path}: bad idx label magic {magic}")
+        return _np.frombuffer(f.read(), dtype=_np.uint8).astype(_np.int32)
+
+
+class _DownloadedDataset(Dataset):
+    def __init__(self, root: str, train: bool,
+                 transform: Optional[Callable]) -> None:
+        self._root = os.path.expanduser(root)
+        self._train = train
+        self._transform = transform
+        self._data: Optional[_np.ndarray] = None
+        self._label: Optional[_np.ndarray] = None
+        self._get_data()
+
+    def __getitem__(self, idx: int):
+        from ....ndarray.ndarray import NDArray
+        data = NDArray(self._data[idx])
+        label = int(self._label[idx])
+        if self._transform is not None:
+            return self._transform(data, label)
+        return data, label
+
+    def __len__(self) -> int:
+        return len(self._data)
+
+    def _get_data(self) -> None:
+        raise NotImplementedError
+
+
+class MNIST(_DownloadedDataset):
+    """MNIST from idx files in ``root`` (reference: gluon.data.vision.MNIST;
+    files as distributed: train-images-idx3-ubyte[.gz] etc.)."""
+
+    _files = {
+        True: ("train-images-idx3-ubyte", "train-labels-idx1-ubyte"),
+        False: ("t10k-images-idx3-ubyte", "t10k-labels-idx1-ubyte"),
+    }
+
+    def __init__(self, root: str = "~/.mxnet/datasets/mnist",
+                 train: bool = True,
+                 transform: Optional[Callable] = None) -> None:
+        super().__init__(root, train, transform)
+
+    def _find(self, stem: str) -> str:
+        for cand in (stem, stem + ".gz"):
+            p = os.path.join(self._root, cand)
+            if os.path.exists(p):
+                return p
+        raise MXNetError(
+            f"MNIST file {stem}[.gz] not found under {self._root}; this "
+            f"environment has no network egress — place the idx files "
+            f"there manually, or use SyntheticImageDataset for smoke runs")
+
+    def _get_data(self) -> None:
+        img, lbl = self._files[self._train]
+        self._data = _read_idx_images(self._find(img))
+        self._label = _read_idx_labels(self._find(lbl))
+
+
+class FashionMNIST(MNIST):
+    def __init__(self, root: str = "~/.mxnet/datasets/fashion-mnist",
+                 train: bool = True,
+                 transform: Optional[Callable] = None) -> None:
+        super().__init__(root, train, transform)
+
+
+class CIFAR10(_DownloadedDataset):
+    """CIFAR10 from the python-version pickled batches in ``root``."""
+
+    def __init__(self, root: str = "~/.mxnet/datasets/cifar10",
+                 train: bool = True,
+                 transform: Optional[Callable] = None) -> None:
+        super().__init__(root, train, transform)
+
+    def _batches(self) -> List[str]:
+        if self._train:
+            return [f"data_batch_{i}" for i in range(1, 6)]
+        return ["test_batch"]
+
+    def _get_data(self) -> None:
+        base = self._root
+        sub = os.path.join(base, "cifar-10-batches-py")
+        if os.path.isdir(sub):
+            base = sub
+        datas, labels = [], []
+        for name in self._batches():
+            p = os.path.join(base, name)
+            if not os.path.exists(p):
+                raise MXNetError(
+                    f"CIFAR10 batch {name} not found under {base}; place "
+                    f"the python-version batches there (no network egress)")
+            with open(p, "rb") as f:
+                d = pickle.load(f, encoding="bytes")
+            datas.append(d[b"data"].reshape(-1, 3, 32, 32)
+                         .transpose(0, 2, 3, 1))
+            labels.extend(d[b"labels"])
+        self._data = _np.concatenate(datas).astype(_np.uint8)
+        self._label = _np.asarray(labels, dtype=_np.int32)
+
+
+class CIFAR100(CIFAR10):
+    def __init__(self, root: str = "~/.mxnet/datasets/cifar100",
+                 fine_label: bool = True, train: bool = True,
+                 transform: Optional[Callable] = None) -> None:
+        self._fine = fine_label
+        super().__init__(root, train, transform)
+
+    def _get_data(self) -> None:
+        base = self._root
+        sub = os.path.join(base, "cifar-100-python")
+        if os.path.isdir(sub):
+            base = sub
+        name = "train" if self._train else "test"
+        p = os.path.join(base, name)
+        if not os.path.exists(p):
+            raise MXNetError(f"CIFAR100 file {name} not found under {base}")
+        with open(p, "rb") as f:
+            d = pickle.load(f, encoding="bytes")
+        self._data = d[b"data"].reshape(-1, 3, 32, 32).transpose(0, 2, 3, 1) \
+            .astype(_np.uint8)
+        key = b"fine_labels" if self._fine else b"coarse_labels"
+        self._label = _np.asarray(d[key], dtype=_np.int32)
+
+
+class ImageFolderDataset(Dataset):
+    """root/class_x/img.jpg layout, PIL-decoded (reference:
+    ImageFolderDataset; decode was OpenCV in the reference)."""
+
+    def __init__(self, root: str, flag: int = 1,
+                 transform: Optional[Callable] = None) -> None:
+        self._root = os.path.expanduser(root)
+        self._flag = flag
+        self._transform = transform
+        self._exts = {".jpg", ".jpeg", ".png", ".bmp"}
+        self.synsets: List[str] = []
+        self.items: List[Tuple[str, int]] = []
+        if not os.path.isdir(self._root):
+            raise MXNetError(f"ImageFolderDataset root {self._root} missing")
+        for folder in sorted(os.listdir(self._root)):
+            path = os.path.join(self._root, folder)
+            if not os.path.isdir(path):
+                continue
+            label = len(self.synsets)
+            self.synsets.append(folder)
+            for fname in sorted(os.listdir(path)):
+                if os.path.splitext(fname)[1].lower() in self._exts:
+                    self.items.append((os.path.join(path, fname), label))
+
+    def __getitem__(self, idx: int):
+        from PIL import Image
+        from ....ndarray.ndarray import NDArray
+        path, label = self.items[idx]
+        img = Image.open(path)
+        img = img.convert("RGB" if self._flag else "L")
+        arr = _np.asarray(img, dtype=_np.uint8)
+        if arr.ndim == 2:
+            arr = arr[:, :, None]
+        data = NDArray(arr)
+        if self._transform is not None:
+            return self._transform(data, label)
+        return data, label
+
+    def __len__(self) -> int:
+        return len(self.items)
+
+
+class ImageRecordDataset(Dataset):
+    """RecordIO-packed images (reference: ImageRecordDataset over
+    ``tools/im2rec.py`` output)."""
+
+    def __init__(self, filename: str, flag: int = 1,
+                 transform: Optional[Callable] = None) -> None:
+        from ....recordio import MXIndexedRecordIO, MXRecordIO, unpack_img
+        self._flag = flag
+        self._transform = transform
+        self._unpack_img = unpack_img
+        idx_file = os.path.splitext(filename)[0] + ".idx"
+        if os.path.exists(idx_file):
+            self._record = MXIndexedRecordIO(idx_file, filename, "r")
+            self._keys = self._record.keys
+        else:
+            # fall back: scan sequentially once to index in memory
+            rec = MXRecordIO(filename, "r")
+            self._items = []
+            while True:
+                item = rec.read()
+                if item is None:
+                    break
+                self._items.append(item)
+            rec.close()
+            self._record = None
+            self._keys = list(range(len(self._items)))
+
+    def __getitem__(self, idx: int):
+        from ....ndarray.ndarray import NDArray
+        if self._record is not None:
+            raw = self._record.read_idx(self._keys[idx])
+        else:
+            raw = self._items[idx]
+        header, img = self._unpack_img(raw, flag=self._flag)
+        label = header.label
+        if hasattr(label, "__len__") and len(label) == 1:
+            label = float(label[0])
+        data = NDArray(img)
+        if self._transform is not None:
+            return self._transform(data, label)
+        return data, label
+
+    def __len__(self) -> int:
+        return len(self._keys)
+
+
+class SyntheticImageDataset(Dataset):
+    """Deterministic random images+labels for benchmarks — stands in for
+    ImageNet when no data is mounted (benchmark-only; not in reference)."""
+
+    def __init__(self, length: int = 1024,
+                 shape: Tuple[int, ...] = (224, 224, 3),
+                 num_classes: int = 1000, seed: int = 0,
+                 transform: Optional[Callable] = None) -> None:
+        self._length = length
+        self._shape = shape
+        self._num_classes = num_classes
+        self._seed = seed
+        self._transform = transform
+
+    def __getitem__(self, idx: int):
+        from ....ndarray.ndarray import NDArray
+        rng = _np.random.RandomState((self._seed * 1000003 + idx) % (2**31))
+        img = rng.randint(0, 256, size=self._shape, dtype=_np.uint8)
+        label = int(rng.randint(0, self._num_classes))
+        data = NDArray(img)
+        if self._transform is not None:
+            return self._transform(data, label)
+        return data, label
+
+    def __len__(self) -> int:
+        return self._length

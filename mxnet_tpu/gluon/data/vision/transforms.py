@@ -1,0 +1,301 @@
+"""Vision transforms (reference:
+``python/mxnet/gluon/data/vision/transforms.py`` — ToTensor, Normalize,
+Resize, CenterCrop, RandomResizedCrop, RandomFlip*, Cast, Compose; backed
+by C++ image ops in the reference, by numpy/PIL + XLA ops here)."""
+from __future__ import annotations
+
+import random as _pyrandom
+from typing import Any, List, Optional, Sequence, Tuple, Union
+
+import numpy as _np
+
+from ....ndarray.ndarray import NDArray
+from ...block import Block, HybridBlock
+
+__all__ = ["Compose", "Cast", "ToTensor", "Normalize", "Resize",
+           "CenterCrop", "RandomResizedCrop", "RandomCrop",
+           "RandomFlipLeftRight", "RandomFlipTopBottom",
+           "RandomBrightness", "RandomContrast", "RandomSaturation", "RandomHue",
+           "RandomLighting", "RandomColorJitter"]
+
+
+def _to_np(x: Any) -> _np.ndarray:
+    return x.asnumpy() if isinstance(x, NDArray) else _np.asarray(x)
+
+
+class Compose(Block):
+    """Sequentially apply transforms (``transforms.Compose``)."""
+
+    def __init__(self, transforms: Sequence[Any]) -> None:
+        super().__init__()
+        self._transforms = list(transforms)
+
+    def forward(self, x: Any) -> Any:
+        for t in self._transforms:
+            x = t(x)
+        return x
+
+
+class Cast(Block):
+    def __init__(self, dtype: str = "float32") -> None:
+        super().__init__()
+        self._dtype = dtype
+
+    def forward(self, x: NDArray) -> NDArray:
+        return x.astype(self._dtype)
+
+
+class ToTensor(Block):
+    """HWC uint8 [0,255] -> CHW float32 [0,1] (reference semantics)."""
+
+    def forward(self, x: NDArray) -> NDArray:
+        arr = _to_np(x).astype(_np.float32) / 255.0
+        if arr.ndim == 3:
+            arr = arr.transpose(2, 0, 1)
+        elif arr.ndim == 4:
+            arr = arr.transpose(0, 3, 1, 2)
+        return NDArray(arr)
+
+
+class Normalize(Block):
+    """(x - mean) / std per channel, CHW input (after ToTensor)."""
+
+    def __init__(self, mean: Union[float, Sequence[float]] = 0.0,
+                 std: Union[float, Sequence[float]] = 1.0) -> None:
+        super().__init__()
+        self._mean = _np.asarray(mean, dtype=_np.float32)
+        self._std = _np.asarray(std, dtype=_np.float32)
+
+    def forward(self, x: NDArray) -> NDArray:
+        arr = _to_np(x)
+        c = arr.shape[0] if arr.ndim == 3 else arr.shape[1]
+        mean = self._mean.reshape(-1, 1, 1) if self._mean.ndim else self._mean
+        std = self._std.reshape(-1, 1, 1) if self._std.ndim else self._std
+        return NDArray((arr - mean) / std)
+
+
+def _pil_resize(arr: _np.ndarray, size: Tuple[int, int],
+                interpolation: int = 1) -> _np.ndarray:
+    from PIL import Image
+    modes = {0: Image.NEAREST, 1: Image.BILINEAR, 2: Image.BICUBIC,
+             3: Image.LANCZOS}
+    squeeze = arr.shape[-1] == 1
+    img = Image.fromarray(arr.squeeze(-1) if squeeze else arr)
+    img = img.resize(size, modes.get(interpolation, Image.BILINEAR))
+    out = _np.asarray(img)
+    if squeeze:
+        out = out[:, :, None]
+    return out
+
+
+class Resize(Block):
+    """Resize HWC image; ``size`` int (short edge if keep_ratio) or (w,h)."""
+
+    def __init__(self, size: Union[int, Tuple[int, int]],
+                 keep_ratio: bool = False, interpolation: int = 1) -> None:
+        super().__init__()
+        self._size = size
+        self._keep = keep_ratio
+        self._interp = interpolation
+
+    def forward(self, x: NDArray) -> NDArray:
+        arr = _to_np(x)
+        h, w = arr.shape[:2]
+        if isinstance(self._size, int):
+            if self._keep:
+                if h < w:
+                    size = (int(w * self._size / h), self._size)
+                else:
+                    size = (self._size, int(h * self._size / w))
+            else:
+                size = (self._size, self._size)
+        else:
+            size = tuple(self._size)
+        return NDArray(_pil_resize(arr, size, self._interp))
+
+
+class CenterCrop(Block):
+    def __init__(self, size: Union[int, Tuple[int, int]],
+                 interpolation: int = 1) -> None:
+        super().__init__()
+        self._size = (size, size) if isinstance(size, int) else tuple(size)
+        self._interp = interpolation
+
+    def forward(self, x: NDArray) -> NDArray:
+        arr = _to_np(x)
+        h, w = arr.shape[:2]
+        cw, ch = self._size
+        if h < ch or w < cw:
+            arr = _pil_resize(arr, (max(w, cw), max(h, ch)), self._interp)
+            h, w = arr.shape[:2]
+        y0 = (h - ch) // 2
+        x0 = (w - cw) // 2
+        return NDArray(arr[y0:y0 + ch, x0:x0 + cw])
+
+
+class RandomCrop(Block):
+    def __init__(self, size: Union[int, Tuple[int, int]], pad: int = 0,
+                 interpolation: int = 1) -> None:
+        super().__init__()
+        self._size = (size, size) if isinstance(size, int) else tuple(size)
+        self._pad = pad
+        self._interp = interpolation
+
+    def forward(self, x: NDArray) -> NDArray:
+        arr = _to_np(x)
+        if self._pad:
+            arr = _np.pad(arr, ((self._pad,) * 2, (self._pad,) * 2, (0, 0)),
+                          mode="constant")
+        h, w = arr.shape[:2]
+        cw, ch = self._size
+        if h < ch or w < cw:  # upscale like CenterCrop before cropping
+            arr = _pil_resize(arr, (max(w, cw), max(h, ch)), self._interp)
+            h, w = arr.shape[:2]
+        y0 = _pyrandom.randint(0, h - ch)
+        x0 = _pyrandom.randint(0, w - cw)
+        return NDArray(arr[y0:y0 + ch, x0:x0 + cw])
+
+
+class RandomResizedCrop(Block):
+    """Random area/aspect crop then resize (the ImageNet train transform)."""
+
+    def __init__(self, size: Union[int, Tuple[int, int]],
+                 scale: Tuple[float, float] = (0.08, 1.0),
+                 ratio: Tuple[float, float] = (3 / 4, 4 / 3),
+                 interpolation: int = 1) -> None:
+        super().__init__()
+        self._size = (size, size) if isinstance(size, int) else tuple(size)
+        self._scale = scale
+        self._ratio = ratio
+        self._interp = interpolation
+
+    def forward(self, x: NDArray) -> NDArray:
+        import math
+        arr = _to_np(x)
+        h, w = arr.shape[:2]
+        area = h * w
+        for _ in range(10):
+            target = _pyrandom.uniform(*self._scale) * area
+            log_r = (math.log(self._ratio[0]), math.log(self._ratio[1]))
+            aspect = math.exp(_pyrandom.uniform(*log_r))
+            cw = int(round(math.sqrt(target * aspect)))
+            ch = int(round(math.sqrt(target / aspect)))
+            if cw <= w and ch <= h:
+                x0 = _pyrandom.randint(0, w - cw)
+                y0 = _pyrandom.randint(0, h - ch)
+                crop = arr[y0:y0 + ch, x0:x0 + cw]
+                return NDArray(_pil_resize(crop, self._size, self._interp))
+        # fallback: center crop
+        return CenterCrop(self._size, self._interp)(NDArray(arr))
+
+
+class RandomFlipLeftRight(Block):
+    def __init__(self, p: float = 0.5) -> None:
+        super().__init__()
+        self._p = p
+
+    def forward(self, x: NDArray) -> NDArray:
+        if _pyrandom.random() < self._p:
+            return NDArray(_to_np(x)[:, ::-1].copy())
+        return x
+
+
+class RandomFlipTopBottom(Block):
+    def __init__(self, p: float = 0.5) -> None:
+        super().__init__()
+        self._p = p
+
+    def forward(self, x: NDArray) -> NDArray:
+        if _pyrandom.random() < self._p:
+            return NDArray(_to_np(x)[::-1].copy())
+        return x
+
+
+class _RandomJitterBase(Block):
+    def __init__(self, value: float) -> None:
+        super().__init__()
+        self._value = max(0.0, value)
+
+    def _factor(self) -> float:
+        return 1.0 + _pyrandom.uniform(-self._value, self._value)
+
+
+class RandomBrightness(_RandomJitterBase):
+    def forward(self, x: NDArray) -> NDArray:
+        arr = _to_np(x).astype(_np.float32) * self._factor()
+        return NDArray(_np.clip(arr, 0, 255).astype(_to_np(x).dtype))
+
+
+class RandomContrast(_RandomJitterBase):
+    def forward(self, x: NDArray) -> NDArray:
+        arr = _to_np(x).astype(_np.float32)
+        mean = arr.mean()
+        out = (arr - mean) * self._factor() + mean
+        return NDArray(_np.clip(out, 0, 255).astype(_to_np(x).dtype))
+
+
+class RandomSaturation(_RandomJitterBase):
+    def forward(self, x: NDArray) -> NDArray:
+        arr = _to_np(x).astype(_np.float32)
+        gray = arr.mean(axis=-1, keepdims=True)
+        out = (arr - gray) * self._factor() + gray
+        return NDArray(_np.clip(out, 0, 255).astype(_to_np(x).dtype))
+
+
+class RandomLighting(_RandomJitterBase):
+    """AlexNet-style PCA noise."""
+
+    _eigval = _np.array([55.46, 4.794, 1.148], dtype=_np.float32)
+    _eigvec = _np.array([[-0.5675, 0.7192, 0.4009],
+                         [-0.5808, -0.0045, -0.814],
+                         [-0.5836, -0.6948, 0.4203]], dtype=_np.float32)
+
+    def forward(self, x: NDArray) -> NDArray:
+        alpha = _np.random.normal(0, self._value, size=(3,)).astype(_np.float32)
+        rgb = (self._eigvec * alpha * self._eigval).sum(axis=1)
+        arr = _to_np(x).astype(_np.float32) + rgb
+        return NDArray(_np.clip(arr, 0, 255).astype(_to_np(x).dtype))
+
+
+class RandomHue(_RandomJitterBase):
+    """Rotate hue by up to ±value (in [0,0.5] half-turns of the hue wheel),
+    via the YIQ rotation the reference's C++ hue op uses."""
+
+    def forward(self, x: NDArray) -> NDArray:
+        import math
+        alpha = _pyrandom.uniform(-self._value, self._value)
+        theta = alpha * math.pi
+        u, w = math.cos(theta), math.sin(theta)
+        t_yiq = _np.array([[0.299, 0.587, 0.114],
+                           [0.596, -0.274, -0.321],
+                           [0.211, -0.523, 0.311]], dtype=_np.float32)
+        t_rgb = _np.array([[1.0, 0.956, 0.621],
+                           [1.0, -0.272, -0.647],
+                           [1.0, -1.107, 1.705]], dtype=_np.float32)
+        rot = _np.array([[1, 0, 0], [0, u, -w], [0, w, u]], dtype=_np.float32)
+        m = t_rgb @ rot @ t_yiq
+        arr = _to_np(x).astype(_np.float32)
+        out = arr @ m.T
+        return NDArray(_np.clip(out, 0, 255).astype(_to_np(x).dtype))
+
+
+class RandomColorJitter(Block):
+    def __init__(self, brightness: float = 0, contrast: float = 0,
+                 saturation: float = 0, hue: float = 0) -> None:
+        super().__init__()
+        self._ts: List[Block] = []
+        if brightness:
+            self._ts.append(RandomBrightness(brightness))
+        if contrast:
+            self._ts.append(RandomContrast(contrast))
+        if saturation:
+            self._ts.append(RandomSaturation(saturation))
+        if hue:
+            self._ts.append(RandomHue(hue))
+
+    def forward(self, x: NDArray) -> NDArray:
+        order = list(self._ts)
+        _pyrandom.shuffle(order)
+        for t in order:
+            x = t(x)
+        return x

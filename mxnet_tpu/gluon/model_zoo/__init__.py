@@ -1,0 +1,4 @@
+"""``gluon.model_zoo`` (reference: python/mxnet/gluon/model_zoo)."""
+from . import vision
+
+__all__ = ["vision"]

@@ -1,0 +1,38 @@
+"""``gluon.model_zoo.vision`` — classification model zoo (reference:
+``python/mxnet/gluon/model_zoo/vision/__init__.py`` with get_model)."""
+from .resnet import *  # noqa: F401,F403
+from .resnet import __all__ as _resnet_all
+from .simple_nets import *  # noqa: F401,F403
+from .simple_nets import __all__ as _simple_all
+
+from ....base import MXNetError
+
+_models = {
+    "resnet18_v1": resnet18_v1, "resnet34_v1": resnet34_v1,
+    "resnet50_v1": resnet50_v1, "resnet101_v1": resnet101_v1,
+    "resnet152_v1": resnet152_v1, "resnet18_v2": resnet18_v2,
+    "resnet34_v2": resnet34_v2, "resnet50_v2": resnet50_v2,
+    "resnet101_v2": resnet101_v2, "resnet152_v2": resnet152_v2,
+    "vgg11": vgg11, "vgg13": vgg13, "vgg16": vgg16, "vgg19": vgg19,
+    "vgg11_bn": vgg11_bn, "vgg13_bn": vgg13_bn, "vgg16_bn": vgg16_bn,
+    "vgg19_bn": vgg19_bn, "alexnet": alexnet,
+    "squeezenet1.0": squeezenet1_0, "squeezenet1.1": squeezenet1_1,
+    "densenet121": densenet121, "densenet161": densenet161,
+    "densenet169": densenet169, "densenet201": densenet201,
+    "mobilenet1.0": mobilenet1_0, "mobilenet0.75": mobilenet0_75,
+    "mobilenet0.5": mobilenet0_5, "mobilenet0.25": mobilenet0_25,
+    "mobilenetv2_1.0": mobilenet_v2_1_0, "mobilenetv2_0.75": mobilenet_v2_0_75,
+    "mobilenetv2_0.5": mobilenet_v2_0_5, "mobilenetv2_0.25": mobilenet_v2_0_25,
+}
+
+
+def get_model(name: str, **kwargs):
+    """Create a model by name (reference: ``vision.get_model``)."""
+    name = name.lower()
+    if name not in _models:
+        raise MXNetError(
+            f"model {name!r} not in zoo; available: {sorted(_models)}")
+    return _models[name](**kwargs)
+
+
+__all__ = list(_resnet_all) + list(_simple_all) + ["get_model"]

@@ -1,0 +1,259 @@
+"""ResNet v1/v2 (reference: ``python/mxnet/gluon/model_zoo/vision/resnet.py``).
+
+Same architecture family and factory API: resnet18_v1 ... resnet152_v2,
+``get_resnet(version, num_layers)``. BASELINE config 2's model.
+"""
+from __future__ import annotations
+
+from typing import Any, List, Optional, Type
+
+from ....base import MXNetError
+from ...block import HybridBlock
+from ...nn import (Activation, AvgPool2D, BatchNorm, Conv2D, Dense, Flatten,
+                   GlobalAvgPool2D, HybridSequential, MaxPool2D)
+
+__all__ = ["ResNetV1", "ResNetV2", "BasicBlockV1", "BasicBlockV2",
+           "BottleneckV1", "BottleneckV2", "get_resnet",
+           "resnet18_v1", "resnet34_v1", "resnet50_v1", "resnet101_v1",
+           "resnet152_v1", "resnet18_v2", "resnet34_v2", "resnet50_v2",
+           "resnet101_v2", "resnet152_v2"]
+
+
+def _conv3x3(channels: int, stride: int, in_channels: int) -> Conv2D:
+    return Conv2D(channels, kernel_size=3, strides=stride, padding=1,
+                  use_bias=False, in_channels=in_channels)
+
+
+class BasicBlockV1(HybridBlock):
+    def __init__(self, channels: int, stride: int, downsample: bool = False,
+                 in_channels: int = 0, **kwargs: Any) -> None:
+        super().__init__(**kwargs)
+        self.body = HybridSequential()
+        self.body.add(_conv3x3(channels, stride, in_channels))
+        self.body.add(BatchNorm())
+        self.body.add(Activation("relu"))
+        self.body.add(_conv3x3(channels, 1, channels))
+        self.body.add(BatchNorm())
+        if downsample:
+            self.downsample = HybridSequential()
+            self.downsample.add(Conv2D(channels, kernel_size=1,
+                                       strides=stride, use_bias=False,
+                                       in_channels=in_channels))
+            self.downsample.add(BatchNorm())
+        else:
+            self.downsample = None
+
+    def forward(self, x):
+        residual = x
+        out = self.body(x)
+        if self.downsample is not None:
+            residual = self.downsample(x)
+        from .... import npx
+        return npx.relu(out + residual)
+
+
+class BottleneckV1(HybridBlock):
+    def __init__(self, channels: int, stride: int, downsample: bool = False,
+                 in_channels: int = 0, **kwargs: Any) -> None:
+        super().__init__(**kwargs)
+        self.body = HybridSequential()
+        self.body.add(Conv2D(channels // 4, kernel_size=1, strides=stride))
+        self.body.add(BatchNorm())
+        self.body.add(Activation("relu"))
+        self.body.add(_conv3x3(channels // 4, 1, channels // 4))
+        self.body.add(BatchNorm())
+        self.body.add(Activation("relu"))
+        self.body.add(Conv2D(channels, kernel_size=1, strides=1))
+        self.body.add(BatchNorm())
+        if downsample:
+            self.downsample = HybridSequential()
+            self.downsample.add(Conv2D(channels, kernel_size=1,
+                                       strides=stride, use_bias=False,
+                                       in_channels=in_channels))
+            self.downsample.add(BatchNorm())
+        else:
+            self.downsample = None
+
+    def forward(self, x):
+        residual = x
+        out = self.body(x)
+        if self.downsample is not None:
+            residual = self.downsample(x)
+        from .... import npx
+        return npx.relu(out + residual)
+
+
+class BasicBlockV2(HybridBlock):
+    def __init__(self, channels: int, stride: int, downsample: bool = False,
+                 in_channels: int = 0, **kwargs: Any) -> None:
+        super().__init__(**kwargs)
+        self.bn1 = BatchNorm()
+        self.conv1 = _conv3x3(channels, stride, in_channels)
+        self.bn2 = BatchNorm()
+        self.conv2 = _conv3x3(channels, 1, channels)
+        if downsample:
+            self.downsample = Conv2D(channels, 1, strides=stride,
+                                     use_bias=False, in_channels=in_channels)
+        else:
+            self.downsample = None
+
+    def forward(self, x):
+        from .... import npx
+        residual = x
+        out = npx.relu(self.bn1(x))
+        if self.downsample is not None:
+            residual = self.downsample(out)
+        out = self.conv1(out)
+        out = npx.relu(self.bn2(out))
+        out = self.conv2(out)
+        return out + residual
+
+
+class BottleneckV2(HybridBlock):
+    def __init__(self, channels: int, stride: int, downsample: bool = False,
+                 in_channels: int = 0, **kwargs: Any) -> None:
+        super().__init__(**kwargs)
+        self.bn1 = BatchNorm()
+        self.conv1 = Conv2D(channels // 4, 1, strides=1, use_bias=False)
+        self.bn2 = BatchNorm()
+        self.conv2 = _conv3x3(channels // 4, stride, channels // 4)
+        self.bn3 = BatchNorm()
+        self.conv3 = Conv2D(channels, 1, strides=1, use_bias=False)
+        if downsample:
+            self.downsample = Conv2D(channels, 1, strides=stride,
+                                     use_bias=False, in_channels=in_channels)
+        else:
+            self.downsample = None
+
+    def forward(self, x):
+        from .... import npx
+        residual = x
+        out = npx.relu(self.bn1(x))
+        if self.downsample is not None:
+            residual = self.downsample(out)
+        out = self.conv1(out)
+        out = npx.relu(self.bn2(out))
+        out = self.conv2(out)
+        out = npx.relu(self.bn3(out))
+        out = self.conv3(out)
+        return out + residual
+
+
+_BLOCK_V1 = {"basic_block": BasicBlockV1, "bottle_neck": BottleneckV1}
+_BLOCK_V2 = {"basic_block": BasicBlockV2, "bottle_neck": BottleneckV2}
+
+# num_layers -> (block_type, layers-per-stage, channels-per-stage)
+_RESNET_SPEC = {
+    18: ("basic_block", [2, 2, 2, 2], [64, 64, 128, 256, 512]),
+    34: ("basic_block", [3, 4, 6, 3], [64, 64, 128, 256, 512]),
+    50: ("bottle_neck", [3, 4, 6, 3], [64, 256, 512, 1024, 2048]),
+    101: ("bottle_neck", [3, 4, 23, 3], [64, 256, 512, 1024, 2048]),
+    152: ("bottle_neck", [3, 8, 36, 3], [64, 256, 512, 1024, 2048]),
+}
+
+
+class ResNetV1(HybridBlock):
+    def __init__(self, block: type, layers: List[int], channels: List[int],
+                 classes: int = 1000, thumbnail: bool = False,
+                 **kwargs: Any) -> None:
+        super().__init__(**kwargs)
+        assert len(layers) == len(channels) - 1
+        self.features = HybridSequential()
+        if thumbnail:
+            self.features.add(_conv3x3(channels[0], 1, 0))
+        else:
+            self.features.add(Conv2D(channels[0], 7, strides=2, padding=3,
+                                     use_bias=False))
+            self.features.add(BatchNorm())
+            self.features.add(Activation("relu"))
+            self.features.add(MaxPool2D(3, 2, 1))
+        for i, num_layer in enumerate(layers):
+            stride = 1 if i == 0 else 2
+            self.features.add(self._make_layer(
+                block, num_layer, channels[i + 1], stride,
+                in_channels=channels[i]))
+        self.features.add(GlobalAvgPool2D())
+        self.output = Dense(classes, in_units=channels[-1])
+
+    def _make_layer(self, block, layers, channels, stride, in_channels=0):
+        layer = HybridSequential()
+        layer.add(block(channels, stride, channels != in_channels,
+                        in_channels=in_channels))
+        for _ in range(layers - 1):
+            layer.add(block(channels, 1, False, in_channels=channels))
+        return layer
+
+    def forward(self, x):
+        x = self.features(x)
+        return self.output(Flatten()(x))
+
+
+class ResNetV2(HybridBlock):
+    def __init__(self, block: type, layers: List[int], channels: List[int],
+                 classes: int = 1000, thumbnail: bool = False,
+                 **kwargs: Any) -> None:
+        super().__init__(**kwargs)
+        self.features = HybridSequential()
+        self.features.add(BatchNorm(scale=False, center=False))
+        if thumbnail:
+            self.features.add(_conv3x3(channels[0], 1, 0))
+        else:
+            self.features.add(Conv2D(channels[0], 7, strides=2, padding=3,
+                                     use_bias=False))
+            self.features.add(BatchNorm())
+            self.features.add(Activation("relu"))
+            self.features.add(MaxPool2D(3, 2, 1))
+        in_channels = channels[0]
+        for i, num_layer in enumerate(layers):
+            stride = 1 if i == 0 else 2
+            self.features.add(self._make_layer(
+                block, num_layer, channels[i + 1], stride,
+                in_channels=in_channels))
+            in_channels = channels[i + 1]
+        self.features.add(BatchNorm())
+        self.features.add(Activation("relu"))
+        self.features.add(GlobalAvgPool2D())
+        self.output = Dense(classes, in_units=channels[-1])
+
+    _make_layer = ResNetV1._make_layer
+
+    def forward(self, x):
+        x = self.features(x)
+        return self.output(Flatten()(x))
+
+
+def get_resnet(version: int, num_layers: int, pretrained: bool = False,
+               ctx: Any = None, classes: int = 1000,
+               **kwargs: Any) -> HybridBlock:
+    """Factory (reference: ``get_resnet``); pretrained weights require
+    local files (no egress) via ``load_parameters``."""
+    if num_layers not in _RESNET_SPEC:
+        raise MXNetError(f"invalid resnet depth {num_layers}; "
+                         f"options: {sorted(_RESNET_SPEC)}")
+    block_type, layers, channels = _RESNET_SPEC[num_layers]
+    if version == 1:
+        net = ResNetV1(_BLOCK_V1[block_type], layers, channels,
+                       classes=classes, **kwargs)
+    elif version == 2:
+        net = ResNetV2(_BLOCK_V2[block_type], layers, channels,
+                       classes=classes, **kwargs)
+    else:
+        raise MXNetError(f"invalid resnet version {version}")
+    if pretrained:
+        raise MXNetError("pretrained weights unavailable without network "
+                         "egress; call net.load_parameters(path) instead")
+    if ctx is not None:
+        net.initialize(ctx=ctx)
+    return net
+
+
+def resnet18_v1(**kw): return get_resnet(1, 18, **kw)
+def resnet34_v1(**kw): return get_resnet(1, 34, **kw)
+def resnet50_v1(**kw): return get_resnet(1, 50, **kw)
+def resnet101_v1(**kw): return get_resnet(1, 101, **kw)
+def resnet152_v1(**kw): return get_resnet(1, 152, **kw)
+def resnet18_v2(**kw): return get_resnet(2, 18, **kw)
+def resnet34_v2(**kw): return get_resnet(2, 34, **kw)
+def resnet50_v2(**kw): return get_resnet(2, 50, **kw)
+def resnet101_v2(**kw): return get_resnet(2, 101, **kw)
+def resnet152_v2(**kw): return get_resnet(2, 152, **kw)

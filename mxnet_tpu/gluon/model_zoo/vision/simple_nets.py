@@ -1,0 +1,315 @@
+"""AlexNet, VGG, SqueezeNet, DenseNet, MobileNet v1/v2 (reference:
+``python/mxnet/gluon/model_zoo/vision/{alexnet,vgg,squeezenet,densenet,
+mobilenet}.py`` — same architectures, same factory names)."""
+from __future__ import annotations
+
+from typing import Any, List
+
+from ....base import MXNetError
+from ...block import HybridBlock
+from ...nn import (Activation, AvgPool2D, BatchNorm, Conv2D, Dense, Dropout,
+                   Flatten, GlobalAvgPool2D, HybridSequential, MaxPool2D)
+
+__all__ = ["AlexNet", "alexnet", "VGG", "get_vgg", "vgg11", "vgg13", "vgg16",
+           "vgg19", "vgg11_bn", "vgg13_bn", "vgg16_bn", "vgg19_bn",
+           "SqueezeNet", "squeezenet1_0", "squeezenet1_1",
+           "DenseNet", "densenet121", "densenet161", "densenet169",
+           "densenet201", "MobileNet", "MobileNetV2", "mobilenet1_0",
+           "mobilenet0_75", "mobilenet0_5", "mobilenet0_25",
+           "mobilenet_v2_1_0", "mobilenet_v2_0_75", "mobilenet_v2_0_5",
+           "mobilenet_v2_0_25"]
+
+
+class AlexNet(HybridBlock):
+    def __init__(self, classes: int = 1000, **kwargs: Any) -> None:
+        super().__init__(**kwargs)
+        self.features = HybridSequential()
+        self.features.add(Conv2D(64, 11, 4, 2, activation="relu"))
+        self.features.add(MaxPool2D(3, 2))
+        self.features.add(Conv2D(192, 5, padding=2, activation="relu"))
+        self.features.add(MaxPool2D(3, 2))
+        self.features.add(Conv2D(384, 3, padding=1, activation="relu"))
+        self.features.add(Conv2D(256, 3, padding=1, activation="relu"))
+        self.features.add(Conv2D(256, 3, padding=1, activation="relu"))
+        self.features.add(MaxPool2D(3, 2))
+        self.features.add(Flatten())
+        self.features.add(Dense(4096, activation="relu"))
+        self.features.add(Dropout(0.5))
+        self.features.add(Dense(4096, activation="relu"))
+        self.features.add(Dropout(0.5))
+        self.output = Dense(classes)
+
+    def forward(self, x):
+        return self.output(self.features(x))
+
+
+def alexnet(classes: int = 1000, ctx: Any = None, **kw) -> AlexNet:
+    net = AlexNet(classes=classes, **kw)
+    if ctx is not None:
+        net.initialize(ctx=ctx)
+    return net
+
+
+_VGG_SPEC = {
+    11: ([1, 1, 2, 2, 2], [64, 128, 256, 512, 512]),
+    13: ([2, 2, 2, 2, 2], [64, 128, 256, 512, 512]),
+    16: ([2, 2, 3, 3, 3], [64, 128, 256, 512, 512]),
+    19: ([2, 2, 4, 4, 4], [64, 128, 256, 512, 512]),
+}
+
+
+class VGG(HybridBlock):
+    def __init__(self, layers: List[int], filters: List[int],
+                 classes: int = 1000, batch_norm: bool = False,
+                 **kwargs: Any) -> None:
+        super().__init__(**kwargs)
+        self.features = HybridSequential()
+        for i, num in enumerate(layers):
+            for _ in range(num):
+                self.features.add(Conv2D(filters[i], 3, padding=1))
+                if batch_norm:
+                    self.features.add(BatchNorm())
+                self.features.add(Activation("relu"))
+            self.features.add(MaxPool2D(2, 2))
+        self.features.add(Flatten())
+        self.features.add(Dense(4096, activation="relu"))
+        self.features.add(Dropout(0.5))
+        self.features.add(Dense(4096, activation="relu"))
+        self.features.add(Dropout(0.5))
+        self.output = Dense(classes)
+
+    def forward(self, x):
+        return self.output(self.features(x))
+
+
+def get_vgg(num_layers: int, batch_norm: bool = False, classes: int = 1000,
+            ctx: Any = None, **kw) -> VGG:
+    if num_layers not in _VGG_SPEC:
+        raise MXNetError(f"invalid vgg depth {num_layers}")
+    layers, filters = _VGG_SPEC[num_layers]
+    net = VGG(layers, filters, classes=classes, batch_norm=batch_norm, **kw)
+    if ctx is not None:
+        net.initialize(ctx=ctx)
+    return net
+
+
+def vgg11(**kw): return get_vgg(11, **kw)
+def vgg13(**kw): return get_vgg(13, **kw)
+def vgg16(**kw): return get_vgg(16, **kw)
+def vgg19(**kw): return get_vgg(19, **kw)
+def vgg11_bn(**kw): return get_vgg(11, batch_norm=True, **kw)
+def vgg13_bn(**kw): return get_vgg(13, batch_norm=True, **kw)
+def vgg16_bn(**kw): return get_vgg(16, batch_norm=True, **kw)
+def vgg19_bn(**kw): return get_vgg(19, batch_norm=True, **kw)
+
+
+class _Fire(HybridBlock):
+    def __init__(self, squeeze: int, expand1x1: int, expand3x3: int,
+                 **kwargs: Any) -> None:
+        super().__init__(**kwargs)
+        self.squeeze = Conv2D(squeeze, 1, activation="relu")
+        self.expand1 = Conv2D(expand1x1, 1, activation="relu")
+        self.expand3 = Conv2D(expand3x3, 3, padding=1, activation="relu")
+
+    def forward(self, x):
+        from .... import numpy as mxnp
+        s = self.squeeze(x)
+        return mxnp.concatenate([self.expand1(s), self.expand3(s)], axis=1)
+
+
+class SqueezeNet(HybridBlock):
+    def __init__(self, version: str = "1.0", classes: int = 1000,
+                 **kwargs: Any) -> None:
+        super().__init__(**kwargs)
+        self.features = HybridSequential()
+        if version == "1.0":
+            self.features.add(Conv2D(96, 7, 2, activation="relu"))
+            self.features.add(MaxPool2D(3, 2, ceil_mode=True))
+            for spec in [(16, 64, 64), (16, 64, 64), (32, 128, 128)]:
+                self.features.add(_Fire(*spec))
+            self.features.add(MaxPool2D(3, 2, ceil_mode=True))
+            for spec in [(32, 128, 128), (48, 192, 192), (48, 192, 192),
+                         (64, 256, 256)]:
+                self.features.add(_Fire(*spec))
+            self.features.add(MaxPool2D(3, 2, ceil_mode=True))
+            self.features.add(_Fire(64, 256, 256))
+        else:
+            self.features.add(Conv2D(64, 3, 2, activation="relu"))
+            self.features.add(MaxPool2D(3, 2, ceil_mode=True))
+            for spec in [(16, 64, 64), (16, 64, 64)]:
+                self.features.add(_Fire(*spec))
+            self.features.add(MaxPool2D(3, 2, ceil_mode=True))
+            for spec in [(32, 128, 128), (32, 128, 128)]:
+                self.features.add(_Fire(*spec))
+            self.features.add(MaxPool2D(3, 2, ceil_mode=True))
+            for spec in [(48, 192, 192), (48, 192, 192), (64, 256, 256),
+                         (64, 256, 256)]:
+                self.features.add(_Fire(*spec))
+        self.features.add(Dropout(0.5))
+        self.output = HybridSequential()
+        self.output.add(Conv2D(classes, 1, activation="relu"))
+        self.output.add(GlobalAvgPool2D())
+        self.output.add(Flatten())
+
+    def forward(self, x):
+        return self.output(self.features(x))
+
+
+def squeezenet1_0(**kw): return SqueezeNet("1.0", **kw)
+def squeezenet1_1(**kw): return SqueezeNet("1.1", **kw)
+
+
+class _DenseLayer(HybridBlock):
+    def __init__(self, growth_rate: int, bn_size: int, dropout: float,
+                 **kwargs: Any) -> None:
+        super().__init__(**kwargs)
+        self.body = HybridSequential()
+        self.body.add(BatchNorm(), Activation("relu"),
+                      Conv2D(bn_size * growth_rate, 1, use_bias=False),
+                      BatchNorm(), Activation("relu"),
+                      Conv2D(growth_rate, 3, padding=1, use_bias=False))
+        self._dropout = dropout
+
+    def forward(self, x):
+        from .... import numpy as mxnp, npx
+        out = self.body(x)
+        if self._dropout:
+            out = npx.dropout(out, self._dropout)
+        return mxnp.concatenate([x, out], axis=1)
+
+
+_DENSENET_SPEC = {
+    121: (64, 32, [6, 12, 24, 16]),
+    161: (96, 48, [6, 12, 36, 24]),
+    169: (64, 32, [6, 12, 32, 32]),
+    201: (64, 32, [6, 12, 48, 32]),
+}
+
+
+class DenseNet(HybridBlock):
+    def __init__(self, num_init_features: int, growth_rate: int,
+                 block_config: List[int], bn_size: int = 4,
+                 dropout: float = 0.0, classes: int = 1000,
+                 **kwargs: Any) -> None:
+        super().__init__(**kwargs)
+        self.features = HybridSequential()
+        self.features.add(Conv2D(num_init_features, 7, 2, 3, use_bias=False))
+        self.features.add(BatchNorm(), Activation("relu"), MaxPool2D(3, 2, 1))
+        num_features = num_init_features
+        for i, num_layers in enumerate(block_config):
+            block = HybridSequential()
+            for _ in range(num_layers):
+                block.add(_DenseLayer(growth_rate, bn_size, dropout))
+            self.features.add(block)
+            num_features += num_layers * growth_rate
+            if i != len(block_config) - 1:
+                trans = HybridSequential()
+                trans.add(BatchNorm(), Activation("relu"),
+                          Conv2D(num_features // 2, 1, use_bias=False),
+                          AvgPool2D(2, 2))
+                self.features.add(trans)
+                num_features //= 2
+        self.features.add(BatchNorm(), Activation("relu"), GlobalAvgPool2D(),
+                          Flatten())
+        self.output = Dense(classes)
+
+    def forward(self, x):
+        return self.output(self.features(x))
+
+
+def _densenet(n, **kw):
+    init, growth, config = _DENSENET_SPEC[n]
+    return DenseNet(init, growth, config, **kw)
+
+
+def densenet121(**kw): return _densenet(121, **kw)
+def densenet161(**kw): return _densenet(161, **kw)
+def densenet169(**kw): return _densenet(169, **kw)
+def densenet201(**kw): return _densenet(201, **kw)
+
+
+class MobileNet(HybridBlock):
+    """MobileNet v1 with width multiplier."""
+
+    def __init__(self, multiplier: float = 1.0, classes: int = 1000,
+                 **kwargs: Any) -> None:
+        super().__init__(**kwargs)
+        m = multiplier
+        def c(ch): return max(8, int(ch * m))
+        self.features = HybridSequential()
+        self.features.add(Conv2D(c(32), 3, 2, 1, use_bias=False),
+                          BatchNorm(), Activation("relu"))
+        spec = [(64, 1), (128, 2), (128, 1), (256, 2), (256, 1), (512, 2),
+                *[(512, 1)] * 5, (1024, 2), (1024, 1)]
+        in_c = c(32)
+        for ch, stride in spec:
+            # depthwise
+            self.features.add(Conv2D(in_c, 3, stride, 1, groups=in_c,
+                                     use_bias=False, in_channels=in_c),
+                              BatchNorm(), Activation("relu"))
+            # pointwise
+            self.features.add(Conv2D(c(ch), 1, use_bias=False),
+                              BatchNorm(), Activation("relu"))
+            in_c = c(ch)
+        self.features.add(GlobalAvgPool2D(), Flatten())
+        self.output = Dense(classes)
+
+    def forward(self, x):
+        return self.output(self.features(x))
+
+
+class _InvertedResidual(HybridBlock):
+    def __init__(self, in_channels: int, channels: int, stride: int,
+                 expansion: int, **kwargs: Any) -> None:
+        super().__init__(**kwargs)
+        self.use_shortcut = stride == 1 and in_channels == channels
+        hidden = in_channels * expansion
+        self.body = HybridSequential()
+        if expansion != 1:
+            self.body.add(Conv2D(hidden, 1, use_bias=False), BatchNorm(),
+                          Activation("relu"))
+        self.body.add(Conv2D(hidden, 3, stride, 1, groups=hidden,
+                             use_bias=False, in_channels=hidden),
+                      BatchNorm(), Activation("relu"))
+        self.body.add(Conv2D(channels, 1, use_bias=False), BatchNorm())
+
+    def forward(self, x):
+        out = self.body(x)
+        return x + out if self.use_shortcut else out
+
+
+class MobileNetV2(HybridBlock):
+    def __init__(self, multiplier: float = 1.0, classes: int = 1000,
+                 **kwargs: Any) -> None:
+        super().__init__(**kwargs)
+        m = multiplier
+        def c(ch): return max(8, int(ch * m))
+        self.features = HybridSequential()
+        self.features.add(Conv2D(c(32), 3, 2, 1, use_bias=False),
+                          BatchNorm(), Activation("relu"))
+        spec = [  # t, c, n, s
+            (1, 16, 1, 1), (6, 24, 2, 2), (6, 32, 3, 2), (6, 64, 4, 2),
+            (6, 96, 3, 1), (6, 160, 3, 2), (6, 320, 1, 1)]
+        in_c = c(32)
+        for t, ch, n, s in spec:
+            for i in range(n):
+                self.features.add(_InvertedResidual(
+                    in_c, c(ch), s if i == 0 else 1, t))
+                in_c = c(ch)
+        last = max(1280, int(1280 * m))
+        self.features.add(Conv2D(last, 1, use_bias=False), BatchNorm(),
+                          Activation("relu"), GlobalAvgPool2D(), Flatten())
+        self.output = Dense(classes)
+
+    def forward(self, x):
+        return self.output(self.features(x))
+
+
+def mobilenet1_0(**kw): return MobileNet(1.0, **kw)
+def mobilenet0_75(**kw): return MobileNet(0.75, **kw)
+def mobilenet0_5(**kw): return MobileNet(0.5, **kw)
+def mobilenet0_25(**kw): return MobileNet(0.25, **kw)
+def mobilenet_v2_1_0(**kw): return MobileNetV2(1.0, **kw)
+def mobilenet_v2_0_75(**kw): return MobileNetV2(0.75, **kw)
+def mobilenet_v2_0_5(**kw): return MobileNetV2(0.5, **kw)
+def mobilenet_v2_0_25(**kw): return MobileNetV2(0.25, **kw)

@@ -1,0 +1,9 @@
+"""``mx.io`` — legacy DataIter interface (reference:
+``python/mxnet/io/io.py`` — DataBatch/DataDesc/DataIter/NDArrayIter/
+ResizeIter/PrefetchingIter; the C++ iterator registry collapses into
+Python iterators over the same batch protocol)."""
+from .io import (DataBatch, DataDesc, DataIter, NDArrayIter, ResizeIter,
+                 PrefetchingIter)
+
+__all__ = ["DataBatch", "DataDesc", "DataIter", "NDArrayIter", "ResizeIter",
+           "PrefetchingIter"]

@@ -1,0 +1,290 @@
+"""Legacy DataIter protocol (reference: ``python/mxnet/io/io.py``)."""
+from __future__ import annotations
+
+from collections import namedtuple
+from typing import Any, Dict, List, Optional, Sequence, Tuple, Union
+
+import numpy as _np
+
+from ..base import MXNetError
+from ..ndarray.ndarray import NDArray
+
+__all__ = ["DataBatch", "DataDesc", "DataIter", "NDArrayIter", "ResizeIter",
+           "PrefetchingIter"]
+
+
+class DataDesc(namedtuple("DataDesc", ["name", "shape", "dtype", "layout"])):
+    def __new__(cls, name, shape, dtype=_np.float32, layout="NCHW"):
+        return super().__new__(cls, name, tuple(shape), dtype, layout)
+
+    @staticmethod
+    def get_batch_axis(layout: Optional[str]) -> int:
+        if layout is None:
+            return 0
+        return layout.find("N")
+
+
+class DataBatch:
+    """One batch: lists of data/label NDArrays + pad/index metadata."""
+
+    def __init__(self, data: Sequence[NDArray],
+                 label: Optional[Sequence[NDArray]] = None,
+                 pad: int = 0, index: Any = None,
+                 provide_data: Any = None, provide_label: Any = None) -> None:
+        self.data = list(data) if data is not None else None
+        self.label = list(label) if label is not None else None
+        self.pad = pad
+        self.index = index
+        self.provide_data = provide_data
+        self.provide_label = provide_label
+
+    def __str__(self) -> str:
+        shapes = [d.shape for d in self.data] if self.data else []
+        return f"DataBatch: data shapes: {shapes} pad: {self.pad}"
+
+
+class DataIter:
+    """Base iterator (reference protocol: reset/next/iter_next +
+    provide_data/provide_label)."""
+
+    def __init__(self, batch_size: int = 0) -> None:
+        self.batch_size = batch_size
+
+    def __iter__(self):
+        return self
+
+    def reset(self) -> None:
+        pass
+
+    def __next__(self) -> DataBatch:
+        return self.next()
+
+    def next(self) -> DataBatch:
+        if self.iter_next():
+            return DataBatch(self.getdata(), self.getlabel(),
+                             self.getpad(), self.getindex())
+        raise StopIteration
+
+    def iter_next(self) -> bool:
+        raise NotImplementedError
+
+    def getdata(self):
+        raise NotImplementedError
+
+    def getlabel(self):
+        raise NotImplementedError
+
+    def getindex(self):
+        return None
+
+    def getpad(self) -> int:
+        return 0
+
+
+def _init_data(data, allow_empty: bool, default_name: str):
+    if data is None:
+        return []
+    if isinstance(data, (NDArray, _np.ndarray)):
+        data = [data]
+    if isinstance(data, (list, tuple)):
+        data = {f"{default_name}{('_%d' % i) if i else ''}": d
+                for i, d in enumerate(data)}
+    out = []
+    for name, arr in data.items():
+        if not isinstance(arr, NDArray):
+            arr = NDArray(_np.asarray(arr))
+        out.append((name, arr))
+    return out
+
+
+class NDArrayIter(DataIter):
+    """Iterate over in-memory arrays (reference: mx.io.NDArrayIter) with
+    pad/discard/roll_over last-batch handling."""
+
+    def __init__(self, data: Any, label: Any = None, batch_size: int = 1,
+                 shuffle: bool = False, last_batch_handle: str = "pad",
+                 data_name: str = "data", label_name: str = "softmax_label"
+                 ) -> None:
+        super().__init__(batch_size)
+        self.data = _init_data(data, False, data_name)
+        self.label = _init_data(label, True, label_name)
+        self.num_data = self.data[0][1].shape[0]
+        if shuffle:
+            order = _np.random.permutation(self.num_data)
+            self.data = [(n, NDArray(d.asnumpy()[order])) for n, d in self.data]
+            self.label = [(n, NDArray(d.asnumpy()[order]))
+                          for n, d in self.label]
+        self.last_batch_handle = last_batch_handle
+        self.cursor = -batch_size
+        # roll_over: a modular stream position persisting across epochs;
+        # leftover samples carry into the next epoch (reference semantics)
+        self._pos = 0
+        self._avail = self.num_data
+        if last_batch_handle == "discard":
+            self.num_batches = self.num_data // batch_size
+        else:
+            self.num_batches = (self.num_data + batch_size - 1) // batch_size
+
+    @property
+    def provide_data(self) -> List[DataDesc]:
+        return [DataDesc(n, (self.batch_size,) + d.shape[1:], d.dtype)
+                for n, d in self.data]
+
+    @property
+    def provide_label(self) -> List[DataDesc]:
+        return [DataDesc(n, (self.batch_size,) + d.shape[1:], d.dtype)
+                for n, d in self.label]
+
+    def reset(self) -> None:
+        if self.last_batch_handle == "roll_over":
+            self._avail += self.num_data  # leftover carries into new epoch
+        else:
+            self.cursor = -self.batch_size
+
+    def iter_next(self) -> bool:
+        if self.last_batch_handle == "roll_over":
+            if self._avail < self.batch_size:
+                return False
+            self._batch_start = self._pos
+            self._pos = (self._pos + self.batch_size) % self.num_data
+            self._avail -= self.batch_size
+            return True
+        self.cursor += self.batch_size
+        if self.last_batch_handle == "discard":
+            return self.cursor + self.batch_size <= self.num_data
+        return self.cursor < self.num_data
+
+    def _slice(self, arrs) -> List[NDArray]:
+        from ..ndarray import ops
+        out = []
+        for _, a in arrs:
+            if self.last_batch_handle == "roll_over":
+                start = self._batch_start
+                end = start + self.batch_size
+                if end <= self.num_data:
+                    out.append(a[start:end])
+                else:
+                    out.append(ops.concatenate(
+                        [a[start:self.num_data], a[0:end - self.num_data]],
+                        axis=0))
+                continue
+            end = self.cursor + self.batch_size
+            if end <= self.num_data:
+                out.append(a[self.cursor:end])
+            else:
+                # pad by wrapping (reference 'pad' semantics)
+                out.append(ops.concatenate(
+                    [a[self.cursor:self.num_data], a[0:end - self.num_data]],
+                    axis=0))
+        return out
+
+    def getdata(self) -> List[NDArray]:
+        return self._slice(self.data)
+
+    def getlabel(self) -> List[NDArray]:
+        return self._slice(self.label)
+
+    def getpad(self) -> int:
+        end = self.cursor + self.batch_size
+        if self.last_batch_handle == "pad" and end > self.num_data:
+            return end - self.num_data
+        return 0
+
+
+class ResizeIter(DataIter):
+    """Resize an iterator to a fixed number of batches."""
+
+    def __init__(self, data_iter: DataIter, size: int,
+                 reset_internal: bool = True) -> None:
+        super().__init__(data_iter.batch_size)
+        self.data_iter = data_iter
+        self.size = size
+        self.reset_internal = reset_internal
+        self.cur = 0
+        self.current_batch: Optional[DataBatch] = None
+
+    def reset(self) -> None:
+        self.cur = 0
+        if self.reset_internal:
+            self.data_iter.reset()
+
+    def iter_next(self) -> bool:
+        if self.cur == self.size:
+            return False
+        try:
+            self.current_batch = next(self.data_iter)
+        except StopIteration:
+            self.data_iter.reset()
+            self.current_batch = next(self.data_iter)
+        self.cur += 1
+        return True
+
+    def next(self) -> DataBatch:
+        if self.iter_next():
+            return self.current_batch
+        raise StopIteration
+
+    def getdata(self):
+        return self.current_batch.data
+
+    def getlabel(self):
+        return self.current_batch.label
+
+
+class PrefetchingIter(DataIter):
+    """Background-thread prefetch over one or more iterators."""
+
+    def __init__(self, iters: Union[DataIter, Sequence[DataIter]],
+                 rename_data=None, rename_label=None) -> None:
+        import threading
+        import queue
+        if isinstance(iters, DataIter):
+            iters = [iters]
+        if len(iters) != 1:
+            raise MXNetError("PrefetchingIter supports a single iterator "
+                             "here; compose datasets upstream instead")
+        self.iter = iters[0]
+        super().__init__(self.iter.batch_size)
+        self.current_batch: Optional[DataBatch] = None
+        self._queue = None
+        self._thread = None
+        self._start_epoch()
+
+    def _start_epoch(self) -> None:
+        import threading
+        import queue
+        self._queue = queue.Queue(maxsize=4)
+        self._thread = threading.Thread(target=self._worker,
+                                        args=(self._queue,), daemon=True)
+        self._thread.start()
+
+    def _worker(self, q) -> None:
+        while True:
+            try:
+                batch = next(self.iter)
+            except StopIteration:
+                q.put(None)
+                break
+            q.put(batch)
+
+    def reset(self) -> None:
+        """Restart prefetching for a new epoch (joins the old producer)."""
+        if self._thread is not None and self._thread.is_alive():
+            # drain so the producer can finish, then join
+            while self._queue.get() is not None:
+                pass
+            self._thread.join()
+        self.iter.reset()
+        self._start_epoch()
+
+    def iter_next(self) -> bool:
+        batch = self._queue.get()
+        if batch is None:
+            return False
+        self.current_batch = batch
+        return True
+
+    def next(self) -> DataBatch:
+        if self.iter_next():
+            return self.current_batch
+        raise StopIteration

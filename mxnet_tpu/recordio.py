@@ -1,0 +1,209 @@
+"""RecordIO — splittable binary record format + image packing.
+
+Reference parity (leezu/mxnet): ``python/mxnet/recordio.py`` +
+``3rdparty/dmlc-core/include/dmlc/recordio.h``. The on-disk format is kept
+COMPATIBLE with the reference (same magic, same record framing, same
+IRHeader struct), so ``.rec`` files packed by the reference's
+``tools/im2rec.py`` read directly and vice versa:
+
+  record  := magic:u32 (0xced7230a) | lrecord:u32 | data | pad to 4B
+  lrecord := cflag:u3 << 29 | length:u29    (cflag 0 = whole record;
+             1/2/3 = begin/middle/end of a multi-part record)
+  IRHeader:= flag:u32 | label:f32 | id:u64 | id2:u64   ('<IfQQ');
+             flag>0 means flag float labels follow the header.
+"""
+from __future__ import annotations
+
+import ctypes
+import io
+import numbers
+import os
+import struct
+from collections import namedtuple
+from typing import Any, Dict, List, Optional, Tuple, Union
+
+import numpy as _np
+
+from .base import MXNetError
+
+__all__ = ["MXRecordIO", "MXIndexedRecordIO", "IRHeader", "pack", "unpack",
+           "pack_img", "unpack_img"]
+
+_KMAGIC = 0xCED7230A
+_LEN_MASK = (1 << 29) - 1
+
+IRHeader = namedtuple("IRHeader", ["flag", "label", "id", "id2"])
+_IR_FORMAT = "<IfQQ"
+_IR_SIZE = struct.calcsize(_IR_FORMAT)
+
+
+class MXRecordIO:
+    """Sequential reader/writer of RecordIO files."""
+
+    def __init__(self, uri: str, flag: str) -> None:
+        self.uri = uri
+        self.flag = flag
+        self.fid: Optional[io.BufferedIOBase] = None
+        self.open()
+
+    def open(self) -> None:
+        if self.flag == "w":
+            self.fid = open(self.uri, "wb")
+            self.writable = True
+        elif self.flag == "r":
+            self.fid = open(self.uri, "rb")
+            self.writable = False
+        else:
+            raise MXNetError(f"invalid flag {self.flag!r} (use 'r'/'w')")
+
+    def close(self) -> None:
+        if self.fid is not None:
+            self.fid.close()
+            self.fid = None
+
+    def reset(self) -> None:
+        self.close()
+        self.open()
+
+    def __del__(self) -> None:
+        self.close()
+
+    def __getstate__(self):
+        d = dict(self.__dict__)
+        d["fid"] = None
+        d["_pos"] = self.tell() if self.fid else 0
+        return d
+
+    def __setstate__(self, d):
+        pos = d.pop("_pos", 0)
+        self.__dict__.update(d)
+        self.open()
+        if not self.writable:
+            self.fid.seek(pos)
+
+    def write(self, buf: bytes) -> None:
+        if not self.writable:
+            raise MXNetError("file opened for reading")
+        length = len(buf)
+        if length > _LEN_MASK:
+            raise MXNetError(f"record too large ({length} bytes)")
+        self.fid.write(struct.pack("<II", _KMAGIC, length))
+        self.fid.write(buf)
+        pad = (-(8 + length)) % 4
+        if pad:
+            self.fid.write(b"\0" * pad)
+
+    def read(self) -> Optional[bytes]:
+        if self.writable:
+            raise MXNetError("file opened for writing")
+        head = self.fid.read(8)
+        if len(head) < 8:
+            return None
+        magic, lrec = struct.unpack("<II", head)
+        if magic != _KMAGIC:
+            raise MXNetError(f"{self.uri}: bad record magic {magic:#x}")
+        length = lrec & _LEN_MASK
+        data = self.fid.read(length)
+        pad = (-(8 + length)) % 4
+        if pad:
+            self.fid.read(pad)
+        return data
+
+    def tell(self) -> int:
+        return self.fid.tell()
+
+    def seek(self, pos: int) -> None:
+        if self.writable:
+            raise MXNetError("cannot seek a writable recordio")
+        self.fid.seek(pos)
+
+
+class MXIndexedRecordIO(MXRecordIO):
+    """RecordIO with a sidecar ``.idx`` (key\\tposition) for random access."""
+
+    def __init__(self, idx_path: str, uri: str, flag: str,
+                 key_type: type = int) -> None:
+        self.idx_path = idx_path
+        self.idx: Dict[Any, int] = {}
+        self.keys: List[Any] = []
+        self.key_type = key_type
+        super().__init__(uri, flag)
+
+    def open(self) -> None:
+        super().open()
+        self.idx = {}
+        self.keys = []
+        if not self.writable and os.path.isfile(self.idx_path):
+            with open(self.idx_path) as f:
+                for line in f:
+                    parts = line.strip().split("\t")
+                    if len(parts) >= 2:
+                        key = self.key_type(parts[0])
+                        self.idx[key] = int(parts[1])
+                        self.keys.append(key)
+
+    def close(self) -> None:
+        if self.fid is not None and self.writable:
+            with open(self.idx_path, "w") as f:
+                for key in self.keys:
+                    f.write(f"{key}\t{self.idx[key]}\n")
+        super().close()
+
+    def read_idx(self, idx: Any) -> bytes:
+        self.seek(self.idx[idx])
+        return self.read()
+
+    def write_idx(self, idx: Any, buf: bytes) -> None:
+        pos = self.fid.tell()
+        self.write(buf)
+        self.idx[idx] = pos
+        self.keys.append(idx)
+
+
+def pack(header: IRHeader, s: bytes) -> bytes:
+    """Serialize IRHeader + payload (reference ``recordio.pack``)."""
+    label = header.label
+    if isinstance(label, numbers.Number):
+        header = header._replace(flag=0, label=float(label))
+        payload = b""
+    else:
+        label_arr = _np.asarray(label, dtype=_np.float32).reshape(-1)
+        header = header._replace(flag=label_arr.size, label=0.0)
+        payload = label_arr.tobytes()
+    return struct.pack(_IR_FORMAT, int(header.flag), float(header.label),
+                       int(header.id), int(header.id2)) + payload + s
+
+
+def unpack(s: bytes) -> Tuple[IRHeader, bytes]:
+    flag, label, id_, id2 = struct.unpack(_IR_FORMAT, s[:_IR_SIZE])
+    s = s[_IR_SIZE:]
+    if flag > 0:
+        arr = _np.frombuffer(s[:flag * 4], dtype=_np.float32)
+        return IRHeader(flag, arr, id_, id2), s[flag * 4:]
+    return IRHeader(flag, label, id_, id2), s
+
+
+def pack_img(header: IRHeader, img: Any, quality: int = 95,
+             img_fmt: str = ".jpg") -> bytes:
+    """JPEG/PNG-encode an HWC uint8 image and pack it."""
+    from PIL import Image
+    arr = img.asnumpy() if hasattr(img, "asnumpy") else _np.asarray(img)
+    if arr.ndim == 3 and arr.shape[2] == 1:
+        arr = arr[:, :, 0]
+    pil = Image.fromarray(arr)
+    buf = io.BytesIO()
+    fmt = "JPEG" if img_fmt.lower() in (".jpg", ".jpeg") else "PNG"
+    pil.save(buf, format=fmt, quality=quality)
+    return pack(header, buf.getvalue())
+
+
+def unpack_img(s: bytes, iscolor: int = -1, flag: int = 1
+               ) -> Tuple[IRHeader, _np.ndarray]:
+    from PIL import Image
+    header, img_bytes = unpack(s)
+    pil = Image.open(io.BytesIO(img_bytes))
+    pil = pil.convert("RGB" if flag else "L")
+    arr = _np.asarray(pil, dtype=_np.uint8)
+    if arr.ndim == 2:
+        arr = arr[:, :, None]
+    return header, arr

@@ -1,0 +1,255 @@
+"""Data pipeline (reference analogs: test_io.py, test_recordio.py,
+test_gluon_data.py)."""
+import numpy as onp
+import pytest
+
+import mxnet_tpu as mx
+from mxnet_tpu.gluon import data as gdata
+from mxnet_tpu.gluon.data.vision import SyntheticImageDataset, transforms
+from mxnet_tpu.test_utils import assert_almost_equal
+
+
+def test_array_dataset_and_transform():
+    X = onp.arange(20, dtype="float32").reshape(10, 2)
+    Y = onp.arange(10, dtype="int32")
+    ds = gdata.ArrayDataset(X, Y)
+    assert len(ds) == 10
+    x0, y0 = ds[3]
+    assert (x0 == X[3]).all() and y0 == 3
+    ds2 = ds.transform(lambda x, y: (x * 2, y))
+    assert (ds2[1][0] == X[1] * 2).all()
+    ds3 = ds.transform_first(lambda x: x + 1)
+    assert (ds3[0][0] == X[0] + 1).all()
+    assert len(ds.take(4)) == 4
+    assert len(ds.shard(3, 0)) == 4
+
+
+def test_samplers():
+    s = gdata.SequentialSampler(5)
+    assert list(s) == [0, 1, 2, 3, 4]
+    r = list(gdata.RandomSampler(100))
+    assert sorted(r) == list(range(100))
+    b = gdata.BatchSampler(gdata.SequentialSampler(7), 3, "keep")
+    batches = list(b)
+    assert batches == [[0, 1, 2], [3, 4, 5], [6]]
+    assert len(b) == 3
+    b2 = gdata.BatchSampler(gdata.SequentialSampler(7), 3, "discard")
+    assert len(list(b2)) == 2
+    b3 = gdata.BatchSampler(gdata.SequentialSampler(7), 3, "rollover")
+    assert len(list(b3)) == 2
+    assert len(list(b3)) == 2  # rollover carries remainder
+
+
+def test_dataloader_basic():
+    X = onp.random.rand(17, 3).astype("float32")
+    Y = onp.arange(17, dtype="int32")
+    loader = gdata.DataLoader(gdata.ArrayDataset(X, Y), batch_size=5)
+    batches = list(loader)
+    assert len(batches) == 4
+    xb, yb = batches[0]
+    assert xb.shape == (5, 3) and yb.shape == (5,)
+    assert_almost_equal(xb, X[:5])
+    assert batches[-1][0].shape == (2, 3)
+    assert len(loader) == 4
+
+
+def test_dataloader_shuffle_covers_all():
+    X = onp.arange(12, dtype="float32")
+    loader = gdata.DataLoader(gdata.ArrayDataset(X), batch_size=4,
+                              shuffle=True)
+    seen = onp.concatenate([b.asnumpy() for b in loader])
+    assert sorted(seen.tolist()) == list(range(12))
+
+
+def test_dataloader_multiworker():
+    X = onp.arange(40, dtype="float32").reshape(20, 2)
+    loader = gdata.DataLoader(gdata.ArrayDataset(X), batch_size=4,
+                              num_workers=2)
+    batches = list(loader)
+    assert len(batches) == 5
+    got = onp.concatenate([b.asnumpy() for b in batches])
+    assert_almost_equal(got, X)
+    # second epoch works with the persistent pool
+    assert len(list(loader)) == 5
+
+
+def test_synthetic_dataset_and_transforms():
+    ds = SyntheticImageDataset(length=8, shape=(32, 32, 3), num_classes=10)
+    img, label = ds[0]
+    assert img.shape == (32, 32, 3) and img.dtype == onp.uint8
+    assert 0 <= label < 10
+    img2, label2 = ds[0]
+    assert (img.asnumpy() == img2.asnumpy()).all()  # deterministic
+
+    t = transforms.Compose([
+        transforms.Resize(16), transforms.ToTensor(),
+        transforms.Normalize(mean=(0.5, 0.5, 0.5), std=(0.5, 0.5, 0.5))])
+    out = t(img)
+    assert out.shape == (3, 16, 16)
+    assert out.asnumpy().min() >= -1.0 and out.asnumpy().max() <= 1.0
+
+
+def test_transform_crops_flips():
+    x = mx.np.array(onp.random.randint(0, 255, (40, 60, 3), dtype=onp.uint8))
+    assert transforms.CenterCrop((20, 10))(x).shape == (10, 20, 3)
+    assert transforms.RandomResizedCrop(24)(x).shape == (24, 24, 3)
+    assert transforms.RandomCrop(16)(x).shape == (16, 16, 3)
+    f = transforms.RandomFlipLeftRight(p=1.0)(x)
+    assert (f.asnumpy() == x.asnumpy()[:, ::-1]).all()
+    j = transforms.RandomColorJitter(0.3, 0.3, 0.3)(x)
+    assert j.shape == x.shape
+
+
+def test_recordio_roundtrip(tmp_path):
+    from mxnet_tpu import recordio
+    path = str(tmp_path / "test.rec")
+    w = recordio.MXRecordIO(path, "w")
+    for i in range(5):
+        w.write(f"record-{i}".encode())
+    w.close()
+    r = recordio.MXRecordIO(path, "r")
+    for i in range(5):
+        assert r.read() == f"record-{i}".encode()
+    assert r.read() is None
+    r.close()
+
+
+def test_indexed_recordio_and_pack_img(tmp_path):
+    from mxnet_tpu import recordio
+    rec = str(tmp_path / "img.rec")
+    idx = str(tmp_path / "img.idx")
+    w = recordio.MXIndexedRecordIO(idx, rec, "w")
+    imgs = []
+    for i in range(3):
+        img = onp.random.randint(0, 255, (8, 8, 3), dtype=onp.uint8)
+        imgs.append(img)
+        header = recordio.IRHeader(0, float(i), i, 0)
+        w.write_idx(i, recordio.pack_img(header, img, img_fmt=".png"))
+    w.close()
+
+    r = recordio.MXIndexedRecordIO(idx, rec, "r")
+    assert r.keys == [0, 1, 2]
+    h, img = recordio.unpack_img(r.read_idx(1))
+    assert h.label == 1.0
+    assert (img == imgs[1]).all()  # png is lossless
+    r.close()
+
+    # ImageRecordDataset reads it
+    ds = mx.gluon.data.vision.ImageRecordDataset(rec)
+    data, label = ds[2]
+    assert data.shape == (8, 8, 3) and label == 2.0
+
+
+def test_recordio_pack_multilabel():
+    from mxnet_tpu import recordio
+    header = recordio.IRHeader(0, [1.0, 2.0, 3.0], 7, 0)
+    buf = recordio.pack(header, b"payload")
+    h, s = recordio.unpack(buf)
+    assert h.flag == 3 and list(h.label) == [1, 2, 3] and h.id == 7
+    assert s == b"payload"
+
+
+def test_ndarray_iter():
+    X = onp.random.rand(10, 4).astype("float32")
+    Y = onp.arange(10, dtype="float32")
+    it = mx.io.NDArrayIter(X, Y, batch_size=3, last_batch_handle="pad")
+    batches = list(it)
+    assert len(batches) == 4
+    assert batches[0].data[0].shape == (3, 4)
+    assert batches[-1].pad == 2
+    it.reset()
+    assert len(list(it)) == 4
+    it2 = mx.io.NDArrayIter(X, Y, batch_size=3, last_batch_handle="discard")
+    assert len(list(it2)) == 3
+    desc = it.provide_data[0]
+    assert desc.shape == (3, 4)
+
+
+def test_model_zoo_constructs():
+    from mxnet_tpu.gluon.model_zoo import vision as zoo
+    x = mx.np.ones((1, 3, 32, 32))
+    net = zoo.get_model("resnet18_v1", classes=10)
+    net.initialize()
+    assert net(x).shape == (1, 10)
+    net2 = zoo.resnet18_v2(classes=10)
+    net2.initialize()
+    assert net2(x).shape == (1, 10)
+    with pytest.raises(mx.MXNetError):
+        zoo.get_model("resnet13_v9")
+
+
+def test_mobilenet_squeezenet_densenet_construct():
+    from mxnet_tpu.gluon.model_zoo import vision as zoo
+    x = mx.np.ones((1, 3, 64, 64))
+    for name in ("mobilenet0.25", "mobilenetv2_0.25", "squeezenet1.1"):
+        net = zoo.get_model(name, classes=10)
+        net.initialize()
+        assert net(x).shape == (1, 10), name
+
+
+def test_dataloader_custom_batchify_multiworker():
+    """Custom batchify_fn must run in workers too (pads ragged samples)."""
+    from mxnet_tpu.gluon.data import SimpleDataset
+    samples = [onp.ones(n, dtype="float32") * n for n in (1, 2, 3, 4)]
+
+    def pad_batchify(batch):
+        L = max(len(b) for b in batch)
+        out = onp.zeros((len(batch), L), dtype="float32")
+        for i, b in enumerate(batch):
+            out[i, :len(b)] = onp.asarray(b)
+        return mx.np.array(out)
+
+    for workers in (0, 2):
+        loader = gdata.DataLoader(SimpleDataset(samples), batch_size=2,
+                                  batchify_fn=pad_batchify,
+                                  num_workers=workers)
+        batches = list(loader)
+        assert batches[0].shape == (2, 2), workers
+        assert batches[1].shape == (2, 4), workers
+
+
+def test_ndarray_iter_roll_over():
+    X = onp.arange(10, dtype="float32")
+    it = mx.io.NDArrayIter(X, None, batch_size=3,
+                           last_batch_handle="roll_over")
+    e1 = [b.data[0].asnumpy() for b in it]
+    assert len(e1) == 3  # only full batches; 1 sample carried
+    it.reset()
+    e2 = [b.data[0].asnumpy() for b in it]
+    assert len(e2) == 3
+    # epoch 2 starts where epoch 1 left off (sample 9 first)
+    assert e2[0][0] == 9.0
+    # across both epochs every sample is seen exactly... (9+9=18 of 20)
+    seen = onp.concatenate(e1 + e2)
+    assert len(seen) == 18
+
+
+def test_prefetching_iter_reset():
+    X = onp.arange(8, dtype="float32")
+    inner = mx.io.NDArrayIter(X, None, batch_size=4)
+    it = mx.io.PrefetchingIter(inner)
+    assert len(list(it)) == 2
+    it.reset()
+    assert len(list(it)) == 2  # second epoch does not hang
+
+
+def test_transform_first_bare_sample():
+    from mxnet_tpu.gluon.data import SimpleDataset
+    ds = SimpleDataset([onp.ones(3), onp.zeros(3)])
+    out = ds.transform_first(lambda x: x + 1)[0]
+    assert not isinstance(out, tuple)
+    assert (out == 2).all()
+
+
+def test_random_crop_small_image_upscales():
+    x = mx.np.array(onp.random.randint(0, 255, (28, 28, 3), dtype=onp.uint8))
+    out = transforms.RandomCrop(32)(x)
+    assert out.shape == (32, 32, 3)
+
+
+def test_random_hue():
+    x = mx.np.array(onp.random.randint(0, 255, (8, 8, 3), dtype=onp.uint8))
+    out = transforms.RandomHue(0.4)(x)
+    assert out.shape == x.shape
+    jit = transforms.RandomColorJitter(hue=0.4)
+    assert len(jit._ts) == 1
