@@ -1,0 +1,79 @@
+"""Benchmark driver hook: prints ONE JSON line with the headline metric.
+
+Config 2 (BASELINE.md): ResNet-50 ImageNet-shape training throughput,
+images/sec/chip — hybridized fwd+bwd+update as one compiled XLA program
+(SPMDTrainer on a 1-chip mesh), Speedometer-style timing.
+
+vs_baseline divides by the 300 img/s midpoint of BASELINE.md's unverified
+V100-fp32 sanity band (no verifiable reference numbers exist — see
+BASELINE.md provenance note).
+
+Env knobs: MXNET_BENCH_BATCH (default 32), MXNET_BENCH_STEPS (default 10),
+MXNET_BENCH_MODEL (resnet50_v1), MXNET_BENCH_DTYPE (float32|bfloat16),
+MXNET_BENCH_IMAGE (224).
+"""
+import json
+import os
+import sys
+import time
+
+sys.path.insert(0, os.path.dirname(os.path.abspath(__file__)))
+
+BASELINE_IMG_S = 300.0  # midpoint of BASELINE.md sanity band (unverified)
+
+
+def main() -> None:
+    import numpy as onp
+    import jax
+
+    batch = int(os.environ.get("MXNET_BENCH_BATCH", "32"))
+    steps = int(os.environ.get("MXNET_BENCH_STEPS", "10"))
+    model_name = os.environ.get("MXNET_BENCH_MODEL", "resnet50_v1")
+    dtype = os.environ.get("MXNET_BENCH_DTYPE", "float32")
+    img = int(os.environ.get("MXNET_BENCH_IMAGE", "224"))
+
+    import mxnet_tpu as mx
+    from mxnet_tpu.gluon.model_zoo import vision as zoo
+    from mxnet_tpu.parallel import SPMDTrainer, make_mesh, \
+        DATA_PARALLEL_RULES
+
+    mx.random.seed(0)
+    net = zoo.get_model(model_name, classes=1000)
+    net.initialize()
+    if dtype != "float32":
+        net.cast(dtype)
+
+    x_np = onp.random.uniform(-1, 1, (batch, 3, img, img)).astype(dtype)
+    y_np = onp.random.randint(0, 1000, (batch,)).astype("int32")
+    # settle deferred shapes once (eagerly, off the clock)
+    net(mx.np.array(x_np[:1]))
+
+    mesh = make_mesh({"dp": 1}, devices=jax.devices()[:1])
+    trainer = SPMDTrainer(
+        net, mx.gluon.loss.SoftmaxCrossEntropyLoss(),
+        optimizer="sgd",
+        optimizer_params={"learning_rate": 0.1, "momentum": 0.9},
+        mesh=mesh, rules=DATA_PARALLEL_RULES)
+
+    x, y = mx.np.array(x_np), mx.np.array(y_np)
+    # warmup: compile
+    loss = trainer.step(x, y)
+    loss.wait_to_read()
+
+    t0 = time.perf_counter()
+    for _ in range(steps):
+        loss = trainer.step(x, y)
+    loss.wait_to_read()
+    dt = time.perf_counter() - t0
+
+    img_per_sec = batch * steps / dt
+    print(json.dumps({
+        "metric": f"{model_name}_{dtype}_b{batch}_train_throughput",
+        "value": round(img_per_sec, 2),
+        "unit": "images/sec/chip",
+        "vs_baseline": round(img_per_sec / BASELINE_IMG_S, 3),
+    }))
+
+
+if __name__ == "__main__":
+    main()

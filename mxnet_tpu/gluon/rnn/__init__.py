@@ -1,0 +1,11 @@
+"""``gluon.rnn`` (reference: python/mxnet/gluon/rnn)."""
+from .rnn_layer import RNN, LSTM, GRU
+from .rnn_cell import (RecurrentCell, RNNCell, LSTMCell, GRUCell,
+                       SequentialRNNCell, HybridSequentialRNNCell,
+                       DropoutCell, ModifierCell, ZoneoutCell, ResidualCell,
+                       BidirectionalCell)
+
+__all__ = ["RNN", "LSTM", "GRU", "RecurrentCell", "RNNCell", "LSTMCell",
+           "GRUCell", "SequentialRNNCell", "HybridSequentialRNNCell",
+           "DropoutCell", "ModifierCell", "ZoneoutCell", "ResidualCell",
+           "BidirectionalCell"]

@@ -1,0 +1,340 @@
+"""Unfused recurrent cells (reference: ``python/mxnet/gluon/rnn/rnn_cell.py``).
+
+RNNCell/LSTMCell/GRUCell + modifiers (Residual/Zoneout/Dropout),
+SequentialRNNCell, BidirectionalCell, HybridSequentialRNNCell, and
+``unroll`` — the explicit-stepping API whose fused equivalent lives in
+``rnn_layer.py``. The reference's equivalence test (fused RNN vs stacked
+cells) is mirrored in tests/test_rnn.py.
+"""
+from __future__ import annotations
+
+from typing import Any, List, Optional, Tuple
+
+from ... import npx
+from ... import numpy as mxnp
+from ...ndarray.ndarray import NDArray
+from ..block import Block, HybridBlock
+from ..parameter import Parameter
+
+__all__ = ["RecurrentCell", "RNNCell", "LSTMCell", "GRUCell",
+           "SequentialRNNCell", "HybridSequentialRNNCell", "DropoutCell",
+           "ModifierCell", "ZoneoutCell", "ResidualCell",
+           "BidirectionalCell"]
+
+
+class RecurrentCell(Block):
+    """Base cell: ``__call__(input, states) -> (output, new_states)``."""
+
+    def __init__(self, **kwargs: Any) -> None:
+        super().__init__(**kwargs)
+        self._modified = False
+
+    def state_info(self, batch_size: int = 0):
+        raise NotImplementedError
+
+    def begin_state(self, batch_size: int = 0, func=None,
+                    ctx=None, **kwargs) -> List[NDArray]:
+        from ...ndarray import ops
+        return [ops.zeros((batch_size, info["shape"][1]), ctx=ctx)
+                for info in self.state_info(batch_size)]
+
+    def reset(self) -> None:
+        pass
+
+    def unroll(self, length: int, inputs: NDArray,
+               begin_state: Optional[List[NDArray]] = None,
+               layout: str = "NTC", merge_outputs: Optional[bool] = None,
+               valid_length: Optional[NDArray] = None):
+        """Unroll the cell over ``length`` steps (reference semantics)."""
+        axis = layout.find("T")
+        batch_axis = layout.find("N")
+        batch_size = inputs.shape[batch_axis]
+        if begin_state is None:
+            begin_state = self.begin_state(batch_size)
+        states = begin_state
+        outputs = []
+        from ...ndarray import ops
+        for t in range(length):
+            step = ops.slice_axis(inputs, axis=axis, begin=t, end=t + 1) \
+                .squeeze(axis)
+            out, states = self(step, states)
+            outputs.append(out)
+        if valid_length is not None:
+            stacked = ops.stack(outputs, axis=axis)
+            stacked = npx.sequence_mask(
+                stacked, valid_length, use_sequence_length=True,
+                axis=axis if axis == 0 else 1)
+            if merge_outputs is False:
+                # match the no-valid_length path: per-step (N, C) outputs
+                outputs = [o.squeeze(axis)
+                           for o in stacked.split(length, axis=axis)]
+            else:
+                return stacked, states
+        if merge_outputs is None or merge_outputs:
+            return ops.stack(outputs, axis=axis), states
+        return outputs, states
+
+
+class _BaseGatedCell(RecurrentCell):
+    def __init__(self, hidden_size: int, num_gates: int,
+                 input_size: int = 0,
+                 i2h_weight_initializer: Any = None,
+                 h2h_weight_initializer: Any = None,
+                 i2h_bias_initializer: Any = "zeros",
+                 h2h_bias_initializer: Any = "zeros",
+                 **kwargs: Any) -> None:
+        super().__init__(**kwargs)
+        self._hidden_size = hidden_size
+        self._input_size = input_size
+        ng = num_gates
+        self.i2h_weight = Parameter("i2h_weight",
+                                    shape=(ng * hidden_size, input_size),
+                                    init=i2h_weight_initializer)
+        self.h2h_weight = Parameter("h2h_weight",
+                                    shape=(ng * hidden_size, hidden_size),
+                                    init=h2h_weight_initializer)
+        self.i2h_bias = Parameter("i2h_bias", shape=(ng * hidden_size,),
+                                  init=i2h_bias_initializer)
+        self.h2h_bias = Parameter("h2h_bias", shape=(ng * hidden_size,),
+                                  init=h2h_bias_initializer)
+        self._ng = ng
+
+    def _proj(self, x: NDArray, h: NDArray) -> Tuple[NDArray, NDArray]:
+        if not self.i2h_weight.is_initialized:
+            self.i2h_weight._finish_deferred_init(
+                (self._ng * self._hidden_size, x.shape[-1]))
+        for p in (self.h2h_weight, self.i2h_bias, self.h2h_bias):
+            if not p.is_initialized:
+                p._finish_deferred_init(p.shape)
+        gi = npx.fully_connected(x, self.i2h_weight.data(),
+                                 self.i2h_bias.data(),
+                                 num_hidden=self._ng * self._hidden_size,
+                                 flatten=False)
+        gh = npx.fully_connected(h, self.h2h_weight.data(),
+                                 self.h2h_bias.data(),
+                                 num_hidden=self._ng * self._hidden_size,
+                                 flatten=False)
+        return gi, gh
+
+
+class RNNCell(_BaseGatedCell):
+    def __init__(self, hidden_size: int, activation: str = "tanh",
+                 **kwargs: Any) -> None:
+        super().__init__(hidden_size, 1, **kwargs)
+        self._activation = activation
+
+    def state_info(self, batch_size: int = 0):
+        return [{"shape": (batch_size, self._hidden_size), "__layout__": "NC"}]
+
+    def forward(self, inputs: NDArray, states: List[NDArray]):
+        gi, gh = self._proj(inputs, states[0])
+        h = npx.activation(gi + gh, self._activation)
+        return h, [h]
+
+
+class LSTMCell(_BaseGatedCell):
+    """Gate order i,f,g,o (reference LSTMCell)."""
+
+    def __init__(self, hidden_size: int, **kwargs: Any) -> None:
+        super().__init__(hidden_size, 4, **kwargs)
+
+    def state_info(self, batch_size: int = 0):
+        return [{"shape": (batch_size, self._hidden_size), "__layout__": "NC"},
+                {"shape": (batch_size, self._hidden_size), "__layout__": "NC"}]
+
+    def forward(self, inputs: NDArray, states: List[NDArray]):
+        h_prev, c_prev = states
+        gi, gh = self._proj(inputs, h_prev)
+        g = gi + gh
+        parts = mxnp.split(g, 4, axis=-1)
+        i = parts[0].sigmoid()
+        f = parts[1].sigmoid()
+        gg = parts[2].tanh()
+        o = parts[3].sigmoid()
+        c = f * c_prev + i * gg
+        h = o * c.tanh()
+        return h, [h, c]
+
+
+class GRUCell(_BaseGatedCell):
+    """Gate order r,z,n with cuDNN-style separate h2h bias."""
+
+    def __init__(self, hidden_size: int, **kwargs: Any) -> None:
+        super().__init__(hidden_size, 3, **kwargs)
+
+    def state_info(self, batch_size: int = 0):
+        return [{"shape": (batch_size, self._hidden_size), "__layout__": "NC"}]
+
+    def forward(self, inputs: NDArray, states: List[NDArray]):
+        h_prev = states[0]
+        gi, gh = self._proj(inputs, h_prev)
+        ir, iz, in_ = mxnp.split(gi, 3, axis=-1)
+        hr, hz, hn = mxnp.split(gh, 3, axis=-1)
+        r = (ir + hr).sigmoid()
+        z = (iz + hz).sigmoid()
+        n = (in_ + r * hn).tanh()
+        h = (1 - z) * n + z * h_prev
+        return h, [h]
+
+
+class SequentialRNNCell(RecurrentCell):
+    """Stack cells; states concatenate across cells."""
+
+    def __init__(self, **kwargs: Any) -> None:
+        super().__init__(**kwargs)
+
+    def add(self, cell: RecurrentCell) -> None:
+        self.register_child(cell)
+
+    def state_info(self, batch_size: int = 0):
+        infos = []
+        for cell in self._children.values():
+            infos.extend(cell.state_info(batch_size))
+        return infos
+
+    def begin_state(self, batch_size: int = 0, **kwargs) -> List[NDArray]:
+        states = []
+        for cell in self._children.values():
+            states.extend(cell.begin_state(batch_size, **kwargs))
+        return states
+
+    def forward(self, inputs: NDArray, states: List[NDArray]):
+        next_states = []
+        pos = 0
+        for cell in self._children.values():
+            n = len(cell.state_info())
+            inputs, cell_states = cell(inputs, states[pos:pos + n])
+            next_states.extend(cell_states)
+            pos += n
+        return inputs, next_states
+
+    def __len__(self) -> int:
+        return len(self._children)
+
+    def __getitem__(self, i: int) -> RecurrentCell:
+        return list(self._children.values())[i]
+
+
+HybridSequentialRNNCell = SequentialRNNCell
+
+
+class DropoutCell(RecurrentCell):
+    def __init__(self, rate: float, axes: Tuple[int, ...] = (),
+                 **kwargs: Any) -> None:
+        super().__init__(**kwargs)
+        self._rate = rate
+        self._axes = axes
+
+    def state_info(self, batch_size: int = 0):
+        return []
+
+    def forward(self, inputs: NDArray, states: List[NDArray]):
+        if self._rate:
+            inputs = npx.dropout(inputs, self._rate, axes=self._axes)
+        return inputs, states
+
+
+class ModifierCell(RecurrentCell):
+    def __init__(self, base_cell: RecurrentCell, **kwargs: Any) -> None:
+        super().__init__(**kwargs)
+        base_cell._modified = True
+        self.base_cell = base_cell
+
+    def state_info(self, batch_size: int = 0):
+        return self.base_cell.state_info(batch_size)
+
+    def begin_state(self, batch_size: int = 0, **kwargs) -> List[NDArray]:
+        self.base_cell._modified = False
+        begin = self.base_cell.begin_state(batch_size, **kwargs)
+        self.base_cell._modified = True
+        return begin
+
+
+class ZoneoutCell(ModifierCell):
+    """Zoneout regularization (reference: ZoneoutCell)."""
+
+    def __init__(self, base_cell: RecurrentCell, zoneout_outputs: float = 0.0,
+                 zoneout_states: float = 0.0, **kwargs: Any) -> None:
+        super().__init__(base_cell, **kwargs)
+        self._zo = zoneout_outputs
+        self._zs = zoneout_states
+        self._prev_output: Optional[NDArray] = None
+
+    def reset(self) -> None:
+        self._prev_output = None
+
+    def forward(self, inputs: NDArray, states: List[NDArray]):
+        from ..._tape import is_training
+        out, new_states = self.base_cell(inputs, states)
+        if not is_training():
+            return out, new_states
+        from ...ndarray import random as rnd
+
+        def mask(p, like):
+            return rnd.bernoulli(1 - p, shape=like.shape)
+
+        prev = self._prev_output
+        if prev is None:
+            prev = out.zeros_like()
+        if self._zo:
+            m = mask(self._zo, out)
+            out = m * out + (1 - m) * prev
+        self._prev_output = out
+        if self._zs:
+            masked = []
+            for ns, s in zip(new_states, states):
+                m = mask(self._zs, ns)  # ONE shared mask selects new vs old
+                masked.append(m * ns + (1 - m) * s)
+            new_states = masked
+        return out, new_states
+
+
+class ResidualCell(ModifierCell):
+    def forward(self, inputs: NDArray, states: List[NDArray]):
+        out, new_states = self.base_cell(inputs, states)
+        return out + inputs, new_states
+
+
+class BidirectionalCell(RecurrentCell):
+    def __init__(self, l_cell: RecurrentCell, r_cell: RecurrentCell,
+                 **kwargs: Any) -> None:
+        super().__init__(**kwargs)
+        self.l_cell = l_cell
+        self.r_cell = r_cell
+
+    def state_info(self, batch_size: int = 0):
+        return self.l_cell.state_info(batch_size) + \
+            self.r_cell.state_info(batch_size)
+
+    def begin_state(self, batch_size: int = 0, **kwargs) -> List[NDArray]:
+        return self.l_cell.begin_state(batch_size, **kwargs) + \
+            self.r_cell.begin_state(batch_size, **kwargs)
+
+    def __call__(self, inputs, states):
+        raise NotImplementedError(
+            "BidirectionalCell supports unroll() only (step-by-step "
+            "execution cannot see the future)")
+
+    def unroll(self, length: int, inputs: NDArray,
+               begin_state: Optional[List[NDArray]] = None,
+               layout: str = "NTC", merge_outputs: Optional[bool] = None,
+               valid_length: Optional[NDArray] = None):
+        axis = layout.find("T")
+        batch_axis = layout.find("N")
+        batch_size = inputs.shape[batch_axis]
+        if begin_state is None:
+            begin_state = self.begin_state(batch_size)
+        nl = len(self.l_cell.state_info())
+        l_out, l_states = self.l_cell.unroll(
+            length, inputs, begin_state[:nl], layout, True, valid_length)
+        from ...ndarray import ops
+        rev = npx.sequence_reverse(
+            inputs, valid_length, use_sequence_length=valid_length is not None,
+            axis=axis)
+        r_out, r_states = self.r_cell.unroll(
+            length, rev, begin_state[nl:], layout, True, valid_length)
+        r_out = npx.sequence_reverse(
+            r_out, valid_length, use_sequence_length=valid_length is not None,
+            axis=axis)
+        out = mxnp.concatenate([l_out, r_out], axis=-1)
+        return out, l_states + r_states

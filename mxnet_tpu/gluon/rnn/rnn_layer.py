@@ -1,0 +1,249 @@
+"""Fused recurrent layers: RNN / LSTM / GRU.
+
+Reference parity (leezu/mxnet): ``python/mxnet/gluon/rnn/rnn_layer.py``
+(``_RNNLayer`` -> the stateful fused ``RNN`` op, ``src/operator/rnn-inl.h``
+/ ``cudnn_rnn-inl.h``). Multi-layer, bidirectional, TNC/NTC layouts, same
+parameter naming (``l0_i2h_weight`` ...), same gate orderings (LSTM:
+i,f,g,o; GRU: r,z,n with separate i2h/h2h bias like cuDNN).
+
+Design (tpu-first): the cuDNN fused kernel becomes ONE ``lax.scan`` over
+time per layer/direction, with the input projection for ALL timesteps
+hoisted into a single batched matmul (T*N, I)x(I, 4H) that XLA tiles onto
+the MXU — the same restructuring cuDNN does internally. Under hybridize
+the whole stack compiles into one program.
+"""
+from __future__ import annotations
+
+from typing import Any, List, Optional, Tuple
+
+import jax
+import jax.numpy as jnp
+from jax import lax
+
+from ...base import MXNetError  # noqa: F401  (kept: error paths below)
+from ..block import HybridBlock
+from ..parameter import Parameter
+from ...ndarray.ndarray import NDArray, from_jax
+from ...ndarray.register import invoke
+
+__all__ = ["RNN", "LSTM", "GRU"]
+
+
+def _gates(mode: str) -> int:
+    return {"rnn_relu": 1, "rnn_tanh": 1, "lstm": 4, "gru": 3}[mode]
+
+
+def _cell_step(mode: str):
+    """One timestep: (h[, c]), preactivations -> new states + output."""
+    if mode in ("rnn_relu", "rnn_tanh"):
+        act = jax.nn.relu if mode == "rnn_relu" else jnp.tanh
+
+        def step(carry, gi, gh):
+            (h,) = carry
+            h_new = act(gi + gh)
+            return (h_new,), h_new
+        return step
+    if mode == "lstm":
+        def step(carry, gi, gh):
+            h, c = carry
+            g = gi + gh
+            i_, f_, g_, o_ = jnp.split(g, 4, axis=-1)
+            i_ = jax.nn.sigmoid(i_)
+            f_ = jax.nn.sigmoid(f_)
+            g_ = jnp.tanh(g_)
+            o_ = jax.nn.sigmoid(o_)
+            c_new = f_ * c + i_ * g_
+            h_new = o_ * jnp.tanh(c_new)
+            return (h_new, c_new), h_new
+        return step
+    if mode == "gru":
+        def step(carry, gi, gh):
+            (h,) = carry
+            ir, iz, in_ = jnp.split(gi, 3, axis=-1)
+            hr, hz, hn = jnp.split(gh, 3, axis=-1)
+            r = jax.nn.sigmoid(ir + hr)
+            z = jax.nn.sigmoid(iz + hz)
+            n = jnp.tanh(in_ + r * hn)
+            h_new = (1 - z) * n + z * h
+            return (h_new,), h_new
+        return step
+    raise ValueError(mode)
+
+
+def _run_single_direction(mode, x, h0, c0, wi, wh, bi, bh, reverse=False):
+    """Scan one layer/direction. x: (T,N,I); returns (T,N,H), h_T[, c_T]."""
+    T, N, _ = x.shape
+    H = wh.shape[1]
+    # hoist input projection: one big MXU matmul over all timesteps
+    gi_all = jnp.einsum("tni,gi->tng", x, wi) + bi  # wi: (G*H, I)
+    step = _cell_step(mode)
+
+    def scan_fn(carry, gi_t):
+        gh = carry[0] @ wh.T + bh
+        new_carry, h_out = step(carry, gi_t, gh)
+        return new_carry, h_out
+
+    if reverse:
+        gi_all = jnp.flip(gi_all, axis=0)
+    carry0 = (h0, c0) if mode == "lstm" else (h0,)
+    carry_T, hs = lax.scan(scan_fn, carry0, gi_all)
+    if reverse:
+        hs = jnp.flip(hs, axis=0)
+    return hs, carry_T
+
+
+class _RNNLayer(HybridBlock):
+    def __init__(self, mode: str, hidden_size: int, num_layers: int = 1,
+                 layout: str = "TNC", dropout: float = 0.0,
+                 bidirectional: bool = False, input_size: int = 0,
+                 i2h_weight_initializer: Any = None,
+                 h2h_weight_initializer: Any = None,
+                 i2h_bias_initializer: Any = "zeros",
+                 h2h_bias_initializer: Any = "zeros",
+                 **kwargs: Any) -> None:
+        super().__init__(**kwargs)
+        assert layout in ("TNC", "NTC"), \
+            f"invalid layout {layout}; expected TNC or NTC"
+        self._mode = mode
+        self._hidden_size = hidden_size
+        self._num_layers = num_layers
+        self._layout = layout
+        self._dropout = dropout
+        self._dir = 2 if bidirectional else 1
+        self._input_size = input_size
+        ng = _gates(mode)
+        for layer in range(num_layers):
+            for d in range(self._dir):
+                suffix = "_" if d == 0 else "_r_"
+                in_size = input_size if layer == 0 \
+                    else hidden_size * self._dir
+                for name, shape, init in (
+                        ("i2h_weight", (ng * hidden_size, in_size),
+                         i2h_weight_initializer),
+                        ("h2h_weight", (ng * hidden_size, hidden_size),
+                         h2h_weight_initializer),
+                        ("i2h_bias", (ng * hidden_size,),
+                         i2h_bias_initializer),
+                        ("h2h_bias", (ng * hidden_size,),
+                         h2h_bias_initializer)):
+                    pname = f"l{layer}{suffix}{name}" if d else \
+                        f"l{layer}_{name}"
+                    self.register_parameter(
+                        pname, Parameter(pname, shape=shape, init=init))
+
+    def state_info(self):
+        raise NotImplementedError
+
+    def _num_states(self) -> int:
+        return 2 if self._mode == "lstm" else 1
+
+    def begin_state(self, batch_size: int = 0, func=None, ctx=None,
+                    **kwargs) -> List[NDArray]:
+        """Initial states, shape (num_layers*dir, N, H) each."""
+        from ...ndarray import ops
+        shape = (self._num_layers * self._dir, batch_size, self._hidden_size)
+        return [ops.zeros(shape, ctx=ctx) for _ in range(self._num_states())]
+
+    def _ordered_params(self) -> List[Parameter]:
+        return list(self._reg_params.values())
+
+    def forward(self, inputs: NDArray, states: Optional[List[NDArray]] = None):
+        ret_states = states is not None
+        if self._layout == "NTC":
+            inputs = inputs.swapaxes(0, 1)
+        T, N, I = inputs.shape
+        # finish deferred init for layer-0 weights
+        ng = _gates(self._mode)
+        for name, p in self._reg_params.items():
+            if not p.is_initialized and p.shape is not None:
+                if "l0" in name and "i2h_weight" in name:
+                    p._finish_deferred_init((ng * self._hidden_size, I))
+                else:
+                    p._finish_deferred_init(p.shape)
+        if states is None:
+            states = self.begin_state(N)
+        states_nd = list(states)
+        params = self._ordered_params()
+        mode = self._mode
+        num_layers, ndir, H = self._num_layers, self._dir, self._hidden_size
+        dropout = self._dropout
+        from ..._tape import is_training
+        train = is_training()
+        from ...ndarray import random as _random
+        drop_key = _random.split_key() if (dropout and train) else None
+
+        def impl(x, *arrs):
+            ns = self._num_states()
+            state_arrs = arrs[:ns]
+            weights = arrs[ns:]
+            h_all = state_arrs[0]
+            c_all = state_arrs[1] if ns == 2 else None
+            out = x
+            h_finals, c_finals = [], []
+            widx = 0
+            for layer in range(num_layers):
+                layer_outs = []
+                for d in range(ndir):
+                    wi, wh, bi, bh = weights[widx:widx + 4]
+                    widx += 4
+                    sidx = layer * ndir + d
+                    h0 = h_all[sidx]
+                    c0 = c_all[sidx] if c_all is not None else None
+                    hs, carry_T = _run_single_direction(
+                        mode, out, h0, c0, wi, wh, bi, bh, reverse=(d == 1))
+                    layer_outs.append(hs)
+                    h_finals.append(carry_T[0])
+                    if c_all is not None:
+                        c_finals.append(carry_T[1])
+                out = layer_outs[0] if ndir == 1 else \
+                    jnp.concatenate(layer_outs, axis=-1)
+                if dropout and train and layer != num_layers - 1:
+                    keep = jax.random.bernoulli(
+                        jax.random.fold_in(drop_key, layer),
+                        1.0 - dropout, out.shape)
+                    out = jnp.where(keep, out / (1.0 - dropout), 0.0)
+            new_states = [jnp.stack(h_finals)]
+            if c_all is not None:
+                new_states.append(jnp.stack(c_finals))
+            return (out, *new_states)
+
+        inputs_list = [inputs] + states_nd + [p.data() for p in params]
+        results = invoke(f"rnn_{mode}", impl, inputs_list)
+        out = results[0]
+        new_states = list(results[1:])
+        if self._layout == "NTC":
+            out = out.swapaxes(0, 1)
+        if ret_states:
+            return out, new_states
+        return out
+
+    def __repr__(self) -> str:
+        return (f"{type(self).__name__}({self._hidden_size}, "
+                f"num_layers={self._num_layers}, "
+                f"bidirectional={self._dir == 2})")
+
+
+class RNN(_RNNLayer):
+    """Elman RNN with relu/tanh (reference: ``gluon.rnn.RNN``)."""
+
+    def __init__(self, hidden_size: int, num_layers: int = 1,
+                 activation: str = "relu", **kwargs: Any) -> None:
+        super().__init__(f"rnn_{activation}", hidden_size, num_layers,
+                         **kwargs)
+
+
+class LSTM(_RNNLayer):
+    """Multi-layer (bi)LSTM (reference: ``gluon.rnn.LSTM``; BASELINE
+    config 4's model)."""
+
+    def __init__(self, hidden_size: int, num_layers: int = 1,
+                 **kwargs: Any) -> None:
+        super().__init__("lstm", hidden_size, num_layers, **kwargs)
+
+
+class GRU(_RNNLayer):
+    """Multi-layer (bi)GRU with cuDNN-style separate reset-gate bias."""
+
+    def __init__(self, hidden_size: int, num_layers: int = 1,
+                 **kwargs: Any) -> None:
+        super().__init__("gru", hidden_size, num_layers, **kwargs)

@@ -46,16 +46,26 @@ class KVStore:
         self._compression: Dict[str, Any] = {}
 
     # -- core API ----------------------------------------------------------
+    @staticmethod
+    def _pair(key: Any, value: Any):
+        """Normalize (key, value) to parallel lists. A list value under a
+        single key is that key's per-device value list (CommDevice input),
+        not a multi-key batch."""
+        if isinstance(key, (list, tuple)):
+            vals = [None] * len(key) if value is None else list(value)
+            return list(key), vals
+        return [key], [value]
+
     def init(self, key: Any, value: Union[NDArray, Sequence[NDArray]]) -> None:
-        keys = key if isinstance(key, (list, tuple)) else [key]
-        vals = value if isinstance(value, (list, tuple)) else [value]
+        keys, vals = self._pair(key, value)
         for k, v in zip(keys, vals):
+            if isinstance(v, (list, tuple)):
+                v = v[0]
             self._store[k] = v.copy()
 
     def push(self, key: Any, value: Union[NDArray, Sequence[NDArray]],
              priority: int = 0) -> None:
-        keys = key if isinstance(key, (list, tuple)) else [key]
-        vals = value if isinstance(value, (list, tuple)) else [value]
+        keys, vals = self._pair(key, value)
         for k, v in zip(keys, vals):
             if isinstance(v, (list, tuple)):
                 # multi-device gradient lists reduce locally (CommDevice)
@@ -69,8 +79,7 @@ class KVStore:
 
     def pull(self, key: Any, out: Union[NDArray, Sequence[NDArray], None] = None,
              priority: int = 0, ignore_sparse: bool = True) -> Optional[NDArray]:
-        keys = key if isinstance(key, (list, tuple)) else [key]
-        outs = out if isinstance(out, (list, tuple)) else [out]
+        keys, outs = self._pair(key, out)
         results = []
         for k, o in zip(keys, outs):
             v = self._store.get(k)

@@ -4,5 +4,7 @@ Reference parity: ``src/operator/nn/*`` — see ``nn.py``.
 """
 from .nn import *  # noqa: F401,F403
 from .nn import __all__ as _nn_all
+from .transformer import *  # noqa: F401,F403
+from .transformer import __all__ as _tr_all
 
-__all__ = list(_nn_all)
+__all__ = list(_nn_all) + list(_tr_all)

@@ -36,8 +36,8 @@ __all__ = [
     "fully_connected", "convolution", "deconvolution", "pooling",
     "adaptive_avg_pool2d", "batch_norm", "layer_norm", "group_norm",
     "instance_norm", "rms_norm", "l2_normalization", "lrn",
-    "dropout", "embedding", "pick", "sequence_mask", "sequence_last",
-    "sequence_reverse", "topk_mask", "smooth_l1",
+    "dropout", "embedding", "pick", "take_positions", "sequence_mask",
+    "sequence_last", "sequence_reverse", "topk_mask", "smooth_l1",
 ]
 
 
@@ -576,6 +576,15 @@ def embedding(data, weight, input_dim: Optional[int] = None,
         return jnp.take(w, idx.astype(jnp.int32), axis=0)
     # weight first in grad order matters not; inputs order = (data, weight)
     return invoke("embedding", impl, (_as_nd(data), _as_nd(weight)))
+
+
+def take_positions(data, positions):
+    """Gather per-batch sequence positions: (B,T,C),(B,P) -> (B,P,C)
+    (gluon-nlp ``select_vectors_by_position`` — the MLM-head gather)."""
+    def impl(x, pos):
+        pos = pos.astype(jnp.int32)
+        return jnp.take_along_axis(x, pos[:, :, None], axis=1)
+    return invoke("take_positions", impl, (_as_nd(data), _as_nd(positions)))
 
 
 def pick(data, index, axis: int = -1, keepdims: bool = False,

@@ -1,0 +1,10 @@
+"""Pallas TPU kernels — the replacement for the reference's CUDA specials.
+
+Reference parity: where leezu/mxnet hand-wrote ``.cu`` kernels
+(``src/operator/contrib/transformer.cu``, fused softmax/layernorm paths),
+this package holds Mosaic kernels authored with ``jax.experimental.pallas``
+(SURVEY.md section 7 design stance).
+"""
+from .attention import flash_attention
+
+__all__ = ["flash_attention"]

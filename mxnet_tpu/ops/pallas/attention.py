@@ -1,0 +1,173 @@
+"""Flash attention — blockwise online-softmax Pallas kernel.
+
+Reference parity (leezu/mxnet): the reference's attention is full O(T²)
+materialized scores (``src/operator/contrib/transformer.cu``); this kernel
+is the TPU-native upgrade (SURVEY.md 5.7): tiles of Q stream over tiles of
+K/V held in VMEM with a running max/denominator, so scores never hit HBM.
+
+Forward is the Pallas kernel (grid B×H×Tq-blocks×Tk-blocks, sequential
+accumulation over the last grid axis in VMEM scratch). Backward currently
+recomputes through the dense XLA path via ``jax.custom_vjp`` — flash-fwd /
+dense-bwd; a blockwise backward kernel is planned. On CPU the kernel runs
+in interpret mode, keeping tests meaningful.
+"""
+from __future__ import annotations
+
+import functools
+import math
+from typing import Optional
+
+import jax
+import jax.numpy as jnp
+from jax.experimental import pallas as pl
+from jax.experimental.pallas import tpu as pltpu
+
+_NEG_INF = -1e30
+
+
+def _flash_fwd_kernel(q_ref, k_ref, v_ref, o_ref,
+                      acc_ref, m_ref, l_ref, *,
+                      scale: float, causal: bool, block_q: int,
+                      block_k: int, kv_len: int, num_k_blocks: int):
+    iq = pl.program_id(2)
+    ik = pl.program_id(3)
+
+    @pl.when(ik == 0)
+    def _init():
+        acc_ref[...] = jnp.zeros_like(acc_ref)
+        m_ref[...] = jnp.full_like(m_ref, _NEG_INF)
+        l_ref[...] = jnp.zeros_like(l_ref)
+
+    q = q_ref[0, 0].astype(jnp.float32) * scale       # (bq, d)
+    k = k_ref[0, 0].astype(jnp.float32)               # (bk, d)
+    v = v_ref[0, 0].astype(jnp.float32)               # (bk, d)
+
+    s = jax.lax.dot_general(q, k, (((1,), (1,)), ((), ())),
+                            preferred_element_type=jnp.float32)  # (bq, bk)
+
+    # mask out-of-range (padded) kv columns, and the future when causal
+    col = ik * block_k + jax.lax.broadcasted_iota(jnp.int32, s.shape, 1)
+    mask = col < kv_len
+    if causal:
+        row = iq * block_q + jax.lax.broadcasted_iota(jnp.int32, s.shape, 0)
+        mask = jnp.logical_and(mask, col <= row)
+    s = jnp.where(mask, s, _NEG_INF)
+
+    m_prev = m_ref[...]                               # (bq, 1)
+    l_prev = l_ref[...]
+    m_cur = jnp.max(s, axis=1, keepdims=True)
+    m_new = jnp.maximum(m_prev, m_cur)
+    alpha = jnp.exp(m_prev - m_new)
+    p = jnp.exp(s - m_new)                            # (bq, bk)
+    l_new = alpha * l_prev + jnp.sum(p, axis=1, keepdims=True)
+    acc_ref[...] = acc_ref[...] * alpha + jax.lax.dot_general(
+        p, v, (((1,), (0,)), ((), ())),
+        preferred_element_type=jnp.float32)
+    m_ref[...] = m_new
+    l_ref[...] = l_new
+
+    @pl.when(ik == num_k_blocks - 1)
+    def _finish():
+        denom = jnp.maximum(l_ref[...], 1e-30)
+        o_ref[0, 0] = (acc_ref[...] / denom).astype(o_ref.dtype)
+
+
+def _pad_to(x, axis, mult):
+    size = x.shape[axis]
+    pad = (-size) % mult
+    if pad == 0:
+        return x
+    widths = [(0, 0)] * x.ndim
+    widths[axis] = (0, pad)
+    return jnp.pad(x, widths)
+
+
+def _flash_forward(q, k, v, scale: float, causal: bool,
+                   block_q: int, block_k: int, interpret: bool):
+    """q/k/v: (B, H, T, D). Returns (B, H, Tq, D)."""
+    B, H, Tq, D = q.shape
+    Tk = k.shape[2]
+    qp = _pad_to(q, 2, block_q)
+    kp = _pad_to(k, 2, block_k)
+    vp = _pad_to(v, 2, block_k)
+    Tq_p, Tk_p = qp.shape[2], kp.shape[2]
+    n_q, n_k = Tq_p // block_q, Tk_p // block_k
+
+    kernel = functools.partial(
+        _flash_fwd_kernel, scale=scale, causal=causal, block_q=block_q,
+        block_k=block_k, kv_len=Tk, num_k_blocks=n_k)
+
+    out = pl.pallas_call(
+        kernel,
+        grid=(B, H, n_q, n_k),
+        in_specs=[
+            pl.BlockSpec((1, 1, block_q, D),
+                         lambda b, h, i, j: (b, h, i, 0)),
+            pl.BlockSpec((1, 1, block_k, D),
+                         lambda b, h, i, j: (b, h, j, 0)),
+            pl.BlockSpec((1, 1, block_k, D),
+                         lambda b, h, i, j: (b, h, j, 0)),
+        ],
+        out_specs=pl.BlockSpec((1, 1, block_q, D),
+                               lambda b, h, i, j: (b, h, i, 0)),
+        out_shape=jax.ShapeDtypeStruct((B, H, Tq_p, D), q.dtype),
+        scratch_shapes=[
+            pltpu.VMEM((block_q, D), jnp.float32),   # acc
+            pltpu.VMEM((block_q, 1), jnp.float32),   # running max
+            pltpu.VMEM((block_q, 1), jnp.float32),   # running denom
+        ],
+        interpret=interpret,
+    )(qp, kp, vp)
+    return out[:, :, :Tq]
+
+
+def _dense_reference(q, k, v, scale: float, causal: bool):
+    """O(T^2) reference in plain XLA (used for the backward pass)."""
+    s = jnp.einsum("bhqd,bhkd->bhqk", q.astype(jnp.float32),
+                   k.astype(jnp.float32)) * scale
+    if causal:
+        Tq, Tk = s.shape[-2], s.shape[-1]
+        mask = jnp.tril(jnp.ones((Tq, Tk), bool), Tk - Tq)
+        s = jnp.where(mask, s, _NEG_INF)
+    p = jax.nn.softmax(s, axis=-1)
+    return jnp.einsum("bhqk,bhkd->bhqd", p,
+                      v.astype(jnp.float32)).astype(q.dtype)
+
+
+@functools.partial(jax.custom_vjp, nondiff_argnums=(3, 4, 5, 6))
+def _flash(q, k, v, scale, causal, block_q, block_k):
+    interpret = jax.default_backend() == "cpu"
+    return _flash_forward(q, k, v, scale, causal, block_q, block_k,
+                          interpret)
+
+
+def _flash_fwd(q, k, v, scale, causal, block_q, block_k):
+    out = _flash(q, k, v, scale, causal, block_q, block_k)
+    return out, (q, k, v)
+
+
+def _flash_bwd(scale, causal, block_q, block_k, res, g):
+    q, k, v = res
+    _, vjp = jax.vjp(lambda a, b, c: _dense_reference(a, b, c, scale, causal),
+                     q, k, v)
+    return vjp(g)
+
+
+_flash.defvjp(_flash_fwd, _flash_bwd)
+
+
+def flash_attention(q, k, v, scale: Optional[float] = None,
+                    causal: bool = False, block_q: int = 128,
+                    block_k: int = 128):
+    """Flash attention over (B, T, H, D) inputs (jax layout convention)."""
+    if scale is None:
+        scale = 1.0 / math.sqrt(q.shape[-1])
+    # kernel blocks over (B, H, T, D)
+    qt = jnp.swapaxes(q, 1, 2)
+    kt = jnp.swapaxes(k, 1, 2)
+    vt = jnp.swapaxes(v, 1, 2)
+    block_q = min(block_q, max(qt.shape[2], 8))
+    block_k = min(block_k, max(kt.shape[2], 8))
+    out = _flash(qt, kt, vt, float(scale), bool(causal),
+                 int(block_q), int(block_k))
+    return jnp.swapaxes(out, 1, 2)

@@ -1,0 +1,207 @@
+"""Transformer attention ops.
+
+Reference parity (leezu/mxnet): ``src/operator/contrib/transformer.{cc,cu}``
+— the gluon-nlp BERT-era interleaved self-attention matmuls
+(``_contrib_interleaved_matmul_selfatt_qk`` / ``_valatt``) — SURVEY.md
+section 2.2. Those exist because cuBLAS wanted one interleaved QKV buffer;
+on TPU the fused form is a single ``dot_product_attention`` that XLA maps
+onto the MXU (and a Pallas flash kernel for long sequences — see
+``mxnet_tpu/ops/pallas/attention.py``). The interleaved API is provided
+for source parity and lowers to the same fused path.
+"""
+from __future__ import annotations
+
+from typing import Any, Optional
+
+import jax
+import jax.numpy as jnp
+
+from ..base import getenv, register_env
+from ..ndarray.ndarray import NDArray
+from ..ndarray.ops import _as_nd
+from ..ndarray.register import invoke, register_op
+
+__all__ = ["dot_product_attention", "multi_head_attention",
+           "interleaved_matmul_selfatt_qk", "interleaved_matmul_selfatt_valatt",
+           "interleaved_matmul_encdec_qk", "interleaved_matmul_encdec_valatt"]
+
+register_env("MXNET_ATTENTION_USE_PALLAS", 0,
+             "Use the Pallas flash-attention kernel on TPU (auto-enabled "
+             "for long sequences when available).")
+
+
+def _mask_to_bias(mask, dtype, batch: int, tq: int, tk: int):
+    """Normalize a mask to an additive bias of rank 4 (B/1, H/1, Tq/1, Tk).
+
+    Accepted shapes: (B, Tk) key-padding mask (the canonical BERT
+    valid-length mask), (Tq, Tk) score mask, (B, Tq, Tk), or rank-4
+    (B/1, H/1, Tq/1, Tk). Boolean True = attend.
+    """
+    if mask.dtype == jnp.bool_:
+        bias = jnp.where(mask, jnp.asarray(0.0, dtype),
+                         jnp.finfo(dtype).min)
+    else:
+        bias = mask
+    if bias.ndim == 2:
+        if bias.shape == (batch, tk) and (batch != tq or tq == tk):
+            bias = bias[:, None, None, :]      # key-padding: (B,1,1,Tk)
+        else:
+            bias = bias[None, None, :, :]      # score mask: (1,1,Tq,Tk)
+    elif bias.ndim == 3:
+        bias = bias[:, None, :, :]             # (B,1,Tq,Tk)
+    return bias
+
+
+def dot_product_attention(query, key, value, mask=None,
+                          scale: Optional[float] = None,
+                          dropout: float = 0.0, causal: bool = False):
+    """Fused scaled dot-product attention.
+
+    Shapes: (B, T, H, D) for q/k/v (jax convention — batch, time, heads,
+    head_dim). Returns (B, T, H, D). Uses XLA's fused attention; the
+    Pallas flash kernel (ops/pallas/attention.py) engages on TPU for long
+    sequences or when MXNET_ATTENTION_USE_PALLAS=1.
+    """
+    inputs = [_as_nd(query), _as_nd(key), _as_nd(value)]
+    has_mask = mask is not None
+    if has_mask:
+        inputs.append(_as_nd(mask))
+    sc, cz = scale, causal
+
+    def impl(q, k, v, *m):
+        bias = None
+        if m:
+            bias = _mask_to_bias(m[0], q.dtype, q.shape[0], q.shape[1],
+                                 k.shape[1])
+        if bias is None and _use_pallas(q):
+            from .pallas.attention import flash_attention
+            return flash_attention(q, k, v, scale=sc, causal=cz)
+        return jax.nn.dot_product_attention(
+            q, k, v, bias=bias, scale=sc, is_causal=cz)
+
+    return invoke("dot_product_attention", impl, inputs)
+
+
+def _use_pallas(q) -> bool:
+    """Pallas flash kernel policy: explicit opt-in, or long sequences on
+    TPU where the O(T^2) materialized-scores path thrashes HBM."""
+    import jax as _jax
+    if getenv("MXNET_ATTENTION_USE_PALLAS", 0):
+        return True
+    try:
+        on_tpu = _jax.default_backend() not in ("cpu",)
+    except Exception:
+        return False
+    return on_tpu and q.shape[1] >= 4096
+
+
+def multi_head_attention(query, key, value, num_heads: int, mask=None,
+                         causal: bool = False, scale: Optional[float] = None):
+    """(B, T, C) inputs already projected; splits heads, attends, merges."""
+    nh, cz, sc = num_heads, causal, scale
+    inputs = [_as_nd(query), _as_nd(key), _as_nd(value)]
+    has_mask = mask is not None
+    if has_mask:
+        inputs.append(_as_nd(mask))
+
+    def impl(q, k, v, *m):
+        B, Tq, C = q.shape
+        Tk = k.shape[1]
+        d = C // nh
+        qh = q.reshape(B, Tq, nh, d)
+        kh = k.reshape(B, Tk, nh, d)
+        vh = v.reshape(B, Tk, nh, d)
+        bias = None
+        if m:
+            bias = _mask_to_bias(m[0], q.dtype, B, Tq, Tk)
+        if bias is None and _use_pallas(qh):
+            from .pallas.attention import flash_attention
+            out = flash_attention(qh, kh, vh, scale=sc, causal=cz)
+        else:
+            out = jax.nn.dot_product_attention(qh, kh, vh, bias=bias,
+                                               scale=sc, is_causal=cz)
+        return out.reshape(B, Tq, C)
+
+    return invoke("multi_head_attention", impl, inputs)
+
+
+# ---------------------------------------------------------------------------
+# Interleaved-QKV API parity (reference transformer.cc). Layout matches the
+# reference: qkv is (T, N, 3*H*D) with per-head interleaving [q|k|v].
+# ---------------------------------------------------------------------------
+
+def interleaved_matmul_selfatt_qk(queries_keys_values, heads: int):
+    """scores = scaled Q·Kᵀ from interleaved QKV, out (N*heads, T, T)."""
+    nh = heads
+
+    def impl(qkv):
+        T, N, C3 = qkv.shape
+        d = C3 // (3 * nh)
+        x = qkv.reshape(T, N, nh, 3, d)
+        q = x[:, :, :, 0]  # (T, N, H, D)
+        k = x[:, :, :, 1]
+        q = jnp.transpose(q, (1, 2, 0, 3)).reshape(N * nh, T, d)
+        k = jnp.transpose(k, (1, 2, 0, 3)).reshape(N * nh, T, d)
+        scale = 1.0 / jnp.sqrt(jnp.asarray(d, q.dtype))
+        return jnp.einsum("btd,bsd->bts", q * scale, k)
+
+    return invoke("interleaved_matmul_selfatt_qk", impl,
+                  (_as_nd(queries_keys_values),))
+
+
+def interleaved_matmul_selfatt_valatt(queries_keys_values, attention,
+                                      heads: int):
+    """out = att·V back to (T, N, H*D) from interleaved QKV."""
+    nh = heads
+
+    def impl(qkv, att):
+        T, N, C3 = qkv.shape
+        d = C3 // (3 * nh)
+        x = qkv.reshape(T, N, nh, 3, d)
+        v = x[:, :, :, 2]
+        v = jnp.transpose(v, (1, 2, 0, 3)).reshape(N * nh, T, d)
+        out = jnp.einsum("bts,bsd->btd", att, v)  # (N*H, T, D)
+        out = out.reshape(N, nh, T, d)
+        return jnp.transpose(out, (2, 0, 1, 3)).reshape(T, N, nh * d)
+
+    return invoke("interleaved_matmul_selfatt_valatt", impl,
+                  (_as_nd(queries_keys_values), _as_nd(attention)))
+
+
+def interleaved_matmul_encdec_qk(queries, keys_values, heads: int):
+    nh = heads
+
+    def impl(q, kv):
+        Tq, N, C = q.shape
+        Tk = kv.shape[0]
+        d = C // nh
+        qh = jnp.transpose(q.reshape(Tq, N, nh, d), (1, 2, 0, 3)) \
+            .reshape(N * nh, Tq, d)
+        k = kv.reshape(Tk, N, nh, 2, d)[:, :, :, 0]
+        kh = jnp.transpose(k, (1, 2, 0, 3)).reshape(N * nh, Tk, d)
+        scale = 1.0 / jnp.sqrt(jnp.asarray(d, q.dtype))
+        return jnp.einsum("btd,bsd->bts", qh * scale, kh)
+
+    return invoke("interleaved_matmul_encdec_qk", impl,
+                  (_as_nd(queries), _as_nd(keys_values)))
+
+
+def interleaved_matmul_encdec_valatt(keys_values, attention, heads: int):
+    nh = heads
+
+    def impl(kv, att):
+        Tk, N, C2 = kv.shape
+        d = C2 // (2 * nh)
+        v = kv.reshape(Tk, N, nh, 2, d)[:, :, :, 1]
+        vh = jnp.transpose(v, (1, 2, 0, 3)).reshape(N * nh, Tk, d)
+        out = jnp.einsum("bts,bsd->btd", att, vh)
+        Tq = att.shape[1]
+        out = out.reshape(N, nh, Tq, d)
+        return jnp.transpose(out, (2, 0, 1, 3)).reshape(Tq, N, nh * d)
+
+    return invoke("interleaved_matmul_encdec_valatt", impl,
+                  (_as_nd(keys_values), _as_nd(attention)))
+
+
+for _name in __all__:
+    register_op(_name, globals()[_name])

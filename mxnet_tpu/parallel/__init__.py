@@ -1,0 +1,16 @@
+"""``mx.parallel`` — device-mesh SPMD training.
+
+Reference parity (leezu/mxnet): this package REPLACES the reference's
+distributed stack (``src/kvstore/`` + ps-lite + NCCL, SURVEY.md 2.3/3.5)
+with the TPU-native model: one ``jax.sharding.Mesh`` under everything,
+parameters/activations annotated with PartitionSpecs, XLA inserting the
+collectives over ICI/DCN. Strategies the reference never had (TP/SP) are
+new capability here, exposed as sharding rules (SURVEY.md 5.7/5.8).
+"""
+from .mesh import make_mesh, mesh_axes, replicated, shard_batch
+from .spmd import (PartitionRules, SPMDTrainer, DEFAULT_TRANSFORMER_RULES,
+                   DATA_PARALLEL_RULES)
+
+__all__ = ["make_mesh", "mesh_axes", "replicated", "shard_batch",
+           "PartitionRules", "SPMDTrainer", "DEFAULT_TRANSFORMER_RULES",
+           "DATA_PARALLEL_RULES"]

@@ -1,0 +1,83 @@
+"""Device-mesh construction helpers.
+
+The single mesh abstraction under all parallelism (SURVEY.md section 7
+design stance). Axis names follow convention: ``dp`` (data), ``tp``
+(tensor/model), ``sp`` (sequence/context), ``pp`` (pipeline stage),
+``ep`` (expert).
+"""
+from __future__ import annotations
+
+from typing import Dict, List, Optional, Sequence, Tuple
+
+import numpy as _np
+import jax
+
+from ..base import MXNetError
+
+__all__ = ["make_mesh", "mesh_axes", "replicated", "shard_batch"]
+
+
+def make_mesh(shape: Optional[Dict[str, int]] = None,
+              devices: Optional[Sequence] = None) -> "jax.sharding.Mesh":
+    """Build a Mesh from an axis-name -> size dict.
+
+    ``make_mesh({"dp": 2, "tp": 4})`` on 8 chips. With ``shape=None`` all
+    devices go on one ``dp`` axis. Sizes of ``-1`` are inferred (at most
+    one). Axis order follows dict order — put the fastest-varying
+    (ICI-neighbor) axis last, e.g. ``tp`` innermost.
+    """
+    devices = list(devices if devices is not None else jax.devices())
+    n = len(devices)
+    if shape is None:
+        shape = {"dp": n}
+    names = list(shape.keys())
+    sizes = list(shape.values())
+    n_infer = sum(1 for s in sizes if s == -1)
+    if n_infer > 1:
+        raise MXNetError("at most one mesh axis may be -1")
+    if n_infer == 1:
+        known = 1
+        for s in sizes:
+            if s != -1:
+                known *= s
+        if n % known:
+            raise MXNetError(f"cannot infer axis: {n} devices not divisible "
+                             f"by {known}")
+        sizes = [n // known if s == -1 else s for s in sizes]
+    total = 1
+    for s in sizes:
+        total *= s
+    if total != n:
+        raise MXNetError(f"mesh {dict(zip(names, sizes))} needs {total} "
+                         f"devices, have {n}")
+    arr = _np.asarray(devices).reshape(sizes)
+    return jax.sharding.Mesh(arr, tuple(names))
+
+
+def mesh_axes(mesh: "jax.sharding.Mesh") -> Tuple[str, ...]:
+    return tuple(mesh.axis_names)
+
+
+def replicated(mesh: "jax.sharding.Mesh") -> "jax.sharding.NamedSharding":
+    return jax.sharding.NamedSharding(mesh, jax.sharding.PartitionSpec())
+
+
+def shard_batch(batch, mesh: "jax.sharding.Mesh", axis: str = "dp",
+                seq_axis: Optional[str] = None):
+    """Place a host batch onto the mesh, batch dim sharded over ``axis``
+    (and optionally dim1 over ``seq_axis`` for sequence parallelism).
+
+    The TPU-native replacement for ``gluon.utils.split_and_load``.
+    """
+    from ..ndarray.ndarray import NDArray, from_jax
+    P = jax.sharding.PartitionSpec
+    spec = P(axis, seq_axis) if seq_axis else P(axis)
+    sharding = jax.sharding.NamedSharding(mesh, spec)
+
+    def place(x):
+        data = x._data if isinstance(x, NDArray) else x
+        return from_jax(jax.device_put(data, sharding))
+
+    if isinstance(batch, (list, tuple)):
+        return type(batch)(place(b) for b in batch)
+    return place(batch)

@@ -1,0 +1,274 @@
+"""RNN layers/cells (reference analog: tests/python/unittest/
+test_gluon_rnn.py — incl. the fused-vs-unfused equivalence test)."""
+import numpy as onp
+import pytest
+
+import mxnet_tpu as mx
+from mxnet_tpu import autograd as ag
+from mxnet_tpu.gluon import rnn
+from mxnet_tpu.test_utils import assert_almost_equal, rand_ndarray
+
+
+def test_lstm_shapes():
+    layer = rnn.LSTM(16, num_layers=2)
+    layer.initialize()
+    x = rand_ndarray((5, 3, 8))  # TNC
+    out = layer(x)
+    assert out.shape == (5, 3, 16)
+    states = layer.begin_state(batch_size=3)
+    out, new_states = layer(x, states)
+    assert out.shape == (5, 3, 16)
+    assert new_states[0].shape == (2, 3, 16)  # h
+    assert new_states[1].shape == (2, 3, 16)  # c
+
+
+def test_lstm_ntc_layout():
+    layer = rnn.LSTM(8, layout="NTC")
+    layer.initialize()
+    out = layer(rand_ndarray((3, 5, 4)))
+    assert out.shape == (3, 5, 8)
+
+
+def test_bidirectional_lstm():
+    layer = rnn.LSTM(8, bidirectional=True)
+    layer.initialize()
+    out = layer(rand_ndarray((5, 2, 4)))
+    assert out.shape == (5, 2, 16)
+
+
+def test_gru_rnn_shapes():
+    for layer in (rnn.GRU(8), rnn.RNN(8, activation="tanh"),
+                  rnn.RNN(8, activation="relu")):
+        layer.initialize()
+        assert layer(rand_ndarray((4, 2, 3))).shape == (4, 2, 8)
+
+
+def test_fused_lstm_matches_cell():
+    """Fused scan vs explicit LSTMCell unroll — the reference's own
+    equivalence pattern (fused RNN op vs unfused cell stack)."""
+    T, N, I, H = 4, 2, 3, 5
+    fused = rnn.LSTM(H, input_size=I)
+    fused.initialize()
+    cell = rnn.LSTMCell(H, input_size=I)
+    cell.initialize()
+    # copy fused layer weights into the cell
+    cell.i2h_weight.set_data(fused.l0_i2h_weight.data())
+    cell.h2h_weight.set_data(fused.l0_h2h_weight.data())
+    cell.i2h_bias.set_data(fused.l0_i2h_bias.data())
+    cell.h2h_bias.set_data(fused.l0_h2h_bias.data())
+
+    x = rand_ndarray((T, N, I))
+    out_fused = fused(x)
+    out_cell, _ = cell.unroll(T, x, layout="TNC", merge_outputs=True)
+    assert_almost_equal(out_fused, out_cell, rtol=1e-5, atol=1e-6)
+
+
+def test_fused_gru_matches_cell():
+    T, N, I, H = 3, 2, 4, 6
+    fused = rnn.GRU(H, input_size=I)
+    fused.initialize()
+    cell = rnn.GRUCell(H, input_size=I)
+    cell.initialize()
+    cell.i2h_weight.set_data(fused.l0_i2h_weight.data())
+    cell.h2h_weight.set_data(fused.l0_h2h_weight.data())
+    cell.i2h_bias.set_data(fused.l0_i2h_bias.data())
+    cell.h2h_bias.set_data(fused.l0_h2h_bias.data())
+    x = rand_ndarray((T, N, I))
+    assert_almost_equal(fused(x), cell.unroll(T, x, layout="TNC",
+                                              merge_outputs=True)[0],
+                        rtol=1e-5, atol=1e-6)
+
+
+def test_lstm_gradients_flow():
+    layer = rnn.LSTM(8, num_layers=2, input_size=4)
+    layer.initialize()
+    x = rand_ndarray((6, 3, 4))
+    x.attach_grad()
+    with ag.record():
+        out = layer(x)
+        loss = (out * out).sum()
+    loss.backward()
+    assert onp.abs(x.grad.asnumpy()).sum() > 0
+    for name, p in layer.collect_params().items():
+        g = p.data().grad.asnumpy()
+        assert onp.isfinite(g).all(), name
+        assert onp.abs(g).sum() > 0, name
+
+
+def test_lstm_hybridized():
+    layer = rnn.LSTM(8, input_size=4)
+    layer.initialize()
+    x = rand_ndarray((5, 2, 4))
+    y_imp = layer(x)
+    layer.hybridize()
+    y_hyb = layer(x)
+    assert_almost_equal(y_imp, y_hyb, rtol=1e-5, atol=1e-6)
+
+
+def test_sequential_cells_and_modifiers():
+    stack = rnn.SequentialRNNCell()
+    stack.add(rnn.LSTMCell(8, input_size=4))
+    stack.add(rnn.ResidualCell(rnn.LSTMCell(8, input_size=8)))
+    stack.add(rnn.DropoutCell(0.0))
+    stack.initialize()
+    x = rand_ndarray((3, 5, 4))  # NTC
+    out, states = stack.unroll(5, x, layout="NTC", merge_outputs=True)
+    assert out.shape == (3, 5, 8)
+    assert len(states) == 4  # 2 LSTM cells x (h, c)
+
+
+def test_bidirectional_cell():
+    bi = rnn.BidirectionalCell(rnn.LSTMCell(6, input_size=4),
+                               rnn.LSTMCell(6, input_size=4))
+    bi.initialize()
+    x = rand_ndarray((2, 5, 4))
+    out, states = bi.unroll(5, x, layout="NTC", merge_outputs=True)
+    assert out.shape == (2, 5, 12)
+
+
+def test_rnn_cell_begin_state_and_step():
+    cell = rnn.LSTMCell(8, input_size=3)
+    cell.initialize()
+    states = cell.begin_state(4)
+    out, new_states = cell(rand_ndarray((4, 3)), states)
+    assert out.shape == (4, 8)
+    assert len(new_states) == 2
+
+
+def test_transformer_ops():
+    from mxnet_tpu import npx
+    B, T, H, D = 2, 6, 4, 8
+    q = rand_ndarray((B, T, H, D))
+    k = rand_ndarray((B, T, H, D))
+    v = rand_ndarray((B, T, H, D))
+    out = npx.dot_product_attention(q, k, v)
+    assert out.shape == (B, T, H, D)
+    # causal masking: first position attends only to itself
+    out_c = npx.dot_product_attention(q, k, v, causal=True)
+    ref0 = v.asnumpy()[:, 0]
+    assert_almost_equal(out_c[:, 0], ref0, rtol=1e-4, atol=1e-5)
+
+    # interleaved API round-trip matches plain attention
+    import numpy as np
+    qkv = rand_ndarray((T, B, 3 * H * D))
+    att = npx.interleaved_matmul_selfatt_qk(qkv, H)
+    assert att.shape == (B * H, T, T)
+    probs = npx.softmax(att, axis=-1)
+    out2 = npx.interleaved_matmul_selfatt_valatt(qkv, probs, H)
+    assert out2.shape == (T, B, H * D)
+
+
+def test_bert_forward_and_mlm():
+    from mxnet_tpu.gluon.model_zoo.bert import get_bert
+    net = get_bert("bert_12_768_12", vocab_size=100, num_layers=2, units=32,
+                   hidden_size=64, num_heads=4, max_length=16)
+    net.initialize()
+    B, T = 2, 10
+    tokens = mx.nd.random.randint(0, 100, shape=(B, T))
+    token_types = mx.np.zeros((B, T), dtype="int32")
+    valid_len = mx.np.array([10, 7], dtype="int32")
+    seq, pooled = net(tokens, token_types, valid_len)
+    assert seq.shape == (B, T, 32)
+    assert pooled.shape == (B, 32)
+
+    positions = mx.np.array([[1, 2, 3], [4, 5, 6]], dtype="int32")
+    seq, pooled, mlm = net(tokens, token_types, valid_len, positions)
+    assert mlm.shape == (B, 3, 100)
+
+
+def test_bert_trains():
+    from mxnet_tpu.gluon.model_zoo.bert import get_bert
+    net = get_bert(vocab_size=50, num_layers=1, units=16, hidden_size=32,
+                   num_heads=2, max_length=8, dropout=0.0)
+    net.initialize()
+    trainer = mx.gluon.Trainer(net.collect_params(), "adam",
+                               {"learning_rate": 1e-3})
+    loss_fn = mx.gluon.loss.SoftmaxCrossEntropyLoss()
+    tokens = mx.nd.random.randint(0, 50, shape=(2, 8))
+    positions = mx.np.array([[1, 2], [3, 4]], dtype="int32")
+    labels = mx.nd.random.randint(0, 50, shape=(2, 2))
+    with ag.record():
+        _, _, mlm = net(tokens, None, None, positions)
+        loss = loss_fn(mlm.reshape(-1, 50), labels.reshape(-1)).mean()
+    loss.backward()
+    # pooler/NSP heads are not ancestors of the MLM loss -> stale grads
+    trainer.step(2, ignore_stale_grad=True)
+    assert onp.isfinite(loss.item())
+
+
+def test_flash_attention_matches_dense():
+    """Pallas flash kernel (interpret mode on CPU) vs dense reference."""
+    import jax.numpy as jnp
+    from mxnet_tpu.ops.pallas.attention import (_dense_reference,
+                                                flash_attention)
+    onp.random.seed(0)
+    B, T, H, D = 2, 64, 2, 16
+    q = jnp.asarray(onp.random.randn(B, T, H, D).astype("float32"))
+    k = jnp.asarray(onp.random.randn(B, T, H, D).astype("float32"))
+    v = jnp.asarray(onp.random.randn(B, T, H, D).astype("float32"))
+    for causal in (False, True):
+        out = flash_attention(q, k, v, causal=causal, block_q=16, block_k=16)
+        import jax as _jax
+        ref = _dense_reference(
+            jnp.swapaxes(q, 1, 2), jnp.swapaxes(k, 1, 2),
+            jnp.swapaxes(v, 1, 2), 1.0 / (D ** 0.5), causal)
+        ref = jnp.swapaxes(ref, 1, 2)
+        assert_almost_equal(onp.asarray(out), onp.asarray(ref),
+                            rtol=1e-4, atol=1e-5)
+
+
+def test_flash_attention_ragged_blocks():
+    """T not divisible by block size exercises the padded-column mask."""
+    import jax.numpy as jnp
+    from mxnet_tpu.ops.pallas.attention import (_dense_reference,
+                                                flash_attention)
+    onp.random.seed(1)
+    B, T, H, D = 1, 50, 1, 8
+    q = jnp.asarray(onp.random.randn(B, T, H, D).astype("float32"))
+    k = jnp.asarray(onp.random.randn(B, T, H, D).astype("float32"))
+    v = jnp.asarray(onp.random.randn(B, T, H, D).astype("float32"))
+    out = flash_attention(q, k, v, block_q=16, block_k=16)
+    ref = jnp.swapaxes(_dense_reference(
+        jnp.swapaxes(q, 1, 2), jnp.swapaxes(k, 1, 2),
+        jnp.swapaxes(v, 1, 2), 1.0 / (D ** 0.5), False), 1, 2)
+    assert_almost_equal(onp.asarray(out), onp.asarray(ref),
+                        rtol=1e-4, atol=1e-5)
+
+
+def test_flash_attention_backward():
+    import jax
+    import jax.numpy as jnp
+    from mxnet_tpu.ops.pallas.attention import flash_attention
+    onp.random.seed(2)
+    B, T, H, D = 1, 32, 2, 8
+    q = jnp.asarray(onp.random.randn(B, T, H, D).astype("float32"))
+    k = jnp.asarray(onp.random.randn(B, T, H, D).astype("float32"))
+    v = jnp.asarray(onp.random.randn(B, T, H, D).astype("float32"))
+
+    def f_flash(q, k, v):
+        return flash_attention(q, k, v, block_q=8, block_k=8).sum()
+
+    def f_ref(q, k, v):
+        return jax.nn.dot_product_attention(q, k, v).sum()
+
+    g_flash = jax.grad(f_flash, argnums=(0, 1, 2))(q, k, v)
+    g_ref = jax.grad(f_ref, argnums=(0, 1, 2))(q, k, v)
+    for a, b in zip(g_flash, g_ref):
+        assert_almost_equal(onp.asarray(a), onp.asarray(b),
+                            rtol=1e-3, atol=1e-4)
+
+
+def test_attention_padding_mask_2d():
+    """(B, Tk) valid-length mask must broadcast as key padding, not Tq/Tk."""
+    from mxnet_tpu import npx
+    B, T, C, H = 3, 5, 8, 2
+    q = rand_ndarray((B, T, C))
+    mask = onp.ones((B, T), dtype=bool)
+    mask[1, 3:] = False  # sample 1: only 3 valid keys
+    out = npx.multi_head_attention(q, q, q, H, mask=mx.np.array(mask))
+    assert out.shape == (B, T, C)
+    # fully-visible samples must match the unmasked result
+    out_nomask = npx.multi_head_attention(q, q, q, H)
+    assert_almost_equal(out[0], out_nomask[0], rtol=1e-5, atol=1e-6)
+    n = out.asnumpy()
+    assert onp.isfinite(n).all()
