@@ -7,9 +7,13 @@ reuses these same ops (see ``mxnet_tpu/numpy``).
 from .ndarray import NDArray, from_jax, waitall
 from .ops import *  # noqa: F401,F403
 from .ops import __all__ as _ops_all
+from .ops_numpy import *  # noqa: F401,F403
+from .ops_numpy import __all__ as _ops_np_all
 from . import ops
 from . import random
+from . import linalg
 from .register import get_op, list_ops, register_op, invoke
 
-__all__ = ["NDArray", "from_jax", "waitall", "random",
-           "get_op", "list_ops", "register_op"] + list(_ops_all)
+__all__ = (["NDArray", "from_jax", "waitall", "random", "linalg",
+            "get_op", "list_ops", "register_op"]
+           + list(_ops_all) + list(_ops_np_all))

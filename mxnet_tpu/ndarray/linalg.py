@@ -1,0 +1,423 @@
+"""Linear-algebra op family — ``mx.np.linalg`` + ``mx.nd.linalg``.
+
+Reference parity (leezu/mxnet): ``src/operator/tensor/la_op.{cc,cu,-inl.h}``
+(gemm/potrf/trsm/trmm/syrk/... registered as ``_linalg_*``) and
+``src/operator/numpy/linalg/`` (``np.linalg`` svd/inv/det/... semantics),
+python surface ``python/mxnet/numpy/linalg.py`` / ``python/mxnet/ndarray/
+linalg.py``.
+
+Design (tpu-first): every routine is a composition of ``jax.numpy.linalg`` /
+``jax.lax.linalg`` primitives, which XLA lowers to MXU-friendly blocked
+factorizations; autograd comes uniformly from the vjp hook in
+``register.invoke`` instead of per-op FGradient.
+"""
+from __future__ import annotations
+
+from typing import Any, Optional
+
+import jax.numpy as jnp
+import numpy as _np
+
+from .ndarray import NDArray, from_jax
+from .register import invoke, register_op
+
+__all__ = [
+    "norm", "svd", "svdvals", "inv", "pinv", "det", "slogdet", "cholesky",
+    "qr", "eig", "eigh", "eigvals", "eigvalsh", "solve", "lstsq",
+    "matrix_rank", "matrix_power", "multi_dot", "tensorinv", "tensorsolve",
+    "cond", "matrix_norm", "vector_norm", "outer", "cross", "trace",
+    "diagonal", "matmul", "matrix_transpose",
+    # mxnet-style la_op family
+    "gemm", "gemm2", "potrf", "potri", "trsm", "trmm", "syrk",
+    "sumlogdiag", "extractdiag", "makediag", "extracttrian", "maketrian",
+]
+
+
+def _as_nd(x: Any) -> NDArray:
+    if isinstance(x, NDArray):
+        return x
+    return NDArray(jnp.asarray(x), _wrap=True)
+
+
+def _reg(fn, name=None):
+    register_op("linalg_" + (name or fn.__name__), fn)
+    return fn
+
+
+# ---------------------------------------------------------------------------
+# numpy.linalg semantics (reference: src/operator/numpy/linalg/)
+# ---------------------------------------------------------------------------
+
+@_reg
+def norm(x, ord=None, axis=None, keepdims=False):  # noqa: A002
+    o, ax, kd = ord, axis, keepdims
+    return invoke("linalg_norm",
+                  lambda a: jnp.linalg.norm(a, ord=o, axis=ax, keepdims=kd),
+                  (_as_nd(x),))
+
+
+@_reg
+def matrix_norm(x, ord="fro", keepdims=False):  # noqa: A002
+    o, kd = ord, keepdims
+    return invoke("linalg_matrix_norm",
+                  lambda a: jnp.linalg.norm(a, ord=o, axis=(-2, -1), keepdims=kd),
+                  (_as_nd(x),))
+
+
+@_reg
+def vector_norm(x, ord=2, axis=None, keepdims=False):  # noqa: A002
+    o, ax, kd = ord, axis, keepdims
+
+    def impl(a):
+        if ax is None:
+            a = a.ravel()
+            return jnp.linalg.norm(a, ord=o, keepdims=kd)
+        return jnp.linalg.norm(a, ord=o, axis=ax, keepdims=kd)
+
+    return invoke("linalg_vector_norm", impl, (_as_nd(x),))
+
+
+@_reg
+def svd(a, full_matrices=False, compute_uv=True):
+    fm, cu = full_matrices, compute_uv
+    nd = _as_nd(a)
+    if not cu:
+        return invoke("linalg_svdvals",
+                      lambda x: jnp.linalg.svd(x, full_matrices=fm,
+                                               compute_uv=False), (nd,))
+    return invoke("linalg_svd",
+                  lambda x: tuple(jnp.linalg.svd(x, full_matrices=fm)), (nd,))
+
+
+@_reg
+def svdvals(a):
+    return svd(a, compute_uv=False)
+
+
+@_reg
+def inv(a):
+    return invoke("linalg_inv", jnp.linalg.inv, (_as_nd(a),))
+
+
+@_reg
+def pinv(a, rcond=None, hermitian=False):
+    rc, h = rcond, hermitian
+    return invoke("linalg_pinv",
+                  lambda x: jnp.linalg.pinv(x, rcond=rc, hermitian=h),
+                  (_as_nd(a),))
+
+
+@_reg
+def det(a):
+    return invoke("linalg_det", jnp.linalg.det, (_as_nd(a),))
+
+
+@_reg
+def slogdet(a):
+    return invoke("linalg_slogdet",
+                  lambda x: tuple(jnp.linalg.slogdet(x)), (_as_nd(a),))
+
+
+@_reg
+def cholesky(a, upper=False):
+    up = upper
+
+    def impl(x):
+        l = jnp.linalg.cholesky(x)
+        return jnp.swapaxes(l, -1, -2).conj() if up else l
+
+    return invoke("linalg_cholesky", impl, (_as_nd(a),))
+
+
+@_reg
+def qr(a, mode="reduced"):
+    m = mode
+    return invoke("linalg_qr",
+                  lambda x: tuple(jnp.linalg.qr(x, mode=m)), (_as_nd(a),))
+
+
+@_reg
+def eig(a):
+    # jnp.linalg.eig is CPU-only in XLA; evaluate on host, return device arrays.
+    nd = _as_nd(a)
+    w, v = _np.linalg.eig(_np.asarray(nd.asnumpy()))
+    return from_jax(jnp.asarray(w)), from_jax(jnp.asarray(v))
+
+
+@_reg
+def eigvals(a):
+    nd = _as_nd(a)
+    w = _np.linalg.eigvals(_np.asarray(nd.asnumpy()))
+    return from_jax(jnp.asarray(w))
+
+
+@_reg
+def eigh(a, UPLO="L"):  # noqa: N803
+    u = UPLO
+    return invoke("linalg_eigh",
+                  lambda x: tuple(jnp.linalg.eigh(x, UPLO=u)), (_as_nd(a),))
+
+
+@_reg
+def eigvalsh(a, UPLO="L"):  # noqa: N803
+    u = UPLO
+    return invoke("linalg_eigvalsh",
+                  lambda x: jnp.linalg.eigvalsh(x, UPLO=u), (_as_nd(a),))
+
+
+@_reg
+def solve(a, b):
+    return invoke("linalg_solve", jnp.linalg.solve, (_as_nd(a), _as_nd(b)))
+
+
+@_reg
+def lstsq(a, b, rcond="warn"):
+    rc = None if rcond == "warn" else rcond
+    nd_a, nd_b = _as_nd(a), _as_nd(b)
+    x, res, rank, s = jnp.linalg.lstsq(nd_a._data, nd_b._data, rcond=rc)
+    return from_jax(x), from_jax(res), int(rank), from_jax(s)
+
+
+@_reg
+def matrix_rank(a, tol=None, hermitian=False):
+    t = tol
+    nd = _as_nd(a)
+    r = jnp.linalg.matrix_rank(nd._data, tol=t)
+    return from_jax(r)
+
+
+@_reg
+def matrix_power(a, n):
+    nn = n
+    return invoke("linalg_matrix_power",
+                  lambda x: jnp.linalg.matrix_power(x, nn), (_as_nd(a),))
+
+
+@_reg
+def multi_dot(arrays):
+    nds = [_as_nd(a) for a in arrays]
+    return invoke("linalg_multi_dot",
+                  lambda *xs: jnp.linalg.multi_dot(list(xs)), nds)
+
+
+@_reg
+def tensorinv(a, ind=2):
+    i = ind
+    return invoke("linalg_tensorinv",
+                  lambda x: jnp.linalg.tensorinv(x, ind=i), (_as_nd(a),))
+
+
+@_reg
+def tensorsolve(a, b, axes=None):
+    ax = axes
+    return invoke("linalg_tensorsolve",
+                  lambda x, y: jnp.linalg.tensorsolve(x, y, axes=ax),
+                  (_as_nd(a), _as_nd(b)))
+
+
+@_reg
+def cond(x, p=None):
+    pp = p
+    nd = _as_nd(x)
+    return from_jax(jnp.linalg.cond(nd._data, p=pp))
+
+
+@_reg
+def outer(a, b):
+    return invoke("linalg_outer",
+                  lambda x, y: jnp.outer(x.ravel(), y.ravel()),
+                  (_as_nd(a), _as_nd(b)))
+
+
+@_reg
+def cross(a, b, axis=-1):
+    ax = axis
+    return invoke("linalg_cross",
+                  lambda x, y: jnp.cross(x, y, axis=ax),
+                  (_as_nd(a), _as_nd(b)))
+
+
+@_reg
+def trace(a, offset=0):
+    off = offset
+    return invoke("linalg_trace",
+                  lambda x: jnp.trace(x, offset=off, axis1=-2, axis2=-1),
+                  (_as_nd(a),))
+
+
+@_reg
+def diagonal(a, offset=0):
+    off = offset
+    return invoke("linalg_diagonal",
+                  lambda x: jnp.diagonal(x, offset=off, axis1=-2, axis2=-1),
+                  (_as_nd(a),))
+
+
+@_reg
+def matmul(a, b):
+    return invoke("linalg_matmul", jnp.matmul, (_as_nd(a), _as_nd(b)))
+
+
+@_reg
+def matrix_transpose(a):
+    return invoke("linalg_matrix_transpose",
+                  lambda x: jnp.swapaxes(x, -1, -2), (_as_nd(a),))
+
+
+# ---------------------------------------------------------------------------
+# mxnet la_op family (reference: src/operator/tensor/la_op.cc _linalg_*)
+# ---------------------------------------------------------------------------
+
+@_reg
+def gemm(A, B, C, transpose_a=False, transpose_b=False, alpha=1.0, beta=1.0):  # noqa: N803
+    ta, tb, al, be = transpose_a, transpose_b, alpha, beta
+
+    def impl(a, b, c):
+        if ta:
+            a = jnp.swapaxes(a, -1, -2)
+        if tb:
+            b = jnp.swapaxes(b, -1, -2)
+        return al * jnp.matmul(a, b) + be * c
+
+    return invoke("linalg_gemm", impl, (_as_nd(A), _as_nd(B), _as_nd(C)))
+
+
+@_reg
+def gemm2(A, B, transpose_a=False, transpose_b=False, alpha=1.0):  # noqa: N803
+    ta, tb, al = transpose_a, transpose_b, alpha
+
+    def impl(a, b):
+        if ta:
+            a = jnp.swapaxes(a, -1, -2)
+        if tb:
+            b = jnp.swapaxes(b, -1, -2)
+        return al * jnp.matmul(a, b)
+
+    return invoke("linalg_gemm2", impl, (_as_nd(A), _as_nd(B)))
+
+
+@_reg
+def potrf(A, lower=True):  # noqa: N803
+    lo = lower
+
+    def impl(a):
+        l = jnp.linalg.cholesky(a)
+        return l if lo else jnp.swapaxes(l, -1, -2)
+
+    return invoke("linalg_potrf", impl, (_as_nd(A),))
+
+
+@_reg
+def potri(A, lower=True):  # noqa: N803
+    lo = lower
+
+    def impl(a):
+        l = a if lo else jnp.swapaxes(a, -1, -2)
+        eye = jnp.eye(a.shape[-1], dtype=a.dtype)
+        linv = jnp.linalg.solve(l, jnp.broadcast_to(eye, a.shape))
+        return jnp.matmul(jnp.swapaxes(linv, -1, -2), linv)
+
+    return invoke("linalg_potri", impl, (_as_nd(A),))
+
+
+@_reg
+def trsm(A, B, transpose=False, rightside=False, lower=True, alpha=1.0):  # noqa: N803
+    import jax.scipy.linalg as jsl
+    tr, rs, lo, al = transpose, rightside, lower, alpha
+
+    def impl(a, b):
+        if rs:
+            # solve X op(A) = alpha B  <=>  op(A)^T X^T = alpha B^T
+            x = jsl.solve_triangular(a, al * jnp.swapaxes(b, -1, -2),
+                                     lower=lo, trans=0 if tr else 1)
+            return jnp.swapaxes(x, -1, -2)
+        return jsl.solve_triangular(a, al * b, lower=lo, trans=1 if tr else 0)
+
+    return invoke("linalg_trsm", impl, (_as_nd(A), _as_nd(B)))
+
+
+@_reg
+def trmm(A, B, transpose=False, rightside=False, lower=True, alpha=1.0):  # noqa: N803
+    tr, rs, lo, al = transpose, rightside, lower, alpha
+
+    def impl(a, b):
+        t = jnp.tril(a) if lo else jnp.triu(a)
+        if tr:
+            t = jnp.swapaxes(t, -1, -2)
+        return al * (jnp.matmul(b, t) if rs else jnp.matmul(t, b))
+
+    return invoke("linalg_trmm", impl, (_as_nd(A), _as_nd(B)))
+
+
+@_reg
+def syrk(A, transpose=False, alpha=1.0):  # noqa: N803
+    tr, al = transpose, alpha
+
+    def impl(a):
+        at = jnp.swapaxes(a, -1, -2)
+        return al * (jnp.matmul(at, a) if tr else jnp.matmul(a, at))
+
+    return invoke("linalg_syrk", impl, (_as_nd(A),))
+
+
+@_reg
+def sumlogdiag(A):  # noqa: N803
+    return invoke("linalg_sumlogdiag",
+                  lambda a: jnp.sum(jnp.log(jnp.diagonal(a, axis1=-2, axis2=-1)),
+                                    axis=-1), (_as_nd(A),))
+
+
+@_reg
+def extractdiag(A, offset=0):  # noqa: N803
+    off = offset
+    return invoke("linalg_extractdiag",
+                  lambda a: jnp.diagonal(a, offset=off, axis1=-2, axis2=-1),
+                  (_as_nd(A),))
+
+
+@_reg
+def makediag(A, offset=0):  # noqa: N803
+    off = offset
+    return invoke("linalg_makediag",
+                  lambda a: _batched_diag(a, off), (_as_nd(A),))
+
+
+def _batched_diag(a, offset):
+    import jax
+    if a.ndim == 1:
+        return jnp.diag(a, k=offset)
+    fn = _batched_diag
+    return jax.vmap(lambda x: fn(x, offset))(a)
+
+
+@_reg
+def extracttrian(A, offset=0, lower=True):  # noqa: N803
+    off, lo = offset, lower
+
+    def impl(a):
+        n = a.shape[-1]
+        rows, cols = _np.tril_indices(n, k=off) if lo else _np.triu_indices(n, k=off)
+        return a[..., rows, cols]
+
+    return invoke("linalg_extracttrian", impl, (_as_nd(A),))
+
+
+@_reg
+def maketrian(A, offset=0, lower=True):  # noqa: N803
+    off, lo = offset, lower
+
+    def impl(a):
+        m = a.shape[-1]
+        k = abs(off)
+        strict = (lo and off < 0) or (not lo and off > 0)
+        if strict:
+            # strict triangle: m = (n-k)(n-k+1)/2 over an n x n matrix
+            n = int((_np.sqrt(8 * m + 1) - 1) / 2) + k
+        else:
+            # widened triangle: m = n(n+1)/2 + sum of the k extra diagonals
+            n = int((_np.sqrt(8 * m + (2 * k + 1) ** 2) - (2 * k + 1)) / 2) + k
+        rows, cols = _np.tril_indices(n, k=off) if lo else _np.triu_indices(n, k=off)
+        out = jnp.zeros(a.shape[:-1] + (n, n), dtype=a.dtype)
+        return out.at[..., rows, cols].set(a)
+
+    return invoke("linalg_maketrian", impl, (_as_nd(A),))

@@ -1,0 +1,745 @@
+"""Extended NumPy-semantics op surface (``mx.np`` beyond the core set).
+
+Reference parity (leezu/mxnet): ``src/operator/numpy/*`` (np broadcast /
+reduce / init / where / unique / einsum families) and
+``python/mxnet/numpy/multiarray.py`` — the 2.x NumPy interface the leezu
+fork's era standardized on (SURVEY.md section 2.2 "NumPy ops").
+
+Design (tpu-first): thin pure-jax compositions; autograd via the vjp hook in
+``register.invoke``. Stacking/combining helpers, nan-reductions, bitwise
+ops, statistics, and index helpers that round out ``mx.np`` to practical
+numpy drop-in coverage.
+"""
+from __future__ import annotations
+
+from typing import Any, Optional, Sequence
+
+import jax
+import jax.numpy as jnp
+import numpy as _np
+
+from .ndarray import NDArray, from_jax
+from .register import invoke, register_op
+
+__all__: list = []
+
+
+def _public(fn, name=None):
+    name = name or fn.__name__
+    __all__.append(name)
+    register_op(name, fn)
+    return fn
+
+
+def _as_nd(x: Any) -> NDArray:
+    if isinstance(x, NDArray):
+        return x
+    return NDArray(jnp.asarray(x), _wrap=True)
+
+
+def _nds(seq) -> list:
+    return [_as_nd(x) for x in seq]
+
+
+# ---------------------------------------------------------------------------
+# Stacking / combining
+# ---------------------------------------------------------------------------
+
+@_public
+def vstack(tup):
+    return invoke("vstack", lambda *xs: jnp.vstack(list(xs)), _nds(tup))
+
+
+@_public
+def hstack(tup):
+    return invoke("hstack", lambda *xs: jnp.hstack(list(xs)), _nds(tup))
+
+
+@_public
+def dstack(tup):
+    return invoke("dstack", lambda *xs: jnp.dstack(list(xs)), _nds(tup))
+
+
+@_public
+def column_stack(tup):
+    return invoke("column_stack", lambda *xs: jnp.column_stack(list(xs)),
+                  _nds(tup))
+
+
+row_stack = _public(vstack, "row_stack")
+
+
+@_public
+def append(arr, values, axis=None):
+    ax = axis
+    return invoke("append", lambda a, v: jnp.append(a, v, axis=ax),
+                  (_as_nd(arr), _as_nd(values)))
+
+
+@_public
+def insert(arr, obj, values, axis=None):
+    o, ax = obj, axis
+    return invoke("insert", lambda a, v: jnp.insert(a, o, v, axis=ax),
+                  (_as_nd(arr), _as_nd(values)))
+
+
+@_public
+def delete(arr, obj, axis=None):
+    o, ax = obj, axis
+    return invoke("delete", lambda a: jnp.delete(a, o, axis=ax),
+                  (_as_nd(arr),))
+
+
+@_public
+def resize(a, new_shape):
+    ns = new_shape
+    return invoke("resize", lambda x: jnp.resize(x, ns), (_as_nd(a),))
+
+
+@_public
+def trim_zeros(filt, trim="fb"):
+    nd = _as_nd(filt)
+    return from_jax(jnp.asarray(_np.trim_zeros(_np.asarray(nd.asnumpy()), trim)))
+
+
+@_public
+def rot90(m, k=1, axes=(0, 1)):
+    kk, ax = k, axes
+    return invoke("rot90", lambda x: jnp.rot90(x, k=kk, axes=ax), (_as_nd(m),))
+
+
+@_public
+def fliplr(m):
+    return invoke("fliplr", jnp.fliplr, (_as_nd(m),))
+
+
+@_public
+def flipud(m):
+    return invoke("flipud", jnp.flipud, (_as_nd(m),))
+
+
+@_public
+def broadcast_arrays(*args):
+    arrs = _nds(args)
+    outs = jnp.broadcast_arrays(*[a._data for a in arrs])
+    return [from_jax(o) for o in outs]
+
+
+# ---------------------------------------------------------------------------
+# Statistics
+# ---------------------------------------------------------------------------
+
+@_public
+def average(a, axis=None, weights=None, returned=False):
+    ax, ret = axis, returned
+    if weights is None:
+        out = invoke("average", lambda x: jnp.mean(x, axis=ax), (_as_nd(a),))
+        if ret:
+            nd = _as_nd(a)
+            n = nd.size if ax is None else nd.shape[ax]
+            return out, from_jax(jnp.full_like(out._data, n))
+        return out
+    nd_a, nd_w = _as_nd(a), _as_nd(weights)
+    out = invoke("average",
+                 lambda x, w: jnp.average(x, axis=ax, weights=w),
+                 (nd_a, nd_w))
+    if ret:
+        def sumw(x, w):
+            if w.ndim != x.ndim:
+                pos = (ax if ax is not None else 0) % x.ndim
+                w = jnp.expand_dims(w, tuple(i for i in range(x.ndim)
+                                             if i != pos))
+            return jnp.sum(jnp.broadcast_to(w, x.shape), axis=ax)
+
+        return out, invoke("average_sumw", sumw, (nd_a, nd_w))
+    return out
+
+
+@_public
+def median(a, axis=None, keepdims=False):
+    ax, kd = axis, keepdims
+    return invoke("median",
+                  lambda x: jnp.median(x, axis=ax, keepdims=kd), (_as_nd(a),))
+
+
+@_public
+def quantile(a, q, axis=None, keepdims=False, interpolation=None, method="linear"):
+    ax, kd = axis, keepdims
+    m = interpolation or method
+    return invoke("quantile",
+                  lambda x, qq: jnp.quantile(x, qq, axis=ax, keepdims=kd,
+                                             method=m),
+                  (_as_nd(a), _as_nd(q)))
+
+
+@_public
+def percentile(a, q, axis=None, keepdims=False, interpolation=None,
+               method="linear"):
+    ax, kd = axis, keepdims
+    m = interpolation or method
+    return invoke("percentile",
+                  lambda x, qq: jnp.percentile(x, qq, axis=ax, keepdims=kd,
+                                               method=m),
+                  (_as_nd(a), _as_nd(q)))
+
+
+@_public
+def ptp(a, axis=None, keepdims=False):
+    ax, kd = axis, keepdims
+    return invoke("ptp", lambda x: jnp.ptp(x, axis=ax, keepdims=kd),
+                  (_as_nd(a),))
+
+
+@_public
+def bincount(x, weights=None, minlength=0):
+    ml = minlength
+    if weights is None:
+        return invoke("bincount",
+                      lambda a: jnp.bincount(a, minlength=ml), (_as_nd(x),))
+    return invoke("bincount",
+                  lambda a, w: jnp.bincount(a, weights=w, minlength=ml),
+                  (_as_nd(x), _as_nd(weights)))
+
+
+@_public
+def corrcoef(x, y=None):
+    if y is None:
+        return invoke("corrcoef", jnp.corrcoef, (_as_nd(x),))
+    return invoke("corrcoef", jnp.corrcoef, (_as_nd(x), _as_nd(y)))
+
+
+@_public
+def cov(m, y=None, rowvar=True, bias=False, ddof=None):
+    rv, b, dd = rowvar, bias, ddof
+    if y is None:
+        return invoke("cov",
+                      lambda x: jnp.cov(x, rowvar=rv, bias=b, ddof=dd),
+                      (_as_nd(m),))
+    return invoke("cov",
+                  lambda x, yy: jnp.cov(x, yy, rowvar=rv, bias=b, ddof=dd),
+                  (_as_nd(m), _as_nd(y)))
+
+
+@_public
+def count_nonzero(a, axis=None, keepdims=False):
+    ax, kd = axis, keepdims
+    return invoke("count_nonzero",
+                  lambda x: jnp.count_nonzero(x, axis=ax, keepdims=kd),
+                  (_as_nd(a),))
+
+
+@_public
+def ediff1d(ary, to_end=None, to_begin=None):
+    te, tb = to_end, to_begin
+    return invoke("ediff1d",
+                  lambda x: jnp.ediff1d(x, to_end=te, to_begin=tb),
+                  (_as_nd(ary),))
+
+
+# nan-reductions ------------------------------------------------------------
+
+def _nanred(name, jfn):
+    def fn(a, axis=None, keepdims=False):
+        ax, kd = axis, keepdims
+        return invoke(name, lambda x: jfn(x, axis=ax, keepdims=kd),
+                      (_as_nd(a),))
+    fn.__name__ = name
+    return _public(fn)
+
+
+nansum = _nanred("nansum", jnp.nansum)
+nanprod = _nanred("nanprod", jnp.nanprod)
+nanmean = _nanred("nanmean", jnp.nanmean)
+nanmax = _nanred("nanmax", jnp.nanmax)
+nanmin = _nanred("nanmin", jnp.nanmin)
+nanstd = _nanred("nanstd", jnp.nanstd)
+nanvar = _nanred("nanvar", jnp.nanvar)
+
+
+@_public
+def nanargmax(a, axis=None):
+    ax = axis
+    return invoke("nanargmax", lambda x: jnp.nanargmax(x, axis=ax),
+                  (_as_nd(a),))
+
+
+@_public
+def nanargmin(a, axis=None):
+    ax = axis
+    return invoke("nanargmin", lambda x: jnp.nanargmin(x, axis=ax),
+                  (_as_nd(a),))
+
+
+@_public
+def nancumsum(a, axis=None):
+    ax = axis
+    return invoke("nancumsum", lambda x: jnp.nancumsum(x, axis=ax),
+                  (_as_nd(a),))
+
+
+@_public
+def nanmedian(a, axis=None, keepdims=False):
+    ax, kd = axis, keepdims
+    return invoke("nanmedian",
+                  lambda x: jnp.nanmedian(x, axis=ax, keepdims=kd),
+                  (_as_nd(a),))
+
+
+# ---------------------------------------------------------------------------
+# Bitwise / integer ops
+# ---------------------------------------------------------------------------
+
+def _binop(name, jfn):
+    def fn(a, b):
+        return invoke(name, jfn, (_as_nd(a), _as_nd(b)))
+    fn.__name__ = name
+    return _public(fn)
+
+
+bitwise_and = _binop("bitwise_and", jnp.bitwise_and)
+bitwise_or = _binop("bitwise_or", jnp.bitwise_or)
+bitwise_xor = _binop("bitwise_xor", jnp.bitwise_xor)
+left_shift = _binop("left_shift", jnp.left_shift)
+right_shift = _binop("right_shift", jnp.right_shift)
+gcd = _binop("gcd", jnp.gcd)
+lcm = _binop("lcm", jnp.lcm)
+heaviside = _binop("heaviside", jnp.heaviside)
+float_power = _binop("float_power", jnp.float_power)
+ldexp = _binop("ldexp", jnp.ldexp)
+nextafter = _binop("nextafter", jnp.nextafter)
+
+
+@_public
+def bitwise_not(a):
+    return invoke("bitwise_not", jnp.bitwise_not, (_as_nd(a),))
+
+
+invert = _public(bitwise_not, "invert")
+
+
+@_public
+def positive(a):
+    return invoke("positive", jnp.positive, (_as_nd(a),))
+
+
+@_public
+def exp2(a):
+    return invoke("exp2", jnp.exp2, (_as_nd(a),))
+
+
+@_public
+def signbit(a):
+    return invoke("signbit", jnp.signbit, (_as_nd(a),))
+
+
+@_public
+def frexp(a):
+    nd = _as_nd(a)
+    m, e = jnp.frexp(nd._data)
+    return from_jax(m), from_jax(e)
+
+
+@_public
+def modf(a):
+    nd = _as_nd(a)
+    frac, intg = jnp.modf(nd._data)
+    return from_jax(frac), from_jax(intg)
+
+
+@_public
+def divmod(a, b):  # noqa: A001
+    nd_a, nd_b = _as_nd(a), _as_nd(b)
+    q, r = jnp.divmod(nd_a._data, nd_b._data)
+    return from_jax(q), from_jax(r)
+
+
+@_public
+def deg2rad(a):
+    return invoke("deg2rad", jnp.deg2rad, (_as_nd(a),))
+
+
+@_public
+def rad2deg(a):
+    return invoke("rad2deg", jnp.rad2deg, (_as_nd(a),))
+
+
+@_public
+def around(a, decimals=0):
+    d = decimals
+    return invoke("around", lambda x: jnp.round(x, decimals=d), (_as_nd(a),))
+
+
+@_public
+def real(a):
+    return invoke("real", jnp.real, (_as_nd(a),))
+
+
+@_public
+def imag(a):
+    return invoke("imag", jnp.imag, (_as_nd(a),))
+
+
+@_public
+def conj(a):
+    return invoke("conj", jnp.conj, (_as_nd(a),))
+
+
+conjugate = _public(conj, "conjugate")
+
+
+@_public
+def angle(a, deg=False):
+    d = deg
+    return invoke("angle", lambda x: jnp.angle(x, deg=d), (_as_nd(a),))
+
+
+@_public
+def i0(a):
+    return invoke("i0", jnp.i0, (_as_nd(a),))
+
+
+@_public
+def sinc(a):
+    return invoke("sinc", jnp.sinc, (_as_nd(a),))
+
+
+# ---------------------------------------------------------------------------
+# Windows / ranges / grids
+# ---------------------------------------------------------------------------
+
+@_public
+def hanning(M, dtype="float32"):  # noqa: N803
+    return from_jax(jnp.hanning(M).astype(dtype))
+
+
+@_public
+def hamming(M, dtype="float32"):  # noqa: N803
+    return from_jax(jnp.hamming(M).astype(dtype))
+
+
+@_public
+def blackman(M, dtype="float32"):  # noqa: N803
+    return from_jax(jnp.blackman(M).astype(dtype))
+
+
+@_public
+def bartlett(M, dtype="float32"):  # noqa: N803
+    return from_jax(jnp.bartlett(M).astype(dtype))
+
+
+@_public
+def kaiser(M, beta, dtype="float32"):  # noqa: N803
+    return from_jax(jnp.kaiser(M, beta).astype(dtype))
+
+
+@_public
+def logspace(start, stop, num=50, endpoint=True, base=10.0, dtype=None,
+             ctx=None):
+    arr = jnp.logspace(start, stop, num=num, endpoint=endpoint, base=base,
+                       dtype=dtype)
+    return NDArray(arr, ctx=ctx)
+
+
+@_public
+def geomspace(start, stop, num=50, endpoint=True, dtype=None, ctx=None):
+    arr = jnp.geomspace(start, stop, num=num, endpoint=endpoint, dtype=dtype)
+    return NDArray(arr, ctx=ctx)
+
+
+@_public
+def indices(dimensions, dtype="int32", ctx=None):
+    return NDArray(jnp.indices(dimensions, dtype=dtype), ctx=ctx)
+
+
+@_public
+def tri(N, M=None, k=0, dtype="float32", ctx=None):  # noqa: N803
+    return NDArray(jnp.tri(N, M=M, k=k, dtype=dtype), ctx=ctx)
+
+
+@_public
+def vander(x, N=None, increasing=False):  # noqa: N803
+    n, inc = N, increasing
+    return invoke("vander",
+                  lambda a: jnp.vander(a, N=n, increasing=inc), (_as_nd(x),))
+
+
+@_public
+def tril_indices(n, k=0, m=None):
+    rows, cols = jnp.tril_indices(n, k=k, m=m)
+    return from_jax(rows), from_jax(cols)
+
+
+@_public
+def triu_indices(n, k=0, m=None):
+    rows, cols = jnp.triu_indices(n, k=k, m=m)
+    return from_jax(rows), from_jax(cols)
+
+
+@_public
+def diag_indices(n, ndim=2):
+    out = jnp.diag_indices(n, ndim=ndim)
+    return tuple(from_jax(o) for o in out)
+
+
+@_public
+def unravel_index(indices, shape):  # noqa: A002
+    sh = shape
+    nd = _as_nd(indices)
+    out = jnp.unravel_index(nd._data, sh)
+    return tuple(from_jax(o) for o in out)
+
+
+@_public
+def ravel_multi_index(multi_index, dims, mode="raise"):
+    m = mode
+    nds = _nds(multi_index)
+    out = jnp.ravel_multi_index(tuple(a._data for a in nds), dims, mode=m)
+    return from_jax(out)
+
+
+# ---------------------------------------------------------------------------
+# Selection / comparison
+# ---------------------------------------------------------------------------
+
+@_public
+def select(condlist, choicelist, default=0):
+    d = default
+    conds = _nds(condlist)
+    choices = _nds(choicelist)
+    n = len(conds)
+
+    def impl(*xs):
+        return jnp.select(list(xs[:n]), list(xs[n:]), default=d)
+
+    return invoke("select", impl, conds + choices)
+
+
+@_public
+def extract(condition, arr):
+    nd_c, nd_a = _as_nd(condition), _as_nd(arr)
+    return from_jax(jnp.extract(nd_c._data, nd_a._data))
+
+
+@_public
+def compress(condition, a, axis=None):
+    ax = axis
+    nd_c, nd_a = _as_nd(condition), _as_nd(a)
+    return from_jax(jnp.compress(nd_c._data, nd_a._data, axis=ax))
+
+
+@_public
+def choose(a, choices, mode="raise"):
+    m = mode
+    nd = _as_nd(a)
+    ch = _nds(choices)
+
+    def impl(x, *cs):
+        # 'raise' needs a concrete index check, impossible under tracing —
+        # fall back to numpy's documented alternative there.
+        mm = m
+        if mm == "raise" and isinstance(x, jax.core.Tracer):
+            mm = "clip"
+        return jnp.choose(x, list(cs), mode=mm)
+
+    return invoke("choose", impl, [nd] + ch)
+
+
+@_public
+def argwhere(a):
+    nd = _as_nd(a)
+    return from_jax(jnp.argwhere(nd._data))
+
+
+@_public
+def flatnonzero(a):
+    nd = _as_nd(a)
+    return from_jax(jnp.flatnonzero(nd._data))
+
+
+@_public
+def array_equal(a1, a2):
+    nd1, nd2 = _as_nd(a1), _as_nd(a2)
+    return bool(jnp.array_equal(nd1._data, nd2._data))
+
+
+@_public
+def array_equiv(a1, a2):
+    nd1, nd2 = _as_nd(a1), _as_nd(a2)
+    return bool(jnp.array_equiv(nd1._data, nd2._data))
+
+
+@_public
+def allclose(a, b, rtol=1e-05, atol=1e-08, equal_nan=False):
+    nd1, nd2 = _as_nd(a), _as_nd(b)
+    return bool(jnp.allclose(nd1._data, nd2._data, rtol=rtol, atol=atol,
+                             equal_nan=equal_nan))
+
+
+@_public
+def isin(element, test_elements, invert=False):  # noqa: A002
+    inv = invert
+    return invoke("isin",
+                  lambda e, t: jnp.isin(e, t, invert=inv),
+                  (_as_nd(element), _as_nd(test_elements)))
+
+
+@_public
+def union1d(ar1, ar2):
+    nd1, nd2 = _as_nd(ar1), _as_nd(ar2)
+    return from_jax(jnp.union1d(nd1._data, nd2._data))
+
+
+@_public
+def intersect1d(ar1, ar2, assume_unique=False):
+    au = assume_unique
+    nd1, nd2 = _as_nd(ar1), _as_nd(ar2)
+    return from_jax(jnp.intersect1d(nd1._data, nd2._data, assume_unique=au))
+
+
+@_public
+def setdiff1d(ar1, ar2, assume_unique=False):
+    au = assume_unique
+    nd1, nd2 = _as_nd(ar1), _as_nd(ar2)
+    return from_jax(jnp.setdiff1d(nd1._data, nd2._data, assume_unique=au))
+
+
+@_public
+def in1d(ar1, ar2, invert=False):  # noqa: A002
+    inv = invert
+    nd1, nd2 = _as_nd(ar1), _as_nd(ar2)
+    return from_jax(jnp.isin(nd1._data.ravel(), nd2._data, invert=inv))
+
+
+# ---------------------------------------------------------------------------
+# Polynomials / misc math
+# ---------------------------------------------------------------------------
+
+@_public
+def polyval(p, x):
+    return invoke("polyval", jnp.polyval, (_as_nd(p), _as_nd(x)))
+
+
+@_public
+def polyfit(x, y, deg):
+    nd_x, nd_y = _as_nd(x), _as_nd(y)
+    return from_jax(jnp.polyfit(nd_x._data.astype("float32"),
+                                nd_y._data.astype("float32"), deg))
+
+
+@_public
+def roots(p):
+    nd = _as_nd(p)
+    return from_jax(jnp.asarray(_np.roots(_np.asarray(nd.asnumpy()))))
+
+
+@_public
+def convolve(a, v, mode="full"):
+    m = mode
+    return invoke("convolve", lambda x, y: jnp.convolve(x, y, mode=m),
+                  (_as_nd(a), _as_nd(v)))
+
+
+@_public
+def correlate(a, v, mode="valid"):
+    m = mode
+    return invoke("correlate", lambda x, y: jnp.correlate(x, y, mode=m),
+                  (_as_nd(a), _as_nd(v)))
+
+
+@_public
+def gradient(f, *varargs, axis=None):
+    ax = axis
+    nd = _as_nd(f)
+    out = jnp.gradient(nd._data, *varargs, axis=ax)
+    if isinstance(out, (tuple, list)):
+        return [from_jax(o) for o in out]
+    return from_jax(out)
+
+
+@_public
+def trapz(y, x=None, dx=1.0, axis=-1):
+    d, ax = dx, axis
+    if x is None:
+        return invoke("trapz",
+                      lambda yy: jnp.trapezoid(yy, dx=d, axis=ax), (_as_nd(y),))
+    return invoke("trapz",
+                  lambda yy, xx: jnp.trapezoid(yy, xx, axis=ax),
+                  (_as_nd(y), _as_nd(x)))
+
+
+@_public
+def digitize(x, bins, right=False):
+    r = right
+    return invoke("digitize",
+                  lambda a, b: jnp.digitize(a, b, right=r),
+                  (_as_nd(x), _as_nd(bins)))
+
+
+@_public
+def piecewise(x, condlist, funclist):
+    nd = _as_nd(x)
+    conds = [_as_nd(c)._data for c in condlist]
+    return from_jax(jnp.piecewise(nd._data, conds, funclist))
+
+
+@_public
+def apply_along_axis(func1d, axis, arr, *args, **kwargs):
+    nd = _as_nd(arr)
+    return from_jax(jnp.apply_along_axis(func1d, axis, nd._data, *args, **kwargs))
+
+
+@_public
+def may_share_memory(a, b):
+    # functional XLA arrays: views share buffers only via jax aliasing,
+    # which is not observable — mirror numpy's conservative False.
+    return False
+
+
+shares_memory = _public(may_share_memory, "shares_memory")
+
+
+@_public
+def result_type(*args):
+    vals = [a._data if isinstance(a, NDArray) else a for a in args]
+    return _np.dtype(jnp.result_type(*vals))
+
+
+@_public
+def promote_types(t1, t2):
+    return _np.dtype(jnp.promote_types(t1, t2))
+
+
+@_public
+def can_cast(from_, to, casting="safe"):
+    if isinstance(from_, NDArray):
+        from_ = from_.dtype
+    return _np.can_cast(from_, to, casting=casting)
+
+
+@_public
+def ndim(a):
+    return _as_nd(a).ndim
+
+
+@_public
+def shape(a):
+    return _as_nd(a).shape
+
+
+@_public
+def size(a, axis=None):
+    nd = _as_nd(a)
+    return nd.size if axis is None else nd.shape[axis]
+
+
+@_public
+def copy(a):
+    return invoke("copy", lambda x: x + 0, (_as_nd(a),))
+
+
+@_public
+def require(a, dtype=None, requirements=None):
+    nd = _as_nd(a)
+    if dtype is not None:
+        return from_jax(nd._data.astype(dtype))
+    return nd

@@ -26,7 +26,11 @@ from .register import invoke
 __all__ = ["seed", "uniform", "normal", "randn", "randint", "gamma",
            "exponential", "poisson", "bernoulli", "multinomial", "choice",
            "shuffle", "beta", "laplace", "gumbel", "rand", "current_key",
-           "split_key", "trace_key_scope"]
+           "split_key", "trace_key_scope", "chisquare", "rayleigh",
+           "weibull", "pareto", "power", "logistic", "lognormal",
+           "negative_binomial", "generalized_negative_binomial", "f", "t",
+           "dirichlet", "binomial", "permutation", "randperm",
+           "standard_normal", "random_sample", "sample"]
 
 register_env("MXNET_RANDOM_SEED", 0, "Initial global PRNG seed.")
 
@@ -209,3 +213,139 @@ def shuffle(data):
     """Random permutation along the first axis (``mx.nd.random.shuffle``)."""
     arr = data._data if isinstance(data, NDArray) else jnp.asarray(data)
     return from_jax(jax.random.permutation(split_key(), arr, axis=0))
+
+
+def chisquare(df=1.0, shape=None, dtype="float32", ctx=None, **kw):
+    shp = _shape(shape)
+    return _sample("chisquare",
+                   lambda k: jax.random.chisquare(k, df, shape=shp,
+                                                  dtype=dtype), ctx)
+
+
+def rayleigh(scale=1.0, shape=None, dtype="float32", ctx=None, **kw):
+    shp = _shape(shape)
+    return _sample("rayleigh",
+                   lambda k: jax.random.rayleigh(k, scale, shape=shp,
+                                                 dtype=dtype), ctx)
+
+
+def weibull(a=1.0, shape=None, dtype="float32", ctx=None, **kw):
+    shp = _shape(shape)
+    return _sample("weibull",
+                   lambda k: jax.random.weibull_min(k, 1.0, a, shape=shp,
+                                                    dtype=dtype), ctx)
+
+
+def pareto(a=1.0, shape=None, dtype="float32", ctx=None, **kw):
+    shp = _shape(shape)
+    # numpy's pareto is the Lomax form: samples of (X - 1) with X ~ Pareto(a)
+    return _sample("pareto",
+                   lambda k: jax.random.pareto(k, a, shape=shp,
+                                               dtype=dtype) - 1.0, ctx)
+
+
+def power(a=1.0, shape=None, dtype="float32", ctx=None, **kw):
+    shp = _shape(shape)
+    return _sample("power",
+                   lambda k: jax.random.uniform(k, shp, dtype=dtype)
+                   ** (1.0 / a), ctx)
+
+
+def logistic(loc=0.0, scale=1.0, shape=None, dtype="float32", ctx=None, **kw):
+    shp = _shape(shape)
+    return _sample("logistic",
+                   lambda k: loc + scale * jax.random.logistic(k, shp,
+                                                               dtype=dtype),
+                   ctx)
+
+
+def lognormal(mean=0.0, sigma=1.0, shape=None, dtype="float32", ctx=None, **kw):
+    shp = _shape(shape)
+    return _sample("lognormal",
+                   lambda k: jnp.exp(mean + sigma * jax.random.normal(
+                       k, shp, dtype=dtype)), ctx)
+
+
+def negative_binomial(k=1, p=0.5, shape=None, dtype="float32", ctx=None, **kw):
+    shp = _shape(shape)
+    kk, pp = k, p
+
+    def impl(key):
+        k1, k2 = jax.random.split(key)
+        # NB(k, p) == Poisson(Gamma(k, (1-p)/p))
+        lam = jax.random.gamma(k1, kk, shp) * ((1.0 - pp) / pp)
+        return jax.random.poisson(k2, lam, shp).astype(dtype)
+
+    return _sample("negative_binomial", impl, ctx)
+
+
+def generalized_negative_binomial(mu=1.0, alpha=1.0, shape=None,
+                                  dtype="float32", ctx=None, **kw):
+    shp = _shape(shape)
+
+    def impl(key):
+        k1, k2 = jax.random.split(key)
+        r = 1.0 / alpha
+        lam = jax.random.gamma(k1, r, shp) * (mu * alpha)
+        return jax.random.poisson(k2, lam, shp).astype(dtype)
+
+    return _sample("generalized_negative_binomial", impl, ctx)
+
+
+def f(dfnum=1.0, dfden=1.0, shape=None, dtype="float32", ctx=None, **kw):
+    shp = _shape(shape)
+
+    def impl(key):
+        k1, k2 = jax.random.split(key)
+        num = jax.random.chisquare(k1, dfnum, shape=shp, dtype=dtype) / dfnum
+        den = jax.random.chisquare(k2, dfden, shape=shp, dtype=dtype) / dfden
+        return num / den
+
+    return _sample("f", impl, ctx)
+
+
+def t(df=1.0, shape=None, dtype="float32", ctx=None, **kw):
+    shp = _shape(shape)
+    return _sample("t",
+                   lambda k: jax.random.t(k, df, shape=shp, dtype=dtype), ctx)
+
+
+def dirichlet(alpha, shape=None, dtype="float32", ctx=None, **kw):
+    al = alpha._data if isinstance(alpha, NDArray) else jnp.asarray(
+        alpha, dtype=dtype)
+    shp = _shape(shape)
+    return _sample("dirichlet",
+                   lambda k: jax.random.dirichlet(k, al, shape=shp,
+                                                  dtype=dtype), ctx)
+
+
+def binomial(n=1, p=0.5, shape=None, dtype="float32", ctx=None, **kw):
+    shp = _shape(shape)
+    return _sample("binomial",
+                   lambda k: jax.random.binomial(k, n, p, shape=shp).astype(
+                       dtype), ctx)
+
+
+def permutation(x, ctx=None):
+    if isinstance(x, int):
+        return _sample("permutation",
+                       lambda k: jax.random.permutation(k, x), ctx)
+    arr = x._data if isinstance(x, NDArray) else jnp.asarray(x)
+    return _sample("permutation",
+                   lambda k: jax.random.permutation(k, arr, axis=0), ctx)
+
+
+def randperm(n, ctx=None):
+    return permutation(n, ctx=ctx)
+
+
+def standard_normal(shape=None, dtype="float32", ctx=None):
+    return normal(0.0, 1.0, shape=shape, dtype=dtype, ctx=ctx)
+
+
+def random_sample(shape=None, dtype="float32", ctx=None):
+    return uniform(0.0, 1.0, shape=shape, dtype=dtype, ctx=ctx)
+
+
+def sample(shape=None, dtype="float32", ctx=None):
+    return uniform(0.0, 1.0, shape=shape, dtype=dtype, ctx=ctx)

@@ -11,7 +11,10 @@ from ..ndarray.ndarray import NDArray as ndarray  # noqa: N813
 from ..ndarray.ndarray import NDArray, from_jax
 from ..ndarray.ops import *  # noqa: F401,F403
 from ..ndarray.ops import __all__ as _ops_all
+from ..ndarray.ops_numpy import *  # noqa: F401,F403
+from ..ndarray.ops_numpy import __all__ as _ops_np_all
 from ..ndarray import random  # noqa: F401
+from ..ndarray import linalg  # noqa: F401
 
 # dtype aliases / constants
 float16 = _onp.float16
@@ -31,6 +34,7 @@ nan = _onp.nan
 newaxis = None
 dtype = _onp.dtype
 
-__all__ = ["ndarray", "NDArray", "from_jax", "random", "float16", "float32",
-           "float64", "bfloat16", "int8", "int16", "int32", "int64", "uint8",
-           "bool_", "pi", "e", "inf", "nan", "newaxis", "dtype"] + list(_ops_all)
+__all__ = (["ndarray", "NDArray", "from_jax", "random", "linalg", "float16",
+            "float32", "float64", "bfloat16", "int8", "int16", "int32",
+            "int64", "uint8", "bool_", "pi", "e", "inf", "nan", "newaxis",
+            "dtype"] + list(_ops_all) + list(_ops_np_all))

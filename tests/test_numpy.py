@@ -1,0 +1,211 @@
+"""mx.np extended surface: linalg, statistics, stacking, random dists.
+
+Models the reference's test_numpy_op.py / test_numpy_interoperability.py:
+cross-check against real numpy on random inputs.
+"""
+import numpy as onp
+import pytest
+
+import mxnet_tpu as mx
+from mxnet_tpu import np as mnp
+from mxnet_tpu.test_utils import assert_almost_equal
+
+
+def test_linalg_norm_inv_det_solve():
+    onp.random.seed(0)
+    a = onp.random.rand(4, 4).astype("float32") + 4 * onp.eye(4, dtype="float32")
+    b = onp.random.rand(4, 3).astype("float32")
+    assert_almost_equal(mnp.linalg.norm(mnp.array(a)), onp.linalg.norm(a),
+                        rtol=1e-4, atol=1e-5)
+    assert_almost_equal(mnp.linalg.inv(mnp.array(a)), onp.linalg.inv(a),
+                        rtol=1e-3, atol=1e-4)
+    assert_almost_equal(mnp.linalg.det(mnp.array(a)), onp.linalg.det(a),
+                        rtol=1e-3, atol=1e-3)
+    assert_almost_equal(mnp.linalg.solve(mnp.array(a), mnp.array(b)),
+                        onp.linalg.solve(a, b), rtol=1e-3, atol=1e-4)
+
+
+def test_linalg_factorizations():
+    onp.random.seed(1)
+    m = onp.random.rand(5, 5).astype("float32")
+    spd = m @ m.T + 5 * onp.eye(5, dtype="float32")
+    l = mnp.linalg.cholesky(mnp.array(spd))
+    assert_almost_equal(l.asnumpy() @ l.asnumpy().T, spd, rtol=1e-4, atol=1e-4)
+    q, r = mnp.linalg.qr(mnp.array(m))
+    assert_almost_equal(q.asnumpy() @ r.asnumpy(), m, rtol=1e-4, atol=1e-4)
+    u, s, vt = mnp.linalg.svd(mnp.array(m))
+    assert_almost_equal((u.asnumpy() * s.asnumpy()) @ vt.asnumpy(), m,
+                        rtol=1e-3, atol=1e-4)
+    w, v = mnp.linalg.eigh(mnp.array(spd))
+    assert_almost_equal(onp.sort(w.asnumpy()),
+                        onp.sort(onp.linalg.eigvalsh(spd)),
+                        rtol=1e-3, atol=1e-3)
+
+
+def test_linalg_autograd():
+    from mxnet_tpu import autograd
+    a = mnp.array(onp.eye(3, dtype="float32") * 2.0)
+    a.attach_grad()
+    with autograd.record():
+        out = mnp.linalg.sumlogdiag(a)
+    out.backward()
+    # d/dA sum(log(diag(A))) = diag(1/diag(A))
+    assert_almost_equal(a.grad.asnumpy(), onp.eye(3, dtype="float32") * 0.5,
+                        rtol=1e-5, atol=1e-6)
+
+
+def test_la_op_family():
+    onp.random.seed(2)
+    a = onp.random.rand(3, 4).astype("float32")
+    b = onp.random.rand(4, 5).astype("float32")
+    c = onp.random.rand(3, 5).astype("float32")
+    out = mnp.linalg.gemm(mnp.array(a), mnp.array(b), mnp.array(c),
+                          alpha=2.0, beta=0.5)
+    assert_almost_equal(out, 2.0 * (a @ b) + 0.5 * c, rtol=1e-4, atol=1e-5)
+    out2 = mnp.linalg.gemm2(mnp.array(a), mnp.array(a), transpose_b=True)
+    assert_almost_equal(out2, a @ a.T, rtol=1e-4, atol=1e-5)
+    sy = mnp.linalg.syrk(mnp.array(a))
+    assert_almost_equal(sy, a @ a.T, rtol=1e-4, atol=1e-5)
+
+
+def test_stacking_and_stats():
+    x = onp.arange(12, dtype="float32").reshape(3, 4)
+    y = x + 100
+    assert_almost_equal(mnp.vstack([mnp.array(x), mnp.array(y)]),
+                        onp.vstack([x, y]))
+    assert_almost_equal(mnp.hstack([mnp.array(x), mnp.array(y)]),
+                        onp.hstack([x, y]))
+    assert_almost_equal(mnp.column_stack([mnp.array(x[:, 0]), mnp.array(y[:, 0])]),
+                        onp.column_stack([x[:, 0], y[:, 0]]))
+    assert_almost_equal(mnp.median(mnp.array(x), axis=1),
+                        onp.median(x, axis=1))
+    assert_almost_equal(mnp.average(mnp.array(x), axis=0,
+                                    weights=mnp.array([1., 2., 3.])),
+                        onp.average(x, axis=0, weights=[1., 2., 3.]),
+                        rtol=1e-5, atol=1e-6)
+    assert_almost_equal(mnp.percentile(mnp.array(x), 50),
+                        onp.percentile(x, 50), rtol=1e-5, atol=1e-6)
+    assert_almost_equal(mnp.ptp(mnp.array(x), axis=0), onp.ptp(x, axis=0))
+
+
+def test_nan_reductions():
+    x = onp.array([[1., onp.nan, 3.], [4., 5., onp.nan]], dtype="float32")
+    assert_almost_equal(mnp.nansum(mnp.array(x)), onp.nansum(x))
+    assert_almost_equal(mnp.nanmean(mnp.array(x), axis=1),
+                        onp.nanmean(x, axis=1), rtol=1e-6, atol=1e-6)
+    assert_almost_equal(mnp.nanmax(mnp.array(x), axis=0), onp.nanmax(x, axis=0))
+
+
+def test_bitwise_and_int_ops():
+    a = onp.array([0b1100, 0b1010], dtype="int32")
+    b = onp.array([0b1010, 0b0110], dtype="int32")
+    assert_almost_equal(mnp.bitwise_and(mnp.array(a), mnp.array(b)), a & b)
+    assert_almost_equal(mnp.bitwise_or(mnp.array(a), mnp.array(b)), a | b)
+    assert_almost_equal(mnp.left_shift(mnp.array(a), 2), a << 2)
+    assert_almost_equal(mnp.gcd(mnp.array(a), mnp.array(b)), onp.gcd(a, b))
+
+
+def test_selection_sets():
+    a = onp.array([1, 2, 3, 4], dtype="int32")
+    b = onp.array([3, 4, 5, 6], dtype="int32")
+    assert_almost_equal(mnp.union1d(mnp.array(a), mnp.array(b)),
+                        onp.union1d(a, b))
+    assert_almost_equal(mnp.intersect1d(mnp.array(a), mnp.array(b)),
+                        onp.intersect1d(a, b))
+    assert mnp.array_equal(mnp.array(a), mnp.array(a))
+    assert not mnp.array_equal(mnp.array(a), mnp.array(b))
+    got = mnp.isin(mnp.array(a), mnp.array(b))
+    assert_almost_equal(got, onp.isin(a, b))
+
+
+def test_poly_windows_grids():
+    p = onp.array([1., -2., 1.], dtype="float32")
+    x = onp.array([0., 1., 2.], dtype="float32")
+    assert_almost_equal(mnp.polyval(mnp.array(p), mnp.array(x)),
+                        onp.polyval(p, x))
+    assert_almost_equal(mnp.hanning(8), onp.hanning(8).astype("float32"),
+                        rtol=1e-5, atol=1e-6)
+    rows, cols = mnp.tril_indices(4)
+    erows, ecols = onp.tril_indices(4)
+    assert_almost_equal(rows, erows)
+    assert_almost_equal(cols, ecols)
+    assert_almost_equal(mnp.logspace(0, 2, 3), onp.logspace(0, 2, 3),
+                        rtol=1e-5, atol=1e-5)
+
+
+def test_random_distributions_shapes_and_moments():
+    mx.random.seed(42)
+    n = 20000
+    for name, kwargs, mean, tol in [
+        ("chisquare", dict(df=4.0), 4.0, 0.15),
+        ("rayleigh", dict(scale=2.0), 2.0 * onp.sqrt(onp.pi / 2), 0.1),
+        ("logistic", dict(loc=1.0, scale=0.5), 1.0, 0.1),
+        ("lognormal", dict(mean=0.0, sigma=0.25), onp.exp(0.03125), 0.1),
+        ("binomial", dict(n=10, p=0.3), 3.0, 0.1),
+        ("power", dict(a=3.0), 0.75, 0.05),
+    ]:
+        fn = getattr(mx.random, name)
+        out = fn(shape=(n,), **kwargs)
+        assert out.shape == (n,)
+        got = float(out.asnumpy().mean())
+        assert abs(got - mean) < tol, f"{name}: {got} vs {mean}"
+
+
+def test_random_permutation_dirichlet():
+    mx.random.seed(0)
+    perm = mx.random.permutation(10)
+    assert sorted(perm.asnumpy().tolist()) == list(range(10))
+    d = mx.random.dirichlet([1.0, 2.0, 3.0], shape=(5,))
+    assert d.shape == (5, 3)
+    assert_almost_equal(d.asnumpy().sum(axis=-1), onp.ones(5),
+                        rtol=1e-4, atol=1e-4)
+
+
+def test_result_type_shape_size():
+    a = mnp.array([1, 2], dtype="int32")
+    assert mnp.ndim(a) == 1
+    assert mnp.shape(a) == (2,)
+    assert mnp.size(a) == 2
+    assert mnp.result_type(a, onp.float32(1)) == onp.float32
+
+
+def test_trsm_rightside_and_transpose():
+    a = onp.array([[2., 0.], [1., 3.]], dtype="float32")
+    b = onp.array([[1., 2.], [3., 4.]], dtype="float32")
+    assert_almost_equal(
+        mnp.linalg.trsm(mnp.array(a), mnp.array(b), rightside=True),
+        b @ onp.linalg.inv(a), rtol=1e-4, atol=1e-5)
+    assert_almost_equal(
+        mnp.linalg.trsm(mnp.array(a), mnp.array(b), rightside=True,
+                        transpose=True),
+        b @ onp.linalg.inv(a.T), rtol=1e-4, atol=1e-5)
+    assert_almost_equal(
+        mnp.linalg.trsm(mnp.array(a), mnp.array(b), transpose=True),
+        onp.linalg.inv(a.T) @ b, rtol=1e-4, atol=1e-5)
+
+
+def test_maketrian_round_trip():
+    for off, lower in [(0, True), (-1, True), (1, False), (1, True),
+                       (-1, False)]:
+        src = onp.random.rand(4, 4).astype("float32")
+        packed = mnp.linalg.extracttrian(mnp.array(src), offset=off,
+                                         lower=lower)
+        rebuilt = mnp.linalg.maketrian(packed, offset=off, lower=lower)
+        mask = onp.tril(onp.ones((4, 4)), off) if lower else \
+            onp.triu(onp.ones((4, 4)), off)
+        assert_almost_equal(rebuilt, src * mask, rtol=1e-5, atol=1e-6)
+
+
+def test_average_returned_negative_axis():
+    x = onp.arange(12.).reshape(3, 4).astype("float32")
+    w = onp.array([1., 2., 3., 4.], dtype="float32")
+    out, s = mnp.average(mnp.array(x), axis=-1, weights=mnp.array(w),
+                         returned=True)
+    eo, es = onp.average(x, axis=-1, weights=w, returned=True)
+    assert_almost_equal(out, eo, rtol=1e-5, atol=1e-6)
+    assert_almost_equal(s, es, rtol=1e-5, atol=1e-6)
+
+
+def test_choose_raises_out_of_bounds():
+    with pytest.raises(Exception):
+        mnp.choose(mnp.array([0, 3]), [mnp.array([1, 2]), mnp.array([3, 4])])
