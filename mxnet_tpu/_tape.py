@@ -168,6 +168,10 @@ def backward_arrays(heads: Sequence[Any],
             c = cots.get(id(arr)) if arr is not None else None
             if c is None:
                 c = jnp.zeros(shape, dtype=dtype)
+            elif c.dtype != dtype:
+                # cotangents accumulated in a wider dtype (e.g. amp widest-
+                # cast) must match the recorded output aval for jax.vjp
+                c = c.astype(dtype)
             out_cots.append(c)
         payload = tuple(out_cots) if node.out_is_tuple else out_cots[0]
         in_cots = node.vjp_fn(payload)

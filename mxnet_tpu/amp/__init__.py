@@ -1,0 +1,263 @@
+"""Automatic mixed precision.
+
+Reference parity (leezu/mxnet): ``python/mxnet/amp/amp.py`` —
+``amp.init()`` (op-level cast insertion per curated lists),
+``amp.init_trainer`` / ``amp.scale_loss`` / ``amp.unscale`` (dynamic loss
+scaling with skip-on-overflow), ``amp.convert_model`` /
+``convert_hybrid_block`` (inference conversion).
+
+Design (tpu-first): the default target dtype is **bfloat16** (MXU native;
+fp32 exponent range, so loss scaling is rarely needed — kept for fp16 and
+API parity). Instead of monkey-patching generated op namespaces, the cast
+policy hooks the single dispatch point ``ndarray.register.invoke``: ops in
+``TARGET_DTYPE_FUNCS`` get float32 inputs cast down (MXU-bound matmuls),
+ops in ``FP32_FUNCS`` get low-precision inputs cast up, and
+``WIDEST_TYPE_CASTS`` promote mixed inputs. Because the hook also runs
+under hybridize tracing, the casts land inside the compiled XLA program —
+the analog of the reference's symbol-pass cast insertion, with XLA fusing
+the casts into neighbours for free.
+"""
+from __future__ import annotations
+
+import contextlib
+import warnings
+from typing import Any, Iterable, List, Optional, Union
+
+import jax
+import jax.numpy as jnp
+import numpy as _np
+
+from ..base import MXNetError
+from ..ndarray.ndarray import NDArray, from_jax
+from ..ndarray.register import invoke, register_op
+from . import lists
+
+__all__ = ["init", "init_trainer", "scale_loss", "unscale", "convert_model",
+           "convert_hybrid_block", "amp_cast", "amp_multicast",
+           "DynamicLossScaler", "is_enabled", "disable"]
+
+_STATE = {
+    "active": False,
+    "target_dtype": None,      # jnp dtype
+    "target_funcs": frozenset(),
+    "fp32_funcs": frozenset(),
+    "widest_funcs": frozenset(),
+}
+
+
+def is_enabled() -> bool:
+    return _STATE["active"]
+
+
+def _float_like(a) -> bool:
+    return jnp.issubdtype(a.dtype, jnp.floating)
+
+
+def apply_cast_policy(name: str, arrays: List[Any]) -> List[Any]:
+    """Cast hook consulted by ``register.invoke`` on every op dispatch."""
+    if not _STATE["active"]:
+        return arrays
+    tgt = _STATE["target_dtype"]
+    if name in _STATE["target_funcs"]:
+        return [a.astype(tgt)
+                if _float_like(a) and a.dtype == jnp.float32 else a
+                for a in arrays]
+    if name in _STATE["fp32_funcs"]:
+        return [a.astype(jnp.float32)
+                if _float_like(a) and a.dtype in (tgt, jnp.float16) else a
+                for a in arrays]
+    if name in _STATE["widest_funcs"]:
+        fdts = [a.dtype for a in arrays if _float_like(a)]
+        if len(set(map(str, fdts))) > 1:
+            widest = jnp.result_type(*fdts)
+            return [a.astype(widest) if _float_like(a) else a
+                    for a in arrays]
+    return arrays
+
+
+def init(target_dtype: Union[str, Any] = "bfloat16",
+         target_dtype_ops: Optional[Iterable[str]] = None,
+         fp32_ops: Optional[Iterable[str]] = None,
+         widest_dtype_ops: Optional[Iterable[str]] = None) -> None:
+    """Enable mixed precision globally (reference: ``amp.init()``).
+
+    Optional op-name lists override the curated defaults in
+    ``amp/lists.py``.
+    """
+    dt = jnp.bfloat16 if str(target_dtype) in ("bfloat16", "bf16") else \
+        jnp.dtype(target_dtype)
+    if dt not in (jnp.bfloat16, jnp.float16):
+        raise MXNetError(
+            f"amp target_dtype must be bfloat16 or float16, got {dt}")
+    from ..ndarray import register as _reg
+    _reg._amp_state["active"] = True
+    _STATE.update(
+        active=True,
+        target_dtype=dt,
+        target_funcs=frozenset(target_dtype_ops
+                               if target_dtype_ops is not None
+                               else lists.TARGET_DTYPE_FUNCS),
+        fp32_funcs=frozenset(fp32_ops if fp32_ops is not None
+                             else lists.FP32_FUNCS),
+        widest_funcs=frozenset(widest_dtype_ops
+                               if widest_dtype_ops is not None
+                               else lists.WIDEST_TYPE_CASTS),
+    )
+
+
+def disable() -> None:
+    """Turn the cast policy off (no reference analog; useful in tests)."""
+    _STATE["active"] = False
+    from ..ndarray import register as _reg
+    _reg._amp_state["active"] = False
+
+
+# ---------------------------------------------------------------------------
+# Cast ops (reference: src/operator/tensor/amp_cast.cc)
+# ---------------------------------------------------------------------------
+
+def amp_cast(data: Any, dtype: Any) -> NDArray:
+    """Gradient-transparent cast (reference ``amp_cast``: dtype changes do
+    not block gradient flow)."""
+    dt = jnp.bfloat16 if str(dtype) in ("bfloat16", "bf16") else \
+        jnp.dtype(dtype)
+    nd = data if isinstance(data, NDArray) else NDArray(data)
+    return invoke("amp_cast", lambda x: x.astype(dt), (nd,))
+
+
+def amp_multicast(*data: Any, num_outputs: Optional[int] = None):
+    """Cast all inputs to the widest of their dtypes (``amp_multicast``)."""
+    nds = [d if isinstance(d, NDArray) else NDArray(d) for d in data]
+    widest = jnp.result_type(*[n._data.dtype for n in nds])
+    return tuple(invoke("amp_cast", lambda x: x.astype(widest), (n,))
+                 for n in nds)
+
+
+register_op("amp_cast", amp_cast)
+register_op("amp_multicast", amp_multicast)
+
+
+# ---------------------------------------------------------------------------
+# Dynamic loss scaling (reference: amp.py LossScaler)
+# ---------------------------------------------------------------------------
+
+class DynamicLossScaler:
+    """Dynamic loss scale: halve on overflow, double every
+    ``scale_window`` clean steps (the reference's fp16 recipe)."""
+
+    def __init__(self, init_scale: float = 2.0 ** 16,
+                 scale_factor: float = 2.0, scale_window: int = 2000) -> None:
+        self.loss_scale = float(init_scale)
+        self._factor = scale_factor
+        self._window = scale_window
+        self._unskipped = 0
+
+    def has_overflow(self, grads: Iterable[NDArray]) -> bool:
+        for g in grads:
+            if g is None:
+                continue
+            arr = g._data if isinstance(g, NDArray) else g
+            if not bool(jnp.isfinite(arr).all()):
+                return True
+        return False
+
+    def update_scale(self, overflow: bool) -> None:
+        if overflow:
+            self.loss_scale = max(1.0, self.loss_scale / self._factor)
+            self._unskipped = 0
+        else:
+            self._unskipped += 1
+            if self._unskipped >= self._window:
+                self.loss_scale *= self._factor
+                self._unskipped = 0
+
+
+def init_trainer(trainer: Any, init_scale: float = 2.0 ** 16,
+                 scale_window: int = 2000) -> None:
+    """Attach dynamic loss scaling to a Trainer: ``trainer.step`` divides
+    grads by the live scale and skips the update on overflow (reference:
+    ``amp.init_trainer``)."""
+    scaler = DynamicLossScaler(init_scale=init_scale,
+                               scale_window=scale_window)
+    trainer._amp_scaler = scaler
+    orig_update = trainer._update
+
+    def _update(ignore_stale_grad: bool = False) -> None:
+        grads = [p.data().grad for p in trainer._params
+                 if p.grad_req != "null" and p.is_initialized]
+        overflow = scaler.has_overflow(grads)
+        scaler.update_scale(overflow)
+        if overflow:
+            for p in trainer._params:
+                if p.is_initialized and p.data().grad is not None:
+                    p.data()._fresh_grad = False
+            warnings.warn(
+                f"amp: gradient overflow, skipping step "
+                f"(loss scale -> {scaler.loss_scale})")
+            return
+        orig_update(ignore_stale_grad)
+
+    trainer._update = _update
+
+
+@contextlib.contextmanager
+def scale_loss(loss: Any, trainer: Any):
+    """Multiply the loss by the live scale inside the context; trainer.step
+    un-scales gradients automatically (reference: ``amp.scale_loss``)."""
+    scaler: Optional[DynamicLossScaler] = getattr(trainer, "_amp_scaler", None)
+    if scaler is None:
+        raise MXNetError("call amp.init_trainer(trainer) before scale_loss")
+    # trainer.step multiplies grads by _scale/batch_size — set the inverse
+    # so gradients come out un-scaled
+    trainer._scale = 1.0 / scaler.loss_scale
+    try:
+        if isinstance(loss, (list, tuple)):
+            yield type(loss)(l * scaler.loss_scale for l in loss)
+        else:
+            yield loss * scaler.loss_scale
+    finally:
+        pass
+
+
+def unscale(trainer: Any) -> None:
+    """Divide current grads by the loss scale (for grad clipping before
+    step; reference: ``amp.unscale``)."""
+    scaler: Optional[DynamicLossScaler] = getattr(trainer, "_amp_scaler", None)
+    if scaler is None:
+        raise MXNetError("call amp.init_trainer(trainer) first")
+    inv = 1.0 / scaler.loss_scale
+    for p in trainer._params:
+        if p.grad_req != "null" and p.is_initialized:
+            w = p.data()
+            if w.grad is not None and w._fresh_grad:
+                w._grad = from_jax(w.grad._data * inv)
+    trainer._scale = 1.0
+
+
+# ---------------------------------------------------------------------------
+# Model conversion (reference: amp.convert_model / convert_hybrid_block)
+# ---------------------------------------------------------------------------
+
+def convert_model(block: Any, target_dtype: Union[str, Any] = "bfloat16",
+                  excluded_sym_names: Optional[Iterable[str]] = None) -> Any:
+    """Cast a trained block's parameters to the target dtype for
+    low-precision inference, keeping norm-layer params in fp32 (the
+    reference keeps FP32_FUNCS ops in fp32)."""
+    excluded = set(excluded_sym_names or ())
+    dt = "bfloat16" if str(target_dtype) in ("bfloat16", "bf16") else \
+        str(_np.dtype(target_dtype))
+    for name, p in block.collect_params().items():
+        if name in excluded:
+            continue
+        lname = name.lower()
+        if any(t in lname for t in ("norm", "gamma", "beta",
+                                    "running_mean", "running_var")):
+            continue
+        if p.is_initialized and jnp.issubdtype(p.data()._data.dtype,
+                                               jnp.floating):
+            p.set_data(from_jax(p.data()._data.astype(dt)))
+            p._dtype = dt
+    return block
+
+
+convert_hybrid_block = convert_model

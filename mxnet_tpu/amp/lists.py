@@ -1,0 +1,47 @@
+"""AMP op classification lists.
+
+Reference parity (leezu/mxnet): ``python/mxnet/amp/lists/symbol_fp16.py``
+(FP16_FUNCS / FP32_FUNCS / WIDEST_TYPE_CASTS / CONDITIONAL_FP32_FUNCS).
+
+Design (tpu-first): the target low-precision dtype is **bfloat16** (the
+MXU's native format) rather than float16 — bf16 keeps fp32's exponent
+range so the loss-scaling machinery is optional (still provided for
+parity and for fp16 use). Names here are op-registry names as passed to
+``register.invoke``; the cast hook in ``amp/__init__.py`` consults these
+centrally, replacing the reference's per-namespace monkey-patching.
+"""
+
+# MXU-bound ops: run in the low-precision target dtype.
+TARGET_DTYPE_FUNCS = [
+    "fully_connected", "convolution", "deconvolution", "dot", "batch_dot",
+    "matmul", "linalg_gemm", "linalg_gemm2", "linalg_matmul", "tensordot",
+    "inner", "outer", "kron", "einsum",
+    "interleaved_matmul_selfatt_qk", "interleaved_matmul_selfatt_valatt",
+    "interleaved_matmul_encdec_qk", "interleaved_matmul_encdec_valatt",
+    "multi_head_attention", "dot_product_attention",
+    "rnn", "embedding",
+]
+
+# Numerically sensitive ops: always run in float32.
+FP32_FUNCS = [
+    "softmax", "log_softmax", "masked_softmax", "masked_log_softmax",
+    "batch_norm", "layer_norm", "group_norm", "instance_norm", "rms_norm",
+    "l2_normalization", "lrn", "norm", "logsumexp",
+    "exp", "expm1", "log", "log1p", "log2", "log10",
+    "erfinv", "reciprocal", "rsqrt", "rcbrt",
+    "linalg_potrf", "linalg_potri", "linalg_trsm", "linalg_cholesky",
+    "linalg_inv", "linalg_det", "linalg_slogdet", "linalg_svd",
+    "linalg_sumlogdiag", "linalg_norm",
+    "mean", "sum", "prod", "cumsum", "var", "std",
+    "quantile", "percentile", "median",
+    "smooth_l1", "pick",
+]
+
+# Elementwise combiners: promote all float inputs to the widest dtype.
+WIDEST_TYPE_CASTS = [
+    "add", "subtract", "multiply", "true_divide", "divide", "mod",
+    "power", "maximum", "minimum", "fmax", "fmin", "hypot", "arctan2",
+    "add_n", "ElementWiseSum", "maximum_n", "where", "clip",
+    "equal", "not_equal", "greater", "greater_equal", "less", "less_equal",
+    "logaddexp", "copysign",
+]

@@ -23,6 +23,7 @@ from collections import OrderedDict
 from typing import Any, Callable, Dict, List, Optional, Sequence, Tuple, Union
 
 import jax
+import jax.numpy as jnp
 import numpy as _np
 
 from .. import engine
@@ -268,36 +269,49 @@ class HybridBlock(Block):
         self.hybridize()
         self(x)
 
+    def _make_traced(self, params: List[Parameter], train: bool,
+                     cell: Dict[str, Any]) -> Callable:
+        """Build the jittable closure shared by _call_cached and export:
+        (rng_key, param_arrays, *inputs) -> flat output leaves, recording
+        the output treedef into ``cell``."""
+        block = self
+
+        def traced(rng_key, param_arrays, *input_arrays):
+            prev = set_training(train)
+            try:
+                with _bind_params(params, param_arrays), \
+                        _random.trace_key_scope(rng_key):
+                    inputs = [from_jax(a) for a in input_arrays]
+                    out = block.forward(*inputs)
+            finally:
+                set_training(prev)
+            raw = jax.tree_util.tree_map(
+                lambda o: o._data if isinstance(o, NDArray) else o, out,
+                is_leaf=lambda o: isinstance(o, NDArray))
+            leaves, treedef = jax.tree_util.tree_flatten(raw)
+            cell["treedef"] = treedef
+            return tuple(leaves)
+
+        return traced
+
     def _call_cached(self, *args: Any) -> Any:
         nd_args = [a if isinstance(a, NDArray) else NDArray(a) for a in args]
         self._ensure_shapes(*nd_args)
         params = [p for p in self.collect_params().values() if p.is_initialized]
 
         train = is_training()
+        self._last_sig = [(tuple(a.shape), a.dtype) for a in nd_args]
+        from ..ndarray.register import _amp_state
+        amp_key = None
+        if _amp_state["active"]:
+            from ..amp import _STATE as _amp
+            amp_key = str(_amp["target_dtype"])
         key_sig = (tuple((tuple(a.shape), str(a.dtype)) for a in nd_args),
-                   train)
+                   train, amp_key)
         entry = self._cached_graph.get(key_sig)
         if entry is None:
-            block = self
             cell: Dict[str, Any] = {}  # filled with treedef at trace time
-
-            def traced(rng_key, param_arrays, *input_arrays):
-                prev = set_training(train)
-                try:
-                    with _bind_params(params, param_arrays), \
-                            _random.trace_key_scope(rng_key):
-                        inputs = [from_jax(a) for a in input_arrays]
-                        out = block.forward(*inputs)
-                finally:
-                    set_training(prev)
-                raw = jax.tree_util.tree_map(
-                    lambda o: o._data if isinstance(o, NDArray) else o, out,
-                    is_leaf=lambda o: isinstance(o, NDArray))
-                leaves, treedef = jax.tree_util.tree_flatten(raw)
-                cell["treedef"] = treedef
-                return tuple(leaves)
-
-            entry = (jax.jit(traced), cell)
+            entry = (jax.jit(self._make_traced(params, train, cell)), cell)
             self._cached_graph[key_sig] = entry
 
         cached, cell = entry
@@ -323,25 +337,120 @@ class HybridBlock(Block):
         return super().__call__(*args)
 
     # -- export/deploy -----------------------------------------------------
-    def export(self, path: str, epoch: int = 0) -> Tuple[str, str]:
-        """Serialize architecture (StableHLO text) + params for deployment
-        (reference: ``HybridBlock.export`` → symbol.json + .params)."""
+    def export(self, path: str, epoch: int = 0,
+               input_signature: Optional[Sequence[tuple]] = None
+               ) -> Tuple[str, str]:
+        """Serialize a runnable program + params for deployment (reference:
+        ``HybridBlock.export`` → ``prefix-symbol.json`` + ``.params``).
+
+        The "symbol" payload is a jax.export StableHLO artifact traced in
+        inference mode (the TPU-era graph format; the reference stored an
+        NNVM json graph). ``input_signature`` is a list of (shape, dtype)
+        per input; if omitted, the signature of the last hybridized call
+        is used (so call the block once before exporting, as in the
+        reference).
+        """
+        import base64
         import json
+
+        if input_signature is None:
+            input_signature = getattr(self, "_last_sig", None)
+        if input_signature is None:
+            raise MXNetError(
+                "export() needs the input signature: run the block once "
+                "(after hybridize()) or pass input_signature=[(shape, "
+                "dtype), ...]")
+
         params = {k: v for k, v in self.collect_params().items()
                   if v.is_initialized}
         param_file = f"{path}-{epoch:04d}.params"
         from ..ndarray_io import save_params
         save_params(param_file, {k: v.data() for k, v in params.items()})
+
+        from jax import export as jax_export
+        param_list = list(params.values())
+        cell: Dict[str, Any] = {}
+        key_spec = jax.ShapeDtypeStruct((2,), jnp.uint32)
+        param_specs = [jax.ShapeDtypeStruct(p.shape, p.dtype)
+                       for p in param_list]
+        in_specs = [jax.ShapeDtypeStruct(tuple(s), d)
+                    for s, d in input_signature]
+        jitted = jax.jit(self._make_traced(param_list, False, cell))
+        try:
+            exp = jax_export.export(jitted, platforms=("cpu", "tpu"))(
+                key_spec, param_specs, *in_specs)
+        except Exception as e:
+            # some backends (e.g. the axon tunnel) reject multi-platform
+            # lowering; fall back to the current platform only. Anything
+            # that is not a platform complaint is a real trace error.
+            if "platform" not in str(e).lower():
+                raise
+            exp = jax_export.export(jitted)(key_spec, param_specs, *in_specs)
+
         meta = {
             "framework": "mxnet_tpu",
+            "format_version": 1,
             "block": type(self).__name__,
+            "inputs": [{"shape": list(s), "dtype": str(_np.dtype(d))}
+                       for s, d in input_signature],
             "params": {k: {"shape": list(v.shape), "dtype": str(v.dtype)}
                        for k, v in params.items()},
+            "param_order": list(params.keys()),
+            "out_treedef": _treedef_to_obj(cell["treedef"]),
+            "stablehlo": base64.b64encode(bytes(exp.serialize())).decode(
+                "ascii"),
         }
         sym_file = f"{path}-symbol.json"
         with open(sym_file, "w") as f:
             json.dump(meta, f, indent=2)
         return sym_file, param_file
+
+
+def _treedef_to_obj(treedef: Any) -> Any:
+    """Declarative (JSON-able) encoding of an output pytree structure.
+
+    Supports the standard containers a forward may return (leaf, tuple,
+    list, dict) — no pickle, so model files stay safe to load from
+    untrusted sources.
+    """
+    n = treedef.num_leaves
+    skeleton = jax.tree_util.tree_unflatten(treedef, list(range(n)))
+
+    def enc(node: Any) -> Any:
+        if isinstance(node, int):
+            return {"t": "leaf"}
+        if isinstance(node, tuple):
+            return {"t": "tuple", "c": [enc(x) for x in node]}
+        if isinstance(node, list):
+            return {"t": "list", "c": [enc(x) for x in node]}
+        if isinstance(node, dict):
+            return {"t": "dict", "k": list(node.keys()),
+                    "c": [enc(node[k]) for k in node.keys()]}
+        if node is None:
+            return {"t": "none"}
+        raise MXNetError(
+            f"export: forward returned a {type(node).__name__}; only "
+            f"tuples/lists/dicts/arrays are exportable")
+
+    return enc(skeleton)
+
+
+def _obj_to_treedef(obj: Any) -> Any:
+    def dec(node: Any) -> Any:
+        t = node["t"]
+        if t == "leaf":
+            return 0  # placeholder leaf
+        if t == "tuple":
+            return tuple(dec(x) for x in node["c"])
+        if t == "list":
+            return [dec(x) for x in node["c"]]
+        if t == "dict":
+            return {k: dec(x) for k, x in zip(node["k"], node["c"])}
+        if t == "none":
+            return None
+        raise MXNetError(f"bad treedef node type {t!r} in model file")
+
+    return jax.tree_util.tree_structure(dec(obj))
 
 
 def _tracing_now(args) -> bool:
@@ -354,8 +463,10 @@ def _tracing_now(args) -> bool:
 
 class SymbolBlock(HybridBlock):
     """Load-and-run container for exported models (reference:
-    ``gluon.SymbolBlock.imports``). The XLA build deploys whole Python
-    blocks + params; this wraps a stored callable."""
+    ``gluon.SymbolBlock.imports`` over ``-symbol.json`` + ``.params``).
+
+    Wraps either a deserialized jax.export artifact (from
+    ``HybridBlock.export``) or any stored callable."""
 
     def __init__(self, fn: Callable, params: Dict[str, Parameter]) -> None:
         super().__init__()
@@ -364,12 +475,61 @@ class SymbolBlock(HybridBlock):
             self._reg_params[k] = v
 
     @staticmethod
-    def imports(symbol_file: str, input_names, param_file: Optional[str] = None,
+    def imports(symbol_file: str, input_names: Any = None,
+                param_file: Optional[str] = None,
                 ctx: Any = None) -> "SymbolBlock":
-        raise MXNetError(
-            "SymbolBlock.imports of reference-format json graphs is not "
-            "supported; re-instantiate the Python block and call "
-            "load_parameters(params_file) instead")
+        """Load an exported model: deserializes the StableHLO artifact and
+        rebinds the saved parameters (reference: ``SymbolBlock.imports``)."""
+        import base64
+        import json
+
+        from jax import export as jax_export
+
+        with open(symbol_file) as f:
+            meta = json.load(f)
+        if meta.get("framework") != "mxnet_tpu" or "stablehlo" not in meta:
+            raise MXNetError(
+                f"{symbol_file} is not an mxnet_tpu export (re-export with "
+                "HybridBlock.export)")
+
+        exp = jax_export.deserialize(
+            bytearray(base64.b64decode(meta["stablehlo"])))
+        treedef = _obj_to_treedef(meta["out_treedef"])
+        order = meta["param_order"]
+
+        params: Dict[str, Parameter] = {}
+        if param_file is not None:
+            from ..ndarray_io import load_params
+            loaded = load_params(param_file, ctx=ctx)
+            missing = [k for k in order if k not in loaded]
+            if missing:
+                raise MXNetError(
+                    f"{param_file} is missing exported params: {missing}")
+            for k in order:
+                p = Parameter(k, shape=loaded[k].shape,
+                              dtype=loaded[k].dtype, grad_req="null")
+                p.set_data(loaded[k])
+                params[k] = p
+        elif order:
+            # no params file: leave parameters uninitialized so first use
+            # raises instead of silently running random weights
+            raise MXNetError(
+                "SymbolBlock.imports: this export has parameters — pass "
+                "param_file=<prefix-NNNN.params> (loading without weights "
+                "would silently return garbage)")
+
+        def fn(*args: Any) -> Any:
+            arrays = [a._data if isinstance(a, NDArray) else jnp.asarray(a)
+                      for a in args]
+            rng = _random.split_key()
+            if rng.shape != (2,):  # typed key -> raw uint32 pair
+                rng = jax.random.key_data(rng)
+            pa = [params[k].data()._data for k in order]
+            leaves = exp.call(rng.astype(jnp.uint32), pa, *arrays)
+            out = jax.tree_util.tree_unflatten(treedef, list(leaves))
+            return jax.tree_util.tree_map(from_jax, out)
+
+        return SymbolBlock(fn, params)
 
     def forward(self, *args: Any) -> Any:
         return self._fn(*args)

@@ -30,6 +30,13 @@ __all__ = ["invoke", "register_op", "get_op", "list_ops", "wrap_out"]
 # name -> {"fn": public python fn, "doc": ...}
 _OP_REGISTRY: Dict[str, Dict[str, Any]] = {}
 
+# flipped by mxnet_tpu.amp.init()/disable(); checked on the hot dispatch
+# path before importing the amp module at all
+_amp_state = {"active": False}
+
+# flipped by mxnet_tpu.profiler.set_state(); same hot-path pattern
+_profiler_state = {"on": False}
+
 
 def register_op(name: str, fn: Callable, doc: str = "") -> Callable:
     """Register a public op under ``name`` (NNVM_REGISTER_OP analog)."""
@@ -69,11 +76,26 @@ def invoke(name: str, impl: Callable, inputs: Sequence[Any],
     """
     arrays = [x._data for x in inputs]
 
+    if _amp_state["active"]:
+        from ..amp import apply_cast_policy
+        arrays = apply_cast_policy(name, arrays)
+
+    timer = None
+    if _profiler_state["on"]:
+        from ..profiler import op_timer
+        timer = op_timer(name)
+        if timer is not None:
+            timer.__enter__()
+
     record = is_recording() and any(x._on_tape for x in inputs)
-    if record:
-        outs, vjp_fn = jax.vjp(impl, *arrays)
-    else:
-        outs = impl(*arrays)
+    try:
+        if record:
+            outs, vjp_fn = jax.vjp(impl, *arrays)
+        else:
+            outs = impl(*arrays)
+    finally:
+        if timer is not None:
+            timer.__exit__()
 
     single = not isinstance(outs, (tuple, list))
     outs_t = (outs,) if single else tuple(outs)

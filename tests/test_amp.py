@@ -1,0 +1,127 @@
+"""AMP: cast policy, loss scaling, model conversion.
+
+Models the reference's tests/python/gpu/test_amp.py (cast insertion per
+lists, dynamic loss scaling skip-on-overflow, convert_model dtype checks).
+"""
+import numpy as onp
+import pytest
+
+import mxnet_tpu as mx
+from mxnet_tpu import amp, gluon, autograd
+from mxnet_tpu.gluon import nn
+from mxnet_tpu.test_utils import assert_almost_equal
+
+
+@pytest.fixture(autouse=True)
+def _amp_off_after():
+    yield
+    amp.disable()
+
+
+def test_cast_policy_target_and_fp32():
+    amp.init("bfloat16")
+    a = mx.nd.ones((4, 8), dtype="float32")
+    b = mx.nd.ones((8, 2), dtype="float32")
+    out = mx.nd.dot(a, b)
+    assert str(out.dtype) in ("bfloat16",)  # MXU op ran in bf16
+    s = mx.npx.softmax(out)
+    assert s.dtype == onp.float32  # fp32-list op upcast
+
+
+def test_widest_cast():
+    amp.init("bfloat16")
+    a = mx.nd.ones((3,), dtype="float32")
+    b = mx.nd.dot(mx.nd.ones((3, 3)), mx.nd.ones((3,)))  # bf16
+    out = a + b
+    assert out.dtype == onp.float32  # promoted to widest
+
+
+def test_amp_cast_ops():
+    x = mx.nd.ones((2, 2), dtype="float32")
+    y = amp.amp_cast(x, "bfloat16")
+    assert "bfloat16" in str(y.dtype)
+    outs = amp.amp_multicast(y, mx.nd.ones((2, 2), dtype="float32"))
+    assert all(o.dtype == onp.float32 for o in outs)
+
+
+def test_amp_cast_gradient_flows():
+    x = mx.nd.ones((3,), dtype="float32")
+    x.attach_grad()
+    with autograd.record():
+        y = (amp.amp_cast(x, "bfloat16") * 2).sum()
+    y.backward()
+    assert_almost_equal(x.grad.asnumpy(), 2 * onp.ones(3), rtol=1e-2,
+                        atol=1e-2)
+
+
+def test_training_with_amp_converges():
+    mx.random.seed(0)
+    amp.init("bfloat16")
+    net = nn.Dense(1)
+    net.initialize()
+    trainer = gluon.Trainer(net.collect_params(), "sgd",
+                            {"learning_rate": 0.1})
+    amp.init_trainer(trainer)
+    X = mx.nd.random.normal(shape=(64, 4))
+    w = mx.nd.array([[1.0], [2.0], [-1.0], [0.5]])
+    y = mx.nd.dot(X, w)
+    l2 = gluon.loss.L2Loss()
+    for _ in range(150):
+        with autograd.record():
+            loss = l2(net(X), y)
+            with amp.scale_loss(loss, trainer) as scaled:
+                pass
+        scaled.backward()
+        trainer.step(64)
+    final = float(loss.asnumpy().mean())
+    assert final < 1e-2, final
+
+
+def test_loss_scaler_overflow_skips_update():
+    net = nn.Dense(1, in_units=2)
+    net.initialize()
+    trainer = gluon.Trainer(net.collect_params(), "sgd",
+                            {"learning_rate": 0.5})
+    amp.init_trainer(trainer, init_scale=4.0)
+    w_before = net.weight.data().asnumpy().copy()
+    X = mx.nd.array([[1.0, 1.0]])
+    with autograd.record():
+        out = net(X) * float("inf")  # force non-finite grads
+        loss = out.sum()
+    loss.backward()
+    with pytest.warns(UserWarning, match="overflow"):
+        trainer.step(1)
+    assert_almost_equal(net.weight.data().asnumpy(), w_before)
+    assert trainer._amp_scaler.loss_scale == 2.0  # halved
+
+
+def test_scaler_grows_after_window():
+    s = amp.DynamicLossScaler(init_scale=8.0, scale_window=3)
+    for _ in range(3):
+        s.update_scale(False)
+    assert s.loss_scale == 16.0
+    s.update_scale(True)
+    assert s.loss_scale == 8.0
+
+
+def test_convert_model_keeps_norms_fp32():
+    net = nn.HybridSequential()
+    net.add(nn.Dense(8), nn.BatchNorm(), nn.Dense(2))
+    net.initialize()
+    net(mx.nd.ones((2, 4)))
+    amp.convert_model(net, "bfloat16")
+    for name, p in net.collect_params().items():
+        dt = str(p.data().dtype)
+        if "batchnorm" in name.lower() or "gamma" in name or "beta" in name:
+            assert dt == "float32", name
+        elif "dense" in name.lower():
+            assert "bfloat16" in dt, (name, dt)
+
+
+def test_scale_loss_requires_init_trainer():
+    net = nn.Dense(1, in_units=1)
+    net.initialize()
+    trainer = gluon.Trainer(net.collect_params(), "sgd")
+    with pytest.raises(mx.MXNetError, match="init_trainer"):
+        with amp.scale_loss(mx.nd.ones((1,)), trainer):
+            pass

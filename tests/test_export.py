@@ -1,0 +1,149 @@
+"""Export/import deploy format: HybridBlock.export -> SymbolBlock.imports.
+
+Models the reference's export/SymbolBlock reload equivalence tests in
+test_gluon.py (export -> prefix-symbol.json + prefix-0000.params ->
+reload -> identical outputs).
+"""
+import json
+import os
+
+import numpy as onp
+import pytest
+
+import mxnet_tpu as mx
+from mxnet_tpu import gluon
+from mxnet_tpu.gluon import nn
+from mxnet_tpu.test_utils import assert_almost_equal
+
+
+def _make_net():
+    net = nn.HybridSequential()
+    net.add(nn.Dense(16, activation="relu"),
+            nn.Dense(8, activation="tanh"),
+            nn.Dense(4))
+    net.initialize()
+    return net
+
+
+def test_export_roundtrip(tmp_path):
+    mx.random.seed(0)
+    net = _make_net()
+    x = mx.nd.random.normal(shape=(5, 12))
+    net.hybridize()
+    expected = net(x).asnumpy()
+
+    prefix = str(tmp_path / "model")
+    sym_file, param_file = net.export(prefix, epoch=3)
+    assert sym_file.endswith("-symbol.json")
+    assert param_file.endswith("-0003.params")
+    assert os.path.exists(sym_file) and os.path.exists(param_file)
+
+    loaded = gluon.SymbolBlock.imports(sym_file, ["data"], param_file)
+    got = loaded(x).asnumpy()
+    assert_almost_equal(got, expected, rtol=1e-5, atol=1e-6)
+
+
+def test_export_requires_signature(tmp_path):
+    net = _make_net()
+    with pytest.raises(mx.MXNetError, match="input signature"):
+        net.export(str(tmp_path / "m"))
+
+
+def test_export_explicit_signature(tmp_path):
+    net = _make_net()
+    x = mx.nd.random.normal(shape=(2, 6))
+    net(x)  # resolve deferred shapes
+    prefix = str(tmp_path / "m")
+    sym_file, param_file = net.export(prefix,
+                                      input_signature=[((2, 6), "float32")])
+    loaded = gluon.SymbolBlock.imports(sym_file, ["data"], param_file)
+    assert_almost_equal(loaded(x).asnumpy(), net(x).asnumpy(),
+                        rtol=1e-5, atol=1e-6)
+
+
+def test_symbol_json_metadata(tmp_path):
+    net = _make_net()
+    x = mx.nd.random.normal(shape=(3, 7))
+    net.hybridize()
+    net(x)
+    sym_file, _ = net.export(str(tmp_path / "meta"))
+    meta = json.load(open(sym_file))
+    assert meta["framework"] == "mxnet_tpu"
+    assert meta["inputs"][0]["shape"] == [3, 7]
+    assert meta["param_order"]
+    assert set(meta["param_order"]) == set(meta["params"])
+
+
+def test_imports_rejects_garbage(tmp_path):
+    bad = tmp_path / "bad-symbol.json"
+    bad.write_text(json.dumps({"something": "else"}))
+    with pytest.raises(mx.MXNetError, match="not an mxnet_tpu export"):
+        gluon.SymbolBlock.imports(str(bad), ["data"])
+
+
+def test_export_dropout_inference_mode(tmp_path):
+    """Exported programs run in inference mode: dropout is identity."""
+    net = nn.HybridSequential()
+    net.add(nn.Dense(8), nn.Dropout(0.5), nn.Dense(4))
+    net.initialize()
+    x = mx.nd.random.normal(shape=(4, 6))
+    net.hybridize()
+    net(x)
+    sym_file, param_file = net.export(str(tmp_path / "d"))
+    loaded = gluon.SymbolBlock.imports(sym_file, ["data"], param_file)
+    a = loaded(x).asnumpy()
+    b = loaded(x).asnumpy()
+    assert_almost_equal(a, b)  # deterministic despite dropout layer
+
+
+def test_imports_without_params_raises(tmp_path):
+    net = _make_net()
+    x = mx.nd.random.normal(shape=(2, 5))
+    net.hybridize()
+    net(x)
+    sym_file, _ = net.export(str(tmp_path / "np"))
+    with pytest.raises(mx.MXNetError, match="param_file"):
+        gluon.SymbolBlock.imports(sym_file, ["data"])
+
+
+def test_export_dict_output_structure(tmp_path):
+    class DictNet(gluon.HybridBlock):
+        def __init__(self):
+            super().__init__()
+            self.d = nn.Dense(3)
+
+        def forward(self, x):
+            out = self.d(x)
+            return {"logits": out, "pair": (out * 2, out + 1)}
+
+    net = DictNet()
+    net.initialize()
+    x = mx.nd.random.normal(shape=(2, 4))
+    net.hybridize()
+    expected = net(x)
+    sym_file, param_file = net.export(str(tmp_path / "dict"))
+    loaded = gluon.SymbolBlock.imports(sym_file, ["data"], param_file)
+    got = loaded(x)
+    assert set(got) == {"logits", "pair"}
+    assert_almost_equal(got["logits"].asnumpy(),
+                        expected["logits"].asnumpy(), rtol=1e-5, atol=1e-6)
+    assert_almost_equal(got["pair"][1].asnumpy(),
+                        expected["pair"][1].asnumpy(), rtol=1e-5, atol=1e-6)
+
+
+def test_hybridize_cache_respects_amp_toggle():
+    from mxnet_tpu import amp
+    import numpy as onp2
+    net = _make_net()
+    x = mx.nd.random.normal(shape=(4, 6))
+    net.hybridize()
+    out_fp32 = net(x)
+    assert out_fp32.dtype == onp2.float32
+    try:
+        amp.init("bfloat16")
+        out_amp = net(x)  # must re-trace under the amp policy
+        assert "bfloat16" in str(out_amp.dtype)
+    finally:
+        amp.disable()
+    out_back = net(x)
+    assert out_back.dtype == onp2.float32
