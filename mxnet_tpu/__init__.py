@@ -51,6 +51,8 @@ _LAZY = {
     "lr_scheduler": ".lr_scheduler",
     "callback": ".callback",
     "model": ".model",
+    "mod": ".module",
+    "module": ".module",
 }
 
 

@@ -1,0 +1,95 @@
+"""Training callbacks (reference: ``python/mxnet/callback.py``).
+
+``Speedometer`` prints samples/sec every N batches — the number the
+BASELINE configs report (SURVEY.md section 5.5).
+"""
+from __future__ import annotations
+
+import logging
+import time
+from typing import Any, Callable, Optional
+
+__all__ = ["Speedometer", "do_checkpoint", "log_train_metric",
+           "ProgressBar", "module_checkpoint"]
+
+
+class Speedometer:
+    """Log throughput + metrics every ``frequent`` batches."""
+
+    def __init__(self, batch_size: int, frequent: int = 50,
+                 auto_reset: bool = True) -> None:
+        self.batch_size = batch_size
+        self.frequent = frequent
+        self.auto_reset = auto_reset
+        self.init = False
+        self.tic = 0.0
+        self.last_count = 0
+
+    def __call__(self, param: Any) -> None:
+        count = param.nbatch
+        if self.last_count > count:
+            self.init = False
+        self.last_count = count
+        if self.init:
+            if count % self.frequent == 0:
+                speed = self.frequent * self.batch_size / \
+                    (time.time() - self.tic)
+                if param.eval_metric is not None:
+                    name_value = param.eval_metric.get_name_value()
+                    if self.auto_reset:
+                        param.eval_metric.reset()
+                    msg = "Epoch[%d] Batch [%d]\tSpeed: %.2f samples/sec\t%s"
+                    metrics = "\t".join(f"{n}={v:.6f}" for n, v in name_value)
+                    logging.info(msg, param.epoch, count, speed, metrics)
+                else:
+                    logging.info(
+                        "Epoch[%d] Batch [%d]\tSpeed: %.2f samples/sec",
+                        param.epoch, count, speed)
+                self.tic = time.time()
+        else:
+            self.init = True
+            self.tic = time.time()
+
+
+def do_checkpoint(prefix: str, period: int = 1) -> Callable:
+    """Epoch-end callback saving module checkpoints every ``period``."""
+    period = int(max(1, period))
+
+    def _callback(iter_no: int, sym: Any = None, arg: Any = None,
+                  aux: Any = None) -> None:
+        if (iter_no + 1) % period == 0:
+            from .model import save_checkpoint
+            save_checkpoint(prefix, iter_no + 1, sym, arg, aux or {})
+
+    return _callback
+
+
+module_checkpoint = do_checkpoint
+
+
+def log_train_metric(period: int, auto_reset: bool = False) -> Callable:
+    def _callback(param: Any) -> None:
+        if param.nbatch % period == 0 and param.eval_metric is not None:
+            name_value = param.eval_metric.get_name_value()
+            metrics = "\t".join(f"{n}={v:.6f}" for n, v in name_value)
+            logging.info("Iter[%d] Batch[%d] Train-%s",
+                         param.epoch, param.nbatch, metrics)
+            if auto_reset:
+                param.eval_metric.reset()
+
+    return _callback
+
+
+class ProgressBar:
+    """Text progress bar batch callback."""
+
+    def __init__(self, total: int, length: int = 80) -> None:
+        self.total = total
+        self.length = length
+
+    def __call__(self, param: Any) -> None:
+        count = param.nbatch
+        filled = int(round(self.length * count / float(self.total)))
+        pct = round(100.0 * count / float(self.total), 1)
+        bar = "=" * filled + "-" * (self.length - filled)
+        print(f"[{bar}] {pct}%", end="\r")

@@ -1,0 +1,5 @@
+"""gluon.contrib — estimator and experimental blocks (reference:
+``python/mxnet/gluon/contrib/``)."""
+from . import estimator
+
+__all__ = ["estimator"]

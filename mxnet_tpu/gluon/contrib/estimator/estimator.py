@@ -1,0 +1,141 @@
+"""Estimator fit loop.
+
+Reference parity (leezu/mxnet): ``python/mxnet/gluon/contrib/estimator/
+estimator.py`` — ``Estimator(net, loss, train_metrics, trainer).fit(
+train_data, val_data, epochs, event_handlers)``.
+"""
+from __future__ import annotations
+
+import logging
+from typing import Any, List, Optional, Sequence, Union
+
+from .... import autograd
+from ....base import MXNetError
+from ....context import current_context
+from ....metric import EvalMetric, Loss as LossMetric, create as metric_create
+from ....ndarray.ndarray import NDArray
+from ...trainer import Trainer
+from .event_handler import (BatchBegin, BatchEnd, EpochBegin, EpochEnd,
+                            LoggingHandler, MetricHandler, StoppingHandler,
+                            TrainBegin, TrainEnd, ValidationHandler)
+
+__all__ = ["Estimator"]
+
+
+def _as_nd(x: Any) -> NDArray:
+    return x if isinstance(x, NDArray) else NDArray(x)
+
+
+class Estimator:
+    """High-level train facility over a gluon block."""
+
+    def __init__(self, net: Any, loss: Any,
+                 train_metrics: Any = None,
+                 trainer: Optional[Trainer] = None,
+                 context: Any = None,
+                 val_metrics: Any = None) -> None:
+        self.net = net
+        self.loss = loss
+        self.context = context or current_context()
+        self.logger = logging.getLogger("mxnet_tpu.estimator")
+        self.logger.setLevel(logging.INFO)
+
+        def _norm_metrics(m: Any) -> List[EvalMetric]:
+            if m is None:
+                return []
+            if isinstance(m, (list, tuple)):
+                return [mm if isinstance(mm, EvalMetric)
+                        else metric_create(mm) for mm in m]
+            return [m if isinstance(m, EvalMetric) else metric_create(m)]
+
+        self.train_metrics = _norm_metrics(train_metrics)
+        self.val_metrics = _norm_metrics(val_metrics) or \
+            [type(m)() for m in self.train_metrics]
+        self.train_loss_metric = LossMetric(name="train_loss")
+        self.val_loss_metric = LossMetric(name="val_loss")
+
+        if trainer is None:
+            params = net.collect_params()
+            trainer = Trainer(params, "adam", {"learning_rate": 1e-3})
+        self.trainer = trainer
+        self.max_epoch: Optional[int] = None
+        self.max_batch: Optional[int] = None
+
+    # ------------------------------------------------------------------
+    def evaluate(self, val_data: Any = None) -> None:
+        if val_data is None:
+            return
+        for m in self.val_metrics:
+            m.reset()
+        self.val_loss_metric.reset()
+        for batch in val_data:
+            data, label = _as_nd(batch[0]), _as_nd(batch[1])
+            pred = self.net(data)
+            loss = self.loss(pred, label)
+            self.val_loss_metric.update(0, loss)
+            for m in self.val_metrics:
+                m.update([label], [pred])
+
+    def _default_handlers(self, val_data: Any) -> list:
+        handlers: list = [StoppingHandler(self.max_epoch, self.max_batch),
+                          MetricHandler([self.train_loss_metric]
+                                        + self.train_metrics)]
+        if val_data is not None:
+            handlers.append(ValidationHandler(val_data, self.evaluate))
+        handlers.append(LoggingHandler(
+            metrics=[self.train_loss_metric] + self.train_metrics))
+        return handlers
+
+    def fit(self, train_data: Any, val_data: Any = None,
+            epochs: Optional[int] = None,
+            event_handlers: Optional[Sequence[Any]] = None,
+            batches: Optional[int] = None) -> None:
+        if epochs is None and batches is None:
+            raise MXNetError("fit: specify epochs or batches")
+        self.max_epoch = epochs
+        self.max_batch = batches
+
+        handlers = list(event_handlers or [])
+        existing = {type(h) for h in handlers}
+        for h in self._default_handlers(val_data):
+            if type(h) not in existing:
+                handlers.append(h)
+        handlers.sort(key=lambda h: getattr(h, "priority", 0))
+
+        train_begin = [h for h in handlers if isinstance(h, TrainBegin)]
+        epoch_begin = [h for h in handlers if isinstance(h, EpochBegin)]
+        batch_begin = [h for h in handlers if isinstance(h, BatchBegin)]
+        batch_end = [h for h in handlers if isinstance(h, BatchEnd)]
+        epoch_end = [h for h in handlers if isinstance(h, EpochEnd)]
+        train_end = [h for h in handlers if isinstance(h, TrainEnd)]
+
+        for h in train_begin:
+            h.train_begin(self)
+
+        stop = False
+        while not stop:
+            for h in epoch_begin:
+                h.epoch_begin(self)
+            for batch in train_data:
+                data, label = _as_nd(batch[0]), _as_nd(batch[1])
+                for h in batch_begin:
+                    h.batch_begin(self, batch=batch)
+                with autograd.record():
+                    pred = self.net(data)
+                    loss = self.loss(pred, label)
+                loss.backward()
+                self.trainer.step(data.shape[0])
+                for h in batch_end:
+                    if h.batch_end(self, batch=batch, pred=pred,
+                                   label=label, loss=loss):
+                        stop = True
+                if stop:
+                    break
+            for h in epoch_end:
+                if h.epoch_end(self):
+                    stop = True
+            if self.max_epoch is None and self.max_batch is None:
+                break
+
+        for h in train_end:
+            h.train_end(self)

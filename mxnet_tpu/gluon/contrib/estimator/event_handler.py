@@ -1,0 +1,281 @@
+"""Estimator event handlers.
+
+Reference parity (leezu/mxnet): ``python/mxnet/gluon/contrib/estimator/
+event_handler.py`` — mixin interfaces (TrainBegin/TrainEnd/EpochBegin/
+EpochEnd/BatchBegin/BatchEnd) and the stock handlers (stopping, metric,
+validation, logging, checkpoint, early stopping).
+"""
+from __future__ import annotations
+
+import logging
+import os
+import time
+import warnings
+from typing import Any, List, Optional
+
+__all__ = ["TrainBegin", "TrainEnd", "EpochBegin", "EpochEnd", "BatchBegin",
+           "BatchEnd", "StoppingHandler", "MetricHandler",
+           "ValidationHandler", "LoggingHandler", "CheckpointHandler",
+           "EarlyStoppingHandler"]
+
+
+class TrainBegin:
+    def train_begin(self, estimator: Any, *args: Any, **kwargs: Any) -> None:
+        pass
+
+
+class TrainEnd:
+    def train_end(self, estimator: Any, *args: Any, **kwargs: Any) -> None:
+        pass
+
+
+class EpochBegin:
+    def epoch_begin(self, estimator: Any, *args: Any, **kwargs: Any) -> None:
+        pass
+
+
+class EpochEnd:
+    def epoch_end(self, estimator: Any, *args: Any, **kwargs: Any) -> bool:
+        return False
+
+
+class BatchBegin:
+    def batch_begin(self, estimator: Any, *args: Any, **kwargs: Any) -> None:
+        pass
+
+
+class BatchEnd:
+    def batch_end(self, estimator: Any, *args: Any, **kwargs: Any) -> bool:
+        return False
+
+
+class StoppingHandler(TrainBegin, BatchEnd, EpochEnd):
+    """Stop on max_epoch / max_batch."""
+
+    def __init__(self, max_epoch: Optional[int] = None,
+                 max_batch: Optional[int] = None) -> None:
+        self.max_epoch = max_epoch
+        self.max_batch = max_batch
+        self.current_batch = 0
+        self.current_epoch = 0
+        self.stop_training = False
+
+    def train_begin(self, estimator: Any, *args: Any, **kwargs: Any) -> None:
+        self.max_epoch = estimator.max_epoch
+        self.max_batch = estimator.max_batch
+        self.current_batch = 0
+        self.current_epoch = 0
+
+    def batch_end(self, estimator: Any, *args: Any, **kwargs: Any) -> bool:
+        self.current_batch += 1
+        if self.max_batch and self.current_batch == self.max_batch:
+            self.stop_training = True
+        return self.stop_training
+
+    def epoch_end(self, estimator: Any, *args: Any, **kwargs: Any) -> bool:
+        self.current_epoch += 1
+        if self.max_epoch and self.current_epoch == self.max_epoch:
+            self.stop_training = True
+        return self.stop_training
+
+
+class MetricHandler(EpochBegin, BatchEnd):
+    """Reset metrics at epoch start; update after each batch."""
+
+    def __init__(self, metrics: List[Any], priority: int = -1000) -> None:
+        self.metrics = metrics
+        self.priority = priority
+
+    def epoch_begin(self, estimator: Any, *args: Any, **kwargs: Any) -> None:
+        for m in self.metrics:
+            m.reset()
+
+    def batch_end(self, estimator: Any, *args: Any, **kwargs: Any) -> bool:
+        pred = kwargs.get("pred")
+        label = kwargs.get("label")
+        loss = kwargs.get("loss")
+        for m in self.metrics:
+            name = m.get()[0] if not isinstance(m.get()[0], list) else ""
+            if "loss" in str(name):
+                m.update(0, loss)
+            else:
+                m.update([label], [pred])
+        return False
+
+
+class ValidationHandler(TrainBegin, BatchEnd, EpochEnd):
+    """Run validation every ``epoch_period`` epochs (or batch_period)."""
+
+    def __init__(self, val_data: Any, eval_fn: Any,
+                 epoch_period: int = 1,
+                 batch_period: Optional[int] = None,
+                 priority: int = -1000) -> None:
+        self.val_data = val_data
+        self.eval_fn = eval_fn
+        self.epoch_period = epoch_period
+        self.batch_period = batch_period
+        self.priority = priority
+        self.current_batch = 0
+        self.current_epoch = 0
+
+    def train_begin(self, estimator: Any, *args: Any, **kwargs: Any) -> None:
+        self.current_batch = 0
+        self.current_epoch = 0
+
+    def batch_end(self, estimator: Any, *args: Any, **kwargs: Any) -> bool:
+        self.current_batch += 1
+        if self.batch_period and self.current_batch % self.batch_period == 0:
+            self.eval_fn(val_data=self.val_data)
+        return False
+
+    def epoch_end(self, estimator: Any, *args: Any, **kwargs: Any) -> bool:
+        self.current_epoch += 1
+        if self.epoch_period and self.current_epoch % self.epoch_period == 0:
+            self.eval_fn(val_data=self.val_data)
+        return False
+
+
+class LoggingHandler(TrainBegin, TrainEnd, EpochBegin, EpochEnd, BatchEnd):
+    """Log metrics per epoch (and optionally every N batches)."""
+
+    def __init__(self, log_interval: Any = "epoch",
+                 metrics: Optional[List[Any]] = None,
+                 priority: int = float("inf")) -> None:
+        self.log_interval = log_interval
+        self.metrics = metrics or []
+        self.priority = priority
+        self.batch_index = 0
+        self.current_epoch = 0
+        self.processed_samples = 0
+
+    def train_begin(self, estimator: Any, *args: Any, **kwargs: Any) -> None:
+        self.train_start = time.time()
+        estimator.logger.info("Training begin: using optimizer %s with lr %s",
+                              type(estimator.trainer.optimizer).__name__,
+                              estimator.trainer.learning_rate)
+
+    def train_end(self, estimator: Any, *args: Any, **kwargs: Any) -> None:
+        estimator.logger.info("Train finished in %.3fs",
+                              time.time() - self.train_start)
+
+    def epoch_begin(self, estimator: Any, *args: Any, **kwargs: Any) -> None:
+        self.epoch_start = time.time()
+        self.batch_index = 0
+
+    def epoch_end(self, estimator: Any, *args: Any, **kwargs: Any) -> bool:
+        msg = f"[Epoch {self.current_epoch}] finished in " \
+              f"{time.time() - self.epoch_start:.3f}s: "
+        for m in self.metrics:
+            name, value = m.get()
+            msg += f"{name}: {value:.4f} "
+        estimator.logger.info(msg)
+        self.current_epoch += 1
+        return False
+
+    def batch_end(self, estimator: Any, *args: Any, **kwargs: Any) -> bool:
+        if isinstance(self.log_interval, int):
+            self.batch_index += 1
+            if self.batch_index % self.log_interval == 0:
+                msg = f"[Epoch {self.current_epoch}][Batch " \
+                      f"{self.batch_index}] "
+                for m in self.metrics:
+                    name, value = m.get()
+                    msg += f"{name}: {value:.4f} "
+                estimator.logger.info(msg)
+        return False
+
+
+class CheckpointHandler(TrainBegin, BatchEnd, EpochEnd):
+    """Save model (and trainer) per epoch; keeps best by monitored metric."""
+
+    def __init__(self, model_dir: str, model_prefix: str = "model",
+                 monitor: Any = None, verbose: int = 0,
+                 save_best: bool = False, mode: str = "auto",
+                 epoch_period: int = 1,
+                 max_checkpoints: int = 5) -> None:
+        self.model_dir = model_dir
+        self.model_prefix = model_prefix
+        self.monitor = monitor
+        self.save_best = save_best
+        self.epoch_period = epoch_period
+        self.max_checkpoints = max_checkpoints
+        self.current_epoch = 0
+        self.best = None
+        self.mode = mode
+        os.makedirs(model_dir, exist_ok=True)
+
+    def _is_better(self, value: float) -> bool:
+        if self.best is None:
+            return True
+        if self.mode == "min":
+            return value < self.best
+        if self.mode == "max":
+            return value > self.best
+        # auto: loss/error -> min else max
+        name = self.monitor.get()[0] if self.monitor else ""
+        minimize = any(t in str(name) for t in ("loss", "error"))
+        return value < self.best if minimize else value > self.best
+
+    def epoch_end(self, estimator: Any, *args: Any, **kwargs: Any) -> bool:
+        self.current_epoch += 1
+        if self.current_epoch % self.epoch_period != 0:
+            return False
+        prefix = os.path.join(self.model_dir, self.model_prefix)
+        estimator.net.save_parameters(
+            f"{prefix}-epoch{self.current_epoch}.params")
+        if estimator.trainer is not None:
+            estimator.trainer.save_states(
+                f"{prefix}-epoch{self.current_epoch}.states")
+        if self.save_best and self.monitor is not None:
+            _, value = self.monitor.get()
+            if self._is_better(value):
+                self.best = value
+                estimator.net.save_parameters(f"{prefix}-best.params")
+        return False
+
+
+class EarlyStoppingHandler(TrainBegin, EpochEnd, TrainEnd):
+    """Stop when the monitored metric stops improving."""
+
+    def __init__(self, monitor: Any, min_delta: float = 0.0,
+                 patience: int = 0, mode: str = "auto",
+                 baseline: Optional[float] = None) -> None:
+        self.monitor = monitor
+        self.min_delta = min_delta
+        self.patience = patience
+        self.mode = mode
+        self.baseline = baseline
+        self.wait = 0
+        self.best: Optional[float] = None
+        self.stopped_epoch = 0
+        self.current_epoch = 0
+        self.stop_training = False
+
+    def _improved(self, value: float) -> bool:
+        if self.best is None:
+            return True
+        name = str(self.monitor.get()[0])
+        if self.mode == "min" or (self.mode == "auto" and
+                                  any(t in name for t in ("loss", "error"))):
+            return value < self.best - self.min_delta
+        return value > self.best + self.min_delta
+
+    def epoch_end(self, estimator: Any, *args: Any, **kwargs: Any) -> bool:
+        _, value = self.monitor.get()
+        self.current_epoch += 1
+        if self.baseline is not None and self.best is None:
+            self.best = self.baseline
+        if self._improved(value):
+            self.best = value
+            self.wait = 0
+        else:
+            self.wait += 1
+            if self.wait >= self.patience:
+                self.stopped_epoch = self.current_epoch
+                self.stop_training = True
+        return self.stop_training
+
+    def train_end(self, estimator: Any, *args: Any, **kwargs: Any) -> None:
+        if self.stopped_epoch > 0:
+            estimator.logger.info("Early stopping at epoch %d",
+                                  self.stopped_epoch)

@@ -1,0 +1,70 @@
+"""Checkpoint helpers (reference: ``python/mxnet/model.py`` —
+``save_checkpoint``/``load_checkpoint``: per-epoch params + architecture).
+
+The reference saved ``prefix-symbol.json`` + ``prefix-%04d.params`` with
+``arg:``/``aux:`` key prefixes; this build keeps the same file naming and
+key-prefix convention over the mxnet_tpu ``.params`` container so Module
+checkpoints round-trip by name.
+"""
+from __future__ import annotations
+
+import json
+import os
+from typing import Any, Dict, Optional, Tuple
+
+from .base import MXNetError
+from .ndarray.ndarray import NDArray
+from .ndarray_io import load_params, save_params
+
+__all__ = ["save_checkpoint", "load_checkpoint", "BatchEndParam"]
+
+
+class BatchEndParam:
+    """Carrier passed to batch/epoch callbacks (reference namedtuple)."""
+
+    def __init__(self, epoch: int, nbatch: int, eval_metric: Any,
+                 locals: Any = None) -> None:  # noqa: A002
+        self.epoch = epoch
+        self.nbatch = nbatch
+        self.eval_metric = eval_metric
+        self.locals = locals
+
+
+def save_checkpoint(prefix: str, epoch: int, symbol: Any,
+                    arg_params: Dict[str, NDArray],
+                    aux_params: Dict[str, NDArray]) -> None:
+    """Save ``prefix-symbol.json`` (architecture metadata) +
+    ``prefix-{epoch:04d}.params`` (arg:/aux:-prefixed tensors)."""
+    if symbol is not None:
+        meta = {"framework": "mxnet_tpu", "kind": "module_checkpoint",
+                "block": type(symbol).__name__}
+        with open(f"{prefix}-symbol.json", "w") as f:
+            json.dump(meta, f)
+    payload = {f"arg:{k}": v for k, v in (arg_params or {}).items()}
+    payload.update({f"aux:{k}": v for k, v in (aux_params or {}).items()})
+    save_params(f"{prefix}-{epoch:04d}.params", payload)
+
+
+def load_checkpoint(prefix: str, epoch: int
+                    ) -> Tuple[Optional[dict], Dict[str, NDArray],
+                               Dict[str, NDArray]]:
+    """Load a checkpoint; returns (symbol_meta, arg_params, aux_params)."""
+    sym_meta = None
+    sym_file = f"{prefix}-symbol.json"
+    if os.path.exists(sym_file):
+        with open(sym_file) as f:
+            sym_meta = json.load(f)
+    fname = f"{prefix}-{epoch:04d}.params"
+    if not os.path.exists(fname):
+        raise MXNetError(f"checkpoint {fname} does not exist")
+    loaded = load_params(fname)
+    arg_params: Dict[str, NDArray] = {}
+    aux_params: Dict[str, NDArray] = {}
+    for k, v in loaded.items():
+        if k.startswith("arg:"):
+            arg_params[k[4:]] = v
+        elif k.startswith("aux:"):
+            aux_params[k[4:]] = v
+        else:
+            arg_params[k] = v
+    return sym_meta, arg_params, aux_params

@@ -1,0 +1,8 @@
+"""``mx.mod`` — the legacy Module training API.
+
+Reference parity: ``python/mxnet/module/`` (BaseModule.fit epoch loop,
+Module bind/init/forward/backward/update, BucketingModule).
+"""
+from .module import BaseModule, Module, BucketingModule
+
+__all__ = ["BaseModule", "Module", "BucketingModule"]

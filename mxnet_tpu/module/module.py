@@ -1,0 +1,419 @@
+"""Module — the legacy symbolic-style trainer.
+
+Reference parity (leezu/mxnet): ``python/mxnet/module/base_module.py``
+(``BaseModule.fit`` epoch loop), ``module.py`` (bind / init_params /
+init_optimizer / forward / backward / update / predict / score /
+save_checkpoint), ``bucketing_module.py`` (per-bucket executors sharing
+weights — the era's variable-length answer).
+
+Design (tpu-first): the reference's Symbol is replaced by a gluon
+(Hybrid)Block plus a loss — under XLA the "symbolic executor" and the
+hybridized block are the same compiled-program machinery, so Module is a
+thin training harness over Block + Trainer. BucketingModule exploits the
+jit cache directly: one shared block, per-shape executables appear
+automatically per bucket key (the reference needed explicit per-length
+executor groups, ``DataParallelExecutorGroup``).
+"""
+from __future__ import annotations
+
+import logging
+from typing import Any, Callable, Dict, List, Optional, Sequence, Tuple, Union
+
+import numpy as _np
+
+from .. import autograd
+from ..base import MXNetError
+from ..context import Context, cpu, current_context
+from ..io.io import DataBatch, DataDesc
+from ..metric import EvalMetric, create as metric_create
+from ..model import BatchEndParam, load_checkpoint, save_checkpoint
+from ..ndarray.ndarray import NDArray
+
+__all__ = ["BaseModule", "Module", "BucketingModule"]
+
+
+def _as_list(x: Any) -> list:
+    if x is None:
+        return []
+    if isinstance(x, (list, tuple)):
+        return list(x)
+    return [x]
+
+
+class BaseModule:
+    """Shared fit/score/predict loops (reference ``BaseModule``)."""
+
+    def __init__(self, logger: Any = logging) -> None:
+        self.logger = logger
+        self.binded = False
+        self.params_initialized = False
+        self.optimizer_initialized = False
+
+    # subclass interface ----------------------------------------------------
+    def forward(self, data_batch: DataBatch, is_train: Optional[bool] = None
+                ) -> None:
+        raise NotImplementedError
+
+    def backward(self) -> None:
+        raise NotImplementedError
+
+    def update(self) -> None:
+        raise NotImplementedError
+
+    def get_outputs(self) -> List[NDArray]:
+        raise NotImplementedError
+
+    def update_metric(self, eval_metric: EvalMetric,
+                      labels: Sequence[NDArray]) -> None:
+        raise NotImplementedError
+
+    # shared loops ----------------------------------------------------------
+    def forward_backward(self, data_batch: DataBatch) -> None:
+        self.forward(data_batch, is_train=True)
+        self.backward()
+
+    def score(self, eval_data: Any, eval_metric: Union[str, EvalMetric],
+              num_batch: Optional[int] = None, reset: bool = True,
+              epoch: int = 0, batch_end_callback: Any = None) -> list:
+        if not isinstance(eval_metric, EvalMetric):
+            eval_metric = metric_create(eval_metric)
+        if reset:
+            eval_data.reset()
+        eval_metric.reset()
+        for nbatch, batch in enumerate(eval_data):
+            if num_batch is not None and nbatch == num_batch:
+                break
+            self.forward(batch, is_train=False)
+            self.update_metric(eval_metric, batch.label)
+            for cb in _as_list(batch_end_callback):
+                cb(BatchEndParam(epoch, nbatch, eval_metric))
+        return eval_metric.get_name_value()
+
+    def predict(self, eval_data: Any, num_batch: Optional[int] = None,
+                reset: bool = True) -> Union[NDArray, List[NDArray]]:
+        if reset:
+            eval_data.reset()
+        outputs: List[List[NDArray]] = []
+        for nbatch, batch in enumerate(eval_data):
+            if num_batch is not None and nbatch == num_batch:
+                break
+            self.forward(batch, is_train=False)
+            outs = self.get_outputs()
+            pad = batch.pad or 0
+            if pad:
+                outs = [o[:o.shape[0] - pad] for o in outs]
+            outputs.append(outs)
+        if not outputs:
+            return []
+        n_out = len(outputs[0])
+        from ..ndarray.ops import concatenate
+        cat = [concatenate([row[i] for row in outputs], axis=0)
+               for i in range(n_out)]
+        return cat[0] if n_out == 1 else cat
+
+    def fit(self, train_data: Any, eval_data: Any = None,
+            eval_metric: Union[str, EvalMetric] = "acc",
+            epoch_end_callback: Any = None, batch_end_callback: Any = None,
+            kvstore: str = "local", optimizer: str = "sgd",
+            optimizer_params: Optional[dict] = None,
+            eval_end_callback: Any = None,
+            eval_batch_end_callback: Any = None,
+            initializer: Any = None, arg_params: Optional[dict] = None,
+            aux_params: Optional[dict] = None,
+            allow_missing: bool = False, force_init: bool = False,
+            begin_epoch: int = 0, num_epoch: Optional[int] = None,
+            validation_metric: Any = None, monitor: Any = None) -> None:
+        """The classic epoch loop (reference ``BaseModule.fit``)."""
+        if num_epoch is None:
+            raise MXNetError("fit: num_epoch must be given")
+        if not self.binded:
+            self.bind(data_shapes=train_data.provide_data,
+                      label_shapes=train_data.provide_label, for_training=True)
+        if not self.params_initialized or force_init:
+            self.init_params(initializer=initializer, arg_params=arg_params,
+                             aux_params=aux_params,
+                             allow_missing=allow_missing,
+                             force_init=force_init)
+        if not self.optimizer_initialized:
+            self.init_optimizer(kvstore=kvstore, optimizer=optimizer,
+                                optimizer_params=optimizer_params)
+        if not isinstance(eval_metric, EvalMetric):
+            eval_metric = metric_create(eval_metric)
+        validation_metric = validation_metric or eval_metric
+
+        for epoch in range(begin_epoch, num_epoch):
+            eval_metric.reset()
+            train_data.reset()
+            for nbatch, data_batch in enumerate(train_data):
+                self.forward_backward(data_batch)
+                self.update()
+                self.update_metric(eval_metric, data_batch.label)
+                for cb in _as_list(batch_end_callback):
+                    cb(BatchEndParam(epoch, nbatch, eval_metric))
+            for name, val in eval_metric.get_name_value():
+                self.logger.info("Epoch[%d] Train-%s=%f", epoch, name, val)
+            arg, aux = self.get_params()
+            for cb in _as_list(epoch_end_callback):
+                cb(epoch, self.symbol, arg, aux)
+            if eval_data is not None:
+                res = self.score(eval_data, validation_metric,
+                                 epoch=epoch + 1,
+                                 batch_end_callback=eval_batch_end_callback)
+                for name, val in res:
+                    self.logger.info("Epoch[%d] Validation-%s=%f",
+                                     epoch, name, val)
+
+
+class Module(BaseModule):
+    """Train a block through the classic Module workflow.
+
+    ``symbol`` is a gluon (Hybrid)Block producing network outputs;
+    ``loss`` maps (output, label) -> per-sample loss (defaults to softmax
+    cross-entropy, the reference's ``SoftmaxOutput`` head).
+    """
+
+    def __init__(self, symbol: Any, data_names: Sequence[str] = ("data",),
+                 label_names: Sequence[str] = ("softmax_label",),
+                 logger: Any = logging,
+                 context: Optional[Union[Context, Sequence[Context]]] = None,
+                 loss: Any = None) -> None:
+        super().__init__(logger)
+        self._block = symbol
+        self._data_names = list(data_names)
+        self._label_names = list(label_names or [])
+        ctxs = context if context is not None else [current_context()]
+        self._contexts = list(ctxs) if isinstance(ctxs, (list, tuple)) \
+            else [ctxs]
+        if loss is None:
+            from ..gluon.loss import SoftmaxCrossEntropyLoss
+            loss = SoftmaxCrossEntropyLoss()
+        self._loss_fn = loss
+        self._trainer = None
+        self._outputs: List[NDArray] = []
+        self._loss_val: Optional[NDArray] = None
+        self._cur_batch_size = 0
+
+    # -- binding / params ---------------------------------------------------
+    @property
+    def symbol(self) -> Any:
+        return self._block
+
+    def bind(self, data_shapes: Any, label_shapes: Any = None,
+             for_training: bool = True, inputs_need_grad: bool = False,
+             force_rebind: bool = False, **kwargs: Any) -> None:
+        if self.binded and not force_rebind:
+            return
+        self._data_shapes = data_shapes
+        self._label_shapes = label_shapes
+        self.binded = True
+
+    def init_params(self, initializer: Any = None, arg_params: Any = None,
+                    aux_params: Any = None, allow_missing: bool = False,
+                    force_init: bool = False, allow_extra: bool = False
+                    ) -> None:
+        if not self.binded:
+            raise MXNetError("call bind before init_params")
+        self._block.initialize(init=initializer, ctx=self._contexts[0],
+                               force_reinit=force_init)
+        # materialize deferred shapes with one dummy forward
+        dummies = []
+        for desc in self._data_shapes:
+            shape = tuple(desc.shape) if hasattr(desc, "shape") else \
+                tuple(desc[1])
+            dtype = getattr(desc, "dtype", _np.float32)
+            dummies.append(NDArray(_np.zeros(shape, dtype=dtype)))
+        self._block(*dummies)
+        if arg_params or aux_params:
+            merged = dict(arg_params or {})
+            merged.update(aux_params or {})
+            params = self._block.collect_params()
+            for k, v in merged.items():
+                if k in params:
+                    params[k].set_data(v)
+                elif not allow_extra:
+                    raise MXNetError(f"init_params: unknown param {k!r}")
+        self.params_initialized = True
+
+    def get_params(self) -> Tuple[Dict[str, NDArray], Dict[str, NDArray]]:
+        arg: Dict[str, NDArray] = {}
+        aux: Dict[str, NDArray] = {}
+        for name, p in self._block.collect_params().items():
+            if not p.is_initialized:
+                continue
+            (aux if p.grad_req == "null" else arg)[name] = p.data()
+        return arg, aux
+
+    def set_params(self, arg_params: dict, aux_params: dict,
+                   allow_missing: bool = False, force_init: bool = True,
+                   allow_extra: bool = False) -> None:
+        self.init_params(arg_params=arg_params, aux_params=aux_params,
+                         allow_missing=allow_missing, force_init=False,
+                         allow_extra=allow_extra)
+
+    def init_optimizer(self, kvstore: str = "local", optimizer: str = "sgd",
+                       optimizer_params: Optional[dict] = None,
+                       force_init: bool = False) -> None:
+        if not self.params_initialized:
+            raise MXNetError("call init_params before init_optimizer")
+        from ..gluon.trainer import Trainer
+        self._trainer = Trainer(self._block.collect_params(), optimizer,
+                                optimizer_params or {"learning_rate": 0.01},
+                                kvstore=kvstore)
+        self.optimizer_initialized = True
+
+    # -- execution ----------------------------------------------------------
+    def forward(self, data_batch: DataBatch,
+                is_train: Optional[bool] = None) -> None:
+        data = [d if isinstance(d, NDArray) else NDArray(d)
+                for d in _as_list(data_batch.data)]
+        labels = [l if isinstance(l, NDArray) else NDArray(l)
+                  for l in _as_list(data_batch.label)]
+        is_train = self.binded if is_train is None else is_train
+        self._cur_batch_size = data[0].shape[0] if data else 0
+        if is_train:
+            with autograd.record():
+                out = self._block(*data)
+                outs = _as_list(out)
+                if labels:
+                    loss = self._loss_fn(outs[0], *labels)
+                    self._loss_val = loss.mean() if loss.ndim > 0 else loss
+                else:
+                    self._loss_val = None
+            self._outputs = outs
+        else:
+            out = self._block(*data)
+            self._outputs = _as_list(out)
+            self._loss_val = None
+
+    def backward(self) -> None:
+        if self._loss_val is None:
+            raise MXNetError("backward: no training forward recorded "
+                             "(labels missing or is_train=False)")
+        self._loss_val.backward()
+
+    def update(self) -> None:
+        if self._trainer is None:
+            raise MXNetError("call init_optimizer before update")
+        # loss was averaged over the batch already
+        self._trainer.step(1, ignore_stale_grad=True)
+
+    def get_outputs(self, merge_multi_context: bool = True) -> List[NDArray]:
+        return self._outputs
+
+    def update_metric(self, eval_metric: EvalMetric,
+                      labels: Sequence[NDArray]) -> None:
+        eval_metric.update(_as_list(labels), self._outputs)
+
+    # -- checkpointing ------------------------------------------------------
+    def save_checkpoint(self, prefix: str, epoch: int,
+                        save_optimizer_states: bool = False) -> None:
+        arg, aux = self.get_params()
+        save_checkpoint(prefix, epoch, self._block, arg, aux)
+        if save_optimizer_states and self._trainer is not None:
+            self._trainer.save_states(f"{prefix}-{epoch:04d}.states")
+
+    @staticmethod
+    def load(prefix: str, epoch: int, load_optimizer_states: bool = False,
+             symbol: Any = None, **kwargs: Any) -> "Module":
+        """Rebuild a Module from a checkpoint; ``symbol`` (the block) must
+        be supplied since python code is not serialized (the reference
+        reconstructed the graph from symbol.json)."""
+        if symbol is None:
+            raise MXNetError(
+                "Module.load: pass symbol=<block instance> (architecture "
+                "is python code in this build)")
+        _, arg, aux = load_checkpoint(prefix, epoch)
+        mod = Module(symbol, **kwargs)
+        mod._pending_params = (arg, aux)
+        mod._load_prefix_epoch = (prefix, epoch, load_optimizer_states)
+        return mod
+
+    def _apply_pending(self) -> None:
+        pending = getattr(self, "_pending_params", None)
+        if pending is not None:
+            arg, aux = pending
+            self.init_params(arg_params=arg, aux_params=aux,
+                             allow_extra=True)
+            self._pending_params = None
+
+
+class BucketingModule(BaseModule):
+    """Variable-length training over bucketed batches.
+
+    ``sym_gen(bucket_key) -> (block, data_names, label_names)`` as in the
+    reference; parameters are shared by returning the same underlying
+    block (weights live on the block, executables are cached per input
+    shape by hybridize/jit — no explicit executor sharing needed).
+    """
+
+    def __init__(self, sym_gen: Callable,
+                 default_bucket_key: Any = None, logger: Any = logging,
+                 context: Any = None, loss: Any = None) -> None:
+        super().__init__(logger)
+        self._sym_gen = sym_gen
+        self._default_key = default_bucket_key
+        self._context = context
+        self._loss = loss
+        self._modules: Dict[Any, Module] = {}
+        self._curr_key = default_bucket_key
+
+    def _get_module(self, key: Any) -> Module:
+        if key not in self._modules:
+            block, data_names, label_names = self._sym_gen(key)
+            mod = Module(block, data_names, label_names, self.logger,
+                         self._context, loss=self._loss)
+            self._modules[key] = mod
+        return self._modules[key]
+
+    @property
+    def symbol(self) -> Any:
+        return self._get_module(self._curr_key).symbol
+
+    def bind(self, data_shapes: Any, label_shapes: Any = None,
+             for_training: bool = True, **kwargs: Any) -> None:
+        mod = self._get_module(self._default_key)
+        mod.bind(data_shapes, label_shapes, for_training, **kwargs)
+        self.binded = True
+
+    def init_params(self, **kwargs: Any) -> None:
+        self._get_module(self._default_key).init_params(**kwargs)
+        self.params_initialized = True
+
+    def init_optimizer(self, **kwargs: Any) -> None:
+        self._get_module(self._default_key).init_optimizer(**kwargs)
+        self.optimizer_initialized = True
+
+    def switch_bucket(self, bucket_key: Any, data_shapes: Any = None,
+                      label_shapes: Any = None) -> None:
+        mod = self._get_module(bucket_key)
+        if not mod.binded and data_shapes is not None:
+            mod.bind(data_shapes, label_shapes)
+        # share trainer/optimizer state with the default module
+        default = self._modules[self._default_key]
+        mod._trainer = default._trainer
+        mod.params_initialized = True
+        mod.optimizer_initialized = default.optimizer_initialized
+        self._curr_key = bucket_key
+
+    def forward(self, data_batch: DataBatch,
+                is_train: Optional[bool] = None) -> None:
+        key = getattr(data_batch, "bucket_key", self._default_key)
+        self.switch_bucket(key, getattr(data_batch, "provide_data", None),
+                           getattr(data_batch, "provide_label", None))
+        self._modules[key].forward(data_batch, is_train)
+
+    def backward(self) -> None:
+        self._modules[self._curr_key].backward()
+
+    def update(self) -> None:
+        self._modules[self._curr_key].update()
+
+    def get_outputs(self) -> List[NDArray]:
+        return self._modules[self._curr_key].get_outputs()
+
+    def update_metric(self, eval_metric: EvalMetric,
+                      labels: Sequence[NDArray]) -> None:
+        self._modules[self._curr_key].update_metric(eval_metric, labels)
+
+    def get_params(self):
+        return self._modules[self._default_key].get_params()

@@ -1,0 +1,323 @@
+"""Profiler — op instrumentation, Chrome-trace dump, aggregate stats.
+
+Reference parity (leezu/mxnet): ``src/profiler/profiler.{h,cc}`` (singleton
+``Profiler``, engine hooks around Opr execution, per-device stats,
+chrome://tracing JSON dump, ``AggregateStats`` tables) and the Python
+surface ``python/mxnet/profiler.py`` (``set_config``/``set_state``/
+``pause``/``resume``/``dump``/``dumps``, ``ProfileTask``/``ProfileEvent``/
+``ProfileCounter``/``ProfileFrame``/``ProfileDomain``).
+
+Design (tpu-first): ops are instrumented at the one dispatch point
+(``ndarray.register.invoke``); device-side detail comes from wrapping the
+XLA profiler (``start_xla_trace``/``stop_xla_trace`` → TensorBoard xplane,
+the TPU analog of the reference's NVTX emitter). Eager timings measure
+dispatch by default (the reference likewise measures engine-op execution,
+not python); set ``MXNET_PROFILER_SYNC=1`` to block per op and capture
+true device latency.
+"""
+from __future__ import annotations
+
+import json
+import os
+import threading
+import time
+from typing import Any, Dict, List, Optional
+
+from .base import MXNetError, getenv, register_env
+
+__all__ = ["set_config", "set_state", "start", "stop", "pause", "resume",
+           "dump", "dumps", "reset", "state",
+           "ProfileDomain", "ProfileTask", "ProfileEvent", "ProfileCounter",
+           "ProfileFrame", "ProfileMarker", "scope",
+           "start_xla_trace", "stop_xla_trace"]
+
+register_env("MXNET_PROFILER_AUTOSTART", 0,
+             "Start the profiler at import time (1 = on).")
+register_env("MXNET_PROFILER_SYNC", 0,
+             "Block after each profiled op to capture device latency.")
+
+# checked on the hot dispatch path (mirrors register._amp_state pattern)
+_active = {"on": False}
+
+_LOCK = threading.Lock()
+
+
+class _ProfilerState:
+    def __init__(self) -> None:
+        self.filename = "profile.json"
+        self.profile_all = False
+        self.profile_symbolic = True
+        self.profile_imperative = True
+        self.profile_memory = False
+        self.profile_api = False
+        self.aggregate_stats = True
+        self.continuous_dump = False
+        self.running = False
+        self.paused = False
+        self.events: List[Dict[str, Any]] = []
+        self.agg: Dict[str, Dict[str, float]] = {}
+        self.t0 = time.perf_counter()
+
+
+_P = _ProfilerState()
+
+
+def set_config(**kwargs: Any) -> None:
+    """Configure the profiler (reference ``profiler.set_config``); accepts
+    filename, profile_all, profile_symbolic, profile_imperative,
+    profile_memory, profile_api, aggregate_stats, continuous_dump."""
+    allowed = {"filename", "profile_all", "profile_symbolic",
+               "profile_imperative", "profile_memory", "profile_api",
+               "aggregate_stats", "continuous_dump"}
+    for k, v in kwargs.items():
+        if k not in allowed:
+            raise MXNetError(f"profiler.set_config: unknown key {k!r} "
+                             f"(allowed: {sorted(allowed)})")
+        setattr(_P, k, v)
+
+
+def state() -> str:
+    return "run" if _P.running else "stop"
+
+
+def _sync_flags() -> None:
+    on = _P.running and not _P.paused
+    _active["on"] = on
+    from .ndarray import register as _reg
+    _reg._profiler_state["on"] = on
+
+
+def set_state(new_state: str = "stop") -> None:
+    if new_state not in ("run", "stop"):
+        raise MXNetError("profiler state must be 'run' or 'stop'")
+    _P.running = new_state == "run"
+    _P.paused = False
+    _sync_flags()
+    if _P.running and not _P.events:
+        _P.t0 = time.perf_counter()
+
+
+def start() -> None:
+    set_state("run")
+
+
+def stop() -> None:
+    set_state("stop")
+
+
+def pause() -> None:
+    _P.paused = True
+    _sync_flags()
+
+
+def resume() -> None:
+    _P.paused = False
+    _sync_flags()
+
+
+def reset() -> None:
+    _P.events.clear()
+    _P.agg.clear()
+    _P.t0 = time.perf_counter()
+
+
+def _now_us() -> float:
+    return (time.perf_counter() - _P.t0) * 1e6
+
+
+def record_op(name: str, begin_us: float, end_us: float,
+              category: str = "operator") -> None:
+    """Append one op execution record (called from register.invoke)."""
+    with _LOCK:
+        _P.events.append({"name": name, "cat": category, "ph": "X",
+                          "ts": begin_us, "dur": end_us - begin_us,
+                          "pid": 0, "tid": threading.get_ident() % 100000})
+        a = _P.agg.setdefault(name, {"count": 0, "total": 0.0,
+                                     "min": float("inf"), "max": 0.0})
+        d = end_us - begin_us
+        a["count"] += 1
+        a["total"] += d
+        a["min"] = min(a["min"], d)
+        a["max"] = max(a["max"], d)
+
+
+class _OpTimer:
+    """Context used by the dispatch hook."""
+
+    __slots__ = ("name", "begin")
+
+    def __init__(self, name: str) -> None:
+        self.name = name
+
+    def __enter__(self) -> "_OpTimer":
+        self.begin = _now_us()
+        return self
+
+    def __exit__(self, *exc: Any) -> None:
+        if getenv("MXNET_PROFILER_SYNC", 0):
+            from . import engine
+            engine.waitall()
+        record_op(self.name, self.begin, _now_us())
+
+
+def op_timer(name: str) -> Optional[_OpTimer]:
+    if not _active["on"]:
+        return None
+    return _OpTimer(name)
+
+
+def dump(finished: bool = True) -> str:
+    """Write accumulated events as chrome://tracing JSON; returns path."""
+    payload = {
+        "traceEvents": [
+            {"name": "process_name", "ph": "M", "pid": 0,
+             "args": {"name": "mxnet_tpu"}},
+            *_P.events,
+        ],
+        "displayTimeUnit": "ms",
+    }
+    with open(_P.filename, "w") as f:
+        json.dump(payload, f)
+    if finished:
+        reset()
+    return _P.filename
+
+
+def dumps(reset_stats: bool = False) -> str:
+    """Aggregate per-op summary table (reference ``AggregateStats``)."""
+    lines = [f"{'Name':<40}{'Count':>8}{'Total(us)':>14}"
+             f"{'Min(us)':>12}{'Max(us)':>12}{'Avg(us)':>12}"]
+    with _LOCK:
+        for name, a in sorted(_P.agg.items(),
+                              key=lambda kv: -kv[1]["total"]):
+            avg = a["total"] / max(a["count"], 1)
+            lines.append(f"{name:<40}{int(a['count']):>8}"
+                         f"{a['total']:>14.1f}{a['min']:>12.1f}"
+                         f"{a['max']:>12.1f}{avg:>12.1f}")
+        if reset_stats:
+            _P.agg.clear()
+    return "\n".join(lines)
+
+
+# ---------------------------------------------------------------------------
+# User-level markers (reference: c_api_profile.cc objects)
+# ---------------------------------------------------------------------------
+
+class ProfileDomain:
+    """Named grouping for marker objects (reference ``ProfileDomain``)."""
+
+    def __init__(self, name: str) -> None:
+        self.name = name
+
+
+class ProfileTask:
+    """start()/stop() span attributed to a domain."""
+
+    def __init__(self, name: str, domain: Optional[ProfileDomain] = None) -> None:
+        self.name = name
+        self.domain = domain
+        self._begin: Optional[float] = None
+
+    def start(self) -> None:
+        self._begin = _now_us()
+
+    def stop(self) -> None:
+        if self._begin is None:
+            raise MXNetError(f"ProfileTask {self.name!r}: stop before start")
+        cat = self.domain.name if self.domain else "task"
+        record_op(self.name, self._begin, _now_us(), category=cat)
+        self._begin = None
+
+    def __enter__(self) -> "ProfileTask":
+        self.start()
+        return self
+
+    def __exit__(self, *exc: Any) -> None:
+        self.stop()
+
+
+ProfileFrame = ProfileTask  # frames are tasks that may nest (same record)
+
+
+class ProfileEvent(ProfileTask):
+    """Instant or spanning user event."""
+
+    def mark(self) -> None:
+        t = _now_us()
+        with _LOCK:
+            _P.events.append({"name": self.name, "cat": "event", "ph": "i",
+                              "ts": t, "pid": 0, "s": "g",
+                              "tid": threading.get_ident() % 100000})
+
+
+class ProfileCounter:
+    """Named counter emitted into the trace (reference ProfileCounter)."""
+
+    def __init__(self, name: str, domain: Optional[ProfileDomain] = None) -> None:
+        self.name = name
+        self.value = 0
+
+    def set_value(self, value: float) -> None:
+        self.value = value
+        with _LOCK:
+            _P.events.append({"name": self.name, "ph": "C", "ts": _now_us(),
+                              "pid": 0, "args": {self.name: value}})
+
+    def increment(self, delta: float = 1) -> None:
+        self.set_value(self.value + delta)
+
+    def decrement(self, delta: float = 1) -> None:
+        self.set_value(self.value - delta)
+
+    def __iadd__(self, delta: float) -> "ProfileCounter":
+        self.increment(delta)
+        return self
+
+    def __isub__(self, delta: float) -> "ProfileCounter":
+        self.decrement(delta)
+        return self
+
+
+class ProfileMarker(ProfileEvent):
+    pass
+
+
+class scope:
+    """``with profiler.scope('phase'):`` convenience span."""
+
+    def __init__(self, name: str) -> None:
+        self._task = ProfileTask(name)
+
+    def __enter__(self) -> "scope":
+        self._task.start()
+        return self
+
+    def __exit__(self, *exc: Any) -> None:
+        self._task.stop()
+
+
+# ---------------------------------------------------------------------------
+# XLA device-side tracing (TPU analog of the NVTX emitter)
+# ---------------------------------------------------------------------------
+
+_xla_trace_dir: Optional[str] = None
+
+
+def start_xla_trace(logdir: str = "/tmp/mxnet_tpu_trace") -> None:
+    """Start the XLA/xplane profiler; view in TensorBoard."""
+    global _xla_trace_dir
+    import jax
+    jax.profiler.start_trace(logdir)
+    _xla_trace_dir = logdir
+
+
+def stop_xla_trace() -> Optional[str]:
+    global _xla_trace_dir
+    import jax
+    jax.profiler.stop_trace()
+    d, _xla_trace_dir = _xla_trace_dir, None
+    return d
+
+
+if getenv("MXNET_PROFILER_AUTOSTART", 0):
+    set_state("run")

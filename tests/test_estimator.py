@@ -1,0 +1,92 @@
+"""Estimator fit loop + event handlers.
+
+Models the reference's tests/python/unittest/test_gluon_estimator.py /
+test_gluon_event_handler.py.
+"""
+import os
+
+import numpy as onp
+import pytest
+
+import mxnet_tpu as mx
+from mxnet_tpu import gluon
+from mxnet_tpu.gluon import nn
+from mxnet_tpu.gluon.contrib.estimator import (
+    CheckpointHandler, EarlyStoppingHandler, Estimator, LoggingHandler)
+from mxnet_tpu.metric import Accuracy, Loss as LossMetric
+
+
+def _toy_loader(n=128, batch=32, seed=0):
+    rng = onp.random.RandomState(seed)
+    x = rng.randn(n, 4).astype("float32")
+    w = onp.array([[1.0, -1.0], [2.0, 0.5], [-1.5, 1.0], [0.3, -0.3]],
+                  dtype="float32")
+    y = (x @ w).argmax(axis=1).astype("float32")
+    batches = [(x[i:i + batch], y[i:i + batch])
+               for i in range(0, n, batch)]
+    return batches
+
+
+def _estimator(lr=0.05):
+    net = nn.HybridSequential()
+    net.add(nn.Dense(16, activation="relu"), nn.Dense(2))
+    net.initialize()
+    trainer = gluon.Trainer(net.collect_params(), "adam",
+                            {"learning_rate": lr})
+    return Estimator(net, gluon.loss.SoftmaxCrossEntropyLoss(),
+                     train_metrics=Accuracy(), trainer=trainer)
+
+
+def test_estimator_fit_improves_accuracy():
+    mx.random.seed(0)
+    est = _estimator()
+    data = _toy_loader()
+    est.fit(train_data=data, epochs=10)
+    name, acc = est.train_metrics[0].get()
+    assert name == "accuracy" and acc > 0.9, acc
+
+
+def test_estimator_validation():
+    mx.random.seed(0)
+    est = _estimator()
+    data = _toy_loader()
+    val = _toy_loader(seed=7)
+    est.fit(train_data=data, val_data=val, epochs=5)
+    _, vacc = est.val_metrics[0].get()
+    assert vacc > 0.7, vacc
+
+
+def test_estimator_max_batches():
+    est = _estimator()
+    data = _toy_loader()
+    est.fit(train_data=data, batches=3)
+    stopping = [h for h in [] ]  # handler internal; assert via metric count
+    # 3 batches * 32 samples seen by the loss metric
+    assert est.train_loss_metric.num_inst == 96
+
+
+def test_checkpoint_handler(tmp_path):
+    est = _estimator()
+    data = _toy_loader(n=64)
+    ckpt = CheckpointHandler(str(tmp_path), model_prefix="net",
+                             monitor=est.train_loss_metric, save_best=True)
+    est.fit(train_data=data, epochs=2, event_handlers=[ckpt])
+    assert os.path.exists(tmp_path / "net-epoch1.params")
+    assert os.path.exists(tmp_path / "net-epoch2.params")
+    assert os.path.exists(tmp_path / "net-best.params")
+
+
+def test_early_stopping():
+    est = _estimator(lr=0.0)  # lr=0 -> no improvement ever
+    data = _toy_loader(n=64)
+    early = EarlyStoppingHandler(monitor=est.train_loss_metric, patience=1,
+                                 mode="min")
+    est.fit(train_data=data, epochs=50, event_handlers=[early])
+    assert early.stopped_epoch > 0
+    assert early.current_epoch < 50
+
+
+def test_fit_requires_duration():
+    est = _estimator()
+    with pytest.raises(mx.MXNetError, match="epochs or batches"):
+        est.fit(train_data=_toy_loader())

@@ -1,0 +1,134 @@
+"""Module API: bind/init/fit/score/predict, checkpoints, bucketing.
+
+Models the reference's tests/python/unittest/test_module.py (fit on a
+small problem asserting accuracy, checkpoint round-trip, bucketing).
+"""
+import logging
+
+import numpy as onp
+import pytest
+
+import mxnet_tpu as mx
+from mxnet_tpu import gluon
+from mxnet_tpu.gluon import nn
+from mxnet_tpu.io.io import NDArrayIter
+from mxnet_tpu.test_utils import assert_almost_equal
+
+
+def _toy_classification(n=256, seed=0):
+    rng = onp.random.RandomState(seed)
+    x = rng.randn(n, 4).astype("float32")
+    w = onp.array([[1.0, -1.0], [2.0, 0.5], [-1.5, 1.0], [0.3, -0.3]],
+                  dtype="float32")
+    logits = x @ w
+    y = logits.argmax(axis=1).astype("float32")
+    return x, y
+
+
+def _mlp():
+    net = nn.HybridSequential()
+    net.add(nn.Dense(16, activation="relu"), nn.Dense(2))
+    return net
+
+
+def test_module_fit_accuracy():
+    mx.random.seed(0)
+    x, y = _toy_classification()
+    train_iter = NDArrayIter(x, y, batch_size=32, shuffle=True,
+                             label_name="softmax_label")
+    mod = mx.mod.Module(_mlp(), data_names=["data"],
+                        label_names=["softmax_label"])
+    mod.fit(train_iter, num_epoch=12, optimizer="adam",
+            optimizer_params={"learning_rate": 0.01},
+            eval_metric="acc")
+    score_iter = NDArrayIter(x, y, batch_size=32,
+                             label_name="softmax_label")
+    res = dict(mod.score(score_iter, "acc"))
+    assert res["accuracy"] > 0.95, res
+
+
+def test_module_predict_shape():
+    x, y = _toy_classification(n=100)
+    mod = mx.mod.Module(_mlp(), label_names=["softmax_label"])
+    it = NDArrayIter(x, y, batch_size=32, label_name="softmax_label")
+    mod.bind(data_shapes=it.provide_data, label_shapes=it.provide_label)
+    mod.init_params()
+    out = mod.predict(it)
+    assert out.shape == (100, 2)  # padding stripped
+
+
+def test_module_checkpoint_roundtrip(tmp_path):
+    mx.random.seed(1)
+    x, y = _toy_classification(n=64)
+    it = NDArrayIter(x, y, batch_size=16, label_name="softmax_label")
+    mod = mx.mod.Module(_mlp(), label_names=["softmax_label"])
+    mod.bind(data_shapes=it.provide_data, label_shapes=it.provide_label)
+    mod.init_params()
+    prefix = str(tmp_path / "ckpt")
+    mod.save_checkpoint(prefix, 5)
+
+    expected = mod.predict(it).asnumpy()
+    net2 = _mlp()
+    mod2 = mx.mod.Module.load(prefix, 5, symbol=net2,
+                              label_names=["softmax_label"])
+    mod2.bind(data_shapes=it.provide_data, label_shapes=it.provide_label)
+    mod2._apply_pending()
+    got = mod2.predict(it).asnumpy()
+    assert_almost_equal(got, expected, rtol=1e-5, atol=1e-6)
+
+
+def test_load_checkpoint_keys(tmp_path):
+    from mxnet_tpu.model import save_checkpoint, load_checkpoint
+    prefix = str(tmp_path / "m")
+    save_checkpoint(prefix, 2, None,
+                    {"w": mx.nd.ones((2, 2))}, {"rm": mx.nd.zeros((2,))})
+    _, arg, aux = load_checkpoint(prefix, 2)
+    assert set(arg) == {"w"} and set(aux) == {"rm"}
+    with pytest.raises(mx.MXNetError, match="does not exist"):
+        load_checkpoint(prefix, 9)
+
+
+def test_bucketing_module():
+    """Variable-length inputs share one parameter set across buckets."""
+    mx.random.seed(2)
+    shared = nn.Dense(2, flatten=False)
+
+    def sym_gen(seq_len):
+        return shared, ["data"], ["softmax_label"]
+
+    bmod = mx.mod.BucketingModule(sym_gen, default_bucket_key=8)
+    from mxnet_tpu.io.io import DataDesc, DataBatch
+
+    bmod.bind(data_shapes=[DataDesc("data", (4, 8, 3))])
+    bmod.init_params()
+    bmod.init_optimizer(optimizer="sgd",
+                        optimizer_params={"learning_rate": 0.1})
+
+    for seq_len in (8, 4, 8, 6):
+        data = mx.nd.random.normal(shape=(4, seq_len, 3))
+        label = mx.nd.zeros((4, seq_len))
+        batch = DataBatch([data], [label])
+        batch.bucket_key = seq_len
+        bmod.forward(batch, is_train=True)
+        out = bmod.get_outputs()[0]
+        assert out.shape == (4, seq_len, 2)
+        bmod.backward()
+        bmod.update()
+    # every bucket used the same underlying parameter objects
+    assert len(bmod._modules) == 3
+    param_ids = {tuple(id(p) for p in m.symbol.collect_params().values())
+                 for m in bmod._modules.values()}
+    assert len(param_ids) == 1
+
+
+def test_speedometer_callback_runs(caplog):
+    from mxnet_tpu.callback import Speedometer
+    from mxnet_tpu.model import BatchEndParam
+    from mxnet_tpu.metric import create
+    sp = Speedometer(batch_size=32, frequent=2)
+    m = create("acc")
+    m.update([mx.nd.array([0, 1])], [mx.nd.array([[0.9, 0.1], [0.1, 0.9]])])
+    with caplog.at_level(logging.INFO):
+        for i in range(5):
+            sp(BatchEndParam(0, i, m))
+    assert any("samples/sec" in r.message for r in caplog.records)

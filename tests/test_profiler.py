@@ -1,0 +1,91 @@
+"""Profiler: op capture, chrome trace dump, aggregate table, markers.
+
+Models the reference's tests/python/unittest/test_profiler.py.
+"""
+import json
+import os
+
+import pytest
+
+import mxnet_tpu as mx
+from mxnet_tpu import profiler
+
+
+@pytest.fixture(autouse=True)
+def _stop_after():
+    yield
+    profiler.set_state("stop")
+    profiler.reset()
+
+
+def test_capture_and_dump(tmp_path):
+    out = tmp_path / "trace.json"
+    profiler.set_config(filename=str(out))
+    profiler.start()
+    a = mx.nd.ones((8, 8))
+    b = mx.nd.dot(a, a)
+    (b + 1).sum().asnumpy()
+    profiler.stop()
+    path = profiler.dump()
+    assert path == str(out) and os.path.exists(path)
+    trace = json.load(open(path))
+    names = {e.get("name") for e in trace["traceEvents"]}
+    assert "dot" in names
+    assert any(e.get("ph") == "X" for e in trace["traceEvents"])
+
+
+def test_aggregate_table():
+    profiler.start()
+    a = mx.nd.ones((4, 4))
+    for _ in range(3):
+        mx.nd.dot(a, a)
+    profiler.stop()
+    table = profiler.dumps()
+    assert "dot" in table
+    line = [l for l in table.splitlines() if l.startswith("dot")][0]
+    assert int(line.split()[1]) == 3  # count column
+
+
+def test_pause_resume():
+    profiler.start()
+    a = mx.nd.ones((2, 2))
+    profiler.pause()
+    mx.nd.dot(a, a)
+    profiler.resume()
+    mx.nd.dot(a, a)
+    profiler.stop()
+    table = profiler.dumps()
+    line = [l for l in table.splitlines() if l.startswith("dot")][0]
+    assert int(line.split()[1]) == 1  # only the resumed call counted
+
+
+def test_markers_and_counters(tmp_path):
+    out = tmp_path / "m.json"
+    profiler.set_config(filename=str(out))
+    profiler.start()
+    domain = profiler.ProfileDomain("train")
+    with profiler.ProfileTask("epoch", domain):
+        pass
+    ev = profiler.ProfileEvent("milestone")
+    ev.mark()
+    c = profiler.ProfileCounter("samples")
+    c.set_value(100)
+    c += 28
+    profiler.stop()
+    trace = json.load(open(profiler.dump()))
+    names = [e.get("name") for e in trace["traceEvents"]]
+    assert "epoch" in names and "milestone" in names and "samples" in names
+    counter_events = [e for e in trace["traceEvents"]
+                      if e.get("ph") == "C" and e["name"] == "samples"]
+    assert counter_events[-1]["args"]["samples"] == 128
+
+
+def test_set_config_rejects_unknown():
+    with pytest.raises(mx.MXNetError, match="unknown key"):
+        profiler.set_config(bogus=True)
+
+
+def test_profiler_off_has_no_capture():
+    a = mx.nd.ones((2, 2))
+    mx.nd.dot(a, a)
+    assert "dot" not in profiler.dumps()
