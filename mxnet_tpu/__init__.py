@@ -53,6 +53,8 @@ _LAZY = {
     "model": ".model",
     "mod": ".module",
     "module": ".module",
+    "symbol": ".symbol",
+    "sym": ".symbol",
 }
 
 

@@ -461,18 +461,100 @@ def _tracing_now(args) -> bool:
     return False
 
 
+def _default_init_for(name: str):
+    """Name-dispatched default initializer for symbol-created parameters
+    (reference: the variable-name heuristics in ``initializer.py`` —
+    gamma/moving_var -> ones, beta/bias/moving_mean -> zeros)."""
+    from .. import initializer as _init_mod
+    if name.endswith(("_gamma", "_moving_var", "_running_var")):
+        return _init_mod.One()
+    if name.endswith(("_beta", "_bias", "_moving_mean", "_running_mean")):
+        return _init_mod.Zero()
+    return None
+
+
 class SymbolBlock(HybridBlock):
-    """Load-and-run container for exported models (reference:
-    ``gluon.SymbolBlock.imports`` over ``-symbol.json`` + ``.params``).
+    """Run a symbolic graph as a gluon block (reference:
+    ``gluon.SymbolBlock(outputs, inputs)`` and ``SymbolBlock.imports``
+    over ``-symbol.json`` + ``.params``).
 
-    Wraps either a deserialized jax.export artifact (from
-    ``HybridBlock.export``) or any stored callable."""
+    Accepts either a ``mx.sym.Symbol`` with its input symbols (classic
+    constructor), or a callable + params dict (used internally by
+    ``imports`` for jax.export artifacts)."""
 
-    def __init__(self, fn: Callable, params: Dict[str, Parameter]) -> None:
+    def __init__(self, outputs: Any, inputs: Any = None,
+                 params: Optional[Dict[str, Parameter]] = None) -> None:
         super().__init__()
-        self._fn = fn
-        for k, v in params.items():
+        if hasattr(outputs, "_heads"):          # mx.sym.Symbol
+            self._init_from_symbol(outputs, inputs, params)
+            return
+        self._fn = outputs
+        self._symbol = None
+        for k, v in (inputs if isinstance(inputs, dict)
+                     else (params or {})).items():
             self._reg_params[k] = v
+
+    def _init_from_symbol(self, outputs: Any, inputs: Any,
+                          params: Optional[Dict[str, Parameter]]) -> None:
+        from ..symbol.symbol import _eval_graph
+        if inputs is None:
+            raise MXNetError("SymbolBlock(symbol) requires the input "
+                             "symbols, e.g. inputs=[mx.sym.var('data')]")
+        if not isinstance(inputs, (list, tuple)):
+            inputs = [inputs]
+        in_names = [i if isinstance(i, str) else i.name for i in inputs]
+        self._symbol = outputs
+        self._sym_input_names = in_names
+        arg_names = [n for n in outputs.list_arguments()
+                     if n not in in_names]
+        aux_names = outputs.list_auxiliary_states()
+        for n in arg_names:
+            p = (params or {}).get(n) or Parameter(
+                n, shape=None, allow_deferred_init=True,
+                init=_default_init_for(n))
+            self._reg_params[n] = p
+        for n in aux_names:
+            p = (params or {}).get(n) or Parameter(
+                n, grad_req="null", shape=None, allow_deferred_init=True,
+                init=_default_init_for(n))
+            self._reg_params[n] = p
+
+        def fn(*args: Any) -> Any:
+            self._sym_finish_deferred(args)
+            feed = {}
+            for name, a in zip(in_names, args):
+                feed[name] = a if isinstance(a, NDArray) else NDArray(a)
+            for name, p in self._reg_params.items():
+                feed[name] = p.data()
+
+            def aux_hook(name: str, value: NDArray) -> None:
+                self._reg_params[name].set_data(value.detach())
+
+            from .._tape import is_training
+            outs = _eval_graph(self._symbol, feed,
+                               training=is_training(), aux_hook=aux_hook)
+            return outs[0] if len(outs) == 1 else tuple(outs)
+
+        self._fn = fn
+
+    def _sym_finish_deferred(self, args: Any) -> None:
+        pending = {n: p for n, p in self._reg_params.items()
+                   if p._data is None and p._deferred_init is not None}
+        if not pending:
+            return
+        from ..symbol.symbol import _infer_structs
+        known = {n: tuple(a.shape)
+                 for n, a in zip(self._sym_input_names, args)}
+        var_structs, _ = _infer_structs(self._symbol, known, partial=True)
+        for n, p in pending.items():
+            st = var_structs.get(n)
+            if st is None:
+                raise MXNetError(
+                    f"SymbolBlock: could not infer shape of parameter "
+                    f"{n!r} from input shapes {known}")
+            if p.dtype is None or _np.dtype(p.dtype) != _np.dtype(st.dtype):
+                p.dtype = _np.dtype(st.dtype)
+            p._finish_deferred_init(tuple(st.shape))
 
     @staticmethod
     def imports(symbol_file: str, input_names: Any = None,

@@ -178,9 +178,35 @@ class Module(BaseModule):
                  context: Optional[Union[Context, Sequence[Context]]] = None,
                  loss: Any = None) -> None:
         super().__init__(logger)
-        self._block = symbol
         self._data_names = list(data_names)
         self._label_names = list(label_names or [])
+        self._sym_mode = False
+        self._head_op = None
+        self._root: Optional[NDArray] = None
+        if hasattr(symbol, "_heads"):      # mx.sym.Symbol: wrap in a block
+            from ..gluon.block import SymbolBlock
+            from ..symbol import Variable
+            self._sym_mode = True
+            # any head may be the loss head (Group([features, loss]) is a
+            # standard reference pattern)
+            self._head_op = None
+            self._loss_head_idx = None
+            for i, (node, _) in enumerate(symbol._heads):
+                if node.op in self._LOSS_HEADS:
+                    self._head_op = node.op
+                    self._loss_head_idx = i
+                    self._head_normalization = node.attrs.get(
+                        "normalization", "null")
+                    break
+            sym_args = set(symbol.list_arguments())
+            # only wire label inputs the graph actually consumes
+            self._used_labels = [n for n in self._label_names
+                                 if n in sym_args]
+            in_syms = [Variable(n) for n in self._data_names
+                       + self._used_labels]
+            self._block = SymbolBlock(symbol, in_syms)
+        else:
+            self._block = symbol
         ctxs = context if context is not None else [current_context()]
         self._contexts = list(ctxs) if isinstance(ctxs, (list, tuple)) \
             else [ctxs]
@@ -216,12 +242,20 @@ class Module(BaseModule):
         self._block.initialize(init=initializer, ctx=self._contexts[0],
                                force_reinit=force_init)
         # materialize deferred shapes with one dummy forward
-        dummies = []
-        for desc in self._data_shapes:
+        def _desc_to_dummy(desc):
             shape = tuple(desc.shape) if hasattr(desc, "shape") else \
                 tuple(desc[1])
             dtype = getattr(desc, "dtype", _np.float32)
-            dummies.append(NDArray(_np.zeros(shape, dtype=dtype)))
+            return NDArray(_np.zeros(shape, dtype=dtype))
+
+        dummies = [_desc_to_dummy(d) for d in self._data_shapes]
+        if self._sym_mode and self._used_labels:
+            if self._label_shapes:
+                dummies += [_desc_to_dummy(d) for d in self._label_shapes]
+            else:
+                batch = dummies[0].shape[0] if dummies else 1
+                dummies += [NDArray(_np.zeros((batch,), dtype=_np.float32))
+                            for _ in self._used_labels]
         self._block(*dummies)
         if arg_params or aux_params:
             merged = dict(arg_params or {})
@@ -270,6 +304,9 @@ class Module(BaseModule):
                   for l in _as_list(data_batch.label)]
         is_train = self.binded if is_train is None else is_train
         self._cur_batch_size = data[0].shape[0] if data else 0
+        if self._sym_mode:
+            self._forward_symbol(data, labels, is_train)
+            return
         if is_train:
             with autograd.record():
                 out = self._block(*data)
@@ -285,7 +322,56 @@ class Module(BaseModule):
             self._outputs = _as_list(out)
             self._loss_val = None
 
+    # ops whose backward injects the loss gradient directly (reference:
+    # SoftmaxOutput & the regression output heads)
+    _LOSS_HEADS = frozenset([
+        "softmax_output", "linear_regression_output",
+        "logistic_regression_output", "mae_regression_output", "make_loss"])
+
+    def _forward_symbol(self, data: List[NDArray], labels: List[NDArray],
+                        is_train: bool) -> None:
+        """Forward for a wrapped mx.sym.Symbol: loss-head graphs carry
+        their own gradient, so the root of backward is the head output."""
+        feeds = list(data)
+        if self._used_labels:
+            if labels:
+                feeds += labels[:len(self._used_labels)]
+            else:   # inference without labels: heads ignore label values
+                feeds += [NDArray(_np.zeros((self._cur_batch_size,),
+                                            dtype=_np.float32))
+                          for _ in self._used_labels]
+        if is_train and self._head_op is not None:
+            with autograd.record():
+                out = self._block(*feeds)
+            self._outputs = _as_list(out)
+            self._root = self._outputs[self._loss_head_idx]
+            self._loss_val = None
+            if self._head_op == "softmax_output" and labels:
+                from ..ops.nn import pick
+                p = pick(self._root.detach(), labels[0])
+                self._loss_val = -(p + 1e-12).log().mean()
+        elif is_train:
+            with autograd.record():
+                out = self._block(*feeds)
+                outs = _as_list(out)
+                if labels:
+                    loss = self._loss_fn(outs[0], *labels)
+                    self._loss_val = loss.mean() if loss.ndim > 0 else loss
+                else:
+                    self._loss_val = None
+                self._root = self._loss_val
+            self._outputs = outs
+        else:
+            out = self._block(*feeds)
+            self._outputs = _as_list(out)
+            self._root = None
+            self._loss_val = None
+
     def backward(self) -> None:
+        if self._sym_mode and self._root is not None and \
+                self._head_op is not None:
+            self._root.backward()
+            return
         if self._loss_val is None:
             raise MXNetError("backward: no training forward recorded "
                              "(labels missing or is_train=False)")
@@ -294,6 +380,14 @@ class Module(BaseModule):
     def update(self) -> None:
         if self._trainer is None:
             raise MXNetError("call init_optimizer before update")
+        if self._sym_mode and self._head_op is not None:
+            # With normalization='null' the loss-head grads are per-sample
+            # sums; the reference's Module sets rescale_grad=1/batch — do
+            # that here. Heads that normalize themselves need no rescale.
+            scale = 1 if self._head_normalization in ("batch", "valid") \
+                else max(1, self._cur_batch_size)
+            self._trainer.step(scale, ignore_stale_grad=True)
+            return
         # loss was averaged over the batch already
         self._trainer.step(1, ignore_stale_grad=True)
 
@@ -302,7 +396,12 @@ class Module(BaseModule):
 
     def update_metric(self, eval_metric: EvalMetric,
                       labels: Sequence[NDArray]) -> None:
-        eval_metric.update(_as_list(labels), self._outputs)
+        outputs = self._outputs
+        if self._sym_mode and self._head_op is not None and \
+                len(outputs) > 1:
+            # metrics score the loss head's prediction, not extra outputs
+            outputs = [outputs[self._loss_head_idx]]
+        eval_metric.update(_as_list(labels), outputs)
 
     # -- checkpointing ------------------------------------------------------
     def save_checkpoint(self, prefix: str, epoch: int,

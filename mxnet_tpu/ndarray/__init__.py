@@ -17,3 +17,16 @@ from .register import get_op, list_ops, register_op, invoke
 __all__ = (["NDArray", "from_jax", "waitall", "random", "linalg",
             "get_op", "list_ops", "register_op"]
            + list(_ops_all) + list(_ops_np_all))
+
+
+def __getattr__(name: str):
+    """Resolve any registered op (and the reference's CamelCase aliases)
+    as ``mx.nd.<name>`` — the analog of the generated-op namespace."""
+    from ..symbol.symbol import _ALIASES
+    canonical = _ALIASES.get(name, name)
+    if canonical in list_ops():
+        fn = get_op(canonical)
+        globals()[name] = fn
+        return fn
+    raise AttributeError(f"module 'mxnet_tpu.ndarray' has no attribute "
+                         f"{name!r}")

@@ -415,6 +415,21 @@ def split(a, indices_or_sections, axis=0):
 
 
 @_public
+def slice_channel(data, num_outputs, axis=1, squeeze_axis=False):
+    """Split along ``axis`` into ``num_outputs`` equal parts (reference:
+    ``SliceChannel`` in src/operator/slice_channel.cc; default axis=1)."""
+    n, ax, sq = num_outputs, axis, squeeze_axis
+
+    def impl(x):
+        parts = jnp.split(x, n, axis=ax)
+        if sq:
+            parts = [jnp.squeeze(p, axis=ax) for p in parts]
+        return tuple(parts)
+
+    return invoke("slice_channel", impl, (_as_nd(data),))
+
+
+@_public
 def array_split(a, indices_or_sections, axis=0):
     i, ax = indices_or_sections, axis
     return invoke("array_split",

@@ -687,5 +687,142 @@ def smooth_l1(data, scalar: float = 1.0):
     return invoke("smooth_l1", impl, (_as_nd(data),))
 
 
+# ---------------------------------------------------------------------------
+# Loss-head output ops (reference: src/operator/softmax_output.cc and
+# src/operator/regression_output-inl.h). These are the symbolic-API loss
+# heads: forward is the prediction; backward IGNORES the incoming output
+# cotangent and injects the loss gradient directly — the reference's
+# "implicit loss" contract that Module/Executor training relies on.
+# ---------------------------------------------------------------------------
+
+def _zero_cot(lab):
+    """A cotangent for the label input (float0 for ints, zeros for floats)."""
+    import numpy as onp
+    if jnp.issubdtype(lab.dtype, jnp.integer) or lab.dtype == jnp.bool_:
+        return onp.zeros(lab.shape, dtype=jax.dtypes.float0)
+    return jnp.zeros_like(lab)
+
+
+def softmax_output(data, label, grad_scale: float = 1.0,
+                   ignore_label: float = -1.0, use_ignore: bool = False,
+                   normalization: str = "null", multi_output: bool = False,
+                   preserve_shape: bool = False, smooth_alpha: float = 0.0,
+                   out_grad: bool = False):
+    """Softmax forward with cross-entropy gradient injected on backward.
+
+    ``multi_output``: softmax over axis 1 with label shaped like the
+    remaining axes (the reference's per-position classification mode).
+    """
+    gs, il, ui, nrm = grad_scale, ignore_label, use_ignore, normalization
+    ax = 1 if multi_output else -1
+    sa = smooth_alpha
+
+    @jax.custom_vjp
+    def _core(x, lab):
+        return jax.nn.softmax(x, axis=ax)
+
+    def _fwd(x, lab):
+        return _core(x, lab), (x, lab)
+
+    def _bwd(res, g):
+        x, lab = res
+        prob = jax.nn.softmax(x, axis=ax)
+        ncls = x.shape[ax]
+        oh = jax.nn.one_hot(lab.astype(jnp.int32), ncls, dtype=x.dtype,
+                            axis=ax)
+        if sa:
+            oh = oh * (1.0 - sa) + sa / (ncls - 1) * (1.0 - oh)
+        grad = prob - oh
+        valid = None
+        if ui:
+            valid = (lab != il).astype(x.dtype)
+            grad = grad * jnp.expand_dims(valid, ax)
+        if nrm == "batch":
+            grad = grad / x.shape[0]
+        elif nrm == "valid":
+            cnt = jnp.sum(valid) if valid is not None else \
+                float(lab.size)
+            grad = grad / jnp.maximum(cnt, 1.0)
+        return grad * gs, _zero_cot(lab)
+
+    _core.defvjp(_fwd, _bwd)
+    return invoke("softmax_output", _core, (_as_nd(data), _as_nd(label)))
+
+
+def _regression_output(name, fwd_fn, grad_fn, data, label, grad_scale):
+    gs = grad_scale
+
+    @jax.custom_vjp
+    def _core(x, lab):
+        return fwd_fn(x)
+
+    def _fwd(x, lab):
+        return _core(x, lab), (x, lab)
+
+    def _bwd(res, g):
+        x, lab = res
+        out = fwd_fn(x)
+        # the reference normalizes regression grads by the label size per
+        # batch row (DivNum over num_output)
+        nout = max(1, int(_np_prod(x.shape[1:]) if x.ndim > 1 else 1))
+        grad = grad_fn(out, lab.astype(x.dtype)) * (gs / nout)
+        return grad, _zero_cot(lab)
+
+    _core.defvjp(_fwd, _bwd)
+    return invoke(name, _core, (_as_nd(data), _as_nd(label)))
+
+
+def _np_prod(shape):
+    n = 1
+    for s in shape:
+        n *= s
+    return n
+
+
+def linear_regression_output(data, label, grad_scale: float = 1.0):
+    """out = data; grad = (out - label) (L2 loss head)."""
+    return _regression_output("linear_regression_output", lambda x: x,
+                              lambda o, l: o - l, data, label, grad_scale)
+
+
+def mae_regression_output(data, label, grad_scale: float = 1.0):
+    """out = data; grad = sign(out - label) (L1 loss head)."""
+    return _regression_output("mae_regression_output", lambda x: x,
+                              lambda o, l: jnp.sign(o - l),
+                              data, label, grad_scale)
+
+
+def logistic_regression_output(data, label, grad_scale: float = 1.0):
+    """out = sigmoid(data); grad = (out - label) (logistic loss head)."""
+    return _regression_output("logistic_regression_output", jax.nn.sigmoid,
+                              lambda o, l: o - l, data, label, grad_scale)
+
+
+def make_loss(data, grad_scale: float = 1.0, normalization: str = "null",
+              valid_thresh: float = 0.0):
+    """Mark ``data`` as a loss: backward injects ``grad_scale`` ones
+    (reference: ``MakeLoss``), ignoring any incoming cotangent."""
+    gs, nrm = grad_scale, normalization
+
+    @jax.custom_vjp
+    def _core(x):
+        return x
+
+    def _fwd(x):
+        return x, (x.shape, x.dtype)
+
+    def _bwd(res, g):
+        shape, dt = res
+        scale = gs / shape[0] if nrm == "batch" else gs
+        return (jnp.full(shape, scale, dtype=dt),)
+
+    _core.defvjp(_fwd, _bwd)
+    return invoke("make_loss", _core, (_as_nd(data),))
+
+
+__all__ += ["softmax_output", "linear_regression_output",
+            "mae_regression_output", "logistic_regression_output",
+            "make_loss"]
+
 for _name in __all__:
     register_op(_name, globals()[_name])

@@ -1,0 +1,33 @@
+"""mx.sym — the symbolic API (reference: ``python/mxnet/symbol/``).
+
+Every op registered in the shared registry is available as a symbol
+builder (``mx.sym.relu``, ``mx.sym.FullyConnected`` CamelCase aliases
+included), generated on first access — the analog of the reference's
+import-time codegen from ``MXListAllOpNames``.
+"""
+from .symbol import (Symbol, Variable, var, Group, load, load_json,
+                     _apply_op, _ALIASES)
+from .executor import Executor
+from ..ndarray.register import list_ops as _list_ops
+
+__all__ = ["Symbol", "Variable", "var", "Group", "load", "load_json",
+           "Executor"]
+
+
+def __getattr__(name: str):
+    canonical = _ALIASES.get(name, name)
+    if canonical not in _list_ops():
+        raise AttributeError(f"module 'mxnet_tpu.symbol' has no op {name!r}")
+
+    def op_fn(*args, **kwargs):
+        return _apply_op(canonical, *args, **kwargs)
+
+    op_fn.__name__ = name
+    op_fn.__qualname__ = name
+    op_fn.__doc__ = f"Symbolic form of op {canonical!r} (see mx.nd.{canonical})."
+    globals()[name] = op_fn
+    return op_fn
+
+
+def __dir__():
+    return sorted(set(__all__) | set(_list_ops()) | set(_ALIASES))

@@ -1,0 +1,202 @@
+"""Executor — bound symbolic graph, runnable forward/backward.
+
+Reference parity (leezu/mxnet): ``include/mxnet/executor.h`` /
+``src/executor/graph_executor.cc`` (``GraphExecutor::Init``, ``RunOps``,
+``Executor::SimpleBind``) and the python wrapper
+``python/mxnet/executor.py``.
+
+Design (tpu-first): the reference's executor plans memory and pushes
+per-node closures into the dependency engine; here the "engine" is jax's
+async dispatch, so the Executor is a thin shell that walks the graph
+imperatively through the shared op registry, recording on the autograd tape
+when ``is_train`` — the backward graph is the tape's vjp chain instead of a
+separate NNVM Gradient pass. Memory planning (buffer sharing, inplace) is
+XLA's job under hybridize; the executor path favors correctness and API
+parity.
+"""
+from __future__ import annotations
+
+from typing import Any, Dict, List, Optional, Sequence
+
+import numpy as _np
+
+from .. import autograd
+from ..base import MXNetError
+from ..context import Context
+from ..ndarray.ndarray import NDArray
+from ..ndarray import ops as _nd_ops
+from .symbol import Symbol, _eval_graph, _infer_structs
+
+__all__ = ["Executor"]
+
+
+def _as_dict(values: Any, names: Sequence[str], what: str
+             ) -> Dict[str, NDArray]:
+    if values is None:
+        return {}
+    if isinstance(values, dict):
+        return dict(values)
+    values = list(values)
+    if len(values) != len(names):
+        raise MXNetError(
+            f"{what}: expected {len(names)} arrays ({list(names)}), "
+            f"got {len(values)}")
+    return dict(zip(names, values))
+
+
+class Executor:
+    """A symbol bound to argument/gradient/aux buffers on a context."""
+
+    def __init__(self, sym: Symbol, ctx: Context, args: Any = None,
+                 args_grad: Any = None, grad_req: Any = "write",
+                 aux_states: Any = None) -> None:
+        self._sym = sym
+        self._ctx = ctx
+        self._arg_names = sym.list_arguments()
+        self._aux_names = sym.list_auxiliary_states()
+
+        self.arg_dict: Dict[str, NDArray] = {
+            k: v if isinstance(v, NDArray) else NDArray(v, ctx=ctx)
+            for k, v in _as_dict(args, self._arg_names, "args").items()}
+        missing = [n for n in self._arg_names if n not in self.arg_dict]
+        if missing:
+            raise MXNetError(f"bind: missing argument arrays for {missing}")
+        self.aux_dict: Dict[str, NDArray] = {
+            k: v if isinstance(v, NDArray) else NDArray(v, ctx=ctx)
+            for k, v in _as_dict(aux_states, self._aux_names,
+                                 "aux_states").items()}
+        for n in self._aux_names:
+            if n not in self.aux_dict:
+                raise MXNetError(f"bind: missing auxiliary state {n!r}")
+
+        if isinstance(grad_req, str):
+            self._grad_req = {n: grad_req for n in self._arg_names}
+        elif isinstance(grad_req, dict):
+            self._grad_req = {n: grad_req.get(n, "null")
+                              for n in self._arg_names}
+        else:
+            self._grad_req = dict(zip(self._arg_names, grad_req))
+
+        self.grad_dict: Dict[str, NDArray] = _as_dict(
+            args_grad, self._arg_names, "args_grad")
+        for n, req in self._grad_req.items():
+            if req != "null" and n not in self.grad_dict:
+                arr = self.arg_dict[n]
+                self.grad_dict[n] = NDArray(
+                    _np.zeros(arr.shape, dtype=arr.dtype), ctx=ctx)
+
+        self.outputs: List[NDArray] = []
+
+    # -- construction ------------------------------------------------------
+    @staticmethod
+    def simple_bind(sym: Symbol, ctx: Context, grad_req: Any = "write",
+                    shapes: Optional[Dict[str, tuple]] = None) -> "Executor":
+        """Infer all shapes from the given input shapes and allocate
+        argument/grad/aux buffers (reference: ``Symbol.simple_bind``)."""
+        shapes = shapes or {}
+        structs = _infer_structs(sym, shapes, partial=False)
+        var_structs, _ = structs
+        args: Dict[str, NDArray] = {}
+        for n in sym.list_arguments():
+            st = var_structs.get(n)
+            if st is None:
+                raise MXNetError(
+                    f"simple_bind: could not infer shape of {n!r}; pass it "
+                    f"explicitly (e.g. {n}=(...))")
+            args[n] = NDArray(_np.zeros(st.shape, dtype=st.dtype), ctx=ctx)
+        aux: Dict[str, NDArray] = {}
+        for n in sym.list_auxiliary_states():
+            st = var_structs.get(n)
+            if st is None:
+                raise MXNetError(
+                    f"simple_bind: could not infer shape of aux {n!r}")
+            init = _np.zeros(st.shape, dtype=st.dtype)
+            if n.endswith("_moving_var"):
+                init = _np.ones(st.shape, dtype=st.dtype)
+            aux[n] = NDArray(init, ctx=ctx)
+        return Executor(sym, ctx, args, None, grad_req, aux)
+
+    # -- properties mirroring the reference --------------------------------
+    @property
+    def arg_arrays(self) -> List[NDArray]:
+        return [self.arg_dict[n] for n in self._arg_names]
+
+    @property
+    def grad_arrays(self) -> List[Optional[NDArray]]:
+        return [self.grad_dict.get(n) for n in self._arg_names]
+
+    @property
+    def aux_arrays(self) -> List[NDArray]:
+        return [self.aux_dict[n] for n in self._aux_names]
+
+    # -- execution ---------------------------------------------------------
+    def forward(self, is_train: bool = False, **kwargs: Any
+                ) -> List[NDArray]:
+        for k, v in kwargs.items():
+            if k not in self.arg_dict:
+                raise MXNetError(f"forward: unknown argument {k!r}")
+            src = v if isinstance(v, NDArray) else NDArray(v, ctx=self._ctx)
+            # rebind in place so tape identity and grad wiring persist
+            self.arg_dict[k]._data = src.as_in_context(self._ctx)._data
+
+        feed = dict(self.arg_dict)
+        feed.update(self.aux_dict)
+
+        def aux_hook(name: str, value: NDArray) -> None:
+            self.aux_dict[name]._data = value._data
+
+        if is_train:
+            for n, arr in self.arg_dict.items():
+                req = self._grad_req[n]
+                arr._grad_req = req
+                arr._grad = self.grad_dict.get(n) if req != "null" else None
+            with autograd.record():
+                outs = _eval_graph(self._sym, feed, training=True,
+                                   aux_hook=aux_hook)
+        else:
+            outs = _eval_graph(self._sym, feed, training=False)
+        self.outputs = outs
+        return outs
+
+    def backward(self, out_grads: Any = None) -> None:
+        """Propagate gradients into ``grad_dict``/``grad_arrays``."""
+        if not self.outputs:
+            raise MXNetError("backward: call forward(is_train=True) first")
+        from .._tape import backward_arrays
+        if out_grads is None:
+            grads = [None] * len(self.outputs)
+        elif isinstance(out_grads, (list, tuple)):
+            grads = [g if (g is None or isinstance(g, NDArray))
+                     else NDArray(g) for g in out_grads]
+        else:
+            grads = [out_grads if isinstance(out_grads, NDArray)
+                     else NDArray(out_grads)]
+        backward_arrays(self.outputs, grads)
+
+    # -- params ------------------------------------------------------------
+    def copy_params_from(self, arg_params: Dict[str, Any],
+                         aux_params: Optional[Dict[str, Any]] = None,
+                         allow_extra_params: bool = False) -> None:
+        for k, v in (arg_params or {}).items():
+            if k in self.arg_dict:
+                self.arg_dict[k]._data = NDArray(v, ctx=self._ctx)._data
+            elif not allow_extra_params:
+                raise MXNetError(f"copy_params_from: unknown arg {k!r}")
+        for k, v in (aux_params or {}).items():
+            if k in self.aux_dict:
+                self.aux_dict[k]._data = NDArray(v, ctx=self._ctx)._data
+            elif not allow_extra_params:
+                raise MXNetError(f"copy_params_from: unknown aux {k!r}")
+
+    def reshape(self, **shapes: Any) -> "Executor":
+        """Return a new executor bound with the given input shapes (shapes
+        of parameters are re-inferred; parameter values are shared)."""
+        ex = Executor.simple_bind(self._sym, self._ctx,
+                                  grad_req=self._grad_req, shapes=shapes)
+        for n, arr in self.arg_dict.items():
+            if n in ex.arg_dict and ex.arg_dict[n].shape == arr.shape:
+                ex.arg_dict[n] = arr
+        for n, arr in self.aux_dict.items():
+            if n in ex.aux_dict and ex.aux_dict[n].shape == arr.shape:
+                ex.aux_dict[n] = arr
+        return ex

@@ -1,0 +1,918 @@
+"""Symbol — declarative graph composition (the symbolic half of the API).
+
+Reference parity (leezu/mxnet): ``python/mxnet/symbol/symbol.py``
+(Symbol composition, ``infer_shape``, ``bind``/``simple_bind``, JSON
+save/load) over the NNVM graph IR (``3rdparty/tvm/nnvm`` ``nnvm::Graph``).
+
+Design (tpu-first): a Symbol is a lightweight Python DAG over the SAME op
+registry the imperative layer uses (one op set, two runtimes — SURVEY.md
+section 0). There is no separate symbolic kernel path: evaluation calls the
+registered op functions on NDArrays, so an Executor is a thin shell over the
+imperative runtime + autograd tape, exactly as the reference's GraphExecutor
+is a shell over the dependency engine. Shape/type inference is abstract
+interpretation with ``jax.eval_shape`` per node — XLA's shape calculus
+replaces NNVM's per-op FInferShape functions.
+"""
+from __future__ import annotations
+
+import ast
+import inspect
+import itertools
+import json
+import threading
+from typing import Any, Callable, Dict, List, Optional, Sequence, Tuple
+
+import jax
+import jax.numpy as jnp
+import numpy as _np
+
+from ..base import MXNetError
+from ..context import Context, current_context
+from ..ndarray.ndarray import NDArray, from_jax
+from ..ndarray.register import get_op, list_ops
+
+__all__ = ["Symbol", "Variable", "var", "Group", "load", "load_json",
+           "FORMAT_VERSION"]
+
+FORMAT_VERSION = 1
+
+_UID = itertools.count()
+
+
+class _NameCounters(threading.local):
+    def __init__(self) -> None:
+        self.counts: Dict[str, int] = {}
+
+
+_NAMES = _NameCounters()
+
+
+def _auto_name(op: str) -> str:
+    base = op.lower().replace("_", "")
+    n = _NAMES.counts.get(base, 0)
+    _NAMES.counts[base] = n + 1
+    return f"{base}{n}"
+
+
+class _SymNode:
+    """One graph node: an op application or a variable (op == 'null')."""
+
+    __slots__ = ("op", "name", "attrs", "inputs", "layout", "is_aux",
+                 "uid", "_user_attrs")
+
+    def __init__(self, op: str, name: str, attrs: Dict[str, Any],
+                 inputs: List[Tuple["_SymNode", int]],
+                 layout: List[Tuple[str, ...]], is_aux: bool = False) -> None:
+        self.op = op            # registered op name, or "null"
+        self.name = name
+        self.attrs = attrs      # python values (repr'd on save)
+        self.inputs = inputs    # [(node, out_idx)]
+        # layout: how to rebuild the python call; entries
+        #   ("sym", param)           one Symbol input bound to `param`
+        #   ("symlist", param, n)    n inputs bound as a list to `param`
+        #   ("varsym", n)            n inputs bound as *args
+        self.layout = layout
+        self.is_aux = is_aux
+        self.uid = next(_UID)
+        self._user_attrs: Dict[str, str] = {}
+
+    def n_outputs(self) -> int:
+        return len(_multi_out_slots(self.op)) if self.op in _MULTI_OUT else 1
+
+
+# ops whose python fn returns a tuple; maps op -> output name suffixes.
+# batch_norm's (mean, var) outputs are consumed by the executor for the
+# moving-stat update and not exposed as graph outputs (reference parity:
+# BatchNorm's aux update happens inside the op).
+_MULTI_OUT: Dict[str, Tuple[str, ...]] = {}
+
+
+def _multi_out_slots(op: str) -> Tuple[str, ...]:
+    return _MULTI_OUT.get(op, ("output",))
+
+
+def _topo_order(heads: Sequence[Tuple[_SymNode, int]]) -> List[_SymNode]:
+    seen: Dict[int, _SymNode] = {}
+    order: List[_SymNode] = []
+
+    def visit(node: _SymNode) -> None:
+        if node.uid in seen:
+            return
+        seen[node.uid] = node
+        for n, _ in node.inputs:
+            visit(n)
+        order.append(node)
+
+    for n, _ in heads:
+        visit(n)
+    return order
+
+
+class Symbol:
+    """A symbolic multi-output expression (reference: ``mx.sym.Symbol``)."""
+
+    __slots__ = ("_heads",)
+
+    def __init__(self, heads: Sequence[Tuple[_SymNode, int]]) -> None:
+        self._heads = list(heads)
+
+    # -- introspection -----------------------------------------------------
+    @property
+    def name(self) -> str:
+        if len(self._heads) == 1:
+            return self._heads[0][0].name
+        return f"group[{','.join(n.name for n, _ in self._heads)}]"
+
+    def __repr__(self) -> str:
+        args = ", ".join(self.list_arguments())
+        return f"<Symbol {self.name}({args})>"
+
+    def list_arguments(self) -> List[str]:
+        return [n.name for n in _topo_order(self._heads)
+                if n.op == "null" and not n.is_aux]
+
+    def list_auxiliary_states(self) -> List[str]:
+        return [n.name for n in _topo_order(self._heads)
+                if n.op == "null" and n.is_aux]
+
+    def list_outputs(self) -> List[str]:
+        outs = []
+        for node, idx in self._heads:
+            slots = _multi_out_slots(node.op)
+            suffix = slots[idx] if idx < len(slots) else f"output{idx}"
+            outs.append(f"{node.name}_{suffix}" if node.op != "null"
+                        else node.name)
+        return outs
+
+    def list_inputs(self) -> List[str]:
+        return [n.name for n in _topo_order(self._heads) if n.op == "null"]
+
+    @property
+    def num_outputs(self) -> int:
+        return len(self._heads)
+
+    def __len__(self) -> int:
+        return len(self._heads)
+
+    def __iter__(self):
+        for i in range(len(self._heads)):
+            yield self[i]
+
+    def __getitem__(self, key) -> "Symbol":
+        if isinstance(key, str):
+            names = self.list_outputs()
+            if key not in names:
+                raise MXNetError(f"no output named {key!r}; have {names}")
+            return Symbol([self._heads[names.index(key)]])
+        if isinstance(key, slice):
+            return Symbol(self._heads[key])
+        return Symbol([self._heads[key]])
+
+    def get_internals(self) -> "Symbol":
+        """Every node's primary output as a group (reference:
+        ``Symbol.get_internals``)."""
+        return Symbol([(n, 0) for n in _topo_order(self._heads)])
+
+    def get_children(self) -> Optional["Symbol"]:
+        node = self._head_node()
+        if not node.inputs:
+            return None
+        return Symbol(list(node.inputs))
+
+    def _head_node(self) -> _SymNode:
+        if len(self._heads) != 1:
+            raise MXNetError("operation requires a single-output symbol")
+        return self._heads[0][0]
+
+    # -- attributes --------------------------------------------------------
+    def attr(self, key: str) -> Optional[str]:
+        return self._head_node()._user_attrs.get(key)
+
+    def list_attr(self) -> Dict[str, str]:
+        return dict(self._head_node()._user_attrs)
+
+    def attr_dict(self) -> Dict[str, Dict[str, str]]:
+        out: Dict[str, Dict[str, str]] = {}
+        for n in _topo_order(self._heads):
+            if n._user_attrs:
+                out[n.name] = dict(n._user_attrs)
+        return out
+
+    def _set_attr(self, **kwargs: str) -> None:
+        self._head_node()._user_attrs.update(
+            {k: str(v) for k, v in kwargs.items()})
+
+    # -- arithmetic sugar --------------------------------------------------
+    def _binop(self, op: str, other: Any, swap: bool = False) -> "Symbol":
+        a, b = (other, self) if swap else (self, other)
+        return _apply_op(op, a, b)
+
+    def __add__(self, o): return self._binop("add", o)
+    def __radd__(self, o): return self._binop("add", o, True)
+    def __sub__(self, o): return self._binop("subtract", o)
+    def __rsub__(self, o): return self._binop("subtract", o, True)
+    def __mul__(self, o): return self._binop("multiply", o)
+    def __rmul__(self, o): return self._binop("multiply", o, True)
+    def __truediv__(self, o): return self._binop("divide", o)
+    def __rtruediv__(self, o): return self._binop("divide", o, True)
+    def __pow__(self, o): return self._binop("power", o)
+    def __rpow__(self, o): return self._binop("power", o, True)
+    def __mod__(self, o): return self._binop("mod", o)
+    def __neg__(self): return self._binop("multiply", -1.0)
+    def __matmul__(self, o): return self._binop("matmul", o)
+    def __eq__(self, o): return self._binop("equal", o)
+    def __ne__(self, o): return self._binop("not_equal", o)
+    def __lt__(self, o): return self._binop("less", o)
+    def __le__(self, o): return self._binop("less_equal", o)
+    def __gt__(self, o): return self._binop("greater", o)
+    def __ge__(self, o): return self._binop("greater_equal", o)
+    __hash__ = None  # type: ignore[assignment]
+
+    def abs(self): return _apply_op("abs", self)
+    def exp(self): return _apply_op("exp", self)
+    def log(self): return _apply_op("log", self)
+    def sqrt(self): return _apply_op("sqrt", self)
+    def square(self): return _apply_op("square", self)
+    def reshape(self, shape): return _apply_op("reshape", self, shape)
+    def transpose(self, axes=None): return _apply_op("transpose", self, axes)
+    def sum(self, **kw): return _apply_op("sum", self, **kw)
+    def mean(self, **kw): return _apply_op("mean", self, **kw)
+    def astype(self, dtype): return _apply_op("cast", self, dtype=dtype)
+
+    # -- shape/type inference ---------------------------------------------
+    def infer_shape(self, *args, **kwargs):
+        """Returns ``(arg_shapes, out_shapes, aux_shapes)`` aligned with
+        ``list_arguments()`` / ``list_outputs()`` / ``list_auxiliary_states``.
+        """
+        res = self._infer(kwargs, partial=False)
+        return res
+
+    def infer_shape_partial(self, *args, **kwargs):
+        return self._infer(kwargs, partial=True)
+
+    def _infer(self, known: Dict[str, tuple], partial: bool):
+        structs = _infer_structs(self, known, partial=partial)
+        if structs is None:
+            return None, None, None
+        var_structs, out_structs = structs
+        args = [var_structs.get(n) for n in self.list_arguments()]
+        auxs = [var_structs.get(n) for n in self.list_auxiliary_states()]
+        to_shape = lambda s: tuple(s.shape) if s is not None else None
+        arg_shapes = [to_shape(s) for s in args]
+        aux_shapes = [to_shape(s) for s in auxs]
+        out_shapes = [to_shape(s) for s in out_structs]
+        if not partial and any(s is None for s in arg_shapes + out_shapes):
+            missing = [n for n, s in zip(self.list_arguments(), arg_shapes)
+                       if s is None]
+            raise MXNetError(
+                f"infer_shape: unresolved shapes for {missing}; provide "
+                f"them as keyword shapes (e.g. data=(batch, ...))")
+        return arg_shapes, out_shapes, aux_shapes
+
+    def infer_type(self, *args, **kwargs):
+        """Dtype propagation (promotion-based — XLA's result_type calculus
+        replaces NNVM per-op FInferType). Returns
+        ``(arg_types, out_types, aux_types)``."""
+        var_t: Dict[str, Any] = {}
+        memo: Dict[int, Any] = {}
+        for node in _topo_order(self._heads):
+            if node.op == "null":
+                dt = kwargs.get(node.name, node.attrs.get("__dtype__"))
+                var_t[node.name] = _np.dtype(dt) if dt is not None else None
+                memo[node.uid] = var_t[node.name]
+                continue
+            in_t = [memo.get(m.uid) for m, _ in node.inputs]
+            out_t = _propagate_dtype(node, in_t)
+            # back-fill float params from the data dtype (implicit weights
+            # follow their consumer, as NNVM's back-inference did)
+            if out_t is not None:
+                for m, _ in node.inputs:
+                    if m.op == "null" and var_t.get(m.name) is None and \
+                            node.op in _PARAM_SPECS:
+                        var_t[m.name] = memo[m.uid] = out_t
+            memo[node.uid] = out_t
+        args_out = [var_t.get(n) for n in self.list_arguments()]
+        outs = [memo.get(n.uid) for n, _ in self._heads]
+        auxs = [var_t.get(n) for n in self.list_auxiliary_states()]
+        return args_out, outs, auxs
+
+    # -- evaluation / binding ---------------------------------------------
+    def eval(self, ctx: Optional[Context] = None, **kwargs: Any):
+        """Evaluate imperatively with named NDArray inputs."""
+        feed = {k: v if isinstance(v, NDArray) else NDArray(v)
+                for k, v in kwargs.items()}
+        return _eval_graph(self, feed)
+
+    def bind(self, ctx: Optional[Context] = None, args: Any = None,
+             args_grad: Any = None, grad_req: Any = "write",
+             aux_states: Any = None, **kwargs: Any):
+        from .executor import Executor
+        return Executor(self, ctx or current_context(), args, args_grad,
+                        grad_req, aux_states)
+
+    def simple_bind(self, ctx: Optional[Context] = None,
+                    grad_req: Any = "write", **shapes: Any):
+        from .executor import Executor
+        return Executor.simple_bind(self, ctx or current_context(),
+                                    grad_req, shapes)
+
+    # -- serialization -----------------------------------------------------
+    def tojson(self) -> str:
+        order = _topo_order(self._heads)
+        nid = {n.uid: i for i, n in enumerate(order)}
+        nodes = []
+        for n in order:
+            attrs = {k: repr(v) for k, v in n.attrs.items()}
+            if n.layout:
+                attrs["__layout__"] = repr(n.layout)
+            if n.is_aux:
+                attrs["__aux__"] = "1"
+            if n._user_attrs:
+                attrs["__user__"] = repr(n._user_attrs)
+            nodes.append({
+                "op": n.op, "name": n.name, "attrs": attrs,
+                "inputs": [[nid[m.uid], idx, 0] for m, idx in n.inputs],
+            })
+        payload = {
+            "nodes": nodes,
+            "arg_nodes": [i for i, n in enumerate(order) if n.op == "null"],
+            "heads": [[nid[n.uid], idx, 0] for n, idx in self._heads],
+            "attrs": {"mxnet_version": ("str", "mxnet_tpu"),
+                      "format_version": ("int", FORMAT_VERSION)},
+        }
+        return json.dumps(payload, indent=2)
+
+    def save(self, fname: str) -> None:
+        with open(fname, "w") as f:
+            f.write(self.tojson())
+
+
+# ---------------------------------------------------------------------------
+# Construction API
+# ---------------------------------------------------------------------------
+
+def Variable(name: str, shape: Optional[tuple] = None, dtype: Any = None,
+             attr: Optional[Dict[str, str]] = None, init: Any = None,
+             lr_mult: Optional[float] = None, wd_mult: Optional[float] = None,
+             stype: Optional[str] = None, **kwargs: Any) -> Symbol:
+    """A named graph input (reference: ``mx.sym.Variable``)."""
+    attrs: Dict[str, Any] = {}
+    if shape is not None:
+        attrs["__shape__"] = tuple(shape)
+    if dtype is not None:
+        attrs["__dtype__"] = _np.dtype(dtype).name
+    if init is not None:
+        attrs["__init__"] = str(init)
+    if lr_mult is not None:
+        attrs["__lr_mult__"] = lr_mult
+    if wd_mult is not None:
+        attrs["__wd_mult__"] = wd_mult
+    node = _SymNode("null", name, attrs, [], [])
+    if attr:
+        node._user_attrs.update({k: str(v) for k, v in attr.items()})
+    return Symbol([(node, 0)])
+
+
+var = Variable
+
+
+def Group(symbols: Sequence[Symbol]) -> Symbol:
+    """Combine symbols into one multi-output symbol."""
+    heads: List[Tuple[_SymNode, int]] = []
+    for s in symbols:
+        heads.extend(s._heads)
+    return Symbol(heads)
+
+
+def load(fname: str) -> Symbol:
+    with open(fname) as f:
+        return load_json(f.read())
+
+
+def _parse_attr_value(v: Any) -> Any:
+    """Attr values round-trip through ``repr``; reference-format files
+    store plain strings (``act_type: "relu"``) — fall back to the raw
+    string when it is not a python literal."""
+    if not isinstance(v, str):
+        return v
+    try:
+        return ast.literal_eval(v)
+    except (ValueError, SyntaxError):
+        return v
+
+
+def _default_layout(op: str, attrs: Dict[str, Any],
+                    n_inputs: int) -> List[Tuple[str, ...]]:
+    """Synthesize an input layout for graphs saved without ``__layout__``
+    (reference-format json): bind inputs positionally to the op's leading
+    non-attr parameters."""
+    fn = get_op(op)
+    sig = inspect.signature(fn)
+    layout: List[Tuple[str, ...]] = []
+    taken = 0
+    for pname, p in sig.parameters.items():
+        if taken >= n_inputs:
+            break
+        if pname in attrs:
+            continue
+        if p.kind is inspect.Parameter.VAR_POSITIONAL:
+            layout.append(("varsym", n_inputs - taken))
+            taken = n_inputs
+            break
+        layout.append(("sym", pname))
+        taken += 1
+    if taken < n_inputs:
+        raise MXNetError(
+            f"load: cannot map {n_inputs} inputs onto op {op!r} signature")
+    return layout
+
+
+def load_json(json_str: str) -> Symbol:
+    payload = json.loads(json_str)
+    nodes_js = payload["nodes"]
+    built: List[_SymNode] = []
+    for nd_js in nodes_js:
+        raw_attrs = dict(nd_js.get("attrs") or nd_js.get("param") or {})
+        layout_s = raw_attrs.pop("__layout__", None)
+        is_aux = raw_attrs.pop("__aux__", None) == "1"
+        user = ast.literal_eval(raw_attrs.pop("__user__", "{}"))
+        attrs = {k: _parse_attr_value(v) for k, v in raw_attrs.items()}
+        op = nd_js["op"]
+        if op != "null" and op not in list_ops():
+            alias = _ALIAS_TO_CANONICAL.get(op)
+            if alias is None:
+                raise MXNetError(f"load: unknown op {op!r} in graph json")
+            op = alias
+        inputs = [(built[i], idx) for i, idx, *_ in nd_js["inputs"]]
+        if layout_s is not None:
+            layout = [tuple(e) for e in ast.literal_eval(layout_s)]
+        elif op != "null" and inputs:
+            layout = _default_layout(op, attrs, len(inputs))
+        else:
+            layout = []
+        node = _SymNode(op, nd_js["name"], attrs, inputs, layout,
+                        is_aux=is_aux)
+        node._user_attrs = {str(k): str(v) for k, v in user.items()}
+        built.append(node)
+    heads = [(built[i], idx) for i, idx, *_ in payload["heads"]]
+    return Symbol(heads)
+
+
+# ---------------------------------------------------------------------------
+# Generic op application: bind python args, split Symbol inputs from attrs
+# ---------------------------------------------------------------------------
+
+# ops that auto-create parameter variables when omitted (the reference's
+# implicit-weight UX: sym.FullyConnected(data, num_hidden=10) creates
+# fc_weight/fc_bias). Each entry: param kwarg -> (suffix, is_aux).
+_PARAM_SPECS: Dict[str, Dict[str, Tuple[str, bool]]] = {
+    "fully_connected": {"weight": ("weight", False), "bias": ("bias", False)},
+    "convolution": {"weight": ("weight", False), "bias": ("bias", False)},
+    "deconvolution": {"weight": ("weight", False), "bias": ("bias", False)},
+    "batch_norm": {"gamma": ("gamma", False), "beta": ("beta", False),
+                   "running_mean": ("moving_mean", True),
+                   "running_var": ("moving_var", True)},
+    "layer_norm": {"gamma": ("gamma", False), "beta": ("beta", False)},
+    "group_norm": {"gamma": ("gamma", False), "beta": ("beta", False)},
+    "instance_norm": {"gamma": ("gamma", False), "beta": ("beta", False)},
+    "rms_norm": {"gamma": ("gamma", False)},
+    "embedding": {"weight": ("weight", False)},
+    "prelu": {"gamma": ("gamma", False)},
+}
+
+# per-op hooks resolving auto-created param shapes from the data shape
+# (the NNVM FInferShape back-inference the symbolic API depends on)
+def _fc_shapes(structs, attrs):
+    d = structs.get("data")
+    if d is None:
+        return {}
+    nh = attrs.get("num_hidden")
+    if nh is None:
+        return {}
+    flat = attrs.get("flatten", True)
+    in_units = int(_np.prod(d.shape[1:])) if (flat and len(d.shape) > 2) \
+        else d.shape[-1]
+    return {"weight": (nh, in_units), "bias": (nh,)}
+
+
+def _conv_shapes(structs, attrs):
+    d = structs.get("data")
+    if d is None:
+        return {}
+    layout = attrs.get("layout", "NCHW")
+    kernel = attrs.get("kernel")
+    nf = attrs.get("num_filter")
+    if kernel is None or not nf:
+        return {}
+    if isinstance(kernel, int):
+        kernel = (kernel,) * (len(d.shape) - 2)
+    c = d.shape[layout.index("C")]
+    ng = attrs.get("num_group", 1)
+    return {"weight": (nf, c // ng) + tuple(kernel), "bias": (nf,)}
+
+
+def _deconv_shapes(structs, attrs):
+    d = structs.get("data")
+    if d is None:
+        return {}
+    layout = attrs.get("layout", "NCHW")
+    kernel = attrs.get("kernel")
+    nf = attrs.get("num_filter")
+    if kernel is None or not nf:
+        return {}
+    if isinstance(kernel, int):
+        kernel = (kernel,) * (len(d.shape) - 2)
+    c = d.shape[layout.index("C")]
+    ng = attrs.get("num_group", 1)
+    return {"weight": (c, nf // ng) + tuple(kernel), "bias": (nf,)}
+
+
+def _bn_shapes(structs, attrs):
+    d = structs.get("data")
+    if d is None:
+        return {}
+    ax = attrs.get("axis", 1) % len(d.shape)
+    c = (d.shape[ax],)
+    return {"gamma": c, "beta": c, "running_mean": c, "running_var": c}
+
+
+def _ln_shapes(structs, attrs):
+    d = structs.get("data")
+    if d is None:
+        return {}
+    ax = attrs.get("axis", -1) % len(d.shape)
+    return {"gamma": (d.shape[ax],), "beta": (d.shape[ax],)}
+
+
+def _gn_shapes(structs, attrs):
+    d = structs.get("data")
+    if d is None:
+        return {}
+    return {"gamma": (d.shape[1],), "beta": (d.shape[1],)}
+
+
+def _emb_shapes(structs, attrs):
+    i, o = attrs.get("input_dim"), attrs.get("output_dim")
+    if i and o:
+        return {"weight": (i, o)}
+    return {}
+
+
+def _prelu_shapes(structs, attrs):
+    d = structs.get("data")
+    if d is None:
+        return {}
+    return {"gamma": (d.shape[1] if len(d.shape) > 1 else 1,)}
+
+
+_SHAPE_HOOKS: Dict[str, Callable] = {
+    "fully_connected": _fc_shapes,
+    "convolution": _conv_shapes,
+    "deconvolution": _deconv_shapes,
+    "batch_norm": _bn_shapes,
+    "layer_norm": _ln_shapes,
+    "group_norm": _gn_shapes,
+    "instance_norm": _gn_shapes,
+    "rms_norm": lambda s, a: ({"gamma": (s["data"].shape[a.get("axis", -1)],)}
+                              if s.get("data") is not None else {}),
+    "embedding": _emb_shapes,
+    "prelu": _prelu_shapes,
+}
+
+# CamelCase aliases (the reference exposes both spellings)
+_ALIASES: Dict[str, str] = {
+    "FullyConnected": "fully_connected",
+    "Convolution": "convolution",
+    "Deconvolution": "deconvolution",
+    "Activation": "activation",
+    "BatchNorm": "batch_norm",
+    "LayerNorm": "layer_norm",
+    "GroupNorm": "group_norm",
+    "InstanceNorm": "instance_norm",
+    "Pooling": "pooling",
+    "Dropout": "dropout",
+    "Embedding": "embedding",
+    "LeakyReLU": "leaky_relu",
+    "SoftmaxOutput": "softmax_output",
+    "LinearRegressionOutput": "linear_regression_output",
+    "LogisticRegressionOutput": "logistic_regression_output",
+    "MAERegressionOutput": "mae_regression_output",
+    "MakeLoss": "make_loss",
+    "BlockGrad": "stop_gradient",
+    "SoftmaxActivation": "softmax",
+    "Concat": "concat",
+    "Reshape": "reshape",
+    "Flatten": "flatten",
+    "Cast": "cast",
+    "SwapAxis": "swapaxes",
+    "SequenceMask": "sequence_mask",
+    "SequenceLast": "sequence_last",
+    "SequenceReverse": "sequence_reverse",
+    "L2Normalization": "l2_normalization",
+    "LRN": "lrn",
+    "Pad": "pad",
+    "SliceChannel": "slice_channel",
+    "UpSampling": "up_sampling",
+    "softmax_cross_entropy": "softmax_cross_entropy",
+}
+_ALIAS_TO_CANONICAL = dict(_ALIASES)
+
+
+def _apply_op(op: str, *args: Any, **kwargs: Any) -> Symbol:
+    """Create a graph node for op applied to Symbol/attr arguments."""
+    op = _ALIASES.get(op, op)
+    fn = get_op(op)
+    name = kwargs.pop("name", None) or _auto_name(op)
+    user_attr = kwargs.pop("attr", None)
+
+    sig = inspect.signature(fn)
+    try:
+        bound = sig.bind_partial(*args, **kwargs)
+    except TypeError as e:
+        raise MXNetError(f"symbol op {op!r}: {e}") from None
+
+    inputs: List[Tuple[_SymNode, int]] = []
+    layout: List[Tuple[str, ...]] = []
+    attrs: Dict[str, Any] = {}
+
+    for pname, value in bound.arguments.items():
+        kind = sig.parameters[pname].kind
+        if kind is inspect.Parameter.VAR_POSITIONAL:
+            if all(isinstance(v, Symbol) for v in value) and value:
+                for v in value:
+                    inputs.extend(v._heads[:1])
+                layout.append(("varsym", len(value)))
+            else:
+                attrs[pname] = value
+        elif isinstance(value, Symbol):
+            if len(value._heads) != 1:
+                raise MXNetError(
+                    f"symbol op {op!r}: input {pname!r} must be a "
+                    f"single-output symbol (got {len(value._heads)} outputs)")
+            inputs.extend(value._heads)
+            layout.append(("sym", pname))
+        elif isinstance(value, (list, tuple)) and value and \
+                all(isinstance(v, Symbol) for v in value):
+            for v in value:
+                inputs.extend(v._heads[:1])
+            layout.append(("symlist", pname, len(value)))
+        elif kind is inspect.Parameter.VAR_KEYWORD:
+            attrs.update(value)
+        else:
+            attrs[pname] = value
+
+    # implicit parameter variables (fc_weight etc.)
+    spec = _PARAM_SPECS.get(op)
+    if spec is not None:
+        bound_names = {e[1] for e in layout if e[0] == "sym"}
+        for pname, (suffix, is_aux) in spec.items():
+            if pname in bound_names or pname in attrs:
+                continue
+            if pname == "bias" and attrs.get("no_bias"):
+                continue
+            vnode = _SymNode("null", f"{name}_{suffix}", {}, [], [],
+                             is_aux=is_aux)
+            inputs.append((vnode, 0))
+            layout.append(("sym", pname))
+        # aux slots the user wired explicitly still count as aux states
+        it = iter(inputs)
+        for entry in layout:
+            if entry[0] == "sym":
+                node, _ = next(it)
+                if entry[1] in spec and spec[entry[1]][1] and \
+                        node.op == "null":
+                    node.is_aux = True
+            elif entry[0] == "symlist":
+                for _ in range(entry[2]):
+                    next(it)
+            elif entry[0] == "varsym":
+                for _ in range(entry[1]):
+                    next(it)
+
+    node = _SymNode(op, name, attrs, inputs, layout)
+    if user_attr:
+        node._user_attrs.update({k: str(v) for k, v in user_attr.items()})
+
+    # statically-known multi-output ops (reference: SliceChannel etc.)
+    n_out = 1
+    if op == "slice_channel":
+        n_out = attrs.get("num_outputs", 1)
+    elif op in ("split", "array_split"):
+        sections = attrs.get("indices_or_sections")
+        if isinstance(sections, int):
+            n_out = sections
+        elif isinstance(sections, (list, tuple)):
+            n_out = len(sections) + 1
+    return Symbol([(node, i) for i in range(n_out)])
+
+
+def _call_node(node: _SymNode, in_vals: Sequence[Any],
+               training: bool = False) -> Tuple[Any, ...]:
+    """Rebuild the python call for a node and run it on NDArrays."""
+    fn = get_op(node.op)
+    kwargs = dict(node.attrs)
+    varargs: List[Any] = []
+    it = iter(in_vals)
+    for entry in node.layout:
+        if entry[0] == "sym":
+            kwargs[entry[1]] = next(it)
+        elif entry[0] == "symlist":
+            kwargs[entry[1]] = [next(it) for _ in range(entry[2])]
+        elif entry[0] == "varsym":
+            varargs = [next(it) for _ in range(entry[1])]
+        else:
+            raise MXNetError(f"bad layout entry {entry!r}")
+    if node.op in ("batch_norm", "dropout"):
+        kwargs.setdefault("training", training)
+    out = fn(*varargs, **kwargs)
+    return tuple(out) if isinstance(out, (tuple, list)) else (out,)
+
+
+def _eval_graph(sym: Symbol, feed: Dict[str, NDArray],
+                training: bool = False,
+                aux_hook: Optional[Callable] = None) -> List[NDArray]:
+    """Imperatively evaluate a symbol. ``aux_hook(name, value)`` receives
+    moving-stat updates from batch_norm nodes in training mode."""
+    memo: Dict[int, Tuple[Any, ...]] = {}
+    for node in _topo_order(sym._heads):
+        if node.op == "null":
+            if node.name not in feed:
+                raise MXNetError(f"eval: missing input {node.name!r}")
+            memo[node.uid] = (feed[node.name],)
+            continue
+        ins = [memo[m.uid][idx] for m, idx in node.inputs]
+        outs = _call_node(node, ins, training=training)
+        if node.op == "batch_norm":
+            out, mean, vvar = outs
+            if training and not node.attrs.get("use_global_stats", False) \
+                    and aux_hook is not None:
+                mom = node.attrs.get("momentum", 0.9)
+                names = _bn_aux_names(node)
+                if names is not None:
+                    rm_name, rv_name = names
+                    rm, rv = feed[rm_name], feed[rv_name]
+                    aux_hook(rm_name, rm * mom + mean.detach() * (1 - mom))
+                    aux_hook(rv_name, rv * mom + vvar.detach() * (1 - mom))
+            outs = (out,)
+        memo[node.uid] = outs
+    return [memo[n.uid][idx] for n, idx in sym._heads]
+
+
+def _bn_aux_names(node: _SymNode) -> Optional[Tuple[str, str]]:
+    names = {}
+    it = iter(node.inputs)
+    for entry in node.layout:
+        if entry[0] == "sym":
+            m, _ = next(it)
+            if entry[1] in ("running_mean", "running_var"):
+                names[entry[1]] = m.name
+        elif entry[0] == "symlist":
+            for _ in range(entry[2]):
+                next(it)
+        elif entry[0] == "varsym":
+            for _ in range(entry[1]):
+                next(it)
+    if "running_mean" in names and "running_var" in names:
+        return names["running_mean"], names["running_var"]
+    return None
+
+
+_BOOL_OUT_OPS = frozenset(["equal", "not_equal", "less", "less_equal",
+                           "greater", "greater_equal", "logical_and",
+                           "logical_or", "logical_xor", "logical_not",
+                           "isnan", "isinf", "isfinite"])
+_INT_OUT_OPS = frozenset(["argmax", "argmin", "argsort", "nonzero"])
+
+
+def _propagate_dtype(node: _SymNode, in_dtypes: List[Any]):
+    """Promotion-based per-node dtype rule for ``infer_type``."""
+    if node.op == "cast" or node.op == "astype":
+        dt = node.attrs.get("dtype")
+        return _np.dtype(dt) if dt is not None else None
+    if node.op in _BOOL_OUT_OPS:
+        return _np.dtype(_np.bool_)
+    if node.op in _INT_OUT_OPS:
+        return _np.dtype(_np.int64)
+    known = [d for d in in_dtypes if d is not None]
+    if not known:
+        # creation ops (zeros/ones/...) carry a dtype attr
+        dt = node.attrs.get("dtype")
+        return _np.dtype(dt) if dt is not None else (
+            _np.dtype(_np.float32) if not node.inputs else None)
+    try:
+        return _np.dtype(_np.result_type(*known))
+    except TypeError:
+        return known[0]
+
+
+# ---------------------------------------------------------------------------
+# Abstract interpretation (shape/type inference)
+# ---------------------------------------------------------------------------
+
+def _infer_structs(sym: Symbol, known: Dict[str, tuple], partial: bool,
+                   var_dtypes: Optional[Dict[str, Any]] = None):
+    """Walk the graph propagating ShapeDtypeStructs.
+
+    Returns (var_structs: name->struct, out_structs aligned with heads),
+    with None entries where inference was impossible (partial mode).
+    """
+    var_dtypes = var_dtypes or {}
+    var_structs: Dict[str, Optional[jax.ShapeDtypeStruct]] = {}
+    memo: Dict[int, Optional[Tuple[Any, ...]]] = {}
+
+    order = _topo_order(sym._heads)
+    node_by_name = {n.name: n for n in order}
+
+    def struct_for_var(node: _SymNode) -> Optional[jax.ShapeDtypeStruct]:
+        if node.name in var_structs:
+            return var_structs[node.name]
+        shape = known.get(node.name, node.attrs.get("__shape__"))
+        dtype = var_dtypes.get(node.name,
+                               node.attrs.get("__dtype__", "float32"))
+        s = jax.ShapeDtypeStruct(tuple(shape), _np.dtype(dtype)) \
+            if shape is not None else None
+        var_structs[node.name] = s
+        return s
+
+    for node in order:
+        if node.op == "null":
+            memo[node.uid] = (struct_for_var(node),)
+            continue
+
+        # resolve implicit-param shapes from data shape (back-inference)
+        hook = _SHAPE_HOOKS.get(node.op)
+        if hook is not None:
+            in_named: Dict[str, Any] = {}
+            it = iter(node.inputs)
+            for entry in node.layout:
+                if entry[0] == "sym":
+                    m, idx = next(it)
+                    st = memo.get(m.uid)
+                    in_named[entry[1]] = st[idx] if st else None
+                elif entry[0] == "symlist":
+                    for _ in range(entry[2]):
+                        next(it)
+                elif entry[0] == "varsym":
+                    for _ in range(entry[1]):
+                        next(it)
+            inferred = hook(in_named, node.attrs)
+            it = iter(node.inputs)
+            for entry in node.layout:
+                if entry[0] == "sym":
+                    m, idx = next(it)
+                    if m.op == "null" and var_structs.get(m.name) is None \
+                            and entry[1] in inferred:
+                        dt = var_dtypes.get(
+                            m.name, m.attrs.get("__dtype__", None))
+                        if dt is None:
+                            d = in_named.get("data")
+                            dt = d.dtype if d is not None else "float32"
+                        var_structs[m.name] = jax.ShapeDtypeStruct(
+                            tuple(inferred[entry[1]]), _np.dtype(dt))
+                        memo[m.uid] = (var_structs[m.name],)
+                elif entry[0] == "symlist":
+                    for _ in range(entry[2]):
+                        next(it)
+                elif entry[0] == "varsym":
+                    for _ in range(entry[1]):
+                        next(it)
+
+        in_structs = []
+        ok = True
+        for m, idx in node.inputs:
+            st = memo.get(m.uid)
+            if st is None or st[idx] is None:
+                ok = False
+                break
+            in_structs.append(st[idx])
+        if not ok:
+            if not partial:
+                raise MXNetError(
+                    f"infer_shape: inputs of node {node.name!r} "
+                    f"({node.op}) are unresolved")
+            memo[node.uid] = None
+            continue
+
+        def f(*raw):
+            ins = [from_jax(r) for r in raw]
+            outs = _call_node(node, ins, training=False)
+            return [o._data for o in outs]
+
+        try:
+            out = jax.eval_shape(f, *in_structs)
+        except Exception as e:
+            if partial:
+                memo[node.uid] = None
+                continue
+            raise MXNetError(
+                f"infer_shape failed at node {node.name!r} ({node.op}): "
+                f"{e}") from None
+        if node.op == "batch_norm":
+            out = out[:1]
+        memo[node.uid] = tuple(out)
+
+    out_structs = []
+    for n, idx in sym._heads:
+        st = memo.get(n.uid)
+        out_structs.append(st[idx] if st else None)
+    return var_structs, out_structs

@@ -1,0 +1,308 @@
+"""Symbol API tests (reference strategy: tests/python/unittest/test_symbol.py
+and the symbolic halves of test_operator.py — composition, infer_shape,
+json round trip, executor fwd/bwd vs imperative autograd)."""
+import numpy as onp
+import pytest
+
+import mxnet_tpu as mx
+from mxnet_tpu import symbol as sym
+
+
+def test_compose_and_listing():
+    data = sym.Variable("data")
+    fc1 = sym.FullyConnected(data, num_hidden=16, name="fc1")
+    act = sym.Activation(fc1, act_type="relu", name="relu1")
+    out = sym.FullyConnected(act, num_hidden=4, name="fc2")
+    assert out.list_arguments() == [
+        "data", "fc1_weight", "fc1_bias", "fc2_weight", "fc2_bias"]
+    assert out.list_outputs() == ["fc2_output"]
+    assert out.name == "fc2"
+    internals = out.get_internals()
+    assert "relu1_output" in internals.list_outputs()
+
+
+def test_infer_shape_backward_inference():
+    data = sym.Variable("data")
+    c = sym.Convolution(data, kernel=(3, 3), num_filter=8, pad=(1, 1),
+                        name="conv0")
+    b = sym.BatchNorm(c, name="bn0")
+    f = sym.FullyConnected(sym.Flatten(b), num_hidden=10, name="fc")
+    arg_shapes, out_shapes, aux_shapes = f.infer_shape(data=(2, 3, 8, 8))
+    shapes = dict(zip(f.list_arguments(), arg_shapes))
+    assert shapes["conv0_weight"] == (8, 3, 3, 3)
+    assert shapes["conv0_bias"] == (8,)
+    assert shapes["bn0_gamma"] == (8,)
+    assert shapes["fc_weight"] == (10, 8 * 8 * 8)
+    assert out_shapes == [(2, 10)]
+    aux = dict(zip(f.list_auxiliary_states(), aux_shapes))
+    assert aux == {"bn0_moving_mean": (8,), "bn0_moving_var": (8,)}
+
+
+def test_infer_shape_partial():
+    a = sym.Variable("a")
+    b = sym.Variable("b")
+    out = a + b
+    args, outs, _ = out.infer_shape_partial(a=(2, 3))
+    assert outs == [None] or outs == [(2, 3)]  # b unknown -> no out shape
+    with pytest.raises(mx.MXNetError):
+        out.infer_shape(a=(2, 3))
+
+
+def test_infer_type():
+    data = sym.Variable("data")
+    out = sym.FullyConnected(data, num_hidden=4, name="fc")
+    arg_types, out_types, _ = out.infer_type(data=onp.float32)
+    assert out_types[0] == onp.float32
+
+
+def test_json_roundtrip():
+    data = sym.Variable("data", shape=(4, 10))
+    net = sym.FullyConnected(data, num_hidden=3, name="fc")
+    net = sym.softmax(net)
+    js = net.tojson()
+    net2 = sym.load_json(js)
+    assert net2.list_arguments() == net.list_arguments()
+    assert net2.list_outputs() == net.list_outputs()
+    # evaluation equivalence after round trip
+    feed = {n: mx.np.array(onp.random.RandomState(0).randn(
+        *s).astype("float32"))
+        for n, s in zip(net.list_arguments(),
+                        net.infer_shape()[0])}
+    o1 = net.eval(**feed)[0].asnumpy()
+    o2 = net2.eval(**feed)[0].asnumpy()
+    onp.testing.assert_allclose(o1, o2, rtol=1e-6)
+
+
+def test_symbol_arithmetic_eval():
+    a = sym.Variable("a")
+    b = sym.Variable("b")
+    c = (a + b * 2.0) / (a - b + 3.0)
+    av = onp.random.randn(3, 4).astype("float32")
+    bv = onp.random.randn(3, 4).astype("float32")
+    out = c.eval(a=mx.np.array(av), b=mx.np.array(bv))[0].asnumpy()
+    onp.testing.assert_allclose(out, (av + bv * 2) / (av - bv + 3),
+                                rtol=1e-5)
+
+
+def test_executor_forward_backward_matches_autograd():
+    onp.random.seed(0)
+    x = onp.random.randn(5, 6).astype("float32")
+    w = onp.random.randn(3, 6).astype("float32")
+
+    data = sym.Variable("data")
+    out = sym.sum(sym.relu(sym.FullyConnected(data, num_hidden=3, no_bias=True,
+                                              name="fc")))
+    ex = out.bind(mx.cpu(), {"data": x, "fc_weight": w})
+    ex.forward(is_train=True)
+    ex.backward()
+    g_sym = ex.grad_dict["fc_weight"].asnumpy()
+
+    # imperative reference
+    xv, wv = mx.np.array(x), mx.np.array(w)
+    wv.attach_grad()
+    with mx.autograd.record():
+        y = mx.nd.FullyConnected(xv, wv, no_bias=True).relu().sum()
+    y.backward()
+    onp.testing.assert_allclose(g_sym, wv.grad.asnumpy(), rtol=1e-5,
+                                atol=1e-6)
+
+
+def test_simple_bind_and_grad_req():
+    data = sym.Variable("data")
+    out = sym.FullyConnected(data, num_hidden=2, name="fc")
+    ex = out.simple_bind(mx.cpu(), grad_req={"data": "null",
+                                             "fc_weight": "write",
+                                             "fc_bias": "write"},
+                         data=(4, 3))
+    assert ex.arg_dict["fc_weight"].shape == (2, 3)
+    ex.forward(is_train=True, data=onp.ones((4, 3), dtype="float32"))
+    ex.backward(onp.ones((4, 2), dtype="float32"))
+    assert ex.grad_dict.get("data") is None
+    assert onp.abs(ex.grad_dict["fc_bias"].asnumpy() - 4.0).max() < 1e-5
+
+
+def test_softmax_output_gradient():
+    """SoftmaxOutput backward == softmax - one_hot (the reference's CE
+    gradient injection)."""
+    onp.random.seed(1)
+    logits = onp.random.randn(6, 4).astype("float32")
+    labels = onp.random.randint(0, 4, (6,)).astype("float32")
+    data = sym.Variable("data")
+    lab = sym.Variable("label")
+    out = sym.SoftmaxOutput(data, lab, name="sm")
+    ex = out.bind(mx.cpu(), {"data": logits, "label": labels},
+                  grad_req={"data": "write", "label": "null"})
+    probs = ex.forward(is_train=True)[0].asnumpy()
+    ex.backward()
+    g = ex.grad_dict["data"].asnumpy()
+    onehot = onp.eye(4, dtype="float32")[labels.astype(int)]
+    onp.testing.assert_allclose(g, probs - onehot, rtol=1e-5, atol=1e-6)
+
+
+def test_split_multi_output():
+    a = sym.Variable("a")
+    parts = sym.split(a, 3, axis=1)
+    assert parts.num_outputs == 3
+    av = onp.arange(12, dtype="float32").reshape(2, 6)
+    outs = parts.eval(a=mx.np.array(av))
+    assert len(outs) == 3
+    onp.testing.assert_allclose(outs[1].asnumpy(), av[:, 2:4])
+    # single output selection
+    p1 = parts[1]
+    assert p1.num_outputs == 1
+
+
+def test_group():
+    a = sym.Variable("a")
+    g = sym.Group([sym.relu(a), sym.tanh(a)])
+    assert g.num_outputs == 2
+    av = onp.array([[-1.0, 2.0]], dtype="float32")
+    o = g.eval(a=mx.np.array(av))
+    onp.testing.assert_allclose(o[0].asnumpy(), [[0.0, 2.0]])
+    onp.testing.assert_allclose(o[1].asnumpy(), onp.tanh(av), rtol=1e-6)
+
+
+def test_symbolblock_from_symbol_and_training():
+    onp.random.seed(0)
+    data = sym.Variable("data")
+    net_s = sym.FullyConnected(sym.Activation(
+        sym.FullyConnected(data, num_hidden=8, name="fc1"),
+        act_type="tanh"), num_hidden=1, name="fc2")
+    blk = mx.gluon.SymbolBlock(net_s, [data])
+    blk.initialize()
+    x = mx.np.array(onp.random.randn(4, 5).astype("float32"))
+    out = blk(x)
+    assert out.shape == (4, 1)
+    # params registered and trainable
+    names = set(blk.collect_params().keys())
+    assert {"fc1_weight", "fc1_bias", "fc2_weight", "fc2_bias"} <= names
+    trainer = mx.gluon.Trainer(blk.collect_params(), "sgd",
+                               {"learning_rate": 0.1})
+    with mx.autograd.record():
+        loss = (blk(x) ** 2).mean()
+    loss.backward()
+    w0 = blk.collect_params()["fc2_weight"].data().asnumpy().copy()
+    trainer.step(1)
+    w1 = blk.collect_params()["fc2_weight"].data().asnumpy()
+    assert onp.abs(w1 - w0).max() > 0
+
+
+def test_module_with_symbol_trains():
+    onp.random.seed(0)
+    X = onp.random.randn(120, 8).astype("float32")
+    w_true = onp.random.randn(8)
+    y = (X @ w_true > 0).astype("float32")
+    s = sym.SoftmaxOutput(
+        sym.FullyConnected(sym.Variable("data"), num_hidden=2, name="fc"),
+        sym.Variable("softmax_label"), name="softmax")
+    mod = mx.mod.Module(s, context=mx.cpu())
+    it = mx.io.NDArrayIter(X, y, batch_size=20, shuffle=True,
+                           label_name="softmax_label")
+    mod.fit(it, num_epoch=4, optimizer="sgd",
+            optimizer_params={"learning_rate": 0.5})
+    score = dict(mod.score(mx.io.NDArrayIter(
+        X, y, batch_size=20, label_name="softmax_label"), "acc"))
+    assert score["accuracy"] > 0.85
+
+
+def test_save_load_file(tmp_path):
+    net = sym.FullyConnected(sym.Variable("data"), num_hidden=2, name="fc")
+    path = str(tmp_path / "net-symbol.json")
+    net.save(path)
+    net2 = sym.load(path)
+    assert net2.list_arguments() == net.list_arguments()
+
+
+def test_attrs():
+    a = sym.Variable("a", attr={"lr_mult": "2.0"})
+    assert a.attr("lr_mult") == "2.0"
+    b = sym.relu(a, name="r0", attr={"ctx_group": "dev1"})
+    assert b.attr("ctx_group") == "dev1"
+    assert "r0" in b.attr_dict()
+
+
+def test_symbolblock_norm_param_defaults():
+    """gamma -> ones, beta/bias/moving_mean -> zeros, moving_var -> ones
+    (the reference's name-dispatched initializer defaults)."""
+    data = sym.Variable("data")
+    net = sym.BatchNorm(sym.Convolution(data, kernel=(3, 3), num_filter=4,
+                                        name="c0"), name="bn0")
+    blk = mx.gluon.SymbolBlock(net, [data])
+    blk.initialize()
+    blk(mx.np.array(onp.zeros((1, 2, 5, 5), dtype="float32")))
+    params = blk.collect_params()
+    onp.testing.assert_allclose(params["bn0_gamma"].data().asnumpy(), 1.0)
+    onp.testing.assert_allclose(params["bn0_beta"].data().asnumpy(), 0.0)
+    onp.testing.assert_allclose(params["c0_bias"].data().asnumpy(), 0.0)
+    onp.testing.assert_allclose(
+        params["bn0_moving_mean"].data().asnumpy(), 0.0)
+    onp.testing.assert_allclose(
+        params["bn0_moving_var"].data().asnumpy(), 1.0)
+
+
+def test_slice_channel():
+    a = sym.Variable("a")
+    parts = sym.SliceChannel(a, num_outputs=2, axis=1, squeeze_axis=False)
+    assert parts.num_outputs == 2
+    av = onp.arange(8, dtype="float32").reshape(2, 4)
+    outs = parts.eval(a=mx.np.array(av))
+    onp.testing.assert_allclose(outs[0].asnumpy(), av[:, :2])
+    onp.testing.assert_allclose(outs[1].asnumpy(), av[:, 2:])
+
+
+def test_load_reference_format_json():
+    """Reference-era json: plain-string attrs, no __layout__ hints."""
+    import json as _json
+    payload = {
+        "nodes": [
+            {"op": "null", "name": "data", "attrs": {}, "inputs": []},
+            {"op": "null", "name": "fc_weight", "attrs": {}, "inputs": []},
+            {"op": "FullyConnected", "name": "fc",
+             "attrs": {"num_hidden": "3", "no_bias": "True"},
+             "inputs": [[0, 0, 0], [1, 0, 0]]},
+            {"op": "Activation", "name": "act",
+             "attrs": {"act_type": "relu"}, "inputs": [[2, 0, 0]]},
+        ],
+        "arg_nodes": [0, 1],
+        "heads": [[3, 0, 0]],
+    }
+    net = sym.load_json(_json.dumps(payload))
+    assert net.list_arguments() == ["data", "fc_weight"]
+    x = onp.random.randn(2, 5).astype("float32")
+    w = onp.random.randn(3, 5).astype("float32")
+    out = net.eval(data=mx.np.array(x), fc_weight=mx.np.array(w))[0]
+    onp.testing.assert_allclose(out.asnumpy(),
+                                onp.maximum(x @ w.T, 0), rtol=1e-5)
+
+
+def test_module_group_loss_head():
+    """Group([features, SoftmaxOutput]) must train through the loss head
+    regardless of its position."""
+    onp.random.seed(0)
+    X = onp.random.randn(80, 6).astype("float32")
+    y = (X.sum(axis=1) > 0).astype("float32")
+    fc = sym.FullyConnected(sym.Variable("data"), num_hidden=2, name="fc")
+    g = sym.Group([sym.stop_gradient(fc),
+                   sym.SoftmaxOutput(fc, sym.Variable("softmax_label"),
+                                     name="softmax")])
+    mod = mx.mod.Module(g, context=mx.cpu())
+    it = mx.io.NDArrayIter(X, y, batch_size=16, shuffle=True,
+                           label_name="softmax_label")
+    mod.fit(it, num_epoch=5, optimizer="sgd",
+            optimizer_params={"learning_rate": 0.5})
+    probs = mod._outputs[1].asnumpy()
+    assert probs.shape[1] == 2
+
+
+def test_regression_heads():
+    x = onp.random.randn(8, 3).astype("float32")
+    lab = onp.random.randn(8, 3).astype("float32")
+    data, l = sym.Variable("data"), sym.Variable("label")
+    out = sym.LinearRegressionOutput(data, l)
+    ex = out.bind(mx.cpu(), {"data": x, "label": lab},
+                  grad_req={"data": "write", "label": "null"})
+    o = ex.forward(is_train=True)[0].asnumpy()
+    onp.testing.assert_allclose(o, x)
+    ex.backward()
+    g = ex.grad_dict["data"].asnumpy()
+    onp.testing.assert_allclose(g, (x - lab) / 3.0, rtol=1e-5, atol=1e-6)
