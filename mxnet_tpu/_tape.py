@@ -52,6 +52,46 @@ def set_training(flag: bool) -> bool:
     return prev
 
 
+class RowSparseCot:
+    """A row-sparse cotangent produced by ops with ``sparse_grad``
+    (reference: Embedding's kRowSparseStorage gradient). Travels through
+    the tape only as a LEAF gradient; any arithmetic with a dense
+    cotangent densifies it."""
+
+    __slots__ = ("indices", "values", "shape")
+
+    def __init__(self, indices: Any, values: Any,
+                 shape: Tuple[int, ...]) -> None:
+        self.indices = indices      # (nnz,) int32 row ids (may repeat)
+        self.values = values        # (nnz,) + row shape
+        self.shape = tuple(shape)
+
+    def dense(self):
+        out = jnp.zeros(self.shape, dtype=self.values.dtype)
+        return out.at[self.indices].add(self.values)
+
+    def merge(self, other: "RowSparseCot") -> "RowSparseCot":
+        return RowSparseCot(
+            jnp.concatenate([self.indices, other.indices]),
+            jnp.concatenate([self.values, other.values]), self.shape)
+
+
+def add_cotangents(a: Any, b: Any) -> Any:
+    """Accumulate two cotangents; handles row-sparse values and the
+    float0 zeros jax emits for integer-dtype inputs (absorbing)."""
+    if isinstance(a, RowSparseCot) and isinstance(b, RowSparseCot):
+        return a.merge(b)
+    if isinstance(a, RowSparseCot):
+        return b + a.dense()
+    if isinstance(b, RowSparseCot):
+        return a + b.dense()
+    if getattr(a, "dtype", None) == jax.dtypes.float0:
+        return a
+    if getattr(b, "dtype", None) == jax.dtypes.float0:
+        return b
+    return a + b
+
+
 class TapeNode:
     """One recorded op: inputs, output metadata, and the vjp pullback.
 
@@ -133,7 +173,7 @@ def backward_arrays(heads: Sequence[Any],
     def _add_cot(arr: Any, value: Any) -> None:
         key = id(arr)
         if key in cots:
-            cots[key] = cots[key] + value
+            cots[key] = add_cotangents(cots[key], value)
         else:
             cots[key] = value
 
@@ -166,6 +206,8 @@ def backward_arrays(heads: Sequence[Any],
         for arr_ref, (shape, dtype) in zip(outs, node.out_avals):
             arr = arr_ref() if callable(arr_ref) else arr_ref
             c = cots.get(id(arr)) if arr is not None else None
+            if isinstance(c, RowSparseCot):
+                c = c.dense()   # only leaf grads stay sparse
             if c is None:
                 c = jnp.zeros(shape, dtype=dtype)
             elif c.dtype != dtype:
@@ -187,6 +229,8 @@ def backward_arrays(heads: Sequence[Any],
         result = []
         for v in variables:
             c = cots.get(id(v))
+            if isinstance(c, RowSparseCot):
+                c = c.dense()
             if c is None:
                 c = jnp.zeros(v.shape, dtype=v.dtype)
             result.append(c)

@@ -214,6 +214,13 @@ class Parameter:
         if self._data is not None and self._data.grad is not None:
             import jax.numpy as jnp
             g = self._data.grad
+            if getattr(g, "stype", "default") == "row_sparse":
+                # drop the stored rows; a dense-cache write would leave
+                # the sparse components alive for the next 'add' merge
+                g._sp_values = g._sp_values[:0]
+                g._sp_indices = g._sp_indices[:0]
+                g._dense_cache = None
+                return
             # zeros_like, not g*0: multiplying would keep NaN/Inf poison
             g._data = jnp.zeros_like(g._data)
 

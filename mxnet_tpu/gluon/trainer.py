@@ -98,8 +98,14 @@ class Trainer:
             return
         for i, p in enumerate(self._params):
             if p.grad_req != "null" and p.is_initialized:
-                self._kvstore.push(i, p.data().grad)
-                self._kvstore.pull(i, out=p.data().grad)
+                g = p.data().grad
+                if getattr(g, "stype", "default") == "row_sparse":
+                    # row-sparse grads skip the dense allreduce round-trip;
+                    # multi-worker aggregation uses row_sparse_pull
+                    # semantics (reference: Trainer._row_sparse_pull)
+                    continue
+                self._kvstore.push(i, g)
+                self._kvstore.pull(i, out=g)
 
     def step(self, batch_size: int, ignore_stale_grad: bool = False) -> None:
         """Rescale grads by 1/batch_size and apply one optimizer update."""

@@ -98,8 +98,28 @@ class KVStore:
         self.pull(key, out if out is not None else value, priority)
 
     def row_sparse_pull(self, key, out=None, priority=0, row_ids=None):
-        # dense layouts only; row_ids accepted for API parity
-        return self.pull(key, out, priority)
+        """Pull only the listed rows as a RowSparseNDArray (reference:
+        ``KVStore.row_sparse_pull`` — the sparse-embedding pull path)."""
+        if row_ids is None:
+            return self.pull(key, out, priority)
+        from .ndarray.sparse import RowSparseNDArray
+        import numpy as _onp
+        v = self._store.get(key)
+        if v is None:
+            raise MXNetError(f"key {key!r} was never init/pushed")
+        ids = row_ids.asnumpy() if isinstance(row_ids, NDArray) \
+            else _onp.asarray(row_ids)
+        ids = _onp.unique(ids.astype(_onp.int64))
+        rows = v._data[ids]
+        rsp = RowSparseNDArray(rows, ids.astype(_onp.int32),
+                               tuple(v.shape), ctx=v.context)
+        if out is not None and isinstance(out, RowSparseNDArray):
+            out._sp_values = rsp._sp_values
+            out._sp_indices = rsp._sp_indices
+            out._sp_shape = rsp._sp_shape
+            out._dense_cache = None
+            return out
+        return rsp
 
     def _allreduce(self, v: NDArray) -> NDArray:
         return v  # single process: reduction already local

@@ -250,12 +250,21 @@ class Module(BaseModule):
 
         dummies = [_desc_to_dummy(d) for d in self._data_shapes]
         if self._sym_mode and self._used_labels:
-            if self._label_shapes:
-                dummies += [_desc_to_dummy(d) for d in self._label_shapes]
-            else:
-                batch = dummies[0].shape[0] if dummies else 1
-                dummies += [NDArray(_np.zeros((batch,), dtype=_np.float32))
-                            for _ in self._used_labels]
+            # pick label descs by name (DataDesc.name) where available so
+            # only the consumed labels are fed, in graph-input order
+            by_name = {}
+            for j, d in enumerate(self._label_shapes or []):
+                nm = getattr(d, "name", None) or \
+                    (d[0] if isinstance(d, (tuple, list)) else None)
+                by_name[nm] = d
+            batch = dummies[0].shape[0] if dummies else 1
+            for n in self._used_labels:
+                desc = by_name.get(n)
+                if desc is not None:
+                    dummies.append(_desc_to_dummy(desc))
+                else:
+                    dummies.append(NDArray(_np.zeros((batch,),
+                                                     dtype=_np.float32)))
         self._block(*dummies)
         if arg_params or aux_params:
             merged = dict(arg_params or {})
@@ -335,7 +344,12 @@ class Module(BaseModule):
         feeds = list(data)
         if self._used_labels:
             if labels:
-                feeds += labels[:len(self._used_labels)]
+                # labels arrive ordered by label_names; select by name so
+                # a non-prefix consumed subset still lines up
+                feeds += [labels[self._label_names.index(n)]
+                          if self._label_names.index(n) < len(labels)
+                          else labels[-1]
+                          for n in self._used_labels]
             else:   # inference without labels: heads ignore label values
                 feeds += [NDArray(_np.zeros((self._cur_batch_size,),
                                             dtype=_np.float32))

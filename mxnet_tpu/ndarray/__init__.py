@@ -12,6 +12,8 @@ from .ops_numpy import __all__ as _ops_np_all
 from . import ops
 from . import random
 from . import linalg
+from . import sparse
+from .sparse import RowSparseNDArray, CSRNDArray, BaseSparseNDArray
 from .register import get_op, list_ops, register_op, invoke
 
 __all__ = (["NDArray", "from_jax", "waitall", "random", "linalg",

@@ -231,6 +231,25 @@ class NDArray:
     def _write_grad(self, cot: Any) -> None:
         if self._grad_req == "null":
             return
+        from .._tape import RowSparseCot
+        if isinstance(cot, RowSparseCot):
+            # sparse-grad leaf (Embedding sparse_grad): the grad buffer
+            # becomes a fresh RowSparseNDArray each backward, as in the
+            # reference's kRowSparseStorage gradient contract
+            from .sparse import RowSparseNDArray
+            rsp = RowSparseNDArray(cot.values, cot.indices, cot.shape,
+                                   ctx=self._ctx)
+            if self._grad_req == "add" and self._grad is not None and \
+                    getattr(self._grad, "stype", "default") == "row_sparse":
+                merged = RowSparseCot(
+                    jnp.concatenate([self._grad._sp_indices, cot.indices]),
+                    jnp.concatenate([self._grad._sp_values, cot.values]),
+                    cot.shape)
+                rsp = RowSparseNDArray(merged.values, merged.indices,
+                                       cot.shape, ctx=self._ctx)
+            self._grad = rsp._canonical()
+            self._fresh_grad = True
+            return
         if cot is None:
             cot = jnp.zeros(self.shape, dtype=self._data.dtype)
         if cot.dtype != self._data.dtype:
@@ -453,10 +472,14 @@ class NDArray:
     def ones_like(self): return self._op("ones_like")
 
     def tostype(self, stype: str) -> "NDArray":
-        if stype != "default":
-            raise MXNetError("sparse storage types are not implemented; "
-                             "dense XLA layouts only")
-        return self
+        if stype == "default":
+            return self
+        from . import sparse as _sparse
+        if stype == "row_sparse":
+            return _sparse._dense_to_rsp(self)
+        if stype == "csr":
+            return _sparse._dense_to_csr(self)
+        raise MXNetError(f"unknown storage type {stype!r}")
 
 
 def _is_tracer(x: Any) -> bool:

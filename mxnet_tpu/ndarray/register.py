@@ -67,6 +67,37 @@ def wrap_out(data: Any, ctx=None) -> Any:
     return out
 
 
+def invoke_with_custom_vjp(name: str, impl: Callable,
+                           inputs: Sequence[Any], vjp_fn: Callable,
+                           ctx=None) -> Any:
+    """Like :func:`invoke` but with a hand-written pullback instead of
+    ``jax.vjp`` — for ops whose gradient is not a jax type (e.g. the
+    row-sparse embedding grad). ``vjp_fn(out_cot) -> per-input cotangents``
+    (None entries are skipped). Single-output ops only."""
+    arrays = [x._data for x in inputs]
+
+    timer = None
+    if _profiler_state["on"]:
+        from ..profiler import op_timer
+        timer = op_timer(name)
+        if timer is not None:
+            timer.__enter__()
+    try:
+        out = impl(*arrays)
+    finally:
+        if timer is not None:
+            timer.__exit__()
+
+    wrapped = wrap_out(out, ctx=ctx)
+    if is_recording() and any(x._on_tape for x in inputs):
+        node = TapeNode(name, vjp_fn, inputs,
+                        [(tuple(out.shape), out.dtype)])
+        node.out_arrays = [weakref.ref(wrapped)]
+        wrapped._ag_node = node
+        wrapped._ag_out_idx = 0
+    return wrapped
+
+
 def invoke(name: str, impl: Callable, inputs: Sequence[Any],
            ctx=None) -> Any:
     """Execute op ``impl`` over NDArray ``inputs``; handle autograd.

@@ -1,0 +1,442 @@
+"""Sparse storage types: ``row_sparse`` and ``csr``.
+
+Reference parity (leezu/mxnet): ``include/mxnet/ndarray.h`` (storage types
+kRowSparseStorage/kCSRStorage on NDArray::Chunk), the python surface
+``python/mxnet/ndarray/sparse.py`` (RowSparseNDArray, CSRNDArray,
+row_sparse_array, csr_matrix) and sparse FComputeEx kernels in
+``src/operator/tensor/`` (dot, elemwise, cast_storage, sparse_retain).
+
+Design (tpu-first): XLA has no first-class sparse tensors, so sparse
+storage lives in the imperative layer as (indices, values) / CSR component
+arrays on device; ops that have an efficient sparse formulation (dot,
+retain, elemwise on aligned rows, row-sparse optimizer updates) work on
+the components with gather/scatter/segment-sum primitives the MXU/VPU
+handle well, and everything else falls back to dense with the reference's
+"storage fallback" warning. Sparse is a host-driven (eager) feature —
+under jit tracing, arrays densify.
+"""
+from __future__ import annotations
+
+import warnings
+from typing import Any, Optional, Sequence, Tuple
+
+import jax
+import jax.numpy as jnp
+import numpy as _np
+
+from ..base import MXNetError
+from ..context import Context, current_context
+from .ndarray import NDArray
+from .ops import _as_nd
+
+__all__ = ["BaseSparseNDArray", "RowSparseNDArray", "CSRNDArray",
+           "row_sparse_array", "csr_matrix", "zeros", "empty", "array",
+           "dot", "add", "subtract", "multiply", "retain", "todense"]
+
+
+def _warn_fallback(op: str, stype: str) -> None:
+    warnings.warn(
+        f"op {op!r} falling back to dense storage for a {stype} input "
+        f"(the reference logs the same storage-fallback warning)",
+        stacklevel=3)
+
+
+class BaseSparseNDArray(NDArray):
+    """Common base of the sparse storage classes.
+
+    Accessing ``_data`` (i.e. using a dense-only op) densifies with a
+    fallback warning, mirroring the reference's FComputeFallback path.
+    """
+
+    __slots__ = ("_sp_shape", "_sp_dtype", "_dense_cache")
+
+    def __init__(self) -> None:  # components set by subclass
+        self._dense_cache = None
+        self._ag_node = None
+        self._ag_out_idx = 0
+        self._grad = None
+        self._grad_req = "null"
+        self._fresh_grad = False
+        self._ctx = None
+
+    # -- NDArray interface over components ---------------------------------
+    @property
+    def _data(self):
+        if self._dense_cache is None:
+            _warn_fallback("<dense access>", self.stype)
+            self._dense_cache = self._todense_impl()
+        return self._dense_cache
+
+    @_data.setter
+    def _data(self, value) -> None:
+        # a dense write converts this array to dense storage semantics
+        self._dense_cache = value
+
+    @property
+    def shape(self) -> tuple:
+        return tuple(self._sp_shape)
+
+    @property
+    def dtype(self):
+        return _np.dtype(self._sp_dtype)
+
+    @property
+    def context(self) -> Context:
+        return self._ctx or current_context()
+
+    ctx = context
+
+    def asnumpy(self) -> _np.ndarray:
+        return _np.asarray(self._todense_impl())
+
+    def todense(self) -> NDArray:
+        return NDArray(self._todense_impl(), ctx=self._ctx, _wrap=True)
+
+    def wait_to_read(self) -> None:
+        for c in self._components():
+            c.block_until_ready()
+
+    def __repr__(self) -> str:
+        return (f"<{type(self).__name__} {self.shape} "
+                f"@{self.context}>")
+
+    # subclass hooks
+    def _todense_impl(self):
+        raise NotImplementedError
+
+    def _components(self):
+        raise NotImplementedError
+
+
+class RowSparseNDArray(BaseSparseNDArray):
+    """Sparse tensor where only some leading-axis rows are stored
+    (reference: kRowSparseStorage — the gradient format of Embedding with
+    ``sparse_grad`` and of sparse optimizer updates).
+
+    ``indices``: sorted int64 (nnz,) row ids; ``data``: (nnz,) + row shape.
+    """
+
+    __slots__ = ("_sp_indices", "_sp_values")
+
+    def __init__(self, data: Any, indices: Any, shape: Tuple[int, ...],
+                 ctx: Optional[Context] = None, dtype: Any = None) -> None:
+        super().__init__()
+        vals = jnp.asarray(data, dtype=dtype)
+        idx = jnp.asarray(indices, dtype=jnp.int32)
+        if vals.ndim != len(shape):
+            raise MXNetError(
+                f"row_sparse data ndim {vals.ndim} must equal shape ndim "
+                f"{len(shape)} (rows are stored whole)")
+        if idx.shape[0] != vals.shape[0]:
+            raise MXNetError(
+                f"row_sparse: {idx.shape[0]} indices vs {vals.shape[0]} "
+                f"value rows")
+        self._sp_values = vals
+        self._sp_indices = idx
+        self._sp_shape = tuple(shape)
+        self._sp_dtype = vals.dtype
+        self._ctx = ctx
+
+    @property
+    def stype(self) -> str:
+        return "row_sparse"
+
+    @property
+    def indices(self) -> NDArray:
+        return NDArray(self._sp_indices, ctx=self._ctx, _wrap=True)
+
+    @property
+    def data(self) -> NDArray:
+        return NDArray(self._sp_values, ctx=self._ctx, _wrap=True)
+
+    def _components(self):
+        return (self._sp_indices, self._sp_values)
+
+    def _todense_impl(self):
+        dense = jnp.zeros(self._sp_shape, dtype=self._sp_dtype)
+        if self._sp_values.shape[0] == 0:
+            return dense
+        return dense.at[self._sp_indices].add(self._sp_values)
+
+    def tostype(self, stype: str):
+        if stype == "row_sparse":
+            return self
+        if stype == "default":
+            return self.todense()
+        if stype == "csr":
+            return self.todense().tostype("csr")
+        raise MXNetError(f"unknown stype {stype!r}")
+
+    def retain(self, indices: Any) -> "RowSparseNDArray":
+        return retain(self, indices)
+
+    def copyto(self, other):
+        if isinstance(other, Context):
+            return RowSparseNDArray(self._sp_values, self._sp_indices,
+                                    self._sp_shape, ctx=other)
+        return super().copyto(other)
+
+    def _canonical(self) -> "RowSparseNDArray":
+        """Deduplicate + sort row ids (host-side; eager only)."""
+        idx = _np.asarray(self._sp_indices)
+        if idx.size == 0 or (_np.all(_np.diff(idx) > 0)):
+            return self
+        uniq, inv = _np.unique(idx, return_inverse=True)
+        vals = jnp.zeros((len(uniq),) + tuple(self._sp_values.shape[1:]),
+                         dtype=self._sp_values.dtype)
+        vals = vals.at[jnp.asarray(inv)].add(self._sp_values)
+        return RowSparseNDArray(vals, uniq.astype(_np.int32),
+                                self._sp_shape, ctx=self._ctx)
+
+
+class CSRNDArray(BaseSparseNDArray):
+    """2-D compressed-sparse-row tensor (reference: kCSRStorage; the input
+    format of sparse linear models / libsvm data)."""
+
+    __slots__ = ("_sp_data", "_sp_indices", "_sp_indptr")
+
+    def __init__(self, data: Any, indices: Any, indptr: Any,
+                 shape: Tuple[int, ...], ctx: Optional[Context] = None,
+                 dtype: Any = None) -> None:
+        super().__init__()
+        if len(shape) != 2:
+            raise MXNetError("csr arrays are 2-D")
+        self._sp_data = jnp.asarray(data, dtype=dtype)
+        self._sp_indices = jnp.asarray(indices, dtype=jnp.int32)
+        self._sp_indptr = jnp.asarray(indptr, dtype=jnp.int32)
+        if self._sp_indptr.shape[0] != shape[0] + 1:
+            raise MXNetError(
+                f"csr: indptr length {self._sp_indptr.shape[0]} != "
+                f"rows+1 ({shape[0] + 1})")
+        self._sp_shape = tuple(shape)
+        self._sp_dtype = self._sp_data.dtype
+        self._ctx = ctx
+
+    @property
+    def stype(self) -> str:
+        return "csr"
+
+    @property
+    def data(self) -> NDArray:
+        return NDArray(self._sp_data, ctx=self._ctx, _wrap=True)
+
+    @property
+    def indices(self) -> NDArray:
+        return NDArray(self._sp_indices, ctx=self._ctx, _wrap=True)
+
+    @property
+    def indptr(self) -> NDArray:
+        return NDArray(self._sp_indptr, ctx=self._ctx, _wrap=True)
+
+    def _components(self):
+        return (self._sp_data, self._sp_indices, self._sp_indptr)
+
+    def _row_ids(self) -> _np.ndarray:
+        ptr = _np.asarray(self._sp_indptr)
+        return _np.repeat(_np.arange(self._sp_shape[0]), _np.diff(ptr))
+
+    def _todense_impl(self):
+        dense = jnp.zeros(self._sp_shape, dtype=self._sp_dtype)
+        if self._sp_data.shape[0] == 0:
+            return dense
+        rows = jnp.asarray(self._row_ids())
+        return dense.at[rows, self._sp_indices].add(self._sp_data)
+
+    def tostype(self, stype: str):
+        if stype == "csr":
+            return self
+        if stype == "default":
+            return self.todense()
+        raise MXNetError(f"cannot convert csr to {stype!r} directly")
+
+    def __getitem__(self, key):
+        if isinstance(key, int):
+            lo = int(self._sp_indptr[key])
+            hi = int(self._sp_indptr[key + 1])
+            row = jnp.zeros((self._sp_shape[1],), dtype=self._sp_dtype)
+            row = row.at[self._sp_indices[lo:hi]].set(self._sp_data[lo:hi])
+            return NDArray(row, ctx=self._ctx, _wrap=True)
+        if isinstance(key, slice):
+            start, stop, step = key.indices(self._sp_shape[0])
+            if step != 1:
+                raise MXNetError("csr slicing requires step 1")
+            lo, hi = int(self._sp_indptr[start]), int(self._sp_indptr[stop])
+            return CSRNDArray(self._sp_data[lo:hi],
+                              self._sp_indices[lo:hi],
+                              self._sp_indptr[start:stop + 1] -
+                              self._sp_indptr[start],
+                              (stop - start, self._sp_shape[1]),
+                              ctx=self._ctx)
+        raise MXNetError("csr supports int / contiguous-slice indexing")
+
+
+# ---------------------------------------------------------------------------
+# Creation (reference: python/mxnet/ndarray/sparse.py row_sparse_array etc.)
+# ---------------------------------------------------------------------------
+
+def row_sparse_array(arg1: Any, shape: Optional[tuple] = None,
+                     ctx: Optional[Context] = None, dtype: Any = None
+                     ) -> RowSparseNDArray:
+    """Build from ``(data, indices)`` or densify-convert an array."""
+    if isinstance(arg1, RowSparseNDArray):
+        return arg1
+    if isinstance(arg1, tuple) and len(arg1) == 2 and not isinstance(
+            arg1[0], int):
+        data, indices = arg1
+        if shape is None:
+            raise MXNetError("row_sparse_array((data, indices)) needs shape")
+        return RowSparseNDArray(data, indices, shape, ctx=ctx, dtype=dtype)
+    dense = _as_nd(arg1) if not isinstance(arg1, NDArray) else arg1
+    return _dense_to_rsp(dense, ctx=ctx)
+
+
+def csr_matrix(arg1: Any, shape: Optional[tuple] = None,
+               ctx: Optional[Context] = None, dtype: Any = None
+               ) -> CSRNDArray:
+    """Build from ``(data, indices, indptr)``, scipy-style triples, or a
+    dense array."""
+    if isinstance(arg1, CSRNDArray):
+        return arg1
+    if isinstance(arg1, tuple) and len(arg1) == 3:
+        data, indices, indptr = arg1
+        if shape is None:
+            raise MXNetError("csr_matrix((data, indices, indptr)) needs "
+                             "shape")
+        return CSRNDArray(data, indices, indptr, shape, ctx=ctx, dtype=dtype)
+    dense = _as_nd(arg1) if not isinstance(arg1, NDArray) else arg1
+    return _dense_to_csr(dense, ctx=ctx)
+
+
+def zeros(stype: str, shape: tuple, ctx: Optional[Context] = None,
+          dtype: Any = "float32"):
+    if stype == "row_sparse":
+        row = (0,) + tuple(shape[1:])
+        return RowSparseNDArray(_np.zeros(row, dtype=dtype), [], shape,
+                                ctx=ctx)
+    if stype == "csr":
+        return CSRNDArray([], [], _np.zeros(shape[0] + 1, dtype=_np.int32),
+                          shape, ctx=ctx, dtype=dtype)
+    if stype == "default":
+        from . import ops as _ops
+        return _ops.zeros(shape, ctx=ctx, dtype=dtype)
+    raise MXNetError(f"unknown stype {stype!r}")
+
+
+empty = zeros
+
+
+def array(source, ctx: Optional[Context] = None, dtype: Any = None):
+    """Sparse-aware ``mx.nd.sparse.array`` (scipy.sparse input supported
+    when scipy is available)."""
+    stype = getattr(source, "format", None)  # scipy sparse matrices
+    if stype == "csr":
+        return CSRNDArray(source.data, source.indices, source.indptr,
+                          source.shape, ctx=ctx, dtype=dtype)
+    if isinstance(source, BaseSparseNDArray):
+        return source
+    return NDArray(source, ctx=ctx, dtype=dtype)
+
+
+def _dense_to_rsp(dense: NDArray, ctx=None) -> RowSparseNDArray:
+    a = _np.asarray(dense.asnumpy())
+    keep = _np.where(a.reshape(a.shape[0], -1).any(axis=1))[0]
+    return RowSparseNDArray(a[keep], keep.astype(_np.int32), a.shape,
+                            ctx=ctx or dense.context)
+
+
+def _dense_to_csr(dense: NDArray, ctx=None) -> CSRNDArray:
+    a = _np.asarray(dense.asnumpy())
+    if a.ndim != 2:
+        raise MXNetError("csr conversion requires a 2-D array")
+    rows, cols = _np.nonzero(a)
+    data = a[rows, cols]
+    indptr = _np.zeros(a.shape[0] + 1, dtype=_np.int32)
+    _np.add.at(indptr, rows + 1, 1)
+    indptr = _np.cumsum(indptr).astype(_np.int32)
+    return CSRNDArray(data, cols.astype(_np.int32), indptr, a.shape,
+                      ctx=ctx or dense.context)
+
+
+def todense(a) -> NDArray:
+    return a.todense() if isinstance(a, BaseSparseNDArray) else _as_nd(a)
+
+
+# ---------------------------------------------------------------------------
+# Sparse ops (reference: FComputeEx kernels — dot, elemwise, retain)
+# ---------------------------------------------------------------------------
+
+def retain(a: RowSparseNDArray, indices: Any) -> RowSparseNDArray:
+    """Keep only the listed rows (reference: ``sparse_retain``)."""
+    if not isinstance(a, RowSparseNDArray):
+        raise MXNetError("retain expects a row_sparse array")
+    want = _np.asarray(indices.asnumpy() if isinstance(indices, NDArray)
+                       else indices).astype(_np.int64)
+    have = _np.asarray(a._sp_indices)
+    mask = _np.isin(have, want)
+    keep = _np.where(mask)[0]
+    return RowSparseNDArray(a._sp_values[jnp.asarray(keep)],
+                            have[keep].astype(_np.int32), a.shape,
+                            ctx=a._ctx)
+
+
+def dot(a, b, transpose_a: bool = False, transpose_b: bool = False):
+    """Sparse-aware dot: csr·dense, csrᵀ·dense (segment-sum formulation),
+    dense·rspᵀ fall back where no sparse kernel applies."""
+    if isinstance(a, CSRNDArray) and isinstance(b, NDArray) and \
+            not isinstance(b, BaseSparseNDArray) and not transpose_b:
+        rows = jnp.asarray(a._row_ids())
+        if transpose_a:
+            # out[k, :] = sum over nnz with col==k of data * b[row]
+            m = a.shape[1]
+            gathered = a._sp_data[:, None] * b._data[rows]
+            out = jax.ops.segment_sum(gathered, a._sp_indices,
+                                      num_segments=m)
+            return NDArray(out.astype(a._sp_dtype), ctx=a._ctx, _wrap=True)
+        # out[r, :] = sum over row-nnz of data * b[col]
+        gathered = a._sp_data[:, None] * b._data[a._sp_indices]
+        out = jax.ops.segment_sum(gathered, rows,
+                                  num_segments=a.shape[0])
+        return NDArray(out.astype(a._sp_dtype), ctx=a._ctx, _wrap=True)
+    if isinstance(a, BaseSparseNDArray) or isinstance(b, BaseSparseNDArray):
+        _warn_fallback("dot", a.stype if isinstance(a, BaseSparseNDArray)
+                       else b.stype)
+    from . import ops as _ops
+    da = todense(a) if isinstance(a, BaseSparseNDArray) else a
+    db = todense(b) if isinstance(b, BaseSparseNDArray) else b
+    if transpose_a:
+        da = da.T
+    if transpose_b:
+        db = db.T
+    return _ops.dot(da, db)
+
+
+def _rsp_elemwise(name: str, op, a, b):
+    if isinstance(a, RowSparseNDArray) and isinstance(b, RowSparseNDArray) \
+            and a.shape == b.shape:
+        ca, cb = a._canonical(), b._canonical()
+        ia, ib = _np.asarray(ca._sp_indices), _np.asarray(cb._sp_indices)
+        union = _np.union1d(ia, ib)
+        va = jnp.zeros((len(union),) + ca._sp_values.shape[1:],
+                       dtype=ca._sp_values.dtype)
+        pos_a = _np.searchsorted(union, ia)
+        pos_b = _np.searchsorted(union, ib)
+        va = va.at[jnp.asarray(pos_a)].set(ca._sp_values)
+        vb = jnp.zeros_like(va).at[jnp.asarray(pos_b)].set(cb._sp_values)
+        return RowSparseNDArray(op(va, vb), union.astype(_np.int32),
+                                a.shape, ctx=a._ctx)
+    _warn_fallback(name, a.stype if isinstance(a, BaseSparseNDArray)
+                   else getattr(b, "stype", "default"))
+    from . import ops as _ops
+    return getattr(_ops, name)(todense(a), todense(b))
+
+
+def add(a, b):
+    return _rsp_elemwise("add", jnp.add, a, b)
+
+
+def subtract(a, b):
+    return _rsp_elemwise("subtract", jnp.subtract, a, b)
+
+
+def multiply(a, b):
+    return _rsp_elemwise("multiply", jnp.multiply, a, b)

@@ -571,11 +571,32 @@ def dropout(data, p: float = 0.5, mode: str = "training", axes=None,
 def embedding(data, weight, input_dim: Optional[int] = None,
               output_dim: Optional[int] = None, dtype=None,
               sparse_grad: bool = False):
-    """Table lookup: out[i...] = weight[data[i...]]."""
+    """Table lookup: out[i...] = weight[data[i...]].
+
+    ``sparse_grad=True`` produces a row-sparse weight gradient
+    (reference: Embedding's kRowSparseStorage grad — only touched rows
+    are stored, feeding the lazy sparse optimizer updates)."""
+    nd_idx, nd_w = _as_nd(data), _as_nd(weight)
+
     def impl(idx, w):
         return jnp.take(w, idx.astype(jnp.int32), axis=0)
-    # weight first in grad order matters not; inputs order = (data, weight)
-    return invoke("embedding", impl, (_as_nd(data), _as_nd(weight)))
+
+    if not sparse_grad:
+        return invoke("embedding", impl, (nd_idx, nd_w))
+
+    from .._tape import RowSparseCot
+    from ..ndarray.register import invoke_with_custom_vjp
+
+    idx_raw = nd_idx._data
+    w_shape = tuple(nd_w.shape)
+
+    def vjp_fn(g):
+        flat_idx = idx_raw.reshape(-1).astype(jnp.int32)
+        vals = g.reshape((-1,) + w_shape[1:])
+        return (None, RowSparseCot(flat_idx, vals, w_shape))
+
+    return invoke_with_custom_vjp("embedding", impl, (nd_idx, nd_w),
+                                  vjp_fn)
 
 
 def take_positions(data, positions):

@@ -154,6 +154,11 @@ class Optimizer:
         wd = self._get_wd(index)
         hp = self._hyper(index)
         cls = type(self)
+        if getattr(grad, "stype", "default") == "row_sparse":
+            done = self._sparse_update(index, weight, grad, state,
+                                       lr, wd, hp)
+            if done is not NotImplemented:
+                return done
         cache_key = (cls, tuple(weight.shape), str(weight.dtype), hp,
                      self.clip_gradient is not None)
         stepfn = self._jit_cache.get(cache_key)
@@ -180,6 +185,42 @@ class Optimizer:
         weight._data = new_w
         engine.track(new_w)
         return new_state
+
+    def _sparse_update(self, index: Any, weight: NDArray, grad: Any,
+                       state: Any, lr: float, wd: float, hp: tuple) -> Any:
+        """Lazy row-sparse update: apply ``_step`` only on the touched rows
+        (reference: the ``lazy_update`` row_sparse optimizer kernels).
+        Returns NotImplemented when the state layout prevents row slicing
+        (caller then densifies via the storage-fallback path)."""
+        rows_dim = weight.shape[0]
+        leaves, treedef = jax.tree_util.tree_flatten(state)
+        if any(not hasattr(s, "shape") or not s.shape or
+               s.shape[0] != rows_dim for s in leaves):
+            return NotImplemented
+        rsp = grad._canonical()
+        rows = rsp._sp_indices
+        if rows.shape[0] == 0:
+            return state
+        cls = type(self)
+        g = rsp._sp_values
+        w_rows = weight._data[rows]
+        if w_rows.dtype != g.dtype:
+            g = g.astype(jnp.float32)
+        g = g * self.rescale_grad
+        if self.clip_gradient is not None:
+            g = jnp.clip(g, -self.clip_gradient, self.clip_gradient)
+        state_rows = jax.tree_util.tree_map(lambda s: s[rows], state)
+        t = self._index_update_count.get(index, self.begin_num_update)
+        new_w_rows, new_state_rows = cls._step(
+            w_rows, g, state_rows, jnp.float32(lr), jnp.float32(wd),
+            jnp.float32(t), hp)
+        weight._data = weight._data.at[rows].set(
+            new_w_rows.astype(weight._data.dtype))
+        engine.track(weight._data)
+        new_leaves = jax.tree_util.tree_leaves(new_state_rows)
+        updated = [s.at[rows].set(nl.astype(s.dtype))
+                   for s, nl in zip(leaves, new_leaves)]
+        return jax.tree_util.tree_unflatten(treedef, updated)
 
     def update_multi_precision(self, index: Any, weight: NDArray,
                                grad: NDArray, state: Any) -> Any:

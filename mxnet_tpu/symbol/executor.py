@@ -172,6 +172,12 @@ class Executor:
             grads = [out_grads if isinstance(out_grads, NDArray)
                      else NDArray(out_grads)]
         backward_arrays(self.outputs, grads)
+        # sparse-grad leaves rebind arr._grad to a fresh RowSparseNDArray;
+        # keep grad_dict pointing at the live gradient object
+        for n, arr in self.arg_dict.items():
+            if arr._grad is not None and \
+                    self.grad_dict.get(n) is not arr._grad:
+                self.grad_dict[n] = arr._grad
 
     # -- params ------------------------------------------------------------
     def copy_params_from(self, arg_params: Dict[str, Any],

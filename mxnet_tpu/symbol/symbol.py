@@ -283,12 +283,15 @@ class Symbol:
                 continue
             in_t = [memo.get(m.uid) for m, _ in node.inputs]
             out_t = _propagate_dtype(node, in_t)
-            # back-fill float params from the data dtype (implicit weights
-            # follow their consumer, as NNVM's back-inference did)
-            if out_t is not None:
-                for m, _ in node.inputs:
-                    if m.op == "null" and var_t.get(m.name) is None and \
-                            node.op in _PARAM_SPECS:
+            # back-fill implicit-param dtypes from the node result (NNVM's
+            # back-inference); only the spec'd param slots, never data
+            spec = _PARAM_SPECS.get(node.op)
+            if out_t is not None and spec is not None:
+                for kind, pname, pairs in _iter_layout(node):
+                    if kind != "sym" or pname not in spec:
+                        continue
+                    m, _ = pairs[0]
+                    if m.op == "null" and var_t.get(m.name) is None:
                         var_t[m.name] = memo[m.uid] = out_t
             memo[node.uid] = out_t
         args_out = [var_t.get(n) for n in self.list_arguments()]
@@ -565,6 +568,9 @@ def _prelu_shapes(structs, attrs):
     return {"gamma": (d.shape[1] if len(d.shape) > 1 else 1,)}
 
 
+# ops whose implicit params are float regardless of the data input dtype
+_FLOAT_PARAM_OPS = frozenset(["embedding"])
+
 _SHAPE_HOOKS: Dict[str, Callable] = {
     "fully_connected": _fc_shapes,
     "convolution": _conv_shapes,
@@ -675,19 +681,11 @@ def _apply_op(op: str, *args: Any, **kwargs: Any) -> Symbol:
             inputs.append((vnode, 0))
             layout.append(("sym", pname))
         # aux slots the user wired explicitly still count as aux states
-        it = iter(inputs)
-        for entry in layout:
-            if entry[0] == "sym":
-                node, _ = next(it)
-                if entry[1] in spec and spec[entry[1]][1] and \
-                        node.op == "null":
-                    node.is_aux = True
-            elif entry[0] == "symlist":
-                for _ in range(entry[2]):
-                    next(it)
-            elif entry[0] == "varsym":
-                for _ in range(entry[1]):
-                    next(it)
+        probe = _SymNode("probe", "probe", attrs, inputs, layout)
+        for kind, pname, pairs in _iter_layout(probe):
+            if kind == "sym" and pname in spec and spec[pname][1] and \
+                    pairs[0][0].op == "null":
+                pairs[0][0].is_aux = True
 
     node = _SymNode(op, name, attrs, inputs, layout)
     if user_attr:
@@ -704,6 +702,22 @@ def _apply_op(op: str, *args: Any, **kwargs: Any) -> Symbol:
         elif isinstance(sections, (list, tuple)):
             n_out = len(sections) + 1
     return Symbol([(node, i) for i in range(n_out)])
+
+
+def _iter_layout(node: _SymNode):
+    """Walk a node's input layout, yielding ``(kind, param_name, pairs)``
+    where ``pairs`` is the list of ``(input_node, out_idx)`` consumed by
+    that entry (param_name is None for varargs)."""
+    it = iter(node.inputs)
+    for entry in node.layout:
+        if entry[0] == "sym":
+            yield "sym", entry[1], [next(it)]
+        elif entry[0] == "symlist":
+            yield "symlist", entry[1], [next(it) for _ in range(entry[2])]
+        elif entry[0] == "varsym":
+            yield "varsym", None, [next(it) for _ in range(entry[1])]
+        else:
+            raise MXNetError(f"bad layout entry {entry!r}")
 
 
 def _call_node(node: _SymNode, in_vals: Sequence[Any],
@@ -760,18 +774,9 @@ def _eval_graph(sym: Symbol, feed: Dict[str, NDArray],
 
 def _bn_aux_names(node: _SymNode) -> Optional[Tuple[str, str]]:
     names = {}
-    it = iter(node.inputs)
-    for entry in node.layout:
-        if entry[0] == "sym":
-            m, _ = next(it)
-            if entry[1] in ("running_mean", "running_var"):
-                names[entry[1]] = m.name
-        elif entry[0] == "symlist":
-            for _ in range(entry[2]):
-                next(it)
-        elif entry[0] == "varsym":
-            for _ in range(entry[1]):
-                next(it)
+    for kind, pname, pairs in _iter_layout(node):
+        if kind == "sym" and pname in ("running_mean", "running_var"):
+            names[pname] = pairs[0][0].name
     if "running_mean" in names and "running_var" in names:
         return names["running_mean"], names["running_var"]
     return None
@@ -793,6 +798,13 @@ def _propagate_dtype(node: _SymNode, in_dtypes: List[Any]):
         return _np.dtype(_np.bool_)
     if node.op in _INT_OUT_OPS:
         return _np.dtype(_np.int64)
+    if node.op in _FLOAT_PARAM_OPS:
+        # Embedding: result follows the (float) table, not the int indices
+        for kind, pname, pairs in _iter_layout(node):
+            if kind == "sym" and pname == "weight":
+                wt = in_dtypes[node.inputs.index(pairs[0])]
+                return wt if wt is not None else _np.dtype(_np.float32)
+        return _np.dtype(_np.float32)
     known = [d for d in in_dtypes if d is not None]
     if not known:
         # creation ops (zeros/ones/...) carry a dtype attr
@@ -843,39 +855,31 @@ def _infer_structs(sym: Symbol, known: Dict[str, tuple], partial: bool,
         hook = _SHAPE_HOOKS.get(node.op)
         if hook is not None:
             in_named: Dict[str, Any] = {}
-            it = iter(node.inputs)
-            for entry in node.layout:
-                if entry[0] == "sym":
-                    m, idx = next(it)
+            for kind, pname, pairs in _iter_layout(node):
+                if kind == "sym":
+                    m, idx = pairs[0]
                     st = memo.get(m.uid)
-                    in_named[entry[1]] = st[idx] if st else None
-                elif entry[0] == "symlist":
-                    for _ in range(entry[2]):
-                        next(it)
-                elif entry[0] == "varsym":
-                    for _ in range(entry[1]):
-                        next(it)
+                    in_named[pname] = st[idx] if st else None
             inferred = hook(in_named, node.attrs)
-            it = iter(node.inputs)
-            for entry in node.layout:
-                if entry[0] == "sym":
-                    m, idx = next(it)
-                    if m.op == "null" and var_structs.get(m.name) is None \
-                            and entry[1] in inferred:
-                        dt = var_dtypes.get(
-                            m.name, m.attrs.get("__dtype__", None))
-                        if dt is None:
-                            d = in_named.get("data")
-                            dt = d.dtype if d is not None else "float32"
-                        var_structs[m.name] = jax.ShapeDtypeStruct(
-                            tuple(inferred[entry[1]]), _np.dtype(dt))
-                        memo[m.uid] = (var_structs[m.name],)
-                elif entry[0] == "symlist":
-                    for _ in range(entry[2]):
-                        next(it)
-                elif entry[0] == "varsym":
-                    for _ in range(entry[1]):
-                        next(it)
+            for kind, pname, pairs in _iter_layout(node):
+                if kind != "sym":
+                    continue
+                m, idx = pairs[0]
+                if m.op == "null" and var_structs.get(m.name) is None \
+                        and pname in inferred:
+                    dt = var_dtypes.get(
+                        m.name, m.attrs.get("__dtype__", None))
+                    if dt is None:
+                        d = in_named.get("data")
+                        # params of index-consuming ops (Embedding) are
+                        # float even when the data input is integer
+                        if node.op in _FLOAT_PARAM_OPS or d is None:
+                            dt = "float32"
+                        else:
+                            dt = d.dtype
+                    var_structs[m.name] = jax.ShapeDtypeStruct(
+                        tuple(inferred[pname]), _np.dtype(dt))
+                    memo[m.uid] = (var_structs[m.name],)
 
         in_structs = []
         ok = True

@@ -1,0 +1,185 @@
+"""Sparse storage tests (reference strategy: tests/python/unittest/
+test_sparse_ndarray.py + test_sparse_operator.py — creation, conversion,
+dot, retain, sparse Embedding grad, lazy optimizer updates)."""
+import warnings
+
+import numpy as onp
+import pytest
+
+import mxnet_tpu as mx
+from mxnet_tpu.ndarray import sparse
+
+
+def test_row_sparse_create_and_dense():
+    vals = onp.array([[1.0, 2.0], [3.0, 4.0]], dtype="float32")
+    rsp = sparse.row_sparse_array((vals, [1, 3]), shape=(5, 2))
+    assert rsp.stype == "row_sparse"
+    assert rsp.shape == (5, 2)
+    d = rsp.asnumpy()
+    expect = onp.zeros((5, 2), dtype="float32")
+    expect[1], expect[3] = vals[0], vals[1]
+    onp.testing.assert_allclose(d, expect)
+
+
+def test_dense_to_row_sparse_roundtrip():
+    a = onp.zeros((6, 3), dtype="float32")
+    a[2] = 1.5
+    a[4] = -2.0
+    nd = mx.np.array(a)
+    rsp = nd.tostype("row_sparse")
+    assert list(rsp.indices.asnumpy()) == [2, 4]
+    onp.testing.assert_allclose(rsp.todense().asnumpy(), a)
+
+
+def test_csr_create_and_dense():
+    # [[0, 1, 0], [2, 0, 3]]
+    csr = sparse.csr_matrix(([1.0, 2.0, 3.0], [1, 0, 2], [0, 1, 3]),
+                            shape=(2, 3))
+    assert csr.stype == "csr"
+    onp.testing.assert_allclose(csr.asnumpy(),
+                                [[0, 1, 0], [2, 0, 3]])
+    # row indexing
+    onp.testing.assert_allclose(csr[1].asnumpy(), [2, 0, 3])
+    sl = csr[0:1]
+    onp.testing.assert_allclose(sl.asnumpy(), [[0, 1, 0]])
+
+
+def test_dense_to_csr():
+    a = onp.array([[0, 5, 0], [0, 0, 0], [7, 0, 8]], dtype="float32")
+    csr = mx.np.array(a).tostype("csr")
+    onp.testing.assert_allclose(csr.asnumpy(), a)
+    assert list(csr.indptr.asnumpy()) == [0, 1, 1, 3]
+
+
+def test_csr_dot_dense():
+    onp.random.seed(0)
+    a = onp.random.rand(4, 6).astype("float32")
+    a[a < 0.6] = 0
+    b = onp.random.rand(6, 3).astype("float32")
+    csr = mx.np.array(a).tostype("csr")
+    out = sparse.dot(csr, mx.np.array(b))
+    onp.testing.assert_allclose(out.asnumpy(), a @ b, rtol=1e-5)
+    # transpose_a
+    out_t = sparse.dot(csr, mx.np.array(onp.random.rand(4, 2).astype(
+        "float32")), transpose_a=True)
+    assert out_t.shape == (6, 2)
+
+
+def test_retain():
+    vals = onp.arange(6, dtype="float32").reshape(3, 2)
+    rsp = sparse.row_sparse_array((vals, [0, 2, 4]), shape=(6, 2))
+    kept = sparse.retain(rsp, [2, 4])
+    assert list(kept.indices.asnumpy()) == [2, 4]
+    onp.testing.assert_allclose(kept.data.asnumpy(), vals[1:])
+
+
+def test_rsp_elemwise_add():
+    r1 = sparse.row_sparse_array(
+        (onp.ones((2, 3), dtype="float32"), [0, 2]), shape=(5, 3))
+    r2 = sparse.row_sparse_array(
+        (onp.full((2, 3), 2.0, dtype="float32"), [2, 4]), shape=(5, 3))
+    out = sparse.add(r1, r2)
+    assert out.stype == "row_sparse"
+    d = out.asnumpy()
+    onp.testing.assert_allclose(d[0], 1.0)
+    onp.testing.assert_allclose(d[2], 3.0)
+    onp.testing.assert_allclose(d[4], 2.0)
+    onp.testing.assert_allclose(d[1], 0.0)
+
+
+def test_dense_fallback_warns():
+    rsp = sparse.row_sparse_array(
+        (onp.ones((1, 2), dtype="float32"), [1]), shape=(3, 2))
+    with warnings.catch_warnings(record=True) as w:
+        warnings.simplefilter("always")
+        _ = (rsp + 1.0)  # dense-only op densifies
+        assert any("fallback" in str(x.message) for x in w)
+
+
+def test_embedding_sparse_grad():
+    onp.random.seed(0)
+    emb = mx.gluon.nn.Embedding(10, 4, sparse_grad=True)
+    emb.initialize()
+    idx = mx.np.array(onp.array([1, 3, 3], dtype="int32"))
+    with mx.autograd.record():
+        out = emb(idx)
+        loss = (out * out).sum()
+    loss.backward()
+    g = emb.weight.grad()
+    assert g.stype == "row_sparse"
+    rows = sorted(g.indices.asnumpy().tolist())
+    assert rows == [1, 3]
+    # value check vs dense embedding
+    emb_d = mx.gluon.nn.Embedding(10, 4)
+    emb_d.initialize()
+    emb_d.weight.set_data(emb.weight.data())
+    with mx.autograd.record():
+        out = emb_d(idx)
+        loss = (out * out).sum()
+    loss.backward()
+    gd = emb_d.weight.grad().asnumpy()
+    onp.testing.assert_allclose(g.todense().asnumpy(), gd, rtol=1e-6)
+
+
+def test_sparse_sgd_lazy_update():
+    onp.random.seed(0)
+    emb = mx.gluon.nn.Embedding(8, 3, sparse_grad=True)
+    emb.initialize()
+    w0 = emb.weight.data().asnumpy().copy()
+    trainer = mx.gluon.Trainer(emb.collect_params(), "sgd",
+                               {"learning_rate": 1.0})
+    idx = mx.np.array(onp.array([2, 5], dtype="int32"))
+    with mx.autograd.record():
+        loss = emb(idx).sum()
+    loss.backward()
+    trainer.step(1)
+    w1 = emb.weight.data().asnumpy()
+    # only rows 2 and 5 moved
+    changed = onp.where(onp.abs(w1 - w0).sum(axis=1) > 0)[0].tolist()
+    assert changed == [2, 5]
+    onp.testing.assert_allclose(w1[2], w0[2] - 1.0, rtol=1e-5)
+
+
+def test_sparse_adam_lazy_update():
+    emb = mx.gluon.nn.Embedding(8, 3, sparse_grad=True)
+    emb.initialize()
+    w0 = emb.weight.data().asnumpy().copy()
+    trainer = mx.gluon.Trainer(emb.collect_params(), "adam",
+                               {"learning_rate": 0.1, "wd": 0.0})
+    for _ in range(2):
+        idx = mx.np.array(onp.array([1], dtype="int32"))
+        with mx.autograd.record():
+            loss = emb(idx).sum()
+        loss.backward()
+        trainer.step(1)
+    w1 = emb.weight.data().asnumpy()
+    changed = onp.where(onp.abs(w1 - w0).sum(axis=1) > 0)[0].tolist()
+    assert changed == [1]
+
+
+def test_sparse_zeros():
+    z = sparse.zeros("row_sparse", (4, 2))
+    assert z.stype == "row_sparse"
+    onp.testing.assert_allclose(z.asnumpy(), 0)
+    zc = sparse.zeros("csr", (3, 3))
+    onp.testing.assert_allclose(zc.asnumpy(), 0)
+
+
+def test_kvstore_row_sparse_pull():
+    kv = mx.kvstore.create("local")
+    w = mx.np.array(onp.arange(12, dtype="float32").reshape(6, 2))
+    kv.init("w", w)
+    out = kv.row_sparse_pull("w", row_ids=mx.np.array(
+        onp.array([1, 4, 1], dtype="int32")))
+    assert out.stype == "row_sparse"
+    assert list(out.indices.asnumpy()) == [1, 4]
+    onp.testing.assert_allclose(out.data.asnumpy(),
+                                [[2, 3], [8, 9]])
+
+
+def test_duplicate_indices_canonicalized():
+    rsp = sparse.row_sparse_array(
+        (onp.ones((3, 2), dtype="float32"), [1, 1, 0]), shape=(4, 2))
+    c = rsp._canonical()
+    assert list(c.indices.asnumpy()) == [0, 1]
+    onp.testing.assert_allclose(c.todense().asnumpy()[1], 2.0)
