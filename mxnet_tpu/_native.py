@@ -1,0 +1,339 @@
+"""ctypes bindings for the native runtime (libmxtpu.so).
+
+Reference parity (leezu/mxnet): ``python/mxnet/base.py`` (``_LIB`` ctypes
+loading, ``check_call`` + ``MXGetLastError`` error trampoline).  The
+native library provides the host-side runtime: dependency engine, pooled
+storage, RecordIO and the threaded prefetcher (see ``src/mxtpu.h``).
+
+Everything degrades gracefully: if the library is absent and cannot be
+built (no toolchain), ``LIB`` is ``None`` and pure-Python fallbacks are
+used by callers.
+"""
+from __future__ import annotations
+
+import atexit
+import ctypes
+import os
+import subprocess
+import threading
+from typing import Callable, Dict, List, Optional, Sequence
+
+from .base import MXNetError
+
+__all__ = ["LIB", "check_call", "NativeEngine", "NativeRecordWriter",
+           "NativeRecordReader", "NativePrefetcher", "storage_stats",
+           "storage_release_all", "native_features"]
+
+_PKG_DIR = os.path.dirname(os.path.abspath(__file__))
+_LIB_PATH = os.path.join(_PKG_DIR, "libmxtpu.so")
+_SRC_DIR = os.path.join(os.path.dirname(_PKG_DIR), "src")
+
+
+def _try_build() -> bool:
+    if os.environ.get("MXNET_NATIVE_BUILD", "1") == "0":
+        return False
+    if not os.path.isfile(os.path.join(_SRC_DIR, "Makefile")):
+        return False
+    try:
+        subprocess.run(["make", "-C", _SRC_DIR], check=True,
+                       capture_output=True, timeout=300)
+        return os.path.isfile(_LIB_PATH)
+    except (OSError, subprocess.SubprocessError):
+        return False
+
+
+def _load() -> Optional[ctypes.CDLL]:
+    if not os.path.isfile(_LIB_PATH) and not _try_build():
+        return None
+    try:
+        lib = ctypes.CDLL(_LIB_PATH)
+    except OSError:
+        return None
+    lib.MXGetLastError.restype = ctypes.c_char_p
+    lib.MXLibInfoFeatures.restype = ctypes.c_char_p
+    return lib
+
+
+LIB = _load()
+
+# Engine callback signatures (src/mxtpu.h MXEngineFn / MXEngineOnComplete).
+_ENGINE_FN = ctypes.CFUNCTYPE(None, ctypes.c_void_p)
+_ON_COMPLETE = ctypes.CFUNCTYPE(None, ctypes.c_void_p, ctypes.c_int)
+
+
+def check_call(ret: int) -> None:
+    """Raise MXNetError with the native message on nonzero return."""
+    if ret != 0:
+        msg = LIB.MXGetLastError().decode("utf-8", "replace")
+        raise MXNetError(msg or "native call failed")
+
+
+def native_features() -> List[str]:
+    if LIB is None:
+        return []
+    return LIB.MXLibInfoFeatures().decode().split(",")
+
+
+def storage_stats() -> Dict[str, int]:
+    """Pooled-allocator counters (storage/pooled_storage_manager.h)."""
+    if LIB is None:
+        return {}
+    vals = [ctypes.c_uint64() for _ in range(4)]
+    check_call(LIB.MXStorageStats(*[ctypes.byref(v) for v in vals]))
+    keys = ("bytes_in_use", "bytes_pooled", "pool_hits", "pool_misses")
+    return dict(zip(keys, (v.value for v in vals)))
+
+
+def storage_release_all() -> None:
+    if LIB is not None:
+        check_call(LIB.MXStorageReleaseAll())
+
+
+class NativeEngine:
+    """Asynchronous host-work engine with read/write var dependencies.
+
+    Mirrors ``Engine::PushAsync`` semantics (include/mxnet/engine.h):
+    callables pushed with var lists execute on worker threads once all
+    dependencies clear; writers are exclusive, readers concurrent.
+    """
+
+    def __init__(self, num_workers: int = 0, naive: bool = False) -> None:
+        if LIB is None:
+            raise MXNetError("native library unavailable")
+        self.handle = ctypes.c_void_p()
+        check_call(LIB.MXEngineCreate(num_workers, int(naive),
+                                      ctypes.byref(self.handle)))
+        self._lock = threading.Lock()
+        self._inflight: Dict[int, Callable[[], None]] = {}
+        self._token = 0
+        # static trampolines: the engine invokes _fn then _done exactly
+        # once per op, so the closure registry cannot leak
+        self._fn_cb = _ENGINE_FN(self._fn)
+        self._done_cb = _ON_COMPLETE(self._done)
+        self._closed = False
+
+    def _fn(self, ctx) -> None:
+        with self._lock:
+            fn = self._inflight.get(int(ctx or 0))
+        if fn is not None:
+            try:
+                fn()
+            except Exception:   # noqa: BLE001 — worker threads must survive
+                import traceback
+                traceback.print_exc()
+
+    def _done(self, ctx, _cancelled) -> None:
+        with self._lock:
+            self._inflight.pop(int(ctx or 0), None)
+
+    def new_var(self) -> int:
+        out = ctypes.c_void_p()
+        check_call(LIB.MXEngineNewVar(self.handle, ctypes.byref(out)))
+        return out.value
+
+    def free_var(self, var: int) -> None:
+        check_call(LIB.MXEngineFreeVar(self.handle,
+                                       ctypes.c_void_p(var)))
+
+    def push(self, fn: Callable[[], None],
+             read_vars: Sequence[int] = (),
+             write_vars: Sequence[int] = (),
+             priority: int = 0, name: str = "") -> None:
+        with self._lock:
+            self._token += 1
+            token = self._token
+            self._inflight[token] = fn
+        n_r, n_w = len(read_vars), len(write_vars)
+        r_arr = (ctypes.c_void_p * max(n_r, 1))(*read_vars)
+        w_arr = (ctypes.c_void_p * max(n_w, 1))(*write_vars)
+        check_call(LIB.MXEnginePushAsync(
+            self.handle, self._fn_cb, ctypes.c_void_p(token),
+            self._done_cb, r_arr, n_r, w_arr, n_w, priority,
+            name.encode() if name else None))
+
+    def wait_for_var(self, var: int) -> None:
+        check_call(LIB.MXEngineWaitForVar(self.handle,
+                                          ctypes.c_void_p(var)))
+
+    def wait_all(self) -> None:
+        check_call(LIB.MXEngineWaitAll(self.handle))
+
+    def set_profiling(self, enabled: bool) -> None:
+        check_call(LIB.MXEngineSetProfiling(self.handle, int(enabled)))
+
+    def dump_profile(self) -> str:
+        out = ctypes.c_char_p()
+        check_call(LIB.MXEngineDumpProfile(self.handle,
+                                           ctypes.byref(out)))
+        try:
+            return (out.value or b"[]").decode()
+        finally:
+            LIB.MXFreeString(out)
+
+    def close(self) -> None:
+        if not self._closed and self.handle:
+            self._closed = True
+            check_call(LIB.MXEngineFree(self.handle))
+            self.handle = None
+
+    def __del__(self) -> None:
+        try:
+            self.close()
+        except Exception:   # noqa: BLE001 — interpreter teardown
+            pass
+
+
+_GLOBAL_ENGINE: Optional[NativeEngine] = None
+_GLOBAL_ENGINE_LOCK = threading.Lock()
+
+
+def global_engine() -> Optional[NativeEngine]:
+    """Lazily-created shared engine (CreateEngine in engine/engine.cc);
+    honors MXNET_ENGINE_TYPE=NaiveEngine."""
+    global _GLOBAL_ENGINE
+    if LIB is None:
+        return None
+    with _GLOBAL_ENGINE_LOCK:
+        if _GLOBAL_ENGINE is None:
+            naive = os.environ.get("MXNET_ENGINE_TYPE") == "NaiveEngine"
+            nthreads = int(os.environ.get("MXNET_CPU_WORKER_NTHREADS", "0"))
+            _GLOBAL_ENGINE = NativeEngine(nthreads, naive)
+        return _GLOBAL_ENGINE
+
+
+@atexit.register
+def _shutdown() -> None:
+    global _GLOBAL_ENGINE
+    with _GLOBAL_ENGINE_LOCK:
+        if _GLOBAL_ENGINE is not None:
+            try:
+                _GLOBAL_ENGINE.wait_all()
+                _GLOBAL_ENGINE.close()
+            except Exception:   # noqa: BLE001
+                pass
+            _GLOBAL_ENGINE = None
+
+
+class NativeRecordWriter:
+    def __init__(self, path: str) -> None:
+        if LIB is None:
+            raise MXNetError("native library unavailable")
+        self.handle = ctypes.c_void_p()
+        check_call(LIB.MXRecordIOWriterCreate(path.encode(),
+                                              ctypes.byref(self.handle)))
+
+    def write(self, buf: bytes) -> int:
+        pos = ctypes.c_uint64()
+        check_call(LIB.MXRecordIOWriterWrite(
+            self.handle, buf, ctypes.c_uint64(len(buf)),
+            ctypes.byref(pos)))
+        return pos.value
+
+    def tell(self) -> int:
+        pos = ctypes.c_uint64()
+        check_call(LIB.MXRecordIOWriterTell(self.handle,
+                                            ctypes.byref(pos)))
+        return pos.value
+
+    def close(self) -> None:
+        if self.handle:
+            check_call(LIB.MXRecordIOWriterFree(self.handle))
+            self.handle = None
+
+    def __del__(self) -> None:
+        try:
+            self.close()
+        except Exception:   # noqa: BLE001
+            pass
+
+
+class NativeRecordReader:
+    def __init__(self, path: str) -> None:
+        if LIB is None:
+            raise MXNetError("native library unavailable")
+        self.handle = ctypes.c_void_p()
+        check_call(LIB.MXRecordIOReaderCreate(path.encode(),
+                                              ctypes.byref(self.handle)))
+
+    def read(self) -> Optional[bytes]:
+        data = ctypes.c_void_p()
+        size = ctypes.c_uint64()
+        check_call(LIB.MXRecordIOReaderNext(
+            self.handle, ctypes.byref(data), ctypes.byref(size)))
+        if data.value is None:
+            return None
+        return ctypes.string_at(data.value, size.value)
+
+    def seek(self, pos: int) -> None:
+        check_call(LIB.MXRecordIOReaderSeek(self.handle,
+                                            ctypes.c_uint64(pos)))
+
+    def tell(self) -> int:
+        pos = ctypes.c_uint64()
+        check_call(LIB.MXRecordIOReaderTell(self.handle,
+                                            ctypes.byref(pos)))
+        return pos.value
+
+    def scan_index(self) -> List[int]:
+        buf = ctypes.POINTER(ctypes.c_uint64)()
+        count = ctypes.c_uint64()
+        check_call(LIB.MXRecordIOReaderScanIndex(
+            self.handle, ctypes.byref(buf), ctypes.byref(count)))
+        try:
+            return [buf[i] for i in range(count.value)]
+        finally:
+            LIB.MXFreeBuffer(buf)
+
+    def close(self) -> None:
+        if self.handle:
+            check_call(LIB.MXRecordIOReaderFree(self.handle))
+            self.handle = None
+
+    def __del__(self) -> None:
+        try:
+            self.close()
+        except Exception:   # noqa: BLE001
+            pass
+
+
+class NativePrefetcher:
+    """Background-thread record batches (src/io/iter_prefetcher.h)."""
+
+    def __init__(self, path: str, batch_size: int, capacity: int = 4,
+                 index: Optional[Sequence[int]] = None) -> None:
+        if LIB is None:
+            raise MXNetError("native library unavailable")
+        self.batch_size = batch_size
+        self.handle = ctypes.c_void_p()
+        n = len(index) if index else 0
+        idx_arr = (ctypes.c_uint64 * max(n, 1))(*(index or ()))
+        check_call(LIB.MXPrefetcherCreate(
+            path.encode(), batch_size, capacity,
+            idx_arr if n else None, ctypes.c_uint64(n),
+            ctypes.byref(self.handle)))
+        # c_void_p (not c_char_p): records are binary; c_char_p getitem
+        # would truncate at the first NUL byte
+        self._data = (ctypes.c_void_p * batch_size)()
+        self._sizes = (ctypes.c_uint64 * batch_size)()
+
+    def next_batch(self) -> List[bytes]:
+        """Returns the next list of records; [] at epoch end."""
+        n = ctypes.c_int()
+        check_call(LIB.MXPrefetcherNext(self.handle, self._data,
+                                        self._sizes, ctypes.byref(n)))
+        return [ctypes.string_at(self._data[i], self._sizes[i])
+                for i in range(n.value)]
+
+    def reset(self) -> None:
+        check_call(LIB.MXPrefetcherReset(self.handle))
+
+    def close(self) -> None:
+        if self.handle:
+            check_call(LIB.MXPrefetcherFree(self.handle))
+            self.handle = None
+
+    def __del__(self) -> None:
+        try:
+            self.close()
+        except Exception:   # noqa: BLE001
+            pass

@@ -30,7 +30,8 @@ import jax
 
 from .base import MXNetError, getenv
 
-__all__ = ["waitall", "is_naive", "set_bulk_size", "bulk"]
+__all__ = ["waitall", "is_naive", "set_bulk_size", "bulk",
+           "native_engine", "push_host_async"]
 
 # Weak registry of live device arrays so waitall() can provide a true
 # barrier. jax arrays are weakref-able but unhashable, so this is an
@@ -80,6 +81,36 @@ def waitall() -> None:
 def wait(arrs: Iterable[Any]) -> None:
     for a in arrs:
         _sync_and_translate(a)
+
+
+# ---------------------------------------------------------------------------
+# Native host-work engine (src/engine.cc — ThreadedEngine analog).
+# Device ordering belongs to XLA; this engine schedules *host* work (IO
+# decode, custom ops, checkpoint writes) with the reference's read/write
+# var dependency discipline.
+# ---------------------------------------------------------------------------
+
+def native_engine():
+    """The shared native dependency engine, or None if libmxtpu.so is
+    unavailable (``Engine::Get()`` analog; ``MXNET_ENGINE_TYPE`` and
+    ``MXNET_CPU_WORKER_NTHREADS`` are honored at creation)."""
+    from ._native import global_engine
+    return global_engine()
+
+
+def push_host_async(fn, read_vars=(), write_vars=(), priority: int = 0,
+                    name: str = "") -> bool:
+    """Push host work with var dependencies (``Engine::PushAsync``).
+
+    Returns True if scheduled on the native engine, False if executed
+    inline (no native library)."""
+    eng = native_engine()
+    if eng is None:
+        fn()
+        return False
+    eng.push(fn, read_vars=read_vars, write_vars=write_vars,
+             priority=priority, name=name)
+    return True
 
 
 # ---------------------------------------------------------------------------

@@ -65,6 +65,19 @@ class Parameter:
         if not differentiable:
             grad_req = "null"
         self._grad_req = grad_req
+        # Storage types (reference: Parameter(..., stype, grad_stype)).
+        # stype='row_sparse' weights (full sparse-weight training) are not
+        # supported — fail loudly rather than silently training densely.
+        # grad_stype is advisory: sparse gradients materialize when the
+        # producing op emits them (npx.embedding sparse_grad), matching
+        # how Embedding wires it; a dense-only graph yields dense grads.
+        if stype != "default":
+            raise ValueError(
+                f"Parameter stype={stype!r} is not supported (only "
+                "'default'; sparse *gradients* come via grad_stype)")
+        if grad_stype not in ("default", "row_sparse"):
+            raise ValueError(f"invalid grad_stype {grad_stype!r}")
+        self.grad_stype = grad_stype
         self._data: Optional[NDArray] = None
         self._ctx: Optional[Context] = None
         self._deferred_init: Optional[tuple] = None  # (init, ctx, default_init)

@@ -113,10 +113,17 @@ class KVStore:
         rows = v._data[ids]
         rsp = RowSparseNDArray(rows, ids.astype(_onp.int32),
                                tuple(v.shape), ctx=v.context)
-        if out is not None and isinstance(out, RowSparseNDArray):
+        if out is not None:
+            if not isinstance(out, RowSparseNDArray):
+                # the reference errors on a dense out here
+                # (kvstore_local.h PullRowSparseImpl CHECKs the stype)
+                raise MXNetError(
+                    "row_sparse_pull requires a row_sparse `out`, got "
+                    f"stype {getattr(out, 'stype', 'default')!r}")
             out._sp_values = rsp._sp_values
             out._sp_indices = rsp._sp_indices
             out._sp_shape = rsp._sp_shape
+            out._sp_dtype = rsp._sp_values.dtype
             out._dense_cache = None
             return out
         return rsp

@@ -346,10 +346,14 @@ class Module(BaseModule):
             if labels:
                 # labels arrive ordered by label_names; select by name so
                 # a non-prefix consumed subset still lines up
-                feeds += [labels[self._label_names.index(n)]
-                          if self._label_names.index(n) < len(labels)
-                          else labels[-1]
-                          for n in self._used_labels]
+                for n in self._used_labels:
+                    pos = self._label_names.index(n)
+                    if pos >= len(labels):
+                        raise MXNetError(
+                            f"label {n!r} (position {pos} of "
+                            f"{self._label_names}) not provided: batch "
+                            f"has only {len(labels)} label array(s)")
+                    feeds.append(labels[pos])
             else:   # inference without labels: heads ignore label values
                 feeds += [NDArray(_np.zeros((self._cur_batch_size,),
                                             dtype=_np.float32))

@@ -69,7 +69,11 @@ class BaseSparseNDArray(NDArray):
 
     @_data.setter
     def _data(self, value) -> None:
-        # a dense write converts this array to dense storage semantics
+        # A dense write re-encodes the value into this array's storage
+        # format (the reference's storage-fallback cast on write), so
+        # sparse readers (stype/asnumpy/optimizer FComputeEx paths) stay
+        # consistent with dense ones.
+        self._assign_dense(value)
         self._dense_cache = value
 
     @property
@@ -105,6 +109,9 @@ class BaseSparseNDArray(NDArray):
         raise NotImplementedError
 
     def _components(self):
+        raise NotImplementedError
+
+    def _assign_dense(self, value):
         raise NotImplementedError
 
 
@@ -176,6 +183,14 @@ class RowSparseNDArray(BaseSparseNDArray):
                                     self._sp_shape, ctx=other)
         return super().copyto(other)
 
+    def _assign_dense(self, value) -> None:
+        # all-rows representation: indices = arange(nrows)
+        v = jnp.asarray(value)
+        self._sp_values = v
+        self._sp_indices = jnp.arange(v.shape[0], dtype=jnp.int32)
+        self._sp_shape = tuple(v.shape)
+        self._sp_dtype = v.dtype
+
     def _canonical(self) -> "RowSparseNDArray":
         """Deduplicate + sort row ids (host-side; eager only)."""
         idx = _np.asarray(self._sp_indices)
@@ -234,6 +249,19 @@ class CSRNDArray(BaseSparseNDArray):
     def _row_ids(self) -> _np.ndarray:
         ptr = _np.asarray(self._sp_indptr)
         return _np.repeat(_np.arange(self._sp_shape[0]), _np.diff(ptr))
+
+    def _assign_dense(self, value) -> None:
+        arr = _np.asarray(value)
+        if arr.ndim != 2:
+            raise MXNetError("csr arrays are 2-D")
+        mask = arr != 0
+        self._sp_data = jnp.asarray(arr[mask])
+        self._sp_indices = jnp.asarray(
+            _np.nonzero(mask)[1].astype(_np.int32))
+        self._sp_indptr = jnp.asarray(_np.concatenate(
+            [[0], _np.cumsum(mask.sum(axis=1))]).astype(_np.int32))
+        self._sp_shape = tuple(arr.shape)
+        self._sp_dtype = jnp.asarray(arr).dtype
 
     def _todense_impl(self):
         dense = jnp.zeros(self._sp_shape, dtype=self._sp_dtype)

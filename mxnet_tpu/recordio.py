@@ -37,26 +37,46 @@ _IR_FORMAT = "<IfQQ"
 _IR_SIZE = struct.calcsize(_IR_FORMAT)
 
 
+def _use_native() -> bool:
+    from . import _native
+    return (_native.LIB is not None
+            and os.environ.get("MXNET_NATIVE_RECORDIO", "1") != "0")
+
+
 class MXRecordIO:
-    """Sequential reader/writer of RecordIO files."""
+    """Sequential reader/writer of RecordIO files.
+
+    Backed by the native C++ reader/writer (``src/recordio.cc``, the
+    dmlc::RecordIOReader analog) when ``libmxtpu.so`` is available;
+    pure-Python fallback otherwise.  Both produce identical bytes.
+    """
 
     def __init__(self, uri: str, flag: str) -> None:
         self.uri = uri
         self.flag = flag
         self.fid: Optional[io.BufferedIOBase] = None
+        self._nat = None
         self.open()
 
     def open(self) -> None:
         if self.flag == "w":
-            self.fid = open(self.uri, "wb")
             self.writable = True
         elif self.flag == "r":
-            self.fid = open(self.uri, "rb")
             self.writable = False
         else:
             raise MXNetError(f"invalid flag {self.flag!r} (use 'r'/'w')")
+        if _use_native():
+            from . import _native
+            self._nat = (_native.NativeRecordWriter(self.uri)
+                         if self.writable
+                         else _native.NativeRecordReader(self.uri))
+        else:
+            self.fid = open(self.uri, "wb" if self.writable else "rb")
 
     def close(self) -> None:
+        if self._nat is not None:
+            self._nat.close()
+            self._nat = None
         if self.fid is not None:
             self.fid.close()
             self.fid = None
@@ -71,7 +91,8 @@ class MXRecordIO:
     def __getstate__(self):
         d = dict(self.__dict__)
         d["fid"] = None
-        d["_pos"] = self.tell() if self.fid else 0
+        d["_nat"] = None
+        d["_pos"] = self.tell() if (self.fid or self._nat) else 0
         return d
 
     def __setstate__(self, d):
@@ -79,7 +100,7 @@ class MXRecordIO:
         self.__dict__.update(d)
         self.open()
         if not self.writable:
-            self.fid.seek(pos)
+            self.seek(pos)
 
     def write(self, buf: bytes) -> None:
         if not self.writable:
@@ -87,6 +108,9 @@ class MXRecordIO:
         length = len(buf)
         if length > _LEN_MASK:
             raise MXNetError(f"record too large ({length} bytes)")
+        if self._nat is not None:
+            self._nat.write(bytes(buf))
+            return
         self.fid.write(struct.pack("<II", _KMAGIC, length))
         self.fid.write(buf)
         pad = (-(8 + length)) % 4
@@ -96,6 +120,8 @@ class MXRecordIO:
     def read(self) -> Optional[bytes]:
         if self.writable:
             raise MXNetError("file opened for writing")
+        if self._nat is not None:
+            return self._nat.read()
         head = self.fid.read(8)
         if len(head) < 8:
             return None
@@ -110,12 +136,17 @@ class MXRecordIO:
         return data
 
     def tell(self) -> int:
+        if self._nat is not None:
+            return self._nat.tell()
         return self.fid.tell()
 
     def seek(self, pos: int) -> None:
         if self.writable:
             raise MXNetError("cannot seek a writable recordio")
-        self.fid.seek(pos)
+        if self._nat is not None:
+            self._nat.seek(pos)
+        else:
+            self.fid.seek(pos)
 
 
 class MXIndexedRecordIO(MXRecordIO):
@@ -143,7 +174,7 @@ class MXIndexedRecordIO(MXRecordIO):
                         self.keys.append(key)
 
     def close(self) -> None:
-        if self.fid is not None and self.writable:
+        if (self.fid is not None or self._nat is not None) and self.writable:
             with open(self.idx_path, "w") as f:
                 for key in self.keys:
                     f.write(f"{key}\t{self.idx[key]}\n")
@@ -154,7 +185,7 @@ class MXIndexedRecordIO(MXRecordIO):
         return self.read()
 
     def write_idx(self, idx: Any, buf: bytes) -> None:
-        pos = self.fid.tell()
+        pos = self.tell()
         self.write(buf)
         self.idx[idx] = pos
         self.keys.append(idx)

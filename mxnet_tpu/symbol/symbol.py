@@ -287,7 +287,7 @@ class Symbol:
             # back-inference); only the spec'd param slots, never data
             spec = _PARAM_SPECS.get(node.op)
             if out_t is not None and spec is not None:
-                for kind, pname, pairs in _iter_layout(node):
+                for kind, pname, pairs in _iter_layout(node.inputs, node.layout):
                     if kind != "sym" or pname not in spec:
                         continue
                     m, _ = pairs[0]
@@ -681,8 +681,7 @@ def _apply_op(op: str, *args: Any, **kwargs: Any) -> Symbol:
             inputs.append((vnode, 0))
             layout.append(("sym", pname))
         # aux slots the user wired explicitly still count as aux states
-        probe = _SymNode("probe", "probe", attrs, inputs, layout)
-        for kind, pname, pairs in _iter_layout(probe):
+        for kind, pname, pairs in _iter_layout(inputs, layout):
             if kind == "sym" and pname in spec and spec[pname][1] and \
                     pairs[0][0].op == "null":
                 pairs[0][0].is_aux = True
@@ -704,12 +703,12 @@ def _apply_op(op: str, *args: Any, **kwargs: Any) -> Symbol:
     return Symbol([(node, i) for i in range(n_out)])
 
 
-def _iter_layout(node: _SymNode):
-    """Walk a node's input layout, yielding ``(kind, param_name, pairs)``
+def _iter_layout(inputs, layout):
+    """Walk an input layout, yielding ``(kind, param_name, pairs)``
     where ``pairs`` is the list of ``(input_node, out_idx)`` consumed by
     that entry (param_name is None for varargs)."""
-    it = iter(node.inputs)
-    for entry in node.layout:
+    it = iter(inputs)
+    for entry in layout:
         if entry[0] == "sym":
             yield "sym", entry[1], [next(it)]
         elif entry[0] == "symlist":
@@ -774,7 +773,7 @@ def _eval_graph(sym: Symbol, feed: Dict[str, NDArray],
 
 def _bn_aux_names(node: _SymNode) -> Optional[Tuple[str, str]]:
     names = {}
-    for kind, pname, pairs in _iter_layout(node):
+    for kind, pname, pairs in _iter_layout(node.inputs, node.layout):
         if kind == "sym" and pname in ("running_mean", "running_var"):
             names[pname] = pairs[0][0].name
     if "running_mean" in names and "running_var" in names:
@@ -800,7 +799,7 @@ def _propagate_dtype(node: _SymNode, in_dtypes: List[Any]):
         return _np.dtype(_np.int64)
     if node.op in _FLOAT_PARAM_OPS:
         # Embedding: result follows the (float) table, not the int indices
-        for kind, pname, pairs in _iter_layout(node):
+        for kind, pname, pairs in _iter_layout(node.inputs, node.layout):
             if kind == "sym" and pname == "weight":
                 wt = in_dtypes[node.inputs.index(pairs[0])]
                 return wt if wt is not None else _np.dtype(_np.float32)
@@ -855,13 +854,13 @@ def _infer_structs(sym: Symbol, known: Dict[str, tuple], partial: bool,
         hook = _SHAPE_HOOKS.get(node.op)
         if hook is not None:
             in_named: Dict[str, Any] = {}
-            for kind, pname, pairs in _iter_layout(node):
+            for kind, pname, pairs in _iter_layout(node.inputs, node.layout):
                 if kind == "sym":
                     m, idx = pairs[0]
                     st = memo.get(m.uid)
                     in_named[pname] = st[idx] if st else None
             inferred = hook(in_named, node.attrs)
-            for kind, pname, pairs in _iter_layout(node):
+            for kind, pname, pairs in _iter_layout(node.inputs, node.layout):
                 if kind != "sym":
                     continue
                 m, idx = pairs[0]

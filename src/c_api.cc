@@ -1,0 +1,34 @@
+/*
+ * c_api.cc — error trampoline + runtime feature introspection.
+ *
+ * Reference parity (leezu/mxnet): src/c_api/c_api_error.cc
+ * (MXGetLastError with thread-local storage; every API function returns
+ * -1 and stores the message) and src/libinfo.cc (runtime feature flags
+ * surfaced as mx.runtime.Features).
+ */
+#include <string>
+
+#include "./mxtpu.h"
+
+namespace mxtpu {
+
+namespace {
+thread_local std::string last_error;
+}
+
+void SetLastError(const std::string &msg) { last_error = msg; }
+
+}  // namespace mxtpu
+
+extern "C" {
+
+const char *MXGetLastError(void) { return mxtpu::last_error.c_str(); }
+
+const char *MXLibInfoFeatures(void) {
+  /* comma-separated feature names; the Python side pairs this with
+   * jax-derived features (TPU, etc.) in mxnet_tpu.runtime */
+  return "NATIVE_ENGINE,NATIVE_STORAGE_POOL,NATIVE_RECORDIO,"
+         "NATIVE_PREFETCHER,CHROME_TRACE_PROFILER";
+}
+
+}  // extern "C"

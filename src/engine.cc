@@ -1,0 +1,423 @@
+/*
+ * engine.cc — asynchronous dependency engine.
+ *
+ * Reference parity (leezu/mxnet): src/engine/threaded_engine.{h,cc},
+ * src/engine/threaded_engine_perdevice.cc, src/engine/naive_engine.cc.
+ *
+ * The scheduling model is the reference's: an op is pushed with lists of
+ * read and write vars; each var serialises writers and parallelises
+ * readers in FIFO order (ThreadedVar); when every var has granted access
+ * the op is dispatched to a worker pool; on completion each var releases
+ * its grant and wakes successors.  Unlike the reference there is no
+ * device-stream dimension — XLA owns device ordering — so this engine
+ * schedules *host* work: IO decode, custom Python ops, checkpoint writes.
+ */
+#include <atomic>
+#include <chrono>
+#include <condition_variable>
+#include <cstring>
+#include <deque>
+#include <mutex>
+#include <queue>
+#include <string>
+#include <thread>
+#include <vector>
+
+#include "./mxtpu.h"
+
+namespace mxtpu {
+
+void SetLastError(const std::string &msg);
+
+namespace {
+
+struct Opr;
+
+/* ThreadedVar analog: FIFO queue of pending accesses per var. */
+struct Var {
+  std::mutex mu;
+  struct Pending {
+    Opr *op;
+    bool write;
+  };
+  std::deque<Pending> queue;
+  int active_reads = 0;
+  bool active_write = false;
+  bool to_delete = false;  /* free requested; delete when drained */
+
+  /* Called with mu held.  Grants queued accesses that can proceed now;
+   * returns ops whose dependency count hit zero. */
+  void Grant(std::vector<Opr *> *ready);
+};
+
+struct Opr {
+  MXEngineFn fn;
+  void *ctx;
+  MXEngineOnComplete on_complete;
+  std::vector<Var *> reads;
+  std::vector<Var *> writes;
+  std::atomic<int> wait_count{0};
+  int priority = 0;
+  std::string name;
+};
+
+struct ProfileEvent {
+  std::string name;
+  uint64_t tid;
+  uint64_t start_us;
+  uint64_t dur_us;
+};
+
+class Engine {
+ public:
+  Engine(int num_workers, bool naive) : naive_(naive) {
+    if (!naive_) {
+      if (num_workers <= 0) {
+        num_workers = static_cast<int>(std::thread::hardware_concurrency());
+        if (num_workers <= 0) num_workers = 4;
+      }
+      for (int i = 0; i < num_workers; ++i) {
+        workers_.emplace_back([this] { WorkerLoop(); });
+      }
+    }
+  }
+
+  ~Engine() {
+    WaitAll();
+    {
+      std::lock_guard<std::mutex> lk(mu_);
+      shutdown_ = true;
+    }
+    cv_.notify_all();
+    for (auto &t : workers_) t.join();
+  }
+
+  Var *NewVar() { return new Var(); }
+
+  void FreeVar(Var *v) {
+    bool idle;
+    {
+      std::lock_guard<std::mutex> lk(v->mu);
+      v->to_delete = true;
+      idle = v->queue.empty() && v->active_reads == 0 && !v->active_write;
+    }
+    if (idle) delete v;
+  }
+
+  void Push(MXEngineFn fn, void *ctx, MXEngineOnComplete on_complete,
+            EngineVarHandle *read_vars, int n_read,
+            EngineVarHandle *write_vars, int n_write, int priority,
+            const char *name) {
+    Opr *op = new Opr();
+    op->fn = fn;
+    op->ctx = ctx;
+    op->on_complete = on_complete;
+    op->priority = priority;
+    if (name) op->name = name;
+    /* Dedup: a var in both lists is write-only (reference dedups
+     * const_vars against mutable_vars in Engine::PushAsync). */
+    for (int i = 0; i < n_write; ++i) {
+      Var *v = static_cast<Var *>(write_vars[i]);
+      bool seen = false;
+      for (Var *w : op->writes) seen = seen || (w == v);
+      if (!seen) op->writes.push_back(v);
+    }
+    for (int i = 0; i < n_read; ++i) {
+      Var *v = static_cast<Var *>(read_vars[i]);
+      bool seen = false;
+      for (Var *w : op->writes) seen = seen || (w == v);
+      for (Var *w : op->reads) seen = seen || (w == v);
+      if (!seen) op->reads.push_back(v);
+    }
+    pending_.fetch_add(1, std::memory_order_relaxed);
+
+    int ndeps = static_cast<int>(op->reads.size() + op->writes.size());
+    if (ndeps == 0) {
+      Dispatch(op);
+      return;
+    }
+    op->wait_count.store(ndeps, std::memory_order_relaxed);
+    std::vector<Opr *> ready;
+    for (Var *v : op->reads) {
+      std::lock_guard<std::mutex> lk(v->mu);
+      v->queue.push_back({op, false});
+      v->Grant(&ready);
+    }
+    for (Var *v : op->writes) {
+      std::lock_guard<std::mutex> lk(v->mu);
+      v->queue.push_back({op, true});
+      v->Grant(&ready);
+    }
+    for (Opr *r : ready) Dispatch(r);
+  }
+
+  void WaitForVar(Var *v) {
+    /* Push a no-op write on the var and wait for it (WaitForVar in
+     * threaded_engine.cc uses the same trick). */
+    struct Sync {
+      std::mutex mu;
+      std::condition_variable cv;
+      bool done = false;
+    } sync;
+    EngineVarHandle wv = v;
+    Push(
+        [](void *c) {
+          Sync *s = static_cast<Sync *>(c);
+          std::lock_guard<std::mutex> lk(s->mu);
+          s->done = true;
+          s->cv.notify_all();
+        },
+        &sync, nullptr, nullptr, 0, &wv, 1, /*priority=*/1, "WaitForVar");
+    std::unique_lock<std::mutex> lk(sync.mu);
+    sync.cv.wait(lk, [&] { return sync.done; });
+  }
+
+  void WaitAll() {
+    std::unique_lock<std::mutex> lk(finish_mu_);
+    finish_cv_.wait(lk, [this] {
+      return pending_.load(std::memory_order_acquire) == 0;
+    });
+  }
+
+  void SetProfiling(bool on) {
+    std::lock_guard<std::mutex> lk(prof_mu_);
+    profiling_ = on;
+  }
+
+  std::string DumpProfile() {
+    std::lock_guard<std::mutex> lk(prof_mu_);
+    std::string out = "[";
+    for (size_t i = 0; i < events_.size(); ++i) {
+      const ProfileEvent &e = events_[i];
+      if (i) out += ",";
+      out += "{\"name\":\"" + e.name + "\",\"cat\":\"engine\",\"ph\":\"X\"";
+      out += ",\"ts\":" + std::to_string(e.start_us);
+      out += ",\"dur\":" + std::to_string(e.dur_us);
+      out += ",\"pid\":0,\"tid\":" + std::to_string(e.tid) + "}";
+    }
+    out += "]";
+    events_.clear();
+    return out;
+  }
+
+ private:
+  void Dispatch(Opr *op) {
+    if (naive_) {
+      Execute(op);
+      return;
+    }
+    {
+      std::lock_guard<std::mutex> lk(mu_);
+      if (op->priority > 0) {
+        hi_queue_.push_back(op);
+      } else {
+        queue_.push_back(op);
+      }
+    }
+    cv_.notify_one();
+  }
+
+  void WorkerLoop() {
+    for (;;) {
+      Opr *op = nullptr;
+      {
+        std::unique_lock<std::mutex> lk(mu_);
+        cv_.wait(lk, [this] {
+          return shutdown_ || !queue_.empty() || !hi_queue_.empty();
+        });
+        if (shutdown_ && queue_.empty() && hi_queue_.empty()) return;
+        if (!hi_queue_.empty()) {
+          op = hi_queue_.front();
+          hi_queue_.pop_front();
+        } else {
+          op = queue_.front();
+          queue_.pop_front();
+        }
+      }
+      Execute(op);
+    }
+  }
+
+  static uint64_t NowUs() {
+    return static_cast<uint64_t>(
+        std::chrono::duration_cast<std::chrono::microseconds>(
+            std::chrono::steady_clock::now().time_since_epoch())
+            .count());
+  }
+
+  void Execute(Opr *op) {
+    bool prof;
+    {
+      std::lock_guard<std::mutex> lk(prof_mu_);
+      prof = profiling_;
+    }
+    uint64_t t0 = prof ? NowUs() : 0;
+    if (op->fn) op->fn(op->ctx);
+    if (prof) {
+      uint64_t t1 = NowUs();
+      std::lock_guard<std::mutex> lk(prof_mu_);
+      events_.push_back({op->name.empty() ? "op" : op->name,
+                         std::hash<std::thread::id>()(
+                             std::this_thread::get_id()) %
+                             4096,
+                         t0, t1 - t0});
+    }
+    OnComplete(op);
+  }
+
+  void OnComplete(Opr *op) {
+    std::vector<Opr *> ready;
+    for (Var *v : op->reads) Release(v, /*write=*/false, &ready);
+    for (Var *v : op->writes) Release(v, /*write=*/true, &ready);
+    if (op->on_complete) op->on_complete(op->ctx, /*cancelled=*/0);
+    delete op;
+    if (pending_.fetch_sub(1, std::memory_order_acq_rel) == 1) {
+      std::lock_guard<std::mutex> lk(finish_mu_);
+      finish_cv_.notify_all();
+    }
+    for (Opr *r : ready) Dispatch(r);
+  }
+
+  void Release(Var *v, bool write, std::vector<Opr *> *ready) {
+    bool del = false;
+    {
+      std::lock_guard<std::mutex> lk(v->mu);
+      if (write) {
+        v->active_write = false;
+      } else {
+        --v->active_reads;
+      }
+      v->Grant(ready);
+      del = v->to_delete && v->queue.empty() && v->active_reads == 0 &&
+            !v->active_write;
+    }
+    if (del) delete v;
+  }
+
+  bool naive_;
+  std::vector<std::thread> workers_;
+  std::mutex mu_;
+  std::condition_variable cv_;
+  std::deque<Opr *> queue_;
+  std::deque<Opr *> hi_queue_;
+  bool shutdown_ = false;
+
+  std::atomic<int64_t> pending_{0};
+  std::mutex finish_mu_;
+  std::condition_variable finish_cv_;
+
+  std::mutex prof_mu_;
+  bool profiling_ = false;
+  std::vector<ProfileEvent> events_;
+};
+
+void Var::Grant(std::vector<Opr *> *ready) {
+  /* FIFO: grant a run of reads, or one write when fully drained. */
+  while (!queue.empty()) {
+    Pending &head = queue.front();
+    if (head.write) {
+      if (active_reads > 0 || active_write) break;
+      active_write = true;
+    } else {
+      if (active_write) break;
+      ++active_reads;
+    }
+    Opr *op = head.op;
+    queue.pop_front();
+    if (op->wait_count.fetch_sub(1, std::memory_order_acq_rel) == 1) {
+      ready->push_back(op);
+    }
+    if (active_write) break; /* writer granted exclusively */
+  }
+}
+
+}  // namespace
+}  // namespace mxtpu
+
+using mxtpu::SetLastError;
+
+#define API_BEGIN() try {
+#define API_END()                        \
+  }                                      \
+  catch (const std::exception &e) {      \
+    SetLastError(e.what());              \
+    return -1;                           \
+  }                                      \
+  catch (...) {                          \
+    SetLastError("unknown C++ error");   \
+    return -1;                           \
+  }                                      \
+  return 0;
+
+extern "C" {
+
+int MXEngineCreate(int num_workers, int naive, EngineHandle *out) {
+  API_BEGIN();
+  *out = new mxtpu::Engine(num_workers, naive != 0);
+  API_END();
+}
+
+int MXEngineFree(EngineHandle h) {
+  API_BEGIN();
+  delete static_cast<mxtpu::Engine *>(h);
+  API_END();
+}
+
+int MXEngineNewVar(EngineHandle h, EngineVarHandle *out) {
+  API_BEGIN();
+  *out = static_cast<mxtpu::Engine *>(h)->NewVar();
+  API_END();
+}
+
+int MXEngineFreeVar(EngineHandle h, EngineVarHandle var) {
+  API_BEGIN();
+  static_cast<mxtpu::Engine *>(h)->FreeVar(static_cast<mxtpu::Var *>(var));
+  API_END();
+}
+
+int MXEnginePushAsync(EngineHandle h, MXEngineFn fn, void *ctx,
+                      MXEngineOnComplete on_complete,
+                      EngineVarHandle *read_vars, int n_read,
+                      EngineVarHandle *write_vars, int n_write, int priority,
+                      const char *name) {
+  API_BEGIN();
+  static_cast<mxtpu::Engine *>(h)->Push(fn, ctx, on_complete, read_vars,
+                                        n_read, write_vars, n_write,
+                                        priority, name);
+  API_END();
+}
+
+int MXEngineWaitForVar(EngineHandle h, EngineVarHandle var) {
+  API_BEGIN();
+  static_cast<mxtpu::Engine *>(h)->WaitForVar(
+      static_cast<mxtpu::Var *>(var));
+  API_END();
+}
+
+int MXEngineWaitAll(EngineHandle h) {
+  API_BEGIN();
+  static_cast<mxtpu::Engine *>(h)->WaitAll();
+  API_END();
+}
+
+int MXEngineSetProfiling(EngineHandle h, int enabled) {
+  API_BEGIN();
+  static_cast<mxtpu::Engine *>(h)->SetProfiling(enabled != 0);
+  API_END();
+}
+
+int MXEngineDumpProfile(EngineHandle h, char **out_json) {
+  API_BEGIN();
+  std::string s = static_cast<mxtpu::Engine *>(h)->DumpProfile();
+  char *buf = static_cast<char *>(std::malloc(s.size() + 1));
+  std::memcpy(buf, s.c_str(), s.size() + 1);
+  *out_json = buf;
+  API_END();
+}
+
+int MXFreeString(char *s) {
+  std::free(s);
+  return 0;
+}
+
+}  // extern "C"

@@ -1,0 +1,119 @@
+/*
+ * mxtpu.h — C API for the native runtime of mxnet_tpu.
+ *
+ * Reference parity (leezu/mxnet): include/mxnet/c_api.h (error trampoline,
+ * handle-based API), include/mxnet/engine.h (Engine::PushAsync var
+ * semantics), include/mxnet/storage.h (pooled allocator),
+ * 3rdparty/dmlc-core/include/dmlc/recordio.h (record framing).
+ *
+ * The compute path of mxnet_tpu is JAX/XLA/Pallas; this library is the
+ * native runtime *around* it: an asynchronous dependency engine for host
+ * work (IO decode, custom ops, checkpoint writes), a pooled host allocator
+ * for staging buffers, and the RecordIO data plane with a threaded
+ * prefetcher.  Every function returns 0 on success, -1 on failure with the
+ * message retrievable via MXGetLastError() (thread-local), matching the
+ * reference's MXGetLastError contract.
+ */
+#ifndef MXTPU_H_
+#define MXTPU_H_
+
+#include <stddef.h>
+#include <stdint.h>
+
+#ifdef __cplusplus
+extern "C" {
+#endif
+
+typedef void *EngineHandle;
+typedef void *EngineVarHandle;
+typedef void *RecordIOHandle;
+typedef void *PrefetcherHandle;
+
+/* Callback executed by an engine worker thread.  `ctx` is the opaque
+ * pointer given to MXEnginePushAsync. */
+typedef void (*MXEngineFn)(void *ctx);
+/* Called exactly once after the op's fn has run (or been cancelled on
+ * engine shutdown, in which case `cancelled` is 1). */
+typedef void (*MXEngineOnComplete)(void *ctx, int cancelled);
+
+/* ---- error handling (c_api_error.cc analog) ---- */
+const char *MXGetLastError(void);
+
+/* ---- dependency engine (threaded_engine_perdevice.cc analog) ---- */
+/* num_workers<=0 picks hardware_concurrency.  naive!=0 => every push runs
+ * synchronously on the calling thread (MXNET_ENGINE_TYPE=NaiveEngine). */
+int MXEngineCreate(int num_workers, int naive, EngineHandle *out);
+int MXEngineFree(EngineHandle h);
+int MXEngineNewVar(EngineHandle h, EngineVarHandle *out);
+/* Deletes the var once all pending ops touching it have completed. */
+int MXEngineFreeVar(EngineHandle h, EngineVarHandle var);
+/* Push fn with read/write dependencies.  A var listed in both sets is
+ * treated as write.  `name` may be NULL; it labels profiler events.
+ * on_complete may be NULL.  priority>0 jumps the dispatch queue. */
+int MXEnginePushAsync(EngineHandle h, MXEngineFn fn, void *ctx,
+                      MXEngineOnComplete on_complete,
+                      EngineVarHandle *read_vars, int n_read,
+                      EngineVarHandle *write_vars, int n_write,
+                      int priority, const char *name);
+int MXEngineWaitForVar(EngineHandle h, EngineVarHandle var);
+int MXEngineWaitAll(EngineHandle h);
+/* Profiling: when enabled the engine records one event per executed op. */
+int MXEngineSetProfiling(EngineHandle h, int enabled);
+/* Returns a malloc'd JSON array of chrome-trace event objects (caller
+ * frees with MXFreeString) and clears the buffer. */
+int MXEngineDumpProfile(EngineHandle h, char **out_json);
+int MXFreeString(char *s);
+
+/* ---- pooled storage manager (storage/pooled_storage_manager.h analog) */
+int MXStorageAlloc(size_t size, void **out);
+int MXStorageFree(void *ptr);
+/* Drop all cached free blocks back to the OS. */
+int MXStorageReleaseAll(void);
+int MXStorageStats(uint64_t *bytes_in_use, uint64_t *bytes_pooled,
+                   uint64_t *pool_hits, uint64_t *pool_misses);
+
+/* ---- RecordIO (dmlc/recordio.h analog; format-compatible) ---- */
+int MXRecordIOWriterCreate(const char *path, RecordIOHandle *out);
+/* Writes one framed record; *out_pos receives its byte offset. */
+int MXRecordIOWriterWrite(RecordIOHandle h, const char *data, uint64_t size,
+                          uint64_t *out_pos);
+int MXRecordIOWriterTell(RecordIOHandle h, uint64_t *out_pos);
+int MXRecordIOWriterFree(RecordIOHandle h);
+
+int MXRecordIOReaderCreate(const char *path, RecordIOHandle *out);
+/* *out_data points at an internal buffer valid until the next call.
+ * At EOF returns 0 with *out_data = NULL. */
+int MXRecordIOReaderNext(RecordIOHandle h, const char **out_data,
+                         uint64_t *out_size);
+int MXRecordIOReaderSeek(RecordIOHandle h, uint64_t pos);
+int MXRecordIOReaderTell(RecordIOHandle h, uint64_t *out_pos);
+/* Scans the whole file and returns a malloc'd array of record offsets
+ * (caller frees with MXFreeBuffer); leaves the read position at 0. */
+int MXRecordIOReaderScanIndex(RecordIOHandle h, uint64_t **out_positions,
+                              uint64_t *out_count);
+int MXRecordIOReaderFree(RecordIOHandle h);
+int MXFreeBuffer(void *buf);
+
+/* ---- threaded record prefetcher (iter_prefetcher.h analog) ----
+ * A background thread reads records (optionally following a shuffled /
+ * sharded index) into a bounded queue of batches backed by the pooled
+ * allocator. */
+int MXPrefetcherCreate(const char *path, int batch_size, int capacity,
+                       const uint64_t *index, uint64_t index_len,
+                       PrefetcherHandle *out);
+/* Blocks for the next batch.  Fills caller arrays data[i]/sizes[i]
+ * (capacity batch_size); *out_n receives the number of records (0 at
+ * epoch end).  Buffers stay valid until the following Next/Free. */
+int MXPrefetcherNext(PrefetcherHandle h, const char **data, uint64_t *sizes,
+                     int *out_n);
+int MXPrefetcherReset(PrefetcherHandle h);
+int MXPrefetcherFree(PrefetcherHandle h);
+
+/* ---- runtime feature introspection (libinfo.cc analog) ---- */
+const char *MXLibInfoFeatures(void);
+
+#ifdef __cplusplus
+}
+#endif
+
+#endif /* MXTPU_H_ */

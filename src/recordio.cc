@@ -1,0 +1,444 @@
+/*
+ * recordio.cc — RecordIO reader/writer + threaded prefetcher.
+ *
+ * Reference parity (leezu/mxnet):
+ * 3rdparty/dmlc-core/include/dmlc/recordio.h (framing),
+ * src/io/iter_prefetcher.h + dmlc/threadediter.h (double-buffered
+ * background producer).  Format is byte-identical to the reference (and
+ * to python/mxnet_tpu/recordio.py):
+ *
+ *   record  := magic:u32 (0xced7230a) | lrecord:u32 | data | pad to 4B
+ *   lrecord := cflag:u3 << 29 | length:u29
+ */
+#include <condition_variable>
+#include <cstdio>
+#include <cstdlib>
+#include <cstring>
+#include <deque>
+#include <mutex>
+#include <stdexcept>
+#include <string>
+#include <thread>
+#include <vector>
+
+#include "./mxtpu.h"
+
+namespace mxtpu {
+
+void SetLastError(const std::string &msg);
+void *PoolAlloc(size_t size);
+void PoolFree(void *ptr);
+
+namespace {
+
+constexpr uint32_t kMagic = 0xced7230a;
+constexpr uint32_t kLenMask = (1u << 29) - 1;
+
+class Writer {
+ public:
+  explicit Writer(const char *path) : fp_(std::fopen(path, "wb")) {
+    if (!fp_) throw std::runtime_error(std::string("cannot open ") + path);
+  }
+  ~Writer() {
+    if (fp_) std::fclose(fp_);
+  }
+
+  uint64_t Write(const char *data, uint64_t size) {
+    if (size > kLenMask) throw std::runtime_error("record too large");
+    uint64_t pos = static_cast<uint64_t>(std::ftell(fp_));
+    uint32_t head[2] = {kMagic, static_cast<uint32_t>(size)};
+    if (std::fwrite(head, 1, 8, fp_) != 8) {
+      throw std::runtime_error("short write");
+    }
+    if (size && std::fwrite(data, 1, size, fp_) != size) {
+      throw std::runtime_error("short write");
+    }
+    size_t pad = (4 - ((8 + size) % 4)) % 4;
+    if (pad) {
+      const char zeros[4] = {0, 0, 0, 0};
+      if (std::fwrite(zeros, 1, pad, fp_) != pad) {
+        throw std::runtime_error("short write");
+      }
+    }
+    return pos;
+  }
+
+  uint64_t Tell() { return static_cast<uint64_t>(std::ftell(fp_)); }
+
+ private:
+  std::FILE *fp_;
+};
+
+class Reader {
+ public:
+  explicit Reader(const char *path) : fp_(std::fopen(path, "rb")) {
+    if (!fp_) throw std::runtime_error(std::string("cannot open ") + path);
+  }
+  ~Reader() {
+    if (fp_) std::fclose(fp_);
+    if (buf_) PoolFree(buf_);
+  }
+
+  /* Returns pointer into internal buffer, or nullptr at EOF. */
+  const char *Next(uint64_t *out_size) {
+    uint32_t head[2];
+    size_t got = std::fread(head, 1, 8, fp_);
+    if (got < 8) {
+      if (got == 0) return nullptr;
+      throw std::runtime_error("truncated record header");
+    }
+    if (head[0] != kMagic) throw std::runtime_error("bad record magic");
+    uint64_t length = head[1] & kLenMask;
+    Reserve(length);
+    if (length && std::fread(buf_, 1, length, fp_) != length) {
+      throw std::runtime_error("truncated record body");
+    }
+    size_t pad = (4 - ((8 + length) % 4)) % 4;
+    if (pad) {
+      char scratch[4];
+      if (std::fread(scratch, 1, pad, fp_) != pad) {
+        throw std::runtime_error("truncated record padding");
+      }
+    }
+    *out_size = length;
+    return buf_;
+  }
+
+  void Seek(uint64_t pos) {
+    if (std::fseek(fp_, static_cast<long>(pos), SEEK_SET) != 0) {
+      throw std::runtime_error("seek failed");
+    }
+  }
+
+  uint64_t Tell() { return static_cast<uint64_t>(std::ftell(fp_)); }
+
+  std::vector<uint64_t> ScanIndex() {
+    Seek(0);
+    std::vector<uint64_t> positions;
+    uint64_t size;
+    for (;;) {
+      uint64_t pos = Tell();
+      if (Next(&size) == nullptr) break;
+      positions.push_back(pos);
+    }
+    Seek(0);
+    return positions;
+  }
+
+ private:
+  void Reserve(uint64_t length) {
+    if (length <= cap_) return;
+    if (buf_) PoolFree(buf_);
+    cap_ = length;
+    buf_ = static_cast<char *>(PoolAlloc(cap_));
+  }
+
+  std::FILE *fp_;
+  char *buf_ = nullptr;
+  uint64_t cap_ = 0;
+};
+
+/* Threaded prefetcher: a producer thread reads batches of records ahead
+ * of the consumer, bounded by `capacity` in-flight batches. */
+class Prefetcher {
+ public:
+  Prefetcher(const char *path, int batch_size, int capacity,
+             const uint64_t *index, uint64_t index_len)
+      : path_(path), batch_(batch_size),
+        capacity_(capacity > 0 ? capacity : 2) {
+    if (index && index_len) {
+      index_.assign(index, index + index_len);
+    }
+    Start();
+  }
+
+  ~Prefetcher() { Stop(); }
+
+  struct Batch {
+    /* one pooled buffer holding all records, plus offsets/sizes */
+    char *data = nullptr;
+    std::vector<uint64_t> offsets;
+    std::vector<uint64_t> sizes;
+    int n = 0;
+    bool epoch_end = false;
+  };
+
+  /* Blocks until a batch is available.  Caller owns `last_` until the
+   * next call. */
+  Batch *Next() {
+    std::unique_lock<std::mutex> lk(mu_);
+    cv_nonempty_.wait(lk, [this] {
+      return !queue_.empty() || error_set_ || finished_;
+    });
+    if (queue_.empty()) {
+      if (error_set_) throw std::runtime_error(error_);
+      /* producer exhausted and queue drained: keep returning the
+       * epoch-end marker instead of blocking forever */
+      FreeLast();
+      last_ = new Batch();
+      last_->epoch_end = true;
+      return last_;
+    }
+    FreeLast();
+    last_ = queue_.front();
+    queue_.pop_front();
+    cv_nonfull_.notify_one();
+    return last_;
+  }
+
+  void Reset() {
+    Stop();
+    Start();
+  }
+
+ private:
+  void Start() {
+    stop_ = false;
+    finished_ = false;
+    error_set_ = false;
+    error_.clear();
+    producer_ = std::thread([this] { ProducerLoop(); });
+  }
+
+  void Stop() {
+    {
+      std::lock_guard<std::mutex> lk(mu_);
+      stop_ = true;
+    }
+    cv_nonfull_.notify_all();
+    if (producer_.joinable()) producer_.join();
+    std::lock_guard<std::mutex> lk(mu_);
+    FreeLast();
+    for (Batch *b : queue_) {
+      if (b->data) PoolFree(b->data);
+      delete b;
+    }
+    queue_.clear();
+  }
+
+  void FreeLast() {
+    if (last_) {
+      if (last_->data) PoolFree(last_->data);
+      delete last_;
+      last_ = nullptr;
+    }
+  }
+
+  /* Returns false if the prefetcher is shutting down. */
+  bool Enqueue(Batch *b) {
+    std::unique_lock<std::mutex> lk(mu_);
+    cv_nonfull_.wait(lk, [this] {
+      return stop_ || static_cast<int>(queue_.size()) < capacity_;
+    });
+    if (stop_) {
+      if (b->data) PoolFree(b->data);
+      delete b;
+      return false;
+    }
+    queue_.push_back(b);
+    cv_nonempty_.notify_one();
+    return true;
+  }
+
+  void ProducerLoop() {
+    try {
+      Reader reader(path_.c_str());
+      size_t cursor = 0; /* into index_, when present */
+      bool done = false;
+      while (!done) {
+        Batch *b = new Batch();
+        std::vector<std::string> recs;
+        uint64_t total = 0;
+        for (int i = 0; i < batch_; ++i) {
+          const char *data = nullptr;
+          uint64_t size = 0;
+          if (!index_.empty()) {
+            if (cursor >= index_.size()) break;
+            reader.Seek(index_[cursor++]);
+            data = reader.Next(&size);
+          } else {
+            data = reader.Next(&size);
+          }
+          if (!data) break;
+          recs.emplace_back(data, size);
+          total += size;
+        }
+        b->n = static_cast<int>(recs.size());
+        if (b->n < batch_) {
+          done = true;
+          b->epoch_end = true;
+        }
+        if (b->n > 0) {
+          b->data = static_cast<char *>(PoolAlloc(total ? total : 1));
+          uint64_t off = 0;
+          for (const std::string &r : recs) {
+            std::memcpy(b->data + off, r.data(), r.size());
+            b->offsets.push_back(off);
+            b->sizes.push_back(r.size());
+            off += r.size();
+          }
+        }
+        if (!Enqueue(b)) return;
+        if (done && b->n > 0) {
+          /* a short final batch still needs a 0-record epoch marker so
+           * the consumer's next call sees the end */
+          Batch *mark = new Batch();
+          mark->epoch_end = true;
+          if (!Enqueue(mark)) return;
+        }
+      }
+      {
+        std::lock_guard<std::mutex> lk(mu_);
+        finished_ = true;
+      }
+      cv_nonempty_.notify_all();
+    } catch (const std::exception &e) {
+      std::lock_guard<std::mutex> lk(mu_);
+      error_ = e.what();
+      error_set_ = true;
+      cv_nonempty_.notify_all();
+    }
+  }
+
+  std::string path_;
+  int batch_;
+  int capacity_;
+  std::vector<uint64_t> index_;
+
+  std::thread producer_;
+  std::mutex mu_;
+  std::condition_variable cv_nonempty_;
+  std::condition_variable cv_nonfull_;
+  std::deque<Batch *> queue_;
+  Batch *last_ = nullptr;
+  bool stop_ = false;
+  bool finished_ = false;
+  bool error_set_ = false;
+  std::string error_;
+};
+
+}  // namespace
+}  // namespace mxtpu
+
+#define API_BEGIN() try {
+#define API_END()                        \
+  }                                      \
+  catch (const std::exception &e) {      \
+    mxtpu::SetLastError(e.what());       \
+    return -1;                           \
+  }                                      \
+  return 0;
+
+extern "C" {
+
+int MXRecordIOWriterCreate(const char *path, RecordIOHandle *out) {
+  API_BEGIN();
+  *out = new mxtpu::Writer(path);
+  API_END();
+}
+
+int MXRecordIOWriterWrite(RecordIOHandle h, const char *data, uint64_t size,
+                          uint64_t *out_pos) {
+  API_BEGIN();
+  uint64_t pos = static_cast<mxtpu::Writer *>(h)->Write(data, size);
+  if (out_pos) *out_pos = pos;
+  API_END();
+}
+
+int MXRecordIOWriterTell(RecordIOHandle h, uint64_t *out_pos) {
+  API_BEGIN();
+  *out_pos = static_cast<mxtpu::Writer *>(h)->Tell();
+  API_END();
+}
+
+int MXRecordIOWriterFree(RecordIOHandle h) {
+  API_BEGIN();
+  delete static_cast<mxtpu::Writer *>(h);
+  API_END();
+}
+
+int MXRecordIOReaderCreate(const char *path, RecordIOHandle *out) {
+  API_BEGIN();
+  *out = new mxtpu::Reader(path);
+  API_END();
+}
+
+int MXRecordIOReaderNext(RecordIOHandle h, const char **out_data,
+                         uint64_t *out_size) {
+  API_BEGIN();
+  uint64_t size = 0;
+  const char *data = static_cast<mxtpu::Reader *>(h)->Next(&size);
+  *out_data = data;
+  *out_size = data ? size : 0;
+  API_END();
+}
+
+int MXRecordIOReaderSeek(RecordIOHandle h, uint64_t pos) {
+  API_BEGIN();
+  static_cast<mxtpu::Reader *>(h)->Seek(pos);
+  API_END();
+}
+
+int MXRecordIOReaderTell(RecordIOHandle h, uint64_t *out_pos) {
+  API_BEGIN();
+  *out_pos = static_cast<mxtpu::Reader *>(h)->Tell();
+  API_END();
+}
+
+int MXRecordIOReaderScanIndex(RecordIOHandle h, uint64_t **out_positions,
+                              uint64_t *out_count) {
+  API_BEGIN();
+  std::vector<uint64_t> pos = static_cast<mxtpu::Reader *>(h)->ScanIndex();
+  uint64_t *buf = static_cast<uint64_t *>(
+      std::malloc(sizeof(uint64_t) * (pos.empty() ? 1 : pos.size())));
+  std::memcpy(buf, pos.data(), sizeof(uint64_t) * pos.size());
+  *out_positions = buf;
+  *out_count = pos.size();
+  API_END();
+}
+
+int MXRecordIOReaderFree(RecordIOHandle h) {
+  API_BEGIN();
+  delete static_cast<mxtpu::Reader *>(h);
+  API_END();
+}
+
+int MXFreeBuffer(void *buf) {
+  std::free(buf);
+  return 0;
+}
+
+int MXPrefetcherCreate(const char *path, int batch_size, int capacity,
+                       const uint64_t *index, uint64_t index_len,
+                       PrefetcherHandle *out) {
+  API_BEGIN();
+  *out = new mxtpu::Prefetcher(path, batch_size, capacity, index, index_len);
+  API_END();
+}
+
+int MXPrefetcherNext(PrefetcherHandle h, const char **data, uint64_t *sizes,
+                     int *out_n) {
+  API_BEGIN();
+  mxtpu::Prefetcher::Batch *b =
+      static_cast<mxtpu::Prefetcher *>(h)->Next();
+  for (int i = 0; i < b->n; ++i) {
+    data[i] = b->data + b->offsets[i];
+    sizes[i] = b->sizes[i];
+  }
+  *out_n = b->n;
+  API_END();
+}
+
+int MXPrefetcherReset(PrefetcherHandle h) {
+  API_BEGIN();
+  static_cast<mxtpu::Prefetcher *>(h)->Reset();
+  API_END();
+}
+
+int MXPrefetcherFree(PrefetcherHandle h) {
+  API_BEGIN();
+  delete static_cast<mxtpu::Prefetcher *>(h);
+  API_END();
+}
+
+}  // extern "C"

@@ -1,0 +1,181 @@
+/*
+ * storage.cc — pooled host storage manager.
+ *
+ * Reference parity (leezu/mxnet): src/storage/storage.cc,
+ * src/storage/pooled_storage_manager.h (GPUPooledStorageManager with
+ * round-up buckets, MXNET_GPU_MEM_POOL_TYPE=Round).  Device memory on TPU
+ * belongs to PJRT/XLA; this pool serves the host side: RecordIO read
+ * buffers, prefetcher batches, staging space for checkpoint IO — the
+ * role CPUSharedStorage/pinned memory plays in the reference's data
+ * pipeline.
+ *
+ * Strategy: sizes are rounded up to the next power of two (>= 4KiB uses
+ * pow2 buckets; small sizes round to 64B lines) and freed blocks are
+ * cached in per-bucket free lists, bounded by MXTPU_MEM_POOL_LIMIT bytes
+ * (default 1GiB) of cached memory.
+ */
+#include <cstdlib>
+#include <cstring>
+#include <mutex>
+#include <string>
+#include <unordered_map>
+#include <vector>
+
+#include "./mxtpu.h"
+
+namespace mxtpu {
+
+void SetLastError(const std::string &msg);
+
+namespace {
+
+constexpr size_t kAlign = 64;
+
+size_t RoundSize(size_t size) {
+  if (size <= kAlign) return kAlign;
+  if (size < 4096) return (size + kAlign - 1) & ~(kAlign - 1);
+  size_t p = 4096;
+  while (p < size) p <<= 1;
+  return p;
+}
+
+class Pool {
+ public:
+  static Pool &Get() {
+    static Pool inst;
+    return inst;
+  }
+
+  void *Alloc(size_t size) {
+    size_t bucket = RoundSize(size);
+    {
+      std::lock_guard<std::mutex> lk(mu_);
+      auto it = free_.find(bucket);
+      if (it != free_.end() && !it->second.empty()) {
+        void *p = it->second.back();
+        it->second.pop_back();
+        pooled_bytes_ -= bucket;
+        in_use_bytes_ += bucket;
+        ++hits_;
+        sizes_[p] = bucket;
+        return p;
+      }
+      ++misses_;
+    }
+    void *p = nullptr;
+    if (posix_memalign(&p, kAlign, bucket) != 0 || p == nullptr) {
+      /* Reference behavior: on OOM, release the pool and retry once
+       * (GPUPooledStorageManager::Alloc → ReleaseAll → retry). */
+      ReleaseAll();
+      if (posix_memalign(&p, kAlign, bucket) != 0 || p == nullptr) {
+        throw std::bad_alloc();
+      }
+    }
+    std::lock_guard<std::mutex> lk(mu_);
+    in_use_bytes_ += bucket;
+    sizes_[p] = bucket;
+    return p;
+  }
+
+  void Free(void *ptr) {
+    size_t bucket;
+    {
+      std::lock_guard<std::mutex> lk(mu_);
+      auto it = sizes_.find(ptr);
+      if (it == sizes_.end()) {
+        throw std::runtime_error("MXStorageFree: unknown pointer");
+      }
+      bucket = it->second;
+      sizes_.erase(it);
+      in_use_bytes_ -= bucket;
+      if (pooled_bytes_ + bucket <= PoolLimit()) {
+        free_[bucket].push_back(ptr);
+        pooled_bytes_ += bucket;
+        return;
+      }
+    }
+    std::free(ptr);
+  }
+
+  void ReleaseAll() {
+    std::lock_guard<std::mutex> lk(mu_);
+    for (auto &kv : free_) {
+      for (void *p : kv.second) std::free(p);
+      kv.second.clear();
+    }
+    pooled_bytes_ = 0;
+  }
+
+  void Stats(uint64_t *in_use, uint64_t *pooled, uint64_t *hits,
+             uint64_t *misses) {
+    std::lock_guard<std::mutex> lk(mu_);
+    *in_use = in_use_bytes_;
+    *pooled = pooled_bytes_;
+    *hits = hits_;
+    *misses = misses_;
+  }
+
+ private:
+  static size_t PoolLimit() {
+    static size_t limit = [] {
+      const char *env = std::getenv("MXTPU_MEM_POOL_LIMIT");
+      return env ? static_cast<size_t>(std::atoll(env))
+                 : (size_t)1 << 30;
+    }();
+    return limit;
+  }
+
+  std::mutex mu_;
+  std::unordered_map<size_t, std::vector<void *>> free_;
+  std::unordered_map<void *, size_t> sizes_;
+  uint64_t in_use_bytes_ = 0;
+  uint64_t pooled_bytes_ = 0;
+  uint64_t hits_ = 0;
+  uint64_t misses_ = 0;
+};
+
+}  // namespace
+
+void *PoolAlloc(size_t size) { return Pool::Get().Alloc(size); }
+void PoolFree(void *ptr) { Pool::Get().Free(ptr); }
+
+}  // namespace mxtpu
+
+#define API_BEGIN() try {
+#define API_END()                        \
+  }                                      \
+  catch (const std::exception &e) {      \
+    mxtpu::SetLastError(e.what());       \
+    return -1;                           \
+  }                                      \
+  return 0;
+
+extern "C" {
+
+int MXStorageAlloc(size_t size, void **out) {
+  API_BEGIN();
+  *out = mxtpu::PoolAlloc(size);
+  API_END();
+}
+
+int MXStorageFree(void *ptr) {
+  API_BEGIN();
+  mxtpu::PoolFree(ptr);
+  API_END();
+}
+
+int MXStorageReleaseAll(void) {
+  API_BEGIN();
+  mxtpu::Pool::Get().ReleaseAll();
+  API_END();
+}
+
+int MXStorageStats(uint64_t *bytes_in_use, uint64_t *bytes_pooled,
+                   uint64_t *pool_hits, uint64_t *pool_misses) {
+  API_BEGIN();
+  mxtpu::Pool::Get().Stats(bytes_in_use, bytes_pooled, pool_hits,
+                           pool_misses);
+  API_END();
+}
+
+}  // extern "C"

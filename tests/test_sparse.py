@@ -183,3 +183,63 @@ def test_duplicate_indices_canonicalized():
     c = rsp._canonical()
     assert list(c.indices.asnumpy()) == [0, 1]
     onp.testing.assert_allclose(c.todense().asnumpy()[1], 2.0)
+
+
+def test_dense_backward_into_sparse_grad_buffer():
+    """Regression: a dense cotangent written into an existing row_sparse
+    grad buffer must be visible to both sparse and dense readers."""
+    import mxnet_tpu as mx
+    from mxnet_tpu import autograd, gluon
+    import numpy as onp
+
+    emb = gluon.nn.Embedding(6, 4, sparse_grad=True)
+    emb.initialize()
+    w = emb.weight
+
+    # backward 1: sparse grad via the embedding
+    with autograd.record():
+        out = emb(mx.np.array(onp.array([1, 1], dtype="int32")))
+    out.backward()
+    assert w.grad().stype == "row_sparse"
+
+    # backward 2: dense use of the same weight
+    with autograd.record():
+        loss = (w.data() * 3.0).sum()
+    loss.backward()
+    g = w.grad()
+    onp.testing.assert_allclose(g.asnumpy(),
+                                onp.full((6, 4), 3.0, "float32"))
+    # sparse view must agree with the dense one
+    if g.stype == "row_sparse":
+        assert g.indices.asnumpy().tolist() == list(range(6))
+        onp.testing.assert_allclose(g.data.asnumpy(),
+                                    onp.full((6, 4), 3.0, "float32"))
+
+
+def test_copyto_dense_into_row_sparse_consistent():
+    import mxnet_tpu as mx
+    from mxnet_tpu.ndarray import sparse as sp
+    import numpy as onp
+
+    rsp = sp.row_sparse_array(
+        (onp.ones((1, 3), "float32"), onp.array([1], "int32")),
+        shape=(4, 3))
+    dense = mx.nd.array(onp.arange(12, dtype="float32").reshape(4, 3))
+    dense.copyto(rsp)
+    onp.testing.assert_allclose(rsp.asnumpy(), dense.asnumpy())
+    assert rsp.dtype == onp.float32
+
+
+def test_row_sparse_pull_rejects_dense_out():
+    import mxnet_tpu as mx
+    import numpy as onp
+    import pytest
+    from mxnet_tpu.base import MXNetError
+
+    from mxnet_tpu import kvstore
+    kv = kvstore.create("local")
+    kv.init("w", mx.nd.array(onp.ones((4, 2), "float32")))
+    dense_out = mx.nd.zeros((4, 2))
+    with pytest.raises(MXNetError, match="row_sparse"):
+        kv.row_sparse_pull("w", out=dense_out,
+                           row_ids=mx.nd.array(onp.array([0, 1])))
