@@ -146,10 +146,15 @@ class NativeEngine:
         n_r, n_w = len(read_vars), len(write_vars)
         r_arr = (ctypes.c_void_p * max(n_r, 1))(*read_vars)
         w_arr = (ctypes.c_void_p * max(n_w, 1))(*write_vars)
-        check_call(LIB.MXEnginePushAsync(
-            self.handle, self._fn_cb, ctypes.c_void_p(token),
-            self._done_cb, r_arr, n_r, w_arr, n_w, priority,
-            name.encode() if name else None))
+        try:
+            check_call(LIB.MXEnginePushAsync(
+                self.handle, self._fn_cb, ctypes.c_void_p(token),
+                self._done_cb, r_arr, n_r, w_arr, n_w, priority,
+                name.encode() if name else None))
+        except Exception:
+            with self._lock:
+                self._inflight.pop(token, None)
+            raise
 
     def wait_for_var(self, var: int) -> None:
         check_call(LIB.MXEngineWaitForVar(self.handle,
@@ -236,7 +241,8 @@ class NativeRecordWriter:
         return pos.value
 
     def close(self) -> None:
-        if self.handle:
+        # LIB may already be torn down at interpreter shutdown
+        if self.handle and LIB is not None:
             check_call(LIB.MXRecordIOWriterFree(self.handle))
             self.handle = None
 
@@ -285,7 +291,8 @@ class NativeRecordReader:
             LIB.MXFreeBuffer(buf)
 
     def close(self) -> None:
-        if self.handle:
+        # LIB may already be torn down at interpreter shutdown
+        if self.handle and LIB is not None:
             check_call(LIB.MXRecordIOReaderFree(self.handle))
             self.handle = None
 

@@ -1,0 +1,15 @@
+"""``mx.image`` — image loading, augmentation, and iterators.
+
+Reference parity: ``python/mxnet/image/image.py`` (ImageIter + augmenter
+pipeline over C-backed OpenCV decode) and ``detection.py`` (ImageDetIter).
+"""
+from .image import (imdecode, imread, imresize, imrotate, fixed_crop,
+                    center_crop, random_crop, random_size_crop, resize_short,
+                    color_normalize, scale_down,
+                    Augmenter, SequentialAug, RandomOrderAug, ResizeAug,
+                    ForceResizeAug, RandomCropAug, RandomSizedCropAug,
+                    CenterCropAug, HorizontalFlipAug, CastAug,
+                    BrightnessJitterAug, ContrastJitterAug,
+                    SaturationJitterAug, HueJitterAug, ColorJitterAug,
+                    LightingAug, ColorNormalizeAug, RandomGrayAug,
+                    CreateAugmenter, ImageIter)

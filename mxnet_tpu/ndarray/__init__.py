@@ -12,6 +12,7 @@ from .ops_numpy import __all__ as _ops_np_all
 from . import ops
 from . import random
 from . import linalg
+from . import image
 from . import sparse
 from .sparse import RowSparseNDArray, CSRNDArray, BaseSparseNDArray
 from .register import get_op, list_ops, register_op, invoke

@@ -1,0 +1,268 @@
+"""``mx.nd.image`` operator namespace — image transform ops.
+
+Reference parity (leezu/mxnet): ``src/operator/image/image_random.cc``,
+``resize.cc``, ``crop.cc`` (``_image_to_tensor``, ``_image_normalize``,
+``_image_resize``, ``_image_crop``, flips and color jitters) which back the
+gluon vision transforms.
+
+Design (tpu-first): every op is a pure jax function over HWC / NHWC arrays;
+color jitter randomness uses numpy host RNG at call sites (augmentation is a
+host-side pipeline stage feeding the device, like the reference's CPU-side
+OpenCV augmenters), while the arithmetic itself is XLA-traceable so the same
+ops can be fused on-device when composed under hybridize.
+"""
+from __future__ import annotations
+
+from typing import Optional, Sequence, Tuple, Union
+
+import jax
+import jax.numpy as jnp
+import numpy as _np
+
+from .ndarray import NDArray, from_jax
+from .register import invoke
+
+__all__ = ["to_tensor", "normalize", "resize", "crop", "random_crop",
+           "flip_left_right", "flip_top_bottom", "random_flip_left_right",
+           "random_flip_top_bottom", "adjust_lighting", "random_lighting",
+           "random_brightness", "random_contrast", "random_saturation",
+           "random_hue", "random_color_jitter"]
+
+_R, _G, _B = 0.299, 0.587, 0.114
+
+
+def _as_jax(x):
+    return x._data if isinstance(x, NDArray) else jnp.asarray(x)
+
+
+def _chan_axis(x) -> int:
+    # HWC (3d) or NHWC (4d) — the reference's image ops use channels-last.
+    return x.ndim - 1
+
+
+def to_tensor(data) -> NDArray:
+    """HWC/NHWC uint8 [0,255] -> CHW/NCHW float32 [0,1]
+    (reference: ``_image_to_tensor``)."""
+    def impl(x):
+        x = x.astype(jnp.float32) / 255.0
+        if x.ndim == 3:
+            return jnp.transpose(x, (2, 0, 1))
+        return jnp.transpose(x, (0, 3, 1, 2))
+    return invoke("image_to_tensor", impl, (_wrap(data),))
+
+
+def normalize(data, mean=0.0, std=1.0) -> NDArray:
+    """Channel-wise normalize of CHW/NCHW float input
+    (reference: ``_image_normalize``)."""
+    def impl(x):
+        c = x.shape[0] if x.ndim == 3 else x.shape[1]
+        m = jnp.asarray(mean, dtype=x.dtype).reshape(-1)
+        s = jnp.asarray(std, dtype=x.dtype).reshape(-1)
+        m = jnp.broadcast_to(m, (c,))
+        s = jnp.broadcast_to(s, (c,))
+        shape = (c, 1, 1) if x.ndim == 3 else (1, c, 1, 1)
+        return (x - m.reshape(shape)) / s.reshape(shape)
+    return invoke("image_normalize", impl, (_wrap(data),))
+
+
+def resize(data, size: Union[int, Sequence[int]], keep_ratio: bool = False,
+           interp: int = 1) -> NDArray:
+    """Resize HWC/NHWC image(s) (reference: ``_image_resize``).
+
+    ``size`` is (w, h) or int; interp 0=nearest, 1=bilinear, 2=cubic."""
+    x = _as_jax(data)
+    if x.ndim == 3:
+        h, w = x.shape[0], x.shape[1]
+    else:
+        h, w = x.shape[1], x.shape[2]
+    if isinstance(size, int):
+        if keep_ratio:
+            if h > w:
+                new_w, new_h = size, int(h * size / w)
+            else:
+                new_w, new_h = int(w * size / h), size
+        else:
+            new_w = new_h = size
+    else:
+        new_w, new_h = size
+    method = {0: "nearest", 1: "linear", 2: "cubic"}.get(interp, "linear")
+
+    def impl(x):
+        dt = x.dtype
+        xf = x.astype(jnp.float32)
+        if x.ndim == 3:
+            out = jax.image.resize(xf, (new_h, new_w, x.shape[2]), method)
+        else:
+            out = jax.image.resize(
+                xf, (x.shape[0], new_h, new_w, x.shape[3]), method)
+        if jnp.issubdtype(dt, jnp.integer):
+            out = jnp.clip(jnp.round(out), 0, 255)
+        return out.astype(dt)
+    return invoke("image_resize", impl, (_wrap(data),))
+
+
+def crop(data, x: int, y: int, width: int, height: int) -> NDArray:
+    """Crop at (x, y) with (width, height), HWC/NHWC
+    (reference: ``_image_crop``)."""
+    def impl(a):
+        if a.ndim == 3:
+            return a[y:y + height, x:x + width, :]
+        return a[:, y:y + height, x:x + width, :]
+    return invoke("image_crop", impl, (_wrap(data),))
+
+
+def random_crop(data, size: Tuple[int, int], rng: Optional[_np.random.RandomState] = None):
+    """Random crop to (w, h); returns (cropped, (x, y, w, h))
+    (reference: ``mx.image.random_crop``)."""
+    rng = rng or _np.random
+    x = _as_jax(data)
+    h, w = (x.shape[0], x.shape[1]) if x.ndim == 3 else (x.shape[1], x.shape[2])
+    cw, ch = size
+    cw, ch = min(cw, w), min(ch, h)
+    x0 = int(rng.randint(0, w - cw + 1))
+    y0 = int(rng.randint(0, h - ch + 1))
+    return crop(data, x0, y0, cw, ch), (x0, y0, cw, ch)
+
+
+def flip_left_right(data) -> NDArray:
+    def impl(x):
+        return jnp.flip(x, axis=x.ndim - 2)
+    return invoke("image_flip_lr", impl, (_wrap(data),))
+
+
+def flip_top_bottom(data) -> NDArray:
+    def impl(x):
+        return jnp.flip(x, axis=x.ndim - 3)
+    return invoke("image_flip_tb", impl, (_wrap(data),))
+
+
+def random_flip_left_right(data, p: float = 0.5) -> NDArray:
+    if _np.random.uniform() < p:
+        return flip_left_right(data)
+    return _wrap(data)
+
+
+def random_flip_top_bottom(data, p: float = 0.5) -> NDArray:
+    if _np.random.uniform() < p:
+        return flip_top_bottom(data)
+    return _wrap(data)
+
+
+def _blend(a, b, alpha):
+    def impl(x, y):
+        out = alpha * x.astype(jnp.float32) + (1.0 - alpha) * y
+        return out.astype(x.dtype) if not jnp.issubdtype(x.dtype, jnp.integer) \
+            else jnp.clip(out, 0, 255).astype(x.dtype)
+    return invoke("image_blend", impl, (_wrap(a), _wrap(b)))
+
+
+def random_brightness(data, min_factor: float, max_factor: float) -> NDArray:
+    alpha = float(_np.random.uniform(min_factor, max_factor))
+    def impl(x):
+        out = x.astype(jnp.float32) * alpha
+        if jnp.issubdtype(x.dtype, jnp.integer):
+            out = jnp.clip(out, 0, 255)
+        return out.astype(x.dtype)
+    return invoke("image_brightness", impl, (_wrap(data),))
+
+
+def random_contrast(data, min_factor: float, max_factor: float) -> NDArray:
+    alpha = float(_np.random.uniform(min_factor, max_factor))
+    def impl(x):
+        xf = x.astype(jnp.float32)
+        coef = jnp.asarray([_R, _G, _B], dtype=jnp.float32)
+        gray = (xf * coef).sum(axis=-1, keepdims=True)
+        mean = jnp.mean(gray, axis=(-3, -2), keepdims=True)
+        out = xf * alpha + mean * (1.0 - alpha)
+        if jnp.issubdtype(x.dtype, jnp.integer):
+            out = jnp.clip(out, 0, 255)
+        return out.astype(x.dtype)
+    return invoke("image_contrast", impl, (_wrap(data),))
+
+
+def random_saturation(data, min_factor: float, max_factor: float) -> NDArray:
+    alpha = float(_np.random.uniform(min_factor, max_factor))
+    def impl(x):
+        xf = x.astype(jnp.float32)
+        coef = jnp.asarray([_R, _G, _B], dtype=jnp.float32)
+        gray = (xf * coef).sum(axis=-1, keepdims=True)
+        out = xf * alpha + gray * (1.0 - alpha)
+        if jnp.issubdtype(x.dtype, jnp.integer):
+            out = jnp.clip(out, 0, 255)
+        return out.astype(x.dtype)
+    return invoke("image_saturation", impl, (_wrap(data),))
+
+
+def random_hue(data, min_factor: float, max_factor: float) -> NDArray:
+    alpha = float(_np.random.uniform(min_factor, max_factor))
+    # YIQ rotation, matching the reference's hue jitter matrix
+    # (src/operator/image/image_random-inl.h RandomHue).
+    u = _np.cos(alpha * _np.pi)
+    w = _np.sin(alpha * _np.pi)
+    t_yiq = _np.array([[0.299, 0.587, 0.114],
+                       [0.596, -0.274, -0.321],
+                       [0.211, -0.523, 0.311]], dtype=_np.float32)
+    t_rgb = _np.array([[1.0, 0.956, 0.621],
+                       [1.0, -0.272, -0.647],
+                       [1.0, -1.107, 1.705]], dtype=_np.float32)
+    rot = _np.array([[1.0, 0.0, 0.0],
+                     [0.0, u, -w],
+                     [0.0, w, u]], dtype=_np.float32)
+    m = jnp.asarray(t_rgb @ rot @ t_yiq)
+
+    def impl(x):
+        xf = x.astype(jnp.float32)
+        out = jnp.einsum("...c,dc->...d", xf, m)
+        if jnp.issubdtype(x.dtype, jnp.integer):
+            out = jnp.clip(out, 0, 255)
+        return out.astype(x.dtype)
+    return invoke("image_hue", impl, (_wrap(data),))
+
+
+def random_color_jitter(data, brightness: float = 0.0, contrast: float = 0.0,
+                        saturation: float = 0.0, hue: float = 0.0) -> NDArray:
+    augs = []
+    if brightness > 0:
+        augs.append(lambda d: random_brightness(d, 1 - brightness, 1 + brightness))
+    if contrast > 0:
+        augs.append(lambda d: random_contrast(d, 1 - contrast, 1 + contrast))
+    if saturation > 0:
+        augs.append(lambda d: random_saturation(d, 1 - saturation, 1 + saturation))
+    if hue > 0:
+        augs.append(lambda d: random_hue(d, -hue, hue))
+    _np.random.shuffle(augs)
+    out = _wrap(data)
+    for a in augs:
+        out = a(out)
+    return out
+
+
+def adjust_lighting(data, alpha) -> NDArray:
+    """AlexNet-style PCA lighting noise (reference: ``_image_adjust_lighting``);
+    input HWC/NHWC RGB in [0,255] or [0,1]."""
+    eigval = _np.array([55.46, 4.794, 1.148], dtype=_np.float32)
+    eigvec = _np.array([[-0.5675, 0.7192, 0.4009],
+                        [-0.5808, -0.0045, -0.8140],
+                        [-0.5836, -0.6948, 0.4203]], dtype=_np.float32)
+    a = _np.asarray(alpha, dtype=_np.float32)
+    delta = jnp.asarray(eigvec @ (a * eigval))
+
+    def impl(x):
+        xf = x.astype(jnp.float32)
+        scale = 1.0 if jnp.issubdtype(x.dtype, jnp.integer) else 1.0 / 255.0
+        out = xf + delta * scale
+        if jnp.issubdtype(x.dtype, jnp.integer):
+            out = jnp.clip(out, 0, 255)
+        return out.astype(x.dtype)
+    return invoke("image_lighting", impl, (_wrap(data),))
+
+
+def random_lighting(data, alpha_std: float = 0.05) -> NDArray:
+    alpha = _np.random.normal(0.0, alpha_std, size=(3,))
+    return adjust_lighting(data, alpha)
+
+
+def _wrap(x) -> NDArray:
+    if isinstance(x, NDArray):
+        return x
+    return from_jax(jnp.asarray(x))

@@ -261,7 +261,7 @@ class CSRNDArray(BaseSparseNDArray):
         self._sp_indptr = jnp.asarray(_np.concatenate(
             [[0], _np.cumsum(mask.sum(axis=1))]).astype(_np.int32))
         self._sp_shape = tuple(arr.shape)
-        self._sp_dtype = jnp.asarray(arr).dtype
+        self._sp_dtype = self._sp_data.dtype
 
     def _todense_impl(self):
         dense = jnp.zeros(self._sp_shape, dtype=self._sp_dtype)

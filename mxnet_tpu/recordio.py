@@ -86,7 +86,10 @@ class MXRecordIO:
         self.open()
 
     def __del__(self) -> None:
-        self.close()
+        try:
+            self.close()
+        except Exception:   # noqa: BLE001 - interpreter shutdown
+            pass
 
     def __getstate__(self):
         d = dict(self.__dict__)

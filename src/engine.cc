@@ -15,6 +15,7 @@
 #include <atomic>
 #include <chrono>
 #include <condition_variable>
+#include <cstdio>
 #include <cstring>
 #include <deque>
 #include <mutex>
@@ -30,6 +31,30 @@ namespace mxtpu {
 void SetLastError(const std::string &msg);
 
 namespace {
+
+/* Minimal JSON string escape for chrome-trace op names. */
+std::string JsonEscape(const std::string &s) {
+  std::string out;
+  out.reserve(s.size());
+  for (char c : s) {
+    switch (c) {
+      case '"': out += "\\\""; break;
+      case '\\': out += "\\\\"; break;
+      case '\n': out += "\\n"; break;
+      case '\t': out += "\\t"; break;
+      case '\r': out += "\\r"; break;
+      default:
+        if (static_cast<unsigned char>(c) < 0x20) {
+          char buf[8];
+          std::snprintf(buf, sizeof(buf), "\\u%04x", c);
+          out += buf;
+        } else {
+          out += c;
+        }
+    }
+  }
+  return out;
+}
 
 struct Opr;
 
@@ -190,7 +215,8 @@ class Engine {
     for (size_t i = 0; i < events_.size(); ++i) {
       const ProfileEvent &e = events_[i];
       if (i) out += ",";
-      out += "{\"name\":\"" + e.name + "\",\"cat\":\"engine\",\"ph\":\"X\"";
+      out += "{\"name\":\"" + JsonEscape(e.name) +
+             "\",\"cat\":\"engine\",\"ph\":\"X\"";
       out += ",\"ts\":" + std::to_string(e.start_us);
       out += ",\"dur\":" + std::to_string(e.dur_us);
       out += ",\"pid\":0,\"tid\":" + std::to_string(e.tid) + "}";

@@ -129,8 +129,12 @@ class Reader {
   void Reserve(uint64_t length) {
     if (length <= cap_) return;
     if (buf_) PoolFree(buf_);
+    // clear before realloc: if PoolAlloc throws, ~Reader must not
+    // double-free the old pointer
+    buf_ = nullptr;
+    cap_ = 0;
+    buf_ = static_cast<char *>(PoolAlloc(length));
     cap_ = length;
-    buf_ = static_cast<char *>(PoolAlloc(cap_));
   }
 
   std::FILE *fp_;

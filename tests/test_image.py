@@ -1,0 +1,138 @@
+"""mx.image + nd.image op tests (reference: tests/python/unittest/test_image.py)."""
+import numpy as onp
+import pytest
+
+import mxnet_tpu as mx
+from mxnet_tpu.ndarray import image as ndimg
+
+
+def _png_bytes(h=32, w=40, seed=0):
+    from PIL import Image
+    import io
+    rng = onp.random.RandomState(seed)
+    arr = rng.randint(0, 255, (h, w, 3), dtype=onp.uint8)
+    buf = io.BytesIO()
+    Image.fromarray(arr).save(buf, format="PNG")
+    return arr, buf.getvalue()
+
+
+def test_imdecode_roundtrip():
+    arr, data = _png_bytes()
+    img = mx.image.imdecode(data)
+    assert img.shape == arr.shape
+    assert onp.array_equal(img.asnumpy(), arr)
+
+
+def test_imdecode_gray_and_bgr():
+    arr, data = _png_bytes()
+    gray = mx.image.imdecode(data, flag=0)
+    assert gray.shape == (32, 40, 1)
+    bgr = mx.image.imdecode(data, to_rgb=False)
+    assert onp.array_equal(bgr.asnumpy()[:, :, ::-1], arr)
+
+
+def test_to_tensor_normalize():
+    arr = onp.random.randint(0, 255, (8, 10, 3)).astype(onp.uint8)
+    t = ndimg.to_tensor(mx.nd.array(arr, dtype="uint8"))
+    assert t.shape == (3, 8, 10)
+    assert t.dtype == onp.float32
+    onp.testing.assert_allclose(t.asnumpy(),
+                                arr.transpose(2, 0, 1) / 255.0, rtol=1e-6)
+    n = ndimg.normalize(t, mean=(0.5, 0.5, 0.5), std=(0.2, 0.2, 0.2))
+    onp.testing.assert_allclose(n.asnumpy(), (t.asnumpy() - 0.5) / 0.2,
+                                rtol=1e-5)
+
+
+def test_resize_crop():
+    arr = onp.random.randint(0, 255, (32, 48, 3)).astype(onp.uint8)
+    img = mx.nd.array(arr, dtype="uint8")
+    r = ndimg.resize(img, (24, 16))
+    assert r.shape == (16, 24, 3)
+    rs = mx.image.resize_short(img, 16)
+    assert min(rs.shape[:2]) == 16
+    c = ndimg.crop(img, 4, 2, 10, 20)
+    assert c.shape == (20, 10, 3)
+    assert onp.array_equal(c.asnumpy(), arr[2:22, 4:14])
+    cc, rect = mx.image.center_crop(img, (16, 16))
+    assert cc.shape == (16, 16, 3)
+
+
+def test_flips():
+    arr = onp.arange(2 * 3 * 3).reshape(2, 3, 3).astype(onp.uint8)
+    img = mx.nd.array(arr, dtype="uint8")
+    lr = ndimg.flip_left_right(img)
+    assert onp.array_equal(lr.asnumpy(), arr[:, ::-1])
+    tb = ndimg.flip_top_bottom(img)
+    assert onp.array_equal(tb.asnumpy(), arr[::-1])
+
+
+def test_color_jitter_ops_bounded():
+    arr = onp.random.randint(0, 255, (8, 8, 3)).astype(onp.uint8)
+    img = mx.nd.array(arr, dtype="uint8")
+    for fn in [lambda: ndimg.random_brightness(img, 0.7, 1.3),
+               lambda: ndimg.random_contrast(img, 0.7, 1.3),
+               lambda: ndimg.random_saturation(img, 0.7, 1.3),
+               lambda: ndimg.random_hue(img, -0.1, 0.1),
+               lambda: ndimg.random_lighting(img, 0.05),
+               lambda: ndimg.random_color_jitter(img, 0.3, 0.3, 0.3, 0.1)]:
+        out = fn()
+        assert out.shape == img.shape
+        a = out.asnumpy()
+        assert a.min() >= 0 and a.max() <= 255
+
+
+def test_augmenter_pipeline():
+    arr = onp.random.randint(0, 255, (50, 60, 3)).astype(onp.uint8)
+    img = mx.nd.array(arr, dtype="uint8")
+    augs = mx.image.CreateAugmenter((3, 24, 24), resize=30, rand_crop=True,
+                                    rand_mirror=True, mean=True, std=True,
+                                    brightness=0.1)
+    for a in augs:
+        img = a(img)
+    assert img.shape == (24, 24, 3)
+    assert img.dtype == onp.float32
+
+
+def test_image_iter_imglist(tmp_path):
+    arrs = [onp.random.randint(0, 255, (40, 40, 3)).astype(onp.uint8)
+            for _ in range(7)]
+    imglist = [(float(i), mx.nd.array(a, dtype="uint8"))
+               for i, a in enumerate(arrs)]
+    it = mx.image.ImageIter(batch_size=3, data_shape=(3, 24, 24),
+                            imglist=imglist, aug_list=[
+                                mx.image.CenterCropAug((24, 24)),
+                                mx.image.CastAug()])
+    batches = list(it)
+    assert len(batches) == 3
+    assert batches[0].data[0].shape == (3, 3, 24, 24)
+    assert batches[-1].pad == 2
+    it.reset()
+    assert next(it).data[0].shape == (3, 3, 24, 24)
+
+
+def test_image_iter_recordio(tmp_path):
+    from mxnet_tpu import recordio
+    rec_path = str(tmp_path / "data.rec")
+    idx_path = str(tmp_path / "data.idx")
+    rec = recordio.MXIndexedRecordIO(idx_path, rec_path, "w")
+    for i in range(5):
+        arr, data = _png_bytes(40, 40, seed=i)
+        hdr = recordio.IRHeader(0, float(i), i, 0)
+        rec.write_idx(i, recordio.pack(hdr, data))
+    rec.close()
+
+    it = mx.image.ImageIter(batch_size=2, data_shape=(3, 32, 32),
+                            path_imgrec=rec_path, path_imgidx=idx_path,
+                            shuffle=False, last_batch_handle="discard",
+                            aug_list=[mx.image.CenterCropAug((32, 32)),
+                                      mx.image.CastAug()])
+    batches = list(it)
+    assert len(batches) == 2
+    labels = onp.concatenate([b.label[0].asnumpy() for b in batches])
+    assert onp.array_equal(labels, onp.array([0.0, 1.0, 2.0, 3.0]))
+
+
+def test_imrotate():
+    arr = onp.random.randint(0, 255, (20, 20, 3)).astype(onp.uint8)
+    out = mx.image.imrotate(mx.nd.array(arr, dtype="uint8"), 90)
+    assert out.shape == arr.shape
