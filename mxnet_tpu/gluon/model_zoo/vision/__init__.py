@@ -4,6 +4,8 @@ from .resnet import *  # noqa: F401,F403
 from .resnet import __all__ as _resnet_all
 from .simple_nets import *  # noqa: F401,F403
 from .simple_nets import __all__ as _simple_all
+from .inception import *  # noqa: F401,F403
+from .inception import __all__ as _inception_all
 
 from ....base import MXNetError
 
@@ -23,6 +25,9 @@ _models = {
     "mobilenet0.5": mobilenet0_5, "mobilenet0.25": mobilenet0_25,
     "mobilenetv2_1.0": mobilenet_v2_1_0, "mobilenetv2_0.75": mobilenet_v2_0_75,
     "mobilenetv2_0.5": mobilenet_v2_0_5, "mobilenetv2_0.25": mobilenet_v2_0_25,
+    "mobilenetv3_large": mobilenet_v3_large,
+    "mobilenetv3_small": mobilenet_v3_small,
+    "inceptionv3": inception_v3,
 }
 
 
@@ -35,4 +40,5 @@ def get_model(name: str, **kwargs):
     return _models[name](**kwargs)
 
 
-__all__ = list(_resnet_all) + list(_simple_all) + ["get_model"]
+__all__ = (list(_resnet_all) + list(_simple_all) + list(_inception_all)
+           + ["get_model"])

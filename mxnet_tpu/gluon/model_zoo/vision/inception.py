@@ -1,0 +1,151 @@
+"""Inception v3 (reference: ``python/mxnet/gluon/model_zoo/vision/
+inception.py`` — same architecture and factory name).
+
+Built from the same HybridBlock layers as the rest of the zoo; all convs are
+channels-first NCHW so XLA lays them onto the MXU directly.
+"""
+from __future__ import annotations
+
+from typing import Any
+
+from ....ndarray import ops as ndops
+from ...block import HybridBlock
+from ...nn import (Activation, AvgPool2D, BatchNorm, Conv2D, Dense, Dropout,
+                   Flatten, GlobalAvgPool2D, HybridSequential, MaxPool2D)
+
+__all__ = ["Inception3", "inception_v3"]
+
+
+def _conv_bn(channels: int, kernel, stride=1, padding=0) -> HybridSequential:
+    out = HybridSequential()
+    out.add(Conv2D(channels, kernel, stride, padding, use_bias=False),
+            BatchNorm(epsilon=0.001), Activation("relu"))
+    return out
+
+
+class _Concurrent(HybridSequential):
+    """Run children on the same input and concat outputs on channel axis
+    (reference: gluon.contrib.nn.HybridConcurrent used by inception)."""
+
+    def forward(self, x):
+        outs = [blk(x) for blk in self._children_list()]
+        return ndops.concat(*outs, dim=1)
+
+    def _children_list(self):
+        return list(self._children.values())
+
+
+def _make_A(pool_features: int) -> _Concurrent:
+    out = _Concurrent()
+    out.add(_conv_bn(64, 1))
+    b2 = HybridSequential(); b2.add(_conv_bn(48, 1), _conv_bn(64, 5, 1, 2))
+    b3 = HybridSequential()
+    b3.add(_conv_bn(64, 1), _conv_bn(96, 3, 1, 1), _conv_bn(96, 3, 1, 1))
+    b4 = HybridSequential()
+    b4.add(AvgPool2D(3, 1, 1), _conv_bn(pool_features, 1))
+    out.add(b2, b3, b4)
+    return out
+
+
+def _make_B() -> _Concurrent:
+    out = _Concurrent()
+    out.add(_conv_bn(384, 3, 2))
+    b2 = HybridSequential()
+    b2.add(_conv_bn(64, 1), _conv_bn(96, 3, 1, 1), _conv_bn(96, 3, 2))
+    b3 = HybridSequential(); b3.add(MaxPool2D(3, 2))
+    out.add(b2, b3)
+    return out
+
+
+def _make_C(channels_7x7: int) -> _Concurrent:
+    out = _Concurrent()
+    out.add(_conv_bn(192, 1))
+    c = channels_7x7
+    b2 = HybridSequential()
+    b2.add(_conv_bn(c, 1), _conv_bn(c, (1, 7), 1, (0, 3)),
+           _conv_bn(192, (7, 1), 1, (3, 0)))
+    b3 = HybridSequential()
+    b3.add(_conv_bn(c, 1), _conv_bn(c, (7, 1), 1, (3, 0)),
+           _conv_bn(c, (1, 7), 1, (0, 3)), _conv_bn(c, (7, 1), 1, (3, 0)),
+           _conv_bn(192, (1, 7), 1, (0, 3)))
+    b4 = HybridSequential()
+    b4.add(AvgPool2D(3, 1, 1), _conv_bn(192, 1))
+    out.add(b2, b3, b4)
+    return out
+
+
+def _make_D() -> _Concurrent:
+    out = _Concurrent()
+    b1 = HybridSequential(); b1.add(_conv_bn(192, 1), _conv_bn(320, 3, 2))
+    b2 = HybridSequential()
+    b2.add(_conv_bn(192, 1), _conv_bn(192, (1, 7), 1, (0, 3)),
+           _conv_bn(192, (7, 1), 1, (3, 0)), _conv_bn(192, 3, 2))
+    b3 = HybridSequential(); b3.add(MaxPool2D(3, 2))
+    out.add(b1, b2, b3)
+    return out
+
+
+class _SplitConcat(HybridBlock):
+    """1x1 reduce then parallel (1,3)/(3,1) convs concatenated (the E-block
+    arm that fans one tensor into two convs)."""
+
+    def __init__(self, reduce: HybridSequential, arms, **kwargs: Any) -> None:
+        super().__init__(**kwargs)
+        self.reduce = reduce
+        for i, arm in enumerate(arms):
+            setattr(self, f"arm{i}", arm)
+        self._n_arms = len(arms)
+
+    def forward(self, x):
+        if self.reduce is not None:
+            x = self.reduce(x)
+        outs = [getattr(self, f"arm{i}")(x) for i in range(self._n_arms)]
+        return ndops.concat(*outs, dim=1)
+
+
+def _make_E() -> _Concurrent:
+    out = _Concurrent()
+    out.add(_conv_bn(320, 1))
+    out.add(_SplitConcat(_conv_bn(384, 1),
+                         [_conv_bn(384, (1, 3), 1, (0, 1)),
+                          _conv_bn(384, (3, 1), 1, (1, 0))]))
+    pre = HybridSequential(); pre.add(_conv_bn(448, 1), _conv_bn(384, 3, 1, 1))
+    out.add(_SplitConcat(pre,
+                         [_conv_bn(384, (1, 3), 1, (0, 1)),
+                          _conv_bn(384, (3, 1), 1, (1, 0))]))
+    b4 = HybridSequential()
+    b4.add(AvgPool2D(3, 1, 1), _conv_bn(192, 1))
+    out.add(b4)
+    return out
+
+
+class Inception3(HybridBlock):
+    """Inception v3 (299x299 input; reference ``Inception3``)."""
+
+    def __init__(self, classes: int = 1000, **kwargs: Any) -> None:
+        super().__init__(**kwargs)
+        self.features = HybridSequential()
+        self.features.add(_conv_bn(32, 3, 2),
+                          _conv_bn(32, 3),
+                          _conv_bn(64, 3, 1, 1),
+                          MaxPool2D(3, 2),
+                          _conv_bn(80, 1),
+                          _conv_bn(192, 3),
+                          MaxPool2D(3, 2),
+                          _make_A(32), _make_A(64), _make_A(64),
+                          _make_B(),
+                          _make_C(128), _make_C(160), _make_C(160),
+                          _make_C(192),
+                          _make_D(),
+                          _make_E(), _make_E(),
+                          AvgPool2D(8),
+                          Dropout(0.5),
+                          Flatten())
+        self.output = Dense(classes)
+
+    def forward(self, x):
+        return self.output(self.features(x))
+
+
+def inception_v3(classes: int = 1000, **kwargs: Any) -> Inception3:
+    return Inception3(classes=classes, **kwargs)

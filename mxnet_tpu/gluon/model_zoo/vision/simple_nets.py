@@ -17,7 +17,8 @@ __all__ = ["AlexNet", "alexnet", "VGG", "get_vgg", "vgg11", "vgg13", "vgg16",
            "densenet201", "MobileNet", "MobileNetV2", "mobilenet1_0",
            "mobilenet0_75", "mobilenet0_5", "mobilenet0_25",
            "mobilenet_v2_1_0", "mobilenet_v2_0_75", "mobilenet_v2_0_5",
-           "mobilenet_v2_0_25"]
+           "mobilenet_v2_0_25", "MobileNetV3", "mobilenet_v3_large",
+           "mobilenet_v3_small"]
 
 
 class AlexNet(HybridBlock):
@@ -313,3 +314,95 @@ def mobilenet_v2_1_0(**kw): return MobileNetV2(1.0, **kw)
 def mobilenet_v2_0_75(**kw): return MobileNetV2(0.75, **kw)
 def mobilenet_v2_0_5(**kw): return MobileNetV2(0.5, **kw)
 def mobilenet_v2_0_25(**kw): return MobileNetV2(0.25, **kw)
+
+
+class _HardSwish(HybridBlock):
+    def forward(self, x):
+        return x * (x + 3.0).clip(0.0, 6.0) / 6.0
+
+
+class _SE(HybridBlock):
+    """Squeeze-and-excitation with hard-sigmoid gate (MobileNetV3)."""
+
+    def __init__(self, channels: int, reduction: int = 4, **kwargs: Any) -> None:
+        super().__init__(**kwargs)
+        self.pool = GlobalAvgPool2D()
+        self.fc1 = Conv2D(max(8, channels // reduction), 1)
+        self.fc2 = Conv2D(channels, 1)
+
+    def forward(self, x):
+        w = self.pool(x)
+        w = self.fc1(w).relu()
+        w = (self.fc2(w) + 3.0).clip(0.0, 6.0) / 6.0
+        return x * w
+
+
+class _V3Bottleneck(HybridBlock):
+    def __init__(self, in_c: int, exp: int, out_c: int, kernel: int,
+                 stride: int, se: bool, act: str, **kwargs: Any) -> None:
+        super().__init__(**kwargs)
+        self.use_shortcut = stride == 1 and in_c == out_c
+        act_blk = _HardSwish if act == "hswish" else \
+            (lambda: Activation("relu"))
+        self.body = HybridSequential()
+        if exp != in_c:
+            self.body.add(Conv2D(exp, 1, use_bias=False), BatchNorm(),
+                          act_blk())
+        self.body.add(Conv2D(exp, kernel, stride, kernel // 2, groups=exp,
+                             use_bias=False, in_channels=exp),
+                      BatchNorm(), act_blk())
+        if se:
+            self.body.add(_SE(exp))
+        self.body.add(Conv2D(out_c, 1, use_bias=False), BatchNorm())
+
+    def forward(self, x):
+        out = self.body(x)
+        return x + out if self.use_shortcut else out
+
+
+class MobileNetV3(HybridBlock):
+    """MobileNet v3 large/small (reference era: gluoncv mobilenetv3;
+    SURVEY.md 2.5 zoo inventory). Hard-swish + SE bottlenecks."""
+
+    # k, exp, out, se, act, stride
+    _LARGE = [(3, 16, 16, False, "relu", 1), (3, 64, 24, False, "relu", 2),
+              (3, 72, 24, False, "relu", 1), (5, 72, 40, True, "relu", 2),
+              (5, 120, 40, True, "relu", 1), (5, 120, 40, True, "relu", 1),
+              (3, 240, 80, False, "hswish", 2), (3, 200, 80, False, "hswish", 1),
+              (3, 184, 80, False, "hswish", 1), (3, 184, 80, False, "hswish", 1),
+              (3, 480, 112, True, "hswish", 1), (3, 672, 112, True, "hswish", 1),
+              (5, 672, 160, True, "hswish", 2), (5, 960, 160, True, "hswish", 1),
+              (5, 960, 160, True, "hswish", 1)]
+    _SMALL = [(3, 16, 16, True, "relu", 2), (3, 72, 24, False, "relu", 2),
+              (3, 88, 24, False, "relu", 1), (5, 96, 40, True, "hswish", 2),
+              (5, 240, 40, True, "hswish", 1), (5, 240, 40, True, "hswish", 1),
+              (5, 120, 48, True, "hswish", 1), (5, 144, 48, True, "hswish", 1),
+              (5, 288, 96, True, "hswish", 2), (5, 576, 96, True, "hswish", 1),
+              (5, 576, 96, True, "hswish", 1)]
+
+    def __init__(self, mode: str = "large", classes: int = 1000,
+                 **kwargs: Any) -> None:
+        super().__init__(**kwargs)
+        if mode not in ("large", "small"):
+            raise MXNetError("MobileNetV3 mode must be 'large' or 'small'")
+        spec = self._LARGE if mode == "large" else self._SMALL
+        last_exp = 960 if mode == "large" else 576
+        head = 1280 if mode == "large" else 1024
+        self.features = HybridSequential()
+        self.features.add(Conv2D(16, 3, 2, 1, use_bias=False), BatchNorm(),
+                          _HardSwish())
+        in_c = 16
+        for k, exp, out_c, se, act, s in spec:
+            self.features.add(_V3Bottleneck(in_c, exp, out_c, k, s, se, act))
+            in_c = out_c
+        self.features.add(Conv2D(last_exp, 1, use_bias=False), BatchNorm(),
+                          _HardSwish(), GlobalAvgPool2D(),
+                          Conv2D(head, 1), _HardSwish(), Flatten())
+        self.output = Dense(classes)
+
+    def forward(self, x):
+        return self.output(self.features(x))
+
+
+def mobilenet_v3_large(**kw): return MobileNetV3("large", **kw)
+def mobilenet_v3_small(**kw): return MobileNetV3("small", **kw)

@@ -253,3 +253,33 @@ def test_random_hue():
     assert out.shape == x.shape
     jit = transforms.RandomColorJitter(hue=0.4)
     assert len(jit._ts) == 1
+
+
+def test_mobilenet_v3_constructs():
+    from mxnet_tpu.gluon.model_zoo import vision as zoo
+    x = mx.np.ones((1, 3, 64, 64))
+    for name in ("mobilenetv3_small", "mobilenetv3_large"):
+        net = zoo.get_model(name, classes=10)
+        net.initialize()
+        assert net(x).shape == (1, 10), name
+
+
+def test_inception_v3_constructs():
+    from mxnet_tpu.gluon.model_zoo import vision as zoo
+    net = zoo.get_model("inceptionv3", classes=10)
+    net.initialize()
+    x = mx.np.ones((1, 3, 299, 299))
+    assert net(x).shape == (1, 10)
+
+
+def test_inception_v3_hybridize_equivalence():
+    from mxnet_tpu.gluon.model_zoo import vision as zoo
+    import numpy as _onp
+    net = zoo.get_model("inceptionv3", classes=4)
+    net.initialize()
+    x = mx.np.array(_onp.random.RandomState(0).uniform(
+        -1, 1, (1, 3, 299, 299)).astype("float32"))
+    ref = net(x).asnumpy()
+    net.hybridize()
+    out = net(x).asnumpy()
+    _onp.testing.assert_allclose(out, ref, rtol=2e-4, atol=2e-4)
