@@ -53,6 +53,9 @@ _LAZY = {
     "model": ".model",
     "mod": ".module",
     "module": ".module",
+    "operator": ".operator",
+    "monitor": ".monitor",
+    "mon": ".monitor",
     "symbol": ".symbol",
     "sym": ".symbol",
 }

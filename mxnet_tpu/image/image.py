@@ -182,7 +182,14 @@ class Augmenter:
 
     def dumps(self) -> str:
         import json
-        return json.dumps([self.__class__.__name__.lower(), self._kwargs])
+
+        def clean(v):
+            if isinstance(v, (_np.ndarray, NDArray)):
+                return _np.asarray(v.asnumpy() if isinstance(v, NDArray)
+                                   else v).tolist()
+            return v
+        kwargs = {k: clean(v) for k, v in self._kwargs.items()}
+        return json.dumps([self.__class__.__name__.lower(), kwargs])
 
     def __call__(self, src: NDArray) -> NDArray:
         raise NotImplementedError
@@ -335,9 +342,11 @@ class LightingAug(Augmenter):
     def __init__(self, alphastd: float, eigval=None, eigvec=None) -> None:
         super().__init__(alphastd=alphastd)
         self.alphastd = alphastd
+        self.eigval, self.eigvec = eigval, eigvec
 
     def __call__(self, src):
-        return ndimg.random_lighting(src, self.alphastd)
+        return ndimg.random_lighting(src, self.alphastd,
+                                     eigval=self.eigval, eigvec=self.eigvec)
 
 
 class ColorNormalizeAug(Augmenter):

@@ -1,0 +1,117 @@
+"""``mx.monitor`` — per-op output statistics for debugging.
+
+Reference parity (leezu/mxnet): ``python/mxnet/monitor.py`` — ``Monitor``
+installs a callback on executor op outputs and prints a stat (default
+|x|/size) per matching op every ``interval`` batches; the standard tool for
+chasing exploding activations.
+
+Design (tpu-first): rather than executor install-hooks, the monitor taps
+the imperative dispatch layer (``ndarray.register.invoke``) — every op the
+framework executes flows through it, eager or under Block.__call__, so one
+hook covers Gluon and Module paths alike.  Stats are computed lazily as XLA
+reductions and only synced to host at ``toc()``.
+"""
+from __future__ import annotations
+
+import re
+from typing import Any, Callable, List, Optional, Tuple
+
+from .ndarray import register as _register
+from .ndarray.ndarray import NDArray
+
+__all__ = ["Monitor"]
+
+
+class Monitor:
+    """Collect per-op output statistics (reference: ``mx.mon.Monitor``).
+
+    Parameters mirror the reference: ``interval`` (batches between
+    collections), ``stat_func`` (NDArray -> NDArray stat, default mean
+    |x|), ``pattern`` (regex on op/output name), ``sort`` (sort results
+    by name at ``toc``).
+    """
+
+    def __init__(self, interval: int = 1,
+                 stat_func: Optional[Callable[[NDArray], NDArray]] = None,
+                 pattern: str = ".*", sort: bool = False) -> None:
+        if stat_func is None:
+            def stat_func(x: NDArray) -> NDArray:
+                return x.abs().mean()
+        self.stat_func = stat_func
+        self.interval = interval
+        self.pattern = re.compile(pattern)
+        self.sort = sort
+        self.step = 0
+        self.activated = False
+        self.queue: List[Tuple[int, str, NDArray]] = []
+        self._exes: List[Any] = []
+        self._in_hook = False
+
+    # -- gluon/imperative path --------------------------------------------
+    def _hook(self, name: str, outputs: Tuple[NDArray, ...]) -> None:
+        # stat_func itself runs ops through the same dispatch layer;
+        # guard against recursing into our own stat computation
+        if self._in_hook or not self.pattern.match(name):
+            return
+        import jax
+        from . import autograd
+        self._in_hook = True
+        try:
+            # stats are a debugging side-channel: never tape them, and skip
+            # abstract tracers (ops running under a hybridize/jit trace)
+            with autograd.pause():
+                for i, out in enumerate(outputs):
+                    if isinstance(out._data, jax.core.Tracer):
+                        continue
+                    oname = name if len(outputs) == 1 else f"{name}_output{i}"
+                    try:
+                        self.queue.append(
+                            (self.step, oname, self.stat_func(out)))
+                    except Exception:   # noqa: BLE001 - stat on odd dtypes
+                        pass
+        finally:
+            self._in_hook = False
+
+    def install(self, exe: Any) -> None:
+        """Attach to a symbol Executor (reference: ``Monitor.install``).
+        The executor runs ops through the same dispatch layer, so this
+        just remembers the exe for interface parity."""
+        self._exes.append(exe)
+
+    def tic(self) -> None:
+        """Start collecting for this batch if the interval hits
+        (reference: ``Monitor.tic``)."""
+        if self.step % self.interval == 0:
+            self.queue = []
+            self.activated = True
+            _register._monitor_state["hook"] = self._hook
+        self.step += 1
+
+    def toc(self) -> List[Tuple[int, str, str]]:
+        """Stop collecting; return [(step, name, stat_str)]
+        (reference: ``Monitor.toc``)."""
+        if not self.activated:
+            return []
+        _register._monitor_state["hook"] = None
+        self.activated = False
+        res = []
+        for step, name, stat in self.queue:
+            arr = stat.asnumpy() if isinstance(stat, NDArray) else stat
+            res.append((step, name, str(arr)))
+        if self.sort:
+            res.sort(key=lambda t: t[1])
+        self.queue = []
+        return res
+
+    def toc_print(self) -> None:
+        """Collect and log results (reference: ``Monitor.toc_print``)."""
+        import logging
+        for step, name, stat in self.toc():
+            logging.info("Batch: %7d %30s %s", step, name, stat)
+
+    def __enter__(self) -> "Monitor":
+        self.tic()
+        return self
+
+    def __exit__(self, *exc: Any) -> None:
+        self.toc_print()

@@ -148,14 +148,6 @@ def random_flip_top_bottom(data, p: float = 0.5) -> NDArray:
     return _wrap(data)
 
 
-def _blend(a, b, alpha):
-    def impl(x, y):
-        out = alpha * x.astype(jnp.float32) + (1.0 - alpha) * y
-        return out.astype(x.dtype) if not jnp.issubdtype(x.dtype, jnp.integer) \
-            else jnp.clip(out, 0, 255).astype(x.dtype)
-    return invoke("image_blend", impl, (_wrap(a), _wrap(b)))
-
-
 def random_brightness(data, min_factor: float, max_factor: float) -> NDArray:
     alpha = float(_np.random.uniform(min_factor, max_factor))
     def impl(x):
@@ -237,13 +229,18 @@ def random_color_jitter(data, brightness: float = 0.0, contrast: float = 0.0,
     return out
 
 
-def adjust_lighting(data, alpha) -> NDArray:
+def adjust_lighting(data, alpha, eigval=None, eigvec=None) -> NDArray:
     """AlexNet-style PCA lighting noise (reference: ``_image_adjust_lighting``);
-    input HWC/NHWC RGB in [0,255] or [0,1]."""
-    eigval = _np.array([55.46, 4.794, 1.148], dtype=_np.float32)
-    eigvec = _np.array([[-0.5675, 0.7192, 0.4009],
-                        [-0.5808, -0.0045, -0.8140],
-                        [-0.5836, -0.6948, 0.4203]], dtype=_np.float32)
+    input HWC/NHWC RGB in [0,255] or [0,1]. ``eigval``/``eigvec`` default to
+    the ImageNet PCA basis."""
+    if eigval is None:
+        eigval = _np.array([55.46, 4.794, 1.148], dtype=_np.float32)
+    if eigvec is None:
+        eigvec = _np.array([[-0.5675, 0.7192, 0.4009],
+                            [-0.5808, -0.0045, -0.8140],
+                            [-0.5836, -0.6948, 0.4203]], dtype=_np.float32)
+    eigval = _np.asarray(eigval, dtype=_np.float32)
+    eigvec = _np.asarray(eigvec, dtype=_np.float32)
     a = _np.asarray(alpha, dtype=_np.float32)
     delta = jnp.asarray(eigvec @ (a * eigval))
 
@@ -257,9 +254,10 @@ def adjust_lighting(data, alpha) -> NDArray:
     return invoke("image_lighting", impl, (_wrap(data),))
 
 
-def random_lighting(data, alpha_std: float = 0.05) -> NDArray:
+def random_lighting(data, alpha_std: float = 0.05, eigval=None,
+                    eigvec=None) -> NDArray:
     alpha = _np.random.normal(0.0, alpha_std, size=(3,))
-    return adjust_lighting(data, alpha)
+    return adjust_lighting(data, alpha, eigval=eigval, eigvec=eigvec)
 
 
 def _wrap(x) -> NDArray:

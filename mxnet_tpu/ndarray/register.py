@@ -36,6 +36,7 @@ _amp_state = {"active": False}
 
 # flipped by mxnet_tpu.profiler.set_state(); same hot-path pattern
 _profiler_state = {"on": False}
+_monitor_state = {"hook": None}   # set by mx.monitor.Monitor.tic
 
 
 def register_op(name: str, fn: Callable, doc: str = "") -> Callable:
@@ -95,6 +96,11 @@ def invoke_with_custom_vjp(name: str, impl: Callable,
         node.out_arrays = [weakref.ref(wrapped)]
         wrapped._ag_node = node
         wrapped._ag_out_idx = 0
+
+    hook = _monitor_state["hook"]
+    if hook is not None:
+        hook(name, (wrapped,))
+
     return wrapped
 
 
@@ -140,5 +146,9 @@ def invoke(name: str, impl: Callable, inputs: Sequence[Any],
         for i, w in enumerate(wrapped):
             w._ag_node = node
             w._ag_out_idx = i
+
+    hook = _monitor_state["hook"]
+    if hook is not None:
+        hook(name, tuple(wrapped))
 
     return wrapped[0] if single else tuple(wrapped)

@@ -1,0 +1,180 @@
+"""``mx.operator`` — user-defined custom operators in Python.
+
+Reference parity (leezu/mxnet): ``python/mxnet/operator.py`` +
+``src/operator/custom/custom.cc`` — ``CustomOp``/``CustomOpProp`` classes,
+``mx.operator.register`` decorator, invoked as
+``mx.nd.Custom(*data, op_type=name)``.
+
+Design (tpu-first): the reference re-enters the engine from a dedicated
+callback thread pool; here custom ops run eagerly on host at dispatch time
+(they are by definition opaque Python, so they are a host boundary — the
+same position they occupy in the reference's schedule).  Gradients plug
+into the autograd tape through the custom-vjp hook, so ``backward`` composes
+with the rest of the tape exactly like a built-in op.
+"""
+from __future__ import annotations
+
+from typing import Any, Callable, Dict, List, Optional, Sequence, Tuple
+
+import numpy as _np
+
+from .base import MXNetError
+from .ndarray import ops as ndops
+from .ndarray.ndarray import NDArray, from_jax
+from .ndarray.register import (invoke_with_custom_vjp, is_recording,
+                               register_op)
+
+__all__ = ["CustomOp", "CustomOpProp", "register", "get_all_registered"]
+
+_REGISTRY: Dict[str, type] = {}
+
+
+class CustomOp:
+    """Base for user ops (reference: ``mx.operator.CustomOp``)."""
+
+    def forward(self, is_train: bool, req: Sequence[str],
+                in_data: Sequence[NDArray], out_data: List[Optional[NDArray]],
+                aux: Sequence[NDArray]) -> None:
+        raise NotImplementedError
+
+    def backward(self, req: Sequence[str], out_grad: Sequence[NDArray],
+                 in_data: Sequence[NDArray], out_data: Sequence[NDArray],
+                 in_grad: List[Optional[NDArray]],
+                 aux: Sequence[NDArray]) -> None:
+        raise NotImplementedError
+
+    def assign(self, dst: List[Optional[NDArray]], index_or_req: Any,
+               src: Any, req: str = "write") -> None:
+        """``self.assign(out_data, 0, result)`` or the reference's
+        ``self.assign(out_data[0], req[0], result)`` calling convention."""
+        if isinstance(dst, list):
+            if isinstance(index_or_req, int):
+                idx, mode = index_or_req, req
+            else:
+                idx, mode = 0, index_or_req
+            if mode == "null":
+                return
+            val = src if isinstance(src, NDArray) else ndops.array(src)
+            if mode == "add_to" and dst[idx] is not None:
+                dst[idx] = dst[idx] + val
+            else:
+                dst[idx] = val
+        else:
+            raise MXNetError("assign expects the out_data/in_grad list")
+
+
+class CustomOpProp:
+    """Op metadata + factory (reference: ``mx.operator.CustomOpProp``)."""
+
+    def __init__(self, need_top_grad: bool = True) -> None:
+        self.need_top_grad_ = need_top_grad
+
+    def list_arguments(self) -> List[str]:
+        return ["data"]
+
+    def list_outputs(self) -> List[str]:
+        return ["output"]
+
+    def list_auxiliary_states(self) -> List[str]:
+        return []
+
+    def infer_shape(self, in_shape: Sequence[Tuple[int, ...]]):
+        return in_shape, [in_shape[0]], []
+
+    def infer_type(self, in_type: Sequence[Any]):
+        return in_type, [in_type[0]] * len(self.list_outputs()), []
+
+    def create_operator(self, ctx: Any, in_shapes: Sequence[Tuple[int, ...]],
+                        in_dtypes: Sequence[Any]) -> CustomOp:
+        raise NotImplementedError
+
+    def declare_backward_dependency(self, out_grad, in_data, out_data):
+        deps = []
+        if self.need_top_grad_:
+            deps.extend(out_grad)
+        deps.extend(in_data)
+        deps.extend(out_data)
+        return deps
+
+
+def register(op_type: str) -> Callable[[type], type]:
+    """Register a CustomOpProp subclass under ``op_type``
+    (reference: ``mx.operator.register``)."""
+
+    def wrap(prop_cls: type) -> type:
+        if not issubclass(prop_cls, CustomOpProp):
+            raise MXNetError("register expects a CustomOpProp subclass")
+        _REGISTRY[op_type] = prop_cls
+        return prop_cls
+    return wrap
+
+
+def get_all_registered() -> List[str]:
+    return sorted(_REGISTRY)
+
+
+def _invoke_custom(op_type: str, inputs: Sequence[NDArray],
+                   kwargs: Dict[str, Any]) -> Any:
+    if op_type not in _REGISTRY:
+        raise MXNetError(f"custom op {op_type!r} is not registered; "
+                         f"known: {get_all_registered()}")
+    prop = _REGISTRY[op_type](**kwargs)
+    n_args = len(prop.list_arguments())
+    n_aux = len(prop.list_auxiliary_states())
+    if len(inputs) != n_args + n_aux:
+        raise MXNetError(
+            f"custom op {op_type!r} expects {n_args} args + {n_aux} aux, "
+            f"got {len(inputs)} inputs")
+    in_data = list(inputs[:n_args])
+    aux = list(inputs[n_args:])
+
+    in_shapes = [tuple(x.shape) for x in in_data]
+    in_dtypes = [x.dtype for x in in_data]
+    _, out_shapes, _ = prop.infer_shape(in_shapes)
+    op = prop.create_operator(None, in_shapes, in_dtypes)
+
+    n_out = len(prop.list_outputs())
+    out_data: List[Optional[NDArray]] = [None] * n_out
+    req = ["write"] * n_out
+
+    recording = is_recording() and any(x._on_tape for x in in_data)
+    op.forward(recording, req, in_data, out_data, aux)
+    for i, o in enumerate(out_data):
+        if o is None:
+            raise MXNetError(f"custom op {op_type!r} did not assign "
+                             f"output {i}")
+
+    if not recording:
+        return out_data[0] if n_out == 1 else tuple(out_data)
+
+    if n_out != 1:
+        raise MXNetError("autograd through multi-output custom ops is not "
+                         "supported; wrap outputs in separate ops")
+
+    result = out_data[0]
+
+    def vjp_fn(out_cot):
+        ograd = from_jax(out_cot)
+        in_grad: List[Optional[NDArray]] = [None] * n_args
+        op.backward(["write"] * n_args, [ograd], in_data, out_data,
+                    in_grad, aux)
+        cots = []
+        for g in in_grad:
+            cots.append(None if g is None else g._data)
+        return cots + [None] * n_aux
+
+    # re-run forward under the tape's custom-vjp hook so the output is a
+    # tracked NDArray whose pullback calls op.backward
+    def impl(*arrays):
+        return result._data
+
+    return invoke_with_custom_vjp(f"Custom[{op_type}]", impl,
+                                  list(in_data) + list(aux), vjp_fn)
+
+
+def Custom(*data: NDArray, op_type: str, **kwargs: Any) -> Any:
+    """Invoke a registered custom op (reference: ``mx.nd.Custom``)."""
+    return _invoke_custom(op_type, list(data), kwargs)
+
+
+register_op("Custom", Custom)
