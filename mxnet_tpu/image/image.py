@@ -340,7 +340,7 @@ class ColorJitterAug(RandomOrderAug):
 
 class LightingAug(Augmenter):
     def __init__(self, alphastd: float, eigval=None, eigvec=None) -> None:
-        super().__init__(alphastd=alphastd)
+        super().__init__(alphastd=alphastd, eigval=eigval, eigvec=eigvec)
         self.alphastd = alphastd
         self.eigval, self.eigvec = eigval, eigvec
 

@@ -84,7 +84,7 @@ class Monitor:
         if self.step % self.interval == 0:
             self.queue = []
             self.activated = True
-            _register._monitor_state["hook"] = self._hook
+            _register._monitor_state["hooks"][id(self)] = self._hook
         self.step += 1
 
     def toc(self) -> List[Tuple[int, str, str]]:
@@ -92,7 +92,7 @@ class Monitor:
         (reference: ``Monitor.toc``)."""
         if not self.activated:
             return []
-        _register._monitor_state["hook"] = None
+        _register._monitor_state["hooks"].pop(id(self), None)
         self.activated = False
         res = []
         for step, name, stat in self.queue:

@@ -27,6 +27,8 @@ def __getattr__(name: str):
     as ``mx.nd.<name>`` — the analog of the generated-op namespace."""
     from ..symbol.symbol import _ALIASES
     canonical = _ALIASES.get(name, name)
+    if canonical == "Custom":
+        from .. import operator  # registers the Custom op on first touch
     if canonical in list_ops():
         fn = get_op(canonical)
         globals()[name] = fn

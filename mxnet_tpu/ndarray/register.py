@@ -36,7 +36,13 @@ _amp_state = {"active": False}
 
 # flipped by mxnet_tpu.profiler.set_state(); same hot-path pattern
 _profiler_state = {"on": False}
-_monitor_state = {"hook": None}   # set by mx.monitor.Monitor.tic
+# id -> hook fn; multiple Monitors may collect concurrently
+_monitor_state = {"hooks": {}}
+
+
+def _fire_monitor_hooks(name, outputs) -> None:
+    for hook in list(_monitor_state["hooks"].values()):
+        hook(name, outputs)
 
 
 def register_op(name: str, fn: Callable, doc: str = "") -> Callable:
@@ -97,9 +103,8 @@ def invoke_with_custom_vjp(name: str, impl: Callable,
         wrapped._ag_node = node
         wrapped._ag_out_idx = 0
 
-    hook = _monitor_state["hook"]
-    if hook is not None:
-        hook(name, (wrapped,))
+    if _monitor_state["hooks"]:
+        _fire_monitor_hooks(name, (wrapped,))
 
     return wrapped
 
@@ -147,8 +152,7 @@ def invoke(name: str, impl: Callable, inputs: Sequence[Any],
             w._ag_node = node
             w._ag_out_idx = i
 
-    hook = _monitor_state["hook"]
-    if hook is not None:
-        hook(name, tuple(wrapped))
+    if _monitor_state["hooks"]:
+        _fire_monitor_hooks(name, tuple(wrapped))
 
     return wrapped[0] if single else tuple(wrapped)

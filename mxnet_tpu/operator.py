@@ -43,10 +43,15 @@ class CustomOp:
                  aux: Sequence[NDArray]) -> None:
         raise NotImplementedError
 
-    def assign(self, dst: List[Optional[NDArray]], index_or_req: Any,
-               src: Any, req: str = "write") -> None:
-        """``self.assign(out_data, 0, result)`` or the reference's
-        ``self.assign(out_data[0], req[0], result)`` calling convention."""
+    def assign(self, dst: Any, index_or_req: Any, src: Any,
+               req: str = "write") -> None:
+        """Write ``src`` into an output/grad slot.
+
+        Both conventions work: the reference's
+        ``self.assign(out_data[0], req[0], result)`` (out_data entries are
+        preallocated NDArrays, written in place) and the list form
+        ``self.assign(out_data, req[0], result)`` (writes slot 0)."""
+        val = src if isinstance(src, NDArray) else ndops.array(src)
         if isinstance(dst, list):
             if isinstance(index_or_req, int):
                 idx, mode = index_or_req, req
@@ -54,13 +59,22 @@ class CustomOp:
                 idx, mode = 0, index_or_req
             if mode == "null":
                 return
-            val = src if isinstance(src, NDArray) else ndops.array(src)
             if mode == "add_to" and dst[idx] is not None:
                 dst[idx] = dst[idx] + val
             else:
                 dst[idx] = val
+        elif isinstance(dst, NDArray):
+            mode = index_or_req if isinstance(index_or_req, str) else req
+            if mode == "null":
+                return
+            if mode == "add_to":
+                dst._data = (dst + val)._data
+            else:
+                dst._data = val._data.astype(dst.dtype) \
+                    if val.dtype != dst.dtype else val._data
         else:
-            raise MXNetError("assign expects the out_data/in_grad list")
+            raise MXNetError("assign expects an NDArray slot or the "
+                             "out_data/in_grad list")
 
 
 class CustomOpProp:
@@ -131,10 +145,15 @@ def _invoke_custom(op_type: str, inputs: Sequence[NDArray],
     in_shapes = [tuple(x.shape) for x in in_data]
     in_dtypes = [x.dtype for x in in_data]
     _, out_shapes, _ = prop.infer_shape(in_shapes)
+    _, out_dtypes, _ = prop.infer_type(in_dtypes)
     op = prop.create_operator(None, in_shapes, in_dtypes)
 
     n_out = len(prop.list_outputs())
-    out_data: List[Optional[NDArray]] = [None] * n_out
+    # preallocate outputs so the reference's assign(out_data[0], ...)
+    # convention works; the list convention may replace entries
+    out_data: List[Optional[NDArray]] = [
+        ndops.zeros(tuple(s), dtype=_np.dtype(dt).name)
+        for s, dt in zip(out_shapes, out_dtypes)]
     req = ["write"] * n_out
 
     recording = is_recording() and any(x._on_tape for x in in_data)
@@ -143,6 +162,11 @@ def _invoke_custom(op_type: str, inputs: Sequence[NDArray],
         if o is None:
             raise MXNetError(f"custom op {op_type!r} did not assign "
                              f"output {i}")
+        if tuple(o.shape) != tuple(out_shapes[i]):
+            raise MXNetError(
+                f"custom op {op_type!r} output {i} has shape "
+                f"{tuple(o.shape)} but infer_shape declared "
+                f"{tuple(out_shapes[i])}")
 
     if not recording:
         return out_data[0] if n_out == 1 else tuple(out_data)
@@ -155,7 +179,11 @@ def _invoke_custom(op_type: str, inputs: Sequence[NDArray],
 
     def vjp_fn(out_cot):
         ograd = from_jax(out_cot)
-        in_grad: List[Optional[NDArray]] = [None] * n_args
+        # preallocated zero grads: both assign conventions work, and an
+        # unassigned slot correctly means zero gradient
+        in_grad: List[Optional[NDArray]] = [
+            ndops.zeros(tuple(x.shape), dtype=_np.dtype(x.dtype).name)
+            for x in in_data]
         op.backward(["write"] * n_args, [ograd], in_data, out_data,
                     in_grad, aux)
         cots = []

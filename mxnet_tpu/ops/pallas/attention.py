@@ -126,8 +126,9 @@ def _dense_reference(q, k, v, scale: float, causal: bool):
     s = jnp.einsum("bhqd,bhkd->bhqk", q.astype(jnp.float32),
                    k.astype(jnp.float32)) * scale
     if causal:
+        # top-left alignment (col <= row), matching the kernel's mask
         Tq, Tk = s.shape[-2], s.shape[-1]
-        mask = jnp.tril(jnp.ones((Tq, Tk), bool), Tk - Tq)
+        mask = jnp.tril(jnp.ones((Tq, Tk), bool))
         s = jnp.where(mask, s, _NEG_INF)
     p = jax.nn.softmax(s, axis=-1)
     return jnp.einsum("bhqk,bhkd->bhqd", p,

@@ -73,13 +73,36 @@ def dot_product_attention(query, key, value, mask=None,
         if m:
             bias = _mask_to_bias(m[0], q.dtype, q.shape[0], q.shape[1],
                                  k.shape[1])
-        if bias is None and _use_pallas(q):
-            from .pallas.attention import flash_attention
-            return flash_attention(q, k, v, scale=sc, causal=cz)
+        if bias is None:
+            ring = _use_ring(q, k)
+            if ring is not None:
+                from ..parallel.ring import ring_attention
+                mesh, axis = ring
+                return ring_attention(q, k, v, mesh, axis=axis,
+                                      scale=sc, causal=cz)
+            if _use_pallas(q):
+                from .pallas.attention import flash_attention
+                return flash_attention(q, k, v, scale=sc, causal=cz)
         return jax.nn.dot_product_attention(
             q, k, v, bias=bias, scale=sc, is_causal=cz)
 
     return invoke("dot_product_attention", impl, inputs)
+
+
+def _use_ring(q, k):
+    """Sequence-parallel policy: a sequence_parallel context is active and
+    the sequence divides over the axis → (mesh, axis), else None."""
+    from ..parallel.ring import current_sequence_parallel
+    sp = current_sequence_parallel()
+    if sp is None:
+        return None
+    mesh, axis = sp
+    if axis not in mesh.axis_names:
+        return None
+    n = mesh.shape[axis]
+    if n <= 1 or q.shape[1] % n or k.shape[1] % n:
+        return None
+    return mesh, axis
 
 
 def _use_pallas(q) -> bool:
@@ -114,7 +137,13 @@ def multi_head_attention(query, key, value, num_heads: int, mask=None,
         bias = None
         if m:
             bias = _mask_to_bias(m[0], q.dtype, B, Tq, Tk)
-        if bias is None and _use_pallas(qh):
+        ring = None if bias is not None else _use_ring(qh, kh)
+        if ring is not None:
+            from ..parallel.ring import ring_attention
+            mesh, axis = ring
+            out = ring_attention(qh, kh, vh, mesh, axis=axis,
+                                 scale=sc, causal=cz)
+        elif bias is None and _use_pallas(qh):
             from .pallas.attention import flash_attention
             out = flash_attention(qh, kh, vh, scale=sc, causal=cz)
         else:

@@ -10,7 +10,8 @@ new capability here, exposed as sharding rules (SURVEY.md 5.7/5.8).
 from .mesh import make_mesh, mesh_axes, replicated, shard_batch
 from .spmd import (PartitionRules, SPMDTrainer, DEFAULT_TRANSFORMER_RULES,
                    DATA_PARALLEL_RULES)
+from .ring import ring_attention, local_ring_attention
 
 __all__ = ["make_mesh", "mesh_axes", "replicated", "shard_batch",
            "PartitionRules", "SPMDTrainer", "DEFAULT_TRANSFORMER_RULES",
-           "DATA_PARALLEL_RULES"]
+           "DATA_PARALLEL_RULES", "ring_attention", "local_ring_attention"]

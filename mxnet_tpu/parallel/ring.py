@@ -1,0 +1,182 @@
+"""Ring attention — sequence-parallel attention over a mesh axis.
+
+NEW capability beyond the reference (SURVEY.md 5.7): leezu/mxnet's long-
+sequence story is bucketing + truncated BPTT; it has no sequence
+parallelism at all.  This module shards the sequence dimension across the
+``sp`` mesh axis and computes exact attention by rotating K/V blocks
+around the ring with ``jax.lax.ppermute`` (one neighbor hop per step —
+the collective rides ICI), combining partial results with the online-
+softmax rule so nothing O(T²) ever materializes per device.
+
+Math: per ring step each device holds one K/V block; scores for the local
+Q block are combined via the running (max, denominator, accumulator)
+triple — the same rule the Pallas flash kernel uses within a chip
+(ops/pallas/attention.py), applied here across chips.  Backward is plain
+reverse-mode through the ``lax.scan`` (ppermute transposes to the reverse
+rotation automatically); ``jax.checkpoint`` on the per-step body keeps
+residual memory at one K/V block per step.
+"""
+from __future__ import annotations
+
+import functools
+import math
+from typing import Optional
+
+import jax
+import jax.numpy as jnp
+from jax.sharding import PartitionSpec as P
+
+__all__ = ["ring_attention", "local_ring_attention", "sequence_parallel",
+           "current_sequence_parallel"]
+
+_NEG_INF = -1e30
+
+# Active sequence-parallel context: attention ops consult this to route
+# through ring attention (set by SPMDTrainer or the user context manager).
+_sp_state = {"mesh": None, "axis": None}
+
+
+class sequence_parallel:
+    """Context manager: route attention ops through ring attention over
+    ``axis`` of ``mesh`` while active.  SPMDTrainer enters this
+    automatically when its mesh has an ``sp`` axis."""
+
+    def __init__(self, mesh: "jax.sharding.Mesh", axis: str = "sp") -> None:
+        self.mesh, self.axis = mesh, axis
+        self._prev = None
+
+    def __enter__(self) -> "sequence_parallel":
+        self._prev = dict(_sp_state)
+        _sp_state["mesh"], _sp_state["axis"] = self.mesh, self.axis
+        return self
+
+    def __exit__(self, *exc) -> None:
+        _sp_state.update(self._prev)
+
+
+def current_sequence_parallel():
+    """(mesh, axis) if a sequence-parallel context is active, else None."""
+    if _sp_state["mesh"] is None:
+        return None
+    return _sp_state["mesh"], _sp_state["axis"]
+
+
+def _block_update(q, k, v, m, l, acc, scale, row0, col0, causal, kv_len):
+    """Online-softmax update of (m, l, acc) with one K/V block.
+
+    q: (B, Tq, H, D); k/v: (B, Tk, H, D); m/l: (B, Tq, H, 1);
+    acc: (B, Tq, H, D). row0/col0 are the global offsets of the local Q
+    block and the current K/V block; kv_len masks ragged padding.
+    """
+    s = jnp.einsum("bqhd,bkhd->bqhk", q.astype(jnp.float32),
+                   k.astype(jnp.float32)) * scale
+    col = col0 + jax.lax.broadcasted_iota(jnp.int32, s.shape, 3)
+    mask = col < kv_len
+    if causal:
+        row = row0 + jax.lax.broadcasted_iota(jnp.int32, s.shape, 1)
+        mask = jnp.logical_and(mask, col <= row)
+    s = jnp.where(mask, s, _NEG_INF)
+
+    m_cur = jnp.max(s, axis=3, keepdims=True)          # (B, Tq, H, 1)
+    m_new = jnp.maximum(m, m_cur)
+    alpha = jnp.exp(m - m_new)
+    p = jnp.exp(s - m_new)
+    l_new = alpha * l + jnp.sum(p, axis=3, keepdims=True)
+    acc_new = acc * alpha + jnp.einsum(
+        "bqhk,bkhd->bqhd", p, v.astype(jnp.float32))
+    return m_new, l_new, acc_new
+
+
+def local_ring_attention(q, k, v, axis_name: str, n_shards: int,
+                         scale: Optional[float] = None,
+                         causal: bool = False, kv_len: Optional[int] = None):
+    """Per-device body: exact attention with K/V rotating around the ring.
+
+    Call inside ``shard_map`` with the sequence axis sharded over
+    ``axis_name``. q/k/v: (B, T_local, H, D) — this device's sequence
+    shard. Returns (B, T_local, H, D).
+    """
+    if scale is None:
+        scale = 1.0 / math.sqrt(q.shape[-1])
+    B, Tl, H, D = q.shape
+    Tk = k.shape[1]
+    my = jax.lax.axis_index(axis_name)
+    if kv_len is None:
+        kv_len = n_shards * Tk
+    row0 = my * Tl
+
+    m0 = jnp.full((B, Tl, H, 1), _NEG_INF, jnp.float32)
+    l0 = jnp.zeros((B, Tl, H, 1), jnp.float32)
+    acc0 = jnp.zeros((B, Tl, H, D), jnp.float32)
+    perm = [(i, (i + 1) % n_shards) for i in range(n_shards)]
+
+    @jax.checkpoint
+    def body(carry, step):
+        k_blk, v_blk, m, l, acc = carry
+        src = (my - step) % n_shards          # origin of the held block
+        col0 = src * Tk
+        m, l, acc = _block_update(q, k_blk, v_blk, m, l, acc, scale,
+                                  row0, col0, causal, kv_len)
+        # rotate: send our block to the next device, receive from previous
+        k_blk = jax.lax.ppermute(k_blk, axis_name, perm)
+        v_blk = jax.lax.ppermute(v_blk, axis_name, perm)
+        return (k_blk, v_blk, m, l, acc), None
+
+    (_, _, m, l, acc), _ = jax.lax.scan(
+        body, (k, v, m0, l0, acc0), jnp.arange(n_shards))
+    out = acc / jnp.maximum(l, 1e-30)
+    return out.astype(q.dtype)
+
+
+def ring_attention(q, k, v, mesh: "jax.sharding.Mesh", axis: str = "sp",
+                   scale: Optional[float] = None, causal: bool = False):
+    """Sequence-parallel exact attention over mesh axis ``axis``.
+
+    q/k/v: (B, T, H, D) logically global; T must divide by the axis size.
+    The call shard_maps over the mesh: batch replicated over the axis,
+    sequence sharded; inside, K/V blocks ride the ring via ppermute.
+    Differentiable; composable with jit and other mesh axes (other axes
+    see this function as purely local compute).
+    """
+    if axis not in mesh.axis_names:
+        return _dense(q, k, v, scale, causal)
+    n = mesh.shape[axis]
+    if n == 1 or q.shape[1] % n != 0 or k.shape[1] % n != 0:
+        return _dense(q, k, v, scale, causal)
+
+    # carry the surrounding dp/tp layout through the shard_map so GSPMD
+    # does not insert gathers around it (SPMDTrainer shards batch over dp
+    # and heads over tp)
+    def _axis_if(name, dim_size):
+        return name if (name in mesh.axis_names and name != axis
+                        and dim_size % mesh.shape[name] == 0) else None
+
+    bax = _axis_if("dp", q.shape[0])
+    hax = _axis_if("tp", q.shape[2])
+    spec = P(bax, axis, hax, None)
+    fn = functools.partial(local_ring_attention, axis_name=axis, n_shards=n,
+                           scale=scale, causal=causal)
+    try:
+        from jax import shard_map
+        kw = {"check_vma": False}
+    except ImportError:     # jax < 0.8
+        from jax.experimental.shard_map import shard_map
+        kw = {"check_rep": False}
+    return shard_map(fn, mesh=mesh, in_specs=(spec, spec, spec),
+                     out_specs=spec, **kw)(q, k, v)
+
+
+def _dense(q, k, v, scale, causal):
+    if scale is None:
+        scale = 1.0 / math.sqrt(q.shape[-1])
+    s = jnp.einsum("bqhd,bkhd->bqhk", q.astype(jnp.float32),
+                   k.astype(jnp.float32)) * scale
+    if causal:
+        # top-left alignment (col <= row), matching the ring path and
+        # jax.nn.dot_product_attention(is_causal=True)
+        Tq, Tk = s.shape[1], s.shape[3]
+        mask = jnp.tril(jnp.ones((Tq, Tk), bool))[None, :, None, :]
+        s = jnp.where(mask, s, _NEG_INF)
+    p = jax.nn.softmax(s, axis=3)
+    return jnp.einsum("bqhk,bkhd->bqhd", p,
+                      v.astype(jnp.float32)).astype(q.dtype)

@@ -157,6 +157,7 @@ class SPMDTrainer:
     # ------------------------------------------------------------------
     def _build_step(self, n_inputs: int) -> Callable:
         block, loss_fn = self.block, self.loss_fn
+        mesh = self.mesh
         params = self._params
         optimizer = self.optimizer
         hp = [optimizer._hyper(i) for i in range(len(params))]
@@ -166,7 +167,13 @@ class SPMDTrainer:
             inputs, labels = list(batch[:-1]), batch[-1]
 
             def forward(pa):
-                with _bind_params(params, pa), _random.trace_key_scope(rng):
+                from .ring import sequence_parallel
+                import contextlib
+                sp_ctx = (sequence_parallel(mesh, "sp")
+                          if "sp" in mesh.axis_names
+                          else contextlib.nullcontext())
+                with _bind_params(params, pa), _random.trace_key_scope(rng), \
+                        sp_ctx:
                     from .._tape import set_training
                     prev = set_training(True)
                     try:
