@@ -18,6 +18,7 @@ from typing import Any, Callable, List, Optional, Sequence, Tuple
 
 import jax
 import jax.numpy as jnp
+import numpy as _onp
 
 __all__ = [
     "TapeNode", "is_recording", "is_training", "set_recording",
@@ -208,12 +209,27 @@ def backward_arrays(heads: Sequence[Any],
             c = cots.get(id(arr)) if arr is not None else None
             if isinstance(c, RowSparseCot):
                 c = c.dense()   # only leaf grads stay sparse
+            _is_int_out = jnp.issubdtype(_onp.dtype(dtype), jnp.integer) or \
+                _onp.dtype(dtype) == jnp.bool_
             if c is None:
-                c = jnp.zeros(shape, dtype=dtype)
+                # integer/bool outputs take float0 cotangents (jax.vjp
+                # contract for non-differentiable dtypes)
+                c = _onp.zeros(shape, jax.dtypes.float0) if _is_int_out \
+                    else jnp.zeros(shape, dtype=dtype)
+            elif c.dtype == jax.dtypes.float0 or _is_int_out:
+                # zero-tangent for an int-valued output (e.g. argmax feeding
+                # one_hot): pass through as float0, never cast
+                c = _onp.zeros(shape, jax.dtypes.float0)
             elif c.dtype != dtype:
                 # cotangents accumulated in a wider dtype (e.g. amp widest-
                 # cast) must match the recorded output aval for jax.vjp
-                c = c.astype(dtype)
+                try:
+                    c = c.astype(dtype)
+                except (TypeError, ValueError) as e:
+                    raise MXNetError(
+                        f"backward of op {node.name!r}: cannot cast "
+                        f"cotangent dtype {c.dtype} to recorded output "
+                        f"dtype {dtype!r}: {e}") from e
             out_cots.append(c)
         payload = tuple(out_cots) if node.out_is_tuple else out_cots[0]
         in_cots = node.vjp_fn(payload)

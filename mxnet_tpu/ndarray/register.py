@@ -36,8 +36,41 @@ _amp_state = {"active": False}
 
 # flipped by mxnet_tpu.profiler.set_state(); same hot-path pattern
 _profiler_state = {"on": False}
+
+
 # id -> hook fn; multiple Monitors may collect concurrently
 _monitor_state = {"hooks": {}}
+
+# flipped by SPMDTrainer once any parameter is placed on a multi-device
+# mesh; single-device programs never pay the per-op sharding scan
+_mesh_state = {"active": False}
+
+
+def _harmonize_mesh_placement(arrays):
+    """Eager ops mixing mesh-sharded operands (e.g. parameters placed by
+    SPMDTrainer) with fresh single-device arrays: replicate the latter
+    onto the same mesh so XLA can dispatch one program.  The mesh is one
+    logical device in this framework's model (the reference instead
+    *errors* on cross-context ops; here the mesh placement is an
+    implementation detail the user never chose)."""
+    mesh = None
+    for a in arrays:
+        if isinstance(a, jax.Array) and not isinstance(a, jax.core.Tracer):
+            sh = a.sharding
+            if getattr(sh, "mesh", None) is not None \
+                    and sh.num_devices > 1:
+                mesh = sh.mesh
+                break
+    if mesh is None:
+        return arrays
+    out = []
+    rep = jax.sharding.NamedSharding(mesh, jax.sharding.PartitionSpec())
+    for a in arrays:
+        if isinstance(a, jax.Array) and not isinstance(a, jax.core.Tracer) \
+                and a.sharding.num_devices == 1:
+            a = jax.device_put(a, rep)
+        out.append(a)
+    return out
 
 
 def _fire_monitor_hooks(name, outputs) -> None:
@@ -82,6 +115,8 @@ def invoke_with_custom_vjp(name: str, impl: Callable,
     row-sparse embedding grad). ``vjp_fn(out_cot) -> per-input cotangents``
     (None entries are skipped). Single-output ops only."""
     arrays = [x._data for x in inputs]
+    if _mesh_state["active"]:
+        arrays = _harmonize_mesh_placement(arrays)
 
     timer = None
     if _profiler_state["on"]:
@@ -117,6 +152,8 @@ def invoke(name: str, impl: Callable, inputs: Sequence[Any],
     into the closure) and returns one array or a tuple of arrays.
     """
     arrays = [x._data for x in inputs]
+    if _mesh_state["active"]:
+        arrays = _harmonize_mesh_placement(arrays)
 
     if _amp_state["active"]:
         from ..amp import apply_cast_policy

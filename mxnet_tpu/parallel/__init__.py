@@ -11,7 +11,10 @@ from .mesh import make_mesh, mesh_axes, replicated, shard_batch
 from .spmd import (PartitionRules, SPMDTrainer, DEFAULT_TRANSFORMER_RULES,
                    DATA_PARALLEL_RULES)
 from .ring import ring_attention, local_ring_attention
+from .pipeline import pipeline_apply
+from .moe import MoEDense, MOE_RULES
 
 __all__ = ["make_mesh", "mesh_axes", "replicated", "shard_batch",
            "PartitionRules", "SPMDTrainer", "DEFAULT_TRANSFORMER_RULES",
-           "DATA_PARALLEL_RULES", "ring_attention", "local_ring_attention"]
+           "DATA_PARALLEL_RULES", "ring_attention", "local_ring_attention",
+           "pipeline_apply", "MoEDense", "MOE_RULES"]

@@ -1,0 +1,151 @@
+"""Mixture-of-experts with expert parallelism over the ``ep`` mesh axis.
+
+NEW capability beyond the reference (SURVEY.md 2.3 lists EP/MoE as
+ABSENT).  Design (tpu-first): experts are ONE set of stacked parameters
+(leading dim = num_experts) so a PartitionSpec ``P('ep', ...)`` shards
+them; token dispatch/combine are dense einsums against a capacity-bucketed
+one-hot mask (Shazeer/GShard style), which GSPMD turns into all-to-all
+over ICI when the expert dim is sharded — no manual collective calls.
+"""
+from __future__ import annotations
+
+import contextlib
+import math
+from typing import Any, Optional
+
+import jax
+
+from jax.sharding import PartitionSpec as P
+
+from ..base import MXNetError
+from ..gluon.block import HybridBlock
+from ..gluon.parameter import Parameter
+from ..ndarray import ops as ndops
+from ..ndarray.ndarray import NDArray
+from .spmd import PartitionRules
+
+__all__ = ["MoEDense", "MOE_RULES", "collect_aux_losses"]
+
+
+# Active aux-loss collector (trace-safe channel from MoE layers to the
+# trainer's objective; ``self.aux_loss`` would leak tracers under jit).
+_collector: Optional[list] = None
+
+
+@contextlib.contextmanager
+def collect_aux_losses():
+    """Collect MoE load-balancing losses raised during ``forward``.
+
+    SPMDTrainer wraps its traced loss computation in this context and adds
+    the collected terms to the objective inside the same trace. Yields the
+    list that forward() appends NDArray aux-loss terms to."""
+    global _collector
+    prev = _collector
+    _collector = []
+    try:
+        yield _collector
+    finally:
+        _collector = prev
+
+
+# Shard stacked expert weights over ep; everything else replicated.
+MOE_RULES = PartitionRules([
+    (r"expert_w1$", P("ep", None, None)),
+    (r"expert_b1$", P("ep", None)),
+    (r"expert_w2$", P("ep", None, None)),
+    (r"expert_b2$", P("ep", None)),
+])
+
+
+class MoEDense(HybridBlock):
+    """Top-1 routed mixture of expert FFNs (GShard-style).
+
+    Input (B, T, d) or (N, d); each token goes to its argmax expert,
+    bucketed to ``capacity_factor * N / num_experts`` slots per expert.
+    Overflow tokens produce ZERO output — wrap the layer in an external
+    residual connection (as Switch Transformer does) so they pass through.
+    The load-balancing auxiliary loss (fraction·probability dot product,
+    Switch-Transformer eq. 4) is stored on ``self.aux_loss`` after eager
+    forwards; under a traced step (SPMDTrainer) it is instead delivered
+    through ``collect_aux_losses`` and added to the objective.
+    """
+
+    def __init__(self, num_experts: int, hidden_size: int,
+                 units: Optional[int] = None, activation: str = "gelu",
+                 capacity_factor: float = 1.25, dtype: Any = "float32",
+                 **kwargs: Any) -> None:
+        super().__init__(**kwargs)
+        if num_experts < 1:
+            raise MXNetError("num_experts must be >= 1")
+        self._E = num_experts
+        self._H = hidden_size
+        self._units = units          # defaults to input dim (residual FFN)
+        self._act = activation
+        self._cf = capacity_factor
+        self.gate = Parameter("gate", shape=(num_experts, 0), dtype=dtype)
+        self.expert_w1 = Parameter("expert_w1",
+                                   shape=(num_experts, 0, hidden_size),
+                                   dtype=dtype)
+        self.expert_b1 = Parameter("expert_b1",
+                                   shape=(num_experts, hidden_size),
+                                   dtype=dtype, init="zeros")
+        self.expert_w2 = Parameter("expert_w2",
+                                   shape=(num_experts, hidden_size, 0),
+                                   dtype=dtype)
+        self.expert_b2 = Parameter("expert_b2", shape=(num_experts, 0),
+                                   dtype=dtype, init="zeros")
+        self.aux_loss: Optional[NDArray] = None
+
+    def _finish_init(self, d: int) -> None:
+        units = self._units or d
+        if not self.gate.is_initialized:
+            self.gate._finish_deferred_init((self._E, d))
+            self.expert_w1._finish_deferred_init((self._E, d, self._H))
+            self.expert_b1._finish_deferred_init((self._E, self._H))
+            self.expert_w2._finish_deferred_init((self._E, self._H, units))
+            self.expert_b2._finish_deferred_init((self._E, units))
+
+    def forward(self, x: NDArray) -> NDArray:
+        shape = x.shape
+        d = shape[-1]
+        self._finish_init(d)
+        flat = x.reshape((-1, d))                       # (N, d)
+        N = flat.shape[0]
+        E = self._E
+        C = max(1, int(math.ceil(self._cf * N / E)))
+
+        logits = ndops.dot(flat, self.gate.data().T)    # (N, E)
+        from ..ops import nn as npx
+        probs = npx.softmax(logits, axis=-1)
+        top_p = probs.max(axis=-1, keepdims=True)       # (N, 1)
+        top_e = ndops.argmax(logits, axis=-1)           # (N,)
+        e_hot = ndops.one_hot(top_e, E, dtype=x.dtype)  # (N, E)
+
+        # capacity bucketing: token's position within its expert queue
+        pos = ndops.cumsum(e_hot, axis=0) * e_hot - e_hot    # (N, E) 0-based
+        keep = (pos < float(C)).astype(x.dtype) * e_hot      # within capacity
+        pos_idx = (pos * keep).sum(axis=-1)                  # (N,)
+        c_hot = ndops.one_hot(pos_idx, C, dtype=x.dtype)     # (N, C)
+        dispatch = ndops.einsum("ne,nc->nec", keep, c_hot)   # (N, E, C)
+
+        # aux load-balance loss: E * sum_e fraction_e * mean-prob_e
+        frac = e_hot.mean(axis=0)                            # (E,)
+        mean_p = probs.mean(axis=0)
+        aux = (frac * mean_p).sum() * float(E)
+        if _collector is not None:
+            _collector.append(aux)
+        if not isinstance(aux._data, jax.core.Tracer):
+            self.aux_loss = aux
+
+        # dispatch -> expert FFN (stacked weights) -> combine
+        xe = ndops.einsum("nec,nd->ecd", dispatch, flat)     # (E, C, d)
+        h = ndops.einsum("ecd,edh->ech", xe, self.expert_w1.data())
+        h = h + self.expert_b1.data().reshape((E, 1, self._H))
+        h = npx.gelu(h) if self._act == "gelu" else npx.relu(h)
+        ye = ndops.einsum("ech,ehu->ecu", h, self.expert_w2.data())
+        ye = ye + self.expert_b2.data().reshape((E, 1, -1))
+        combine = dispatch * top_p.reshape((N, 1, 1))        # weighted
+        out = ndops.einsum("nec,ecu->nu", combine, ye)       # (N, units)
+
+        units = out.shape[-1]
+        return out.reshape(tuple(shape[:-1]) + (units,))

@@ -1,0 +1,113 @@
+"""Pipeline parallelism — GPipe-style microbatch schedule over a mesh axis.
+
+NEW capability beyond the reference (SURVEY.md 2.3): leezu/mxnet's closest
+analog is manual ``ctx_group`` model parallelism with cross-device copy
+nodes; it has no pipeline schedule.  Here stage parameters are stacked on a
+leading axis sharded over ``pp``; microbatches flow stage-to-stage via
+``ppermute`` inside a ``lax.scan`` (the scaling-book pipelining recipe),
+so each hop is one ICI neighbor transfer and XLA overlaps compute with the
+collective.
+
+Schedule: ``num_microbatches + num_stages - 1`` ticks (the GPipe bubble);
+differentiable end to end — reverse-mode runs the reverse schedule
+automatically through the scan/ppermute transpose.
+"""
+from __future__ import annotations
+
+import functools
+from typing import Any, Callable, Optional
+
+import jax
+import jax.numpy as jnp
+from jax.sharding import PartitionSpec as P
+
+__all__ = ["pipeline_apply"]
+
+
+def _shard_map(fn, mesh, in_specs, out_specs):
+    try:
+        from jax import shard_map
+        kw = {"check_vma": False}
+    except ImportError:     # jax < 0.8
+        from jax.experimental.shard_map import shard_map
+        kw = {"check_rep": False}
+    return shard_map(fn, mesh=mesh, in_specs=in_specs, out_specs=out_specs,
+                     **kw)
+
+
+def pipeline_apply(stage_fn: Callable, stage_params: Any, x: "jax.Array",
+                   mesh: "jax.sharding.Mesh", axis: str = "pp",
+                   num_microbatches: Optional[int] = None) -> "jax.Array":
+    """Apply ``num_stages`` chained stages to ``x`` with a GPipe schedule.
+
+    stage_fn(params_i, h) -> h' — one stage's computation; the activation
+    shape must be the same for every stage (classic pipeline constraint).
+    stage_params: pytree whose leaves have leading dim ``num_stages``
+    (stage i's slice feeds stage i), sharded over mesh axis ``axis``.
+    x: (B, ...) global batch; split into microbatches along dim 0.
+
+    Returns stage_{N-1}(...stage_0(x)) with shape x.shape.
+    """
+    if axis not in mesh.axis_names:
+        # degenerate: run stages sequentially on one device
+        n = jax.tree_util.tree_leaves(stage_params)[0].shape[0]
+        h = x
+        for i in range(n):
+            h = stage_fn(jax.tree_util.tree_map(lambda a: a[i],
+                                                stage_params), h)
+        return h
+
+    n_stages = mesh.shape[axis]
+    for leaf in jax.tree_util.tree_leaves(stage_params):
+        if leaf.shape[0] != n_stages:
+            raise ValueError(
+                f"stage_params leading dim {leaf.shape[0]} must equal mesh "
+                f"axis '{axis}' size {n_stages} (one stage per device)")
+    n_micro = num_microbatches or n_stages
+    B = x.shape[0]
+    if B % n_micro:
+        raise ValueError(f"batch {B} not divisible into {n_micro} "
+                         f"microbatches")
+    mb = B // n_micro
+    x_mb = x.reshape((n_micro, mb) + x.shape[1:])
+
+    perm = [(i, (i + 1) % n_stages) for i in range(n_stages)]
+
+    def local(params, x_mb):
+        # params leaves: (1, ...) own stage slice; x_mb: (n_micro, mb, ...)
+        params = jax.tree_util.tree_map(lambda a: a[0], params)
+        stage = jax.lax.axis_index(axis)
+        state0 = jnp.zeros_like(x_mb[0])
+        out_buf0 = jnp.zeros_like(x_mb)
+
+        @jax.checkpoint
+        def tick(carry, t):
+            state, out_buf = carry
+            # stage 0 ingests microbatch t (clamped; masked by `where`)
+            inp = x_mb[jnp.clip(t, 0, n_micro - 1)]
+            feed = jnp.logical_and(stage == 0, t < n_micro)
+            h = jnp.where(feed, inp, state)
+            h = stage_fn(params, h)
+            # last stage banks finished microbatch t-(n_stages-1)
+            done_idx = jnp.clip(t - (n_stages - 1), 0, n_micro - 1)
+            bank = jnp.logical_and(stage == n_stages - 1,
+                                   t >= n_stages - 1)
+            out_buf = jnp.where(
+                bank,
+                jax.lax.dynamic_update_index_in_dim(out_buf, h, done_idx, 0),
+                out_buf)
+            # hand activations to the next stage
+            state = jax.lax.ppermute(h, axis, perm)
+            return (state, out_buf), None
+
+        (_, out_buf), _ = jax.lax.scan(
+            tick, (state0, out_buf0), jnp.arange(n_micro + n_stages - 1))
+        return out_buf[None]
+
+    pspec = jax.tree_util.tree_map(lambda _: P(axis), stage_params)
+    out = _shard_map(local, mesh,
+                     in_specs=(pspec, P()), out_specs=P(axis))(
+        stage_params, x_mb)
+    # the bank is only populated on the last stage; its slice is the result
+    out = out[-1]
+    return out.reshape((B,) + x.shape[1:])

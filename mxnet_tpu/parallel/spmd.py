@@ -141,6 +141,11 @@ class SPMDTrainer:
             sh = jax.sharding.NamedSharding(mesh, spec)
             p._data._data = jax.device_put(p.data()._data, sh)
             self._param_shardings.append(sh)
+        if mesh.size > 1:
+            # eager ops may now mix mesh-placed params with fresh
+            # single-device arrays; enable the dispatch-path fixup
+            from ..ndarray import register as _register
+            _register._mesh_state["active"] = True
 
         # optimizer states co-sharded with their parameter
         self._opt_states = []
@@ -168,12 +173,13 @@ class SPMDTrainer:
 
             def forward(pa):
                 from .ring import sequence_parallel
+                from .moe import collect_aux_losses
                 import contextlib
                 sp_ctx = (sequence_parallel(mesh, "sp")
                           if "sp" in mesh.axis_names
                           else contextlib.nullcontext())
                 with _bind_params(params, pa), _random.trace_key_scope(rng), \
-                        sp_ctx:
+                        sp_ctx, collect_aux_losses() as aux_losses:
                     from .._tape import set_training
                     prev = set_training(True)
                     try:
@@ -187,7 +193,11 @@ class SPMDTrainer:
                     # loss is already MEAN-reduced here, so grads need no
                     # 1/batch rescale (unlike the Trainer path, which
                     # rescales summed per-sample grads)
-                    return loss.mean()._data
+                    total = loss.mean()._data
+                    # MoE load-balancing terms raised during forward
+                    for a in aux_losses:
+                        total = total + a._data
+                    return total
 
             loss, grads = jax.value_and_grad(forward)(list(param_arrays))
             new_params, new_states = [], []

@@ -1,0 +1,232 @@
+"""Pipeline + expert parallelism tests (NEW capability vs the reference —
+SURVEY.md 2.3 lists PP and EP as ABSENT)."""
+import jax
+import jax.numpy as jnp
+import numpy as onp
+import pytest
+
+import mxnet_tpu as mx
+from mxnet_tpu.parallel import (MoEDense, MOE_RULES, SPMDTrainer,
+                                DATA_PARALLEL_RULES, make_mesh,
+                                pipeline_apply)
+
+
+def _stage(params, h):
+    W, b = params
+    return jnp.tanh(h @ W + b)
+
+
+def _stacked(n_stages=4, d=16, seed=0):
+    rng = onp.random.RandomState(seed)
+    W = jnp.asarray(rng.uniform(-0.3, 0.3, (n_stages, d, d))
+                    .astype(onp.float32))
+    b = jnp.asarray(rng.uniform(-0.1, 0.1, (n_stages, d))
+                    .astype(onp.float32))
+    return W, b
+
+
+def _seq_ref(W, b, x):
+    h = x
+    for i in range(W.shape[0]):
+        h = jnp.tanh(h @ W[i] + b[i])
+    return h
+
+
+def test_pipeline_matches_sequential():
+    mesh = make_mesh({"pp": 4}, devices=jax.devices()[:4])
+    W, b = _stacked()
+    x = jnp.asarray(onp.random.RandomState(1)
+                    .uniform(-1, 1, (8, 16)).astype(onp.float32))
+    out = pipeline_apply(_stage, (W, b), x, mesh, axis="pp")
+    onp.testing.assert_allclose(onp.asarray(out),
+                                onp.asarray(_seq_ref(W, b, x)),
+                                rtol=1e-5, atol=1e-6)
+
+
+def test_pipeline_more_microbatches():
+    mesh = make_mesh({"pp": 2}, devices=jax.devices()[:2])
+    W, b = _stacked(n_stages=2)
+    x = jnp.asarray(onp.random.RandomState(2)
+                    .uniform(-1, 1, (12, 16)).astype(onp.float32))
+    out = pipeline_apply(_stage, (W, b), x, mesh, axis="pp",
+                         num_microbatches=6)
+    onp.testing.assert_allclose(onp.asarray(out),
+                                onp.asarray(_seq_ref(W, b, x)),
+                                rtol=1e-5, atol=1e-6)
+
+
+def test_pipeline_gradients_match():
+    mesh = make_mesh({"pp": 4}, devices=jax.devices()[:4])
+    W, b = _stacked()
+    x = jnp.asarray(onp.random.RandomState(3)
+                    .uniform(-1, 1, (8, 16)).astype(onp.float32))
+
+    g_pp = jax.grad(lambda W, b: (pipeline_apply(
+        _stage, (W, b), x, mesh) ** 2).sum(), argnums=(0, 1))(W, b)
+    g_seq = jax.grad(lambda W, b: (_seq_ref(W, b, x) ** 2).sum(),
+                     argnums=(0, 1))(W, b)
+    for a, c in zip(g_pp, g_seq):
+        onp.testing.assert_allclose(onp.asarray(a), onp.asarray(c),
+                                    rtol=1e-4, atol=1e-5)
+
+
+def test_pipeline_stage_count_mismatch_raises():
+    mesh = make_mesh({"pp": 4}, devices=jax.devices()[:4])
+    W, b = _stacked(n_stages=8)
+    x = jnp.zeros((8, 16), dtype=jnp.float32)
+    with pytest.raises(ValueError, match="leading dim"):
+        pipeline_apply(_stage, (W, b), x, mesh, axis="pp")
+
+
+def test_pipeline_no_axis_falls_back():
+    mesh = make_mesh({"dp": 8})
+    W, b = _stacked()
+    x = jnp.asarray(onp.random.RandomState(4)
+                    .uniform(-1, 1, (4, 16)).astype(onp.float32))
+    out = pipeline_apply(_stage, (W, b), x, mesh, axis="pp")
+    onp.testing.assert_allclose(onp.asarray(out),
+                                onp.asarray(_seq_ref(W, b, x)),
+                                rtol=1e-5, atol=1e-6)
+
+
+def test_pipeline_under_jit_in_hlo():
+    """Compiled pipeline must contain collective-permutes (the stage
+    handoffs)."""
+    mesh = make_mesh({"pp": 4}, devices=jax.devices()[:4])
+    W, b = _stacked()
+    x = jnp.asarray(onp.random.RandomState(5)
+                    .uniform(-1, 1, (8, 16)).astype(onp.float32))
+    f = jax.jit(lambda W, b, x: pipeline_apply(_stage, (W, b), x, mesh))
+    hlo = f.lower(W, b, x).compile().as_text()
+    assert "collective-permute" in hlo
+    onp.testing.assert_allclose(onp.asarray(f(W, b, x)),
+                                onp.asarray(_seq_ref(W, b, x)),
+                                rtol=1e-5, atol=1e-6)
+
+
+# ---------------------------------------------------------------------------
+# MoE / expert parallelism
+# ---------------------------------------------------------------------------
+
+def test_moe_routes_to_argmax_expert():
+    """With ample capacity, each token's output equals its top-1 expert's
+    FFN output scaled by the gate probability."""
+    mx.random.seed(0)
+    moe = MoEDense(num_experts=4, hidden_size=8, capacity_factor=8.0)
+    moe.initialize()
+    x = mx.np.array(onp.random.RandomState(1)
+                    .uniform(-1, 1, (16, 8)).astype("float32"))
+    out = moe(x).asnumpy()
+
+    gate = moe.gate.data().asnumpy()
+    w1 = moe.expert_w1.data().asnumpy()
+    b1 = moe.expert_b1.data().asnumpy()
+    w2 = moe.expert_w2.data().asnumpy()
+    b2 = moe.expert_b2.data().asnumpy()
+    xn = x.asnumpy()
+    logits = xn @ gate.T
+    probs = onp.exp(logits - logits.max(-1, keepdims=True))
+    probs /= probs.sum(-1, keepdims=True)
+    ref = onp.zeros_like(out)
+    from scipy.special import erf
+    gelu = lambda v: 0.5 * v * (1 + erf(v / onp.sqrt(2)))
+    for n in range(xn.shape[0]):
+        e = logits[n].argmax()
+        h = gelu(xn[n] @ w1[e] + b1[e])
+        ref[n] = (h @ w2[e] + b2[e]) * probs[n].max()
+    onp.testing.assert_allclose(out, ref, rtol=1e-4, atol=1e-5)
+
+
+def test_moe_capacity_overflow_drops_tokens():
+    mx.random.seed(0)
+    moe = MoEDense(num_experts=2, hidden_size=4, capacity_factor=0.25)
+    moe.initialize()
+    x = mx.np.array(onp.ones((8, 4), dtype="float32"))
+    out = moe(x).asnumpy()
+    # identical tokens all route to one expert; capacity 1 → 1 kept
+    nonzero_rows = (onp.abs(out) > 1e-9).any(axis=1).sum()
+    assert nonzero_rows == 1, nonzero_rows
+
+
+def test_moe_trains_and_aux_loss():
+    mx.random.seed(2)
+    net = mx.gluon.nn.Sequential()
+    moe = MoEDense(num_experts=4, hidden_size=16, capacity_factor=2.0)
+    net.add(mx.gluon.nn.Dense(8), moe, mx.gluon.nn.Dense(2))
+    net.initialize()
+    rng = onp.random.RandomState(5)
+    X = mx.np.array(rng.uniform(-1, 1, (32, 4)).astype("float32"))
+    Y = mx.np.array((rng.uniform(size=32) > 0.5).astype("int32"))
+    trainer = mx.gluon.Trainer(net.collect_params(), "adam",
+                               {"learning_rate": 5e-3})
+    loss_fn = mx.gluon.loss.SoftmaxCrossEntropyLoss()
+    losses = []
+    for _ in range(25):
+        with mx.autograd.record():
+            out = net(X)
+            loss = loss_fn(out, Y).mean() + 0.01 * moe.aux_loss
+        loss.backward()
+        trainer.step(32)
+        losses.append(float(loss.asnumpy()))
+    assert losses[-1] < losses[0], losses
+    assert onp.isfinite(losses).all()
+
+
+def test_moe_aux_loss_in_spmd_objective():
+    """Under the traced SPMD step the aux loss reaches the objective via
+    collect_aux_losses (self.aux_loss would leak tracers), and the step
+    must not leave a tracer on the block."""
+    mx.random.seed(11)
+    moe = MoEDense(num_experts=4, hidden_size=16, capacity_factor=4.0)
+    moe.initialize()
+    moe(mx.np.zeros((4, 8)))
+    rng = onp.random.RandomState(13)
+    X = rng.uniform(-1, 1, (16, 8)).astype("float32")
+    Y = rng.randint(0, 8, (16,)).astype("int32")
+    loss_fn = mx.gluon.loss.SoftmaxCrossEntropyLoss()
+
+    # eager reference with the same (pre-update) parameters
+    out = moe(mx.np.array(X))
+    expected = float((loss_fn(out, mx.np.array(Y)).mean()
+                      + moe.aux_loss).asnumpy())
+
+    mesh = make_mesh({"ep": 4}, devices=jax.devices()[:4])
+    tr = SPMDTrainer(moe, loss_fn, "sgd", {"learning_rate": 0.05},
+                     mesh=mesh, rules=MOE_RULES,
+                     data_spec=jax.sharding.PartitionSpec(),
+                     label_spec=jax.sharding.PartitionSpec())
+    loss = tr.step(mx.np.array(X), mx.np.array(Y))
+    assert abs(float(loss.asnumpy()) - expected) < 1e-4
+    # no leaked tracer: aux_loss still usable after the traced step
+    if moe.aux_loss is not None:
+        onp.asarray(moe.aux_loss.asnumpy())
+
+
+def test_moe_ep_sharded_matches_replicated():
+    """Expert-parallel sharded training must match replicated math."""
+    def build():
+        mx.random.seed(9)
+        moe = MoEDense(num_experts=4, hidden_size=16, capacity_factor=4.0)
+        moe.initialize()
+        moe(mx.np.zeros((4, 8)))
+        return moe
+
+    rng = onp.random.RandomState(7)
+    X = rng.uniform(-1, 1, (16, 8)).astype("float32")
+    Y = rng.randint(0, 8, (16,)).astype("int32")
+    loss_fn = mx.gluon.loss.SoftmaxCrossEntropyLoss()
+
+    outs = []
+    for rules, shape, nd in ((DATA_PARALLEL_RULES, {"dp": 1}, 1),
+                             (MOE_RULES, {"dp": 2, "ep": 4}, 8)):
+        moe = build()
+        mesh = make_mesh(shape, devices=jax.devices()[:nd])
+        tr = SPMDTrainer(moe, loss_fn, "sgd", {"learning_rate": 0.05},
+                         mesh=mesh, rules=rules)
+        for _ in range(2):
+            loss = tr.step(mx.np.array(X), mx.np.array(Y))
+        outs.append(float(loss.asnumpy()))
+        if "ep" in shape:
+            w1 = moe.expert_w1.data()._data
+            assert len(w1.devices()) == 8
+    assert abs(outs[0] - outs[1]) < 1e-4, outs
