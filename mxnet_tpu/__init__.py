@@ -58,6 +58,7 @@ _LAZY = {
     "mon": ".monitor",
     "symbol": ".symbol",
     "sym": ".symbol",
+    "contrib": ".contrib",
 }
 
 

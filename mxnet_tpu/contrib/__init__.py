@@ -1,0 +1,6 @@
+"""Contrib namespace — experimental / auxiliary subsystems.
+
+Reference parity (leezu/mxnet): ``python/mxnet/contrib/`` (quantization
+driver, onnx, tensorboard hooks, …).
+"""
+from . import quantization  # noqa: F401

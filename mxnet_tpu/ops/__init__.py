@@ -6,5 +6,7 @@ from .nn import *  # noqa: F401,F403
 from .nn import __all__ as _nn_all
 from .transformer import *  # noqa: F401,F403
 from .transformer import __all__ as _tr_all
+from .quantization import *  # noqa: F401,F403
+from .quantization import __all__ as _q_all
 
-__all__ = list(_nn_all) + list(_tr_all)
+__all__ = list(_nn_all) + list(_tr_all) + list(_q_all)

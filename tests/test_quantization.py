@@ -1,0 +1,189 @@
+"""INT8 quantization tests (reference: tests/python/quantization/
+test_quantization.py — op-level roundtrips + quantize_net accuracy)."""
+import numpy as onp
+import pytest
+
+import mxnet_tpu as mx
+from mxnet_tpu.ops import quantization as qop
+from mxnet_tpu.contrib.quantization import (quantize_net,
+                                            optimal_threshold_entropy,
+                                            QuantizedDense, QuantizedConv)
+
+
+def test_quantize_dequantize_roundtrip_int8():
+    x = mx.np.array(onp.random.RandomState(0)
+                    .uniform(-3, 3, (4, 16)).astype("float32"))
+    q, mn, mx_ = qop.quantize(x, x.min(), x.max(), out_type="int8")
+    assert q.dtype == onp.int8
+    back = qop.dequantize(q, mn, mx_)
+    onp.testing.assert_allclose(back.asnumpy(), x.asnumpy(),
+                                atol=3.0 / 127 + 1e-6)
+
+
+def test_quantize_dequantize_roundtrip_uint8():
+    x = mx.np.array(onp.random.RandomState(1)
+                    .uniform(0, 5, (8, 8)).astype("float32"))
+    q, mn, mx_ = qop.quantize(x, x.min(), x.max(), out_type="uint8")
+    assert q.dtype == onp.uint8
+    back = qop.dequantize(q, mn, mx_)
+    onp.testing.assert_allclose(back.asnumpy(), x.asnumpy(),
+                                atol=5.0 / 255 + 1e-6)
+
+
+def test_quantize_v2_calibrated_clips():
+    x = mx.np.array(onp.array([[-10.0, 0.5, 10.0]], dtype="float32"))
+    q, mn, mx_ = qop.quantize_v2(x, min_calib_range=-1.0,
+                                 max_calib_range=1.0)
+    back = qop.dequantize(q, mn, mx_).asnumpy()
+    onp.testing.assert_allclose(back, [[-1.0, 0.5, 1.0]], atol=1e-2)
+
+
+def test_quantized_fully_connected_matches_float():
+    rng = onp.random.RandomState(2)
+    x = rng.uniform(-1, 1, (8, 32)).astype("float32")
+    w = rng.uniform(-0.5, 0.5, (16, 32)).astype("float32")
+    b = rng.uniform(-0.2, 0.2, (16,)).astype("float32")
+
+    xq, xmn, xmx = qop.quantize_v2(mx.np.array(x))
+    wq, wmn, wmx = qop.quantize_v2(mx.np.array(w))
+    bq, bmn, bmx = qop.quantize_v2(mx.np.array(b))
+    y32, mn_o, mx_o = qop.quantized_fully_connected(
+        xq, wq, bq, xmn, xmx, wmn, wmx, bmn, bmx, num_hidden=16)
+    assert y32.dtype == onp.int32
+    y = qop.dequantize(y32, mn_o, mx_o).asnumpy()
+    ref = x @ w.T + b
+    # int8 with per-tensor scales: ~1% of the output range
+    assert onp.abs(y - ref).max() < 0.05 * onp.abs(ref).max() + 0.05
+
+
+def test_quantized_conv_matches_float():
+    rng = onp.random.RandomState(3)
+    x = rng.uniform(-1, 1, (2, 3, 8, 8)).astype("float32")
+    w = rng.uniform(-0.5, 0.5, (4, 3, 3, 3)).astype("float32")
+
+    xq, xmn, xmx = qop.quantize_v2(mx.np.array(x))
+    wq, wmn, wmx = qop.quantize_v2(mx.np.array(w))
+    y32, mn_o, mx_o = qop.quantized_conv(
+        xq, wq, None, xmn, xmx, wmn, wmx, kernel=(3, 3), pad=(1, 1),
+        num_filter=4, no_bias=True)
+    y = qop.dequantize(y32, mn_o, mx_o).asnumpy()
+
+    ref = mx.npx.convolution(mx.np.array(x), mx.np.array(w),
+                             kernel=(3, 3), pad=(1, 1),
+                             num_filter=4, no_bias=True).asnumpy()
+    assert onp.abs(y - ref).max() < 0.05 * onp.abs(ref).max() + 0.05
+
+
+def test_quantized_pooling_and_act():
+    rng = onp.random.RandomState(4)
+    x = rng.uniform(-2, 2, (1, 2, 4, 4)).astype("float32")
+    q, mn, mx_ = qop.quantize_v2(mx.np.array(x))
+    p, pmn, pmx = qop.quantized_pooling(q, mn, mx_, kernel=(2, 2),
+                                        stride=(2, 2), pool_type="max")
+    ref = mx.npx.pooling(mx.np.array(x), kernel=(2, 2), stride=(2, 2),
+                         pool_type="max")
+    back = qop.dequantize(p, pmn, pmx).asnumpy()
+    onp.testing.assert_allclose(back, ref.asnumpy(), atol=4.0 / 127 + 1e-6)
+
+    r, rmn, rmx = qop.quantized_act(q, mn, mx_)
+    assert (r.asnumpy() >= 0).all()
+    assert float(rmn.asnumpy()) >= 0.0
+
+
+def test_entropy_threshold_ignores_outlier():
+    """KL calibration should clip a lone outlier that min/max keeps."""
+    vals = onp.concatenate([onp.random.RandomState(5).normal(0, 1, 100000),
+                            [50.0]])
+    hist, edges = onp.histogram(onp.abs(vals), bins=2048, range=(0, 50.0))
+    t = optimal_threshold_entropy(hist, edges)
+    assert t < 25.0, t
+
+
+def _mlp():
+    net = mx.gluon.nn.HybridSequential()
+    net.add(mx.gluon.nn.Dense(32, activation="relu"),
+            mx.gluon.nn.Dense(10))
+    net.initialize()
+    return net
+
+
+@pytest.mark.parametrize("mode", ["naive", "entropy", "none"])
+def test_quantize_net_dense_close_to_float(mode):
+    mx.random.seed(0)
+    net = _mlp()
+    rng = onp.random.RandomState(6)
+    X = mx.np.array(rng.uniform(-1, 1, (16, 20)).astype("float32"))
+    ref = net(X).asnumpy()
+    calib = None if mode == "none" else [X]
+    qnet = quantize_net(net, calib_data=calib, calib_mode=mode)
+    out = qnet(X).asnumpy()
+    assert isinstance(qnet._children["0"], QuantizedDense)
+    denom = onp.abs(ref).max()
+    assert onp.abs(out - ref).max() < 0.1 * denom + 0.05, mode
+
+
+def test_quantize_net_conv_and_exclude():
+    mx.random.seed(1)
+    net = mx.gluon.nn.HybridSequential()
+    net.add(mx.gluon.nn.Conv2D(8, 3, padding=1, activation="relu"),
+            mx.gluon.nn.Conv2D(8, 3, padding=1))
+    net.initialize()
+    rng = onp.random.RandomState(7)
+    X = mx.np.array(rng.uniform(-1, 1, (2, 3, 8, 8)).astype("float32"))
+    ref = net(X).asnumpy()
+    qnet = quantize_net(net, calib_data=[X], calib_mode="naive",
+                        exclude_layers=["1"])
+    assert isinstance(qnet._children["0"], QuantizedConv)
+    assert not isinstance(qnet._children["1"], QuantizedConv)   # excluded
+    out = qnet(X).asnumpy()
+    assert onp.abs(out - ref).max() < 0.1 * onp.abs(ref).max() + 0.05
+
+
+def test_quantize_net_preserves_classification():
+    """End-to-end: train a tiny MLP, quantize, assert argmax agreement."""
+    mx.random.seed(2)
+    rng = onp.random.RandomState(8)
+    X = rng.uniform(-1, 1, (64, 16)).astype("float32")
+    Y = (X[:, :8].sum(1) > X[:, 8:].sum(1)).astype("int32")
+    net = mx.gluon.nn.HybridSequential()
+    net.add(mx.gluon.nn.Dense(32, activation="relu"), mx.gluon.nn.Dense(2))
+    net.initialize()
+    tr = mx.gluon.Trainer(net.collect_params(), "adam",
+                          {"learning_rate": 1e-2})
+    lf = mx.gluon.loss.SoftmaxCrossEntropyLoss()
+    Xn, Yn = mx.np.array(X), mx.np.array(Y)
+    for _ in range(40):
+        with mx.autograd.record():
+            loss = lf(net(Xn), Yn).mean()
+        loss.backward()
+        tr.step(64)
+    ref_pred = net(Xn).asnumpy().argmax(1)
+    qnet = quantize_net(net, calib_data=[Xn], calib_mode="entropy")
+    q_pred = qnet(Xn).asnumpy().argmax(1)
+    assert (ref_pred == q_pred).mean() >= 0.95
+
+
+def test_quantize_net_hybridizes():
+    net = _mlp()
+    X = mx.np.array(onp.random.RandomState(9)
+                    .uniform(-1, 1, (4, 12)).astype("float32"))
+    ref = net(X).asnumpy()
+    qnet = quantize_net(net, calib_data=[X], calib_mode="naive")
+    eager = qnet(X).asnumpy()
+    qnet.hybridize()
+    hybrid = qnet(X).asnumpy()
+    onp.testing.assert_allclose(hybrid, eager, rtol=1e-5, atol=1e-5)
+    assert onp.abs(hybrid - ref).max() < 0.1 * onp.abs(ref).max() + 0.05
+
+
+def test_quantize_errors():
+    net = _mlp()
+    with pytest.raises(mx.MXNetError):
+        quantize_net(net, quantized_dtype="uint4")
+    with pytest.raises(mx.MXNetError):
+        quantize_net(net, calib_mode="bogus")
+    with pytest.raises(mx.MXNetError):
+        quantize_net(net, calib_mode="naive")   # no calib_data
+    with pytest.raises(mx.MXNetError):
+        qop.quantize(mx.np.zeros((2,)), mx.np.array(0.0),
+                     mx.np.array(1.0), out_type="int4")
