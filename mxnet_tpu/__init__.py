@@ -59,6 +59,7 @@ _LAZY = {
     "symbol": ".symbol",
     "sym": ".symbol",
     "contrib": ".contrib",
+    "subgraph": ".subgraph",
 }
 
 

@@ -261,13 +261,19 @@ class HybridBlock(Block):
         finally:
             self._active = was
 
-    def optimize_for(self, x: Any, backend: Optional[str] = None,
-                     **kwargs: Any) -> None:
-        """Reference ``optimize_for(backend)``: under XLA the graph
-        compiler IS the accelerator backend, so this just hybridizes and
-        warms the cache."""
-        self.hybridize()
-        self(x)
+    def optimize_for(self, x: Any, *args: Any,
+                     backend: Optional[str] = None,
+                     **kwargs: Any) -> "HybridBlock":
+        """Apply a subgraph accelerator backend and warm-compile
+        (reference ``optimize_for(backend)`` / ``MXNET_SUBGRAPH_BACKEND``).
+
+        Built-in backends: 'xla' (default — hybridize + jit warm),
+        'int8' (post-training quantization calibrated on ``x``), 'bf16'
+        (AMP cast policy); more via ``mxnet_tpu.subgraph.register_backend``.
+        Returns the optimized block (usually ``self``, mutated in place).
+        """
+        from ..subgraph import get_backend
+        return get_backend(backend)(self, (x,) + args, **kwargs)
 
     def _make_traced(self, params: List[Parameter], train: bool,
                      cell: Dict[str, Any]) -> Callable:

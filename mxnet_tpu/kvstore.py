@@ -176,6 +176,24 @@ class KVStore:
         return f"KVStore(type={self.type}, keys={len(self._store)})"
 
 
+def _maybe_init_distributed() -> None:
+    """Join the multi-process job described by the launcher's env
+    (``tools/launch.py`` sets ``JAX_COORDINATOR_ADDRESS`` / ``JAX_NUM_
+    PROCESSES`` / ``JAX_PROCESS_ID`` — the DMLC_* rendezvous analog).
+    No-op when unset or already initialized."""
+    import os
+    coord = os.environ.get("JAX_COORDINATOR_ADDRESS")
+    if not coord or jax.process_count() > 1:
+        return
+    try:
+        jax.distributed.initialize(
+            coordinator_address=coord,
+            num_processes=int(os.environ.get("JAX_NUM_PROCESSES", "1")),
+            process_id=int(os.environ.get("JAX_PROCESS_ID", "0")))
+    except RuntimeError:
+        pass    # already initialized
+
+
 class KVStoreICI(KVStore):
     """Multi-host synchronous data parallelism over ICI/DCN.
 
@@ -188,6 +206,7 @@ class KVStoreICI(KVStore):
     def __init__(self, kv_type: str = "ici") -> None:
         super().__init__(kv_type)
         self._allreduce_fn = None
+        _maybe_init_distributed()
 
     def _get_allreduce(self):
         if self._allreduce_fn is None:

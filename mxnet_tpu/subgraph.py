@@ -1,0 +1,94 @@
+"""Subgraph accelerator backends — the ``optimize_for`` registry.
+
+Reference parity (leezu/mxnet): ``src/operator/subgraph/subgraph_property.h``
++ ``build_subgraph.cc`` — pluggable backends (MKLDNN fusion, TensorRT)
+selected via ``HybridBlock.optimize_for(backend)`` or the
+``MXNET_SUBGRAPH_BACKEND`` env var.
+
+Design (tpu-first): XLA already does the fusion the reference's MKLDNN/
+TensorRT properties existed for, so a backend here is a whole-block
+transform applied before compilation rather than a C++ graph-partition
+pass.  Built-ins:
+
+- ``'xla'``    — hybridize + warm the jit cache (the default accelerator;
+                 equivalent to the reference's default partitioner).
+- ``'int8'``   — post-training int8 quantization via
+                 ``contrib.quantization.quantize_net`` (MKLDNN/TensorRT
+                 int8 analog), calibrating on the sample input.
+- ``'bf16'``   — AMP bf16 cast policy over the block's compiled program
+                 (the reference's AMP-convert-model analog).
+
+Custom backends: ``register_backend(name, fn)`` with
+``fn(block, sample_inputs, **kwargs) -> block``.
+"""
+from __future__ import annotations
+
+from typing import Any, Callable, Dict, List, Optional
+
+from .base import MXNetError, getenv, register_env
+
+__all__ = ["register_backend", "get_backend", "list_backends"]
+
+register_env("MXNET_SUBGRAPH_BACKEND", "xla",
+             "Default backend applied by HybridBlock.optimize_for when "
+             "none is given ('xla', 'int8', 'bf16', or a registered name).")
+
+_BACKENDS: Dict[str, Callable] = {}
+
+
+def register_backend(name: str, fn: Callable) -> Callable:
+    """Register ``fn(block, sample_inputs, **kwargs) -> block`` under
+    ``name`` (SubgraphProperty registration analog)."""
+    _BACKENDS[name] = fn
+    return fn
+
+
+def get_backend(name: Optional[str] = None) -> Callable:
+    name = name or getenv("MXNET_SUBGRAPH_BACKEND")
+    try:
+        return _BACKENDS[name]
+    except KeyError:
+        raise MXNetError(
+            f"unknown subgraph backend {name!r}; registered: "
+            f"{sorted(_BACKENDS)}") from None
+
+
+def list_backends() -> List[str]:
+    return sorted(_BACKENDS)
+
+
+# ---------------------------------------------------------------------------
+# Built-ins
+# ---------------------------------------------------------------------------
+
+def _xla_backend(block, sample_inputs, static_alloc: bool = False,
+                 static_shape: bool = False, **kwargs: Any):
+    block.hybridize(static_alloc=static_alloc, static_shape=static_shape)
+    block(*sample_inputs)
+    return block
+
+
+def _int8_backend(block, sample_inputs, calib_mode: str = "naive",
+                  exclude_layers=None, calib_data=None, **kwargs: Any):
+    from .contrib.quantization import quantize_net
+    if calib_data is None and calib_mode != "none":
+        calib_data = [sample_inputs[0]]
+    block = quantize_net(block, calib_mode=calib_mode,
+                         calib_data=calib_data,
+                         exclude_layers=exclude_layers)
+    block.hybridize()
+    block(*sample_inputs)
+    return block
+
+
+def _bf16_backend(block, sample_inputs, **kwargs: Any):
+    from . import amp
+    amp.init(target_dtype="bfloat16")
+    block.hybridize()
+    block(*sample_inputs)
+    return block
+
+
+register_backend("xla", _xla_backend)
+register_backend("int8", _int8_backend)
+register_backend("bf16", _bf16_backend)

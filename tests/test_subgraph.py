@@ -1,0 +1,64 @@
+"""Subgraph backend / optimize_for tests (reference:
+tests/python/unittest/test_subgraph.py + optimize_for API)."""
+import numpy as onp
+import pytest
+
+import mxnet_tpu as mx
+from mxnet_tpu import subgraph
+from mxnet_tpu.contrib.quantization import QuantizedDense
+
+
+def _net():
+    net = mx.gluon.nn.HybridSequential()
+    net.add(mx.gluon.nn.Dense(16, activation="relu"),
+            mx.gluon.nn.Dense(4))
+    net.initialize()
+    return net
+
+
+def _x(seed=0, shape=(8, 12)):
+    return mx.np.array(onp.random.RandomState(seed)
+                       .uniform(-1, 1, shape).astype("float32"))
+
+
+def test_optimize_for_default_xla():
+    net, x = _net(), _x()
+    ref = net(x).asnumpy()
+    net.optimize_for(x)
+    assert net._active
+    assert len(net._cached_graph) == 1      # warmed
+    onp.testing.assert_allclose(net(x).asnumpy(), ref, rtol=1e-5,
+                                atol=1e-5)
+
+
+def test_optimize_for_int8():
+    net, x = _net(), _x(1)
+    ref = net(x).asnumpy()
+    net.optimize_for(x, backend="int8")
+    assert isinstance(net._children["0"], QuantizedDense)
+    out = net(x).asnumpy()
+    assert onp.abs(out - ref).max() < 0.1 * onp.abs(ref).max() + 0.05
+
+
+def test_optimize_for_env_default(monkeypatch):
+    calls = []
+
+    def custom(block, sample_inputs, **kw):
+        calls.append(sample_inputs)
+        return block
+
+    subgraph.register_backend("_test_backend", custom)
+    try:
+        monkeypatch.setenv("MXNET_SUBGRAPH_BACKEND", "_test_backend")
+        net, x = _net(), _x(2)
+        net.optimize_for(x)
+        assert len(calls) == 1
+    finally:
+        subgraph._BACKENDS.pop("_test_backend", None)
+
+
+def test_unknown_backend_raises():
+    net, x = _net(), _x(3)
+    with pytest.raises(mx.MXNetError, match="unknown subgraph backend"):
+        net.optimize_for(x, backend="tensorrt")
+    assert set(subgraph.list_backends()) >= {"xla", "int8", "bf16"}

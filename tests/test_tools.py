@@ -1,0 +1,110 @@
+"""Tools-layer tests: im2rec packing, local launcher, opperf, diagnose
+(reference: tools/im2rec.py, tools/launch.py, benchmark/opperf)."""
+import json
+import os
+import subprocess
+import sys
+
+import numpy as onp
+import pytest
+
+import mxnet_tpu as mx
+
+ROOT = os.path.dirname(os.path.dirname(os.path.abspath(__file__)))
+sys.path.insert(0, os.path.join(ROOT, "tools"))
+sys.path.insert(0, os.path.join(ROOT, "benchmark", "opperf"))
+
+
+def _make_image_tree(root):
+    from PIL import Image
+    rng = onp.random.RandomState(0)
+    for cls in ("cat", "dog"):
+        d = os.path.join(root, cls)
+        os.makedirs(d)
+        for i in range(3):
+            arr = rng.randint(0, 255, (20, 24, 3)).astype("uint8")
+            Image.fromarray(arr).save(os.path.join(d, f"{cls}{i}.png"))
+
+
+def test_im2rec_list_and_pack(tmp_path):
+    import im2rec
+    img_root = tmp_path / "imgs"
+    os.makedirs(img_root)
+    _make_image_tree(str(img_root))
+    prefix = str(tmp_path / "data")
+
+    im2rec.main([prefix, str(img_root), "--list", "--recursive"])
+    lst = prefix + ".lst"
+    assert os.path.exists(lst)
+    lines = open(lst).read().strip().splitlines()
+    assert len(lines) == 6
+    labels = {float(l.split("\t")[1]) for l in lines}
+    assert labels == {0.0, 1.0}
+
+    im2rec.main([prefix, str(img_root), "--resize", "16"])
+    assert os.path.exists(prefix + ".rec")
+    assert os.path.exists(prefix + ".idx")
+
+    # readable through the data pipeline
+    rec = mx.recordio.MXIndexedRecordIO(prefix + ".idx", prefix + ".rec",
+                                        "r")
+    keys = rec.keys
+    assert len(keys) == 6
+    header, img_buf = mx.recordio.unpack(rec.read_idx(keys[0]))
+    img = mx.image.imdecode(img_buf)
+    assert min(img.shape[0], img.shape[1]) == 16
+    rec.close()
+
+
+def test_im2rec_train_val_split(tmp_path):
+    import im2rec
+    img_root = tmp_path / "imgs"
+    os.makedirs(img_root)
+    _make_image_tree(str(img_root))
+    prefix = str(tmp_path / "split")
+    im2rec.main([prefix, str(img_root), "--list", "--recursive",
+                 "--train-ratio", "0.5"])
+    train = open(prefix + "_train.lst").read().strip().splitlines()
+    val = open(prefix + "_val.lst").read().strip().splitlines()
+    assert len(train) == 3 and len(val) == 3
+
+
+def test_launch_local_sets_env(tmp_path):
+    out = tmp_path / "env{}.json"
+    script = tmp_path / "worker.py"
+    script.write_text(
+        "import os, json, sys\n"
+        "keys = ['JAX_PROCESS_ID', 'JAX_NUM_PROCESSES',\n"
+        "        'JAX_COORDINATOR_ADDRESS', 'DMLC_WORKER_ID',\n"
+        "        'DMLC_ROLE']\n"
+        f"path = {str(out)!r}.format(os.environ['JAX_PROCESS_ID'])\n"
+        "json.dump({k: os.environ.get(k) for k in keys}, open(path, 'w'))\n")
+    rc = subprocess.call(
+        [sys.executable, os.path.join(ROOT, "tools", "launch.py"),
+         "-n", "2", sys.executable, str(script)])
+    assert rc == 0
+    for rank in range(2):
+        env = json.load(open(str(out).format(rank)))
+        assert env["JAX_PROCESS_ID"] == str(rank)
+        assert env["JAX_NUM_PROCESSES"] == "2"
+        assert env["DMLC_WORKER_ID"] == str(rank)
+        assert env["DMLC_ROLE"] == "worker"
+        assert env["JAX_COORDINATOR_ADDRESS"].startswith("127.0.0.1:")
+
+
+def test_opperf_runs_subset(tmp_path):
+    import opperf
+    results = opperf.run(size=32, warmup=1, runs=3,
+                         ops=["add", "dot", "softmax"])
+    assert set(results) == {"add", "dot", "softmax"}
+    for r in results.values():
+        assert "mean_us" in r and r["mean_us"] > 0
+
+
+def test_diagnose_smoke(capsys):
+    import diagnose
+    diagnose.main()
+    out = capsys.readouterr().out
+    assert "Platform Info" in out
+    assert "mxnet_tpu" in out
+    assert "Runtime Features" in out

@@ -1,0 +1,49 @@
+#!/usr/bin/env python
+"""Environment diagnostics for bug reports.
+
+Reference parity (leezu/mxnet): ``tools/diagnose.py`` — dumps platform,
+python, library versions, env config, and hardware info.
+"""
+import os
+import platform
+import sys
+
+sys.path.insert(0, os.path.dirname(os.path.dirname(os.path.abspath(__file__))))
+
+
+def main() -> None:
+    print("----------Platform Info----------")
+    print(f"Platform : {platform.platform()}")
+    print(f"system   : {platform.system()}")
+    print(f"machine  : {platform.machine()}")
+    print("----------Python Info----------")
+    print(f"Version  : {sys.version.split()[0]}")
+    print(f"Compiler : {platform.python_compiler()}")
+    print("----------Library Info----------")
+    import numpy
+    print(f"numpy    : {numpy.__version__}")
+    import jax
+    print(f"jax      : {jax.__version__}")
+    import jaxlib
+    print(f"jaxlib   : {jaxlib.__version__}")
+    import mxnet_tpu as mx
+    print(f"mxnet_tpu: {mx.__version__}")
+    print("----------Device Info----------")
+    try:
+        for d in jax.devices():
+            print(f"device   : {d} ({d.platform})")
+        print(f"process  : {jax.process_index()}/{jax.process_count()}")
+    except Exception as e:     # backend init can fail on broken installs
+        print(f"device   : UNAVAILABLE ({e})")
+    print("----------Runtime Features----------")
+    feats = mx.runtime.Features()
+    enabled = [name for name in feats.keys() if feats.is_enabled(name)]
+    print(", ".join(enabled))
+    print("----------Environment----------")
+    for k, v in sorted(os.environ.items()):
+        if k.startswith(("MXNET_", "JAX_", "XLA_", "DMLC_", "TPU_")):
+            print(f"{k}={v}")
+
+
+if __name__ == "__main__":
+    main()

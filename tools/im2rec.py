@@ -1,0 +1,140 @@
+#!/usr/bin/env python
+"""Pack an image folder into RecordIO (.rec/.idx/.lst).
+
+Reference parity (leezu/mxnet): ``tools/im2rec.py`` — the same two-phase
+CLI: ``--list`` walks a directory into a .lst manifest (with optional
+train/val split), then the pack phase encodes each image (optional
+resize/quality) into an indexed RecordIO file readable by
+``mx.io.ImageRecordIter`` / ``ImageRecordDataset``.
+
+TPU-native stance: the .rec format is byte-identical to the reference's
+(mxnet_tpu/recordio.py), so datasets packed here or by upstream mxnet are
+interchangeable.
+"""
+import argparse
+import os
+import random
+import sys
+
+sys.path.insert(0, os.path.dirname(os.path.dirname(os.path.abspath(__file__))))
+
+_EXTS = (".jpg", ".jpeg", ".png", ".bmp")
+
+
+def list_images(root, recursive=False, exts=_EXTS):
+    """Yield (relpath, label) with labels assigned per sorted subfolder."""
+    if recursive:
+        cats = {}
+        for path, _, files in sorted(os.walk(root, followlinks=True)):
+            for f in sorted(files):
+                if f.lower().endswith(exts):
+                    cat = os.path.relpath(path, root)
+                    if cat not in cats:
+                        cats[cat] = len(cats)
+                    yield os.path.relpath(os.path.join(path, f), root), \
+                        cats[cat]
+    else:
+        for f in sorted(os.listdir(root)):
+            if f.lower().endswith(exts):
+                yield f, 0
+
+
+def write_list(args):
+    entries = list(list_images(args.root, args.recursive, args.exts))
+    if args.shuffle:
+        random.seed(100)
+        random.shuffle(entries)
+    n_train = int(len(entries) * args.train_ratio)
+    chunks = [("", entries)] if args.train_ratio >= 1.0 else [
+        ("_train", entries[:n_train]), ("_val", entries[n_train:])]
+    for suffix, chunk in chunks:
+        path = args.prefix + suffix + ".lst"
+        with open(path, "w") as f:
+            for i, (rel, label) in enumerate(chunk):
+                f.write(f"{i}\t{label}\t{rel}\n")
+        print(f"wrote {len(chunk)} entries to {path}")
+
+
+def read_list(path):
+    with open(path) as f:
+        for line in f:
+            parts = line.strip().split("\t")
+            if len(parts) < 3:
+                continue
+            idx, rel = int(parts[0]), parts[-1]
+            labels = [float(x) for x in parts[1:-1]]
+            yield idx, labels[0] if len(labels) == 1 else labels, rel
+
+
+def pack_records(args, lst_path):
+    import numpy as onp
+    from mxnet_tpu import recordio
+    from mxnet_tpu.image import imdecode, imresize
+
+    prefix = os.path.splitext(lst_path)[0]
+    rec = recordio.MXIndexedRecordIO(prefix + ".idx", prefix + ".rec", "w")
+    count = 0
+    for idx, label, rel in read_list(lst_path):
+        fullpath = os.path.join(args.root, rel)
+        with open(fullpath, "rb") as f:
+            buf = f.read()
+        header = recordio.IRHeader(0, label, idx, 0)
+        if args.resize or args.center_crop:
+            img = imdecode(buf)
+            if args.resize:
+                h, w = img.shape[0], img.shape[1]
+                if min(h, w) != args.resize:
+                    if h < w:
+                        img = imresize(img, args.resize * w // h, args.resize)
+                    else:
+                        img = imresize(img, args.resize, args.resize * h // w)
+            if args.center_crop:
+                h, w = img.shape[0], img.shape[1]
+                s = min(h, w)
+                y0, x0 = (h - s) // 2, (w - s) // 2
+                img = img[y0:y0 + s, x0:x0 + s]
+            packed = recordio.pack_img(header, onp.asarray(img.asnumpy()),
+                                       quality=args.quality,
+                                       img_fmt=args.encoding)
+        else:
+            packed = recordio.pack(header, buf)
+        rec.write_idx(idx, packed)
+        count += 1
+        if count % 1000 == 0:
+            print(f"packed {count} images")
+    rec.close()
+    print(f"wrote {count} records to {prefix}.rec")
+
+
+def main(argv=None):
+    ap = argparse.ArgumentParser(
+        description="Create an image list / RecordIO pack of a folder")
+    ap.add_argument("prefix", help="output prefix (or .lst path to pack)")
+    ap.add_argument("root", help="image folder root")
+    ap.add_argument("--list", action="store_true",
+                    help="generate .lst manifest instead of packing")
+    ap.add_argument("--recursive", action="store_true",
+                    help="label by subfolder (sorted) and walk recursively")
+    ap.add_argument("--shuffle", type=bool, default=True)
+    ap.add_argument("--train-ratio", type=float, default=1.0)
+    ap.add_argument("--exts", nargs="+", default=list(_EXTS))
+    ap.add_argument("--resize", type=int, default=0,
+                    help="resize shorter side to this many pixels")
+    ap.add_argument("--center-crop", action="store_true")
+    ap.add_argument("--quality", type=int, default=95)
+    ap.add_argument("--encoding", default=".jpg", choices=[".jpg", ".png"])
+    args = ap.parse_args(argv)
+    args.exts = tuple(args.exts)
+
+    if args.list:
+        write_list(args)
+    else:
+        lst = args.prefix if args.prefix.endswith(".lst") \
+            else args.prefix + ".lst"
+        if not os.path.exists(lst):
+            raise SystemExit(f"no list file {lst}; run with --list first")
+        pack_records(args, lst)
+
+
+if __name__ == "__main__":
+    main()

@@ -1,0 +1,109 @@
+#!/usr/bin/env python
+"""Multi-process / multi-host training launcher.
+
+Reference parity (leezu/mxnet): ``tools/launch.py`` +
+``dmlc_tracker/{local,ssh}.py`` — the CLI that starts scheduler/server/
+worker processes with ``DMLC_*`` rendezvous env vars.
+
+Design (tpu-first): there are no parameter-server roles; every process is
+an SPMD worker in one ``jax.distributed`` job. The launcher assigns
+``JAX_COORDINATOR_ADDRESS`` / process ids and (for ``--launcher local``)
+forks N local processes, each seeing a slice of devices — the exact local
+analog of a multi-host TPU pod slice, and the same env contract
+``mxnet_tpu.kvstore.create('dist')`` reads at init.
+
+    python tools/launch.py -n 4 python train.py        # 4 local workers
+    python tools/launch.py -n 16 -H hosts.txt ...      # ssh multi-host
+"""
+import argparse
+import os
+import subprocess
+import sys
+
+
+def launch_local(args, cmd):
+    procs = []
+    base_env = dict(os.environ)
+    coord = f"127.0.0.1:{args.port}"
+    for rank in range(args.num_workers):
+        env = dict(base_env)
+        env.update({
+            "JAX_COORDINATOR_ADDRESS": coord,
+            "JAX_NUM_PROCESSES": str(args.num_workers),
+            "JAX_PROCESS_ID": str(rank),
+            # reference-compatible aliases (kvstore reads either)
+            "DMLC_NUM_WORKER": str(args.num_workers),
+            "DMLC_WORKER_ID": str(rank),
+            "DMLC_ROLE": "worker",
+            "DMLC_PS_ROOT_URI": "127.0.0.1",
+            "DMLC_PS_ROOT_PORT": str(args.port),
+        })
+        if args.cpu_devices_per_worker:
+            env["XLA_FLAGS"] = (
+                env.get("XLA_FLAGS", "") +
+                f" --xla_force_host_platform_device_count="
+                f"{args.cpu_devices_per_worker}").strip()
+            env["JAX_PLATFORMS"] = "cpu"
+        procs.append(subprocess.Popen(cmd, env=env))
+    rc = 0
+    for p in procs:
+        rc = p.wait() or rc
+    if rc:
+        for p in procs:
+            if p.poll() is None:
+                p.terminate()
+    return rc
+
+
+def launch_ssh(args, cmd):
+    with open(args.hostfile) as f:
+        hosts = [h.strip() for h in f if h.strip()]
+    if not hosts:
+        raise SystemExit("empty hostfile")
+    coord = f"{hosts[0]}:{args.port}"
+    procs = []
+    for rank in range(args.num_workers):
+        host = hosts[rank % len(hosts)]
+        envs = " ".join([
+            f"JAX_COORDINATOR_ADDRESS={coord}",
+            f"JAX_NUM_PROCESSES={args.num_workers}",
+            f"JAX_PROCESS_ID={rank}",
+            f"DMLC_NUM_WORKER={args.num_workers}",
+            f"DMLC_WORKER_ID={rank}",
+            "DMLC_ROLE=worker",
+        ])
+        remote = f"cd {os.getcwd()} && {envs} {' '.join(cmd)}"
+        procs.append(subprocess.Popen(["ssh", "-o",
+                                       "StrictHostKeyChecking=no", host,
+                                       remote]))
+    rc = 0
+    for p in procs:
+        rc = p.wait() or rc
+    return rc
+
+
+def main(argv=None):
+    ap = argparse.ArgumentParser(
+        description="Launch an SPMD multi-process training job")
+    ap.add_argument("-n", "--num-workers", type=int, required=True)
+    ap.add_argument("--launcher", default="local",
+                    choices=["local", "ssh"])
+    ap.add_argument("-H", "--hostfile", default=None,
+                    help="one host per line (ssh launcher)")
+    ap.add_argument("-p", "--port", type=int, default=9871)
+    ap.add_argument("--cpu-devices-per-worker", type=int, default=0,
+                    help="force each worker onto N virtual CPU devices "
+                         "(testing without TPU hardware)")
+    ap.add_argument("command", nargs=argparse.REMAINDER)
+    args = ap.parse_args(argv)
+    if not args.command:
+        raise SystemExit("no command given")
+    if args.launcher == "ssh":
+        if not args.hostfile:
+            raise SystemExit("ssh launcher requires --hostfile")
+        return launch_ssh(args, args.command)
+    return launch_local(args, args.command)
+
+
+if __name__ == "__main__":
+    sys.exit(main())
