@@ -60,6 +60,7 @@ _LAZY = {
     "sym": ".symbol",
     "contrib": ".contrib",
     "subgraph": ".subgraph",
+    "rtc": ".rtc",
 }
 
 

@@ -4,3 +4,4 @@ Reference parity (leezu/mxnet): ``python/mxnet/contrib/`` (quantization
 driver, onnx, tensorboard hooks, …).
 """
 from . import quantization  # noqa: F401
+from . import onnx  # noqa: F401

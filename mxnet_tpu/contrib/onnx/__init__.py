@@ -1,0 +1,10 @@
+"""ONNX interop (reference: ``python/mxnet/contrib/onnx/``).
+
+``export_model`` writes standard ONNX protobuf files;
+``import_model`` loads them back into a Symbol + params.
+The codec is self-contained (``_proto.py``) — no ``onnx`` dependency.
+"""
+from .mx2onnx import export_model
+from .onnx2mx import import_model
+
+__all__ = ["export_model", "import_model"]

@@ -1,0 +1,315 @@
+"""Minimal protobuf wire-format codec for ONNX messages.
+
+The image has no ``onnx`` package; ONNX's wire format is plain protobuf,
+which is stable and simple (varint/length-delimited fields), so the
+exporter/importer encode it directly.  Field numbers follow onnx.proto3
+(IR version 8 era — they are frozen by protobuf compatibility rules).
+
+Only the messages the converters need are modeled: ModelProto,
+GraphProto, NodeProto, AttributeProto, TensorProto, ValueInfoProto,
+TypeProto(.Tensor), TensorShapeProto(.Dimension), OperatorSetIdProto.
+"""
+from __future__ import annotations
+
+import struct
+from typing import Any, Dict, List, Tuple, Union
+
+import numpy as onp
+
+# -- wire primitives --------------------------------------------------------
+
+def _varint(n: int) -> bytes:
+    out = bytearray()
+    n &= (1 << 64) - 1
+    while True:
+        b = n & 0x7F
+        n >>= 7
+        out.append(b | (0x80 if n else 0))
+        if not n:
+            return bytes(out)
+
+
+def _tag(field: int, wire: int) -> bytes:
+    return _varint((field << 3) | wire)
+
+
+def field_varint(field: int, value: int) -> bytes:
+    return _tag(field, 0) + _varint(int(value))
+
+
+def field_bytes(field: int, value: bytes) -> bytes:
+    return _tag(field, 2) + _varint(len(value)) + value
+
+
+def field_string(field: int, value: str) -> bytes:
+    return field_bytes(field, value.encode("utf-8"))
+
+
+def field_float(field: int, value: float) -> bytes:
+    return _tag(field, 5) + struct.pack("<f", value)
+
+
+def field_packed_int64(field: int, values) -> bytes:
+    payload = b"".join(_varint(int(v)) for v in values)
+    return field_bytes(field, payload)
+
+
+def field_packed_float(field: int, values) -> bytes:
+    payload = b"".join(struct.pack("<f", float(v)) for v in values)
+    return field_bytes(field, payload)
+
+
+# -- decoder (generic: field number -> list of raw values) ------------------
+
+def _read_varint(buf: bytes, pos: int) -> Tuple[int, int]:
+    result = shift = 0
+    while True:
+        b = buf[pos]
+        pos += 1
+        result |= (b & 0x7F) << shift
+        if not b & 0x80:
+            return result, pos
+        shift += 7
+
+
+def decode(buf: bytes) -> Dict[int, List[Any]]:
+    """Parse one protobuf message into {field_number: [values...]}.
+    Length-delimited fields come back as bytes (decode nested messages by
+    calling :func:`decode` again); varints as int; fixed32 as raw bytes."""
+    out: Dict[int, List[Any]] = {}
+    pos = 0
+    while pos < len(buf):
+        key, pos = _read_varint(buf, pos)
+        field, wire = key >> 3, key & 7
+        if wire == 0:
+            val, pos = _read_varint(buf, pos)
+        elif wire == 2:
+            ln, pos = _read_varint(buf, pos)
+            val = buf[pos:pos + ln]
+            pos += ln
+        elif wire == 5:
+            val = buf[pos:pos + 4]
+            pos += 4
+        elif wire == 1:
+            val = buf[pos:pos + 8]
+            pos += 8
+        else:
+            raise ValueError(f"unsupported wire type {wire}")
+        out.setdefault(field, []).append(val)
+    return out
+
+
+def _signed64(v: int) -> int:
+    """Protobuf int64 varints are two's complement; recover the sign."""
+    return v - (1 << 64) if v >= (1 << 63) else v
+
+
+def decode_packed_int64(raw: bytes) -> List[int]:
+    vals, pos = [], 0
+    while pos < len(raw):
+        v, pos = _read_varint(raw, pos)
+        vals.append(_signed64(v))
+    return vals
+
+
+# -- ONNX dtype mapping -----------------------------------------------------
+
+# onnx.TensorProto.DataType
+FLOAT, UINT8, INT8, INT32, INT64 = 1, 2, 3, 6, 7
+BOOL, FLOAT16, DOUBLE, BFLOAT16 = 9, 10, 11, 16
+
+_NP2ONNX = {
+    onp.dtype("float32"): FLOAT, onp.dtype("uint8"): UINT8,
+    onp.dtype("int8"): INT8, onp.dtype("int32"): INT32,
+    onp.dtype("int64"): INT64, onp.dtype("bool"): BOOL,
+    onp.dtype("float16"): FLOAT16, onp.dtype("float64"): DOUBLE,
+}
+_ONNX2NP = {v: k for k, v in _NP2ONNX.items()}
+
+
+def np_to_onnx_dtype(dt) -> int:
+    try:
+        return _NP2ONNX[onp.dtype(dt)]
+    except KeyError:
+        raise ValueError(f"no ONNX dtype for {dt}") from None
+
+
+def onnx_to_np_dtype(code: int):
+    return _ONNX2NP[code]
+
+
+# -- message builders -------------------------------------------------------
+
+def tensor(name: str, array: onp.ndarray) -> bytes:
+    """TensorProto via raw_data."""
+    array = onp.ascontiguousarray(array)
+    msg = b""
+    msg += field_packed_int64(1, array.shape) if array.ndim else b""
+    msg += field_varint(2, np_to_onnx_dtype(array.dtype))
+    msg += field_string(8, name)
+    msg += field_bytes(9, array.tobytes())
+    return msg
+
+
+def parse_tensor(raw: bytes) -> Tuple[str, onp.ndarray]:
+    f = decode(raw)
+    dims = decode_packed_int64(f[1][0]) if 1 in f else []
+    dtype = onnx_to_np_dtype(f[2][0])
+    name = f[8][0].decode() if 8 in f else ""
+    if 9 in f:
+        arr = onp.frombuffer(f[9][0], dtype=dtype).reshape(dims)
+    elif 4 in f:        # float_data (packed)
+        arr = onp.array(struct.unpack(f"<{len(f[4][0]) // 4}f", f[4][0]),
+                        dtype=onp.float32).reshape(dims)
+    elif 7 in f:        # int64_data
+        arr = onp.array(decode_packed_int64(f[7][0]),
+                        dtype=onp.int64).reshape(dims)
+    else:
+        arr = onp.zeros(dims, dtype=dtype)
+    return name, arr
+
+
+# AttributeProto.AttributeType
+A_FLOAT, A_INT, A_STRING, A_TENSOR = 1, 2, 3, 4
+A_FLOATS, A_INTS, A_STRINGS = 6, 7, 8
+
+
+def attribute(name: str, value: Any) -> bytes:
+    msg = field_string(1, name)
+    if isinstance(value, bool):
+        msg += field_varint(3, int(value)) + field_varint(20, A_INT)
+    elif isinstance(value, int):
+        msg += field_varint(3, value) + field_varint(20, A_INT)
+    elif isinstance(value, float):
+        msg += field_float(2, value) + field_varint(20, A_FLOAT)
+    elif isinstance(value, str):
+        msg += field_bytes(4, value.encode()) + field_varint(20, A_STRING)
+    elif isinstance(value, onp.ndarray):
+        msg += field_bytes(5, tensor("", value)) + field_varint(20, A_TENSOR)
+    elif isinstance(value, (list, tuple)):
+        if all(isinstance(v, int) for v in value):
+            msg += field_packed_int64(8, value) + field_varint(20, A_INTS)
+        elif all(isinstance(v, float) for v in value):
+            msg += field_packed_float(7, value) + field_varint(20, A_FLOATS)
+        else:
+            raise ValueError(f"mixed attribute list {name}: {value}")
+    else:
+        raise ValueError(f"unsupported attribute {name}: {type(value)}")
+    return msg
+
+
+def parse_attribute(raw: bytes) -> Tuple[str, Any]:
+    f = decode(raw)
+    name = f[1][0].decode()
+    atype = f[20][0] if 20 in f else None
+    if atype == A_INT or (atype is None and 3 in f):
+        return name, _signed64(f[3][0])
+    if atype == A_FLOAT or (atype is None and 2 in f):
+        return name, struct.unpack("<f", f[2][0])[0]
+    if atype == A_STRING or (atype is None and 4 in f):
+        return name, f[4][0].decode()
+    if atype == A_TENSOR or (atype is None and 5 in f):
+        return name, parse_tensor(f[5][0])[1]
+    if atype == A_INTS or (atype is None and 8 in f):
+        return name, decode_packed_int64(f[8][0])
+    if atype == A_FLOATS or (atype is None and 7 in f):
+        raw7 = f[7][0]
+        return name, list(struct.unpack(f"<{len(raw7) // 4}f", raw7))
+    return name, None
+
+
+def node(op_type: str, inputs: List[str], outputs: List[str],
+         name: str = "", attrs: Dict[str, Any] = None) -> bytes:
+    msg = b""
+    for i in inputs:
+        msg += field_string(1, i)
+    for o in outputs:
+        msg += field_string(2, o)
+    if name:
+        msg += field_string(3, name)
+    msg += field_string(4, op_type)
+    for k, v in (attrs or {}).items():
+        msg += field_bytes(5, attribute(k, v))
+    return msg
+
+
+def parse_node(raw: bytes) -> Dict[str, Any]:
+    f = decode(raw)
+    return {
+        "inputs": [b.decode() for b in f.get(1, [])],
+        "outputs": [b.decode() for b in f.get(2, [])],
+        "name": f[3][0].decode() if 3 in f else "",
+        "op_type": f[4][0].decode() if 4 in f else "",
+        "attrs": dict(parse_attribute(a) for a in f.get(5, [])),
+    }
+
+
+def value_info(name: str, dtype, shape) -> bytes:
+    dim_msgs = b""
+    for d in shape:
+        if isinstance(d, int):
+            dim_msgs += field_bytes(1, field_varint(1, d))
+        else:
+            dim_msgs += field_bytes(1, field_string(2, str(d)))
+    ttype = field_varint(1, np_to_onnx_dtype(dtype)) \
+        + field_bytes(2, dim_msgs)
+    return field_string(1, name) + field_bytes(2, field_bytes(1, ttype))
+
+
+def parse_value_info(raw: bytes) -> Tuple[str, Any, List[int]]:
+    f = decode(raw)
+    name = f[1][0].decode()
+    ttype = decode(decode(f[2][0])[1][0])
+    dtype = onnx_to_np_dtype(ttype[1][0]) if 1 in ttype else None
+    shape = []
+    if 2 in ttype:
+        for draw in decode(ttype[2][0]).get(1, []):
+            df = decode(draw)
+            shape.append(df[1][0] if 1 in df
+                         else df[2][0].decode() if 2 in df else None)
+    return name, dtype, shape
+
+
+def graph(nodes: List[bytes], name: str, initializers: List[bytes],
+          inputs: List[bytes], outputs: List[bytes]) -> bytes:
+    msg = b""
+    for n in nodes:
+        msg += field_bytes(1, n)
+    msg += field_string(2, name)
+    for t in initializers:
+        msg += field_bytes(5, t)
+    for i in inputs:
+        msg += field_bytes(11, i)
+    for o in outputs:
+        msg += field_bytes(12, o)
+    return msg
+
+
+def model(graph_msg: bytes, opset: int = 13,
+          producer: str = "mxnet_tpu") -> bytes:
+    msg = field_varint(1, 8)                     # ir_version
+    msg += field_string(2, producer)
+    msg += field_bytes(8, field_varint(2, opset))   # opset_import
+    msg += field_bytes(7, graph_msg)
+    return msg
+
+
+def parse_model(raw: bytes) -> Dict[str, Any]:
+    f = decode(raw)
+    g = decode(f[7][0])
+    opsets = []
+    for o in f.get(8, []):
+        of = decode(o)
+        opsets.append(of.get(2, [0])[0])
+    return {
+        "ir_version": f.get(1, [None])[0],
+        "producer": f[2][0].decode() if 2 in f else "",
+        "opset": max(opsets) if opsets else 0,
+        "graph": {
+            "name": g[2][0].decode() if 2 in g else "",
+            "nodes": [parse_node(n) for n in g.get(1, [])],
+            "initializers": dict(parse_tensor(t) for t in g.get(5, [])),
+            "inputs": [parse_value_info(v) for v in g.get(11, [])],
+            "outputs": [parse_value_info(v) for v in g.get(12, [])],
+        },
+    }

@@ -1,0 +1,280 @@
+"""Symbol-graph → ONNX exporter.
+
+Reference parity (leezu/mxnet): ``python/mxnet/contrib/onnx/mx2onnx/`` —
+``export_model(sym, params, in_shapes, in_types, onnx_file)`` with a
+per-op converter table (``_op_translations.py``).
+
+The protobuf encoding is hand-rolled (``_proto.py``) since the image has
+no ``onnx`` package; files produced here load in onnxruntime/netron.
+"""
+from __future__ import annotations
+
+from typing import Any, Dict, List, Optional, Sequence, Tuple
+
+import numpy as onp
+
+from ...base import MXNetError
+from ...symbol.symbol import Symbol, _topo_order
+from . import _proto as P
+
+__all__ = ["export_model"]
+
+
+def _pair(v, n=2):
+    if v is None:
+        return (1,) * n
+    if isinstance(v, int):
+        return (v,) * n
+    return tuple(v)
+
+
+class _Ctx:
+    def __init__(self, params, dtype):
+        self.params = params
+        self.dtype = dtype
+        self.nodes: List[bytes] = []
+        self.initializers: Dict[str, onp.ndarray] = {}
+        self.renames: Dict[str, str] = {}
+        self._uid = 0
+
+    def out(self, node, idx=0):
+        base = node.name if idx == 0 else f"{node.name}_out{idx}"
+        return self.renames.get(base, base)
+
+    def tmp(self, hint):
+        self._uid += 1
+        return f"{hint}_{self._uid}"
+
+    def add(self, op_type, inputs, outputs, name="", **attrs):
+        self.nodes.append(P.node(op_type, inputs, outputs, name, attrs))
+
+    def const(self, name, array):
+        self.initializers[name] = onp.asarray(array)
+        return name
+
+
+# --- converters: fn(ctx, node, in_names) appends ONNX nodes ---------------
+
+def _conv_fc(ctx, n, ins):
+    a = n.attrs
+    flatten = a.get("flatten", True)
+    out = ctx.out(n)
+    x, w = ins[0], ins[1]
+    bias = ins[2] if len(ins) > 2 and not a.get("no_bias", False) else None
+    if flatten:
+        fl = ctx.tmp(f"{n.name}_flat")
+        ctx.add("Flatten", [x], [fl], axis=1)
+        x = fl
+    gemm_in = [x, w] + ([bias] if bias else [])
+    ctx.add("Gemm", gemm_in, [out], n.name, alpha=1.0, beta=1.0,
+            transA=0, transB=1)
+
+
+def _conv_convolution(ctx, n, ins):
+    a = n.attrs
+    if a.get("layout", "NCHW") not in ("NCHW", "NCW", "NCDHW"):
+        raise MXNetError("ONNX export supports channel-first conv layouts")
+    kernel = _pair(a.get("kernel"), len(_pair(a.get("kernel"))))
+    ndim = len(kernel)
+    stride = _pair(a.get("stride") or 1, ndim)
+    pad = _pair(a.get("pad") if a.get("pad") is not None else 0, ndim)
+    dilate = _pair(a.get("dilate") or 1, ndim)
+    inputs = list(ins)
+    if a.get("no_bias", False) and len(inputs) > 2:
+        inputs = inputs[:2]
+    ctx.add("Conv", inputs, [ctx.out(n)], n.name,
+            kernel_shape=list(kernel), strides=list(stride),
+            pads=list(pad) * 2, dilations=list(dilate),
+            group=int(a.get("num_group", 1)))
+
+
+_ACT_MAP = {"relu": "Relu", "sigmoid": "Sigmoid", "tanh": "Tanh",
+            "softrelu": "Softplus", "softsign": "Softsign",
+            "gelu": "Gelu", "elu": "Elu", "selu": "Selu"}
+
+
+def _conv_activation(ctx, n, ins):
+    act = n.attrs.get("act_type", "relu")
+    if act not in _ACT_MAP:
+        raise MXNetError(f"no ONNX mapping for activation {act!r}")
+    ctx.add(_ACT_MAP[act], [ins[0]], [ctx.out(n)], n.name)
+
+
+def _conv_pooling(ctx, n, ins):
+    a = n.attrs
+    ptype = a.get("pool_type", "max")
+    out = ctx.out(n)
+    if a.get("global_pool", False):
+        op = {"max": "GlobalMaxPool", "avg": "GlobalAveragePool"}[ptype]
+        ctx.add(op, [ins[0]], [out], n.name)
+        return
+    kernel = _pair(a.get("kernel"), len(_pair(a.get("kernel"))))
+    ndim = len(kernel)
+    stride = _pair(a.get("stride") or kernel, ndim)
+    pad = _pair(a.get("pad") if a.get("pad") is not None else 0, ndim)
+    op = {"max": "MaxPool", "avg": "AveragePool"}[ptype]
+    attrs = dict(kernel_shape=list(kernel), strides=list(stride),
+                 pads=list(pad) * 2)
+    if ptype == "avg":
+        attrs["count_include_pad"] = int(a.get("count_include_pad", True))
+    ctx.add(op, [ins[0]], [out], n.name, **attrs)
+
+
+def _conv_batch_norm(ctx, n, ins):
+    a = n.attrs
+    # inputs: data gamma beta mean var
+    ctx.add("BatchNormalization", list(ins[:5]), [ctx.out(n)], n.name,
+            epsilon=float(a.get("eps", 1e-5)),
+            momentum=float(a.get("momentum", 0.9)))
+
+
+def _conv_layer_norm(ctx, n, ins):
+    a = n.attrs
+    ctx.add("LayerNormalization", list(ins[:3]), [ctx.out(n)], n.name,
+            axis=int(a.get("axis", -1)),
+            epsilon=float(a.get("eps", 1e-5)))
+
+
+def _conv_softmax(ctx, n, ins):
+    ctx.add("Softmax", [ins[0]], [ctx.out(n)], n.name,
+            axis=int(n.attrs.get("axis", -1)))
+
+
+def _conv_flatten(ctx, n, ins):
+    ctx.add("Flatten", [ins[0]], [ctx.out(n)], n.name, axis=1)
+
+
+def _conv_dropout(ctx, n, ins):
+    ratio = ctx.const(ctx.tmp(f"{n.name}_ratio"),
+                      onp.float32(n.attrs.get("p", 0.5)))
+    ctx.add("Dropout", [ins[0], ratio], [ctx.out(n)], n.name)
+
+
+def _conv_reshape(ctx, n, ins):
+    shape = n.attrs.get("shape")
+    cname = ctx.const(ctx.tmp(f"{n.name}_shape"),
+                      onp.asarray(shape, dtype=onp.int64))
+    ctx.add("Reshape", [ins[0], cname], [ctx.out(n)], n.name)
+
+
+def _conv_concat(ctx, n, ins):
+    axis = n.attrs.get("dim", n.attrs.get("axis", 1))
+    ctx.add("Concat", list(ins), [ctx.out(n)], n.name, axis=int(axis))
+
+
+def _binop(op_type):
+    def conv(ctx, n, ins):
+        ctx.add(op_type, list(ins[:2]), [ctx.out(n)], n.name)
+    return conv
+
+
+def _conv_embedding(ctx, n, ins):
+    # mx embedding(data, weight) -> Gather(weight, indices)
+    idx = ctx.tmp(f"{n.name}_idx")
+    ctx.add("Cast", [ins[0]], [idx], to=P.INT64)
+    ctx.add("Gather", [ins[1], idx], [ctx.out(n)], n.name, axis=0)
+
+
+def _conv_cast(ctx, n, ins):
+    dt = P.np_to_onnx_dtype(n.attrs.get("dtype", "float32"))
+    ctx.add("Cast", [ins[0]], [ctx.out(n)], n.name, to=dt)
+
+
+def _conv_transpose(ctx, n, ins):
+    axes = n.attrs.get("axes")
+    kw = {"perm": [int(x) for x in axes]} if axes else {}
+    ctx.add("Transpose", [ins[0]], [ctx.out(n)], n.name, **kw)
+
+
+def _conv_stopgrad(ctx, n, ins):
+    ctx.add("Identity", [ins[0]], [ctx.out(n)], n.name)
+
+
+_CONVERTERS = {
+    "fully_connected": _conv_fc,
+    "convolution": _conv_convolution,
+    "activation": _conv_activation,
+    "pooling": _conv_pooling,
+    "batch_norm": _conv_batch_norm,
+    "layer_norm": _conv_layer_norm,
+    "softmax": _conv_softmax,
+    "flatten": _conv_flatten,
+    "dropout": _conv_dropout,
+    "reshape": _conv_reshape,
+    "concat": _conv_concat,
+    "add": _binop("Add"), "subtract": _binop("Sub"),
+    "multiply": _binop("Mul"), "divide": _binop("Div"),
+    "maximum": _binop("Max"), "minimum": _binop("Min"),
+    "power": _binop("Pow"),
+    "dot": _binop("MatMul"),
+    "embedding": _conv_embedding,
+    "cast": _conv_cast,
+    "transpose": _conv_transpose,
+    "stop_gradient": _conv_stopgrad,
+    "relu": lambda ctx, n, ins: ctx.add("Relu", [ins[0]], [ctx.out(n)],
+                                        n.name),
+    "sigmoid": lambda ctx, n, ins: ctx.add("Sigmoid", [ins[0]],
+                                           [ctx.out(n)], n.name),
+    "tanh": lambda ctx, n, ins: ctx.add("Tanh", [ins[0]], [ctx.out(n)],
+                                        n.name),
+    "exp": lambda ctx, n, ins: ctx.add("Exp", [ins[0]], [ctx.out(n)],
+                                       n.name),
+}
+
+
+def export_model(sym: Symbol, params: Dict[str, Any],
+                 input_shapes: Sequence[Tuple[int, ...]],
+                 input_types: Any = "float32",
+                 onnx_file_path: str = "model.onnx",
+                 opset: int = 13, verbose: bool = False) -> str:
+    """Export a Symbol + params dict to an ONNX file.
+
+    params values may be NDArray or numpy; keys may carry the reference's
+    ``arg:``/``aux:`` prefixes.  Returns ``onnx_file_path``.
+    """
+    clean_params = {}
+    for k, v in params.items():
+        k = k.split(":", 1)[-1]
+        clean_params[k] = onp.asarray(
+            v.asnumpy() if hasattr(v, "asnumpy") else v)
+
+    order = _topo_order(sym._heads)
+    data_inputs = [n for n in order
+                   if n.op == "null" and n.name not in clean_params]
+    if len(data_inputs) != len(input_shapes):
+        raise MXNetError(
+            f"{len(data_inputs)} graph inputs "
+            f"({[n.name for n in data_inputs]}) but "
+            f"{len(input_shapes)} input_shapes given")
+    if isinstance(input_types, (str, onp.dtype, type)):
+        input_types = [input_types] * len(data_inputs)
+
+    ctx = _Ctx(clean_params, input_types)
+    for name, arr in clean_params.items():
+        ctx.initializers[name] = arr
+
+    for n in order:
+        if n.op == "null":
+            continue
+        conv = _CONVERTERS.get(n.op)
+        if conv is None:
+            raise MXNetError(f"no ONNX converter for op {n.op!r} "
+                             f"(node {n.name!r})")
+        ins = [ctx.out(m, idx) for m, idx in n.inputs]
+        conv(ctx, n, ins)
+
+    inits = [P.tensor(k, v) for k, v in ctx.initializers.items()]
+    vi_in = [P.value_info(n.name, dt, list(shape))
+             for n, shape, dt in zip(data_inputs, input_shapes,
+                                     input_types)]
+    heads = [(n, idx) for n, idx in sym._heads]
+    vi_out = [P.value_info(ctx.out(n, idx), input_types[0], [])
+              for n, idx in heads]
+    g = P.graph(ctx.nodes, "mxnet_tpu_graph", inits, vi_in, vi_out)
+    blob = P.model(g, opset=opset)
+    with open(onnx_file_path, "wb") as f:
+        f.write(blob)
+    if verbose:
+        print(f"exported {len(ctx.nodes)} nodes, "
+              f"{len(inits)} initializers -> {onnx_file_path}")
+    return onnx_file_path

@@ -1,0 +1,267 @@
+"""ONNX → Symbol-graph importer.
+
+Reference parity (leezu/mxnet): ``python/mxnet/contrib/onnx/onnx2mx/`` —
+``import_model(onnx_file) -> (sym, arg_params, aux_params)`` with a
+per-op translation table (``_import_helper.py``).
+"""
+from __future__ import annotations
+
+from typing import Any, Dict, List, Tuple
+
+import numpy as onp
+
+from ...base import MXNetError
+from ...ndarray.ndarray import NDArray
+from ...symbol import symbol as S
+from . import _proto as P
+
+__all__ = ["import_model"]
+
+
+def _pads(attrs, ndim):
+    pads = attrs.get("pads", [0] * ndim * 2)
+    begin, end = pads[:ndim], pads[ndim:]
+    if list(begin) != list(end):
+        raise MXNetError(f"asymmetric ONNX pads {pads} unsupported")
+    return tuple(int(p) for p in begin)
+
+
+class _Importer:
+    def __init__(self, model: Dict[str, Any]):
+        self.graph = model["graph"]
+        self.inits: Dict[str, onp.ndarray] = self.graph["initializers"]
+        self.syms: Dict[str, Any] = {}
+        self.aux_names: set = set()
+
+    def sym(self, name: str):
+        if name not in self.syms:
+            if name in self.inits:
+                self.syms[name] = S.Variable(name)
+            else:
+                raise MXNetError(f"undefined ONNX tensor {name!r}")
+        return self.syms[name]
+
+    def const_value(self, name: str) -> onp.ndarray:
+        if name not in self.inits:
+            raise MXNetError(f"ONNX input {name!r} must be an initializer")
+        return self.inits[name]
+
+    def run(self):
+        for name, _, _ in self.graph["inputs"]:
+            if name not in self.inits:
+                self.syms[name] = S.Variable(name)
+        for node in self.graph["nodes"]:
+            conv = _IMPORTERS.get(node["op_type"])
+            if conv is None:
+                raise MXNetError(
+                    f"no importer for ONNX op {node['op_type']!r}")
+            conv(self, node)
+        heads = [self.syms[name] for name, _, _ in self.graph["outputs"]]
+        out = heads[0] if len(heads) == 1 else S.Group(heads)
+        arg_params, aux_params = {}, {}
+        for k, v in self.inits.items():
+            if k in self._used_inits:
+                (aux_params if k in self.aux_names
+                 else arg_params)[k] = NDArray(v)
+        return out, arg_params, aux_params
+
+    _used_inits: set
+
+    def mark_used(self, *names):
+        for n in names:
+            if n in self.inits:
+                self._used_inits.add(n)
+
+
+def _imp_gemm(imp, n):
+    a = n["attrs"]
+    if a.get("transA", 0):
+        raise MXNetError("Gemm transA=1 unsupported")
+    x, w = n["inputs"][0], n["inputs"][1]
+    bias = n["inputs"][2] if len(n["inputs"]) > 2 else None
+    if not a.get("transB", 0):
+        # weight is (in, out): transpose the initializer to mx layout
+        imp.inits[w] = onp.ascontiguousarray(imp.const_value(w).T)
+    num_hidden = imp.inits[w].shape[0] if w in imp.inits else 0
+    args = [imp.sym(x), imp.sym(w)]
+    kw = dict(num_hidden=int(num_hidden), flatten=False,
+              name=n["name"] or None)
+    if bias:
+        args.append(imp.sym(bias))
+    else:
+        kw["no_bias"] = True
+    imp.mark_used(w, bias or "")
+    imp.syms[n["outputs"][0]] = S._apply_op("fully_connected", *args, **kw)
+
+
+def _imp_conv(imp, n):
+    a = n["attrs"]
+    kernel = tuple(int(k) for k in a["kernel_shape"])
+    ndim = len(kernel)
+    args = [imp.sym(i) for i in n["inputs"]]
+    w = imp.const_value(n["inputs"][1])
+    kw = dict(kernel=kernel,
+              stride=tuple(int(s) for s in a.get("strides", [1] * ndim)),
+              pad=_pads(a, ndim),
+              dilate=tuple(int(d) for d in a.get("dilations",
+                                                 [1] * ndim)),
+              num_filter=int(w.shape[0]),
+              num_group=int(a.get("group", 1)),
+              name=n["name"] or None)
+    if len(args) < 3:
+        kw["no_bias"] = True
+    imp.mark_used(*n["inputs"][1:])
+    imp.syms[n["outputs"][0]] = S._apply_op("convolution", *args, **kw)
+
+
+def _imp_act(act):
+    def conv(imp, n):
+        imp.syms[n["outputs"][0]] = S._apply_op(
+            "activation", imp.sym(n["inputs"][0]), act_type=act,
+            name=n["name"] or None)
+    return conv
+
+
+def _imp_pool(ptype, global_pool=False):
+    def conv(imp, n):
+        a = n["attrs"]
+        kw = dict(pool_type=ptype, name=n["name"] or None)
+        if global_pool:
+            kw["global_pool"] = True
+        else:
+            kernel = tuple(int(k) for k in a["kernel_shape"])
+            ndim = len(kernel)
+            kw.update(kernel=kernel,
+                      stride=tuple(int(s) for s in
+                                   a.get("strides", kernel)),
+                      pad=_pads(a, ndim))
+            if ptype == "avg":
+                kw["count_include_pad"] = bool(
+                    a.get("count_include_pad", 1))
+        imp.syms[n["outputs"][0]] = S._apply_op(
+            "pooling", imp.sym(n["inputs"][0]), **kw)
+    return conv
+
+
+def _imp_bn(imp, n):
+    a = n["attrs"]
+    x, gamma, beta, mean, var = n["inputs"][:5]
+    imp.aux_names.update([mean, var])
+    imp.mark_used(gamma, beta, mean, var)
+    imp.syms[n["outputs"][0]] = S._apply_op(
+        "batch_norm", imp.sym(x), imp.sym(gamma), imp.sym(beta),
+        imp.sym(mean), imp.sym(var),
+        eps=float(a.get("epsilon", 1e-5)),
+        momentum=float(a.get("momentum", 0.9)), name=n["name"] or None)
+
+
+def _imp_ln(imp, n):
+    a = n["attrs"]
+    ins = [imp.sym(i) for i in n["inputs"][:3]]
+    imp.mark_used(*n["inputs"][1:3])
+    imp.syms[n["outputs"][0]] = S._apply_op(
+        "layer_norm", *ins, axis=int(a.get("axis", -1)),
+        eps=float(a.get("epsilon", 1e-5)), name=n["name"] or None)
+
+
+def _imp_softmax(imp, n):
+    imp.syms[n["outputs"][0]] = S._apply_op(
+        "softmax", imp.sym(n["inputs"][0]),
+        axis=int(n["attrs"].get("axis", -1)), name=n["name"] or None)
+
+
+def _imp_flatten(imp, n):
+    imp.syms[n["outputs"][0]] = S._apply_op(
+        "flatten", imp.sym(n["inputs"][0]), name=n["name"] or None)
+
+
+def _imp_dropout(imp, n):
+    # inference import: identity (reference does the same)
+    for out in n["outputs"]:
+        imp.syms[out] = imp.sym(n["inputs"][0])
+    imp.mark_used(*n["inputs"][1:])
+    for extra in n["inputs"][1:]:
+        imp.inits.pop(extra, None)
+
+
+def _imp_reshape(imp, n):
+    shape = tuple(int(s) for s in imp.const_value(n["inputs"][1]))
+    imp.inits.pop(n["inputs"][1], None)
+    imp.syms[n["outputs"][0]] = S._apply_op(
+        "reshape", imp.sym(n["inputs"][0]), shape, name=n["name"] or None)
+
+
+def _imp_concat(imp, n):
+    ins = [imp.sym(i) for i in n["inputs"]]
+    imp.syms[n["outputs"][0]] = S._apply_op(
+        "concat", *ins, axis=int(n["attrs"].get("axis", 1)),
+        name=n["name"] or None)
+
+
+def _imp_binop(op):
+    def conv(imp, n):
+        imp.mark_used(*n["inputs"])
+        imp.syms[n["outputs"][0]] = S._apply_op(
+            op, imp.sym(n["inputs"][0]), imp.sym(n["inputs"][1]),
+            name=n["name"] or None)
+    return conv
+
+
+def _imp_gather(imp, n):
+    if int(n["attrs"].get("axis", 0)) != 0:
+        raise MXNetError("Gather axis != 0 unsupported")
+    imp.mark_used(n["inputs"][0])
+    imp.syms[n["outputs"][0]] = S._apply_op(
+        "take", imp.sym(n["inputs"][0]), imp.sym(n["inputs"][1]),
+        axis=0, name=n["name"] or None)
+
+
+def _imp_cast(imp, n):
+    dt = P.onnx_to_np_dtype(int(n["attrs"]["to"]))
+    imp.syms[n["outputs"][0]] = S._apply_op(
+        "cast", imp.sym(n["inputs"][0]), dtype=onp.dtype(dt).name,
+        name=n["name"] or None)
+
+
+def _imp_transpose(imp, n):
+    perm = n["attrs"].get("perm")
+    kw = {"axes": tuple(int(p) for p in perm)} if perm else {}
+    imp.syms[n["outputs"][0]] = S._apply_op(
+        "transpose", imp.sym(n["inputs"][0]), name=n["name"] or None,
+        **kw)
+
+
+def _imp_identity(imp, n):
+    imp.syms[n["outputs"][0]] = imp.sym(n["inputs"][0])
+
+
+_IMPORTERS = {
+    "Gemm": _imp_gemm, "Conv": _imp_conv,
+    "Relu": _imp_act("relu"), "Sigmoid": _imp_act("sigmoid"),
+    "Tanh": _imp_act("tanh"), "Softplus": _imp_act("softrelu"),
+    "Elu": _imp_act("elu"), "Selu": _imp_act("selu"),
+    "Gelu": _imp_act("gelu"),
+    "MaxPool": _imp_pool("max"), "AveragePool": _imp_pool("avg"),
+    "GlobalMaxPool": _imp_pool("max", True),
+    "GlobalAveragePool": _imp_pool("avg", True),
+    "BatchNormalization": _imp_bn, "LayerNormalization": _imp_ln,
+    "Softmax": _imp_softmax, "Flatten": _imp_flatten,
+    "Dropout": _imp_dropout, "Reshape": _imp_reshape,
+    "Concat": _imp_concat,
+    "Add": _imp_binop("add"), "Sub": _imp_binop("subtract"),
+    "Mul": _imp_binop("multiply"), "Div": _imp_binop("divide"),
+    "Max": _imp_binop("maximum"), "Min": _imp_binop("minimum"),
+    "Pow": _imp_binop("power"), "MatMul": _imp_binop("dot"),
+    "Gather": _imp_gather, "Cast": _imp_cast,
+    "Transpose": _imp_transpose, "Identity": _imp_identity,
+}
+
+
+def import_model(onnx_file_path: str):
+    """Load an ONNX file -> ``(sym, arg_params, aux_params)``
+    (reference ``onnx_mxnet.import_model``)."""
+    with open(onnx_file_path, "rb") as f:
+        model = P.parse_model(f.read())
+    imp = _Importer(model)
+    imp._used_inits = set()
+    return imp.run()

@@ -1,0 +1,147 @@
+"""ONNX export/import tests (reference: tests/python-pytest/onnx/) —
+round-trip through the self-contained protobuf codec and compare
+numerics between the original and re-imported graphs."""
+import numpy as onp
+import pytest
+
+import mxnet_tpu as mx
+from mxnet_tpu.contrib.onnx import export_model, import_model
+from mxnet_tpu.contrib.onnx import _proto as P
+
+
+def _roundtrip(sym, params, input_shapes, path, feed):
+    export_model(sym, params, input_shapes, "float32", path)
+    sym2, args2, aux2 = import_model(path)
+    out_ref = sym.eval(**feed, **params)
+    merged = dict(feed)
+    merged.update(args2)
+    merged.update(aux2)
+    out_new = sym2.eval(**merged)
+    ref = out_ref[0] if isinstance(out_ref, (list, tuple)) else out_ref
+    new = out_new[0] if isinstance(out_new, (list, tuple)) else out_new
+    onp.testing.assert_allclose(new.asnumpy(), ref.asnumpy(),
+                                rtol=1e-4, atol=1e-5)
+    return sym2
+
+
+def test_proto_tensor_roundtrip():
+    arr = onp.random.RandomState(0).uniform(-1, 1, (3, 4)) \
+        .astype("float32")
+    name, back = P.parse_tensor(P.tensor("w", arr))
+    assert name == "w"
+    onp.testing.assert_array_equal(back, arr)
+
+
+def test_proto_attribute_roundtrip():
+    for val in (3, 2.5, "hello", [1, 2, 3], [1.0, 2.0]):
+        name, back = P.parse_attribute(P.attribute("a", val))
+        assert name == "a"
+        if isinstance(val, list):
+            assert [type(val[0])(v) for v in back] == val
+        elif isinstance(val, float):
+            assert abs(back - val) < 1e-6
+        else:
+            assert back == val
+
+
+def test_export_import_mlp(tmp_path):
+    rng = onp.random.RandomState(1)
+    data = mx.sym.Variable("data")
+    net = mx.sym.FullyConnected(data, num_hidden=8, name="fc1")
+    net = mx.sym.Activation(net, act_type="relu", name="relu1")
+    net = mx.sym.FullyConnected(net, num_hidden=4, name="fc2")
+    net = mx.sym.softmax(net, axis=-1)
+    params = {
+        "fc1_weight": mx.np.array(rng.uniform(-1, 1, (8, 12))
+                                  .astype("float32")),
+        "fc1_bias": mx.np.array(rng.uniform(-1, 1, (8,))
+                                .astype("float32")),
+        "fc2_weight": mx.np.array(rng.uniform(-1, 1, (4, 8))
+                                  .astype("float32")),
+        "fc2_bias": mx.np.array(rng.uniform(-1, 1, (4,))
+                                .astype("float32")),
+    }
+    x = mx.np.array(rng.uniform(-1, 1, (2, 12)).astype("float32"))
+    _roundtrip(net, params, [(2, 12)], str(tmp_path / "mlp.onnx"),
+               {"data": x})
+
+
+def test_export_import_convnet(tmp_path):
+    rng = onp.random.RandomState(2)
+    data = mx.sym.Variable("data")
+    net = mx.sym.Convolution(data, kernel=(3, 3), pad=(1, 1),
+                             num_filter=4, name="conv1")
+    net = mx.sym.Activation(net, act_type="tanh", name="act1")
+    net = mx.sym.Pooling(net, kernel=(2, 2), stride=(2, 2),
+                         pool_type="max", name="pool1")
+    net = mx.sym.Flatten(net, name="flat")
+    net = mx.sym.FullyConnected(net, num_hidden=3, name="fc")
+    params = {
+        "conv1_weight": mx.np.array(rng.uniform(-0.5, 0.5, (4, 3, 3, 3))
+                                    .astype("float32")),
+        "conv1_bias": mx.np.array(rng.uniform(-0.1, 0.1, (4,))
+                                  .astype("float32")),
+        "fc_weight": mx.np.array(rng.uniform(-0.5, 0.5, (3, 4 * 4 * 4))
+                                 .astype("float32")),
+        "fc_bias": mx.np.array(rng.uniform(-0.1, 0.1, (3,))
+                               .astype("float32")),
+    }
+    x = mx.np.array(rng.uniform(-1, 1, (2, 3, 8, 8)).astype("float32"))
+    _roundtrip(net, params, [(2, 3, 8, 8)],
+               str(tmp_path / "conv.onnx"), {"data": x})
+
+
+def test_export_import_batchnorm_aux(tmp_path):
+    rng = onp.random.RandomState(3)
+    data = mx.sym.Variable("data")
+    net = mx.sym.BatchNorm(data, name="bn")
+    params = {
+        "bn_gamma": mx.np.array(rng.uniform(0.5, 1.5, (5,))
+                                .astype("float32")),
+        "bn_beta": mx.np.array(rng.uniform(-0.5, 0.5, (5,))
+                               .astype("float32")),
+        "bn_moving_mean": mx.np.array(rng.uniform(-0.2, 0.2, (5,))
+                                      .astype("float32")),
+        "bn_moving_var": mx.np.array(rng.uniform(0.5, 1.5, (5,))
+                                     .astype("float32")),
+    }
+    path = str(tmp_path / "bn.onnx")
+    export_model(net, params, [(2, 5, 4, 4)], "float32", path)
+    sym2, args2, aux2 = import_model(path)
+    # moving stats come back as aux params (reference convention)
+    assert set(aux2) == {"bn_moving_mean", "bn_moving_var"}
+    assert set(args2) == {"bn_gamma", "bn_beta"}
+    x = mx.np.array(rng.uniform(-1, 1, (2, 5, 4, 4)).astype("float32"))
+    ref = net.eval(data=x, **params)
+    new = sym2.eval(data=x, **args2, **aux2)
+    ref0 = ref[0] if isinstance(ref, (list, tuple)) else ref
+    new0 = new[0] if isinstance(new, (list, tuple)) else new
+    onp.testing.assert_allclose(new0.asnumpy(), ref0.asnumpy(),
+                                rtol=1e-4, atol=1e-5)
+
+
+def test_export_parsable_model_structure(tmp_path):
+    data = mx.sym.Variable("data")
+    net = mx.sym.FullyConnected(data, num_hidden=2, name="fc")
+    params = {"fc_weight": mx.np.ones((2, 3)),
+              "fc_bias": mx.np.zeros((2,))}
+    path = str(tmp_path / "m.onnx")
+    export_model(net, params, [(1, 3)], "float32", path)
+    model = P.parse_model(open(path, "rb").read())
+    assert model["producer"] == "mxnet_tpu"
+    assert model["opset"] == 13
+    g = model["graph"]
+    assert [n["op_type"] for n in g["nodes"]] == ["Flatten", "Gemm"]
+    assert set(g["initializers"]) == {"fc_weight", "fc_bias"}
+    assert g["inputs"][0][0] == "data"
+    assert list(g["inputs"][0][2]) == [1, 3]
+
+
+def test_export_unsupported_op_raises(tmp_path):
+    data = mx.sym.Variable("data")
+    net = mx.sym.sin(data) if hasattr(mx.sym, "sin") else None
+    if net is None:
+        pytest.skip("no sin symbol op")
+    with pytest.raises(mx.MXNetError, match="no ONNX converter"):
+        export_model(net, {}, [(2, 2)], "float32",
+                     str(tmp_path / "x.onnx"))

@@ -1,0 +1,73 @@
+"""mx.rtc tests — user-authored Pallas kernels (reference:
+tests/python/gpu/test_rtc.py for CudaModule; here PallasModule)."""
+import numpy as onp
+import pytest
+
+import mxnet_tpu as mx
+from mxnet_tpu import rtc
+
+
+def test_pallas_module_elementwise():
+    def axpy_kernel(x_ref, y_ref, o_ref):
+        o_ref[...] = 2.0 * x_ref[...] + y_ref[...]
+
+    mod = rtc.PallasModule(axpy_kernel, name="axpy")
+    f = mod.get_kernel(out_shapes=[((64,), "float32")])
+    rng = onp.random.RandomState(0)
+    x = rng.uniform(-1, 1, (64,)).astype("float32")
+    y = rng.uniform(-1, 1, (64,)).astype("float32")
+    out = f(mx.np.array(x), mx.np.array(y))
+    onp.testing.assert_allclose(out.asnumpy(), 2 * x + y, rtol=1e-6)
+
+
+def test_pallas_module_grid():
+    from jax.experimental import pallas as pl
+
+    def scale_kernel(x_ref, o_ref):
+        o_ref[...] = x_ref[...] * 3.0
+
+    mod = rtc.PallasModule(scale_kernel)
+    f = mod.get_kernel(
+        out_shapes=[((8, 128), "float32")], grid=(2,),
+        in_specs=[pl.BlockSpec((4, 128), lambda i: (i, 0))],
+        out_specs=pl.BlockSpec((4, 128), lambda i: (i, 0)))
+    x = onp.random.RandomState(1).uniform(-1, 1, (8, 128)) \
+        .astype("float32")
+    out = f(mx.np.array(x))
+    onp.testing.assert_allclose(out.asnumpy(), x * 3.0, rtol=1e-6)
+
+
+def test_pallas_module_autograd_with_vjp():
+    """rtc kernels join the tape when a vjp is supplied."""
+    def square_kernel(x_ref, o_ref):
+        o_ref[...] = x_ref[...] * x_ref[...]
+
+    f = rtc.PallasModule(square_kernel).get_kernel(
+        out_shapes=[((16,), "float32")],
+        vjp=lambda cot, x: [cot * 2.0 * x])
+    x = mx.np.array(onp.linspace(-1, 1, 16).astype("float32"))
+    x.attach_grad()
+    with mx.autograd.record():
+        y = f(x)
+    y.backward()
+    onp.testing.assert_allclose(x.grad.asnumpy(),
+                                2 * x.asnumpy(), rtol=1e-5)
+
+
+def test_pallas_module_not_differentiable_without_vjp():
+    def square_kernel(x_ref, o_ref):
+        o_ref[...] = x_ref[...] * x_ref[...]
+
+    f = rtc.PallasModule(square_kernel).get_kernel(
+        out_shapes=[((4,), "float32")])
+    x = mx.np.array(onp.ones(4, dtype="float32"))
+    x.attach_grad()
+    with pytest.raises(Exception):
+        with mx.autograd.record():
+            y = f(x)
+        y.backward()
+
+
+def test_cuda_module_raises_with_guidance():
+    with pytest.raises(mx.MXNetError, match="PallasModule"):
+        rtc.CudaModule("__global__ void k() {}")
