@@ -245,3 +245,51 @@ class SPMDTrainer:
     @property
     def learning_rate(self) -> float:
         return self.optimizer.learning_rate
+
+    # -- checkpoint / resume (reference SURVEY.md 5.4: .params format +
+    # sharded device-resident trainer state keyed by param names) --------
+    def save_checkpoint(self, prefix: str) -> None:
+        """Write ``prefix.params`` (reference-format, interop-safe) and
+        ``prefix.states`` (optimizer state + step count).  Sharded arrays
+        are gathered to host; shardings are re-applied on load."""
+        import pickle
+        import numpy as onp
+        from .. import ndarray_io
+        ndarray_io.save_params(
+            prefix + ".params",
+            {n: from_jax(p.data()._data)
+             for n, p in zip(self._names, self._params)})
+        payload = {
+            "step_count": self._step_count,
+            "opt_states": [jax.tree_util.tree_map(onp.asarray, s)
+                           for s in self._opt_states],
+            "names": self._names,
+        }
+        with open(prefix + ".states", "wb") as f:
+            pickle.dump(payload, f)
+
+    def load_checkpoint(self, prefix: str) -> None:
+        """Restore a :meth:`save_checkpoint`; parameters and optimizer
+        state land back on the mesh with their recorded shardings."""
+        import pickle
+        from .. import ndarray_io
+        loaded = ndarray_io.load_params(prefix + ".params")
+        missing = [n for n in self._names if n not in loaded]
+        if missing:
+            raise MXNetError(f"checkpoint {prefix}.params missing "
+                             f"parameters {missing}")
+        for name, p, sh in zip(self._names, self._params,
+                               self._param_shardings):
+            p._data._data = jax.device_put(loaded[name]._data, sh)
+        with open(prefix + ".states", "rb") as f:
+            payload = pickle.load(f)
+        if payload["names"] != self._names:
+            raise MXNetError("checkpoint parameter names do not match "
+                             "this trainer's model")
+        self._step_count = payload["step_count"]
+        self.optimizer.num_update = self._step_count
+        self._opt_states = [
+            jax.tree_util.tree_map(
+                lambda a, s=sh: jax.device_put(jnp.asarray(a), s), st)
+            for st, sh in zip(payload["opt_states"],
+                              self._param_shardings)]
