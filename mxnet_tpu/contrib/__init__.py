@@ -5,3 +5,5 @@ driver, onnx, tensorboard hooks, …).
 """
 from . import quantization  # noqa: F401
 from . import onnx  # noqa: F401
+from . import text  # noqa: F401
+from . import svrg  # noqa: F401

@@ -1,0 +1,229 @@
+"""Text utilities: vocabulary + token embeddings.
+
+Reference parity (leezu/mxnet): ``python/mxnet/contrib/text/`` —
+``vocab.Vocabulary``, ``embedding.TokenEmbedding`` (GloVe/fastText
+loaders, CustomEmbedding from local files), ``utils.count_tokens_from_str``.
+
+Pretrained downloads are out (zero egress); the file-format loaders read
+local GloVe/fastText-style text files, which is what the reference's
+loaders do after download.
+"""
+from __future__ import annotations
+
+import collections
+import re
+from typing import Any, Dict, Iterable, List, Optional, Sequence, Union
+
+import numpy as onp
+
+from ..base import MXNetError
+from ..ndarray.ndarray import NDArray
+
+__all__ = ["Vocabulary", "CustomEmbedding", "count_tokens_from_str",
+           "register_embedding", "create"]
+
+
+def count_tokens_from_str(source_str: str, token_delim: str = " ",
+                          seq_delim: str = "\n", to_lower: bool = False,
+                          counter_to_update: Optional[
+                              collections.Counter] = None
+                          ) -> collections.Counter:
+    """Count tokens (reference ``text.utils.count_tokens_from_str``)."""
+    source_str = re.sub(f"[{token_delim}{seq_delim}]+", " ", source_str)
+    if to_lower:
+        source_str = source_str.lower()
+    counter = counter_to_update if counter_to_update is not None \
+        else collections.Counter()
+    counter.update(t for t in source_str.split(" ") if t)
+    return counter
+
+
+class Vocabulary:
+    """Indexed vocabulary with unknown + reserved tokens
+    (reference ``text.vocab.Vocabulary``)."""
+
+    def __init__(self, counter: Optional[collections.Counter] = None,
+                 most_freq_count: Optional[int] = None, min_freq: int = 1,
+                 unknown_token: str = "<unk>",
+                 reserved_tokens: Optional[Sequence[str]] = None) -> None:
+        if min_freq < 1:
+            raise MXNetError("min_freq must be >= 1")
+        reserved_tokens = list(reserved_tokens or [])
+        if unknown_token in reserved_tokens:
+            raise MXNetError("unknown_token must not be in reserved_tokens")
+        if len(set(reserved_tokens)) != len(reserved_tokens):
+            raise MXNetError("reserved_tokens must be unique")
+        self._unknown_token = unknown_token
+        self._idx_to_token: List[str] = [unknown_token] + reserved_tokens
+        self._reserved_tokens = reserved_tokens
+        if counter is not None:
+            pairs = sorted(counter.items(), key=lambda kv: (-kv[1], kv[0]))
+            if most_freq_count is not None:
+                pairs = pairs[:most_freq_count]
+            for tok, freq in pairs:
+                if freq < min_freq:
+                    break
+                if tok != unknown_token and tok not in reserved_tokens:
+                    self._idx_to_token.append(tok)
+        self._token_to_idx = {t: i
+                              for i, t in enumerate(self._idx_to_token)}
+
+    def __len__(self) -> int:
+        return len(self._idx_to_token)
+
+    @property
+    def token_to_idx(self) -> Dict[str, int]:
+        return self._token_to_idx
+
+    @property
+    def idx_to_token(self) -> List[str]:
+        return self._idx_to_token
+
+    @property
+    def unknown_token(self) -> str:
+        return self._unknown_token
+
+    @property
+    def reserved_tokens(self) -> List[str]:
+        return self._reserved_tokens
+
+    def to_indices(self, tokens: Union[str, Sequence[str]]):
+        single = isinstance(tokens, str)
+        toks = [tokens] if single else list(tokens)
+        idx = [self._token_to_idx.get(t, 0) for t in toks]
+        return idx[0] if single else idx
+
+    def to_tokens(self, indices: Union[int, Sequence[int]]):
+        single = isinstance(indices, int)
+        idxs = [indices] if single else list(indices)
+        for i in idxs:
+            if not 0 <= i < len(self):
+                raise MXNetError(f"token index {i} out of range")
+        toks = [self._idx_to_token[i] for i in idxs]
+        return toks[0] if single else toks
+
+
+class _TokenEmbedding:
+    """Base: vocabulary-aligned embedding matrix with unknown fallback."""
+
+    def __init__(self, vocabulary: Optional[Vocabulary] = None) -> None:
+        self._vocab = vocabulary
+        self._idx_to_vec: Optional[onp.ndarray] = None
+
+    @property
+    def vec_len(self) -> int:
+        return 0 if self._idx_to_vec is None else self._idx_to_vec.shape[1]
+
+    @property
+    def idx_to_vec(self) -> NDArray:
+        return NDArray(self._idx_to_vec)
+
+    def _load_embedding_file(self, path: str, elem_delim: str = " ",
+                             encoding: str = "utf-8"
+                             ) -> Dict[str, onp.ndarray]:
+        vecs: Dict[str, onp.ndarray] = {}
+        with open(path, encoding=encoding) as f:
+            for line_num, line in enumerate(f):
+                parts = line.rstrip().split(elem_delim)
+                if line_num == 0 and len(parts) == 2 \
+                        and parts[0].isdigit() and parts[1].isdigit():
+                    continue        # fastText header "count dim"
+                tok, elems = parts[0], parts[1:]
+                if len(elems) <= 1:
+                    continue        # malformed line (reference warns)
+                vecs[tok] = onp.asarray([float(e) for e in elems],
+                                        dtype=onp.float32)
+        if not vecs:
+            raise MXNetError(f"no embedding vectors parsed from {path}")
+        return vecs
+
+    def _build(self, token_vecs: Dict[str, onp.ndarray],
+               init_unknown_vec) -> None:
+        dim = len(next(iter(token_vecs.values())))
+        if self._vocab is None:
+            counter = collections.Counter(
+                {t: 1 for t in token_vecs})
+            self._vocab = Vocabulary(counter)
+        n = len(self._vocab)
+        mat = onp.stack([init_unknown_vec(dim)] * n)
+        for tok, vec in token_vecs.items():
+            i = self._vocab.token_to_idx.get(tok)
+            if i is not None and len(vec) == dim:
+                mat[i] = vec
+        self._idx_to_vec = mat.astype(onp.float32)
+
+    # vocabulary passthroughs
+    def __len__(self) -> int:
+        return len(self._vocab)
+
+    @property
+    def token_to_idx(self):
+        return self._vocab.token_to_idx
+
+    @property
+    def idx_to_token(self):
+        return self._vocab.idx_to_token
+
+    def get_vecs_by_tokens(self, tokens: Union[str, Sequence[str]],
+                           lower_case_backup: bool = False) -> NDArray:
+        single = isinstance(tokens, str)
+        toks = [tokens] if single else list(tokens)
+        t2i = self._vocab.token_to_idx
+        idx = []
+        for t in toks:
+            i = t2i.get(t)
+            if i is None and lower_case_backup:
+                i = t2i.get(t.lower())
+            idx.append(0 if i is None else i)
+        out = self._idx_to_vec[idx]
+        return NDArray(out[0] if single else out)
+
+    def update_token_vectors(self, tokens: Union[str, Sequence[str]],
+                             new_vectors: Any) -> None:
+        toks = [tokens] if isinstance(tokens, str) else list(tokens)
+        arr = new_vectors.asnumpy() if isinstance(new_vectors, NDArray) \
+            else onp.asarray(new_vectors)
+        arr = arr.reshape(len(toks), -1)
+        for t, v in zip(toks, arr):
+            i = self._vocab.token_to_idx.get(t)
+            if i is None:
+                raise MXNetError(f"token {t!r} not in vocabulary")
+            self._idx_to_vec[i] = v
+
+
+class CustomEmbedding(_TokenEmbedding):
+    """Embedding from a local GloVe/fastText-style text file
+    (reference ``text.embedding.CustomEmbedding``)."""
+
+    def __init__(self, pretrained_file_path: str, elem_delim: str = " ",
+                 encoding: str = "utf-8",
+                 init_unknown_vec=onp.zeros,
+                 vocabulary: Optional[Vocabulary] = None) -> None:
+        super().__init__(vocabulary)
+        vecs = self._load_embedding_file(pretrained_file_path, elem_delim,
+                                         encoding)
+        self._build(vecs, init_unknown_vec)
+
+
+_EMBED_REGISTRY: Dict[str, type] = {"custom": CustomEmbedding}
+
+
+def register_embedding(name: str, cls: type) -> type:
+    """Register an embedding loader (reference ``TokenEmbedding.register``)."""
+    _EMBED_REGISTRY[name.lower()] = cls
+    return cls
+
+
+def create(embedding_name: str, **kwargs: Any):
+    """Create a registered embedding (reference ``text.embedding.create``).
+    Note: 'glove'/'fasttext' pretrained downloads need network access —
+    point CustomEmbedding at a local vector file instead."""
+    try:
+        cls = _EMBED_REGISTRY[embedding_name.lower()]
+    except KeyError:
+        raise MXNetError(
+            f"unknown embedding {embedding_name!r} (registered: "
+            f"{sorted(_EMBED_REGISTRY)}); pretrained downloads are "
+            "unavailable offline — use 'custom' with a local file") \
+            from None
+    return cls(**kwargs)

@@ -1,0 +1,110 @@
+"""contrib.text (vocab + embeddings) and contrib.svrg tests
+(reference: tests/python/unittest/test_contrib_text.py, test_contrib_svrg_*)."""
+import collections
+
+import numpy as onp
+import pytest
+
+import mxnet_tpu as mx
+from mxnet_tpu.contrib import text as ctext
+from mxnet_tpu.contrib.svrg import SVRGTrainer
+
+
+# -- text -------------------------------------------------------------------
+
+def test_count_tokens_from_str():
+    c = ctext.count_tokens_from_str("a b c\nb c c")
+    assert c == collections.Counter({"c": 3, "b": 2, "a": 1})
+    c2 = ctext.count_tokens_from_str("A a", to_lower=True)
+    assert c2["a"] == 2
+
+
+def test_vocabulary_order_and_unknown():
+    counter = collections.Counter({"c": 3, "b": 2, "a": 1, "rare": 1})
+    v = ctext.Vocabulary(counter, min_freq=2, unknown_token="<unk>",
+                         reserved_tokens=["<pad>"])
+    assert v.idx_to_token[:2] == ["<unk>", "<pad>"]
+    assert v.to_indices("c") == 2          # most frequent first
+    assert v.to_indices("rare") == 0       # filtered by min_freq -> unk
+    assert v.to_tokens([0, 2]) == ["<unk>", "c"]
+    with pytest.raises(mx.MXNetError):
+        v.to_tokens(99)
+    assert len(ctext.Vocabulary(counter, most_freq_count=2)) == 3
+
+
+def test_custom_embedding_loads_file(tmp_path):
+    path = tmp_path / "vecs.txt"
+    path.write_text("hello 0.1 0.2 0.3\nworld 0.4 0.5 0.6\n")
+    emb = ctext.CustomEmbedding(str(path))
+    assert emb.vec_len == 3
+    v = emb.get_vecs_by_tokens("hello").asnumpy()
+    onp.testing.assert_allclose(v, [0.1, 0.2, 0.3], rtol=1e-6)
+    # unknown -> zero vector
+    onp.testing.assert_allclose(
+        emb.get_vecs_by_tokens("missing").asnumpy(), [0, 0, 0])
+    # with an explicit vocabulary
+    counter = collections.Counter({"world": 2, "other": 1})
+    vocab = ctext.Vocabulary(counter)
+    emb2 = ctext.CustomEmbedding(str(path), vocabulary=vocab)
+    onp.testing.assert_allclose(
+        emb2.get_vecs_by_tokens("world").asnumpy(), [0.4, 0.5, 0.6],
+        rtol=1e-6)
+    emb2.update_token_vectors("other", onp.array([1.0, 1.0, 1.0]))
+    onp.testing.assert_allclose(
+        emb2.get_vecs_by_tokens("other").asnumpy(), [1, 1, 1])
+
+
+def test_fasttext_header_skipped(tmp_path):
+    path = tmp_path / "ft.txt"
+    path.write_text("2 3\na 1 2 3\nb 4 5 6\n")
+    emb = ctext.CustomEmbedding(str(path))
+    assert emb.vec_len == 3
+    assert set(["a", "b"]) <= set(emb.token_to_idx)
+
+
+def test_embedding_registry():
+    assert "custom" in ctext._EMBED_REGISTRY
+    with pytest.raises(mx.MXNetError, match="unknown embedding"):
+        ctext.create("glove")
+
+
+# -- svrg -------------------------------------------------------------------
+
+def test_svrg_converges_linear_regression():
+    """SVRG on least squares: loss must drop well below the start."""
+    mx.random.seed(0)
+    rng = onp.random.RandomState(1)
+    W_true = rng.uniform(-1, 1, (3, 8)).astype("float32")
+    X = rng.uniform(-1, 1, (64, 8)).astype("float32")
+    Y = X @ W_true.T
+
+    net = mx.gluon.nn.Dense(3, use_bias=False)
+    net.initialize()
+    net(mx.np.array(X[:1]))
+
+    tr = SVRGTrainer(net, "sgd", {"learning_rate": 1.0})
+    loss_fn = mx.gluon.loss.L2Loss()
+    Xn, Yn = mx.np.array(X), mx.np.array(Y)
+
+    def full_iter():
+        for i in range(0, 64, 16):
+            yield Xn[i:i + 16], Yn[i:i + 16]
+
+    with mx.autograd.record():
+        first = float(loss_fn(net(Xn), Yn).mean().asnumpy())
+    for _ in range(8):
+        tr.update_snapshot(full_iter(), loss_fn)
+        for i in range(0, 64, 16):
+            loss = tr.step_svrg(Xn[i:i + 16], Yn[i:i + 16], loss_fn)
+    final = float(loss_fn(net(Xn), Yn).mean().asnumpy())
+    assert final < first * 0.01, (first, final)
+
+
+def test_svrg_requires_snapshot():
+    net = mx.gluon.nn.Dense(2)
+    net.initialize()
+    net(mx.np.zeros((1, 4)))
+    tr = SVRGTrainer(net)
+    with pytest.raises(mx.MXNetError, match="update_snapshot"):
+        tr.step_svrg(mx.np.zeros((2, 4)), mx.np.zeros((2,)),
+                     mx.gluon.loss.L2Loss())
