@@ -1,0 +1,215 @@
+/*
+ * Native runtime unit tests.
+ *
+ * Reference parity (leezu/mxnet): tests/cpp/engine/threaded_engine_test.cc
+ * (random dependency DAGs stressing the engine, compared against serial
+ * execution), tests/cpp/storage/storage_test.cc, and recordio framing
+ * round-trips.  Assert-based single binary (`make -C src test`).
+ */
+#include <atomic>
+#include <cstdio>
+#include <cstdlib>
+#include <cstring>
+#include <random>
+#include <string>
+#include <vector>
+
+#include "../mxtpu.h"
+
+#define CHECK(cond)                                                        \
+  do {                                                                     \
+    if (!(cond)) {                                                         \
+      std::fprintf(stderr, "CHECK failed at %s:%d: %s\n", __FILE__,        \
+                   __LINE__, #cond);                                       \
+      std::fprintf(stderr, "last error: %s\n", MXGetLastError());          \
+      std::exit(1);                                                        \
+    }                                                                      \
+  } while (0)
+
+/* ---- engine: random DAG result must equal serial execution ---- */
+
+struct DagCtx {
+  std::vector<long long> *cells;
+  std::vector<int> reads;
+  std::vector<int> writes;
+  int serial;     /* op id, for the serial replay */
+};
+
+static void dag_fn(void *vctx) {
+  DagCtx *c = static_cast<DagCtx *>(vctx);
+  long long acc = 1;
+  for (int r : c->reads) acc += (*c->cells)[r];
+  for (int w : c->writes) (*c->cells)[w] = (*c->cells)[w] * 31 + acc;
+}
+
+static std::vector<long long> run_dag(int n_vars, int n_ops, int naive,
+                                      unsigned seed) {
+  EngineHandle eng;
+  CHECK(MXEngineCreate(4, naive, &eng) == 0);
+  std::vector<EngineVarHandle> vars(n_vars);
+  for (int i = 0; i < n_vars; ++i)
+    CHECK(MXEngineNewVar(eng, &vars[i]) == 0);
+
+  std::vector<long long> cells(n_vars, 0);
+  std::mt19937 rng(seed);
+  std::vector<DagCtx> ctxs(n_ops);
+  for (int op = 0; op < n_ops; ++op) {
+    DagCtx &c = ctxs[op];
+    c.cells = &cells;
+    c.serial = op;
+    int n_read = 1 + (int)(rng() % 3), n_write = 1 + (int)(rng() % 2);
+    for (int i = 0; i < n_read; ++i) c.reads.push_back(rng() % n_vars);
+    for (int i = 0; i < n_write; ++i) c.writes.push_back(rng() % n_vars);
+    std::vector<EngineVarHandle> rv, wv;
+    for (int r : c.reads) rv.push_back(vars[r]);
+    for (int w : c.writes) wv.push_back(vars[w]);
+    CHECK(MXEnginePushAsync(eng, dag_fn, &c, nullptr, rv.data(),
+                            (int)rv.size(), wv.data(), (int)wv.size(), 0,
+                            "dag_op") == 0);
+  }
+  CHECK(MXEngineWaitAll(eng) == 0);
+  for (int i = 0; i < n_vars; ++i) CHECK(MXEngineFreeVar(eng, vars[i]) == 0);
+  CHECK(MXEngineFree(eng) == 0);
+  return cells;
+}
+
+static void test_engine_dag_matches_serial() {
+  for (unsigned seed = 1; seed <= 5; ++seed) {
+    std::vector<long long> threaded = run_dag(8, 200, /*naive=*/0, seed);
+    std::vector<long long> serial = run_dag(8, 200, /*naive=*/1, seed);
+    CHECK(threaded == serial);
+  }
+  std::puts("engine_dag_matches_serial OK");
+}
+
+/* writers to one var must serialize: counter increments never lost */
+struct IncCtx { std::atomic<int> *started; long long *cell; };
+static void inc_fn(void *vctx) {
+  IncCtx *c = static_cast<IncCtx *>(vctx);
+  c->started->fetch_add(1);
+  long long v = *c->cell;            /* deliberate read-modify-write */
+  for (volatile int i = 0; i < 100; ++i) {}
+  *c->cell = v + 1;
+}
+
+static void test_engine_writer_serialization() {
+  EngineHandle eng;
+  CHECK(MXEngineCreate(8, 0, &eng) == 0);
+  EngineVarHandle var;
+  CHECK(MXEngineNewVar(eng, &var) == 0);
+  std::atomic<int> started{0};
+  long long cell = 0;
+  const int kOps = 500;
+  std::vector<IncCtx> ctxs(kOps, IncCtx{&started, &cell});
+  for (int i = 0; i < kOps; ++i)
+    CHECK(MXEnginePushAsync(eng, inc_fn, &ctxs[i], nullptr, nullptr, 0,
+                            &var, 1, 0, "inc") == 0);
+  CHECK(MXEngineWaitForVar(eng, var) == 0);
+  CHECK(cell == kOps);
+  CHECK(started.load() == kOps);
+  CHECK(MXEngineFreeVar(eng, var) == 0);
+  CHECK(MXEngineFree(eng) == 0);
+  std::puts("engine_writer_serialization OK");
+}
+
+static void test_engine_profile_dump() {
+  EngineHandle eng;
+  CHECK(MXEngineCreate(2, 0, &eng) == 0);
+  CHECK(MXEngineSetProfiling(eng, 1) == 0);
+  EngineVarHandle var;
+  CHECK(MXEngineNewVar(eng, &var) == 0);
+  std::atomic<int> started{0};
+  long long cell = 0;
+  IncCtx c{&started, &cell};
+  CHECK(MXEnginePushAsync(eng, inc_fn, &c, nullptr, nullptr, 0, &var, 1, 0,
+                          "profiled_op") == 0);
+  CHECK(MXEngineWaitAll(eng) == 0);
+  char *json = nullptr;
+  CHECK(MXEngineDumpProfile(eng, &json) == 0);
+  CHECK(json != nullptr);
+  CHECK(std::strstr(json, "profiled_op") != nullptr);
+  CHECK(std::strstr(json, "\"ph\"") != nullptr);
+  CHECK(MXFreeString(json) == 0);
+  CHECK(MXEngineFreeVar(eng, var) == 0);
+  CHECK(MXEngineFree(eng) == 0);
+  std::puts("engine_profile_dump OK");
+}
+
+/* ---- storage pool ---- */
+
+static void test_storage_pool_reuse() {
+  CHECK(MXStorageReleaseAll() == 0);
+  void *a = nullptr;
+  CHECK(MXStorageAlloc(1 << 20, &a) == 0 && a != nullptr);
+  std::memset(a, 0xAB, 1 << 20);
+  CHECK(MXStorageFree(a) == 0);
+  void *b = nullptr;
+  CHECK(MXStorageAlloc(1 << 20, &b) == 0);
+  uint64_t in_use, pooled, hits, misses;
+  CHECK(MXStorageStats(&in_use, &pooled, &hits, &misses) == 0);
+  CHECK(hits >= 1);          /* second alloc served from the pool */
+  CHECK(in_use >= (1 << 20));
+  CHECK(MXStorageFree(b) == 0);
+  CHECK(MXStorageReleaseAll() == 0);
+  std::puts("storage_pool_reuse OK");
+}
+
+/* ---- recordio ---- */
+
+static void test_recordio_roundtrip() {
+  const char *path = "/tmp/mxtpu_test.rec";
+  RecordIOHandle w;
+  CHECK(MXRecordIOWriterCreate(path, &w) == 0);
+  std::vector<std::string> recs;
+  std::mt19937 rng(7);
+  for (int i = 0; i < 50; ++i) {
+    std::string s(1 + rng() % 300, '\0');
+    for (auto &ch : s) ch = (char)(rng() & 0xFF);   /* incl. magic bytes */
+    uint64_t pos;
+    CHECK(MXRecordIOWriterWrite(w, s.data(), s.size(), &pos) == 0);
+    recs.push_back(s);
+  }
+  CHECK(MXRecordIOWriterFree(w) == 0);
+
+  RecordIOHandle r;
+  CHECK(MXRecordIOReaderCreate(path, &r) == 0);
+  uint64_t *positions; uint64_t count;
+  CHECK(MXRecordIOReaderScanIndex(r, &positions, &count) == 0);
+  CHECK(count == recs.size());
+  for (size_t i = 0; i < recs.size(); ++i) {
+    const char *data; uint64_t size;
+    CHECK(MXRecordIOReaderNext(r, &data, &size) == 0);
+    CHECK(data != nullptr && size == recs[i].size());
+    CHECK(std::memcmp(data, recs[i].data(), size) == 0);
+  }
+  const char *data; uint64_t size;
+  CHECK(MXRecordIOReaderNext(r, &data, &size) == 0);
+  CHECK(data == nullptr);       /* EOF */
+  /* random access via the index */
+  CHECK(MXRecordIOReaderSeek(r, positions[10]) == 0);
+  CHECK(MXRecordIOReaderNext(r, &data, &size) == 0);
+  CHECK(size == recs[10].size());
+  CHECK(std::memcmp(data, recs[10].data(), size) == 0);
+  CHECK(MXFreeBuffer(positions) == 0);
+  CHECK(MXRecordIOReaderFree(r) == 0);
+  std::remove(path);
+  std::puts("recordio_roundtrip OK");
+}
+
+static void test_error_message() {
+  RecordIOHandle r;
+  CHECK(MXRecordIOReaderCreate("/nonexistent/path.rec", &r) != 0);
+  CHECK(std::strlen(MXGetLastError()) > 0);
+  std::puts("error_message OK");
+}
+
+int main() {
+  test_engine_dag_matches_serial();
+  test_engine_writer_serialization();
+  test_engine_profile_dump();
+  test_storage_pool_reuse();
+  test_recordio_roundtrip();
+  test_error_message();
+  std::puts("ALL C++ TESTS PASSED");
+  return 0;
+}

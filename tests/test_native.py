@@ -291,3 +291,14 @@ def test_prefetcher_next_after_epoch_end_returns_empty(tmp_path):
         assert len(pf.next_batch()) == 2
     finally:
         pf.close()
+
+
+def test_cpp_unit_suite():
+    """Build+run the native C++ unit tests (reference: tests/cpp/)."""
+    import os
+    import subprocess
+    root = os.path.dirname(os.path.dirname(os.path.abspath(__file__)))
+    r = subprocess.run(["make", "-C", os.path.join(root, "src"), "test"],
+                       capture_output=True, text=True, timeout=300)
+    assert r.returncode == 0, r.stdout + r.stderr
+    assert "ALL C++ TESTS PASSED" in r.stdout
