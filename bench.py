@@ -22,6 +22,104 @@ sys.path.insert(0, os.path.dirname(os.path.abspath(__file__)))
 BASELINE_IMG_S = 300.0  # midpoint of BASELINE.md sanity band (unverified)
 
 
+def bench_bert(batch: int, steps: int, dtype: str, seq_len: int) -> None:
+    """Config 3: BERT-base MLM step throughput, tokens/sec/chip."""
+    import numpy as onp
+    import jax
+    import mxnet_tpu as mx
+    from mxnet_tpu.gluon.model_zoo.bert import get_bert
+    from mxnet_tpu.parallel import SPMDTrainer, make_mesh, \
+        DATA_PARALLEL_RULES
+
+    mx.random.seed(0)
+    net = get_bert("bert_12_768_12", vocab_size=30522, dropout=0.0,
+                   use_pooler=False, use_decoder=False,
+                   use_classifier=False)
+    net.initialize()
+    net(mx.np.zeros((2, 32), dtype="int32"), None, None)
+    if dtype != "float32":
+        net.cast(dtype)
+
+    loss_fn = mx.gluon.loss.SoftmaxCrossEntropyLoss(axis=-1)
+
+    class MLMLoss:
+        def __call__(self, seq_out, labels):
+            return loss_fn(seq_out, labels)
+
+    mesh = make_mesh({"dp": 1}, devices=jax.devices()[:1])
+    trainer = SPMDTrainer(net, MLMLoss(), optimizer="adamw",
+                          optimizer_params={"learning_rate": 1e-4},
+                          mesh=mesh, rules=DATA_PARALLEL_RULES)
+    rng = onp.random.RandomState(0)
+    x = mx.np.array(rng.randint(0, 30522, (batch, seq_len))
+                    .astype("int32"))
+    y = mx.np.array(rng.randint(0, 768, (batch, seq_len))
+                    .astype("int32"))
+    trainer.step(x, y).wait_to_read()
+    t0 = time.perf_counter()
+    for _ in range(steps):
+        loss = trainer.step(x, y)
+    loss.wait_to_read()
+    dt = time.perf_counter() - t0
+    tok_s = batch * seq_len * steps / dt
+    print(json.dumps({
+        "metric": f"bert_base_mlm_{dtype}_b{batch}x{seq_len}_train",
+        "value": round(tok_s, 1), "unit": "tokens/sec/chip",
+        "vs_baseline": 0.0}))
+
+
+def bench_lstm(batch: int, steps: int, dtype: str, seq_len: int) -> None:
+    """Config 4: 2-layer LSTM LM (PTB-shape) tokens/sec/chip."""
+    import numpy as onp
+    import jax
+    import mxnet_tpu as mx
+    from mxnet_tpu.parallel import SPMDTrainer, make_mesh, \
+        DATA_PARALLEL_RULES
+
+    vocab, embed, hidden = 10000, 650, 650
+    mx.random.seed(0)
+
+    class LM(mx.gluon.HybridBlock):
+        def __init__(self):
+            super().__init__()
+            self.emb = mx.gluon.nn.Embedding(vocab, embed)
+            self.rnn = mx.gluon.rnn.LSTM(hidden, num_layers=2,
+                                         layout="NTC")
+            self.out = mx.gluon.nn.Dense(vocab, flatten=False)
+
+        def forward(self, x):
+            return self.out(self.rnn(self.emb(x)))
+
+    net = LM()
+    net.initialize()
+    net(mx.np.zeros((2, 8), dtype="int32"))
+    if dtype != "float32":
+        net.cast(dtype)
+
+    loss_fn = mx.gluon.loss.SoftmaxCrossEntropyLoss(axis=-1)
+    mesh = make_mesh({"dp": 1}, devices=jax.devices()[:1])
+    trainer = SPMDTrainer(net, lambda o, l: loss_fn(o, l),
+                          optimizer="sgd",
+                          optimizer_params={"learning_rate": 1.0},
+                          mesh=mesh, rules=DATA_PARALLEL_RULES)
+    rng = onp.random.RandomState(0)
+    x = mx.np.array(rng.randint(0, vocab, (batch, seq_len))
+                    .astype("int32"))
+    y = mx.np.array(rng.randint(0, vocab, (batch, seq_len))
+                    .astype("int32"))
+    trainer.step(x, y).wait_to_read()
+    t0 = time.perf_counter()
+    for _ in range(steps):
+        loss = trainer.step(x, y)
+    loss.wait_to_read()
+    dt = time.perf_counter() - t0
+    tok_s = batch * seq_len * steps / dt
+    print(json.dumps({
+        "metric": f"lstm_ptb_{dtype}_b{batch}x{seq_len}_train",
+        "value": round(tok_s, 1), "unit": "tokens/sec/chip",
+        "vs_baseline": 0.0}))
+
+
 def main() -> None:
     import numpy as onp
     import jax
@@ -32,6 +130,13 @@ def main() -> None:
     dtype = os.environ.get("MXNET_BENCH_DTYPE", "float32")
     img = int(os.environ.get("MXNET_BENCH_IMAGE", "224"))
 
+    if model_name.startswith("bert"):
+        return bench_bert(batch, steps, dtype,
+                          int(os.environ.get("MXNET_BENCH_SEQLEN", "512")))
+    if model_name.startswith("lstm"):
+        return bench_lstm(batch, steps, dtype,
+                          int(os.environ.get("MXNET_BENCH_SEQLEN", "35")))
+
     import mxnet_tpu as mx
     from mxnet_tpu.gluon.model_zoo import vision as zoo
     from mxnet_tpu.parallel import SPMDTrainer, make_mesh, \
@@ -40,13 +145,14 @@ def main() -> None:
     mx.random.seed(0)
     net = zoo.get_model(model_name, classes=1000)
     net.initialize()
-    if dtype != "float32":
-        net.cast(dtype)
 
     x_np = onp.random.uniform(-1, 1, (batch, 3, img, img)).astype(dtype)
     y_np = onp.random.randint(0, 1000, (batch,)).astype("int32")
-    # settle deferred shapes once (eagerly, off the clock)
-    net(mx.np.array(x_np[:1]))
+    # settle deferred shapes once (eagerly, off the clock), THEN cast —
+    # casting first would leave late-initialized params in float32
+    net(mx.np.array(x_np[:1].astype("float32")))
+    if dtype != "float32":
+        net.cast(dtype)
 
     mesh = make_mesh({"dp": 1}, devices=jax.devices()[:1])
     trainer = SPMDTrainer(

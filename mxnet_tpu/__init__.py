@@ -61,6 +61,10 @@ _LAZY = {
     "contrib": ".contrib",
     "subgraph": ".subgraph",
     "rtc": ".rtc",
+    "name": ".name",
+    "attribute": ".attribute",
+    "visualization": ".visualization",
+    "viz": ".visualization",
 }
 
 

@@ -1,0 +1,56 @@
+"""Symbol attribute scopes.
+
+Reference parity (leezu/mxnet): ``python/mxnet/attribute.py`` —
+``AttrScope``: attributes applied to every symbol created inside the
+``with`` block (e.g. ``ctx_group`` for manual model parallelism,
+``lr_mult``/``wd_mult``).
+"""
+from __future__ import annotations
+
+import threading
+from typing import Dict, Optional
+
+__all__ = ["AttrScope"]
+
+
+class _Current(threading.local):
+    def __init__(self) -> None:
+        self.scope: Optional["AttrScope"] = None
+
+
+_CURRENT = _Current()
+
+
+class AttrScope:
+    """``with AttrScope(ctx_group='dev1'):`` — every symbol created in the
+    block carries these user attributes (stackable; inner wins)."""
+
+    def __init__(self, **attrs: str) -> None:
+        for v in attrs.values():
+            if not isinstance(v, str):
+                raise ValueError("AttrScope values must be strings")
+        self._attrs = attrs
+        self._old: Optional[AttrScope] = None
+
+    def get(self, user_attrs: Optional[Dict[str, str]]
+            ) -> Dict[str, str]:
+        merged = dict(self._attrs)
+        if user_attrs:
+            merged.update(user_attrs)
+        return merged
+
+    @staticmethod
+    def current() -> Optional["AttrScope"]:
+        return _CURRENT.scope
+
+    def __enter__(self) -> "AttrScope":
+        self._old = _CURRENT.scope
+        if self._old is not None:
+            merged = dict(self._old._attrs)
+            merged.update(self._attrs)
+            self._attrs = merged
+        _CURRENT.scope = self
+        return self
+
+    def __exit__(self, *exc) -> None:
+        _CURRENT.scope = self._old

@@ -1,0 +1,62 @@
+"""Symbol naming scopes.
+
+Reference parity (leezu/mxnet): ``python/mxnet/name.py`` — ``NameManager``
+(auto-naming of unnamed symbols) and ``Prefix`` (prepends a prefix inside
+a ``with`` block).
+"""
+from __future__ import annotations
+
+import threading
+from typing import Dict, Optional
+
+__all__ = ["NameManager", "Prefix"]
+
+
+class _Current(threading.local):
+    def __init__(self) -> None:
+        self.manager: Optional["NameManager"] = None
+
+
+_CURRENT = _Current()
+
+
+class NameManager:
+    """Assigns ``op0``, ``op1``, … names to unnamed symbols; use as a
+    context manager to scope the counter."""
+
+    def __init__(self) -> None:
+        self._counter: Dict[str, int] = {}
+        self._old: Optional[NameManager] = None
+
+    def get(self, name: Optional[str], hint: str) -> str:
+        if name:
+            return name
+        n = self._counter.get(hint, 0)
+        self._counter[hint] = n + 1
+        return f"{hint}{n}"
+
+    @staticmethod
+    def current() -> "NameManager":
+        if _CURRENT.manager is None:
+            _CURRENT.manager = NameManager()
+        return _CURRENT.manager
+
+    def __enter__(self) -> "NameManager":
+        self._old = _CURRENT.manager
+        _CURRENT.manager = self
+        return self
+
+    def __exit__(self, *exc) -> None:
+        _CURRENT.manager = self._old
+
+
+class Prefix(NameManager):
+    """NameManager that prepends ``prefix`` to every generated name
+    (reference ``mx.name.Prefix``)."""
+
+    def __init__(self, prefix: str) -> None:
+        super().__init__()
+        self._prefix = prefix
+
+    def get(self, name: Optional[str], hint: str) -> str:
+        return self._prefix + super().get(name, hint)

@@ -49,6 +49,10 @@ _NAMES = _NameCounters()
 
 def _auto_name(op: str) -> str:
     base = op.lower().replace("_", "")
+    from ..name import _CURRENT
+    if _CURRENT.manager is not None:
+        # an active mx.name.NameManager/Prefix scope owns naming
+        return _CURRENT.manager.get(None, base)
     n = _NAMES.counts.get(base, 0)
     _NAMES.counts[base] = n + 1
     return f"{base}{n}"
@@ -371,8 +375,11 @@ def Variable(name: str, shape: Optional[tuple] = None, dtype: Any = None,
     if wd_mult is not None:
         attrs["__wd_mult__"] = wd_mult
     node = _SymNode("null", name, attrs, [], [])
-    if attr:
-        node._user_attrs.update({k: str(v) for k, v in attr.items()})
+    from ..attribute import AttrScope
+    scope = AttrScope.current()
+    merged = scope.get(attr) if scope is not None else (attr or {})
+    if merged:
+        node._user_attrs.update({k: str(v) for k, v in merged.items()})
     return Symbol([(node, 0)])
 
 
@@ -687,8 +694,13 @@ def _apply_op(op: str, *args: Any, **kwargs: Any) -> Symbol:
                 pairs[0][0].is_aux = True
 
     node = _SymNode(op, name, attrs, inputs, layout)
-    if user_attr:
-        node._user_attrs.update({k: str(v) for k, v in user_attr.items()})
+    from ..attribute import AttrScope
+    scope = AttrScope.current()
+    merged_attr = scope.get(user_attr) if scope is not None \
+        else (user_attr or {})
+    if merged_attr:
+        node._user_attrs.update({k: str(v)
+                                 for k, v in merged_attr.items()})
 
     # statically-known multi-output ops (reference: SliceChannel etc.)
     n_out = 1

@@ -1,0 +1,133 @@
+"""Network visualization / summaries.
+
+Reference parity (leezu/mxnet): ``python/mxnet/visualization.py`` —
+``print_summary`` (layer table with shapes + param counts) and
+``plot_network`` (graphviz digraph; gated here since graphviz is not in
+the image — the dot source is still produced).
+"""
+from __future__ import annotations
+
+from typing import Any, Dict, List, Optional, Tuple
+
+from .base import MXNetError
+from .symbol.symbol import Symbol, _topo_order
+
+__all__ = ["print_summary", "plot_network"]
+
+
+def _param_count(shape) -> int:
+    n = 1
+    for s in shape or ():
+        n *= s
+    return n if shape else 0
+
+
+def print_summary(symbol: Symbol,
+                  shape: Optional[Dict[str, Tuple[int, ...]]] = None,
+                  line_length: int = 98,
+                  positions=(0.44, 0.64, 0.74, 1.0)) -> None:
+    """Print a Keras-style layer table (reference ``print_summary``)."""
+    if not isinstance(symbol, Symbol):
+        raise MXNetError("print_summary expects a Symbol")
+    shape_dict: Dict[str, Tuple[int, ...]] = {}
+    if shape is not None:
+        arg_shapes, out_shapes, aux_shapes = symbol.infer_shape(**shape)
+        args = symbol.list_arguments()
+        auxs = symbol.list_auxiliary_states()
+        shape_dict = dict(zip(args, arg_shapes))
+        shape_dict.update(zip(auxs, aux_shapes))
+        for name, oshape in zip(symbol.list_outputs(), out_shapes):
+            shape_dict[name] = oshape
+
+    order = _topo_order(symbol._heads)
+    positions = [int(line_length * p) for p in positions]
+    fields = ["Layer (type)", "Output Shape", "Param #", "Previous Layer"]
+
+    def print_row(cells, pos):
+        line = ""
+        for c, p in zip(cells, pos):
+            line += str(c)
+            line = line[:p - 1].ljust(p)
+        print(line)
+
+    print("_" * line_length)
+    print_row(fields, positions)
+    print("=" * line_length)
+    total = 0
+    nodes_by_uid = {n.uid: n for n in order}
+    for n in order:
+        if n.op == "null" and any(
+                n.uid in (m.uid for m, _ in other.inputs)
+                for other in order):
+            continue        # params/inputs folded into their consumer row
+        if n.op == "null":
+            continue
+        # params feeding this node (data inputs — names given in `shape`
+        # — are not parameters)
+        n_params = 0
+        prevs = []
+        data_names = set(shape or ())
+        for m, _ in n.inputs:
+            if m.op == "null":
+                if m.name in data_names:
+                    prevs.append(m.name)
+                else:
+                    n_params += _param_count(shape_dict.get(m.name))
+            else:
+                prevs.append(m.name)
+        out_shape = shape_dict.get(f"{n.name}_output", "")
+        print_row([f"{n.name} ({n.op})", out_shape or "", n_params,
+                   ",".join(prevs)], positions)
+        total += n_params
+    print("=" * line_length)
+    print(f"Total params: {total}")
+    print("_" * line_length)
+
+
+def plot_network(symbol: Symbol, title: str = "plot",
+                 save_format: str = "pdf",
+                 shape: Optional[Dict[str, Tuple[int, ...]]] = None,
+                 node_attrs: Optional[Dict[str, str]] = None,
+                 hide_weights: bool = True) -> Any:
+    """Build a graphviz Digraph of the network (reference
+    ``plot_network``).  Returns the Digraph if the ``graphviz`` package is
+    importable, else the dot source string (rendering needs graphviz,
+    which this image does not ship)."""
+    order = _topo_order(symbol._heads)
+    lines = [f'digraph "{title}" {{', "  rankdir=BT;"]
+    for n in order:
+        if n.op == "null":
+            if hide_weights and any(
+                    n.uid in (m.uid for m, _ in other.inputs)
+                    and other.op != "null" for other in order):
+                is_data = not any(
+                    n.name.endswith(sfx) for sfx in
+                    ("weight", "bias", "gamma", "beta", "moving_mean",
+                     "moving_var"))
+                if not is_data:
+                    continue
+            lines.append(
+                f'  "{n.name}" [label="{n.name}" shape=oval '
+                f'fillcolor="#8dd3c7" style=filled];')
+        else:
+            lines.append(
+                f'  "{n.name}" [label="{n.name}\\n({n.op})" shape=box '
+                f'fillcolor="#fb8072" style=filled];')
+    for n in order:
+        if n.op == "null":
+            continue
+        for m, _ in n.inputs:
+            if m.op == "null" and hide_weights and any(
+                    m.name.endswith(sfx) for sfx in
+                    ("weight", "bias", "gamma", "beta", "moving_mean",
+                     "moving_var")):
+                continue
+            lines.append(f'  "{m.name}" -> "{n.name}";')
+    lines.append("}")
+    src = "\n".join(lines)
+    try:
+        import graphviz
+        g = graphviz.Source(src, format=save_format)
+        return g
+    except ImportError:
+        return src

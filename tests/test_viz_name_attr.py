@@ -1,0 +1,70 @@
+"""mx.visualization / mx.name / mx.attribute tests (reference:
+python/mxnet/{visualization,name,attribute}.py)."""
+import pytest
+
+import mxnet_tpu as mx
+
+
+def _mlp():
+    data = mx.sym.Variable("data")
+    net = mx.sym.FullyConnected(data, num_hidden=8, name="fc1")
+    net = mx.sym.Activation(net, act_type="relu", name="act1")
+    return mx.sym.FullyConnected(net, num_hidden=2, name="fc2")
+
+
+def test_print_summary(capsys):
+    net = _mlp()
+    mx.visualization.print_summary(net, shape={"data": (4, 16)})
+    out = capsys.readouterr().out
+    assert "fc1 (fully_connected)" in out
+    assert "Total params: " in out
+    # fc1: 16*8+8 = 136; fc2: 8*2+2 = 18
+    assert "Total params: 154" in out
+
+
+def test_print_summary_requires_symbol():
+    with pytest.raises(mx.MXNetError):
+        mx.visualization.print_summary("not a symbol")
+
+
+def test_plot_network_dot_source():
+    net = _mlp()
+    src = mx.viz.plot_network(net, title="mlp")
+    text = src if isinstance(src, str) else src.source
+    assert "digraph" in text
+    assert '"fc1"' in text and '"act1" -> "fc2"' in text
+    assert "fc1_weight" not in text          # hidden weights
+    src2 = mx.viz.plot_network(net, hide_weights=False)
+    text2 = src2 if isinstance(src2, str) else src2.source
+    assert "fc1_weight" in text2
+
+
+def test_name_manager_prefix():
+    with mx.name.Prefix("block1_"):
+        a = mx.sym.Variable("x")
+        s = mx.sym.Activation(a, act_type="relu")
+    assert s.name.startswith("block1_")
+    with mx.name.NameManager():
+        t = mx.sym.Activation(a, act_type="relu")
+        u = mx.sym.Activation(a, act_type="relu")
+    assert t.name != u.name
+
+
+def test_attr_scope_applies_and_nests():
+    with mx.attribute.AttrScope(ctx_group="dev1"):
+        a = mx.sym.Variable("a")
+        with mx.attribute.AttrScope(lr_mult="2"):
+            b = mx.sym.Variable("b")
+    c = mx.sym.Variable("c")
+    assert a.attr("ctx_group") == "dev1"
+    assert b.attr("ctx_group") == "dev1" and b.attr("lr_mult") == "2"
+    assert c.attr("ctx_group") is None
+    with pytest.raises(ValueError):
+        mx.attribute.AttrScope(bad=3)
+
+
+def test_attr_scope_on_ops():
+    with mx.attribute.AttrScope(ctx_group="dev2"):
+        x = mx.sym.Variable("x")
+        y = mx.sym.Activation(x, act_type="relu", name="act_scoped")
+    assert y.attr("ctx_group") == "dev2"
