@@ -4,6 +4,9 @@ ResizeIter/PrefetchingIter; the C++ iterator registry collapses into
 Python iterators over the same batch protocol)."""
 from .io import (DataBatch, DataDesc, DataIter, NDArrayIter, ResizeIter,
                  PrefetchingIter)
+from .iters import (ImageRecordIter, CSVIter, LibSVMIter, MNISTIter,
+                    create, register_iter)
 
 __all__ = ["DataBatch", "DataDesc", "DataIter", "NDArrayIter", "ResizeIter",
-           "PrefetchingIter"]
+           "PrefetchingIter", "ImageRecordIter", "CSVIter", "LibSVMIter",
+           "MNISTIter", "create", "register_iter"]

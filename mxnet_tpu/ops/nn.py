@@ -266,13 +266,13 @@ def convolution(data, weight, bias=None, kernel=None, stride=None, dilate=None,
     chan_axis = layout.index("C")
 
     def impl(x, w, *b):
+        # no preferred_element_type upcast for bf16: the TPU MXU already
+        # accumulates bf16 convs in f32 internally, and an explicit f32
+        # output breaks the conv transpose rule under reverse-mode AD
         y = lax.conv_general_dilated(
             x, w, window_strides=stride, padding=padding,
             rhs_dilation=dilate, dimension_numbers=dn,
-            feature_group_count=groups,
-            preferred_element_type=jnp.float32
-            if x.dtype == jnp.bfloat16 else None)
-        y = y.astype(x.dtype)
+            feature_group_count=groups)
         if b:
             shape = [1] * y.ndim
             shape[chan_axis] = b[0].shape[0]

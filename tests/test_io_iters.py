@@ -1,0 +1,117 @@
+"""Named iterator tests: ImageRecordIter, CSVIter, LibSVMIter, MNISTIter
+(reference: tests/python/unittest/test_io.py)."""
+import gzip
+import os
+import struct
+
+import numpy as onp
+import pytest
+
+import mxnet_tpu as mx
+from mxnet_tpu.io import iters
+
+
+def test_csv_iter(tmp_path):
+    rng = onp.random.RandomState(0)
+    data = rng.uniform(-1, 1, (10, 6)).astype("float32")
+    labels = rng.randint(0, 3, (10, 1)).astype("float32")
+    dpath, lpath = str(tmp_path / "d.csv"), str(tmp_path / "l.csv")
+    onp.savetxt(dpath, data, delimiter=",")
+    onp.savetxt(lpath, labels, delimiter=",")
+
+    it = iters.CSVIter(data_csv=dpath, data_shape=(6,), label_csv=lpath,
+                       label_shape=(1,), batch_size=4)
+    batches = list(it)
+    assert len(batches) == 3       # 10 rows, round_batch wraps the last
+    onp.testing.assert_allclose(batches[0].data[0].asnumpy(), data[:4],
+                                rtol=1e-5)
+    # wrapped batch: rows 8,9,0,1
+    onp.testing.assert_allclose(batches[2].data[0].asnumpy(),
+                                data[[8, 9, 0, 1]], rtol=1e-5)
+    it.reset()
+    assert len(list(it)) == 3
+
+
+def test_libsvm_iter(tmp_path):
+    path = tmp_path / "d.svm"
+    path.write_text("1 0:1.5 3:2.0\n0 1:0.5\n1 2:3.0 3:1.0\n")
+    it = iters.LibSVMIter(data_libsvm=str(path), data_shape=(4,),
+                          batch_size=2)
+    b1 = next(it)
+    dense = b1.data[0].asnumpy() if hasattr(b1.data[0], "asnumpy") else None
+    expect = onp.zeros((2, 4), dtype="float32")
+    expect[0, 0], expect[0, 3] = 1.5, 2.0
+    expect[1, 1] = 0.5
+    onp.testing.assert_allclose(dense, expect)
+    onp.testing.assert_allclose(b1.label[0].asnumpy(), [1.0, 0.0])
+    b2 = next(it)
+    assert b2.pad == 1
+
+
+def _write_idx_images(path, arr):
+    with gzip.open(path, "wb") as f:
+        f.write(struct.pack(">I", 0x00000803))
+        for d in arr.shape:
+            f.write(struct.pack(">I", d))
+        f.write(arr.astype(">u1").tobytes())
+
+
+def _write_idx_labels(path, arr):
+    with gzip.open(path, "wb") as f:
+        f.write(struct.pack(">I", 0x00000801))
+        f.write(struct.pack(">I", arr.shape[0]))
+        f.write(arr.astype(">u1").tobytes())
+
+
+def test_mnist_iter(tmp_path):
+    rng = onp.random.RandomState(1)
+    imgs = rng.randint(0, 255, (20, 28, 28)).astype("uint8")
+    labels = rng.randint(0, 10, (20,)).astype("uint8")
+    ipath = str(tmp_path / "imgs.gz")
+    lpath = str(tmp_path / "labels.gz")
+    _write_idx_images(ipath, imgs)
+    _write_idx_labels(lpath, labels)
+
+    it = iters.MNISTIter(image=ipath, label=lpath, batch_size=5)
+    b = next(it)
+    assert b.data[0].shape == (5, 1, 28, 28)
+    onp.testing.assert_allclose(b.data[0].asnumpy()[0, 0],
+                                imgs[0] / 255.0, rtol=1e-6)
+    onp.testing.assert_allclose(b.label[0].asnumpy(), labels[:5])
+    flat = iters.MNISTIter(image=ipath, label=lpath, batch_size=5,
+                           flat=True)
+    assert next(flat).data[0].shape == (5, 784)
+
+
+def test_image_record_iter(tmp_path):
+    from PIL import Image
+    rng = onp.random.RandomState(2)
+    prefix = str(tmp_path / "data")
+    rec = mx.recordio.MXIndexedRecordIO(prefix + ".idx", prefix + ".rec",
+                                        "w")
+    import io as _io
+    for i in range(8):
+        arr = rng.randint(0, 255, (40, 40, 3)).astype("uint8")
+        buf = _io.BytesIO()
+        Image.fromarray(arr).save(buf, format="PNG")
+        header = mx.recordio.IRHeader(0, float(i % 2), i, 0)
+        rec.write_idx(i, mx.recordio.pack(header, buf.getvalue()))
+    rec.close()
+
+    it = iters.ImageRecordIter(
+        path_imgrec=prefix + ".rec", path_imgidx=prefix + ".idx",
+        data_shape=(3, 32, 32), batch_size=4, rand_crop=True,
+        rand_mirror=True, mean_r=123.0, mean_g=117.0, mean_b=104.0,
+        std_r=58.0, std_g=57.0, std_b=57.0)
+    b = next(it)
+    assert b.data[0].shape == (4, 3, 32, 32)
+    assert b.label[0].shape[0] == 4
+    # normalized: values roughly centered
+    assert abs(float(b.data[0].asnumpy().mean())) < 2.0
+
+
+def test_iter_registry():
+    assert set(iters._ITER_REGISTRY) >= {"ImageRecordIter", "CSVIter",
+                                         "LibSVMIter", "MNISTIter"}
+    with pytest.raises(mx.MXNetError, match="unknown data iter"):
+        iters.create("BogusIter")
