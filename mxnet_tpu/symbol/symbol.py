@@ -488,6 +488,12 @@ _PARAM_SPECS: Dict[str, Dict[str, Tuple[str, bool]]] = {
     "rms_norm": {"gamma": ("gamma", False)},
     "embedding": {"weight": ("weight", False)},
     "prelu": {"gamma": ("gamma", False)},
+    # loss heads auto-create their label variable (reference:
+    # sym.SoftmaxOutput(net) binds a `<name>_label` input)
+    "softmax_output": {"label": ("label", False)},
+    "linear_regression_output": {"label": ("label", False)},
+    "logistic_regression_output": {"label": ("label", False)},
+    "mae_regression_output": {"label": ("label", False)},
 }
 
 # per-op hooks resolving auto-created param shapes from the data shape

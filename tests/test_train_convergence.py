@@ -1,0 +1,96 @@
+"""Small real trainings asserting final accuracy — the reference's
+``tests/python/train/`` strategy (SURVEY.md section 4)."""
+import numpy as onp
+
+import mxnet_tpu as mx
+
+
+def _blob_data(n=256, d=16, classes=4, seed=3):
+    """Gaussian blobs: linearly separable-ish multi-class problem."""
+    rng = onp.random.RandomState(seed)
+    centers = rng.uniform(-2, 2, (classes, d)).astype("float32")
+    y = rng.randint(0, classes, n).astype("int32")
+    X = centers[y] + rng.normal(0, 0.35, (n, d)).astype("float32")
+    return X.astype("float32"), y
+
+
+def _accuracy(net, X, Y):
+    pred = net(mx.np.array(X)).asnumpy().argmax(1)
+    return float((pred == Y).mean())
+
+
+def test_mlp_trains_to_accuracy():
+    mx.random.seed(0)
+    X, Y = _blob_data()
+    net = mx.gluon.nn.HybridSequential()
+    net.add(mx.gluon.nn.Dense(32, activation="relu"),
+            mx.gluon.nn.Dense(4))
+    net.initialize()
+    tr = mx.gluon.Trainer(net.collect_params(), "adam",
+                          {"learning_rate": 5e-3})
+    lf = mx.gluon.loss.SoftmaxCrossEntropyLoss()
+    Xn, Yn = mx.np.array(X), mx.np.array(Y)
+    for _ in range(60):
+        with mx.autograd.record():
+            loss = lf(net(Xn), Yn).mean()
+        loss.backward()
+        tr.step(len(X))
+    assert _accuracy(net, X, Y) > 0.95
+
+
+def test_convnet_trains_hybridized():
+    """Conv net, hybridized end to end (CachedOp path), reaches accuracy."""
+    mx.random.seed(1)
+    rng = onp.random.RandomState(5)
+    # class = which quadrant of the image carries the bright patch
+    n, hw = 192, 12
+    Y = rng.randint(0, 4, n).astype("int32")
+    X = rng.normal(0, 0.15, (n, 1, hw, hw)).astype("float32")
+    half = hw // 2
+    for i, c in enumerate(Y):
+        r, col = divmod(int(c), 2)
+        X[i, 0, r * half:(r + 1) * half, col * half:(col + 1) * half] += 1.0
+
+    net = mx.gluon.nn.HybridSequential()
+    net.add(mx.gluon.nn.Conv2D(8, 3, padding=1, activation="relu"),
+            mx.gluon.nn.MaxPool2D(2),
+            mx.gluon.nn.Conv2D(16, 3, padding=1, activation="relu"),
+            mx.gluon.nn.GlobalAvgPool2D(),
+            mx.gluon.nn.Dense(4))
+    net.initialize()
+    net(mx.np.array(X[:1]))
+    net.hybridize()
+    tr = mx.gluon.Trainer(net.collect_params(), "adam",
+                          {"learning_rate": 1e-2})
+    lf = mx.gluon.loss.SoftmaxCrossEntropyLoss()
+    Xn, Yn = mx.np.array(X), mx.np.array(Y)
+    for _ in range(40):
+        with mx.autograd.record():
+            loss = lf(net(Xn), Yn).mean()
+        loss.backward()
+        tr.step(n)
+    assert _accuracy(net, X, Y) > 0.9
+
+
+def test_module_fit_converges():
+    """Legacy Module.fit epoch loop (reference Module API path)."""
+    mx.random.seed(2)
+    X, Y = _blob_data(n=200, seed=7)
+    data = mx.sym.Variable("data")
+    net = mx.sym.FullyConnected(data, num_hidden=32, name="fc1")
+    net = mx.sym.Activation(net, act_type="relu", name="a1")
+    net = mx.sym.FullyConnected(net, num_hidden=4, name="fc2")
+    net = mx.sym.SoftmaxOutput(net, name="softmax")
+
+    train_iter = mx.io.NDArrayIter(X, Y, batch_size=50, shuffle=True,
+                                   label_name="softmax_label")
+    mod = mx.mod.Module(net, data_names=["data"],
+                        label_names=["softmax_label"])
+    mod.fit(train_iter, num_epoch=25,
+            optimizer="adam", optimizer_params={"learning_rate": 5e-3},
+            eval_metric="acc")
+    score = mod.score(mx.io.NDArrayIter(X, Y, batch_size=50,
+                                        label_name="softmax_label"),
+                      mx.metric.Accuracy())
+    acc = dict([score] if isinstance(score, tuple) else score)["accuracy"]
+    assert acc > 0.9, acc
