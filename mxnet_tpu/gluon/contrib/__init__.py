@@ -1,5 +1,6 @@
 """gluon.contrib — estimator and experimental blocks (reference:
 ``python/mxnet/gluon/contrib/``)."""
 from . import estimator
+from . import nn
 
-__all__ = ["estimator"]
+__all__ = ["estimator", "nn"]

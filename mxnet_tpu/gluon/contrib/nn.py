@@ -1,0 +1,95 @@
+"""Experimental gluon layers.
+
+Reference parity (leezu/mxnet): ``python/mxnet/gluon/contrib/nn/
+basic_layers.py`` — ``Concurrent``, ``HybridConcurrent``, ``Identity``,
+``PixelShuffle1D/2D/3D`` (SyncBatchNorm lives in ``gluon.nn`` here, as in
+2.x).
+"""
+from __future__ import annotations
+
+from typing import Any, Optional
+
+from ...base import MXNetError
+from ...ndarray.ndarray import NDArray
+from ...ndarray import ops as ndops
+from ..block import HybridBlock
+from ..nn.basic_layers import Identity  # re-export (reference location)
+
+__all__ = ["Concurrent", "HybridConcurrent", "Identity", "PixelShuffle1D",
+           "PixelShuffle2D", "PixelShuffle3D"]
+
+
+class HybridConcurrent(HybridBlock):
+    """Runs children on the same input, concatenates outputs along
+    ``axis`` (Inception-style branches)."""
+
+    def __init__(self, axis: int = -1, **kwargs: Any) -> None:
+        super().__init__(**kwargs)
+        self.axis = axis
+
+    def add(self, *blocks: HybridBlock) -> None:
+        for b in blocks:
+            self.register_child(b)
+
+    def forward(self, x: NDArray) -> NDArray:
+        outs = [child(x) for child in self._children.values()]
+        return ndops.concat(*outs, axis=self.axis)
+
+
+class Concurrent(HybridConcurrent):
+    """Imperative alias of :class:`HybridConcurrent` (reference keeps
+    both names)."""
+
+
+class _PixelShuffle(HybridBlock):
+    def __init__(self, factor, ndim: int, **kwargs: Any) -> None:
+        super().__init__(**kwargs)
+        if isinstance(factor, int):
+            factor = (factor,) * ndim
+        self._factor = tuple(int(f) for f in factor)
+        if len(self._factor) != ndim:
+            raise MXNetError(f"factor must have {ndim} elements")
+        self._ndim = ndim
+
+    def forward(self, x: NDArray) -> NDArray:
+        f = self._factor
+        shape = x.shape
+        C = shape[1]
+        prod = 1
+        for v in f:
+            prod *= v
+        if C % prod:
+            raise MXNetError(
+                f"channels {C} not divisible by shuffle factor {f}")
+        Cout = C // prod
+        spatial = shape[2:]
+        # (N, Cout, f1..fn, d1..dn) -> interleave -> (N, Cout, d1*f1, ...)
+        x = x.reshape((shape[0], Cout) + f + tuple(spatial))
+        # build permutation: N, Cout, d1, f1, d2, f2, ...
+        perm = [0, 1]
+        for i in range(self._ndim):
+            perm += [2 + self._ndim + i, 2 + i]
+        x = x.transpose(tuple(perm))
+        out_spatial = tuple(d * fi for d, fi in zip(spatial, f))
+        return x.reshape((shape[0], Cout) + out_spatial)
+
+
+class PixelShuffle1D(_PixelShuffle):
+    """(N, C·f, W) -> (N, C, W·f) sub-pixel upsample."""
+
+    def __init__(self, factor, **kwargs: Any) -> None:
+        super().__init__(factor, 1, **kwargs)
+
+
+class PixelShuffle2D(_PixelShuffle):
+    """(N, C·f1·f2, H, W) -> (N, C, H·f1, W·f2)."""
+
+    def __init__(self, factor, **kwargs: Any) -> None:
+        super().__init__(factor, 2, **kwargs)
+
+
+class PixelShuffle3D(_PixelShuffle):
+    """(N, C·f1·f2·f3, D, H, W) -> (N, C, D·f1, H·f2, W·f3)."""
+
+    def __init__(self, factor, **kwargs: Any) -> None:
+        super().__init__(factor, 3, **kwargs)

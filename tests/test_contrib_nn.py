@@ -1,0 +1,39 @@
+"""gluon.contrib.nn tests (reference:
+tests/python/unittest/test_contrib_gluon ... basic_layers)."""
+import numpy as onp
+import pytest
+
+import mxnet_tpu as mx
+from mxnet_tpu.gluon.contrib import nn as cnn
+
+
+def test_hybrid_concurrent_concats():
+    mx.random.seed(0)
+    c = cnn.HybridConcurrent(axis=1)
+    c.add(mx.gluon.nn.Dense(4), mx.gluon.nn.Dense(6), cnn.Identity())
+    c.initialize()
+    x = mx.np.array(onp.random.RandomState(1)
+                    .uniform(-1, 1, (2, 5)).astype("float32"))
+    out = c(x)
+    assert out.shape == (2, 15)
+    c.hybridize()
+    onp.testing.assert_allclose(c(x).asnumpy(), out.asnumpy(), rtol=1e-6)
+
+
+def test_pixel_shuffle_matches_torch():
+    import torch
+    ps = cnn.PixelShuffle2D(2)
+    x = mx.np.array(onp.arange(2 * 8 * 3 * 3)
+                    .reshape(2, 8, 3, 3).astype("float32"))
+    out = ps(x).asnumpy()
+    ref = torch.pixel_shuffle(torch.tensor(x.asnumpy()), 2).numpy()
+    onp.testing.assert_allclose(out, ref, rtol=1e-6)
+
+
+def test_pixel_shuffle_1d_3d_shapes():
+    o1 = cnn.PixelShuffle1D(3)(mx.np.zeros((1, 6, 4)))
+    assert o1.shape == (1, 2, 12)
+    o3 = cnn.PixelShuffle3D((1, 2, 2))(mx.np.zeros((1, 8, 2, 2, 2)))
+    assert o3.shape == (1, 2, 2, 4, 4)
+    with pytest.raises(mx.MXNetError):
+        cnn.PixelShuffle2D(2)(mx.np.zeros((1, 3, 2, 2)))   # 3 % 4 != 0
