@@ -1,0 +1,73 @@
+#!/usr/bin/env python
+"""Config-2-style example: ResNet on CIFAR-shape images, hybridized,
+one-chip SPMD step (fwd+bwd+update in a single XLA program).
+
+Reference parity: example/image-classification/train_cifar10.py.
+Uses synthetic data unless --rec points at an im2rec-packed file.
+
+    python examples/train_cifar_resnet.py --model resnet18_v1 --steps 50
+"""
+import argparse
+import os
+import sys
+import time
+
+sys.path.insert(0, os.path.dirname(os.path.dirname(os.path.abspath(__file__))))
+
+import numpy as onp
+import jax
+import mxnet_tpu as mx
+from mxnet_tpu.parallel import (SPMDTrainer, make_mesh,
+                                DATA_PARALLEL_RULES)
+
+
+def main():
+    ap = argparse.ArgumentParser()
+    ap.add_argument("--model", default="resnet18_v1")
+    ap.add_argument("--batch-size", type=int, default=128)
+    ap.add_argument("--steps", type=int, default=50)
+    ap.add_argument("--dtype", default="float32")
+    ap.add_argument("--rec", default=None,
+                    help="optional .rec file from tools/im2rec.py")
+    args = ap.parse_args()
+
+    from mxnet_tpu.gluon.model_zoo import vision as zoo
+    mx.random.seed(0)
+    net = zoo.get_model(args.model, classes=10)
+    net.initialize()
+    net(mx.np.zeros((1, 3, 32, 32)))
+    if args.dtype != "float32":
+        net.cast(args.dtype)
+
+    mesh = make_mesh({"dp": 1}, devices=jax.devices()[:1])
+    trainer = SPMDTrainer(net, mx.gluon.loss.SoftmaxCrossEntropyLoss(),
+                          "sgd", {"learning_rate": 0.1, "momentum": 0.9},
+                          mesh=mesh, rules=DATA_PARALLEL_RULES)
+
+    if args.rec:
+        it = mx.io.ImageRecordIter(
+            path_imgrec=args.rec, data_shape=(3, 32, 32),
+            batch_size=args.batch_size, shuffle=True, rand_mirror=True)
+        batches = ((b.data[0], b.label[0]) for b in it)
+    else:
+        rng = onp.random.RandomState(0)
+        x = mx.np.array(rng.uniform(-1, 1, (args.batch_size, 3, 32, 32))
+                        .astype(args.dtype))
+        y = mx.np.array(rng.randint(0, 10, (args.batch_size,))
+                        .astype("int32"))
+        batches = ((x, y) for _ in range(args.steps))
+
+    t0, n = time.perf_counter(), 0
+    for i, (x, y) in enumerate(batches):
+        if i >= args.steps:
+            break
+        loss = trainer.step(x, y)
+        n += args.batch_size
+        if (i + 1) % 10 == 0:
+            dt = time.perf_counter() - t0
+            print(f"step {i+1}: loss={float(loss.asnumpy()):.4f} "
+                  f"{n / dt:.1f} img/s")
+
+
+if __name__ == "__main__":
+    main()
