@@ -31,29 +31,37 @@ def bench_bert(batch: int, steps: int, dtype: str, seq_len: int) -> None:
     from mxnet_tpu.parallel import SPMDTrainer, make_mesh, \
         DATA_PARALLEL_RULES
 
+    vocab = 30522
+    n_mask = max(1, int(seq_len * 0.15))     # standard 15% MLM masking
     mx.random.seed(0)
-    net = get_bert("bert_12_768_12", vocab_size=30522, dropout=0.0,
-                   use_pooler=False, use_decoder=False,
+    net = get_bert("bert_12_768_12", vocab_size=vocab, dropout=0.0,
+                   use_pooler=False, use_decoder=True,
                    use_classifier=False)
     net.initialize()
-    net(mx.np.zeros((2, 32), dtype="int32"), None, None)
+    net(mx.np.zeros((2, 32), dtype="int32"),
+        mx.np.zeros((2, 32), dtype="int32"),
+        mx.np.full((2,), 32, dtype="int32"),
+        mx.np.zeros((2, 4), dtype="int32"))
     if dtype != "float32":
         net.cast(dtype)
 
     loss_fn = mx.gluon.loss.SoftmaxCrossEntropyLoss(axis=-1)
 
-    class MLMLoss:
-        def __call__(self, seq_out, labels):
-            return loss_fn(seq_out, labels)
-
     mesh = make_mesh({"dp": 1}, devices=jax.devices()[:1])
-    trainer = SPMDTrainer(net, MLMLoss(), optimizer="adamw",
-                          optimizer_params={"learning_rate": 1e-4},
-                          mesh=mesh, rules=DATA_PARALLEL_RULES)
+    trainer = SPMDTrainer(
+        net, lambda logits, labels: loss_fn(logits, labels),
+        optimizer="adamw", optimizer_params={"learning_rate": 1e-4},
+        mesh=mesh, rules=DATA_PARALLEL_RULES,
+        # loss reads the MLM vocab logits (last forward output)
+        output_transform=lambda out: out[-1])
     rng = onp.random.RandomState(0)
-    x = mx.np.array(rng.randint(0, 30522, (batch, seq_len))
-                    .astype("int32"))
-    y = mx.np.array(rng.randint(0, 768, (batch, seq_len))
+    x = [mx.np.array(rng.randint(0, vocab, (batch, seq_len))
+                     .astype("int32")),
+         mx.np.array(onp.zeros((batch, seq_len), dtype="int32")),
+         mx.np.array(onp.full((batch,), seq_len, dtype="int32")),
+         mx.np.array(rng.randint(0, seq_len, (batch, n_mask))
+                     .astype("int32"))]
+    y = mx.np.array(rng.randint(0, vocab, (batch, n_mask))
                     .astype("int32"))
     # two warmup steps: the first compiles, the second recompiles with
     # the donated buffers' optimized on-device layouts
@@ -154,9 +162,14 @@ def main() -> None:
     y_np = onp.random.randint(0, 1000, (batch,)).astype("int32")
     # settle deferred shapes once (eagerly, off the clock), THEN cast —
     # casting first would leave late-initialized params in float32.
-    # Small spatial size: identical param shapes (channels drive them),
-    # ~10x faster eager warmup through the remote-compile tunnel.
-    net(mx.np.zeros((1, 3, 64, 64), dtype="float32"))
+    # Fully-convolutional families (global-pool head) get a small settle
+    # size for a ~10x faster eager warmup through the remote-compile
+    # tunnel; spatial-dependent heads (VGG Flatten+Dense, Inception's
+    # fixed AvgPool) must settle at the real image size.
+    fully_conv = model_name.startswith(
+        ("resnet", "mobilenet", "squeezenet", "densenet"))
+    settle = 64 if fully_conv else img
+    net(mx.np.zeros((1, 3, settle, settle), dtype="float32"))
     if dtype != "float32":
         net.cast(dtype)
 

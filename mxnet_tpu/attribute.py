@@ -30,11 +30,12 @@ class AttrScope:
             if not isinstance(v, str):
                 raise ValueError("AttrScope values must be strings")
         self._attrs = attrs
+        self._effective = dict(attrs)   # attrs + outer scopes while active
         self._old: Optional[AttrScope] = None
 
     def get(self, user_attrs: Optional[Dict[str, str]]
             ) -> Dict[str, str]:
-        merged = dict(self._attrs)
+        merged = dict(self._effective)
         if user_attrs:
             merged.update(user_attrs)
         return merged
@@ -46,11 +47,16 @@ class AttrScope:
     def __enter__(self) -> "AttrScope":
         self._old = _CURRENT.scope
         if self._old is not None:
-            merged = dict(self._old._attrs)
+            # effective attrs for this activation only; self._attrs must
+            # stay pristine so the scope object is reusable elsewhere
+            merged = dict(self._old._effective)
             merged.update(self._attrs)
-            self._attrs = merged
+            self._effective = merged
+        else:
+            self._effective = dict(self._attrs)
         _CURRENT.scope = self
         return self
 
     def __exit__(self, *exc) -> None:
         _CURRENT.scope = self._old
+        self._effective = dict(self._attrs)

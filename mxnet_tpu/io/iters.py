@@ -55,14 +55,16 @@ def ImageRecordIter(path_imgrec: str, data_shape, batch_size: int,
     std = onp.array([std_r, std_g, std_b], dtype=onp.float32)
 
     class _NormAug:
+        # reference DefaultImageAugmenter order: (pixel - mean) / std,
+        # then scale
         def __call__(self, src):
             out = src
-            if scale != 1.0:
-                out = out * scale
             if mean.any():
                 out = out - NDArray(mean.reshape(1, 1, 3))
             if (std != 1.0).any():
                 out = out / NDArray(std.reshape(1, 1, 3))
+            if scale != 1.0:
+                out = out * scale
             return out
 
     augs.append(_NormAug())
@@ -249,7 +251,8 @@ def register_iter(name: str, fn: Any) -> Any:
 def create(name: str, **kwargs: Any):
     """Create an iterator by registry name (C-iterator creation analog)."""
     try:
-        return _ITER_REGISTRY[name](**kwargs)
+        cls = _ITER_REGISTRY[name]
     except KeyError:
         raise MXNetError(f"unknown data iter {name!r} (registered: "
                          f"{sorted(_ITER_REGISTRY)})") from None
+    return cls(**kwargs)

@@ -37,9 +37,11 @@ class NameManager:
 
     @staticmethod
     def current() -> "NameManager":
-        if _CURRENT.manager is None:
-            _CURRENT.manager = NameManager()
-        return _CURRENT.manager
+        """The active manager, or the process-wide default — never
+        installs anything (symbol auto-naming shares the default's
+        counter, so observing must not fork the namespace)."""
+        return _CURRENT.manager if _CURRENT.manager is not None \
+            else _DEFAULT
 
     def __enter__(self) -> "NameManager":
         self._old = _CURRENT.manager
@@ -48,6 +50,10 @@ class NameManager:
 
     def __exit__(self, *exc) -> None:
         _CURRENT.manager = self._old
+
+
+# process-wide default namespace (the symbol layer's auto-name counter)
+_DEFAULT = NameManager()
 
 
 class Prefix(NameManager):

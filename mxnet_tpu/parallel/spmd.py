@@ -115,9 +115,13 @@ class SPMDTrainer:
                  rules: PartitionRules = DATA_PARALLEL_RULES,
                  data_spec: "P" = P("dp"),
                  label_spec: "P" = P("dp"),
-                 donate: bool = True) -> None:
+                 donate: bool = True,
+                 output_transform: Optional[Callable] = None) -> None:
         self.block = block
         self.loss_fn = loss_fn
+        # which forward output feeds the loss (default: first of a tuple)
+        self._output_transform = output_transform or (
+            lambda out: out[0] if isinstance(out, tuple) else out)
         if isinstance(optimizer, str):
             optimizer = opt_mod.create(optimizer, **(optimizer_params or {}))
         elif optimizer_params:
@@ -187,8 +191,7 @@ class SPMDTrainer:
                             *[from_jax(b) for b in inputs])
                     finally:
                         set_training(prev)
-                    if isinstance(out, tuple):
-                        out = out[0]
+                    out = self._output_transform(out)
                     loss = loss_fn(out, from_jax(labels))
                     # loss is already MEAN-reduced here, so grads need no
                     # 1/batch rescale (unlike the Trainer path, which
@@ -273,19 +276,21 @@ class SPMDTrainer:
         state land back on the mesh with their recorded shardings."""
         import pickle
         from .. import ndarray_io
+        # validate EVERYTHING before touching live state: a mismatched
+        # checkpoint must not leave the trainer half-loaded
         loaded = ndarray_io.load_params(prefix + ".params")
         missing = [n for n in self._names if n not in loaded]
         if missing:
             raise MXNetError(f"checkpoint {prefix}.params missing "
                              f"parameters {missing}")
-        for name, p, sh in zip(self._names, self._params,
-                               self._param_shardings):
-            p._data._data = jax.device_put(loaded[name]._data, sh)
         with open(prefix + ".states", "rb") as f:
             payload = pickle.load(f)
         if payload["names"] != self._names:
             raise MXNetError("checkpoint parameter names do not match "
                              "this trainer's model")
+        for name, p, sh in zip(self._names, self._params,
+                               self._param_shardings):
+            p._data._data = jax.device_put(loaded[name]._data, sh)
         self._step_count = payload["step_count"]
         self.optimizer.num_update = self._step_count
         self._opt_states = [

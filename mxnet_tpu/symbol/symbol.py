@@ -39,23 +39,12 @@ FORMAT_VERSION = 1
 _UID = itertools.count()
 
 
-class _NameCounters(threading.local):
-    def __init__(self) -> None:
-        self.counts: Dict[str, int] = {}
-
-
-_NAMES = _NameCounters()
-
-
 def _auto_name(op: str) -> str:
-    base = op.lower().replace("_", "")
-    from ..name import _CURRENT
-    if _CURRENT.manager is not None:
-        # an active mx.name.NameManager/Prefix scope owns naming
-        return _CURRENT.manager.get(None, base)
-    n = _NAMES.counts.get(base, 0)
-    _NAMES.counts[base] = n + 1
-    return f"{base}{n}"
+    """Auto-name via the single mx.name namespace — the active
+    NameManager/Prefix scope, else the process-wide default counter (ONE
+    namespace, so scoped and unscoped names never collide)."""
+    from ..name import NameManager
+    return NameManager.current().get(None, op.lower().replace("_", ""))
 
 
 class _SymNode:

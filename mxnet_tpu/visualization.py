@@ -54,14 +54,9 @@ def print_summary(symbol: Symbol,
     print_row(fields, positions)
     print("=" * line_length)
     total = 0
-    nodes_by_uid = {n.uid: n for n in order}
     for n in order:
-        if n.op == "null" and any(
-                n.uid in (m.uid for m, _ in other.inputs)
-                for other in order):
-            continue        # params/inputs folded into their consumer row
         if n.op == "null":
-            continue
+            continue        # params/inputs folded into their consumer row
         # params feeding this node (data inputs — names given in `shape`
         # — are not parameters)
         n_params = 0

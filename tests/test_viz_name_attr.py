@@ -63,6 +63,29 @@ def test_attr_scope_applies_and_nests():
         mx.attribute.AttrScope(bad=3)
 
 
+def test_name_default_namespace_shared():
+    """Observing the current manager must not fork the auto-name
+    namespace (regression: duplicate names after NameManager.current)."""
+    a = mx.sym.Variable("zz")
+    s1 = mx.sym.Activation(a, act_type="relu")
+    mx.name.NameManager.current()
+    s2 = mx.sym.Activation(a, act_type="relu")
+    assert s1.name != s2.name
+
+
+def test_attr_scope_reusable_after_nesting():
+    """A scope nested once must not leak the outer attrs into later
+    standalone uses (regression)."""
+    inner = mx.attribute.AttrScope(lr_mult="2")
+    with mx.attribute.AttrScope(ctx_group="dev1"):
+        with inner:
+            pass
+    with inner:
+        v = mx.sym.Variable("reuse_check")
+    assert v.attr("lr_mult") == "2"
+    assert v.attr("ctx_group") is None
+
+
 def test_attr_scope_on_ops():
     with mx.attribute.AttrScope(ctx_group="dev2"):
         x = mx.sym.Variable("x")
