@@ -160,11 +160,17 @@ class SPMDTrainer:
             self._opt_states.append(state)
 
         self._step_fn = None
+        self._multi_fn = None
         self._step_count = 0
         self._donate = donate
 
     # ------------------------------------------------------------------
     def _build_step(self, n_inputs: int) -> Callable:
+        donate = (0, 1) if self._donate else ()
+        return jax.jit(self._build_step_body(n_inputs),
+                       donate_argnums=donate)
+
+    def _build_step_body(self, n_inputs: int) -> Callable:
         block, loss_fn = self.block, self.loss_fn
         mesh = self.mesh
         params = self._params
@@ -211,8 +217,76 @@ class SPMDTrainer:
                 new_states.append(ns)
             return new_params, new_states, loss
 
+        return step
+
+    def _build_multi_step(self, n_inputs: int) -> Callable:
+        """K steps fused into one program via lax.scan — the TPU analog
+        of the reference's engine op bulking (MXNET_EXEC_BULK_EXEC_TRAIN):
+        one dispatch, one set of output buffers, no per-step host
+        round-trips."""
+        raw_step = self._raw_step(n_inputs)
+
+        def multi(param_arrays, opt_states, keys, lr, wd, t0, *batches):
+            xs, ys = list(batches[:-1]), batches[-1]
+
+            def body(carry, inp):
+                params, states, t = carry
+                key = inp[0]
+                step_inputs = inp[1:]
+                new_p, new_s, loss = raw_step(
+                    params, states, key, lr, wd, t, *step_inputs)
+                return (new_p, new_s, t + 1.0), loss
+
+            (params, states, _), losses = jax.lax.scan(
+                body, (list(param_arrays), list(opt_states), t0),
+                (keys,) + tuple(xs) + (ys,))
+            return params, states, losses
+
         donate = (0, 1) if self._donate else ()
-        return jax.jit(step, donate_argnums=donate)
+        return jax.jit(multi, donate_argnums=donate)
+
+    def _raw_step(self, n_inputs: int) -> Callable:
+        """The unjitted single-step body (shared by step and multi-step)."""
+        if not hasattr(self, "_raw_step_fn") or \
+                self._raw_step_n != n_inputs:
+            self._raw_step_fn = self._build_step_body(n_inputs)
+            self._raw_step_n = n_inputs
+        return self._raw_step_fn
+
+    def run_steps(self, data: Any, labels: Any) -> NDArray:
+        """Run K fused steps: ``data``/``labels`` carry a leading step
+        dimension (K, batch, ...). Returns the (K,) per-step losses.
+        Parameters/optimizer state advance K times on device."""
+        inputs = data if isinstance(data, (list, tuple)) else [data]
+        import numpy as onp
+
+        def place(x, spec):
+            a = x._data if isinstance(x, NDArray) else jnp.asarray(x)
+            per_step = _filter_spec(spec, tuple(a.shape[1:]), self.mesh)
+            sh = jax.sharding.NamedSharding(
+                self.mesh, P(*((None,) + tuple(per_step))))
+            return jax.device_put(a, sh)
+
+        arrays = [place(x, self._data_spec) for x in inputs]
+        label_arr = place(labels, self._label_spec)
+        K = arrays[0].shape[0]
+        if self._multi_fn is None:
+            self._multi_fn = self._build_multi_step(len(arrays))
+        rng = _random.split_key()
+        keys = jax.random.split(rng, K)
+        lr = self.optimizer.learning_rate
+        wd = self.optimizer.wd
+        param_arrays = [p.data()._data for p in self._params]
+        new_params, new_states, losses = self._multi_fn(
+            param_arrays, self._opt_states, keys,
+            jnp.float32(lr), jnp.float32(wd),
+            jnp.float32(self._step_count + 1), *arrays, label_arr)
+        self._step_count += K
+        self.optimizer.num_update = self._step_count
+        for p, a in zip(self._params, new_params):
+            p.data()._data = a
+        self._opt_states = new_states
+        return from_jax(losses)
 
     def step(self, data: Any, labels: Any, batch_size: Optional[int] = None
              ) -> NDArray:
