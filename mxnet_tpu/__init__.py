@@ -40,6 +40,7 @@ _LAZY = {
     "initializer": ".initializer",
     "init": ".initializer",
     "kvstore": ".kvstore",
+    "kv": ".kvstore",
     "io": ".io",
     "image": ".image",
     "recordio": ".recordio",

@@ -2,5 +2,6 @@
 ``python/mxnet/gluon/contrib/``)."""
 from . import estimator
 from . import nn
+from . import rnn
 
-__all__ = ["estimator", "nn"]
+__all__ = ["estimator", "nn", "rnn"]

@@ -1,0 +1,128 @@
+"""Experimental recurrent cells.
+
+Reference parity (leezu/mxnet): ``python/mxnet/gluon/contrib/rnn/
+conv_rnn_cell.py`` (``Conv2DLSTMCell`` family) and ``rnn_cell.py``
+(``VariationalDropoutCell`` — per-sequence dropout masks shared across
+time steps, Gal & Ghahramani).
+"""
+from __future__ import annotations
+
+from typing import Any, List, Optional, Tuple
+
+from ...base import MXNetError
+from ...ndarray.ndarray import NDArray
+from ...ndarray import ops as ndops
+from ... import npx
+from ..parameter import Parameter
+from ..rnn.rnn_cell import ModifierCell, RecurrentCell
+
+__all__ = ["VariationalDropoutCell", "Conv2DLSTMCell"]
+
+
+class VariationalDropoutCell(ModifierCell):
+    """Applies the SAME dropout mask at every time step (variational RNN
+    dropout) to inputs, states, and/or outputs."""
+
+    def __init__(self, base_cell: RecurrentCell,
+                 drop_inputs: float = 0.0, drop_states: float = 0.0,
+                 drop_outputs: float = 0.0, **kwargs: Any) -> None:
+        super().__init__(base_cell, **kwargs)
+        self._di, self._ds, self._do = drop_inputs, drop_states, \
+            drop_outputs
+        self._mask_in: Optional[NDArray] = None
+        self._mask_st: Optional[NDArray] = None
+        self._mask_out: Optional[NDArray] = None
+
+    def reset(self) -> None:
+        self._mask_in = self._mask_st = self._mask_out = None
+        if hasattr(self.base_cell, "reset"):
+            self.base_cell.reset()
+
+    def _mask(self, cached: Optional[NDArray], p: float,
+              like: NDArray) -> Tuple[Optional[NDArray], NDArray]:
+        from ..._tape import is_training
+        if not p or not is_training():
+            return cached, like
+        if cached is None:
+            from ...ndarray import random as rnd
+            cached = rnd.bernoulli(1 - p, shape=like.shape) / (1 - p)
+        return cached, like * cached
+
+    def forward(self, inputs: NDArray, states: List[NDArray]):
+        self._mask_in, inputs = self._mask(self._mask_in, self._di,
+                                           inputs)
+        if self._ds:
+            self._mask_st, h = self._mask(self._mask_st, self._ds,
+                                          states[0])
+            states = [h] + list(states[1:])
+        out, new_states = self.base_cell(inputs, states)
+        self._mask_out, out = self._mask(self._mask_out, self._do, out)
+        return out, new_states
+
+    def __repr__(self) -> str:
+        return (f"VariationalDropoutCell(in={self._di}, state={self._ds},"
+                f" out={self._do}, base={self.base_cell!r})")
+
+
+class Conv2DLSTMCell(RecurrentCell):
+    """Convolutional LSTM (Shi et al. 2015): gates computed by conv over
+    (C, H, W) states instead of dense projections
+    (reference ``gluon.contrib.rnn.Conv2DLSTMCell``, NCHW layout)."""
+
+    def __init__(self, input_shape: Tuple[int, int, int],
+                 hidden_channels: int,
+                 i2h_kernel=(3, 3), h2h_kernel=(3, 3),
+                 i2h_pad=(1, 1), **kwargs: Any) -> None:
+        super().__init__(**kwargs)
+        in_c, in_h, in_w = input_shape
+        self._shape = (in_h, in_w)
+        self._hc = hidden_channels
+        kh, kw = h2h_kernel
+        if kh % 2 == 0 or kw % 2 == 0:
+            raise MXNetError("h2h_kernel must be odd (state-preserving)")
+        self._i2h_kernel = tuple(i2h_kernel)
+        self._h2h_kernel = tuple(h2h_kernel)
+        self._i2h_pad = tuple(i2h_pad)
+        self._h2h_pad = (kh // 2, kw // 2)
+        self.i2h_weight = Parameter(
+            "i2h_weight", shape=(4 * hidden_channels, in_c)
+            + self._i2h_kernel)
+        self.h2h_weight = Parameter(
+            "h2h_weight", shape=(4 * hidden_channels, hidden_channels)
+            + self._h2h_kernel)
+        self.i2h_bias = Parameter("i2h_bias",
+                                  shape=(4 * hidden_channels,),
+                                  init="zeros")
+        self.h2h_bias = Parameter("h2h_bias",
+                                  shape=(4 * hidden_channels,),
+                                  init="zeros")
+
+    def state_info(self, batch_size: int = 0):
+        shape = (batch_size, self._hc) + self._shape
+        return [{"shape": shape, "__layout__": "NCHW"},
+                {"shape": shape, "__layout__": "NCHW"}]
+
+    def forward(self, inputs: NDArray, states: List[NDArray]):
+        for p in (self.i2h_weight, self.h2h_weight, self.i2h_bias,
+                  self.h2h_bias):
+            if not p.is_initialized:
+                p._finish_deferred_init(p.shape)
+        h, c = states
+        gi = npx.convolution(inputs, self.i2h_weight.data(),
+                             self.i2h_bias.data(),
+                             kernel=self._i2h_kernel, pad=self._i2h_pad,
+                             num_filter=4 * self._hc)
+        gh = npx.convolution(h, self.h2h_weight.data(),
+                             self.h2h_bias.data(),
+                             kernel=self._h2h_kernel, pad=self._h2h_pad,
+                             num_filter=4 * self._hc)
+        g = gi + gh
+        i_g, f_g, c_g, o_g = [
+            ndops.slice_axis(g, axis=1, begin=k * self._hc,
+                             end=(k + 1) * self._hc) for k in range(4)]
+        i_g = ndops.sigmoid(i_g)
+        f_g = ndops.sigmoid(f_g)
+        o_g = ndops.sigmoid(o_g)
+        c_next = f_g * c + i_g * ndops.tanh(c_g)
+        h_next = o_g * ndops.tanh(c_next)
+        return h_next, [h_next, c_next]

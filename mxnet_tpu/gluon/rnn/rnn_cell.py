@@ -35,7 +35,8 @@ class RecurrentCell(Block):
     def begin_state(self, batch_size: int = 0, func=None,
                     ctx=None, **kwargs) -> List[NDArray]:
         from ...ndarray import ops
-        return [ops.zeros((batch_size, info["shape"][1]), ctx=ctx)
+        # full state_info shape: conv cells carry (N, C, H, W) states
+        return [ops.zeros(tuple(info["shape"]), ctx=ctx)
                 for info in self.state_info(batch_size)]
 
     def reset(self) -> None:

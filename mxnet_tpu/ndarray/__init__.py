@@ -22,6 +22,24 @@ __all__ = (["NDArray", "from_jax", "waitall", "random", "linalg",
            + list(_ops_all) + list(_ops_np_all))
 
 
+class _ContribNamespace:
+    """``mx.nd.contrib`` — the reference's contrib op namespace. Accepts
+    both plain and ``_contrib_``-prefixed spellings and resolves against
+    the one op registry (quantize, interleaved attention matmuls, ...)."""
+
+    def __getattr__(self, name: str):
+        plain = name[len("_contrib_"):] if name.startswith("_contrib_") \
+            else name
+        if plain in list_ops():
+            fn = get_op(plain)
+            setattr(self, name, fn)
+            return fn
+        raise AttributeError(f"no contrib op {name!r}")
+
+
+contrib = _ContribNamespace()
+
+
 def __getattr__(name: str):
     """Resolve any registered op (and the reference's CamelCase aliases)
     as ``mx.nd.<name>`` — the analog of the generated-op namespace."""

@@ -1,0 +1,75 @@
+"""gluon.contrib.rnn tests (reference: test_contrib_rnn.py —
+conv cells + variational dropout)."""
+import numpy as onp
+
+import mxnet_tpu as mx
+from mxnet_tpu._tape import set_training
+from mxnet_tpu.gluon.contrib.rnn import (Conv2DLSTMCell,
+                                         VariationalDropoutCell)
+from mxnet_tpu.gluon.rnn import LSTMCell
+
+
+def test_conv_lstm_shapes_and_unroll():
+    mx.random.seed(0)
+    cell = Conv2DLSTMCell((3, 8, 8), hidden_channels=6)
+    cell.initialize()
+    states = cell.begin_state(batch_size=2)
+    assert states[0].shape == (2, 6, 8, 8)
+    x = mx.np.array(onp.random.RandomState(0)
+                    .uniform(-1, 1, (2, 3, 8, 8)).astype("float32"))
+    out, states = cell(x, states)
+    assert out.shape == (2, 6, 8, 8)
+    seq = mx.np.array(onp.random.RandomState(1)
+                      .uniform(-1, 1, (2, 5, 3, 8, 8)).astype("float32"))
+    outs, _ = cell.unroll(5, seq, layout="NTC")
+    assert outs.shape == (2, 5, 6, 8, 8)
+    assert onp.isfinite(outs.asnumpy()).all()
+
+
+def test_conv_lstm_gate_math_reduces_to_lstm():
+    """With 1x1 kernels on 1x1 spatial input, ConvLSTM == dense LSTM."""
+    mx.random.seed(1)
+    conv = Conv2DLSTMCell((4, 1, 1), hidden_channels=3,
+                          i2h_kernel=(1, 1), h2h_kernel=(1, 1),
+                          i2h_pad=(0, 0))
+    conv.initialize()
+    dense = LSTMCell(3)
+    dense.initialize()
+    dense(mx.np.zeros((1, 4)), dense.begin_state(1))
+    # copy conv weights into the dense cell (reshaped), matching gate
+    # order i,f,c,o
+    dense.i2h_weight.set_data(
+        conv.i2h_weight.data().reshape(12, 4))
+    dense.h2h_weight.set_data(
+        conv.h2h_weight.data().reshape(12, 3))
+    x = onp.random.RandomState(2).uniform(-1, 1, (5, 4)).astype("float32")
+    cs = conv.begin_state(batch_size=5)
+    ds = dense.begin_state(batch_size=5)
+    co, _ = conv(mx.np.array(x.reshape(5, 4, 1, 1)), cs)
+    do, _ = dense(mx.np.array(x), ds)
+    onp.testing.assert_allclose(co.asnumpy().reshape(5, 3),
+                                do.asnumpy(), rtol=1e-4, atol=1e-5)
+
+
+def test_variational_dropout_mask_fixed_within_sequence():
+    mx.random.seed(2)
+    vd = VariationalDropoutCell(LSTMCell(16), drop_inputs=0.5,
+                                drop_outputs=0.3)
+    vd.initialize()
+    st = vd.begin_state(batch_size=4)
+    x = mx.np.array(onp.ones((4, 8), dtype="float32"))
+    prev = set_training(True)
+    try:
+        _, st = vd(x, st)
+        m_in1 = vd._mask_in.asnumpy()
+        m_out1 = vd._mask_out.asnumpy()
+        _, st = vd(x, st)
+        onp.testing.assert_array_equal(vd._mask_in.asnumpy(), m_in1)
+        onp.testing.assert_array_equal(vd._mask_out.asnumpy(), m_out1)
+    finally:
+        set_training(prev)
+    vd.reset()
+    assert vd._mask_in is None and vd._mask_out is None
+    # inference: no dropout
+    out, _ = vd(x, vd.begin_state(batch_size=4))
+    assert vd._mask_in is None
