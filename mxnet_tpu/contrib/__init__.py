@@ -7,3 +7,4 @@ from . import quantization  # noqa: F401
 from . import onnx  # noqa: F401
 from . import text  # noqa: F401
 from . import svrg  # noqa: F401
+from . import tensorboard  # noqa: F401

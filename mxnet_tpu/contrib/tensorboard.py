@@ -1,0 +1,163 @@
+"""TensorBoard logging — the mxboard analog.
+
+Reference parity (leezu/mxnet): the external ``mxboard`` package
+(SURVEY.md 5.5) — ``SummaryWriter.add_scalar/add_histogram`` writing
+TensorFlow event files.  The event-file format is TFRecord framing
+(length + masked CRC32C) around ``Event`` protobufs; both are encoded
+directly here (no tensorflow dependency), and the files load in a stock
+TensorBoard.
+"""
+from __future__ import annotations
+
+import os
+import socket
+import struct
+import time
+from typing import Any, Dict, Optional
+
+import numpy as onp
+
+from ..base import MXNetError
+
+__all__ = ["SummaryWriter"]
+
+
+# -- CRC32C (Castagnoli), table-driven; TFRecord masking ---------------------
+
+_CRC_TABLE = []
+
+
+def _crc_table():
+    global _CRC_TABLE
+    if _CRC_TABLE:
+        return _CRC_TABLE
+    poly = 0x82F63B78
+    for i in range(256):
+        crc = i
+        for _ in range(8):
+            crc = (crc >> 1) ^ poly if crc & 1 else crc >> 1
+        _CRC_TABLE.append(crc)
+    return _CRC_TABLE
+
+
+def _crc32c(data: bytes) -> int:
+    table = _crc_table()
+    crc = 0xFFFFFFFF
+    for b in data:
+        crc = table[(crc ^ b) & 0xFF] ^ (crc >> 8)
+    return crc ^ 0xFFFFFFFF
+
+
+def _masked_crc(data: bytes) -> int:
+    crc = _crc32c(data)
+    return ((crc >> 15 | crc << 17) + 0xA282EAD8) & 0xFFFFFFFF
+
+
+# -- protobuf wire helpers (see contrib/onnx/_proto.py for the scheme) ------
+
+def _varint(n: int) -> bytes:
+    out = bytearray()
+    n &= (1 << 64) - 1
+    while True:
+        b = n & 0x7F
+        n >>= 7
+        out.append(b | (0x80 if n else 0))
+        if not n:
+            return bytes(out)
+
+
+def _tag(field: int, wire: int) -> bytes:
+    return _varint((field << 3) | wire)
+
+
+def _f_bytes(field: int, v: bytes) -> bytes:
+    return _tag(field, 2) + _varint(len(v)) + v
+
+
+def _f_str(field: int, v: str) -> bytes:
+    return _f_bytes(field, v.encode())
+
+
+def _f_double(field: int, v: float) -> bytes:
+    return _tag(field, 1) + struct.pack("<d", v)
+
+
+def _f_float(field: int, v: float) -> bytes:
+    return _tag(field, 5) + struct.pack("<f", v)
+
+
+def _f_varint(field: int, v: int) -> bytes:
+    return _tag(field, 0) + _varint(v)
+
+
+def _f_packed_double(field: int, vals) -> bytes:
+    return _f_bytes(field, b"".join(struct.pack("<d", float(v))
+                                    for v in vals))
+
+
+# Event: wall_time(1,double), step(2,int64), file_version(3,str),
+#        summary(5,msg)
+# Summary.Value: tag(1,str), simple_value(2,float), histo(7,HistogramProto)
+# HistogramProto: min(1,d) max(2,d) num(3,d) sum(4,d) sum_squares(5,d)
+#                 bucket_limit(6,packed d) bucket(7,packed d)
+
+def _event(payload: bytes) -> bytes:
+    return _f_double(1, time.time()) + payload
+
+
+def _record(event: bytes) -> bytes:
+    header = struct.pack("<Q", len(event))
+    return (header + struct.pack("<I", _masked_crc(header))
+            + event + struct.pack("<I", _masked_crc(event)))
+
+
+class SummaryWriter:
+    """Writes TensorBoard event files (``add_scalar`` /
+    ``add_histogram`` / ``flush`` / ``close`` — the mxboard surface)."""
+
+    def __init__(self, logdir: str, filename_suffix: str = "") -> None:
+        os.makedirs(logdir, exist_ok=True)
+        name = (f"events.out.tfevents.{int(time.time())}."
+                f"{socket.gethostname()}{filename_suffix}")
+        self._path = os.path.join(logdir, name)
+        self._f = open(self._path, "wb")
+        self._f.write(_record(_event(_f_str(3, "brain.Event:2"))))
+
+    def add_scalar(self, tag: str, value: Any,
+                   global_step: int = 0) -> None:
+        value = float(value.asnumpy() if hasattr(value, "asnumpy")
+                      else value)
+        val = _f_str(1, tag) + _f_float(2, value)
+        summary = _f_bytes(1, val)
+        self._f.write(_record(_event(
+            _f_varint(2, global_step) + _f_bytes(5, summary))))
+
+    def add_histogram(self, tag: str, values: Any, global_step: int = 0,
+                      bins: int = 30) -> None:
+        arr = onp.asarray(values.asnumpy() if hasattr(values, "asnumpy")
+                          else values, dtype=onp.float64).ravel()
+        if arr.size == 0:
+            raise MXNetError("add_histogram: empty value array")
+        counts, edges = onp.histogram(arr, bins=bins)
+        histo = (_f_double(1, float(arr.min()))
+                 + _f_double(2, float(arr.max()))
+                 + _f_double(3, float(arr.size))
+                 + _f_double(4, float(arr.sum()))
+                 + _f_double(5, float((arr ** 2).sum()))
+                 + _f_packed_double(6, edges[1:])
+                 + _f_packed_double(7, counts))
+        val = _f_str(1, tag) + _f_bytes(7, histo)
+        self._f.write(_record(_event(
+            _f_varint(2, global_step) + _f_bytes(5, _f_bytes(1, val)))))
+
+    def flush(self) -> None:
+        self._f.flush()
+
+    def close(self) -> None:
+        self._f.close()
+
+    def __enter__(self) -> "SummaryWriter":
+        return self
+
+    def __exit__(self, *exc) -> None:
+        self.close()

@@ -1,0 +1,53 @@
+"""TensorBoard SummaryWriter tests (mxboard analog — verifies TFRecord
+framing CRCs and event payload structure without tensorflow)."""
+import os
+import struct
+
+import numpy as onp
+
+import mxnet_tpu as mx
+from mxnet_tpu.contrib.tensorboard import SummaryWriter, _masked_crc
+from mxnet_tpu.contrib.onnx import _proto as P
+
+
+def _records(path):
+    raw = open(path, "rb").read()
+    pos = 0
+    while pos < len(raw):
+        (ln,) = struct.unpack("<Q", raw[pos:pos + 8])
+        (hcrc,) = struct.unpack("<I", raw[pos + 8:pos + 12])
+        assert hcrc == _masked_crc(raw[pos:pos + 8])
+        data = raw[pos + 12:pos + 12 + ln]
+        (dcrc,) = struct.unpack("<I", raw[pos + 12 + ln:pos + 16 + ln])
+        assert dcrc == _masked_crc(data)
+        yield data
+        pos += 16 + ln
+
+
+def test_writer_scalars_and_histogram(tmp_path):
+    d = str(tmp_path / "logs")
+    with SummaryWriter(d) as w:
+        w.add_scalar("loss", 0.5, global_step=3)
+        w.add_scalar("acc", mx.np.array(0.75), global_step=3)
+        w.add_histogram("weights", onp.arange(100.0), global_step=1)
+    files = os.listdir(d)
+    assert len(files) == 1 and files[0].startswith("events.out.tfevents")
+    recs = list(_records(os.path.join(d, files[0])))
+    assert len(recs) == 4          # version + 2 scalars + 1 histogram
+
+    # first record announces the format version
+    f0 = P.decode(recs[0])
+    assert f0[3][0] == b"brain.Event:2"
+
+    # scalar event: step 3, Summary.Value{tag, simple_value}
+    ev = P.decode(recs[1])
+    assert ev[2][0] == 3
+    val = P.decode(P.decode(ev[5][0])[1][0])
+    assert val[1][0] == b"loss"
+    assert abs(struct.unpack("<f", val[2][0])[0] - 0.5) < 1e-7
+
+    # histogram event carries HistogramProto with num=100
+    ev = P.decode(recs[3])
+    val = P.decode(P.decode(ev[5][0])[1][0])
+    histo = P.decode(val[7][0])
+    assert struct.unpack("<d", histo[3][0])[0] == 100.0

@@ -1,0 +1,88 @@
+#!/usr/bin/env python
+"""Collective-bandwidth benchmark.
+
+Reference parity (leezu/mxnet): ``tools/bandwidth/measure.py`` — measured
+kvstore push/pull bandwidth across devices. Here the data plane is XLA
+collectives over the mesh, so this measures allreduce (psum),
+all_gather, and reduce_scatter bus bandwidth per transfer size.
+
+    python tools/bandwidth.py --sizes 1 8 64 --axis dp
+    XLA_FLAGS=--xla_force_host_platform_device_count=8 \
+        JAX_PLATFORMS=cpu python tools/bandwidth.py
+"""
+import argparse
+import os
+import sys
+import time
+
+sys.path.insert(0, os.path.dirname(os.path.dirname(os.path.abspath(__file__))))
+
+
+def main(argv=None):
+    ap = argparse.ArgumentParser()
+    ap.add_argument("--sizes", type=float, nargs="+",
+                    default=[1, 4, 16, 64],
+                    help="transfer sizes in MB (float32 elements)")
+    ap.add_argument("--axis", default="dp")
+    ap.add_argument("--iters", type=int, default=10)
+    ap.add_argument("--force-cpu", action="store_true")
+    args = ap.parse_args(argv)
+
+    if args.force_cpu:
+        import jax
+        jax.config.update("jax_platforms", "cpu")
+    import jax
+    import jax.numpy as jnp
+    from jax.sharding import PartitionSpec as P
+    from mxnet_tpu.parallel import make_mesh
+
+    n = len(jax.devices())
+    if n < 2:
+        print(f"only {n} device(s); collective bandwidth needs >= 2")
+        return 0
+    mesh = make_mesh({args.axis: n})
+    try:
+        from jax import shard_map
+        smap = lambda f: shard_map(f, mesh=mesh, in_specs=P(args.axis),
+                                   out_specs=P(args.axis), check_vma=False)
+    except ImportError:
+        from jax.experimental.shard_map import shard_map as _sm
+        smap = lambda f: _sm(f, mesh=mesh, in_specs=P(args.axis),
+                             out_specs=P(args.axis), check_rep=False)
+
+    results = []
+    for mb in args.sizes:
+        elems = int(mb * 1e6 / 4)
+        x = jnp.ones((n, max(1, elems // 1)), jnp.float32)
+
+        ops = {
+            "psum": jax.jit(smap(
+                lambda v: jax.lax.psum(v, args.axis))),
+            "all_gather": jax.jit(smap(
+                lambda v: jax.lax.all_gather(v, args.axis).reshape(
+                    1, -1))),
+            "reduce_scatter": jax.jit(smap(
+                lambda v: jax.lax.psum_scatter(
+                    v.reshape(-1), args.axis,
+                    tiled=True).reshape(1, -1))),
+        }
+        row = {"size_mb": mb}
+        for name, f in ops.items():
+            out = f(x)
+            jax.block_until_ready(out)
+            t0 = time.perf_counter()
+            for _ in range(args.iters):
+                out = f(x)
+            jax.block_until_ready(out)
+            dt = (time.perf_counter() - t0) / args.iters
+            # bus bandwidth convention (nccl-tests): bytes*(n-1)/n / time
+            bw = mb * 1e6 * (n - 1) / n / dt / 1e9
+            row[name] = bw
+        results.append(row)
+        print(f"{mb:8.1f} MB  " + "  ".join(
+            f"{k}={row[k]:7.2f} GB/s" for k in ops))
+    return 0
+
+
+if __name__ == "__main__":
+    sys.exit(main())
