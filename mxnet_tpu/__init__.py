@@ -62,6 +62,7 @@ _LAZY = {
     "contrib": ".contrib",
     "subgraph": ".subgraph",
     "rtc": ".rtc",
+    "checkpoint": ".checkpoint",
     "name": ".name",
     "attribute": ".attribute",
     "visualization": ".visualization",

@@ -1,0 +1,125 @@
+"""Checkpoint management — restart-safe training state.
+
+Reference parity (leezu/mxnet): ``mod.save_checkpoint`` / epoch-numbered
+``prefix-000N.params`` files + ``Trainer.save_states`` (SURVEY.md 5.4),
+and the 5.3 blueprint note that the TPU build's failure story is
+checkpoint-restart: this manager adds atomicity (tmp + rename), a
+``latest`` pointer, keep-last-k retention, and one-call resume.
+
+Works with anything exposing ``save_checkpoint(prefix)`` /
+``load_checkpoint(prefix)`` (SPMDTrainer), or a (block, trainer) pair
+(gluon save_parameters + Trainer.save_states).
+"""
+from __future__ import annotations
+
+import json
+import os
+import shutil
+import tempfile
+from typing import Any, List, Optional, Tuple
+
+from .base import MXNetError
+
+__all__ = ["CheckpointManager"]
+
+
+class CheckpointManager:
+    """Numbered, atomic, self-pruning checkpoints under ``directory``."""
+
+    def __init__(self, directory: str, max_to_keep: int = 5) -> None:
+        if max_to_keep < 1:
+            raise MXNetError("max_to_keep must be >= 1")
+        self.directory = directory
+        self.max_to_keep = max_to_keep
+        os.makedirs(directory, exist_ok=True)
+
+    # -- bookkeeping -------------------------------------------------------
+    def _meta_path(self) -> str:
+        return os.path.join(self.directory, "checkpoint.json")
+
+    def _read_meta(self) -> dict:
+        try:
+            with open(self._meta_path()) as f:
+                return json.load(f)
+        except (OSError, ValueError):
+            return {"checkpoints": []}
+
+    def _write_meta(self, meta: dict) -> None:
+        tmp = self._meta_path() + ".tmp"
+        with open(tmp, "w") as f:
+            json.dump(meta, f)
+        os.replace(tmp, self._meta_path())
+
+    @property
+    def checkpoints(self) -> List[int]:
+        return list(self._read_meta()["checkpoints"])
+
+    @property
+    def latest_step(self) -> Optional[int]:
+        cks = self._read_meta()["checkpoints"]
+        return cks[-1] if cks else None
+
+    def _prefix(self, step: int) -> str:
+        return os.path.join(self.directory, f"ckpt-{step:07d}")
+
+    # -- save / restore ----------------------------------------------------
+    def save(self, target: Any, step: int,
+             block: Optional[Any] = None) -> str:
+        """Write checkpoint ``step`` atomically and prune old ones.
+
+        target: an object with ``save_checkpoint(prefix)`` (SPMDTrainer),
+        or a gluon Trainer when ``block`` is given (block params +
+        trainer states).
+        """
+        # stage into a temp dir in the same filesystem, then rename files
+        staging = tempfile.mkdtemp(dir=self.directory)
+        try:
+            stage_prefix = os.path.join(staging, "ckpt")
+            if hasattr(target, "save_checkpoint"):
+                target.save_checkpoint(stage_prefix)
+            elif block is not None:
+                block.save_parameters(stage_prefix + ".params")
+                target.save_states(stage_prefix + ".states")
+            else:
+                raise MXNetError(
+                    "target needs save_checkpoint(), or pass block=")
+            final = self._prefix(step)
+            for fname in os.listdir(staging):
+                suffix = fname[len("ckpt"):]
+                os.replace(os.path.join(staging, fname), final + suffix)
+        finally:
+            shutil.rmtree(staging, ignore_errors=True)
+
+        meta = self._read_meta()
+        meta["checkpoints"] = [s for s in meta["checkpoints"]
+                               if s != step] + [step]
+        while len(meta["checkpoints"]) > self.max_to_keep:
+            old = meta["checkpoints"].pop(0)
+            for f in os.listdir(self.directory):
+                if f.startswith(f"ckpt-{old:07d}"):
+                    os.remove(os.path.join(self.directory, f))
+        self._write_meta(meta)
+        return self._prefix(step)
+
+    def restore(self, target: Any, step: Optional[int] = None,
+                block: Optional[Any] = None) -> Optional[int]:
+        """Load checkpoint ``step`` (default: latest). Returns the step
+        restored, or None if the directory has no checkpoints (fresh
+        start)."""
+        if step is None:
+            step = self.latest_step
+            if step is None:
+                return None
+        elif step not in self.checkpoints:
+            raise MXNetError(f"no checkpoint for step {step}; have "
+                             f"{self.checkpoints}")
+        prefix = self._prefix(step)
+        if hasattr(target, "load_checkpoint"):
+            target.load_checkpoint(prefix)
+        elif block is not None:
+            block.load_parameters(prefix + ".params")
+            target.load_states(prefix + ".states")
+        else:
+            raise MXNetError(
+                "target needs load_checkpoint(), or pass block=")
+        return step

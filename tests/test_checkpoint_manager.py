@@ -1,0 +1,85 @@
+"""CheckpointManager tests — atomic numbered checkpoints with retention
+and resume (SURVEY.md 5.3/5.4 checkpoint-restart story)."""
+import os
+
+import jax
+import numpy as onp
+import pytest
+
+import mxnet_tpu as mx
+from mxnet_tpu.checkpoint import CheckpointManager
+from mxnet_tpu.parallel import SPMDTrainer, make_mesh
+
+
+def _trainer():
+    mx.random.seed(0)
+    net = mx.gluon.nn.Dense(4)
+    net.initialize()
+    net(mx.np.zeros((2, 8)))
+    return SPMDTrainer(net, mx.gluon.loss.SoftmaxCrossEntropyLoss(),
+                       "sgd", {"learning_rate": 0.1},
+                       mesh=make_mesh({"dp": 1},
+                                      devices=jax.devices()[:1]))
+
+
+def test_save_restore_roundtrip(tmp_path):
+    tr = _trainer()
+    mgr = CheckpointManager(str(tmp_path), max_to_keep=2)
+    assert mgr.restore(tr) is None          # fresh start
+
+    rng = onp.random.RandomState(0)
+    X = mx.np.array(rng.uniform(-1, 1, (8, 8)).astype("float32"))
+    Y = mx.np.array(rng.randint(0, 4, (8,)).astype("int32"))
+    tr.step(X, Y)
+    mgr.save(tr, step=1)
+    ref = [p.data().asnumpy().copy() for p in tr._params]
+    tr.step(X, Y)
+
+    assert mgr.restore(tr) == 1
+    for p, r in zip(tr._params, ref):
+        onp.testing.assert_allclose(p.data().asnumpy(), r, rtol=1e-6)
+
+
+def test_retention_prunes_old(tmp_path):
+    tr = _trainer()
+    mgr = CheckpointManager(str(tmp_path), max_to_keep=2)
+    X = mx.np.zeros((4, 8))
+    Y = mx.np.zeros((4,), dtype="int32")
+    for s in (1, 2, 3):
+        tr.step(X, Y)
+        mgr.save(tr, step=s)
+    assert mgr.checkpoints == [2, 3]
+    assert mgr.latest_step == 3
+    assert not any(f.startswith("ckpt-0000001")
+                   for f in os.listdir(str(tmp_path)))
+    with pytest.raises(mx.MXNetError, match="no checkpoint"):
+        mgr.restore(tr, step=1)
+
+
+def test_gluon_trainer_pair(tmp_path):
+    mx.random.seed(1)
+    net = mx.gluon.nn.Dense(3)
+    net.initialize()
+    net(mx.np.zeros((1, 5)))
+    tr = mx.gluon.Trainer(net.collect_params(), "adam",
+                          {"learning_rate": 1e-2})
+    lf = mx.gluon.loss.L2Loss()
+    X = mx.np.array(onp.random.RandomState(2)
+                    .uniform(-1, 1, (4, 5)).astype("float32"))
+    Y = mx.np.zeros((4, 3))
+    with mx.autograd.record():
+        loss = lf(net(X), Y).mean()
+    loss.backward()
+    tr.step(4)
+
+    mgr = CheckpointManager(str(tmp_path))
+    mgr.save(tr, step=1, block=net)
+    ref = net.weight.data().asnumpy().copy()
+    with mx.autograd.record():
+        loss = lf(net(X), Y).mean()
+    loss.backward()
+    tr.step(4)
+    assert not onp.allclose(net.weight.data().asnumpy(), ref)
+    mgr.restore(tr, block=net)
+    onp.testing.assert_allclose(net.weight.data().asnumpy(), ref,
+                                rtol=1e-6)
