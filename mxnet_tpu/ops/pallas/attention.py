@@ -6,10 +6,12 @@ is the TPU-native upgrade (SURVEY.md 5.7): tiles of Q stream over tiles of
 K/V held in VMEM with a running max/denominator, so scores never hit HBM.
 
 Forward is the Pallas kernel (grid B×H×Tq-blocks×Tk-blocks, sequential
-accumulation over the last grid axis in VMEM scratch). Backward currently
-recomputes through the dense XLA path via ``jax.custom_vjp`` — flash-fwd /
-dense-bwd; a blockwise backward kernel is planned. On CPU the kernel runs
-in interpret mode, keeping tests meaningful.
+accumulation over the last grid axis in VMEM scratch), emitting the
+per-row log-sum-exp. Backward is blockwise too (standard flash-attention
+recipe): a dq kernel streams K/V blocks against the saved LSE and
+``delta = rowsum(dO·O)``, and a dk/dv kernel streams Q/dO blocks — scores
+are recomputed per tile and never hit HBM in either direction. On CPU the
+kernels run in interpret mode, keeping tests meaningful.
 """
 from __future__ import annotations
 
@@ -25,7 +27,7 @@ from jax.experimental.pallas import tpu as pltpu
 _NEG_INF = -1e30
 
 
-def _flash_fwd_kernel(q_ref, k_ref, v_ref, o_ref,
+def _flash_fwd_kernel(q_ref, k_ref, v_ref, o_ref, lse_ref,
                       acc_ref, m_ref, l_ref, *,
                       scale: float, causal: bool, block_q: int,
                       block_k: int, kv_len: int, num_k_blocks: int):
@@ -70,6 +72,7 @@ def _flash_fwd_kernel(q_ref, k_ref, v_ref, o_ref,
     def _finish():
         denom = jnp.maximum(l_ref[...], 1e-30)
         o_ref[0, 0] = (acc_ref[...] / denom).astype(o_ref.dtype)
+        lse_ref[0, 0] = (m_ref[...] + jnp.log(denom))[:, 0]
 
 
 def _pad_to(x, axis, mult):
@@ -84,7 +87,7 @@ def _pad_to(x, axis, mult):
 
 def _flash_forward(q, k, v, scale: float, causal: bool,
                    block_q: int, block_k: int, interpret: bool):
-    """q/k/v: (B, H, T, D). Returns (B, H, Tq, D)."""
+    """q/k/v: (B, H, T, D). Returns ((B, H, Tq, D), lse (B, H, Tq))."""
     B, H, Tq, D = q.shape
     Tk = k.shape[2]
     qp = _pad_to(q, 2, block_q)
@@ -97,7 +100,7 @@ def _flash_forward(q, k, v, scale: float, causal: bool,
         _flash_fwd_kernel, scale=scale, causal=causal, block_q=block_q,
         block_k=block_k, kv_len=Tk, num_k_blocks=n_k)
 
-    out = pl.pallas_call(
+    out, lse = pl.pallas_call(
         kernel,
         grid=(B, H, n_q, n_k),
         in_specs=[
@@ -108,9 +111,16 @@ def _flash_forward(q, k, v, scale: float, causal: bool,
             pl.BlockSpec((1, 1, block_k, D),
                          lambda b, h, i, j: (b, h, j, 0)),
         ],
-        out_specs=pl.BlockSpec((1, 1, block_q, D),
-                               lambda b, h, i, j: (b, h, i, 0)),
-        out_shape=jax.ShapeDtypeStruct((B, H, Tq_p, D), q.dtype),
+        out_specs=[
+            pl.BlockSpec((1, 1, block_q, D),
+                         lambda b, h, i, j: (b, h, i, 0)),
+            pl.BlockSpec((1, 1, block_q),
+                         lambda b, h, i, j: (b, h, i)),
+        ],
+        out_shape=[
+            jax.ShapeDtypeStruct((B, H, Tq_p, D), q.dtype),
+            jax.ShapeDtypeStruct((B, H, Tq_p), jnp.float32),
+        ],
         scratch_shapes=[
             pltpu.VMEM((block_q, D), jnp.float32),   # acc
             pltpu.VMEM((block_q, 1), jnp.float32),   # running max
@@ -118,7 +128,148 @@ def _flash_forward(q, k, v, scale: float, causal: bool,
         ],
         interpret=interpret,
     )(qp, kp, vp)
-    return out[:, :, :Tq]
+    return out[:, :, :Tq], lse[:, :, :Tq]
+
+
+def _flash_bwd_dq_kernel(q_ref, k_ref, v_ref, do_ref, lse_ref, delta_ref,
+                         dq_ref, dq_acc, *, scale: float, causal: bool,
+                         block_q: int, block_k: int, kv_len: int,
+                         num_k_blocks: int):
+    ik = pl.program_id(3)
+
+    @pl.when(ik == 0)
+    def _init():
+        dq_acc[...] = jnp.zeros_like(dq_acc)
+
+    iq = pl.program_id(2)
+    q = q_ref[0, 0].astype(jnp.float32)                # (bq, d)
+    k = k_ref[0, 0].astype(jnp.float32)                # (bk, d)
+    v = v_ref[0, 0].astype(jnp.float32)
+    do = do_ref[0, 0].astype(jnp.float32)              # (bq, d)
+    lse = lse_ref[0, 0][:, None]                       # (bq, 1)
+    delta = delta_ref[0, 0][:, None]                   # (bq, 1)
+
+    s = jax.lax.dot_general(q, k, (((1,), (1,)), ((), ())),
+                            preferred_element_type=jnp.float32) * scale
+    col = ik * block_k + jax.lax.broadcasted_iota(jnp.int32, s.shape, 1)
+    mask = col < kv_len
+    if causal:
+        row = iq * block_q + jax.lax.broadcasted_iota(jnp.int32, s.shape, 0)
+        mask = jnp.logical_and(mask, col <= row)
+    p = jnp.where(mask, jnp.exp(s - lse), 0.0)         # (bq, bk)
+    dp = jax.lax.dot_general(do, v, (((1,), (1,)), ((), ())),
+                             preferred_element_type=jnp.float32)
+    ds = p * (dp - delta) * scale
+    dq_acc[...] += jax.lax.dot_general(
+        ds, k, (((1,), (0,)), ((), ())),
+        preferred_element_type=jnp.float32)
+
+    @pl.when(ik == num_k_blocks - 1)
+    def _finish():
+        dq_ref[0, 0] = dq_acc[...].astype(dq_ref.dtype)
+
+
+def _flash_bwd_dkv_kernel(q_ref, k_ref, v_ref, do_ref, lse_ref, delta_ref,
+                          dk_ref, dv_ref, dk_acc, dv_acc, *, scale: float,
+                          causal: bool, block_q: int, block_k: int,
+                          kv_len: int, num_q_blocks: int):
+    iq = pl.program_id(3)
+
+    @pl.when(iq == 0)
+    def _init():
+        dk_acc[...] = jnp.zeros_like(dk_acc)
+        dv_acc[...] = jnp.zeros_like(dv_acc)
+
+    ik = pl.program_id(2)
+    q = q_ref[0, 0].astype(jnp.float32)                # (bq, d)
+    k = k_ref[0, 0].astype(jnp.float32)                # (bk, d)
+    v = v_ref[0, 0].astype(jnp.float32)
+    do = do_ref[0, 0].astype(jnp.float32)
+    lse = lse_ref[0, 0][:, None]
+    delta = delta_ref[0, 0][:, None]
+
+    s = jax.lax.dot_general(q, k, (((1,), (1,)), ((), ())),
+                            preferred_element_type=jnp.float32) * scale
+    col = ik * block_k + jax.lax.broadcasted_iota(jnp.int32, s.shape, 1)
+    mask = col < kv_len
+    if causal:
+        row = iq * block_q + jax.lax.broadcasted_iota(jnp.int32, s.shape, 0)
+        mask = jnp.logical_and(mask, col <= row)
+    p = jnp.where(mask, jnp.exp(s - lse), 0.0)         # (bq, bk)
+    # dv += p^T do
+    dv_acc[...] += jax.lax.dot_general(
+        p, do, (((0,), (0,)), ((), ())),
+        preferred_element_type=jnp.float32)
+    dp = jax.lax.dot_general(do, v, (((1,), (1,)), ((), ())),
+                             preferred_element_type=jnp.float32)
+    ds = p * (dp - delta) * scale
+    # dk += ds^T q
+    dk_acc[...] += jax.lax.dot_general(
+        ds, q, (((0,), (0,)), ((), ())),
+        preferred_element_type=jnp.float32)
+
+    @pl.when(iq == num_q_blocks - 1)
+    def _finish():
+        dk_ref[0, 0] = dk_acc[...].astype(dk_ref.dtype)
+        dv_ref[0, 0] = dv_acc[...].astype(dv_ref.dtype)
+
+
+def _flash_backward(q, k, v, o, lse, g, scale: float, causal: bool,
+                    block_q: int, block_k: int, interpret: bool):
+    B, H, Tq, D = q.shape
+    Tk = k.shape[2]
+    delta = jnp.sum(g.astype(jnp.float32) * o.astype(jnp.float32),
+                    axis=-1)                           # (B, H, Tq)
+    qp = _pad_to(q, 2, block_q)
+    dop = _pad_to(g, 2, block_q)
+    lsep = _pad_to(lse, 2, block_q)
+    deltap = _pad_to(delta, 2, block_q)
+    kp = _pad_to(k, 2, block_k)
+    vp = _pad_to(v, 2, block_k)
+    Tq_p, Tk_p = qp.shape[2], kp.shape[2]
+    n_q, n_k = Tq_p // block_q, Tk_p // block_k
+
+    q_spec = pl.BlockSpec((1, 1, block_q, D),
+                          lambda b, h, i, j: (b, h, i, 0))
+    k_spec = pl.BlockSpec((1, 1, block_k, D),
+                          lambda b, h, i, j: (b, h, j, 0))
+    row_spec = pl.BlockSpec((1, 1, block_q), lambda b, h, i, j: (b, h, i))
+
+    dq = pl.pallas_call(
+        functools.partial(_flash_bwd_dq_kernel, scale=scale,
+                          causal=causal, block_q=block_q, block_k=block_k,
+                          kv_len=Tk, num_k_blocks=n_k),
+        grid=(B, H, n_q, n_k),
+        in_specs=[q_spec, k_spec, k_spec, q_spec, row_spec, row_spec],
+        out_specs=q_spec,
+        out_shape=jax.ShapeDtypeStruct((B, H, Tq_p, D), q.dtype),
+        scratch_shapes=[pltpu.VMEM((block_q, D), jnp.float32)],
+        interpret=interpret,
+    )(qp, kp, vp, dop, lsep, deltap)
+
+    # dk/dv: swap the roles — kv blocks on the parallel axis, q blocks
+    # sequential
+    qs_spec = pl.BlockSpec((1, 1, block_q, D),
+                           lambda b, h, j, i: (b, h, i, 0))
+    ks_spec = pl.BlockSpec((1, 1, block_k, D),
+                           lambda b, h, j, i: (b, h, j, 0))
+    rows_spec = pl.BlockSpec((1, 1, block_q),
+                             lambda b, h, j, i: (b, h, i))
+    dk, dv = pl.pallas_call(
+        functools.partial(_flash_bwd_dkv_kernel, scale=scale,
+                          causal=causal, block_q=block_q, block_k=block_k,
+                          kv_len=Tk, num_q_blocks=n_q),
+        grid=(B, H, n_k, n_q),
+        in_specs=[qs_spec, ks_spec, ks_spec, qs_spec, rows_spec,
+                  rows_spec],
+        out_specs=[ks_spec, ks_spec],
+        out_shape=[jax.ShapeDtypeStruct((B, H, Tk_p, D), k.dtype),
+                   jax.ShapeDtypeStruct((B, H, Tk_p, D), v.dtype)],
+        scratch_shapes=[pltpu.VMEM((block_k, D), jnp.float32),
+                        pltpu.VMEM((block_k, D), jnp.float32)],
+        interpret=interpret,
+    )(qp, kp, vp, dop, lsep, deltap)
+    return dq[:, :, :Tq], dk[:, :, :Tk], dv[:, :, :Tk]
 
 
 def _dense_reference(q, k, v, scale: float, causal: bool):
@@ -138,20 +289,23 @@ def _dense_reference(q, k, v, scale: float, causal: bool):
 @functools.partial(jax.custom_vjp, nondiff_argnums=(3, 4, 5, 6))
 def _flash(q, k, v, scale, causal, block_q, block_k):
     interpret = jax.default_backend() == "cpu"
-    return _flash_forward(q, k, v, scale, causal, block_q, block_k,
-                          interpret)
+    out, _ = _flash_forward(q, k, v, scale, causal, block_q, block_k,
+                            interpret)
+    return out
 
 
 def _flash_fwd(q, k, v, scale, causal, block_q, block_k):
-    out = _flash(q, k, v, scale, causal, block_q, block_k)
-    return out, (q, k, v)
+    interpret = jax.default_backend() == "cpu"
+    out, lse = _flash_forward(q, k, v, scale, causal, block_q, block_k,
+                              interpret)
+    return out, (q, k, v, out, lse)
 
 
 def _flash_bwd(scale, causal, block_q, block_k, res, g):
-    q, k, v = res
-    _, vjp = jax.vjp(lambda a, b, c: _dense_reference(a, b, c, scale, causal),
-                     q, k, v)
-    return vjp(g)
+    q, k, v, o, lse = res
+    interpret = jax.default_backend() == "cpu"
+    return _flash_backward(q, k, v, o, lse, g, scale, causal, block_q,
+                           block_k, interpret)
 
 
 _flash.defvjp(_flash_fwd, _flash_bwd)
