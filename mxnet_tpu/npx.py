@@ -8,15 +8,17 @@ from .ops.nn import *  # noqa: F401,F403
 from .ops.nn import __all__ as _nn_all
 from .ops.transformer import *  # noqa: F401,F403
 from .ops.transformer import __all__ as _tr_all
+from .ops.quantization import *  # noqa: F401,F403
+from .ops.quantization import __all__ as _q_all
 from .util import set_np, reset_np, is_np_array, is_np_shape, use_np
 from .context import cpu, gpu, tpu, num_gpus, num_tpus, current_context
 from .ndarray.ndarray import waitall
 from .ndarray.ops import (one_hot, topk, pad, arange, reshape,  # noqa: F401
-                          gather_nd, scatter_nd)
+                          gather_nd, scatter_nd, sigmoid, tanh)
 
-__all__ = list(_nn_all) + list(_tr_all) + [
+__all__ = list(_nn_all) + list(_tr_all) + list(_q_all) + [
     "set_np", "reset_np", "is_np_array", "is_np_shape", "use_np",
     "cpu", "gpu", "tpu", "num_gpus", "num_tpus", "current_context",
     "waitall", "one_hot", "topk", "pad", "arange", "reshape", "gather_nd",
-    "scatter_nd",
+    "scatter_nd", "sigmoid", "tanh",
 ]

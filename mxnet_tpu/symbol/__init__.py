@@ -14,6 +14,27 @@ __all__ = ["Symbol", "Variable", "var", "Group", "load", "load_json",
            "Executor"]
 
 
+class _SymContrib:
+    """``mx.sym.contrib`` — contrib ops as symbol builders (accepts
+    plain or ``_contrib_``-prefixed names, like ``mx.nd.contrib``)."""
+
+    def __getattr__(self, name: str):
+        plain = name[len("_contrib_"):] if name.startswith("_contrib_") \
+            else name
+        if plain not in _list_ops():
+            raise AttributeError(f"no contrib op {name!r}")
+
+        def op_fn(*args, **kwargs):
+            return _apply_op(plain, *args, **kwargs)
+
+        op_fn.__name__ = name
+        setattr(self, name, op_fn)
+        return op_fn
+
+
+contrib = _SymContrib()
+
+
 def __getattr__(name: str):
     canonical = _ALIASES.get(name, name)
     if canonical not in _list_ops():
