@@ -68,9 +68,17 @@ class KVStore:
         keys, vals = self._pair(key, value)
         for k, v in zip(keys, vals):
             if isinstance(v, (list, tuple)):
+                if self._compression:
+                    # compress each device's contribution before the
+                    # reduce — that's the traffic the reference's scheme
+                    # targets (gradient_compression.cc)
+                    v = [self._compress(k, i, x)
+                         for i, x in enumerate(v)]
                 # multi-device gradient lists reduce locally (CommDevice)
                 from .ndarray import ops
                 v = ops.add_n(*v)
+            elif self._compression:
+                v = self._compress(k, 0, v)
             reduced = self._allreduce(v)
             if self._updater is not None and k in self._store:
                 self._updater(k, reduced, self._store[k])
@@ -139,10 +147,39 @@ class KVStore:
         self._updater = get_updater(optimizer)
 
     def set_gradient_compression(self, compression_params: Dict[str, Any]) -> None:
-        """2-bit/fp16 gradient compression (reference:
-        src/kvstore/gradient_compression.cc). Under XLA we support dtype
-        compression of the allreduce payload."""
-        self._compression = dict(compression_params)
+        """Gradient compression (reference:
+        src/kvstore/gradient_compression.cc).
+
+        type='2bit': per-push values quantize to {-threshold, 0,
+        +threshold} with an error-feedback residual carried to the next
+        push (the reference's scheme). type='fp16'/'bf16': dtype-compress
+        the payload (the TPU-native cheap option)."""
+        ctype = compression_params.get("type", "2bit")
+        if ctype not in ("2bit", "fp16", "bf16", "none"):
+            raise MXNetError(f"unknown compression type {ctype!r}")
+        if ctype == "2bit" and float(
+                compression_params.get("threshold", 0.5)) <= 0:
+            raise MXNetError("2bit compression threshold must be > 0")
+        self._compression = {} if ctype == "none" \
+            else dict(compression_params, type=ctype)
+        self._residuals: Dict[Any, NDArray] = {}
+
+    def _compress(self, key: Any, slot: int, v: NDArray) -> NDArray:
+        ctype = self._compression["type"]
+        if ctype in ("fp16", "bf16"):
+            dt = "float16" if ctype == "fp16" else "bfloat16"
+            return v.astype(dt).astype(v.dtype)
+        thr = float(self._compression.get("threshold", 0.5))
+        rkey = (key, slot)
+        res = self._residuals.get(rkey)
+        acc = v if res is None else v + res
+        data = acc._data
+        q = jnp.where(data >= thr, jnp.float32(thr),
+                      jnp.where(data <= -thr, jnp.float32(-thr), 0.0)) \
+            .astype(data.dtype)
+        out = NDArray(q, _wrap=True)
+        self._residuals[rkey] = NDArray(data - q, _wrap=True)
+        return out
 
     def _set_updater(self, updater: Callable) -> None:
         self._updater = updater

@@ -222,3 +222,54 @@ def test_augmenter_dumps_with_arrays():
     for a in augs:
         s = a.dumps()
         assert isinstance(s, str)
+
+
+def test_kvstore_2bit_compression_residual():
+    """2-bit compression quantizes to {-t, 0, +t} and carries the error
+    to the next push (reference gradient_compression.cc semantics)."""
+    import numpy as onp
+    import mxnet_tpu as mx
+    kv = mx.kv.create("device")
+    kv.set_gradient_compression({"type": "2bit", "threshold": 1.0})
+    kv.init("w", mx.np.zeros((4,)))
+    g = mx.np.array(onp.array([0.6, -0.6, 1.4, 0.0], dtype="float32"))
+
+    kv.push("w", g)
+    out = kv.pull("w")
+    onp.testing.assert_allclose(out.asnumpy(), [0, 0, 1.0, 0], atol=1e-6)
+    # residual [0.6, -0.6, 0.4, 0] + next g crosses threshold for idx 0/1
+    kv.push("w", g)
+    out = kv.pull("w")
+    onp.testing.assert_allclose(out.asnumpy(), [1.0, -1.0, 1.0, 0],
+                                atol=1e-6)
+
+
+def test_kvstore_2bit_multi_device_and_errors():
+    import numpy as onp
+    import pytest
+    import mxnet_tpu as mx
+    kv = mx.kv.create("device")
+    with pytest.raises(mx.MXNetError):
+        kv.set_gradient_compression({"type": "1bit"})
+    with pytest.raises(mx.MXNetError):
+        kv.set_gradient_compression({"type": "2bit", "threshold": 0})
+    kv.set_gradient_compression({"type": "2bit", "threshold": 0.5})
+    kv.init("w", mx.np.zeros((2,)))
+    # two device contributions compressed independently, then reduced
+    a = mx.np.array(onp.array([0.7, 0.1], dtype="float32"))
+    b = mx.np.array(onp.array([0.7, 0.2], dtype="float32"))
+    kv.push("w", [a, b])     # per-device value list for one key
+    out = kv.pull("w")
+    onp.testing.assert_allclose(out.asnumpy(), [1.0, 0.0], atol=1e-6)
+
+
+def test_kvstore_bf16_compression_roundtrip():
+    import numpy as onp
+    import mxnet_tpu as mx
+    kv = mx.kv.create("device")
+    kv.set_gradient_compression({"type": "bf16"})
+    kv.init("w", mx.np.zeros((3,)))
+    g = mx.np.array(onp.array([1.0, 2.0, 3.0], dtype="float32"))
+    kv.push("w", g)
+    onp.testing.assert_allclose(kv.pull("w").asnumpy(), [1, 2, 3],
+                                rtol=1e-2)
