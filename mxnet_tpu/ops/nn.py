@@ -38,6 +38,7 @@ __all__ = [
     "instance_norm", "rms_norm", "l2_normalization", "lrn",
     "dropout", "embedding", "pick", "take_positions", "sequence_mask",
     "sequence_last", "sequence_reverse", "topk_mask", "smooth_l1",
+    "up_sampling", "roi_pooling", "ctc_loss",
 ]
 
 
@@ -839,6 +840,96 @@ def make_loss(data, grad_scale: float = 1.0, normalization: str = "null",
 
     _core.defvjp(_fwd, _bwd)
     return invoke("make_loss", _core, (_as_nd(data),))
+
+
+# ---------------------------------------------------------------------------
+# UpSampling / ROIPooling / CTC (reference: src/operator/nn/upsampling.cc,
+# src/operator/roi_pooling.cc, src/operator/contrib/ctc_loss.cc)
+# ---------------------------------------------------------------------------
+
+def up_sampling(data, scale: int = 2, sample_type: str = "nearest",
+                num_filter: int = 0):
+    """Spatial upsample of NCHW data by an integer ``scale``.
+    sample_type: 'nearest' (repeat) or 'bilinear' (jax.image.resize —
+    the reference realizes bilinear as a fixed deconv kernel)."""
+    nd = _as_nd(data)
+    s = int(scale)
+
+    def impl(x):
+        N, C, H, W = x.shape
+        if sample_type == "nearest":
+            return jnp.repeat(jnp.repeat(x, s, axis=2), s, axis=3)
+        if sample_type == "bilinear":
+            return jax.image.resize(x, (N, C, H * s, W * s), "bilinear")
+        raise MXNetError(f"unknown sample_type {sample_type!r}")
+
+    return invoke("up_sampling", impl, (nd,))
+
+
+def roi_pooling(data, rois, pooled_size, spatial_scale: float = 1.0):
+    """Max pooling over regions of interest (reference ``ROIPooling``).
+
+    data: (N, C, H, W); rois: (R, 5) of [batch_idx, x1, y1, x2, y2] in
+    image coordinates (scaled by ``spatial_scale`` onto the feature map).
+    Returns (R, C, ph, pw).  TPU-first formulation: every output bin is a
+    masked max over the full (H, W) plane — static shapes, no gathers.
+    """
+    ph, pw = (pooled_size, pooled_size) if isinstance(pooled_size, int) \
+        else tuple(pooled_size)
+    ss = float(spatial_scale)
+
+    def impl(x, r):
+        N, C, H, W = x.shape
+        batch_idx = r[:, 0].astype(jnp.int32)            # (R,)
+        # quantized roi bounds on the feature map (reference rounding)
+        x1 = jnp.round(r[:, 1] * ss).astype(jnp.int32)
+        y1 = jnp.round(r[:, 2] * ss).astype(jnp.int32)
+        x2 = jnp.round(r[:, 3] * ss).astype(jnp.int32)
+        y2 = jnp.round(r[:, 4] * ss).astype(jnp.int32)
+        rw = jnp.maximum(x2 - x1 + 1, 1).astype(jnp.float32)
+        rh = jnp.maximum(y2 - y1 + 1, 1).astype(jnp.float32)
+        bin_h = rh / ph                                  # (R,)
+        bin_w = rw / pw
+
+        iy = jnp.arange(ph)
+        ix = jnp.arange(pw)
+        # bin edges per roi: (R, ph[+1])
+        hstart = jnp.floor(iy[None, :] * bin_h[:, None]).astype(
+            jnp.int32) + y1[:, None]
+        hend = jnp.ceil((iy[None, :] + 1) * bin_h[:, None]).astype(
+            jnp.int32) + y1[:, None]
+        wstart = jnp.floor(ix[None, :] * bin_w[:, None]).astype(
+            jnp.int32) + x1[:, None]
+        wend = jnp.ceil((ix[None, :] + 1) * bin_w[:, None]).astype(
+            jnp.int32) + x1[:, None]
+
+        hh = jnp.arange(H)
+        ww = jnp.arange(W)
+        # membership masks: (R, ph, H) and (R, pw, W)
+        hmask = (hh[None, None, :] >= hstart[:, :, None]) \
+            & (hh[None, None, :] < jnp.minimum(hend, H)[:, :, None])
+        wmask = (ww[None, None, :] >= wstart[:, :, None]) \
+            & (ww[None, None, :] < jnp.minimum(wend, W)[:, :, None])
+        # (R, ph, pw, H, W)
+        mask = hmask[:, :, None, :, None] & wmask[:, None, :, None, :]
+        feats = x[batch_idx]                             # (R, C, H, W)
+        neg = jnp.finfo(x.dtype).min
+        masked = jnp.where(mask[:, None], feats[:, :, None, None],
+                           neg)                          # (R,C,ph,pw,H,W)
+        out = masked.max(axis=(-2, -1))
+        # empty bins (degenerate rois) produce 0, like the reference
+        empty = ~mask.any(axis=(-2, -1))                 # (R, ph, pw)
+        return jnp.where(empty[:, None], 0.0, out).astype(x.dtype)
+
+    return invoke("roi_pooling", impl, (_as_nd(data), _as_nd(rois)))
+
+
+def ctc_loss(data, label, data_lengths=None, label_lengths=None,
+             layout: str = "NTC"):
+    """Functional CTC loss (reference ``nd.ctc_loss`` /
+    ``_contrib_CTCLoss``); the log-domain DP lives in gluon.loss.CTCLoss."""
+    from ..gluon.loss import CTCLoss as _CTC
+    return _CTC(layout=layout)(data, label, data_lengths, label_lengths)
 
 
 __all__ += ["softmax_output", "linear_regression_output",

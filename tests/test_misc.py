@@ -273,3 +273,55 @@ def test_kvstore_bf16_compression_roundtrip():
     kv.push("w", g)
     onp.testing.assert_allclose(kv.pull("w").asnumpy(), [1, 2, 3],
                                 rtol=1e-2)
+
+
+def test_up_sampling_and_roi_pooling():
+    import numpy as onp
+    import mxnet_tpu as mx
+    x = mx.np.array(onp.arange(4).reshape(1, 1, 2, 2).astype("float32"))
+    up = mx.npx.up_sampling(x, scale=2).asnumpy()
+    onp.testing.assert_array_equal(
+        up[0, 0], [[0, 0, 1, 1], [0, 0, 1, 1], [2, 2, 3, 3], [2, 2, 3, 3]])
+    assert mx.npx.up_sampling(x, scale=2,
+                              sample_type="bilinear").shape == (1, 1, 4, 4)
+
+    def ref(feat, rois, ph, pw, ss):
+        out = onp.zeros((rois.shape[0], feat.shape[1], ph, pw),
+                        feat.dtype)
+        for ri, r in enumerate(rois):
+            b = int(r[0])
+            x1, y1 = int(round(r[1] * ss)), int(round(r[2] * ss))
+            x2, y2 = int(round(r[3] * ss)), int(round(r[4] * ss))
+            rw, rh = max(x2 - x1 + 1, 1), max(y2 - y1 + 1, 1)
+            for i in range(ph):
+                for j in range(pw):
+                    hs = max(int(onp.floor(i * rh / ph)) + y1, 0)
+                    he = min(int(onp.ceil((i + 1) * rh / ph)) + y1,
+                             feat.shape[2])
+                    ws = max(int(onp.floor(j * rw / pw)) + x1, 0)
+                    we = min(int(onp.ceil((j + 1) * rw / pw)) + x1,
+                             feat.shape[3])
+                    if he > hs and we > ws:
+                        out[ri, :, i, j] = feat[b, :, hs:he, ws:we] \
+                            .max(axis=(1, 2))
+        return out
+
+    feat = onp.random.RandomState(0).uniform(-1, 1, (2, 3, 16, 16)) \
+        .astype("float32")
+    rois = onp.array([[0, 0, 0, 7, 7], [1, 4, 4, 15, 15],
+                      [0, 2, 3, 12, 9]], dtype="float32")
+    out = mx.npx.roi_pooling(mx.np.array(feat), mx.np.array(rois),
+                             pooled_size=(4, 4),
+                             spatial_scale=1.0).asnumpy()
+    onp.testing.assert_allclose(out, ref(feat, rois, 4, 4, 1.0),
+                                atol=1e-6)
+
+
+def test_functional_ctc_loss():
+    import numpy as onp
+    import mxnet_tpu as mx
+    rng = onp.random.RandomState(1)
+    logits = mx.np.array(rng.uniform(-1, 1, (2, 10, 5)).astype("float32"))
+    labels = mx.np.array(rng.randint(1, 5, (2, 3)).astype("int32"))
+    l = mx.nd.ctc_loss(logits, labels)
+    assert l.shape == (2,) and (l.asnumpy() > 0).all()
