@@ -13,3 +13,7 @@ from .image import (imdecode, imread, imresize, imrotate, fixed_crop,
                     SaturationJitterAug, HueJitterAug, ColorJitterAug,
                     LightingAug, ColorNormalizeAug, RandomGrayAug,
                     CreateAugmenter, ImageIter)
+
+from .detection import (ImageDetIter, DetHorizontalFlipAug,  # noqa: F401,E402
+                        DetRandomCropAug, DetBorderAug,
+                        CreateDetAugmenter)

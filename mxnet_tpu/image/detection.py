@@ -1,0 +1,196 @@
+"""Object-detection image pipeline.
+
+Reference parity (leezu/mxnet): ``python/mxnet/image/detection.py`` —
+``ImageDetIter`` (detection label format over the ImageIter transport)
+and the ``Det*Aug`` augmenters that keep boxes consistent with the image
+transform (flip mirrors boxes, crop clips/filters them).
+
+Label format per image (reference convention): ``[header_width A,
+object_width B, extra..., obj0(B), obj1(B), ...]`` where each object is
+``[class_id, xmin, ymin, xmax, ymax, ...]`` with coordinates normalized
+to [0, 1].
+"""
+from __future__ import annotations
+
+import random as pyrandom
+from typing import Any, List, Optional, Tuple
+
+import numpy as onp
+
+from ..base import MXNetError
+from ..ndarray.ndarray import NDArray
+from .image import (Augmenter, CastAug, ImageIter, ResizeAug,
+                    fixed_crop, imresize)
+
+__all__ = ["ImageDetIter", "DetHorizontalFlipAug", "DetRandomCropAug",
+           "DetBorderAug", "CreateDetAugmenter"]
+
+
+class DetAugmenter:
+    """Base: ``__call__(src, label) -> (src, label)``; label is the
+    (N_obj, width) float array of [cls, xmin, ymin, xmax, ymax, ...]."""
+
+    def __call__(self, src, label):
+        raise NotImplementedError
+
+
+class DetHorizontalFlipAug(DetAugmenter):
+    """Mirror image and boxes with probability p."""
+
+    def __init__(self, p: float = 0.5) -> None:
+        self.p = p
+
+    def __call__(self, src, label):
+        if pyrandom.random() < self.p:
+            src = src[:, ::-1]
+            label = label.copy()
+            xmin = 1.0 - label[:, 3]
+            xmax = 1.0 - label[:, 1]
+            label[:, 1], label[:, 3] = xmin, xmax
+        return src, label
+
+
+class DetBorderAug(DetAugmenter):
+    """Pad to a square canvas, rescaling boxes (reference uses border
+    fill for aspect-preserving resize)."""
+
+    def __init__(self, fill: float = 127.0) -> None:
+        self.fill = fill
+
+    def __call__(self, src, label):
+        arr = src.asnumpy() if isinstance(src, NDArray) else onp.asarray(src)
+        h, w = arr.shape[:2]
+        s = max(h, w)
+        if h == w:
+            return src, label
+        canvas = onp.full((s, s, arr.shape[2]), self.fill, arr.dtype)
+        y0, x0 = (s - h) // 2, (s - w) // 2
+        canvas[y0:y0 + h, x0:x0 + w] = arr
+        label = label.copy()
+        label[:, 1] = (label[:, 1] * w + x0) / s
+        label[:, 3] = (label[:, 3] * w + x0) / s
+        label[:, 2] = (label[:, 2] * h + y0) / s
+        label[:, 4] = (label[:, 4] * h + y0) / s
+        return NDArray(canvas), label
+
+
+class DetRandomCropAug(DetAugmenter):
+    """Random crop keeping boxes whose centers survive; clips the rest
+    (simplified reference DetRandomCropAug: min_object_covered via
+    center-inclusion)."""
+
+    def __init__(self, min_scale: float = 0.5, max_trials: int = 10,
+                 p: float = 0.5) -> None:
+        self.min_scale = min_scale
+        self.max_trials = max_trials
+        self.p = p
+
+    def __call__(self, src, label):
+        if pyrandom.random() >= self.p or label.shape[0] == 0:
+            return src, label
+        arr = src.asnumpy() if isinstance(src, NDArray) else onp.asarray(src)
+        h, w = arr.shape[:2]
+        for _ in range(self.max_trials):
+            scale = pyrandom.uniform(self.min_scale, 1.0)
+            cw, ch = int(w * scale), int(h * scale)
+            x0 = pyrandom.randint(0, w - cw)
+            y0 = pyrandom.randint(0, h - ch)
+            cx = (label[:, 1] + label[:, 3]) / 2 * w
+            cy = (label[:, 2] + label[:, 4]) / 2 * h
+            keep = ((cx >= x0) & (cx < x0 + cw)
+                    & (cy >= y0) & (cy < y0 + ch))
+            if not keep.any():
+                continue
+            new = label[keep].copy()
+            new[:, 1] = onp.clip((new[:, 1] * w - x0) / cw, 0, 1)
+            new[:, 3] = onp.clip((new[:, 3] * w - x0) / cw, 0, 1)
+            new[:, 2] = onp.clip((new[:, 2] * h - y0) / ch, 0, 1)
+            new[:, 4] = onp.clip((new[:, 4] * h - y0) / ch, 0, 1)
+            return NDArray(arr[y0:y0 + ch, x0:x0 + cw].copy()), new
+        return src, label
+
+
+def CreateDetAugmenter(data_shape, resize: int = 0, rand_crop: float = 0,
+                       rand_mirror: bool = False, mean=None, std=None,
+                       fill: float = 127.0, **kwargs: Any
+                       ) -> List[DetAugmenter]:
+    """Build the standard detection augmenter chain (reference
+    ``CreateDetAugmenter``)."""
+    augs: List[DetAugmenter] = []
+    if rand_crop > 0:
+        augs.append(DetRandomCropAug(p=rand_crop))
+    if rand_mirror:
+        augs.append(DetHorizontalFlipAug(0.5))
+    return augs
+
+
+class ImageDetIter(ImageIter):
+    """Detection iterator: ImageIter transport + multi-object labels
+    (reference ``mx.image.ImageDetIter``).
+
+    Labels per batch come out as (batch, max_objects, object_width),
+    padded with -1 rows (the reference's invalid-object marker).
+    """
+
+    def __init__(self, batch_size: int, data_shape, path_imgrec=None,
+                 path_imglist=None, path_root: str = "", imglist=None,
+                 aug_list: Optional[List[DetAugmenter]] = None,
+                 max_objects: int = 16, object_width: int = 5,
+                 **kwargs: Any) -> None:
+        self._det_augs = aug_list or []
+        self.max_objects = max_objects
+        self.object_width = object_width
+        kwargs.pop("label_width", None)
+        from .image import ForceResizeAug
+        c, hh, ww = data_shape
+        # the transport resizes to the declared shape (normalized boxes
+        # are resize-invariant); det augs then run per image in next()
+        super().__init__(batch_size, data_shape,
+                         label_width=max_objects * object_width,
+                         path_imgrec=path_imgrec,
+                         path_imglist=path_imglist, path_root=path_root,
+                         imglist=imglist,
+                         aug_list=[ForceResizeAug((ww, hh)), CastAug()],
+                         **kwargs)
+
+    def _parse_det_label(self, raw) -> onp.ndarray:
+        """Flat label -> (N_obj, object_width), reference header layout."""
+        raw = onp.asarray(raw, dtype=onp.float32).ravel()
+        if raw.size >= 2 and raw[0] >= 2 and raw[1] >= 5:
+            a, b = int(raw[0]), int(raw[1])
+            objs = raw[a:]
+        else:                        # headerless: plain flat objects
+            b = self.object_width
+            objs = raw
+        n = objs.size // b
+        out = objs[: n * b].reshape(n, b)[:, :self.object_width]
+        # the flat-label transport zero-pads: drop degenerate boxes
+        valid = (out[:, 3] > out[:, 1]) & (out[:, 4] > out[:, 2])
+        return out[valid]
+
+    def next(self):
+        from ..io.io import DataBatch
+        batch = super().next()
+        data = batch.data[0]
+        raw_labels = batch.label[0].asnumpy()
+        B = data.shape[0]
+        out_label = onp.full(
+            (B, self.max_objects, self.object_width), -1.0,
+            dtype=onp.float32)
+        imgs = []
+        for i in range(B):
+            img = data[i].transpose((1, 2, 0))      # CHW -> HWC for augs
+            label = self._parse_det_label(raw_labels[i])
+            for aug in self._det_augs:
+                img, label = aug(img, label)
+            # back to the declared spatial size (crops change it)
+            c, hh, ww = self.data_shape
+            arr = img.asnumpy() if isinstance(img, NDArray) \
+                else onp.asarray(img)
+            if arr.shape[0] != hh or arr.shape[1] != ww:
+                arr = imresize(NDArray(arr), ww, hh).asnumpy()
+            imgs.append(arr.transpose((2, 0, 1)))
+            n = min(label.shape[0], self.max_objects)
+            out_label[i, :n] = label[:n]
+        return DataBatch([NDArray(onp.stack(imgs))],
+                         [NDArray(out_label)], pad=batch.pad)

@@ -1,0 +1,72 @@
+"""Detection image pipeline tests (reference:
+tests/python/unittest/test_image.py ImageDetIter cases)."""
+import os
+
+import numpy as onp
+import pytest
+
+import mxnet_tpu as mx
+from mxnet_tpu.ndarray.ndarray import NDArray
+
+
+def _dataset(tmp_path, n=4):
+    from PIL import Image
+    rng = onp.random.RandomState(0)
+    labels = [[2, 5, 1, 0.1, 0.2, 0.5, 0.6],
+              [2, 5, 0, 0.05, 0.05, 0.3, 0.3, 2, 0.5, 0.5, 0.9, 0.9],
+              [2, 5, 1, 0.2, 0.2, 0.8, 0.8],
+              [2, 5, 0, 0.4, 0.1, 0.6, 0.5]][:n]
+    lst = []
+    for i in range(n):
+        arr = rng.randint(0, 255, (32, 40, 3)).astype("uint8")
+        Image.fromarray(arr).save(str(tmp_path / f"{i}.png"))
+        lst.append([labels[i], f"{i}.png"])
+    return lst
+
+
+def test_det_iter_labels_and_padding(tmp_path):
+    lst = _dataset(tmp_path)
+    it = mx.image.ImageDetIter(batch_size=2, data_shape=(3, 24, 24),
+                               path_root=str(tmp_path), imglist=lst)
+    b = next(it)
+    assert b.data[0].shape == (2, 3, 24, 24)
+    lab = b.label[0].asnumpy()
+    assert lab.shape == (2, 16, 5)
+    onp.testing.assert_allclose(lab[0, 0], [1, 0.1, 0.2, 0.5, 0.6],
+                                atol=1e-5)
+    assert (lab[0, 1:] == -1).all()
+    assert (lab[1, :2, 0] >= 0).all() and (lab[1, 2:] == -1).all()
+
+
+def test_det_flip_mirrors_boxes(tmp_path):
+    lst = _dataset(tmp_path, n=2)
+    it = mx.image.ImageDetIter(
+        batch_size=2, data_shape=(3, 24, 24), path_root=str(tmp_path),
+        imglist=lst, aug_list=[mx.image.DetHorizontalFlipAug(p=1.0)])
+    lab = next(it).label[0].asnumpy()
+    onp.testing.assert_allclose(lab[0, 0], [1, 0.5, 0.2, 0.9, 0.6],
+                                atol=1e-5)
+
+
+def test_det_random_crop_keeps_normalized_boxes(tmp_path):
+    lst = _dataset(tmp_path)
+    it = mx.image.ImageDetIter(
+        batch_size=4, data_shape=(3, 24, 24), path_root=str(tmp_path),
+        imglist=lst, aug_list=[mx.image.DetRandomCropAug(p=1.0)])
+    b = next(it)
+    assert b.data[0].shape == (4, 3, 24, 24)
+    lab = b.label[0].asnumpy()
+    valid = lab[lab[:, :, 0] >= 0]
+    assert (valid[:, 1:] >= 0).all() and (valid[:, 1:] <= 1).all()
+
+
+def test_det_border_aug_squares_and_rescales():
+    img = NDArray(onp.zeros((20, 40, 3), dtype=onp.float32))
+    label = onp.array([[1, 0.25, 0.2, 0.75, 0.8]], dtype=onp.float32)
+    out, lab = mx.image.DetBorderAug()(img, label)
+    assert out.shape == (40, 40, 3)
+    # x untouched (w == s); y rescaled into the centered band
+    onp.testing.assert_allclose(lab[0, 2], (0.2 * 20 + 10) / 40,
+                                atol=1e-6)
+    onp.testing.assert_allclose(lab[0, 4], (0.8 * 20 + 10) / 40,
+                                atol=1e-6)
