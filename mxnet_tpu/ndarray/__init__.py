@@ -16,6 +16,7 @@ from . import image
 from . import sparse
 from .sparse import RowSparseNDArray, CSRNDArray, BaseSparseNDArray
 from .register import get_op, list_ops, register_op, invoke
+from ..ndarray_io import save, load, save_params, load_params
 
 __all__ = (["NDArray", "from_jax", "waitall", "random", "linalg",
             "get_op", "list_ops", "register_op"]
