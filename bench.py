@@ -35,6 +35,7 @@ def bench_bert(batch: int, steps: int, dtype: str, seq_len: int) -> None:
     n_mask = max(1, int(seq_len * 0.15))     # standard 15% MLM masking
     mx.random.seed(0)
     net = get_bert("bert_12_768_12", vocab_size=vocab, dropout=0.0,
+                   max_length=max(512, seq_len),
                    use_pooler=False, use_decoder=True,
                    use_classifier=False)
     net.initialize()

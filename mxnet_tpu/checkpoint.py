@@ -96,7 +96,9 @@ class CheckpointManager:
         while len(meta["checkpoints"]) > self.max_to_keep:
             old = meta["checkpoints"].pop(0)
             for f in os.listdir(self.directory):
-                if f.startswith(f"ckpt-{old:07d}"):
+                # match 'ckpt-NNNNNNN.<suffix>' exactly — a bare prefix
+                # would also delete longer step numbers it prefixes
+                if f.startswith(f"ckpt-{old:07d}."):
                     os.remove(os.path.join(self.directory, f))
         self._write_meta(meta)
         return self._prefix(step)

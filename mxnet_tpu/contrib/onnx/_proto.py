@@ -49,6 +49,15 @@ def field_float(field: int, value: float) -> bytes:
     return _tag(field, 5) + struct.pack("<f", value)
 
 
+def field_double(field: int, value: float) -> bytes:
+    return _tag(field, 1) + struct.pack("<d", value)
+
+
+def field_packed_double(field: int, values) -> bytes:
+    return field_bytes(field, b"".join(struct.pack("<d", float(v))
+                                       for v in values))
+
+
 def field_packed_int64(field: int, values) -> bytes:
     payload = b"".join(_varint(int(v)) for v in values)
     return field_bytes(field, payload)

@@ -53,46 +53,13 @@ def _masked_crc(data: bytes) -> int:
     return ((crc >> 15 | crc << 17) + 0xA282EAD8) & 0xFFFFFFFF
 
 
-# -- protobuf wire helpers (see contrib/onnx/_proto.py for the scheme) ------
-
-def _varint(n: int) -> bytes:
-    out = bytearray()
-    n &= (1 << 64) - 1
-    while True:
-        b = n & 0x7F
-        n >>= 7
-        out.append(b | (0x80 if n else 0))
-        if not n:
-            return bytes(out)
-
-
-def _tag(field: int, wire: int) -> bytes:
-    return _varint((field << 3) | wire)
-
-
-def _f_bytes(field: int, v: bytes) -> bytes:
-    return _tag(field, 2) + _varint(len(v)) + v
-
-
-def _f_str(field: int, v: str) -> bytes:
-    return _f_bytes(field, v.encode())
-
-
-def _f_double(field: int, v: float) -> bytes:
-    return _tag(field, 1) + struct.pack("<d", v)
-
-
-def _f_float(field: int, v: float) -> bytes:
-    return _tag(field, 5) + struct.pack("<f", v)
-
-
-def _f_varint(field: int, v: int) -> bytes:
-    return _tag(field, 0) + _varint(v)
-
-
-def _f_packed_double(field: int, vals) -> bytes:
-    return _f_bytes(field, b"".join(struct.pack("<d", float(v))
-                                    for v in vals))
+# protobuf wire helpers shared with the ONNX codec (one implementation)
+from .onnx._proto import (field_bytes as _f_bytes,       # noqa: E402
+                          field_string as _f_str,
+                          field_float as _f_float,
+                          field_varint as _f_varint,
+                          field_double as _f_double,
+                          field_packed_double as _f_packed_double)
 
 
 # Event: wall_time(1,double), step(2,int64), file_version(3,str),

@@ -110,17 +110,57 @@ class DetRandomCropAug(DetAugmenter):
         return src, label
 
 
+class _ImgOnlyAug(DetAugmenter):
+    """Adapt a plain image augmenter whose transform leaves normalized
+    boxes invariant (uniform resize, color normalize)."""
+
+    def __init__(self, aug) -> None:
+        self.aug = aug
+
+    def __call__(self, src, label):
+        return self.aug(src), label
+
+
+class DetColorNormalizeAug(DetAugmenter):
+    def __init__(self, mean, std) -> None:
+        self.mean = None if mean is None else onp.asarray(
+            mean, dtype=onp.float32)
+        self.std = None if std is None else onp.asarray(
+            std, dtype=onp.float32)
+
+    def __call__(self, src, label):
+        arr = src.asnumpy() if isinstance(src, NDArray) \
+            else onp.asarray(src)
+        arr = arr.astype(onp.float32)
+        if self.mean is not None:
+            arr = arr - self.mean
+        if self.std is not None:
+            arr = arr / self.std
+        return NDArray(arr), label
+
+
 def CreateDetAugmenter(data_shape, resize: int = 0, rand_crop: float = 0,
-                       rand_mirror: bool = False, mean=None, std=None,
-                       fill: float = 127.0, **kwargs: Any
-                       ) -> List[DetAugmenter]:
+                       rand_pad: float = 0, rand_mirror: bool = False,
+                       mean=None, std=None, fill: float = 127.0,
+                       **kwargs: Any) -> List[DetAugmenter]:
     """Build the standard detection augmenter chain (reference
-    ``CreateDetAugmenter``)."""
+    ``CreateDetAugmenter``): resize, random crop/pad, mirror, color
+    normalization. mean/std may be True for ImageNet defaults."""
     augs: List[DetAugmenter] = []
+    if resize > 0:
+        augs.append(_ImgOnlyAug(ResizeAug(resize)))
     if rand_crop > 0:
         augs.append(DetRandomCropAug(p=rand_crop))
+    if rand_pad > 0:
+        augs.append(DetBorderAug(fill=fill))
     if rand_mirror:
         augs.append(DetHorizontalFlipAug(0.5))
+    if mean is True:
+        mean = [123.68, 116.28, 103.53]
+    if std is True:
+        std = [58.395, 57.12, 57.375]
+    if mean is not None or std is not None:
+        augs.append(DetColorNormalizeAug(mean, std))
     return augs
 
 

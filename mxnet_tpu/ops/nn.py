@@ -910,15 +910,23 @@ def roi_pooling(data, rois, pooled_size, spatial_scale: float = 1.0):
             & (hh[None, None, :] < jnp.minimum(hend, H)[:, :, None])
         wmask = (ww[None, None, :] >= wstart[:, :, None]) \
             & (ww[None, None, :] < jnp.minimum(wend, W)[:, :, None])
-        # (R, ph, pw, H, W)
-        mask = hmask[:, :, None, :, None] & wmask[:, None, :, None, :]
         feats = x[batch_idx]                             # (R, C, H, W)
         neg = jnp.finfo(x.dtype).min
-        masked = jnp.where(mask[:, None], feats[:, :, None, None],
-                           neg)                          # (R,C,ph,pw,H,W)
-        out = masked.max(axis=(-2, -1))
+        # rectangle max separates into two staged masked maxes — peak
+        # intermediate stays O(R*C*H*W), not O(R*C*ph*pw*H*W)
+        rows = []
+        for i in range(ph):
+            m = jnp.where(hmask[:, i][:, None, :, None], feats, neg) \
+                .max(axis=2)                             # (R, C, W)
+            cells = []
+            for j in range(pw):
+                cells.append(jnp.where(wmask[:, j][:, None, :], m, neg)
+                             .max(axis=-1))              # (R, C)
+            rows.append(jnp.stack(cells, axis=-1))       # (R, C, pw)
+        out = jnp.stack(rows, axis=-2)                   # (R, C, ph, pw)
         # empty bins (degenerate rois) produce 0, like the reference
-        empty = ~mask.any(axis=(-2, -1))                 # (R, ph, pw)
+        empty = ~(hmask.any(-1)[:, :, None]
+                  & wmask.any(-1)[:, None, :])           # (R, ph, pw)
         return jnp.where(empty[:, None], 0.0, out).astype(x.dtype)
 
     return invoke("roi_pooling", impl, (_as_nd(data), _as_nd(rois)))

@@ -226,20 +226,20 @@ class SPMDTrainer:
         round-trips."""
         raw_step = self._raw_step(n_inputs)
 
-        def multi(param_arrays, opt_states, keys, lr, wd, t0, *batches):
+        def multi(param_arrays, opt_states, keys, lrs, wds, t0, *batches):
             xs, ys = list(batches[:-1]), batches[-1]
 
             def body(carry, inp):
                 params, states, t = carry
-                key = inp[0]
-                step_inputs = inp[1:]
+                key, lr, wd = inp[0], inp[1], inp[2]
+                step_inputs = inp[3:]
                 new_p, new_s, loss = raw_step(
                     params, states, key, lr, wd, t, *step_inputs)
                 return (new_p, new_s, t + 1.0), loss
 
             (params, states, _), losses = jax.lax.scan(
                 body, (list(param_arrays), list(opt_states), t0),
-                (keys,) + tuple(xs) + (ys,))
+                (keys, lrs, wds) + tuple(xs) + (ys,))
             return params, states, losses
 
         donate = (0, 1) if self._donate else ()
@@ -274,13 +274,18 @@ class SPMDTrainer:
             self._multi_fn = self._build_multi_step(len(arrays))
         rng = _random.split_key()
         keys = jax.random.split(rng, K)
-        lr = self.optimizer.learning_rate
-        wd = self.optimizer.wd
+        # per-step lr/wd so schedules advance exactly as K single steps
+        base = self._step_count
+        lrs, wds = [], []
+        for i in range(1, K + 1):
+            self.optimizer.num_update = base + i
+            lrs.append(self.optimizer.learning_rate)
+            wds.append(self.optimizer.wd)
         param_arrays = [p.data()._data for p in self._params]
         new_params, new_states, losses = self._multi_fn(
             param_arrays, self._opt_states, keys,
-            jnp.float32(lr), jnp.float32(wd),
-            jnp.float32(self._step_count + 1), *arrays, label_arr)
+            jnp.asarray(lrs, jnp.float32), jnp.asarray(wds, jnp.float32),
+            jnp.float32(base + 1), *arrays, label_arr)
         self._step_count += K
         self.optimizer.num_update = self._step_count
         for p, a in zip(self._params, new_params):

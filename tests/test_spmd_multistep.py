@@ -46,6 +46,36 @@ def test_run_steps_matches_single_steps():
     assert tr2._step_count == 4
 
 
+def test_run_steps_matches_single_steps_with_lr_schedule():
+    """Fused steps must advance the lr schedule per step, not hold the
+    pre-call lr for all K (regression)."""
+    import mxnet_tpu.lr_scheduler as lrs
+    rng = onp.random.RandomState(3)
+    X = rng.uniform(-1, 1, (4, 8, 8)).astype("float32")
+    Y = rng.randint(0, 4, (4, 8)).astype("int32")
+    lf = mx.gluon.loss.SoftmaxCrossEntropyLoss()
+
+    def make_tr():
+        sched = lrs.FactorScheduler(step=1, factor=0.5, base_lr=0.4)
+        return SPMDTrainer(_build(), lf, "sgd",
+                           {"lr_scheduler": sched},
+                           mesh=make_mesh({"dp": 1},
+                                          devices=jax.devices()[:1]))
+
+    tr1 = make_tr()
+    ref = [float(tr1.step(mx.np.array(X[i]),
+                          mx.np.array(Y[i])).asnumpy())
+           for i in range(4)]
+    tr2 = make_tr()
+    losses = tr2.run_steps(mx.np.array(X), mx.np.array(Y))
+    onp.testing.assert_allclose(losses.asnumpy(), ref, rtol=1e-4,
+                                atol=1e-5)
+    for p1, p2 in zip(tr1._params, tr2._params):
+        onp.testing.assert_allclose(p1.data().asnumpy(),
+                                    p2.data().asnumpy(),
+                                    rtol=1e-4, atol=1e-5)
+
+
 def test_run_steps_sharded_mesh():
     """Fused steps under a dp x tp mesh keep losses finite and
     decreasing over enough steps."""

@@ -53,7 +53,7 @@ def main(argv=None):
     results = []
     for mb in args.sizes:
         elems = int(mb * 1e6 / 4)
-        x = jnp.ones((n, max(1, elems // 1)), jnp.float32)
+        x = jnp.ones((n, max(1, elems)), jnp.float32)
 
         ops = {
             "psum": jax.jit(smap(
@@ -66,6 +66,12 @@ def main(argv=None):
                     v.reshape(-1), args.axis,
                     tiled=True).reshape(1, -1))),
         }
+        # nccl-tests busBw factors on the per-rank shard of mb MB:
+        # allreduce moves 2(n-1)/n * mb per rank; all_gather /
+        # reduce_scatter move (n-1) * mb (total buffer is n*mb)
+        factors = {"psum": 2.0 * (n - 1) / n,
+                   "all_gather": float(n - 1),
+                   "reduce_scatter": float(n - 1)}
         row = {"size_mb": mb}
         for name, f in ops.items():
             out = f(x)
@@ -75,9 +81,7 @@ def main(argv=None):
                 out = f(x)
             jax.block_until_ready(out)
             dt = (time.perf_counter() - t0) / args.iters
-            # bus bandwidth convention (nccl-tests): bytes*(n-1)/n / time
-            bw = mb * 1e6 * (n - 1) / n / dt / 1e9
-            row[name] = bw
+            row[name] = mb * 1e6 * factors[name] / dt / 1e9
         results.append(row)
         print(f"{mb:8.1f} MB  " + "  ".join(
             f"{k}={row[k]:7.2f} GB/s" for k in ops))
