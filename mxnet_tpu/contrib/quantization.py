@@ -278,6 +278,12 @@ def quantize_net(net: HybridBlock, quantized_dtype: str = "int8",
 
             layer.forward = hooked
             hooks.append((layer, orig))
+        # calibration must run EAGERLY: a hybridized net would execute
+        # its cached compiled graph and the observer hooks would never
+        # fire (silent garbage ranges)
+        was_active = bool(getattr(net, "_active", False))
+        if was_active:
+            net.hybridize(active=False)
         try:
             for i, batch in enumerate(calib_data):
                 if num_calib_batches is not None \
@@ -289,6 +295,8 @@ def quantize_net(net: HybridBlock, quantized_dtype: str = "int8",
         finally:
             for layer, orig in hooks:
                 layer.forward = orig
+            if was_active:
+                net.hybridize(active=True)
         ranges = {p: obs.range() for p, obs in observers.items()}
         for p, r in ranges.items():
             log.info("calibrated %s: range (%.4g, %.4g)", p, *r)
@@ -303,4 +311,9 @@ def quantize_net(net: HybridBlock, quantized_dtype: str = "int8",
         # attribute-registered children also live in __dict__
         if parent.__dict__.get(name) is layer:
             object.__setattr__(parent, name, qlayer)
+        # any compiled cache of the parent now traces the old children
+        if hasattr(parent, "_cached_graph"):
+            parent._cached_graph.clear()
+    if hasattr(net, "_cached_graph"):
+        net._cached_graph.clear()
     return net

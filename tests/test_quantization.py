@@ -176,6 +176,28 @@ def test_quantize_net_hybridizes():
     assert onp.abs(hybrid - ref).max() < 0.1 * onp.abs(ref).max() + 0.05
 
 
+def test_quantize_net_on_hybridized_net():
+    """Calibration must run eagerly: on a hybridized net the cached
+    compiled graph would bypass the observer hooks, producing garbage
+    ranges (regression — predictions collapsed to ~random)."""
+    mx.random.seed(4)
+    net = mx.gluon.nn.HybridSequential()
+    net.add(mx.gluon.nn.Conv2D(4, 3, padding=1, activation="relu"),
+            mx.gluon.nn.GlobalAvgPool2D(), mx.gluon.nn.Dense(3))
+    net.initialize()
+    X = mx.np.array(onp.random.RandomState(11)
+                    .uniform(-1, 1, (8, 2, 8, 8)).astype("float32"))
+    net(X)
+    net.hybridize()
+    ref = net(X).asnumpy()
+    qnet = quantize_net(net, calib_data=[X], calib_mode="naive")
+    out = qnet(X).asnumpy()
+    assert onp.abs(out - ref).max() < 0.1 * onp.abs(ref).max() + 0.05
+    # calibrated ranges are real, not +-inf garbage
+    qconv = qnet._children["0"]
+    assert onp.isfinite([qconv._in_min, qconv._in_max]).all()
+
+
 def test_quantize_errors():
     net = _mlp()
     with pytest.raises(mx.MXNetError):
