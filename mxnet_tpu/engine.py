@@ -69,6 +69,37 @@ def _sync_and_translate(arr: Any) -> Any:
         raise MXNetError(str(exc)) from exc
 
 
+_LAUNDER_CACHE: dict = {}
+
+
+def launder(arrays):
+    """Re-materialize eager-op-produced buffers as compiled-executable
+    outputs before they become jit arguments.
+
+    On the axon remote-TPU backend, arrays produced by per-op eager
+    dispatch are lazy handles: every compiled-program call consuming them
+    pays a tunnel round-trip PER HANDLE (~1s each — measured 60-80s/call
+    for a 267-parameter ResNet forward vs 37ms after laundering).  A
+    single jitted identity copy turns them into ordinary device buffers.
+    No-op on CPU where eager results are already plain buffers.
+    """
+    single = not isinstance(arrays, (list, tuple))
+    arrs = [arrays] if single else list(arrays)
+    try:
+        if jax.devices()[0].platform == "cpu":
+            return arrays
+    except Exception:
+        return arrays
+    n = len(arrs)
+    fn = _LAUNDER_CACHE.get(n)
+    if fn is None:
+        import jax.numpy as _jnp
+        fn = jax.jit(lambda xs: [_jnp.asarray(a).copy() for a in xs])
+        _LAUNDER_CACHE[n] = fn
+    out = fn(arrs)
+    return out[0] if single else out
+
+
 def waitall() -> None:
     """Block until all pushed device work completes (``mx.nd.waitall``)."""
     for key, ref in list(_LIVE.items()):

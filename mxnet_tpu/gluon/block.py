@@ -327,6 +327,13 @@ class HybridBlock(Block):
         def impl(*arrays):
             return cached(rng, list(arrays[:n_params]), *arrays[n_params:])
 
+        # launder eager-produced param buffers: on the axon remote
+        # backend they are lazy handles costing a tunnel round-trip per
+        # jit argument per call (engine.launder; no-op on CPU)
+        from .. import engine as _engine
+        clean = _engine.launder([p.data()._data for p in params])
+        for p, a in zip(params, clean):
+            p._data._data = a
         inputs = [p.data() for p in params] + nd_args
         flat_out = invoke(f"cached_{type(self).__name__}", impl, inputs)
         leaves = list(flat_out) if isinstance(flat_out, tuple) else [flat_out]

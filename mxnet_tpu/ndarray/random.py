@@ -56,12 +56,23 @@ def current_key() -> Any:
     return _STATE.key
 
 
+_split_jit = None
+
+
 def split_key() -> Any:
-    """Draw a fresh subkey (eager) or fold from the traced key (tracing)."""
+    """Draw a fresh subkey (eager) or fold from the traced key (tracing).
+
+    The eager split runs JITTED so the returned keys are clean compiled
+    outputs — on the axon remote backend, eager-op-produced arrays are
+    lazy handles that cost a tunnel round-trip per consuming jit call
+    (see ``engine.launder``)."""
     if _STATE.trace_key is not None:
         _STATE.trace_count += 1
         return jax.random.fold_in(_STATE.trace_key, _STATE.trace_count)
-    _STATE.key, sub = jax.random.split(_STATE.key)
+    global _split_jit
+    if _split_jit is None:
+        _split_jit = jax.jit(lambda k: tuple(jax.random.split(k)))
+    _STATE.key, sub = _split_jit(_STATE.key)
     return sub
 
 

@@ -138,12 +138,16 @@ class SPMDTrainer:
             p for p in block.collect_params().values() if p.is_initialized]
         self._names = [k for k, p in block.collect_params().items()
                        if p.is_initialized]
-        # place parameters onto the mesh per rules
+        # launder eager-produced parameter buffers first (axon: lazy
+        # handles cost a tunnel round-trip PER PARAM per step — see
+        # engine.launder), then place onto the mesh per rules
+        from .. import engine as _engine
+        clean = _engine.launder([p.data()._data for p in self._params])
         self._param_shardings = []
-        for name, p in zip(self._names, self._params):
+        for name, p, arr in zip(self._names, self._params, clean):
             spec = rules.spec_for(name, tuple(p.shape), mesh)
             sh = jax.sharding.NamedSharding(mesh, spec)
-            p._data._data = jax.device_put(p.data()._data, sh)
+            p._data._data = jax.device_put(arr, sh)
             self._param_shardings.append(sh)
         if mesh.size > 1:
             # eager ops may now mix mesh-placed params with fresh
@@ -151,13 +155,18 @@ class SPMDTrainer:
             from ..ndarray import register as _register
             _register._mesh_state["active"] = True
 
-        # optimizer states co-sharded with their parameter
-        self._opt_states = []
-        for i, p in enumerate(self._params):
-            state = self.optimizer.create_state_multi_precision(i, p.data())
-            state = jax.tree_util.tree_map(
-                lambda a: jax.device_put(a, self._param_shardings[i]), state)
-            self._opt_states.append(state)
+        # optimizer states co-sharded with their parameter (laundered:
+        # they come from eager state-creation ops)
+        states = [self.optimizer.create_state_multi_precision(i, p.data())
+                  for i, p in enumerate(self._params)]
+        leaves, treedef = jax.tree_util.tree_flatten(states)
+        leaves = _engine.launder(leaves) if leaves else leaves
+        states = jax.tree_util.tree_unflatten(treedef, leaves)
+        self._opt_states = [
+            jax.tree_util.tree_map(
+                lambda a, s=self._param_shardings[i]: jax.device_put(a, s),
+                st)
+            for i, st in enumerate(states)]
 
         self._step_fn = None
         self._multi_fn = None
