@@ -743,3 +743,61 @@ def require(a, dtype=None, requirements=None):
     if dtype is not None:
         return from_jax(nd._data.astype(dtype))
     return nd
+
+
+# ---------------------------------------------------------------------------
+# Linear-algebra / index round-out (np.cross, diagonal, sorting variants)
+# ---------------------------------------------------------------------------
+
+@_public
+def cross(a, b, axisa=-1, axisb=-1, axisc=-1, axis=None):
+    if axis is not None:
+        axisa = axisb = axisc = axis
+    return invoke("cross",
+                  lambda x, y: jnp.cross(x, y, axisa=axisa, axisb=axisb,
+                                         axisc=axisc),
+                  (_as_nd(a), _as_nd(b)))
+
+
+@_public
+def diagonal(a, offset=0, axis1=0, axis2=1):
+    return invoke("diagonal",
+                  lambda x: jnp.diagonal(x, offset=offset, axis1=axis1,
+                                         axis2=axis2),
+                  (_as_nd(a),))
+
+
+@_public
+def partition(a, kth, axis=-1):
+    return invoke("partition",
+                  lambda x: jnp.partition(x, kth=kth, axis=axis),
+                  (_as_nd(a),))
+
+
+@_public
+def argpartition(a, kth, axis=-1):
+    return invoke("argpartition",
+                  lambda x: jnp.argpartition(x, kth=kth, axis=axis),
+                  (_as_nd(a),))
+
+
+@_public
+def lexsort(keys, axis=-1):
+    return invoke("lexsort",
+                  lambda *ks: jnp.lexsort(list(ks), axis=axis),
+                  _nds(list(keys)))
+
+
+@_public
+def packbits(a, axis=None, bitorder="big"):
+    return invoke("packbits",
+                  lambda x: jnp.packbits(x, axis=axis, bitorder=bitorder),
+                  (_as_nd(a),))
+
+
+@_public
+def unpackbits(a, axis=None, count=None, bitorder="big"):
+    return invoke("unpackbits",
+                  lambda x: jnp.unpackbits(x, axis=axis, count=count,
+                                           bitorder=bitorder),
+                  (_as_nd(a),))

@@ -209,3 +209,27 @@ def test_average_returned_negative_axis():
 def test_choose_raises_out_of_bounds():
     with pytest.raises(Exception):
         mnp.choose(mnp.array([0, 3]), [mnp.array([1, 2]), mnp.array([3, 4])])
+
+
+def test_cross_diagonal_partition_lexsort_packbits():
+    a = onp.random.rand(3).astype("float32")
+    b = onp.random.rand(3).astype("float32")
+    assert_almost_equal(mnp.cross(mnp.array(a), mnp.array(b)),
+                        onp.cross(a, b), rtol=1e-5, atol=1e-6)
+    m = onp.random.rand(4, 5).astype("float32")
+    assert_almost_equal(mnp.diagonal(mnp.array(m), offset=1),
+                        onp.diagonal(m, offset=1), rtol=1e-6, atol=0)
+    v = onp.random.rand(8).astype("float32")
+    assert float(mnp.partition(mnp.array(v), 3)[3]) == \
+        float(onp.partition(v, 3)[3])
+    idx = mnp.argpartition(mnp.array(v), 3)
+    assert float(v[int(idx[3])]) == float(onp.partition(v, 3)[3])
+    k1 = onp.array([2, 1, 3, 1])
+    k2 = onp.array([0, 0, 1, 1])
+    assert mnp.lexsort([mnp.array(k1), mnp.array(k2)]).asnumpy().tolist() \
+        == onp.lexsort([k1, k2]).tolist()
+    bits = onp.array([1, 0, 1, 1, 0, 0, 1, 0, 1], dtype=onp.uint8)
+    packed = mnp.packbits(mnp.array(bits))
+    assert packed.asnumpy().tolist() == onp.packbits(bits).tolist()
+    assert mnp.unpackbits(packed, count=9).asnumpy().tolist() == \
+        bits.tolist()
