@@ -217,6 +217,16 @@ def _bind_params(params: Sequence[Parameter], arrays: Sequence[Any]):
             p._data._data = s
 
 
+def _collect_mutated(params: Sequence[Parameter],
+                     bound_arrays: Sequence[Any]) -> List[Tuple[int, Any]]:
+    """In-trace writes to parameter state (BatchNorm running stats) as
+    ``(index, new_array)`` pairs — identity-compared against the arrays
+    `_bind_params` bound, so it MUST run inside the ``_bind_params``
+    scope, before the saved buffers are restored."""
+    return [(i, p._data._data) for i, p in enumerate(params)
+            if p._data._data is not bound_arrays[i]]
+
+
 class HybridBlock(Block):
     """A Block that can be compiled to a single XLA executable.
 
@@ -289,6 +299,11 @@ class HybridBlock(Block):
                         _random.trace_key_scope(rng_key):
                     inputs = [from_jax(a) for a in input_arrays]
                     out = block.forward(*inputs)
+                    # BatchNorm running stats etc.: the reference updates
+                    # them as a side effect of the cached graph
+                    # (src/operator/nn/batch_norm); here they ride out as
+                    # extra outputs and are written back by the caller
+                    mutated = _collect_mutated(params, param_arrays)
             finally:
                 set_training(prev)
             raw = jax.tree_util.tree_map(
@@ -296,7 +311,8 @@ class HybridBlock(Block):
                 is_leaf=lambda o: isinstance(o, NDArray))
             leaves, treedef = jax.tree_util.tree_flatten(raw)
             cell["treedef"] = treedef
-            return tuple(leaves)
+            cell["mutated_idx"] = [i for i, _ in mutated]
+            return tuple(leaves) + tuple(a for _, a in mutated)
 
         return traced
 
@@ -327,16 +343,26 @@ class HybridBlock(Block):
         def impl(*arrays):
             return cached(rng, list(arrays[:n_params]), *arrays[n_params:])
 
-        # launder eager-produced param buffers: on the axon remote
-        # backend they are lazy handles costing a tunnel round-trip per
-        # jit argument per call (engine.launder; no-op on CPU)
+        # launder eager-produced param AND input buffers: on the axon
+        # remote backend they are lazy handles that re-pay their transfer
+        # on every consuming jit call (engine.launder; no-op on CPU)
         from .. import engine as _engine
-        clean = _engine.launder([p.data()._data for p in params])
+        clean = _engine.launder([p.data()._data for p in params] +
+                                [a._data for a in nd_args])
         for p, a in zip(params, clean):
             p._data._data = a
+        for nd, a in zip(nd_args, clean[len(params):]):
+            nd._data = a
         inputs = [p.data() for p in params] + nd_args
         flat_out = invoke(f"cached_{type(self).__name__}", impl, inputs)
         leaves = list(flat_out) if isinstance(flat_out, tuple) else [flat_out]
+        m_idx = cell.get("mutated_idx") or []
+        if m_idx:
+            n_out = cell["treedef"].num_leaves
+            for i, a in zip(m_idx, leaves[n_out:]):
+                params[i]._data._data = \
+                    a._data if isinstance(a, NDArray) else a
+            leaves = leaves[:n_out]
         return jax.tree_util.tree_unflatten(cell["treedef"], leaves)
 
     def __call__(self, *args: Any) -> Any:
@@ -376,9 +402,6 @@ class HybridBlock(Block):
 
         params = {k: v for k, v in self.collect_params().items()
                   if v.is_initialized}
-        param_file = f"{path}-{epoch:04d}.params"
-        from ..ndarray_io import save_params
-        save_params(param_file, {k: v.data() for k, v in params.items()})
 
         from jax import export as jax_export
         param_list = list(params.values())
@@ -399,7 +422,11 @@ class HybridBlock(Block):
             if "platform" not in str(e).lower():
                 raise
             exp = jax_export.export(jitted)(key_spec, param_specs, *in_specs)
-
+        if cell.get("mutated_idx"):
+            raise MXNetError(
+                "export traced a forward that mutates parameter state "
+                "(training-mode BatchNorm?); export runs in inference "
+                "mode — check autograd/use_global_stats configuration")
         meta = {
             "framework": "mxnet_tpu",
             "format_version": 1,
@@ -413,6 +440,11 @@ class HybridBlock(Block):
             "stablehlo": base64.b64encode(bytes(exp.serialize())).decode(
                 "ascii"),
         }
+        # write artifacts only after trace + serialization succeeded — a
+        # failed export must not leave a stale .params behind
+        param_file = f"{path}-{epoch:04d}.params"
+        from ..ndarray_io import save_params
+        save_params(param_file, {k: v.data() for k, v in params.items()})
         sym_file = f"{path}-symbol.json"
         with open(sym_file, "w") as f:
             json.dump(meta, f, indent=2)

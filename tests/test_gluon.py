@@ -275,3 +275,33 @@ def test_trainer_varying_batch_size():
         tr.step(batch_size=bs)
         delta = net.weight.data().item() - w_before
         assert abs(delta + 1.0 / bs) < 1e-6, (bs, delta)
+
+
+def test_hybridized_batchnorm_updates_running_stats():
+    """Hybridized training forward must update BN running stats exactly
+    like the imperative path (reference: stats are a stateful side effect
+    of the cached graph — CachedOp runs the same stateful BN op)."""
+    def build():
+        mx.random.seed(11)
+        net = nn.HybridSequential()
+        net.add(nn.Dense(6, in_units=5),
+                nn.BatchNorm(axis=-1, in_channels=6))
+        net.initialize()
+        return net
+
+    imp, hyb = build(), build()
+    hyb.hybridize()
+    x = rand_ndarray((8, 5), low=0.5, high=1.5)
+    for _ in range(2):
+        with ag.record():
+            a = imp(x)
+            b = hyb(x)
+    assert_almost_equal(a, b, rtol=1e-5, atol=1e-6)
+    assert hyb[1].running_mean.data().asnumpy().sum() != 0
+    assert_almost_equal(imp[1].running_mean.data(),
+                        hyb[1].running_mean.data(), rtol=1e-5, atol=1e-7)
+    assert_almost_equal(imp[1].running_var.data(),
+                        hyb[1].running_var.data(), rtol=1e-5, atol=1e-7)
+    # eval after training consumes the updated stats identically
+    ea, eb = imp(x), hyb(x)
+    assert_almost_equal(ea, eb, rtol=1e-5, atol=1e-6)

@@ -96,3 +96,37 @@ def test_run_steps_sharded_mesh():
         assert onp.isfinite(losses).all()
         first = first if first is not None else losses[0]
     assert losses[-1] < first, (first, losses)
+
+
+def test_run_steps_updates_batchnorm_stats():
+    """BN running stats must advance through the lax.scan carry of the
+    fused multi-step path exactly like K single steps."""
+    import numpy as onp
+    mx.random.seed(3)
+    net = mx.gluon.nn.HybridSequential()
+    net.add(mx.gluon.nn.Dense(6, in_units=4),
+            mx.gluon.nn.BatchNorm(axis=-1, in_channels=6),
+            mx.gluon.nn.Dense(2, in_units=6))
+    net.initialize()
+    mesh = make_mesh({"dp": 2}, devices=jax.devices()[:2])
+    tr = SPMDTrainer(net, mx.gluon.loss.SoftmaxCrossEntropyLoss(),
+                     optimizer="sgd",
+                     optimizer_params={"learning_rate": 0.0,
+                                       "momentum": 0.9},
+                     mesh=mesh, rules=DATA_PARALLEL_RULES)
+    rng = onp.random.RandomState(0)
+    K = 3
+    xs = rng.uniform(0.5, 1.5, (K, 8, 4)).astype("float32")
+    ys = rng.randint(0, 2, (K, 8)).astype("int32")
+    losses = tr.run_steps(mx.np.array(xs), mx.np.array(ys))
+    assert losses.shape == (K,)
+    bn = net[1]
+    rm = onp.asarray(bn.running_mean.data()._data)
+    assert not onp.allclose(rm, 0.0)
+    # lr=0 freezes weights, so the momentum recursion over each scan
+    # step's batch mean is exact
+    expect = onp.zeros(6, dtype="float64")
+    for k in range(K):
+        hk = onp.asarray(net[0](mx.np.array(xs[k]))._data)
+        expect = 0.9 * expect + 0.1 * hk.mean(axis=0)
+    onp.testing.assert_allclose(rm, expect, rtol=2e-2, atol=2e-4)

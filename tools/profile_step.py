@@ -1,10 +1,9 @@
 """Break down where a ResNet-50 training step spends wall-clock.
 
-Phases timed separately:
-  1. host prep (input device_put + param list build)
-  2. jit dispatch (call returns, no sync)
-  3. device completion (fetch loss scalar)
-Plus a pure-jax matmul/conv calibration of the tunnel + chip.
+Per step it prints dispatch time (trainer.step returns — includes host
+prep and input device_put, no device sync) and total time including the
+loss sync; plus a one-off param-list-build cost and a pure-jax
+matmul/conv calibration of the tunnel + chip.
 """
 import os
 import sys
@@ -62,7 +61,7 @@ def calibrate():
                 dimension_numbers=("NHWC", "HWIO", "NHWC"))
         return a
 
-    convs(img, ker)
+    convs(img, ker).block_until_ready()
     t0 = time.perf_counter()
     _ = onp.asarray(convs(img, ker)[0, 0, 0, 0])
     dt = time.perf_counter() - t0
