@@ -73,15 +73,16 @@ _LAUNDER_CACHE: dict = {}
 
 
 def launder(arrays):
-    """Re-materialize eager-op-produced buffers as compiled-executable
-    outputs before they become jit arguments.
+    """Re-materialize eager-produced buffers as accelerator-resident
+    compiled-program outputs before they become jit arguments.
 
-    On the axon remote-TPU backend, arrays produced by per-op eager
-    dispatch are lazy handles: every compiled-program call consuming them
-    pays a tunnel round-trip PER HANDLE (~1s each — measured 60-80s/call
-    for a 267-parameter ResNet forward vs 37ms after laundering).  A
-    single jitted identity copy turns them into ordinary device buffers.
-    No-op on CPU where eager results are already plain buffers.
+    Eager dispatch runs on the eager backend (host CPU under the axon
+    remote-TPU tunnel), so eager-produced arrays consumed by a compiled
+    program re-pay their host->device transfer on EVERY call (measured
+    60-80s/call for a 267-parameter ResNet forward vs 37ms laundered;
+    ~1s/step for a re-used 19MB input batch).  One jitted identity copy
+    moves them onto the accelerator once.  No-op when the default
+    platform IS the cpu backend (tests / virtual mesh).
     """
     single = not isinstance(arrays, (list, tuple))
     arrs = [arrays] if single else list(arrays)

@@ -120,6 +120,7 @@ class Trainer:
         self._update(ignore_stale_grad)
 
     def _update(self, ignore_stale_grad: bool = False) -> None:
+        updatable = []
         for i, p in enumerate(self._params):
             if p.grad_req == "null" or not p.is_initialized:
                 continue
@@ -136,9 +137,100 @@ class Trainer:
             if i not in self._states:
                 self._states[i] = \
                     self._optimizer.create_state_multi_precision(i, w)
-            self._states[i] = self._optimizer.update_multi_precision(
-                i, w, g, self._states[i])
+            updatable.append((i, w, g))
+        agg = self._optimizer.aggregate_num
+        if len(updatable) > 1 and agg > 1 and \
+                self._fused_applicable(updatable):
+            # reference semantics: MXNET_OPTIMIZER_AGGREGATION_SIZE bounds
+            # the number of parameters per fused update batch
+            for k in range(0, len(updatable), agg):
+                group = updatable[k:k + agg]
+                if len(group) > 1:
+                    self._fused_update(group)
+                else:
+                    i, w, g = group[0]
+                    self._states[i] = \
+                        self._optimizer.update_multi_precision(
+                            i, w, g, self._states[i])
+        else:
+            for i, w, g in updatable:
+                self._states[i] = self._optimizer.update_multi_precision(
+                    i, w, g, self._states[i])
+        for _, w, _ in updatable:
             w._fresh_grad = False
+
+    def _fused_applicable(self, updatable) -> bool:
+        """Dense params whose optimizer is fully described by the
+        functional ``_step`` core can fuse into one compiled update.
+        Optimizers that override ``update``/``update_multi_precision``
+        (e.g. SGLD's eager Langevin noise) must take the per-param path,
+        as must fp32-master-weight state."""
+        cls = type(self._optimizer)
+        if cls._step is opt.Optimizer._step or \
+                cls.update is not opt.Optimizer.update or \
+                cls.update_multi_precision is not \
+                opt.Optimizer.update_multi_precision:
+            return False
+        for i, w, g in updatable:
+            if getattr(g, "stype", "default") == "row_sparse":
+                return False
+            if isinstance(self._states[i], opt.MasterWeightState):
+                return False
+        return True
+
+    def _fused_update(self, group) -> None:
+        """One compiled program applying a group of parameter updates —
+        the TPU-native form of the reference's multi-tensor ops
+        (``multi_sgd_mom_update`` etc.): XLA fuses the group's update
+        sweep into one dispatch."""
+        import jax
+        import jax.numpy as jnp
+        o = self._optimizer
+        cls = type(o)
+        lrs, wds, ts = [], [], []
+        for i, w, g in group:
+            o._update_count(i)
+            lrs.append(o._get_lr(i))
+            wds.append(o._get_wd(i))
+            ts.append(o._index_update_count[i])
+        key = (cls, o.clip_gradient is not None,
+               tuple((i, tuple(w.shape), str(w.dtype), o._hyper(i))
+                     for i, w, _ in group))
+        cache = getattr(self, "_fused_cache", None)
+        if cache is None:
+            cache = self._fused_cache = {}
+        fn = cache.get(key)
+        if fn is None:
+            has_clip = o.clip_gradient is not None
+            hps = [o._hyper(i) for i, _, _ in group]
+
+            def raw(ws, gs, sts, lrs_, wds_, ts_, rescale_, clip_):
+                new_ws, new_sts = [], []
+                for k, (w, g, st) in enumerate(zip(ws, gs, sts)):
+                    g = g.astype(jnp.float32) if w.dtype != g.dtype else g
+                    g = g * rescale_
+                    if has_clip:
+                        g = jnp.clip(g, -clip_, clip_)
+                    nw, ns = cls._step(w, g, st, lrs_[k], wds_[k], ts_[k],
+                                       hps[k])
+                    new_ws.append(nw)
+                    new_sts.append(ns)
+                return new_ws, new_sts
+
+            fn = cache[key] = jax.jit(raw, donate_argnums=(0, 2))
+        clip = o.clip_gradient if o.clip_gradient is not None else 0.0
+        new_ws, new_sts = fn(
+            [w._data for _, w, _ in group],
+            [g._data for _, _, g in group],
+            [self._states[i] for i, _, _ in group],
+            jnp.asarray(lrs, jnp.float32), jnp.asarray(wds, jnp.float32),
+            jnp.asarray(ts, jnp.float32), jnp.float32(o.rescale_grad),
+            jnp.float32(clip))
+        from .. import engine
+        for (i, w, _), nw, ns in zip(group, new_ws, new_sts):
+            w._data = nw
+            engine.track(nw)
+            self._states[i] = ns
 
     def zero_grad(self) -> None:
         for p in self._params:

@@ -3,12 +3,12 @@
 Reference parity: ``python/mxnet/optimizer/optimizer.py`` +
 ``src/operator/optimizer_op.cc`` / ``src/operator/contrib/adamw.cc``.
 """
-from .optimizer import (Optimizer, register, create, SGD, NAG, Adam, AdamW,
+from .optimizer import (Optimizer, MasterWeightState, register, create, SGD, NAG, Adam, AdamW,
                         LAMB, LARS, RMSProp, AdaGrad, AdaDelta, Adamax, Ftrl,
                         FTML, Signum, SGLD, DCASGD, LBSGD, Updater,
                         get_updater)
 
-__all__ = ["Optimizer", "register", "create", "SGD", "NAG", "Adam", "AdamW",
+__all__ = ["Optimizer", "MasterWeightState", "register", "create", "SGD", "NAG", "Adam", "AdamW",
            "LAMB", "LARS", "RMSProp", "AdaGrad", "AdaDelta", "Adamax",
            "Ftrl", "FTML", "Signum", "SGLD", "DCASGD", "LBSGD", "Updater",
            "get_updater"]

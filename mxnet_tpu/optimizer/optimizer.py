@@ -18,7 +18,8 @@ params) follows the reference's ``mp_sgd_*`` pattern.
 from __future__ import annotations
 
 import math
-from typing import Any, Callable, Dict, List, Optional, Sequence, Tuple
+from typing import (Any, Callable, Dict, List, NamedTuple, Optional,
+                    Sequence, Tuple)
 
 import jax
 import jax.numpy as jnp
@@ -28,7 +29,17 @@ from ..base import MXNetError, getenv, register_env
 from ..ndarray.ndarray import NDArray
 from .. import engine
 
-__all__ = ["Optimizer", "register", "create"]
+__all__ = ["Optimizer", "MasterWeightState", "register", "create"]
+
+
+class MasterWeightState(NamedTuple):
+    """fp32 master-weight wrapper for low-precision training state
+    (reference: the ``mp_*_update`` multi-precision optimizer ops keep an
+    fp32 copy beside fp16 weights).  A dedicated type — NamedTuples are
+    jax pytrees — so the master-weight layout is recognized by
+    ``isinstance`` rather than guessed from state structure."""
+    master: Any
+    inner: Any
 
 _OPT_REGISTRY: Dict[str, type] = {}
 
@@ -129,7 +140,7 @@ class Optimizer:
         if self.multi_precision and weight.dtype in (_np.float16,) or \
                 (self.multi_precision and "bfloat16" in str(weight.dtype)):
             master = weight._data.astype(jnp.float32)
-            return (master, self.create_state(index, weight))
+            return MasterWeightState(master, self.create_state(index, weight))
         return self.create_state(index, weight)
 
     # -- the pure math; subclasses override --------------------------------
@@ -224,16 +235,16 @@ class Optimizer:
 
     def update_multi_precision(self, index: Any, weight: NDArray,
                                grad: NDArray, state: Any) -> Any:
-        if isinstance(state, tuple) and len(state) == 2 and \
-                isinstance(state[0], jax.Array) and \
-                state[0].dtype == jnp.float32 and \
-                weight.dtype != _np.float32:
-            master, inner = state
-            master_nd = NDArray(master, _wrap=True)
-            new_inner = self.update(index, master_nd, grad, inner)
+        # the master-weight layout is identified by TYPE, not structure:
+        # guessing from (fp32-array, ...) tuples false-positives on
+        # Adam-style (m, v) fp32 state under bf16 weights and silently
+        # corrupts the update
+        if isinstance(state, MasterWeightState):
+            master_nd = NDArray(state.master, _wrap=True)
+            new_inner = self.update(index, master_nd, grad, state.inner)
             weight._data = master_nd._data.astype(weight._data.dtype)
             engine.track(weight._data)
-            return (master_nd._data, new_inner)
+            return MasterWeightState(master_nd._data, new_inner)
         return self.update(index, weight, grad, state)
 
     def __repr__(self) -> str:

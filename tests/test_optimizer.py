@@ -148,3 +148,100 @@ def test_trainer_save_load_states(tmp_path):
     tr2.load_states(f)
     assert tr2._optimizer.num_update == tr._optimizer.num_update
     assert set(tr2._states) == set(tr._states)
+
+
+def test_fused_trainer_update_matches_per_param():
+    """One-dispatch fused multi-tensor update (reference multi_sgd_* /
+    MXNET_OPTIMIZER_AGGREGATION_SIZE) must match per-param updates
+    exactly, including optimizer state evolution."""
+    import numpy as onp
+
+    def build():
+        mx.random.seed(9)
+        net = mx.gluon.nn.HybridSequential()
+        net.add(mx.gluon.nn.Dense(16, in_units=8, activation="relu"),
+                mx.gluon.nn.Dense(4, in_units=16))
+        net.initialize()
+        return net
+
+    for optim, kw in [("adam", {"learning_rate": 1e-2}),
+                      ("sgd", {"learning_rate": 0.1, "momentum": 0.9,
+                               "wd": 1e-3}),
+                      ("adamw", {"learning_rate": 1e-2,
+                                 "clip_gradient": 0.5})]:
+        net_a, net_b = build(), build()
+        tr_a = mx.gluon.Trainer(net_a.collect_params(), optim, dict(kw))
+        tr_b = mx.gluon.Trainer(net_b.collect_params(), optim, dict(kw))
+        tr_a._optimizer.aggregate_num = 4         # fused path (env-proof)
+        tr_b._optimizer.aggregate_num = 1         # force per-param path
+        loss_fn = mx.gluon.loss.L2Loss()
+        rng = onp.random.RandomState(1)
+        for _ in range(3):
+            x = mx.np.array(rng.uniform(-1, 1, (4, 8)).astype("float32"))
+            y = mx.np.array(rng.uniform(-1, 1, (4, 4)).astype("float32"))
+            for net, tr in ((net_a, tr_a), (net_b, tr_b)):
+                with mx.autograd.record():
+                    loss = loss_fn(net(x), y)
+                loss.backward()
+                tr.step(4)
+        for (ka, pa), (kb, pb) in zip(net_a.collect_params().items(),
+                                      net_b.collect_params().items()):
+            assert_almost_equal(pa.data(), pb.data(), rtol=1e-6, atol=1e-7,
+                                names=(f"{optim}:{ka}", kb))
+
+
+def test_bf16_adam_state_not_mistaken_for_master_weights():
+    """Adam's (m, v) fp32 state under bf16 weights must NOT be routed
+    through the master-weight branch (which would overwrite the weights
+    with updated zeros). Regression for the structural-guess bug — the
+    layout is now identified by the MasterWeightState type."""
+    import numpy as onp
+    mx.random.seed(2)
+    net = mx.gluon.nn.Dense(8, in_units=4)
+    net.initialize()
+    net.cast("bfloat16")
+    w0 = onp.asarray(net.weight.data()._data).astype("float32").copy()
+    tr = mx.gluon.Trainer(net.collect_params(), "adam",
+                          {"learning_rate": 1e-5})
+    tr._optimizer.aggregate_num = 1  # exercise the per-param path
+    x = mx.np.array(onp.random.RandomState(0)
+                    .uniform(-1, 1, (4, 4)).astype("float32")) \
+        .astype("bfloat16")
+    with mx.autograd.record():
+        loss = net(x).square().mean()
+    loss.backward()
+    tr.step(4)
+    w1 = onp.asarray(net.weight.data()._data).astype("float32")
+    # with lr=1e-5 one step must barely move the weights; the bug
+    # replaced them with (updated) zero master weights
+    assert onp.abs(w1 - w0).max() < 1e-3, onp.abs(w1 - w0).max()
+    assert not onp.allclose(w1, 0.0)
+
+
+def test_multi_precision_master_weight_state():
+    """multi_precision keeps an fp32 master copy (MasterWeightState) and
+    updates flow through it (reference mp_sgd_mom_update)."""
+    import numpy as onp
+    from mxnet_tpu.optimizer import MasterWeightState
+    mx.random.seed(4)
+    net = mx.gluon.nn.Dense(3, in_units=5)
+    net.initialize()
+    net.cast("bfloat16")
+    tr = mx.gluon.Trainer(net.collect_params(), "sgd",
+                          {"learning_rate": 0.1, "momentum": 0.9,
+                           "multi_precision": True})
+    x = mx.np.array(onp.random.RandomState(1)
+                    .uniform(-1, 1, (4, 5)).astype("float32")) \
+        .astype("bfloat16")
+    for _ in range(2):
+        with mx.autograd.record():
+            loss = net(x).square().mean()
+        loss.backward()
+        tr.step(4)
+    st = tr._states[[i for i, p in enumerate(tr._params)
+                     if p.name.endswith("weight")][0]]
+    assert isinstance(st, MasterWeightState)
+    assert str(st.master.dtype) == "float32"
+    # master tracks the bf16 weight at fp32 precision
+    w = onp.asarray(net.weight.data()._data).astype("float32")
+    assert onp.allclose(w, onp.asarray(st.master), atol=1e-2)
