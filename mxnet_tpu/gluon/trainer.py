@@ -253,10 +253,24 @@ class Trainer:
     def load_states(self, fname: str) -> None:
         import jax.numpy as jnp
         import jax
+        import numpy as _np
         with open(fname, "rb") as f:
             payload = pickle.load(f)
         self._optimizer.num_update = payload["num_update"]
         self._optimizer._index_update_count = payload["index_update_count"]
-        self._states = {
-            i: jax.tree_util.tree_map(jnp.asarray, s)
-            for i, s in payload["states"].items()}
+
+        def restore(i, s):
+            # states saved before MasterWeightState existed stored the
+            # master-weight layout as a plain (master, inner) tuple;
+            # rewrap so the typed dispatch still routes them correctly
+            if self._optimizer.multi_precision and \
+                    type(s) is tuple and len(s) == 2 and \
+                    isinstance(s[0], _np.ndarray) and \
+                    s[0].dtype == _np.float32 and \
+                    i < len(self._params) and \
+                    tuple(s[0].shape) == tuple(self._params[i].shape):
+                s = opt.MasterWeightState(s[0], s[1])
+            return jax.tree_util.tree_map(jnp.asarray, s)
+
+        self._states = {i: restore(i, s)
+                        for i, s in payload["states"].items()}

@@ -26,6 +26,7 @@ import numpy as _np
 from ..context import Context, current_context
 from .ndarray import NDArray, from_jax
 from .register import invoke, register_op
+from builtins import slice as builtins_slice
 
 __all__: list = []  # populated by _public
 
@@ -811,3 +812,215 @@ def interp(x, xp, fp):
 def waitall():
     from .. import engine as _e
     _e.waitall()
+
+
+# ---------------------------------------------------------------------------
+# Legacy 1.x op-name aliases + remaining tensor ops (reference:
+# src/operator/tensor/elemwise_binary_broadcast_op*, matrix_op*,
+# src/operator/bilinear_sampler.cc, grid_generator.cc). The broadcast_*/
+# elemwise_* spellings share one implementation — XLA broadcasts either
+# way; keeping both names preserves the reference's public surface.
+# ---------------------------------------------------------------------------
+
+broadcast_add = _public(globals()["add"], "broadcast_add")
+broadcast_plus = _public(globals()["add"], "broadcast_plus")
+broadcast_sub = _public(globals()["subtract"], "broadcast_sub")
+broadcast_minus = _public(globals()["subtract"], "broadcast_minus")
+broadcast_mul = _public(globals()["multiply"], "broadcast_mul")
+broadcast_div = _public(globals()["divide"], "broadcast_div")
+broadcast_mod = _public(globals()["mod"], "broadcast_mod")
+broadcast_power = _public(globals()["power"], "broadcast_power")
+broadcast_maximum = _public(globals()["maximum"], "broadcast_maximum")
+broadcast_minimum = _public(globals()["minimum"], "broadcast_minimum")
+broadcast_equal = _public(globals()["equal"], "broadcast_equal")
+broadcast_not_equal = _public(globals()["not_equal"], "broadcast_not_equal")
+broadcast_greater = _public(globals()["greater"], "broadcast_greater")
+broadcast_greater_equal = _public(globals()["greater_equal"],
+                                  "broadcast_greater_equal")
+broadcast_lesser = _public(globals()["less"], "broadcast_lesser")
+broadcast_lesser_equal = _public(globals()["less_equal"],
+                                 "broadcast_lesser_equal")
+broadcast_logical_and = _public(globals()["logical_and"],
+                                "broadcast_logical_and")
+broadcast_logical_or = _public(globals()["logical_or"],
+                               "broadcast_logical_or")
+broadcast_logical_xor = _public(globals()["logical_xor"],
+                                "broadcast_logical_xor")
+elemwise_add = _public(globals()["add"], "elemwise_add")
+elemwise_sub = _public(globals()["subtract"], "elemwise_sub")
+elemwise_mul = _public(globals()["multiply"], "elemwise_mul")
+elemwise_div = _public(globals()["divide"], "elemwise_div")
+
+
+@_public
+def broadcast_axis(data, axis=(), size=()):
+    axes = (axis,) if isinstance(axis, int) else tuple(axis)
+    sizes = (size,) if isinstance(size, int) else tuple(size)
+
+    def impl(x):
+        shape = list(x.shape)
+        for ax, s in zip(axes, sizes):
+            shape[ax] = s
+        return jnp.broadcast_to(x, shape)
+
+    return invoke("broadcast_axis", impl, (_as_nd(data),))
+
+
+broadcast_axes = _public(globals()["broadcast_axis"], "broadcast_axes")
+
+
+@_public
+def broadcast_like(lhs, rhs, lhs_axes=None, rhs_axes=None):
+    l, r = _as_nd(lhs), _as_nd(rhs)
+    if lhs_axes is None:
+        return invoke("broadcast_like",
+                      lambda a, b: jnp.broadcast_to(a, b.shape), (l, r))
+    l_axes, r_axes = tuple(lhs_axes), tuple(rhs_axes)
+
+    def impl(a, b):
+        shape = list(a.shape)
+        for la, ra in zip(l_axes, r_axes):
+            shape[la] = b.shape[ra]
+        return jnp.broadcast_to(a, shape)
+
+    return invoke("broadcast_like", impl, (l, r))
+
+
+@_public
+def reshape_like(lhs, rhs):
+    return invoke("reshape_like",
+                  lambda a, b: jnp.reshape(a, b.shape),
+                  (_as_nd(lhs), _as_nd(rhs)))
+
+
+@_public
+def reverse(data, axis):
+    axes = (axis,) if isinstance(axis, int) else tuple(axis)
+    return invoke("reverse", lambda x: jnp.flip(x, axis=axes),
+                  (_as_nd(data),))
+
+
+@_public
+def slice(data, begin, end, step=None):  # noqa: A001
+    b, e = tuple(begin), tuple(end)
+    st = tuple(step) if step is not None else (1,) * len(b)
+    sl = tuple(builtins_slice(bb, ee, ss if ss != 0 else None)
+               for bb, ee, ss in zip(b, e, st))
+    return invoke("slice", lambda x: x[sl], (_as_nd(data),))
+
+
+@_public
+def softmin(data, axis=-1):
+    return invoke("softmin",
+                  lambda x: jax.nn.softmax(-x.astype(jnp.float32), axis=axis)
+                  .astype(x.dtype), (_as_nd(data),))
+
+
+@_public
+def moments(data, axes=None, keepdims=False):
+    ax = tuple(axes) if axes is not None else None
+
+    def impl(x):
+        m = jnp.mean(x, axis=ax, keepdims=keepdims)
+        v = jnp.var(x, axis=ax, keepdims=keepdims)
+        return m, v
+
+    out = invoke("moments", impl, (_as_nd(data),))
+    return out
+
+
+@_public
+def shape_array(data):
+    nd = _as_nd(data)
+    return from_jax(jnp.asarray(nd.shape, dtype=jnp.int32))
+
+
+@_public
+def size_array(data):
+    nd = _as_nd(data)
+    return from_jax(jnp.asarray([nd.size], dtype=jnp.int32))
+
+
+@_public
+def batch_take(a, indices):
+    return invoke("batch_take",
+                  lambda x, idx: jnp.take_along_axis(
+                      x, idx[:, None].astype(jnp.int32), axis=1)[:, 0],
+                  (_as_nd(a), _as_nd(indices)))
+
+
+@_public
+def grid_generator(data, transform_type="affine", target_shape=None):
+    """Sampling-grid generation for spatial transformers (reference:
+    src/operator/grid_generator.cc). 'affine': data is (N, 6) affine
+    params; 'warp': data is (N, 2, H, W) flow offsets. Output grid is
+    (N, 2, H, W) with x/y in [-1, 1]."""
+    th, tw = (target_shape if transform_type == "affine"
+              else _as_nd(data).shape[2:])
+
+    def impl(d):
+        if transform_type == "affine":
+            n = d.shape[0]
+            ys = jnp.linspace(-1.0, 1.0, th)
+            xs = jnp.linspace(-1.0, 1.0, tw)
+            gy, gx = jnp.meshgrid(ys, xs, indexing="ij")
+            ones = jnp.ones_like(gx)
+            base = jnp.stack([gx.ravel(), gy.ravel(), ones.ravel()])  # 3,HW
+            theta = d.reshape(n, 2, 3).astype(jnp.float32)
+            out = jnp.einsum("nij,jk->nik", theta, base)  # n,2,HW
+            return out.reshape(n, 2, th, tw)
+        # warp: offsets are in pixels; normalize to [-1, 1]
+        n, _, h, w = d.shape
+        ys = jnp.arange(h, dtype=jnp.float32)
+        xs = jnp.arange(w, dtype=jnp.float32)
+        gy, gx = jnp.meshgrid(ys, xs, indexing="ij")
+        fx = (gx + d[:, 0].astype(jnp.float32)) * 2.0 / \
+            jnp.maximum(w - 1, 1) - 1.0
+        fy = (gy + d[:, 1].astype(jnp.float32)) * 2.0 / \
+            jnp.maximum(h - 1, 1) - 1.0
+        return jnp.stack([fx, fy], axis=1)
+
+    return invoke("grid_generator", impl, (_as_nd(data),))
+
+
+@_public
+def bilinear_sampler(data, grid, cudnn_off=None):
+    """Bilinear sampling of (N, C, H, W) data at grid locations
+    (reference: src/operator/bilinear_sampler.cc; the spatial-transformer
+    sampler). ``grid`` is (N, 2, Ho, Wo) with x/y in [-1, 1]; out-of-
+    range samples read zero (border handled by clamping the gather and
+    masking the weight)."""
+
+    def impl(x, g):
+        n, c, h, w = x.shape
+        gx = (g[:, 0].astype(jnp.float32) + 1.0) * (w - 1) / 2.0
+        gy = (g[:, 1].astype(jnp.float32) + 1.0) * (h - 1) / 2.0
+        x0 = jnp.floor(gx)
+        y0 = jnp.floor(gy)
+        wx = gx - x0
+        wy = gy - y0
+
+        def gather(yy, xx):
+            yi = jnp.clip(yy, 0, h - 1).astype(jnp.int32)
+            xi = jnp.clip(xx, 0, w - 1).astype(jnp.int32)
+            valid = ((yy >= 0) & (yy <= h - 1) &
+                     (xx >= 0) & (xx <= w - 1)).astype(jnp.float32)
+            vals = jax.vmap(
+                lambda img, yj, xj: img[:, yj, xj])(x, yi, xi)  # n,c,Ho,Wo?
+            return vals, valid
+
+        v00, m00 = gather(y0, x0)
+        v01, m01 = gather(y0, x0 + 1)
+        v10, m10 = gather(y0 + 1, x0)
+        v11, m11 = gather(y0 + 1, x0 + 1)
+        w00 = ((1 - wy) * (1 - wx) * m00)[:, None]
+        w01 = ((1 - wy) * wx * m01)[:, None]
+        w10 = (wy * (1 - wx) * m10)[:, None]
+        w11 = (wy * wx * m11)[:, None]
+        out = (v00.astype(jnp.float32) * w00 +
+               v01.astype(jnp.float32) * w01 +
+               v10.astype(jnp.float32) * w10 +
+               v11.astype(jnp.float32) * w11)
+        return out.astype(x.dtype)
+
+    return invoke("bilinear_sampler", impl, (_as_nd(data), _as_nd(grid)))

@@ -946,3 +946,118 @@ __all__ += ["softmax_output", "linear_regression_output",
 
 for _name in __all__:
     register_op(_name, globals()[_name])
+
+
+def arange_like(data, start: float = 0.0, step: float = 1.0, axis=None):
+    """Range shaped like ``data`` (axis=None: the full shape, ravel
+    order; otherwise a 1-D range matching that axis's length) —
+    reference ``npx.arange_like``."""
+    nd = _as_nd(data)
+    if axis is None:
+        shape = nd.shape
+        n = nd.size
+        return invoke("arange_like",
+                      lambda x: (jnp.arange(n, dtype=jnp.float32) * step
+                                 + start).reshape(shape), (nd,))
+    n = nd.shape[axis]
+    return invoke("arange_like",
+                  lambda x: jnp.arange(n, dtype=jnp.float32) * step + start,
+                  (nd,))
+
+
+def rnn(data, parameters, state, state_cell=None, mode: str = "lstm",
+        state_size: Optional[int] = None, num_layers: int = 1,
+        bidirectional: bool = False, p: float = 0.0,
+        state_outputs: bool = False, use_sequence_length: bool = False,
+        sequence_length=None, training: Optional[bool] = None):
+    """Functional fused RNN over a packed parameter vector — the
+    reference's stateful ``RNN`` op (``src/operator/rnn-inl.h`` /
+    ``npx.rnn``): cuDNN packed layout (all i2h/h2h weights layer-major,
+    direction-minor; then all biases), TNC data, (L*D, N, H) states.
+
+    TPU-first: unpacks the vector and runs the same hoisted-matmul
+    ``lax.scan`` as ``gluon.rnn`` layers — one compiled program under
+    jit, weight layout identical to the reference for checkpoint interop.
+    """
+    from ..gluon.rnn.rnn_layer import _gates, _run_single_direction
+
+    if use_sequence_length or sequence_length is not None:
+        raise NotImplementedError(
+            "npx.rnn use_sequence_length is not implemented; mask with "
+            "npx.sequence_mask / pick final states with npx.sequence_last")
+    train = is_training() if training is None else training
+    x_nd = _as_nd(data)
+    params_nd = _as_nd(parameters)
+    h0_nd = _as_nd(state)
+    inputs = [x_nd, params_nd, h0_nd]
+    if mode == "lstm":
+        if state_cell is None:
+            raise ValueError("lstm mode needs state_cell")
+        inputs.append(_as_nd(state_cell))
+    H = state_size
+    D = 2 if bidirectional else 1
+    G = _gates(mode)
+    I = x_nd.shape[2]  # noqa: E741
+
+    def impl(x, params, h0, *rest):
+        c0 = rest[0] if rest else None
+        # -- unpack the cuDNN-ordered flat parameter vector
+        off = 0
+
+        def take(shape):
+            nonlocal off
+            n = 1
+            for s in shape:
+                n *= s
+            seg = lax.dynamic_slice_in_dim(params, off, n)
+            off += n
+            return seg.reshape(shape)
+
+        wi, wh, bi, bh = [], [], [], []
+        for layer in range(num_layers):
+            in_size = I if layer == 0 else H * D
+            for d in range(D):
+                wi.append(take((G * H, in_size)))
+                wh.append(take((G * H, H)))
+        for layer in range(num_layers):
+            for d in range(D):
+                bi.append(take((G * H,)))
+                bh.append(take((G * H,)))
+
+        outs = x
+        h_finals, c_finals = [], []
+        for layer in range(num_layers):
+            dir_outs = []
+            for d in range(D):
+                k = layer * D + d
+                h_init = h0[k]
+                c_init = c0[k] if c0 is not None else None
+                hs, carry = _run_single_direction(
+                    mode, outs, h_init, c_init, wi[k], wh[k], bi[k], bh[k],
+                    reverse=(d == 1))
+                dir_outs.append(hs)
+                h_finals.append(carry[0])
+                if mode == "lstm":
+                    c_finals.append(carry[1])
+            outs = dir_outs[0] if D == 1 else \
+                jnp.concatenate(dir_outs, axis=-1)
+            if p > 0.0 and train and layer < num_layers - 1:
+                from ..ndarray import random as _random
+                keep = 1.0 - p
+                mask = jax.random.bernoulli(
+                    _random.split_key(), keep, outs.shape)
+                outs = jnp.where(mask, outs / keep, 0.0).astype(outs.dtype)
+        res = [outs, jnp.stack(h_finals)]
+        if mode == "lstm":
+            res.append(jnp.stack(c_finals))
+        return tuple(res)
+
+    out = invoke("rnn", impl, inputs)
+    if not state_outputs:
+        return out[0]
+    return out
+
+
+__all__ += ["arange_like", "rnn"]
+for _name in ("arange_like", "rnn"):
+    register_op(_name, globals()[_name])

@@ -225,3 +225,60 @@ def test_norm_ord_high_rank():
     x = mx.np.ones((2, 3, 4))
     assert abs(x.norm(ord=1).item() - 24.0) < 1e-5
     assert abs(x.norm().item() - onp.sqrt(24.0)) < 1e-5
+
+
+def test_legacy_broadcast_elemwise_aliases():
+    """1.x op-name surface: broadcast_*/elemwise_* spellings (reference
+    src/operator/tensor/elemwise_binary_broadcast_op*)."""
+    a = mx.np.array(onp.arange(6.0).reshape(2, 3).astype("float32"))
+    b = mx.np.array(onp.ones((1, 3), dtype="float32"))
+    assert onp.allclose(mx.nd.broadcast_add(a, b).asnumpy(),
+                        a.asnumpy() + 1)
+    assert onp.allclose(mx.nd.broadcast_mul(a, a).asnumpy(),
+                        a.asnumpy() ** 2)
+    assert onp.allclose(mx.nd.elemwise_sub(a, a).asnumpy(), 0)
+    assert mx.nd.broadcast_axis(mx.np.ones((1, 3)), axis=0,
+                                size=4).shape == (4, 3)
+    assert mx.nd.broadcast_like(mx.np.ones((1, 3)),
+                                mx.np.ones((5, 3))).shape == (5, 3)
+    assert mx.nd.reshape_like(a, mx.np.ones((3, 2))).shape == (3, 2)
+    assert onp.allclose(mx.nd.reverse(a, axis=1).asnumpy(),
+                        a.asnumpy()[:, ::-1])
+    assert onp.allclose(mx.nd.slice(a, (0, 1), (2, 3)).asnumpy(),
+                        a.asnumpy()[0:2, 1:3])
+    sm = mx.nd.softmin(a, axis=1).asnumpy()
+    assert onp.allclose(sm.sum(axis=1), 1, atol=1e-5)
+    m, v = mx.nd.moments(a, axes=(0,))
+    assert onp.allclose(m.asnumpy(), a.asnumpy().mean(0))
+    assert onp.allclose(v.asnumpy(), a.asnumpy().var(0))
+    assert mx.nd.shape_array(a).asnumpy().tolist() == [2, 3]
+    assert mx.nd.size_array(a).asnumpy().tolist() == [6]
+    assert mx.nd.batch_take(a, mx.np.array(onp.array([2, 0]))) \
+        .asnumpy().tolist() == [2.0, 3.0]
+
+
+def test_spatial_transformer_sampling():
+    """grid_generator + bilinear_sampler (reference
+    src/operator/{grid_generator,bilinear_sampler}.cc): identity affine
+    and zero warp reproduce the input; gradients flow to the data."""
+    img = mx.np.array(onp.random.rand(2, 3, 5, 7).astype("float32"))
+    theta = mx.np.array(onp.tile(
+        onp.array([1, 0, 0, 0, 1, 0], dtype="float32"), (2, 1)))
+    grid = mx.nd.grid_generator(theta, "affine", target_shape=(5, 7))
+    out = mx.nd.bilinear_sampler(img, grid)
+    assert onp.allclose(out.asnumpy(), img.asnumpy(), atol=1e-4)
+    flow = mx.np.array(onp.zeros((2, 2, 5, 7), dtype="float32"))
+    out2 = mx.nd.bilinear_sampler(img, mx.nd.grid_generator(flow, "warp"))
+    assert onp.allclose(out2.asnumpy(), img.asnumpy(), atol=1e-4)
+    # translation by a full grid-width pushes samples out of range -> 0
+    theta_t = mx.np.array(onp.tile(
+        onp.array([1, 0, 2.5, 0, 1, 0], dtype="float32"), (2, 1)))
+    out3 = mx.nd.bilinear_sampler(
+        img, mx.nd.grid_generator(theta_t, "affine", target_shape=(5, 7)))
+    assert (onp.asarray(out3.asnumpy())[:, :, :, -1] == 0).all()
+    img.attach_grad()
+    with mx.autograd.record():
+        s = mx.nd.bilinear_sampler(img, grid).sum()
+    s.backward()
+    g = img.grad.asnumpy()
+    assert onp.isfinite(g).all() and abs(g).sum() > 0

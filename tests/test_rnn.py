@@ -307,3 +307,58 @@ def test_flash_attention_backward_matches_dense():
         gd = jax.grad(loss_dense, argnums=(0, 1, 2))(q, k, v)
         for a, b in zip(gf, gd):
             assert float(jnp.abs(a - b).max()) < 2e-4
+
+
+def test_npx_rnn_packed_matches_gluon_lstm():
+    """npx.rnn over a cuDNN-packed parameter vector must match the gluon
+    LSTM layer bit-for-bit (reference: the stateful RNN op,
+    src/operator/rnn-inl.h — same packed layout for interop)."""
+    T, N, I, H, L = 5, 3, 4, 6, 2
+    mx.random.seed(0)
+    lstm = mx.gluon.rnn.LSTM(H, num_layers=L, layout="TNC", input_size=I)
+    lstm.initialize()
+    x = mx.np.array(onp.random.RandomState(0)
+                    .uniform(-1, 1, (T, N, I)).astype("float32"))
+    h0 = mx.np.zeros((L, N, H))
+    c0 = mx.np.zeros((L, N, H))
+    out_ref, states_ref = lstm(x, [h0, c0])
+    params = lstm.collect_params()
+    parts = []
+    for layer in range(L):
+        parts += [params[f"l{layer}_i2h_weight"].data().asnumpy().ravel(),
+                  params[f"l{layer}_h2h_weight"].data().asnumpy().ravel()]
+    for layer in range(L):
+        parts += [params[f"l{layer}_i2h_bias"].data().asnumpy().ravel(),
+                  params[f"l{layer}_h2h_bias"].data().asnumpy().ravel()]
+    packed = mx.np.array(onp.concatenate(parts))
+    out, hT, cT = mx.npx.rnn(x, packed, h0, state_cell=c0, mode="lstm",
+                             state_size=H, num_layers=L,
+                             state_outputs=True)
+    assert_almost_equal(out, out_ref, rtol=1e-5, atol=1e-6)
+    assert_almost_equal(hT, states_ref[0], rtol=1e-5, atol=1e-6)
+    assert_almost_equal(cT, states_ref[1], rtol=1e-5, atol=1e-6)
+
+
+def test_npx_rnn_gru_bidirectional():
+    """Bidirectional GRU through npx.rnn agrees with the gluon layer."""
+    T, N, I, H = 4, 2, 3, 5
+    mx.random.seed(1)
+    gru = mx.gluon.rnn.GRU(H, num_layers=1, layout="TNC", input_size=I,
+                           bidirectional=True)
+    gru.initialize()
+    x = mx.np.array(onp.random.RandomState(1)
+                    .uniform(-1, 1, (T, N, I)).astype("float32"))
+    h0 = mx.np.zeros((2, N, H))
+    out_ref, _ = gru(x, [h0])
+    params = gru.collect_params()
+    parts = []
+    for sfx in ("l0", "l0_r"):
+        parts += [params[f"{sfx}_i2h_weight"].data().asnumpy().ravel(),
+                  params[f"{sfx}_h2h_weight"].data().asnumpy().ravel()]
+    for sfx in ("l0", "l0_r"):
+        parts += [params[f"{sfx}_i2h_bias"].data().asnumpy().ravel(),
+                  params[f"{sfx}_h2h_bias"].data().asnumpy().ravel()]
+    packed = mx.np.array(onp.concatenate(parts))
+    out = mx.npx.rnn(x, packed, h0, mode="gru", state_size=H,
+                     num_layers=1, bidirectional=True)
+    assert_almost_equal(out, out_ref, rtol=1e-5, atol=1e-6)
