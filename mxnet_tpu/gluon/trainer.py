@@ -241,6 +241,7 @@ class Trainer:
         import numpy as _np
         import jax
         payload = {
+            "format": 2,  # >=2: MasterWeightState pickles as its type
             "num_update": self._optimizer.num_update,
             "index_update_count": self._optimizer._index_update_count,
             "states": {
@@ -259,15 +260,22 @@ class Trainer:
         self._optimizer.num_update = payload["num_update"]
         self._optimizer._index_update_count = payload["index_update_count"]
 
+        legacy = payload.get("format", 1) < 2
+
         def restore(i, s):
-            # states saved before MasterWeightState existed stored the
-            # master-weight layout as a plain (master, inner) tuple;
-            # rewrap so the typed dispatch still routes them correctly
-            if self._optimizer.multi_precision and \
+            # format<2 states stored the master-weight layout as a plain
+            # (master, inner_state_tuple) tuple; rewrap so the typed
+            # dispatch still routes them. The inner-is-a-tuple condition
+            # distinguishes it from Adam-style (m, v) plain state (whose
+            # second element is an array), and masters only ever exist
+            # for non-fp32 weights.
+            if legacy and self._optimizer.multi_precision and \
                     type(s) is tuple and len(s) == 2 and \
                     isinstance(s[0], _np.ndarray) and \
                     s[0].dtype == _np.float32 and \
+                    isinstance(s[1], tuple) and \
                     i < len(self._params) and \
+                    self._params[i].dtype != _np.float32 and \
                     tuple(s[0].shape) == tuple(self._params[i].shape):
                 s = opt.MasterWeightState(s[0], s[1])
             return jax.tree_util.tree_map(jnp.asarray, s)

@@ -856,6 +856,9 @@ elemwise_div = _public(globals()["divide"], "elemwise_div")
 def broadcast_axis(data, axis=(), size=()):
     axes = (axis,) if isinstance(axis, int) else tuple(axis)
     sizes = (size,) if isinstance(size, int) else tuple(size)
+    if len(axes) != len(sizes):
+        raise ValueError(f"broadcast_axis: axis {axes} and size {sizes} "
+                         "must have the same length")
 
     def impl(x):
         shape = list(x.shape)
@@ -875,6 +878,9 @@ def broadcast_like(lhs, rhs, lhs_axes=None, rhs_axes=None):
     if lhs_axes is None:
         return invoke("broadcast_like",
                       lambda a, b: jnp.broadcast_to(a, b.shape), (l, r))
+    if rhs_axes is None or len(tuple(lhs_axes)) != len(tuple(rhs_axes)):
+        raise ValueError("broadcast_like: lhs_axes and rhs_axes must be "
+                         "given together with equal length")
     l_axes, r_axes = tuple(lhs_axes), tuple(rhs_axes)
 
     def impl(a, b):
@@ -904,7 +910,12 @@ def reverse(data, axis):
 def slice(data, begin, end, step=None):  # noqa: A001
     b, e = tuple(begin), tuple(end)
     st = tuple(step) if step is not None else (1,) * len(b)
-    sl = tuple(builtins_slice(bb, ee, ss if ss != 0 else None)
+    if len(b) != len(e) or len(st) != len(b):
+        raise ValueError(f"slice: begin {b}, end {e}, step {st} must have "
+                         "equal lengths")
+    if 0 in st:  # NB: module-level `any` is the reduction op, not builtin
+        raise ValueError("slice: step cannot be 0")
+    sl = tuple(builtins_slice(bb, ee, ss)
                for bb, ee, ss in zip(b, e, st))
     return invoke("slice", lambda x: x[sl], (_as_nd(data),))
 

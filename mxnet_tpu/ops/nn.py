@@ -999,6 +999,20 @@ def rnn(data, parameters, state, state_cell=None, mode: str = "lstm",
     G = _gates(mode)
     I = x_nd.shape[2]  # noqa: E741
 
+    # validate the packed vector length up front: a mis-sized vector
+    # must error, not silently read duplicated/truncated tail data
+    expected = 0
+    for layer in range(num_layers):
+        in_sz = I if layer == 0 else H * D
+        expected += D * (G * H * in_sz + G * H * H)  # i2h + h2h weights
+    expected += num_layers * D * 2 * G * H           # i2h + h2h biases
+    if params_nd.size != expected:
+        raise ValueError(
+            f"rnn: packed parameter vector has {params_nd.size} elements, "
+            f"expected {expected} for mode={mode!r} state_size={H} "
+            f"num_layers={num_layers} bidirectional={bidirectional} "
+            f"input size {I}")
+
     def impl(x, params, h0, *rest):
         c0 = rest[0] if rest else None
         # -- unpack the cuDNN-ordered flat parameter vector
@@ -1009,7 +1023,7 @@ def rnn(data, parameters, state, state_cell=None, mode: str = "lstm",
             n = 1
             for s in shape:
                 n *= s
-            seg = lax.dynamic_slice_in_dim(params, off, n)
+            seg = params[off:off + n]
             off += n
             return seg.reshape(shape)
 

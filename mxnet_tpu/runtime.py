@@ -11,7 +11,9 @@ from __future__ import annotations
 from collections import OrderedDict
 from typing import Dict, Iterator
 
-__all__ = ["Feature", "Features", "feature_list"]
+__all__ = ["Feature", "Features", "feature_list", "list_env"]
+
+from .base import list_env  # noqa: E402  (env-var config surface)
 
 
 class Feature:
