@@ -8,5 +8,7 @@ from .transformer import *  # noqa: F401,F403
 from .transformer import __all__ as _tr_all
 from .quantization import *  # noqa: F401,F403
 from .quantization import __all__ as _q_all
+from .boxes import *  # noqa: F401,F403
+from .boxes import __all__ as _box_all
 
-__all__ = list(_nn_all) + list(_tr_all) + list(_q_all)
+__all__ = list(_nn_all) + list(_tr_all) + list(_q_all) + list(_box_all)

@@ -1,0 +1,358 @@
+"""Bounding-box contrib ops: IoU, NMS, matching, multibox anchors.
+
+Reference parity (leezu/mxnet): ``src/operator/contrib/bounding_box.cc``
+(`_contrib_box_iou`, `_contrib_box_nms`, `_contrib_bipartite_matching`)
+and ``src/operator/contrib/multibox_prior.cc`` — the SSD/YOLO-era
+detection tool set behind gluon-cv.
+
+Design (tpu-first): everything is static-shape. NMS keeps the (B, N, K)
+layout and marks suppressed rows with -1 (reference semantics) instead
+of compacting; suppression is the O(N^2)-mask sequential sweep expressed
+as a ``lax.fori_loop`` over the score-sorted IoU matrix, which XLA maps
+onto vector ops per step — no data-dependent shapes anywhere, so the
+whole thing jits and vmaps over the batch.
+"""
+from __future__ import annotations
+
+from typing import Any
+
+import jax
+import jax.numpy as jnp
+from jax import lax
+
+from ..ndarray.ndarray import NDArray  # noqa: F401  (public type in sigs)
+from ..ndarray.ops import _as_nd
+from ..ndarray.register import invoke, register_op
+
+__all__ = ["box_iou", "box_nms", "bipartite_matching", "multibox_prior"]
+
+
+def _to_corner(b, fmt):
+    """(..., 4) boxes to corner (x1, y1, x2, y2)."""
+    if fmt == "corner":
+        return b
+    cx, cy, w, h = jnp.split(b, 4, axis=-1)
+    return jnp.concatenate(
+        [cx - w / 2, cy - h / 2, cx + w / 2, cy + h / 2], axis=-1)
+
+
+def _pairwise_iou(a, b):
+    """a: (..., M, 4), b: (..., N, 4) corner boxes -> (..., M, N)."""
+    ax1, ay1, ax2, ay2 = jnp.split(a[..., :, None, :], 4, axis=-1)
+    bx1, by1, bx2, by2 = jnp.split(b[..., None, :, :], 4, axis=-1)
+    iw = jnp.maximum(jnp.minimum(ax2, bx2) - jnp.maximum(ax1, bx1), 0.0)
+    ih = jnp.maximum(jnp.minimum(ay2, by2) - jnp.maximum(ay1, by1), 0.0)
+    inter = (iw * ih)[..., 0]
+    area_a = ((ax2 - ax1) * (ay2 - ay1))[..., 0]
+    area_b = ((bx2 - bx1) * (by2 - by1))[..., 0]
+    union = area_a + area_b - inter
+    return jnp.where(union > 0, inter / union, 0.0)
+
+
+def box_iou(lhs, rhs, format: str = "corner"):  # noqa: A002
+    """Pairwise IoU between (..., M, 4) and (..., N, 4) boxes
+    (reference ``_contrib_box_iou``)."""
+    fmt = format
+
+    def impl(a, b):
+        return _pairwise_iou(
+            _to_corner(a.astype(jnp.float32), fmt),
+            _to_corner(b.astype(jnp.float32), fmt))
+
+    return invoke("box_iou", impl, (_as_nd(lhs), _as_nd(rhs)))
+
+
+def box_nms(data, overlap_thresh: float = 0.5, valid_thresh: float = 0.0,
+            topk: int = -1, coord_start: int = 2, score_index: int = 1,
+            id_index: int = -1, background_id: int = -1,
+            force_suppress: bool = False, in_format: str = "corner",
+            out_format: str = "corner"):
+    """Non-maximum suppression (reference ``_contrib_box_nms``).
+
+    ``data``: (B, N, K) or (N, K) with per-box [..., score, ..., 4 coords,
+    ...] at ``score_index``/``coord_start`` (and optional class at
+    ``id_index``). Returns the same shape, score-sorted, with suppressed
+    or invalid boxes as all -1 rows — the reference's static-shape
+    contract, which is also exactly what a TPU wants.
+    """
+    nd = _as_nd(data)
+    squeeze = nd.ndim == 2
+
+    def impl(x):
+        d = x[None] if squeeze else x
+        d = d.astype(jnp.float32)
+        B, N, K = d.shape
+        scores = d[:, :, score_index]
+        boxes = _to_corner(
+            d[:, :, coord_start:coord_start + 4], in_format)
+        cls = d[:, :, id_index] if id_index >= 0 else \
+            jnp.zeros((B, N), jnp.float32)
+        valid = scores > valid_thresh
+        if id_index >= 0 and background_id >= 0:
+            valid &= cls != background_id
+
+        order = jnp.argsort(-jnp.where(valid, scores, -jnp.inf), axis=1)
+        if topk > 0:
+            rank = jnp.arange(N)
+            valid_sorted = jnp.take_along_axis(valid, order, 1) & \
+                (rank[None, :] < topk)
+        else:
+            valid_sorted = jnp.take_along_axis(valid, order, 1)
+        boxes_s = jnp.take_along_axis(boxes, order[..., None], 1)
+        cls_s = jnp.take_along_axis(cls, order, 1)
+        iou = _pairwise_iou(boxes_s, boxes_s)                    # B,N,N
+        same_cls = (cls_s[:, :, None] == cls_s[:, None, :]) | \
+            force_suppress
+        later = jnp.arange(N)[None, :] > jnp.arange(N)[:, None]  # i<j
+        sup_mask = (iou > overlap_thresh) & same_cls & later[None]
+
+        def body(i, suppressed):
+            row = sup_mask[:, i, :]                              # B,N
+            alive = (~suppressed[:, i]) & valid_sorted[:, i]
+            return suppressed | (row & alive[:, None])
+
+        suppressed = lax.fori_loop(
+            0, N, body, jnp.zeros((B, N), bool))
+        keep = valid_sorted & ~suppressed
+        out = jnp.take_along_axis(d, order[..., None], 1)
+        out = jnp.where(keep[..., None], out, -1.0)
+        if out_format != in_format:
+            coords = out[:, :, coord_start:coord_start + 4]
+            if out_format == "center":
+                x1, y1, x2, y2 = jnp.split(coords, 4, axis=-1)
+                conv = jnp.concatenate(
+                    [(x1 + x2) / 2, (y1 + y2) / 2, x2 - x1, y2 - y1], -1)
+            else:
+                conv = _to_corner(coords, in_format)
+            conv = jnp.where(keep[..., None], conv, -1.0)
+            out = jnp.concatenate(
+                [out[:, :, :coord_start], conv,
+                 out[:, :, coord_start + 4:]], axis=-1)
+        return out[0] if squeeze else out
+
+    return invoke("box_nms", impl, (nd,))
+
+
+def bipartite_matching(data, threshold: float = 0.5, topk: int = -1,
+                       is_ascend: bool = False):
+    """Greedy bipartite matching over a (B, M, N) score matrix
+    (reference ``_contrib_bipartite_matching``): repeatedly take the
+    globally best remaining pair. Returns (row_match (B, M),
+    col_match (B, N)) with -1 for unmatched."""
+    nd = _as_nd(data)
+    squeeze = nd.ndim == 2
+
+    def impl(x):
+        d = x[None] if squeeze else x
+        d = d.astype(jnp.float32)
+        B, M, N = d.shape
+        sign = 1.0 if is_ascend else -1.0
+        steps = min(M, N) if topk <= 0 else min(topk, min(M, N))
+
+        def body(_, carry):
+            rows, cols, mat = carry
+            flat = (sign * mat).reshape(B, M * N)
+            idx = jnp.argmin(flat, axis=1)
+            ri, ci = idx // N, idx % N
+            val = jnp.take_along_axis(
+                mat.reshape(B, M * N), idx[:, None], 1)[:, 0]
+            ok = (val >= threshold) if not is_ascend else \
+                (val <= threshold)
+            rows = rows.at[jnp.arange(B), ri].set(
+                jnp.where(ok, ci, rows[jnp.arange(B), ri]))
+            cols = cols.at[jnp.arange(B), ci].set(
+                jnp.where(ok, ri, cols[jnp.arange(B), ci]))
+            # retire the chosen row+col so they can't match again
+            worst = -jnp.inf if not is_ascend else jnp.inf
+            chosen = ok[:, None, None] & \
+                ((jnp.arange(M)[None, :, None] == ri[:, None, None]) |
+                 (jnp.arange(N)[None, None, :] == ci[:, None, None]))
+            mat = jnp.where(chosen, worst, mat)
+            return rows, cols, mat
+
+        rows0 = jnp.full((B, M), -1.0)
+        cols0 = jnp.full((B, N), -1.0)
+        rows, cols, _ = lax.fori_loop(0, steps, body, (rows0, cols0, d))
+        if squeeze:
+            return rows[0], cols[0]
+        return rows, cols
+
+    return invoke("bipartite_matching", impl, (nd,))
+
+
+def multibox_prior(data, sizes=(1.0,), ratios=(1.0,), clip: bool = False,
+                   steps=(-1.0, -1.0), offsets=(0.5, 0.5)):
+    """SSD anchor generation (reference ``_contrib_MultiBoxPrior``):
+    for an (B, C, H, W) feature map, emit (1, H*W*A, 4) corner anchors,
+    A = len(sizes) + len(ratios) - 1."""
+    nd = _as_nd(data)
+    szs = tuple(float(s) for s in sizes)
+    rts = tuple(float(r) for r in ratios)
+
+    def impl(x):
+        h, w = x.shape[2], x.shape[3]
+        sy = 1.0 / h if steps[0] <= 0 else steps[0]
+        sx = 1.0 / w if steps[1] <= 0 else steps[1]
+        cy = (jnp.arange(h, dtype=jnp.float32) + offsets[0]) * sy
+        cx = (jnp.arange(w, dtype=jnp.float32) + offsets[1]) * sx
+        gy, gx = jnp.meshgrid(cy, cx, indexing="ij")
+        # anchor set: (size_i, ratio_0) for all sizes, then
+        # (size_0, ratio_j) for ratios[1:]
+        whs = [(szs[i] * jnp.sqrt(rts[0]), szs[i] / jnp.sqrt(rts[0]))
+               for i in range(len(szs))]
+        whs += [(szs[0] * jnp.sqrt(r), szs[0] / jnp.sqrt(r))
+                for r in rts[1:]]
+        anchors = []
+        for aw, ah in whs:
+            anchors.append(jnp.stack(
+                [gx - aw / 2, gy - ah / 2, gx + aw / 2, gy + ah / 2],
+                axis=-1))
+        out = jnp.stack(anchors, axis=2).reshape(1, -1, 4)
+        if clip:
+            out = jnp.clip(out, 0.0, 1.0)
+        return out
+
+    return invoke("multibox_prior", impl, (nd,))
+
+
+for _name in __all__:
+    register_op(_name, globals()[_name])
+
+
+def multibox_target(anchor, label, cls_pred,
+                    overlap_threshold: float = 0.5,
+                    ignore_label: float = -1.0,
+                    negative_mining_ratio: float = -1.0,
+                    negative_mining_thresh: float = 0.5,
+                    minimum_negative_samples: int = 0,
+                    variances=(0.1, 0.1, 0.2, 0.2)):
+    """SSD training-target assignment (reference
+    ``_contrib_MultiBoxTarget``, src/operator/contrib/multibox_target.cc).
+
+    anchor (1, N, 4) corner; label (B, M, 5) rows [cls, x1, y1, x2, y2]
+    with cls = -1 padding; cls_pred (B, C, N) (used for hard-negative
+    mining). Returns (loc_target (B, N*4), loc_mask (B, N*4),
+    cls_target (B, N)) — cls_target is gt_class+1 for matched anchors,
+    0 for kept negatives, ``ignore_label`` for mined-away negatives.
+    """
+    v0, v1, v2, v3 = [float(v) for v in variances]
+
+    def impl(anc, lab, pred):
+        a = anc[0].astype(jnp.float32)                    # N,4 corner
+        N = a.shape[0]
+        B, M, _ = lab.shape
+        acx = (a[:, 0] + a[:, 2]) / 2
+        acy = (a[:, 1] + a[:, 3]) / 2
+        aw = jnp.maximum(a[:, 2] - a[:, 0], 1e-12)
+        ah = jnp.maximum(a[:, 3] - a[:, 1], 1e-12)
+
+        gt_valid = lab[:, :, 0] >= 0                      # B,M
+        iou = _pairwise_iou(a[None],
+                            lab[:, :, 1:5].astype(jnp.float32))  # B,N,M
+        iou = jnp.where(gt_valid[:, None, :], iou, -1.0)
+
+        # each anchor's best gt + force-match the best anchor per gt.
+        # scatter-max (not set): padding gts (argmax over an all -1 IoU
+        # column lands on anchor 0) must not clobber a valid gt's forced
+        # match, and two valid gts sharing a best anchor keep one
+        # deterministic winner (highest gt index) instead of dropping one
+        best_gt = jnp.argmax(iou, axis=2)                 # B,N
+        best_iou = jnp.max(iou, axis=2)
+        best_anchor = jnp.argmax(iou, axis=1)             # B,M
+        rows = jnp.arange(B)[:, None]
+        forced = jnp.zeros((B, N), bool).at[
+            rows, best_anchor].max(gt_valid)
+        cand = jnp.where(gt_valid,
+                         jnp.arange(M, dtype=jnp.int32)[None, :], -1)
+        forced_gt = jnp.full((B, N), -1, jnp.int32).at[
+            rows, best_anchor].max(cand)
+        matched = forced | (best_iou >= overlap_threshold)
+        gt_idx = jnp.where(forced, forced_gt, best_gt)    # B,N
+
+        g = jnp.take_along_axis(lab, gt_idx[..., None], 1)  # B,N,5
+        gcx = (g[..., 1] + g[..., 3]) / 2
+        gcy = (g[..., 2] + g[..., 4]) / 2
+        gw = jnp.maximum(g[..., 3] - g[..., 1], 1e-12)
+        gh = jnp.maximum(g[..., 4] - g[..., 2], 1e-12)
+        dx = (gcx - acx) / aw / v0
+        dy = (gcy - acy) / ah / v1
+        dw = jnp.log(gw / aw) / v2
+        dh = jnp.log(gh / ah) / v3
+        loc_t = jnp.stack([dx, dy, dw, dh], -1)           # B,N,4
+        loc_t = jnp.where(matched[..., None], loc_t, 0.0)
+        loc_m = jnp.where(matched[..., None],
+                          jnp.ones_like(loc_t), 0.0)
+
+        cls_t = jnp.where(matched, g[..., 0] + 1.0, 0.0)
+        if negative_mining_ratio > 0:
+            # hard negatives: unmatched anchors whose best-class
+            # confidence is highest; keep ratio*num_pos, rest -> ignore
+            max_conf = jnp.max(pred, axis=1)              # B,N over C
+            neg = ~matched
+            num_pos = jnp.sum(matched, axis=1)
+            quota = jnp.maximum(
+                (num_pos * negative_mining_ratio).astype(jnp.int32),
+                minimum_negative_samples)
+            conf = jnp.where(neg & (best_iou < negative_mining_thresh),
+                             max_conf, -jnp.inf)
+            rank = jnp.argsort(jnp.argsort(-conf, axis=1), axis=1)
+            keep_neg = neg & (rank < quota[:, None])
+            cls_t = jnp.where(matched, cls_t,
+                              jnp.where(keep_neg, 0.0, ignore_label))
+        return (loc_t.reshape(B, N * 4), loc_m.reshape(B, N * 4), cls_t)
+
+    return invoke("multibox_target", impl,
+                  (_as_nd(anchor), _as_nd(label), _as_nd(cls_pred)))
+
+
+def multibox_detection(cls_prob, loc_pred, anchor, clip: bool = True,
+                       threshold: float = 0.01, background_id: int = 0,
+                       nms_threshold: float = 0.5,
+                       force_suppress: bool = False,
+                       variances=(0.1, 0.1, 0.2, 0.2),
+                       nms_topk: int = -1):
+    """SSD inference decode + NMS (reference
+    ``_contrib_MultiBoxDetection``): cls_prob (B, C, N) softmax scores,
+    loc_pred (B, N*4) encoded offsets, anchor (1, N, 4). Returns
+    (B, N, 6) rows [class_id, score, x1, y1, x2, y2], suppressed/
+    background rows marked -1 (class ids exclude background, 0-based)."""
+    v0, v1, v2, v3 = [float(v) for v in variances]
+
+    def impl(prob, loc, anc):
+        B, C, N = prob.shape
+        a = anc[0].astype(jnp.float32)
+        acx = (a[:, 0] + a[:, 2]) / 2
+        acy = (a[:, 1] + a[:, 3]) / 2
+        aw = a[:, 2] - a[:, 0]
+        ah = a[:, 3] - a[:, 1]
+        p = loc.reshape(B, N, 4).astype(jnp.float32)
+        cx = p[..., 0] * v0 * aw + acx
+        cy = p[..., 1] * v1 * ah + acy
+        w = jnp.exp(p[..., 2] * v2) * aw
+        h = jnp.exp(p[..., 3] * v3) * ah
+        boxes = jnp.stack([cx - w / 2, cy - h / 2,
+                           cx + w / 2, cy + h / 2], -1)
+        if clip:
+            boxes = jnp.clip(boxes, 0.0, 1.0)
+        # best foreground class per anchor
+        fg = jnp.concatenate(
+            [prob[:, :background_id], prob[:, background_id + 1:]],
+            axis=1) if 0 <= background_id < C else prob
+        cid = jnp.argmax(fg, axis=1).astype(jnp.float32)  # B,N
+        score = jnp.max(fg, axis=1)
+        valid = score > threshold
+        rows = jnp.concatenate(
+            [jnp.where(valid, cid, -1.0)[..., None],
+             jnp.where(valid, score, -1.0)[..., None], boxes], -1)
+        return rows
+
+    decoded = invoke("multibox_detection", impl,
+                     (_as_nd(cls_prob), _as_nd(loc_pred), _as_nd(anchor)))
+    return box_nms(decoded, overlap_thresh=nms_threshold, valid_thresh=0.0,
+                   topk=nms_topk, coord_start=2, score_index=1, id_index=0,
+                   force_suppress=force_suppress)
+
+
+__all__ += ["multibox_target", "multibox_detection"]
+for _name in ("multibox_target", "multibox_detection"):
+    register_op(_name, globals()[_name])

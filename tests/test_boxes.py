@@ -1,0 +1,175 @@
+"""Bounding-box contrib ops (reference:
+src/operator/contrib/bounding_box.cc, multibox_prior.cc)."""
+import numpy as onp
+import pytest
+
+import mxnet_tpu as mx
+import mxnet_tpu.ops  # noqa: F401  (registers box ops)
+
+
+def test_box_iou_corner_and_center():
+    a = mx.np.array(onp.array([[0, 0, 2, 2], [1, 1, 3, 3]], dtype="float32"))
+    b = mx.np.array(onp.array([[0, 0, 2, 2], [10, 10, 12, 12]],
+                              dtype="float32"))
+    iou = mx.nd.contrib.box_iou(a, b).asnumpy()
+    assert abs(iou[0, 0] - 1.0) < 1e-6
+    assert iou[0, 1] == 0
+    assert abs(iou[1, 0] - 1 / 7) < 1e-5
+    # center format: (cx=1, cy=1, w=2, h=2) == corner (0, 0, 2, 2)
+    ac = mx.np.array(onp.array([[1, 1, 2, 2]], dtype="float32"))
+    bc = mx.np.array(onp.array([[0, 0, 2, 2]], dtype="float32"))  # corner
+    iou_c = mx.nd.contrib.box_iou(ac, ac, format="center").asnumpy()
+    assert abs(iou_c[0, 0] - 1.0) < 1e-6
+    cross = mx.nd.contrib.box_iou(
+        a[:1], bc[:1], format="corner").asnumpy()
+    assert abs(cross[0, 0] - 1.0) < 1e-6
+
+
+def test_box_nms_class_aware_and_force():
+    data = onp.array([[
+        [0, 0.9, 0, 0, 2, 2],
+        [0, 0.8, 0.1, 0.1, 2.1, 2.1],
+        [1, 0.7, 5, 5, 7, 7],
+    ]], dtype="float32")
+    out = mx.nd.contrib.box_nms(
+        mx.np.array(data), overlap_thresh=0.5, coord_start=2,
+        score_index=1, id_index=0).asnumpy()
+    assert out[0, 0, 1] == onp.float32(0.9)
+    assert (out[0, 1] == -1).all()   # overlapping same-class suppressed
+    assert out[0, 2, 1] == onp.float32(0.7)
+    same_box = onp.array([[[0, 0.9, 0, 0, 2, 2],
+                           [1, 0.8, 0, 0, 2, 2]]], dtype="float32")
+    keep = mx.nd.contrib.box_nms(
+        mx.np.array(same_box), overlap_thresh=0.5, coord_start=2,
+        score_index=1, id_index=0).asnumpy()
+    assert (keep[0] != -1).all()     # different class -> both kept
+    forced = mx.nd.contrib.box_nms(
+        mx.np.array(same_box), overlap_thresh=0.5, coord_start=2,
+        score_index=1, id_index=0, force_suppress=True).asnumpy()
+    assert (forced[0, 1] == -1).all()
+
+
+def test_box_nms_valid_thresh_topk_2d_center():
+    d = onp.array([[0.9, 0.5, 0.5, 1.0, 1.0],
+                   [0.05, 0.5, 0.5, 1.0, 1.0]], dtype="float32")
+    o = mx.nd.contrib.box_nms(
+        mx.np.array(d), overlap_thresh=0.5, valid_thresh=0.1,
+        coord_start=1, score_index=0, in_format="center").asnumpy()
+    assert o.shape == (2, 5)
+    assert o[0, 0] == onp.float32(0.9)
+    assert (o[1] == -1).all()        # below valid_thresh
+    many = onp.stack([
+        onp.array([0.9 - 0.1 * i, 10.0 * i, 10.0 * i,
+                   10.0 * i + 2, 10.0 * i + 2], dtype="float32")
+        for i in range(5)])
+    topped = mx.nd.contrib.box_nms(
+        mx.np.array(many), overlap_thresh=0.5, coord_start=1,
+        score_index=0, topk=3).asnumpy()
+    assert (topped[3:] == -1).all()  # beyond topk invalid
+    assert (topped[:3, 0] > 0).all()
+
+
+def test_box_nms_out_format_conversion():
+    d = onp.array([[[0.9, 0.0, 0.0, 2.0, 2.0]]], dtype="float32")
+    o = mx.nd.contrib.box_nms(
+        mx.np.array(d), coord_start=1, score_index=0,
+        in_format="corner", out_format="center").asnumpy()
+    assert onp.allclose(o[0, 0], [0.9, 1.0, 1.0, 2.0, 2.0])
+
+
+def test_bipartite_matching_greedy():
+    scores = onp.array([[[0.9, 0.2], [0.8, 0.7]]], dtype="float32")
+    rm, cm = mx.nd.contrib.bipartite_matching(
+        mx.np.array(scores), threshold=0.1)
+    assert rm.asnumpy().tolist() == [[0.0, 1.0]]
+    assert cm.asnumpy().tolist() == [[0.0, 1.0]]
+    # threshold excludes weak pairs
+    rm2, cm2 = mx.nd.contrib.bipartite_matching(
+        mx.np.array(scores), threshold=0.75)
+    assert rm2.asnumpy().tolist() == [[0.0, -1.0]]
+    assert cm2.asnumpy().tolist() == [[0.0, -1.0]]
+    # ascending mode: smaller is better (distance matrices)
+    dist = onp.array([[[0.1, 0.9], [0.9, 0.2]]], dtype="float32")
+    rma, _ = mx.nd.contrib.bipartite_matching(
+        mx.np.array(dist), threshold=0.5, is_ascend=True)
+    assert rma.asnumpy().tolist() == [[0.0, 1.0]]
+
+
+def test_multibox_prior_anchors():
+    x = mx.np.zeros((1, 3, 2, 2))
+    anc = mx.nd.contrib.multibox_prior(
+        x, sizes=(0.5, 0.25), ratios=(1, 2)).asnumpy()
+    assert anc.shape == (1, 12, 4)   # H*W*(S+R-1) = 2*2*3
+    assert onp.allclose(anc[0, 0], [0.0, 0.0, 0.5, 0.5], atol=1e-6)
+    # ratio-2 anchor: w = s0*sqrt(2), h = s0/sqrt(2)
+    w = anc[0, 2, 2] - anc[0, 2, 0]
+    h = anc[0, 2, 3] - anc[0, 2, 1]
+    assert abs(w / h - 2.0) < 1e-5
+    clipped = mx.nd.contrib.multibox_prior(
+        x, sizes=(1.5,), clip=True).asnumpy()
+    assert clipped.min() >= 0.0 and clipped.max() <= 1.0
+
+
+def test_box_nms_gradient_passthrough():
+    d = mx.np.array(onp.array([[[0.9, 0.0, 0.0, 2.0, 2.0]]],
+                              dtype="float32"))
+    d.attach_grad()
+    with mx.autograd.record():
+        out = mx.nd.contrib.box_nms(d, coord_start=1, score_index=0).sum()
+    out.backward()
+    assert d.grad is not None
+
+
+def test_multibox_target_matching_and_encoding():
+    anchor = mx.np.array(onp.array(
+        [[[0.1, 0.1, 0.4, 0.4], [0.6, 0.6, 0.9, 0.9]]], dtype="float32"))
+    label = mx.np.array(onp.array(
+        [[[1, 0.1, 0.1, 0.4, 0.4], [-1, 0, 0, 0, 0]]], dtype="float32"))
+    cls_pred = mx.np.array(onp.zeros((1, 3, 2), dtype="float32"))
+    lt, lm, ct = mx.nd.contrib.multibox_target(anchor, label, cls_pred)
+    assert ct.asnumpy().tolist() == [[2.0, 0.0]]  # gt class 1 -> target 2
+    assert onp.allclose(lt.asnumpy()[0, :4], 0, atol=1e-5)  # exact match
+    assert lm.asnumpy()[0].tolist() == [1, 1, 1, 1, 0, 0, 0, 0]
+    # offset gt: dx = (gcx-acx)/aw/v0
+    label2 = mx.np.array(onp.array(
+        [[[0, 0.15, 0.1, 0.45, 0.4], [-1, 0, 0, 0, 0]]], dtype="float32"))
+    lt2, _, ct2 = mx.nd.contrib.multibox_target(anchor, label2, cls_pred)
+    assert ct2.asnumpy()[0, 0] == 1.0
+    assert abs(lt2.asnumpy()[0, 0] - (0.05 / 0.3 / 0.1)) < 1e-4
+
+
+def test_multibox_detection_decode_roundtrip():
+    anchor = mx.np.array(onp.array(
+        [[[0.1, 0.1, 0.4, 0.4], [0.6, 0.6, 0.9, 0.9]]], dtype="float32"))
+    prob = onp.zeros((1, 3, 2), dtype="float32")
+    prob[0, 1, 0] = 0.9
+    prob[0, 2, 1] = 0.8
+    loc = onp.zeros((1, 8), dtype="float32")
+    det = mx.nd.contrib.multibox_detection(
+        mx.np.array(prob), mx.np.array(loc), anchor).asnumpy()
+    assert det.shape == (1, 2, 6)
+    assert det[0, 0, 0] == 0.0 and abs(det[0, 0, 1] - 0.9) < 1e-6
+    assert onp.allclose(det[0, 0, 2:], [0.1, 0.1, 0.4, 0.4], atol=1e-5)
+    assert det[0, 1, 0] == 1.0
+    # below-threshold anchors come back as -1 rows
+    weak = onp.zeros((1, 3, 2), dtype="float32")
+    weak[0, 0] = 1.0  # all background
+    det2 = mx.nd.contrib.multibox_detection(
+        mx.np.array(weak), mx.np.array(loc), anchor,
+        threshold=0.5).asnumpy()
+    assert (det2 == -1).all()
+
+
+def test_multibox_target_padding_does_not_clobber_forced_match():
+    """Regression: a padded label row (cls=-1) argmaxes to anchor 0 and
+    must not overwrite a valid gt's force-match there (scatter-max, not
+    scatter-set)."""
+    anchor = mx.np.array(onp.array(
+        [[[0.0, 0.0, 0.3, 0.3], [0.6, 0.6, 0.9, 0.9]]], dtype="float32"))
+    # gt overlaps anchor0 weakly (IoU < 0.5) -> only the forced match
+    # can claim it; the padding row must not erase that
+    label = mx.np.array(onp.array(
+        [[[1, 0.0, 0.0, 0.15, 0.3], [-1, 0, 0, 0, 0]]], dtype="float32"))
+    cls_pred = mx.np.array(onp.zeros((1, 3, 2), dtype="float32"))
+    _, _, ct = mx.nd.contrib.multibox_target(anchor, label, cls_pred)
+    assert ct.asnumpy().tolist() == [[2.0, 0.0]], ct.asnumpy()
