@@ -17,6 +17,13 @@ Usage mirrors the reference::
 """
 __version__ = "0.1.0"
 
+# Join a launcher-described multi-process job BEFORE anything touches the
+# XLA backend (jax.distributed.initialize must run first) — the analog of
+# the reference reading DMLC_* rendezvous env at import. No-op when the
+# env is absent; see base.join_distributed_job for the knobs.
+from .base import join_distributed_job as _join
+_join()
+
 from . import base
 from .base import MXNetError
 from .context import (Context, cpu, cpu_pinned, gpu, tpu, current_context,

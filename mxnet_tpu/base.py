@@ -23,7 +23,47 @@ __all__ = [
     "register_env",
     "list_env",
     "classproperty",
+    "join_distributed_job",
 ]
+
+
+def join_distributed_job() -> bool:
+    """Join the multi-process job described by the launcher env
+    (``tools/launch.py`` sets ``JAX_COORDINATOR_ADDRESS`` /
+    ``JAX_NUM_PROCESSES`` / ``JAX_PROCESS_ID`` — the DMLC_* rendezvous
+    analog). Idempotent; no-op (returns False) when the env is absent or
+    ``MXNET_NO_AUTO_DISTRIBUTED=1``. Must run before anything touches
+    the XLA backend; raises MXNetError with guidance if it is too late.
+
+    ``MXNET_DIST_INIT_TIMEOUT`` (seconds, default 120) bounds the wait
+    for the coordinator so a stale env cannot hang an import forever.
+    """
+    coord = os.environ.get("JAX_COORDINATOR_ADDRESS")
+    if not coord or os.environ.get("MXNET_NO_AUTO_DISTRIBUTED") == "1":
+        return False
+    import jax
+    if jax.distributed.is_initialized():
+        return True
+    try:
+        jax.distributed.initialize(
+            coordinator_address=coord,
+            num_processes=int(os.environ.get("JAX_NUM_PROCESSES", "1")),
+            process_id=int(os.environ.get("JAX_PROCESS_ID", "0")),
+            initialization_timeout=int(
+                os.environ.get("MXNET_DIST_INIT_TIMEOUT", "120")))
+    except RuntimeError as e:
+        msg = str(e).lower()
+        if "already" in msg:
+            return True
+        if "must be called before" in msg:
+            raise MXNetError(
+                "the XLA backend was initialized before joining the "
+                "multi-process job; import mxnet_tpu (or call "
+                "jax.distributed.initialize) before any jax computation "
+                "when JAX_COORDINATOR_ADDRESS is set — or set "
+                "MXNET_NO_AUTO_DISTRIBUTED=1 to opt out") from e
+        raise
+    return True
 
 
 class MXNetError(RuntimeError):

@@ -22,9 +22,12 @@ _ACCEL_TYPES = ("tpu", "gpu", "cuda", "rocm", "axon")
 
 
 def _accel_devices() -> List["jax.Device"]:
-    """All non-CPU jax devices (TPU chips; empty on CPU-only hosts)."""
+    """Process-local non-CPU jax devices (TPU chips; empty on CPU-only
+    hosts). Local, not global: in a multi-process job eager arrays must
+    land on THIS process's chips — other processes' devices are not
+    addressable (global placement goes through mesh shardings)."""
     try:
-        devs = jax.devices()
+        devs = jax.local_devices()
     except RuntimeError:
         return []
     return [d for d in devs if d.platform != "cpu"]
@@ -68,10 +71,14 @@ class Context:
                 # CPU fallback keeps ctx=tpu code runnable on CPU-only hosts
                 # (mirrors the reference's graceful "GPU not enabled" UX but
                 # non-fatally, since XLA:CPU runs the same programs).
-                cpus = [d for d in jax.devices() if d.platform == "cpu"]
+                cpus = [d for d in jax.local_devices()
+                        if d.platform == "cpu"]
                 return cpus[min(self.device_id, len(cpus) - 1)]
             return accel[self.device_id % len(accel)]
-        cpus = [d for d in jax.devices("cpu")] if _has_cpu_backend() else jax.devices()
+        if _has_cpu_backend():
+            cpus = [d for d in jax.local_devices(backend="cpu")]
+        else:
+            cpus = jax.local_devices()
         return cpus[min(self.device_id, len(cpus) - 1)]
 
     # -- equality / hashing ------------------------------------------------

@@ -214,21 +214,11 @@ class KVStore:
 
 
 def _maybe_init_distributed() -> None:
-    """Join the multi-process job described by the launcher's env
-    (``tools/launch.py`` sets ``JAX_COORDINATOR_ADDRESS`` / ``JAX_NUM_
-    PROCESSES`` / ``JAX_PROCESS_ID`` — the DMLC_* rendezvous analog).
-    No-op when unset or already initialized."""
-    import os
-    coord = os.environ.get("JAX_COORDINATOR_ADDRESS")
-    if not coord or jax.process_count() > 1:
-        return
-    try:
-        jax.distributed.initialize(
-            coordinator_address=coord,
-            num_processes=int(os.environ.get("JAX_NUM_PROCESSES", "1")),
-            process_id=int(os.environ.get("JAX_PROCESS_ID", "0")))
-    except RuntimeError:
-        pass    # already initialized
+    """Join the launcher-described multi-process job (idempotent; see
+    base.join_distributed_job — mxnet_tpu/__init__ already does this at
+    import when the env is present)."""
+    from .base import join_distributed_job
+    join_distributed_job()
 
 
 class KVStoreICI(KVStore):

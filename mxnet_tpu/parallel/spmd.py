@@ -33,6 +33,26 @@ P = jax.sharding.PartitionSpec
 
 _INCR_FN = None  # jitted t+1 for the device-resident step counter
 
+
+def _global_put(a, sh):
+    """Place a REPLICATED-CONSISTENT host value (params, optimizer
+    state, schedule arrays — every process holds the same full value)
+    onto a possibly multi-process mesh sharding.
+
+    ``jax.device_put`` cannot target non-addressable devices; each
+    process contributes its addressable shards of the common value via
+    ``make_array_from_callback`` — the standard multihost placement
+    pattern. NOT for per-process batch data (see ``SPMDTrainer._place``:
+    local batches are shards of the global batch, not copies of it)."""
+    if jax.process_count() == 1:
+        return jax.device_put(a, sh)
+    if isinstance(a, jax.Array) and not a.is_fully_addressable:
+        # already a global array: reshard through the compiled path
+        return jax.device_put(a, sh)
+    arr = jnp.asarray(a)
+    return jax.make_array_from_callback(
+        arr.shape, sh, lambda idx: arr[idx])
+
 __all__ = ["PartitionRules", "SPMDTrainer", "DEFAULT_TRANSFORMER_RULES",
            "DATA_PARALLEL_RULES"]
 
@@ -149,7 +169,7 @@ class SPMDTrainer:
         for name, p, arr in zip(self._names, self._params, clean):
             spec = rules.spec_for(name, tuple(p.shape), mesh)
             sh = jax.sharding.NamedSharding(mesh, spec)
-            p._data._data = jax.device_put(arr, sh)
+            p._data._data = _global_put(arr, sh)
             self._param_shardings.append(sh)
         if mesh.size > 1:
             # eager ops may now mix mesh-placed params with fresh
@@ -166,7 +186,7 @@ class SPMDTrainer:
         states = jax.tree_util.tree_unflatten(treedef, leaves)
         self._opt_states = [
             jax.tree_util.tree_map(
-                lambda a, s=self._param_shardings[i]: jax.device_put(a, s),
+                lambda a, s=self._param_shardings[i]: _global_put(a, s),
                 st)
             for i, st in enumerate(states)]
 
@@ -334,9 +354,23 @@ class SPMDTrainer:
         else:
             spec = _filter_spec(spec, tuple(a.shape), self.mesh)
         sh = jax.sharding.NamedSharding(self.mesh, spec)
-        if getattr(a, "sharding", None) == sh:
+        cur = getattr(a, "sharding", None)
+        if cur is not None and (cur == sh or (
+                hasattr(cur, "is_equivalent_to") and
+                cur.is_equivalent_to(sh, a.ndim))):
             return a
-        a = jax.device_put(a, sh)
+        if jax.process_count() > 1:
+            if isinstance(a, jax.Array) and not a.is_fully_addressable:
+                a = jax.device_put(a, sh)       # global array: reshard
+            else:
+                # a per-process batch is this process's SHARD of the
+                # global batch (reference dist_sync semantics: every
+                # worker feeds its own local data)
+                from jax.experimental import multihost_utils
+                a = multihost_utils.host_local_array_to_global_array(
+                    jnp.asarray(a), self.mesh, spec)
+        else:
+            a = _global_put(a, sh)
         if isinstance(x, NDArray):
             x._data = a
         return a
@@ -452,12 +486,12 @@ class SPMDTrainer:
                              "this trainer's model")
         for name, p, sh in zip(self._names, self._params,
                                self._param_shardings):
-            p._data._data = jax.device_put(loaded[name]._data, sh)
+            p._data._data = _global_put(loaded[name]._data, sh)
         self._step_count = payload["step_count"]
         self.optimizer.num_update = self._step_count
         self._t_dev = None  # re-sync the device counter on next step()
         self._opt_states = [
             jax.tree_util.tree_map(
-                lambda a, s=sh: jax.device_put(jnp.asarray(a), s), st)
+                lambda a, s=sh: _global_put(jnp.asarray(a), s), st)
             for st, sh in zip(payload["opt_states"],
                               self._param_shardings)]

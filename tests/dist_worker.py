@@ -1,0 +1,56 @@
+"""Worker script for the multi-process distributed test (launched by
+tools/launch.py — the analog of tests/nightly/dist_sync_kvstore.py's
+worker). Each process joins the jax.distributed job, trains a tiny net
+data-parallel over the global 2-process mesh, and writes its result."""
+import os
+import sys
+
+sys.path.insert(0, os.path.dirname(os.path.dirname(os.path.abspath(__file__))))
+
+import jax
+jax.config.update("jax_platforms", "cpu")
+
+
+def main() -> None:
+    out_dir = sys.argv[1]
+    import mxnet_tpu as mx
+    from mxnet_tpu import kvstore as kvs
+    kvs._maybe_init_distributed()   # reads the launcher's env contract
+
+    import numpy as onp
+    from jax.sharding import PartitionSpec as P
+    from mxnet_tpu.parallel import (SPMDTrainer, make_mesh,
+                                    DATA_PARALLEL_RULES)
+
+    rank = jax.process_index()
+    nproc = jax.process_count()
+    assert nproc == 2, nproc
+    assert len(jax.devices()) == 2          # one cpu device per process
+
+    mx.random.seed(0)
+    net = mx.gluon.nn.Dense(2, in_units=3)
+    net.initialize()
+    mesh = make_mesh({"dp": 2})
+    tr = SPMDTrainer(net, mx.gluon.loss.L2Loss(), optimizer="sgd",
+                     optimizer_params={"learning_rate": 0.1},
+                     mesh=mesh, rules=DATA_PARALLEL_RULES)
+
+    # each process feeds its OWN local batch (the reference dist_sync
+    # pattern) — _place globalizes it as this process's shard of the
+    # global batch; same data per rank on every run so both processes
+    # must agree bit-for-bit
+    rng = onp.random.RandomState(100 + rank)
+    x = rng.uniform(-1, 1, (2, 3)).astype("float32")
+    y = rng.uniform(-1, 1, (2, 2)).astype("float32")
+
+    losses = [float(tr.step(x, y).asnumpy()) for _ in range(3)]
+    w = onp.asarray(
+        net.weight.data()._data.addressable_data(0)).ravel()
+
+    with open(os.path.join(out_dir, f"worker{rank}.txt"), "w") as f:
+        f.write(" ".join(f"{v:.8f}" for v in losses) + "\n")
+        f.write(" ".join(f"{v:.8f}" for v in w) + "\n")
+
+
+if __name__ == "__main__":
+    main()
