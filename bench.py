@@ -80,6 +80,49 @@ def bench_bert(batch: int, steps: int, dtype: str, seq_len: int) -> None:
         "vs_baseline": 0.0}))
 
 
+def bench_gpt(batch: int, steps: int, dtype: str, seq_len: int) -> None:
+    """GPT-2-124M causal-LM step throughput, tokens/sec/chip
+    (beyond-reference config; flash attention engages for long seqs)."""
+    import numpy as onp
+    import jax
+    import mxnet_tpu as mx
+    from mxnet_tpu.gluon.model_zoo.gpt import get_gpt
+    from mxnet_tpu.parallel import SPMDTrainer, make_mesh, \
+        DATA_PARALLEL_RULES
+
+    vocab = 50257
+    mx.random.seed(0)
+    net = get_gpt("gpt2_124m", vocab_size=vocab, dropout=0.0,
+                  max_length=max(1024, seq_len))
+    net.initialize()
+    net(mx.np.zeros((2, 16), dtype="int32"))
+    if dtype != "float32":
+        net.cast(dtype)
+    loss_fn = mx.gluon.loss.SoftmaxCrossEntropyLoss(axis=-1)
+    mesh = make_mesh({"dp": 1}, devices=jax.devices()[:1])
+    trainer = SPMDTrainer(net, lambda o, l: loss_fn(o, l),
+                          optimizer="adamw",
+                          optimizer_params={"learning_rate": 1e-4},
+                          mesh=mesh, rules=DATA_PARALLEL_RULES)
+    rng = onp.random.RandomState(0)
+    x = mx.np.array(rng.randint(0, vocab, (batch, seq_len))
+                    .astype("int32"))
+    y = mx.np.array(rng.randint(0, vocab, (batch, seq_len))
+                    .astype("int32"))
+    float(trainer.step(x, y).asnumpy())
+    float(trainer.step(x, y).asnumpy())
+    t0 = time.perf_counter()
+    for _ in range(steps):
+        loss = trainer.step(x, y)
+    loss.asnumpy()
+    dt = time.perf_counter() - t0
+    tok_s = batch * seq_len * steps / dt
+    print(json.dumps({
+        "metric": f"gpt2_124m_lm_{dtype}_b{batch}x{seq_len}_train",
+        "value": round(tok_s, 1), "unit": "tokens/sec/chip",
+        "vs_baseline": 0.0}))
+
+
 def bench_lstm(batch: int, steps: int, dtype: str, seq_len: int) -> None:
     """Config 4: 2-layer LSTM LM (PTB-shape) tokens/sec/chip."""
     import numpy as onp
@@ -146,6 +189,9 @@ def main() -> None:
     if model_name.startswith("bert"):
         return bench_bert(batch, steps, dtype,
                           int(os.environ.get("MXNET_BENCH_SEQLEN", "512")))
+    if model_name.startswith("gpt"):
+        return bench_gpt(batch, steps, dtype,
+                         int(os.environ.get("MXNET_BENCH_SEQLEN", "1024")))
     if model_name.startswith("lstm"):
         return bench_lstm(batch, steps, dtype,
                           int(os.environ.get("MXNET_BENCH_SEQLEN", "35")))

@@ -1,4 +1,9 @@
-"""``gluon.model_zoo`` (reference: python/mxnet/gluon/model_zoo)."""
+"""``gluon.model_zoo`` (reference: python/mxnet/gluon/model_zoo) plus the
+NLP models (BERT per gluon-nlp; GPT beyond-reference)."""
 from . import vision
+from . import bert
+from . import gpt
+from .bert import get_bert
+from .gpt import get_gpt
 
-__all__ = ["vision"]
+__all__ = ["vision", "bert", "gpt", "get_bert", "get_gpt"]

@@ -1,0 +1,131 @@
+"""GPT — decoder-only causal transformer language model.
+
+Beyond-reference model family (the reference's NLP story was gluon-nlp
+BERT, SURVEY.md section 2.5; the fork era predates decoder-only LMs as a
+zoo staple) built from the same primitives: pre-LN blocks,
+``npx.multi_head_attention(causal=True)`` (XLA attention, Pallas flash
+kernel for long sequences, ring attention when the mesh has an 'sp'
+axis), GELU FFN, weight-tied LM head. Works imperatively, hybridized,
+and under SPMDTrainer (DEFAULT_TRANSFORMER_RULES name the qkv/out/ffn
+parameters this model uses).
+"""
+from __future__ import annotations
+
+from typing import Any, Optional
+
+from ... import npx
+from ... import numpy as mxnp
+from ...ndarray.ndarray import NDArray
+from ..block import HybridBlock
+from ..nn import Dense, Embedding, HybridSequential, LayerNorm
+from ..parameter import Parameter
+
+__all__ = ["GPTBlock", "GPTModel", "get_gpt", "gpt2_124m"]
+
+
+class GPTBlock(HybridBlock):
+    """One pre-LN causal transformer block."""
+
+    def __init__(self, units: int = 768, hidden_size: int = 3072,
+                 num_heads: int = 12, dropout: float = 0.1,
+                 layer_norm_eps: float = 1e-5, **kwargs: Any) -> None:
+        super().__init__(**kwargs)
+        self._num_heads = num_heads
+        self.ln1 = LayerNorm(epsilon=layer_norm_eps, in_channels=units)
+        self.attn_qkv = Dense(3 * units, in_units=units, flatten=False)
+        self.attn_out = Dense(units, in_units=units, flatten=False)
+        self.ln2 = LayerNorm(epsilon=layer_norm_eps, in_channels=units)
+        self.ffn1 = Dense(hidden_size, in_units=units, flatten=False)
+        self.ffn2 = Dense(units, in_units=hidden_size, flatten=False)
+        self._dropout = dropout
+
+    def forward(self, x: NDArray) -> NDArray:
+        h = self.ln1(x)
+        qkv = self.attn_qkv(h)
+        q, k, v = mxnp.split(qkv, 3, axis=-1)
+        att = npx.multi_head_attention(q, k, v, self._num_heads,
+                                       causal=True)
+        att = self.attn_out(att)
+        if self._dropout:
+            att = npx.dropout(att, self._dropout)
+        x = x + att
+        h = self.ln2(x)
+        ffn = self.ffn2(npx.gelu(self.ffn1(h)))
+        if self._dropout:
+            ffn = npx.dropout(ffn, self._dropout)
+        return x + ffn
+
+
+class GPTModel(HybridBlock):
+    """Decoder-only LM: tokens (B, T) int -> logits (B, T, vocab).
+
+    The LM head is weight-tied to ``word_embed`` (standard GPT-2
+    practice; also what DEFAULT_TRANSFORMER_RULES expects for
+    vocab-parallel sharding of the embedding).
+    """
+
+    def __init__(self, vocab_size: int = 50257, num_layers: int = 12,
+                 units: int = 768, hidden_size: int = 3072,
+                 num_heads: int = 12, max_length: int = 1024,
+                 dropout: float = 0.1, **kwargs: Any) -> None:
+        super().__init__(**kwargs)
+        self._units = units
+        self._max_length = max_length
+        self.word_embed = Embedding(vocab_size, units)
+        self.position_weight = Parameter(
+            "position_weight", shape=(max_length, units), init="normal")
+        self.blocks = HybridSequential()
+        for _ in range(num_layers):
+            self.blocks.add(GPTBlock(units, hidden_size, num_heads,
+                                     dropout))
+        self.ln_f = LayerNorm(epsilon=1e-5, in_channels=units)
+        self._dropout = dropout
+
+    def forward(self, tokens: NDArray) -> NDArray:
+        T = tokens.shape[1]
+        if T > self._max_length:
+            from ...base import MXNetError
+            raise MXNetError(
+                f"sequence length {T} exceeds max_length "
+                f"{self._max_length}")
+        if not self.position_weight.is_initialized:
+            self.position_weight._finish_deferred_init(
+                (self._max_length, self._units))
+        x = self.word_embed(tokens)
+        from ...ndarray import ops
+        pos = ops.slice_axis(self.position_weight.data(), axis=0,
+                             begin=0, end=T)
+        x = x + pos.expand_dims(0)
+        if self._dropout:
+            x = npx.dropout(x, self._dropout)
+        x = self.blocks(x)
+        x = self.ln_f(x)
+        # weight-tied LM head: logits = x @ E^T
+        w = self.word_embed.weight.data()
+        return mxnp.matmul(x, w.T)
+
+
+_SPECS = {
+    # name: (num_layers, units, hidden, heads, max_length)
+    "gpt2_124m": (12, 768, 3072, 12, 1024),
+    "gpt2_350m": (24, 1024, 4096, 16, 1024),
+    "gpt2_774m": (36, 1280, 5120, 20, 1024),
+}
+
+
+def get_gpt(model_name: str = "gpt2_124m", vocab_size: int = 50257,
+            dropout: float = 0.1, max_length: Optional[int] = None,
+            **kwargs: Any) -> GPTModel:
+    if model_name not in _SPECS:
+        raise ValueError(
+            f"unknown GPT spec {model_name!r}; choose from "
+            f"{sorted(_SPECS)}")
+    L, u, h, nh, ml = _SPECS[model_name]
+    return GPTModel(vocab_size=vocab_size, num_layers=L, units=u,
+                    hidden_size=h, num_heads=nh,
+                    max_length=max_length or ml, dropout=dropout,
+                    **kwargs)
+
+
+def gpt2_124m(**kw: Any) -> GPTModel:
+    return get_gpt("gpt2_124m", **kw)

@@ -25,12 +25,12 @@ def main() -> None:
     rank = jax.process_index()
     nproc = jax.process_count()
     assert nproc == 2, nproc
-    assert len(jax.devices()) == 2          # one cpu device per process
+    ndev = jax.device_count()               # nproc * devices-per-process
 
     mx.random.seed(0)
     net = mx.gluon.nn.Dense(2, in_units=3)
     net.initialize()
-    mesh = make_mesh({"dp": 2})
+    mesh = make_mesh({"dp": ndev})
     tr = SPMDTrainer(net, mx.gluon.loss.L2Loss(), optimizer="sgd",
                      optimizer_params={"learning_rate": 0.1},
                      mesh=mesh, rules=DATA_PARALLEL_RULES)

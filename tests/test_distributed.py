@@ -48,3 +48,29 @@ def test_two_process_spmd_training(tmp_path):
     assert r0[1] == r1[1]
     losses = [float(v) for v in r0[0].split()]
     assert losses[2] < losses[0]        # it actually trains
+
+
+def test_two_process_two_devices_each(tmp_path):
+    """dp=4 over 2 processes x 2 local devices: each worker's local
+    batch is its shard of the global batch, split over its own 2
+    devices (the host-local divisibility is per-process, not global)."""
+    env = dict(os.environ)
+    env.pop("XLA_FLAGS", None)
+    env["JAX_PLATFORMS"] = "cpu"
+    env["PYTHONPATH"] = REPO
+    for attempt in range(2):
+        cmd = [sys.executable, os.path.join(REPO, "tools", "launch.py"),
+               "-n", "2", "--port", str(_free_port()),
+               "--cpu-devices-per-worker", "2",
+               sys.executable,
+               os.path.join(REPO, "tests", "dist_worker.py"),
+               str(tmp_path)]
+        proc = subprocess.run(cmd, env=env, capture_output=True,
+                              text=True, timeout=280)
+        if proc.returncode == 0 or attempt == 1:
+            break
+    assert proc.returncode == 0, (proc.stdout[-2000:], proc.stderr[-2000:])
+    r0 = (tmp_path / "worker0.txt").read_text().splitlines()
+    r1 = (tmp_path / "worker1.txt").read_text().splitlines()
+    assert r0[0] == r1[0]
+    assert r0[1] == r1[1]

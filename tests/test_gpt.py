@@ -1,0 +1,68 @@
+"""GPT decoder-only LM (beyond-reference model family; causal attention
+through the same transformer op stack as BERT)."""
+import jax
+import numpy as onp
+
+import mxnet_tpu as mx
+from mxnet_tpu.gluon.model_zoo.gpt import GPTModel, get_gpt
+from mxnet_tpu.parallel import (SPMDTrainer, make_mesh,
+                                DEFAULT_TRANSFORMER_RULES)
+from mxnet_tpu.test_utils import assert_almost_equal
+
+
+def _tiny(dropout=0.0):
+    mx.random.seed(0)
+    net = GPTModel(vocab_size=101, num_layers=2, units=32, hidden_size=64,
+                   num_heads=4, max_length=16, dropout=dropout)
+    net.initialize()
+    return net
+
+
+def test_gpt_causality():
+    net = _tiny()
+    x = mx.np.array(onp.random.RandomState(0)
+                    .randint(0, 101, (2, 10)).astype("int32"))
+    out = net(x)
+    assert out.shape == (2, 10, 101)
+    x2 = onp.asarray(x.asnumpy()).copy()
+    x2[:, -1] = (x2[:, -1] + 1) % 101
+    out2 = net(mx.np.array(x2.astype("int32")))
+    # past logits unchanged, final position changed
+    assert_almost_equal(out.asnumpy()[:, :-1], out2.asnumpy()[:, :-1],
+                        rtol=1e-5, atol=1e-6)
+    assert not onp.allclose(out.asnumpy()[:, -1], out2.asnumpy()[:, -1])
+
+
+def test_gpt_hybridize_equivalence():
+    net = _tiny()
+    x = mx.np.array(onp.random.RandomState(1)
+                    .randint(0, 101, (2, 8)).astype("int32"))
+    eager = net(x)
+    net.hybridize()
+    compiled = net(x)
+    assert_almost_equal(eager, compiled, rtol=1e-5, atol=1e-6)
+
+
+def test_gpt_spmd_tp_training_converges():
+    net = _tiny()
+    mesh = make_mesh({"dp": 2, "tp": 2}, devices=jax.devices()[:4])
+    loss_fn = mx.gluon.loss.SoftmaxCrossEntropyLoss(axis=-1)
+    tr = SPMDTrainer(net, loss_fn, optimizer="adamw",
+                     optimizer_params={"learning_rate": 1e-3},
+                     mesh=mesh, rules=DEFAULT_TRANSFORMER_RULES)
+    rng = onp.random.RandomState(2)
+    x = mx.np.array(rng.randint(0, 101, (4, 10)).astype("int32"))
+    y = mx.np.array(rng.randint(0, 101, (4, 10)).astype("int32"))
+    l0 = float(tr.step(x, y).asnumpy())
+    for _ in range(5):
+        l = float(tr.step(x, y).asnumpy())
+    assert l < l0
+
+
+def test_gpt_specs_and_max_length_guard():
+    import pytest
+    with pytest.raises(ValueError):
+        get_gpt("gpt_unknown")
+    net = _tiny()
+    with pytest.raises(mx.MXNetError):
+        net(mx.np.zeros((1, 32), dtype="int32"))  # > max_length 16
