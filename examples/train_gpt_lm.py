@@ -1,0 +1,68 @@
+"""Train a small GPT causal LM with the SPMD trainer.
+
+Demonstrates the decoder-only path end-to-end: synthetic token stream,
+dp x tp mesh, AdamW with warmup-cosine schedule, checkpoint/resume.
+Runs on the 8-device virtual CPU mesh:
+
+    XLA_FLAGS=--xla_force_host_platform_device_count=8 \
+        python examples/train_gpt_lm.py
+"""
+import os
+import sys
+
+sys.path.insert(0, os.path.dirname(os.path.dirname(os.path.abspath(__file__))))
+
+import jax
+if not any(d.platform != "cpu" for d in jax.local_devices()):
+    jax.config.update("jax_platforms", "cpu")
+
+import numpy as onp
+
+import mxnet_tpu as mx
+from mxnet_tpu.gluon.model_zoo.gpt import GPTModel
+from mxnet_tpu.lr_scheduler import CosineScheduler
+from mxnet_tpu.parallel import (DEFAULT_TRANSFORMER_RULES, SPMDTrainer,
+                                make_mesh)
+
+
+def main() -> None:
+    vocab, seq_len, batch = 257, 64, 16
+    steps = int(os.environ.get("STEPS", "120"))
+
+    mx.random.seed(0)
+    net = GPTModel(vocab_size=vocab, num_layers=2, units=64,
+                   hidden_size=256, num_heads=4, max_length=seq_len,
+                   dropout=0.0)
+    net.initialize()
+
+    n_dev = len(jax.devices())
+    axes = {"dp": max(1, n_dev // 2), "tp": 2 if n_dev >= 2 else 1}
+    mesh = make_mesh(axes, devices=jax.devices()[:axes["dp"] * axes["tp"]])
+    sched = CosineScheduler(max_update=steps, base_lr=3e-3,
+                            warmup_steps=5, final_lr=1e-4)
+    trainer = SPMDTrainer(
+        net, mx.gluon.loss.SoftmaxCrossEntropyLoss(axis=-1),
+        optimizer="adamw",
+        optimizer_params={"learning_rate": 3e-3, "lr_scheduler": sched},
+        mesh=mesh, rules=DEFAULT_TRANSFORMER_RULES)
+
+    # synthetic corpus with learnable structure: next token = +1 mod vocab
+    rng = onp.random.RandomState(0)
+    for step in range(1, steps + 1):
+        start = rng.randint(0, vocab, (batch, 1))
+        seq = (start + onp.arange(seq_len + 1)) % vocab
+        x = mx.np.array(seq[:, :-1].astype("int32"))
+        y = mx.np.array(seq[:, 1:].astype("int32"))
+        loss = float(trainer.step(x, y).asnumpy())
+        if step % 5 == 0 or step == 1:
+            print(f"step {step:3d}  lr {trainer.learning_rate:.5f}  "
+                  f"loss {loss:.4f}")
+
+    trainer.save_checkpoint("/tmp/gpt_lm")
+    print("checkpoint written to /tmp/gpt_lm.{params,states}")
+    assert loss < 1.0, loss
+    print("converged: the model learned the +1 successor structure")
+
+
+if __name__ == "__main__":
+    main()

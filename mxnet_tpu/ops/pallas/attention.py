@@ -72,7 +72,9 @@ def _flash_fwd_kernel(q_ref, k_ref, v_ref, o_ref, lse_ref,
     def _finish():
         denom = jnp.maximum(l_ref[...], 1e-30)
         o_ref[0, 0] = (acc_ref[...] / denom).astype(o_ref.dtype)
-        lse_ref[0, 0] = (m_ref[...] + jnp.log(denom))[:, 0]
+        # lse rides as (B, H, T, 1): a trailing unit dim keeps the block
+        # shape (block_q, 1) legal under TPU (8, 128) tiling rules
+        lse_ref[0, 0] = m_ref[...] + jnp.log(denom)
 
 
 def _pad_to(x, axis, mult):
@@ -87,7 +89,11 @@ def _pad_to(x, axis, mult):
 
 def _flash_forward(q, k, v, scale: float, causal: bool,
                    block_q: int, block_k: int, interpret: bool):
-    """q/k/v: (B, H, T, D). Returns ((B, H, Tq, D), lse (B, H, Tq))."""
+    """q/k/v: (B, H, T, D). Returns ((B, H, Tq, D), lse (B, H, Tq, 1)).
+
+    lse keeps its trailing unit dim end-to-end (kernel block layout is
+    (block_q, 1)); it is a custom-vjp residual only.
+    """
     B, H, Tq, D = q.shape
     Tk = k.shape[2]
     qp = _pad_to(q, 2, block_q)
@@ -114,12 +120,12 @@ def _flash_forward(q, k, v, scale: float, causal: bool,
         out_specs=[
             pl.BlockSpec((1, 1, block_q, D),
                          lambda b, h, i, j: (b, h, i, 0)),
-            pl.BlockSpec((1, 1, block_q),
-                         lambda b, h, i, j: (b, h, i)),
+            pl.BlockSpec((1, 1, block_q, 1),
+                         lambda b, h, i, j: (b, h, i, 0)),
         ],
         out_shape=[
             jax.ShapeDtypeStruct((B, H, Tq_p, D), q.dtype),
-            jax.ShapeDtypeStruct((B, H, Tq_p), jnp.float32),
+            jax.ShapeDtypeStruct((B, H, Tq_p, 1), jnp.float32),
         ],
         scratch_shapes=[
             pltpu.VMEM((block_q, D), jnp.float32),   # acc
@@ -146,8 +152,8 @@ def _flash_bwd_dq_kernel(q_ref, k_ref, v_ref, do_ref, lse_ref, delta_ref,
     k = k_ref[0, 0].astype(jnp.float32)                # (bk, d)
     v = v_ref[0, 0].astype(jnp.float32)
     do = do_ref[0, 0].astype(jnp.float32)              # (bq, d)
-    lse = lse_ref[0, 0][:, None]                       # (bq, 1)
-    delta = delta_ref[0, 0][:, None]                   # (bq, 1)
+    lse = lse_ref[0, 0]                                # (bq, 1)
+    delta = delta_ref[0, 0]                            # (bq, 1)
 
     s = jax.lax.dot_general(q, k, (((1,), (1,)), ((), ())),
                             preferred_element_type=jnp.float32) * scale
@@ -185,8 +191,8 @@ def _flash_bwd_dkv_kernel(q_ref, k_ref, v_ref, do_ref, lse_ref, delta_ref,
     k = k_ref[0, 0].astype(jnp.float32)                # (bk, d)
     v = v_ref[0, 0].astype(jnp.float32)
     do = do_ref[0, 0].astype(jnp.float32)
-    lse = lse_ref[0, 0][:, None]
-    delta = delta_ref[0, 0][:, None]
+    lse = lse_ref[0, 0]
+    delta = delta_ref[0, 0]
 
     s = jax.lax.dot_general(q, k, (((1,), (1,)), ((), ())),
                             preferred_element_type=jnp.float32) * scale
@@ -219,7 +225,7 @@ def _flash_backward(q, k, v, o, lse, g, scale: float, causal: bool,
     B, H, Tq, D = q.shape
     Tk = k.shape[2]
     delta = jnp.sum(g.astype(jnp.float32) * o.astype(jnp.float32),
-                    axis=-1)                           # (B, H, Tq)
+                    axis=-1, keepdims=True)            # (B, H, Tq, 1)
     qp = _pad_to(q, 2, block_q)
     dop = _pad_to(g, 2, block_q)
     lsep = _pad_to(lse, 2, block_q)
@@ -233,7 +239,8 @@ def _flash_backward(q, k, v, o, lse, g, scale: float, causal: bool,
                           lambda b, h, i, j: (b, h, i, 0))
     k_spec = pl.BlockSpec((1, 1, block_k, D),
                           lambda b, h, i, j: (b, h, j, 0))
-    row_spec = pl.BlockSpec((1, 1, block_q), lambda b, h, i, j: (b, h, i))
+    row_spec = pl.BlockSpec((1, 1, block_q, 1),
+                            lambda b, h, i, j: (b, h, i, 0))
 
     dq = pl.pallas_call(
         functools.partial(_flash_bwd_dq_kernel, scale=scale,
@@ -253,8 +260,8 @@ def _flash_backward(q, k, v, o, lse, g, scale: float, causal: bool,
                            lambda b, h, j, i: (b, h, i, 0))
     ks_spec = pl.BlockSpec((1, 1, block_k, D),
                            lambda b, h, j, i: (b, h, j, 0))
-    rows_spec = pl.BlockSpec((1, 1, block_q),
-                             lambda b, h, j, i: (b, h, i))
+    rows_spec = pl.BlockSpec((1, 1, block_q, 1),
+                             lambda b, h, j, i: (b, h, i, 0))
     dk, dv = pl.pallas_call(
         functools.partial(_flash_bwd_dkv_kernel, scale=scale,
                           causal=causal, block_q=block_q, block_k=block_k,

@@ -363,13 +363,25 @@ class SPMDTrainer:
         sizes = (dict(zip(self.mesh.axis_names,
                           self.mesh.local_mesh.devices.shape))
                  if host_local else None)
+        orig, shape = spec, tuple(a.shape[1:] if leading_step_dim
+                                  else a.shape)
+        spec = _filter_spec(orig, shape, self.mesh, axis_sizes=sizes)
+        if host_local:
+            # for host-local data a dropped-for-divisibility axis CHANGES
+            # MEANING (shard of the global batch -> claimed copy of it),
+            # so it must error, not silently replicate inconsistent data
+            membership = _filter_spec(
+                orig, shape, self.mesh,
+                axis_sizes={n: 1 for n in self.mesh.axis_names})
+            if tuple(spec) != tuple(membership):
+                raise MXNetError(
+                    f"per-process batch shape {shape} does not divide "
+                    f"the local mesh extent "
+                    f"{dict((k, v) for k, v in sizes.items())} for spec "
+                    f"{orig}; each process's local batch must split "
+                    "evenly over its own devices")
         if leading_step_dim:
-            per_step = _filter_spec(spec, tuple(a.shape[1:]), self.mesh,
-                                    axis_sizes=sizes)
-            spec = P(*((None,) + tuple(per_step)))
-        else:
-            spec = _filter_spec(spec, tuple(a.shape), self.mesh,
-                                axis_sizes=sizes)
+            spec = P(*((None,) + tuple(spec)))
         sh = jax.sharding.NamedSharding(self.mesh, spec)
         cur = getattr(a, "sharding", None)
         if cur is not None and (cur == sh or (
