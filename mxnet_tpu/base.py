@@ -44,6 +44,22 @@ def join_distributed_job() -> bool:
     import jax
     if jax.distributed.is_initialized():
         return True
+    too_late = MXNetError(
+        "the XLA backend was initialized before joining the "
+        "multi-process job; import mxnet_tpu (or call "
+        "jax.distributed.initialize) before any jax computation "
+        "when JAX_COORDINATOR_ADDRESS is set — or set "
+        "MXNET_NO_AUTO_DISTRIBUTED=1 to opt out")
+    # A live XLA backend means initialize() is guaranteed to be too late;
+    # check the backend state directly rather than relying on jax's error
+    # wording (which shifts across versions — string match kept below only
+    # as a fallback).
+    try:
+        from jax._src import xla_bridge as _xb
+        if getattr(_xb, "_backends", None):
+            raise too_late
+    except ImportError:
+        pass
     try:
         jax.distributed.initialize(
             coordinator_address=coord,
@@ -56,12 +72,7 @@ def join_distributed_job() -> bool:
         if "already" in msg:
             return True
         if "must be called before" in msg:
-            raise MXNetError(
-                "the XLA backend was initialized before joining the "
-                "multi-process job; import mxnet_tpu (or call "
-                "jax.distributed.initialize) before any jax computation "
-                "when JAX_COORDINATOR_ADDRESS is set — or set "
-                "MXNET_NO_AUTO_DISTRIBUTED=1 to opt out") from e
+            raise too_late from e
         raise
     return True
 

@@ -244,7 +244,13 @@ class HybridBlock(Block):
     def hybridize(self, active: bool = True, static_alloc: bool = False,
                   static_shape: bool = False, **kwargs: Any) -> None:
         """Enable compiled execution (reference: ``HybridBlock.hybridize``;
-        static_alloc ≙ XLA buffer donation, applied automatically)."""
+        static_alloc ≙ XLA buffer donation, applied automatically).
+
+        Note: hybridized calls rebind the buffers of input NDArrays (and
+        parameters) in place to accelerator-resident copies the first time
+        each is seen, so later consuming jit calls skip the host->device
+        transfer; values are unchanged and later eager use stays valid.
+        """
         self._active = active
         self._flags = dict(static_alloc=static_alloc,
                            static_shape=static_shape, **kwargs)
@@ -360,8 +366,9 @@ class HybridBlock(Block):
         if m_idx:
             n_out = cell["treedef"].num_leaves
             for i, a in zip(m_idx, leaves[n_out:]):
-                params[i]._data._data = \
-                    a._data if isinstance(a, NDArray) else a
+                raw = a._data if isinstance(a, NDArray) else a
+                params[i]._data._data = raw
+                _engine.mark_clean(raw)
             leaves = leaves[:n_out]
         return jax.tree_util.tree_unflatten(cell["treedef"], leaves)
 

@@ -139,12 +139,19 @@ class Trainer:
                     self._optimizer.create_state_multi_precision(i, w)
             updatable.append((i, w, g))
         agg = self._optimizer.aggregate_num
-        if len(updatable) > 1 and agg > 1 and \
-                self._fused_applicable(updatable):
+        if len(updatable) > 1 and agg > 1 and self._fused_optimizer_ok():
             # reference semantics: MXNET_OPTIMIZER_AGGREGATION_SIZE bounds
-            # the number of parameters per fused update batch
-            for k in range(0, len(updatable), agg):
-                group = updatable[k:k + agg]
+            # the number of parameters per fused update batch. Params that
+            # can't fuse (row_sparse grads, fp32 master weights) take the
+            # per-param path WITHOUT disabling fusion for the dense
+            # majority in mixed models.
+            fusible, rest = [], []
+            for t in updatable:
+                (fusible if self._param_fusible(t) else rest).append(t)
+            if len(fusible) < 2:
+                fusible, rest = [], updatable
+            for k in range(0, len(fusible), agg):
+                group = fusible[k:k + agg]
                 if len(group) > 1:
                     self._fused_update(group)
                 else:
@@ -153,30 +160,29 @@ class Trainer:
                         self._optimizer.update_multi_precision(
                             i, w, g, self._states[i])
         else:
-            for i, w, g in updatable:
-                self._states[i] = self._optimizer.update_multi_precision(
-                    i, w, g, self._states[i])
+            rest = updatable
+        for i, w, g in rest:
+            self._states[i] = self._optimizer.update_multi_precision(
+                i, w, g, self._states[i])
         for _, w, _ in updatable:
             w._fresh_grad = False
 
-    def _fused_applicable(self, updatable) -> bool:
-        """Dense params whose optimizer is fully described by the
-        functional ``_step`` core can fuse into one compiled update.
-        Optimizers that override ``update``/``update_multi_precision``
-        (e.g. SGLD's eager Langevin noise) must take the per-param path,
-        as must fp32-master-weight state."""
+    def _fused_optimizer_ok(self) -> bool:
+        """Optimizers fully described by the functional ``_step`` core can
+        fuse; ones that override ``update``/``update_multi_precision``
+        (e.g. SGLD's eager Langevin noise) must take the per-param path."""
         cls = type(self._optimizer)
-        if cls._step is opt.Optimizer._step or \
-                cls.update is not opt.Optimizer.update or \
-                cls.update_multi_precision is not \
-                opt.Optimizer.update_multi_precision:
-            return False
-        for i, w, g in updatable:
-            if getattr(g, "stype", "default") == "row_sparse":
-                return False
-            if isinstance(self._states[i], opt.MasterWeightState):
-                return False
-        return True
+        return not (cls._step is opt.Optimizer._step or
+                    cls.update is not opt.Optimizer.update or
+                    cls.update_multi_precision is not
+                    opt.Optimizer.update_multi_precision)
+
+    def _param_fusible(self, t) -> bool:
+        """Dense params without fp32-master-weight state can join a fused
+        update group."""
+        i, w, g = t
+        return (getattr(g, "stype", "default") != "row_sparse" and
+                not isinstance(self._states[i], opt.MasterWeightState))
 
     def _fused_update(self, group) -> None:
         """One compiled program applying a group of parameter updates —

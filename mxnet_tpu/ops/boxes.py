@@ -315,7 +315,19 @@ def multibox_detection(cls_prob, loc_pred, anchor, clip: bool = True,
     ``_contrib_MultiBoxDetection``): cls_prob (B, C, N) softmax scores,
     loc_pred (B, N*4) encoded offsets, anchor (1, N, 4). Returns
     (B, N, 6) rows [class_id, score, x1, y1, x2, y2], suppressed/
-    background rows marked -1 (class ids exclude background, 0-based)."""
+    background rows marked -1 (class ids exclude background, 0-based:
+    id = original class - 1, the reference convention).
+
+    ``background_id`` must be 0 (the reference kernel hardcodes class 0 as
+    background) or negative (no background class; ids are original class
+    indices). Other values would silently shift ids for classes above the
+    background and are rejected."""
+    if background_id > 0:
+        raise ValueError(
+            "multibox_detection: background_id must be 0 (reference "
+            "convention — class 0 is background) or negative (no "
+            f"background class); got {background_id}. Nonzero background "
+            "classes would shift the reported ids of higher classes.")
     v0, v1, v2, v3 = [float(v) for v in variances]
 
     def impl(prob, loc, anc):
@@ -334,10 +346,9 @@ def multibox_detection(cls_prob, loc_pred, anchor, clip: bool = True,
                            cx + w / 2, cy + h / 2], -1)
         if clip:
             boxes = jnp.clip(boxes, 0.0, 1.0)
-        # best foreground class per anchor
-        fg = jnp.concatenate(
-            [prob[:, :background_id], prob[:, background_id + 1:]],
-            axis=1) if 0 <= background_id < C else prob
+        # best foreground class per anchor (background_id validated above:
+        # 0 = drop class 0, negative = no background class)
+        fg = prob[:, 1:] if background_id == 0 else prob
         cid = jnp.argmax(fg, axis=1).astype(jnp.float32)  # B,N
         score = jnp.max(fg, axis=1)
         valid = score > threshold

@@ -399,13 +399,24 @@ class SPMDTrainer:
         else:
             a = _global_put(a, sh)
         if isinstance(x, NDArray):
+            # write the mesh-resident buffer back into the caller's NDArray
+            # so re-used batches skip the host->device transfer on every
+            # step (see step()/run_steps() docstrings — in multi-process
+            # jobs this makes the NDArray non-host-addressable)
             x._data = a
+        from .. import engine as _engine
+        _engine.mark_clean(a)
         return a
 
     def run_steps(self, data: Any, labels: Any) -> NDArray:
         """Run K fused steps: ``data``/``labels`` carry a leading step
         dimension (K, batch, ...). Returns the (K,) per-step losses.
-        Parameters/optimizer state advance K times on device."""
+        Parameters/optimizer state advance K times on device.
+
+        Like :meth:`step`, input NDArrays are rebound in place to their
+        mesh-resident shardings (see the step() docstring for the
+        multi-process caveat).
+        """
         inputs = data if isinstance(data, (list, tuple)) else [data]
 
         arrays = [self._place(x, self._data_spec, leading_step_dim=True)
@@ -438,6 +449,8 @@ class SPMDTrainer:
         self._step_count += K
         self.optimizer.num_update = self._step_count
         self._t_dev = None  # re-sync the device counter on next step()
+        from .. import engine as _engine
+        _engine.mark_clean(new_params)
         for p, a in zip(self._params, new_params):
             p.data()._data = a
         self._opt_states = new_states
@@ -445,7 +458,15 @@ class SPMDTrainer:
 
     def step(self, data: Any, labels: Any, batch_size: Optional[int] = None
              ) -> NDArray:
-        """One training step; returns the (replicated) scalar loss."""
+        """One training step; returns the (replicated) scalar loss.
+
+        Input NDArrays are rebound in place to their mesh-resident
+        shardings so a re-used batch pays its host->device transfer only
+        once. In multi-process jobs the rebound buffer is a global
+        (non-host-addressable) array: per-process host-side reads of the
+        same NDArray (``asnumpy``, eager ops, metrics) must use a separate
+        copy of the data.
+        """
         inputs = data if isinstance(data, (list, tuple)) else [data]
 
         arrays = [self._place(x, self._data_spec) for x in inputs]
@@ -463,6 +484,8 @@ class SPMDTrainer:
             self._committed_scalar(lr), self._committed_scalar(wd),
             self._advance_t(),
             *arrays, label_arr)
+        from .. import engine as _engine
+        _engine.mark_clean(new_params)
         for p, a in zip(self._params, new_params):
             p.data()._data = a
         self._opt_states = new_states
