@@ -8,7 +8,9 @@ vs_baseline divides by the 300 img/s midpoint of BASELINE.md's unverified
 V100-fp32 sanity band (no verifiable reference numbers exist — see
 BASELINE.md provenance note).
 
-Env knobs: MXNET_BENCH_BATCH (default 128), MXNET_BENCH_STEPS (default 10),
+Env knobs: MXNET_BENCH_BATCH (default 128), MXNET_BENCH_STEPS (default 40 —
+short timed loops under-report: the ~120ms tunnel sync round-trip plus
+dispatch tails are fixed costs inside the timed region, ~26% at 10 steps),
 MXNET_BENCH_MODEL (resnet50_v1|bert|gpt|lstm), MXNET_BENCH_DTYPE
 (default bfloat16), MXNET_BENCH_IMAGE (224), MXNET_BENCH_SEQLEN.
 """
@@ -183,7 +185,7 @@ def main() -> None:
     # defaults = the headline config (BASELINE.md config 2): ResNet-50
     # bf16 b128 training — bf16 is the TPU-native training dtype
     batch = int(os.environ.get("MXNET_BENCH_BATCH", "128"))
-    steps = int(os.environ.get("MXNET_BENCH_STEPS", "10"))
+    steps = int(os.environ.get("MXNET_BENCH_STEPS", "40"))
     model_name = os.environ.get("MXNET_BENCH_MODEL", "resnet50_v1")
     dtype = os.environ.get("MXNET_BENCH_DTYPE", "bfloat16")
     img = int(os.environ.get("MXNET_BENCH_IMAGE", "224"))

@@ -1,0 +1,122 @@
+"""Imperative-mode dispatch benchmark (TPU-resident eager execution).
+
+Measures per-op dispatch cost of the executable cache on the accelerator
+(BASELINE: the reference's ~10-30us python->PushAsync path; through the
+axon tunnel the floor is network RTT, so the interesting number is
+amortized async dispatch, not sync round-trip). Also verifies the VERDICT
+done-criteria: imperative MLP + ResNet-block steps execute on the TPU
+backend with eager output buffers on-device.
+"""
+import os
+import sys
+import time
+
+sys.path.insert(0, os.path.dirname(os.path.dirname(os.path.abspath(__file__))))
+
+import numpy as onp
+
+
+def main():
+    import jax
+    import mxnet_tpu as mx
+    from mxnet_tpu import autograd
+    from mxnet_tpu.ndarray import register as reg
+
+    ctx = mx.tpu()
+    dev = ctx.jax_device
+    print(f"accelerator: {dev} (platform {dev.platform})")
+
+    with ctx:
+        a = mx.np.array(onp.random.RandomState(0)
+                        .uniform(-1, 1, (256, 256)).astype("float32"))
+        b = mx.np.array(onp.random.RandomState(1)
+                        .uniform(-1, 1, (256, 256)).astype("float32"))
+        # warm the executable
+        c = mx.np.dot(a, b)
+        print("eager output devices:", {d.platform for d in c._data.devices()},
+              "| cache entries:", len(reg._EXEC_CACHE))
+        c.asnumpy()
+
+        n = 50
+        t0 = time.perf_counter()
+        x = a
+        for _ in range(n):
+            x = mx.np.dot(x, b)
+        x.asnumpy()
+        dt = (time.perf_counter() - t0) / n
+        print(f"chained dot dispatch (cached): {dt*1e3:.2f} ms/op")
+
+        # imperative MLP fwd+bwd+sgd on-device
+        mx.random.seed(0)
+        net = mx.gluon.nn.HybridSequential()
+        net.add(mx.gluon.nn.Dense(256, activation="relu"),
+                mx.gluon.nn.Dense(64, activation="relu"),
+                mx.gluon.nn.Dense(10))
+        net.initialize(ctx=ctx)
+        tr = mx.gluon.Trainer(net.collect_params(), "sgd",
+                              {"learning_rate": 0.05})
+        X = mx.np.array(onp.random.RandomState(2)
+                        .uniform(-1, 1, (64, 128)).astype("float32"))
+        Y = mx.np.array(onp.random.RandomState(3)
+                        .randint(0, 10, (64,)).astype("int32"))
+        lf = mx.gluon.loss.SoftmaxCrossEntropyLoss()
+        with autograd.record():
+            loss = lf(net(X), Y).mean()
+        loss.backward()
+        tr.step(1)
+        w = net[0].weight.data()
+        print("MLP imperative step OK; param devices:",
+              {d.platform for d in w._data.devices()},
+              "loss", float(loss.asnumpy()))
+
+        t0 = time.perf_counter()
+        for _ in range(10):
+            with autograd.record():
+                loss = lf(net(X), Y).mean()
+            loss.backward()
+            tr.step(1)
+        loss.asnumpy()
+        dt = (time.perf_counter() - t0) / 10
+        print(f"MLP imperative fwd+bwd+sgd: {dt*1e3:.1f} ms/step")
+
+        # ResNet basic block, imperative
+        class Block(mx.gluon.HybridBlock):
+            def __init__(self):
+                super().__init__()
+                self.c1 = mx.gluon.nn.Conv2D(64, 3, padding=1)
+                self.b1 = mx.gluon.nn.BatchNorm()
+                self.c2 = mx.gluon.nn.Conv2D(64, 3, padding=1)
+                self.b2 = mx.gluon.nn.BatchNorm()
+
+            def forward(self, x):
+                h = mx.npx.relu(self.b1(self.c1(x)))
+                return mx.npx.relu(self.b2(self.c2(h)) + x)
+
+        blk = Block()
+        blk.initialize(ctx=ctx)
+        xb = mx.np.array(onp.random.RandomState(4)
+                         .uniform(-1, 1, (16, 64, 32, 32)).astype("float32"))
+        trb = mx.gluon.Trainer(blk.collect_params(), "sgd",
+                               {"learning_rate": 0.05})
+        with autograd.record():
+            out = blk(xb)
+            l2 = (out * out).mean()
+        l2.backward()
+        trb.step(1)
+        print("ResNet-block imperative step OK; out devices:",
+              {d.platform for d in out._data.devices()},
+              "loss", float(l2.asnumpy()))
+        t0 = time.perf_counter()
+        for _ in range(10):
+            with autograd.record():
+                out = blk(xb)
+                l2 = (out * out).mean()
+            l2.backward()
+            trb.step(1)
+        l2.asnumpy()
+        dt = (time.perf_counter() - t0) / 10
+        print(f"ResNet-block imperative fwd+bwd+sgd: {dt*1e3:.1f} ms/step")
+
+
+if __name__ == "__main__":
+    main()

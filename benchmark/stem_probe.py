@@ -1,0 +1,106 @@
+"""Is the ResNet stem (7x7 s2 conv on C=3) worth a space-to-depth rewrite?
+
+Times fwd+bwd of: (a) the standard stem conv, (b) the mathematically
+equivalent space-to-depth form (2x2 patches -> C=12, 4x4 s1 kernel),
+(c) the rest-of-network first bottleneck conv for scale. Diagnostic only.
+"""
+import sys
+import time
+
+import jax
+import jax.numpy as jnp
+import numpy as onp
+from jax import lax
+
+
+def timeit(fn, *args, n=30):
+    fn(*args)
+    fn(*args)
+    r = fn(*args)
+    onp.asarray(jax.tree_util.tree_leaves(r)[0]).ravel()[:1]
+    t0 = time.perf_counter()
+    for _ in range(n):
+        r = fn(*args)
+    onp.asarray(jax.tree_util.tree_leaves(r)[0]).ravel()[:1]
+    return (time.perf_counter() - t0) / n
+
+
+def main():
+    batch = int(sys.argv[1]) if len(sys.argv) > 1 else 128
+    dev = jax.devices()[0]
+    rng = onp.random.RandomState(0)
+    x = jax.device_put(jnp.asarray(
+        rng.uniform(-1, 1, (batch, 3, 224, 224)), jnp.bfloat16), dev)
+    w = jax.device_put(jnp.asarray(
+        rng.uniform(-0.1, 0.1, (64, 3, 7, 7)), jnp.bfloat16), dev)
+
+    def stem(x, w):
+        return lax.conv_general_dilated(
+            x, w, (2, 2), [(3, 3), (3, 3)],
+            dimension_numbers=("NCHW", "OIHW", "NCHW"))
+
+    def loss_std(x, w):
+        return stem(x, w).astype(jnp.float32).sum()
+
+    g_std = jax.jit(jax.grad(loss_std, argnums=(0, 1)))
+    dt = timeit(g_std, x, w)
+    print(f"stem 7x7s2 C3 fwd+bwd: {dt*1e3:.2f} ms")
+
+    # space-to-depth: pad W to kernel 8, pack 2x2 spatial into channels.
+    # y[n,o,i,j] = sum_{c,p,q} x[n,c,2i+p-3,2j+q-3] w[o,c,p,q]  (7x7, pad 3)
+    # With x2[n, c*4 + (di*2+dj), I, J] = x[n, c, 2I+di, 2J+dj] the same sum
+    # is a 4x4 s1 conv over 12 channels (kernel w2 scattered from w).
+    def pack_x(x):
+        B, C, H, W = x.shape
+        xp = jnp.pad(x, ((0, 0), (0, 0), (3, 5), (3, 5)))  # 224 -> 232 even
+        Hp = (H + 8) // 2
+        xr = xp.reshape(B, C, Hp, 2, Hp, 2)
+        return xr.transpose(0, 1, 3, 5, 2, 4).reshape(B, C * 4, Hp, Hp)
+
+    def pack_w(w):
+        O, C, KH, KW = w.shape
+        wp = jnp.pad(w, ((0, 0), (0, 0), (0, 1), (0, 1)))  # 7->8
+        wr = wp.reshape(O, C, 4, 2, 4, 2)
+        return wr.transpose(0, 1, 3, 5, 2, 4).reshape(O, C * 4, 4, 4)
+
+    def loss_s2d(x, w):
+        x2 = pack_x(x)
+        w2 = pack_w(w)
+        y = lax.conv_general_dilated(
+            x2, w2, (1, 1), [(0, 0), (0, 0)],
+            dimension_numbers=("NCHW", "OIHW", "NCHW"))[:, :, :112, :112]
+        return y.astype(jnp.float32).sum()
+
+    # correctness first
+    y1 = stem(x[:2].astype(jnp.float32), w.astype(jnp.float32))
+    x2 = pack_x(x[:2].astype(jnp.float32))
+    w2 = pack_w(w.astype(jnp.float32))
+    y2 = lax.conv_general_dilated(
+        x2, w2, (1, 1), [(0, 0), (0, 0)],
+        dimension_numbers=("NCHW", "OIHW", "NCHW"))[:, :, :112, :112]
+    err = float(jnp.abs(y1 - y2).max())
+    print(f"s2d equivalence max err: {err:.2e} (shapes {y1.shape} {y2.shape})")
+
+    g_s2d = jax.jit(jax.grad(loss_s2d, argnums=(0, 1)))
+    dt = timeit(g_s2d, x, w)
+    print(f"stem s2d 4x4 C12 fwd+bwd: {dt*1e3:.2f} ms")
+
+    # scale reference: one mid-network conv
+    h = jax.device_put(jnp.asarray(
+        rng.uniform(-1, 1, (batch, 256, 56, 56)), jnp.bfloat16), dev)
+    wk = jax.device_put(jnp.asarray(
+        rng.uniform(-0.1, 0.1, (64, 256, 1, 1)), jnp.bfloat16), dev)
+
+    def loss_mid(h, wk):
+        y = lax.conv_general_dilated(
+            h, wk, (1, 1), [(0, 0), (0, 0)],
+            dimension_numbers=("NCHW", "OIHW", "NCHW"))
+        return y.astype(jnp.float32).sum()
+
+    g_mid = jax.jit(jax.grad(loss_mid, argnums=(0, 1)))
+    dt = timeit(g_mid, h, wk)
+    print(f"mid 1x1 C256->64 fwd+bwd: {dt*1e3:.2f} ms")
+
+
+if __name__ == "__main__":
+    main()

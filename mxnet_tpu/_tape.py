@@ -101,7 +101,7 @@ class TapeNode:
     """
 
     __slots__ = ("name", "vjp_fn", "inputs", "out_avals", "out_arrays",
-                 "out_is_tuple", "consumed")
+                 "out_is_tuple", "consumed", "jit_pull")
 
     def __init__(self, name: str, vjp_fn: Callable,
                  inputs: Sequence[Any],
@@ -114,9 +114,23 @@ class TapeNode:
         self.out_arrays: List[Any] = []     # weakrefs to output NDArrays
         self.out_is_tuple = out_is_tuple    # fwd returned a tuple (any arity)
         self.consumed = False
+        # True when the forward ran through the per-op executable cache:
+        # vjp_fn is then a jit-able tree_util.Partial with device-resident
+        # residuals, and backward dispatches it as ONE compiled program
+        self.jit_pull = False
 
     def n_out(self) -> int:
         return len(self.out_avals)
+
+
+_PULL_JIT: dict = {"fn": None}
+
+
+def _pullback_jit() -> Callable:
+    fn = _PULL_JIT["fn"]
+    if fn is None:
+        fn = _PULL_JIT["fn"] = jax.jit(lambda vjp, ct: vjp(ct))
+    return fn
 
 
 def _toposort(heads: Sequence[Any]) -> List[TapeNode]:
@@ -232,7 +246,15 @@ def backward_arrays(heads: Sequence[Any],
                         f"dtype {dtype!r}: {e}") from e
             out_cots.append(c)
         payload = tuple(out_cots) if node.out_is_tuple else out_cots[0]
-        in_cots = node.vjp_fn(payload)
+        if node.jit_pull and not any(
+                getattr(c, "dtype", None) == jax.dtypes.float0
+                for c in out_cots):
+            # one compiled pullback dispatch (jax.jit caches per pullback
+            # structure + cotangent avals); float0 cotangents can't cross
+            # a jit boundary, those nodes stay eager
+            in_cots = _pullback_jit()(node.vjp_fn, payload)
+        else:
+            in_cots = node.vjp_fn(payload)
         if not retain_graph:
             node.vjp_fn = None
             node.consumed = True

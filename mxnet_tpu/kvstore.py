@@ -224,50 +224,40 @@ def _maybe_init_distributed() -> None:
 class KVStoreICI(KVStore):
     """Multi-host synchronous data parallelism over ICI/DCN.
 
-    Push = psum over all participating processes' chips via a jitted
-    allreduce on the global mesh (requires ``jax.distributed.initialize``
-    to have run; single-process degenerates to local). The reference's
-    scheduler/server roles and key slicing disappear — SURVEY.md 3.5.
+    Push of a per-process gradient sums it across all processes (the
+    reference dist_sync invariant: pulled == sum over workers of pushed,
+    ``tests/nightly/dist_sync_kvstore.py``). Mesh-sharded global arrays
+    pass through unchanged — their reduction already happened inside the
+    compiled SPMD step (XLA inserted the psum; SURVEY.md 3.5 TPU MAPPING).
+    The reference's scheduler/server roles and key slicing disappear.
     """
 
     def __init__(self, kv_type: str = "ici") -> None:
         super().__init__(kv_type)
-        self._allreduce_fn = None
         _maybe_init_distributed()
 
-    def _get_allreduce(self):
-        if self._allreduce_fn is None:
-            ndev = len(jax.devices())
-            if ndev == 1:
-                self._allreduce_fn = lambda x: x
-            else:
-                mesh = jax.sharding.Mesh(jax.devices(), ("dp",))
-                spec = jax.sharding.PartitionSpec()
-
-                @jax.jit
-                def reduce_replicated(x):
-                    # replicated input: psum across dp via shard_map
-                    return jax.shard_map(
-                        lambda y: jax.lax.psum(y, "dp"),
-                        mesh=mesh, in_specs=spec, out_specs=spec)(x)
-
-                self._allreduce_fn = reduce_replicated
-        return self._allreduce_fn
-
     def _allreduce(self, v: NDArray) -> NDArray:
-        # Gradients produced by a replicated-parameter step are already
-        # identical across devices; summing again would multiply by N.
-        # This path is for per-process partial grads (multi-host DP):
-        # only engage when the array is sharded.
         data = v._data
         try:
-            sharded = len(data.devices()) > 1
+            multi_device = len(data.devices()) > 1
         except Exception:
-            sharded = False
-        if not sharded:
+            multi_device = False
+        if multi_device:
+            # a mesh-placed global array: the SPMD step already reduced it
+            # (summing again would multiply by N)
             return v
-        fn = self._get_allreduce()
-        return NDArray(fn(data), _wrap=True)
+        if jax.process_count() == 1:
+            return v
+        # Per-process contribution: gather every process's value over DCN/
+        # ICI and sum locally in a fixed order, so all workers compute a
+        # bit-identical result (the dist_sync server-aggregation analog —
+        # no server processes, the collective IS the server).
+        from jax.experimental import multihost_utils
+        gathered = multihost_utils.process_allgather(jnp.asarray(data))
+        reduced = jnp.asarray(gathered).sum(axis=0).astype(data.dtype)
+        out = NDArray(reduced, ctx=v.context)
+        out._data = jax.device_put(out._data, next(iter(data.devices())))
+        return out
 
     @property
     def rank(self) -> int:

@@ -541,7 +541,9 @@ def choose(a, choices, mode="raise"):
             mm = "clip"
         return jnp.choose(x, list(cs), mode=mm)
 
-    return invoke("choose", impl, [nd] + ch)
+    # mode='raise' validates indices against concrete values — the per-op
+    # executable cache would silently degrade it to 'clip'
+    return invoke("choose", impl, [nd] + ch, eager_only=(m == "raise"))
 
 
 @_public

@@ -45,6 +45,167 @@ _monitor_state = {"hooks": {}}
 # mesh; single-device programs never pay the per-op sharding scan
 _mesh_state = {"active": False}
 
+# ---------------------------------------------------------------------------
+# TPU-resident imperative mode: per-op executable cache
+# (reference: src/imperative/imperative.cc Imperative::Invoke → PushFCompute —
+# the per-op kernel dispatch; here each op becomes ONE cached XLA executable
+# instead of a chain of per-primitive eager dispatches, and its outputs are
+# real device buffers, so eager ops run on the accelerator and hybridize/jit
+# consumers need no host->device re-transfer)
+# ---------------------------------------------------------------------------
+
+# (op name, closure token, recording) -> jitted callable. jax.jit handles
+# the per-shape/dtype executable keying internally; the closure token keys
+# the op's attributes (closure cell values), so behaviorally-equal closures
+# share one traced wrapper.
+_EXEC_CACHE: Dict[Any, Callable] = {}
+
+# MXNET_IMPERATIVE_EXEC_CACHE: "auto" (cache when an input lives on an
+# accelerator device), "1" (always — also on CPU; used by tests), "0" (off)
+_exec_mode = {"value": None}
+
+
+class _UnhashableAttr(Exception):
+    pass
+
+
+def _attr_token(v: Any, depth: int = 0) -> Any:
+    """A hashable token for a closure cell value, or raise."""
+    if depth > 4:
+        raise _UnhashableAttr
+    if v is None or isinstance(v, (str, bytes)):
+        return v
+    if isinstance(v, (bool, int, float)):
+        # dict-key equality conflates 0 == 0.0 == False; the numeric TYPE
+        # is part of the op's behavior (output dtype), so key it too
+        return (type(v).__name__, v)
+    if isinstance(v, (tuple, list)):
+        return (type(v).__name__,) + tuple(
+            _attr_token(x, depth + 1) for x in v)
+    if isinstance(v, dict):
+        try:
+            return tuple(sorted(
+                (k, _attr_token(x, depth + 1)) for k, x in v.items()))
+        except TypeError:  # mixed-type keys don't sort
+            raise _UnhashableAttr from None
+    if isinstance(v, type) or hasattr(v, "dtype") and not hasattr(v, "shape"):
+        return str(v)
+    import numpy as _onp
+    if isinstance(v, _onp.dtype):
+        return str(v)
+    if callable(v) and hasattr(v, "__code__"):
+        return _closure_token(v, depth + 1)
+    if callable(v):
+        # code-less callable (jnp ufunc, builtin): stable object identity
+        # is the token — the common case for scalar-operand binary ops
+        try:
+            hash(v)
+            return v
+        except TypeError:
+            raise _UnhashableAttr from None
+    raise _UnhashableAttr
+
+
+def _closure_token(fn: Callable, depth: int = 0) -> Any:
+    """Key an op impl closure by code object + attribute cell values.
+    Cells holding arrays/objects (e.g. PRNG keys) are unhashable — such
+    ops fall back to plain eager dispatch."""
+    code = getattr(fn, "__code__", None)
+    if code is None:
+        # not a Python function (jnp ufunc, builtin): the stable callable
+        # object itself is the token
+        try:
+            hash(fn)
+        except TypeError:
+            raise _UnhashableAttr from None
+        return fn
+    cells = fn.__closure__ or ()
+    try:
+        return (code,) + tuple(
+            _attr_token(c.cell_contents, depth) for c in cells)
+    except ValueError:  # empty (not-yet-bound) cell
+        raise _UnhashableAttr from None
+
+
+def _exec_cache_mode() -> str:
+    mode = _exec_mode["value"]
+    if mode is None:
+        import os
+        mode = os.environ.get("MXNET_IMPERATIVE_EXEC_CACHE", "auto")
+        _exec_mode["value"] = mode
+    return mode
+
+
+def _should_use_exec_cache(arrays) -> bool:
+    mode = _exec_cache_mode()
+    if mode == "0":
+        return False
+    for a in arrays:
+        if isinstance(a, jax.core.Tracer):
+            return False  # inside a hybridize/jit trace: run inline
+    if mode == "1":
+        return True
+    for a in arrays:
+        if isinstance(a, jax.Array):
+            try:
+                devs = a.devices()
+            except Exception:
+                continue
+            if any(d.platform != "cpu" for d in devs):
+                return True
+    return False
+
+
+_EAGER_ONLY = object()  # cache sentinel: op failed to trace once, stay eager
+
+
+def _cached_exec(name: str, impl: Callable, arrays, record: bool):
+    """Try the per-op executable cache; returns the raw result or None
+    when the op must take the eager path."""
+    try:
+        key = (name, _closure_token(impl), record)
+    except _UnhashableAttr:
+        return None  # attrs hold arrays/objects (e.g. PRNG keys)
+    fn = _EXEC_CACHE.get(key)
+    if fn is _EAGER_ONLY:
+        return None
+    if fn is None:
+        if record:
+            # jax.vjp's pullback is a tree_util.Partial: its residuals
+            # come back as device buffers and the pullback itself stays
+            # jit-able for backward
+            fn = jax.jit(lambda *xs: jax.vjp(impl, *xs))
+        else:
+            fn = jax.jit(impl)
+        _EXEC_CACHE[key] = fn
+    try:
+        return fn(*arrays)
+    except jax.errors.JAXTypeError:
+        # op needs concrete values (data-dependent host checks, e.g.
+        # mode='raise' bounds validation) — permanently take the eager
+        # path for this op signature
+        _EXEC_CACHE[key] = _EAGER_ONLY
+        return None
+
+
+def _dispatch(name: str, impl: Callable, arrays, record: bool,
+              eager_only: bool = False):
+    """Run ``impl`` over raw arrays, through the per-op executable cache
+    when eligible. Returns ``(outs, vjp_fn_or_None, cached)``."""
+    if not eager_only and _should_use_exec_cache(arrays):
+        result = _cached_exec(name, impl, arrays, record)
+        if result is not None:
+            outs = result[0] if record else result
+            for o in (outs if isinstance(outs, (tuple, list)) else (outs,)):
+                engine.mark_clean(o)
+            if record:
+                return result[0], result[1], True
+            return result, None, True
+    if record:
+        outs, vjp_fn = jax.vjp(impl, *arrays)
+        return outs, vjp_fn, False
+    return impl(*arrays), None, False
+
 
 def _harmonize_mesh_placement(arrays):
     """Eager ops mixing mesh-sharded operands (e.g. parameters placed by
@@ -145,11 +306,13 @@ def invoke_with_custom_vjp(name: str, impl: Callable,
 
 
 def invoke(name: str, impl: Callable, inputs: Sequence[Any],
-           ctx=None) -> Any:
+           ctx=None, eager_only: bool = False) -> Any:
     """Execute op ``impl`` over NDArray ``inputs``; handle autograd.
 
     ``impl`` takes the raw arrays positionally (attrs must already be bound
     into the closure) and returns one array or a tuple of arrays.
+    ``eager_only`` ops (data-dependent host-side behavior, e.g. bounds
+    validation with mode='raise') bypass the per-op executable cache.
     """
     arrays = [x._data for x in inputs]
     if _mesh_state["active"]:
@@ -168,10 +331,8 @@ def invoke(name: str, impl: Callable, inputs: Sequence[Any],
 
     record = is_recording() and any(x._on_tape for x in inputs)
     try:
-        if record:
-            outs, vjp_fn = jax.vjp(impl, *arrays)
-        else:
-            outs = impl(*arrays)
+        outs, vjp_fn, cached = _dispatch(name, impl, arrays, record,
+                                         eager_only)
     finally:
         if timer is not None:
             timer.__exit__()
@@ -184,6 +345,7 @@ def invoke(name: str, impl: Callable, inputs: Sequence[Any],
     if record:
         avals = [(tuple(o.shape), o.dtype) for o in outs_t]
         node = TapeNode(name, vjp_fn, inputs, avals, out_is_tuple=not single)
+        node.jit_pull = cached
         node.out_arrays = [weakref.ref(w) for w in wrapped]
         for i, w in enumerate(wrapped):
             w._ag_node = node

@@ -11,7 +11,7 @@ from .mesh import make_mesh, mesh_axes, replicated, shard_batch
 from .spmd import (PartitionRules, SPMDTrainer, DEFAULT_TRANSFORMER_RULES,
                    DATA_PARALLEL_RULES)
 from .ring import ring_attention, local_ring_attention
-from .pipeline import pipeline_apply
+from .pipeline import pipeline_apply, GPTPipe, PIPELINE_RULES
 from .moe import MoEDense, MOE_RULES
 
 __all__ = ["make_mesh", "mesh_axes", "replicated", "shard_batch",

@@ -21,7 +21,7 @@ import jax
 import jax.numpy as jnp
 from jax.sharding import PartitionSpec as P
 
-__all__ = ["pipeline_apply"]
+__all__ = ["pipeline_apply", "GPTPipe", "PIPELINE_RULES"]
 
 
 def _shard_map(fn, mesh, in_specs, out_specs):
@@ -111,3 +111,148 @@ def pipeline_apply(stage_fn: Callable, stage_params: Any, x: "jax.Array",
     # the bank is only populated on the last stage; its slice is the result
     out = out[-1]
     return out.reshape((B,) + x.shape[1:])
+
+
+# ---------------------------------------------------------------------------
+# Real-model pipeline parallelism: GPT blocks as pipeline stages
+# ---------------------------------------------------------------------------
+
+from .spmd import PartitionRules  # noqa: E402  (no gluon<->parallel cycle)
+from ..gluon.block import HybridBlock  # noqa: E402
+
+PIPELINE_RULES = PartitionRules([
+    # stacked per-stage block weights: leading (stage) dim over pp
+    (r"stage_", P("pp")),
+])
+
+
+class GPTPipe(HybridBlock):
+    """GPT whose transformer blocks run as GPipe pipeline stages.
+
+    Beyond-reference capability (SURVEY.md 2.3: PP absent upstream) on a
+    REAL model: the per-block weights live as stacked ``(num_layers, ...)``
+    parameters sharded over the mesh's ``pp`` axis (PIPELINE_RULES), and
+    forward streams microbatches through ONE template :class:`GPTBlock`
+    whose buffers are rebound per stage (``_bind_params``) inside
+    :func:`pipeline_apply` — the block math is the model zoo's own, not a
+    reimplementation. Works under SPMDTrainer (the stacked params are
+    ordinary Parameters).
+
+    Dropout is forced to 0 inside the pipeline (per-tick RNG inside the
+    scan is not threaded); embed/head dropout would go outside the stages.
+    """
+
+    def __init__(self, mesh, vocab_size: int = 50257, num_layers: int = 4,
+                 units: int = 256, hidden_size: int = 1024,
+                 num_heads: int = 4, max_length: int = 512,
+                 num_microbatches: Optional[int] = None,
+                 axis: str = "pp", **kwargs: Any) -> None:
+        super().__init__(**kwargs)
+        from ..gluon.model_zoo.gpt import GPTBlock
+        from ..gluon.nn import Embedding, LayerNorm
+        from ..gluon.parameter import Parameter
+
+        self._mesh = mesh
+        self._axis = axis
+        self._n_micro = num_microbatches
+        self._units = units
+        self._max_length = max_length
+        self._num_layers = num_layers
+
+        self.word_embed = Embedding(vocab_size, units)
+        self.position_weight = Parameter(
+            "position_weight", shape=(max_length, units), init="normal")
+        self.ln_f = LayerNorm(epsilon=1e-5, in_channels=units)
+
+        # template block: supplies the stage math; its own (tiny) buffers
+        # are bind targets only, never trained — bypass child registration
+        tpl = GPTBlock(units, hidden_size, num_heads, dropout=0.0)
+        tpl.initialize()
+        object.__setattr__(self, "_template", tpl)
+        tpl_params = list(tpl.collect_params().items())
+        object.__setattr__(self, "_tpl_params",
+                           [p for _, p in tpl_params])
+        for name, p in tpl_params:
+            sp = Parameter("stage_" + name.replace(".", "_"),
+                           shape=(num_layers,) + tuple(p.shape),
+                           init=getattr(p, "init", None) or "uniform")
+            setattr(self, "stage_" + name.replace(".", "_"), sp)
+        object.__setattr__(
+            self, "_stacked",
+            [getattr(self, "stage_" + name.replace(".", "_"))
+             for name, _ in tpl_params])
+
+    def load_block_weights(self, gpt_model) -> None:
+        """Copy a :class:`GPTModel`'s per-block weights into the stacked
+        stage parameters (for parity tests / converting a trained model)."""
+        from ..ndarray.ndarray import NDArray
+        blocks = list(gpt_model.blocks._children.values())
+        assert len(blocks) == self._num_layers, \
+            (len(blocks), self._num_layers)
+        per_block = [list(b.collect_params().values()) for b in blocks]
+        for k, sp in enumerate(self._stacked):
+            stacked = jnp.stack(
+                [per_block[i][k].data()._data
+                 for i in range(self._num_layers)])
+            sp.set_data(NDArray(stacked))
+
+    def _mesh_place(self, nd, spec):
+        """Commit an NDArray's buffer to this mesh (writes back), or pass
+        tracers through untouched."""
+        arr = nd._data
+        if isinstance(arr, jax.core.Tracer):
+            return arr
+        sh = jax.sharding.NamedSharding(self._mesh, spec)
+        cur = getattr(arr, "sharding", None)
+        if cur is not None and (cur == sh or (
+                hasattr(cur, "is_equivalent_to") and
+                cur.is_equivalent_to(sh, arr.ndim))):
+            return arr
+        arr = jax.device_put(arr, sh)
+        nd._data = arr
+        from .. import engine
+        engine.mark_clean(arr)
+        return arr
+
+    def forward(self, tokens):
+        from ..gluon.block import _bind_params
+        from ..ndarray.ndarray import from_jax
+        from ..ndarray import ops
+        from .. import numpy as mxnp
+        # eager ops downstream of the pipeline mix mesh-sharded activations
+        # with single-device params; enable the per-op harmonization scan
+        # only once pipeline work actually runs
+        from ..ndarray.register import _mesh_state
+        _mesh_state["active"] = True
+
+        T = tokens.shape[1]
+        if not self.position_weight.is_initialized:
+            self.position_weight._finish_deferred_init(
+                (self._max_length, self._units))
+        x = self.word_embed(tokens)
+        pos = ops.slice_axis(self.position_weight.data(), axis=0,
+                             begin=0, end=T)
+        x = x + pos.expand_dims(0)
+
+        tpl = self._template
+        tpl_params = self._tpl_params
+
+        def stage_fn(param_slices, h):
+            with _bind_params(tpl_params, param_slices):
+                out = tpl.forward(from_jax(h))
+            return out._data
+
+        # eager path: stacked weights must live sharded over the pp mesh
+        # (write back so the placement is paid once); tracers are already
+        # placed by the enclosing pjit (SPMDTrainer rules)
+        arrays = []
+        for p in self._stacked:
+            nd = p.data()
+            arrays.append(self._mesh_place(nd, P(self._axis)))
+        h = self._mesh_place(x, P())
+        out = pipeline_apply(stage_fn, arrays, h, self._mesh,
+                             axis=self._axis,
+                             num_microbatches=self._n_micro)
+        x = self.ln_f(from_jax(out))
+        w = self.word_embed.weight.data()
+        return mxnp.matmul(x, w.T)

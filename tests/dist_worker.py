@@ -11,8 +11,56 @@ import jax
 jax.config.update("jax_platforms", "cpu")
 
 
+def kvstore_main(out_dir: str) -> None:
+    """Reference dist_sync contract (tests/nightly/dist_sync_kvstore.py):
+    pulled == sum over workers of pushed, and gluon.Trainer(kvstore='ici')
+    keeps parameters bit-identical across processes WITHOUT SPMDTrainer."""
+    import mxnet_tpu as mx
+    from mxnet_tpu import kvstore as kvs
+    kvs._maybe_init_distributed()
+    import numpy as onp
+
+    rank = jax.process_index()
+    kv = kvs.create("dist_sync")
+    nw = kv.num_workers
+    assert nw == 2, nw
+
+    # raw push/pull invariant with rank-dependent values
+    base = onp.arange(12, dtype="float32").reshape(3, 4)
+    kv.init(0, mx.np.array(onp.zeros((3, 4), "float32")))
+    kv.push(0, mx.np.array(base * (rank + 1)))
+    pulled = kv.pull(0).asnumpy()
+    expect = base * sum(r + 1 for r in range(nw))
+    assert onp.allclose(pulled, expect), (pulled, expect)
+
+    # plain gluon.Trainer over the kvstore: per-rank batches differ, the
+    # summed-grad update must keep params bit-identical across ranks
+    mx.random.seed(0)
+    net = mx.gluon.nn.Dense(2, in_units=3)
+    net.initialize()
+    tr = mx.gluon.Trainer(net.collect_params(), "sgd",
+                          {"learning_rate": 0.1}, kvstore="ici")
+    loss_fn = mx.gluon.loss.L2Loss()
+    rng = onp.random.RandomState(100 + rank)
+    for _ in range(3):
+        x = mx.np.array(rng.uniform(-1, 1, (2, 3)).astype("float32"))
+        y = mx.np.array(rng.uniform(-1, 1, (2, 2)).astype("float32"))
+        with mx.autograd.record():
+            loss = loss_fn(net(x), y)
+        loss.backward()
+        tr.step(2 * nw)
+    w = net.weight.data().asnumpy().ravel()
+    b = net.bias.data().asnumpy().ravel()
+    with open(os.path.join(out_dir, f"worker{rank}.txt"), "w") as f:
+        f.write(" ".join(f"{v:.8f}" for v in pulled.ravel()) + "\n")
+        f.write(" ".join(f"{v:.8f}" for v in list(w) + list(b)) + "\n")
+
+
 def main() -> None:
     out_dir = sys.argv[1]
+    if len(sys.argv) > 2 and sys.argv[2] == "kvstore":
+        kvstore_main(out_dir)
+        return
     import mxnet_tpu as mx
     from mxnet_tpu import kvstore as kvs
     kvs._maybe_init_distributed()   # reads the launcher's env contract

@@ -50,6 +50,32 @@ def test_two_process_spmd_training(tmp_path):
     assert losses[2] < losses[0]        # it actually trains
 
 
+def test_two_process_kvstore_contract(tmp_path):
+    """Reference dist_sync invariant without SPMDTrainer: pushed
+    per-process gradients come back summed over workers, and a plain
+    gluon.Trainer(kvstore='ici') trains bit-identically across ranks
+    (tests/nightly/dist_sync_kvstore.py analog)."""
+    env = dict(os.environ)
+    env.pop("XLA_FLAGS", None)
+    env["JAX_PLATFORMS"] = "cpu"
+    env["PYTHONPATH"] = REPO
+    for attempt in range(2):
+        cmd = [sys.executable, os.path.join(REPO, "tools", "launch.py"),
+               "-n", "2", "--port", str(_free_port()),
+               sys.executable,
+               os.path.join(REPO, "tests", "dist_worker.py"),
+               str(tmp_path), "kvstore"]
+        proc = subprocess.run(cmd, env=env, capture_output=True,
+                              text=True, timeout=280)
+        if proc.returncode == 0 or attempt == 1:
+            break
+    assert proc.returncode == 0, (proc.stdout[-2000:], proc.stderr[-2000:])
+    r0 = (tmp_path / "worker0.txt").read_text().splitlines()
+    r1 = (tmp_path / "worker1.txt").read_text().splitlines()
+    assert r0[0] == r1[0]   # pulled values identical (and = sum of pushes)
+    assert r0[1] == r1[1]   # params bit-identical after kvstore training
+
+
 def test_two_process_two_devices_each(tmp_path):
     """dp=4 over 2 processes x 2 local devices: each worker's local
     batch is its shard of the global batch, split over its own 2

@@ -230,3 +230,68 @@ def test_moe_ep_sharded_matches_replicated():
             w1 = moe.expert_w1.data()._data
             assert len(w1.devices()) == 8
     assert abs(outs[0] - outs[1]) < 1e-4, outs
+
+
+# ---------------------------------------------------------------------------
+# Real-model pipeline parallelism: GPT blocks as stages (VERDICT r1 item 7)
+# ---------------------------------------------------------------------------
+
+def _make_pipe_and_ref(n_micro=4):
+    from mxnet_tpu.parallel.pipeline import GPTPipe
+    from mxnet_tpu.gluon.model_zoo.gpt import GPTModel
+    mesh = make_mesh({"pp": 4}, devices=jax.devices()[:4])
+    mx.random.seed(0)
+    pipe = GPTPipe(mesh, vocab_size=128, num_layers=4, units=32,
+                   hidden_size=64, num_heads=2, max_length=32,
+                   num_microbatches=n_micro)
+    pipe.initialize()
+    toks = onp.random.RandomState(0).randint(0, 128, (8, 16)).astype("int32")
+    pipe(mx.np.array(toks))
+    mx.random.seed(1)
+    ref = GPTModel(vocab_size=128, num_layers=4, units=32,
+                   hidden_size=64, num_heads=2, max_length=32, dropout=0.0)
+    ref.initialize()
+    ref(mx.np.array(toks))
+    pipe.load_block_weights(ref)
+    cp = lambda p: mx.np.array(p.data().asnumpy())  # noqa: E731
+    pipe.word_embed.weight.set_data(cp(ref.word_embed.weight))
+    pipe.position_weight.set_data(cp(ref.position_weight))
+    pipe.ln_f.gamma.set_data(cp(ref.ln_f.gamma))
+    pipe.ln_f.beta.set_data(cp(ref.ln_f.beta))
+    return pipe, ref, toks
+
+
+def test_gpt_pipeline_logit_parity():
+    """GPTPipe (4 stages x 4 microbatches over a pp mesh) must produce the
+    sequential GPTModel's logits exactly (same weights, same math)."""
+    pipe, ref, toks = _make_pipe_and_ref()
+    o_pipe = pipe(mx.np.array(toks)).asnumpy()
+    o_ref = ref(mx.np.array(toks)).asnumpy()
+    assert float(onp.abs(o_pipe - o_ref).max()) < 1e-4
+
+
+def test_gpt_pipeline_trains_with_spmdtrainer():
+    """A REAL model (GPT blocks) trains through pipeline_apply under
+    SPMDTrainer with >= 4 microbatches, loss-parity vs the non-pp run."""
+    from jax.sharding import PartitionSpec as P
+    from mxnet_tpu.parallel.pipeline import PIPELINE_RULES
+    pipe, ref, toks = _make_pipe_and_ref()
+    labels = onp.random.RandomState(1).randint(0, 128, (8, 16)) \
+        .astype("int32")
+    lf = mx.gluon.loss.SoftmaxCrossEntropyLoss(axis=-1)
+    tr_pipe = SPMDTrainer(pipe, lambda o, l: lf(o, l), optimizer="sgd",
+                          optimizer_params={"learning_rate": 0.1},
+                          mesh=pipe._mesh, rules=PIPELINE_RULES,
+                          data_spec=P(), label_spec=P())
+    mesh1 = make_mesh({"dp": 1}, devices=jax.devices()[:1])
+    tr_ref = SPMDTrainer(ref, lambda o, l: lf(o, l), optimizer="sgd",
+                         optimizer_params={"learning_rate": 0.1},
+                         mesh=mesh1, rules=DATA_PARALLEL_RULES)
+    lp, lr = [], []
+    for _ in range(3):
+        lp.append(float(tr_pipe.step(mx.np.array(toks),
+                                     mx.np.array(labels)).asnumpy()))
+        lr.append(float(tr_ref.step(mx.np.array(toks),
+                                    mx.np.array(labels)).asnumpy()))
+    assert onp.allclose(lp, lr, rtol=2e-3, atol=2e-4), (lp, lr)
+    assert lp[-1] < lp[0]
