@@ -239,13 +239,17 @@ class KVStoreICI(KVStore):
     def _allreduce(self, v: NDArray) -> NDArray:
         data = v._data
         try:
-            multi_device = len(data.devices()) > 1
+            # only a NON-fully-addressable array is a true global SPMD
+            # array whose reduction already happened inside the compiled
+            # step (summing again would multiply by N). A multi-device
+            # but fully-addressable array is just this process's local
+            # mesh replica (e.g. params mesh-placed by SPMDTrainer, then
+            # trained through plain gluon.Trainer) — its gradient still
+            # needs the cross-process sum.
+            if len(data.devices()) > 1 and not data.is_fully_addressable:
+                return v
         except Exception:
-            multi_device = False
-        if multi_device:
-            # a mesh-placed global array: the SPMD step already reduced it
-            # (summing again would multiply by N)
-            return v
+            pass
         if jax.process_count() == 1:
             return v
         # Per-process contribution: gather every process's value over DCN/

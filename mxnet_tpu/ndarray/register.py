@@ -57,8 +57,20 @@ _mesh_state = {"active": False}
 # (op name, closure token, recording) -> jitted callable. jax.jit handles
 # the per-shape/dtype executable keying internally; the closure token keys
 # the op's attributes (closure cell values), so behaviorally-equal closures
-# share one traced wrapper.
-_EXEC_CACHE: Dict[Any, Callable] = {}
+# share one traced wrapper. LRU-bounded: evicting a wrapper releases its
+# compiled executables.
+from collections import OrderedDict  # noqa: E402
+
+_EXEC_CACHE: "OrderedDict[Any, Callable]" = OrderedDict()
+_EXEC_CACHE_CAP = 1024
+
+# Ops whose attrs churn (e.g. an annealed python scalar bound into the
+# closure every step) would otherwise pay a fresh trace+compile per call;
+# after _CHURN_LIMIT distinct attr tokens for one (op, code) we stop
+# caching that op and dispatch it eagerly.
+_CHURN_COUNT: Dict[Any, int] = {}
+_CHURN_EAGER: set = set()
+_CHURN_LIMIT = 16
 
 # MXNET_IMPERATIVE_EXEC_CACHE: "auto" (cache when an input lives on an
 # accelerator device), "1" (always — also on CPU; used by tests), "0" (off)
@@ -163,13 +175,28 @@ def _cached_exec(name: str, impl: Callable, arrays, record: bool):
     """Try the per-op executable cache; returns the raw result or None
     when the op must take the eager path."""
     try:
-        key = (name, _closure_token(impl), record)
+        token = _closure_token(impl)
     except _UnhashableAttr:
         return None  # attrs hold arrays/objects (e.g. PRNG keys)
+    churn_key = (name, token[0] if isinstance(token, tuple) else token)
+    if churn_key in _CHURN_EAGER:
+        return None
+    key = (name, token, record)
     fn = _EXEC_CACHE.get(key)
+    if fn is not None:
+        _EXEC_CACHE.move_to_end(key)
+        # a hit means attrs repeat — not the per-call-varying pattern the
+        # churn guard targets
+        _CHURN_COUNT.pop(churn_key, None)
     if fn is _EAGER_ONLY:
         return None
     if fn is None:
+        n = _CHURN_COUNT[churn_key] = _CHURN_COUNT.get(churn_key, 0) + 1
+        if n > _CHURN_LIMIT:
+            # attrs vary call-to-call (e.g. annealed scalars): caching
+            # would trace+compile every step — stay eager from now on
+            _CHURN_EAGER.add(churn_key)
+            return None
         if record:
             # jax.vjp's pullback is a tree_util.Partial: its residuals
             # come back as device buffers and the pullback itself stays
@@ -178,6 +205,8 @@ def _cached_exec(name: str, impl: Callable, arrays, record: bool):
         else:
             fn = jax.jit(impl)
         _EXEC_CACHE[key] = fn
+        if len(_EXEC_CACHE) > _EXEC_CACHE_CAP:
+            _EXEC_CACHE.popitem(last=False)
     try:
         return fn(*arrays)
     except jax.errors.JAXTypeError:

@@ -162,3 +162,29 @@ def test_trace_failure_poisons_to_eager(exec_cache):
     poisoned = [v for k, v in reg._EXEC_CACHE.items()
                 if k[0] == "fake_concrete_op"]
     assert poisoned and all(v is reg._EAGER_ONLY for v in poisoned)
+
+
+def test_churning_attrs_fall_back_to_eager(exec_cache):
+    """A per-call-varying closure attr (annealed scalar) must not compile
+    a fresh executable forever — after the churn limit the op goes eager."""
+    x = mx.np.array(onp.ones((2, 2), "float32"))
+    n0 = len(reg._EXEC_CACHE)
+    for i in range(reg._CHURN_LIMIT + 5):
+        y = x * (1.0 + i * 0.001)
+    assert reg._CHURN_EAGER, "churn guard never engaged"
+    # after poisoning, no further cache entries accumulate for this op
+    assert len(reg._EXEC_CACHE) - n0 <= reg._CHURN_LIMIT
+    # still correct after the fallback
+    assert onp.allclose(y.asnumpy(),
+                        onp.ones((2, 2)) * (1.0 + (reg._CHURN_LIMIT + 4)
+                                            * 0.001))
+
+
+def test_repeated_attr_variants_stay_cached(exec_cache):
+    """Ops legitimately used with many REUSED attr variants (axis=0/1,
+    different shapes) must not be poisoned by the churn guard."""
+    x = mx.np.array(onp.ones((4, 4), "float32"))
+    for _ in range(3):
+        for ax in (0, 1, None):
+            s = mx.np.sum(x, axis=ax)
+    assert not any(k[0] == "sum" for k in reg._CHURN_EAGER)
