@@ -260,7 +260,10 @@ class KVStoreICI(KVStore):
         gathered = multihost_utils.process_allgather(jnp.asarray(data))
         reduced = jnp.asarray(gathered).sum(axis=0).astype(data.dtype)
         out = NDArray(reduced, ctx=v.context)
-        out._data = jax.device_put(out._data, next(iter(data.devices())))
+        # preserve the input's placement: a local-mesh-replicated gradient
+        # must come back with the same sharding so the following optimizer
+        # op doesn't mix devices; single-device inputs round-trip unchanged
+        out._data = jax.device_put(out._data, data.sharding)
         return out
 
     @property

@@ -206,7 +206,15 @@ def _cached_exec(name: str, impl: Callable, arrays, record: bool):
             fn = jax.jit(impl)
         _EXEC_CACHE[key] = fn
         if len(_EXEC_CACHE) > _EXEC_CACHE_CAP:
-            _EXEC_CACHE.popitem(last=False)
+            # evict the oldest NON-poison entry: an evicted _EAGER_ONLY
+            # marker would make a known-unjittable op re-attempt (and
+            # re-fail) its trace
+            for k in _EXEC_CACHE:
+                if _EXEC_CACHE[k] is not _EAGER_ONLY:
+                    del _EXEC_CACHE[k]
+                    break
+            else:
+                _EXEC_CACHE.popitem(last=False)
     try:
         return fn(*arrays)
     except jax.errors.JAXTypeError:

@@ -28,7 +28,8 @@ const char *MXLibInfoFeatures(void) {
   /* comma-separated feature names; the Python side pairs this with
    * jax-derived features (TPU, etc.) in mxnet_tpu.runtime */
   return "NATIVE_ENGINE,NATIVE_STORAGE_POOL,NATIVE_RECORDIO,"
-         "NATIVE_PREFETCHER,CHROME_TRACE_PROFILER";
+         "NATIVE_PREFETCHER,CHROME_TRACE_PROFILER,NATIVE_NDARRAY,"
+         "PARAMS_IO";
 }
 
 }  // extern "C"

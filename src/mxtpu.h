@@ -109,6 +109,53 @@ int MXPrefetcherNext(PrefetcherHandle h, const char **data, uint64_t *sizes,
 int MXPrefetcherReset(PrefetcherHandle h);
 int MXPrefetcherFree(PrefetcherHandle h);
 
+/* ---- NDArray C surface (c_api_ndarray.cc analog) ----
+ * Host tensors over the pooled allocator with engine-scheduled native
+ * ops: the deployment/runtime half of the ABI.  The accelerator op set
+ * (445 ops) lives behind the Python/XLA path by design (SURVEY.md L4
+ * stance: ONE clean C API + Python frontend); the ops listed here are
+ * the native-runtime kernels executable without a Python interpreter. */
+typedef void *NDArrayHandle;
+
+/* dtypes: 0=float32 1=float64 3=uint8 4=int32 6=int64 12=bfloat16
+ * (reference mshadow type codes). */
+int MXNDArrayCreate(const int64_t *shape, int ndim, int dtype,
+                    NDArrayHandle *out);
+int MXNDArrayFree(NDArrayHandle h);
+int MXNDArrayGetShape(NDArrayHandle h, int *out_ndim,
+                      const int64_t **out_shape);
+int MXNDArrayGetDType(NDArrayHandle h, int *out_dtype);
+int MXNDArraySize(NDArrayHandle h, uint64_t *out_size);
+/* Blocks until pending engine ops writing this array finish. */
+int MXNDArrayWaitToRead(NDArrayHandle h);
+int MXNDArrayWaitAll(void);
+/* Raw data pointer (host); call MXNDArrayWaitToRead first. */
+int MXNDArrayGetData(NDArrayHandle h, void **out);
+int MXNDArraySyncCopyFromCPU(NDArrayHandle h, const void *data,
+                             uint64_t nbytes);
+int MXNDArraySyncCopyToCPU(NDArrayHandle h, void *data, uint64_t nbytes);
+
+/* Invoke a registered native op asynchronously through the dependency
+ * engine (Imperative::Invoke -> PushFCompute analog).  Outputs must be
+ * pre-created with the correct shape/dtype.  Same-shape elementwise:
+ * add, sub, mul, div, relu, exp; matrix: dot (2-D f32); reduction:
+ * sum (scalar out); copy. */
+int MXImperativeInvoke(const char *op_name,
+                       NDArrayHandle *inputs, int n_in,
+                       NDArrayHandle *outputs, int n_out);
+/* Native-runtime op names; pointers are static storage. */
+int MXListAllOpNames(int *out_n, const char ***out_names);
+
+/* ---- .params serialization (NDArray::Save/Load analog) ----
+ * Byte-compatible with mxnet_tpu/ndarray_io.py (MXTPU001 container). */
+int MXNDArraySave(const char *fname, int num, NDArrayHandle *handles,
+                  const char **names);
+/* Caller frees handles with MXNDArrayFree and the arrays with
+ * MXNDArrayLoadFree. */
+int MXNDArrayLoad(const char *fname, int *out_num,
+                  NDArrayHandle **out_handles, char ***out_names);
+int MXNDArrayLoadFree(int num, NDArrayHandle *handles, char **names);
+
 /* ---- runtime feature introspection (libinfo.cc analog) ---- */
 const char *MXLibInfoFeatures(void);
 

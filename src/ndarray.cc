@@ -1,0 +1,539 @@
+/*
+ * ndarray.cc — NDArray C surface of the native runtime.
+ *
+ * Reference parity (leezu/mxnet): src/c_api/c_api_ndarray.cc +
+ * src/ndarray/ndarray.cc (handle-based tensors, Imperative::Invoke ->
+ * PushFCompute through the dependency engine, NDArray::Save/Load).
+ *
+ * Host tensors over the pooled storage manager; ops execute as closures
+ * pushed to the shared dependency engine with read/write var discipline,
+ * so the C surface exhibits the same async semantics as the reference
+ * (create returns immediately, WaitToRead is the sync point).  The
+ * accelerator op set stays behind the Python/XLA path by design; these
+ * are the native kernels runnable without a Python interpreter.
+ * Serialization is byte-compatible with mxnet_tpu/ndarray_io.py
+ * (MXTPU001 container).
+ */
+#include <algorithm>
+#include <atomic>
+#include <cmath>
+#include <cstdint>
+#include <cstdio>
+#include <cstring>
+#include <functional>
+#include <map>
+#include <memory>
+#include <mutex>
+#include <stdexcept>
+#include <string>
+#include <vector>
+
+#include "./mxtpu.h"
+
+namespace mxtpu {
+void SetLastError(const std::string &msg);
+namespace nd {
+
+struct DTypeInfo {
+  const char *np_str;  /* numpy dtype tag used by ndarray_io.py */
+  size_t size;
+};
+
+/* reference mshadow type codes */
+static const std::map<int, DTypeInfo> kDTypes = {
+    {0, {"<f4", 4}}, {1, {"<f8", 8}}, {3, {"|u1", 1}},
+    {4, {"<i4", 4}}, {6, {"<i8", 8}}, {12, {"bfloat16", 2}},
+};
+
+static int DTypeFromString(const std::string &s) {
+  for (const auto &kv : kDTypes) {
+    if (s == kv.second.np_str) return kv.first;
+  }
+  /* ndarray_io also writes e.g. "float32" style? no — numpy .str tags or
+   * "bfloat16"; reject anything else */
+  throw std::runtime_error("unsupported dtype tag '" + s + "'");
+}
+
+struct Array {
+  std::vector<int64_t> shape;
+  int dtype;
+  void *data;          /* pooled host buffer */
+  size_t nbytes;
+  EngineVarHandle var; /* engine dependency var */
+};
+
+/* one shared engine + lock for the op path */
+static EngineHandle g_engine = nullptr;
+static std::mutex g_mu;
+
+static EngineHandle Eng() {
+  std::lock_guard<std::mutex> lk(g_mu);
+  if (g_engine == nullptr) {
+    if (MXEngineCreate(0, 0, &g_engine) != 0)
+      throw std::runtime_error("engine creation failed");
+  }
+  return g_engine;
+}
+
+static Array *Cast(NDArrayHandle h) {
+  if (h == nullptr) throw std::runtime_error("null NDArrayHandle");
+  return static_cast<Array *>(h);
+}
+
+static uint64_t NumElems(const Array *a) {
+  uint64_t n = 1;
+  for (int64_t s : a->shape) n *= static_cast<uint64_t>(s);
+  return n;
+}
+
+static Array *NewArray(const int64_t *shape, int ndim, int dtype) {
+  auto it = kDTypes.find(dtype);
+  if (it == kDTypes.end())
+    throw std::runtime_error("unsupported dtype code " +
+                             std::to_string(dtype));
+  auto *a = new Array();
+  a->shape.assign(shape, shape + ndim);
+  a->dtype = dtype;
+  uint64_t n = 1;
+  for (int i = 0; i < ndim; ++i) {
+    if (shape[i] < 0) throw std::runtime_error("negative dim");
+    n *= static_cast<uint64_t>(shape[i]);
+  }
+  a->nbytes = n * it->second.size;
+  if (MXStorageAlloc(a->nbytes ? a->nbytes : 1, &a->data) != 0)
+    throw std::runtime_error("storage alloc failed");
+  if (MXEngineNewVar(Eng(), &a->var) != 0)
+    throw std::runtime_error("engine var failed");
+  return a;
+}
+
+static void FreeArray(Array *a) {
+  /* var free waits for pending ops touching the array */
+  MXEngineFreeVar(g_engine ? g_engine : Eng(), a->var);
+  MXStorageFree(a->data);
+  delete a;
+}
+
+/* ---- native op kernels ------------------------------------------------ */
+
+using OpFn = std::function<void(const std::vector<Array *> &,
+                                const std::vector<Array *> &)>;
+/* Shape/dtype validation runs SYNCHRONOUSLY in MXImperativeInvoke before
+ * the push — an exception on an engine worker thread would terminate the
+ * process, never reach MXGetLastError.  Kernels assume validated args. */
+using Validator = std::function<void(const std::vector<Array *> &,
+                                     const std::vector<Array *> &)>;
+
+static void CheckSameShape(const std::vector<Array *> &in,
+                           const std::vector<Array *> &out) {
+  for (const Array *a : in)
+    if (a->shape != in[0]->shape)
+      throw std::runtime_error("elementwise op: shape mismatch");
+  if (out[0]->shape != in[0]->shape)
+    throw std::runtime_error("elementwise op: output shape mismatch");
+  for (const Array *a : in)
+    if (a->dtype != 0)
+      throw std::runtime_error("native kernels are float32-only");
+  if (out[0]->dtype != 0)
+    throw std::runtime_error("native kernels are float32-only");
+}
+
+template <typename F>
+static OpFn Elemwise2(F f) {
+  return [f](const std::vector<Array *> &in,
+             const std::vector<Array *> &out) {
+    const float *a = static_cast<const float *>(in[0]->data);
+    const float *b = static_cast<const float *>(in[1]->data);
+    float *o = static_cast<float *>(out[0]->data);
+    uint64_t n = NumElems(in[0]);
+    for (uint64_t i = 0; i < n; ++i) o[i] = f(a[i], b[i]);
+  };
+}
+
+template <typename F>
+static OpFn Elemwise1(F f) {
+  return [f](const std::vector<Array *> &in,
+             const std::vector<Array *> &out) {
+    const float *a = static_cast<const float *>(in[0]->data);
+    float *o = static_cast<float *>(out[0]->data);
+    uint64_t n = NumElems(in[0]);
+    for (uint64_t i = 0; i < n; ++i) o[i] = f(a[i]);
+  };
+}
+
+static void ValidateDot(const std::vector<Array *> &in,
+                        const std::vector<Array *> &out) {
+  const Array *A = in[0], *B = in[1], *C = out[0];
+  if (A->shape.size() != 2 || B->shape.size() != 2 ||
+      A->shape[1] != B->shape[0])
+    throw std::runtime_error("dot: need (m,k)x(k,n) 2-D operands");
+  if (C->shape.size() != 2 || C->shape[0] != A->shape[0] ||
+      C->shape[1] != B->shape[1])
+    throw std::runtime_error("dot: bad output shape");
+  if (A->dtype != 0 || B->dtype != 0 || C->dtype != 0)
+    throw std::runtime_error("dot: float32 only");
+}
+
+static void DotOp(const std::vector<Array *> &in,
+                  const std::vector<Array *> &out) {
+  const Array *A = in[0], *B = in[1];
+  Array *C = out[0];
+  int64_t m = A->shape[0], k = A->shape[1], n = B->shape[1];
+  const float *a = static_cast<const float *>(A->data);
+  const float *b = static_cast<const float *>(B->data);
+  float *c = static_cast<float *>(C->data);
+  std::memset(c, 0, sizeof(float) * m * n);
+  for (int64_t i = 0; i < m; ++i)
+    for (int64_t l = 0; l < k; ++l) {
+      float av = a[i * k + l];
+      const float *brow = b + l * n;
+      float *crow = c + i * n;
+      for (int64_t j = 0; j < n; ++j) crow[j] += av * brow[j];
+    }
+}
+
+static void ValidateSum(const std::vector<Array *> &in,
+                        const std::vector<Array *> &out) {
+  if (in[0]->dtype != 0 || out[0]->dtype != 0)
+    throw std::runtime_error("sum: float32 only");
+  if (NumElems(out[0]) != 1)
+    throw std::runtime_error("sum: scalar output expected");
+}
+
+static void SumOp(const std::vector<Array *> &in,
+                  const std::vector<Array *> &out) {
+  const float *a = static_cast<const float *>(in[0]->data);
+  double acc = 0.0;
+  uint64_t n = NumElems(in[0]);
+  for (uint64_t i = 0; i < n; ++i) acc += a[i];
+  *static_cast<float *>(out[0]->data) = static_cast<float>(acc);
+}
+
+static void ValidateCopy(const std::vector<Array *> &in,
+                         const std::vector<Array *> &out) {
+  if (in[0]->nbytes != out[0]->nbytes)
+    throw std::runtime_error("copy: size mismatch");
+}
+
+static void CopyOp(const std::vector<Array *> &in,
+                   const std::vector<Array *> &out) {
+  std::memcpy(out[0]->data, in[0]->data, in[0]->nbytes);
+}
+
+struct OpEntry {
+  int n_in, n_out;
+  Validator validate;
+  OpFn fn;
+};
+
+static const std::map<std::string, OpEntry> &Ops() {
+  static const std::map<std::string, OpEntry> ops = {
+      {"add",
+       {2, 1, CheckSameShape,
+        Elemwise2([](float a, float b) { return a + b; })}},
+      {"sub",
+       {2, 1, CheckSameShape,
+        Elemwise2([](float a, float b) { return a - b; })}},
+      {"mul",
+       {2, 1, CheckSameShape,
+        Elemwise2([](float a, float b) { return a * b; })}},
+      {"div",
+       {2, 1, CheckSameShape,
+        Elemwise2([](float a, float b) { return a / b; })}},
+      {"maximum",
+       {2, 1, CheckSameShape,
+        Elemwise2([](float a, float b) { return a > b ? a : b; })}},
+      {"relu",
+       {1, 1, CheckSameShape,
+        Elemwise1([](float a) { return a > 0 ? a : 0.f; })}},
+      {"exp",
+       {1, 1, CheckSameShape,
+        Elemwise1([](float a) { return std::exp(a); })}},
+      {"sqrt",
+       {1, 1, CheckSameShape,
+        Elemwise1([](float a) { return std::sqrt(a); })}},
+      {"negative",
+       {1, 1, CheckSameShape,
+        Elemwise1([](float a) { return -a; })}},
+      {"dot", {2, 1, ValidateDot, DotOp}},
+      {"sum", {1, 1, ValidateSum, SumOp}},
+      {"copy", {1, 1, ValidateCopy, CopyOp}},
+  };
+  return ops;
+}
+
+/* engine closure ctx */
+struct InvokeCtx {
+  OpFn fn;
+  std::vector<Array *> in, out;
+};
+
+static void RunInvoke(void *p) {
+  auto *ctx = static_cast<InvokeCtx *>(p);
+  try {
+    ctx->fn(ctx->in, ctx->out);
+  } catch (...) {
+    /* validation runs synchronously before the push; an exception here
+     * would otherwise std::terminate the worker thread */
+  }
+}
+
+static void DoneInvoke(void *p, int /*cancelled*/) {
+  delete static_cast<InvokeCtx *>(p);
+}
+
+/* ---- .params container (mirror of ndarray_io.py) ---------------------- */
+
+static const char kMagic[8] = {'M', 'X', 'T', 'P', 'U', '0', '0', '1'};
+static const size_t kAlign = 64;
+
+static void WriteAll(FILE *f, const void *p, size_t n) {
+  if (n && std::fwrite(p, 1, n, f) != n)
+    throw std::runtime_error("short write");
+}
+
+static void ReadAll(FILE *f, void *p, size_t n) {
+  if (n && std::fread(p, 1, n, f) != n)
+    throw std::runtime_error("short read / truncated file");
+}
+
+}  // namespace nd
+}  // namespace mxtpu
+
+using mxtpu::SetLastError;
+using namespace mxtpu::nd;  // NOLINT
+
+#define API_BEGIN() try {
+#define API_END()                      \
+  }                                    \
+  catch (const std::exception &e) {    \
+    SetLastError(e.what());            \
+    return -1;                         \
+  }                                    \
+  catch (...) {                        \
+    SetLastError("unknown C++ error"); \
+    return -1;                         \
+  }                                    \
+  return 0;
+
+extern "C" {
+
+int MXNDArrayCreate(const int64_t *shape, int ndim, int dtype,
+                    NDArrayHandle *out) {
+  API_BEGIN();
+  *out = NewArray(shape, ndim, dtype);
+  API_END();
+}
+
+int MXNDArrayFree(NDArrayHandle h) {
+  API_BEGIN();
+  FreeArray(Cast(h));
+  API_END();
+}
+
+int MXNDArrayGetShape(NDArrayHandle h, int *out_ndim,
+                      const int64_t **out_shape) {
+  API_BEGIN();
+  Array *a = Cast(h);
+  *out_ndim = static_cast<int>(a->shape.size());
+  *out_shape = a->shape.data();
+  API_END();
+}
+
+int MXNDArrayGetDType(NDArrayHandle h, int *out_dtype) {
+  API_BEGIN();
+  *out_dtype = Cast(h)->dtype;
+  API_END();
+}
+
+int MXNDArraySize(NDArrayHandle h, uint64_t *out_size) {
+  API_BEGIN();
+  *out_size = NumElems(Cast(h));
+  API_END();
+}
+
+int MXNDArrayWaitToRead(NDArrayHandle h) {
+  API_BEGIN();
+  if (MXEngineWaitForVar(Eng(), Cast(h)->var) != 0)
+    throw std::runtime_error(MXGetLastError());
+  API_END();
+}
+
+int MXNDArrayWaitAll(void) {
+  API_BEGIN();
+  if (MXEngineWaitAll(Eng()) != 0)
+    throw std::runtime_error(MXGetLastError());
+  API_END();
+}
+
+int MXNDArrayGetData(NDArrayHandle h, void **out) {
+  API_BEGIN();
+  *out = Cast(h)->data;
+  API_END();
+}
+
+int MXNDArraySyncCopyFromCPU(NDArrayHandle h, const void *data,
+                             uint64_t nbytes) {
+  API_BEGIN();
+  Array *a = Cast(h);
+  if (nbytes != a->nbytes)
+    throw std::runtime_error("size mismatch in SyncCopyFromCPU");
+  /* writer: wait for readers/writers, then copy on the caller thread */
+  if (MXEngineWaitForVar(Eng(), a->var) != 0)
+    throw std::runtime_error(MXGetLastError());
+  std::memcpy(a->data, data, nbytes);
+  API_END();
+}
+
+int MXNDArraySyncCopyToCPU(NDArrayHandle h, void *data, uint64_t nbytes) {
+  API_BEGIN();
+  Array *a = Cast(h);
+  if (nbytes != a->nbytes)
+    throw std::runtime_error("size mismatch in SyncCopyToCPU");
+  if (MXEngineWaitForVar(Eng(), a->var) != 0)
+    throw std::runtime_error(MXGetLastError());
+  std::memcpy(data, a->data, nbytes);
+  API_END();
+}
+
+int MXImperativeInvoke(const char *op_name, NDArrayHandle *inputs, int n_in,
+                       NDArrayHandle *outputs, int n_out) {
+  API_BEGIN();
+  const auto &ops = Ops();
+  auto it = ops.find(op_name ? op_name : "");
+  if (it == ops.end())
+    throw std::runtime_error(std::string("unknown native op '") +
+                             (op_name ? op_name : "<null>") + "'");
+  if (n_in != it->second.n_in || n_out != it->second.n_out)
+    throw std::runtime_error("op arity mismatch");
+  {
+    /* synchronous shape/dtype validation — errors must surface through
+     * the MXGetLastError trampoline, not an engine worker thread */
+    std::vector<Array *> vin, vout;
+    for (int i = 0; i < n_in; ++i) vin.push_back(Cast(inputs[i]));
+    for (int i = 0; i < n_out; ++i) vout.push_back(Cast(outputs[i]));
+    it->second.validate(vin, vout);
+  }
+  auto *ctx = new InvokeCtx();
+  ctx->fn = it->second.fn;
+  std::vector<EngineVarHandle> rvars, wvars;
+  for (int i = 0; i < n_in; ++i) {
+    ctx->in.push_back(Cast(inputs[i]));
+    rvars.push_back(ctx->in.back()->var);
+  }
+  for (int i = 0; i < n_out; ++i) {
+    ctx->out.push_back(Cast(outputs[i]));
+    wvars.push_back(ctx->out.back()->var);
+  }
+  if (MXEnginePushAsync(Eng(), RunInvoke, ctx, DoneInvoke, rvars.data(),
+                        n_in, wvars.data(), n_out, 0, op_name) != 0) {
+    delete ctx;
+    throw std::runtime_error(MXGetLastError());
+  }
+  API_END();
+}
+
+int MXListAllOpNames(int *out_n, const char ***out_names) {
+  API_BEGIN();
+  static std::vector<const char *> names;
+  if (names.empty())
+    for (const auto &kv : Ops()) names.push_back(kv.first.c_str());
+  *out_n = static_cast<int>(names.size());
+  *out_names = names.data();
+  API_END();
+}
+
+int MXNDArraySave(const char *fname, int num, NDArrayHandle *handles,
+                  const char **names) {
+  API_BEGIN();
+  std::unique_ptr<FILE, int (*)(FILE *)> f(std::fopen(fname, "wb"),
+                                           std::fclose);
+  if (!f) throw std::runtime_error(std::string("cannot open ") + fname);
+  WriteAll(f.get(), kMagic, 8);
+  uint64_t cnt = static_cast<uint64_t>(num);
+  WriteAll(f.get(), &cnt, 8);
+  for (int i = 0; i < num; ++i) {
+    Array *a = Cast(handles[i]);
+    if (MXEngineWaitForVar(Eng(), a->var) != 0)
+      throw std::runtime_error(MXGetLastError());
+    const std::string name = names[i];
+    const std::string dt = kDTypes.at(a->dtype).np_str;
+    uint32_t nl = static_cast<uint32_t>(name.size());
+    uint32_t dl = static_cast<uint32_t>(dt.size());
+    WriteAll(f.get(), &nl, 4);
+    WriteAll(f.get(), name.data(), nl);
+    WriteAll(f.get(), &dl, 4);
+    WriteAll(f.get(), dt.data(), dl);
+    uint32_t nd = static_cast<uint32_t>(a->shape.size());
+    WriteAll(f.get(), &nd, 4);
+    for (int64_t s : a->shape) WriteAll(f.get(), &s, 8);
+    long pos = std::ftell(f.get());
+    size_t pad = (kAlign - static_cast<size_t>(pos) % kAlign) % kAlign;
+    static const char zeros[kAlign] = {0};
+    WriteAll(f.get(), zeros, pad);
+    WriteAll(f.get(), a->data, a->nbytes);
+  }
+  API_END();
+}
+
+int MXNDArrayLoad(const char *fname, int *out_num,
+                  NDArrayHandle **out_handles, char ***out_names) {
+  API_BEGIN();
+  std::unique_ptr<FILE, int (*)(FILE *)> f(std::fopen(fname, "rb"),
+                                           std::fclose);
+  if (!f) throw std::runtime_error(std::string("cannot open ") + fname);
+  char magic[8];
+  ReadAll(f.get(), magic, 8);
+  if (std::memcmp(magic, kMagic, 8) != 0)
+    throw std::runtime_error("bad magic: not an MXTPU001 .params file");
+  uint64_t cnt = 0;
+  ReadAll(f.get(), &cnt, 8);
+  std::vector<NDArrayHandle> handles;
+  std::vector<char *> names;
+  try {
+    for (uint64_t i = 0; i < cnt; ++i) {
+      uint32_t nl = 0, dl = 0, nd = 0;
+      ReadAll(f.get(), &nl, 4);
+      std::string name(nl, '\0');
+      ReadAll(f.get(), name.data(), nl);
+      ReadAll(f.get(), &dl, 4);
+      std::string dt(dl, '\0');
+      ReadAll(f.get(), dt.data(), dl);
+      ReadAll(f.get(), &nd, 4);
+      std::vector<int64_t> shape(nd);
+      for (uint32_t d = 0; d < nd; ++d) ReadAll(f.get(), &shape[d], 8);
+      long pos = std::ftell(f.get());
+      size_t pad = (kAlign - static_cast<size_t>(pos) % kAlign) % kAlign;
+      if (pad) std::fseek(f.get(), static_cast<long>(pad), SEEK_CUR);
+      Array *a = NewArray(shape.data(), static_cast<int>(nd),
+                          DTypeFromString(dt));
+      handles.push_back(a);
+      ReadAll(f.get(), a->data, a->nbytes);
+      char *nm = static_cast<char *>(std::malloc(nl + 1));
+      std::memcpy(nm, name.data(), nl);
+      nm[nl] = '\0';
+      names.push_back(nm);
+    }
+  } catch (...) {
+    for (NDArrayHandle h : handles) FreeArray(Cast(h));
+    for (char *nm : names) std::free(nm);
+    throw;
+  }
+  *out_num = static_cast<int>(cnt);
+  *out_handles =
+      static_cast<NDArrayHandle *>(std::malloc(sizeof(void *) * cnt));
+  *out_names = static_cast<char **>(std::malloc(sizeof(char *) * cnt));
+  std::copy(handles.begin(), handles.end(), *out_handles);
+  std::copy(names.begin(), names.end(), *out_names);
+  API_END();
+}
+
+int MXNDArrayLoadFree(int num, NDArrayHandle *handles, char **names) {
+  API_BEGIN();
+  for (int i = 0; i < num; ++i) std::free(names[i]);
+  std::free(handles);
+  std::free(names);
+  API_END();
+}
+
+}  // extern "C"

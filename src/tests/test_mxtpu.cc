@@ -203,6 +203,94 @@ static void test_error_message() {
   std::puts("error_message OK");
 }
 
+/* ---- NDArray C surface (c_api_ndarray.cc analog) ---- */
+
+static void test_ndarray_create_invoke() {
+  int64_t shape[2] = {2, 3};
+  NDArrayHandle a, b, c, d;
+  CHECK(MXNDArrayCreate(shape, 2, 0, &a) == 0);
+  CHECK(MXNDArrayCreate(shape, 2, 0, &b) == 0);
+  CHECK(MXNDArrayCreate(shape, 2, 0, &c) == 0);
+  float av[6] = {1, 2, 3, 4, 5, 6};
+  float bv[6] = {10, 20, 30, 40, 50, 60};
+  CHECK(MXNDArraySyncCopyFromCPU(a, av, sizeof(av)) == 0);
+  CHECK(MXNDArraySyncCopyFromCPU(b, bv, sizeof(bv)) == 0);
+  /* chained async ops through the engine: c = a + b; c = c * a */
+  NDArrayHandle in1[2] = {a, b};
+  CHECK(MXImperativeInvoke("add", in1, 2, &c, 1) == 0);
+  NDArrayHandle in2[2] = {c, a};
+  CHECK(MXNDArrayCreate(shape, 2, 0, &d) == 0);
+  CHECK(MXImperativeInvoke("mul", in2, 2, &d, 1) == 0);
+  float out[6];
+  CHECK(MXNDArraySyncCopyToCPU(d, out, sizeof(out)) == 0);
+  for (int i = 0; i < 6; ++i)
+    CHECK(out[i] == (av[i] + bv[i]) * av[i]);
+  /* dot: (2,3)x(3,2) */
+  int64_t sb[2] = {3, 2}, sc[2] = {2, 2};
+  NDArrayHandle m, r;
+  CHECK(MXNDArrayCreate(sb, 2, 0, &m) == 0);
+  float mv[6] = {1, 0, 0, 1, 1, 1};
+  CHECK(MXNDArraySyncCopyFromCPU(m, mv, sizeof(mv)) == 0);
+  CHECK(MXNDArrayCreate(sc, 2, 0, &r) == 0);
+  NDArrayHandle in3[2] = {a, m};
+  CHECK(MXImperativeInvoke("dot", in3, 2, &r, 1) == 0);
+  float rv[4];
+  CHECK(MXNDArraySyncCopyToCPU(r, rv, sizeof(rv)) == 0);
+  /* [[1,2,3],[4,5,6]] @ [[1,0],[0,1],[1,1]] = [[4,5],[10,11]] */
+  CHECK(rv[0] == 4 && rv[1] == 5 && rv[2] == 10 && rv[3] == 11);
+  /* arity + unknown-op errors surface through MXGetLastError */
+  CHECK(MXImperativeInvoke("nonsense_op", in1, 2, &c, 1) != 0);
+  CHECK(std::strlen(MXGetLastError()) > 0);
+  int n_ops = 0;
+  const char **names;
+  CHECK(MXListAllOpNames(&n_ops, &names) == 0);
+  CHECK(n_ops >= 10);
+  for (NDArrayHandle h : {a, b, c, d, m, r}) CHECK(MXNDArrayFree(h) == 0);
+  std::puts("ndarray_create_invoke OK");
+}
+
+static void test_ndarray_params_roundtrip() {
+  const char *path = "/tmp/mxtpu_capi_test.params";
+  int64_t s1[2] = {2, 2};
+  int64_t s2[1] = {3};
+  NDArrayHandle a, b;
+  CHECK(MXNDArrayCreate(s1, 2, 0, &a) == 0);
+  CHECK(MXNDArrayCreate(s2, 1, 4, &b) == 0);
+  float av[4] = {1.5f, -2.5f, 3.0f, 0.25f};
+  int32_t bv[3] = {7, -8, 9};
+  CHECK(MXNDArraySyncCopyFromCPU(a, av, sizeof(av)) == 0);
+  CHECK(MXNDArraySyncCopyFromCPU(b, bv, sizeof(bv)) == 0);
+  NDArrayHandle hs[2] = {a, b};
+  const char *nm[2] = {"weight", "steps"};
+  CHECK(MXNDArraySave(path, 2, hs, nm) == 0);
+  int n = 0;
+  NDArrayHandle *lh;
+  char **ln;
+  CHECK(MXNDArrayLoad(path, &n, &lh, &ln) == 0);
+  CHECK(n == 2);
+  CHECK(std::strcmp(ln[0], "weight") == 0);
+  CHECK(std::strcmp(ln[1], "steps") == 0);
+  int nd;
+  const int64_t *sh;
+  CHECK(MXNDArrayGetShape(lh[0], &nd, &sh) == 0);
+  CHECK(nd == 2 && sh[0] == 2 && sh[1] == 2);
+  int dt;
+  CHECK(MXNDArrayGetDType(lh[1], &dt) == 0);
+  CHECK(dt == 4);
+  float ra[4];
+  CHECK(MXNDArraySyncCopyToCPU(lh[0], ra, sizeof(ra)) == 0);
+  for (int i = 0; i < 4; ++i) CHECK(ra[i] == av[i]);
+  int32_t rb[3];
+  CHECK(MXNDArraySyncCopyToCPU(lh[1], rb, sizeof(rb)) == 0);
+  for (int i = 0; i < 3; ++i) CHECK(rb[i] == bv[i]);
+  for (int i = 0; i < n; ++i) CHECK(MXNDArrayFree(lh[i]) == 0);
+  CHECK(MXNDArrayLoadFree(n, lh, ln) == 0);
+  CHECK(MXNDArrayFree(a) == 0);
+  CHECK(MXNDArrayFree(b) == 0);
+  std::remove(path);
+  std::puts("ndarray_params_roundtrip OK");
+}
+
 int main() {
   test_engine_dag_matches_serial();
   test_engine_writer_serialization();
@@ -210,6 +298,8 @@ int main() {
   test_storage_pool_reuse();
   test_recordio_roundtrip();
   test_error_message();
+  test_ndarray_create_invoke();
+  test_ndarray_params_roundtrip();
   std::puts("ALL C++ TESTS PASSED");
   return 0;
 }

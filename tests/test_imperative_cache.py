@@ -17,11 +17,21 @@ from mxnet_tpu.ndarray import register as reg
 
 @pytest.fixture
 def exec_cache():
-    """Force the executable cache on, restore after."""
+    """Force the executable cache on; snapshot + restore ALL cache state
+    so churn poisoning in one test can't leak into another."""
     prev = reg._exec_mode["value"]
     reg._exec_mode["value"] = "1"
+    saved_cache = dict(reg._EXEC_CACHE)
+    saved_count = dict(reg._CHURN_COUNT)
+    saved_eager = set(reg._CHURN_EAGER)
     yield
     reg._exec_mode["value"] = prev
+    reg._EXEC_CACHE.clear()
+    reg._EXEC_CACHE.update(saved_cache)
+    reg._CHURN_COUNT.clear()
+    reg._CHURN_COUNT.update(saved_count)
+    reg._CHURN_EAGER.clear()
+    reg._CHURN_EAGER.update(saved_eager)
 
 
 def test_cache_hits_and_matches_eager(exec_cache):

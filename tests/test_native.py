@@ -302,3 +302,89 @@ def test_cpp_unit_suite():
                        capture_output=True, text=True, timeout=300)
     assert r.returncode == 0, r.stdout + r.stderr
     assert "ALL C++ TESTS PASSED" in r.stdout
+
+
+def test_capi_ndarray_params_python_interop(tmp_path):
+    """The C API's MXNDArraySave/Load must be byte-compatible with
+    mxnet_tpu/ndarray_io.py (the reference NDArray::Save/Load contract,
+    SURVEY.md 2.1 C API row)."""
+    import ctypes
+    import numpy as onp
+    from mxnet_tpu import ndarray_io
+    from mxnet_tpu.ndarray.ndarray import NDArray
+
+    lib = _native._load()
+    if lib is None:
+        pytest.skip("native library unavailable")
+
+    # Python writes, C reads
+    w = onp.arange(6, dtype="float32").reshape(2, 3) * 0.5
+    steps = onp.array([3, 1, 4], dtype="int32")
+    py_path = str(tmp_path / "py.params")
+    ndarray_io.save_params(py_path, {"w": NDArray(w),
+                                     "steps": NDArray(steps)})
+    n = ctypes.c_int(0)
+    handles = ctypes.POINTER(ctypes.c_void_p)()
+    names = ctypes.POINTER(ctypes.c_char_p)()
+    _native.check_call(lib.MXNDArrayLoad(
+        py_path.encode(), ctypes.byref(n), ctypes.byref(handles),
+        ctypes.byref(names)))
+    assert n.value == 2
+    assert names[0] == b"w" and names[1] == b"steps"
+    buf = (ctypes.c_float * 6)()
+    # raw ints from POINTER(c_void_p) indexing truncate to 32-bit without
+    # argtypes — always re-wrap in c_void_p
+    h0 = ctypes.c_void_p(handles[0])
+    h1 = ctypes.c_void_p(handles[1])
+    _native.check_call(lib.MXNDArraySyncCopyToCPU(
+        h0, buf, ctypes.c_uint64(24)))
+    assert onp.allclose(onp.frombuffer(buf, "float32").reshape(2, 3), w)
+    c_path = str(tmp_path / "c.params")
+
+    # C writes (the loaded handles), Python reads
+    name_arr = (ctypes.c_char_p * 2)(b"w", b"steps")
+    handle_arr = (ctypes.c_void_p * 2)(h0, h1)
+    _native.check_call(lib.MXNDArraySave(
+        c_path.encode(), 2, handle_arr, name_arr))
+    loaded = ndarray_io.load_params(c_path)
+    assert set(loaded) == {"w", "steps"}
+    assert onp.allclose(loaded["w"].asnumpy(), w)
+    assert (loaded["steps"].asnumpy() == steps).all()
+
+    for h in (h0, h1):
+        _native.check_call(lib.MXNDArrayFree(h))
+    _native.check_call(lib.MXNDArrayLoadFree(n.value, handles, names))
+
+
+def test_capi_imperative_invoke_from_python(tmp_path):
+    """Drive the native op path through ctypes: create -> invoke -> read
+    (the reference's MXImperativeInvokeEx usage shape)."""
+    import ctypes
+    import numpy as onp
+
+    lib = _native._load()
+    if lib is None:
+        pytest.skip("native library unavailable")
+
+    shape = (ctypes.c_int64 * 2)(2, 2)
+    a = ctypes.c_void_p()
+    b = ctypes.c_void_p()
+    c = ctypes.c_void_p()
+    for h in (a, b, c):
+        _native.check_call(lib.MXNDArrayCreate(shape, 2, 0,
+                                               ctypes.byref(h)))
+    av = (ctypes.c_float * 4)(1, 2, 3, 4)
+    bv = (ctypes.c_float * 4)(10, 20, 30, 40)
+    _native.check_call(lib.MXNDArraySyncCopyFromCPU(
+        a, av, ctypes.c_uint64(16)))
+    _native.check_call(lib.MXNDArraySyncCopyFromCPU(
+        b, bv, ctypes.c_uint64(16)))
+    ins = (ctypes.c_void_p * 2)(a, b)
+    outs = (ctypes.c_void_p * 1)(c)
+    _native.check_call(lib.MXImperativeInvoke(b"add", ins, 2, outs, 1))
+    out = (ctypes.c_float * 4)()
+    _native.check_call(lib.MXNDArraySyncCopyToCPU(
+        c, out, ctypes.c_uint64(16)))
+    assert list(out) == [11, 22, 33, 44]
+    for h in (a, b, c):
+        _native.check_call(lib.MXNDArrayFree(h))
