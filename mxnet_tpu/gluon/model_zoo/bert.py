@@ -45,7 +45,8 @@ class BERTEncoderLayer(HybridBlock):
     def forward(self, x: NDArray, mask: Optional[NDArray] = None) -> NDArray:
         qkv = self.attn_qkv(x)  # (B, T, 3C)
         q, k, v = mxnp.split(qkv, 3, axis=-1)
-        att = npx.multi_head_attention(q, k, v, self._num_heads, mask=mask)
+        att = npx.multi_head_attention(q, k, v, self._num_heads, mask=mask,
+                                       dropout=self._dropout)
         att = self.attn_out(att)
         if self._dropout:
             att = npx.dropout(att, self._dropout)

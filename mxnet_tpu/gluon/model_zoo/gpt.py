@@ -44,7 +44,8 @@ class GPTBlock(HybridBlock):
         qkv = self.attn_qkv(h)
         q, k, v = mxnp.split(qkv, 3, axis=-1)
         att = npx.multi_head_attention(q, k, v, self._num_heads,
-                                       causal=True)
+                                       causal=True,
+                                       dropout=self._dropout)
         att = self.attn_out(att)
         if self._dropout:
             att = npx.dropout(att, self._dropout)

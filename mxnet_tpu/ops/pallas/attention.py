@@ -10,8 +10,15 @@ accumulation over the last grid axis in VMEM scratch), emitting the
 per-row log-sum-exp. Backward is blockwise too (standard flash-attention
 recipe): a dq kernel streams K/V blocks against the saved LSE and
 ``delta = rowsum(dO·O)``, and a dk/dv kernel streams Q/dO blocks — scores
-are recomputed per tile and never hit HBM in either direction. On CPU the
-kernels run in interpret mode, keeping tests meaningful.
+are recomputed per tile and never hit HBM in either direction.
+
+Surface (round-2): additive bias/mask blocks stream like K/V (broadcast
+(1|B, 1|H, Tq, Tk) accepted; the bias gradient materializes the softmax
+cotangent ds, O(B·H·T²) — the price of a dense bias); probability dropout
+uses the TPU PRNG seeded per (batch, head, q-block, k-block) tile so the
+backward kernels regenerate the identical mask; block sizes are tunable
+per call. On CPU the kernels run in interpret mode, except dropout which
+takes a dense XLA path (pltpu PRNG is TPU-only).
 """
 from __future__ import annotations
 
@@ -21,16 +28,50 @@ from typing import Optional
 
 import jax
 import jax.numpy as jnp
+import numpy as onp
 from jax.experimental import pallas as pl
 from jax.experimental.pallas import tpu as pltpu
 
 _NEG_INF = -1e30
 
 
-def _flash_fwd_kernel(q_ref, k_ref, v_ref, o_ref, lse_ref,
-                      acc_ref, m_ref, l_ref, *,
-                      scale: float, causal: bool, block_q: int,
-                      block_k: int, kv_len: int, num_k_blocks: int):
+def _bias_spec(bias_shape, block_q, block_k):
+    Bb, Hb = bias_shape[0], bias_shape[1]
+
+    def idx(b, h, i, j):
+        return (b if Bb > 1 else 0, h if Hb > 1 else 0, i, j)
+
+    return pl.BlockSpec((1, 1, block_q, block_k), idx)
+
+
+def _dropout_keep(seed_ref, b, h, iq, ik, rate, shape):
+    """Regenerable keep-mask for one (q-block, k-block) tile: seeding is a
+    pure function of (user seed, batch, head, q-block, k-block), so the
+    dq/dkv kernels rebuild the identical mask. Mosaic caps prng_seed at
+    two words, so the tile coordinates fold in arithmetically (int32
+    wraparound is deterministic)."""
+    mix0 = seed_ref[0] + b * jnp.int32(1000003) + h * jnp.int32(7919)
+    mix1 = seed_ref[1] + iq * jnp.int32(65537) + ik
+    pltpu.prng_seed(mix0, mix1)
+    bits = pltpu.prng_random_bits(shape)
+    threshold = jnp.uint32(min(0xFFFFFFFF, int(rate * 4294967296.0)))
+    return bits.astype(jnp.uint32) >= threshold
+
+
+def _flash_fwd_kernel(*refs, scale: float, causal: bool, block_q: int,
+                      block_k: int, kv_len: int, num_k_blocks: int,
+                      has_bias: bool, rate: float):
+    i = 0
+    q_ref, k_ref, v_ref = refs[0], refs[1], refs[2]
+    i = 3
+    bias_ref = refs[i] if has_bias else None
+    i += 1 if has_bias else 0
+    seed_ref = refs[i] if rate > 0 else None
+    i += 1 if rate > 0 else 0
+    o_ref, lse_ref, acc_ref, m_ref, l_ref = refs[i:i + 5]
+
+    b = pl.program_id(0)
+    h = pl.program_id(1)
     iq = pl.program_id(2)
     ik = pl.program_id(3)
 
@@ -46,6 +87,8 @@ def _flash_fwd_kernel(q_ref, k_ref, v_ref, o_ref, lse_ref,
 
     s = jax.lax.dot_general(q, k, (((1,), (1,)), ((), ())),
                             preferred_element_type=jnp.float32)  # (bq, bk)
+    if has_bias:
+        s = s + bias_ref[0, 0].astype(jnp.float32)
 
     # mask out-of-range (padded) kv columns, and the future when causal
     col = ik * block_k + jax.lax.broadcasted_iota(jnp.int32, s.shape, 1)
@@ -62,8 +105,12 @@ def _flash_fwd_kernel(q_ref, k_ref, v_ref, o_ref, lse_ref,
     alpha = jnp.exp(m_prev - m_new)
     p = jnp.exp(s - m_new)                            # (bq, bk)
     l_new = alpha * l_prev + jnp.sum(p, axis=1, keepdims=True)
+    p_acc = p
+    if rate > 0:
+        keep = _dropout_keep(seed_ref, b, h, iq, ik, rate, p.shape)
+        p_acc = jnp.where(keep, p / (1.0 - rate), 0.0)
     acc_ref[...] = acc_ref[...] * alpha + jax.lax.dot_general(
-        p, v, (((1,), (0,)), ((), ())),
+        p_acc, v, (((1,), (0,)), ((), ())),
         preferred_element_type=jnp.float32)
     m_ref[...] = m_new
     l_ref[...] = l_new
@@ -87,15 +134,17 @@ def _pad_to(x, axis, mult):
     return jnp.pad(x, widths)
 
 
-def _flash_forward(q, k, v, scale: float, causal: bool,
-                   block_q: int, block_k: int, interpret: bool):
-    """q/k/v: (B, H, T, D). Returns ((B, H, Tq, D), lse (B, H, Tq, 1)).
+def _pad_bias(bias, block_q, block_k):
+    return _pad_to(_pad_to(bias, 2, block_q), 3, block_k)
 
-    lse keeps its trailing unit dim end-to-end (kernel block layout is
-    (block_q, 1)); it is a custom-vjp residual only.
-    """
+
+def _flash_forward(q, k, v, bias, seed, scale: float, causal: bool,
+                   block_q: int, block_k: int, rate: float,
+                   interpret: bool):
+    """q/k/v: (B, H, T, D). Returns ((B, H, Tq, D), lse (B, H, Tq, 1))."""
     B, H, Tq, D = q.shape
     Tk = k.shape[2]
+    has_bias = bias is not None
     qp = _pad_to(q, 2, block_q)
     kp = _pad_to(k, 2, block_k)
     vp = _pad_to(v, 2, block_k)
@@ -104,19 +153,27 @@ def _flash_forward(q, k, v, scale: float, causal: bool,
 
     kernel = functools.partial(
         _flash_fwd_kernel, scale=scale, causal=causal, block_q=block_q,
-        block_k=block_k, kv_len=Tk, num_k_blocks=n_k)
+        block_k=block_k, kv_len=Tk, num_k_blocks=n_k, has_bias=has_bias,
+        rate=rate)
+
+    in_specs = [
+        pl.BlockSpec((1, 1, block_q, D), lambda b, h, i, j: (b, h, i, 0)),
+        pl.BlockSpec((1, 1, block_k, D), lambda b, h, i, j: (b, h, j, 0)),
+        pl.BlockSpec((1, 1, block_k, D), lambda b, h, i, j: (b, h, j, 0)),
+    ]
+    args = [qp, kp, vp]
+    if has_bias:
+        bp = _pad_bias(bias, block_q, block_k)
+        in_specs.append(_bias_spec(bias.shape, block_q, block_k))
+        args.append(bp)
+    if rate > 0:
+        in_specs.append(pl.BlockSpec(memory_space=pltpu.SMEM))
+        args.append(seed)
 
     out, lse = pl.pallas_call(
         kernel,
         grid=(B, H, n_q, n_k),
-        in_specs=[
-            pl.BlockSpec((1, 1, block_q, D),
-                         lambda b, h, i, j: (b, h, i, 0)),
-            pl.BlockSpec((1, 1, block_k, D),
-                         lambda b, h, i, j: (b, h, j, 0)),
-            pl.BlockSpec((1, 1, block_k, D),
-                         lambda b, h, i, j: (b, h, j, 0)),
-        ],
+        in_specs=in_specs,
         out_specs=[
             pl.BlockSpec((1, 1, block_q, D),
                          lambda b, h, i, j: (b, h, i, 0)),
@@ -133,21 +190,33 @@ def _flash_forward(q, k, v, scale: float, causal: bool,
             pltpu.VMEM((block_q, 1), jnp.float32),   # running denom
         ],
         interpret=interpret,
-    )(qp, kp, vp)
+    )(*args)
     return out[:, :, :Tq], lse[:, :, :Tq]
 
 
-def _flash_bwd_dq_kernel(q_ref, k_ref, v_ref, do_ref, lse_ref, delta_ref,
-                         dq_ref, dq_acc, *, scale: float, causal: bool,
-                         block_q: int, block_k: int, kv_len: int,
-                         num_k_blocks: int):
+def _flash_bwd_dq_kernel(*refs, scale: float, causal: bool, block_q: int,
+                         block_k: int, kv_len: int, num_k_blocks: int,
+                         has_bias: bool, rate: float, emit_ds: bool):
+    i = 0
+    q_ref, k_ref, v_ref, do_ref, lse_ref, delta_ref = refs[:6]
+    i = 6
+    bias_ref = refs[i] if has_bias else None
+    i += 1 if has_bias else 0
+    seed_ref = refs[i] if rate > 0 else None
+    i += 1 if rate > 0 else 0
+    dq_ref = refs[i]
+    ds_ref = refs[i + 1] if emit_ds else None
+    dq_acc = refs[i + 2] if emit_ds else refs[i + 1]
+
+    b = pl.program_id(0)
+    h = pl.program_id(1)
+    iq = pl.program_id(2)
     ik = pl.program_id(3)
 
     @pl.when(ik == 0)
     def _init():
         dq_acc[...] = jnp.zeros_like(dq_acc)
 
-    iq = pl.program_id(2)
     q = q_ref[0, 0].astype(jnp.float32)                # (bq, d)
     k = k_ref[0, 0].astype(jnp.float32)                # (bk, d)
     v = v_ref[0, 0].astype(jnp.float32)
@@ -157,6 +226,8 @@ def _flash_bwd_dq_kernel(q_ref, k_ref, v_ref, do_ref, lse_ref, delta_ref,
 
     s = jax.lax.dot_general(q, k, (((1,), (1,)), ((), ())),
                             preferred_element_type=jnp.float32) * scale
+    if has_bias:
+        s = s + bias_ref[0, 0].astype(jnp.float32)
     col = ik * block_k + jax.lax.broadcasted_iota(jnp.int32, s.shape, 1)
     mask = col < kv_len
     if causal:
@@ -165,7 +236,13 @@ def _flash_bwd_dq_kernel(q_ref, k_ref, v_ref, do_ref, lse_ref, delta_ref,
     p = jnp.where(mask, jnp.exp(s - lse), 0.0)         # (bq, bk)
     dp = jax.lax.dot_general(do, v, (((1,), (1,)), ((), ())),
                              preferred_element_type=jnp.float32)
-    ds = p * (dp - delta) * scale
+    if rate > 0:
+        keep = _dropout_keep(seed_ref, b, h, iq, ik, rate, p.shape)
+        dp = jnp.where(keep, dp / (1.0 - rate), 0.0)
+    ds0 = p * (dp - delta)                              # dsoftmax (no scale)
+    if emit_ds:
+        ds_ref[0, 0] = ds0.astype(ds_ref.dtype)
+    ds = ds0 * scale
     dq_acc[...] += jax.lax.dot_general(
         ds, k, (((1,), (0,)), ((), ())),
         preferred_element_type=jnp.float32)
@@ -175,10 +252,20 @@ def _flash_bwd_dq_kernel(q_ref, k_ref, v_ref, do_ref, lse_ref, delta_ref,
         dq_ref[0, 0] = dq_acc[...].astype(dq_ref.dtype)
 
 
-def _flash_bwd_dkv_kernel(q_ref, k_ref, v_ref, do_ref, lse_ref, delta_ref,
-                          dk_ref, dv_ref, dk_acc, dv_acc, *, scale: float,
-                          causal: bool, block_q: int, block_k: int,
-                          kv_len: int, num_q_blocks: int):
+def _flash_bwd_dkv_kernel(*refs, scale: float, causal: bool, block_q: int,
+                          block_k: int, kv_len: int, num_q_blocks: int,
+                          has_bias: bool, rate: float):
+    q_ref, k_ref, v_ref, do_ref, lse_ref, delta_ref = refs[:6]
+    i = 6
+    bias_ref = refs[i] if has_bias else None
+    i += 1 if has_bias else 0
+    seed_ref = refs[i] if rate > 0 else None
+    i += 1 if rate > 0 else 0
+    dk_ref, dv_ref, dk_acc, dv_acc = refs[i:i + 4]
+
+    b = pl.program_id(0)
+    h = pl.program_id(1)
+    ik = pl.program_id(2)
     iq = pl.program_id(3)
 
     @pl.when(iq == 0)
@@ -186,7 +273,6 @@ def _flash_bwd_dkv_kernel(q_ref, k_ref, v_ref, do_ref, lse_ref, delta_ref,
         dk_acc[...] = jnp.zeros_like(dk_acc)
         dv_acc[...] = jnp.zeros_like(dv_acc)
 
-    ik = pl.program_id(2)
     q = q_ref[0, 0].astype(jnp.float32)                # (bq, d)
     k = k_ref[0, 0].astype(jnp.float32)                # (bk, d)
     v = v_ref[0, 0].astype(jnp.float32)
@@ -196,18 +282,26 @@ def _flash_bwd_dkv_kernel(q_ref, k_ref, v_ref, do_ref, lse_ref, delta_ref,
 
     s = jax.lax.dot_general(q, k, (((1,), (1,)), ((), ())),
                             preferred_element_type=jnp.float32) * scale
+    if has_bias:
+        s = s + bias_ref[0, 0].astype(jnp.float32)
     col = ik * block_k + jax.lax.broadcasted_iota(jnp.int32, s.shape, 1)
     mask = col < kv_len
     if causal:
         row = iq * block_q + jax.lax.broadcasted_iota(jnp.int32, s.shape, 0)
         mask = jnp.logical_and(mask, col <= row)
     p = jnp.where(mask, jnp.exp(s - lse), 0.0)         # (bq, bk)
-    # dv += p^T do
-    dv_acc[...] += jax.lax.dot_general(
-        p, do, (((0,), (0,)), ((), ())),
-        preferred_element_type=jnp.float32)
+    p_drop = p
     dp = jax.lax.dot_general(do, v, (((1,), (1,)), ((), ())),
                              preferred_element_type=jnp.float32)
+    if rate > 0:
+        keep = _dropout_keep(seed_ref, b, h, iq, ik, rate, p.shape)
+        inv = 1.0 / (1.0 - rate)
+        p_drop = jnp.where(keep, p * inv, 0.0)
+        dp = jnp.where(keep, dp * inv, 0.0)
+    # dv += p_drop^T do
+    dv_acc[...] += jax.lax.dot_general(
+        p_drop, do, (((0,), (0,)), ((), ())),
+        preferred_element_type=jnp.float32)
     ds = p * (dp - delta) * scale
     # dk += ds^T q
     dk_acc[...] += jax.lax.dot_general(
@@ -220,10 +314,15 @@ def _flash_bwd_dkv_kernel(q_ref, k_ref, v_ref, do_ref, lse_ref, delta_ref,
         dv_ref[0, 0] = dv_acc[...].astype(dv_ref.dtype)
 
 
-def _flash_backward(q, k, v, o, lse, g, scale: float, causal: bool,
-                    block_q: int, block_k: int, interpret: bool):
+def _flash_backward(q, k, v, bias, seed, o, lse, g, scale: float,
+                    causal: bool, block_q: int, block_k: int, rate: float,
+                    interpret: bool, bias_grad: bool = True):
     B, H, Tq, D = q.shape
     Tk = k.shape[2]
+    has_bias = bias is not None
+    # a non-learned mask bias skips the O(B*H*T^2) ds materialization —
+    # the whole point of a flash kernel for long contexts
+    want_dbias = has_bias and bias_grad
     delta = jnp.sum(g.astype(jnp.float32) * o.astype(jnp.float32),
                     axis=-1, keepdims=True)            # (B, H, Tq, 1)
     qp = _pad_to(q, 2, block_q)
@@ -242,17 +341,42 @@ def _flash_backward(q, k, v, o, lse, g, scale: float, causal: bool,
     row_spec = pl.BlockSpec((1, 1, block_q, 1),
                             lambda b, h, i, j: (b, h, i, 0))
 
-    dq = pl.pallas_call(
+    in_specs = [q_spec, k_spec, k_spec, q_spec, row_spec, row_spec]
+    args = [qp, kp, vp, dop, lsep, deltap]
+    if has_bias:
+        bp = _pad_bias(bias, block_q, block_k)
+        in_specs.append(_bias_spec(bias.shape, block_q, block_k))
+        args.append(bp)
+    if rate > 0:
+        in_specs.append(pl.BlockSpec(memory_space=pltpu.SMEM))
+        args.append(seed)
+
+    out_specs = [q_spec]
+    out_shape = [jax.ShapeDtypeStruct((B, H, Tq_p, D), q.dtype)]
+    if want_dbias:
+        # the softmax cotangent, materialized so d_bias can reduce over
+        # broadcast dims — O(B*H*T^2), the price of a LEARNED dense bias
+        out_specs.append(pl.BlockSpec((1, 1, block_q, block_k),
+                                      lambda b, h, i, j: (b, h, i, j)))
+        out_shape.append(
+            jax.ShapeDtypeStruct((B, H, Tq_p, Tk_p), jnp.float32))
+
+    dq_out = pl.pallas_call(
         functools.partial(_flash_bwd_dq_kernel, scale=scale,
                           causal=causal, block_q=block_q, block_k=block_k,
-                          kv_len=Tk, num_k_blocks=n_k),
+                          kv_len=Tk, num_k_blocks=n_k, has_bias=has_bias,
+                          rate=rate, emit_ds=want_dbias),
         grid=(B, H, n_q, n_k),
-        in_specs=[q_spec, k_spec, k_spec, q_spec, row_spec, row_spec],
-        out_specs=q_spec,
-        out_shape=jax.ShapeDtypeStruct((B, H, Tq_p, D), q.dtype),
+        in_specs=in_specs,
+        out_specs=out_specs if want_dbias else out_specs[0],
+        out_shape=out_shape if want_dbias else out_shape[0],
         scratch_shapes=[pltpu.VMEM((block_q, D), jnp.float32)],
         interpret=interpret,
-    )(qp, kp, vp, dop, lsep, deltap)
+    )(*args)
+    if want_dbias:
+        dq, ds_full = dq_out
+    else:
+        dq, ds_full = dq_out, None
 
     # dk/dv: swap the roles — kv blocks on the parallel axis, q blocks
     # sequential
@@ -262,27 +386,50 @@ def _flash_backward(q, k, v, o, lse, g, scale: float, causal: bool,
                            lambda b, h, j, i: (b, h, j, 0))
     rows_spec = pl.BlockSpec((1, 1, block_q, 1),
                              lambda b, h, j, i: (b, h, i, 0))
+    in_specs2 = [qs_spec, ks_spec, ks_spec, qs_spec, rows_spec, rows_spec]
+    args2 = [qp, kp, vp, dop, lsep, deltap]
+    if has_bias:
+        Bb, Hb = bias.shape[0], bias.shape[1]
+        in_specs2.append(pl.BlockSpec(
+            (1, 1, block_q, block_k),
+            lambda b, h, j, i, Bb=Bb, Hb=Hb: (b if Bb > 1 else 0,
+                                              h if Hb > 1 else 0, i, j)))
+        args2.append(_pad_bias(bias, block_q, block_k))
+    if rate > 0:
+        in_specs2.append(pl.BlockSpec(memory_space=pltpu.SMEM))
+        args2.append(seed)
     dk, dv = pl.pallas_call(
         functools.partial(_flash_bwd_dkv_kernel, scale=scale,
                           causal=causal, block_q=block_q, block_k=block_k,
-                          kv_len=Tk, num_q_blocks=n_q),
+                          kv_len=Tk, num_q_blocks=n_q, has_bias=has_bias,
+                          rate=rate),
         grid=(B, H, n_k, n_q),
-        in_specs=[qs_spec, ks_spec, ks_spec, qs_spec, rows_spec,
-                  rows_spec],
+        in_specs=in_specs2,
         out_specs=[ks_spec, ks_spec],
         out_shape=[jax.ShapeDtypeStruct((B, H, Tk_p, D), k.dtype),
                    jax.ShapeDtypeStruct((B, H, Tk_p, D), v.dtype)],
         scratch_shapes=[pltpu.VMEM((block_k, D), jnp.float32),
                         pltpu.VMEM((block_k, D), jnp.float32)],
         interpret=interpret,
-    )(qp, kp, vp, dop, lsep, deltap)
-    return dq[:, :, :Tq], dk[:, :, :Tk], dv[:, :, :Tk]
+    )(*args2)
+
+    d_bias = None
+    if want_dbias:
+        ds_full = ds_full[:, :, :Tq, :Tk]
+        # reduce over broadcast dims back to the bias shape
+        red = tuple(ax for ax, size in enumerate(bias.shape[:2])
+                    if size == 1)
+        d_bias = ds_full.sum(axis=red, keepdims=True) if red else ds_full
+        d_bias = d_bias.astype(bias.dtype)
+    return dq[:, :, :Tq], dk[:, :, :Tk], dv[:, :, :Tk], d_bias
 
 
-def _dense_reference(q, k, v, scale: float, causal: bool):
-    """O(T^2) reference in plain XLA (used for the backward pass)."""
+def _dense_reference(q, k, v, scale: float, causal: bool, bias=None):
+    """O(T^2) reference in plain XLA."""
     s = jnp.einsum("bhqd,bhkd->bhqk", q.astype(jnp.float32),
                    k.astype(jnp.float32)) * scale
+    if bias is not None:
+        s = s + bias.astype(jnp.float32)
     if causal:
         # top-left alignment (col <= row), matching the kernel's mask
         Tq, Tk = s.shape[-2], s.shape[-1]
@@ -293,43 +440,100 @@ def _dense_reference(q, k, v, scale: float, causal: bool):
                       v.astype(jnp.float32)).astype(q.dtype)
 
 
-@functools.partial(jax.custom_vjp, nondiff_argnums=(3, 4, 5, 6))
-def _flash(q, k, v, scale, causal, block_q, block_k):
+@functools.partial(jax.custom_vjp, nondiff_argnums=(5, 6, 7, 8, 9, 10))
+def _flash2(q, k, v, bias, seed, rate, scale, causal, block_q, block_k,
+            bias_grad=True):
     interpret = jax.default_backend() == "cpu"
-    out, _ = _flash_forward(q, k, v, scale, causal, block_q, block_k,
-                            interpret)
+    out, _ = _flash_forward(q, k, v, bias, seed, scale, causal, block_q,
+                            block_k, rate, interpret)
     return out
 
 
-def _flash_fwd(q, k, v, scale, causal, block_q, block_k):
+def _flash2_fwd(q, k, v, bias, seed, rate, scale, causal, block_q,
+                block_k, bias_grad=True):
     interpret = jax.default_backend() == "cpu"
-    out, lse = _flash_forward(q, k, v, scale, causal, block_q, block_k,
-                              interpret)
-    return out, (q, k, v, out, lse)
+    out, lse = _flash_forward(q, k, v, bias, seed, scale, causal, block_q,
+                              block_k, rate, interpret)
+    return out, (q, k, v, bias, seed, out, lse)
 
 
-def _flash_bwd(scale, causal, block_q, block_k, res, g):
-    q, k, v, o, lse = res
+def _flash2_bwd(rate, scale, causal, block_q, block_k, bias_grad, res, g):
+    q, k, v, bias, seed, o, lse = res
     interpret = jax.default_backend() == "cpu"
-    return _flash_backward(q, k, v, o, lse, g, scale, causal, block_q,
-                           block_k, interpret)
+    dq, dk, dv, d_bias = _flash_backward(
+        q, k, v, bias, seed, o, lse, g, scale, causal, block_q, block_k,
+        rate, interpret, bias_grad=bias_grad)
+    if d_bias is None and bias is not None:
+        d_bias = jnp.zeros_like(bias)
+    d_seed = None if seed is None else \
+        onp.zeros(seed.shape, jax.dtypes.float0)
+    return dq, dk, dv, d_bias, d_seed
 
 
-_flash.defvjp(_flash_fwd, _flash_bwd)
+_flash2.defvjp(_flash2_fwd, _flash2_bwd)
 
 
 def flash_attention(q, k, v, scale: Optional[float] = None,
                     causal: bool = False, block_q: int = 128,
-                    block_k: int = 128):
-    """Flash attention over (B, T, H, D) inputs (jax layout convention)."""
+                    block_k: int = 128, bias=None, dropout: float = 0.0,
+                    dropout_seed=None, bias_grad: bool = True):
+    """Flash attention over (B, T, H, D) inputs (jax layout convention).
+
+    bias: additive score bias/mask of shape (1|B, 1|H, Tq, Tk) — the two
+    leading dims may broadcast, the trailing two must be full-size.
+    bias_grad=False marks a non-learned mask: its gradient is skipped,
+    avoiding the O(B*H*T^2) softmax-cotangent materialization.
+    dropout: probability-dropout rate on the attention weights;
+    dropout_seed: int32 array of shape (2,) (derive from a threefry key);
+    required when dropout > 0. On CPU, dropout falls back to the dense
+    XLA path (the TPU PRNG has no interpret-mode implementation).
+    """
     if scale is None:
         scale = 1.0 / math.sqrt(q.shape[-1])
+    if bias is not None and (bias.ndim != 4 or
+                             bias.shape[2] != q.shape[1] or
+                             bias.shape[3] != k.shape[1]):
+        raise ValueError(
+            f"flash_attention bias must be (1|B, 1|H, Tq, Tk); got "
+            f"{bias.shape} for Tq={q.shape[1]}, Tk={k.shape[1]} — "
+            "broadcast is only supported over the leading two dims")
     # kernel blocks over (B, H, T, D)
     qt = jnp.swapaxes(q, 1, 2)
     kt = jnp.swapaxes(k, 1, 2)
     vt = jnp.swapaxes(v, 1, 2)
     block_q = min(block_q, max(qt.shape[2], 8))
     block_k = min(block_k, max(kt.shape[2], 8))
-    out = _flash(qt, kt, vt, float(scale), bool(causal),
-                 int(block_q), int(block_k))
+    rate = float(dropout)
+    if rate > 0 and dropout_seed is None:
+        raise ValueError("flash_attention: dropout > 0 needs dropout_seed")
+    if rate > 0 and jax.default_backend() == "cpu":
+        # dense differentiable fallback with jax-level dropout
+        out = dense_dropout_attention_bhtd(
+            qt, kt, vt, bias, jnp.asarray(dropout_seed, jnp.int32), rate,
+            float(scale), bool(causal))
+        return jnp.swapaxes(out, 1, 2)
+    seed = None if rate == 0 else jnp.asarray(dropout_seed, jnp.int32)
+    out = _flash2(qt, kt, vt, bias, seed, rate, float(scale), bool(causal),
+                  int(block_q), int(block_k), bool(bias_grad))
     return jnp.swapaxes(out, 1, 2)
+
+
+def dense_dropout_attention_bhtd(q, k, v, bias, seed, rate, scale, causal):
+    """Plain-XLA attention with probability dropout over (B, H, T, D)
+    operands — the shared differentiable fallback for platforms/paths
+    without the Pallas kernel. ``seed`` is a (2,) int32 array."""
+    key = jax.random.wrap_key_data(
+        jnp.asarray(seed, jnp.uint32).reshape(2,), impl="threefry2x32")
+    s = jnp.einsum("bhqd,bhkd->bhqk", q.astype(jnp.float32),
+                   k.astype(jnp.float32)) * scale
+    if bias is not None:
+        s = s + bias.astype(jnp.float32)
+    if causal:
+        Tq, Tk = s.shape[-2], s.shape[-1]
+        m = jnp.tril(jnp.ones((Tq, Tk), bool))
+        s = jnp.where(m, s, _NEG_INF)
+    p = jax.nn.softmax(s, axis=-1)
+    keep = jax.random.bernoulli(key, 1.0 - rate, p.shape)
+    p = jnp.where(keep, p / (1.0 - rate), 0.0)
+    return jnp.einsum("bhqk,bhkd->bhqd", p,
+                      v.astype(jnp.float32)).astype(q.dtype)

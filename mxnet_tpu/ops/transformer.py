@@ -16,6 +16,7 @@ from typing import Any, Optional
 import jax
 import jax.numpy as jnp
 
+from .._tape import is_training
 from ..base import getenv, register_env
 from ..ndarray.ndarray import NDArray
 from ..ndarray.ops import _as_nd
@@ -28,6 +29,10 @@ __all__ = ["dot_product_attention", "multi_head_attention",
 register_env("MXNET_ATTENTION_USE_PALLAS", 0,
              "Use the Pallas flash-attention kernel on TPU (auto-enabled "
              "for long sequences when available).")
+register_env("MXNET_FLASH_BLOCK_Q", 128,
+             "Flash-attention query-block rows (tunable per chip/shape).")
+register_env("MXNET_FLASH_BLOCK_K", 128,
+             "Flash-attention key-block rows (tunable per chip/shape).")
 
 
 def _mask_to_bias(mask, dtype, batch: int, tq: int, tk: int):
@@ -66,27 +71,73 @@ def dot_product_attention(query, key, value, mask=None,
     has_mask = mask is not None
     if has_mask:
         inputs.append(_as_nd(mask))
+    # training flag and RNG draw resolve OUTSIDE impl: the per-op exec
+    # cache would otherwise bake both into the compiled program (stale
+    # dropout mode; one frozen mask reused every step) — the seed rides
+    # as an op INPUT so every call gets fresh randomness
+    train_rate = float(dropout) if is_training() else 0.0
+    if train_rate > 0.0:
+        inputs.append(_as_nd(_attn_seed()))
     sc, cz = scale, causal
 
-    def impl(q, k, v, *m):
+    def impl(q, k, v, *rest):
+        rest = list(rest)
+        seed = rest.pop() if train_rate > 0.0 else None
         bias = None
-        if m:
-            bias = _mask_to_bias(m[0], q.dtype, q.shape[0], q.shape[1],
+        mask_learned = False
+        if rest:
+            bias = _mask_to_bias(rest[0], q.dtype, q.shape[0], q.shape[1],
                                  k.shape[1])
-        if bias is None:
+            mask_learned = rest[0].dtype != jnp.bool_
+        if bias is None and train_rate == 0.0:
             ring = _use_ring(q, k)
             if ring is not None:
                 from ..parallel.ring import ring_attention
                 mesh, axis = ring
                 return ring_attention(q, k, v, mesh, axis=axis,
                                       scale=sc, causal=cz)
-            if _use_pallas(q):
-                from .pallas.attention import flash_attention
-                return flash_attention(q, k, v, scale=sc, causal=cz)
+        if _use_pallas(q) and _flash_bias_ok(bias, q, k):
+            from .pallas.attention import flash_attention
+            return flash_attention(
+                q, k, v, scale=sc, causal=cz, bias=bias,
+                block_q=_flash_block("Q"), block_k=_flash_block("K"),
+                dropout=train_rate, dropout_seed=seed,
+                bias_grad=mask_learned)
+        if train_rate > 0.0:
+            from .pallas.attention import dense_dropout_attention_bhtd
+            import math as _math
+            s = sc if sc is not None else 1.0 / _math.sqrt(q.shape[-1])
+            qt, kt, vt = (jnp.swapaxes(a, 1, 2) for a in (q, k, v))
+            out = dense_dropout_attention_bhtd(
+                qt, kt, vt, bias, seed, train_rate, float(s), bool(cz))
+            return jnp.swapaxes(out, 1, 2)
         return jax.nn.dot_product_attention(
             q, k, v, bias=bias, scale=sc, is_causal=cz)
 
     return invoke("dot_product_attention", impl, inputs)
+
+
+def _flash_block(which: str) -> int:
+    return int(getenv(f"MXNET_FLASH_BLOCK_{which}", 128))
+
+
+def _flash_bias_ok(bias, q, k) -> bool:
+    """The Pallas kernel broadcasts bias over dims 0/1 only; the trailing
+    (Tq, Tk) must be full-size (a (B,1,1,Tk) key-padding bias would be
+    silently mis-indexed)."""
+    if bias is None:
+        return True
+    return (bias.ndim == 4 and bias.shape[2] == q.shape[1] and
+            bias.shape[3] == k.shape[1])
+
+
+def _attn_seed():
+    """(2,) int32 seed from the framework RNG stream; under a hybridize
+    trace this rides the threaded threefry key, so compiled programs get
+    fresh dropout per step."""
+    from ..ndarray import random as _random
+    key = _random.split_key()
+    return jax.random.key_data(key).reshape(-1)[:2].astype(jnp.int32)
 
 
 def _use_ring(q, k):
@@ -119,15 +170,23 @@ def _use_pallas(q) -> bool:
 
 
 def multi_head_attention(query, key, value, num_heads: int, mask=None,
-                         causal: bool = False, scale: Optional[float] = None):
-    """(B, T, C) inputs already projected; splits heads, attends, merges."""
+                         causal: bool = False, scale: Optional[float] = None,
+                         dropout: float = 0.0):
+    """(B, T, C) inputs already projected; splits heads, attends, merges.
+    ``dropout`` is attention-probability dropout (training mode only)."""
     nh, cz, sc = num_heads, causal, scale
     inputs = [_as_nd(query), _as_nd(key), _as_nd(value)]
     has_mask = mask is not None
     if has_mask:
         inputs.append(_as_nd(mask))
+    # resolved outside impl — see dot_product_attention
+    train_rate = float(dropout) if is_training() else 0.0
+    if train_rate > 0.0:
+        inputs.append(_as_nd(_attn_seed()))
 
-    def impl(q, k, v, *m):
+    def impl(q, k, v, *rest):
+        rest = list(rest)
+        seed = rest.pop() if train_rate > 0.0 else None
         B, Tq, C = q.shape
         Tk = k.shape[1]
         d = C // nh
@@ -135,17 +194,32 @@ def multi_head_attention(query, key, value, num_heads: int, mask=None,
         kh = k.reshape(B, Tk, nh, d)
         vh = v.reshape(B, Tk, nh, d)
         bias = None
-        if m:
-            bias = _mask_to_bias(m[0], q.dtype, B, Tq, Tk)
-        ring = None if bias is not None else _use_ring(qh, kh)
+        mask_learned = False
+        if rest:
+            bias = _mask_to_bias(rest[0], q.dtype, B, Tq, Tk)
+            mask_learned = rest[0].dtype != jnp.bool_
+        ring = None if (bias is not None or train_rate) \
+            else _use_ring(qh, kh)
         if ring is not None:
             from ..parallel.ring import ring_attention
             mesh, axis = ring
             out = ring_attention(qh, kh, vh, mesh, axis=axis,
                                  scale=sc, causal=cz)
-        elif bias is None and _use_pallas(qh):
+        elif _use_pallas(qh) and _flash_bias_ok(bias, qh, kh):
             from .pallas.attention import flash_attention
-            out = flash_attention(qh, kh, vh, scale=sc, causal=cz)
+            out = flash_attention(
+                qh, kh, vh, scale=sc, causal=cz, bias=bias,
+                block_q=_flash_block("Q"), block_k=_flash_block("K"),
+                dropout=train_rate, dropout_seed=seed,
+                bias_grad=mask_learned)
+        elif train_rate > 0.0:
+            from .pallas.attention import dense_dropout_attention_bhtd
+            import math as _math
+            s = sc if sc is not None else 1.0 / _math.sqrt(d)
+            out = jnp.swapaxes(dense_dropout_attention_bhtd(
+                jnp.swapaxes(qh, 1, 2), jnp.swapaxes(kh, 1, 2),
+                jnp.swapaxes(vh, 1, 2), bias, seed, train_rate,
+                float(s), bool(cz)), 1, 2)
         else:
             out = jax.nn.dot_product_attention(qh, kh, vh, bias=bias,
                                                scale=sc, is_causal=cz)

@@ -5,7 +5,7 @@ import jax
 import jax.numpy as jnp
 import pytest
 
-from mxnet_tpu.ops.pallas.attention import (_dense_reference, _flash,
+from mxnet_tpu.ops.pallas.attention import (_dense_reference, _flash2,
                                             flash_attention)
 
 
@@ -18,7 +18,7 @@ def test_flash_forward_matches_dense(causal, shape):
     k = jnp.asarray(rng.normal(0, 1, shape).astype("float32"))
     v = jnp.asarray(rng.normal(0, 1, shape).astype("float32"))
     scale = 1.0 / D ** 0.5
-    out = _flash(q, k, v, scale, causal, 128, 128)
+    out = _flash2(q, k, v, None, None, 0.0, scale, causal, 128, 128)
     ref = _dense_reference(q, k, v, scale, causal)
     onp.testing.assert_allclose(onp.asarray(out), onp.asarray(ref),
                                 rtol=2e-4, atol=2e-5)
@@ -34,7 +34,8 @@ def test_flash_backward_matches_dense(causal):
     scale = 1.0 / D ** 0.5
 
     def loss_flash(q, k, v):
-        return jnp.sum(_flash(q, k, v, scale, causal, 128, 128) ** 2)
+        return jnp.sum(_flash2(q, k, v, None, None, 0.0, scale,
+                       causal, 128, 128) ** 2)
 
     def loss_dense(q, k, v):
         return jnp.sum(_dense_reference(q, k, v, scale, causal) ** 2)
@@ -62,3 +63,89 @@ def test_flash_public_entry_bf16():
     onp.testing.assert_allclose(
         onp.asarray(out).astype("float32"),
         onp.asarray(ref).astype("float32"), rtol=5e-2, atol=5e-2)
+
+
+def test_flash_bias_matches_dense():
+    """Additive bias streams through the kernel; fwd+bwd must match the
+    dense reference including the bias gradient."""
+    rng = onp.random.RandomState(3)
+    B, H, T, D = 2, 2, 64, 16
+    q = jnp.asarray(rng.uniform(-1, 1, (B, H, T, D)).astype("float32"))
+    k = jnp.asarray(rng.uniform(-1, 1, (B, H, T, D)).astype("float32"))
+    v = jnp.asarray(rng.uniform(-1, 1, (B, H, T, D)).astype("float32"))
+    bias = jnp.asarray(rng.uniform(-2, 2, (B, H, T, T)).astype("float32"))
+    scale = 1.0 / onp.sqrt(D)
+
+    def loss_flash(q, k, v, bias):
+        return jnp.sum(_flash2(q, k, v, bias, None, 0.0, scale, False,
+                               32, 32) ** 2)
+
+    def loss_dense(q, k, v, bias):
+        return jnp.sum(_dense_reference(q, k, v, scale, False,
+                                        bias=bias) ** 2)
+
+    out_f = _flash2(q, k, v, bias, None, 0.0, scale, False, 32, 32)
+    out_d = _dense_reference(q, k, v, scale, False, bias=bias)
+    onp.testing.assert_allclose(onp.asarray(out_f), onp.asarray(out_d),
+                                rtol=2e-4, atol=2e-5)
+    gf = jax.grad(loss_flash, argnums=(0, 1, 2, 3))(q, k, v, bias)
+    gd = jax.grad(loss_dense, argnums=(0, 1, 2, 3))(q, k, v, bias)
+    for a, b in zip(gf, gd):
+        onp.testing.assert_allclose(onp.asarray(a), onp.asarray(b),
+                                    rtol=3e-4, atol=3e-5)
+
+
+def test_flash_broadcast_bias_grad():
+    """(1,1,Tq,Tk) broadcast bias: gradient reduces over batch+heads."""
+    rng = onp.random.RandomState(4)
+    B, H, T, D = 2, 3, 32, 8
+    q = jnp.asarray(rng.uniform(-1, 1, (B, H, T, D)).astype("float32"))
+    bias = jnp.asarray(rng.uniform(-1, 1, (1, 1, T, T)).astype("float32"))
+    scale = 1.0 / onp.sqrt(D)
+
+    def loss_flash(bias):
+        return jnp.sum(_flash2(q, q, q, bias, None, 0.0, scale, True,
+                               16, 16) ** 2)
+
+    def loss_dense(bias):
+        return jnp.sum(_dense_reference(q, q, q, scale, True,
+                                        bias=bias) ** 2)
+
+    gf = jax.grad(loss_flash)(bias)
+    gd = jax.grad(loss_dense)(bias)
+    assert gf.shape == bias.shape
+    onp.testing.assert_allclose(onp.asarray(gf), onp.asarray(gd),
+                                rtol=3e-4, atol=3e-5)
+
+
+def test_flash_dropout_semantics_cpu():
+    """On CPU dropout takes the dense XLA fallback: zero-rate equals the
+    no-dropout path; nonzero rate keeps the expected row normalization
+    and zeros ~rate of the weights."""
+    from mxnet_tpu.ops.pallas.attention import flash_attention
+    rng = onp.random.RandomState(5)
+    B, T, H, D = 2, 32, 2, 8
+    q = jnp.asarray(rng.uniform(-1, 1, (B, T, H, D)).astype("float32"))
+    seed = jnp.asarray([123, 456], jnp.int32)
+    out0 = flash_attention(q, q, q)
+    out_d = flash_attention(q, q, q, dropout=0.5, dropout_seed=seed)
+    assert out_d.shape == out0.shape
+    assert bool(jnp.isfinite(out_d).all())
+    # dropped attention changes the output but keeps its scale
+    diff = float(jnp.abs(out_d - out0).mean())
+    assert diff > 1e-4
+    assert float(jnp.abs(out_d).mean()) < 4 * float(jnp.abs(out0).mean())
+    # missing seed errors
+    import pytest as _pytest
+    with _pytest.raises(ValueError):
+        flash_attention(q, q, q, dropout=0.5)
+
+
+def test_flash_tunable_blocks():
+    rng = onp.random.RandomState(6)
+    q = jnp.asarray(rng.uniform(-1, 1, (1, 2, 96, 16)).astype("float32"))
+    scale = 0.25
+    o1 = _flash2(q, q, q, None, None, 0.0, scale, False, 32, 48)
+    o2 = _flash2(q, q, q, None, None, 0.0, scale, False, 96, 96)
+    onp.testing.assert_allclose(onp.asarray(o1), onp.asarray(o2),
+                                rtol=2e-4, atol=2e-5)
