@@ -144,7 +144,10 @@ register_env("MXNET_ENGINE_TYPE", "ThreadedEnginePerDevice",
              "execution (block_until_ready after every op) for debugging; "
              "anything else uses async XLA dispatch.")
 register_env("MXNET_EXEC_BULK_EXEC_TRAIN", 1,
-             "Enable bulked execution (jit) of hybridized training graphs.")
+             "Parity shim, NO-OP under XLA: the reference bulked engine "
+             "segments; here jit compiles whole graphs and XLA fuses, so "
+             "this flag (and engine.set_bulk_size/bulk hints) is accepted "
+             "and recorded but not load-bearing.")
 register_env("MXNET_ENFORCE_DETERMINISM", 0,
              "Restrict to deterministic kernels.")
 

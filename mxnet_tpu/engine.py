@@ -189,7 +189,9 @@ _bulk_size = 15
 
 
 def set_bulk_size(size: int) -> int:
-    """Set the bulk-execution segment-size hint; returns the previous value."""
+    """Set the bulk-execution segment-size hint; returns the previous
+    value. NO-OP parity shim: XLA fuses whole jitted graphs, so the hint
+    is recorded but never read by the executor (see docs/env_vars.md)."""
     global _bulk_size
     prev, _bulk_size = _bulk_size, size
     return prev

@@ -92,6 +92,53 @@ def _run_single_direction(mode, x, h0, c0, wi, wh, bi, bh, reverse=False):
     return hs, carry_T
 
 
+def _seq_reverse(x, lens):
+    """Reverse each batch column's first ``lens[n]`` steps and ZERO the
+    rest — the varlen-scan helper (NOT the reference ``SequenceReverse``
+    op, which preserves padded values; see ops/nn.py sequence_reverse).
+    x: (T, N, C)."""
+    T = x.shape[0]
+    idx = jnp.arange(T)[:, None]                    # (T, 1)
+    src = jnp.clip(lens[None, :] - 1 - idx, 0, T - 1)
+    valid = idx < lens[None, :]
+    g = jnp.take_along_axis(
+        x, jnp.broadcast_to(src[:, :, None], x.shape), axis=0)
+    return jnp.where(valid[:, :, None], g, 0).astype(x.dtype)
+
+
+def _run_single_direction_varlen(mode, x, lens, h0, c0, wi, wh, bi, bh,
+                                 reverse=False):
+    """Variable-length scan (the cuDNN packed-sequence analog): the carry
+    FREEZES once t >= lens[n], so the returned final state is exactly the
+    state after each sequence's true last step; padded outputs are zero.
+    The reverse direction runs forward over the length-aware reversed
+    sequence, so it too starts at each sequence's true end."""
+    T, N, _ = x.shape
+    # lengths beyond T would silently mis-index the reversed gather
+    lens = jnp.minimum(lens, T)
+    if reverse:
+        x = _seq_reverse(x, lens)
+    gi_all = jnp.einsum("tni,gi->tng", x, wi) + bi
+    step = _cell_step(mode)
+
+    def scan_fn(carry, inp):
+        gi_t, t = inp
+        gh = carry[0] @ wh.T + bh
+        new_carry, h_out = step(carry, gi_t, gh)
+        active = (t < lens)[:, None]
+        new_carry = tuple(jnp.where(active, nc, oc)
+                          for nc, oc in zip(new_carry, carry))
+        h_out = jnp.where(active, h_out, 0).astype(h_out.dtype)
+        return new_carry, h_out
+
+    carry0 = (h0, c0) if mode == "lstm" else (h0,)
+    carry_T, hs = lax.scan(scan_fn, carry0,
+                           (gi_all, jnp.arange(T, dtype=jnp.int32)))
+    if reverse:
+        hs = _seq_reverse(hs, lens)
+    return hs, carry_T
+
+
 class _RNNLayer(HybridBlock):
     def __init__(self, mode: str, hidden_size: int, num_layers: int = 1,
                  layout: str = "TNC", dropout: float = 0.0,

@@ -1136,12 +1136,13 @@ def rnn(data, parameters, state, state_cell=None, mode: str = "lstm",
     ``lax.scan`` as ``gluon.rnn`` layers — one compiled program under
     jit, weight layout identical to the reference for checkpoint interop.
     """
-    from ..gluon.rnn.rnn_layer import _gates, _run_single_direction
+    from ..gluon.rnn.rnn_layer import (_gates, _run_single_direction,
+                                       _run_single_direction_varlen)
 
-    if use_sequence_length or sequence_length is not None:
-        raise NotImplementedError(
-            "npx.rnn use_sequence_length is not implemented; mask with "
-            "npx.sequence_mask / pick final states with npx.sequence_last")
+    varlen = use_sequence_length and sequence_length is not None
+    if use_sequence_length and sequence_length is None:
+        raise ValueError(
+            "npx.rnn: use_sequence_length=True needs sequence_length")
     train = is_training() if training is None else training
     x_nd = _as_nd(data)
     params_nd = _as_nd(parameters)
@@ -1151,6 +1152,8 @@ def rnn(data, parameters, state, state_cell=None, mode: str = "lstm",
         if state_cell is None:
             raise ValueError("lstm mode needs state_cell")
         inputs.append(_as_nd(state_cell))
+    if varlen:
+        inputs.append(_as_nd(sequence_length))
     H = state_size
     D = 2 if bidirectional else 1
     G = _gates(mode)
@@ -1171,6 +1174,8 @@ def rnn(data, parameters, state, state_cell=None, mode: str = "lstm",
             f"input size {I}")
 
     def impl(x, params, h0, *rest):
+        rest = list(rest)
+        lens = rest.pop().astype(jnp.int32) if varlen else None
         c0 = rest[0] if rest else None
         # -- unpack the cuDNN-ordered flat parameter vector
         off = 0
@@ -1203,9 +1208,14 @@ def rnn(data, parameters, state, state_cell=None, mode: str = "lstm",
                 k = layer * D + d
                 h_init = h0[k]
                 c_init = c0[k] if c0 is not None else None
-                hs, carry = _run_single_direction(
-                    mode, outs, h_init, c_init, wi[k], wh[k], bi[k], bh[k],
-                    reverse=(d == 1))
+                if varlen:
+                    hs, carry = _run_single_direction_varlen(
+                        mode, outs, lens, h_init, c_init, wi[k], wh[k],
+                        bi[k], bh[k], reverse=(d == 1))
+                else:
+                    hs, carry = _run_single_direction(
+                        mode, outs, h_init, c_init, wi[k], wh[k],
+                        bi[k], bh[k], reverse=(d == 1))
                 dir_outs.append(hs)
                 h_finals.append(carry[0])
                 if mode == "lstm":

@@ -8,10 +8,14 @@ sequence over ``sp``), and ONE jit-compiled step does forward, backward,
 and the fused optimizer update with XLA inserting every collective
 (gradient psum over dp rides ICI — no servers, no key slicing).
 
-Pipeline ('pp') and expert ('ep') axes are accepted in the mesh; 'pp' is
-realized by stage-partitioning rules on layer parameters (contributions
-flow through the same GSPMD partitioner rather than a schedule), full
-1F1B-style scheduling is future work.
+Pipeline ('pp') and expert ('ep') axes are accepted in the mesh. Real
+microbatch pipeline scheduling lives in ``parallel.pipeline`` —
+``GPTPipe`` stacks a model's blocks as stages and runs the GPipe
+schedule (``pipeline_apply``: microbatches hop stages via ppermute
+inside a scan, remat bounds live activations) under this trainer via
+``PIPELINE_RULES``. A 1F1B schedule would only re-order the bubble;
+with ``jax.checkpoint`` on each tick the activation footprint is
+already O(stages), so GPipe is the deliberate choice here.
 """
 from __future__ import annotations
 

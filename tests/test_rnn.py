@@ -362,3 +362,60 @@ def test_npx_rnn_gru_bidirectional():
     out = mx.npx.rnn(x, packed, h0, mode="gru", state_size=H,
                      num_layers=1, bidirectional=True)
     assert_almost_equal(out, out_ref, rtol=1e-5, atol=1e-6)
+
+
+def test_npx_rnn_variable_length():
+    """use_sequence_length (reference RNN op + cuDNN packed sequences):
+    per-sequence results must equal running each sequence alone at its
+    true length — padded outputs zero, final states taken at the true
+    last step, reverse direction starting at the true end."""
+    import numpy as onp
+    from mxnet_tpu import npx
+
+    T, N, I, H, L = 6, 3, 4, 5, 2      # T steps, L layers
+    rng = onp.random.RandomState(0)
+    lens = onp.array([6, 3, 1], "int32")
+    for mode, G in (("lstm", 4), ("gru", 3)):
+        for bidir in (False, True):
+            D = 2 if bidir else 1
+            n_params = 0
+            for layer in range(L):
+                in_sz = I if layer == 0 else H * D
+                n_params += D * (G * H * in_sz + G * H * H)
+            n_params += L * D * 2 * G * H
+            params = mx.np.array(rng.uniform(-0.3, 0.3, (n_params,))
+                                 .astype("float32"))
+            x = mx.np.array(rng.uniform(-1, 1, (T, N, I))
+                            .astype("float32"))
+            h0 = mx.np.array(onp.zeros((L * D, N, H), "float32"))
+            kw = dict(mode=mode, state_size=H, num_layers=L,
+                      bidirectional=bidir, state_outputs=True)
+            if mode == "lstm":
+                kw["state_cell"] = mx.np.array(
+                    onp.zeros((L * D, N, H), "float32"))
+            res = npx.rnn(x, params, h0,
+                          use_sequence_length=True,
+                          sequence_length=mx.np.array(lens), **kw)
+            o_v, h_v = res[0].asnumpy(), res[1].asnumpy()
+            c_v = res[2].asnumpy() if mode == "lstm" else None
+            for n in range(N):
+                Ln = int(lens[n])
+                kw1 = dict(kw)
+                if mode == "lstm":
+                    kw1["state_cell"] = mx.np.array(
+                        onp.zeros((L * D, 1, H), "float32"))
+                res1 = npx.rnn(
+                    mx.np.array(x.asnumpy()[:Ln, n:n + 1]), params,
+                    mx.np.array(onp.zeros((L * D, 1, H), "float32")),
+                    **kw1)
+                o1 = res1[0].asnumpy()
+                onp.testing.assert_allclose(
+                    o_v[:Ln, n], o1[:, 0], rtol=1e-5, atol=1e-5)
+                assert onp.abs(o_v[Ln:, n]).max() == 0 if Ln < T else True
+                onp.testing.assert_allclose(
+                    h_v[:, n], res1[1].asnumpy()[:, 0],
+                    rtol=1e-5, atol=1e-5)
+                if mode == "lstm":
+                    onp.testing.assert_allclose(
+                        c_v[:, n], res1[2].asnumpy()[:, 0],
+                        rtol=1e-5, atol=1e-5)
