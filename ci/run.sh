@@ -1,0 +1,85 @@
+#!/usr/bin/env bash
+# CI driver — the reference's ci/build.py + runtime_functions.sh analog
+# (SURVEY.md §2.7): every supported build/test variant behind one entry
+# point. Usage:
+#
+#   ci/run.sh native        # build libmxtpu.so + run the C++ test binary
+#   ci/run.sh unit          # full Python suite on the 8-dev virtual mesh
+#   ci/run.sh dist          # real multi-process launcher tests
+#   ci/run.sh exec-cache    # suite subset with the per-op executable
+#                           #   cache FORCED on (our sanitizer analog:
+#                           #   flushes out cache-vs-eager divergence)
+#   ci/run.sh naive-engine  # subset under MXNET_ENGINE_TYPE=NaiveEngine
+#                           #   (fully synchronous — the race-debug mode)
+#   ci/run.sh dryrun        # multichip sharding dry run + entry compile
+#   ci/run.sh tpu-sweep     # op sweep against the real chip
+#                           #   (MXNET_TEST_CTX=tpu ctx-flip)
+#   ci/run.sh all           # native + unit + dist + exec-cache +
+#                           #   naive-engine + dryrun
+set -euo pipefail
+cd "$(dirname "$0")/.."
+
+variant="${1:-all}"
+
+run_native() {
+  echo "== native: build libmxtpu.so + C++ tests"
+  make -C src
+  make -C src test
+}
+
+run_unit() {
+  echo "== unit: full Python suite (virtual CPU mesh)"
+  python -m pytest tests/ -q --ignore=tests/test_distributed.py
+}
+
+run_dist() {
+  echo "== dist: real multi-process launcher tests"
+  python -m pytest tests/test_distributed.py -q
+}
+
+run_exec_cache() {
+  echo "== exec-cache: core suite with the executable cache forced on"
+  MXNET_IMPERATIVE_EXEC_CACHE=1 python -m pytest -q \
+    tests/test_imperative_cache.py tests/test_autograd.py \
+    tests/test_ndarray.py tests/test_gluon.py tests/test_numpy.py \
+    tests/test_rnn.py tests/test_sparse.py
+}
+
+run_naive_engine() {
+  echo "== naive-engine: synchronous dispatch mode"
+  MXNET_ENGINE_TYPE=NaiveEngine python -m pytest -q \
+    tests/test_autograd.py tests/test_ndarray.py tests/test_gluon.py
+}
+
+run_dryrun() {
+  echo "== dryrun: multichip sharding + entry compile check"
+  python __graft_entry__.py
+}
+
+run_tpu_sweep() {
+  echo "== tpu-sweep: op sweep with default ctx = tpu"
+  MXNET_TEST_CTX=tpu python -m pytest tests/test_op_sweep.py -q
+}
+
+case "$variant" in
+  native)       run_native ;;
+  unit)         run_unit ;;
+  dist)         run_dist ;;
+  exec-cache)   run_exec_cache ;;
+  naive-engine) run_naive_engine ;;
+  dryrun)       run_dryrun ;;
+  tpu-sweep)    run_tpu_sweep ;;
+  all)
+    run_native
+    run_unit
+    run_dist
+    run_exec_cache
+    run_naive_engine
+    run_dryrun
+    ;;
+  *)
+    echo "unknown variant: $variant" >&2
+    exit 2
+    ;;
+esac
+echo "CI variant '$variant' PASSED"

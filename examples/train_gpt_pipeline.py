@@ -65,6 +65,7 @@ def main():
     x = mx.np.array(toks[:, :-1])
     y = mx.np.array(toks[:, 1:])
 
+    float(trainer.step(x, y).asnumpy())   # compile, off the clock
     t0 = time.perf_counter()
     for step in range(args.steps):
         loss = trainer.step(x, y)
