@@ -68,8 +68,8 @@ def main():
           bool(jnp.isfinite(g.astype(jnp.float32)).all()))
 
     # steady-state timing (scalar outputs — large outputs would stream
-    # back through the remote tunnel and corrupt the number)
-    float(step(q, bias))                   # warm-up, blocked off the clock
+    # back through the remote tunnel and corrupt the number). step() is
+    # already compiled and blocked above.
     t0 = time.perf_counter()
     n = 10
     for _ in range(n):

@@ -440,29 +440,45 @@ def _dense_reference(q, k, v, scale: float, causal: bool, bias=None):
                       v.astype(jnp.float32)).astype(q.dtype)
 
 
+def _interpret_for(*arrays) -> bool:
+    """Should the kernels run in interpret mode?
+
+    Concrete (eager) operands: decide by their committed device — under
+    the axon tunnel, eager default-ctx arrays live on XLA:CPU even though
+    ``jax.default_backend()`` says tpu, and a Mosaic lowering there would
+    fail. Tracers: jit compiles for the default backend. (A jit whose
+    ARGUMENTS are host-committed still lowers for CPU with tracers inside
+    — callers targeting the chip must device_put their args, as
+    __graft_entry__.entry does.)"""
+    for a in arrays:
+        if isinstance(a, jax.Array) and not isinstance(a, jax.core.Tracer):
+            try:
+                return next(iter(a.devices())).platform == "cpu"
+            except Exception:
+                continue
+    return jax.default_backend() == "cpu"
+
+
 @functools.partial(jax.custom_vjp, nondiff_argnums=(5, 6, 7, 8, 9, 10))
 def _flash2(q, k, v, bias, seed, rate, scale, causal, block_q, block_k,
             bias_grad=True):
-    interpret = jax.default_backend() == "cpu"
     out, _ = _flash_forward(q, k, v, bias, seed, scale, causal, block_q,
-                            block_k, rate, interpret)
+                            block_k, rate, _interpret_for(q))
     return out
 
 
 def _flash2_fwd(q, k, v, bias, seed, rate, scale, causal, block_q,
                 block_k, bias_grad=True):
-    interpret = jax.default_backend() == "cpu"
     out, lse = _flash_forward(q, k, v, bias, seed, scale, causal, block_q,
-                              block_k, rate, interpret)
+                              block_k, rate, _interpret_for(q))
     return out, (q, k, v, bias, seed, out, lse)
 
 
 def _flash2_bwd(rate, scale, causal, block_q, block_k, bias_grad, res, g):
     q, k, v, bias, seed, o, lse = res
-    interpret = jax.default_backend() == "cpu"
     dq, dk, dv, d_bias = _flash_backward(
         q, k, v, bias, seed, o, lse, g, scale, causal, block_q, block_k,
-        rate, interpret, bias_grad=bias_grad)
+        rate, _interpret_for(q, g), bias_grad=bias_grad)
     if d_bias is None and bias is not None:
         d_bias = jnp.zeros_like(bias)
     d_seed = None if seed is None else \
@@ -506,8 +522,10 @@ def flash_attention(q, k, v, scale: Optional[float] = None,
     rate = float(dropout)
     if rate > 0 and dropout_seed is None:
         raise ValueError("flash_attention: dropout > 0 needs dropout_seed")
-    if rate > 0 and jax.default_backend() == "cpu":
-        # dense differentiable fallback with jax-level dropout
+    if rate > 0 and _interpret_for(qt):
+        # dense differentiable fallback with jax-level dropout — same
+        # platform decision as the kernels (the TPU PRNG has no
+        # interpret-mode implementation)
         out = dense_dropout_attention_bhtd(
             qt, kt, vt, bias, jnp.asarray(dropout_seed, jnp.int32), rate,
             float(scale), bool(causal))
