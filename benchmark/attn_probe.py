@@ -1,0 +1,132 @@
+"""Isolated attention A/B probe on the real chip.
+
+Times fwd(+bwd) of dot-product attention variants at a given shape, with
+the tunnel measurement rules applied (memory: axon-tunnel-perf-traps):
+ITERS steps run inside ONE jit via lax.scan and only a scalar returns, so
+neither per-call dispatch (~120 ms) nor output streaming pollutes the
+numbers. Two warmup calls absorb compile + first-execution relayout.
+
+Usage:
+  python benchmark/attn_probe.py --T 1024 2048 4096 --phase fwdbwd
+Variants: xla (jax.nn.dot_product_attention), flash:BQxBK (our Pallas
+kernel), jaxref (jax's bundled pallas flash kernel, probe-only target).
+"""
+import argparse
+import functools
+import os
+import sys
+import time
+
+sys.path.insert(0, os.path.dirname(os.path.dirname(os.path.abspath(__file__))))
+
+import jax
+import jax.numpy as jnp
+import numpy as onp
+from jax import lax
+
+
+def make_inputs(B, H, T, D, dtype):
+    rng = onp.random.RandomState(0)
+    dev = jax.devices()[0]
+    mk = lambda: jax.device_put(
+        jnp.asarray(rng.uniform(-1, 1, (B, H, T, D)).astype(onp.float32),
+                    dtype=dtype), dev)
+    return mk(), mk(), mk()
+
+
+def xla_attn(q, k, v):
+    # operates in (B, T, H, D); our probe arrays are (B, H, T, D)
+    qt, kt, vt = (jnp.swapaxes(a, 1, 2) for a in (q, k, v))
+    o = jax.nn.dot_product_attention(qt, kt, vt, is_causal=True)
+    return jnp.swapaxes(o, 1, 2)
+
+
+def ours(q, k, v, bq, bk):
+    from mxnet_tpu.ops.pallas.attention import _flash2
+    return _flash2(q, k, v, None, None, 0.0, 1.0 / (q.shape[-1] ** 0.5),
+                   True, bq, bk, False)
+
+
+def jaxref(q, k, v):
+    from jax.experimental.pallas.ops.tpu.flash_attention import (
+        flash_attention as ja)
+    return ja(q, k, v, causal=True,
+              sm_scale=float(1.0 / (q.shape[-1] ** 0.5)))
+
+
+def timed(fn, q, k, v, iters, phase):
+    if phase == "fwd":
+        def one(c, _):
+            qq, kk, vv = c
+            o = fn(qq, kk, vv)
+            return (qq + 1e-6 * o, kk, vv), jnp.float32(0)
+    else:
+        def loss(qq, kk, vv):
+            return jnp.sum(fn(qq, kk, vv).astype(jnp.float32))
+        g = jax.grad(loss, argnums=(0, 1, 2))
+
+        def one(c, _):
+            qq, kk, vv = c
+            dq, dk, dv = g(qq, kk, vv)
+            return (qq + 1e-6 * dq, kk + 1e-6 * dk, vv + 1e-6 * dv), \
+                jnp.float32(0)
+
+    def run(qq, kk, vv):
+        (qq, kk, vv), _ = lax.scan(one, (qq, kk, vv), None, length=iters)
+        return jnp.sum(qq[0, 0, 0]).astype(jnp.float32)
+
+    jr = jax.jit(run)
+    for _ in range(2):
+        float(jr(q, k, v))          # compile + relayout warmup
+    best = float("inf")
+    for _ in range(3):
+        t0 = time.perf_counter()
+        float(jr(q, k, v))
+        best = min(best, (time.perf_counter() - t0) / iters)
+    return best
+
+
+def main():
+    ap = argparse.ArgumentParser()
+    ap.add_argument("--B", type=int, default=8)
+    ap.add_argument("--H", type=int, default=12)
+    ap.add_argument("--D", type=int, default=64)
+    ap.add_argument("--T", type=int, nargs="+", default=[1024])
+    ap.add_argument("--iters", type=int, default=20)
+    ap.add_argument("--phase", default="fwdbwd", choices=["fwd", "fwdbwd"])
+    ap.add_argument("--variants", nargs="+",
+                    default=["xla", "flash:128x128", "flash:256x256",
+                             "flash:512x512", "flash:256x512", "jaxref"])
+    ap.add_argument("--dtype", default="bfloat16")
+    args = ap.parse_args()
+
+    dtype = jnp.dtype(args.dtype)
+    for T in args.T:
+        q, k, v = make_inputs(args.B, args.H, T, args.D, dtype)
+        # causal fwd flops: 2 matmuls * B*H*T^2*D*2 / 2 (causal half)
+        flops = args.B * args.H * T * T * args.D * 4 / 2
+        if args.phase == "fwdbwd":
+            flops *= 3.5            # dq + dkv recompute + 5 matmuls bwd
+        for name in args.variants:
+            if name == "xla":
+                fn = xla_attn
+            elif name == "jaxref":
+                fn = jaxref
+            elif name.startswith("flash:"):
+                bq, bk = map(int, name.split(":")[1].split("x"))
+                if bq > T or bk > T:
+                    continue
+                fn = functools.partial(ours, bq=bq, bk=bk)
+            else:
+                raise SystemExit(f"unknown variant {name}")
+            try:
+                dt = timed(fn, q, k, v, args.iters, args.phase)
+                print(f"T={T:5d} {name:14s} {dt * 1e3:8.3f} ms/step "
+                      f"{flops / dt / 1e12:6.1f} TFLOP/s", flush=True)
+            except Exception as e:
+                print(f"T={T:5d} {name:14s} FAILED: {type(e).__name__}: "
+                      f"{str(e)[:200]}", flush=True)
+
+
+if __name__ == "__main__":
+    main()

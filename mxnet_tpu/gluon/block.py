@@ -62,7 +62,8 @@ class _ParamDict(OrderedDict):
     def save(self, filename: str) -> None:
         from ..ndarray_io import save_params
         save_params(filename, {k: v.data() for k, v in self.items()
-                               if v.is_initialized})
+                               if v.is_initialized
+                               and getattr(v, "persistent", True)})
 
     def load(self, filename: str, ctx: Any = None,
              allow_missing: bool = False,
@@ -72,7 +73,7 @@ class _ParamDict(OrderedDict):
         for k, p in self.items():
             if k in loaded:
                 p.set_data(loaded[k])
-            elif not allow_missing:
+            elif not allow_missing and getattr(p, "persistent", True):
                 raise MXNetError(f"Parameter {k} missing in file {filename}")
         if not ignore_extra:
             extra = set(loaded) - set(self)
@@ -202,19 +203,43 @@ class Block:
         return s + ("\n)" if self._children else ")")
 
 
+# bumped by layers whose HOST-side state changes the traced program
+# (BatchNorm cold-start bootstrap): cached executables fold the epoch
+# into their key, so the next call re-traces instead of replaying a
+# stale graph
+_GRAPH_EPOCH = [0]
+
+
+def graph_epoch() -> int:
+    return _GRAPH_EPOCH[0]
+
+
+def invalidate_cached_graphs() -> None:
+    _GRAPH_EPOCH[0] += 1
+
+
 @contextlib.contextmanager
 def _bind_params(params: Sequence[Parameter], arrays: Sequence[Any]):
     """Temporarily swap parameter buffers for traced arrays during jit
-    tracing (how one forward implementation serves both runtimes)."""
+    tracing (how one forward implementation serves both runtimes).
+
+    The concrete buffer is kept reachable as ``_concrete_shadow`` so
+    host-side layer logic that must inspect actual VALUES mid-trace
+    (BatchNorm virgin-stats resolution) can still see them."""
     saved = []
     for p, a in zip(params, arrays):
         saved.append(p._data._data)
+        p._data._concrete_shadow = p._data._data
         p._data._data = a
     try:
         yield
     finally:
         for p, s in zip(params, saved):
             p._data._data = s
+            try:
+                del p._data._concrete_shadow
+            except AttributeError:
+                pass
 
 
 def _collect_mutated(params: Sequence[Parameter],
@@ -334,6 +359,12 @@ class HybridBlock(Block):
         if _amp_state["active"]:
             from ..amp import _STATE as _amp
             amp_key = str(_amp["target_dtype"])
+        # a bumped epoch invalidates by CLEARING this block's cache (not
+        # by keying on the epoch, which would strand the old compiled
+        # executables in the dict for the block's lifetime)
+        if getattr(self, "_cache_epoch", None) != _GRAPH_EPOCH[0]:
+            self._cached_graph.clear()
+            self._cache_epoch = _GRAPH_EPOCH[0]
         key_sig = (tuple((tuple(a.shape), str(a.dtype)) for a in nd_args),
                    train, amp_key)
         entry = self._cached_graph.get(key_sig)

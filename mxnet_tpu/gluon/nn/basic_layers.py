@@ -182,26 +182,82 @@ class BatchNorm(HybridBlock):
         self.running_var = Parameter("running_var", shape=(in_channels,),
                                      init=running_variance_initializer,
                                      differentiable=False)
+        # variance-shift buffer for the fused one-pass training stats
+        # (ops/nn.py _bn_train_math): holds the PREVIOUS batch's mean —
+        # always ~E[x], so the shifted variance never catastrophically
+        # cancels, independent of running-mean warm-up. Runtime-only
+        # state: excluded from .params files (persistent=False) and
+        # rebuilt from the first batch after any load. The very first
+        # training forward (virgin shift) uses centered stats instead.
+        self.stat_shift = Parameter("stat_shift", shape=(in_channels,),
+                                    init="zeros", differentiable=False,
+                                    persistent=False)
+        self._stats_virgin: Optional[bool] = None
+        self._virgin_for: Any = None  # weakref to the resolved buffer
+
+    def _resolve_virgin_stats(self) -> bool:
+        # the cached verdict is tied to the buffer OBJECT: initialize(
+        # force_reinit=True) swaps in a fresh zero NDArray, which must
+        # re-trigger the virgin (centered) step — a stale False here
+        # would re-expose the cold-start cancellation
+        arr = self.stat_shift.data()
+        prev = self._virgin_for() if self._virgin_for is not None else None
+        if prev is not arr:
+            self._stats_virgin = None
+        if self._stats_virgin is None:
+            import jax
+            import weakref
+            self._virgin_for = weakref.ref(arr)
+            sh = arr._data
+            if isinstance(sh, jax.core.Tracer):
+                # mid-trace: inspect the concrete buffer _bind_params
+                # stashed (hybridize / SPMDTrainer both bind through it)
+                sh = getattr(arr, "_concrete_shadow", None)
+            if sh is None or isinstance(sh, jax.core.Tracer):
+                return False  # no host value in reach: assume warm
+            import numpy as onp
+            try:
+                self._stats_virgin = not onp.asarray(sh).any()
+            except Exception:
+                # e.g. non-addressable multi-process array: assume warm
+                # (MXNET_BN_STATS=centered is the escape hatch)
+                self._stats_virgin = False
+        return self._stats_virgin
 
     def forward(self, x: NDArray) -> NDArray:
         from ... import autograd
         c = x.shape[self._axis]
-        for p in (self.gamma, self.beta, self.running_mean, self.running_var):
+        for p in (self.gamma, self.beta, self.running_mean,
+                  self.running_var, self.stat_shift):
             if not p.is_initialized:
                 p._finish_deferred_init((c,))
         training = autograd.is_training() and not self._use_global_stats
+        virgin = training and self._resolve_virgin_stats()
         out, batch_mean, batch_var = npx.batch_norm(
             x, self.gamma.data(), self.beta.data(),
             self.running_mean.data(), self.running_var.data(),
             eps=self._epsilon, momentum=self._momentum,
             fix_gamma=not self._scale, axis=self._axis,
-            use_global_stats=self._use_global_stats)
+            use_global_stats=self._use_global_stats,
+            stats="centered" if virgin else None,
+            shift=self.stat_shift.data())
         if training:
             # side-effecting moving-average update, off the tape
+            # (reference momentum recursion, preserved exactly)
             m = self._momentum
             rm, rv = self.running_mean.data(), self.running_var.data()
             rm._data = m * rm._data + (1 - m) * batch_mean.detach()._data
             rv._data = m * rv._data + (1 - m) * batch_var.detach()._data
+            # shift buffer tracks the last batch mean (no blending: it
+            # only needs to be NEAR E[x] for numerical stability)
+            sh = self.stat_shift.data()
+            sh._data = batch_mean.detach()._data.astype(sh._data.dtype)
+            if virgin:
+                self._stats_virgin = False
+                # the centered first-step graph runs exactly once:
+                # cached executables must re-trace onto the shifted path
+                from ..block import invalidate_cached_graphs
+                invalidate_cached_graphs()
         return out
 
     def __repr__(self) -> str:

@@ -52,7 +52,12 @@ class Parameter:
                  wd_mult: float = 1.0, init: Any = None,
                  allow_deferred_init: bool = True,
                  differentiable: bool = True, stype: str = "default",
-                 grad_stype: str = "default") -> None:
+                 grad_stype: str = "default",
+                 persistent: bool = True) -> None:
+        # persistent=False: runtime-only state excluded from .params
+        # files (e.g. BatchNorm's stat-shift buffer) — torch's
+        # register_buffer(persistent=False) notion; absent on load
+        self.persistent = persistent
         self._name = name
         if isinstance(shape, int):
             shape = (shape,)

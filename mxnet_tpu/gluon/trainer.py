@@ -96,6 +96,7 @@ class Trainer:
             self._init_kvstore()
         if self._kvstore is None:
             return
+        keys, grads = [], []
         for i, p in enumerate(self._params):
             if p.grad_req != "null" and p.is_initialized:
                 g = p.data().grad
@@ -104,8 +105,13 @@ class Trainer:
                     # multi-worker aggregation uses row_sparse_pull
                     # semantics (reference: Trainer._row_sparse_pull)
                     continue
-                self._kvstore.push(i, g)
-                self._kvstore.pull(i, out=g)
+                keys.append(i)
+                grads.append(g)
+        if keys:
+            # one batched push: KVStoreICI fuses the small gradients into
+            # bucket collectives instead of one collective per parameter
+            self._kvstore.push(keys, grads)
+            self._kvstore.pull(keys, out=grads)
 
     def step(self, batch_size: int, ignore_stale_grad: bool = False) -> None:
         """Rescale grads by 1/batch_size and apply one optimizer update."""

@@ -65,8 +65,12 @@ class NDArray:
     from any array-like is also supported: ``NDArray([[1, 2], [3, 4]])``.
     """
 
+    # _concrete_shadow: the concrete buffer while _data is temporarily a
+    # tracer under gluon._bind_params (host-side layer logic — BatchNorm
+    # virgin-stats resolution — inspects values mid-trace through it)
     __slots__ = ("_data", "_ctx", "_ag_node", "_ag_out_idx", "_grad",
-                 "_grad_req", "_fresh_grad", "__weakref__")
+                 "_grad_req", "_fresh_grad", "_concrete_shadow",
+                 "__weakref__")
 
     # numpy interop priority (beats np.ndarray in mixed expressions)
     __array_priority__ = 1000.0

@@ -41,9 +41,34 @@ _profiler_state = {"on": False}
 # id -> hook fn; multiple Monitors may collect concurrently
 _monitor_state = {"hooks": {}}
 
-# flipped by SPMDTrainer once any parameter is placed on a multi-device
-# mesh; single-device programs never pay the per-op sharding scan
-_mesh_state = {"active": False}
+# flipped on while any multi-device-sharded array is alive (see
+# mark_mesh_resident); single-device programs never pay the per-op
+# sharding scan, and the flag drops back off once the last mesh-resident
+# buffer is garbage-collected (a discarded GPTPipe doesn't tax every
+# later eager op)
+_mesh_state = {"active": False, "live": 0, "pinned": False}
+
+
+def mark_mesh_resident(holder) -> None:
+    """Track ``holder`` — an object whose lifetime upper-bounds some
+    multi-device-sharded buffer (the NDArray wrapper of a mesh-placed
+    parameter, a mesh-sharded op output, a raw mesh array): the per-op
+    harmonization scan stays enabled only while at least one such holder
+    is alive. Register wrappers rather than raw buffers when the buffer
+    is swapped in place every step (SPMDTrainer parameters)."""
+    _mesh_state["active"] = True
+    try:
+        weakref.finalize(holder, _mesh_release)
+        _mesh_state["live"] += 1
+    except TypeError:
+        # not weakref-able: latch conservatively (previous behavior)
+        _mesh_state["pinned"] = True
+
+
+def _mesh_release() -> None:
+    _mesh_state["live"] -= 1
+    if _mesh_state["live"] <= 0 and not _mesh_state["pinned"]:
+        _mesh_state["active"] = False
 
 # ---------------------------------------------------------------------------
 # TPU-resident imperative mode: per-op executable cache
@@ -378,6 +403,18 @@ def invoke(name: str, impl: Callable, inputs: Sequence[Any],
     outs_t = (outs,) if single else tuple(outs)
 
     wrapped = [wrap_out(o, ctx=ctx) for o in outs_t]
+
+    if _mesh_state["active"]:
+        # mesh-sharded outputs keep the harmonization scan alive for as
+        # long as THEY live (downstream eager ops still mix them with
+        # fresh single-device arrays after the producing trainer/pipeline
+        # is discarded)
+        for w in wrapped:
+            o = w._data
+            if isinstance(o, jax.Array) and not isinstance(
+                    o, jax.core.Tracer) \
+                    and getattr(o.sharding, "num_devices", 1) > 1:
+                mark_mesh_resident(w)
 
     if record:
         avals = [(tuple(o.shape), o.dtype) for o in outs_t]

@@ -32,9 +32,9 @@ from ..ndarray import random as _random
 
 register_env("MXNET_BN_STATS", "shifted",
              "Training BatchNorm statistics: 'shifted' (default — one "
-             "fused sweep, variance about the running mean) or "
-             "'centered' (classic two-pass; unconditionally stable for "
-             "inputs whose |mean|/std exceeds ~900).")
+             "fused sweep, variance about a batch-slice mean; stable "
+             "for any input statistics) or 'centered' (classic "
+             "two-pass; one extra full sweep over the activation).")
 register_env("MXNET_CONV_S2D", "1",
              "Rewrite stride-2 small-channel NCHW stem convolutions via "
              "space-to-depth (exact; better MXU lane utilization). "
@@ -497,11 +497,15 @@ def _bn_train_math(red_axes, eps, centered_stats, x, g, b, shift):
 
     Default (``centered_stats=False``): ONE fused f32 sweep computes
     E[x-s] and E[(x-s)^2] about ``shift`` (the layer's running mean —
-    already an op input, costs nothing). The naive unshifted one-pass
+    already an op input, so the reduction starts immediately; ANY
+    x-derived shift was measured to serialize a pre-pass and cost
+    15-20% of a ResNet-50 step). The naive unshifted one-pass
     E[x^2]-E[x]^2 catastrophically cancels for large-mean inputs; the
-    shift bounds the cancellation by |E[x]-shift|, which tracks ~0 once
-    the running mean warms up (and BN inputs are near-zero-mean conv
-    outputs anyway). Exact in infinite precision regardless of shift.
+    shift bounds the cancellation by |E[x]-shift|/std, which the gluon
+    layer keeps ~0 by passing its stat-shift buffer (the PREVIOUS
+    batch's mean) and using centered stats for the one virgin-shift
+    forward (the fix for the round-2 advisor cold-start finding).
+    Exact in infinite precision regardless of shift.
 
     ``centered_stats=True`` (``MXNET_BN_STATS=centered``): classic
     mean-then-E[(x-m)^2] — unconditionally stable, but the variance
@@ -572,7 +576,8 @@ _bn_train_core.defvjp(_bn_train_fwd, _bn_train_bwd)
 def batch_norm(data, gamma, beta, running_mean, running_var,
                eps: float = 1e-5, momentum: float = 0.9,
                fix_gamma: bool = False, use_global_stats: bool = False,
-               axis: int = 1, training: Optional[bool] = None):
+               axis: int = 1, training: Optional[bool] = None,
+               stats: Optional[str] = None, shift=None):
     """BatchNorm forward. Returns (out, batch_mean, batch_var).
 
     The moving-stat update is done by the caller (gluon BatchNorm layer)
@@ -592,13 +597,24 @@ def batch_norm(data, gamma, beta, running_mean, running_var,
 
     red_axes = tuple(i for i in range(nd.ndim) if i != ax)
 
-    centered_stats = getenv("MXNET_BN_STATS", "shifted") == "centered"
+    # stats: per-call override for the training statistics scheme — the
+    # gluon layer forces 'centered' on its first (virgin-shift) training
+    # forward so the shifted one-pass never sees a cold shift.
+    # shift: explicit variance-shift vector for the one-pass stats (the
+    # gluon layer passes its stat-shift buffer = the previous batch's
+    # mean, always ~E[x]); defaults to the running mean for direct op
+    # callers.
+    if stats is None:
+        stats = getenv("MXNET_BN_STATS", "shifted")
+    centered_stats = stats == "centered"
+    has_shift = shift is not None
 
-    def impl(x, g, b, rm, rv):
+    def impl(x, g, b, rm, rv, *rest):
         gg = jnp.ones_like(g) if fg else g
         if use_batch_stats:
+            sh = rest[0] if has_shift else rm
             out, m, v = _bn_train_core(red_axes, ep, centered_stats,
-                                       x, gg, b, rm)
+                                       x, gg, b, sh)
             # stats return in the running-stat dtype so the layer's
             # moving-average update cannot silently promote rm/rv
             # (and thus eval-mode outputs) to f32 on a bf16-cast model
@@ -610,9 +626,11 @@ def batch_norm(data, gamma, beta, running_mean, running_var,
             + b.reshape(shape)
         return out, rm, rv
 
-    return invoke("batch_norm", impl,
-                  (nd, _as_nd(gamma), _as_nd(beta),
-                   _as_nd(running_mean), _as_nd(running_var)))
+    inputs = (nd, _as_nd(gamma), _as_nd(beta),
+              _as_nd(running_mean), _as_nd(running_var))
+    if has_shift:
+        inputs = inputs + (_as_nd(shift),)
+    return invoke("batch_norm", impl, inputs)
 
 
 def layer_norm(data, gamma, beta, axis: int = -1, eps: float = 1e-5):

@@ -34,6 +34,11 @@ from jax.experimental.pallas import tpu as pltpu
 
 _NEG_INF = -1e30
 
+# all three kernels accumulate over their LAST grid axis only; telling
+# Mosaic the rest are parallel lets it pipeline/reorder grid steps
+_GRID_SEMANTICS = pltpu.CompilerParams(
+    dimension_semantics=("parallel", "parallel", "parallel", "arbitrary"))
+
 
 def _bias_spec(bias_shape, block_q, block_k):
     Bb, Hb = bias_shape[0], bias_shape[1]
@@ -56,6 +61,46 @@ def _dropout_keep(seed_ref, b, h, iq, ik, rate, shape):
     bits = pltpu.prng_random_bits(shape)
     threshold = jnp.uint32(min(0xFFFFFFFF, int(rate * 4294967296.0)))
     return bits.astype(jnp.uint32) >= threshold
+
+
+def _causal_branches(causal, iq, ik, block_q, block_k, kv_len, tile,
+                     skipped=None):
+    """Dispatch one grid step to the right specialization of ``tile``:
+
+    - fully-masked tiles (above the causal diagonal) execute NOTHING —
+      at T=1024/128-blocks this halves the kernel's matmul work, the
+      reason a causal flash kernel can beat XLA's full-T² attention;
+    - interior tiles (fully below the diagonal, inside kv range) skip
+      the iota/compare/where masking entirely;
+    - only diagonal-straddling or kv-padded tiles pay the masked path.
+    All conditions are scalar functions of the grid ids, so Mosaic
+    executes exactly one branch per step."""
+    need_kv = (ik + 1) * block_k > kv_len
+    if causal:
+        live = ik * block_k <= (iq + 1) * block_q - 1
+        need_mask = jnp.logical_or(
+            (ik + 1) * block_k - 1 > iq * block_q, need_kv)
+
+        @pl.when(jnp.logical_and(live, jnp.logical_not(need_mask)))
+        def _fast():
+            tile(False)
+
+        @pl.when(jnp.logical_and(live, need_mask))
+        def _masked():
+            tile(True)
+
+        if skipped is not None:
+            @pl.when(jnp.logical_not(live))
+            def _skip():
+                skipped()
+    else:
+        @pl.when(jnp.logical_not(need_kv))
+        def _fast():
+            tile(False)
+
+        @pl.when(need_kv)
+        def _masked():
+            tile(True)
 
 
 def _flash_fwd_kernel(*refs, scale: float, causal: bool, block_q: int,
@@ -81,39 +126,42 @@ def _flash_fwd_kernel(*refs, scale: float, causal: bool, block_q: int,
         m_ref[...] = jnp.full_like(m_ref, _NEG_INF)
         l_ref[...] = jnp.zeros_like(l_ref)
 
-    q = q_ref[0, 0].astype(jnp.float32) * scale       # (bq, d)
-    k = k_ref[0, 0].astype(jnp.float32)               # (bk, d)
-    v = v_ref[0, 0].astype(jnp.float32)               # (bk, d)
+    def tile(apply_mask):
+        q = q_ref[0, 0]                               # (bq, d) input dtype
+        k = k_ref[0, 0]                               # (bk, d)
+        v = v_ref[0, 0]                               # (bk, d)
+        # matmuls run in the INPUT dtype (bf16 MXU rate is 2-4x f32) with
+        # f32 accumulation; scale applies to the f32 product
+        s = jax.lax.dot_general(q, k, (((1,), (1,)), ((), ())),
+                                preferred_element_type=jnp.float32) * scale
+        if has_bias:
+            s = s + bias_ref[0, 0].astype(jnp.float32)
+        if apply_mask:
+            col = ik * block_k + jax.lax.broadcasted_iota(
+                jnp.int32, s.shape, 1)
+            mask = col < kv_len
+            if causal:
+                row = iq * block_q + jax.lax.broadcasted_iota(
+                    jnp.int32, s.shape, 0)
+                mask = jnp.logical_and(mask, col <= row)
+            s = jnp.where(mask, s, _NEG_INF)
 
-    s = jax.lax.dot_general(q, k, (((1,), (1,)), ((), ())),
-                            preferred_element_type=jnp.float32)  # (bq, bk)
-    if has_bias:
-        s = s + bias_ref[0, 0].astype(jnp.float32)
+        m_prev = m_ref[...]                           # (bq, 1)
+        l_prev = l_ref[...]
+        m_cur = jnp.max(s, axis=1, keepdims=True)
+        m_new = jnp.maximum(m_prev, m_cur)
+        alpha = jnp.exp(m_prev - m_new)
+        p = jnp.exp(s - m_new)                        # (bq, bk) f32
+        l_ref[...] = alpha * l_prev + jnp.sum(p, axis=1, keepdims=True)
+        if rate > 0:
+            keep = _dropout_keep(seed_ref, b, h, iq, ik, rate, p.shape)
+            p = jnp.where(keep, p / (1.0 - rate), 0.0)
+        acc_ref[...] = acc_ref[...] * alpha + jax.lax.dot_general(
+            p.astype(v.dtype), v, (((1,), (0,)), ((), ())),
+            preferred_element_type=jnp.float32)
+        m_ref[...] = m_new
 
-    # mask out-of-range (padded) kv columns, and the future when causal
-    col = ik * block_k + jax.lax.broadcasted_iota(jnp.int32, s.shape, 1)
-    mask = col < kv_len
-    if causal:
-        row = iq * block_q + jax.lax.broadcasted_iota(jnp.int32, s.shape, 0)
-        mask = jnp.logical_and(mask, col <= row)
-    s = jnp.where(mask, s, _NEG_INF)
-
-    m_prev = m_ref[...]                               # (bq, 1)
-    l_prev = l_ref[...]
-    m_cur = jnp.max(s, axis=1, keepdims=True)
-    m_new = jnp.maximum(m_prev, m_cur)
-    alpha = jnp.exp(m_prev - m_new)
-    p = jnp.exp(s - m_new)                            # (bq, bk)
-    l_new = alpha * l_prev + jnp.sum(p, axis=1, keepdims=True)
-    p_acc = p
-    if rate > 0:
-        keep = _dropout_keep(seed_ref, b, h, iq, ik, rate, p.shape)
-        p_acc = jnp.where(keep, p / (1.0 - rate), 0.0)
-    acc_ref[...] = acc_ref[...] * alpha + jax.lax.dot_general(
-        p_acc, v, (((1,), (0,)), ((), ())),
-        preferred_element_type=jnp.float32)
-    m_ref[...] = m_new
-    l_ref[...] = l_new
+    _causal_branches(causal, iq, ik, block_q, block_k, kv_len, tile)
 
     @pl.when(ik == num_k_blocks - 1)
     def _finish():
@@ -190,6 +238,7 @@ def _flash_forward(q, k, v, bias, seed, scale: float, causal: bool,
             pltpu.VMEM((block_q, 1), jnp.float32),   # running denom
         ],
         interpret=interpret,
+        compiler_params=_GRID_SEMANTICS,
     )(*args)
     return out[:, :, :Tq], lse[:, :, :Tq]
 
@@ -217,35 +266,47 @@ def _flash_bwd_dq_kernel(*refs, scale: float, causal: bool, block_q: int,
     def _init():
         dq_acc[...] = jnp.zeros_like(dq_acc)
 
-    q = q_ref[0, 0].astype(jnp.float32)                # (bq, d)
-    k = k_ref[0, 0].astype(jnp.float32)                # (bk, d)
-    v = v_ref[0, 0].astype(jnp.float32)
-    do = do_ref[0, 0].astype(jnp.float32)              # (bq, d)
-    lse = lse_ref[0, 0]                                # (bq, 1)
-    delta = delta_ref[0, 0]                            # (bq, 1)
+    def tile(apply_mask):
+        q = q_ref[0, 0]                                # (bq, d) input dtype
+        k = k_ref[0, 0]                                # (bk, d)
+        v = v_ref[0, 0]
+        do = do_ref[0, 0]                              # (bq, d)
+        lse = lse_ref[0, 0]                            # (bq, 1)
+        delta = delta_ref[0, 0]                        # (bq, 1)
 
-    s = jax.lax.dot_general(q, k, (((1,), (1,)), ((), ())),
-                            preferred_element_type=jnp.float32) * scale
-    if has_bias:
-        s = s + bias_ref[0, 0].astype(jnp.float32)
-    col = ik * block_k + jax.lax.broadcasted_iota(jnp.int32, s.shape, 1)
-    mask = col < kv_len
-    if causal:
-        row = iq * block_q + jax.lax.broadcasted_iota(jnp.int32, s.shape, 0)
-        mask = jnp.logical_and(mask, col <= row)
-    p = jnp.where(mask, jnp.exp(s - lse), 0.0)         # (bq, bk)
-    dp = jax.lax.dot_general(do, v, (((1,), (1,)), ((), ())),
-                             preferred_element_type=jnp.float32)
-    if rate > 0:
-        keep = _dropout_keep(seed_ref, b, h, iq, ik, rate, p.shape)
-        dp = jnp.where(keep, dp / (1.0 - rate), 0.0)
-    ds0 = p * (dp - delta)                              # dsoftmax (no scale)
-    if emit_ds:
-        ds_ref[0, 0] = ds0.astype(ds_ref.dtype)
-    ds = ds0 * scale
-    dq_acc[...] += jax.lax.dot_general(
-        ds, k, (((1,), (0,)), ((), ())),
-        preferred_element_type=jnp.float32)
+        s = jax.lax.dot_general(q, k, (((1,), (1,)), ((), ())),
+                                preferred_element_type=jnp.float32) * scale
+        if has_bias:
+            s = s + bias_ref[0, 0].astype(jnp.float32)
+        p = jnp.exp(s - lse)                           # (bq, bk) f32
+        if apply_mask:
+            col = ik * block_k + jax.lax.broadcasted_iota(
+                jnp.int32, s.shape, 1)
+            mask = col < kv_len
+            if causal:
+                row = iq * block_q + jax.lax.broadcasted_iota(
+                    jnp.int32, s.shape, 0)
+                mask = jnp.logical_and(mask, col <= row)
+            p = jnp.where(mask, p, 0.0)
+        dp = jax.lax.dot_general(do, v, (((1,), (1,)), ((), ())),
+                                 preferred_element_type=jnp.float32)
+        if rate > 0:
+            keep = _dropout_keep(seed_ref, b, h, iq, ik, rate, p.shape)
+            dp = jnp.where(keep, dp / (1.0 - rate), 0.0)
+        ds0 = p * (dp - delta)                         # dsoftmax (no scale)
+        if emit_ds:
+            ds_ref[0, 0] = ds0.astype(ds_ref.dtype)
+        ds = (ds0 * scale).astype(k.dtype)
+        dq_acc[...] += jax.lax.dot_general(
+            ds, k, (((1,), (0,)), ((), ())),
+            preferred_element_type=jnp.float32)
+
+    def skipped():
+        if emit_ds:
+            ds_ref[0, 0] = jnp.zeros_like(ds_ref[0, 0])
+
+    _causal_branches(causal, iq, ik, block_q, block_k, kv_len, tile,
+                     skipped=skipped if emit_ds else None)
 
     @pl.when(ik == num_k_blocks - 1)
     def _finish():
@@ -273,40 +334,47 @@ def _flash_bwd_dkv_kernel(*refs, scale: float, causal: bool, block_q: int,
         dk_acc[...] = jnp.zeros_like(dk_acc)
         dv_acc[...] = jnp.zeros_like(dv_acc)
 
-    q = q_ref[0, 0].astype(jnp.float32)                # (bq, d)
-    k = k_ref[0, 0].astype(jnp.float32)                # (bk, d)
-    v = v_ref[0, 0].astype(jnp.float32)
-    do = do_ref[0, 0].astype(jnp.float32)
-    lse = lse_ref[0, 0]
-    delta = delta_ref[0, 0]
+    def tile(apply_mask):
+        q = q_ref[0, 0]                                # (bq, d) input dtype
+        k = k_ref[0, 0]                                # (bk, d)
+        v = v_ref[0, 0]
+        do = do_ref[0, 0]
+        lse = lse_ref[0, 0]
+        delta = delta_ref[0, 0]
 
-    s = jax.lax.dot_general(q, k, (((1,), (1,)), ((), ())),
-                            preferred_element_type=jnp.float32) * scale
-    if has_bias:
-        s = s + bias_ref[0, 0].astype(jnp.float32)
-    col = ik * block_k + jax.lax.broadcasted_iota(jnp.int32, s.shape, 1)
-    mask = col < kv_len
-    if causal:
-        row = iq * block_q + jax.lax.broadcasted_iota(jnp.int32, s.shape, 0)
-        mask = jnp.logical_and(mask, col <= row)
-    p = jnp.where(mask, jnp.exp(s - lse), 0.0)         # (bq, bk)
-    p_drop = p
-    dp = jax.lax.dot_general(do, v, (((1,), (1,)), ((), ())),
-                             preferred_element_type=jnp.float32)
-    if rate > 0:
-        keep = _dropout_keep(seed_ref, b, h, iq, ik, rate, p.shape)
-        inv = 1.0 / (1.0 - rate)
-        p_drop = jnp.where(keep, p * inv, 0.0)
-        dp = jnp.where(keep, dp * inv, 0.0)
-    # dv += p_drop^T do
-    dv_acc[...] += jax.lax.dot_general(
-        p_drop, do, (((0,), (0,)), ((), ())),
-        preferred_element_type=jnp.float32)
-    ds = p * (dp - delta) * scale
-    # dk += ds^T q
-    dk_acc[...] += jax.lax.dot_general(
-        ds, q, (((0,), (0,)), ((), ())),
-        preferred_element_type=jnp.float32)
+        s = jax.lax.dot_general(q, k, (((1,), (1,)), ((), ())),
+                                preferred_element_type=jnp.float32) * scale
+        if has_bias:
+            s = s + bias_ref[0, 0].astype(jnp.float32)
+        p = jnp.exp(s - lse)                           # (bq, bk) f32
+        if apply_mask:
+            col = ik * block_k + jax.lax.broadcasted_iota(
+                jnp.int32, s.shape, 1)
+            mask = col < kv_len
+            if causal:
+                row = iq * block_q + jax.lax.broadcasted_iota(
+                    jnp.int32, s.shape, 0)
+                mask = jnp.logical_and(mask, col <= row)
+            p = jnp.where(mask, p, 0.0)
+        p_drop = p
+        dp = jax.lax.dot_general(do, v, (((1,), (1,)), ((), ())),
+                                 preferred_element_type=jnp.float32)
+        if rate > 0:
+            keep = _dropout_keep(seed_ref, b, h, iq, ik, rate, p.shape)
+            inv = 1.0 / (1.0 - rate)
+            p_drop = jnp.where(keep, p * inv, 0.0)
+            dp = jnp.where(keep, dp * inv, 0.0)
+        # dv += p_drop^T do
+        dv_acc[...] += jax.lax.dot_general(
+            p_drop.astype(do.dtype), do, (((0,), (0,)), ((), ())),
+            preferred_element_type=jnp.float32)
+        ds = (p * (dp - delta) * scale).astype(q.dtype)
+        # dk += ds^T q
+        dk_acc[...] += jax.lax.dot_general(
+            ds, q, (((0,), (0,)), ((), ())),
+            preferred_element_type=jnp.float32)
+
+    _causal_branches(causal, iq, ik, block_q, block_k, kv_len, tile)
 
     @pl.when(iq == num_q_blocks - 1)
     def _finish():
@@ -372,6 +440,7 @@ def _flash_backward(q, k, v, bias, seed, o, lse, g, scale: float,
         out_shape=out_shape if want_dbias else out_shape[0],
         scratch_shapes=[pltpu.VMEM((block_q, D), jnp.float32)],
         interpret=interpret,
+        compiler_params=_GRID_SEMANTICS,
     )(*args)
     if want_dbias:
         dq, ds_full = dq_out
@@ -411,6 +480,7 @@ def _flash_backward(q, k, v, bias, seed, o, lse, g, scale: float,
         scratch_shapes=[pltpu.VMEM((block_k, D), jnp.float32),
                         pltpu.VMEM((block_k, D), jnp.float32)],
         interpret=interpret,
+        compiler_params=_GRID_SEMANTICS,
     )(*args2)
 
     d_bias = None
@@ -489,9 +559,18 @@ def _flash2_bwd(rate, scale, causal, block_q, block_k, bias_grad, res, g):
 _flash2.defvjp(_flash2_fwd, _flash2_bwd)
 
 
+# measured optimum on v5e (benchmark/attn_probe.py sweep, r3): tall
+# q-blocks over full-width k-blocks, clamped to T per call. Single source
+# of truth — ops/transformer.py's env-var defaults read these too.
+DEFAULT_BLOCK_Q = 256
+DEFAULT_BLOCK_K = 1024
+
+
 def flash_attention(q, k, v, scale: Optional[float] = None,
-                    causal: bool = False, block_q: int = 128,
-                    block_k: int = 128, bias=None, dropout: float = 0.0,
+                    causal: bool = False,
+                    block_q: int = DEFAULT_BLOCK_Q,
+                    block_k: int = DEFAULT_BLOCK_K,
+                    bias=None, dropout: float = 0.0,
                     dropout_seed=None, bias_grad: bool = True):
     """Flash attention over (B, T, H, D) inputs (jax layout convention).
 

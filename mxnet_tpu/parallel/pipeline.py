@@ -211,7 +211,10 @@ class GPTPipe(HybridBlock):
         arr = jax.device_put(arr, sh)
         nd._data = arr
         from .. import engine
+        from ..ndarray.register import mark_mesh_resident
         engine.mark_clean(arr)
+        if sh.num_devices > 1:
+            mark_mesh_resident(nd)   # wrapper outlives per-step buffers
         return arr
 
     def forward(self, tokens):
@@ -220,11 +223,9 @@ class GPTPipe(HybridBlock):
         from ..ndarray import ops
         from .. import numpy as mxnp
         # eager ops downstream of the pipeline mix mesh-sharded activations
-        # with single-device params; enable the per-op harmonization scan
-        # only once pipeline work actually runs
-        from ..ndarray.register import _mesh_state
-        _mesh_state["active"] = True
-
+        # with single-device params; the per-op harmonization scan engages
+        # via mark_mesh_resident on each placed buffer (and disengages when
+        # the last one is collected)
         T = tokens.shape[1]
         if not self.position_weight.is_initialized:
             self.position_weight._finish_deferred_init(
@@ -253,6 +254,11 @@ class GPTPipe(HybridBlock):
         out = pipeline_apply(stage_fn, arrays, h, self._mesh,
                              axis=self._axis,
                              num_microbatches=self._n_micro)
+        if not isinstance(out, jax.core.Tracer) \
+                and getattr(out, "sharding", None) is not None \
+                and out.sharding.num_devices > 1:
+            from ..ndarray.register import mark_mesh_resident
+            mark_mesh_resident(out)
         x = self.ln_f(from_jax(out))
         w = self.word_embed.weight.data()
         return mxnp.matmul(x, w.T)

@@ -25,7 +25,7 @@ from typing import Any, Callable, Dict, List, Optional, Sequence, Tuple
 import jax
 import jax.numpy as jnp
 
-from ..base import MXNetError
+from ..base import MXNetError, getenv, register_env
 from ..ndarray.ndarray import NDArray, from_jax
 from ..ndarray import random as _random
 from .. import optimizer as opt_mod
@@ -36,6 +36,14 @@ from .mesh import make_mesh
 P = jax.sharding.PartitionSpec
 
 _INCR_FN = None  # jitted t+1 for the device-resident step counter
+
+register_env(
+    "MXNET_SPMD_REBIND_INPUTS", 0,
+    "Multi-process SPMDTrainer jobs: rebind caller NDArrays in place to "
+    "their mesh-resident (non-fully-addressable) buffers, saving the "
+    "per-step host->device transfer for re-used batches at the cost of "
+    "later host reads on the same NDArray raising. Single-process jobs "
+    "always rebind. Read per step.")
 
 
 def _global_put(a, sh):
@@ -182,9 +190,13 @@ class SPMDTrainer:
             self._param_shardings.append(sh)
         if mesh.size > 1:
             # eager ops may now mix mesh-placed params with fresh
-            # single-device arrays; enable the dispatch-path fixup
+            # single-device arrays; enable the dispatch-path fixup for
+            # as long as the placed parameter buffers live
             from ..ndarray import register as _register
-            _register._mesh_state["active"] = True
+            for p in self._params:
+                # the NDArray wrapper persists across per-step buffer
+                # swaps; its lifetime = the placed parameter's lifetime
+                _register.mark_mesh_resident(p._data)
 
         # optimizer states co-sharded with their parameter (laundered:
         # they come from eager state-creation ops)
@@ -347,6 +359,19 @@ class SPMDTrainer:
             self._raw_step_n = n_inputs
         return self._raw_step_fn
 
+    def _check_graph_epoch(self) -> None:
+        """Invalidate the compiled step when host-side layer state changed
+        the traced program (BatchNorm cold-start bootstrap runs exactly
+        once: the step after it must re-trace to the blend graph)."""
+        from ..gluon.block import graph_epoch
+        epoch = graph_epoch()
+        if getattr(self, "_graph_epoch", None) != epoch:
+            self._graph_epoch = epoch
+            self._step_fn = None
+            self._multi_fn = None
+            if hasattr(self, "_raw_step_fn"):
+                del self._raw_step_fn
+
     def _place(self, x: Any, spec: "P",
                leading_step_dim: bool = False) -> Any:
         """Put a batch input onto the mesh per ``spec`` (with an unsharded
@@ -402,12 +427,21 @@ class SPMDTrainer:
             a = jax.device_put(a, sh)           # global array: reshard
         else:
             a = _global_put(a, sh)
-        if isinstance(x, NDArray):
+        if isinstance(x, NDArray) and (
+                not multi or bool(getenv("MXNET_SPMD_REBIND_INPUTS", 0))):
             # write the mesh-resident buffer back into the caller's NDArray
             # so re-used batches skip the host->device transfer on every
-            # step (see step()/run_steps() docstrings — in multi-process
-            # jobs this makes the NDArray non-host-addressable)
+            # step. Multi-process jobs skip the rebind by default — there
+            # the buffer is non-fully-addressable and a later asnumpy()/
+            # metric read on the caller's array would raise (opt back in
+            # with MXNET_SPMD_REBIND_INPUTS=1 when inputs are step-only).
             x._data = a
+            if getattr(a, "sharding", None) is not None \
+                    and a.sharding.num_devices > 1:
+                # the caller's wrapper may outlive the trainer: keep the
+                # harmonization scan alive while it does
+                from ..ndarray.register import mark_mesh_resident
+                mark_mesh_resident(x)
         from .. import engine as _engine
         _engine.mark_clean(a)
         return a
@@ -428,6 +462,7 @@ class SPMDTrainer:
         label_arr = self._place(labels, self._label_spec,
                                 leading_step_dim=True)
         K = arrays[0].shape[0]
+        self._check_graph_epoch()
         if self._multi_fn is None:
             self._multi_fn = self._build_multi_step(len(arrays))
         rng = _random.split_key()
@@ -475,6 +510,7 @@ class SPMDTrainer:
 
         arrays = [self._place(x, self._data_spec) for x in inputs]
         label_arr = self._place(labels, self._label_spec)
+        self._check_graph_epoch()
         if self._step_fn is None:
             self._step_fn = self._build_step(len(arrays))
         self._step_count += 1

@@ -268,13 +268,47 @@ struct InvokeCtx {
   std::vector<Array *> in, out;
 };
 
+/* Async-kernel failures cannot throw across the engine worker thread;
+ * record the first one here and rethrow at the next sync point
+ * (WaitToRead/WaitAll/SyncCopy) — the reference engine's
+ * OnCompleteStatic exception-propagation contract. */
+static std::mutex async_err_mu;
+static std::string async_err_msg;
+static std::atomic<bool> async_err_set{false};
+
+static void RecordAsyncError(const std::string &msg) {
+  std::lock_guard<std::mutex> lk(async_err_mu);
+  if (!async_err_set.load(std::memory_order_relaxed)) {
+    async_err_msg = msg;
+    async_err_set.store(true, std::memory_order_release);
+  }
+}
+
+static void RethrowAsyncError() {
+  if (!async_err_set.load(std::memory_order_acquire)) return;
+  std::string msg;
+  {
+    std::lock_guard<std::mutex> lk(async_err_mu);
+    /* recheck under the lock: a concurrent sync point may have consumed
+     * the error between the fast check above and acquiring the mutex */
+    if (!async_err_set.load(std::memory_order_relaxed)) return;
+    msg = async_err_msg;
+    async_err_set.store(false, std::memory_order_release);
+  }
+  throw std::runtime_error("async kernel failed: " + msg);
+}
+
 static void RunInvoke(void *p) {
   auto *ctx = static_cast<InvokeCtx *>(p);
   try {
     ctx->fn(ctx->in, ctx->out);
+  } catch (const std::exception &e) {
+    /* worker thread: record for the next sync point instead of
+     * std::terminate (validation runs synchronously pre-push, so this
+     * catches kernel/allocation failures only) */
+    RecordAsyncError(e.what());
   } catch (...) {
-    /* validation runs synchronously before the push; an exception here
-     * would otherwise std::terminate the worker thread */
+    RecordAsyncError("unknown error");
   }
 }
 
@@ -356,6 +390,7 @@ int MXNDArrayWaitToRead(NDArrayHandle h) {
   API_BEGIN();
   if (MXEngineWaitForVar(Eng(), Cast(h)->var) != 0)
     throw std::runtime_error(MXGetLastError());
+  RethrowAsyncError();
   API_END();
 }
 
@@ -363,6 +398,7 @@ int MXNDArrayWaitAll(void) {
   API_BEGIN();
   if (MXEngineWaitAll(Eng()) != 0)
     throw std::runtime_error(MXGetLastError());
+  RethrowAsyncError();
   API_END();
 }
 
@@ -392,6 +428,7 @@ int MXNDArraySyncCopyToCPU(NDArrayHandle h, void *data, uint64_t nbytes) {
     throw std::runtime_error("size mismatch in SyncCopyToCPU");
   if (MXEngineWaitForVar(Eng(), a->var) != 0)
     throw std::runtime_error(MXGetLastError());
+  RethrowAsyncError();
   std::memcpy(data, a->data, nbytes);
   API_END();
 }

@@ -11,10 +11,11 @@ import jax
 jax.config.update("jax_platforms", "cpu")
 
 
-def kvstore_main(out_dir: str) -> None:
+def kvstore_main(out_dir: str, expect_nw: int = 2) -> None:
     """Reference dist_sync contract (tests/nightly/dist_sync_kvstore.py):
-    pulled == sum over workers of pushed, and gluon.Trainer(kvstore='ici')
-    keeps parameters bit-identical across processes WITHOUT SPMDTrainer."""
+    pulled == sum over workers of pushed, multi-key pushes fuse into
+    bucket collectives, and gluon.Trainer(kvstore='ici') keeps parameters
+    bit-identical across processes WITHOUT SPMDTrainer."""
     import mxnet_tpu as mx
     from mxnet_tpu import kvstore as kvs
     kvs._maybe_init_distributed()
@@ -23,7 +24,7 @@ def kvstore_main(out_dir: str) -> None:
     rank = jax.process_index()
     kv = kvs.create("dist_sync")
     nw = kv.num_workers
-    assert nw == 2, nw
+    assert nw == expect_nw, (nw, expect_nw)
 
     # raw push/pull invariant with rank-dependent values
     base = onp.arange(12, dtype="float32").reshape(3, 4)
@@ -32,6 +33,34 @@ def kvstore_main(out_dir: str) -> None:
     pulled = kv.pull(0).asnumpy()
     expect = base * sum(r + 1 for r in range(nw))
     assert onp.allclose(pulled, expect), (pulled, expect)
+
+    # bucketed multi-key push: 10 small keys must cross the process
+    # boundary as ONE fused collective (kvstore_dist.h BIGARRAY_BOUND
+    # aggregation analog), each key still summing over workers
+    keys = list(range(10, 20))
+    kv.init(keys, [mx.np.array(onp.zeros((3, 4), "float32"))
+                   for _ in keys])
+    before = kv.reduce_collectives
+    kv.push(keys, [mx.np.array(base + k * (rank + 1)) for k in keys])
+    fused = kv.reduce_collectives - before
+    assert fused == 1, f"expected 1 fused collective, used {fused}"
+    for k in keys:
+        got = kv.pull(k).asnumpy()
+        want = base * nw + k * sum(r + 1 for r in range(nw))
+        assert onp.allclose(got, want), (k, got, want)
+
+    # BIGARRAY_BOUND honored: arrays at/over the bound reduce alone
+    os.environ["MXNET_KVSTORE_BIGARRAY_BOUND"] = "8"
+    try:
+        kv.init([30, 31], [mx.np.array(onp.zeros((3, 4), "float32"))
+                           for _ in range(2)])
+        before = kv.reduce_collectives
+        kv.push([30, 31], [mx.np.array(base * (rank + 1))
+                           for _ in range(2)])
+        solo = kv.reduce_collectives - before
+        assert solo == 2, f"12-elem arrays over bound=8 must go solo: {solo}"
+    finally:
+        del os.environ["MXNET_KVSTORE_BIGARRAY_BOUND"]
 
     # plain gluon.Trainer over the kvstore: per-rank batches differ, the
     # summed-grad update must keep params bit-identical across ranks
@@ -59,7 +88,8 @@ def kvstore_main(out_dir: str) -> None:
 def main() -> None:
     out_dir = sys.argv[1]
     if len(sys.argv) > 2 and sys.argv[2] == "kvstore":
-        kvstore_main(out_dir)
+        kvstore_main(out_dir,
+                     expect_nw=int(sys.argv[3]) if len(sys.argv) > 3 else 2)
         return
     import mxnet_tpu as mx
     from mxnet_tpu import kvstore as kvs

@@ -100,3 +100,30 @@ def test_two_process_two_devices_each(tmp_path):
     r1 = (tmp_path / "worker1.txt").read_text().splitlines()
     assert r0[0] == r1[0]
     assert r0[1] == r1[1]
+
+
+def test_four_process_kvstore_bucketed(tmp_path):
+    """dp=4 launcher job: the dist_sync invariant (pulled == sum over the
+    4 workers of pushed), fused bucket collectives for multi-key pushes,
+    BIGARRAY_BOUND solo reduction, and bit-identical gluon.Trainer
+    parameters across all 4 ranks."""
+    env = dict(os.environ)
+    env.pop("XLA_FLAGS", None)
+    env["JAX_PLATFORMS"] = "cpu"
+    env["PYTHONPATH"] = REPO
+    for attempt in range(2):
+        cmd = [sys.executable, os.path.join(REPO, "tools", "launch.py"),
+               "-n", "4", "--port", str(_free_port()),
+               sys.executable,
+               os.path.join(REPO, "tests", "dist_worker.py"),
+               str(tmp_path), "kvstore", "4"]
+        proc = subprocess.run(cmd, env=env, capture_output=True,
+                              text=True, timeout=280)
+        if proc.returncode == 0 or attempt == 1:
+            break
+    assert proc.returncode == 0, (proc.stdout[-2000:], proc.stderr[-2000:])
+    rows = [(tmp_path / f"worker{r}.txt").read_text().splitlines()
+            for r in range(4)]
+    for r in range(1, 4):
+        assert rows[0][0] == rows[r][0]   # pulled sums identical
+        assert rows[0][1] == rows[r][1]   # trained params bit-identical

@@ -198,3 +198,52 @@ def test_repeated_attr_variants_stay_cached(exec_cache):
         for ax in (0, 1, None):
             s = mx.np.sum(x, axis=ax)
     assert not any(k[0] == "sum" for k in reg._CHURN_EAGER)
+
+
+def test_mesh_active_flag_releases_with_arrays():
+    """The per-op sharding-harmonization scan turns itself off once the
+    last mesh-resident array is collected (a discarded GPTPipe must not
+    tax every later eager op in the process)."""
+    import gc
+    import jax.numpy as jnp
+    # flush finalizers of arrays earlier tests left unreachable — their
+    # decrements must land on the OLD counter, not the zeroed one below
+    gc.collect()
+    saved = dict(reg._mesh_state)
+    try:
+        reg._mesh_state.update(active=False, live=0, pinned=False)
+        a = jnp.ones((4,)) * 3.0
+        b = jnp.ones((2,)) * 5.0
+        reg.mark_mesh_resident(a)
+        reg.mark_mesh_resident(b)
+        assert reg._mesh_state["active"] and reg._mesh_state["live"] == 2
+        del a
+        gc.collect()
+        assert reg._mesh_state["active"], "one mesh array still alive"
+        del b
+        gc.collect()
+        assert not reg._mesh_state["active"], \
+            "flag must drop when the last mesh array dies"
+    finally:
+        reg._mesh_state.clear()
+        reg._mesh_state.update(saved)
+
+
+def test_attention_env_routing_in_cache_key(exec_cache, monkeypatch):
+    """MXNET_ATTENTION_USE_PALLAS toggled at runtime must re-dispatch:
+    the routing decision resolves outside impl so it lands in the closure
+    cells the exec cache keys on (a stale cached executable would
+    silently keep the old path)."""
+    from mxnet_tpu.ops.transformer import dot_product_attention
+    rng = onp.random.RandomState(3)
+    q = mx.np.array(rng.uniform(-1, 1, (1, 8, 2, 16)).astype("float32"))
+    k = mx.np.array(rng.uniform(-1, 1, (1, 8, 2, 16)).astype("float32"))
+    v = mx.np.array(rng.uniform(-1, 1, (1, 8, 2, 16)).astype("float32"))
+    monkeypatch.delenv("MXNET_ATTENTION_USE_PALLAS", raising=False)
+    o1 = dot_product_attention(q, k, v).asnumpy()
+    n1 = sum(1 for key in reg._EXEC_CACHE if key[0] == "dot_product_attention")
+    monkeypatch.setenv("MXNET_ATTENTION_USE_PALLAS", "1")
+    o2 = dot_product_attention(q, k, v).asnumpy()
+    n2 = sum(1 for key in reg._EXEC_CACHE if key[0] == "dot_product_attention")
+    assert n2 > n1, "env flip must produce a distinct cache entry"
+    assert onp.allclose(o1, o2, rtol=2e-2, atol=2e-2)

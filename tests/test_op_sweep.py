@@ -520,6 +520,56 @@ def test_batch_norm_large_mean_stable(monkeypatch):
     monkeypatch.setenv("MXNET_BN_STATS", "centered")
     o = bn(0.0, x)
     assert abs(o.std() - 1.0) < 0.1 and abs(o).max() < 6.0,         (o.std(), abs(o).max())
+    monkeypatch.delenv("MXNET_BN_STATS")
+
+
+def test_batch_norm_layer_cold_start_stable():
+    """COLD start at the layer (virgin shift buffer, |E[x]|/std ~1e5):
+    the first training forward uses centered stats (no cancellation
+    blow-up); afterwards the stat-shift buffer holds the last batch
+    mean, so the shifted one-pass is safe REGARDLESS of running-mean
+    warm-up — while the running stats keep the exact reference momentum
+    recursion (no bootstrap)."""
+    from mxnet_tpu.gluon import nn
+    from mxnet_tpu import autograd
+    layer = nn.BatchNorm(axis=-1)
+    layer.initialize()
+    rng = onp.random.RandomState(0)
+    x = NDArray(rng.normal(1000.0, 0.01, (64, 4)).astype("float32"))
+    with autograd.record(train_mode=True):
+        o = layer(x).asnumpy()
+    assert abs(o.std() - 1.0) < 0.1 and abs(o).max() < 6.0, \
+        (o.std(), abs(o).max())
+    # reference momentum semantics preserved: rm = 0.1 * m after step 1
+    rm = layer.running_mean.data().asnumpy()
+    assert onp.allclose(rm, 100.0, atol=1.0), rm
+    # shift buffer = last batch mean (warm immediately)
+    sh = layer.stat_shift.data().asnumpy()
+    assert onp.allclose(sh, 1000.0, atol=1.0), sh
+    # second forward takes the shifted path with the warm shift: stable
+    with autograd.record(train_mode=True):
+        o2 = layer(x).asnumpy()
+    assert abs(o2.std() - 1.0) < 0.1 and abs(o2).max() < 6.0, \
+        (o2.std(), abs(o2).max())
+    # force_reinit zeroes the shift buffer: the cached virgin verdict
+    # must re-derive from the NEW buffer, not stay stale-False
+    layer.initialize(force_reinit=True)
+    with autograd.record(train_mode=True):
+        o3 = layer(x).asnumpy()
+    assert abs(o3.std() - 1.0) < 0.1 and abs(o3).max() < 6.0, \
+        (o3.std(), abs(o3).max())
+    # .params round-trip: the runtime-only shift buffer must NOT leak
+    # into the reference-format file, and load must not require it
+    import tempfile, os
+    with tempfile.TemporaryDirectory() as d:
+        f = os.path.join(d, "bn.params")
+        layer.save_parameters(f)
+        from mxnet_tpu.ndarray_io import load_params
+        assert not any("stat_shift" in k for k in load_params(f))
+        fresh = nn.BatchNorm(axis=-1)
+        fresh.initialize()
+        fresh(NDArray(onp.zeros((2, 4), "float32")))
+        fresh.load_parameters(f)
 
 
 def test_batch_norm_stats_keep_running_dtype():

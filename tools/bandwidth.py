@@ -26,7 +26,14 @@ def main(argv=None):
     ap.add_argument("--axis", default="dp")
     ap.add_argument("--iters", type=int, default=10)
     ap.add_argument("--force-cpu", action="store_true")
+    ap.add_argument("--kvstore", type=int, default=0, metavar="KEYS",
+                    help="measure KVStoreICI push of KEYS small gradients "
+                         "— fused bucket collectives vs per-key (run "
+                         "under tools/launch.py with >= 2 processes)")
     args = ap.parse_args(argv)
+
+    if args.kvstore:
+        return _kvstore_mode(args.kvstore, args.iters)
 
     if args.force_cpu:
         import jax
@@ -85,6 +92,48 @@ def main(argv=None):
         results.append(row)
         print(f"{mb:8.1f} MB  " + "  ".join(
             f"{k}={row[k]:7.2f} GB/s" for k in ops))
+    return 0
+
+
+def _kvstore_mode(n_keys: int, iters: int) -> int:
+    """Push ``n_keys`` small (256x256 f32) gradients through KVStoreICI
+    twice: with the default BIGARRAY_BOUND fusion buffer (one collective
+    per ~bound elements) and with bucketing disabled (one collective per
+    key) — the reference's aggregation-vs-per-key traffic comparison."""
+    import time as _time
+    import numpy as onp
+    import jax
+    # must run before the backend initializes: under the local launcher
+    # the env var alone does not displace an installed accelerator
+    # plugin (same pattern as tests/dist_worker.py)
+    if os.environ.get("JAX_PLATFORMS", "") == "cpu":
+        jax.config.update("jax_platforms", "cpu")
+    import mxnet_tpu as mx
+    from mxnet_tpu import kvstore as kvs
+    kvs._maybe_init_distributed()
+    if jax.process_count() < 2:
+        print("kvstore mode needs >= 2 processes (tools/launch.py -n 2 "
+              "python tools/bandwidth.py --kvstore 32)")
+        return 0
+    kv = kvs.create("ici")
+    keys = list(range(n_keys))
+    rng = onp.random.RandomState(0)
+    vals = [mx.np.array(rng.uniform(-1, 1, (256, 256)).astype("float32"))
+            for _ in keys]
+    kv.init(keys, [mx.np.zeros((256, 256)) for _ in keys])
+    for bound, label in ((10 ** 9, "bucketed"), (1, "per-key ")):
+        os.environ["MXNET_KVSTORE_BIGARRAY_BOUND"] = str(bound)
+        kv.push(keys, vals)                      # warm the reduce program
+        before, t0 = kv.reduce_collectives, _time.perf_counter()
+        for _ in range(iters):
+            kv.push(keys, vals)
+        dt = (_time.perf_counter() - t0) / iters
+        used = (kv.reduce_collectives - before) / iters
+        if jax.process_index() == 0:
+            print(f"{label}: {used:5.0f} collectives/push  "
+                  f"{dt * 1e3:8.2f} ms/push  ({n_keys} keys x 256KB)",
+                  flush=True)
+    del os.environ["MXNET_KVSTORE_BIGARRAY_BOUND"]
     return 0
 
 
