@@ -180,6 +180,21 @@ class Block:
         fn(self)
         return self
 
+    def _epoch_sensitive(self) -> bool:
+        """Does this block tree contain a layer whose host-side state can
+        change the traced program (BatchNorm's virgin-stats flag)? Used
+        to scope graph-epoch invalidation: blocks without such layers
+        keep their compiled executables. Cached after the first walk."""
+        cached = getattr(self, "_epoch_sensitive_cache", None)
+        if cached is None:
+            def walk(b) -> bool:
+                if hasattr(b, "_stats_virgin"):
+                    return True
+                return any(walk(c) for c in b._children.values())
+            cached = walk(self)
+            self._epoch_sensitive_cache = cached
+        return cached
+
     # -- execution ---------------------------------------------------------
     def __call__(self, *args: Any) -> Any:
         for hook in self._forward_pre_hooks:
@@ -361,9 +376,13 @@ class HybridBlock(Block):
             amp_key = str(_amp["target_dtype"])
         # a bumped epoch invalidates by CLEARING this block's cache (not
         # by keying on the epoch, which would strand the old compiled
-        # executables in the dict for the block's lifetime)
+        # executables in the dict for the block's lifetime) — and only
+        # for blocks that CONTAIN an epoch-sensitive layer (BatchNorm):
+        # other models' traced programs cannot have changed, so they
+        # keep their executables
         if getattr(self, "_cache_epoch", None) != _GRAPH_EPOCH[0]:
-            self._cached_graph.clear()
+            if self._epoch_sensitive():
+                self._cached_graph.clear()
             self._cache_epoch = _GRAPH_EPOCH[0]
         key_sig = (tuple((tuple(a.shape), str(a.dtype)) for a in nd_args),
                    train, amp_key)

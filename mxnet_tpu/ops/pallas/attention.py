@@ -40,12 +40,21 @@ _GRID_SEMANTICS = pltpu.CompilerParams(
     dimension_semantics=("parallel", "parallel", "parallel", "arbitrary"))
 
 
-def _bias_spec(bias_shape, block_q, block_k):
-    Bb, Hb = bias_shape[0], bias_shape[1]
+def _bias_spec(bias_shape, block_q, block_k, kv_major: bool = False):
+    """Bias streams like K/V. A Tq-broadcast bias (B/1, H/1, 1, Tk) —
+    the canonical BERT key-padding mask — ships as (1, block_k) rows
+    that broadcast over the q tile inside the kernel; a full bias ships
+    (block_q, block_k) tiles. ``kv_major`` flips the grid argument
+    order for the dkv kernel's (b, h, ik, iq) grid."""
+    Bb, Hb, Tqb = bias_shape[0], bias_shape[1], bias_shape[2]
 
-    def idx(b, h, i, j):
-        return (b if Bb > 1 else 0, h if Hb > 1 else 0, i, j)
+    def idx(b, h, x, y):
+        i, j = (y, x) if kv_major else (x, y)
+        return (b if Bb > 1 else 0, h if Hb > 1 else 0,
+                0 if Tqb == 1 else i, j)
 
+    if Tqb == 1:
+        return pl.BlockSpec((1, 1, 1, block_k), idx)
     return pl.BlockSpec((1, 1, block_q, block_k), idx)
 
 
@@ -183,6 +192,8 @@ def _pad_to(x, axis, mult):
 
 
 def _pad_bias(bias, block_q, block_k):
+    if bias.shape[2] == 1:          # Tq-broadcast row bias: pad Tk only
+        return _pad_to(bias, 3, block_k)
     return _pad_to(_pad_to(bias, 2, block_q), 3, block_k)
 
 
@@ -458,11 +469,8 @@ def _flash_backward(q, k, v, bias, seed, o, lse, g, scale: float,
     in_specs2 = [qs_spec, ks_spec, ks_spec, qs_spec, rows_spec, rows_spec]
     args2 = [qp, kp, vp, dop, lsep, deltap]
     if has_bias:
-        Bb, Hb = bias.shape[0], bias.shape[1]
-        in_specs2.append(pl.BlockSpec(
-            (1, 1, block_q, block_k),
-            lambda b, h, j, i, Bb=Bb, Hb=Hb: (b if Bb > 1 else 0,
-                                              h if Hb > 1 else 0, i, j)))
+        in_specs2.append(_bias_spec(bias.shape, block_q, block_k,
+                                    kv_major=True))
         args2.append(_pad_bias(bias, block_q, block_k))
     if rate > 0:
         in_specs2.append(pl.BlockSpec(memory_space=pltpu.SMEM))
@@ -486,8 +494,9 @@ def _flash_backward(q, k, v, bias, seed, o, lse, g, scale: float,
     d_bias = None
     if want_dbias:
         ds_full = ds_full[:, :, :Tq, :Tk]
-        # reduce over broadcast dims back to the bias shape
-        red = tuple(ax for ax, size in enumerate(bias.shape[:2])
+        # reduce over broadcast dims (incl. a Tq-broadcast row bias's
+        # query axis) back to the bias shape
+        red = tuple(ax for ax, size in enumerate(bias.shape[:3])
                     if size == 1)
         d_bias = ds_full.sum(axis=red, keepdims=True) if red else ds_full
         d_bias = d_bias.astype(bias.dtype)
@@ -586,12 +595,12 @@ def flash_attention(q, k, v, scale: Optional[float] = None,
     if scale is None:
         scale = 1.0 / math.sqrt(q.shape[-1])
     if bias is not None and (bias.ndim != 4 or
-                             bias.shape[2] != q.shape[1] or
+                             bias.shape[2] not in (1, q.shape[1]) or
                              bias.shape[3] != k.shape[1]):
         raise ValueError(
-            f"flash_attention bias must be (1|B, 1|H, Tq, Tk); got "
+            f"flash_attention bias must be (1|B, 1|H, 1|Tq, Tk); got "
             f"{bias.shape} for Tq={q.shape[1]}, Tk={k.shape[1]} — "
-            "broadcast is only supported over the leading two dims")
+            "the trailing key dim must be full-size")
     # kernel blocks over (B, H, T, D)
     qt = jnp.swapaxes(q, 1, 2)
     kt = jnp.swapaxes(k, 1, 2)

@@ -130,12 +130,13 @@ def _flash_block(which: str) -> int:
 
 
 def _flash_bias_ok(bias, q, k) -> bool:
-    """The Pallas kernel broadcasts bias over dims 0/1 only; the trailing
-    (Tq, Tk) must be full-size (a (B,1,1,Tk) key-padding bias would be
-    silently mis-indexed)."""
+    """The Pallas kernel broadcasts bias over dims 0/1 and (r3) over a
+    unit query dim — (B,1,1,Tk) key-padding masks, the canonical BERT
+    case, stream as per-tile rows. Only the trailing key dim must be
+    full-size."""
     if bias is None:
         return True
-    return (bias.ndim == 4 and bias.shape[2] == q.shape[1] and
+    return (bias.ndim == 4 and bias.shape[2] in (1, q.shape[1]) and
             bias.shape[3] == k.shape[1])
 
 

@@ -367,6 +367,8 @@ class SPMDTrainer:
         epoch = graph_epoch()
         if getattr(self, "_graph_epoch", None) != epoch:
             self._graph_epoch = epoch
+            if not getattr(self.block, "_epoch_sensitive", lambda: True)():
+                return      # traced program cannot have changed
             self._step_fn = None
             self._multi_fn = None
             if hasattr(self, "_raw_step_fn"):

@@ -149,3 +149,61 @@ def test_flash_tunable_blocks():
     o2 = _flash2(q, q, q, None, None, 0.0, scale, False, 96, 96)
     onp.testing.assert_allclose(onp.asarray(o1), onp.asarray(o2),
                                 rtol=2e-4, atol=2e-5)
+
+
+def test_flash_key_padding_row_bias():
+    """(B,1,1,Tk) Tq-broadcast row bias — the canonical BERT key-padding
+    mask — streams as (1, block_k) rows (r3): fwd + q/k/v grads must
+    match dense, including a PADDED kv range and off-block T."""
+    rng = onp.random.RandomState(5)
+    B, H, T, D = 2, 2, 96, 16           # T=96 pads inside 32-blocks
+    q = jnp.asarray(rng.uniform(-1, 1, (B, H, T, D)).astype("float32"))
+    k = jnp.asarray(rng.uniform(-1, 1, (B, H, T, D)).astype("float32"))
+    v = jnp.asarray(rng.uniform(-1, 1, (B, H, T, D)).astype("float32"))
+    # boolean keep-mask -> additive -inf-ish rows; last 20 keys padded out
+    keep = onp.ones((B, 1, 1, T), bool)
+    keep[:, :, :, -20:] = False
+    bias = jnp.asarray(onp.where(keep, 0.0, -1e9).astype("float32"))
+    scale = 1.0 / onp.sqrt(D)
+
+    def loss_flash(q, k, v):
+        return jnp.sum(_flash2(q, k, v, bias, None, 0.0, scale, False,
+                               32, 32, False) ** 2)
+
+    def loss_dense(q, k, v):
+        return jnp.sum(_dense_reference(q, k, v, scale, False,
+                                        bias=bias) ** 2)
+
+    out_f = _flash2(q, k, v, bias, None, 0.0, scale, False, 32, 32, False)
+    out_d = _dense_reference(q, k, v, scale, False, bias=bias)
+    onp.testing.assert_allclose(onp.asarray(out_f), onp.asarray(out_d),
+                                rtol=2e-4, atol=2e-5)
+    gf = jax.grad(loss_flash, argnums=(0, 1, 2))(q, k, v)
+    gd = jax.grad(loss_dense, argnums=(0, 1, 2))(q, k, v)
+    for a, b in zip(gf, gd):
+        onp.testing.assert_allclose(onp.asarray(a), onp.asarray(b),
+                                    rtol=3e-4, atol=3e-5)
+
+
+def test_flash_row_bias_learned_grad():
+    """A LEARNED (B,1,1,Tk) row bias gets its gradient reduced over the
+    query axis as well as the broadcast head axis."""
+    rng = onp.random.RandomState(6)
+    B, H, T, D = 2, 2, 32, 8
+    q = jnp.asarray(rng.uniform(-1, 1, (B, H, T, D)).astype("float32"))
+    bias = jnp.asarray(rng.uniform(-1, 1, (B, 1, 1, T)).astype("float32"))
+    scale = 1.0 / onp.sqrt(D)
+
+    def loss_flash(bias):
+        return jnp.sum(_flash2(q, q, q, bias, None, 0.0, scale, False,
+                               16, 16) ** 2)
+
+    def loss_dense(bias):
+        return jnp.sum(_dense_reference(q, q, q, scale, False,
+                                        bias=bias) ** 2)
+
+    gf = jax.grad(loss_flash)(bias)
+    gd = jax.grad(loss_dense)(bias)
+    assert gf.shape == bias.shape
+    onp.testing.assert_allclose(onp.asarray(gf), onp.asarray(gd),
+                                rtol=3e-4, atol=3e-5)
