@@ -24,19 +24,38 @@ __all__ = ["GPTBlock", "GPTModel", "get_gpt", "gpt2_124m"]
 
 
 class GPTBlock(HybridBlock):
-    """One pre-LN causal transformer block."""
+    """One pre-LN causal transformer block.
+
+    ``moe_experts > 0`` replaces the dense FFN with a routed
+    mixture-of-experts FFN (top-2 GShard gating by default): the
+    pre-LN residual carries tokens an over-capacity expert drops —
+    the Switch-Transformer integration pattern. Expert weights shard
+    over the mesh's ``ep`` axis via MOE_TRANSFORMER_RULES.
+    """
 
     def __init__(self, units: int = 768, hidden_size: int = 3072,
                  num_heads: int = 12, dropout: float = 0.1,
-                 layer_norm_eps: float = 1e-5, **kwargs: Any) -> None:
+                 layer_norm_eps: float = 1e-5, moe_experts: int = 0,
+                 moe_top_k: int = 2, moe_capacity_factor: float = 1.25,
+                 moe_router_z_loss: float = 1e-3,
+                 **kwargs: Any) -> None:
         super().__init__(**kwargs)
         self._num_heads = num_heads
         self.ln1 = LayerNorm(epsilon=layer_norm_eps, in_channels=units)
         self.attn_qkv = Dense(3 * units, in_units=units, flatten=False)
         self.attn_out = Dense(units, in_units=units, flatten=False)
         self.ln2 = LayerNorm(epsilon=layer_norm_eps, in_channels=units)
-        self.ffn1 = Dense(hidden_size, in_units=units, flatten=False)
-        self.ffn2 = Dense(units, in_units=hidden_size, flatten=False)
+        if moe_experts > 0:
+            from ...parallel.moe import MoEDense
+            self.moe = MoEDense(moe_experts, hidden_size, units=units,
+                                top_k=moe_top_k,
+                                capacity_factor=moe_capacity_factor,
+                                router_z_loss=moe_router_z_loss)
+            self.ffn1 = self.ffn2 = None
+        else:
+            self.moe = None
+            self.ffn1 = Dense(hidden_size, in_units=units, flatten=False)
+            self.ffn2 = Dense(units, in_units=hidden_size, flatten=False)
         self._dropout = dropout
 
     def forward(self, x: NDArray) -> NDArray:
@@ -51,7 +70,10 @@ class GPTBlock(HybridBlock):
             att = npx.dropout(att, self._dropout)
         x = x + att
         h = self.ln2(x)
-        ffn = self.ffn2(npx.gelu(self.ffn1(h)))
+        if self.moe is not None:
+            ffn = self.moe(h)
+        else:
+            ffn = self.ffn2(npx.gelu(self.ffn1(h)))
         if self._dropout:
             ffn = npx.dropout(ffn, self._dropout)
         return x + ffn
@@ -68,7 +90,9 @@ class GPTModel(HybridBlock):
     def __init__(self, vocab_size: int = 50257, num_layers: int = 12,
                  units: int = 768, hidden_size: int = 3072,
                  num_heads: int = 12, max_length: int = 1024,
-                 dropout: float = 0.1, **kwargs: Any) -> None:
+                 dropout: float = 0.1, moe_every_n: int = 0,
+                 moe_experts: int = 8, moe_top_k: int = 2,
+                 **kwargs: Any) -> None:
         super().__init__(**kwargs)
         self._units = units
         self._max_length = max_length
@@ -76,9 +100,15 @@ class GPTModel(HybridBlock):
         self.position_weight = Parameter(
             "position_weight", shape=(max_length, units), init="normal")
         self.blocks = HybridSequential()
-        for _ in range(num_layers):
+        for i in range(num_layers):
+            # moe_every_n > 0: every n-th block swaps its dense FFN for a
+            # routed expert FFN (GShard/ST-MoE interleaving)
+            is_moe = moe_every_n > 0 and (i + 1) % moe_every_n == 0
             self.blocks.add(GPTBlock(units, hidden_size, num_heads,
-                                     dropout))
+                                     dropout,
+                                     moe_experts=moe_experts if is_moe
+                                     else 0,
+                                     moe_top_k=moe_top_k))
         self.ln_f = LayerNorm(epsilon=1e-5, in_channels=units)
         self._dropout = dropout
 

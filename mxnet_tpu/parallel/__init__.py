@@ -11,10 +11,12 @@ from .mesh import make_mesh, mesh_axes, replicated, shard_batch
 from .spmd import (PartitionRules, SPMDTrainer, DEFAULT_TRANSFORMER_RULES,
                    DATA_PARALLEL_RULES)
 from .ring import ring_attention, local_ring_attention
-from .pipeline import pipeline_apply, GPTPipe, PIPELINE_RULES
-from .moe import MoEDense, MOE_RULES
+from .pipeline import (pipeline_apply, pipeline_train_grads, GPTPipe,
+                       PIPELINE_RULES)
+from .moe import MoEDense, MOE_RULES, MOE_TRANSFORMER_RULES
 
 __all__ = ["make_mesh", "mesh_axes", "replicated", "shard_batch",
            "PartitionRules", "SPMDTrainer", "DEFAULT_TRANSFORMER_RULES",
            "DATA_PARALLEL_RULES", "ring_attention", "local_ring_attention",
-           "pipeline_apply", "MoEDense", "MOE_RULES"]
+           "pipeline_apply", "pipeline_train_grads", "MoEDense",
+           "MOE_RULES", "MOE_TRANSFORMER_RULES"]

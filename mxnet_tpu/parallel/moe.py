@@ -22,9 +22,10 @@ from ..gluon.block import HybridBlock
 from ..gluon.parameter import Parameter
 from ..ndarray import ops as ndops
 from ..ndarray.ndarray import NDArray
-from .spmd import PartitionRules
+from .spmd import DEFAULT_TRANSFORMER_RULES, PartitionRules
 
-__all__ = ["MoEDense", "MOE_RULES", "collect_aux_losses"]
+__all__ = ["MoEDense", "MOE_RULES", "MOE_TRANSFORMER_RULES",
+           "collect_aux_losses"]
 
 
 # Active aux-loss collector (trace-safe channel from MoE layers to the
@@ -56,6 +57,11 @@ MOE_RULES = PartitionRules([
     (r"expert_b2$", P("ep", None)),
 ])
 
+# MoE transformer on a combined mesh (e.g. {"dp": 2, "ep": 4}): expert
+# weights over ep, attention/FFN/embedding over tp when present, batch
+# over dp via the trainer's data spec.
+MOE_TRANSFORMER_RULES = MOE_RULES + DEFAULT_TRANSFORMER_RULES
+
 
 class MoEDense(HybridBlock):
     """Top-1 routed mixture of expert FFNs (GShard-style).
@@ -73,10 +79,19 @@ class MoEDense(HybridBlock):
     def __init__(self, num_experts: int, hidden_size: int,
                  units: Optional[int] = None, activation: str = "gelu",
                  capacity_factor: float = 1.25, dtype: Any = "float32",
+                 top_k: int = 1, router_z_loss: float = 0.0,
                  **kwargs: Any) -> None:
         super().__init__(**kwargs)
         if num_experts < 1:
             raise MXNetError("num_experts must be >= 1")
+        if top_k not in (1, 2):
+            raise MXNetError("top_k must be 1 or 2")
+        if top_k > num_experts:
+            raise MXNetError(
+                f"top_k={top_k} needs at least that many experts "
+                f"(got num_experts={num_experts})")
+        self._top_k = top_k
+        self._z_coef = float(router_z_loss)
         self._E = num_experts
         self._H = hidden_size
         self._units = units          # defaults to input dim (residual FFN)
@@ -117,21 +132,55 @@ class MoEDense(HybridBlock):
         logits = ndops.dot(flat, self.gate.data().T)    # (N, E)
         from ..ops import nn as npx
         probs = npx.softmax(logits, axis=-1)
-        top_p = probs.max(axis=-1, keepdims=True)       # (N, 1)
         top_e = ndops.argmax(logits, axis=-1)           # (N,)
         e_hot = ndops.one_hot(top_e, E, dtype=x.dtype)  # (N, E)
+        p1 = (probs * e_hot).sum(axis=-1)               # (N,)
 
-        # capacity bucketing: token's position within its expert queue
-        pos = ndops.cumsum(e_hot, axis=0) * e_hot - e_hot    # (N, E) 0-based
-        keep = (pos < float(C)).astype(x.dtype) * e_hot      # within capacity
-        pos_idx = (pos * keep).sum(axis=-1)                  # (N,)
-        c_hot = ndops.one_hot(pos_idx, C, dtype=x.dtype)     # (N, C)
-        dispatch = ndops.einsum("ne,nc->nec", keep, c_hot)   # (N, E, C)
+        # first-choice capacity queues (position of each token within its
+        # expert's queue; tokens past capacity produce zero output — the
+        # external residual carries them, Switch-Transformer style)
+        pos1 = ndops.cumsum(e_hot, axis=0) * e_hot - e_hot   # (N, E)
+        keep1 = (pos1 < float(C)).astype(x.dtype) * e_hot
+        pos_idx1 = (pos1 * keep1).sum(axis=-1)               # (N,)
+        c_hot1 = ndops.one_hot(pos_idx1, C, dtype=x.dtype)   # (N, C)
+        d1 = ndops.einsum("ne,nc->nec", keep1, c_hot1)       # (N, E, C)
+
+        if self._top_k == 2:
+            # second choice: argmax with the first expert masked out;
+            # its queue appends AFTER every first-choice token (GShard
+            # top-2 priority), combine weights renormalized over the pair
+            probs2 = probs * (1.0 - e_hot)
+            e2_hot = ndops.one_hot(ndops.argmax(probs2, axis=-1), E,
+                                   dtype=x.dtype)            # (N, E)
+            p2 = (probs2 * e2_hot).sum(axis=-1)
+            cnt1 = e_hot.sum(axis=0)                         # (E,)
+            pos2 = (ndops.cumsum(e2_hot, axis=0) * e2_hot - e2_hot
+                    + e2_hot * cnt1.reshape((1, E)))
+            keep2 = (pos2 < float(C)).astype(x.dtype) * e2_hot
+            pos_idx2 = (pos2 * keep2).sum(axis=-1)
+            c_hot2 = ndops.one_hot(pos_idx2, C, dtype=x.dtype)
+            d2 = ndops.einsum("ne,nc->nec", keep2, c_hot2)
+            denom = p1 + p2 + 1e-9
+            w1, w2 = p1 / denom, p2 / denom
+            dispatch = d1 + d2
+            combine = d1 * w1.reshape((N, 1, 1)) \
+                + d2 * w2.reshape((N, 1, 1))
+        else:
+            dispatch = d1
+            combine = d1 * p1.reshape((N, 1, 1))
 
         # aux load-balance loss: E * sum_e fraction_e * mean-prob_e
+        # (first-choice fractions, Switch-Transformer eq. 4), plus the
+        # router z-loss mean(logsumexp(logits)^2) that keeps gate logits
+        # from drifting large (ST-MoE)
         frac = e_hot.mean(axis=0)                            # (E,)
         mean_p = probs.mean(axis=0)
         aux = (frac * mean_p).sum() * float(E)
+        if self._z_coef:
+            zmax = logits.max(axis=-1, keepdims=True)
+            z = ((logits - zmax).exp().sum(axis=-1)).log() \
+                + zmax.squeeze(-1)
+            aux = aux + float(self._z_coef) * (z * z).mean()
         if _collector is not None:
             _collector.append(aux)
         if not isinstance(aux._data, jax.core.Tracer):
@@ -144,7 +193,6 @@ class MoEDense(HybridBlock):
         h = npx.gelu(h) if self._act == "gelu" else npx.relu(h)
         ye = ndops.einsum("ech,ehu->ecu", h, self.expert_w2.data())
         ye = ye + self.expert_b2.data().reshape((E, 1, -1))
-        combine = dispatch * top_p.reshape((N, 1, 1))        # weighted
         out = ndops.einsum("nec,ecu->nu", combine, ye)       # (N, units)
 
         units = out.shape[-1]

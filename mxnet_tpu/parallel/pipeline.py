@@ -21,7 +21,8 @@ import jax
 import jax.numpy as jnp
 from jax.sharding import PartitionSpec as P
 
-__all__ = ["pipeline_apply", "GPTPipe", "PIPELINE_RULES"]
+__all__ = ["pipeline_apply", "pipeline_train_grads", "GPTPipe",
+           "PIPELINE_RULES"]
 
 
 def _shard_map(fn, mesh, in_specs, out_specs):
@@ -37,7 +38,8 @@ def _shard_map(fn, mesh, in_specs, out_specs):
 
 def pipeline_apply(stage_fn: Callable, stage_params: Any, x: "jax.Array",
                    mesh: "jax.sharding.Mesh", axis: str = "pp",
-                   num_microbatches: Optional[int] = None) -> "jax.Array":
+                   num_microbatches: Optional[int] = None,
+                   rng_key: Optional["jax.Array"] = None) -> "jax.Array":
     """Apply ``num_stages`` chained stages to ``x`` with a GPipe schedule.
 
     stage_fn(params_i, h) -> h' — one stage's computation; the activation
@@ -45,16 +47,30 @@ def pipeline_apply(stage_fn: Callable, stage_params: Any, x: "jax.Array",
     stage_params: pytree whose leaves have leading dim ``num_stages``
     (stage i's slice feeds stage i), sharded over mesh axis ``axis``.
     x: (B, ...) global batch; split into microbatches along dim 0.
+    rng_key: when given, ``stage_fn`` is called as
+    ``stage_fn(params_i, h, key)`` with a key folded per (tick, stage) —
+    the plumbing that makes in-pipeline dropout draw fresh randomness for
+    every microbatch at every stage (and regenerate identically in the
+    scan's recompute-for-backward).
 
     Returns stage_{N-1}(...stage_0(x)) with shape x.shape.
     """
+    def call_stage(params, h, m, stage):
+        # key folds on (microbatch, stage) — NOT the tick — so the 1F1B
+        # backward's recompute (different tick) regenerates the same
+        # dropout masks as the forward
+        if rng_key is None:
+            return stage_fn(params, h)
+        key = jax.random.fold_in(jax.random.fold_in(rng_key, m), stage)
+        return stage_fn(params, h, key)
+
     if axis not in mesh.axis_names:
         # degenerate: run stages sequentially on one device
         n = jax.tree_util.tree_leaves(stage_params)[0].shape[0]
         h = x
         for i in range(n):
-            h = stage_fn(jax.tree_util.tree_map(lambda a: a[i],
-                                                stage_params), h)
+            h = call_stage(jax.tree_util.tree_map(lambda a: a[i],
+                                                  stage_params), h, i, i)
         return h
 
     n_stages = mesh.shape[axis]
@@ -87,7 +103,8 @@ def pipeline_apply(stage_fn: Callable, stage_params: Any, x: "jax.Array",
             inp = x_mb[jnp.clip(t, 0, n_micro - 1)]
             feed = jnp.logical_and(stage == 0, t < n_micro)
             h = jnp.where(feed, inp, state)
-            h = stage_fn(params, h)
+            h = call_stage(params, h, jnp.clip(t - stage, 0, n_micro - 1),
+                           stage)
             # last stage banks finished microbatch t-(n_stages-1)
             done_idx = jnp.clip(t - (n_stages - 1), 0, n_micro - 1)
             bank = jnp.logical_and(stage == n_stages - 1,
@@ -111,6 +128,224 @@ def pipeline_apply(stage_fn: Callable, stage_params: Any, x: "jax.Array",
     # the bank is only populated on the last stage; its slice is the result
     out = out[-1]
     return out.reshape((B,) + x.shape[1:])
+
+
+# ---------------------------------------------------------------------------
+# 1F1B schedule: hand-scheduled forward+backward in one pass
+# ---------------------------------------------------------------------------
+
+def _simulate_1f1b(S: int, M: int):
+    """Host-side 1F1B schedule simulation → static per-tick work tables.
+
+    Classic one-forward-one-backward discipline: stage ``s`` may hold at
+    most ``S - s`` microbatches in flight (warmup), then strictly
+    alternates backward/forward. Each global tick has a forward phase
+    and a backward phase; activations/cotangents transfer at tick end
+    and are consumable from the next tick (the last stage turns its own
+    fresh forward around within the same tick).
+
+    Returns int32 arrays ``(fwd, bwd, arr_f, arr_b)`` of shape (T, S):
+    the microbatch each stage forwards / backwards at tick k (-1 idle),
+    and the microbatch whose activation / cotangent ARRIVES at stage s
+    at tick k (what the previous tick's ppermute carried).
+    """
+    import numpy as onp
+    fwd_done = onp.full((S, M), -1, onp.int64)
+    bwd_done = onp.full((S, M), -1, onp.int64)
+    next_fwd = [0] * S
+    next_bwd = [0] * S
+    rows_f, rows_b = [], []
+    k = 0
+    while any(n < M for n in next_bwd):
+        if k > 4 * (M + S) + 8:
+            raise AssertionError("1F1B schedule simulation did not "
+                                 f"converge (S={S}, M={M})")
+        row_f = [-1] * S
+        # forward phase: decisions depend only on prior ticks
+        for s in range(S):
+            m = next_fwd[s]
+            if m >= M:
+                continue
+            if next_fwd[s] - next_bwd[s] >= S - s:   # 1F1B in-flight cap
+                continue
+            if s > 0 and not (0 <= fwd_done[s - 1][m] < k):
+                continue
+            row_f[s] = m
+            fwd_done[s][m] = k
+            next_fwd[s] += 1
+        row_b = [-1] * S
+        # backward phase: the last stage may consume its same-tick fwd
+        for s in range(S):
+            m = next_bwd[s]
+            if m >= M:
+                continue
+            if s == S - 1:
+                ok = 0 <= fwd_done[s][m] <= k
+            else:
+                ok = 0 <= bwd_done[s + 1][m] < k
+            if ok:
+                row_b[s] = m
+                bwd_done[s][m] = k
+                next_bwd[s] += 1
+        rows_f.append(row_f)
+        rows_b.append(row_b)
+        k += 1
+    fwd = onp.asarray(rows_f, onp.int32)
+    bwd = onp.asarray(rows_b, onp.int32)
+    T = fwd.shape[0]
+    arr_f = onp.full((T, S), -1, onp.int32)
+    arr_b = onp.full((T, S), -1, onp.int32)
+    for kk in range(1, T):
+        for s in range(1, S):
+            arr_f[kk][s] = fwd[kk - 1][s - 1]
+        for s in range(S - 1):
+            arr_b[kk][s] = bwd[kk - 1][s + 1]
+    # ring-safety: with S saved slots per stage, fwd of m must never
+    # overwrite a residual whose backward is still pending
+    for s in range(S):
+        for m in range(S, M):
+            assert bwd_done[s][m - S] < fwd_done[s][m], (s, m)
+    return fwd, bwd, arr_f, arr_b
+
+
+def pipeline_train_grads(stage_fn: Callable, loss_fn: Callable,
+                         stage_params: Any, x: "jax.Array", y: "jax.Array",
+                         mesh: "jax.sharding.Mesh", axis: str = "pp",
+                         num_microbatches: Optional[int] = None,
+                         rng_key: Optional["jax.Array"] = None):
+    """One pipeline-parallel training pass with the 1F1B schedule:
+    returns ``(mean_loss, stage_grads)`` in a single hand-scheduled
+    sweep — no ``jax.grad`` over the whole pipeline.
+
+    Versus the GPipe path (``jax.grad`` of :func:`pipeline_apply`):
+
+    * **Memory**: GPipe holds all ``M`` microbatch residuals per stage
+      until its reverse sweep; 1F1B holds at most ``S`` (the saved-input
+      ring) — backward of microbatch m starts as soon as its forward
+      leaves the last stage.
+    * **Bubble**: both schedules idle (S-1)/(ticks) at the ramps; the
+      tick count here is the simulated 1F1B length (~M + 2(S-1) double
+      ticks vs GPipe's (M+S-1) forward + (M+S-1) reversed ticks).
+    * Work units are wrapped in ``lax.cond`` so an idle stage SKIPS the
+      compute (collectives stay outside the conditionals — every device
+      reaches both ppermutes each tick).
+
+    Backward recomputes each stage's forward from the saved input (the
+    same remat tradeoff as the GPipe path's per-tick ``jax.checkpoint``).
+    ``loss_fn(h_out, y_mb) -> scalar`` is evaluated at the last stage
+    (masked elsewhere); grads come back stacked over ``axis`` like
+    ``stage_params`` and are already divided by ``num_microbatches``.
+    ``rng_key``: as in :func:`pipeline_apply`, folded per
+    (microbatch, stage) so backward regenerates the forward's dropout.
+    """
+    S = mesh.shape[axis]
+    n_micro = num_microbatches or S
+    B = x.shape[0]
+    if B % n_micro:
+        raise ValueError(f"batch {B} not divisible into {n_micro} "
+                         f"microbatches")
+    mbs = B // n_micro
+    x_mb = x.reshape((n_micro, mbs) + x.shape[1:])
+    y_mb = y.reshape((n_micro, mbs) + y.shape[1:])
+    ftbl_np, btbl_np, af_np, ab_np = _simulate_1f1b(S, n_micro)
+    T = ftbl_np.shape[0]
+    perm_f = [(i, (i + 1) % S) for i in range(S)]
+    perm_b = [((i + 1) % S, i) for i in range(S)]
+    act_shape = (mbs,) + x.shape[1:]
+
+    def _stage(params, h, m):
+        if rng_key is None:
+            return stage_fn(params, h)
+        stage = jax.lax.axis_index(axis)
+        key = jax.random.fold_in(jax.random.fold_in(rng_key, m), stage)
+        return stage_fn(params, h, key)
+
+    def local(params, x_mb, y_mb):
+        params = jax.tree_util.tree_map(lambda a: a[0], params)
+        stage = jax.lax.axis_index(axis)
+        ftbl = jnp.asarray(ftbl_np)
+        btbl = jnp.asarray(btbl_np)
+        af = jnp.asarray(af_np)
+        ab = jnp.asarray(ab_np)
+        dt = x_mb.dtype
+        zero_act = jnp.zeros(act_shape, dt)
+        ring0 = jnp.zeros((S,) + act_shape, dt)
+
+        def tick(carry, k):
+            wire_f, wire_b, inbox_f, inbox_b, saved, gacc, lacc = carry
+            fm = ftbl[k][stage]
+            bm = btbl[k][stage]
+            afk = af[k][stage]
+            abk = ab[k][stage]
+
+            # bank last tick's arrivals under their microbatch slot
+            inbox_f = jax.lax.cond(
+                afk >= 0,
+                lambda ib: jax.lax.dynamic_update_index_in_dim(
+                    ib, wire_f, afk % S, 0),
+                lambda ib: ib, inbox_f)
+            inbox_b = jax.lax.cond(
+                abk >= 0,
+                lambda ib: jax.lax.dynamic_update_index_in_dim(
+                    ib, wire_b, abk % S, 0),
+                lambda ib: ib, inbox_b)
+
+            # ---- forward phase -------------------------------------
+            def fwd_branch(op):
+                saved, = op
+                h_in = jnp.where(
+                    stage == 0, x_mb[jnp.clip(fm, 0, n_micro - 1)],
+                    inbox_f[fm % S])
+                h_out = _stage(params, h_in, fm)
+                saved = jax.lax.dynamic_update_index_in_dim(
+                    saved, h_in, fm % S, 0)
+                return saved, h_out
+
+            saved, send_f = jax.lax.cond(
+                fm >= 0, fwd_branch, lambda op: (op[0], zero_act), (saved,))
+
+            # ---- backward phase ------------------------------------
+            def bwd_branch(op):
+                gacc, lacc = op
+                m_clip = jnp.clip(bm, 0, n_micro - 1)
+                h_in = saved[bm % S]
+                h_out, pull = jax.vjp(
+                    lambda p, h: _stage(p, h, bm), params, h_in)
+                loss_m, lpull = jax.vjp(
+                    lambda ho: loss_fn(ho, y_mb[m_clip]), h_out)
+                (dh_loss,) = lpull(jnp.ones_like(loss_m))
+                g_in = jnp.where(stage == S - 1, dh_loss,
+                                 inbox_b[bm % S])
+                dp, dh_in = pull(g_in)
+                gacc = jax.tree_util.tree_map(jnp.add, gacc, dp)
+                lacc = lacc + jnp.where(stage == S - 1,
+                                        loss_m.astype(jnp.float32), 0.0)
+                return gacc, lacc, dh_in
+
+            gacc, lacc, send_b = jax.lax.cond(
+                bm >= 0, bwd_branch,
+                lambda op: (op[0], op[1], zero_act), (gacc, lacc))
+
+            # collectives OUTSIDE the conds: every device participates
+            wire_f = jax.lax.ppermute(send_f, axis, perm_f)
+            wire_b = jax.lax.ppermute(send_b, axis, perm_b)
+            return (wire_f, wire_b, inbox_f, inbox_b, saved,
+                    gacc, lacc), None
+
+        gacc0 = jax.tree_util.tree_map(jnp.zeros_like, params)
+        carry0 = (zero_act, zero_act, ring0, ring0, ring0,
+                  gacc0, jnp.float32(0))
+        (*_, gacc, lacc), _ = jax.lax.scan(tick, carry0, jnp.arange(T))
+        loss = jax.lax.psum(lacc, axis) / n_micro
+        grads = jax.tree_util.tree_map(
+            lambda g: (g / n_micro)[None], gacc)
+        return loss, grads
+
+    pspec = jax.tree_util.tree_map(lambda _: P(axis), stage_params)
+    loss, grads = _shard_map(
+        local, mesh, in_specs=(pspec, P(), P()),
+        out_specs=(P(), pspec))(stage_params, x_mb, y_mb)
+    return loss, grads
 
 
 # ---------------------------------------------------------------------------
@@ -138,15 +373,19 @@ class GPTPipe(HybridBlock):
     reimplementation. Works under SPMDTrainer (the stacked params are
     ordinary Parameters).
 
-    Dropout is forced to 0 inside the pipeline (per-tick RNG inside the
-    scan is not threaded); embed/head dropout would go outside the stages.
+    In-pipeline dropout (r3): a per-(microbatch, stage) PRNG key threads
+    through the schedule (``pipeline_apply(rng_key=...)``), scoped around
+    the template block so its dropout ops draw fresh randomness each
+    microbatch at each stage — and regenerate identically in the
+    backward recompute.
     """
 
     def __init__(self, mesh, vocab_size: int = 50257, num_layers: int = 4,
                  units: int = 256, hidden_size: int = 1024,
                  num_heads: int = 4, max_length: int = 512,
                  num_microbatches: Optional[int] = None,
-                 axis: str = "pp", **kwargs: Any) -> None:
+                 axis: str = "pp", dropout: float = 0.0,
+                 **kwargs: Any) -> None:
         super().__init__(**kwargs)
         from ..gluon.model_zoo.gpt import GPTBlock
         from ..gluon.nn import Embedding, LayerNorm
@@ -158,6 +397,7 @@ class GPTPipe(HybridBlock):
         self._units = units
         self._max_length = max_length
         self._num_layers = num_layers
+        self._dropout = float(dropout)
 
         self.word_embed = Embedding(vocab_size, units)
         self.position_weight = Parameter(
@@ -166,7 +406,7 @@ class GPTPipe(HybridBlock):
 
         # template block: supplies the stage math; its own (tiny) buffers
         # are bind targets only, never trained — bypass child registration
-        tpl = GPTBlock(units, hidden_size, num_heads, dropout=0.0)
+        tpl = GPTBlock(units, hidden_size, num_heads, dropout=dropout)
         tpl.initialize()
         object.__setattr__(self, "_template", tpl)
         tpl_params = list(tpl.collect_params().items())
@@ -238,9 +478,16 @@ class GPTPipe(HybridBlock):
         tpl = self._template
         tpl_params = self._tpl_params
 
-        def stage_fn(param_slices, h):
+        def stage_fn(param_slices, h, key=None):
+            from ..ndarray import random as _random
             with _bind_params(tpl_params, param_slices):
-                out = tpl.forward(from_jax(h))
+                if key is None:
+                    out = tpl.forward(from_jax(h))
+                else:
+                    # scope the per-(microbatch, stage) key so the
+                    # block's dropout ops draw from it
+                    with _random.trace_key_scope(key):
+                        out = tpl.forward(from_jax(h))
             return out._data
 
         # eager path: stacked weights must live sharded over the pp mesh
@@ -251,9 +498,15 @@ class GPTPipe(HybridBlock):
             nd = p.data()
             arrays.append(self._mesh_place(nd, P(self._axis)))
         h = self._mesh_place(x, P())
+        rng = None
+        from .._tape import is_training
+        if self._dropout > 0.0 and is_training():
+            from ..ndarray import random as _random
+            rng = _random.split_key()
         out = pipeline_apply(stage_fn, arrays, h, self._mesh,
                              axis=self._axis,
-                             num_microbatches=self._n_micro)
+                             num_microbatches=self._n_micro,
+                             rng_key=rng)
         if not isinstance(out, jax.core.Tracer) \
                 and getattr(out, "sharding", None) is not None \
                 and out.sharding.num_devices > 1:

@@ -295,3 +295,222 @@ def test_gpt_pipeline_trains_with_spmdtrainer():
                                     mx.np.array(labels)).asnumpy()))
     assert onp.allclose(lp, lr, rtol=2e-3, atol=2e-4), (lp, lr)
     assert lp[-1] < lp[0]
+
+
+# ---------------------------------------------------------------------------
+# 1F1B schedule + in-pipeline dropout (VERDICT r2 item 5)
+# ---------------------------------------------------------------------------
+
+def test_1f1b_schedule_tables_well_formed():
+    """Every (stage, microbatch) fwd and bwd unit is scheduled exactly
+    once, dependencies point backward in time, and the in-flight bound
+    that justifies the S-slot residual ring holds."""
+    from mxnet_tpu.parallel.pipeline import _simulate_1f1b
+    for S, M in [(2, 2), (3, 5), (4, 8), (8, 8)]:
+        fwd, bwd, arr_f, arr_b = _simulate_1f1b(S, M)
+        T = fwd.shape[0]
+        for s in range(S):
+            assert sorted(m for m in fwd[:, s] if m >= 0) == list(range(M))
+            assert sorted(m for m in bwd[:, s] if m >= 0) == list(range(M))
+        # arrival tables point at the producing tick's schedule, both
+        # for activations (fwd, from stage s-1) and cotangents (bwd,
+        # from stage s+1 — what inbox_b banking relies on)
+        for k in range(1, T):
+            for s in range(1, S):
+                assert arr_f[k][s] == fwd[k - 1][s - 1]
+            for s in range(S - 1):
+                assert arr_b[k][s] == bwd[k - 1][s + 1]
+
+
+def test_1f1b_matches_gpipe_autodiff():
+    """pipeline_train_grads (hand-scheduled 1F1B fwd+bwd) must produce
+    the SAME loss and stage gradients as jax.grad over the GPipe
+    pipeline_apply schedule."""
+    from mxnet_tpu.parallel.pipeline import pipeline_train_grads
+    mesh = make_mesh({"pp": 4}, devices=jax.devices()[:4])
+    W, b = _stacked()
+    M = 8
+    rng = onp.random.RandomState(3)
+    x = jnp.asarray(rng.uniform(-1, 1, (M * 2, 16)).astype(onp.float32))
+    y = jnp.asarray(rng.uniform(-1, 1, (M * 2, 16)).astype(onp.float32))
+
+    def loss_fn(h, ymb):
+        return jnp.mean((h - ymb) ** 2)
+
+    def gpipe_loss(params, x, y):
+        out = pipeline_apply(_stage, params, x, mesh, axis="pp",
+                             num_microbatches=M)
+        out_mb = out.reshape((M, -1) + out.shape[1:])
+        y_mb = y.reshape((M, -1) + y.shape[1:])
+        return jnp.mean(jax.vmap(loss_fn)(out_mb, y_mb))
+
+    lg, gg = jax.value_and_grad(gpipe_loss)((W, b), x, y)
+    l1, g1 = pipeline_train_grads(_stage, loss_fn, (W, b), x, y, mesh,
+                                  axis="pp", num_microbatches=M)
+    assert abs(float(lg) - float(l1)) < 1e-6, (float(lg), float(l1))
+    for a, c in zip(gg, g1):
+        onp.testing.assert_allclose(onp.asarray(a), onp.asarray(c),
+                                    rtol=1e-4, atol=1e-6)
+
+
+def test_1f1b_uneven_micro_and_stages():
+    """Off-square configs (M != S, M not multiple of S) stay exact."""
+    from mxnet_tpu.parallel.pipeline import pipeline_train_grads
+    mesh = make_mesh({"pp": 2}, devices=jax.devices()[:2])
+    W, b = _stacked(n_stages=2, seed=5)
+    M = 5
+    rng = onp.random.RandomState(5)
+    x = jnp.asarray(rng.uniform(-1, 1, (M * 3, 16)).astype(onp.float32))
+    y = jnp.asarray(rng.uniform(-1, 1, (M * 3, 16)).astype(onp.float32))
+
+    def loss_fn(h, ymb):
+        return jnp.mean((h - ymb) ** 2)
+
+    def seq_loss(params, x, y):
+        out = _seq_ref(params[0], params[1], x)
+        out_mb = out.reshape((M, -1) + out.shape[1:])
+        y_mb = y.reshape((M, -1) + y.shape[1:])
+        return jnp.mean(jax.vmap(loss_fn)(out_mb, y_mb))
+
+    ls, gs = jax.value_and_grad(seq_loss)((W, b), x, y)
+    l1, g1 = pipeline_train_grads(_stage, loss_fn, (W, b), x, y, mesh,
+                                  axis="pp", num_microbatches=M)
+    assert abs(float(ls) - float(l1)) < 1e-6
+    for a, c in zip(gs, g1):
+        onp.testing.assert_allclose(onp.asarray(a), onp.asarray(c),
+                                    rtol=1e-4, atol=1e-6)
+
+
+def test_gpt_pipeline_dropout_trains():
+    """GPTPipe(dropout>0): per-(microbatch, stage) keys thread through
+    the schedule — train-mode forwards differ run to run, eval is
+    deterministic, and the model trains under SPMDTrainer."""
+    from jax.sharding import PartitionSpec as P
+    from mxnet_tpu.parallel.pipeline import GPTPipe, PIPELINE_RULES
+    mesh = make_mesh({"pp": 4}, devices=jax.devices()[:4])
+    mx.random.seed(0)
+    pipe = GPTPipe(mesh, vocab_size=64, num_layers=4, units=32,
+                   hidden_size=64, num_heads=2, max_length=16,
+                   num_microbatches=4, dropout=0.3)
+    pipe.initialize()
+    toks = onp.random.RandomState(0).randint(0, 64, (8, 8)).astype("int32")
+    pipe(mx.np.array(toks))  # deferred init (eval mode)
+
+    # eval: deterministic
+    o1 = pipe(mx.np.array(toks)).asnumpy()
+    o2 = pipe(mx.np.array(toks)).asnumpy()
+    onp.testing.assert_allclose(o1, o2, rtol=1e-6, atol=1e-7)
+
+    # train mode: dropout draws fresh randomness per forward
+    with mx.autograd.record(train_mode=True):
+        t1 = pipe(mx.np.array(toks)).asnumpy()
+        t2 = pipe(mx.np.array(toks)).asnumpy()
+    assert float(onp.abs(t1 - t2).max()) > 1e-4
+
+    labels = onp.random.RandomState(1).randint(0, 64, (8, 8)).astype("int32")
+    lf = mx.gluon.loss.SoftmaxCrossEntropyLoss(axis=-1)
+    tr = SPMDTrainer(pipe, lambda o, l: lf(o, l), optimizer="adam",
+                     optimizer_params={"learning_rate": 0.01},
+                     mesh=mesh, rules=PIPELINE_RULES,
+                     data_spec=P(), label_spec=P())
+    losses = [float(tr.step(mx.np.array(toks),
+                            mx.np.array(labels)).asnumpy())
+              for _ in range(8)]
+    assert onp.mean(losses[-2:]) < onp.mean(losses[:2]), losses
+
+
+# ---------------------------------------------------------------------------
+# Top-2 gating + router z-loss + MoE-in-GPT (VERDICT r2 item 6)
+# ---------------------------------------------------------------------------
+
+def test_moe_top2_matches_manual_dense():
+    """With ample capacity, the top-2 routed output equals the manual
+    per-token sum of the two best experts' FFNs with renormalized gate
+    weights."""
+    mx.random.seed(2)
+    m = MoEDense(4, 24, top_k=2, capacity_factor=8.0)
+    m.initialize()
+    rng = onp.random.RandomState(2)
+    x = mx.np.array(rng.uniform(-1, 1, (10, 12)).astype("float32"))
+    y = m(x).asnumpy()
+
+    gate = m.gate.data().asnumpy()
+    w1 = m.expert_w1.data().asnumpy()
+    b1 = m.expert_b1.data().asnumpy()
+    w2 = m.expert_w2.data().asnumpy()
+    b2 = m.expert_b2.data().asnumpy()
+    xs = x.asnumpy()
+    logits = xs @ gate.T
+    pr = onp.exp(logits - logits.max(-1, keepdims=True))
+    pr /= pr.sum(-1, keepdims=True)
+
+    def gelu(a):
+        from scipy.special import erf
+        return a * 0.5 * (1 + erf(a / onp.sqrt(2.0)))
+
+    expect = onp.zeros_like(y)
+    for n in range(xs.shape[0]):
+        order = onp.argsort(-pr[n])
+        e1, e2 = order[0], order[1]
+        p1, p2 = pr[n][e1], pr[n][e2]
+        ws = [p1 / (p1 + p2), p2 / (p1 + p2)]
+        for e, w in zip((e1, e2), ws):
+            h = gelu(xs[n] @ w1[e] + b1[e])
+            expect[n] += w * (h @ w2[e] + b2[e])
+    onp.testing.assert_allclose(y, expect, rtol=2e-4, atol=2e-5)
+
+
+def test_moe_router_z_loss_term():
+    """aux = E*sum_e frac_e*mean_p_e + coef * mean(logsumexp(logits)^2)."""
+    mx.random.seed(3)
+    m = MoEDense(4, 16, top_k=1, router_z_loss=0.1)
+    m.initialize()
+    x = mx.np.array(onp.random.RandomState(3)
+                    .uniform(-1, 1, (8, 8)).astype("float32"))
+    m(x)
+    got = float(m.aux_loss.asnumpy())
+    logits = x.asnumpy() @ m.gate.data().asnumpy().T
+    mx_ = logits.max(-1, keepdims=True)
+    pr = onp.exp(logits - mx_)
+    pr /= pr.sum(-1, keepdims=True)
+    frac = onp.eye(4)[logits.argmax(-1)].mean(0)
+    balance = 4.0 * (frac * pr.mean(0)).sum()
+    z = onp.log(onp.exp(logits - mx_).sum(-1)) + mx_[:, 0]
+    onp.testing.assert_allclose(got, balance + 0.1 * (z ** 2).mean(),
+                                rtol=1e-4)
+
+
+def test_moe_gpt_trains_ep_dp_mesh():
+    """GPTModel(moe_every_n=2, top-2 experts) trains under SPMDTrainer on
+    a COMBINED ep x dp mesh with the aux losses in the objective; the
+    ep-sharded run matches a replicated run's losses."""
+    from jax.sharding import PartitionSpec as P
+    from mxnet_tpu.gluon.model_zoo.gpt import GPTModel
+    from mxnet_tpu.parallel import MOE_TRANSFORMER_RULES
+
+    def build_and_run(mesh, rules, data_spec):
+        mx.random.seed(7)
+        net = GPTModel(vocab_size=64, num_layers=2, units=32,
+                       hidden_size=48, num_heads=2, max_length=16,
+                       dropout=0.0, moe_every_n=2, moe_experts=4,
+                       moe_top_k=2)
+        net.initialize()
+        toks = onp.random.RandomState(0).randint(0, 64, (8, 8)) \
+            .astype("int32")
+        net(mx.np.array(toks))
+        lf = mx.gluon.loss.SoftmaxCrossEntropyLoss(axis=-1)
+        tr = SPMDTrainer(net, lambda o, l: lf(o, l), optimizer="adam",
+                         optimizer_params={"learning_rate": 0.01},
+                         mesh=mesh, rules=rules, data_spec=data_spec)
+        labels = onp.random.RandomState(1).randint(0, 64, (8, 8)) \
+            .astype("int32")
+        return [float(tr.step(mx.np.array(toks),
+                              mx.np.array(labels)).asnumpy())
+                for _ in range(6)]
+
+    mesh = make_mesh({"dp": 2, "ep": 4})
+    losses = build_and_run(mesh, MOE_TRANSFORMER_RULES, P("dp"))
+    assert losses[-1] < losses[0], losses
+    mesh1 = make_mesh({"dp": 1}, devices=jax.devices()[:1])
+    ref = build_and_run(mesh1, DATA_PARALLEL_RULES, P())
+    onp.testing.assert_allclose(losses, ref, rtol=5e-3, atol=5e-4)
