@@ -92,6 +92,8 @@ class GPTModel(HybridBlock):
                  num_heads: int = 12, max_length: int = 1024,
                  dropout: float = 0.1, moe_every_n: int = 0,
                  moe_experts: int = 8, moe_top_k: int = 2,
+                 moe_capacity_factor: float = 1.25,
+                 moe_router_z_loss: float = 1e-3,
                  **kwargs: Any) -> None:
         super().__init__(**kwargs)
         self._units = units
@@ -108,7 +110,9 @@ class GPTModel(HybridBlock):
                                      dropout,
                                      moe_experts=moe_experts if is_moe
                                      else 0,
-                                     moe_top_k=moe_top_k))
+                                     moe_top_k=moe_top_k,
+                                     moe_capacity_factor=moe_capacity_factor,
+                                     moe_router_z_loss=moe_router_z_loss))
         self.ln_f = LayerNorm(epsilon=1e-5, in_channels=units)
         self._dropout = dropout
 

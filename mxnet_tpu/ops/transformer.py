@@ -95,13 +95,16 @@ def dot_product_attention(query, key, value, mask=None,
             bias = _mask_to_bias(rest[0], q.dtype, q.shape[0], q.shape[1],
                                  k.shape[1])
             mask_learned = rest[0].dtype != jnp.bool_
-        if bias is None and train_rate == 0.0:
-            ring = _use_ring(q, k)
-            if ring is not None:
-                from ..parallel.ring import ring_attention
-                mesh, axis = ring
-                return ring_attention(q, k, v, mesh, axis=axis,
-                                      scale=sc, causal=cz)
+        ring = _use_ring(q, k)
+        if ring is not None and _ring_bias_ok(bias, q, k):
+            # padding masks and dropout stay ON the ring path (r3): the
+            # bias row-stripe shards with q, dropout masks regenerate
+            # per (shard, block)
+            from ..parallel.ring import ring_attention
+            mesh, axis = ring
+            return ring_attention(q, k, v, mesh, axis=axis,
+                                  scale=sc, causal=cz, bias=bias,
+                                  dropout=train_rate, dropout_seed=seed)
         if use_flash and _flash_bias_ok(bias, q, k):
             from .pallas.attention import flash_attention
             return flash_attention(
@@ -147,6 +150,11 @@ def _attn_seed():
     from ..ndarray import random as _random
     key = _random.split_key()
     return jax.random.key_data(key).reshape(-1)[:2].astype(jnp.int32)
+
+
+# Ring attention shards bias rows with q and slices columns per ring
+# step — the SAME (B|1, H|1, 1|Tq, Tk) contract as the flash kernel.
+_ring_bias_ok = _flash_bias_ok
 
 
 def _use_ring(q, k):
@@ -224,13 +232,13 @@ def multi_head_attention(query, key, value, num_heads: int, mask=None,
         if rest:
             bias = _mask_to_bias(rest[0], q.dtype, B, Tq, Tk)
             mask_learned = rest[0].dtype != jnp.bool_
-        ring = None if (bias is not None or train_rate) \
-            else _use_ring(qh, kh)
-        if ring is not None:
+        ring = _use_ring(qh, kh)
+        if ring is not None and _ring_bias_ok(bias, qh, kh):
             from ..parallel.ring import ring_attention
             mesh, axis = ring
             out = ring_attention(qh, kh, vh, mesh, axis=axis,
-                                 scale=sc, causal=cz)
+                                 scale=sc, causal=cz, bias=bias,
+                                 dropout=train_rate, dropout_seed=seed)
         elif use_flash and _flash_bias_ok(bias, qh, kh):
             from .pallas.attention import flash_attention
             out = flash_attention(

@@ -64,9 +64,11 @@ MOE_TRANSFORMER_RULES = MOE_RULES + DEFAULT_TRANSFORMER_RULES
 
 
 class MoEDense(HybridBlock):
-    """Top-1 routed mixture of expert FFNs (GShard-style).
+    """Routed mixture of expert FFNs (GShard-style, top-1 or top-2).
 
-    Input (B, T, d) or (N, d); each token goes to its argmax expert,
+    Input (B, T, d) or (N, d); each token goes to its argmax expert
+    (``top_k=2`` adds the runner-up with renormalized combine weights
+    and a queue appended after all first choices),
     bucketed to ``capacity_factor * N / num_experts`` slots per expert.
     Overflow tokens produce ZERO output — wrap the layer in an external
     residual connection (as Switch Transformer does) so they pass through.

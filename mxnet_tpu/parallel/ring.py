@@ -61,15 +61,22 @@ def current_sequence_parallel():
     return _sp_state["mesh"], _sp_state["axis"]
 
 
-def _block_update(q, k, v, m, l, acc, scale, row0, col0, causal, kv_len):
+def _block_update(q, k, v, m, l, acc, scale, row0, col0, causal, kv_len,
+                  bias_blk=None, keep=None, rate: float = 0.0):
     """Online-softmax update of (m, l, acc) with one K/V block.
 
     q: (B, Tq, H, D); k/v: (B, Tk, H, D); m/l: (B, Tq, H, 1);
     acc: (B, Tq, H, D). row0/col0 are the global offsets of the local Q
     block and the current K/V block; kv_len masks ragged padding.
+    bias_blk: additive score bias for this block's columns, broadcastable
+    to (B, Tq, H, Tk). keep/rate: probability-dropout mask for the block
+    (the denominator uses the UNdropped probabilities, matching the
+    Pallas flash kernel and inverted-dropout convention).
     """
     s = jnp.einsum("bqhd,bkhd->bqhk", q.astype(jnp.float32),
                    k.astype(jnp.float32)) * scale
+    if bias_blk is not None:
+        s = s + bias_blk.astype(jnp.float32)
     col = col0 + jax.lax.broadcasted_iota(jnp.int32, s.shape, 3)
     mask = col < kv_len
     if causal:
@@ -82,6 +89,8 @@ def _block_update(q, k, v, m, l, acc, scale, row0, col0, causal, kv_len):
     alpha = jnp.exp(m - m_new)
     p = jnp.exp(s - m_new)
     l_new = alpha * l + jnp.sum(p, axis=3, keepdims=True)
+    if rate > 0.0:
+        p = jnp.where(keep, p / (1.0 - rate), 0.0)
     acc_new = acc * alpha + jnp.einsum(
         "bqhk,bkhd->bqhd", p, v.astype(jnp.float32))
     return m_new, l_new, acc_new
@@ -89,12 +98,20 @@ def _block_update(q, k, v, m, l, acc, scale, row0, col0, causal, kv_len):
 
 def local_ring_attention(q, k, v, axis_name: str, n_shards: int,
                          scale: Optional[float] = None,
-                         causal: bool = False, kv_len: Optional[int] = None):
+                         causal: bool = False, kv_len: Optional[int] = None,
+                         bias=None, dropout: float = 0.0,
+                         dropout_key=None):
     """Per-device body: exact attention with K/V rotating around the ring.
 
     Call inside ``shard_map`` with the sequence axis sharded over
     ``axis_name``. q/k/v: (B, T_local, H, D) — this device's sequence
-    shard. Returns (B, T_local, H, D).
+    shard. ``bias``: this device's ROW stripe of the additive score bias
+    in (B|1, Tl|1, H|1, T_global) layout — columns for the held block are
+    dynamically sliced each ring step, so padding masks and dense biases
+    stay on the ring path. ``dropout``/``dropout_key``: probability
+    dropout; the key folds per (destination shard, source block), so the
+    mask is a pure function of global tile coordinates (backward's scan
+    recompute regenerates it). Returns (B, T_local, H, D).
     """
     if scale is None:
         scale = 1.0 / math.sqrt(q.shape[-1])
@@ -104,6 +121,7 @@ def local_ring_attention(q, k, v, axis_name: str, n_shards: int,
     if kv_len is None:
         kv_len = n_shards * Tk
     row0 = my * Tl
+    rate = float(dropout)
 
     m0 = jnp.full((B, Tl, H, 1), _NEG_INF, jnp.float32)
     l0 = jnp.zeros((B, Tl, H, 1), jnp.float32)
@@ -115,8 +133,17 @@ def local_ring_attention(q, k, v, axis_name: str, n_shards: int,
         k_blk, v_blk, m, l, acc = carry
         src = (my - step) % n_shards          # origin of the held block
         col0 = src * Tk
+        bias_blk = None
+        if bias is not None:
+            bias_blk = jax.lax.dynamic_slice_in_dim(bias, col0, Tk, axis=3)
+        keep = None
+        if rate > 0.0:
+            key = jax.random.fold_in(jax.random.fold_in(dropout_key, my),
+                                     src)
+            keep = jax.random.bernoulli(key, 1.0 - rate, (B, Tl, H, Tk))
         m, l, acc = _block_update(q, k_blk, v_blk, m, l, acc, scale,
-                                  row0, col0, causal, kv_len)
+                                  row0, col0, causal, kv_len,
+                                  bias_blk=bias_blk, keep=keep, rate=rate)
         # rotate: send our block to the next device, receive from previous
         k_blk = jax.lax.ppermute(k_blk, axis_name, perm)
         v_blk = jax.lax.ppermute(v_blk, axis_name, perm)
@@ -129,7 +156,8 @@ def local_ring_attention(q, k, v, axis_name: str, n_shards: int,
 
 
 def ring_attention(q, k, v, mesh: "jax.sharding.Mesh", axis: str = "sp",
-                   scale: Optional[float] = None, causal: bool = False):
+                   scale: Optional[float] = None, causal: bool = False,
+                   bias=None, dropout: float = 0.0, dropout_seed=None):
     """Sequence-parallel exact attention over mesh axis ``axis``.
 
     q/k/v: (B, T, H, D) logically global; T must divide by the axis size.
@@ -137,12 +165,22 @@ def ring_attention(q, k, v, mesh: "jax.sharding.Mesh", axis: str = "sp",
     sequence sharded; inside, K/V blocks ride the ring via ppermute.
     Differentiable; composable with jit and other mesh axes (other axes
     see this function as purely local compute).
+
+    bias (r3): additive score bias (B|1, H|1, Tq|1, Tk) — padding masks
+    and dense biases included; its row dim shards over the ring with q,
+    its column dim stays whole per device (memory Tq·Tk/n) and is sliced
+    per ring step. dropout/dropout_seed ((2,) int32): attention-
+    probability dropout with tile-deterministic masks, so sp training
+    with padded batches and dropout STAYS on the ring path.
     """
     if axis not in mesh.axis_names:
-        return _dense(q, k, v, scale, causal)
+        return _dense(q, k, v, scale, causal, bias, dropout, dropout_seed)
     n = mesh.shape[axis]
-    if n == 1 or q.shape[1] % n != 0 or k.shape[1] % n != 0:
-        return _dense(q, k, v, scale, causal)
+    if n == 1 or q.shape[1] % n != 0 or k.shape[1] % n != 0 or \
+            (bias is not None and
+             (bias.shape[2] not in (1, q.shape[1])
+              or bias.shape[3] != k.shape[1])):
+        return _dense(q, k, v, scale, causal, bias, dropout, dropout_seed)
 
     # carry the surrounding dp/tp layout through the shard_map so GSPMD
     # does not insert gathers around it (SPMDTrainer shards batch over dp
@@ -154,23 +192,48 @@ def ring_attention(q, k, v, mesh: "jax.sharding.Mesh", axis: str = "sp",
     bax = _axis_if("dp", q.shape[0])
     hax = _axis_if("tp", q.shape[2])
     spec = P(bax, axis, hax, None)
-    fn = functools.partial(local_ring_attention, axis_name=axis, n_shards=n,
-                           scale=scale, causal=causal)
+    key = None
+    if dropout > 0.0:
+        key = jax.random.wrap_key_data(
+            jnp.asarray(dropout_seed, jnp.uint32).reshape(2,),
+            impl="threefry2x32")
+
+    in_specs = [spec, spec, spec]
+    args = [q, k, v]
+    if bias is not None:
+        # (B|1, H|1, Tq|1, Tk) -> the ring layout (B|1, Tq|1, H|1, Tk);
+        # rows shard with q, columns stay whole per device
+        bias_t = jnp.swapaxes(bias, 1, 2)
+        in_specs.append(P(
+            bax if bias_t.shape[0] > 1 else None,
+            axis if bias_t.shape[1] > 1 else None,
+            hax if bias_t.shape[2] > 1 else None, None))
+        args.append(bias_t)
+
+    def fn(qq, kk, vv, *rest):
+        return local_ring_attention(
+            qq, kk, vv, axis_name=axis, n_shards=n, scale=scale,
+            causal=causal, bias=rest[0] if rest else None,
+            dropout=dropout, dropout_key=key)
+
     try:
         from jax import shard_map
         kw = {"check_vma": False}
     except ImportError:     # jax < 0.8
         from jax.experimental.shard_map import shard_map
         kw = {"check_rep": False}
-    return shard_map(fn, mesh=mesh, in_specs=(spec, spec, spec),
-                     out_specs=spec, **kw)(q, k, v)
+    return shard_map(fn, mesh=mesh, in_specs=tuple(in_specs),
+                     out_specs=spec, **kw)(*args)
 
 
-def _dense(q, k, v, scale, causal):
+def _dense(q, k, v, scale, causal, bias=None, dropout: float = 0.0,
+           dropout_seed=None):
     if scale is None:
         scale = 1.0 / math.sqrt(q.shape[-1])
     s = jnp.einsum("bqhd,bkhd->bqhk", q.astype(jnp.float32),
                    k.astype(jnp.float32)) * scale
+    if bias is not None:
+        s = s + jnp.swapaxes(bias, 1, 2).astype(jnp.float32)
     if causal:
         # top-left alignment (col <= row), matching the ring path and
         # jax.nn.dot_product_attention(is_causal=True)
@@ -178,5 +241,11 @@ def _dense(q, k, v, scale, causal):
         mask = jnp.tril(jnp.ones((Tq, Tk), bool))[None, :, None, :]
         s = jnp.where(mask, s, _NEG_INF)
     p = jax.nn.softmax(s, axis=3)
+    if dropout > 0.0:
+        key = jax.random.wrap_key_data(
+            jnp.asarray(dropout_seed, jnp.uint32).reshape(2,),
+            impl="threefry2x32")
+        keep = jax.random.bernoulli(key, 1.0 - dropout, p.shape)
+        p = jnp.where(keep, p / (1.0 - dropout), 0.0)
     return jnp.einsum("bqhk,bkhd->bqhd", p,
                       v.astype(jnp.float32)).astype(q.dtype)

@@ -143,3 +143,127 @@ def test_causal_cross_attention_alignment_consistent(engaged):
         out = _dense(q, k, v, None, True)
     onp.testing.assert_allclose(onp.asarray(out), onp.asarray(ref),
                                 rtol=2e-5, atol=2e-5)
+
+
+# ---------------------------------------------------------------------------
+# Ring attention under padding masks and dropout (VERDICT r2 item 9)
+# ---------------------------------------------------------------------------
+
+@pytest.mark.parametrize("bias_shape", [(2, 1, 1, 32), (1, 1, 32, 32),
+                                        (2, 4, 32, 32)])
+def test_ring_bias_matches_dense(bias_shape):
+    """Additive biases — key-padding rows, score masks, full dense — ride
+    the ring (row stripe sharded, columns sliced per step) and match the
+    dense reference, forward and backward."""
+    mesh = make_mesh({"sp": 8})
+    q, k, v = _rand_qkv()
+    rng = onp.random.RandomState(9)
+    bias = jnp.asarray(rng.uniform(-2, 2, bias_shape).astype("float32"))
+
+    out = ring_attention(q, k, v, mesh, axis="sp", bias=bias)
+    ref = _dense(q, k, v, None, False, bias=bias)
+    onp.testing.assert_allclose(onp.asarray(out), onp.asarray(ref),
+                                rtol=2e-5, atol=2e-5)
+
+    def loss_ring(q, k, v):
+        return jnp.sum(ring_attention(q, k, v, mesh, axis="sp",
+                                      bias=bias, causal=True) ** 2)
+
+    def loss_dense(q, k, v):
+        return jnp.sum(_dense(q, k, v, None, True, bias=bias) ** 2)
+
+    gr = jax.grad(loss_ring, argnums=(0, 1, 2))(q, k, v)
+    gd = jax.grad(loss_dense, argnums=(0, 1, 2))(q, k, v)
+    for a, b in zip(gr, gd):
+        onp.testing.assert_allclose(onp.asarray(a), onp.asarray(b),
+                                    rtol=3e-5, atol=3e-5)
+
+
+def test_ring_key_padding_mask_zeroes_padded_keys():
+    """A -1e9 key-padding bias on the ring: padded key positions get ~0
+    attention everywhere, and outputs equal dense attention over the
+    valid prefix."""
+    mesh = make_mesh({"sp": 8})
+    q, k, v = _rand_qkv(T=32)
+    keep = onp.ones((2, 1, 1, 32), "float32") * 0.0
+    keep[:, :, :, 24:] = -1e9                   # last shard fully padded
+    bias = jnp.asarray(keep)
+    out = ring_attention(q, k, v, mesh, axis="sp", bias=bias)
+    ref = _dense(q[:, :, :, :], k[:, :24], v[:, :24], None, False)
+    onp.testing.assert_allclose(onp.asarray(out), onp.asarray(ref),
+                                rtol=2e-5, atol=2e-5)
+
+
+def test_ring_dropout_semantics():
+    """Ring dropout: deterministic per seed, different across seeds, and
+    the kept-probability mass is unbiased (inverted dropout)."""
+    mesh = make_mesh({"sp": 8})
+    q, k, v = _rand_qkv(B=2, T=64, H=4, D=8, seed=3)
+    s1 = jnp.asarray([3, 7], jnp.int32)
+    s2 = jnp.asarray([11, 13], jnp.int32)
+    o1 = ring_attention(q, k, v, mesh, axis="sp", dropout=0.4,
+                        dropout_seed=s1)
+    o1b = ring_attention(q, k, v, mesh, axis="sp", dropout=0.4,
+                         dropout_seed=s1)
+    o2 = ring_attention(q, k, v, mesh, axis="sp", dropout=0.4,
+                        dropout_seed=s2)
+    onp.testing.assert_allclose(onp.asarray(o1), onp.asarray(o1b))
+    assert float(jnp.abs(o1 - o2).max()) > 1e-4
+    # unbiasedness: averaging many seeds approaches the undropped output
+    outs = [onp.asarray(ring_attention(
+        q, k, v, mesh, axis="sp", dropout=0.4,
+        dropout_seed=jnp.asarray([s, s + 1], jnp.int32)))
+        for s in range(0, 40, 2)]
+    ref = onp.asarray(ring_attention(q, k, v, mesh, axis="sp"))
+    err = onp.abs(onp.mean(outs, axis=0) - ref).mean()
+    assert err < 0.05, err
+    # gradients flow (backward regenerates the same per-tile masks)
+    g = jax.grad(lambda q: jnp.sum(ring_attention(
+        q, k, v, mesh, axis="sp", dropout=0.4, dropout_seed=s1) ** 2))(q)
+    assert onp.isfinite(onp.asarray(g)).all()
+
+
+def test_spmd_masked_dropout_bert_stays_on_ring():
+    """A BERT layer trained under sp with a PADDING MASK and DROPOUT must
+    keep the ring path (collective-permutes in the compiled step) — the
+    r2 behavior silently fell back to gathered dense attention."""
+    import mxnet_tpu as mx
+    from mxnet_tpu.parallel import SPMDTrainer, DATA_PARALLEL_RULES
+    from mxnet_tpu.gluon.model_zoo.bert import BERTEncoderLayer
+
+    mx.random.seed(7)
+    layer = BERTEncoderLayer(units=16, hidden_size=32, num_heads=2,
+                             dropout=0.2)
+    layer.initialize()
+    layer(mx.np.zeros((2, 8, 16)))
+    X = onp.random.RandomState(4).uniform(-1, 1, (4, 16, 16)) \
+        .astype("float32")
+    M = onp.ones((4, 1, 1, 16), bool)
+    M[:, :, :, 12:] = False                     # padded keys
+    Y = onp.random.RandomState(5).randint(0, 16, (4, 16)).astype("int32")
+    loss_fn = mx.gluon.loss.SoftmaxCrossEntropyLoss(axis=-1)
+    P = jax.sharding.PartitionSpec
+    mesh = make_mesh({"dp": 2, "sp": 4})
+
+    class MaskedLayer(mx.gluon.HybridBlock):
+        def __init__(self):
+            super().__init__()
+            self.inner = layer
+        def forward(self, x, mask):
+            return self.inner(x, mask)
+
+    net = MaskedLayer()
+    tr = SPMDTrainer(net, loss_fn, "sgd", {"learning_rate": 0.05},
+                     mesh=mesh, rules=DATA_PARALLEL_RULES,
+                     data_spec=P("dp", "sp"), label_spec=P("dp", "sp"))
+    ls = [float(tr.step([mx.np.array(X), mx.np.array(M)],
+                        mx.np.array(Y)).asnumpy()) for _ in range(3)]
+    assert all(onp.isfinite(ls)) and ls[-1] < ls[0], ls
+    hlo = tr._step_fn.lower(
+        [p.data()._data for p in tr._params], tr._opt_states,
+        jax.random.PRNGKey(0), jax.numpy.float32(0.05),
+        jax.numpy.float32(0.0), jax.numpy.float32(1.0),
+        jax.numpy.asarray(X), jax.numpy.asarray(M),
+        jax.numpy.asarray(Y)).compile().as_text()
+    assert hlo.count("collective-permute") >= 2, \
+        "masked+dropout attention fell off the ring path"
