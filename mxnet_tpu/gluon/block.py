@@ -497,6 +497,11 @@ class HybridBlock(Block):
             "stablehlo": base64.b64encode(bytes(exp.serialize())).decode(
                 "ascii"),
         }
+        # native-runtime deploy graph (c_predict_api analog): a layer-op
+        # list MXPredCreate can execute with no Python, emitted whenever
+        # the block maps onto the native op set
+        from .deploy import deploy_graph
+        meta["deploy_graph"] = deploy_graph(self)
         # write artifacts only after trace + serialization succeeded — a
         # failed export must not leave a stale .params behind
         param_file = f"{path}-{epoch:04d}.params"

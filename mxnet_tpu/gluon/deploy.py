@@ -1,0 +1,94 @@
+"""Deploy-graph emission: a layer-op list the native C runtime can run.
+
+Reference parity (leezu/mxnet): ``HybridBlock.export`` wrote an NNVM
+graph json that ``src/c_predict_api.cc`` executed from C with no Python.
+Here the primary export payload is a StableHLO artifact (the TPU-era
+graph format), which the C runtime cannot interpret — so export()
+ADDITIONALLY emits this small declarative op list whenever the block is
+composed of layers the native runtime implements (dense / conv2d /
+batchnorm / pooling / activation / flatten / dropout-as-identity).
+``src/predict.cc`` (MXPredCreate/MXPredForward) parses it, loads the
+.params file, and executes the graph through MXImperativeInvoke.
+"""
+from __future__ import annotations
+
+from typing import Any, Dict, List, Optional
+
+
+class _Unmappable(Exception):
+    pass
+
+
+def deploy_graph(block) -> Optional[List[Dict[str, Any]]]:
+    """Best-effort layer-op list for ``block``; None when any layer has
+    no native-runtime mapping (the StableHLO payload still covers it)."""
+    from .nn.basic_layers import (Dense, Dropout, Flatten, BatchNorm,
+                                  HybridSequential)
+    from .nn.activations import Activation
+    from .nn.conv_layers import (Conv2D, MaxPool2D, AvgPool2D,
+                                 GlobalMaxPool2D, GlobalAvgPool2D)
+
+    nodes: List[Dict[str, Any]] = []
+
+    def act_ok(a: Optional[str]) -> Optional[str]:
+        # the native runtime implements exactly these (src/ndarray.cc)
+        if a not in (None, "relu", "sigmoid", "tanh"):
+            raise _Unmappable(f"activation {a!r}")
+        return a
+
+    def emit(b, prefix: str) -> None:
+        if isinstance(b, HybridSequential):
+            for name, child in b._children.items():
+                emit(child, f"{prefix}{name}.")
+            return
+        if isinstance(b, Dense):
+            nodes.append({
+                "op": "dense", "weight": prefix + "weight",
+                "bias": prefix + "bias" if b.bias is not None else None,
+                "flatten": int(b._flatten),
+                "activation": act_ok(b._activation)})
+            return
+        if isinstance(b, Conv2D):
+            if (b._transpose or b._groups != 1 or b._layout != "NCHW"
+                    or tuple(b._dilation) != (1, 1)):
+                raise _Unmappable(repr(b))
+            nodes.append({
+                "op": "conv2d", "weight": prefix + "weight",
+                "bias": prefix + "bias" if b.bias is not None else None,
+                "stride": list(b._strides), "pad": list(b._padding),
+                "activation": act_ok(b._activation)})
+            return
+        if isinstance(b, (MaxPool2D, AvgPool2D, GlobalMaxPool2D,
+                          GlobalAvgPool2D)):
+            if b._layout != "NCHW":
+                raise _Unmappable(repr(b))
+            nodes.append({
+                "op": "maxpool2d" if b._pool_type == "max" else "avgpool2d",
+                "kernel": list(b._kernel), "stride": list(b._strides),
+                "pad": list(b._padding), "global": int(b._global),
+                "count_include_pad": int(b._count_include_pad)})
+            return
+        if isinstance(b, BatchNorm):
+            if b._axis not in (1, -3):
+                raise _Unmappable(repr(b))
+            nodes.append({
+                "op": "batchnorm", "gamma": prefix + "gamma",
+                "beta": prefix + "beta",
+                "mean": prefix + "running_mean",
+                "var": prefix + "running_var", "eps": float(b._epsilon)})
+            return
+        if isinstance(b, Activation):
+            nodes.append({"op": "activation", "act": act_ok(b._act)})
+            return
+        if isinstance(b, Flatten):
+            nodes.append({"op": "flatten"})
+            return
+        if isinstance(b, Dropout):
+            return                      # identity at inference
+        raise _Unmappable(type(b).__name__)
+
+    try:
+        emit(block, "")
+    except _Unmappable:
+        return None
+    return nodes

@@ -156,6 +156,26 @@ int MXNDArrayLoad(const char *fname, int *out_num,
                   NDArrayHandle **out_handles, char ***out_names);
 int MXNDArrayLoadFree(int num, NDArrayHandle *handles, char **names);
 
+/* ---- C predict API (c_predict_api.cc analog) ----
+ * Runs a HybridBlock.export()ed model from C with no Python: parses the
+ * symbol json's "deploy_graph" layer-op list (emitted when the model is
+ * composed of natively-deployable layers: dense / conv2d / batchnorm /
+ * pool2d / activation / flatten / dropout) and executes it through
+ * MXImperativeInvoke on the dependency engine, with weights from the
+ * .params file.  float32 inputs/outputs. */
+typedef void *PredictorHandle;
+
+int MXPredCreate(const char *symbol_json_file, const char *param_file,
+                 const int64_t *input_shape, int input_ndim,
+                 PredictorHandle *out);
+int MXPredSetInput(PredictorHandle h, const float *data, uint64_t size);
+int MXPredForward(PredictorHandle h);
+/* Shape pointer valid until the next Forward/Free. */
+int MXPredGetOutputShape(PredictorHandle h, int *out_ndim,
+                         const int64_t **out_shape);
+int MXPredGetOutput(PredictorHandle h, float *data, uint64_t size);
+int MXPredFree(PredictorHandle h);
+
 /* ---- runtime feature introspection (libinfo.cc analog) ---- */
 const char *MXLibInfoFeatures(void);
 

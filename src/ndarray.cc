@@ -220,6 +220,236 @@ static void CopyOp(const std::vector<Array *> &in,
   std::memcpy(out[0]->data, in[0]->data, in[0]->nbytes);
 }
 
+/* ---- deployment kernels (c_predict_api.cc analog op set) --------------
+ * Ops with geometry take a trailing int32 attrs input array (the engine
+ * path has no attribute channel; attrs ride as data, XLA-style). */
+
+static void RequireF32(const Array *a, const char *who) {
+  if (a->dtype != 0)
+    throw std::runtime_error(std::string(who) + ": float32 only");
+}
+
+static void ValidateDense(const std::vector<Array *> &in,
+                          const std::vector<Array *> &out) {
+  const Array *x = in[0], *W = in[1], *b = in[2], *o = out[0];
+  RequireF32(x, "dense"); RequireF32(W, "dense");
+  RequireF32(b, "dense"); RequireF32(o, "dense");
+  if (x->shape.size() != 2 || W->shape.size() != 2 ||
+      x->shape[1] != W->shape[1])
+    throw std::runtime_error("dense: need x (N,K) and W (U,K)");
+  if (b->shape.size() != 1 || b->shape[0] != W->shape[0])
+    throw std::runtime_error("dense: bias must be (U,)");
+  if (o->shape.size() != 2 || o->shape[0] != x->shape[0] ||
+      o->shape[1] != W->shape[0])
+    throw std::runtime_error("dense: bad output shape");
+}
+
+static void DenseOp(const std::vector<Array *> &in,
+                    const std::vector<Array *> &out) {
+  const float *x = static_cast<const float *>(in[0]->data);
+  const float *W = static_cast<const float *>(in[1]->data);
+  const float *b = static_cast<const float *>(in[2]->data);
+  float *o = static_cast<float *>(out[0]->data);
+  int64_t N = in[0]->shape[0], K = in[0]->shape[1], U = in[1]->shape[0];
+  for (int64_t i = 0; i < N; ++i)
+    for (int64_t u = 0; u < U; ++u) {
+      const float *xr = x + i * K, *wr = W + u * K;
+      double acc = b[u];
+      for (int64_t k = 0; k < K; ++k) acc += double(xr[k]) * wr[k];
+      o[i * U + u] = static_cast<float>(acc);
+    }
+}
+
+static void ValidateSoftmax(const std::vector<Array *> &in,
+                            const std::vector<Array *> &out) {
+  RequireF32(in[0], "softmax"); RequireF32(out[0], "softmax");
+  if (in[0]->shape != out[0]->shape || in[0]->shape.empty())
+    throw std::runtime_error("softmax: same-shape >=1-D in/out required");
+}
+
+static void SoftmaxOp(const std::vector<Array *> &in,
+                      const std::vector<Array *> &out) {
+  const float *x = static_cast<const float *>(in[0]->data);
+  float *o = static_cast<float *>(out[0]->data);
+  int64_t C = in[0]->shape.back();
+  int64_t rows = static_cast<int64_t>(NumElems(in[0])) / (C ? C : 1);
+  for (int64_t r = 0; r < rows; ++r) {
+    const float *xr = x + r * C;
+    float *orow = o + r * C;
+    float mx = xr[0];
+    for (int64_t c = 1; c < C; ++c) mx = std::max(mx, xr[c]);
+    double sum = 0.0;
+    for (int64_t c = 0; c < C; ++c) {
+      orow[c] = std::exp(xr[c] - mx);
+      sum += orow[c];
+    }
+    for (int64_t c = 0; c < C; ++c)
+      orow[c] = static_cast<float>(orow[c] / sum);
+  }
+}
+
+static void ValidateBNInf(const std::vector<Array *> &in,
+                          const std::vector<Array *> &out) {
+  const Array *x = in[0];
+  for (const Array *a : in) RequireF32(a, "batchnorm_inf");
+  RequireF32(out[0], "batchnorm_inf");
+  if (x->shape.size() < 2)
+    throw std::runtime_error("batchnorm_inf: need >= 2-D NC... input");
+  int64_t C = x->shape[1];
+  for (int i = 1; i <= 4; ++i)
+    if (in[i]->shape.size() != 1 || in[i]->shape[0] != C)
+      throw std::runtime_error("batchnorm_inf: stats must be (C,)");
+  if (NumElems(in[5]) != 1)
+    throw std::runtime_error("batchnorm_inf: eps must be a scalar array");
+  if (out[0]->shape != x->shape)
+    throw std::runtime_error("batchnorm_inf: output shape mismatch");
+}
+
+static void BNInfOp(const std::vector<Array *> &in,
+                    const std::vector<Array *> &out) {
+  const float *x = static_cast<const float *>(in[0]->data);
+  const float *g = static_cast<const float *>(in[1]->data);
+  const float *b = static_cast<const float *>(in[2]->data);
+  const float *m = static_cast<const float *>(in[3]->data);
+  const float *v = static_cast<const float *>(in[4]->data);
+  float eps = *static_cast<const float *>(in[5]->data);
+  float *o = static_cast<float *>(out[0]->data);
+  int64_t N = in[0]->shape[0], C = in[0]->shape[1];
+  int64_t inner = 1;
+  for (size_t i = 2; i < in[0]->shape.size(); ++i)
+    inner *= in[0]->shape[i];
+  for (int64_t n = 0; n < N; ++n)
+    for (int64_t c = 0; c < C; ++c) {
+      float scale = g[c] / std::sqrt(v[c] + eps);
+      float shift = b[c] - m[c] * scale;
+      const float *xr = x + (n * C + c) * inner;
+      float *orow = o + (n * C + c) * inner;
+      for (int64_t i = 0; i < inner; ++i) orow[i] = xr[i] * scale + shift;
+    }
+}
+
+static const int32_t *IntAttrs(const Array *a, size_t n, const char *who) {
+  if (a->dtype != 4 || NumElems(a) != n)
+    throw std::runtime_error(std::string(who) +
+                             ": attrs must be int32[" + std::to_string(n) +
+                             "]");
+  return static_cast<const int32_t *>(a->data);
+}
+
+static void ValidateConv2D(const std::vector<Array *> &in,
+                           const std::vector<Array *> &out) {
+  const Array *x = in[0], *W = in[1], *b = in[2], *o = out[0];
+  RequireF32(x, "conv2d"); RequireF32(W, "conv2d");
+  RequireF32(b, "conv2d"); RequireF32(o, "conv2d");
+  if (x->shape.size() != 4 || W->shape.size() != 4 ||
+      x->shape[1] != W->shape[1])
+    throw std::runtime_error("conv2d: need x NCHW and W OIHW");
+  const int32_t *at = IntAttrs(in[3], 4, "conv2d");
+  if (at[0] <= 0 || at[1] <= 0)
+    throw std::runtime_error("conv2d: stride must be positive");
+  int64_t OH = (x->shape[2] + 2 * at[2] - W->shape[2]) / at[0] + 1;
+  int64_t OW = (x->shape[3] + 2 * at[3] - W->shape[3]) / at[1] + 1;
+  std::vector<int64_t> want = {x->shape[0], W->shape[0], OH, OW};
+  if (o->shape != want)
+    throw std::runtime_error("conv2d: bad output shape");
+  if (b->shape.size() != 1 || b->shape[0] != W->shape[0])
+    throw std::runtime_error("conv2d: bias must be (O,)");
+}
+
+static void Conv2DOp(const std::vector<Array *> &in,
+                     const std::vector<Array *> &out) {
+  const float *x = static_cast<const float *>(in[0]->data);
+  const float *W = static_cast<const float *>(in[1]->data);
+  const float *b = static_cast<const float *>(in[2]->data);
+  const int32_t *at = static_cast<const int32_t *>(in[3]->data);
+  float *o = static_cast<float *>(out[0]->data);
+  int64_t N = in[0]->shape[0], C = in[0]->shape[1];
+  int64_t H = in[0]->shape[2], Wd = in[0]->shape[3];
+  int64_t O = in[1]->shape[0], KH = in[1]->shape[2], KW = in[1]->shape[3];
+  int64_t sh = at[0], sw = at[1], ph = at[2], pw = at[3];
+  int64_t OH = out[0]->shape[2], OW = out[0]->shape[3];
+  for (int64_t n = 0; n < N; ++n)
+    for (int64_t oc = 0; oc < O; ++oc)
+      for (int64_t oh = 0; oh < OH; ++oh)
+        for (int64_t ow = 0; ow < OW; ++ow) {
+          double acc = b[oc];
+          for (int64_t c = 0; c < C; ++c)
+            for (int64_t kh = 0; kh < KH; ++kh) {
+              int64_t ih = oh * sh - ph + kh;
+              if (ih < 0 || ih >= H) continue;
+              for (int64_t kw = 0; kw < KW; ++kw) {
+                int64_t iw = ow * sw - pw + kw;
+                if (iw < 0 || iw >= Wd) continue;
+                acc += double(x[((n * C + c) * H + ih) * Wd + iw]) *
+                       W[((oc * C + c) * KH + kh) * KW + kw];
+              }
+            }
+          o[((n * O + oc) * OH + oh) * OW + ow] =
+              static_cast<float>(acc);
+        }
+}
+
+static void ValidatePool2D(const std::vector<Array *> &in,
+                           const std::vector<Array *> &out) {
+  const Array *x = in[0], *o = out[0];
+  RequireF32(x, "pool2d"); RequireF32(o, "pool2d");
+  if (x->shape.size() != 4)
+    throw std::runtime_error("pool2d: need NCHW input");
+  const int32_t *at = IntAttrs(in[1], 7, "pool2d");
+  int64_t OH, OW;
+  if (at[6] & 1) {                          /* global pool */
+    OH = OW = 1;
+  } else {
+    if (at[2] <= 0 || at[3] <= 0)
+      throw std::runtime_error("pool2d: stride must be positive");
+    OH = (x->shape[2] + 2 * at[4] - at[0]) / at[2] + 1;
+    OW = (x->shape[3] + 2 * at[5] - at[1]) / at[3] + 1;
+  }
+  std::vector<int64_t> want = {x->shape[0], x->shape[1], OH, OW};
+  if (o->shape != want)
+    throw std::runtime_error("pool2d: bad output shape");
+}
+
+template <bool MAX>
+static void Pool2DOp(const std::vector<Array *> &in,
+                     const std::vector<Array *> &out) {
+  const float *x = static_cast<const float *>(in[0]->data);
+  const int32_t *at = static_cast<const int32_t *>(in[1]->data);
+  float *o = static_cast<float *>(out[0]->data);
+  int64_t N = in[0]->shape[0], C = in[0]->shape[1];
+  int64_t H = in[0]->shape[2], Wd = in[0]->shape[3];
+  bool global = at[6] & 1, include_pad = at[6] & 2;
+  int64_t kh = global ? H : at[0], kw = global ? Wd : at[1];
+  int64_t sh = global ? 1 : at[2], sw = global ? 1 : at[3];
+  int64_t ph = global ? 0 : at[4], pw = global ? 0 : at[5];
+  int64_t OH = out[0]->shape[2], OW = out[0]->shape[3];
+  for (int64_t n = 0; n < N; ++n)
+    for (int64_t c = 0; c < C; ++c)
+      for (int64_t oh = 0; oh < OH; ++oh)
+        for (int64_t ow = 0; ow < OW; ++ow) {
+          double acc = MAX ? -1e30 : 0.0;
+          int64_t cnt = 0;
+          for (int64_t i = 0; i < kh; ++i) {
+            int64_t ih = oh * sh - ph + i;
+            if (ih < 0 || ih >= H) continue;
+            for (int64_t j = 0; j < kw; ++j) {
+              int64_t iw = ow * sw - pw + j;
+              if (iw < 0 || iw >= Wd) continue;
+              float v = x[((n * C + c) * H + ih) * Wd + iw];
+              if (MAX) acc = std::max(acc, double(v));
+              else acc += v;
+              ++cnt;
+            }
+          }
+          if (!MAX) {
+            int64_t denom = include_pad ? kh * kw : (cnt ? cnt : 1);
+            acc /= denom;
+          }
+          o[((n * C + c) * OH + oh) * OW + ow] =
+              static_cast<float>(acc);
+        }
+}
+
 struct OpEntry {
   int n_in, n_out;
   Validator validate;
@@ -255,9 +485,23 @@ static const std::map<std::string, OpEntry> &Ops() {
       {"negative",
        {1, 1, CheckSameShape,
         Elemwise1([](float a) { return -a; })}},
+      {"sigmoid",
+       {1, 1, CheckSameShape,
+        Elemwise1([](float a) { return 1.f / (1.f + std::exp(-a)); })}},
+      {"tanh",
+       {1, 1, CheckSameShape,
+        Elemwise1([](float a) { return std::tanh(a); })}},
       {"dot", {2, 1, ValidateDot, DotOp}},
       {"sum", {1, 1, ValidateSum, SumOp}},
       {"copy", {1, 1, ValidateCopy, CopyOp}},
+      /* deployment set (c_predict_api analog; see MXPredCreate) */
+      {"dense", {3, 1, ValidateDense, DenseOp}},
+      {"softmax", {1, 1, ValidateSoftmax, SoftmaxOp}},
+      {"flatten", {1, 1, ValidateCopy, CopyOp}},
+      {"batchnorm_inf", {6, 1, ValidateBNInf, BNInfOp}},
+      {"conv2d", {4, 1, ValidateConv2D, Conv2DOp}},
+      {"maxpool2d", {2, 1, ValidatePool2D, Pool2DOp<true>}},
+      {"avgpool2d", {2, 1, ValidatePool2D, Pool2DOp<false>}},
   };
   return ops;
 }

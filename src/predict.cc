@@ -1,0 +1,489 @@
+/*
+ * predict.cc — C deployment path (reference: src/c_predict_api.cc).
+ *
+ * Loads a model exported by HybridBlock.export() — the symbol json's
+ * "deploy_graph" layer-op list plus the .params file — and runs forward
+ * inference from C with no Python interpreter: every layer executes
+ * through MXImperativeInvoke on the native dependency engine, using the
+ * deployment op set registered in ndarray.cc (dense / conv2d /
+ * batchnorm_inf / pooling / activations / flatten / softmax).
+ */
+#include <cctype>
+#include <cmath>
+#include <cstdint>
+#include <cstdio>
+#include <cstdlib>
+#include <cstring>
+#include <fstream>
+#include <map>
+#include <memory>
+#include <sstream>
+#include <stdexcept>
+#include <string>
+#include <vector>
+
+#include "./mxtpu.h"
+
+namespace mxtpu {
+void SetLastError(const std::string &msg);  /* c_api.cc */
+}
+
+namespace {
+
+/* ---- minimal JSON (enough for the export meta schema) ---------------- */
+
+struct JValue {
+  enum Kind { NUL, BOOL, NUM, STR, ARR, OBJ } kind = NUL;
+  bool b = false;
+  double num = 0.0;
+  std::string str;
+  std::vector<JValue> arr;
+  std::map<std::string, JValue> obj;
+
+  const JValue *get(const std::string &k) const {
+    auto it = obj.find(k);
+    return it == obj.end() ? nullptr : &it->second;
+  }
+};
+
+struct JParser {
+  const char *p, *end;
+  explicit JParser(const std::string &s)
+      : p(s.data()), end(s.data() + s.size()) {}
+
+  [[noreturn]] void fail(const char *msg) {
+    throw std::runtime_error(std::string("json parse error: ") + msg);
+  }
+  void ws() {
+    while (p < end && std::isspace(static_cast<unsigned char>(*p))) ++p;
+  }
+  char peek() {
+    ws();
+    if (p >= end) fail("unexpected end");
+    return *p;
+  }
+  void expect(char c) {
+    if (peek() != c) fail("unexpected character");
+    ++p;
+  }
+  JValue parse() {
+    JValue v = value();
+    ws();
+    return v;
+  }
+  JValue value() {
+    switch (peek()) {
+      case '{': return object();
+      case '[': return array();
+      case '"': { JValue v; v.kind = JValue::STR; v.str = string(); return v; }
+      case 't': lit("true");  { JValue v; v.kind = JValue::BOOL; v.b = true;  return v; }
+      case 'f': lit("false"); { JValue v; v.kind = JValue::BOOL; v.b = false; return v; }
+      case 'n': lit("null");  return JValue();
+      default:  return number();
+    }
+  }
+  void lit(const char *s) {
+    ws();
+    size_t n = std::strlen(s);
+    if (p + n > end || std::strncmp(p, s, n) != 0) fail("bad literal");
+    p += n;
+  }
+  JValue number() {
+    ws();
+    char *q = nullptr;
+    JValue v;
+    v.kind = JValue::NUM;
+    v.num = std::strtod(p, &q);
+    if (q == p) fail("bad number");
+    p = q;
+    return v;
+  }
+  std::string string() {
+    expect('"');
+    std::string s;
+    while (p < end && *p != '"') {
+      if (*p == '\\') {
+        ++p;
+        if (p >= end) fail("bad escape");
+        switch (*p) {
+          case 'n': s += '\n'; break;
+          case 't': s += '\t'; break;
+          case 'r': s += '\r'; break;
+          case 'b': s += '\b'; break;
+          case 'f': s += '\f'; break;
+          case 'u': {             /* ASCII subset only */
+            if (p + 4 >= end) fail("bad \\u");
+            s += static_cast<char>(
+                std::strtol(std::string(p + 1, 4).c_str(), nullptr, 16));
+            p += 4;
+            break;
+          }
+          default: s += *p;
+        }
+        ++p;
+      } else {
+        s += *p++;
+      }
+    }
+    if (p >= end) fail("unterminated string");
+    ++p;
+    return s;
+  }
+  JValue array() {
+    expect('[');
+    JValue v;
+    v.kind = JValue::ARR;
+    if (peek() == ']') { ++p; return v; }
+    for (;;) {
+      v.arr.push_back(value());
+      char c = peek();
+      if (c == ',') { ++p; continue; }
+      if (c == ']') { ++p; break; }
+      fail("expected , or ]");
+    }
+    return v;
+  }
+  JValue object() {
+    expect('{');
+    JValue v;
+    v.kind = JValue::OBJ;
+    if (peek() == '}') { ++p; return v; }
+    for (;;) {
+      std::string k = string();
+      expect(':');
+      v.obj[k] = value();
+      char c = peek();
+      if (c == ',') { ++p; continue; }
+      if (c == '}') { ++p; break; }
+      fail("expected , or }");
+    }
+    return v;
+  }
+};
+
+/* ---- predictor ------------------------------------------------------- */
+
+struct Node {
+  std::string op;               /* deploy_graph op name */
+  std::string weight, bias, gamma, beta, mean, var;
+  std::string activation, act;
+  int flatten = 0, global_pool = 0, include_pad = 1;
+  int64_t kernel[2] = {0, 0}, stride[2] = {1, 1}, pad[2] = {0, 0};
+  float eps = 1e-5f;
+};
+
+struct Predictor {
+  std::vector<Node> nodes;
+  std::map<std::string, NDArrayHandle> params;
+  std::vector<NDArrayHandle> owned;     /* params + helper arrays */
+  NDArrayHandle input = nullptr;
+  NDArrayHandle output = nullptr;       /* alias into temps */
+  std::vector<NDArrayHandle> temps;
+
+  ~Predictor() {
+    FreeTemps();
+    if (input) MXNDArrayFree(input);
+    for (auto h : owned) MXNDArrayFree(h);
+  }
+  void FreeTemps() {
+    for (auto h : temps) MXNDArrayFree(h);
+    temps.clear();
+    output = nullptr;
+  }
+};
+
+std::string ReadFile(const char *path) {
+  std::ifstream f(path, std::ios::binary);
+  if (!f) throw std::runtime_error(std::string("cannot open ") + path);
+  std::ostringstream ss;
+  ss << f.rdbuf();
+  return ss.str();
+}
+
+std::string JStr(const JValue *v, const char *what) {
+  if (v == nullptr || v->kind == JValue::NUL) return "";
+  if (v->kind != JValue::STR)
+    throw std::runtime_error(std::string(what) + ": expected string");
+  return v->str;
+}
+
+void JInt2(const JValue *v, int64_t out[2], const char *what) {
+  if (v == nullptr || v->kind != JValue::ARR || v->arr.size() != 2)
+    throw std::runtime_error(std::string(what) + ": expected [a, b]");
+  out[0] = static_cast<int64_t>(v->arr[0].num);
+  out[1] = static_cast<int64_t>(v->arr[1].num);
+}
+
+NDArrayHandle MakeArray(const std::vector<int64_t> &shape, int dtype) {
+  NDArrayHandle h = nullptr;
+  if (MXNDArrayCreate(shape.data(), static_cast<int>(shape.size()), dtype,
+                      &h) != 0)
+    throw std::runtime_error(MXGetLastError());
+  return h;
+}
+
+/* helper arrays live in `temps` — freed at the start of every Forward,
+ * so a long-running inference loop does not accumulate allocations */
+NDArrayHandle IntAttrArray(Predictor *p, std::vector<int32_t> vals) {
+  NDArrayHandle h = MakeArray({static_cast<int64_t>(vals.size())}, 4);
+  if (MXNDArraySyncCopyFromCPU(h, vals.data(),
+                               vals.size() * sizeof(int32_t)) != 0)
+    throw std::runtime_error(MXGetLastError());
+  p->temps.push_back(h);
+  return h;
+}
+
+NDArrayHandle ZeroBias(Predictor *p, int64_t n) {
+  NDArrayHandle h = MakeArray({n}, 0);
+  std::vector<float> z(static_cast<size_t>(n), 0.f);
+  if (MXNDArraySyncCopyFromCPU(h, z.data(), z.size() * sizeof(float)) != 0)
+    throw std::runtime_error(MXGetLastError());
+  p->temps.push_back(h);
+  return h;
+}
+
+std::vector<int64_t> ShapeOf(NDArrayHandle h) {
+  int nd = 0;
+  const int64_t *s = nullptr;
+  if (MXNDArrayGetShape(h, &nd, &s) != 0)
+    throw std::runtime_error(MXGetLastError());
+  return std::vector<int64_t>(s, s + nd);
+}
+
+NDArrayHandle Param(Predictor *p, const std::string &name) {
+  auto it = p->params.find(name);
+  if (it == p->params.end())
+    throw std::runtime_error("param '" + name + "' missing from file");
+  return it->second;
+}
+
+void Invoke(const char *op, std::vector<NDArrayHandle> in,
+            NDArrayHandle out) {
+  if (MXImperativeInvoke(op, in.data(), static_cast<int>(in.size()),
+                         &out, 1) != 0)
+    throw std::runtime_error(MXGetLastError());
+}
+
+NDArrayHandle Temp(Predictor *p, const std::vector<int64_t> &shape) {
+  NDArrayHandle h = MakeArray(shape, 0);
+  p->temps.push_back(h);
+  return h;
+}
+
+NDArrayHandle ApplyAct(Predictor *p, const std::string &act,
+                       NDArrayHandle h) {
+  if (act.empty()) return h;
+  if (act != "relu" && act != "sigmoid" && act != "tanh")
+    throw std::runtime_error("unsupported activation '" + act + "'");
+  NDArrayHandle o = Temp(p, ShapeOf(h));
+  Invoke(act.c_str(), {h}, o);
+  return o;
+}
+
+NDArrayHandle RunNode(Predictor *p, const Node &n, NDArrayHandle h) {
+  std::vector<int64_t> s = ShapeOf(h);
+  if (n.op == "dense") {
+    if (n.flatten && s.size() != 2) {
+      int64_t rest = 1;
+      for (size_t i = 1; i < s.size(); ++i) rest *= s[i];
+      NDArrayHandle flat = Temp(p, {s[0], rest});
+      Invoke("flatten", {h}, flat);
+      h = flat;
+      s = {s[0], rest};
+    }
+    NDArrayHandle W = Param(p, n.weight);
+    NDArrayHandle b = n.bias.empty() ? ZeroBias(p, ShapeOf(W)[0])
+                                     : Param(p, n.bias);
+    NDArrayHandle o = Temp(p, {s[0], ShapeOf(W)[0]});
+    Invoke("dense", {h, W, b}, o);
+    return ApplyAct(p, n.activation, o);
+  }
+  if (n.op == "conv2d") {
+    NDArrayHandle W = Param(p, n.weight);
+    std::vector<int64_t> ws = ShapeOf(W);
+    NDArrayHandle b = n.bias.empty() ? ZeroBias(p, ws[0])
+                                     : Param(p, n.bias);
+    NDArrayHandle at = IntAttrArray(
+        p, {static_cast<int32_t>(n.stride[0]),
+            static_cast<int32_t>(n.stride[1]),
+            static_cast<int32_t>(n.pad[0]),
+            static_cast<int32_t>(n.pad[1])});
+    int64_t OH = (s[2] + 2 * n.pad[0] - ws[2]) / n.stride[0] + 1;
+    int64_t OW = (s[3] + 2 * n.pad[1] - ws[3]) / n.stride[1] + 1;
+    NDArrayHandle o = Temp(p, {s[0], ws[0], OH, OW});
+    Invoke("conv2d", {h, W, b, at}, o);
+    return ApplyAct(p, n.activation, o);
+  }
+  if (n.op == "maxpool2d" || n.op == "avgpool2d") {
+    int flags = (n.global_pool ? 1 : 0) | (n.include_pad ? 2 : 0);
+    NDArrayHandle at = IntAttrArray(
+        p, {static_cast<int32_t>(n.kernel[0]),
+            static_cast<int32_t>(n.kernel[1]),
+            static_cast<int32_t>(n.stride[0]),
+            static_cast<int32_t>(n.stride[1]),
+            static_cast<int32_t>(n.pad[0]),
+            static_cast<int32_t>(n.pad[1]), flags});
+    int64_t OH = 1, OW = 1;
+    if (!n.global_pool) {
+      OH = (s[2] + 2 * n.pad[0] - n.kernel[0]) / n.stride[0] + 1;
+      OW = (s[3] + 2 * n.pad[1] - n.kernel[1]) / n.stride[1] + 1;
+    }
+    NDArrayHandle o = Temp(p, {s[0], s[1], OH, OW});
+    Invoke(n.op.c_str(), {h, at}, o);
+    return o;
+  }
+  if (n.op == "batchnorm") {
+    NDArrayHandle eps = MakeArray({1}, 0);
+    if (MXNDArraySyncCopyFromCPU(eps, &n.eps, sizeof(float)) != 0)
+      throw std::runtime_error(MXGetLastError());
+    p->temps.push_back(eps);
+    NDArrayHandle o = Temp(p, s);
+    Invoke("batchnorm_inf",
+           {h, Param(p, n.gamma), Param(p, n.beta), Param(p, n.mean),
+            Param(p, n.var), eps}, o);
+    return o;
+  }
+  if (n.op == "activation") return ApplyAct(p, n.act, h);
+  if (n.op == "flatten") {
+    int64_t rest = 1;
+    for (size_t i = 1; i < s.size(); ++i) rest *= s[i];
+    NDArrayHandle o = Temp(p, {s[0], rest});
+    Invoke("flatten", {h}, o);
+    return o;
+  }
+  if (n.op == "softmax") {
+    NDArrayHandle o = Temp(p, s);
+    Invoke("softmax", {h}, o);
+    return o;
+  }
+  throw std::runtime_error("deploy op '" + n.op + "' not supported");
+}
+
+}  // namespace
+
+using mxtpu::SetLastError;
+
+#define API_BEGIN() try {
+#define API_END()                      \
+  }                                    \
+  catch (const std::exception &e) {    \
+    SetLastError(e.what());            \
+    return -1;                         \
+  }                                    \
+  catch (...) {                        \
+    SetLastError("unknown C++ error"); \
+    return -1;                         \
+  }                                    \
+  return 0;
+
+extern "C" {
+
+int MXPredCreate(const char *symbol_json_file, const char *param_file,
+                 const int64_t *input_shape, int input_ndim,
+                 PredictorHandle *out) {
+  API_BEGIN();
+  JValue meta = JParser(ReadFile(symbol_json_file)).parse();
+  const JValue *graph = meta.get("deploy_graph");
+  if (graph == nullptr || graph->kind != JValue::ARR)
+    throw std::runtime_error(
+        "this export has no native deploy_graph (the model contains "
+        "layers outside the C-deployable set: dense/conv2d/batchnorm/"
+        "pool2d/activation/flatten/dropout) — run it via the Python/"
+        "StableHLO path instead");
+
+  auto pred = std::unique_ptr<Predictor>(new Predictor());
+  for (const JValue &jn : graph->arr) {
+    Node n;
+    n.op = JStr(jn.get("op"), "op");
+    n.weight = JStr(jn.get("weight"), "weight");
+    n.bias = JStr(jn.get("bias"), "bias");
+    n.gamma = JStr(jn.get("gamma"), "gamma");
+    n.beta = JStr(jn.get("beta"), "beta");
+    n.mean = JStr(jn.get("mean"), "mean");
+    n.var = JStr(jn.get("var"), "var");
+    n.activation = JStr(jn.get("activation"), "activation");
+    n.act = JStr(jn.get("act"), "act");
+    if (const JValue *v = jn.get("flatten"))
+      n.flatten = static_cast<int>(v->num);
+    if (const JValue *v = jn.get("global"))
+      n.global_pool = static_cast<int>(v->num);
+    if (const JValue *v = jn.get("count_include_pad"))
+      n.include_pad = static_cast<int>(v->num);
+    if (const JValue *v = jn.get("eps"))
+      n.eps = static_cast<float>(v->num);
+    if (jn.get("kernel")) JInt2(jn.get("kernel"), n.kernel, "kernel");
+    if (jn.get("stride")) JInt2(jn.get("stride"), n.stride, "stride");
+    if (jn.get("pad")) JInt2(jn.get("pad"), n.pad, "pad");
+    if (n.stride[0] <= 0 || n.stride[1] <= 0)
+      throw std::runtime_error("node '" + n.op +
+                               "': stride must be positive");
+    pred->nodes.push_back(std::move(n));
+  }
+
+  int n_params = 0;
+  NDArrayHandle *handles = nullptr;
+  char **names = nullptr;
+  if (MXNDArrayLoad(param_file, &n_params, &handles, &names) != 0)
+    throw std::runtime_error(MXGetLastError());
+  for (int i = 0; i < n_params; ++i) {
+    pred->params[names[i]] = handles[i];
+    pred->owned.push_back(handles[i]);
+  }
+  /* frees the name strings + container arrays; the NDArray handles were
+   * copied above and are owned by the predictor now */
+  MXNDArrayLoadFree(n_params, handles, names);
+
+  pred->input = MakeArray(
+      std::vector<int64_t>(input_shape, input_shape + input_ndim), 0);
+  *out = pred.release();
+  API_END();
+}
+
+int MXPredSetInput(PredictorHandle h, const float *data, uint64_t size) {
+  API_BEGIN();
+  auto *p = static_cast<Predictor *>(h);
+  if (MXNDArraySyncCopyFromCPU(p->input, data, size * sizeof(float)) != 0)
+    throw std::runtime_error(MXGetLastError());
+  API_END();
+}
+
+int MXPredForward(PredictorHandle h) {
+  API_BEGIN();
+  auto *p = static_cast<Predictor *>(h);
+  p->FreeTemps();
+  NDArrayHandle cur = p->input;
+  for (const Node &n : p->nodes) cur = RunNode(p, n, cur);
+  if (MXNDArrayWaitToRead(cur) != 0)
+    throw std::runtime_error(MXGetLastError());
+  p->output = cur;
+  API_END();
+}
+
+int MXPredGetOutputShape(PredictorHandle h, int *out_ndim,
+                         const int64_t **out_shape) {
+  API_BEGIN();
+  auto *p = static_cast<Predictor *>(h);
+  if (p->output == nullptr)
+    throw std::runtime_error("call MXPredForward first");
+  if (MXNDArrayGetShape(p->output, out_ndim, out_shape) != 0)
+    throw std::runtime_error(MXGetLastError());
+  API_END();
+}
+
+int MXPredGetOutput(PredictorHandle h, float *data, uint64_t size) {
+  API_BEGIN();
+  auto *p = static_cast<Predictor *>(h);
+  if (p->output == nullptr)
+    throw std::runtime_error("call MXPredForward first");
+  if (MXNDArraySyncCopyToCPU(p->output, data, size * sizeof(float)) != 0)
+    throw std::runtime_error(MXGetLastError());
+  API_END();
+}
+
+int MXPredFree(PredictorHandle h) {
+  API_BEGIN();
+  delete static_cast<Predictor *>(h);
+  API_END();
+}
+
+}  /* extern "C" */

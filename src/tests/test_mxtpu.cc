@@ -10,6 +10,8 @@
 #include <cstdio>
 #include <cstdlib>
 #include <cstring>
+#include <cmath>
+#include <fstream>
 #include <random>
 #include <string>
 #include <vector>
@@ -291,6 +293,68 @@ static void test_ndarray_params_roundtrip() {
   std::puts("ndarray_params_roundtrip OK");
 }
 
+static void test_predict_mlp() {
+  /* c_predict_api analog, fully C-side: write a deploy json + params
+   * with the C API, then classify through MXPredCreate/Forward. The
+   * 2-layer net computes relu(x W1^T + b1) W2^T + b2 with hand-picked
+   * weights so the expected logits are known exactly. */
+  const char *pp = "/tmp/mxtpu_pred_test.params";
+  const char *sp = "/tmp/mxtpu_pred_test-symbol.json";
+  {
+    std::ofstream f(sp);
+    f << "{\n  \"deploy_graph\": [\n"
+         "    {\"op\": \"dense\", \"weight\": \"l1.weight\", "
+         "\"bias\": \"l1.bias\", \"flatten\": 1, "
+         "\"activation\": \"relu\"},\n"
+         "    {\"op\": \"dense\", \"weight\": \"l2.weight\", "
+         "\"bias\": null, \"flatten\": 0, \"activation\": null},\n"
+         "    {\"op\": \"softmax\"}\n  ]\n}\n";
+  }
+  /* l1: 3 units over 2 inputs; l2: 2 units over 3 */
+  float w1[6] = {1, 0, 0, 1, 1, -1};
+  float b1[3] = {0, 0, 0.5f};
+  float w2[6] = {1, 0, 1, 0, 1, -1};
+  int64_t s_w1[2] = {3, 2}, s_b1[1] = {3}, s_w2[2] = {2, 3};
+  NDArrayHandle hw1, hb1, hw2;
+  CHECK(MXNDArrayCreate(s_w1, 2, 0, &hw1) == 0);
+  CHECK(MXNDArrayCreate(s_b1, 1, 0, &hb1) == 0);
+  CHECK(MXNDArrayCreate(s_w2, 2, 0, &hw2) == 0);
+  CHECK(MXNDArraySyncCopyFromCPU(hw1, w1, sizeof(w1)) == 0);
+  CHECK(MXNDArraySyncCopyFromCPU(hb1, b1, sizeof(b1)) == 0);
+  CHECK(MXNDArraySyncCopyFromCPU(hw2, w2, sizeof(w2)) == 0);
+  NDArrayHandle hs[3] = {hw1, hb1, hw2};
+  const char *nm[3] = {"l1.weight", "l1.bias", "l2.weight"};
+  CHECK(MXNDArraySave(pp, 3, hs, nm) == 0);
+
+  PredictorHandle pred;
+  int64_t in_shape[2] = {1, 2};
+  CHECK(MXPredCreate(sp, pp, in_shape, 2, &pred) == 0);
+  float x[2] = {2.0f, 1.0f};
+  CHECK(MXPredSetInput(pred, x, 2) == 0);
+  CHECK(MXPredForward(pred) == 0);
+  int nd;
+  const int64_t *osh;
+  CHECK(MXPredGetOutputShape(pred, &nd, &osh) == 0);
+  CHECK(nd == 2 && osh[0] == 1 && osh[1] == 2);
+  float out[2];
+  CHECK(MXPredGetOutput(pred, out, 2) == 0);
+  /* h = relu([2, 1, 2+(-1)+0.5]) = [2, 1, 1.5];
+   * logits = [2+1.5, 1-1.5] = [3.5, -0.5]; softmax(3.5, -0.5) */
+  float e0 = std::exp(3.5f), e1 = std::exp(-0.5f);
+  CHECK(std::fabs(out[0] - e0 / (e0 + e1)) < 1e-5f);
+  CHECK(std::fabs(out[1] - e1 / (e0 + e1)) < 1e-5f);
+  CHECK(out[0] > out[1]);                   /* class 0 wins */
+  /* a second forward reuses the graph */
+  CHECK(MXPredForward(pred) == 0);
+  CHECK(MXPredFree(pred) == 0);
+  CHECK(MXNDArrayFree(hw1) == 0);
+  CHECK(MXNDArrayFree(hb1) == 0);
+  CHECK(MXNDArrayFree(hw2) == 0);
+  std::remove(pp);
+  std::remove(sp);
+  std::puts("predict_mlp OK");
+}
+
 int main() {
   test_engine_dag_matches_serial();
   test_engine_writer_serialization();
@@ -300,6 +364,7 @@ int main() {
   test_error_message();
   test_ndarray_create_invoke();
   test_ndarray_params_roundtrip();
+  test_predict_mlp();
   std::puts("ALL C++ TESTS PASSED");
   return 0;
 }

@@ -388,3 +388,136 @@ def test_capi_imperative_invoke_from_python(tmp_path):
     assert list(out) == [11, 22, 33, 44]
     for h in (a, b, c):
         _native.check_call(lib.MXNDArrayFree(h))
+
+
+# ---------------------------------------------------------------------------
+# C predict path (reference c_predict_api.cc; VERDICT r2 item 7)
+# ---------------------------------------------------------------------------
+
+def _pred_forward(sym_file, param_file, x):
+    """Drive MXPredCreate/SetInput/Forward/GetOutput through ctypes —
+    exactly what a C deployment program would do."""
+    import ctypes
+    import numpy as onp
+    L = _native.LIB
+    h = ctypes.c_void_p()
+    shape = (ctypes.c_int64 * x.ndim)(*x.shape)
+    _native.check_call(L.MXPredCreate(
+        sym_file.encode(), param_file.encode(), shape, x.ndim,
+        ctypes.byref(h)))
+    try:
+        flat = onp.ascontiguousarray(x, dtype=onp.float32).ravel()
+        _native.check_call(L.MXPredSetInput(
+            h, flat.ctypes.data_as(ctypes.POINTER(ctypes.c_float)),
+            ctypes.c_uint64(flat.size)))
+        _native.check_call(L.MXPredForward(h))
+        nd = ctypes.c_int()
+        sp = ctypes.POINTER(ctypes.c_int64)()
+        _native.check_call(L.MXPredGetOutputShape(
+            h, ctypes.byref(nd), ctypes.byref(sp)))
+        oshape = tuple(sp[i] for i in range(nd.value))
+        out = onp.empty(oshape, onp.float32)
+        _native.check_call(L.MXPredGetOutput(
+            h, out.ctypes.data_as(ctypes.POINTER(ctypes.c_float)),
+            ctypes.c_uint64(out.size)))
+        return out
+    finally:
+        L.MXPredFree(h)
+
+
+def test_c_predict_mlp_matches_python(tmp_path):
+    """An exported MNIST-shaped MLP classifies from C (no Python in the
+    compute path) with outputs matching the Python forward."""
+    import numpy as onp
+    import jax
+    jax.config.update("jax_platforms", "cpu")
+    import mxnet_tpu as mx
+    from mxnet_tpu.gluon import nn
+
+    mx.random.seed(0)
+    net = nn.HybridSequential()
+    net.add(nn.Dense(64, in_units=784, activation="relu"),
+            nn.Dense(32, in_units=64, activation="tanh"),
+            nn.Dense(10, in_units=32))
+    net.initialize()
+    net.hybridize()
+    x = onp.random.RandomState(0).uniform(0, 1, (4, 784)).astype("float32")
+    ref = net(mx.np.array(x)).asnumpy()
+    sym, params = net.export(str(tmp_path / "mlp"))
+
+    meta = json.load(open(sym))
+    assert meta["deploy_graph"], "MLP must emit a native deploy graph"
+
+    got = _pred_forward(sym, params, x)
+    onp.testing.assert_allclose(got, ref, rtol=1e-4, atol=1e-5)
+    # and the argmax "classification" agrees per row
+    assert (got.argmax(1) == ref.argmax(1)).all()
+
+
+def test_c_predict_convnet_matches_python(tmp_path):
+    """conv2d + batchnorm + pooling + flatten execute natively too (the
+    LeNet-ish deployment shape)."""
+    import numpy as onp
+    import jax
+    jax.config.update("jax_platforms", "cpu")
+    import mxnet_tpu as mx
+    from mxnet_tpu import autograd
+    from mxnet_tpu.gluon import nn
+
+    mx.random.seed(1)
+    net = nn.HybridSequential()
+    net.add(nn.Conv2D(8, kernel_size=3, padding=1, in_channels=1,
+                      activation="relu"),
+            nn.BatchNorm(in_channels=8),
+            nn.MaxPool2D(pool_size=2, strides=2),
+            nn.Conv2D(16, kernel_size=3, strides=2, in_channels=8),
+            nn.GlobalAvgPool2D(),
+            nn.Flatten(),
+            nn.Dense(10, in_units=16))
+    net.initialize()
+    x = onp.random.RandomState(1).uniform(-1, 1, (2, 1, 28, 28)) \
+        .astype("float32")
+    # one training forward warms BN running stats (else rv=1, rm=0)
+    with autograd.record(train_mode=True):
+        net(mx.np.array(x))
+    net.hybridize()
+    ref = net(mx.np.array(x)).asnumpy()
+    sym, params = net.export(str(tmp_path / "lenet"))
+    assert json.load(open(sym))["deploy_graph"]
+
+    got = _pred_forward(sym, params, x)
+    onp.testing.assert_allclose(got, ref, rtol=2e-4, atol=2e-4)
+
+
+def test_c_predict_unmappable_model_reports(tmp_path):
+    """A model outside the deployable layer set exports with
+    deploy_graph=null and MXPredCreate fails with guidance (instead of
+    silently wrong output)."""
+    import numpy as onp
+    import ctypes
+    import jax
+    jax.config.update("jax_platforms", "cpu")
+    import mxnet_tpu as mx
+    from mxnet_tpu.gluon import nn
+
+    net = nn.HybridSequential()
+    net.add(nn.Dense(8, in_units=4), nn.LayerNorm(in_channels=8))
+    net.initialize()
+    net.hybridize()
+    net(mx.np.zeros((2, 4)))
+    sym, params = net.export(str(tmp_path / "ln"))
+    assert json.load(open(sym))["deploy_graph"] is None
+    # unsupported ACTIVATIONS also opt out (the C runtime has only
+    # relu/sigmoid/tanh)
+    g = nn.HybridSequential()
+    g.add(nn.Dense(4, in_units=4, activation="gelu"))
+    g.initialize(); g.hybridize(); g(mx.np.zeros((1, 4)))
+    gs, _ = g.export(str(tmp_path / "gelu"))
+    assert json.load(open(gs))["deploy_graph"] is None
+    L = _native.LIB
+    h = ctypes.c_void_p()
+    shape = (ctypes.c_int64 * 2)(2, 4)
+    rc = L.MXPredCreate(sym.encode(), params.encode(), shape, 2,
+                        ctypes.byref(h))
+    assert rc != 0
+    assert b"deploy_graph" in L.MXGetLastError()
