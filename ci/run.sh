@@ -14,6 +14,9 @@
 #   ci/run.sh dryrun        # multichip sharding dry run + entry compile
 #   ci/run.sh tpu-sweep     # op sweep against the real chip
 #                           #   (MXNET_TEST_CTX=tpu ctx-flip)
+#   ci/run.sh tpu-unit      # the WHOLE suite with default ctx = tpu
+#                           #   (test_operator_gpu.py "rerun everything
+#                           #   on the accelerator" analog)
 #   ci/run.sh all           # native + unit + dist + exec-cache +
 #                           #   naive-engine + dryrun
 set -euo pipefail
@@ -61,6 +64,13 @@ run_tpu_sweep() {
   MXNET_TEST_CTX=tpu python -m pytest tests/test_op_sweep.py -q
 }
 
+run_tpu_unit() {
+  echo "== tpu-unit: the WHOLE suite with default ctx = tpu (the"
+  echo "   reference's test_operator_gpu.py ctx-flip; host-only"
+  echo "   multi-device tests auto-skip via tests/conftest.py)"
+  MXNET_TEST_CTX=tpu python -m pytest tests/ -q
+}
+
 case "$variant" in
   native)       run_native ;;
   unit)         run_unit ;;
@@ -69,6 +79,7 @@ case "$variant" in
   naive-engine) run_naive_engine ;;
   dryrun)       run_dryrun ;;
   tpu-sweep)    run_tpu_sweep ;;
+  tpu-unit)     run_tpu_unit ;;
   all)
     run_native
     run_unit

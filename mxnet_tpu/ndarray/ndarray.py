@@ -83,8 +83,15 @@ class NDArray:
         else:
             if isinstance(data, NDArray):
                 data = data._data
-            arr = jnp.asarray(data, dtype=dtype)
             ctx = ctx or current_context()
+            if isinstance(data, _np.ndarray) and not isinstance(
+                    data, jax.Array) and ctx.jax_device.platform != "cpu":
+                # accelerator ingest may read the host buffer LAZILY
+                # (the axon tunnel defers the transfer): snapshot it so
+                # caller-side mutation after construction cannot change
+                # the array's value (immutability contract)
+                data = _np.array(data, dtype=dtype, copy=True)
+            arr = jnp.asarray(data, dtype=dtype)
             if not _is_tracer(arr):
                 arr = jax.device_put(arr, ctx.jax_device)
             self._data = arr
@@ -152,8 +159,17 @@ class NDArray:
         engine._sync_and_translate(self._data)
 
     def asnumpy(self) -> _np.ndarray:
-        """Copy to a numpy array — a synchronization point."""
-        return _np.asarray(engine._sync_and_translate(self._data))
+        """Copy to a numpy array — a synchronization point.
+
+        Returns a WRITABLE, C-contiguous array (the reference's asnumpy
+        copied into a fresh buffer): device arrays — in particular via
+        the axon tunnel — can surface as read-only and/or non-C-ordered
+        views, whose `.reshape()` silently COPIES and breaks the
+        mutate-a-view pattern (e.g. finite-difference perturbation)."""
+        out = _np.asarray(engine._sync_and_translate(self._data))
+        if not (out.flags.writeable and out.flags.c_contiguous):
+            out = _np.array(out, order="C")
+        return out
 
     def item(self) -> Any:
         return self.asnumpy().item()

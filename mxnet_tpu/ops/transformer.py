@@ -27,12 +27,18 @@ __all__ = ["dot_product_attention", "multi_head_attention",
            "interleaved_matmul_encdec_qk", "interleaved_matmul_encdec_valatt"]
 
 register_env("MXNET_ATTENTION_USE_PALLAS", 0,
-             "Use the Pallas flash-attention kernel on TPU (auto-enabled "
-             "for long sequences when available).")
-register_env("MXNET_FLASH_BLOCK_Q", 128,
-             "Flash-attention query-block rows (tunable per chip/shape).")
-register_env("MXNET_FLASH_BLOCK_K", 128,
-             "Flash-attention key-block rows (tunable per chip/shape).")
+             "Force the Pallas flash-attention kernel on every sequence "
+             "length (it auto-engages from MXNET_FLASH_MIN_SEQ up).")
+register_env("MXNET_FLASH_MIN_SEQ", 512,
+             "Sequence length at/above which attention auto-routes to "
+             "the Pallas flash kernel (the measured v5e crossover vs "
+             "XLA materialized-scores attention).")
+register_env("MXNET_FLASH_BLOCK_Q", 256,
+             "Flash-attention query-block rows (v5e-tuned default; "
+             "clamped to the sequence length per call).")
+register_env("MXNET_FLASH_BLOCK_K", 1024,
+             "Flash-attention key-block rows (v5e-tuned default; "
+             "clamped to the sequence length per call).")
 
 
 def _mask_to_bias(mask, dtype, batch: int, tq: int, tk: int):

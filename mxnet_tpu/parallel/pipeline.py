@@ -39,7 +39,8 @@ def _shard_map(fn, mesh, in_specs, out_specs):
 def pipeline_apply(stage_fn: Callable, stage_params: Any, x: "jax.Array",
                    mesh: "jax.sharding.Mesh", axis: str = "pp",
                    num_microbatches: Optional[int] = None,
-                   rng_key: Optional["jax.Array"] = None) -> "jax.Array":
+                   rng_key: Optional["jax.Array"] = None,
+                   batch_axis: Optional[str] = None) -> "jax.Array":
     """Apply ``num_stages`` chained stages to ``x`` with a GPipe schedule.
 
     stage_fn(params_i, h) -> h' — one stage's computation; the activation
@@ -52,6 +53,10 @@ def pipeline_apply(stage_fn: Callable, stage_params: Any, x: "jax.Array",
     the plumbing that makes in-pipeline dropout draw fresh randomness for
     every microbatch at every stage (and regenerate identically in the
     scan's recompute-for-backward).
+    batch_axis (r3): a mesh axis to shard each microbatch's batch dim
+    over — pp COMPOSES with dp in one program (each dp row pipelines its
+    own batch slice; gradient reduction over dp is GSPMD's psum as
+    usual). Ignored when absent from the mesh or non-divisible.
 
     Returns stage_{N-1}(...stage_0(x)) with shape x.shape.
     """
@@ -122,8 +127,12 @@ def pipeline_apply(stage_fn: Callable, stage_params: Any, x: "jax.Array",
         return out_buf[None]
 
     pspec = jax.tree_util.tree_map(lambda _: P(axis), stage_params)
+    bax = (batch_axis if batch_axis and batch_axis in mesh.axis_names
+           and batch_axis != axis and mb % mesh.shape[batch_axis] == 0
+           else None)
     out = _shard_map(local, mesh,
-                     in_specs=(pspec, P()), out_specs=P(axis))(
+                     in_specs=(pspec, P(None, bax)),
+                     out_specs=P(axis, None, bax))(
         stage_params, x_mb)
     # the bank is only populated on the last stage; its slice is the result
     out = out[-1]
@@ -497,7 +506,10 @@ class GPTPipe(HybridBlock):
         for p in self._stacked:
             nd = p.data()
             arrays.append(self._mesh_place(nd, P(self._axis)))
-        h = self._mesh_place(x, P())
+        # pp composes with dp when the mesh has one: activations shard
+        # their batch dim over dp, each dp row pipelines its own slice
+        bax = "dp" if "dp" in self._mesh.axis_names else None
+        h = self._mesh_place(x, P(bax))
         rng = None
         from .._tape import is_training
         if self._dropout > 0.0 and is_training():
@@ -506,7 +518,7 @@ class GPTPipe(HybridBlock):
         out = pipeline_apply(stage_fn, arrays, h, self._mesh,
                              axis=self._axis,
                              num_microbatches=self._n_micro,
-                             rng_key=rng)
+                             rng_key=rng, batch_axis=bax)
         if not isinstance(out, jax.core.Tracer) \
                 and getattr(out, "sharding", None) is not None \
                 and out.sharding.num_devices > 1:

@@ -112,7 +112,17 @@ def check_numeric_gradient(fn: Callable[..., NDArray],
     (``python/mxnet/test_utils.py check_numeric_gradient``). ``fn`` maps
     NDArrays to a single NDArray output; gradients are checked for each
     input in float64-free finite differences with seed cotangent of ones.
+
+    On TPU the matmul default precision is bfloat16, which swallows the
+    ±eps perturbation entirely (numeric grads read as 0) — the whole
+    check runs under ``jax.default_matmul_precision('highest')``.
     """
+    import jax
+    with jax.default_matmul_precision("highest"):
+        _check_numeric_gradient_impl(fn, inputs, eps, rtol, atol)
+
+
+def _check_numeric_gradient_impl(fn, inputs, eps, rtol, atol):
     from . import autograd
 
     inputs = [x if isinstance(x, NDArray) else NDArray(x) for x in inputs]

@@ -21,6 +21,7 @@
 #include <cstdio>
 #include <cstring>
 #include <functional>
+#include <limits>
 #include <map>
 #include <memory>
 #include <mutex>
@@ -400,8 +401,13 @@ static void ValidatePool2D(const std::vector<Array *> &in,
   if (at[6] & 1) {                          /* global pool */
     OH = OW = 1;
   } else {
+    if (at[0] <= 0 || at[1] <= 0)
+      throw std::runtime_error("pool2d: kernel must be positive");
     if (at[2] <= 0 || at[3] <= 0)
       throw std::runtime_error("pool2d: stride must be positive");
+    if (at[4] >= at[0] || at[5] >= at[1])
+      throw std::runtime_error(
+          "pool2d: padding must be smaller than the kernel");
     OH = (x->shape[2] + 2 * at[4] - at[0]) / at[2] + 1;
     OW = (x->shape[3] + 2 * at[5] - at[1]) / at[3] + 1;
   }
@@ -427,7 +433,8 @@ static void Pool2DOp(const std::vector<Array *> &in,
     for (int64_t c = 0; c < C; ++c)
       for (int64_t oh = 0; oh < OH; ++oh)
         for (int64_t ow = 0; ow < OW; ++ow) {
-          double acc = MAX ? -1e30 : 0.0;
+          double acc =
+              MAX ? -std::numeric_limits<double>::infinity() : 0.0;
           int64_t cnt = 0;
           for (int64_t i = 0; i < kh; ++i) {
             int64_t ih = oh * sh - ph + i;

@@ -127,3 +127,31 @@ def test_four_process_kvstore_bucketed(tmp_path):
     for r in range(1, 4):
         assert rows[0][0] == rows[r][0]   # pulled sums identical
         assert rows[0][1] == rows[r][1]   # trained params bit-identical
+
+
+def test_two_process_dp_tp_combined(tmp_path):
+    """dp x tp across the process boundary (2 procs x 2 devices each):
+    batch shards over dp, Megatron-split weights over tp, losses and the
+    gathered weights bit-identical on both ranks."""
+    env = dict(os.environ)
+    env.pop("XLA_FLAGS", None)
+    env["JAX_PLATFORMS"] = "cpu"
+    env["PYTHONPATH"] = REPO
+    for attempt in range(2):
+        cmd = [sys.executable, os.path.join(REPO, "tools", "launch.py"),
+               "-n", "2", "--port", str(_free_port()),
+               "--cpu-devices-per-worker", "2",
+               sys.executable,
+               os.path.join(REPO, "tests", "dist_worker.py"),
+               str(tmp_path), "dptp"]
+        proc = subprocess.run(cmd, env=env, capture_output=True,
+                              text=True, timeout=280)
+        if proc.returncode == 0 or attempt == 1:
+            break
+    assert proc.returncode == 0, (proc.stdout[-2000:], proc.stderr[-2000:])
+    r0 = (tmp_path / "worker0.txt").read_text().splitlines()
+    r1 = (tmp_path / "worker1.txt").read_text().splitlines()
+    assert r0[0] == r1[0]          # losses identical
+    assert r0[1] == r1[1]          # tp-gathered weights identical
+    losses = [float(v) for v in r0[0].split()]
+    assert losses[-1] < losses[0]

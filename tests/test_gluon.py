@@ -186,7 +186,9 @@ def test_losses():
     out = sig(mx.np.array([[0.5, -0.5, 2.0]]), lbl)
     x = onp.array([[0.5, -0.5, 2.0]]); z = lbl.asnumpy()
     ref = (onp.maximum(x, 0) - x * z + onp.log1p(onp.exp(-abs(x)))).mean(-1)
-    assert_almost_equal(out, ref, rtol=1e-5, atol=1e-6)
+    # rtol accommodates f32 transcendental differences across backends
+    # (TPU sigmoid/log1p differ from the numpy reference by ~2e-5)
+    assert_almost_equal(out, ref, rtol=1e-4, atol=1e-5)
 
 
 def test_save_load_parameters(tmp_path):
