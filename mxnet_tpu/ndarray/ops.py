@@ -1035,3 +1035,97 @@ def bilinear_sampler(data, grid, cudnn_off=None):
         return out.astype(x.dtype)
 
     return invoke("bilinear_sampler", impl, (_as_nd(data), _as_nd(grid)))
+
+
+@_public
+def depth_to_space(data, block_size: int):
+    """Rearrange depth blocks into spatial blocks, NCHW (reference:
+    src/operator/tensor/matrix_op DepthToSpace — the DCR layout the
+    reference documents: reshape (N, b, b, C/b², H, W) → transpose →
+    (N, C/b², H·b, W·b))."""
+    b = int(block_size)
+
+    def impl(x):
+        n, c, h, w = x.shape
+        t = x.reshape(n, b, b, c // (b * b), h, w)
+        t = jnp.transpose(t, (0, 3, 4, 1, 5, 2))
+        return t.reshape(n, c // (b * b), h * b, w * b)
+
+    nd = _as_nd(data)
+    if b <= 0 or nd.ndim != 4 or nd.shape[1] % (b * b):
+        raise ValueError(
+            f"depth_to_space: need NCHW with C divisible by block² and "
+            f"a positive block (got shape {nd.shape}, block {b})")
+    return invoke("depth_to_space", impl, (nd,))
+
+
+@_public
+def space_to_depth(data, block_size: int):
+    """Inverse of :func:`depth_to_space` (reference SpaceToDepth)."""
+    b = int(block_size)
+
+    def impl(x):
+        n, c, h, w = x.shape
+        t = x.reshape(n, c, h // b, b, w // b, b)
+        t = jnp.transpose(t, (0, 3, 5, 1, 2, 4))
+        return t.reshape(n, c * b * b, h // b, w // b)
+
+    nd = _as_nd(data)
+    if b <= 0 or nd.ndim != 4 or nd.shape[2] % b or nd.shape[3] % b:
+        raise ValueError(
+            f"space_to_depth: need NCHW with H, W divisible by block "
+            f"and a positive block (got shape {nd.shape}, block {b})")
+    return invoke("space_to_depth", impl, (nd,))
+
+
+@_public
+def shuffle(data):
+    """Random permutation along the first axis (reference:
+    mx.nd.random.shuffle / src/operator/random/shuffle_op.cc). Draws
+    from the framework RNG stream; rides as an op input so compiled
+    programs reshuffle every call."""
+    from . import random as _random
+    key = _random.split_key()
+    seed = jax.random.key_data(key).reshape(-1)[:2].astype(jnp.uint32)
+
+    def impl(x, s):
+        k = jax.random.wrap_key_data(s, impl="threefry2x32")
+        return jax.random.permutation(k, x, axis=0)
+
+    return invoke("shuffle", impl,
+                  (_as_nd(data), _as_nd(seed)))
+
+
+@_public
+def spatial_transformer(data, loc, target_shape=None,
+                        transform_type: str = "affine",
+                        sampler_type: str = "bilinear"):
+    """Spatial transformer network op (reference:
+    src/operator/spatial_transformer.cc): affine grid from ``loc``
+    (N, 6) + bilinear sampling of ``data`` — the composition of
+    :func:`grid_generator` and :func:`bilinear_sampler`."""
+    if transform_type != "affine" or sampler_type != "bilinear":
+        raise ValueError("spatial_transformer supports transform_type="
+                         "'affine' with sampler_type='bilinear'")
+    if target_shape is None:
+        target_shape = _as_nd(data).shape[2:]
+    grid = grid_generator(loc, "affine", tuple(target_shape))
+    return bilinear_sampler(data, grid)
+
+
+@_public
+def khatri_rao(*matrices):
+    """Column-wise Kronecker product (reference:
+    src/operator/contrib/krprod.cc — mx.nd.khatri_rao). All inputs are
+    (r_i, k); output ((Πr_i), k)."""
+    if not matrices:
+        raise ValueError("khatri_rao needs at least one matrix")
+
+    def impl(*ms):
+        out = ms[0]
+        for m in ms[1:]:
+            k = out.shape[1]
+            out = (out[:, None, :] * m[None, :, :]).reshape(-1, k)
+        return out
+
+    return invoke("khatri_rao", impl, tuple(_as_nd(m) for m in matrices))

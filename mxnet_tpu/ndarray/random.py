@@ -221,9 +221,13 @@ def choice(a, size=None, replace=True, p=None, ctx=None):
 
 
 def shuffle(data):
-    """Random permutation along the first axis (``mx.nd.random.shuffle``)."""
-    arr = data._data if isinstance(data, NDArray) else jnp.asarray(data)
-    return from_jax(jax.random.permutation(split_key(), arr, axis=0))
+    """Random permutation along the first axis (``mx.nd.random.shuffle``).
+
+    Delegates to the registered ``shuffle`` op so the tape, AMP/profiler
+    hooks and the per-op executable cache all apply (and the seed rides
+    as an op input — compiled programs reshuffle every call)."""
+    from . import ops as _ops
+    return _ops.shuffle(data)
 
 
 def chisquare(df=1.0, shape=None, dtype="float32", ctx=None, **kw):

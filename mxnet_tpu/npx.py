@@ -15,12 +15,15 @@ from .context import cpu, gpu, tpu, num_gpus, num_tpus, current_context
 from .ndarray.ndarray import waitall
 from .ndarray.ops import (one_hot, topk, pad, arange, reshape,  # noqa: F401
                           gather_nd, scatter_nd, sigmoid, tanh,
-                          reshape_like, broadcast_like, batch_dot)
+                          reshape_like, broadcast_like, batch_dot,
+                          depth_to_space, space_to_depth,
+                          shuffle, spatial_transformer, khatri_rao)
 
 __all__ = list(_nn_all) + list(_tr_all) + list(_q_all) + [
     "set_np", "reset_np", "is_np_array", "is_np_shape", "use_np",
     "cpu", "gpu", "tpu", "num_gpus", "num_tpus", "current_context",
     "waitall", "one_hot", "topk", "pad", "arange", "reshape", "gather_nd",
     "scatter_nd", "sigmoid", "tanh", "reshape_like", "broadcast_like",
-    "batch_dot",
+    "batch_dot", "depth_to_space", "space_to_depth",
+    "shuffle", "spatial_transformer", "khatri_rao",
 ]

@@ -586,3 +586,82 @@ def test_batch_norm_stats_keep_running_dtype():
     rv = NDArray(jnp.ones(4, jnp.bfloat16), _wrap=True)
     out, m, v = batch_norm(x, g, b, rm, rv, training=True)
     assert str(m.dtype) == "bfloat16" and str(v.dtype) == "bfloat16"
+
+
+def test_depth_space_roundtrip_and_grads():
+    """depth_to_space/space_to_depth: exact roundtrip, known layout, and
+    gradients (they are pure permutations — grad of sum is ones)."""
+    from mxnet_tpu.ndarray import ops
+    from mxnet_tpu import autograd
+    rng = onp.random.RandomState(0)
+    x = NDArray(rng.uniform(-1, 1, (2, 8, 3, 5)).astype("float32"))
+    d = ops.depth_to_space(x, 2)
+    assert d.shape == (2, 2, 6, 10)
+    r = ops.space_to_depth(d, 2)
+    assert_almost_equal(r, x)
+    x.attach_grad()
+    with autograd.record():
+        ops.depth_to_space(x, 2).sum().backward()
+    assert_almost_equal(x.grad, onp.ones(x.shape, "float32"))
+    import pytest as _pytest
+    with _pytest.raises(ValueError, match="divisible"):
+        ops.depth_to_space(NDArray(onp.zeros((1, 3, 2, 2), "float32")), 2)
+    with _pytest.raises(ValueError, match="positive"):
+        ops.depth_to_space(NDArray(onp.zeros((1, 4, 2, 2), "float32")), 0)
+
+
+def test_upsampling_nearest_and_bilinear():
+    from mxnet_tpu import npx
+    x = NDArray(onp.arange(4, dtype="float32").reshape(1, 1, 2, 2))
+    u = npx.up_sampling(x, 2, "nearest").asnumpy()
+    assert u.shape == (1, 1, 4, 4)
+    assert (u[0, 0, 0] == [0, 0, 1, 1]).all()
+    assert (u[0, 0, 2] == [2, 2, 3, 3]).all()
+    b = npx.up_sampling(x, 2, "bilinear").asnumpy()
+    assert b.shape == (1, 1, 4, 4)
+    assert abs(b[0, 0].mean() - x.asnumpy().mean()) < 1e-5
+
+
+def test_random_shuffle_is_differentiable():
+    """nd.random.shuffle delegates to the registered op: on the tape it
+    must be differentiable (the old direct-jax path silently was not)."""
+    import mxnet_tpu as mx
+    from mxnet_tpu import autograd
+    x = NDArray(onp.arange(8, dtype="float32").reshape(4, 2))
+    x.attach_grad()
+    with autograd.record():
+        mx.nd.random.shuffle(x).sum().backward()
+    assert_almost_equal(x.grad, onp.ones((4, 2), "float32"))
+
+
+def test_shuffle_permutes_rows():
+    from mxnet_tpu.ndarray import ops
+    import mxnet_tpu as mx
+    mx.random.seed(5)
+    x = NDArray(onp.arange(40, dtype="float32").reshape(10, 4))
+    s1 = ops.shuffle(x).asnumpy()
+    s2 = ops.shuffle(x).asnumpy()
+    # rows intact, order is a permutation, successive draws differ
+    assert sorted(s1[:, 0].tolist()) == sorted(x.asnumpy()[:, 0].tolist())
+    for row in s1:
+        assert (row - row[0] == [0, 1, 2, 3]).all()
+    assert not onp.allclose(s1, s2)
+
+
+def test_spatial_transformer_identity_and_shift():
+    from mxnet_tpu.ndarray import ops
+    rng = onp.random.RandomState(2)
+    x = NDArray(rng.uniform(-1, 1, (1, 2, 5, 5)).astype("float32"))
+    ident = NDArray(onp.array([[1, 0, 0, 0, 1, 0]], "float32"))
+    out = ops.spatial_transformer(x, ident, target_shape=(5, 5)).asnumpy()
+    onp.testing.assert_allclose(out, x.asnumpy(), rtol=1e-5, atol=1e-5)
+
+
+def test_khatri_rao_matches_definition():
+    from mxnet_tpu.ndarray import ops
+    rng = onp.random.RandomState(3)
+    a = rng.uniform(-1, 1, (3, 4)).astype("float32")
+    b = rng.uniform(-1, 1, (2, 4)).astype("float32")
+    out = ops.khatri_rao(NDArray(a), NDArray(b)).asnumpy()
+    ref = onp.stack([onp.kron(a[:, k], b[:, k]) for k in range(4)], axis=1)
+    onp.testing.assert_allclose(out, ref, rtol=1e-6)
