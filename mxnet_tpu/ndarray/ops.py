@@ -1085,8 +1085,7 @@ def shuffle(data):
     from the framework RNG stream; rides as an op input so compiled
     programs reshuffle every call."""
     from . import random as _random
-    key = _random.split_key()
-    seed = jax.random.key_data(key).reshape(-1)[:2].astype(jnp.uint32)
+    seed = _random.split_seed()   # jitted: no eager key ops on the tunnel
 
     def impl(x, s):
         k = jax.random.wrap_key_data(s, impl="threefry2x32")
@@ -1120,6 +1119,14 @@ def khatri_rao(*matrices):
     (r_i, k); output ((Πr_i), k)."""
     if not matrices:
         raise ValueError("khatri_rao needs at least one matrix")
+    nds = tuple(_as_nd(m) for m in matrices)
+    bad = len({m.shape[-1] for m in nds}) != 1
+    for m in nds:
+        bad = bad or m.ndim != 2
+    if bad:
+        raise ValueError(
+            "khatri_rao needs 2-D matrices with a COMMON column count; "
+            f"got shapes {[m.shape for m in nds]}")
 
     def impl(*ms):
         out = ms[0]
@@ -1128,4 +1135,4 @@ def khatri_rao(*matrices):
             out = (out[:, None, :] * m[None, :, :]).reshape(-1, k)
         return out
 
-    return invoke("khatri_rao", impl, tuple(_as_nd(m) for m in matrices))
+    return invoke("khatri_rao", impl, nds)

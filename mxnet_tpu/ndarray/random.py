@@ -220,6 +220,24 @@ def choice(a, size=None, replace=True, p=None, ctx=None):
                    ctx)
 
 
+_seed_jit = None
+
+
+def split_seed():
+    """Fresh (2,) uint32 seed words. Jitted end to end when eager — an
+    eager key_data/reshape chain would produce lazy per-op handles that
+    cost a tunnel round-trip per consuming jit call on the axon backend
+    (the same trap ``split_key`` documents)."""
+    key = split_key()
+    if isinstance(key, jax.core.Tracer):
+        return jax.random.key_data(key).reshape(-1)[:2].astype(jnp.uint32)
+    global _seed_jit
+    if _seed_jit is None:
+        _seed_jit = jax.jit(lambda k: jax.random.key_data(k)
+                            .reshape(-1)[:2].astype(jnp.uint32))
+    return _seed_jit(key)
+
+
 def shuffle(data):
     """Random permutation along the first axis (``mx.nd.random.shuffle``).
 

@@ -40,6 +40,16 @@ _GRID_SEMANTICS = pltpu.CompilerParams(
     dimension_semantics=("parallel", "parallel", "parallel", "arbitrary"))
 
 
+def _prec(dtype):
+    """Explicit dot precision per operand dtype: the kernel's contract is
+    bf16 MXU passes for low-precision inputs and exact fp32 for f32 —
+    INDEPENDENT of the global jax_default_matmul_precision (a global
+    'highest' would otherwise request an fp32 contract on bf16 operands,
+    which Mosaic rejects with 'Bad lhs type')."""
+    return (jax.lax.Precision.HIGHEST if dtype == jnp.float32
+            else jax.lax.Precision.DEFAULT)
+
+
 def _bias_spec(bias_shape, block_q, block_k, kv_major: bool = False):
     """Bias streams like K/V. A Tq-broadcast bias (B/1, H/1, 1, Tk) —
     the canonical BERT key-padding mask — ships as (1, block_k) rows
@@ -142,7 +152,8 @@ def _flash_fwd_kernel(*refs, scale: float, causal: bool, block_q: int,
         # matmuls run in the INPUT dtype (bf16 MXU rate is 2-4x f32) with
         # f32 accumulation; scale applies to the f32 product
         s = jax.lax.dot_general(q, k, (((1,), (1,)), ((), ())),
-                                preferred_element_type=jnp.float32) * scale
+                                preferred_element_type=jnp.float32,
+                                precision=_prec(q.dtype)) * scale
         if has_bias:
             s = s + bias_ref[0, 0].astype(jnp.float32)
         if apply_mask:
@@ -167,7 +178,8 @@ def _flash_fwd_kernel(*refs, scale: float, causal: bool, block_q: int,
             p = jnp.where(keep, p / (1.0 - rate), 0.0)
         acc_ref[...] = acc_ref[...] * alpha + jax.lax.dot_general(
             p.astype(v.dtype), v, (((1,), (0,)), ((), ())),
-            preferred_element_type=jnp.float32)
+            preferred_element_type=jnp.float32,
+            precision=_prec(v.dtype))
         m_ref[...] = m_new
 
     _causal_branches(causal, iq, ik, block_q, block_k, kv_len, tile)
@@ -191,6 +203,20 @@ def _pad_to(x, axis, mult):
     return jnp.pad(x, widths)
 
 
+def _legal_blocks(block_q, block_k, Tq, Tk, has_bias, interpret):
+    """TPU tiling for the (block_q, block_k)-blocked bias (and the
+    learned-bias ds output): trailing dim must be a multiple of 128 or
+    the whole (padded) axis, second-to-last a multiple of 8 or whole —
+    odd tunable blocks collapse to whole-axis blocks. Interpret mode
+    (CPU) keeps the requested blocks for multi-block coverage."""
+    if has_bias and not interpret:
+        if block_k % 128:
+            block_k = Tk
+        if block_q % 8:
+            block_q = Tq
+    return block_q, block_k
+
+
 def _pad_bias(bias, block_q, block_k):
     if bias.shape[2] == 1:          # Tq-broadcast row bias: pad Tk only
         return _pad_to(bias, 3, block_k)
@@ -204,6 +230,8 @@ def _flash_forward(q, k, v, bias, seed, scale: float, causal: bool,
     B, H, Tq, D = q.shape
     Tk = k.shape[2]
     has_bias = bias is not None
+    block_q, block_k = _legal_blocks(block_q, block_k, Tq, Tk,
+                                     has_bias, interpret)
     qp = _pad_to(q, 2, block_q)
     kp = _pad_to(k, 2, block_k)
     vp = _pad_to(v, 2, block_k)
@@ -286,7 +314,8 @@ def _flash_bwd_dq_kernel(*refs, scale: float, causal: bool, block_q: int,
         delta = delta_ref[0, 0]                        # (bq, 1)
 
         s = jax.lax.dot_general(q, k, (((1,), (1,)), ((), ())),
-                                preferred_element_type=jnp.float32) * scale
+                                preferred_element_type=jnp.float32,
+                                precision=_prec(q.dtype)) * scale
         if has_bias:
             s = s + bias_ref[0, 0].astype(jnp.float32)
         p = jnp.exp(s - lse)                           # (bq, bk) f32
@@ -354,7 +383,8 @@ def _flash_bwd_dkv_kernel(*refs, scale: float, causal: bool, block_q: int,
         delta = delta_ref[0, 0]
 
         s = jax.lax.dot_general(q, k, (((1,), (1,)), ((), ())),
-                                preferred_element_type=jnp.float32) * scale
+                                preferred_element_type=jnp.float32,
+                                precision=_prec(q.dtype)) * scale
         if has_bias:
             s = s + bias_ref[0, 0].astype(jnp.float32)
         p = jnp.exp(s - lse)                           # (bq, bk) f32
@@ -369,7 +399,8 @@ def _flash_bwd_dkv_kernel(*refs, scale: float, causal: bool, block_q: int,
             p = jnp.where(mask, p, 0.0)
         p_drop = p
         dp = jax.lax.dot_general(do, v, (((1,), (1,)), ((), ())),
-                                 preferred_element_type=jnp.float32)
+                                 preferred_element_type=jnp.float32,
+                                 precision=_prec(v.dtype))
         if rate > 0:
             keep = _dropout_keep(seed_ref, b, h, iq, ik, rate, p.shape)
             inv = 1.0 / (1.0 - rate)
@@ -378,12 +409,14 @@ def _flash_bwd_dkv_kernel(*refs, scale: float, causal: bool, block_q: int,
         # dv += p_drop^T do
         dv_acc[...] += jax.lax.dot_general(
             p_drop.astype(do.dtype), do, (((0,), (0,)), ((), ())),
-            preferred_element_type=jnp.float32)
+            preferred_element_type=jnp.float32,
+            precision=_prec(do.dtype))
         ds = (p * (dp - delta) * scale).astype(q.dtype)
         # dk += ds^T q
         dk_acc[...] += jax.lax.dot_general(
             ds, q, (((0,), (0,)), ((), ())),
-            preferred_element_type=jnp.float32)
+            preferred_element_type=jnp.float32,
+            precision=_prec(q.dtype))
 
     _causal_branches(causal, iq, ik, block_q, block_k, kv_len, tile)
 
@@ -399,6 +432,8 @@ def _flash_backward(q, k, v, bias, seed, o, lse, g, scale: float,
     B, H, Tq, D = q.shape
     Tk = k.shape[2]
     has_bias = bias is not None
+    block_q, block_k = _legal_blocks(block_q, block_k, Tq, Tk,
+                                     has_bias, interpret)
     # a non-learned mask bias skips the O(B*H*T^2) ds materialization —
     # the whole point of a flash kernel for long contexts
     want_dbias = has_bias and bias_grad
