@@ -1120,9 +1120,11 @@ def khatri_rao(*matrices):
     if not matrices:
         raise ValueError("khatri_rao needs at least one matrix")
     nds = tuple(_as_nd(m) for m in matrices)
-    bad = len({m.shape[-1] for m in nds}) != 1
-    for m in nds:
+    bad = False
+    for m in nds:                      # ndim first: 0-d has no shape[-1]
         bad = bad or m.ndim != 2
+    if not bad:
+        bad = len({m.shape[-1] for m in nds}) != 1
     if bad:
         raise ValueError(
             "khatri_rao needs 2-D matrices with a COMMON column count; "

@@ -803,3 +803,111 @@ def unpackbits(a, axis=None, count=None, bitorder="big"):
                   lambda x: jnp.unpackbits(x, axis=axis, count=count,
                                            bitorder=bitorder),
                   (_as_nd(a),))
+
+
+@_public
+def atleast_3d(*arys):
+    outs = [invoke("atleast_3d", jnp.atleast_3d, (_as_nd(a),))
+            for a in arys]
+    return outs[0] if len(outs) == 1 else outs
+
+
+@_public
+def hsplit(a, indices_or_sections):
+    i = indices_or_sections
+    i = tuple(i) if isinstance(i, (list, tuple)) else i
+    return invoke("hsplit", lambda x: tuple(jnp.hsplit(x, i)),
+                  (_as_nd(a),))
+
+
+@_public
+def vsplit(a, indices_or_sections):
+    i = indices_or_sections
+    i = tuple(i) if isinstance(i, (list, tuple)) else i
+    return invoke("vsplit", lambda x: tuple(jnp.vsplit(x, i)),
+                  (_as_nd(a),))
+
+
+@_public
+def dsplit(a, indices_or_sections):
+    i = indices_or_sections
+    i = tuple(i) if isinstance(i, (list, tuple)) else i
+    return invoke("dsplit", lambda x: tuple(jnp.dsplit(x, i)),
+                  (_as_nd(a),))
+
+
+@_public
+def put_along_axis(arr, indices, values, axis):
+    """Out-of-place put_along_axis (arrays are immutable under XLA —
+    returns the updated array rather than mutating, the np.put_along_axis
+    semantics applied functionally)."""
+    ax = axis
+    return invoke(
+        "put_along_axis",
+        lambda a, i, v: jnp.put_along_axis(a, i.astype(jnp.int32), v, ax,
+                                           inplace=False),
+        (_as_nd(arr), _as_nd(indices), _as_nd(values)))
+
+
+@_public
+def fill_diagonal(a, val, wrap=False):
+    """Out-of-place fill_diagonal (returns the filled array)."""
+    w = bool(wrap)
+
+    def impl(x, v):
+        return jnp.fill_diagonal(x, v, wrap=w, inplace=False)
+
+    return invoke("fill_diagonal", impl, (_as_nd(a), _as_nd(val)))
+
+
+@_public
+def histogram2d(x, y, bins=10, range=None, weights=None):
+    b, r = bins, range
+    if weights is not None:
+        return invoke(
+            "histogram2d",
+            lambda xx, yy, ww: jnp.histogram2d(xx, yy, bins=b, range=r,
+                                               weights=ww),
+            (_as_nd(x), _as_nd(y), _as_nd(weights)))
+    return invoke("histogram2d",
+                  lambda xx, yy: jnp.histogram2d(xx, yy, bins=b, range=r),
+                  (_as_nd(x), _as_nd(y)))
+
+
+@_public
+def block(arrays):
+    """np.block over (possibly nested) lists of NDArrays."""
+    # the nesting structure closes over the impl as HASHABLE nested
+    # tuples of leaf indices (a PyTreeDef in the closure would defeat
+    # the per-op executable cache's attr tokenization)
+    leaves = []
+
+    def index_of(node):
+        if isinstance(node, list):
+            return tuple(index_of(c) for c in node)
+        leaves.append(node)
+        return len(leaves) - 1
+
+    struct = index_of(arrays)
+    nds = tuple(_as_nd(v) for v in leaves)
+
+    def impl(*xs):
+        def rebuild(s):
+            if isinstance(s, tuple):
+                return [rebuild(c) for c in s]
+            return xs[s]
+        return jnp.block(rebuild(struct))
+
+    return invoke("block", impl, nds)
+
+
+def _ix_(*seqs):
+    """np.ix_ open-mesh helper (host-side: returns reshaped index
+    NDArrays, no compiled op needed)."""
+    import numpy as _onp
+    outs = _onp.ix_(*[_as_nd(s).asnumpy() for s in seqs])
+    from .ndarray import NDArray as _ND
+    return tuple(_ND(o) for o in outs)
+
+
+ix_ = _public(_ix_, "ix_")

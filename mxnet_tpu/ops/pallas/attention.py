@@ -329,7 +329,8 @@ def _flash_bwd_dq_kernel(*refs, scale: float, causal: bool, block_q: int,
                 mask = jnp.logical_and(mask, col <= row)
             p = jnp.where(mask, p, 0.0)
         dp = jax.lax.dot_general(do, v, (((1,), (1,)), ((), ())),
-                                 preferred_element_type=jnp.float32)
+                                 preferred_element_type=jnp.float32,
+                                 precision=_prec(v.dtype))
         if rate > 0:
             keep = _dropout_keep(seed_ref, b, h, iq, ik, rate, p.shape)
             dp = jnp.where(keep, dp / (1.0 - rate), 0.0)
@@ -339,7 +340,8 @@ def _flash_bwd_dq_kernel(*refs, scale: float, causal: bool, block_q: int,
         ds = (ds0 * scale).astype(k.dtype)
         dq_acc[...] += jax.lax.dot_general(
             ds, k, (((1,), (0,)), ((), ())),
-            preferred_element_type=jnp.float32)
+            preferred_element_type=jnp.float32,
+            precision=_prec(k.dtype))
 
     def skipped():
         if emit_ds:

@@ -233,3 +233,44 @@ def test_cross_diagonal_partition_lexsort_packbits():
     assert packed.asnumpy().tolist() == onp.packbits(bits).tolist()
     assert mnp.unpackbits(packed, count=9).asnumpy().tolist() == \
         bits.tolist()
+
+
+def test_np_splits_and_stacking_helpers():
+    """r3 np-surface parity: hsplit/vsplit/dsplit/atleast_3d/block."""
+    a = mx.np.array(onp.arange(24.0).reshape(2, 3, 4))
+    h = mx.np.hsplit(a, 3)
+    assert len(h) == 3 and h[0].shape == (2, 1, 4)
+    onp.testing.assert_allclose(
+        onp.concatenate([x.asnumpy() for x in h], axis=1), a.asnumpy())
+    assert mx.np.vsplit(a, 2)[1].shape == (1, 3, 4)
+    assert mx.np.dsplit(a, 2)[0].shape == (2, 3, 2)
+    assert mx.np.atleast_3d(mx.np.array([1.0, 2.0])).shape == (1, 2, 1)
+    b = mx.np.block([[mx.np.ones((2, 2)), mx.np.zeros((2, 2))],
+                     [mx.np.zeros((2, 2)), mx.np.ones((2, 2))]])
+    assert b.shape == (4, 4)
+    assert float(b.asnumpy().trace()) == 4.0
+
+
+def test_np_functional_mutation_helpers():
+    """put_along_axis / fill_diagonal are OUT-OF-PLACE under XLA (arrays
+    are immutable): they return the updated array."""
+    z = mx.np.zeros((3, 3))
+    f = mx.np.fill_diagonal(z, 7.0)
+    assert (f.asnumpy().diagonal() == 7).all()
+    assert (z.asnumpy() == 0).all()          # source untouched
+    idx = mx.np.array(onp.array([[2], [0], [1]], "int32"))
+    val = mx.np.array(onp.full((3, 1), 9.0, "float32"))
+    p = mx.np.put_along_axis(mx.np.zeros((3, 3)), idx, val, 1)
+    assert (p.asnumpy()[[0, 1, 2], [2, 0, 1]] == 9).all()
+
+
+def test_np_histogram2d_and_ix():
+    rng = onp.random.RandomState(0)
+    x = mx.np.array(rng.rand(100).astype("float32"))
+    y = mx.np.array(rng.rand(100).astype("float32"))
+    h, ex, ey = mx.np.histogram2d(x, y, bins=5)
+    assert h.shape == (5, 5)
+    assert abs(float(h.asnumpy().sum()) - 100) < 1e-4
+    gx, gy = mx.np.ix_(mx.np.array(onp.array([0, 2])),
+                       mx.np.array(onp.array([1, 3])))
+    assert gx.shape == (2, 1) and gy.shape == (1, 2)
