@@ -14,9 +14,10 @@ from ...ndarray.ndarray import NDArray
 from ...ndarray import ops as ndops
 from ... import npx
 from ..parameter import Parameter
-from ..rnn.rnn_cell import ModifierCell, RecurrentCell
+from ..rnn.rnn_cell import (ModifierCell, RecurrentCell,
+                            _BaseGatedCell)
 
-__all__ = ["VariationalDropoutCell", "Conv2DLSTMCell"]
+__all__ = ["VariationalDropoutCell", "Conv2DLSTMCell", "LSTMPCell"]
 
 
 class VariationalDropoutCell(ModifierCell):
@@ -126,3 +127,48 @@ class Conv2DLSTMCell(RecurrentCell):
         c_next = f_g * c + i_g * ndops.tanh(c_g)
         h_next = o_g * ndops.tanh(c_next)
         return h_next, [h_next, c_next]
+
+
+class LSTMPCell(_BaseGatedCell):
+    """LSTM cell with a hidden-state projection (reference:
+    gluon.contrib.rnn.LSTMPCell, the LSTMP architecture of Sak et al.
+    2014): the recurrent state is the PROJECTED hidden ``r`` of size
+    ``projection_size``; the cell state keeps ``hidden_size``. Gate
+    order i, f, g, o, matching :class:`LSTMCell`; parameter plumbing
+    (deferred init, fused gate projections) comes from the shared
+    gated-cell base with ``recurrent_size=projection_size``."""
+
+    def __init__(self, hidden_size: int, projection_size: int,
+                 input_size: int = 0,
+                 h2r_weight_initializer: Any = None,
+                 **kwargs: Any) -> None:
+        super().__init__(hidden_size, 4, input_size=input_size,
+                         recurrent_size=projection_size, **kwargs)
+        self._projection_size = projection_size
+        self.h2r_weight = Parameter(
+            "h2r_weight", shape=(projection_size, hidden_size),
+            init=h2r_weight_initializer)
+
+    def state_info(self, batch_size: int = 0):
+        return [{"shape": (batch_size, self._projection_size),
+                 "__layout__": "NC"},
+                {"shape": (batch_size, self._hidden_size),
+                 "__layout__": "NC"}]
+
+    def forward(self, inputs: NDArray, states: List[NDArray]):
+        from ... import numpy as mxnp
+        r_prev, c_prev = states
+        gi, gh = self._proj(inputs, r_prev)
+        parts = mxnp.split(gi + gh, 4, axis=-1)
+        i = parts[0].sigmoid()
+        f = parts[1].sigmoid()
+        g = parts[2].tanh()
+        o = parts[3].sigmoid()
+        c = f * c_prev + i * g
+        hidden = o * c.tanh()
+        if not self.h2r_weight.is_initialized:
+            self.h2r_weight._finish_deferred_init(self.h2r_weight.shape)
+        r = npx.fully_connected(hidden, self.h2r_weight.data(), None,
+                                num_hidden=self._projection_size,
+                                flatten=False)
+        return r, [r, c]

@@ -21,7 +21,7 @@ __all__ = ["Loss", "L2Loss", "L1Loss", "SoftmaxCrossEntropyLoss",
            "SoftmaxCELoss", "SigmoidBinaryCrossEntropyLoss", "SigmoidBCELoss",
            "KLDivLoss", "HuberLoss", "HingeLoss", "SquaredHingeLoss",
            "LogisticLoss", "TripletLoss", "CosineEmbeddingLoss",
-           "PoissonNLLLoss", "CTCLoss"]
+           "PoissonNLLLoss", "CTCLoss", "SDMLLoss"]
 
 
 def _apply_weighting(loss: NDArray, weight: Optional[float],
@@ -355,3 +355,42 @@ class CTCLoss(Loss):
                 inputs.append(label_lengths)
         loss = invoke("ctc_loss", impl, tuple(inputs))
         return _apply_weighting(loss, self._weight, sample_weight)
+
+
+class SDMLLoss(Loss):
+    """Smoothed deep metric learning loss (reference: gluon.loss.SDMLLoss,
+    gluon-nlp era): two aligned embedding batches x1/x2 (N, d) where row
+    i of each is a positive pair and every other row is an in-batch
+    negative. Minimizes the KL divergence between smoothed identity
+    labels and the softmax over negative pairwise L2 distances."""
+
+    def __init__(self, smoothing_parameter: float = 0.3,
+                 weight: float = 1.0, batch_axis: int = 0,
+                 **kwargs: Any) -> None:
+        super().__init__(weight, batch_axis, **kwargs)
+        self._smoothing = float(smoothing_parameter)
+
+    def forward(self, x1: NDArray, x2: NDArray) -> NDArray:
+        from .. import numpy as mxnp
+        N = x1.shape[0]
+        # pairwise squared-L2 distance matrix (N, N)
+        sq1 = ops.square(x1).sum(axis=1).reshape((N, 1))
+        sq2 = ops.square(x2).sum(axis=1).reshape((1, N))
+        dist = sq1 + sq2 - 2.0 * mxnp.matmul(x1, x2.T)
+        # smoothed identity labels: diagonal mass 1-s, off-diag s/(N-1)
+        s = self._smoothing
+        eye = ops.eye(N, dtype=x1.dtype)
+        labels = eye * (1.0 - s) + (1.0 - eye) * (s / max(N - 1, 1))
+        log_prob = npx.log_softmax(-dist, axis=-1)
+        # KL(labels || softmax(-dist)) including the constant label-
+        # entropy term: gradients match cross-entropy, but the VALUES
+        # match the reference's KLDivLoss-based implementation
+        import math as _math
+        if N > 1 and 0.0 < s < 1.0:
+            label_entropy = ((1.0 - s) * _math.log(1.0 - s)
+                             + s * _math.log(s / (N - 1)))
+        else:
+            label_entropy = 0.0
+        loss = label_entropy - (labels * log_prob).sum(axis=1)
+        loss = _apply_weighting(loss, self._weight, None)
+        return _batch_mean(loss, self._batch_axis)

@@ -83,17 +83,21 @@ class _BaseGatedCell(RecurrentCell):
                  h2h_weight_initializer: Any = None,
                  i2h_bias_initializer: Any = "zeros",
                  h2h_bias_initializer: Any = "zeros",
+                 recurrent_size: Optional[int] = None,
                  **kwargs: Any) -> None:
         super().__init__(**kwargs)
         self._hidden_size = hidden_size
         self._input_size = input_size
+        # the recurrent input may be narrower than hidden_size
+        # (LSTMPCell feeds back a projection)
+        self._recurrent_size = recurrent_size or hidden_size
         ng = num_gates
         self.i2h_weight = Parameter("i2h_weight",
                                     shape=(ng * hidden_size, input_size),
                                     init=i2h_weight_initializer)
-        self.h2h_weight = Parameter("h2h_weight",
-                                    shape=(ng * hidden_size, hidden_size),
-                                    init=h2h_weight_initializer)
+        self.h2h_weight = Parameter(
+            "h2h_weight", shape=(ng * hidden_size, self._recurrent_size),
+            init=h2h_weight_initializer)
         self.i2h_bias = Parameter("i2h_bias", shape=(ng * hidden_size,),
                                   init=i2h_bias_initializer)
         self.h2h_bias = Parameter("h2h_bias", shape=(ng * hidden_size,),

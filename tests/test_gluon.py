@@ -307,3 +307,38 @@ def test_hybridized_batchnorm_updates_running_stats():
     # eval after training consumes the updated stats identically
     ea, eb = imp(x), hyb(x)
     assert_almost_equal(ea, eb, rtol=1e-5, atol=1e-6)
+
+
+def test_sdml_loss_prefers_aligned_pairs():
+    """SDMLLoss (reference gluon.loss.SDMLLoss): aligned positive pairs
+    score lower than misaligned ones, the smoothed-label math matches a
+    numpy reference, and gradients flow."""
+    from mxnet_tpu.gluon.loss import SDMLLoss
+    rng = onp.random.RandomState(0)
+    x1 = rand_ndarray((6, 8))
+    x2 = mx.np.array(x1.asnumpy() +
+                     rng.normal(0, 0.05, (6, 8)).astype("float32"))
+    l = SDMLLoss(smoothing_parameter=0.3)
+    aligned = l(x1, x2).asnumpy()
+    assert aligned.shape == (6,)
+    perm = onp.arange(6); onp.random.RandomState(1).shuffle(perm)
+    shuffled = l(x1, mx.np.array(x2.asnumpy()[perm])).asnumpy()
+    assert aligned.mean() < shuffled.mean()
+
+    # numpy reference of the smoothed-KL objective
+    a, b = x1.asnumpy().astype("float64"), x2.asnumpy().astype("float64")
+    d = ((a ** 2).sum(1)[:, None] + (b ** 2).sum(1)[None, :]
+         - 2 * a @ b.T)
+    lp = -d - onp.log(onp.exp(-d).sum(axis=1, keepdims=True))
+    N, s = 6, 0.3
+    lab = onp.eye(N) * (1 - s) + (1 - onp.eye(N)) * (s / (N - 1))
+    # KL form (the reference's KLDivLoss-based value): includes the
+    # constant label-entropy term on top of the cross-entropy
+    ent = (1 - s) * onp.log(1 - s) + s * onp.log(s / (N - 1))
+    ref = ent - (lab * lp).sum(axis=1)
+    onp.testing.assert_allclose(aligned, ref, rtol=1e-4, atol=1e-5)
+
+    x1.attach_grad()
+    with ag.record():
+        l(x1, x2).sum().backward()
+    assert float(onp.abs(x1.grad.asnumpy()).sum()) > 0
