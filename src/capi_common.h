@@ -1,0 +1,42 @@
+/*
+ * capi_common.h — shared plumbing for the C API translation units:
+ * the error trampoline macros (every extern "C" entry funnels exceptions
+ * into MXGetLastError, reference API_BEGIN/API_END in c_api_common.h)
+ * and small file helpers used by the deployment surfaces.
+ */
+#ifndef MXTPU_CAPI_COMMON_H_
+#define MXTPU_CAPI_COMMON_H_
+
+#include <fstream>
+#include <sstream>
+#include <stdexcept>
+#include <string>
+
+namespace mxtpu {
+
+void SetLastError(const std::string &msg);  /* c_api.cc */
+
+inline std::string ReadFile(const char *path) {
+  std::ifstream f(path, std::ios::binary);
+  if (!f) throw std::runtime_error(std::string("cannot open ") + path);
+  std::ostringstream ss;
+  ss << f.rdbuf();
+  return ss.str();
+}
+
+}  // namespace mxtpu
+
+#define API_BEGIN() try {
+#define API_END()                             \
+  }                                           \
+  catch (const std::exception &e) {           \
+    ::mxtpu::SetLastError(e.what());          \
+    return -1;                                \
+  }                                           \
+  catch (...) {                               \
+    ::mxtpu::SetLastError("unknown C++ error"); \
+    return -1;                                \
+  }                                           \
+  return 0;
+
+#endif  // MXTPU_CAPI_COMMON_H_

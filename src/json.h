@@ -1,0 +1,152 @@
+/*
+ * json.h — minimal JSON value + recursive-descent parser shared by the
+ * native deployment surfaces (predict.cc, symbol.cc). Covers exactly the
+ * schema HybridBlock.export() emits (objects / arrays / strings / numbers
+ * / bools / null, ASCII \u escapes); not a general-purpose JSON library.
+ * Reference parity: the role nlohmann/dmlc json played for
+ * src/c_api_symbolic.cc and src/c_predict_api.cc.
+ */
+#ifndef MXTPU_JSON_H_
+#define MXTPU_JSON_H_
+
+#include <cctype>
+#include <cstring>
+#include <map>
+#include <stdexcept>
+#include <string>
+#include <vector>
+
+namespace mxtpu {
+
+struct JValue {
+  enum Kind { NUL, BOOL, NUM, STR, ARR, OBJ } kind = NUL;
+  bool b = false;
+  double num = 0.0;
+  std::string str;
+  std::vector<JValue> arr;
+  std::map<std::string, JValue> obj;
+
+  const JValue *get(const std::string &k) const {
+    auto it = obj.find(k);
+    return it == obj.end() ? nullptr : &it->second;
+  }
+};
+
+struct JParser {
+  const char *p, *end;
+  explicit JParser(const std::string &s)
+      : p(s.data()), end(s.data() + s.size()) {}
+
+  [[noreturn]] void fail(const char *msg) {
+    throw std::runtime_error(std::string("json parse error: ") + msg);
+  }
+  void ws() {
+    while (p < end && std::isspace(static_cast<unsigned char>(*p))) ++p;
+  }
+  char peek() {
+    ws();
+    if (p >= end) fail("unexpected end");
+    return *p;
+  }
+  void expect(char c) {
+    if (peek() != c) fail("unexpected character");
+    ++p;
+  }
+  JValue parse() {
+    JValue v = value();
+    ws();
+    return v;
+  }
+  JValue value() {
+    switch (peek()) {
+      case '{': return object();
+      case '[': return array();
+      case '"': { JValue v; v.kind = JValue::STR; v.str = string(); return v; }
+      case 't': lit("true");  { JValue v; v.kind = JValue::BOOL; v.b = true;  return v; }
+      case 'f': lit("false"); { JValue v; v.kind = JValue::BOOL; v.b = false; return v; }
+      case 'n': lit("null");  return JValue();
+      default:  return number();
+    }
+  }
+  void lit(const char *s) {
+    ws();
+    size_t n = std::strlen(s);
+    if (p + n > end || std::strncmp(p, s, n) != 0) fail("bad literal");
+    p += n;
+  }
+  JValue number() {
+    ws();
+    char *q = nullptr;
+    JValue v;
+    v.kind = JValue::NUM;
+    v.num = std::strtod(p, &q);
+    if (q == p) fail("bad number");
+    p = q;
+    return v;
+  }
+  std::string string() {
+    expect('"');
+    std::string s;
+    while (p < end && *p != '"') {
+      if (*p == '\\') {
+        ++p;
+        if (p >= end) fail("bad escape");
+        switch (*p) {
+          case 'n': s += '\n'; break;
+          case 't': s += '\t'; break;
+          case 'r': s += '\r'; break;
+          case 'b': s += '\b'; break;
+          case 'f': s += '\f'; break;
+          case 'u': {             /* ASCII subset only */
+            if (p + 4 >= end) fail("bad \\u");
+            s += static_cast<char>(
+                std::strtol(std::string(p + 1, 4).c_str(), nullptr, 16));
+            p += 4;
+            break;
+          }
+          default: s += *p;
+        }
+        ++p;
+      } else {
+        s += *p++;
+      }
+    }
+    if (p >= end) fail("unterminated string");
+    ++p;
+    return s;
+  }
+  JValue array() {
+    expect('[');
+    JValue v;
+    v.kind = JValue::ARR;
+    if (peek() == ']') { ++p; return v; }
+    for (;;) {
+      v.arr.push_back(value());
+      char c = peek();
+      if (c == ',') { ++p; continue; }
+      if (c == ']') { ++p; break; }
+      fail("expected , or ]");
+    }
+    return v;
+  }
+  JValue object() {
+    expect('{');
+    JValue v;
+    v.kind = JValue::OBJ;
+    if (peek() == '}') { ++p; return v; }
+    for (;;) {
+      std::string k = string();
+      expect(':');
+      v.obj[k] = value();
+      char c = peek();
+      if (c == ',') { ++p; continue; }
+      if (c == '}') { ++p; break; }
+      fail("expected , or }");
+    }
+    return v;
+  }
+};
+
+}  // namespace mxtpu
+
+#endif  // MXTPU_JSON_H_

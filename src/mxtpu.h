@@ -176,6 +176,41 @@ int MXPredGetOutputShape(PredictorHandle h, int *out_ndim,
 int MXPredGetOutput(PredictorHandle h, float *data, uint64_t size);
 int MXPredFree(PredictorHandle h);
 
+/* ---- C symbol API (c_api_symbolic.cc analog) ----
+ * A Symbol wraps the export() artifact (the "-symbol.json" meta: inputs,
+ * param_order, deploy_graph, StableHLO payload). Name lists returned by
+ * the List* functions are owned by the symbol and stay valid until
+ * MXSymbolFree. Argument/auxiliary split follows the reference: BN
+ * running statistics are auxiliary states, everything else arguments. */
+typedef void *SymbolHandle;
+
+int MXSymbolCreateFromFile(const char *path, SymbolHandle *out);
+int MXSymbolCreateFromJSON(const char *json, SymbolHandle *out);
+/* Returns the json text; free via MXFreeString. */
+int MXSymbolSaveToJSON(SymbolHandle h, char **out_json);
+int MXSymbolSaveToFile(SymbolHandle h, const char *path);
+int MXSymbolListArguments(SymbolHandle h, int *out_n,
+                          const char ***out_names);
+int MXSymbolListAuxiliaryStates(SymbolHandle h, int *out_n,
+                                const char ***out_names);
+int MXSymbolListOutputs(SymbolHandle h, int *out_n,
+                        const char ***out_names);
+/* Op names of the native deploy_graph (empty when the export has none). */
+int MXSymbolListDeployOps(SymbolHandle h, int *out_n,
+                          const char ***out_names);
+/* Top-level scalar meta fields ("framework", "block", "format_version");
+ * success with *out = NULL when absent. */
+int MXSymbolGetAttr(SymbolHandle h, const char *key, const char **out);
+int MXSymbolGetNumInputs(SymbolHandle h, int *out_n);
+int MXSymbolGetInputShape(SymbolHandle h, int index, int *out_ndim,
+                          const int64_t **out_shape,
+                          const char **out_dtype);
+int MXSymbolFree(SymbolHandle h);
+/* Build the native predictor from an already-loaded symbol. */
+int MXPredCreateFromSymbol(SymbolHandle sym, const char *param_file,
+                           const int64_t *input_shape, int input_ndim,
+                           PredictorHandle *out);
+
 /* ---- runtime feature introspection (libinfo.cc analog) ---- */
 const char *MXLibInfoFeatures(void);
 

@@ -22,144 +22,15 @@
 #include <string>
 #include <vector>
 
+#include "./capi_common.h"
+#include "./json.h"
 #include "./mxtpu.h"
-
-namespace mxtpu {
-void SetLastError(const std::string &msg);  /* c_api.cc */
-}
 
 namespace {
 
-/* ---- minimal JSON (enough for the export meta schema) ---------------- */
-
-struct JValue {
-  enum Kind { NUL, BOOL, NUM, STR, ARR, OBJ } kind = NUL;
-  bool b = false;
-  double num = 0.0;
-  std::string str;
-  std::vector<JValue> arr;
-  std::map<std::string, JValue> obj;
-
-  const JValue *get(const std::string &k) const {
-    auto it = obj.find(k);
-    return it == obj.end() ? nullptr : &it->second;
-  }
-};
-
-struct JParser {
-  const char *p, *end;
-  explicit JParser(const std::string &s)
-      : p(s.data()), end(s.data() + s.size()) {}
-
-  [[noreturn]] void fail(const char *msg) {
-    throw std::runtime_error(std::string("json parse error: ") + msg);
-  }
-  void ws() {
-    while (p < end && std::isspace(static_cast<unsigned char>(*p))) ++p;
-  }
-  char peek() {
-    ws();
-    if (p >= end) fail("unexpected end");
-    return *p;
-  }
-  void expect(char c) {
-    if (peek() != c) fail("unexpected character");
-    ++p;
-  }
-  JValue parse() {
-    JValue v = value();
-    ws();
-    return v;
-  }
-  JValue value() {
-    switch (peek()) {
-      case '{': return object();
-      case '[': return array();
-      case '"': { JValue v; v.kind = JValue::STR; v.str = string(); return v; }
-      case 't': lit("true");  { JValue v; v.kind = JValue::BOOL; v.b = true;  return v; }
-      case 'f': lit("false"); { JValue v; v.kind = JValue::BOOL; v.b = false; return v; }
-      case 'n': lit("null");  return JValue();
-      default:  return number();
-    }
-  }
-  void lit(const char *s) {
-    ws();
-    size_t n = std::strlen(s);
-    if (p + n > end || std::strncmp(p, s, n) != 0) fail("bad literal");
-    p += n;
-  }
-  JValue number() {
-    ws();
-    char *q = nullptr;
-    JValue v;
-    v.kind = JValue::NUM;
-    v.num = std::strtod(p, &q);
-    if (q == p) fail("bad number");
-    p = q;
-    return v;
-  }
-  std::string string() {
-    expect('"');
-    std::string s;
-    while (p < end && *p != '"') {
-      if (*p == '\\') {
-        ++p;
-        if (p >= end) fail("bad escape");
-        switch (*p) {
-          case 'n': s += '\n'; break;
-          case 't': s += '\t'; break;
-          case 'r': s += '\r'; break;
-          case 'b': s += '\b'; break;
-          case 'f': s += '\f'; break;
-          case 'u': {             /* ASCII subset only */
-            if (p + 4 >= end) fail("bad \\u");
-            s += static_cast<char>(
-                std::strtol(std::string(p + 1, 4).c_str(), nullptr, 16));
-            p += 4;
-            break;
-          }
-          default: s += *p;
-        }
-        ++p;
-      } else {
-        s += *p++;
-      }
-    }
-    if (p >= end) fail("unterminated string");
-    ++p;
-    return s;
-  }
-  JValue array() {
-    expect('[');
-    JValue v;
-    v.kind = JValue::ARR;
-    if (peek() == ']') { ++p; return v; }
-    for (;;) {
-      v.arr.push_back(value());
-      char c = peek();
-      if (c == ',') { ++p; continue; }
-      if (c == ']') { ++p; break; }
-      fail("expected , or ]");
-    }
-    return v;
-  }
-  JValue object() {
-    expect('{');
-    JValue v;
-    v.kind = JValue::OBJ;
-    if (peek() == '}') { ++p; return v; }
-    for (;;) {
-      std::string k = string();
-      expect(':');
-      v.obj[k] = value();
-      char c = peek();
-      if (c == ',') { ++p; continue; }
-      if (c == '}') { ++p; break; }
-      fail("expected , or }");
-    }
-    return v;
-  }
-};
+using mxtpu::JValue;
+using mxtpu::JParser;
+using mxtpu::ReadFile;
 
 /* ---- predictor ------------------------------------------------------- */
 
@@ -191,14 +62,6 @@ struct Predictor {
     output = nullptr;
   }
 };
-
-std::string ReadFile(const char *path) {
-  std::ifstream f(path, std::ios::binary);
-  if (!f) throw std::runtime_error(std::string("cannot open ") + path);
-  std::ostringstream ss;
-  ss << f.rdbuf();
-  return ss.str();
-}
 
 std::string JStr(const JValue *v, const char *what) {
   if (v == nullptr || v->kind == JValue::NUL) return "";
@@ -361,28 +224,12 @@ NDArrayHandle RunNode(Predictor *p, const Node &n, NDArrayHandle h) {
 
 }  // namespace
 
-using mxtpu::SetLastError;
+namespace mxtpu {
 
-#define API_BEGIN() try {
-#define API_END()                      \
-  }                                    \
-  catch (const std::exception &e) {    \
-    SetLastError(e.what());            \
-    return -1;                         \
-  }                                    \
-  catch (...) {                        \
-    SetLastError("unknown C++ error"); \
-    return -1;                         \
-  }                                    \
-  return 0;
-
-extern "C" {
-
-int MXPredCreate(const char *symbol_json_file, const char *param_file,
-                 const int64_t *input_shape, int input_ndim,
-                 PredictorHandle *out) {
-  API_BEGIN();
-  JValue meta = JParser(ReadFile(symbol_json_file)).parse();
+/* Shared with symbol.cc (MXPredCreateFromSymbol): build a Predictor from
+ * an already-parsed export meta object. Throws on error. */
+void *BuildPredictorFromMeta(const JValue &meta, const char *param_file,
+                             const int64_t *input_shape, int input_ndim) {
   const JValue *graph = meta.get("deploy_graph");
   if (graph == nullptr || graph->kind != JValue::ARR)
     throw std::runtime_error(
@@ -435,7 +282,20 @@ int MXPredCreate(const char *symbol_json_file, const char *param_file,
 
   pred->input = MakeArray(
       std::vector<int64_t>(input_shape, input_shape + input_ndim), 0);
-  *out = pred.release();
+  return pred.release();
+}
+
+}  /* namespace mxtpu */
+
+extern "C" {
+
+int MXPredCreate(const char *symbol_json_file, const char *param_file,
+                 const int64_t *input_shape, int input_ndim,
+                 PredictorHandle *out) {
+  API_BEGIN();
+  JValue meta = JParser(ReadFile(symbol_json_file)).parse();
+  *out = mxtpu::BuildPredictorFromMeta(meta, param_file, input_shape,
+                                       input_ndim);
   API_END();
 }
 

@@ -1,0 +1,265 @@
+/*
+ * symbol.cc — C symbol surface (reference: src/c_api/c_api_symbolic.cc:
+ * MXSymbolCreateFromFile/FromJSON, MXSymbolSaveToJSON,
+ * MXSymbolListArguments, MXSymbolListAuxiliaryStates, MXSymbolListOutputs,
+ * MXSymbolListAttr, MXSymbolFree).
+ *
+ * A Symbol here wraps the HybridBlock.export() artifact: the parsed meta
+ * json (inputs / params / param_order / deploy_graph / StableHLO payload).
+ * Argument vs auxiliary-state split follows the reference convention:
+ * BatchNorm running statistics (``*running_mean`` / ``*running_var``) are
+ * auxiliary states (not gradients targets); everything else in
+ * ``param_order`` is an argument. ``MXPredCreateFromSymbol`` builds the
+ * native predictor from an already-loaded symbol, completing the
+ * symbol → executor C-side story for deployment.
+ */
+#include <cstdint>
+#include <cstdlib>
+#include <cstring>
+#include <fstream>
+#include <memory>
+#include <sstream>
+#include <stdexcept>
+#include <string>
+#include <vector>
+
+#include "./capi_common.h"
+#include "./json.h"
+#include "./mxtpu.h"
+
+namespace mxtpu {
+void *BuildPredictorFromMeta(const JValue &meta, const char *param_file,
+                             const int64_t *input_shape, int input_ndim);
+}
+
+namespace {
+
+using mxtpu::JValue;
+using mxtpu::JParser;
+using mxtpu::ReadFile;
+
+bool IsAuxName(const std::string &name) {
+  /* reference: aux_states = BN moving statistics (ndarray.h kAuxArg);
+   * stat_shift is this framework's extra BN stability buffer — untrained
+   * state, same class */
+  return name.find("running_mean") != std::string::npos ||
+         name.find("running_var") != std::string::npos ||
+         name.find("stat_shift") != std::string::npos;
+}
+
+struct Symbol {
+  std::string json;                       /* raw text (SaveToJSON) */
+  JValue meta;
+  std::vector<std::string> args, aux, outputs, ops;
+  std::vector<const char *> args_c, aux_c, outputs_c, ops_c;
+  std::vector<std::vector<int64_t>> input_shapes;
+  std::vector<std::string> input_dtypes;
+  std::map<std::string, std::string> attr_cache;  /* rendered GetAttr values */
+
+  void Index() {
+    const JValue *order = meta.get("param_order");
+    if (order != nullptr && order->kind == JValue::ARR) {
+      for (const JValue &v : order->arr) {
+        if (v.kind != JValue::STR)
+          throw std::runtime_error("param_order: expected strings");
+        (IsAuxName(v.str) ? aux : args).push_back(v.str);
+      }
+    }
+    const JValue *blk = meta.get("block");
+    std::string base =
+        (blk != nullptr && blk->kind == JValue::STR) ? blk->str : "symbol";
+    for (char &c : base)
+      c = static_cast<char>(std::tolower(static_cast<unsigned char>(c)));
+    outputs.push_back(base + "_output");   /* reference "<name>_output" */
+    const JValue *graph = meta.get("deploy_graph");
+    if (graph != nullptr && graph->kind == JValue::ARR) {
+      for (const JValue &node : graph->arr) {
+        const JValue *op = node.get("op");
+        if (op != nullptr && op->kind == JValue::STR)
+          ops.push_back(op->str);
+      }
+    }
+    const JValue *inputs = meta.get("inputs");
+    if (inputs != nullptr && inputs->kind == JValue::ARR) {
+      for (const JValue &in : inputs->arr) {
+        std::vector<int64_t> shape;
+        const JValue *js = in.get("shape");
+        if (js != nullptr && js->kind == JValue::ARR)
+          for (const JValue &d : js->arr)
+            shape.push_back(static_cast<int64_t>(d.num));
+        input_shapes.push_back(std::move(shape));
+        const JValue *jd = in.get("dtype");
+        input_dtypes.push_back(
+            (jd != nullptr && jd->kind == JValue::STR) ? jd->str : "");
+      }
+    }
+    for (const auto &s : args) args_c.push_back(s.c_str());
+    for (const auto &s : aux) aux_c.push_back(s.c_str());
+    for (const auto &s : outputs) outputs_c.push_back(s.c_str());
+    for (const auto &s : ops) ops_c.push_back(s.c_str());
+  }
+};
+
+Symbol *Sym(SymbolHandle h) {
+  if (h == nullptr) throw std::runtime_error("null SymbolHandle");
+  return static_cast<Symbol *>(h);
+}
+
+SymbolHandle CreateFromText(std::string text) {
+  auto sym = std::unique_ptr<Symbol>(new Symbol());
+  sym->json = std::move(text);
+  sym->meta = JParser(sym->json).parse();
+  if (sym->meta.kind != JValue::OBJ)
+    throw std::runtime_error("symbol json: expected a top-level object");
+  sym->Index();
+  return sym.release();
+}
+
+}  // namespace
+
+extern "C" {
+
+int MXSymbolCreateFromFile(const char *path, SymbolHandle *out) {
+  API_BEGIN();
+  *out = CreateFromText(ReadFile(path));
+  API_END();
+}
+
+int MXSymbolCreateFromJSON(const char *json, SymbolHandle *out) {
+  API_BEGIN();
+  if (json == nullptr) throw std::runtime_error("null json");
+  *out = CreateFromText(std::string(json));
+  API_END();
+}
+
+int MXSymbolSaveToJSON(SymbolHandle h, char **out_json) {
+  API_BEGIN();
+  Symbol *s = Sym(h);
+  char *buf = static_cast<char *>(std::malloc(s->json.size() + 1));
+  if (buf == nullptr) throw std::runtime_error("out of memory");
+  std::memcpy(buf, s->json.data(), s->json.size());
+  buf[s->json.size()] = '\0';
+  *out_json = buf;                      /* free via MXFreeString */
+  API_END();
+}
+
+int MXSymbolSaveToFile(SymbolHandle h, const char *path) {
+  API_BEGIN();
+  Symbol *s = Sym(h);
+  std::ofstream f(path, std::ios::binary);
+  if (!f) throw std::runtime_error(std::string("cannot open ") + path);
+  f << s->json;
+  if (!f) throw std::runtime_error(std::string("write failed: ") + path);
+  API_END();
+}
+
+int MXSymbolListArguments(SymbolHandle h, int *out_n,
+                          const char ***out_names) {
+  API_BEGIN();
+  Symbol *s = Sym(h);
+  *out_n = static_cast<int>(s->args_c.size());
+  *out_names = s->args_c.data();
+  API_END();
+}
+
+int MXSymbolListAuxiliaryStates(SymbolHandle h, int *out_n,
+                                const char ***out_names) {
+  API_BEGIN();
+  Symbol *s = Sym(h);
+  *out_n = static_cast<int>(s->aux_c.size());
+  *out_names = s->aux_c.data();
+  API_END();
+}
+
+int MXSymbolListOutputs(SymbolHandle h, int *out_n,
+                        const char ***out_names) {
+  API_BEGIN();
+  Symbol *s = Sym(h);
+  *out_n = static_cast<int>(s->outputs_c.size());
+  *out_names = s->outputs_c.data();
+  API_END();
+}
+
+int MXSymbolListDeployOps(SymbolHandle h, int *out_n,
+                          const char ***out_names) {
+  API_BEGIN();
+  Symbol *s = Sym(h);
+  *out_n = static_cast<int>(s->ops_c.size());
+  *out_names = s->ops_c.data();
+  API_END();
+}
+
+int MXSymbolGetAttr(SymbolHandle h, const char *key, const char **out) {
+  /* top-level scalar meta fields: "framework", "block",
+   * "format_version", ... Returns success with *out = NULL when the key
+   * is absent (reference MXSymbolGetAttr contract). */
+  API_BEGIN();
+  Symbol *s = Sym(h);
+  if (key == nullptr) throw std::runtime_error("null key");
+  *out = nullptr;
+  const JValue *v = s->meta.get(key);
+  if (v == nullptr) return 0;
+  /* rendered once per key, stored on the symbol: pointers stay valid
+   * until MXSymbolFree and die with it */
+  auto it = s->attr_cache.find(key);
+  if (it != s->attr_cache.end()) {
+    *out = it->second.c_str();
+    return 0;
+  }
+  std::string text;
+  switch (v->kind) {
+    case JValue::STR: text = v->str; break;
+    case JValue::NUM: {
+      std::ostringstream ss;
+      if (v->num == static_cast<int64_t>(v->num))
+        ss << static_cast<int64_t>(v->num);
+      else
+        ss << v->num;
+      text = ss.str();
+      break;
+    }
+    case JValue::BOOL: text = v->b ? "true" : "false"; break;
+    default: return 0;                  /* arrays/objects: not an attr */
+  }
+  auto &slot = s->attr_cache[key];
+  slot = std::move(text);
+  *out = slot.c_str();
+  API_END();
+}
+
+int MXSymbolGetNumInputs(SymbolHandle h, int *out_n) {
+  API_BEGIN();
+  *out_n = static_cast<int>(Sym(h)->input_shapes.size());
+  API_END();
+}
+
+int MXSymbolGetInputShape(SymbolHandle h, int index, int *out_ndim,
+                          const int64_t **out_shape,
+                          const char **out_dtype) {
+  API_BEGIN();
+  Symbol *s = Sym(h);
+  if (index < 0 || index >= static_cast<int>(s->input_shapes.size()))
+    throw std::runtime_error("input index out of range");
+  *out_ndim = static_cast<int>(s->input_shapes[index].size());
+  *out_shape = s->input_shapes[index].data();
+  *out_dtype = s->input_dtypes[index].c_str();
+  API_END();
+}
+
+int MXSymbolFree(SymbolHandle h) {
+  API_BEGIN();
+  delete static_cast<Symbol *>(h);
+  API_END();
+}
+
+int MXPredCreateFromSymbol(SymbolHandle sym, const char *param_file,
+                           const int64_t *input_shape, int input_ndim,
+                           PredictorHandle *out) {
+  API_BEGIN();
+  Symbol *s = Sym(sym);
+  *out = mxtpu::BuildPredictorFromMeta(s->meta, param_file, input_shape,
+                                       input_ndim);
+  API_END();
+}
+
+}  /* extern "C" */

@@ -355,6 +355,114 @@ static void test_predict_mlp() {
   std::puts("predict_mlp OK");
 }
 
+static void test_symbol_api() {
+  /* c_api_symbolic analog: parse an export meta, list arguments vs
+   * auxiliary states, outputs, deploy ops, attrs, input shapes; then
+   * build a predictor from the symbol handle and verify it matches the
+   * known MLP numbers from test_predict_mlp. */
+  const char *json =
+      "{\n"
+      "  \"framework\": \"mxnet_tpu\",\n"
+      "  \"format_version\": 1,\n"
+      "  \"block\": \"TestNet\",\n"
+      "  \"inputs\": [{\"shape\": [1, 2], \"dtype\": \"float32\"}],\n"
+      "  \"param_order\": [\"l1.weight\", \"l1.bias\", \"l2.weight\",\n"
+      "                    \"bn.running_mean\", \"bn.running_var\"],\n"
+      "  \"deploy_graph\": [\n"
+      "    {\"op\": \"dense\", \"weight\": \"l1.weight\", "
+      "\"bias\": \"l1.bias\", \"flatten\": 1, \"activation\": \"relu\"},\n"
+      "    {\"op\": \"dense\", \"weight\": \"l2.weight\", "
+      "\"bias\": null, \"flatten\": 0, \"activation\": null},\n"
+      "    {\"op\": \"softmax\"}\n  ]\n}\n";
+
+  SymbolHandle sym;
+  CHECK(MXSymbolCreateFromJSON(json, &sym) == 0);
+
+  int n = 0;
+  const char **names = nullptr;
+  CHECK(MXSymbolListArguments(sym, &n, &names) == 0);
+  CHECK(n == 3);
+  CHECK(std::strcmp(names[0], "l1.weight") == 0);
+  CHECK(std::strcmp(names[1], "l1.bias") == 0);
+  CHECK(std::strcmp(names[2], "l2.weight") == 0);
+  CHECK(MXSymbolListAuxiliaryStates(sym, &n, &names) == 0);
+  CHECK(n == 2);
+  CHECK(std::strcmp(names[0], "bn.running_mean") == 0);
+  CHECK(std::strcmp(names[1], "bn.running_var") == 0);
+  CHECK(MXSymbolListOutputs(sym, &n, &names) == 0);
+  CHECK(n == 1);
+  CHECK(std::strcmp(names[0], "testnet_output") == 0);
+  CHECK(MXSymbolListDeployOps(sym, &n, &names) == 0);
+  CHECK(n == 3);
+  CHECK(std::strcmp(names[0], "dense") == 0);
+  CHECK(std::strcmp(names[2], "softmax") == 0);
+
+  const char *attr = nullptr;
+  CHECK(MXSymbolGetAttr(sym, "framework", &attr) == 0);
+  CHECK(attr != nullptr && std::strcmp(attr, "mxnet_tpu") == 0);
+  CHECK(MXSymbolGetAttr(sym, "format_version", &attr) == 0);
+  CHECK(attr != nullptr && std::strcmp(attr, "1") == 0);
+  CHECK(MXSymbolGetAttr(sym, "no_such_key", &attr) == 0);
+  CHECK(attr == nullptr);
+
+  CHECK(MXSymbolGetNumInputs(sym, &n) == 0);
+  CHECK(n == 1);
+  int nd = 0;
+  const int64_t *shape = nullptr;
+  const char *dtype = nullptr;
+  CHECK(MXSymbolGetInputShape(sym, 0, &nd, &shape, &dtype) == 0);
+  CHECK(nd == 2 && shape[0] == 1 && shape[1] == 2);
+  CHECK(std::strcmp(dtype, "float32") == 0);
+  CHECK(MXSymbolGetInputShape(sym, 1, &nd, &shape, &dtype) != 0);
+
+  /* json round-trip: save, re-create, same argument list */
+  char *text = nullptr;
+  CHECK(MXSymbolSaveToJSON(sym, &text) == 0);
+  SymbolHandle sym2;
+  CHECK(MXSymbolCreateFromJSON(text, &sym2) == 0);
+  CHECK(MXFreeString(text) == 0);
+  CHECK(MXSymbolListArguments(sym2, &n, &names) == 0);
+  CHECK(n == 3 && std::strcmp(names[2], "l2.weight") == 0);
+  CHECK(MXSymbolFree(sym2) == 0);
+
+  /* predictor from symbol: same weights as test_predict_mlp (the aux
+   * names in param_order are absent from the graph, so the .params file
+   * does not need them) */
+  const char *pp = "/tmp/mxtpu_sym_test.params";
+  float w1[6] = {1, 0, 0, 1, 1, -1};
+  float b1[3] = {0, 0, 0.5f};
+  float w2[6] = {1, 0, 1, 0, 1, -1};
+  int64_t s_w1[2] = {3, 2}, s_b1[1] = {3}, s_w2[2] = {2, 3};
+  NDArrayHandle hw1, hb1, hw2;
+  CHECK(MXNDArrayCreate(s_w1, 2, 0, &hw1) == 0);
+  CHECK(MXNDArrayCreate(s_b1, 1, 0, &hb1) == 0);
+  CHECK(MXNDArrayCreate(s_w2, 2, 0, &hw2) == 0);
+  CHECK(MXNDArraySyncCopyFromCPU(hw1, w1, sizeof(w1)) == 0);
+  CHECK(MXNDArraySyncCopyFromCPU(hb1, b1, sizeof(b1)) == 0);
+  CHECK(MXNDArraySyncCopyFromCPU(hw2, w2, sizeof(w2)) == 0);
+  NDArrayHandle hs[3] = {hw1, hb1, hw2};
+  const char *nm[3] = {"l1.weight", "l1.bias", "l2.weight"};
+  CHECK(MXNDArraySave(pp, 3, hs, nm) == 0);
+
+  PredictorHandle pred;
+  int64_t in_shape[2] = {1, 2};
+  CHECK(MXPredCreateFromSymbol(sym, pp, in_shape, 2, &pred) == 0);
+  float x[2] = {2.0f, 1.0f};
+  CHECK(MXPredSetInput(pred, x, 2) == 0);
+  CHECK(MXPredForward(pred) == 0);
+  float out[2];
+  CHECK(MXPredGetOutput(pred, out, 2) == 0);
+  float e0 = std::exp(3.5f), e1 = std::exp(-0.5f);
+  CHECK(std::fabs(out[0] - e0 / (e0 + e1)) < 1e-5f);
+  CHECK(MXPredFree(pred) == 0);
+  CHECK(MXSymbolFree(sym) == 0);
+  CHECK(MXNDArrayFree(hw1) == 0);
+  CHECK(MXNDArrayFree(hb1) == 0);
+  CHECK(MXNDArrayFree(hw2) == 0);
+  std::remove(pp);
+  std::puts("symbol_api OK");
+}
+
 int main() {
   test_engine_dag_matches_serial();
   test_engine_writer_serialization();
@@ -365,6 +473,7 @@ int main() {
   test_ndarray_create_invoke();
   test_ndarray_params_roundtrip();
   test_predict_mlp();
+  test_symbol_api();
   std::puts("ALL C++ TESTS PASSED");
   return 0;
 }

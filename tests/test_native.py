@@ -521,3 +521,118 @@ def test_c_predict_unmappable_model_reports(tmp_path):
                         ctypes.byref(h))
     assert rc != 0
     assert b"deploy_graph" in L.MXGetLastError()
+
+
+# ---------------------------------------------------------------------------
+# C symbol API (reference c_api_symbolic.cc)
+# ---------------------------------------------------------------------------
+
+def test_c_symbol_api_on_exported_model(tmp_path):
+    """MXSymbolCreateFromFile on a real export(): arguments match the
+    Python param names (BN running stats split off as auxiliary states),
+    attrs/inputs are readable, the json round-trips, and a predictor
+    built from the symbol handle matches the Python forward."""
+    import ctypes
+    import numpy as onp
+    import jax
+    jax.config.update("jax_platforms", "cpu")
+    import mxnet_tpu as mx
+    from mxnet_tpu.gluon import nn
+
+    mx.random.seed(0)
+    net = nn.HybridSequential()
+    net.add(nn.Conv2D(8, kernel_size=3, padding=1, in_channels=1,
+                      activation="relu"),
+            nn.BatchNorm(in_channels=8),
+            nn.MaxPool2D(pool_size=2),
+            nn.Flatten(),
+            nn.Dense(10, in_units=8 * 14 * 14))
+    net.initialize()
+    net.hybridize()
+    x = onp.random.RandomState(3).normal(size=(2, 1, 28, 28)).astype(
+        "float32")
+    ref = net(mx.np.array(x)).asnumpy()
+    sym_file, param_file = net.export(str(tmp_path / "lenet"))
+
+    L = _native.LIB
+    h = ctypes.c_void_p()
+    _native.check_call(L.MXSymbolCreateFromFile(sym_file.encode(),
+                                                ctypes.byref(h)))
+    try:
+        n = ctypes.c_int()
+        names = ctypes.POINTER(ctypes.c_char_p)()
+        _native.check_call(L.MXSymbolListArguments(
+            h, ctypes.byref(n), ctypes.byref(names)))
+        args = {names[i].decode() for i in range(n.value)}
+        _native.check_call(L.MXSymbolListAuxiliaryStates(
+            h, ctypes.byref(n), ctypes.byref(names)))
+        aux = {names[i].decode() for i in range(n.value)}
+
+        py_params = set(net.collect_params().keys())
+        # aux = untrained state: BN running stats + the stat_shift buffer
+        py_aux = {k for k in py_params
+                  if "running_" in k or "stat_shift" in k}
+        assert args == py_params - py_aux
+        assert aux == py_aux and len(aux) == 3
+
+        _native.check_call(L.MXSymbolListOutputs(
+            h, ctypes.byref(n), ctypes.byref(names)))
+        assert n.value == 1
+        assert names[0].decode().endswith("_output")
+
+        _native.check_call(L.MXSymbolListDeployOps(
+            h, ctypes.byref(n), ctypes.byref(names)))
+        ops = [names[i].decode() for i in range(n.value)]
+        assert ops == ["conv2d", "batchnorm", "maxpool2d", "flatten",
+                       "dense"]
+
+        attr = ctypes.c_char_p()
+        _native.check_call(L.MXSymbolGetAttr(
+            h, b"framework", ctypes.byref(attr)))
+        assert attr.value == b"mxnet_tpu"
+        _native.check_call(L.MXSymbolGetAttr(
+            h, b"absent", ctypes.byref(attr)))
+        assert attr.value is None
+
+        _native.check_call(L.MXSymbolGetNumInputs(h, ctypes.byref(n)))
+        assert n.value == 1
+        nd = ctypes.c_int()
+        sp = ctypes.POINTER(ctypes.c_int64)()
+        dt = ctypes.c_char_p()
+        _native.check_call(L.MXSymbolGetInputShape(
+            h, 0, ctypes.byref(nd), ctypes.byref(sp), ctypes.byref(dt)))
+        assert tuple(sp[i] for i in range(nd.value)) == (2, 1, 28, 28)
+        assert dt.value == b"float32"
+
+        # round-trip: SaveToJSON → CreateFromJSON sees the same args
+        text = ctypes.c_char_p()
+        _native.check_call(L.MXSymbolSaveToJSON(h, ctypes.byref(text)))
+        h2 = ctypes.c_void_p()
+        _native.check_call(L.MXSymbolCreateFromJSON(text.value,
+                                                    ctypes.byref(h2)))
+        _native.check_call(L.MXFreeString(text))
+        _native.check_call(L.MXSymbolListArguments(
+            h2, ctypes.byref(n), ctypes.byref(names)))
+        assert {names[i].decode() for i in range(n.value)} == args
+        _native.check_call(L.MXSymbolFree(h2))
+
+        # predictor from the symbol handle matches Python
+        ph = ctypes.c_void_p()
+        shape = (ctypes.c_int64 * 4)(*x.shape)
+        _native.check_call(L.MXPredCreateFromSymbol(
+            h, param_file.encode(), shape, 4, ctypes.byref(ph)))
+        try:
+            flat = onp.ascontiguousarray(x).ravel()
+            _native.check_call(L.MXPredSetInput(
+                ph, flat.ctypes.data_as(ctypes.POINTER(ctypes.c_float)),
+                ctypes.c_uint64(flat.size)))
+            _native.check_call(L.MXPredForward(ph))
+            out = onp.empty(ref.shape, onp.float32)
+            _native.check_call(L.MXPredGetOutput(
+                ph, out.ctypes.data_as(ctypes.POINTER(ctypes.c_float)),
+                ctypes.c_uint64(out.size)))
+            onp.testing.assert_allclose(out, ref, rtol=1e-4, atol=1e-4)
+        finally:
+            L.MXPredFree(ph)
+    finally:
+        L.MXSymbolFree(h)
