@@ -59,6 +59,7 @@ class Trainer:
 
         self._states: Dict[int, Any] = {}
         self._kvstore_arg = kvstore
+        self._compression_params = compression_params
         self._kvstore = None
         self._kv_initialized = False
         self._scale = 1.0
@@ -72,6 +73,8 @@ class Trainer:
             self._kvstore = kvs.create(self._kvstore_arg)
         else:
             self._kvstore = self._kvstore_arg
+        if self._kvstore is not None and self._compression_params:
+            self._kvstore.set_gradient_compression(self._compression_params)
         self._kv_initialized = True
 
     @property

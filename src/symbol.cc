@@ -41,10 +41,13 @@ using mxtpu::ReadFile;
 bool IsAuxName(const std::string &name) {
   /* reference: aux_states = BN moving statistics (ndarray.h kAuxArg);
    * stat_shift is this framework's extra BN stability buffer — untrained
-   * state, same class */
-  return name.find("running_mean") != std::string::npos ||
-         name.find("running_var") != std::string::npos ||
-         name.find("stat_shift") != std::string::npos;
+   * state, same class. Match the final dot-separated segment exactly so
+   * a user layer merely NAMED e.g. "running_mean_head" keeps its weights
+   * in the argument list. */
+  size_t dot = name.rfind('.');
+  std::string last = dot == std::string::npos ? name : name.substr(dot + 1);
+  return last == "running_mean" || last == "running_var" ||
+         last == "stat_shift";
 }
 
 struct Symbol {

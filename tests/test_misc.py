@@ -275,6 +275,72 @@ def test_kvstore_bf16_compression_roundtrip():
                                 rtol=1e-2)
 
 
+def test_kvstore_int8_compression_blockwise():
+    """int8 blockwise compression (EQuARX-style quantized collective,
+    SURVEY 5.8): local push round-trips within the blockwise 1/127
+    relative error."""
+    import numpy as onp
+    import mxnet_tpu as mx
+    kv = mx.kv.create("device")
+    kv.set_gradient_compression({"type": "int8"})
+    kv.init("w", mx.np.zeros((300,)))
+    g = onp.random.RandomState(0).normal(0, 3, 300).astype("float32")
+    kv.push("w", mx.np.array(g))
+    got = kv.pull("w").asnumpy()
+    # per-block error bound: amax/127 for that block
+    blocks = onp.pad(g, (0, (-len(g)) % 256)).reshape(-1, 256)
+    bound = onp.abs(blocks).max(axis=1) / 127 + 1e-7
+    err = onp.abs(onp.pad(got - g, (0, (-len(g)) % 256)).reshape(-1, 256))
+    assert (err <= bound[:, None] + 1e-6).all()
+
+
+def test_trainer_compression_params_reach_kvstore():
+    """gluon.Trainer(compression_params=...) configures the kvstore codec
+    (reference trainer.py passes it through to kvstore)."""
+    import mxnet_tpu as mx
+    net = mx.gluon.nn.Dense(2, in_units=3)
+    net.initialize()
+    tr = mx.gluon.Trainer(net.collect_params(), "sgd",
+                          {"learning_rate": 0.1}, kvstore="device",
+                          compression_params={"type": "2bit",
+                                              "threshold": 1.0})
+    tr._init_kvstore()
+    assert tr._kvstore._compression["type"] == "2bit"
+    with mx.autograd.record():
+        loss = net(mx.np.ones((2, 3))).sum()
+    loss.backward()
+    tr.step(2)   # compressed path executes without error
+
+
+def test_gradient_codec_roundtrips():
+    """The packed codecs behind the ICI compressed collectives: 2-bit
+    pack/unpack is exact on its code points; int8 blockwise stays within
+    scale/2 per element; packed payloads really are smaller."""
+    import numpy as onp
+    import jax.numpy as jnp
+    from mxnet_tpu.kvstore import (_quantize_2bit, _dequantize_2bit,
+                                   _quantize_int8, _dequantize_int8,
+                                   _INT8_BLOCK)
+    rng = onp.random.RandomState(1)
+    v = jnp.asarray(rng.normal(0, 1, 1003).astype("float32"))
+    thr = 0.5
+    packed, deq = _quantize_2bit(v, thr)
+    assert packed.dtype == jnp.uint8 and packed.size == (1003 + 3) // 4
+    # dequantized values are exactly the code points
+    assert set(onp.unique(onp.asarray(deq))) <= {-thr, 0.0, thr}
+    # unpack(pack(x)) == quantize(x)
+    onp.testing.assert_array_equal(
+        onp.asarray(_dequantize_2bit(packed, 1003, thr)), onp.asarray(deq))
+
+    codes, scales, n = _quantize_int8(v)
+    assert codes.dtype == jnp.int8 and n == 1003
+    assert scales.shape[0] == (1003 + _INT8_BLOCK - 1) // _INT8_BLOCK
+    back = onp.asarray(_dequantize_int8(codes, scales, n))
+    err = onp.abs(back - onp.asarray(v))
+    per_block_scale = onp.asarray(scales).repeat(_INT8_BLOCK)[:1003]
+    assert (err <= per_block_scale / 2 + 1e-7).all()
+
+
 def test_up_sampling_and_roi_pooling():
     import numpy as onp
     import mxnet_tpu as mx
