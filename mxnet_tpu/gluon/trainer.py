@@ -60,6 +60,8 @@ class Trainer:
         self._states: Dict[int, Any] = {}
         self._kvstore_arg = kvstore
         self._compression_params = compression_params
+        self._update_on_kvstore_arg = update_on_kvstore
+        self._update_on_kvstore = False
         self._kvstore = None
         self._kv_initialized = False
         self._scale = 1.0
@@ -75,6 +77,30 @@ class Trainer:
             self._kvstore = self._kvstore_arg
         if self._kvstore is not None and self._compression_params:
             self._kvstore.set_gradient_compression(self._compression_params)
+        # update_on_kvstore (reference trainer.py decision): explicit
+        # argument wins; default True only for the async parameter
+        # service, whose whole point is server-side updates. The store
+        # then owns weights AND optimizer — ship both.
+        if self._kvstore is not None:
+            auto = getattr(self._kvstore, "type", "") == "dist_async"
+            self._update_on_kvstore = (auto
+                                       if self._update_on_kvstore_arg is None
+                                       else bool(self._update_on_kvstore_arg))
+        if self._update_on_kvstore:
+            # For a SHARED remote store (dist_async: one server-side copy)
+            # rank 0 alone seeds weights and ships the optimizer, THEN
+            # everyone crosses the barrier — a later init would race and a
+            # later set_optimizer would reset server momentum. Per-process
+            # stores (local/device/ici) hold per-rank state: every rank
+            # must seed its own copy and updater.
+            shared = getattr(self._kvstore, "type", "") == "dist_async"
+            if not shared or getattr(self._kvstore, "rank", 0) == 0:
+                for i, p in enumerate(self._params):
+                    if p.grad_req != "null" and p.is_initialized:
+                        self._kvstore.init(i, p.data())
+                self._kvstore.set_optimizer(self._optimizer)
+            if shared and hasattr(self._kvstore, "barrier"):
+                self._kvstore.barrier()
         self._kv_initialized = True
 
     @property
@@ -89,7 +115,7 @@ class Trainer:
         self._optimizer.set_learning_rate(lr)
 
     # -- core step ----------------------------------------------------------
-    def allreduce_grads(self) -> None:
+    def allreduce_grads(self, ignore_stale_grad: bool = False) -> None:
         """Sum gradients across data-parallel workers (kvstore push+pull).
 
         With a sharded SPMD train step this is a no-op: the psum is inside
@@ -103,6 +129,19 @@ class Trainer:
         for i, p in enumerate(self._params):
             if p.grad_req != "null" and p.is_initialized:
                 g = p.data().grad
+                if self._update_on_kvstore and \
+                        not p.data()._fresh_grad:
+                    # same stale-grad contract as the local _update path:
+                    # never push (and server-apply) a gradient backward
+                    # did not refresh this step
+                    if ignore_stale_grad:
+                        continue
+                    from ..base import MXNetError
+                    raise MXNetError(
+                        f"Gradient of Parameter '{p.name}' has not been "
+                        "updated by backward since the last step — wrap "
+                        "the forward in autograd.record() or pass "
+                        "ignore_stale_grad=True")
                 if getattr(g, "stype", "default") == "row_sparse":
                     # row-sparse grads skip the dense allreduce round-trip;
                     # multi-worker aggregation uses row_sparse_pull
@@ -114,17 +153,42 @@ class Trainer:
             # one batched push: KVStoreICI fuses the small gradients into
             # bucket collectives instead of one collective per parameter
             self._kvstore.push(keys, grads)
-            self._kvstore.pull(keys, out=grads)
+            if self._update_on_kvstore:
+                # the store applied the optimizer — pull WEIGHTS back and
+                # mark grads consumed; _update is skipped
+                ws = [self._params[i].data() for i in keys]
+                self._kvstore.pull(keys, out=ws)
+                for i in keys:
+                    self._params[i].data()._fresh_grad = False
+            else:
+                self._kvstore.pull(keys, out=grads)
 
     def step(self, batch_size: int, ignore_stale_grad: bool = False) -> None:
         """Rescale grads by 1/batch_size and apply one optimizer update."""
         self._optimizer.rescale_grad = self._scale / batch_size
-        self.allreduce_grads()
-        self._update(ignore_stale_grad)
+        if not self._kv_initialized:
+            self._init_kvstore()
+        if self._update_on_kvstore and \
+                hasattr(self._kvstore, "update_optimizer_params"):
+            # live hyperparams (lr schedule, loss-scale rescale, wd) must
+            # reach the server-side optimizer without resetting its state
+            self._kvstore.update_optimizer_params({
+                "learning_rate": float(self._optimizer.learning_rate),
+                "rescale_grad": float(self._optimizer.rescale_grad),
+                "wd": float(self._optimizer.wd)})
+        self.allreduce_grads(ignore_stale_grad)
+        if not self._update_on_kvstore:
+            self._update(ignore_stale_grad)
 
     def update(self, batch_size: int, ignore_stale_grad: bool = False) -> None:
         """Apply the optimizer without gradient reduction (caller already
         reduced, e.g. Horovod-style)."""
+        if not self._kv_initialized:
+            self._init_kvstore()
+        if self._update_on_kvstore:
+            raise MXNetError(
+                "update() cannot be used when updates run on the kvstore "
+                "(update_on_kvstore=True) — use step()")
         self._optimizer.rescale_grad = self._scale / batch_size
         self._update(ignore_stale_grad)
 
@@ -253,6 +317,12 @@ class Trainer:
 
     # -- exact resume (reference: Trainer.save_states/load_states) ----------
     def save_states(self, fname: str) -> None:
+        if self._update_on_kvstore and self._kvstore is not None:
+            if hasattr(self._kvstore, "save_optimizer_states"):
+                # states live in the store (server-side for dist_async) —
+                # the reference delegates in exactly this mode
+                self._kvstore.save_optimizer_states(fname)
+                return
         import numpy as _np
         import jax
         payload = {
@@ -267,6 +337,10 @@ class Trainer:
             pickle.dump(payload, f)
 
     def load_states(self, fname: str) -> None:
+        if self._update_on_kvstore and self._kvstore is not None:
+            if hasattr(self._kvstore, "load_optimizer_states"):
+                self._kvstore.load_optimizer_states(fname)
+                return
         import jax.numpy as jnp
         import jax
         import numpy as _np

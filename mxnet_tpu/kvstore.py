@@ -16,8 +16,9 @@ collapses into SPMD collectives:
   multi-host SPMD over a ``jax.distributed``-initialized pod: push performs
   ``jax.lax.psum`` of gradients over the global mesh's data axis via a tiny
   jitted allreduce program; rank/num_workers map to process index/count.
-* ``'dist_async'`` — no ICI analog (reference used param-server staleness);
-  raises with guidance, per SURVEY.md 5.8.
+* ``'dist_async'`` — the host-driven parameter service (SURVEY.md 5.8):
+  TCP servers started by ``tools/launch.py -s S`` apply the optimizer
+  immediately per worker push (Hogwild). See ``kvstore_async.py``.
 """
 from __future__ import annotations
 
@@ -37,6 +38,10 @@ register_env("MXNET_KVSTORE_BIGARRAY_BOUND", 1000000,
              "fused cross-process collective per bucket (the reference "
              "sliced big arrays across servers at this bound; here it "
              "bounds the fusion buffer), larger arrays reduce alone.")
+
+register_env("MXNET_PS_BARRIER_TIMEOUT", 600,
+             "Seconds a parameter-server barrier waits for all workers "
+             "before raising (kvstore='dist_async').")
 
 
 # ---------------------------------------------------------------------------
@@ -564,8 +569,17 @@ def create(name: str = "local") -> KVStore:
                 "dist_sync_device", "horovod"):
         return KVStoreICI(name)
     if name == "dist_async":
-        raise MXNetError(
-            "kvstore='dist_async' has no TPU analog: ICI collectives are "
-            "synchronous by construction. Use 'ici' (sync data parallel) "
-            "or implement a host-side DCN parameter service")
+        # the host-driven DCN parameter service (SURVEY.md 5.8): workers
+        # push/pull over TCP to server processes that apply the optimizer
+        # immediately per push. Requires the launcher's env contract.
+        import os as _os
+        if int(_os.environ.get("DMLC_NUM_SERVER", "0") or 0) < 1:
+            raise MXNetError(
+                "kvstore='dist_async' is the host-side parameter service "
+                "— launch the job with server processes, e.g. "
+                "`python tools/launch.py -n 2 -s 1 python train.py` "
+                "(ICI collectives themselves are synchronous by "
+                "construction; use 'ici' for sync data parallel)")
+        from .kvstore_async import KVStoreDistAsync
+        return KVStoreDistAsync()
     raise MXNetError(f"unknown kvstore type {name!r}")

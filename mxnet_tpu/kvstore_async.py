@@ -1,0 +1,617 @@
+"""Async parameter service — ``kvstore='dist_async'``.
+
+Reference parity (leezu/mxnet): ``kvstore_dist.h`` async branch +
+``kvstore_dist_server.h`` (``KVStoreDistServer::DataHandleDefault``) over
+ps-lite — workers push gradients and pull weights at their own pace; the
+server applies the optimizer IMMEDIATELY per push (Hogwild-style, no
+worker synchronization), which tolerates slow workers by design.
+
+Design (tpu-first, SURVEY.md 2.3/5.8): ICI collectives have no async
+analog, so this is the prescribed "host-driven DCN parameter service" —
+plain TCP between host processes (the reference's ZMQ van), weights and
+optimizer state live host-side in the server process, device work stays
+on each worker. The wire protocol is a length-prefixed binary frame
+(json header + raw array bytes) — no pickle, so a malicious peer cannot
+execute code in the server; ``set_optimizer`` ships (name, scalar
+hyperparams) and the server instantiates from the optimizer registry
+(the reference pickled the optimizer object to the server — same
+capability, safer encoding).
+
+Roles follow the reference env contract: ``tools/launch.py -s S`` starts
+``S`` server processes (``DMLC_ROLE=server``, this module's ``main``)
+and points workers at them via ``DMLC_PS_ROOT_URI`` /
+``DMLC_PS_ROOT_PORT`` / ``DMLC_NUM_SERVER``. With S > 1, keys are
+assigned whole to servers by stable hash (the reference sliced single
+big arrays across servers — PSKV; whole-key assignment keeps each
+update atomic on one server).
+"""
+from __future__ import annotations
+
+import json
+import os
+import socket
+import socketserver
+import struct
+import threading
+import time
+from typing import Any, Dict, List, Optional, Sequence
+
+import numpy as onp
+
+from .base import MXNetError
+
+__all__ = ["PSServer", "KVStoreDistAsync", "run_server"]
+
+_MAGIC = b"MXPS"
+
+
+# ---------------------------------------------------------------------------
+# framing: MXPS | uint32 body_len | cmd(1) | uint32 hdr_len | hdr json | raw
+# ---------------------------------------------------------------------------
+
+def _send_frame(sock: socket.socket, cmd: bytes, header: Dict[str, Any],
+                payload: bytes = b"") -> None:
+    hdr = json.dumps(header).encode()
+    body = cmd + struct.pack("<I", len(hdr)) + hdr + payload
+    sock.sendall(_MAGIC + struct.pack("<I", len(body)) + body)
+
+
+def _recv_exact(sock: socket.socket, n: int) -> bytes:
+    buf = bytearray()
+    while len(buf) < n:
+        chunk = sock.recv(n - len(buf))
+        if not chunk:
+            raise ConnectionError("peer closed")
+        buf.extend(chunk)
+    return bytes(buf)
+
+
+def _recv_frame(sock: socket.socket):
+    magic = _recv_exact(sock, 4)
+    if magic != _MAGIC:
+        raise MXNetError("bad frame magic (not an mxnet_tpu PS peer)")
+    (blen,) = struct.unpack("<I", _recv_exact(sock, 4))
+    body = _recv_exact(sock, blen)
+    cmd = body[0:1]
+    (hlen,) = struct.unpack("<I", body[1:5])
+    header = json.loads(body[5:5 + hlen].decode())
+    payload = body[5 + hlen:]
+    return cmd, header, payload
+
+
+def _arr_payload(a: onp.ndarray):
+    a = onp.ascontiguousarray(a)
+    return ({"dtype": str(a.dtype), "shape": list(a.shape)}, a.tobytes())
+
+
+def _payload_arr(header: Dict[str, Any], payload: bytes) -> onp.ndarray:
+    return onp.frombuffer(payload, dtype=header["dtype"]).reshape(
+        header["shape"]).copy()
+
+
+# ---------------------------------------------------------------------------
+# optimizer-state codec: a restricted, pickle-free structural encoding for
+# shipping Updater.states over the wire (arrays ride the payload; the
+# structure is JSON). Covers everything our optimizers produce: nested
+# tuples/lists/dicts, numbers, None, arrays, MasterWeightState.
+# ---------------------------------------------------------------------------
+
+def _enc_state(s, leaves: List[onp.ndarray]):
+    from .optimizer import MasterWeightState
+    if s is None:
+        return {"t": "none"}
+    if isinstance(s, bool):
+        return {"t": "bool", "v": s}
+    if isinstance(s, (int, float)):
+        return {"t": "num", "v": s}
+    if isinstance(s, MasterWeightState):
+        return {"t": "mws", "m": _enc_state(s.master, leaves),
+                "s": _enc_state(s.inner, leaves)}
+    if isinstance(s, tuple):
+        return {"t": "tup", "v": [_enc_state(x, leaves) for x in s]}
+    if isinstance(s, list):
+        return {"t": "list", "v": [_enc_state(x, leaves) for x in s]}
+    if isinstance(s, dict):
+        return {"t": "dict",
+                "v": {str(k): _enc_state(x, leaves)
+                      for k, x in s.items()}}
+    a = onp.asarray(getattr(s, "_data", s))
+    leaves.append(onp.ascontiguousarray(a))
+    return {"t": "arr", "i": len(leaves) - 1,
+            "dtype": str(a.dtype), "shape": list(a.shape)}
+
+
+def _dec_state(obj, leaves: Sequence[onp.ndarray]):
+    from .optimizer import MasterWeightState
+    t = obj["t"]
+    if t == "none":
+        return None
+    if t in ("bool", "num"):
+        return obj["v"]
+    if t == "mws":
+        return MasterWeightState(_dec_state(obj["m"], leaves),
+                                 _dec_state(obj["s"], leaves))
+    if t == "tup":
+        return tuple(_dec_state(x, leaves) for x in obj["v"])
+    if t == "list":
+        return [_dec_state(x, leaves) for x in obj["v"]]
+    if t == "dict":
+        return {k: _dec_state(x, leaves) for k, x in obj["v"].items()}
+    if t == "arr":
+        return leaves[obj["i"]]
+    raise MXNetError(f"bad state encoding tag {t!r}")
+
+
+def _pack_leaves(leaves: Sequence[onp.ndarray]):
+    specs = [{"dtype": str(a.dtype), "shape": list(a.shape),
+              "nbytes": a.nbytes} for a in leaves]
+    return specs, b"".join(a.tobytes() for a in leaves)
+
+
+def _unpack_leaves(specs, payload: bytes) -> List[onp.ndarray]:
+    out, off = [], 0
+    for sp in specs:
+        n = sp["nbytes"]
+        out.append(onp.frombuffer(payload[off:off + n],
+                                  dtype=sp["dtype"]).reshape(sp["shape"])
+                   .copy())
+        off += n
+    return out
+
+
+# ---------------------------------------------------------------------------
+# server
+# ---------------------------------------------------------------------------
+
+class _Handler(socketserver.BaseRequestHandler):
+    def handle(self) -> None:
+        srv: "PSServer" = self.server.ps          # type: ignore[attr-defined]
+        try:
+            while True:
+                cmd, header, payload = _recv_frame(self.request)
+                if cmd == b"S":
+                    _send_frame(self.request, b"K", {})
+                    threading.Thread(target=self.server.shutdown,
+                                     daemon=True).start()
+                    return
+                try:
+                    reply = srv.handle(cmd, header, payload)
+                except Exception as e:   # report, keep the connection
+                    reply = (b"E", {"error": str(e)}, b"")
+                _send_frame(self.request, *reply)
+        except (ConnectionError, OSError):
+            return
+
+
+class _TCPServer(socketserver.ThreadingTCPServer):
+    allow_reuse_address = True
+    daemon_threads = True
+
+
+class PSServer:
+    """In-process parameter server state + request handler
+    (``KVStoreDistServer`` analog)."""
+
+    def __init__(self, num_workers: int) -> None:
+        self.num_workers = num_workers
+        self.store: Dict[str, onp.ndarray] = {}
+        self.locks: Dict[str, threading.Lock] = {}
+        self.updater = None                      # optimizer.Updater
+        self._global_lock = threading.Lock()
+        self._barrier_lock = threading.Lock()
+        self._barrier_cv = threading.Condition(self._barrier_lock)
+        self._barrier_count = 0
+        self._barrier_gen = 0
+        self.pushes = 0
+
+    def _lock_for(self, key: str) -> threading.Lock:
+        with self._global_lock:
+            if key not in self.locks:
+                self.locks[key] = threading.Lock()
+            return self.locks[key]
+
+    def handle(self, cmd: bytes, header: Dict[str, Any], payload: bytes):
+        if cmd == b"I":                          # init (first wins)
+            key = header["key"]
+            with self._lock_for(key):
+                if key not in self.store:
+                    self.store[key] = _payload_arr(header, payload)
+            return b"K", {}, b""
+        if cmd == b"P":                          # push
+            key = header["key"]
+            grad = _payload_arr(header, payload)
+            with self._lock_for(key):
+                if key not in self.store:
+                    raise MXNetError(f"push to uninitialized key {key!r}")
+                if self.updater is not None:
+                    # async mode proper: apply the optimizer NOW, per
+                    # worker push — no aggregation window (Hogwild)
+                    self._apply_update(key, grad)
+                else:
+                    # no server-side optimizer: running sum (the pulled
+                    # value is the sum of everything pushed since init)
+                    self.store[key] = self.store[key] + grad
+                self.pushes += 1
+            return b"K", {}, b""
+        if cmd == b"G":                          # pull
+            key = header["key"]
+            with self._lock_for(key):
+                if key not in self.store:
+                    raise MXNetError(f"pull of uninitialized key {key!r}")
+                hdr, raw = _arr_payload(self.store[key])
+            return b"V", hdr, raw
+        if cmd == b"p":                          # multi-key push
+            keys = header["keys"]
+            grads = _unpack_leaves(header["specs"], payload)
+            for key, grad in zip(keys, grads):
+                with self._lock_for(key):
+                    if key not in self.store:
+                        raise MXNetError(
+                            f"push to uninitialized key {key!r}")
+                    if self.updater is not None:
+                        self._apply_update(key, grad)
+                    else:
+                        self.store[key] = self.store[key] + grad
+                    self.pushes += 1
+            return b"K", {}, b""
+        if cmd == b"g":                          # multi-key pull
+            keys = header["keys"]
+            vals = []
+            for key in keys:
+                with self._lock_for(key):
+                    if key not in self.store:
+                        raise MXNetError(
+                            f"pull of uninitialized key {key!r}")
+                    vals.append(self.store[key])
+            specs, raw = _pack_leaves(vals)
+            return b"v", {"specs": specs}, raw
+        if cmd == b"H":                          # update live hyperparams
+            with self._global_lock:
+                if self.updater is None:
+                    raise MXNetError("no optimizer on this server")
+                o = self.updater.optimizer
+                for k, v in header.get("params", {}).items():
+                    if k == "learning_rate":
+                        o.lr = v
+                    elif hasattr(o, k) and isinstance(
+                            getattr(o, k), (int, float, bool, type(None))):
+                        setattr(o, k, v)
+            return b"K", {}, b""
+        if cmd == b"X":                          # fetch optimizer states
+            with self._global_lock:
+                if self.updater is None:
+                    return b"v", {"states": None, "specs": []}, b""
+                leaves: List[onp.ndarray] = []
+                enc = {str(k): _enc_state(s, leaves)
+                       for k, s in self.updater.states.items()}
+                specs, raw = _pack_leaves(leaves)
+            return b"v", {"states": enc, "specs": specs}, raw
+        if cmd == b"Y":                          # restore optimizer states
+            with self._global_lock:
+                if self.updater is None:
+                    raise MXNetError(
+                        "set_optimizer before loading states")
+                leaves = _unpack_leaves(header["specs"], payload)
+                self.updater.states = {
+                    k: _dec_state(obj, leaves)
+                    for k, obj in header["states"].items()}
+            return b"K", {}, b""
+        if cmd == b"O":                          # set_optimizer
+            from . import optimizer as opt
+            with self._global_lock:
+                o = opt.create(header["name"], **header.get("params", {}))
+                self.updater = opt.get_updater(o)
+            return b"K", {}, b""
+        if cmd == b"B":                          # barrier over all workers
+            timeout = float(os.environ.get(
+                "MXNET_PS_BARRIER_TIMEOUT", "600"))
+            with self._barrier_cv:
+                gen = self._barrier_gen
+                self._barrier_count += 1
+                if self._barrier_count >= self.num_workers:
+                    self._barrier_count = 0
+                    self._barrier_gen += 1
+                    self._barrier_cv.notify_all()
+                else:
+                    ok = self._barrier_cv.wait_for(
+                        lambda: self._barrier_gen != gen, timeout=timeout)
+                    if not ok:
+                        self._barrier_count -= 1
+                        raise MXNetError(
+                            f"barrier timed out after {timeout:.0f}s "
+                            f"waiting for {self.num_workers} workers "
+                            "(MXNET_PS_BARRIER_TIMEOUT to raise)")
+            return b"K", {}, b""
+        if cmd == b"Q":                          # stats (introspection)
+            return b"K", {"pushes": self.pushes,
+                          "keys": sorted(self.store)}, b""
+        raise MXNetError(f"unknown PS command {cmd!r}")
+
+    def _apply_update(self, key: str, grad: onp.ndarray) -> None:
+        from .ndarray.ndarray import NDArray
+        import jax.numpy as jnp
+        w = NDArray(jnp.asarray(self.store[key]), _wrap=True)
+        g = NDArray(jnp.asarray(grad), _wrap=True)
+        self.updater(key, g, w)                  # mutates w in place
+        self.store[key] = onp.asarray(w._data)
+
+
+def run_server(port: int, num_workers: int,
+               ready_event: Optional[threading.Event] = None) -> None:
+    """Serve until a STOP frame arrives (blocking)."""
+    ps = PSServer(num_workers)
+    with _TCPServer(("0.0.0.0", port), _Handler) as server:
+        server.ps = ps                           # type: ignore[attr-defined]
+        if ready_event is not None:
+            ready_event.set()
+        server.serve_forever(poll_interval=0.1)
+
+
+# ---------------------------------------------------------------------------
+# worker-side client
+# ---------------------------------------------------------------------------
+
+class KVStoreDistAsync:
+    """Worker-side ``kvstore='dist_async'`` client.
+
+    API-compatible subset of KVStore: init/push/pull/pushpull,
+    set_optimizer (ships to the servers), barrier, rank/num_workers.
+    Per-key requests go whole to ``hash(key) % num_servers``.
+    """
+
+    type = "dist_async"
+
+    def __init__(self) -> None:
+        self.uri = os.environ.get("DMLC_PS_ROOT_URI", "127.0.0.1")
+        self.port = int(os.environ.get("DMLC_PS_ROOT_PORT", "9876"))
+        self.num_servers = int(os.environ.get("DMLC_NUM_SERVER", "1"))
+        self._rank = int(os.environ.get("DMLC_WORKER_ID",
+                                        os.environ.get("JAX_PROCESS_ID",
+                                                       "0")))
+        self._num_workers = int(os.environ.get("DMLC_NUM_WORKER", "1"))
+        self._socks: List[Optional[socket.socket]] = \
+            [None] * self.num_servers
+        # one lock per server connection: requests to different servers
+        # may overlap; frames on one socket are serialized
+        self._locks = [threading.Lock() for _ in range(self.num_servers)]
+        self._shipped_params: Dict[str, Any] = {}
+
+    # -- plumbing ----------------------------------------------------------
+    def _sock(self, sidx: int) -> socket.socket:
+        s = self._socks[sidx]
+        if s is None:
+            deadline = time.time() + 30
+            last: Optional[Exception] = None
+            while time.time() < deadline:
+                try:
+                    s = socket.create_connection(
+                        (self.uri, self.port + sidx), timeout=30)
+                    s.setsockopt(socket.IPPROTO_TCP, socket.TCP_NODELAY, 1)
+                    self._socks[sidx] = s
+                    return s
+                except OSError as e:             # server still starting
+                    last = e
+                    time.sleep(0.2)
+            raise MXNetError(
+                f"cannot reach parameter server at "
+                f"{self.uri}:{self.port + sidx}: {last}")
+        return s
+
+    def _server_of(self, key: Any) -> int:
+        import zlib
+        return zlib.crc32(str(key).encode()) % self.num_servers
+
+    def _rpc_server(self, sidx: int, cmd: bytes, header: Dict[str, Any],
+                    payload: bytes = b""):
+        with self._locks[sidx]:
+            s = self._sock(sidx)
+            _send_frame(s, cmd, header, payload)
+            rcmd, rhdr, rpayload = _recv_frame(s)
+        if rcmd == b"E":
+            raise MXNetError(f"parameter server: {rhdr.get('error')}")
+        return rcmd, rhdr, rpayload
+
+    def _rpc(self, key: Any, cmd: bytes, header: Dict[str, Any],
+             payload: bytes = b""):
+        return self._rpc_server(self._server_of(key), cmd, header, payload)
+
+    @staticmethod
+    def _pair(key, value):
+        if isinstance(key, (list, tuple)):
+            vals = [None] * len(key) if value is None else list(value)
+            return list(key), vals
+        return [key], [value]
+
+    @staticmethod
+    def _to_numpy(v) -> onp.ndarray:
+        if isinstance(v, (list, tuple)):          # per-device list: local sum
+            acc = onp.asarray(v[0].asnumpy(), onp.float32)
+            for x in v[1:]:
+                acc = acc + onp.asarray(x.asnumpy(), onp.float32)
+            return acc
+        return onp.asarray(v.asnumpy())
+
+    # -- KVStore API -------------------------------------------------------
+    def init(self, key, value) -> None:
+        keys, vals = self._pair(key, value)
+        for k, v in zip(keys, vals):
+            if isinstance(v, (list, tuple)):
+                v = v[0]
+            hdr, raw = _arr_payload(onp.asarray(v.asnumpy()))
+            hdr["key"] = str(k)
+            self._rpc(k, b"I", hdr, raw)
+
+    def push(self, key, value, priority: int = 0) -> None:
+        keys, vals = self._pair(key, value)
+        if len(keys) == 1:
+            hdr, raw = _arr_payload(self._to_numpy(vals[0]))
+            hdr["key"] = str(keys[0])
+            self._rpc(keys[0], b"P", hdr, raw)
+            return
+        # group by server: the whole multi-key push crosses the wire as
+        # ONE frame per server (the ICI path's bucketing analog)
+        by_server: Dict[int, List[int]] = {}
+        for i, k in enumerate(keys):
+            by_server.setdefault(self._server_of(k), []).append(i)
+        for sidx, idxs in by_server.items():
+            arrs = [self._to_numpy(vals[i]) for i in idxs]
+            specs, raw = _pack_leaves(arrs)
+            self._rpc_server(sidx, b"p",
+                             {"keys": [str(keys[i]) for i in idxs],
+                              "specs": specs}, raw)
+
+    def pull(self, key, out=None, priority: int = 0,
+             ignore_sparse: bool = True):
+        from .ndarray.ops import array
+        keys, outs = self._pair(key, out)
+        arrays: List[Optional[onp.ndarray]] = [None] * len(keys)
+        if len(keys) == 1:
+            cmd, hdr, payload = self._rpc(keys[0], b"G",
+                                          {"key": str(keys[0])})
+            if cmd != b"V":
+                raise MXNetError(f"pull failed for key {keys[0]!r}")
+            arrays[0] = _payload_arr(hdr, payload)
+        else:
+            by_server: Dict[int, List[int]] = {}
+            for i, k in enumerate(keys):
+                by_server.setdefault(self._server_of(k), []).append(i)
+            for sidx, idxs in by_server.items():
+                cmd, hdr, payload = self._rpc_server(
+                    sidx, b"g", {"keys": [str(keys[i]) for i in idxs]})
+                if cmd != b"v":
+                    raise MXNetError("multi-pull failed")
+                for i, a in zip(idxs, _unpack_leaves(hdr["specs"],
+                                                     payload)):
+                    arrays[i] = a
+        results = []
+        for a, o in zip(arrays, outs):
+            nd = array(a)
+            if o is not None:
+                targets = o if isinstance(o, (list, tuple)) else [o]
+                for t in targets:
+                    t._data = nd._data
+            results.append(nd)
+        return results[0] if not isinstance(key, (list, tuple)) else results
+
+    def pushpull(self, key, value, out=None, priority: int = 0) -> None:
+        self.push(key, value, priority)
+        self.pull(key, out if out is not None else value, priority)
+
+    def set_optimizer(self, optimizer) -> None:
+        """Ship the optimizer to every server (reference: pickled via
+        ``_send_command_to_servers``; here name + scalar hyperparams)."""
+        from . import optimizer as opt
+        if isinstance(optimizer, str):
+            name, params = optimizer, {}
+        elif isinstance(optimizer, opt.Optimizer):
+            name = type(optimizer).__name__.lower()
+            params = {"learning_rate": optimizer.lr,
+                      "wd": optimizer.wd,
+                      "rescale_grad": optimizer.rescale_grad}
+            if optimizer.clip_gradient is not None:
+                params["clip_gradient"] = optimizer.clip_gradient
+            for attr, val in vars(optimizer).items():
+                if attr.startswith("_") or attr in (
+                        "lr", "wd", "rescale_grad", "clip_gradient",
+                        "num_update", "begin_num_update", "aggregate_num",
+                        "multi_precision", "param_dict", "lr_scheduler"):
+                    continue
+                if isinstance(val, (int, float, bool)):
+                    params[attr] = val
+        else:
+            raise MXNetError("set_optimizer expects a name or Optimizer")
+        for sidx in range(self.num_servers):
+            self._rpc_server(sidx, b"O", {"name": name, "params": params})
+        self._shipped_params = dict(params)
+
+    def update_optimizer_params(self, params: Dict[str, Any]) -> None:
+        """Push changed scalar hyperparams (lr, rescale_grad, wd, ...) to
+        the live server-side optimizer WITHOUT resetting its state —
+        how lr schedules and loss scaling reach the service."""
+        changed = {k: v for k, v in params.items()
+                   if self._shipped_params.get(k) != v}
+        if not changed:
+            return
+        for sidx in range(self.num_servers):
+            self._rpc_server(sidx, b"H", {"params": changed})
+        self._shipped_params.update(changed)
+
+    def save_optimizer_states(self, fname: str,
+                              dump_weight: bool = False) -> None:
+        """Fetch server-side Updater states and write the Trainer states
+        pickle format (reference: update_on_kvstore state saving)."""
+        import pickle
+        states: Dict[str, Any] = {}
+        for sidx in range(self.num_servers):
+            _, hdr, payload = self._rpc_server(sidx, b"X", {})
+            if hdr.get("states") is None:
+                continue
+            leaves = _unpack_leaves(hdr["specs"], payload)
+            for k, obj in hdr["states"].items():
+                states[k] = _dec_state(obj, leaves)
+        with open(fname, "wb") as f:
+            pickle.dump({"format": 2, "num_update": 0,
+                         "index_update_count": {},
+                         "states": states}, f)
+
+    def load_optimizer_states(self, fname: str) -> None:
+        import pickle
+        with open(fname, "rb") as f:
+            payload = pickle.load(f)
+        by_server: Dict[int, Dict[str, Any]] = {}
+        for k, s in payload["states"].items():
+            by_server.setdefault(self._server_of(k), {})[k] = s
+        for sidx, chunk in by_server.items():
+            leaves: List[onp.ndarray] = []
+            enc = {k: _enc_state(s, leaves) for k, s in chunk.items()}
+            specs, raw = _pack_leaves(leaves)
+            self._rpc_server(sidx, b"Y",
+                             {"states": enc, "specs": specs}, raw)
+
+    def set_gradient_compression(self, compression_params) -> None:
+        raise MXNetError(
+            "gradient compression is not supported on the async service "
+            "(error-feedback residuals are undefined under Hogwild "
+            "updates); use kvstore='ici' for compressed sync training")
+
+    def barrier(self) -> None:
+        for sidx in range(self.num_servers):
+            self._rpc_server(sidx, b"B", {})
+
+    def server_stats(self) -> List[Dict[str, Any]]:
+        return [self._rpc_server(sidx, b"Q", {})[1]
+                for sidx in range(self.num_servers)]
+
+    def stop_servers(self) -> None:
+        """Ask every server process to exit (rank 0, end of job)."""
+        for sidx in range(self.num_servers):
+            try:
+                self._rpc_server(sidx, b"S", {})
+            except (ConnectionError, OSError, MXNetError):
+                pass
+
+    @property
+    def rank(self) -> int:
+        return self._rank
+
+    @property
+    def num_workers(self) -> int:
+        return self._num_workers
+
+    def __repr__(self) -> str:
+        return (f"KVStoreDistAsync(servers={self.num_servers} @ "
+                f"{self.uri}:{self.port}, rank={self._rank}/"
+                f"{self._num_workers})")
+
+
+def main() -> None:
+    """Server-process entry (``DMLC_ROLE=server``):
+    ``python -m mxnet_tpu.kvstore_async``."""
+    port = int(os.environ.get("DMLC_PS_ROOT_PORT", "9876")) + \
+        int(os.environ.get("DMLC_SERVER_ID", "0"))
+    nw = int(os.environ.get("DMLC_NUM_WORKER", "1"))
+    run_server(port, nw)
+
+
+if __name__ == "__main__":
+    main()

@@ -85,6 +85,78 @@ def kvstore_main(out_dir: str, expect_nw: int = 2) -> None:
         f.write(" ".join(f"{v:.8f}" for v in list(w) + list(b)) + "\n")
 
 
+def async_main(out_dir: str) -> None:
+    """kvstore='dist_async' under the launcher (-n 2 -s 1): workers push
+    gradients at their own pace, the server applies sgd immediately per
+    push (Hogwild), weights converge on a shared quadratic despite
+    staleness. Reference: kvstore_dist_server.h async DataHandleDefault.
+    No jax.distributed here — async workers are independent processes."""
+    import time
+    import numpy as onp
+    import mxnet_tpu as mx
+
+    rank = int(os.environ["DMLC_WORKER_ID"])
+    kv = mx.kvstore.create("dist_async")
+    assert kv.num_workers == 2
+    target = onp.arange(6, dtype="float32").reshape(2, 3)
+
+    if rank == 0:
+        kv.init("w", mx.np.zeros((2, 3)))
+        kv.set_optimizer(mx.optimizer.create("sgd", learning_rate=0.1))
+    kv.barrier()                 # everyone waits for init + optimizer
+
+    rng = onp.random.RandomState(rank)
+    for step in range(60):
+        w = kv.pull("w").asnumpy()
+        grad = (w - target) + rng.normal(0, 0.01, w.shape).astype("f4")
+        kv.push("w", mx.np.array(grad))
+        if rank == 1:
+            time.sleep(0.002)    # a deliberately slower worker: async
+            #                      must tolerate it (no sync barrier)
+    kv.barrier()
+    final = kv.pull("w").asnumpy()
+    err = float(onp.abs(final - target).max())
+    stats = kv.server_stats()[0]
+    assert stats["pushes"] >= 120, stats   # both workers' pushes landed
+
+    # gluon.Trainer over the async service: update_on_kvstore engages
+    # automatically (weights + optimizer live server-side), each rank
+    # trains at its own pace on its own data
+    mx.random.seed(0)
+    net = mx.gluon.nn.Dense(2, in_units=3)
+    net.initialize()
+    net(mx.np.zeros((1, 3)))
+    tr = mx.gluon.Trainer(net.collect_params(), "sgd",
+                          {"learning_rate": 0.05}, kvstore="dist_async")
+    loss_fn = mx.gluon.loss.L2Loss()
+    rng2 = onp.random.RandomState(200 + rank)
+    W = onp.ones((3, 2), "float32")
+    first = last = None
+    for _ in range(30):
+        x = rng2.uniform(-1, 1, (4, 3)).astype("float32")
+        y = x @ W
+        with mx.autograd.record():
+            loss = loss_fn(net(mx.np.array(x)), mx.np.array(y))
+        loss.backward()
+        tr.step(4)
+        v = float(loss.asnumpy().mean())
+        first = v if first is None else first
+        last = v
+    assert tr._update_on_kvstore, "async store must update on kvstore"
+    assert last < first, (first, last)      # Hogwild still converges
+    kv.barrier()
+    # the server holds ONE weight copy: both ranks see identical params
+    tr_w = tr._kvstore.pull(0).asnumpy()
+
+    with open(os.path.join(out_dir, f"worker{rank}.txt"), "w") as f:
+        f.write(f"{err:.6f}\n")
+        f.write(f"{stats['pushes']}\n")
+        f.write(" ".join(f"{v:.8f}" for v in tr_w.ravel()) + "\n")
+    kv.barrier()
+    if rank == 0:
+        kv.stop_servers()
+
+
 def compress_main(out_dir: str) -> None:
     """Compressed ICI collectives (EQuARX-style, SURVEY 5.8): each codec
     reduces correctly across 2 processes, every rank gets the identical
@@ -204,6 +276,9 @@ def main() -> None:
         return
     if len(sys.argv) > 2 and sys.argv[2] == "compress":
         compress_main(out_dir)
+        return
+    if len(sys.argv) > 2 and sys.argv[2] == "async":
+        async_main(out_dir)
         return
     import mxnet_tpu as mx
     from mxnet_tpu import kvstore as kvs

@@ -159,9 +159,151 @@ def test_kvstore_local_push_pull():
     assert out.asnumpy().sum() == 8.0
 
 
-def test_kvstore_dist_async_guidance():
-    with pytest.raises(mx.MXNetError):
+def test_kvstore_dist_async_guidance(monkeypatch):
+    """Outside a launched job (no DMLC env) dist_async explains how to
+    start the parameter service instead of hanging on a connect."""
+    monkeypatch.delenv("DMLC_PS_ROOT_PORT", raising=False)
+    monkeypatch.delenv("DMLC_ROLE", raising=False)
+    with pytest.raises(mx.MXNetError, match="launch.py -n 2 -s 1"):
         mx.kvstore.create("dist_async")
+
+
+def test_kvstore_dist_async_service(monkeypatch):
+    """The host-side parameter service end-to-end in one process: a real
+    TCP server thread, a client created via mx.kv.create('dist_async') —
+    init / running-sum push / pull, server-side optimizer updates applied
+    per push (Hogwild), barrier, stats, stop."""
+    import socket
+    import threading
+    import numpy as onp
+    from mxnet_tpu import kvstore_async as ka
+
+    s = socket.socket(); s.bind(("127.0.0.1", 0))
+    port = s.getsockname()[1]; s.close()
+    ready = threading.Event()
+    t = threading.Thread(target=ka.run_server, args=(port, 1, ready),
+                         daemon=True)
+    t.start()
+    assert ready.wait(10)
+
+    monkeypatch.setenv("DMLC_PS_ROOT_URI", "127.0.0.1")
+    monkeypatch.setenv("DMLC_PS_ROOT_PORT", str(port))
+    monkeypatch.setenv("DMLC_NUM_SERVER", "1")
+    monkeypatch.setenv("DMLC_NUM_WORKER", "1")
+    monkeypatch.setenv("DMLC_WORKER_ID", "0")
+    kv = mx.kvstore.create("dist_async")
+    assert kv.type == "dist_async"
+    assert kv.rank == 0 and kv.num_workers == 1
+
+    # running-sum mode (no server-side optimizer)
+    kv.init("w", mx.np.zeros((2, 3)))
+    kv.push("w", mx.np.ones((2, 3)))
+    kv.push("w", mx.np.ones((2, 3)) * 2)
+    onp.testing.assert_allclose(kv.pull("w").asnumpy(), 3.0)
+
+    # server-side optimizer: push applies sgd immediately
+    kv.init("p", mx.np.ones((4,)))
+    kv.set_optimizer(mx.optimizer.create("sgd", learning_rate=0.5))
+    kv.push("p", mx.np.ones((4,)))          # p <- p - 0.5 * 1
+    onp.testing.assert_allclose(kv.pull("p").asnumpy(), 0.5, atol=1e-6)
+    kv.push("p", mx.np.ones((4,)))
+    onp.testing.assert_allclose(kv.pull("p").asnumpy(), 0.0, atol=1e-6)
+
+    kv.barrier()                            # 1-worker barrier: immediate
+    stats = kv.server_stats()
+    assert stats[0]["pushes"] == 4 and "p" in stats[0]["keys"]
+
+    # live hyperparam updates reach the server WITHOUT resetting state:
+    # momentum built at lr=0.5 must persist across the lr change
+    kv.init("q", mx.np.zeros((2,)))
+    kv.set_optimizer(mx.optimizer.create("sgd", learning_rate=0.5,
+                                         momentum=0.5))
+    kv.push("q", mx.np.ones((2,)))     # m=1, q = -0.5
+    kv.update_optimizer_params({"learning_rate": 0.1})
+    kv.push("q", mx.np.ones((2,)))     # m=1.5, q = -0.5 - 0.1*1.5
+    onp.testing.assert_allclose(kv.pull("q").asnumpy(), -0.65, atol=1e-6)
+
+    # optimizer-state round trip over the wire (momentum survives)
+    import tempfile
+    with tempfile.NamedTemporaryFile(suffix=".states") as f:
+        kv.save_optimizer_states(f.name)
+        kv.set_optimizer(mx.optimizer.create("sgd", learning_rate=0.1,
+                                             momentum=0.5))   # resets m
+        kv.load_optimizer_states(f.name)
+    kv.push("q", mx.np.zeros((2,)))    # m = 0.5*1.5 -> q -= 0.1*0.75
+    onp.testing.assert_allclose(kv.pull("q").asnumpy(), -0.725, atol=1e-6)
+
+    # multi-key batched push/pull (one frame per server)
+    kv.init([f"mk{i}" for i in range(5)],
+            [mx.np.zeros((3,)) for _ in range(5)])
+    kv.set_optimizer(mx.optimizer.create("sgd", learning_rate=1.0))
+    kv.push([f"mk{i}" for i in range(5)],
+            [mx.np.ones((3,)) * i for i in range(5)])
+    outs = kv.pull([f"mk{i}" for i in range(5)])
+    for i, o in enumerate(outs):
+        onp.testing.assert_allclose(o.asnumpy(), -float(i), atol=1e-6)
+
+    # server errors come back as MXNetError, connection stays usable
+    with pytest.raises(mx.MXNetError, match="uninitialized"):
+        kv.push("never_inited", mx.np.ones((1,)))
+    onp.testing.assert_allclose(kv.pull("q").asnumpy(), -0.725, atol=1e-6)
+
+    # compression is refused with guidance
+    with pytest.raises(mx.MXNetError, match="ici"):
+        kv.set_gradient_compression({"type": "2bit"})
+
+    kv.stop_servers()
+    t.join(10)
+    assert not t.is_alive()
+
+
+def test_kvstore_dist_async_needs_servers(monkeypatch):
+    """A launched job without -s (DMLC_NUM_SERVER=0) gets the guidance
+    error, not a ZeroDivisionError from key hashing."""
+    monkeypatch.setenv("DMLC_ROLE", "worker")
+    monkeypatch.setenv("DMLC_PS_ROOT_PORT", "9876")
+    monkeypatch.setenv("DMLC_NUM_SERVER", "0")
+    with pytest.raises(mx.MXNetError, match="-s 1"):
+        mx.kvstore.create("dist_async")
+
+
+def test_trainer_update_on_kvstore_matches_local():
+    """update_on_kvstore=True (the dist_async/server-side mode) must
+    produce the same trajectory as the local update path for the same
+    optimizer on a single process (reference trainer.py contract)."""
+    import numpy as onp
+    mx.random.seed(0)
+    def build():
+        net = nn.Dense(2, in_units=3)
+        net.initialize()
+        net(mx.np.zeros((1, 3)))
+        return net
+    net_a, net_b = build(), build()
+    # identical inits
+    net_b.weight.set_data(net_a.weight.data().copy())
+    net_b.bias.set_data(net_a.bias.data().copy())
+    tr_a = mx.gluon.Trainer(net_a.collect_params(), "sgd",
+                            {"learning_rate": 0.1, "momentum": 0.9},
+                            kvstore="device", update_on_kvstore=False)
+    tr_b = mx.gluon.Trainer(net_b.collect_params(), "sgd",
+                            {"learning_rate": 0.1, "momentum": 0.9},
+                            kvstore="device", update_on_kvstore=True)
+    loss_fn = mx.gluon.loss.L2Loss()
+    rng = onp.random.RandomState(5)
+    for _ in range(4):
+        x = mx.np.array(rng.uniform(-1, 1, (4, 3)).astype("float32"))
+        y = mx.np.array(rng.uniform(-1, 1, (4, 2)).astype("float32"))
+        for net, tr in ((net_a, tr_a), (net_b, tr_b)):
+            with mx.autograd.record():
+                loss = loss_fn(net(x), y)
+            loss.backward()
+            tr.step(4)
+    onp.testing.assert_allclose(net_a.weight.data().asnumpy(),
+                                net_b.weight.data().asnumpy(),
+                                rtol=1e-5, atol=1e-6)
+    onp.testing.assert_allclose(net_a.bias.data().asnumpy(),
+                                net_b.bias.data().asnumpy(),
+                                rtol=1e-5, atol=1e-6)
 
 
 def test_spmd_batchnorm_running_stats_advance():

@@ -23,8 +23,26 @@ import sys
 
 def launch_local(args, cmd):
     procs = []
+    servers = []
     base_env = dict(os.environ)
     coord = f"127.0.0.1:{args.port}"
+    ps_port = args.port + 1
+    if args.num_servers:
+        # parameter-server processes (kvstore='dist_async'): role env per
+        # the reference DMLC contract, entry = mxnet_tpu.kvstore_async
+        for sid in range(args.num_servers):
+            env = dict(base_env)
+            env.update({
+                "DMLC_ROLE": "server",
+                "DMLC_SERVER_ID": str(sid),
+                "DMLC_NUM_SERVER": str(args.num_servers),
+                "DMLC_NUM_WORKER": str(args.num_workers),
+                "DMLC_PS_ROOT_URI": "127.0.0.1",
+                "DMLC_PS_ROOT_PORT": str(ps_port),
+            })
+            servers.append(subprocess.Popen(
+                [sys.executable, "-m", "mxnet_tpu.kvstore_async"],
+                env=env))
     for rank in range(args.num_workers):
         env = dict(base_env)
         env.update({
@@ -36,7 +54,9 @@ def launch_local(args, cmd):
             "DMLC_WORKER_ID": str(rank),
             "DMLC_ROLE": "worker",
             "DMLC_PS_ROOT_URI": "127.0.0.1",
-            "DMLC_PS_ROOT_PORT": str(args.port),
+            "DMLC_PS_ROOT_PORT": str(ps_port if args.num_servers
+                                     else args.port),
+            "DMLC_NUM_SERVER": str(args.num_servers),
         })
         if args.cpu_devices_per_worker:
             env["XLA_FLAGS"] = (
@@ -52,6 +72,13 @@ def launch_local(args, cmd):
         for p in procs:
             if p.poll() is None:
                 p.terminate()
+    # workers are done — stop the parameter servers (rank 0 may already
+    # have sent STOP; terminate is the backstop)
+    for p in servers:
+        if p.poll() is None:
+            p.terminate()
+    for p in servers:
+        p.wait()
     return rc
 
 
@@ -86,6 +113,9 @@ def main(argv=None):
     ap = argparse.ArgumentParser(
         description="Launch an SPMD multi-process training job")
     ap.add_argument("-n", "--num-workers", type=int, required=True)
+    ap.add_argument("-s", "--num-servers", type=int, default=0,
+                    help="parameter-server processes for "
+                         "kvstore='dist_async' (0 = pure SPMD job)")
     ap.add_argument("--launcher", default="local",
                     choices=["local", "ssh"])
     ap.add_argument("-H", "--hostfile", default=None,
