@@ -1,0 +1,78 @@
+#!/usr/bin/env python
+"""Asynchronous parameter-server training (``kvstore='dist_async'``).
+
+The reference's ``dist_async`` mode (ps-lite Hogwild updates,
+``example/distributed_training`` heritage) rebuilt as the host-driven
+parameter service: ``tools/launch.py -n W -s S`` starts S server
+processes; each of the W workers trains at its own pace, pushing
+gradients and pulling weights with no per-step synchronization — the
+server applies the optimizer immediately per push. Use this when the
+worker fleet is heterogeneous or flaky; for homogeneous fleets prefer
+the synchronous SPMD path (``kvstore='ici'``), which is exact and rides
+ICI collectives.
+
+    python tools/launch.py -n 2 -s 1 python examples/train_async_ps.py
+
+Each worker reports its own loss curve; the single server-side weight
+copy is what every worker converges onto.
+"""
+import os
+import sys
+import time
+
+sys.path.insert(0, os.path.dirname(os.path.dirname(os.path.abspath(__file__))))
+
+import jax
+jax.config.update("jax_platforms", "cpu")   # demo-sized: host math
+
+
+def main():
+    import numpy as onp
+    import mxnet_tpu as mx
+
+    if "DMLC_NUM_SERVER" not in os.environ:
+        raise SystemExit(__doc__)
+
+    rank = int(os.environ.get("DMLC_WORKER_ID", "0"))
+    mx.random.seed(0)                        # identical init on all ranks
+
+    net = mx.gluon.nn.HybridSequential()
+    net.add(mx.gluon.nn.Dense(64, in_units=20, activation="relu"),
+            mx.gluon.nn.Dense(1, in_units=64))
+    net.initialize()
+    net(mx.np.zeros((1, 20)))
+    net.hybridize()
+
+    # NOTE plain SGD, modest lr: with W Hogwild workers the server
+    # applies ~W updates per local step, and shared server-side momentum
+    # compounds across workers (effective step ~ W*lr/(1-mu^2)) — the
+    # classic async-PS stability tradeoff. Scale lr DOWN as W grows.
+    trainer = mx.gluon.Trainer(
+        net.collect_params(), "sgd", {"learning_rate": 0.02},
+        kvstore="dist_async")                # update_on_kvstore engages
+    loss_fn = mx.gluon.loss.L2Loss()
+
+    # a shared synthetic regression task; each worker sees its own stream
+    truth = onp.random.RandomState(0).normal(size=(20, 1)).astype("f4")
+    rng = onp.random.RandomState(100 + rank)
+
+    t0 = time.time()
+    for step in range(1, 201):
+        x = rng.normal(size=(32, 20)).astype("f4")
+        y = x @ truth
+        with mx.autograd.record():
+            loss = loss_fn(net(mx.np.array(x)), mx.np.array(y))
+        loss.backward()
+        trainer.step(32)                     # push grads, pull weights
+        if step % 50 == 0:
+            print(f"[worker {rank}] step {step:4d} "
+                  f"loss {float(loss.asnumpy().mean()):.5f} "
+                  f"({step / (time.time() - t0):.1f} steps/s)")
+
+    stats = trainer._kvstore.server_stats()[0]
+    print(f"[worker {rank}] done; server applied {stats['pushes']} "
+          f"pushes across {len(stats['keys'])} keys")
+
+
+if __name__ == "__main__":
+    main()

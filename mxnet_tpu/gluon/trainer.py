@@ -143,6 +143,13 @@ class Trainer:
                         "the forward in autograd.record() or pass "
                         "ignore_stale_grad=True")
                 if getattr(g, "stype", "default") == "row_sparse":
+                    if self._update_on_kvstore:
+                        from ..base import MXNetError
+                        raise MXNetError(
+                            f"Parameter '{p.name}' has a row_sparse "
+                            "gradient, which the server-side update "
+                            "path does not support — use a dense "
+                            "gradient or update_on_kvstore=False")
                     # row-sparse grads skip the dense allreduce round-trip;
                     # multi-worker aggregation uses row_sparse_pull
                     # semantics (reference: Trainer._row_sparse_pull)
@@ -170,6 +177,10 @@ class Trainer:
             self._init_kvstore()
         if self._update_on_kvstore and \
                 hasattr(self._kvstore, "update_optimizer_params"):
+            # the worker-side optimizer never runs _update, so advance
+            # its schedule clock here or lr_scheduler(num_update) would
+            # stay frozen at step 0 forever
+            self._optimizer.num_update += 1
             # live hyperparams (lr schedule, loss-scale rescale, wd) must
             # reach the server-side optimizer without resetting its state
             self._kvstore.update_optimizer_params({
@@ -317,6 +328,8 @@ class Trainer:
 
     # -- exact resume (reference: Trainer.save_states/load_states) ----------
     def save_states(self, fname: str) -> None:
+        if not self._kv_initialized:
+            self._init_kvstore()
         if self._update_on_kvstore and self._kvstore is not None:
             if hasattr(self._kvstore, "save_optimizer_states"):
                 # states live in the store (server-side for dist_async) —
@@ -337,6 +350,8 @@ class Trainer:
             pickle.dump(payload, f)
 
     def load_states(self, fname: str) -> None:
+        if not self._kv_initialized:
+            self._init_kvstore()
         if self._update_on_kvstore and self._kvstore is not None:
             if hasattr(self._kvstore, "load_optimizer_states"):
                 self._kvstore.load_optimizer_states(fname)

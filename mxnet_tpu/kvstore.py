@@ -39,6 +39,10 @@ register_env("MXNET_KVSTORE_BIGARRAY_BOUND", 1000000,
              "sliced big arrays across servers at this bound; here it "
              "bounds the fusion buffer), larger arrays reduce alone.")
 
+register_env("MXNET_PS_CONNECT_TIMEOUT", 120,
+             "Seconds a dist_async worker retries connecting to its "
+             "parameter server before failing (server cold start).")
+
 register_env("MXNET_PS_BARRIER_TIMEOUT", 600,
              "Seconds a parameter-server barrier waits for all workers "
              "before raising (kvstore='dist_async').")

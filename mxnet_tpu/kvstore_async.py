@@ -231,6 +231,7 @@ class PSServer:
                     # no server-side optimizer: running sum (the pulled
                     # value is the sum of everything pushed since init)
                     self.store[key] = self.store[key] + grad
+            with self._global_lock:
                 self.pushes += 1
             return b"K", {}, b""
         if cmd == b"G":                          # pull
@@ -252,6 +253,7 @@ class PSServer:
                         self._apply_update(key, grad)
                     else:
                         self.store[key] = self.store[key] + grad
+                with self._global_lock:
                     self.pushes += 1
             return b"K", {}, b""
         if cmd == b"g":                          # multi-key pull
@@ -285,7 +287,13 @@ class PSServer:
                 enc = {str(k): _enc_state(s, leaves)
                        for k, s in self.updater.states.items()}
                 specs, raw = _pack_leaves(leaves)
-            return b"v", {"states": enc, "specs": specs}, raw
+                o = self.updater.optimizer
+                counts = {"num_update": o.num_update,
+                          "index_update_count":
+                              {str(k): v for k, v
+                               in o._index_update_count.items()}}
+            return b"v", {"states": enc, "specs": specs,
+                          "counts": counts}, raw
         if cmd == b"Y":                          # restore optimizer states
             with self._global_lock:
                 if self.updater is None:
@@ -295,6 +303,13 @@ class PSServer:
                 self.updater.states = {
                     k: _dec_state(obj, leaves)
                     for k, obj in header["states"].items()}
+                counts = header.get("counts")
+                if counts:
+                    o = self.updater.optimizer
+                    o.num_update = max(o.num_update,
+                                       counts.get("num_update", 0))
+                    o._index_update_count.update(
+                        counts.get("index_update_count", {}))
             return b"K", {}, b""
         if cmd == b"O":                          # set_optimizer
             from . import optimizer as opt
@@ -380,12 +395,19 @@ class KVStoreDistAsync:
     def _sock(self, sidx: int) -> socket.socket:
         s = self._socks[sidx]
         if s is None:
-            deadline = time.time() + 30
+            # the server process imports the framework (jax) before it
+            # listens — allow for a slow cold start on a loaded machine
+            deadline = time.time() + float(
+                os.environ.get("MXNET_PS_CONNECT_TIMEOUT", "120"))
             last: Optional[Exception] = None
             while time.time() < deadline:
                 try:
                     s = socket.create_connection(
                         (self.uri, self.port + sidx), timeout=30)
+                    # blocking from here on: a barrier reply may take up
+                    # to MXNET_PS_BARRIER_TIMEOUT, far past any sane
+                    # per-recv timeout
+                    s.settimeout(None)
                     s.setsockopt(socket.IPPROTO_TCP, socket.TCP_NODELAY, 1)
                     self._socks[sidx] = s
                     return s
@@ -404,9 +426,20 @@ class KVStoreDistAsync:
     def _rpc_server(self, sidx: int, cmd: bytes, header: Dict[str, Any],
                     payload: bytes = b""):
         with self._locks[sidx]:
-            s = self._sock(sidx)
-            _send_frame(s, cmd, header, payload)
-            rcmd, rhdr, rpayload = _recv_frame(s)
+            try:
+                s = self._sock(sidx)
+                _send_frame(s, cmd, header, payload)
+                rcmd, rhdr, rpayload = _recv_frame(s)
+            except (ConnectionError, OSError):
+                # a half-done exchange leaves the stream desynced — drop
+                # the socket so the next call reconnects cleanly
+                if self._socks[sidx] is not None:
+                    try:
+                        self._socks[sidx].close()
+                    except OSError:
+                        pass
+                    self._socks[sidx] = None
+                raise
         if rcmd == b"E":
             raise MXNetError(f"parameter server: {rhdr.get('error')}")
         return rcmd, rhdr, rpayload
@@ -414,13 +447,6 @@ class KVStoreDistAsync:
     def _rpc(self, key: Any, cmd: bytes, header: Dict[str, Any],
              payload: bytes = b""):
         return self._rpc_server(self._server_of(key), cmd, header, payload)
-
-    @staticmethod
-    def _pair(key, value):
-        if isinstance(key, (list, tuple)):
-            vals = [None] * len(key) if value is None else list(value)
-            return list(key), vals
-        return [key], [value]
 
     @staticmethod
     def _to_numpy(v) -> onp.ndarray:
@@ -493,10 +519,6 @@ class KVStoreDistAsync:
             results.append(nd)
         return results[0] if not isinstance(key, (list, tuple)) else results
 
-    def pushpull(self, key, value, out=None, priority: int = 0) -> None:
-        self.push(key, value, priority)
-        self.pull(key, out if out is not None else value, priority)
-
     def set_optimizer(self, optimizer) -> None:
         """Ship the optimizer to every server (reference: pickled via
         ``_send_command_to_servers``; here name + scalar hyperparams)."""
@@ -542,6 +564,8 @@ class KVStoreDistAsync:
         pickle format (reference: update_on_kvstore state saving)."""
         import pickle
         states: Dict[str, Any] = {}
+        num_update = 0
+        index_counts: Dict[str, int] = {}
         for sidx in range(self.num_servers):
             _, hdr, payload = self._rpc_server(sidx, b"X", {})
             if hdr.get("states") is None:
@@ -549,9 +573,12 @@ class KVStoreDistAsync:
             leaves = _unpack_leaves(hdr["specs"], payload)
             for k, obj in hdr["states"].items():
                 states[k] = _dec_state(obj, leaves)
+            counts = hdr.get("counts", {})
+            num_update = max(num_update, counts.get("num_update", 0))
+            index_counts.update(counts.get("index_update_count", {}))
         with open(fname, "wb") as f:
-            pickle.dump({"format": 2, "num_update": 0,
-                         "index_update_count": {},
+            pickle.dump({"format": 2, "num_update": num_update,
+                         "index_update_count": index_counts,
                          "states": states}, f)
 
     def load_optimizer_states(self, fname: str) -> None:
@@ -560,13 +587,18 @@ class KVStoreDistAsync:
             payload = pickle.load(f)
         by_server: Dict[int, Dict[str, Any]] = {}
         for k, s in payload["states"].items():
-            by_server.setdefault(self._server_of(k), {})[k] = s
+            by_server.setdefault(self._server_of(str(k)), {})[str(k)] = s
+        counts = {"num_update": payload.get("num_update", 0),
+                  "index_update_count":
+                      {str(k): v for k, v in
+                       payload.get("index_update_count", {}).items()}}
         for sidx, chunk in by_server.items():
             leaves: List[onp.ndarray] = []
             enc = {k: _enc_state(s, leaves) for k, s in chunk.items()}
             specs, raw = _pack_leaves(leaves)
             self._rpc_server(sidx, b"Y",
-                             {"states": enc, "specs": specs}, raw)
+                             {"states": enc, "specs": specs,
+                              "counts": counts}, raw)
 
     def set_gradient_compression(self, compression_params) -> None:
         raise MXNetError(
@@ -602,6 +634,13 @@ class KVStoreDistAsync:
         return (f"KVStoreDistAsync(servers={self.num_servers} @ "
                 f"{self.uri}:{self.port}, rank={self._rank}/"
                 f"{self._num_workers})")
+
+
+# key/value normalization and pushpull are the base store's — one
+# implementation, one behavior (kvstore.py)
+from .kvstore import KVStore as _KVStoreBase
+KVStoreDistAsync._pair = staticmethod(_KVStoreBase._pair)  # type: ignore
+KVStoreDistAsync.pushpull = _KVStoreBase.pushpull    # type: ignore
 
 
 def main() -> None:
