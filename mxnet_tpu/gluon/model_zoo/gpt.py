@@ -139,6 +139,28 @@ class GPTModel(HybridBlock):
         w = self.word_embed.weight.data()
         return mxnp.matmul(x, w.T)
 
+    def generate(self, tokens, max_new_tokens: int,
+                 method: str = "greedy", temperature: float = 1.0,
+                 top_k: int = 40, eos_token: Optional[int] = None,
+                 seed: int = 0) -> NDArray:
+        """KV-cache incremental decoding (greedy / 'sample' /
+        'top_k'): one compiled prefill + lax.scan program per shape
+        signature. See ``model_zoo.generation``."""
+        from .generation import generate as _gen
+        return _gen(self, tokens, max_new_tokens, method=method,
+                    temperature=temperature, top_k=top_k,
+                    eos_token=eos_token, seed=seed)
+
+    def beam_search(self, tokens, max_new_tokens: int,
+                    beam_size: int = 4,
+                    eos_token: Optional[int] = None,
+                    alpha: float = 1.0):
+        """Length-normalized beam search over the KV-cache decoder
+        (gluon-nlp BeamSearchSampler analog)."""
+        from .generation import beam_search as _beam
+        return _beam(self, tokens, max_new_tokens, beam_size=beam_size,
+                     eos_token=eos_token, alpha=alpha)
+
 
 _SPECS = {
     # name: (num_layers, units, hidden, heads, max_length)

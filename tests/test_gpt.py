@@ -66,3 +66,125 @@ def test_gpt_specs_and_max_length_guard():
     net = _tiny()
     with pytest.raises(mx.MXNetError):
         net(mx.np.zeros((1, 32), dtype="int32"))  # > max_length 16
+
+
+# ---------------------------------------------------------------------------
+# KV-cache generation (model_zoo.generation)
+# ---------------------------------------------------------------------------
+
+def _tiny_gpt(vocab=97, layers=2, units=32, heads=4, max_len=64):
+    import mxnet_tpu as mx
+    from mxnet_tpu.gluon.model_zoo.gpt import GPTModel
+    mx.random.seed(0)
+    net = GPTModel(vocab_size=vocab, num_layers=layers, units=units,
+                   hidden_size=units * 4, num_heads=heads,
+                   max_length=max_len, dropout=0.0)
+    net.initialize()
+    net(mx.np.zeros((1, 4), dtype="int32"))      # finish deferred init
+    return net
+
+
+def test_generate_greedy_matches_full_forward():
+    """The cached incremental decoder must produce exactly the tokens a
+    naive full-recompute greedy decode produces (cache math == forward
+    math)."""
+    import numpy as onp
+    import mxnet_tpu as mx
+    net = _tiny_gpt()
+    rng = onp.random.RandomState(0)
+    prompt = rng.randint(0, 97, (2, 5)).astype("int32")
+
+    got = net.generate(prompt, max_new_tokens=8).asnumpy()
+
+    # reference: recompute the full forward per step, take argmax
+    toks = prompt.copy()
+    want = []
+    for _ in range(8):
+        logits = net(mx.np.array(toks)).asnumpy()
+        nxt = logits[:, -1, :].argmax(-1).astype("int32")
+        want.append(nxt)
+        toks = onp.concatenate([toks, nxt[:, None]], axis=1)
+    onp.testing.assert_array_equal(got, onp.stack(want, axis=1))
+
+
+def test_generate_sampling_and_eos():
+    import numpy as onp
+    net = _tiny_gpt()
+    prompt = onp.array([[1, 2, 3]], dtype="int32")
+    a = net.generate(prompt, 6, method="sample", temperature=0.8,
+                     seed=7).asnumpy()
+    b = net.generate(prompt, 6, method="sample", temperature=0.8,
+                     seed=7).asnumpy()
+    c = net.generate(prompt, 6, method="sample", temperature=0.8,
+                     seed=8).asnumpy()
+    onp.testing.assert_array_equal(a, b)       # same seed -> same draw
+    assert a.shape == (1, 6) and c.shape == (1, 6)
+
+    # top_k=1 is greedy
+    tk = net.generate(prompt, 6, method="top_k", top_k=1,
+                      seed=3).asnumpy()
+    gd = net.generate(prompt, 6).asnumpy()
+    onp.testing.assert_array_equal(tk, gd)
+
+    # eos: once emitted, the tail is all eos
+    eos = int(gd[0, 1])                        # force a hit at step 2
+    e = net.generate(prompt, 6, eos_token=eos).asnumpy()
+    hit = onp.argmax(e[0] == eos)
+    assert (e[0, hit:] == eos).all()
+
+
+def test_generate_validates_args():
+    import numpy as onp
+    import pytest
+    import mxnet_tpu as mx
+    net = _tiny_gpt(max_len=16)
+    with pytest.raises(mx.MXNetError, match="max_length"):
+        net.generate(onp.zeros((1, 10), "int32"), 10)
+    with pytest.raises(mx.MXNetError, match=">= 1"):
+        net.generate(onp.zeros((1, 4), "int32"), 0)
+    with pytest.raises(mx.MXNetError, match="top_k"):
+        net.generate(onp.zeros((1, 4), "int32"), 2, method="top_k",
+                     top_k=0)
+    # top_k beyond the vocab clamps instead of silently degrading
+    out = net.generate(onp.zeros((1, 4), "int32"), 2, method="top_k",
+                       top_k=10_000, seed=1)
+    assert out.asnumpy().shape == (1, 2)
+
+
+def test_beam_search_beats_greedy_and_matches_at_k1():
+    """beam_size=1 must equal greedy; larger beams never score worse
+    than the greedy sequence under the same (alpha=1) normalization."""
+    import numpy as onp
+    import jax.numpy as jnp
+    import jax
+    net = _tiny_gpt()
+    rng = onp.random.RandomState(3)
+    prompt = rng.randint(0, 97, (2, 4)).astype("int32")
+
+    seqs1, scores1 = net.beam_search(prompt, 6, beam_size=1)
+    greedy = net.generate(prompt, 6).asnumpy()
+    onp.testing.assert_array_equal(seqs1.asnumpy()[:, 0, :], greedy)
+
+    seqs4, scores4 = net.beam_search(prompt, 6, beam_size=4)
+    assert seqs4.asnumpy().shape == (2, 4, 6)
+    s1, s4 = scores1.asnumpy(), scores4.asnumpy()
+    assert (s4[:, 0] >= s1[:, 0] - 1e-4).all()   # beam >= greedy score
+    # beams come back best-first
+    assert (onp.diff(s4, axis=1) <= 1e-5).all()
+
+
+def test_beam_search_eos_normalization():
+    import numpy as onp
+    net = _tiny_gpt()
+    prompt = onp.array([[5, 6]], dtype="int32")
+    g = net.generate(prompt, 5).asnumpy()
+    eos = int(g[0, 0])                         # eos on the first step
+    seqs, scores = net.beam_search(prompt, 5, beam_size=3,
+                                   eos_token=eos)
+    s = seqs.asnumpy()
+    # any beam that emitted eos is eos-padded afterwards
+    for b in range(3):
+        row = s[0, b]
+        if (row == eos).any():
+            hit = onp.argmax(row == eos)
+            assert (row[hit:] == eos).all()
