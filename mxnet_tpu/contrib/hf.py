@@ -1,0 +1,177 @@
+"""HuggingFace transformers interop — convert GPT-2 / BERT checkpoints
+into mxnet_tpu model-zoo models.
+
+The reference model zoo shipped pretrained weights for its
+architectures; the modern equivalent of that capability is loading the
+de-facto checkpoint format. ``convert_gpt2`` / ``convert_bert`` map a
+``transformers`` torch model's state into the corresponding
+``model_zoo`` block with exact numerical parity (pinned by
+``tests/test_hf.py``: logits match to float32 tolerance on random
+weights, so the mapping is verified architecture-wide, not just
+shape-wide).
+
+Usage (no network needed if the HF model is already local):
+
+    from transformers import GPT2LMHeadModel
+    hf = GPT2LMHeadModel.from_pretrained("/path/to/gpt2")
+    net = mxnet_tpu.contrib.hf.convert_gpt2(hf)
+    out = net.generate(prompt, 50)
+
+Weight-layout notes (the whole conversion, really):
+
+* HF GPT-2 uses ``Conv1D`` layers storing ``(in, out)`` — transposed
+  relative to ``Dense``'s ``(out, in)``.
+* HF splits q/k/v projections in BERT; our layers fuse them — concat
+  along the output axis.
+* GPT-2's activation is the tanh GELU approximation ("gelu_new") —
+  ``GPTModel(gelu_approximate=True)``.
+"""
+from __future__ import annotations
+
+from typing import Any
+
+import numpy as onp
+
+from ..base import MXNetError
+
+__all__ = ["convert_gpt2", "convert_bert"]
+
+
+def _t(tensor) -> onp.ndarray:
+    return tensor.detach().cpu().numpy().astype("float32")
+
+
+def _set(param, value: onp.ndarray) -> None:
+    from ..ndarray.ops import array
+    if not param.is_initialized:
+        param._finish_deferred_init(tuple(value.shape))
+    if tuple(param.shape) != tuple(value.shape):
+        raise MXNetError(
+            f"shape mismatch for {param.name}: ours {tuple(param.shape)} "
+            f"vs checkpoint {tuple(value.shape)}")
+    param.set_data(array(onp.ascontiguousarray(value)))
+
+
+def convert_gpt2(hf_model, dropout: float = 0.0):
+    """``transformers.GPT2LMHeadModel`` (or ``GPT2Model``) -> GPTModel."""
+    from ..gluon.model_zoo.gpt import GPTModel
+
+    tr = getattr(hf_model, "transformer", hf_model)   # LMHead or bare
+    cfg = hf_model.config
+    if getattr(cfg, "activation_function", "gelu_new") not in (
+            "gelu_new", "gelu", "gelu_pytorch_tanh"):
+        raise MXNetError(
+            f"unsupported GPT-2 activation {cfg.activation_function!r}")
+    approx = cfg.activation_function in ("gelu_new", "gelu_pytorch_tanh")
+    # config variants that change the math without changing shapes must
+    # refuse loudly — a silent conversion would be numerically wrong
+    if getattr(cfg, "scale_attn_by_inverse_layer_idx", False):
+        raise MXNetError(
+            "scale_attn_by_inverse_layer_idx checkpoints are not "
+            "supported (per-layer attention scaling not implemented)")
+    if getattr(cfg, "reorder_and_upcast_attn", False):
+        raise MXNetError(
+            "reorder_and_upcast_attn checkpoints are not supported")
+
+    net = GPTModel(vocab_size=cfg.vocab_size, num_layers=cfg.n_layer,
+                   units=cfg.n_embd,
+                   hidden_size=cfg.n_inner or 4 * cfg.n_embd,
+                   num_heads=cfg.n_head, max_length=cfg.n_positions,
+                   dropout=dropout,
+                   layer_norm_eps=cfg.layer_norm_epsilon,
+                   gelu_approximate=approx)
+    net.initialize()
+
+    _set(net.word_embed.weight, _t(tr.wte.weight))
+    _set(net.position_weight, _t(tr.wpe.weight))
+    for blk, h in zip(net.blocks._children.values(), tr.h):
+        _set(blk.ln1.gamma, _t(h.ln_1.weight))
+        _set(blk.ln1.beta, _t(h.ln_1.bias))
+        # Conv1D stores (in, out): transpose into Dense's (out, in)
+        _set(blk.attn_qkv.weight, _t(h.attn.c_attn.weight).T)
+        _set(blk.attn_qkv.bias, _t(h.attn.c_attn.bias))
+        _set(blk.attn_out.weight, _t(h.attn.c_proj.weight).T)
+        _set(blk.attn_out.bias, _t(h.attn.c_proj.bias))
+        _set(blk.ln2.gamma, _t(h.ln_2.weight))
+        _set(blk.ln2.beta, _t(h.ln_2.bias))
+        _set(blk.ffn1.weight, _t(h.mlp.c_fc.weight).T)
+        _set(blk.ffn1.bias, _t(h.mlp.c_fc.bias))
+        _set(blk.ffn2.weight, _t(h.mlp.c_proj.weight).T)
+        _set(blk.ffn2.bias, _t(h.mlp.c_proj.bias))
+    _set(net.ln_f.gamma, _t(tr.ln_f.weight))
+    _set(net.ln_f.beta, _t(tr.ln_f.bias))
+    # the LM head is weight-tied to wte in both frameworks — nothing to
+    # copy (HF's lm_head.weight IS wte.weight)
+    return net
+
+
+def convert_bert(hf_model, dropout: float = 0.0):
+    """``transformers.BertModel`` / ``BertForPreTraining`` -> BERTModel."""
+    from ..gluon.model_zoo.bert import BERTModel
+
+    bert = getattr(hf_model, "bert", hf_model)
+    cfg = hf_model.config
+    if getattr(cfg, "hidden_act", "gelu") != "gelu":
+        raise MXNetError(
+            f"unsupported BERT activation {cfg.hidden_act!r}")
+    cls = getattr(hf_model, "cls", None)   # pretraining heads, if any
+
+    net = BERTModel(vocab_size=cfg.vocab_size,
+                    num_layers=cfg.num_hidden_layers,
+                    units=cfg.hidden_size,
+                    hidden_size=cfg.intermediate_size,
+                    num_heads=cfg.num_attention_heads,
+                    max_length=cfg.max_position_embeddings,
+                    token_type_vocab_size=cfg.type_vocab_size,
+                    dropout=dropout,
+                    use_pooler=bert.pooler is not None,
+                    use_decoder=cls is not None,
+                    use_classifier=cls is not None,
+                    layer_norm_eps=cfg.layer_norm_eps)
+    net.initialize()
+
+    emb = bert.embeddings
+    _set(net.word_embed.weight, _t(emb.word_embeddings.weight))
+    _set(net.token_type_embed.weight,
+         _t(emb.token_type_embeddings.weight))
+    _set(net.encoder.position_weight, _t(emb.position_embeddings.weight))
+    _set(net.encoder.ln.gamma, _t(emb.LayerNorm.weight))
+    _set(net.encoder.ln.beta, _t(emb.LayerNorm.bias))
+
+    for lyr, h in zip(net.encoder.layers._children.values(),
+                      bert.encoder.layer):
+        a = h.attention
+        # separate q/k/v Linears fuse into one qkv Dense: concat on the
+        # OUTPUT axis (Dense weight is (out, in))
+        _set(lyr.attn_qkv.weight, onp.concatenate(
+            [_t(a.self.query.weight), _t(a.self.key.weight),
+             _t(a.self.value.weight)], axis=0))
+        _set(lyr.attn_qkv.bias, onp.concatenate(
+            [_t(a.self.query.bias), _t(a.self.key.bias),
+             _t(a.self.value.bias)], axis=0))
+        _set(lyr.attn_out.weight, _t(a.output.dense.weight))
+        _set(lyr.attn_out.bias, _t(a.output.dense.bias))
+        _set(lyr.ln1.gamma, _t(a.output.LayerNorm.weight))
+        _set(lyr.ln1.beta, _t(a.output.LayerNorm.bias))
+        _set(lyr.ffn1.weight, _t(h.intermediate.dense.weight))
+        _set(lyr.ffn1.bias, _t(h.intermediate.dense.bias))
+        _set(lyr.ffn2.weight, _t(h.output.dense.weight))
+        _set(lyr.ffn2.bias, _t(h.output.dense.bias))
+        _set(lyr.ln2.gamma, _t(h.output.LayerNorm.weight))
+        _set(lyr.ln2.beta, _t(h.output.LayerNorm.bias))
+
+    if bert.pooler is not None and net.pooler is not None:
+        _set(net.pooler.weight, _t(bert.pooler.dense.weight))
+        _set(net.pooler.bias, _t(bert.pooler.dense.bias))
+    if cls is not None and net.mlm_transform is not None:
+        pred = cls.predictions
+        _set(net.mlm_transform.weight, _t(pred.transform.dense.weight))
+        _set(net.mlm_transform.bias, _t(pred.transform.dense.bias))
+        _set(net.mlm_ln.gamma, _t(pred.transform.LayerNorm.weight))
+        _set(net.mlm_ln.beta, _t(pred.transform.LayerNorm.bias))
+        _set(net.mlm_bias, _t(pred.decoder.bias))
+        if net.classifier is not None and hasattr(cls,
+                                                  "seq_relationship"):
+            _set(net.classifier.weight, _t(cls.seq_relationship.weight))
+            _set(net.classifier.bias, _t(cls.seq_relationship.bias))
+    return net

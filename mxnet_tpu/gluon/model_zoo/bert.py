@@ -61,6 +61,7 @@ class BERTEncoder(HybridBlock):
     def __init__(self, num_layers: int = 12, units: int = 768,
                  hidden_size: int = 3072, num_heads: int = 12,
                  max_length: int = 512, dropout: float = 0.1,
+                 layer_norm_eps: float = 1e-12,
                  **kwargs: Any) -> None:
         super().__init__(**kwargs)
         self._max_length = max_length
@@ -68,12 +69,13 @@ class BERTEncoder(HybridBlock):
         self.position_weight = Parameter("position_weight",
                                          shape=(max_length, units),
                                          init="normal")
-        self.ln = LayerNorm(in_channels=units, epsilon=1e-12)
+        self.ln = LayerNorm(in_channels=units, epsilon=layer_norm_eps)
         self._dropout = dropout
         self.layers = HybridSequential()
         for _ in range(num_layers):
             self.layers.add(BERTEncoderLayer(units, hidden_size, num_heads,
-                                             dropout))
+                                             dropout,
+                                             layer_norm_eps=layer_norm_eps))
 
     def forward(self, x: NDArray, mask: Optional[NDArray] = None) -> NDArray:
         if not self.position_weight.is_initialized:
@@ -105,20 +107,24 @@ class BERTModel(HybridBlock):
                  num_heads: int = 12, max_length: int = 512,
                  token_type_vocab_size: int = 2, dropout: float = 0.1,
                  use_pooler: bool = True, use_decoder: bool = True,
-                 use_classifier: bool = True, **kwargs: Any) -> None:
+                 use_classifier: bool = True,
+                 layer_norm_eps: float = 1e-12,
+                 **kwargs: Any) -> None:
         super().__init__(**kwargs)
         self._units = units
         self.word_embed = Embedding(vocab_size, units)
         self.token_type_embed = Embedding(token_type_vocab_size, units)
         self.encoder = BERTEncoder(num_layers, units, hidden_size, num_heads,
-                                   max_length, dropout)
+                                   max_length, dropout,
+                                   layer_norm_eps=layer_norm_eps)
         self.pooler = Dense(units, in_units=units, flatten=False,
                             activation="tanh") if use_pooler else None
         if use_decoder:
             # MLM head: transform + layernorm + decode (weights tied to
             # word embedding, reference-style)
             self.mlm_transform = Dense(units, in_units=units, flatten=False)
-            self.mlm_ln = LayerNorm(in_channels=units, epsilon=1e-12)
+            self.mlm_ln = LayerNorm(in_channels=units,
+                                    epsilon=layer_norm_eps)
             self.mlm_bias = Parameter("mlm_bias", shape=(vocab_size,),
                                       init="zeros")
         else:

@@ -60,7 +60,13 @@ def _collect(model) -> Dict[str, Any]:
             "f1_w": _j(blk.ffn1.weight), "f1_b": _j(blk.ffn1.bias),
             "f2_w": _j(blk.ffn2.weight), "f2_b": _j(blk.ffn2.bias),
         })
+    approx = any(blk._gelu_approximate
+                 for blk in model.blocks._children.values())
+    eps = float(next(iter(
+        model.blocks._children.values())).ln1._epsilon)
     return {
+        "gelu_approx": approx,
+        "ln_eps": eps,
         "embed": _j(model.word_embed.weight),
         "pos": _j(model.position_weight),
         "lnf_g": _j(model.ln_f.gamma), "lnf_b": _j(model.ln_f.beta),
@@ -72,18 +78,19 @@ def _collect(model) -> Dict[str, Any]:
 # pure block math (must mirror GPTBlock.forward / ops.nn exactly)
 # ---------------------------------------------------------------------------
 
-def _ln(x, g, b, eps=1e-5):
+def _ln(x, g, b, eps):
     mean = jnp.mean(x, axis=-1, keepdims=True)
     var = jnp.var(x, axis=-1, keepdims=True)
     return (x - mean) * lax.rsqrt(var + eps) * g + b
 
 
-def _block_prefill(p, x, nh: int, L: int):
+def _block_prefill(p, x, nh: int, L: int, ga=(False, 1e-5)):
+    gelu_approx, eps = ga
     """Full causal pass over the prompt; returns (x_out, ck, cv) with
     the caches zero-padded to length L."""
     B, T, C = x.shape
     d = C // nh
-    h = _ln(x, p["ln1_g"], p["ln1_b"])
+    h = _ln(x, p["ln1_g"], p["ln1_b"], eps)
     qkv = h @ p["qkv_w"].T + p["qkv_b"]
     q, k, v = jnp.split(qkv, 3, axis=-1)
     qh = q.reshape(B, T, nh, d)
@@ -91,20 +98,22 @@ def _block_prefill(p, x, nh: int, L: int):
     vh = v.reshape(B, T, nh, d)
     out = jax.nn.dot_product_attention(qh, kh, vh, is_causal=True)
     x = x + (out.reshape(B, T, C) @ p["out_w"].T + p["out_b"])
-    h = _ln(x, p["ln2_g"], p["ln2_b"])
-    ffn = jax.nn.gelu(h @ p["f1_w"].T + p["f1_b"], approximate=False)
+    h = _ln(x, p["ln2_g"], p["ln2_b"], eps)
+    ffn = jax.nn.gelu(h @ p["f1_w"].T + p["f1_b"],
+                      approximate=gelu_approx)
     x = x + (ffn @ p["f2_w"].T + p["f2_b"])
     pad = [(0, 0), (0, L - T), (0, 0), (0, 0)]
     return x, jnp.pad(kh, pad), jnp.pad(vh, pad)
 
 
-def _block_step(p, x, ck, cv, pos, nh: int):
+def _block_step(p, x, ck, cv, pos, nh: int, ga=(False, 1e-5)):
+    gelu_approx, eps = ga
     """One-token decode: x (B, 1, C), caches (B, L, nh, d), pos scalar.
     Writes position ``pos`` then attends over cache[0..pos]."""
     B, _, C = x.shape
     d = C // nh
     L = ck.shape[1]
-    h = _ln(x, p["ln1_g"], p["ln1_b"])
+    h = _ln(x, p["ln1_g"], p["ln1_b"], eps)
     qkv = h @ p["qkv_w"].T + p["qkv_b"]
     q, k, v = jnp.split(qkv, 3, axis=-1)
     qh = q.reshape(B, 1, nh, d)
@@ -118,8 +127,9 @@ def _block_step(p, x, ck, cv, pos, nh: int):
     probs = jax.nn.softmax(scores, axis=-1)
     out = jnp.einsum("bhqk,bkhd->bqhd", probs, cv).reshape(B, 1, C)
     x = x + (out @ p["out_w"].T + p["out_b"])
-    h = _ln(x, p["ln2_g"], p["ln2_b"])
-    ffn = jax.nn.gelu(h @ p["f1_w"].T + p["f1_b"], approximate=False)
+    h = _ln(x, p["ln2_g"], p["ln2_b"], eps)
+    ffn = jax.nn.gelu(h @ p["f1_w"].T + p["f1_b"],
+                      approximate=gelu_approx)
     x = x + (ffn @ p["f2_w"].T + p["f2_b"])
     return x, ck, cv
 
@@ -131,25 +141,25 @@ def _embed_one(params, tok, pos):
                                         axis=0)[None, :, :]
 
 
-def _forward_step(params, tok, caches, pos, nh):
+def _forward_step(params, tok, caches, pos, nh, ga=(False, 1e-5)):
     """Embed one token, run all blocks against the caches, return
     (logits (B, V), new caches)."""
     x = _embed_one(params, tok, pos)
     new_caches = []
     for p, (ck, cv) in zip(params["blocks"], caches):
-        x, ck, cv = _block_step(p, x, ck, cv, pos, nh)
+        x, ck, cv = _block_step(p, x, ck, cv, pos, nh, ga)
         new_caches.append((ck, cv))
-    x = _ln(x, params["lnf_g"], params["lnf_b"])
+    x = _ln(x, params["lnf_g"], params["lnf_b"], ga[1])
     return x[:, 0, :] @ params["embed"].T, new_caches
 
 
-def _prefill(params, tokens, nh, L):
+def _prefill(params, tokens, nh, L, ga=(False, 1e-5)):
     x = params["embed"][tokens] + params["pos"][None, :tokens.shape[1]]
     caches = []
     for p in params["blocks"]:
-        x, ck, cv = _block_prefill(p, x, nh, L)
+        x, ck, cv = _block_prefill(p, x, nh, L, ga)
         caches.append((ck, cv))
-    x = _ln(x, params["lnf_g"], params["lnf_b"])
+    x = _ln(x, params["lnf_g"], params["lnf_b"], ga[1])
     return x[:, -1, :] @ params["embed"].T, caches
 
 
@@ -165,7 +175,29 @@ def _select(logits, method, temperature, top_k, key):
     return jax.random.categorical(key, logits, axis=-1).astype(jnp.int32)
 
 
-_PROG_CACHE: Dict[Any, Any] = {}
+class _LRU(dict):
+    """Bounded program cache: compiled decode executables are big, and a
+    serving loop over varying prompt lengths must not pin one per shape
+    forever."""
+
+    MAX = 32
+
+    def get(self, key, default=None):
+        v = super().get(key, default)
+        if key in self:                     # refresh recency
+            super().__delitem__(key)
+            super().__setitem__(key, v)
+        return v
+
+    def __setitem__(self, key, value):
+        if key in self:
+            super().__delitem__(key)
+        elif len(self) >= self.MAX:
+            super().__delitem__(next(iter(self)))
+        super().__setitem__(key, value)
+
+
+_PROG_CACHE: Dict[Any, Any] = _LRU()
 
 
 def _prepare(model, tokens, max_new_tokens: int):
@@ -185,15 +217,17 @@ def _prepare(model, tokens, max_new_tokens: int):
             f"exceeds max_length {model._max_length}")
     nh = next(iter(model.blocks._children.values()))._num_heads
     params = _collect(model)
-    return toks, params, nh, L
+    # static compile-time config — must NOT ride the jitted pytree
+    ga = (params.pop("gelu_approx"), params.pop("ln_eps"))
+    return toks, params, nh, L, ga
 
 
-def _model_sig(params, nh):
+def _model_sig(params, nh, ga):
     """Structural cache key — NOT id(model): a reused address must not
     serve a stale program, and identical-architecture models can share
     one compiled decode."""
     V, C = params["embed"].shape
-    return (nh, V, C, params["pos"].shape[0], len(params["blocks"]))
+    return (nh, V, C, params["pos"].shape[0], len(params["blocks"]), ga)
 
 
 def generate(model, tokens, max_new_tokens: int, method: str = "greedy",
@@ -207,7 +241,7 @@ def generate(model, tokens, max_new_tokens: int, method: str = "greedy",
     prefill+scan.
     """
     import numpy as onp
-    toks, params, nh, L = _prepare(model, tokens, max_new_tokens)
+    toks, params, nh, L, ga = _prepare(model, tokens, max_new_tokens)
     B, T0 = toks.shape
     eos = -1 if eos_token is None else int(eos_token)
     if method == "top_k":
@@ -216,12 +250,12 @@ def generate(model, tokens, max_new_tokens: int, method: str = "greedy",
             raise MXNetError(f"top_k must be >= 1, got {top_k}")
         top_k = min(int(top_k), V)
 
-    sig = ("gen", _model_sig(params, nh), B, T0, max_new_tokens, method,
-           float(temperature), int(top_k), eos)
+    sig = ("gen", _model_sig(params, nh, ga), B, T0, max_new_tokens,
+           method, float(temperature), int(top_k), eos)
     prog = _PROG_CACHE.get(sig)
     if prog is None:
         def run(params, toks, key):
-            logits, caches = _prefill(params, toks, nh, L)
+            logits, caches = _prefill(params, toks, nh, L, ga)
             key, sub = jax.random.split(key)
             first = _select(logits, method, temperature, top_k, sub)
             if eos >= 0:
@@ -233,7 +267,7 @@ def generate(model, tokens, max_new_tokens: int, method: str = "greedy",
                 caches, tok, done, key = carry
                 pos = T0 + i
                 logits, caches = _forward_step(params, tok, caches,
-                                               pos, nh)
+                                               pos, nh, ga)
                 key, sub = jax.random.split(key)
                 nxt = _select(logits, method, temperature, top_k, sub)
                 if eos >= 0:
@@ -267,7 +301,7 @@ def beam_search(model, tokens, max_new_tokens: int, beam_size: int = 4,
     no re-prefill, static shapes throughout.
     """
     import numpy as onp
-    toks, params, nh, L = _prepare(model, tokens, max_new_tokens)
+    toks, params, nh, L, ga = _prepare(model, tokens, max_new_tokens)
     B, T0 = toks.shape
     K = int(beam_size)
     if K < 1:
@@ -275,12 +309,12 @@ def beam_search(model, tokens, max_new_tokens: int, beam_size: int = 4,
     eos = -1 if eos_token is None else int(eos_token)
     NEG = jnp.float32(-1e30)
 
-    sig = ("beam", _model_sig(params, nh), B, T0, max_new_tokens, K,
-           eos, float(alpha))
+    sig = ("beam", _model_sig(params, nh, ga), B, T0, max_new_tokens,
+           K, eos, float(alpha))
     prog = _PROG_CACHE.get(sig)
     if prog is None:
         def run(params, toks):
-            logits, caches = _prefill(params, toks, nh, L)   # (B, V)
+            logits, caches = _prefill(params, toks, nh, L, ga)  # (B, V)
             V = logits.shape[-1]
             logp = jax.nn.log_softmax(logits, axis=-1)
             # seed the beams from the prompt's top-K continuations
@@ -297,7 +331,7 @@ def beam_search(model, tokens, max_new_tokens: int, beam_size: int = 4,
                 caches, tok, scores, seqs, done = carry
                 pos = T0 + i
                 logits, caches = _forward_step(params, tok, caches,
-                                               pos, nh)       # (B*K, V)
+                                               pos, nh, ga)   # (B*K, V)
                 logp = jax.nn.log_softmax(logits, axis=-1)
                 logp = logp.reshape(B, K, V)
                 if eos >= 0:

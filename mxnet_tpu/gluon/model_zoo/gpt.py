@@ -38,9 +38,13 @@ class GPTBlock(HybridBlock):
                  layer_norm_eps: float = 1e-5, moe_experts: int = 0,
                  moe_top_k: int = 2, moe_capacity_factor: float = 1.25,
                  moe_router_z_loss: float = 1e-3,
+                 gelu_approximate: bool = False,
                  **kwargs: Any) -> None:
         super().__init__(**kwargs)
         self._num_heads = num_heads
+        # GPT-2 proper uses the tanh approximation ("gelu_new"); exact
+        # erf GELU is the default here (and what BERT uses)
+        self._gelu_approximate = gelu_approximate
         self.ln1 = LayerNorm(epsilon=layer_norm_eps, in_channels=units)
         self.attn_qkv = Dense(3 * units, in_units=units, flatten=False)
         self.attn_out = Dense(units, in_units=units, flatten=False)
@@ -73,7 +77,8 @@ class GPTBlock(HybridBlock):
         if self.moe is not None:
             ffn = self.moe(h)
         else:
-            ffn = self.ffn2(npx.gelu(self.ffn1(h)))
+            ffn = self.ffn2(npx.gelu(self.ffn1(h),
+                                     approximate=self._gelu_approximate))
         if self._dropout:
             ffn = npx.dropout(ffn, self._dropout)
         return x + ffn
@@ -94,6 +99,8 @@ class GPTModel(HybridBlock):
                  moe_experts: int = 8, moe_top_k: int = 2,
                  moe_capacity_factor: float = 1.25,
                  moe_router_z_loss: float = 1e-3,
+                 gelu_approximate: bool = False,
+                 layer_norm_eps: float = 1e-5,
                  **kwargs: Any) -> None:
         super().__init__(**kwargs)
         self._units = units
@@ -108,12 +115,14 @@ class GPTModel(HybridBlock):
             is_moe = moe_every_n > 0 and (i + 1) % moe_every_n == 0
             self.blocks.add(GPTBlock(units, hidden_size, num_heads,
                                      dropout,
+                                     layer_norm_eps=layer_norm_eps,
                                      moe_experts=moe_experts if is_moe
                                      else 0,
                                      moe_top_k=moe_top_k,
                                      moe_capacity_factor=moe_capacity_factor,
-                                     moe_router_z_loss=moe_router_z_loss))
-        self.ln_f = LayerNorm(epsilon=1e-5, in_channels=units)
+                                     moe_router_z_loss=moe_router_z_loss,
+                                     gelu_approximate=gelu_approximate))
+        self.ln_f = LayerNorm(epsilon=layer_norm_eps, in_channels=units)
         self._dropout = dropout
 
     def forward(self, tokens: NDArray) -> NDArray:

@@ -107,6 +107,28 @@ def test_generate_greedy_matches_full_forward():
     onp.testing.assert_array_equal(got, onp.stack(want, axis=1))
 
 
+def test_generate_respects_layer_norm_eps():
+    """A non-default layer_norm_eps must flow into the decode path (the
+    pure-jax mirror reads the model's epsilon, not a constant)."""
+    import numpy as onp
+    import mxnet_tpu as mx
+    from mxnet_tpu.gluon.model_zoo.gpt import GPTModel
+    mx.random.seed(0)
+    net = GPTModel(vocab_size=61, num_layers=1, units=16,
+                   hidden_size=32, num_heads=2, max_length=32,
+                   dropout=0.0, layer_norm_eps=1e-2)
+    net.initialize()
+    net(mx.np.zeros((1, 3), dtype="int32"))
+    prompt = onp.random.RandomState(1).randint(0, 61, (1, 4)).astype(
+        "int32")
+    got = net.generate(prompt, 5).asnumpy()
+    toks = prompt.copy()
+    for _ in range(5):
+        nxt = net(mx.np.array(toks)).asnumpy()[:, -1].argmax(-1)
+        toks = onp.concatenate([toks, nxt[:, None].astype("int32")], 1)
+    onp.testing.assert_array_equal(got, toks[:, 4:])
+
+
 def test_generate_sampling_and_eos():
     import numpy as onp
     net = _tiny_gpt()
