@@ -1,0 +1,79 @@
+#!/usr/bin/env python
+"""GPT text generation: KV-cache decoding, sampling, and beam search.
+
+Demonstrates the inference surface of the GPT family: a prompt batch is
+prefilled once, then tokens decode one at a time against static-shape KV
+caches inside a single compiled lax.scan program (greedy / temperature /
+top-k), or via length-normalized beam search. With a real tokenizer and
+a converted HuggingFace checkpoint (``mxnet_tpu.contrib.hf``) this is a
+complete text-generation stack; here the model is randomly initialized
+so the output is structured noise — the point is the machinery and the
+throughput.
+
+    python examples/generate_gpt.py                   # real chip
+    python examples/generate_gpt.py --force-cpu --layers 2
+"""
+import argparse
+import os
+import sys
+import time
+
+sys.path.insert(0, os.path.dirname(os.path.dirname(os.path.abspath(__file__))))
+
+
+def main():
+    ap = argparse.ArgumentParser()
+    ap.add_argument("--batch", type=int, default=8)
+    ap.add_argument("--prompt-len", type=int, default=64)
+    ap.add_argument("--new-tokens", type=int, default=128)
+    ap.add_argument("--layers", type=int, default=12)
+    ap.add_argument("--beam", type=int, default=0,
+                    help="run beam search with this width instead")
+    ap.add_argument("--force-cpu", action="store_true")
+    args = ap.parse_args()
+
+    if args.force_cpu:
+        import jax
+        jax.config.update("jax_platforms", "cpu")
+
+    import numpy as onp
+    import mxnet_tpu as mx
+    mx.random.seed(0)
+    from mxnet_tpu.gluon.model_zoo.gpt import GPTModel
+    net = GPTModel(vocab_size=50257, num_layers=args.layers,
+                   units=768, hidden_size=3072, num_heads=12,
+                   max_length=1024, dropout=0.0)
+    net.initialize()
+    net(mx.np.zeros((1, 4), dtype="int32"))
+
+    rng = onp.random.RandomState(0)
+    prompt = rng.randint(0, 50257,
+                         (args.batch, args.prompt_len)).astype("int32")
+
+    if args.beam:
+        t0 = time.time()
+        seqs, scores = net.beam_search(prompt, args.new_tokens,
+                                       beam_size=args.beam)
+        dt = time.time() - t0
+        print(f"beam={args.beam}: best scores "
+              f"{[round(float(s), 2) for s in scores.asnumpy()[:3, 0]]} "
+              f"({dt:.1f}s incl. compile)")
+        return
+
+    # warm-up compiles prefill + scan; the second call is pure decode
+    t0 = time.time()
+    net.generate(prompt, args.new_tokens, method="top_k", top_k=40,
+                 temperature=0.9, seed=1)
+    t1 = time.time()
+    out = net.generate(prompt, args.new_tokens, method="top_k", top_k=40,
+                       temperature=0.9, seed=2)
+    t2 = time.time()
+    toks = args.batch * args.new_tokens
+    print(f"compile+first: {t1 - t0:.1f}s; steady decode: "
+          f"{toks / (t2 - t1):,.0f} tok/s "
+          f"({args.batch} seqs x {args.new_tokens} new tokens)")
+    print("first sequence head:", out.asnumpy()[0, :12].tolist())
+
+
+if __name__ == "__main__":
+    main()

@@ -115,9 +115,19 @@ def check_numeric_gradient(fn: Callable[..., NDArray],
 
     On TPU the matmul default precision is bfloat16, which swallows the
     ±eps perturbation entirely (numeric grads read as 0) — the whole
-    check runs under ``jax.default_matmul_precision('highest')``.
+    check runs under ``jax.default_matmul_precision('highest')``. On an
+    accelerator the central differences themselves carry extra fp32
+    rounding noise (transcendental libm deviations scale by 1/eps), so
+    tolerances floor at the reference's GPU-suite values (rtol=1e-2,
+    atol=1e-2).
     """
     import jax
+    # detect AFTER wrapping: raw numpy inputs land on the current default
+    # context, which is the accelerator when one exists
+    inputs = [x if isinstance(x, NDArray) else NDArray(x) for x in inputs]
+    accel = any(x.context.device_type != "cpu" for x in inputs)
+    if accel:
+        rtol, atol = max(rtol, 1e-2), max(atol, 1e-2)
     with jax.default_matmul_precision("highest"):
         _check_numeric_gradient_impl(fn, inputs, eps, rtol, atol)
 
@@ -165,15 +175,29 @@ def check_consistency(fn: Callable[..., NDArray],
     The reference's THE cross-backend primitive (cpu/gpu/fp16 there;
     cpu/tpu/bf16 here).
     """
+    ctxs = list(ctx_list or [cpu(), default_context()])
     results = []
-    for ctx in (ctx_list or [cpu(), default_context()]):
+    for ctx in ctxs:
         for dt in dtypes:
             args = [NDArray(a.astype(dt), ctx=ctx) for a in inputs_np]
             results.append((ctx, dt, fn(*args).asnumpy()))
+    ref_ctx = ctxs[0]
     ref = results[0][2]
     for ctx, dt, out in results[1:]:
+        r = rtol if rtol is not None else _RTOLS.get(_np.dtype(dt), 1e-3)
+        a = atol if atol is not None else _ATOLS.get(_np.dtype(dt), 1e-4)
+        if ctx.device_type != ref_ctx.device_type:
+            # cross-BACKEND fp32 comparison: accelerator libm
+            # (transcendental approximations) legitimately deviates from
+            # host libm at the ~1e-4 level; the reference's
+            # check_consistency used 1e-3-class tolerances for exactly
+            # this cpu-vs-gpu case. Same-backend checks keep the tight
+            # tolerance; each bound loosens only if the caller did not
+            # set it explicitly.
+            if rtol is None:
+                r = max(r, 1e-3)
+            if atol is None:
+                a = max(a, 1e-4)
         assert_almost_equal(
             ref.astype(_np.float32), out.astype(_np.float32),
-            rtol=rtol if rtol is not None else _RTOLS.get(_np.dtype(dt), 1e-3),
-            atol=atol if atol is not None else _ATOLS.get(_np.dtype(dt), 1e-4),
-            names=("reference", f"{ctx}/{dt}"))
+            rtol=r, atol=a, names=("reference", f"{ctx}/{dt}"))
