@@ -1,9 +1,13 @@
 """``gluon.model_zoo`` (reference: python/mxnet/gluon/model_zoo) plus the
-NLP models (BERT per gluon-nlp; GPT beyond-reference)."""
+NLP models (BERT per gluon-nlp; GPT and the encoder-decoder Transformer
+beyond-reference, with KV-cache generation)."""
 from . import vision
 from . import bert
 from . import gpt
+from . import transformer
 from .bert import get_bert
 from .gpt import get_gpt
+from .transformer import get_transformer
 
-__all__ = ["vision", "bert", "gpt", "get_bert", "get_gpt"]
+__all__ = ["vision", "bert", "gpt", "transformer", "get_bert",
+           "get_gpt", "get_transformer"]

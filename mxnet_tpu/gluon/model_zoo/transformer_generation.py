@@ -1,0 +1,347 @@
+"""KV-cache incremental decoding for the encoder-decoder Transformer.
+
+Same design as ``generation.py`` (GPT): static-shape self-attention
+caches written with ``dynamic_update_slice``, one compiled
+encode+prefill+``lax.scan`` program per shape signature, on-device
+sampling, beam search with batched cache reorder. The seq2seq twists:
+
+* the encoder runs once; each decoder layer's CROSS-attention keys and
+  values are projected from the memory once at prefill and stay fixed
+  through the scan (no cache writes);
+* decoding starts from ``bos_token`` with an empty self-cache rather
+  than from a prompt prefill;
+* the source padding mask rides along as an additive bias on the
+  cross-attention scores.
+
+The pure-jax math mirrors ``TransformerDecoderLayer.forward`` exactly;
+``tests/test_transformer.py`` pins greedy decode to a naive
+full-recompute reference.
+"""
+from __future__ import annotations
+
+import math
+from typing import Any, Dict, List, Optional
+
+import jax
+import jax.numpy as jnp
+from jax import lax
+
+from ...base import MXNetError
+from .generation import _LRU, _ln, _select
+
+__all__ = ["translate", "beam_translate"]
+
+_PROG_CACHE: Dict[Any, Any] = _LRU()
+
+
+def _j(p) -> jnp.ndarray:
+    return jnp.asarray(p.data()._data)
+
+
+def _enc_layer_params(lyr) -> Dict[str, jnp.ndarray]:
+    return {
+        "ln1_g": _j(lyr.ln1.gamma), "ln1_b": _j(lyr.ln1.beta),
+        "qkv_w": _j(lyr.attn_qkv.weight), "qkv_b": _j(lyr.attn_qkv.bias),
+        "out_w": _j(lyr.attn_out.weight), "out_b": _j(lyr.attn_out.bias),
+        "ln2_g": _j(lyr.ln2.gamma), "ln2_b": _j(lyr.ln2.beta),
+        "f1_w": _j(lyr.ffn1.weight), "f1_b": _j(lyr.ffn1.bias),
+        "f2_w": _j(lyr.ffn2.weight), "f2_b": _j(lyr.ffn2.bias),
+    }
+
+
+def _collect(model) -> Dict[str, Any]:
+    enc = [_enc_layer_params(l)
+           for l in model.enc_layers._children.values()]
+    dec = []
+    for l in model.dec_layers._children.values():
+        p = _enc_layer_params(l)
+        p.update({
+            "lnc_g": _j(l.ln_cross.gamma), "lnc_b": _j(l.ln_cross.beta),
+            "cq_w": _j(l.cross_q.weight), "cq_b": _j(l.cross_q.bias),
+            "ckv_w": _j(l.cross_kv.weight), "ckv_b": _j(l.cross_kv.bias),
+            "co_w": _j(l.cross_out.weight), "co_b": _j(l.cross_out.bias),
+        })
+        dec.append(p)
+    return {
+        "src_embed": _j(model.src_embed.weight),
+        "tgt_embed": _j(model.tgt_embed.weight),
+        "src_pos": _j(model.src_pos), "tgt_pos": _j(model.tgt_pos),
+        "encln_g": _j(model.enc_ln.gamma), "encln_b": _j(model.enc_ln.beta),
+        "decln_g": _j(model.dec_ln.gamma), "decln_b": _j(model.dec_ln.beta),
+        "enc": enc, "dec": dec,
+    }
+
+
+def _attn(qh, kh, vh, bias=None):
+    """(B, Tq, nh, d) x (B, Tk, nh, d) -> (B, Tq, nh, d); bias is an
+    additive (B or 1, 1, Tq or 1, Tk) term."""
+    d = qh.shape[-1]
+    scores = jnp.einsum("bqhd,bkhd->bhqk", qh, kh) / math.sqrt(d)
+    if bias is not None:
+        scores = scores + bias
+    probs = jax.nn.softmax(scores, axis=-1)
+    return jnp.einsum("bhqk,bkhd->bqhd", probs, vh)
+
+
+def _encode(params, src, src_vl, nh, eps):
+    B, Ts = src.shape
+    x = params["src_embed"][src] + params["src_pos"][None, :Ts]
+    src_bias = None
+    if src_vl is not None:
+        keep = jnp.arange(Ts)[None, :] < src_vl[:, None].astype(jnp.int32)
+        src_bias = jnp.where(keep, 0.0, -jnp.inf)[:, None, None, :]
+    for p in params["enc"]:
+        h = _ln(x, p["ln1_g"], p["ln1_b"], eps)
+        qkv = h @ p["qkv_w"].T + p["qkv_b"]
+        q, k, v = jnp.split(qkv, 3, axis=-1)
+        C = q.shape[-1]
+        d = C // nh
+        out = _attn(q.reshape(B, Ts, nh, d), k.reshape(B, Ts, nh, d),
+                    v.reshape(B, Ts, nh, d), src_bias)
+        x = x + (out.reshape(B, Ts, C) @ p["out_w"].T + p["out_b"])
+        h = _ln(x, p["ln2_g"], p["ln2_b"], eps)
+        ffn = jax.nn.gelu(h @ p["f1_w"].T + p["f1_b"], approximate=False)
+        x = x + (ffn @ p["f2_w"].T + p["f2_b"])
+    memory = _ln(x, params["encln_g"], params["encln_b"], eps)
+    # project every decoder layer's cross k/v ONCE
+    cross = []
+    for p in params["dec"]:
+        kv = memory @ p["ckv_w"].T + p["ckv_b"]
+        k, v = jnp.split(kv, 2, axis=-1)
+        C = k.shape[-1]
+        d = C // nh
+        cross.append((k.reshape(B, Ts, nh, d), v.reshape(B, Ts, nh, d)))
+    return cross, src_bias
+
+
+def _dec_step(params, tok, self_caches, cross, src_bias, pos, nh, eps,
+              L):
+    """One decode step: tok (B,), self caches (B, L, nh, d) per layer."""
+    B = tok.shape[0]
+    x = params["tgt_embed"][tok][:, None, :] + \
+        lax.dynamic_slice_in_dim(params["tgt_pos"], pos, 1,
+                                 axis=0)[None, :, :]
+    new_caches = []
+    for p, (ck, cv), (mk, mv) in zip(params["dec"], self_caches, cross):
+        C = x.shape[-1]
+        d = C // nh
+        h = _ln(x, p["ln1_g"], p["ln1_b"], eps)
+        qkv = h @ p["qkv_w"].T + p["qkv_b"]
+        q, k, v = jnp.split(qkv, 3, axis=-1)
+        ck = lax.dynamic_update_slice_in_dim(
+            ck, k.reshape(B, 1, nh, d), pos, axis=1)
+        cv = lax.dynamic_update_slice_in_dim(
+            cv, v.reshape(B, 1, nh, d), pos, axis=1)
+        visible = (jnp.arange(L) <= pos)
+        self_bias = jnp.where(visible, 0.0, -jnp.inf)[None, None, None, :]
+        out = _attn(q.reshape(B, 1, nh, d), ck, cv, self_bias)
+        x = x + (out.reshape(B, 1, C) @ p["out_w"].T + p["out_b"])
+        h = _ln(x, p["lnc_g"], p["lnc_b"], eps)
+        cq = (h @ p["cq_w"].T + p["cq_b"]).reshape(B, 1, nh, d)
+        cout = _attn(cq, mk, mv, src_bias)
+        x = x + (cout.reshape(B, 1, C) @ p["co_w"].T + p["co_b"])
+        h = _ln(x, p["ln2_g"], p["ln2_b"], eps)
+        ffn = jax.nn.gelu(h @ p["f1_w"].T + p["f1_b"], approximate=False)
+        x = x + (ffn @ p["f2_w"].T + p["f2_b"])
+        new_caches.append((ck, cv))
+    x = _ln(x, params["decln_g"], params["decln_b"], eps)
+    return x[:, 0, :] @ params["tgt_embed"].T, new_caches
+
+
+def _prepare(model, src, max_new_tokens, src_valid_length):
+    import numpy as onp
+    s = onp.asarray(src.asnumpy() if hasattr(src, "asnumpy") else src,
+                    dtype="int32")
+    if s.ndim == 1:
+        s = s[None, :]
+    if max_new_tokens < 1:
+        raise MXNetError("max_new_tokens must be >= 1")
+    if max_new_tokens > model._max_length:
+        raise MXNetError(
+            f"max_new_tokens ({max_new_tokens}) exceeds max_length "
+            f"{model._max_length}")
+    if s.shape[1] > model._max_length:
+        raise MXNetError(
+            f"source length {s.shape[1]} exceeds max_length "
+            f"{model._max_length}")
+    vl = None
+    if src_valid_length is not None:
+        vl = onp.asarray(
+            src_valid_length.asnumpy()
+            if hasattr(src_valid_length, "asnumpy") else src_valid_length,
+            dtype="int32")
+    nh = next(iter(model.dec_layers._children.values()))._num_heads
+    eps = float(next(iter(
+        model.dec_layers._children.values())).ln1._epsilon)
+    params = _collect(model)
+    return s, vl, params, nh, eps
+
+
+def _model_sig(params, nh, eps):
+    V, C = params["tgt_embed"].shape
+    return (nh, V, C, params["tgt_pos"].shape[0], len(params["enc"]),
+            len(params["dec"]), eps)
+
+
+def _empty_caches(params, B, L, nh):
+    C = params["tgt_embed"].shape[1]
+    d = C // nh
+    dt = params["tgt_embed"].dtype        # cast models cache in kind
+    return [(jnp.zeros((B, L, nh, d), dt),
+             jnp.zeros((B, L, nh, d), dt))
+            for _ in params["dec"]]
+
+
+def translate(model, src, max_new_tokens: int, bos_token: int,
+              eos_token: Optional[int] = None, src_valid_length=None,
+              method: str = "greedy", temperature: float = 1.0,
+              top_k: int = 40, seed: int = 0):
+    """Decode target tokens for ``src`` starting from ``bos_token``."""
+    import numpy as onp
+    s, vl, params, nh, eps = _prepare(model, src, max_new_tokens,
+                                      src_valid_length)
+    B, Ts = s.shape
+    eos = -1 if eos_token is None else int(eos_token)
+    bos = int(bos_token)
+    if method == "top_k":
+        if top_k < 1:
+            raise MXNetError(f"top_k must be >= 1, got {top_k}")
+        top_k = min(int(top_k), params["tgt_embed"].shape[0])
+    has_vl = vl is not None
+    L = max_new_tokens
+
+    sig = ("tr", _model_sig(params, nh, eps), B, Ts, max_new_tokens,
+           method, float(temperature), int(top_k), eos, bos, has_vl)
+    prog = _PROG_CACHE.get(sig)
+    if prog is None:
+        def run(params, s, vl, key):
+            cross, src_bias = _encode(params, s, vl, nh, eps)
+            caches = _empty_caches(params, B, L, nh)
+
+            def step(carry, i):
+                caches, tok, done, key = carry
+                logits, caches = _dec_step(params, tok, caches, cross,
+                                           src_bias, i, nh, eps, L)
+                key, sub = jax.random.split(key)
+                nxt = _select(logits, method, temperature, top_k, sub)
+                if eos >= 0:
+                    nxt = jnp.where(done, eos, nxt)
+                    done = done | (nxt == eos)
+                return (caches, nxt, done, key), nxt
+
+            bos_t = jnp.full((B,), bos, jnp.int32)
+            done0 = jnp.zeros((B,), bool)
+            (_, _, _, _), toks = lax.scan(
+                step, (caches, bos_t, done0, key),
+                jnp.arange(max_new_tokens))
+            return toks.T                          # (B, max_new)
+
+        prog = jax.jit(run, static_argnums=())
+        _PROG_CACHE[sig] = prog
+    out = prog(params, jnp.asarray(s),
+               None if vl is None else jnp.asarray(vl),
+               jax.random.PRNGKey(seed))
+    from ...ndarray.ops import array
+    return array(onp.asarray(out))
+
+
+def beam_translate(model, src, max_new_tokens: int, bos_token: int,
+                   beam_size: int = 4, eos_token: Optional[int] = None,
+                   src_valid_length=None, alpha: float = 1.0):
+    """Length-normalized beam search; returns (sequences (B, beam,
+    max_new_tokens), scores (B, beam)) best-first."""
+    import numpy as onp
+    s, vl, params, nh, eps = _prepare(model, src, max_new_tokens,
+                                      src_valid_length)
+    B, Ts = s.shape
+    K = int(beam_size)
+    if K < 1:
+        raise MXNetError(f"beam_size must be >= 1, got {K}")
+    eos = -1 if eos_token is None else int(eos_token)
+    bos = int(bos_token)
+    has_vl = vl is not None
+    L = max_new_tokens
+    NEG = jnp.float32(-1e30)
+
+    sig = ("btr", _model_sig(params, nh, eps), B, Ts, max_new_tokens,
+           K, eos, bos, float(alpha), has_vl)
+    prog = _PROG_CACHE.get(sig)
+    if prog is None:
+        def run(params, s, vl):
+            cross, src_bias = _encode(params, s, vl, nh, eps)
+            # expand beam state: rows beam-major within batch. Cross k/v
+            # and the source bias depend only on the batch element, so a
+            # within-batch beam permutation never changes them — expand
+            # once, never reorder.
+            cross = jax.tree_util.tree_map(
+                lambda c: jnp.repeat(c, K, axis=0), cross)
+            if src_bias is not None:
+                src_bias = jnp.repeat(src_bias, K, axis=0)
+            caches = _empty_caches(params, B * K, L, nh)
+            V = params["tgt_embed"].shape[0]
+
+            # step 0: all beams feed bos; keep only beam 0 live so the
+            # K continuations seed from the bos distribution
+            bos_t = jnp.full((B * K,), bos, jnp.int32)
+            logits, caches = _dec_step(params, bos_t, caches, cross,
+                                       src_bias, 0, nh, eps, L)
+            logp = jax.nn.log_softmax(
+                logits.reshape(B, K, V)[:, 0, :], axis=-1)
+            scores, first = lax.top_k(logp, K)       # (B, K)
+            tok = first.reshape(B * K)
+            done = (tok == eos) if eos >= 0 else \
+                jnp.zeros((B * K,), bool)
+            seqs0 = jnp.zeros((B, K, max_new_tokens), jnp.int32)
+            seqs0 = seqs0.at[:, :, 0].set(first)
+
+            def step(carry, i):
+                caches, tok, scores, seqs, done = carry
+                logits, caches = _dec_step(params, tok, caches, cross,
+                                           src_bias, i, nh, eps, L)
+                logp = jax.nn.log_softmax(logits, axis=-1).reshape(
+                    B, K, V)
+                if eos >= 0:
+                    only_eos = jnp.full((V,), NEG).at[eos].set(0.0)
+                    logp = jnp.where(done.reshape(B, K, 1), only_eos,
+                                     logp)
+                cand = (scores[:, :, None] + logp).reshape(B, K * V)
+                scores, idx = lax.top_k(cand, K)
+                beam_src = idx // V
+                tok2 = (idx % V).astype(jnp.int32)
+                gather = (jnp.arange(B)[:, None] * K
+                          + beam_src).reshape(B * K)
+                caches = jax.tree_util.tree_map(lambda c: c[gather],
+                                                caches)
+                seqs = jnp.take_along_axis(seqs, beam_src[:, :, None],
+                                           axis=1)
+                seqs = seqs.at[:, :, i].set(tok2)
+                done = done[gather]
+                tokf = tok2.reshape(B * K)
+                if eos >= 0:
+                    done = done | (tokf == eos)
+                return (caches, tokf, scores, seqs, done), None
+
+            if max_new_tokens > 1:
+                (caches, tok, scores, seqs, done), _ = lax.scan(
+                    step, (caches, tok, scores, seqs0, done),
+                    jnp.arange(1, max_new_tokens))
+            else:
+                seqs = seqs0
+            if eos >= 0:
+                lengths = jnp.sum(
+                    jnp.cumsum(seqs == eos, axis=-1) == 0, axis=-1) + 1
+                lengths = jnp.minimum(lengths, max_new_tokens)
+            else:
+                lengths = jnp.full((B, K), max_new_tokens)
+            norm = scores / (lengths.astype(jnp.float32) ** alpha)
+            order = jnp.argsort(-norm, axis=-1)
+            seqs = jnp.take_along_axis(seqs, order[:, :, None], axis=1)
+            norm = jnp.take_along_axis(norm, order, axis=1)
+            return seqs, norm
+
+        prog = jax.jit(run)
+        _PROG_CACHE[sig] = prog
+    seqs, scores = prog(params, jnp.asarray(s),
+                        None if vl is None else jnp.asarray(vl))
+    from ...ndarray.ops import array
+    return array(onp.asarray(seqs)), array(onp.asarray(scores))

@@ -30,20 +30,30 @@ class Trainer:
                  compression_params: Optional[Dict[str, Any]] = None,
                  update_on_kvstore: Optional[bool] = None) -> None:
         if isinstance(params, dict):
-            param_list = list(params.values())
-            self._param_names = list(params.keys())
+            named = list(params.items())
         elif isinstance(params, (list, tuple)):
-            param_list = list(params)
-            self._param_names = [p.name for p in param_list]
+            named = [(getattr(p, "name", str(i)), p)
+                     for i, p in enumerate(params)]
         else:
             raise MXNetError(
                 "Trainer expects a ParameterDict (from collect_params()) or "
                 f"a list of Parameters, got {type(params)}")
         self._params: List[Parameter] = []
+        self._param_names: List[str] = []
         self._params_to_init: List[Parameter] = []
-        for p in param_list:
+        seen = set()
+        for name, p in named:
             if not isinstance(p, Parameter):
                 raise MXNetError(f"non-Parameter {p!r} passed to Trainer")
+            # a SHARED parameter (e.g. tied embeddings registered under
+            # two names) must be optimized exactly once — the reference
+            # dedupes shared params the same way; double entry would
+            # double-count its gradient and double-donate its buffer.
+            # Names stay index-aligned with the kept parameters.
+            if id(p) in seen:
+                continue
+            seen.add(id(p))
+            self._param_names.append(name)
             self._params.append(p)
 
         optimizer_params = optimizer_params or {}

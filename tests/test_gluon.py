@@ -336,7 +336,11 @@ def test_sdml_loss_prefers_aligned_pairs():
     # constant label-entropy term on top of the cross-entropy
     ent = (1 - s) * onp.log(1 - s) + s * onp.log(s / (N - 1))
     ref = ent - (lab * lp).sum(axis=1)
-    onp.testing.assert_allclose(aligned, ref, rtol=1e-4, atol=1e-5)
+    # accelerator libm/matmul carries ~2e-4 relative deviation on the
+    # pairwise-distance matmul (cross-backend class, see test_utils)
+    from mxnet_tpu.test_utils import default_context
+    tol = 1e-3 if default_context().device_type != "cpu" else 1e-4
+    onp.testing.assert_allclose(aligned, ref, rtol=tol, atol=tol / 10)
 
     x1.attach_grad()
     with ag.record():
