@@ -89,7 +89,11 @@ def _encode(params, src, src_vl, nh, eps):
     src_bias = None
     if src_vl is not None:
         keep = jnp.arange(Ts)[None, :] < src_vl[:, None].astype(jnp.int32)
-        src_bias = jnp.where(keep, 0.0, -jnp.inf)[:, None, None, :]
+        # finfo.min, not -inf: a fully-padded row (valid_length 0) must
+        # degrade to uniform attention like the training path
+        # (_mask_to_bias), not softmax(-inf...) = NaN
+        src_bias = jnp.where(keep, 0.0,
+                             jnp.finfo(jnp.float32).min)[:, None, None, :]
     for p in params["enc"]:
         h = _ln(x, p["ln1_g"], p["ln1_b"], eps)
         qkv = h @ p["qkv_w"].T + p["qkv_b"]
@@ -133,7 +137,8 @@ def _dec_step(params, tok, self_caches, cross, src_bias, pos, nh, eps,
         cv = lax.dynamic_update_slice_in_dim(
             cv, v.reshape(B, 1, nh, d), pos, axis=1)
         visible = (jnp.arange(L) <= pos)
-        self_bias = jnp.where(visible, 0.0, -jnp.inf)[None, None, None, :]
+        self_bias = jnp.where(
+            visible, 0.0, jnp.finfo(jnp.float32).min)[None, None, None, :]
         out = _attn(q.reshape(B, 1, nh, d), ck, cv, self_bias)
         x = x + (out.reshape(B, 1, C) @ p["out_w"].T + p["out_b"])
         h = _ln(x, p["lnc_g"], p["lnc_b"], eps)

@@ -124,6 +124,15 @@ DEFAULT_TRANSFORMER_RULES = PartitionRules([
     (r"ffn2\.weight$", P(None, "tp")),
     (r"attn_qkv\.bias$", P("tp")),
     (r"ffn1\.bias$", P("tp")),
+    # seq2seq decoder cross-attention (model_zoo.transformer): q and kv
+    # projections column-parallel, output row-parallel — same Megatron
+    # split as self-attention
+    (r"cross_q\.weight$", P("tp", None)),
+    (r"cross_q\.bias$", P("tp")),
+    (r"cross_kv\.weight$", P("tp", None)),
+    (r"cross_kv\.bias$", P("tp")),
+    (r"cross_out\.weight$", P(None, "tp")),
+    (r"(src|tgt)_embed\.weight$", P("tp", None)),
     (r"word_embed\.weight$", P("tp", None)),
     (r"mlm_bias$", P("tp")),
 ])
@@ -173,10 +182,18 @@ class SPMDTrainer:
         self._data_spec = data_spec
         self._label_spec = label_spec
 
-        self._params: List[Parameter] = [
-            p for p in block.collect_params().values() if p.is_initialized]
-        self._names = [k for k, p in block.collect_params().items()
-                       if p.is_initialized]
+        # SHARED parameters (tied embeddings registered under two names)
+        # enter once, under their first name — a duplicate would bind the
+        # same buffer twice in the traced step and double-count its grad
+        self._params: List[Parameter] = []
+        self._names: List[str] = []
+        seen = set()
+        for k, p in block.collect_params().items():
+            if not p.is_initialized or id(p) in seen:
+                continue
+            seen.add(id(p))
+            self._params.append(p)
+            self._names.append(k)
         # launder eager-produced parameter buffers first (axon: lazy
         # handles cost a tunnel round-trip PER PARAM per step — see
         # engine.launder), then place onto the mesh per rules
