@@ -1,0 +1,81 @@
+#!/usr/bin/env python
+"""Seq2seq Transformer training + beam-search translation.
+
+The gluon-nlp NMT recipe shape on a synthetic copy/reversal task: teacher
+forcing with SoftmaxCE, then KV-cache beam translation. Swap the toy data
+generator for a real tokenized corpus and this is the full pipeline.
+
+    python examples/train_transformer_nmt.py --force-cpu
+    python tools/launch.py -n 2 python examples/train_transformer_nmt.py  # dp
+"""
+import argparse
+import os
+import sys
+import time
+
+sys.path.insert(0, os.path.dirname(os.path.dirname(os.path.abspath(__file__))))
+
+
+def main():
+    ap = argparse.ArgumentParser()
+    ap.add_argument("--steps", type=int, default=300)
+    ap.add_argument("--batch", type=int, default=32)
+    ap.add_argument("--seq", type=int, default=10)
+    ap.add_argument("--vocab", type=int, default=120)
+    ap.add_argument("--force-cpu", action="store_true")
+    args = ap.parse_args()
+    if args.force_cpu:
+        import jax
+        jax.config.update("jax_platforms", "cpu")
+
+    import numpy as onp
+    import mxnet_tpu as mx
+    from mxnet_tpu.gluon.model_zoo.transformer import TransformerModel
+
+    BOS, EOS = 1, 2
+    mx.random.seed(0)
+    net = TransformerModel(src_vocab_size=args.vocab,
+                           num_encoder_layers=2, num_decoder_layers=2,
+                           units=128, hidden_size=512, num_heads=8,
+                           max_length=args.seq + 4, dropout=0.1)
+    net.initialize()
+    net(mx.np.zeros((1, 4), dtype="int32"),
+        mx.np.zeros((1, 3), dtype="int32"))
+
+    tr = mx.gluon.Trainer(net.collect_params(), "adam",
+                          {"learning_rate": 1e-3})
+    loss_fn = mx.gluon.loss.SoftmaxCrossEntropyLoss()
+    rng = onp.random.RandomState(0)
+
+    def batch():
+        src = rng.randint(3, args.vocab, (args.batch, args.seq)) \
+                 .astype("int32")
+        tgt = src[:, ::-1].copy()                  # task: reverse
+        tgt_in = onp.concatenate(
+            [onp.full((args.batch, 1), BOS, "int32"), tgt[:, :-1]], 1)
+        return src, tgt_in, tgt
+
+    t0 = time.time()
+    for step in range(1, args.steps + 1):
+        src, tgt_in, tgt = batch()
+        with mx.autograd.record():
+            logits = net(mx.np.array(src), mx.np.array(tgt_in))
+            loss = loss_fn(logits.reshape(-1, args.vocab),
+                           mx.np.array(tgt.reshape(-1))).mean()
+        loss.backward()
+        tr.step(args.batch)
+        if step % 50 == 0:
+            tps = step * args.batch * args.seq / (time.time() - t0)
+            print(f"step {step:4d} loss {float(loss.asnumpy()):.4f} "
+                  f"({tps:,.0f} tok/s)")
+
+    # translate a fresh batch with beam search; score exact reversals
+    src, _, tgt = batch()
+    seqs, scores = net.beam_translate(src[:8], args.seq, bos_token=BOS,
+                                      beam_size=4)
+    hits = (seqs.asnumpy()[:, 0, :] == tgt[:8]).mean()
+    print(f"beam-1 token accuracy on held-out batch: {hits:.1%}")
+
+
+if __name__ == "__main__":
+    main()

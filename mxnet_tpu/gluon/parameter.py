@@ -284,3 +284,18 @@ class Constant(Parameter):
             ctx = ctx[0] if ctx else None
         self._data = self._value.as_in_context(ctx) if ctx else self._value
         self._ctx = ctx
+
+
+def dedupe_shared(named_params):
+    """Keep each Parameter once, under its first name (tied/shared
+    parameters register under several names; a trainer must optimize
+    them exactly once or gradients double-count and the fused update
+    donates one buffer twice). Returns (names, params) index-aligned."""
+    names, params, seen = [], [], set()
+    for name, p in named_params:
+        if id(p) in seen:
+            continue
+        seen.add(id(p))
+        names.append(name)
+        params.append(p)
+    return names, params

@@ -38,23 +38,12 @@ class Trainer:
             raise MXNetError(
                 "Trainer expects a ParameterDict (from collect_params()) or "
                 f"a list of Parameters, got {type(params)}")
-        self._params: List[Parameter] = []
-        self._param_names: List[str] = []
-        self._params_to_init: List[Parameter] = []
-        seen = set()
-        for name, p in named:
+        for _, p in named:
             if not isinstance(p, Parameter):
                 raise MXNetError(f"non-Parameter {p!r} passed to Trainer")
-            # a SHARED parameter (e.g. tied embeddings registered under
-            # two names) must be optimized exactly once — the reference
-            # dedupes shared params the same way; double entry would
-            # double-count its gradient and double-donate its buffer.
-            # Names stay index-aligned with the kept parameters.
-            if id(p) in seen:
-                continue
-            seen.add(id(p))
-            self._param_names.append(name)
-            self._params.append(p)
+        from .parameter import dedupe_shared
+        self._param_names, self._params = dedupe_shared(named)
+        self._params_to_init: List[Parameter] = []
 
         optimizer_params = optimizer_params or {}
         if isinstance(optimizer, opt.Optimizer):

@@ -185,15 +185,10 @@ class SPMDTrainer:
         # SHARED parameters (tied embeddings registered under two names)
         # enter once, under their first name — a duplicate would bind the
         # same buffer twice in the traced step and double-count its grad
-        self._params: List[Parameter] = []
-        self._names: List[str] = []
-        seen = set()
-        for k, p in block.collect_params().items():
-            if not p.is_initialized or id(p) in seen:
-                continue
-            seen.add(id(p))
-            self._params.append(p)
-            self._names.append(k)
+        from ..gluon.parameter import dedupe_shared
+        self._names, self._params = dedupe_shared(
+            (k, p) for k, p in block.collect_params().items()
+            if p.is_initialized)
         # launder eager-produced parameter buffers first (axon: lazy
         # handles cost a tunnel round-trip PER PARAM per step — see
         # engine.launder), then place onto the mesh per rules
