@@ -14,6 +14,8 @@
 #   ci/run.sh dryrun        # multichip sharding dry run + entry compile
 #   ci/run.sh tpu-sweep     # op sweep against the real chip
 #                           #   (MXNET_TEST_CTX=tpu ctx-flip)
+#   ci/run.sh tpu-core      # sweep + core-file sample on the chip
+#                           #   (~510 tests, the tractable chip gate)
 #   ci/run.sh tpu-unit      # the WHOLE suite with default ctx = tpu
 #                           #   (test_operator_gpu.py "rerun everything
 #                           #   on the accelerator" analog)
@@ -64,6 +66,16 @@ run_tpu_sweep() {
   MXNET_TEST_CTX=tpu python -m pytest tests/test_op_sweep.py -q
 }
 
+run_tpu_core() {
+  echo "== tpu-core: op sweep + core file sample with default ctx = tpu"
+  echo "   (the tractable on-chip gate; tpu-unit is the exhaustive one)"
+  MXNET_TEST_CTX=tpu python -m pytest -q tests/test_op_sweep.py \
+    tests/test_autograd.py tests/test_gluon.py tests/test_optimizer.py \
+    tests/test_ndarray.py tests/test_numpy.py tests/test_rnn.py \
+    tests/test_misc.py tests/test_sparse.py tests/test_image.py \
+    tests/test_amp.py
+}
+
 run_tpu_unit() {
   echo "== tpu-unit: the WHOLE suite with default ctx = tpu (the"
   echo "   reference's test_operator_gpu.py ctx-flip; host-only"
@@ -79,6 +91,7 @@ case "$variant" in
   naive-engine) run_naive_engine ;;
   dryrun)       run_dryrun ;;
   tpu-sweep)    run_tpu_sweep ;;
+  tpu-core)     run_tpu_core ;;
   tpu-unit)     run_tpu_unit ;;
   all)
     run_native
