@@ -365,7 +365,10 @@ class HybridBlock(Block):
     def _call_cached(self, *args: Any) -> Any:
         nd_args = [a if isinstance(a, NDArray) else NDArray(a) for a in args]
         self._ensure_shapes(*nd_args)
-        params = [p for p in self.collect_params().values() if p.is_initialized]
+        from .parameter import dedupe_shared
+        _, params = dedupe_shared(
+            (k, p) for k, p in self.collect_params().items()
+            if p.is_initialized)
 
         train = is_training()
         self._last_sig = [(tuple(a.shape), a.dtype) for a in nd_args]
@@ -457,8 +460,15 @@ class HybridBlock(Block):
                 "(after hybridize()) or pass input_signature=[(shape, "
                 "dtype), ...]")
 
-        params = {k: v for k, v in self.collect_params().items()
-                  if v.is_initialized}
+        # tied/shared parameters (same object under several names) save
+        # and trace ONCE, under their first name — a duplicate would
+        # double-bind the buffer in the trace and read as a phantom
+        # in-trace mutation
+        from .parameter import dedupe_shared
+        _pnames, _plist = dedupe_shared(
+            (k, p) for k, p in self.collect_params().items()
+            if p.is_initialized)
+        params = dict(zip(_pnames, _plist))
 
         from jax import export as jax_export
         param_list = list(params.values())
@@ -503,10 +513,15 @@ class HybridBlock(Block):
         from .deploy import deploy_graph
         meta["deploy_graph"] = deploy_graph(self)
         # write artifacts only after trace + serialization succeeded — a
-        # failed export must not leave a stale .params behind
+        # failed export must not leave a stale .params behind. The FILE
+        # carries EVERY name, aliases included (same array under each):
+        # load_parameters and the native deploy_graph resolve parameters
+        # by name and must find all of them; only the trace deduped.
         param_file = f"{path}-{epoch:04d}.params"
         from ..ndarray_io import save_params
-        save_params(param_file, {k: v.data() for k, v in params.items()})
+        save_params(param_file,
+                    {k: p.data() for k, p in self.collect_params().items()
+                     if p.is_initialized})
         sym_file = f"{path}-symbol.json"
         with open(sym_file, "w") as f:
             json.dump(meta, f, indent=2)

@@ -182,3 +182,30 @@ def test_seq2seq_tp_training_matches_replicated():
             full = net.dec_layers[0].cross_kv.weight.shape[0]
             assert kv.addressable_shards[0].data.shape[0] == full // 2
     assert abs(outs[0] - outs[1]) < 1e-4, outs
+
+
+def test_shared_embedding_hybridize_and_export(tmp_path):
+    """Tied src/tgt embeddings (one Parameter under two names) must
+    hybridize and export/reimport cleanly — the trace binds each
+    parameter once (a double bind read as a phantom in-trace mutation
+    and broke export)."""
+    from mxnet_tpu.gluon.block import SymbolBlock
+    net = _tiny(src_vocab=41, units=16, heads=2, layers=1, max_len=16)
+    assert net.src_embed is net.tgt_embed
+    net.hybridize()
+    src = mx.np.array(onp.random.RandomState(0).randint(
+        0, 41, (2, 6)).astype("int32"))
+    tgt = mx.np.array(onp.random.RandomState(1).randint(
+        0, 41, (2, 4)).astype("int32"))
+    ref = net(src, tgt).asnumpy()
+    sym, params = net.export(str(tmp_path / "nmt"))
+    blk = SymbolBlock.imports(sym, param_file=params)
+    onp.testing.assert_allclose(blk(src, tgt).asnumpy(), ref,
+                                rtol=1e-5, atol=1e-5)
+    # the .params file keeps ALIAS names too: a fresh model's
+    # load_parameters finds tgt_embed.weight even though the trace
+    # deduped it
+    net2 = _tiny(src_vocab=41, units=16, heads=2, layers=1, max_len=16)
+    net2.load_parameters(params)
+    onp.testing.assert_allclose(net2(src, tgt).asnumpy(), ref,
+                                rtol=1e-5, atol=1e-5)
