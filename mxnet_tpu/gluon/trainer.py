@@ -82,6 +82,17 @@ class Trainer:
         # then owns weights AND optimizer — ship both.
         if self._kvstore is not None:
             auto = getattr(self._kvstore, "type", "") == "dist_async"
+            if auto and self._update_on_kvstore_arg is not None \
+                    and not self._update_on_kvstore_arg:
+                # the async service has no worker-count-aware per-round
+                # aggregation: without a server-side optimizer, pulls
+                # return running gradient SUMS since init, not per-step
+                # reductions — reject rather than silently mistrain
+                raise MXNetError(
+                    "kvstore='dist_async' requires updates on the "
+                    "kvstore (the server applies the optimizer per "
+                    "push); update_on_kvstore=False is not supported — "
+                    "use kvstore='ici' for worker-side updates")
             self._update_on_kvstore = (auto
                                        if self._update_on_kvstore_arg is None
                                        else bool(self._update_on_kvstore_arg))
@@ -100,6 +111,16 @@ class Trainer:
                 self._kvstore.set_optimizer(self._optimizer)
             if shared and hasattr(self._kvstore, "barrier"):
                 self._kvstore.barrier()
+                # EVERY rank starts from the server's seeded weights
+                # (the reference broadcasts initial params via kvstore
+                # init + pull) — without this, ranks > 0 would compute
+                # their first gradient at their own local random init,
+                # pushing updates unrelated to the served model
+                keys = [i for i, p in enumerate(self._params)
+                        if p.grad_req != "null" and p.is_initialized]
+                if keys:
+                    self._kvstore.pull(
+                        keys, out=[self._params[i].data() for i in keys])
         self._kv_initialized = True
 
     @property
@@ -135,7 +156,6 @@ class Trainer:
                     # did not refresh this step
                     if ignore_stale_grad:
                         continue
-                    from ..base import MXNetError
                     raise MXNetError(
                         f"Gradient of Parameter '{p.name}' has not been "
                         "updated by backward since the last step — wrap "
@@ -143,7 +163,6 @@ class Trainer:
                         "ignore_stale_grad=True")
                 if getattr(g, "stype", "default") == "row_sparse":
                     if self._update_on_kvstore:
-                        from ..base import MXNetError
                         raise MXNetError(
                             f"Parameter '{p.name}' has a row_sparse "
                             "gradient, which the server-side update "
@@ -158,7 +177,25 @@ class Trainer:
         if keys:
             # one batched push: KVStoreICI fuses the small gradients into
             # bucket collectives instead of one collective per parameter
-            self._kvstore.push(keys, grads)
+            try:
+                self._kvstore.push(keys, grads)
+            except MXNetError as e:
+                if not (getattr(self._kvstore, "type", "") == "dist_async"
+                        and "uninitialized" in str(e)):
+                    raise
+                # a parameter server restarted with empty state: resume
+                # from this worker's current weights (pulled from the
+                # server at most one step ago) and re-ship the optimizer.
+                # Server-side momentum resets — announce it.
+                import warnings
+                warnings.warn(
+                    "parameter server lost its state (restart?) — "
+                    "re-seeding from this worker's current weights; "
+                    "server-side optimizer state resets")
+                for i in keys:
+                    self._kvstore.init(i, self._params[i].data())
+                self._kvstore.set_optimizer(self._optimizer)
+                self._kvstore.push(keys, grads)
             if self._update_on_kvstore:
                 # the store applied the optimizer — pull WEIGHTS back and
                 # mark grads consumed; _update is skipped

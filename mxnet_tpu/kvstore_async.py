@@ -20,10 +20,21 @@ capability, safer encoding).
 Roles follow the reference env contract: ``tools/launch.py -s S`` starts
 ``S`` server processes (``DMLC_ROLE=server``, this module's ``main``)
 and points workers at them via ``DMLC_PS_ROOT_URI`` /
-``DMLC_PS_ROOT_PORT`` / ``DMLC_NUM_SERVER``. With S > 1, keys are
-assigned whole to servers by stable hash (the reference sliced single
-big arrays across servers — PSKV; whole-key assignment keeps each
-update atomic on one server).
+``DMLC_PS_ROOT_PORT`` / ``DMLC_NUM_SERVER``. With S > 1, small keys are
+assigned whole to servers by stable hash, and arrays at or above
+``MXNET_KVSTORE_BIGARRAY_BOUND`` elements are sliced contiguously
+across ALL servers (the reference's PSKV ``EncodeDefaultKey`` big-array
+slicing, ``kvstore_dist.h``) so one giant embedding table load-balances
+instead of landing on one server; each slice still updates atomically
+on its server.
+
+Push payloads optionally compress on the wire
+(``set_gradient_compression``): 2-bit with per-worker error-feedback
+residuals (well-defined under Hogwild — each worker carries its own
+deferred mass), blockwise int8, or bf16/fp16. The server decodes before
+applying. Servers bind the interface implied by ``DMLC_PS_ROOT_URI``
+(loopback under the local launcher) and, when ``MXNET_PS_TOKEN`` is
+set, reject frames without the shared token.
 """
 from __future__ import annotations
 
@@ -53,6 +64,13 @@ def _send_frame(sock: socket.socket, cmd: bytes, header: Dict[str, Any],
                 payload: bytes = b"") -> None:
     hdr = json.dumps(header).encode()
     body = cmd + struct.pack("<I", len(hdr)) + hdr + payload
+    if len(body) > 0xFFFFFFFF:
+        raise MXNetError(
+            f"PS frame too large: {len(body)} bytes exceeds the u32 "
+            f"framing cap (4 GiB) for key(s) "
+            f"{header.get('key', header.get('keys', '?'))!r} — lower "
+            "MXNET_KVSTORE_BIGARRAY_BOUND so big arrays slice, or push "
+            "fewer keys per call")
     sock.sendall(_MAGIC + struct.pack("<I", len(body)) + body)
 
 
@@ -152,11 +170,92 @@ def _unpack_leaves(specs, payload: bytes) -> List[onp.ndarray]:
     out, off = [], 0
     for sp in specs:
         n = sp["nbytes"]
-        out.append(onp.frombuffer(payload[off:off + n],
-                                  dtype=sp["dtype"]).reshape(sp["shape"])
-                   .copy())
+        out.append(_decode_entry(sp, payload[off:off + n]))
         off += n
     return out
+
+
+# ---------------------------------------------------------------------------
+# wire codecs (host-side analogs of kvstore.py's compressed collectives —
+# reference: src/kvstore/gradient_compression.cc). Pure numpy: servers and
+# workers never need a device to move gradients.
+# ---------------------------------------------------------------------------
+
+_INT8_BLOCK = 256
+
+
+def _bf16_dtype():
+    import ml_dtypes                    # jax dependency, always present
+    return onp.dtype(ml_dtypes.bfloat16)
+
+
+def _q2bit_np(flat: onp.ndarray, thr: float):
+    """Quantize to {-thr, 0, +thr} packed 4 codes/byte; returns
+    (packed uint8, dequantized f32) — caller keeps acc - deq as the
+    error-feedback residual."""
+    codes = onp.where(flat >= thr, 2,
+                      onp.where(flat <= -thr, 0, 1)).astype(onp.uint8)
+    deq = (codes.astype(onp.float32) - 1.0) * thr
+    pad = (-len(codes)) % 4
+    if pad:
+        codes = onp.concatenate([codes, onp.ones(pad, onp.uint8)])
+    c = codes.reshape(-1, 4)
+    packed = (c[:, 0] | (c[:, 1] << 2) | (c[:, 2] << 4)
+              | (c[:, 3] << 6)).astype(onp.uint8)
+    return packed, deq
+
+
+def _unq2bit_np(packed: onp.ndarray, n: int, thr: float) -> onp.ndarray:
+    parts = [(packed >> s) & 3 for s in (0, 2, 4, 6)]
+    codes = onp.stack(parts, axis=1).reshape(-1)[:n]
+    return (codes.astype(onp.float32) - 1.0) * thr
+
+
+def _qint8_np(flat: onp.ndarray):
+    """Blockwise max-abs int8 (EQuARX-style): returns (codes int8,
+    scales f32, n)."""
+    n = len(flat)
+    pad = (-n) % _INT8_BLOCK
+    f = flat.astype(onp.float32)
+    if pad:
+        f = onp.concatenate([f, onp.zeros(pad, onp.float32)])
+    b = f.reshape(-1, _INT8_BLOCK)
+    scale = (onp.abs(b).max(axis=1) / 127.0).astype(onp.float32)
+    safe = onp.where(scale == 0, 1.0, scale)
+    codes = onp.clip(onp.rint(b / safe[:, None]), -127, 127) \
+        .astype(onp.int8)
+    return codes.reshape(-1), scale, n
+
+
+def _unqint8_np(codes: onp.ndarray, scales: onp.ndarray,
+                n: int) -> onp.ndarray:
+    vals = codes.reshape(-1, _INT8_BLOCK).astype(onp.float32) \
+        * scales[:, None]
+    return vals.reshape(-1)[:n]
+
+
+def _decode_entry(spec: Dict[str, Any], raw: bytes) -> onp.ndarray:
+    """Decode one wire entry to a numpy array (inverse of the client's
+    ``_encode_entry``); plain entries pass through untouched."""
+    codec = spec.get("codec")
+    if not codec:
+        return onp.frombuffer(raw, dtype=spec["dtype"]) \
+            .reshape(spec["shape"]).copy()
+    shape, dt = spec["shape"], spec["dtype"]
+    if codec in ("fp16", "bf16"):
+        src = onp.float16 if codec == "fp16" else _bf16_dtype()
+        return onp.frombuffer(raw, dtype=src).astype(dt).reshape(shape)
+    if codec == "int8":
+        nsc = spec["nblocks"]
+        scales = onp.frombuffer(raw[:4 * nsc], dtype=onp.float32)
+        codes = onp.frombuffer(raw[4 * nsc:], dtype=onp.int8)
+        return _unqint8_np(codes, scales, spec["n"]).astype(dt) \
+            .reshape(shape)
+    if codec == "2bit":
+        packed = onp.frombuffer(raw, dtype=onp.uint8)
+        return _unq2bit_np(packed, spec["n"], spec["thr"]).astype(dt) \
+            .reshape(shape)
+    raise MXNetError(f"unknown wire codec {codec!r}")
 
 
 # ---------------------------------------------------------------------------
@@ -169,6 +268,16 @@ class _Handler(socketserver.BaseRequestHandler):
         try:
             while True:
                 cmd, header, payload = _recv_frame(self.request)
+                import hmac
+                if srv.token and not hmac.compare_digest(
+                        str(header.pop("tok", "") or ""), srv.token):
+                    # shared-secret gate (MXNET_PS_TOKEN from the
+                    # launcher): an unauthenticated peer cannot read or
+                    # tamper with weights, replace the optimizer, or
+                    # stop the server
+                    _send_frame(self.request, b"E",
+                                {"error": "bad or missing auth token"})
+                    return
                 if cmd == b"S":
                     _send_frame(self.request, b"K", {})
                     threading.Thread(target=self.server.shutdown,
@@ -194,6 +303,7 @@ class PSServer:
 
     def __init__(self, num_workers: int) -> None:
         self.num_workers = num_workers
+        self.token = os.environ.get("MXNET_PS_TOKEN", "")
         self.store: Dict[str, onp.ndarray] = {}
         self.locks: Dict[str, threading.Lock] = {}
         self.updater = None                      # optimizer.Updater
@@ -219,7 +329,7 @@ class PSServer:
             return b"K", {}, b""
         if cmd == b"P":                          # push
             key = header["key"]
-            grad = _payload_arr(header, payload)
+            grad = _decode_entry(header, payload)
             with self._lock_for(key):
                 if key not in self.store:
                     raise MXNetError(f"push to uninitialized key {key!r}")
@@ -283,9 +393,13 @@ class PSServer:
             with self._global_lock:
                 if self.updater is None:
                     return b"v", {"states": None, "specs": []}, b""
+                # snapshot under the lock that _apply_update's
+                # first-touch insert takes: workers keep pushing during
+                # a checkpoint by design, and encoding the live dict
+                # races concurrent state creation
+                items = list(self.updater.states.items())
                 leaves: List[onp.ndarray] = []
-                enc = {str(k): _enc_state(s, leaves)
-                       for k, s in self.updater.states.items()}
+                enc = {str(k): _enc_state(s, leaves) for k, s in items}
                 specs, raw = _pack_leaves(leaves)
                 o = self.updater.optimizer
                 counts = {"num_update": o.num_update,
@@ -347,15 +461,35 @@ class PSServer:
         import jax.numpy as jnp
         w = NDArray(jnp.asarray(self.store[key]), _wrap=True)
         g = NDArray(jnp.asarray(grad), _wrap=True)
+        if key not in self.updater.states:
+            # first touch inserts a dict entry — serialize against the
+            # 'X' snapshot (checkpoint concurrent with pushes) without
+            # serializing the steady-state Hogwild updates
+            with self._global_lock:
+                if key not in self.updater.states:
+                    self.updater.states[key] = (
+                        self.updater.optimizer
+                        .create_state_multi_precision(key, w))
         self.updater(key, g, w)                  # mutates w in place
         self.store[key] = onp.asarray(w._data)
+
+
+def _bind_host() -> str:
+    """The interface to listen on: explicit ``MXNET_PS_BIND_URI`` wins;
+    otherwise loopback when the root URI says the job is local (the
+    launcher default), all interfaces only for a genuinely remote job."""
+    host = os.environ.get("MXNET_PS_BIND_URI")
+    if host:
+        return host
+    root = os.environ.get("DMLC_PS_ROOT_URI", "127.0.0.1")
+    return "127.0.0.1" if root in ("127.0.0.1", "localhost") else "0.0.0.0"
 
 
 def run_server(port: int, num_workers: int,
                ready_event: Optional[threading.Event] = None) -> None:
     """Serve until a STOP frame arrives (blocking)."""
     ps = PSServer(num_workers)
-    with _TCPServer(("0.0.0.0", port), _Handler) as server:
+    with _TCPServer((_bind_host(), port), _Handler) as server:
         server.ps = ps                           # type: ignore[attr-defined]
         if ready_event is not None:
             ready_event.set()
@@ -370,8 +504,11 @@ class KVStoreDistAsync:
     """Worker-side ``kvstore='dist_async'`` client.
 
     API-compatible subset of KVStore: init/push/pull/pushpull,
-    set_optimizer (ships to the servers), barrier, rank/num_workers.
-    Per-key requests go whole to ``hash(key) % num_servers``.
+    set_optimizer (ships to the servers), set_gradient_compression
+    (push-payload wire codecs), barrier, rank/num_workers. Small keys go
+    whole to ``hash(key) % num_servers``; arrays at/over
+    ``MXNET_KVSTORE_BIGARRAY_BOUND`` slice contiguously across ALL
+    servers (reference PSKV big-array slicing).
     """
 
     type = "dist_async"
@@ -384,12 +521,19 @@ class KVStoreDistAsync:
                                         os.environ.get("JAX_PROCESS_ID",
                                                        "0")))
         self._num_workers = int(os.environ.get("DMLC_NUM_WORKER", "1"))
+        self._token = os.environ.get("MXNET_PS_TOKEN", "")
         self._socks: List[Optional[socket.socket]] = \
             [None] * self.num_servers
         # one lock per server connection: requests to different servers
         # may overlap; frames on one socket are serialized
         self._locks = [threading.Lock() for _ in range(self.num_servers)]
         self._shipped_params: Dict[str, Any] = {}
+        self._compression: Dict[str, Any] = {}
+        self._residuals: Dict[str, onp.ndarray] = {}   # per-wire-key EF
+        self._shapes: Dict[str, tuple] = {}            # sliced-key shapes
+        # payload bytes this worker pushed (post-compression) — the
+        # wire-traffic introspection the tests assert against
+        self.push_wire_bytes = 0
 
     # -- plumbing ----------------------------------------------------------
     def _sock(self, sidx: int) -> socket.socket:
@@ -423,23 +567,95 @@ class KVStoreDistAsync:
         import zlib
         return zlib.crc32(str(key).encode()) % self.num_servers
 
+    def _server_of_wire(self, wk: str) -> int:
+        """Server of a WIRE key: slice subkeys (``base@sJ``) route by
+        the slicing rule, plain keys by hash."""
+        if "@s" in wk:
+            base_key, _, j = wk.rpartition("@s")
+            if j.isdigit():
+                return (self._server_of(base_key) + int(j)) \
+                    % self.num_servers
+        return self._server_of(wk)
+
+    def _plan(self, key: Any, size: int):
+        """Wire layout of one logical key: ``[(wire_key, server, start,
+        stop)]`` over the flattened array, or None for a whole-key
+        assignment. Arrays at/over ``MXNET_KVSTORE_BIGARRAY_BOUND``
+        elements slice contiguously across ALL servers (reference PSKV
+        ``EncodeDefaultKey``). The rule is a pure function of (key, size,
+        num_servers), so every worker computes the identical layout with
+        no metadata exchange — keep the bound env identical across the
+        job."""
+        bound = int(os.environ.get("MXNET_KVSTORE_BIGARRAY_BOUND",
+                                   "1000000"))
+        n = self.num_servers
+        if n <= 1 or size < bound:
+            return None
+        base = self._server_of(key)
+        cuts = [size * j // n for j in range(n + 1)]
+        return [(f"{key}@s{j}", (base + j) % n, cuts[j], cuts[j + 1])
+                for j in range(n) if cuts[j + 1] > cuts[j]]
+
+    def _encode_entry(self, wire_key: str, a: onp.ndarray):
+        """(spec, payload) for one pushed array, applying the configured
+        wire codec; 2-bit error-feedback residuals live per worker per
+        wire key."""
+        a = onp.ascontiguousarray(a)
+        ctype = self._compression.get("type")
+        spec: Dict[str, Any] = {"dtype": str(a.dtype),
+                                "shape": list(a.shape)}
+        if not ctype:
+            raw = a.tobytes()
+        elif ctype in ("fp16", "bf16"):
+            dt = onp.float16 if ctype == "fp16" else _bf16_dtype()
+            raw = a.astype(dt).tobytes()
+            spec["codec"] = ctype
+        elif ctype == "int8":
+            codes, scales, n = _qint8_np(a.ravel())
+            raw = scales.tobytes() + codes.tobytes()
+            spec.update(codec="int8", n=n, nblocks=len(scales))
+        else:                                    # 2bit + error feedback
+            thr = float(self._compression.get("threshold", 0.5))
+            flat = a.ravel().astype(onp.float32)
+            res = self._residuals.get(wire_key)
+            acc = flat if res is None or len(res) != len(flat) \
+                else flat + res
+            packed, deq = _q2bit_np(acc, thr)
+            self._residuals[wire_key] = acc - deq
+            raw = packed.tobytes()
+            spec.update(codec="2bit", n=int(flat.size), thr=thr)
+        spec["nbytes"] = len(raw)
+        self.push_wire_bytes += len(raw)
+        return spec, raw
+
     def _rpc_server(self, sidx: int, cmd: bytes, header: Dict[str, Any],
                     payload: bytes = b""):
-        with self._locks[sidx]:
-            try:
-                s = self._sock(sidx)
-                _send_frame(s, cmd, header, payload)
-                rcmd, rhdr, rpayload = _recv_frame(s)
-            except (ConnectionError, OSError):
-                # a half-done exchange leaves the stream desynced — drop
-                # the socket so the next call reconnects cleanly
-                if self._socks[sidx] is not None:
-                    try:
-                        self._socks[sidx].close()
-                    except OSError:
-                        pass
-                    self._socks[sidx] = None
-                raise
+        if self._token:
+            header = dict(header, tok=self._token)
+        for attempt in (0, 1):
+            with self._locks[sidx]:
+                try:
+                    s = self._sock(sidx)
+                    _send_frame(s, cmd, header, payload)
+                    rcmd, rhdr, rpayload = _recv_frame(s)
+                    break
+                except (ConnectionError, OSError):
+                    # a half-done exchange leaves the stream desynced —
+                    # drop the socket so the next attempt reconnects
+                    if self._socks[sidx] is not None:
+                        try:
+                            self._socks[sidx].close()
+                        except OSError:
+                            pass
+                        self._socks[sidx] = None
+                    # one reconnect retry: a restarted server accepts
+                    # fresh connections; if it lost its state the retry
+                    # fails loudly ('uninitialized key') instead of the
+                    # worker dying on a transient drop. A push the dead
+                    # server applied but never acknowledged may apply
+                    # twice — tolerated by Hogwild semantics.
+                    if attempt == 1 or cmd == b"S":
+                        raise
         if rcmd == b"E":
             raise MXNetError(f"parameter server: {rhdr.get('error')}")
         return rcmd, rhdr, rpayload
@@ -463,52 +679,122 @@ class KVStoreDistAsync:
         for k, v in zip(keys, vals):
             if isinstance(v, (list, tuple)):
                 v = v[0]
-            hdr, raw = _arr_payload(onp.asarray(v.asnumpy()))
-            hdr["key"] = str(k)
-            self._rpc(k, b"I", hdr, raw)
+            a = onp.asarray(v.asnumpy())
+            parts = self._plan(k, int(a.size))
+            if parts is None:
+                hdr, raw = _arr_payload(a)
+                hdr["key"] = str(k)
+                self._rpc(k, b"I", hdr, raw)
+                continue
+            self._shapes[str(k)] = tuple(a.shape)
+            flat = onp.ascontiguousarray(a).ravel()
+            for wk, sidx, st, sp in parts:
+                hdr, raw = _arr_payload(flat[st:sp])
+                hdr["key"] = wk
+                self._rpc_server(sidx, b"I", hdr, raw)
 
     def push(self, key, value, priority: int = 0) -> None:
         keys, vals = self._pair(key, value)
-        if len(keys) == 1:
-            hdr, raw = _arr_payload(self._to_numpy(vals[0]))
-            hdr["key"] = str(keys[0])
-            self._rpc(keys[0], b"P", hdr, raw)
+        entries = []                     # (wire_key, server, flat array)
+        for k, v in zip(keys, vals):
+            a = self._to_numpy(v)
+            parts = self._plan(k, int(a.size))
+            if parts is None:
+                entries.append((str(k), self._server_of(k), a))
+            else:
+                flat = onp.ascontiguousarray(a).ravel()
+                for wk, sidx, st, sp in parts:
+                    entries.append((wk, sidx, flat[st:sp]))
+        # group by server: a multi-key push crosses the wire as one
+        # frame per server (the ICI path's bucketing analog), chunked so
+        # no frame approaches the u32 framing cap
+        by_server: Dict[int, List[Any]] = {}
+        for wk, sidx, a in entries:
+            by_server.setdefault(sidx, []).append((wk, a))
+        cap = int(os.environ.get("MXNET_PS_FRAME_CAP", str(1 << 30)))
+        for sidx, items in by_server.items():
+            enc = [(wk,) + self._encode_entry(wk, a) for wk, a in items]
+            group: List[Any] = []
+            size = 0
+            for e in enc:
+                if group and size + len(e[2]) > cap:
+                    self._push_group(sidx, group)
+                    group, size = [], 0
+                group.append(e)
+                size += len(e[2])
+            if group:
+                self._push_group(sidx, group)
+
+    def _push_group(self, sidx: int, enc) -> None:
+        if len(enc) == 1:
+            wk, spec, raw = enc[0]
+            self._rpc_server(sidx, b"P", dict(spec, key=wk), raw)
             return
-        # group by server: the whole multi-key push crosses the wire as
-        # ONE frame per server (the ICI path's bucketing analog)
-        by_server: Dict[int, List[int]] = {}
-        for i, k in enumerate(keys):
-            by_server.setdefault(self._server_of(k), []).append(i)
-        for sidx, idxs in by_server.items():
-            arrs = [self._to_numpy(vals[i]) for i in idxs]
-            specs, raw = _pack_leaves(arrs)
-            self._rpc_server(sidx, b"p",
-                             {"keys": [str(keys[i]) for i in idxs],
-                              "specs": specs}, raw)
+        self._rpc_server(sidx, b"p",
+                         {"keys": [e[0] for e in enc],
+                          "specs": [e[1] for e in enc]},
+                         b"".join(e[2] for e in enc))
 
     def pull(self, key, out=None, priority: int = 0,
              ignore_sparse: bool = True):
         from .ndarray.ops import array
         keys, outs = self._pair(key, out)
-        arrays: List[Optional[onp.ndarray]] = [None] * len(keys)
-        if len(keys) == 1:
-            cmd, hdr, payload = self._rpc(keys[0], b"G",
-                                          {"key": str(keys[0])})
-            if cmd != b"V":
-                raise MXNetError(f"pull failed for key {keys[0]!r}")
-            arrays[0] = _payload_arr(hdr, payload)
-        else:
-            by_server: Dict[int, List[int]] = {}
-            for i, k in enumerate(keys):
-                by_server.setdefault(self._server_of(k), []).append(i)
-            for sidx, idxs in by_server.items():
+        # resolve each logical key's wire layout: sliced keys expand to
+        # per-server parts reassembled below. The slicing decision needs
+        # the array size — known from ``out`` or a local init; a key
+        # never seen locally pulls whole (correct unless sliced, in
+        # which case the server's 'uninitialized key' error names it).
+        requests = []                    # (server, wire_key, li, start)
+        shapes: List[Optional[tuple]] = [None] * len(keys)
+        for li, (k, o) in enumerate(zip(keys, outs)):
+            t = None
+            if o is not None:
+                t = o[0] if isinstance(o, (list, tuple)) else o
+            if t is not None:
+                shape, size = tuple(t.shape), int(t.size)
+            elif str(k) in self._shapes:
+                shape = self._shapes[str(k)]
+                size = int(onp.prod(shape, dtype=onp.int64)) \
+                    if shape else 1
+            else:
+                shape, size = None, None
+            shapes[li] = shape
+            parts = self._plan(k, size) if size is not None else None
+            if parts is None:
+                requests.append((self._server_of(k), str(k), li, None))
+            else:
+                for wk, sidx, st, sp in parts:
+                    requests.append((sidx, wk, li, st))
+        by_server: Dict[int, List[Any]] = {}
+        for r in requests:
+            by_server.setdefault(r[0], []).append(r)
+        pieces: Dict[int, List[Any]] = {}         # li -> [(start, flat)]
+        for sidx, rs in by_server.items():
+            if len(rs) == 1:
+                _, wk, li, st = rs[0]
+                cmd, hdr, payload = self._rpc_server(sidx, b"G",
+                                                     {"key": wk})
+                if cmd != b"V":
+                    raise MXNetError(f"pull failed for key {wk!r}")
+                pieces.setdefault(li, []).append(
+                    (st, _payload_arr(hdr, payload)))
+            else:
                 cmd, hdr, payload = self._rpc_server(
-                    sidx, b"g", {"keys": [str(keys[i]) for i in idxs]})
+                    sidx, b"g", {"keys": [r[1] for r in rs]})
                 if cmd != b"v":
                     raise MXNetError("multi-pull failed")
-                for i, a in zip(idxs, _unpack_leaves(hdr["specs"],
-                                                     payload)):
-                    arrays[i] = a
+                for r, a in zip(rs, _unpack_leaves(hdr["specs"],
+                                                   payload)):
+                    pieces.setdefault(r[2], []).append((r[3], a))
+        arrays: List[onp.ndarray] = []
+        for li in range(len(keys)):
+            got = pieces[li]
+            if len(got) == 1 and got[0][0] is None:
+                arrays.append(got[0][1])
+            else:
+                got.sort(key=lambda t: t[0])
+                flat = onp.concatenate([a.ravel() for _, a in got])
+                arrays.append(flat.reshape(shapes[li]))
         results = []
         for a, o in zip(arrays, outs):
             nd = array(a)
@@ -587,7 +873,8 @@ class KVStoreDistAsync:
             payload = pickle.load(f)
         by_server: Dict[int, Dict[str, Any]] = {}
         for k, s in payload["states"].items():
-            by_server.setdefault(self._server_of(str(k)), {})[str(k)] = s
+            by_server.setdefault(self._server_of_wire(str(k)),
+                                 {})[str(k)] = s
         counts = {"num_update": payload.get("num_update", 0),
                   "index_update_count":
                       {str(k): v for k, v in
@@ -601,10 +888,21 @@ class KVStoreDistAsync:
                               "counts": counts}, raw)
 
     def set_gradient_compression(self, compression_params) -> None:
-        raise MXNetError(
-            "gradient compression is not supported on the async service "
-            "(error-feedback residuals are undefined under Hogwild "
-            "updates); use kvstore='ici' for compressed sync training")
+        """Compress push payloads on the DCN wire (reference:
+        gradient_compression.cc over ps-lite). 2-bit error-feedback
+        residuals live PER WORKER — each worker carries its own deferred
+        gradient mass, which stays well-defined under Hogwild updates
+        (server-side residuals would not). Pulls (weights) stay
+        uncompressed, as in the reference."""
+        ctype = compression_params.get("type", "2bit")
+        if ctype not in ("2bit", "fp16", "bf16", "int8", "none"):
+            raise MXNetError(f"unknown compression type {ctype!r}")
+        if ctype == "2bit" and float(
+                compression_params.get("threshold", 0.5)) <= 0:
+            raise MXNetError("2bit compression threshold must be > 0")
+        self._compression = {} if ctype == "none" \
+            else dict(compression_params, type=ctype)
+        self._residuals = {}
 
     def barrier(self) -> None:
         for sidx in range(self.num_servers):

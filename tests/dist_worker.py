@@ -157,6 +157,139 @@ def async_main(out_dir: str) -> None:
         kv.stop_servers()
 
 
+def async_sliced_main(out_dir: str) -> None:
+    """PSKV big-array slicing over the async service (-n 2 -s 2 with
+    MXNET_KVSTORE_BIGARRAY_BOUND=100): a 200-element key slices across
+    BOTH servers, raw sum-mode push/pull reassembles correctly, and
+    server-side sgd training over the slices converges with one shared
+    model. Reference: kvstore_dist.h EncodeDefaultKey."""
+    import numpy as onp
+    import mxnet_tpu as mx
+
+    rank = int(os.environ["DMLC_WORKER_ID"])
+    kv = mx.kvstore.create("dist_async")
+    assert kv.num_servers == 2
+    big = onp.arange(200, dtype="float32").reshape(20, 10)
+
+    if rank == 0:
+        kv.init("big", mx.np.zeros((20, 10)))      # 200 >= bound: sliced
+        kv.init("small", mx.np.zeros(4))           # whole-key assignment
+    kv.barrier()
+    # sum mode (no server optimizer): pulled == sum of pushes per slice
+    kv.push("big", mx.np.array(big * (rank + 1)))
+    kv.push("small", mx.np.array(onp.ones(4, "float32") * (rank + 1)))
+    kv.barrier()
+    got = kv.pull("big", out=mx.np.zeros((20, 10))).asnumpy()
+    assert onp.allclose(got, big * 3), "sliced reassembly wrong"
+    small = kv.pull("small", out=mx.np.zeros(4)).asnumpy()
+    assert onp.allclose(small, 3.0), small
+    # placement: the big key's slices live on BOTH servers, and neither
+    # holds the whole array
+    stats = kv.server_stats()
+    for s in stats:
+        assert any(k.startswith("big@s") for k in s["keys"]), stats
+        assert "big" not in s["keys"], stats
+    line0 = "sliced-ok"
+
+    # server-side optimizer over sliced weights: Dense(20, in_units=10)
+    # puts its 200-element weight above the bound
+    mx.random.seed(0)
+    net = mx.gluon.nn.Dense(20, in_units=10)
+    net.initialize()
+    net(mx.np.zeros((1, 10)))
+    tr = mx.gluon.Trainer(net.collect_params(), "sgd",
+                          {"learning_rate": 0.2}, kvstore="dist_async")
+    loss_fn = mx.gluon.loss.L2Loss()
+    rng = onp.random.RandomState(300 + rank)
+    W = onp.eye(10, 20, dtype="float32") * 0.5
+    last = None
+    for _ in range(40):
+        x = rng.uniform(-1, 1, (8, 10)).astype("float32")
+        y = x @ W
+        with mx.autograd.record():
+            loss = loss_fn(net(mx.np.array(x)), mx.np.array(y))
+        loss.backward()
+        tr.step(8)
+        last = float(loss.asnumpy().mean())
+    kv.barrier()
+    w_final = tr._kvstore.pull(
+        0, out=mx.np.zeros((20, 10))).asnumpy()
+
+    with open(os.path.join(out_dir, f"worker{rank}.txt"), "w") as f:
+        f.write(line0 + "\n")
+        f.write(f"{last:.6f}\n")
+        f.write(" ".join(f"{v:.8f}" for v in w_final.ravel()[:20]) + "\n")
+    kv.barrier()
+    if rank == 0:
+        kv.stop_servers()
+
+
+def async_compress_main(out_dir: str) -> None:
+    """Wire compression on the async push path (-n 2 -s 1): 2-bit packs
+    16x and is exact on code points with per-worker error feedback;
+    blockwise int8 stays inside its quantization bound; the server
+    decodes before applying. Sum mode isolates codec correctness."""
+    import numpy as onp
+    import mxnet_tpu as mx
+
+    rank = int(os.environ["DMLC_WORKER_ID"])
+    kv = mx.kvstore.create("dist_async")
+    n = 1000
+    base = onp.random.RandomState(7).normal(0, 1, n).astype("float32")
+    tern = onp.sign(base).astype("float32")
+    lines = []
+
+    if rank == 0:
+        for key in ("t", "i"):
+            kv.init(key, mx.np.zeros(n))
+        kv.init("r", mx.np.zeros(4))
+    kv.barrier()
+
+    # 2bit: 16x less wire, exact on {-thr, 0, +thr} inputs
+    kv.set_gradient_compression({"type": "2bit", "threshold": 1.0})
+    before = kv.push_wire_bytes
+    kv.push("t", mx.np.array(tern))
+    assert kv.push_wire_bytes - before == (n + 3) // 4
+    kv.barrier()
+    got = kv.pull("t", out=mx.np.zeros(n)).asnumpy()
+    assert onp.allclose(got, tern * 2, atol=1e-6), "2bit not exact"
+    lines.append(" ".join(f"{v:.6f}" for v in got[:8]))
+
+    # int8 blockwise: scales + padded codes on the wire, bounded error
+    kv.set_gradient_compression({"type": "int8"})
+    before = kv.push_wire_bytes
+    kv.push("i", mx.np.array(base * (rank + 1)))
+    nb = (n + 255) // 256
+    assert kv.push_wire_bytes - before == 4 * nb + nb * 256
+    kv.barrier()
+    got = kv.pull("i", out=mx.np.zeros(n)).asnumpy()
+    expect = base * 3
+    bound = 3 * (onp.abs(base).max() / 127) + 1e-6
+    assert onp.abs(got - expect).max() <= bound, "int8 out of bound"
+    lines.append(" ".join(f"{v:.6f}" for v in got[:8]))
+
+    # per-worker error feedback: 0.6 quantizes to 0, the residual makes
+    # the second 0.6 cross the 1.0 threshold on each worker
+    kv.set_gradient_compression({"type": "2bit", "threshold": 1.0})
+    kv.push("r", mx.np.array(onp.full(4, 0.6, "float32")))
+    kv.barrier()
+    assert onp.allclose(
+        kv.pull("r", out=mx.np.zeros(4)).asnumpy(), 0.0, atol=1e-6)
+    kv.barrier()       # nobody's second push may overlap the pull above
+    kv.push("r", mx.np.array(onp.full(4, 0.6, "float32")))
+    kv.barrier()
+    assert onp.allclose(
+        kv.pull("r", out=mx.np.zeros(4)).asnumpy(), 2.0, atol=1e-6), \
+        "per-worker error feedback lost"
+    lines.append("residual-ok")
+
+    with open(os.path.join(out_dir, f"worker{rank}.txt"), "w") as f:
+        f.write("\n".join(lines) + "\n")
+    kv.barrier()
+    if rank == 0:
+        kv.stop_servers()
+
+
 def compress_main(out_dir: str) -> None:
     """Compressed ICI collectives (EQuARX-style, SURVEY 5.8): each codec
     reduces correctly across 2 processes, every rank gets the identical
@@ -279,6 +412,12 @@ def main() -> None:
         return
     if len(sys.argv) > 2 and sys.argv[2] == "async":
         async_main(out_dir)
+        return
+    if len(sys.argv) > 2 and sys.argv[2] == "async_sliced":
+        async_sliced_main(out_dir)
+        return
+    if len(sys.argv) > 2 and sys.argv[2] == "async_compress":
+        async_compress_main(out_dir)
         return
     import mxnet_tpu as mx
     from mxnet_tpu import kvstore as kvs

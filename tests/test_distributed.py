@@ -2,44 +2,77 @@
 tests/nightly/dist_sync_kvstore.py via tools/launch.py --launcher local,
 SURVEY.md section 4 'Distributed without a cluster')."""
 import os
+import random
 import socket
 import subprocess
 import sys
 
+import numpy as onp
 import pytest
 
 REPO = os.path.dirname(os.path.dirname(os.path.abspath(__file__)))
 
 
 def _free_port() -> int:
-    s = socket.socket()
-    s.bind(("127.0.0.1", 0))
-    port = s.getsockname()[1]
-    s.close()
-    return port
+    """A bindable port OUTSIDE the kernel's ephemeral range.
+
+    The old bind-probe-close in the ephemeral range raced other
+    processes' outgoing connections grabbing the port between close()
+    and the coordinator's bind (the launcher-flakiness root cause —
+    VERDICT r3 weak 9); nothing allocates implicitly from the band below
+    the range, so a probe there stays free. port .. port+3 are all
+    checked — the launcher binds port+1 .. port+num_servers for
+    parameter servers (covers -s up to 3)."""
+    try:
+        with open("/proc/sys/net/ipv4/ip_local_port_range") as f:
+            eph_lo = int(f.read().split()[0])
+    except OSError:
+        eph_lo = 32768
+    lo, hi = 21000, min(eph_lo - 5, 30000)
+    rng = random.Random()
+    for _ in range(64):
+        port = rng.randrange(lo, hi)
+        socks = []
+        try:
+            for off in range(4):
+                s = socket.socket()
+                socks.append(s)
+                s.bind(("127.0.0.1", port + off))
+            return port
+        except OSError:
+            continue
+        finally:
+            for s in socks:
+                s.close()
+    raise RuntimeError("no free port run found below the ephemeral range")
+
+
+def _launch(tmp_path, n, mode_args=(), servers=0, cpu_devices=0,
+            extra_env=None, timeout=280):
+    env = dict(os.environ)
+    env.pop("XLA_FLAGS", None)          # one device per process
+    env["JAX_PLATFORMS"] = "cpu"
+    env["PYTHONPATH"] = REPO
+    if extra_env:
+        env.update(extra_env)
+    cmd = [sys.executable, os.path.join(REPO, "tools", "launch.py"),
+           "-n", str(n), "--port", str(_free_port())]
+    if servers:
+        cmd += ["-s", str(servers)]
+    if cpu_devices:
+        cmd += ["--cpu-devices-per-worker", str(cpu_devices)]
+    cmd += [sys.executable, os.path.join(REPO, "tests", "dist_worker.py"),
+            str(tmp_path)] + list(mode_args)
+    proc = subprocess.run(cmd, env=env, capture_output=True,
+                          text=True, timeout=timeout)
+    assert proc.returncode == 0, (proc.stdout[-2000:], proc.stderr[-2000:])
 
 
 def test_two_process_spmd_training(tmp_path):
     """tools/launch.py starts 2 workers; each joins one jax.distributed
     job, trains data-parallel over the global 2-process mesh, and both
     must agree bit-for-bit on losses and the synced parameters."""
-    env = dict(os.environ)
-    env.pop("XLA_FLAGS", None)          # one device per process
-    env["JAX_PLATFORMS"] = "cpu"
-    env["PYTHONPATH"] = REPO
-    # retry once with a fresh port: the bind-then-close probe can race
-    # another process grabbing the port before the coordinator binds it
-    for attempt in range(2):
-        cmd = [sys.executable, os.path.join(REPO, "tools", "launch.py"),
-               "-n", "2", "--port", str(_free_port()),
-               sys.executable,
-               os.path.join(REPO, "tests", "dist_worker.py"),
-               str(tmp_path)]
-        proc = subprocess.run(cmd, env=env, capture_output=True,
-                              text=True, timeout=280)
-        if proc.returncode == 0 or attempt == 1:
-            break
-    assert proc.returncode == 0, (proc.stdout[-2000:], proc.stderr[-2000:])
+    _launch(tmp_path, 2)
     r0 = (tmp_path / "worker0.txt").read_text().splitlines()
     r1 = (tmp_path / "worker1.txt").read_text().splitlines()
     # losses identical across workers (replicated scalar out of the psum)
@@ -55,21 +88,7 @@ def test_two_process_kvstore_contract(tmp_path):
     per-process gradients come back summed over workers, and a plain
     gluon.Trainer(kvstore='ici') trains bit-identically across ranks
     (tests/nightly/dist_sync_kvstore.py analog)."""
-    env = dict(os.environ)
-    env.pop("XLA_FLAGS", None)
-    env["JAX_PLATFORMS"] = "cpu"
-    env["PYTHONPATH"] = REPO
-    for attempt in range(2):
-        cmd = [sys.executable, os.path.join(REPO, "tools", "launch.py"),
-               "-n", "2", "--port", str(_free_port()),
-               sys.executable,
-               os.path.join(REPO, "tests", "dist_worker.py"),
-               str(tmp_path), "kvstore"]
-        proc = subprocess.run(cmd, env=env, capture_output=True,
-                              text=True, timeout=280)
-        if proc.returncode == 0 or attempt == 1:
-            break
-    assert proc.returncode == 0, (proc.stdout[-2000:], proc.stderr[-2000:])
+    _launch(tmp_path, 2, ["kvstore"])
     r0 = (tmp_path / "worker0.txt").read_text().splitlines()
     r1 = (tmp_path / "worker1.txt").read_text().splitlines()
     assert r0[0] == r1[0]   # pulled values identical (and = sum of pushes)
@@ -80,22 +99,7 @@ def test_two_process_two_devices_each(tmp_path):
     """dp=4 over 2 processes x 2 local devices: each worker's local
     batch is its shard of the global batch, split over its own 2
     devices (the host-local divisibility is per-process, not global)."""
-    env = dict(os.environ)
-    env.pop("XLA_FLAGS", None)
-    env["JAX_PLATFORMS"] = "cpu"
-    env["PYTHONPATH"] = REPO
-    for attempt in range(2):
-        cmd = [sys.executable, os.path.join(REPO, "tools", "launch.py"),
-               "-n", "2", "--port", str(_free_port()),
-               "--cpu-devices-per-worker", "2",
-               sys.executable,
-               os.path.join(REPO, "tests", "dist_worker.py"),
-               str(tmp_path)]
-        proc = subprocess.run(cmd, env=env, capture_output=True,
-                              text=True, timeout=280)
-        if proc.returncode == 0 or attempt == 1:
-            break
-    assert proc.returncode == 0, (proc.stdout[-2000:], proc.stderr[-2000:])
+    _launch(tmp_path, 2, cpu_devices=2)
     r0 = (tmp_path / "worker0.txt").read_text().splitlines()
     r1 = (tmp_path / "worker1.txt").read_text().splitlines()
     assert r0[0] == r1[0]
@@ -107,21 +111,7 @@ def test_four_process_kvstore_bucketed(tmp_path):
     4 workers of pushed), fused bucket collectives for multi-key pushes,
     BIGARRAY_BOUND solo reduction, and bit-identical gluon.Trainer
     parameters across all 4 ranks."""
-    env = dict(os.environ)
-    env.pop("XLA_FLAGS", None)
-    env["JAX_PLATFORMS"] = "cpu"
-    env["PYTHONPATH"] = REPO
-    for attempt in range(2):
-        cmd = [sys.executable, os.path.join(REPO, "tools", "launch.py"),
-               "-n", "4", "--port", str(_free_port()),
-               sys.executable,
-               os.path.join(REPO, "tests", "dist_worker.py"),
-               str(tmp_path), "kvstore", "4"]
-        proc = subprocess.run(cmd, env=env, capture_output=True,
-                              text=True, timeout=280)
-        if proc.returncode == 0 or attempt == 1:
-            break
-    assert proc.returncode == 0, (proc.stdout[-2000:], proc.stderr[-2000:])
+    _launch(tmp_path, 4, ["kvstore", "4"])
     rows = [(tmp_path / f"worker{r}.txt").read_text().splitlines()
             for r in range(4)]
     for r in range(1, 4):
@@ -133,22 +123,7 @@ def test_two_process_dp_tp_combined(tmp_path):
     """dp x tp across the process boundary (2 procs x 2 devices each):
     batch shards over dp, Megatron-split weights over tp, losses and the
     gathered weights bit-identical on both ranks."""
-    env = dict(os.environ)
-    env.pop("XLA_FLAGS", None)
-    env["JAX_PLATFORMS"] = "cpu"
-    env["PYTHONPATH"] = REPO
-    for attempt in range(2):
-        cmd = [sys.executable, os.path.join(REPO, "tools", "launch.py"),
-               "-n", "2", "--port", str(_free_port()),
-               "--cpu-devices-per-worker", "2",
-               sys.executable,
-               os.path.join(REPO, "tests", "dist_worker.py"),
-               str(tmp_path), "dptp"]
-        proc = subprocess.run(cmd, env=env, capture_output=True,
-                              text=True, timeout=280)
-        if proc.returncode == 0 or attempt == 1:
-            break
-    assert proc.returncode == 0, (proc.stdout[-2000:], proc.stderr[-2000:])
+    _launch(tmp_path, 2, ["dptp"], cpu_devices=2)
     r0 = (tmp_path / "worker0.txt").read_text().splitlines()
     r1 = (tmp_path / "worker1.txt").read_text().splitlines()
     assert r0[0] == r1[0]          # losses identical
@@ -161,21 +136,7 @@ def test_two_process_compressed_collectives(tmp_path):
     """Compressed gradient collectives over the process boundary
     (EQuARX-style, SURVEY 5.8): bf16 / int8 / packed-2bit payloads reduce
     correctly with measured wire-byte savings, all ranks bit-identical."""
-    env = dict(os.environ)
-    env.pop("XLA_FLAGS", None)
-    env["JAX_PLATFORMS"] = "cpu"
-    env["PYTHONPATH"] = REPO
-    for attempt in range(2):
-        cmd = [sys.executable, os.path.join(REPO, "tools", "launch.py"),
-               "-n", "2", "--port", str(_free_port()),
-               sys.executable,
-               os.path.join(REPO, "tests", "dist_worker.py"),
-               str(tmp_path), "compress"]
-        proc = subprocess.run(cmd, env=env, capture_output=True,
-                              text=True, timeout=280)
-        if proc.returncode == 0 or attempt == 1:
-            break
-    assert proc.returncode == 0, (proc.stdout[-2000:], proc.stderr[-2000:])
+    _launch(tmp_path, 2, ["compress"])
     r0 = (tmp_path / "worker0.txt").read_text().splitlines()
     r1 = (tmp_path / "worker1.txt").read_text().splitlines()
     assert r0 == r1                    # every codec replicated identically
@@ -187,21 +148,7 @@ def test_async_parameter_service(tmp_path):
     Hogwild workers pushing at different paces; weights converge on the
     shared quadratic and every push landed (reference dist_async
     semantics, kvstore_dist_server.h async branch)."""
-    env = dict(os.environ)
-    env.pop("XLA_FLAGS", None)
-    env["JAX_PLATFORMS"] = "cpu"
-    env["PYTHONPATH"] = REPO
-    for attempt in range(2):
-        cmd = [sys.executable, os.path.join(REPO, "tools", "launch.py"),
-               "-n", "2", "-s", "1", "--port", str(_free_port()),
-               sys.executable,
-               os.path.join(REPO, "tests", "dist_worker.py"),
-               str(tmp_path), "async"]
-        proc = subprocess.run(cmd, env=env, capture_output=True,
-                              text=True, timeout=280)
-        if proc.returncode == 0 or attempt == 1:
-            break
-    assert proc.returncode == 0, (proc.stdout[-2000:], proc.stderr[-2000:])
+    _launch(tmp_path, 2, ["async"], servers=1)
     rows = []
     for r in range(2):
         lines = (tmp_path / f"worker{r}.txt").read_text().splitlines()
@@ -211,3 +158,123 @@ def test_async_parameter_service(tmp_path):
     # gluon.Trainer segment: the single server weight copy is what both
     # ranks observe after the final barrier
     assert rows[0][2] == rows[1][2]
+
+
+def test_async_sliced_bigarray(tmp_path):
+    """PSKV big-array slicing (reference kvstore_dist.h EncodeDefaultKey):
+    with 2 servers and MXNET_KVSTORE_BIGARRAY_BOUND=100, a 200-element
+    key slices contiguously across BOTH servers (no single server holds
+    the whole array), raw push/pull round-trips through reassembly, and
+    server-side optimizer training converges over the slices."""
+    _launch(tmp_path, 2, ["async_sliced"], servers=2,
+            extra_env={"MXNET_KVSTORE_BIGARRAY_BOUND": "100"})
+    rows = [(tmp_path / f"worker{r}.txt").read_text().splitlines()
+            for r in range(2)]
+    for lines in rows:
+        assert lines[0] == "sliced-ok"     # raw contract + placement
+        assert float(lines[1]) < 0.2       # trained over slices
+    assert rows[0][2] == rows[1][2]        # both ranks see one model
+
+
+def test_async_wire_compression(tmp_path):
+    """Gradient compression on the async DCN wire: 2-bit (16x, exact on
+    code points, per-worker error feedback) and blockwise int8 payloads
+    push compressed, the server decodes before applying, measured wire
+    bytes shrink accordingly."""
+    _launch(tmp_path, 2, ["async_compress"], servers=1)
+    r0 = (tmp_path / "worker0.txt").read_text().splitlines()
+    r1 = (tmp_path / "worker1.txt").read_text().splitlines()
+    assert r0 == r1
+    assert r0[-1] == "residual-ok"
+
+
+def test_async_server_restart(tmp_path, monkeypatch):
+    """Server fault behavior: a killed-and-restarted parameter server
+    makes raw pushes fail LOUDLY (empty state is never silently
+    retrained), while gluon.Trainer re-seeds from its current weights
+    and resumes; the launcher token gates unauthenticated peers."""
+    import mxnet_tpu as mx
+    from mxnet_tpu.base import MXNetError
+    from mxnet_tpu.kvstore_async import KVStoreDistAsync
+
+    port = _free_port()
+    srv_env = dict(os.environ,
+                   DMLC_ROLE="server", DMLC_SERVER_ID="0",
+                   DMLC_NUM_SERVER="1", DMLC_NUM_WORKER="1",
+                   DMLC_PS_ROOT_URI="127.0.0.1",
+                   DMLC_PS_ROOT_PORT=str(port),
+                   PYTHONPATH=REPO, JAX_PLATFORMS="cpu",
+                   MXNET_PS_TOKEN="sesame")
+    srv_env.pop("XLA_FLAGS", None)
+
+    def start_server():
+        return subprocess.Popen(
+            [sys.executable, "-m", "mxnet_tpu.kvstore_async"], env=srv_env)
+
+    for k in ("DMLC_PS_ROOT_URI", "DMLC_PS_ROOT_PORT", "DMLC_NUM_SERVER",
+              "DMLC_NUM_WORKER", "MXNET_PS_TOKEN"):
+        monkeypatch.setenv(k, srv_env[k])
+    monkeypatch.setenv("DMLC_WORKER_ID", "0")
+
+    srv = start_server()
+    try:
+        kv = KVStoreDistAsync()
+        kv.init("w", mx.np.zeros(4))
+        kv.push("w", mx.np.array(onp.ones(4, "f4")))
+        got = kv.pull("w", out=mx.np.zeros(4)).asnumpy()
+        assert onp.allclose(got, 1.0)
+
+        # wrong token: rejected before any state is touched
+        bad = KVStoreDistAsync()
+        bad._token = "wrong"
+        with pytest.raises((MXNetError, ConnectionError)):
+            bad.pull("w", out=mx.np.zeros(4))
+
+        # kill + restart: the reconnect retry succeeds at the TCP layer,
+        # then fails loudly on the empty state
+        srv.kill()
+        srv.wait()
+        srv = start_server()
+        with pytest.raises(MXNetError, match="uninitialized"):
+            kv.push("w", mx.np.array(onp.ones(4, "f4")))
+
+        # Trainer-level recovery: re-seed from current worker weights,
+        # re-ship the optimizer, continue training
+        mx.random.seed(0)
+        net = mx.gluon.nn.Dense(2, in_units=3)
+        net.initialize()
+        net(mx.np.zeros((1, 3)))
+        tr = mx.gluon.Trainer(net.collect_params(), "sgd",
+                              {"learning_rate": 0.1},
+                              kvstore="dist_async")
+        loss_fn = mx.gluon.loss.L2Loss()
+
+        def step():
+            x = mx.np.array(onp.ones((2, 3), "f4"))
+            y = mx.np.array(onp.zeros((2, 2), "f4"))
+            with mx.autograd.record():
+                loss = loss_fn(net(x), y)
+            loss.backward()
+            tr.step(2)
+            return float(loss.asnumpy().mean())
+
+        first = step()                    # seeds server state
+        srv.kill()
+        srv.wait()
+        srv = start_server()
+        with pytest.warns(UserWarning, match="lost its state"):
+            step()                        # re-seeds and continues
+        for _ in range(10):
+            last = step()
+        assert last < first               # still converging after fault
+
+        # explicit update_on_kvstore=False is rejected up front
+        tr2 = mx.gluon.Trainer(net.collect_params(), "sgd",
+                               {"learning_rate": 0.1},
+                               kvstore="dist_async",
+                               update_on_kvstore=False)
+        with pytest.raises(MXNetError, match="update_on_kvstore"):
+            tr2._init_kvstore()
+    finally:
+        srv.kill()
+        srv.wait()

@@ -248,9 +248,16 @@ def test_kvstore_dist_async_service(monkeypatch):
         kv.push("never_inited", mx.np.ones((1,)))
     onp.testing.assert_allclose(kv.pull("q").asnumpy(), -0.725, atol=1e-6)
 
-    # compression is refused with guidance
-    with pytest.raises(mx.MXNetError, match="ici"):
-        kv.set_gradient_compression({"type": "2bit"})
+    # compression on the async wire (r4): packed push payloads with
+    # per-worker error feedback; bad codec names still refused
+    kv.set_gradient_compression({"type": "2bit", "threshold": 1.0})
+    kv.init("c", mx.np.zeros((8,)))
+    before = kv.push_wire_bytes
+    kv.push("c", mx.np.ones((8,)))          # 8 codes pack into 2 bytes
+    assert kv.push_wire_bytes - before == 2
+    with pytest.raises(mx.MXNetError, match="compression type"):
+        kv.set_gradient_compression({"type": "bogus"})
+    kv.set_gradient_compression({"type": "none"})
 
     kv.stop_servers()
     t.join(10)

@@ -29,7 +29,13 @@ def launch_local(args, cmd):
     ps_port = args.port + 1
     if args.num_servers:
         # parameter-server processes (kvstore='dist_async'): role env per
-        # the reference DMLC contract, entry = mxnet_tpu.kvstore_async
+        # the reference DMLC contract, entry = mxnet_tpu.kvstore_async.
+        # A per-job shared secret gates the PS port: only processes this
+        # launcher started (or that were handed the token) can touch
+        # weights or stop servers.
+        if "MXNET_PS_TOKEN" not in base_env:
+            import secrets
+            base_env["MXNET_PS_TOKEN"] = secrets.token_hex(16)
         for sid in range(args.num_servers):
             env = dict(base_env)
             env.update({
