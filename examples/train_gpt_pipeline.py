@@ -1,11 +1,19 @@
 #!/usr/bin/env python
 """Pipeline-parallel GPT training — beyond-reference capability.
 
-A real GPT's transformer blocks run as GPipe pipeline stages over a mesh
-``pp`` axis (``parallel.GPTPipe``): stacked per-stage weights, microbatches
-hopping stage-to-stage via ppermute inside a scan, trained through
-SPMDTrainer at loss parity with the non-pipelined model (see
+A real GPT's transformer blocks run as pipeline stages over a mesh
+``pp`` axis (``parallel.GPTPipe``): stacked per-stage weights,
+microbatches hopping stage-to-stage via ppermute inside a scan, trained
+through SPMDTrainer at loss parity with the non-pipelined model (see
 tests/test_pp_ep.py for the parity proof).
+
+The default schedule is **1F1B** (``--schedule gpipe`` for the
+alternative): backward of microbatch m starts as soon as its forward
+leaves the last stage, so a stage holds at most S saved inputs (the
+residual ring) instead of GPipe's all-M footprint — activation memory
+O(S·act) vs O(M·act), the win that lets M scale to shrink the bubble
+fraction (S-1)/(M+S-1) without scaling memory. Loss/grad parity between
+the two schedules is asserted in tests/test_pp_ep.py.
 
 8-dev CPU mesh: XLA_FLAGS=--xla_force_host_platform_device_count=8 \\
                 python examples/train_gpt_pipeline.py --force-cpu
@@ -26,6 +34,8 @@ def main():
     ap.add_argument("--seq", type=int, default=64)
     ap.add_argument("--units", type=int, default=128)
     ap.add_argument("--steps", type=int, default=10)
+    ap.add_argument("--schedule", default="1f1b",
+                    choices=["1f1b", "gpipe"])
     ap.add_argument("--force-cpu", action="store_true")
     args = ap.parse_args()
 
@@ -49,7 +59,8 @@ def main():
     net = GPTPipe(mesh, vocab_size=vocab, num_layers=n, units=args.units,
                   hidden_size=4 * args.units, num_heads=4,
                   max_length=args.seq,
-                  num_microbatches=args.microbatches)
+                  num_microbatches=args.microbatches,
+                  schedule=args.schedule)
     net.initialize()
     net(mx.np.zeros((args.batch, args.seq), dtype="int32"))
 
@@ -74,7 +85,12 @@ def main():
     dt = time.perf_counter() - t0
     tok_s = args.batch * args.seq * args.steps / dt
     print(f"{tok_s:,.0f} tokens/sec over {n} pipeline stages "
-          f"x {args.microbatches} microbatches")
+          f"x {args.microbatches} microbatches [{args.schedule}]")
+    if args.schedule == "1f1b":
+        M, S = args.microbatches, n
+        print(f"1F1B: max {S} saved inputs/stage vs GPipe's {M}; "
+              f"bubble fraction ~{(S - 1) / (M + S - 1):.0%} — raise "
+              "--microbatches to shrink it at constant memory")
 
 
 if __name__ == "__main__":

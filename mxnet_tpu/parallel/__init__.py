@@ -7,7 +7,8 @@ parameters/activations annotated with PartitionSpecs, XLA inserting the
 collectives over ICI/DCN. Strategies the reference never had (TP/SP) are
 new capability here, exposed as sharding rules (SURVEY.md 5.7/5.8).
 """
-from .mesh import make_mesh, mesh_axes, replicated, shard_batch
+from .mesh import (make_mesh, mesh_axes, replicated, shard_batch,
+                   slice_groups)
 from .spmd import (PartitionRules, SPMDTrainer, DEFAULT_TRANSFORMER_RULES,
                    DATA_PARALLEL_RULES)
 from .ring import ring_attention, local_ring_attention
@@ -16,6 +17,7 @@ from .pipeline import (pipeline_apply, pipeline_train_grads, GPTPipe,
 from .moe import MoEDense, MOE_RULES, MOE_TRANSFORMER_RULES
 
 __all__ = ["make_mesh", "mesh_axes", "replicated", "shard_batch",
+           "slice_groups",
            "PartitionRules", "SPMDTrainer", "DEFAULT_TRANSFORMER_RULES",
            "DATA_PARALLEL_RULES", "ring_attention", "local_ring_attention",
            "pipeline_apply", "pipeline_train_grads", "MoEDense",

@@ -221,7 +221,8 @@ def pipeline_train_grads(stage_fn: Callable, loss_fn: Callable,
                          stage_params: Any, x: "jax.Array", y: "jax.Array",
                          mesh: "jax.sharding.Mesh", axis: str = "pp",
                          num_microbatches: Optional[int] = None,
-                         rng_key: Optional["jax.Array"] = None):
+                         rng_key: Optional["jax.Array"] = None,
+                         head_params: Any = None):
     """One pipeline-parallel training pass with the 1F1B schedule:
     returns ``(mean_loss, stage_grads)`` in a single hand-scheduled
     sweep — no ``jax.grad`` over the whole pipeline.
@@ -246,6 +247,23 @@ def pipeline_train_grads(stage_fn: Callable, loss_fn: Callable,
     ``stage_params`` and are already divided by ``num_microbatches``.
     ``rng_key``: as in :func:`pipeline_apply`, folded per
     (microbatch, stage) so backward regenerates the forward's dropout.
+
+    ``head_params`` (full-model 1F1B, r4): an optional pytree of
+    last-stage head parameters (final norm, LM projection). When given,
+    ``loss_fn(head_params, h_out, y_mb) -> scalar`` runs INSIDE the
+    sweep at the last stage (guarded by ``lax.cond`` so interior stages
+    skip the vocab matmul), and the return becomes ``(mean_loss,
+    stage_grads, head_grads, dx)`` — ``head_grads`` matching
+    ``head_params`` and ``dx`` the gradient w.r.t. ``x`` (stage 0's
+    incoming cotangents, reassembled over microbatches), so the caller
+    can chain embedding/backbone backward outside the pipeline. This is
+    what lets a complete model (embed -> stages -> head) train under
+    the 1F1B discipline rather than only the stage stack.
+
+    Memory note: ``dx`` accumulates per microbatch, an input-batch-sized
+    buffer — the same order as ``x`` itself, which every schedule holds
+    for the whole sweep. The 1F1B O(S)-vs-O(M) advantage concerns the
+    per-stage HIDDEN-activation residual ring, which stays S-slot here.
     """
     S = mesh.shape[axis]
     n_micro = num_microbatches or S
@@ -269,7 +287,7 @@ def pipeline_train_grads(stage_fn: Callable, loss_fn: Callable,
         key = jax.random.fold_in(jax.random.fold_in(rng_key, m), stage)
         return stage_fn(params, h, key)
 
-    def local(params, x_mb, y_mb):
+    def local(params, x_mb, y_mb, hparams=None):
         params = jax.tree_util.tree_map(lambda a: a[0], params)
         stage = jax.lax.axis_index(axis)
         ftbl = jnp.asarray(ftbl_np)
@@ -281,7 +299,13 @@ def pipeline_train_grads(stage_fn: Callable, loss_fn: Callable,
         ring0 = jnp.zeros((S,) + act_shape, dt)
 
         def tick(carry, k):
-            wire_f, wire_b, inbox_f, inbox_b, saved, gacc, lacc = carry
+            if head_params is None:
+                (wire_f, wire_b, inbox_f, inbox_b, saved,
+                 gacc, lacc) = carry
+                hacc = dxacc = None
+            else:
+                (wire_f, wire_b, inbox_f, inbox_b, saved,
+                 gacc, hacc, dxacc, lacc) = carry
             fm = ftbl[k][stage]
             bm = btbl[k][stage]
             afk = af[k][stage]
@@ -315,46 +339,115 @@ def pipeline_train_grads(stage_fn: Callable, loss_fn: Callable,
 
             # ---- backward phase ------------------------------------
             def bwd_branch(op):
-                gacc, lacc = op
+                if head_params is None:
+                    gacc, lacc = op
+                else:
+                    gacc, hacc, dxacc, lacc = op
                 m_clip = jnp.clip(bm, 0, n_micro - 1)
                 h_in = saved[bm % S]
                 h_out, pull = jax.vjp(
                     lambda p, h: _stage(p, h, bm), params, h_in)
-                loss_m, lpull = jax.vjp(
-                    lambda ho: loss_fn(ho, y_mb[m_clip]), h_out)
-                (dh_loss,) = lpull(jnp.ones_like(loss_m))
+                if head_params is None:
+                    loss_m, lpull = jax.vjp(
+                        lambda ho: loss_fn(ho, y_mb[m_clip]), h_out)
+                    (dh_loss,) = lpull(jnp.ones_like(loss_m))
+                    loss_add = jnp.where(stage == S - 1,
+                                         loss_m.astype(jnp.float32), 0.0)
+                else:
+                    # the head (final norm + vocab projection) runs only
+                    # where it exists — interior stages skip its FLOPs
+                    def at_tail(_):
+                        loss_m, lpull = jax.vjp(
+                            lambda hp, ho: loss_fn(hp, ho, y_mb[m_clip]),
+                            hparams, h_out)
+                        dhp, dh = lpull(jnp.ones_like(loss_m))
+                        return loss_m.astype(jnp.float32), dhp, dh
+
+                    def not_tail(_):
+                        return (jnp.float32(0),
+                                jax.tree_util.tree_map(jnp.zeros_like,
+                                                       hparams),
+                                jnp.zeros_like(h_out))
+
+                    loss_add, dhp, dh_loss = jax.lax.cond(
+                        stage == S - 1, at_tail, not_tail, None)
+                    hacc = jax.tree_util.tree_map(jnp.add, hacc, dhp)
                 g_in = jnp.where(stage == S - 1, dh_loss,
                                  inbox_b[bm % S])
                 dp, dh_in = pull(g_in)
                 gacc = jax.tree_util.tree_map(jnp.add, gacc, dp)
-                lacc = lacc + jnp.where(stage == S - 1,
-                                        loss_m.astype(jnp.float32), 0.0)
-                return gacc, lacc, dh_in
+                lacc = lacc + loss_add
+                if head_params is None:
+                    return gacc, lacc, dh_in
+                # stage 0's incoming cotangent IS d(loss)/d(x_mb[m])
+                dxacc = jax.lax.dynamic_update_index_in_dim(
+                    dxacc,
+                    jnp.where(stage == 0, dh_in, jnp.zeros_like(dh_in)),
+                    m_clip, 0)
+                return gacc, hacc, dxacc, lacc, dh_in
 
-            gacc, lacc, send_b = jax.lax.cond(
-                bm >= 0, bwd_branch,
-                lambda op: (op[0], op[1], zero_act), (gacc, lacc))
+            if head_params is None:
+                gacc, lacc, send_b = jax.lax.cond(
+                    bm >= 0, bwd_branch,
+                    lambda op: (op[0], op[1], zero_act), (gacc, lacc))
+            else:
+                gacc, hacc, dxacc, lacc, send_b = jax.lax.cond(
+                    bm >= 0, bwd_branch,
+                    lambda op: (op[0], op[1], op[2], op[3], zero_act),
+                    (gacc, hacc, dxacc, lacc))
 
             # collectives OUTSIDE the conds: every device participates
             wire_f = jax.lax.ppermute(send_f, axis, perm_f)
             wire_b = jax.lax.ppermute(send_b, axis, perm_b)
+            if head_params is None:
+                return (wire_f, wire_b, inbox_f, inbox_b, saved,
+                        gacc, lacc), None
             return (wire_f, wire_b, inbox_f, inbox_b, saved,
-                    gacc, lacc), None
+                    gacc, hacc, dxacc, lacc), None
 
         gacc0 = jax.tree_util.tree_map(jnp.zeros_like, params)
+        if head_params is None:
+            carry0 = (zero_act, zero_act, ring0, ring0, ring0,
+                      gacc0, jnp.float32(0))
+            (*_, gacc, lacc), _ = jax.lax.scan(tick, carry0,
+                                               jnp.arange(T))
+            loss = jax.lax.psum(lacc, axis) / n_micro
+            grads = jax.tree_util.tree_map(
+                lambda g: (g / n_micro)[None], gacc)
+            return loss, grads
+        hacc0 = jax.tree_util.tree_map(jnp.zeros_like, hparams)
+        dx0 = jnp.zeros((n_micro,) + act_shape, dt)
         carry0 = (zero_act, zero_act, ring0, ring0, ring0,
-                  gacc0, jnp.float32(0))
-        (*_, gacc, lacc), _ = jax.lax.scan(tick, carry0, jnp.arange(T))
+                  gacc0, hacc0, dx0, jnp.float32(0))
+        (*_, gacc, hacc, dxacc, lacc), _ = jax.lax.scan(
+            tick, carry0, jnp.arange(T))
         loss = jax.lax.psum(lacc, axis) / n_micro
         grads = jax.tree_util.tree_map(
             lambda g: (g / n_micro)[None], gacc)
-        return loss, grads
+        # head grads live only at the tail, dx only at stage 0 — psum
+        # replicates both to every stage
+        hgrads = jax.tree_util.tree_map(
+            lambda g: jax.lax.psum(g, axis) / n_micro, hacc)
+        # the sweep seeds each microbatch loss with cotangent 1; the
+        # returned total is the MEAN over microbatches, so dx needs the
+        # same 1/n_micro the stage/head grads get
+        dx = jax.lax.psum(dxacc, axis) / n_micro
+        return loss, grads, hgrads, dx
 
     pspec = jax.tree_util.tree_map(lambda _: P(axis), stage_params)
-    loss, grads = _shard_map(
-        local, mesh, in_specs=(pspec, P(), P()),
-        out_specs=(P(), pspec))(stage_params, x_mb, y_mb)
-    return loss, grads
+    if head_params is None:
+        loss, grads = _shard_map(
+            local, mesh, in_specs=(pspec, P(), P()),
+            out_specs=(P(), pspec))(stage_params, x_mb, y_mb)
+        return loss, grads
+    hspec = jax.tree_util.tree_map(lambda _: P(), head_params)
+    loss, grads, hgrads, dx = _shard_map(
+        lambda sp, xm, ym, hp: local(sp, xm, ym, hp),
+        mesh, in_specs=(pspec, P(), P(), hspec),
+        out_specs=(P(), pspec, hspec, P()))(
+            stage_params, x_mb, y_mb, head_params)
+    dx = dx.reshape((B,) + x.shape[1:])
+    return loss, grads, hgrads, dx
 
 
 # ---------------------------------------------------------------------------
@@ -394,12 +487,22 @@ class GPTPipe(HybridBlock):
                  num_heads: int = 4, max_length: int = 512,
                  num_microbatches: Optional[int] = None,
                  axis: str = "pp", dropout: float = 0.0,
+                 schedule: str = "gpipe",
                  **kwargs: Any) -> None:
         super().__init__(**kwargs)
         from ..gluon.model_zoo.gpt import GPTBlock
         from ..gluon.nn import Embedding, LayerNorm
         from ..gluon.parameter import Parameter
 
+        if schedule not in ("gpipe", "1f1b"):
+            raise ValueError(f"schedule must be 'gpipe' or '1f1b', "
+                             f"got {schedule!r}")
+        # '1f1b': SPMDTrainer routes gradients through the hand-scheduled
+        # sweep (pipeline_loss_and_grads) — S-slot residual memory and
+        # tail-ramp backward overlap instead of GPipe's M-microbatch
+        # footprint. Inference/forward always uses the GPipe schedule
+        # (forward-only has no backward to overlap).
+        self.schedule = schedule
         self._mesh = mesh
         self._axis = axis
         self._n_micro = num_microbatches
@@ -527,3 +630,75 @@ class GPTPipe(HybridBlock):
         x = self.ln_f(from_jax(out))
         w = self.word_embed.weight.data()
         return mxnp.matmul(x, w.T)
+
+    def pipeline_loss_and_grads(self, params, param_arrays, inputs,
+                                labels, loss_fn, rng=None,
+                                output_transform=None):
+        """SPMDTrainer gradient hook (``schedule='1f1b'``): full-model
+        loss and per-parameter grads through the hand-scheduled 1F1B
+        sweep — the embedding runs (and backprops) OUTSIDE the pipeline
+        via ``jax.vjp`` chained on the sweep's ``dx``, the final norm +
+        tied LM projection run INSIDE it as last-stage head params.
+        Returns ``(loss, grads, mutated={})`` with grads aligned to
+        ``param_arrays``."""
+        from ..gluon.block import _bind_params
+        from ..ndarray.ndarray import from_jax
+
+        tokens = inputs[0]
+        T = int(tokens.shape[1])
+        idx = {id(p): i for i, p in enumerate(params)}
+
+        def arr(p):
+            return param_arrays[idx[id(p)]]
+
+        ew = arr(self.word_embed.weight)
+        pw = arr(self.position_weight)
+        ln_plist = list(self.ln_f.collect_params().values())
+        ln_arrays = tuple(arr(p) for p in ln_plist)
+        stage_arrays = [arr(sp) for sp in self._stacked]
+
+        def embed_fn(ew_, pw_):
+            return jnp.take(ew_, tokens, axis=0) + pw_[:T][None]
+
+        x_act, embed_vjp = jax.vjp(embed_fn, ew, pw)
+
+        tpl, tpl_params = self._template, self._tpl_params
+
+        def stage_fn(param_slices, h, key=None):
+            from ..ndarray import random as _random
+            with _bind_params(tpl_params, param_slices):
+                if key is None:
+                    out = tpl.forward(from_jax(h))
+                else:
+                    with _random.trace_key_scope(key):
+                        out = tpl.forward(from_jax(h))
+            return out._data
+
+        head_params = ln_arrays + (ew,)
+
+        def head_loss(hp, h_out, y_mb):
+            with _bind_params(ln_plist, list(hp[:-1])):
+                xo = self.ln_f.forward(from_jax(h_out))
+            logits = from_jax(jnp.matmul(xo._data, hp[-1].T))
+            if output_transform is not None:
+                logits = output_transform(logits)
+            l = loss_fn(logits, from_jax(y_mb))
+            return jnp.mean(l._data)
+
+        from .._tape import is_training
+        rng_key = rng if (self._dropout > 0.0 and rng is not None
+                          and is_training()) else None
+        loss, sgrads, hgrads, dx = pipeline_train_grads(
+            stage_fn, head_loss, stage_arrays, x_act, labels, self._mesh,
+            axis=self._axis, num_microbatches=self._n_micro,
+            rng_key=rng_key, head_params=head_params)
+        d_ew_embed, d_pw = embed_vjp(dx)
+        grads = [jnp.zeros_like(a) for a in param_arrays]
+        # tied embedding: lookup grad + LM-projection grad
+        grads[idx[id(self.word_embed.weight)]] = hgrads[-1] + d_ew_embed
+        grads[idx[id(self.position_weight)]] = d_pw
+        for p, g in zip(ln_plist, hgrads[:-1]):
+            grads[idx[id(p)]] = g
+        for sp, g in zip(self._stacked, sgrads):
+            grads[idx[id(sp)]] = g
+        return loss, grads, {}

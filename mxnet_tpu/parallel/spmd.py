@@ -307,8 +307,26 @@ class SPMDTrainer:
                     mut = dict(_collect_mutated(params, pa))
                     return total, mut
 
-            (loss, mut), grads = jax.value_and_grad(
-                forward, has_aux=True)(list(param_arrays))
+            if getattr(block, "schedule", None) == "1f1b" and \
+                    callable(getattr(block, "pipeline_loss_and_grads",
+                                     None)):
+                # pipeline blocks with a hand-scheduled 1F1B sweep own
+                # their gradient computation — interleaved fwd/bwd with
+                # an S-slot residual ring instead of jax.grad over the
+                # whole GPipe schedule. Training mode and the trainer's
+                # output transform apply exactly as on the autodiff path.
+                from .._tape import set_training
+                prev = set_training(True)
+                try:
+                    loss, grads, mut = block.pipeline_loss_and_grads(
+                        params, list(param_arrays), inputs, labels,
+                        loss_fn, rng,
+                        output_transform=self._output_transform)
+                finally:
+                    set_training(prev)
+            else:
+                (loss, mut), grads = jax.value_and_grad(
+                    forward, has_aux=True)(list(param_arrays))
             for i in mut:
                 if params[i].grad_req != "null":
                     raise MXNetError(

@@ -403,3 +403,64 @@ def test_spmd_step_loss_matches_eager_with_bn():
     # running stats advanced identically on both paths
     assert_almost_equal(net_a[1].running_mean.data(),
                         net_b[1].running_mean.data(), rtol=1e-4, atol=1e-6)
+
+
+def test_hybrid_multislice_mesh():
+    """make_mesh(slices=S) builds the DCN x ICI hybrid layout (SURVEY
+    5.8, jax create_hybrid_device_mesh analog): the dcn axis is
+    slice-major — its high-order factor walks slices, its low-order
+    remainder and every other axis stay within a slice — and training
+    over it works end to end."""
+    import jax
+    from jax.sharding import PartitionSpec as P
+    from mxnet_tpu.parallel import (make_mesh, slice_groups,
+                                    PartitionRules, SPMDTrainer)
+
+    devs = jax.devices()[:8]
+    mesh = make_mesh({"dp": 4, "tp": 2}, devices=devs, slices=2,
+                     dcn_axis="dp")
+    assert mesh.shape == {"dp": 4, "tp": 2}
+    # virtual CPU reports no slice structure -> contiguous halves stand
+    # in for slices; dp rows 0-1 must be slice 0, rows 2-3 slice 1
+    half0 = {d.id for d in devs[:4]}
+    assert {d.id for d in mesh.devices[:2, :].ravel()} == half0
+    assert {d.id for d in mesh.devices[2:, :].ravel()} == \
+        {d.id for d in devs[4:]}
+    # each tp pair (ICI neighbors) stays inside one slice
+    for i in range(4):
+        row = {d.id for d in mesh.devices[i, :]}
+        assert row <= half0 or not (row & half0)
+
+    # validation errors
+    with pytest.raises(mx.MXNetError, match="divide"):
+        make_mesh({"dp": 3, "tp": 2}, devices=devs[:6], slices=2)
+    with pytest.raises(mx.MXNetError, match="not a mesh axis"):
+        make_mesh({"dp": 4, "tp": 2}, devices=devs, slices=2,
+                  dcn_axis="pp")
+
+    # slice_groups fallback: one group when nothing reports slices
+    gs = slice_groups(devs)
+    assert len(gs) >= 1
+
+    # end-to-end: dp over dcn x ici, tp inside a slice
+    mx.random.seed(0)
+    net = mx.gluon.nn.HybridSequential()
+    net.add(mx.gluon.nn.Dense(16, in_units=8, activation="relu"),
+            mx.gluon.nn.Dense(4, in_units=16))
+    net.initialize()
+    rules = PartitionRules([
+        (r"0\.weight$", P("tp", None)),
+        (r"0\.bias$", P("tp")),
+        (r"1\.weight$", P(None, "tp")),
+    ])
+    tr = SPMDTrainer(net, mx.gluon.loss.L2Loss(), optimizer="sgd",
+                     optimizer_params={"learning_rate": 0.1},
+                     mesh=mesh, rules=rules,
+                     data_spec=P("dp"), label_spec=P("dp"))
+    import numpy as onp
+    rng = onp.random.RandomState(1)
+    x = rng.uniform(-1, 1, (8, 8)).astype("float32")
+    y = rng.uniform(-1, 1, (8, 4)).astype("float32")
+    l1 = float(tr.step(mx.np.array(x), mx.np.array(y)).asnumpy())
+    l2 = float(tr.step(mx.np.array(x), mx.np.array(y)).asnumpy())
+    assert l2 < l1
