@@ -163,12 +163,23 @@ def _prefill(params, tokens, nh, L, ga=(False, 1e-5)):
     return x[:, -1, :] @ params["embed"].T, caches
 
 
-def _select(logits, method, temperature, top_k, key):
+def _select(logits, method, temperature, top_k, top_p, key):
     if method == "greedy":
         return jnp.argmax(logits, axis=-1).astype(jnp.int32)
     logits = logits / jnp.maximum(temperature, 1e-6)
     if method == "top_k":
         kth = jnp.sort(logits, axis=-1)[:, -top_k][:, None]
+        logits = jnp.where(logits < kth, -jnp.inf, logits)
+    elif method == "top_p":
+        # nucleus sampling: keep the smallest prefix of the
+        # probability-sorted vocab whose cumulative mass reaches top_p
+        # (the most probable token is always kept)
+        sorted_desc = jnp.sort(logits, axis=-1)[:, ::-1]
+        probs = jax.nn.softmax(sorted_desc, axis=-1)
+        cum = jnp.cumsum(probs, axis=-1)
+        keep = (cum - probs) < top_p
+        kth = jnp.min(jnp.where(keep, sorted_desc, jnp.inf),
+                      axis=-1, keepdims=True)
         logits = jnp.where(logits < kth, -jnp.inf, logits)
     elif method != "sample":
         raise MXNetError(f"unknown generation method {method!r}")
@@ -232,13 +243,16 @@ def _model_sig(params, nh, ga):
 
 def generate(model, tokens, max_new_tokens: int, method: str = "greedy",
              temperature: float = 1.0, top_k: int = 40,
-             eos_token: Optional[int] = None, seed: int = 0):
+             eos_token: Optional[int] = None, seed: int = 0,
+             top_p: float = 0.9):
     """Decode ``max_new_tokens`` continuations of ``tokens`` (B, T0).
 
-    Returns an int32 array (B, max_new_tokens). After ``eos_token`` (if
-    given) a sequence keeps emitting ``eos_token``. One XLA program per
-    (shape, method) signature — repeated calls reuse the compiled
-    prefill+scan.
+    ``method``: 'greedy', 'sample', 'top_k', or 'top_p' (nucleus —
+    sample from the smallest probability-sorted vocab prefix whose
+    cumulative mass reaches ``top_p``). Returns an int32 array
+    (B, max_new_tokens). After ``eos_token`` (if given) a sequence keeps
+    emitting ``eos_token``. One XLA program per (shape, method)
+    signature — repeated calls reuse the compiled prefill+scan.
     """
     import numpy as onp
     toks, params, nh, L, ga = _prepare(model, tokens, max_new_tokens)
@@ -249,15 +263,18 @@ def generate(model, tokens, max_new_tokens: int, method: str = "greedy",
         if not 1 <= top_k:
             raise MXNetError(f"top_k must be >= 1, got {top_k}")
         top_k = min(int(top_k), V)
+    if method == "top_p" and not 0.0 < top_p <= 1.0:
+        raise MXNetError(f"top_p must be in (0, 1], got {top_p}")
 
     sig = ("gen", _model_sig(params, nh, ga), B, T0, max_new_tokens,
-           method, float(temperature), int(top_k), eos)
+           method, float(temperature), int(top_k), float(top_p), eos)
     prog = _PROG_CACHE.get(sig)
     if prog is None:
         def run(params, toks, key):
             logits, caches = _prefill(params, toks, nh, L, ga)
             key, sub = jax.random.split(key)
-            first = _select(logits, method, temperature, top_k, sub)
+            first = _select(logits, method, temperature, top_k, top_p,
+                            sub)
             if eos >= 0:
                 done0 = first == eos
             else:
@@ -269,7 +286,8 @@ def generate(model, tokens, max_new_tokens: int, method: str = "greedy",
                 logits, caches = _forward_step(params, tok, caches,
                                                pos, nh, ga)
                 key, sub = jax.random.split(key)
-                nxt = _select(logits, method, temperature, top_k, sub)
+                nxt = _select(logits, method, temperature, top_k, top_p,
+                              sub)
                 if eos >= 0:
                     nxt = jnp.where(done, eos, nxt)
                     done = done | (nxt == eos)

@@ -151,13 +151,13 @@ class GPTModel(HybridBlock):
     def generate(self, tokens, max_new_tokens: int,
                  method: str = "greedy", temperature: float = 1.0,
                  top_k: int = 40, eos_token: Optional[int] = None,
-                 seed: int = 0) -> NDArray:
-        """KV-cache incremental decoding (greedy / 'sample' /
-        'top_k'): one compiled prefill + lax.scan program per shape
-        signature. See ``model_zoo.generation``."""
+                 seed: int = 0, top_p: float = 0.9) -> NDArray:
+        """KV-cache incremental decoding (greedy / 'sample' / 'top_k' /
+        'top_p' nucleus): one compiled prefill + lax.scan program per
+        shape signature. See ``model_zoo.generation``."""
         from .generation import generate as _gen
         return _gen(self, tokens, max_new_tokens, method=method,
-                    temperature=temperature, top_k=top_k,
+                    temperature=temperature, top_k=top_k, top_p=top_p,
                     eos_token=eos_token, seed=seed)
 
     def beam_search(self, tokens, max_new_tokens: int,

@@ -497,6 +497,17 @@ class GPTPipe(HybridBlock):
         if schedule not in ("gpipe", "1f1b"):
             raise ValueError(f"schedule must be 'gpipe' or '1f1b', "
                              f"got {schedule!r}")
+        if schedule == "1f1b":
+            extra = [a for a in mesh.axis_names
+                     if a != axis and mesh.shape[a] > 1]
+            if extra:
+                # the sweep shard_maps the batch replicated (P()) over
+                # every axis: a dp axis would silently recompute the
+                # full batch per replica — no speedup, extra memory
+                raise ValueError(
+                    f"schedule='1f1b' supports a pure-{axis} mesh; "
+                    f"axes {extra} would be silently replicated — use "
+                    "schedule='gpipe' to compose pp with dp")
         # '1f1b': SPMDTrainer routes gradients through the hand-scheduled
         # sweep (pipeline_loss_and_grads) — S-slot residual memory and
         # tail-ramp backward overlap instead of GPipe's M-microbatch

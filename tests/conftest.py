@@ -35,14 +35,15 @@ else:
 
 import pytest  # noqa: E402
 
-# Genuinely host-only test files under the chip flip: they need the
-# 8-device virtual CPU mesh (one real chip in the bench env) or spawn
-# multi-process CPU jobs.
-_HOST_MESH_FILES = {
-    "test_parallel.py", "test_pp_ep.py", "test_ring.py",
-    "test_spmd_multistep.py", "test_spmd_checkpoint.py",
-    "test_distributed.py",
-}
+
+def pytest_configure(config):
+    config.addinivalue_line(
+        "markers",
+        "host_mesh: needs the multi-device virtual CPU mesh or spawns "
+        "multi-process CPU jobs; skipped under the MXNET_TEST_CTX=tpu "
+        "ctx-flip (one real chip in the bench env). Mark any new "
+        "multi-device test file with `pytestmark = pytest.mark."
+        "host_mesh` — there is no central filename list to update.")
 
 
 def pytest_collection_modifyitems(config, items):
@@ -52,7 +53,7 @@ def pytest_collection_modifyitems(config, items):
         reason="multi-device/multi-process test: needs the virtual CPU "
                "mesh (single chip in the bench env)")
     for item in items:
-        if os.path.basename(str(item.fspath)) in _HOST_MESH_FILES:
+        if item.get_closest_marker("host_mesh") is not None:
             item.add_marker(skip)
 
 

@@ -10,6 +10,10 @@ import sys
 import numpy as onp
 import pytest
 
+# chip ctx-flip: this whole file needs the multi-device virtual
+# CPU mesh (see conftest host_mesh marker)
+pytestmark = pytest.mark.host_mesh
+
 REPO = os.path.dirname(os.path.dirname(os.path.abspath(__file__)))
 
 

@@ -210,3 +210,38 @@ def test_beam_search_eos_normalization():
         if (row == eos).any():
             hit = onp.argmax(row == eos)
             assert (row[hit:] == eos).all()
+
+
+def test_generate_top_p_nucleus():
+    """Nucleus sampling (r4): a tiny top_p is greedy (only the argmax
+    survives the nucleus), top_p=1.0 equals plain sampling at the same
+    seed, draws are seed-deterministic, and bounds are validated."""
+    import numpy as onp
+    import pytest
+    net = _tiny_gpt()
+    prompt = onp.array([[1, 2, 3]], dtype="int32")
+
+    # nucleus collapsing to one token == greedy
+    tp = net.generate(prompt, 6, method="top_p", top_p=1e-6,
+                      seed=5).asnumpy()
+    gd = net.generate(prompt, 6).asnumpy()
+    onp.testing.assert_array_equal(tp, gd)
+
+    # top_p=1.0 keeps the whole vocab == unrestricted sampling
+    a = net.generate(prompt, 6, method="top_p", top_p=1.0,
+                     temperature=0.8, seed=7).asnumpy()
+    b = net.generate(prompt, 6, method="sample", temperature=0.8,
+                     seed=7).asnumpy()
+    onp.testing.assert_array_equal(a, b)
+
+    # deterministic per seed, varies across seeds
+    c = net.generate(prompt, 6, method="top_p", top_p=0.9,
+                     temperature=1.2, seed=11).asnumpy()
+    d = net.generate(prompt, 6, method="top_p", top_p=0.9,
+                     temperature=1.2, seed=11).asnumpy()
+    onp.testing.assert_array_equal(c, d)
+
+    with pytest.raises(mx.MXNetError, match="top_p"):
+        net.generate(prompt, 2, method="top_p", top_p=0.0)
+    with pytest.raises(mx.MXNetError, match="top_p"):
+        net.generate(prompt, 2, method="top_p", top_p=1.5)

@@ -13,6 +13,10 @@ from mxnet_tpu.parallel import (DATA_PARALLEL_RULES,
                                 SPMDTrainer, make_mesh, shard_batch)
 from mxnet_tpu.test_utils import assert_almost_equal
 
+# chip ctx-flip: this whole file needs the multi-device virtual
+# CPU mesh (see conftest host_mesh marker)
+pytestmark = pytest.mark.host_mesh
+
 
 def _devices(n):
     return jax.devices()[:n]

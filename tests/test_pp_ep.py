@@ -10,6 +10,10 @@ from mxnet_tpu.parallel import (MoEDense, MOE_RULES, SPMDTrainer,
                                 DATA_PARALLEL_RULES, make_mesh,
                                 pipeline_apply, pipeline_train_grads)
 
+# chip ctx-flip: this whole file needs the multi-device virtual
+# CPU mesh (see conftest host_mesh marker)
+pytestmark = pytest.mark.host_mesh
+
 
 def _stage(params, h):
     W, b = params

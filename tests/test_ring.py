@@ -8,6 +8,10 @@ import pytest
 from mxnet_tpu.parallel import make_mesh, ring_attention
 from mxnet_tpu.parallel.ring import _dense
 
+# chip ctx-flip: this whole file needs the multi-device virtual
+# CPU mesh (see conftest host_mesh marker)
+pytestmark = pytest.mark.host_mesh
+
 
 def _rand_qkv(B=2, T=32, H=4, D=8, seed=0):
     rng = onp.random.RandomState(seed)

@@ -8,6 +8,11 @@ from mxnet_tpu.parallel import (SPMDTrainer, make_mesh,
                                 DATA_PARALLEL_RULES,
                                 DEFAULT_TRANSFORMER_RULES)
 from jax.sharding import PartitionSpec as P
+import pytest
+
+# chip ctx-flip: this whole file needs the multi-device virtual
+# CPU mesh (see conftest host_mesh marker)
+pytestmark = pytest.mark.host_mesh
 
 
 def _build():
