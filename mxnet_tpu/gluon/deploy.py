@@ -1,4 +1,4 @@
-"""Deploy-graph emission: a layer-op list the native C runtime can run.
+"""Deploy-graph emission: an SSA op list the native C runtime can run.
 
 Reference parity (leezu/mxnet): ``HybridBlock.export`` wrote an NNVM
 graph json that ``src/c_predict_api.cc`` executed from C with no Python.
@@ -6,9 +6,22 @@ Here the primary export payload is a StableHLO artifact (the TPU-era
 graph format), which the C runtime cannot interpret — so export()
 ADDITIONALLY emits this small declarative op list whenever the block is
 composed of layers the native runtime implements (dense / conv2d /
-batchnorm / pooling / activation / flatten / dropout-as-identity).
+batchnorm / pooling / activation / flatten / dropout-as-identity, and —
+r4 — elementwise ``add`` and channel ``concat``, which makes residual
+nets (ResNet) and concat trunks (Inception) C-runnable).
 ``src/predict.cc`` (MXPredCreate/MXPredForward) parses it, loads the
 .params file, and executes the graph through MXImperativeInvoke.
+
+Dataflow: value 0 is the network input; node k (0-based) produces value
+k+1; every node lists its input values under ``"in"`` (a node without
+``"in"`` consumes the previous node's output — the pre-r4 sequential
+format, which the C runtime still accepts).
+
+Blocks whose forward is not a plain child chain make themselves
+deployable by defining ``deploy_emit(self, em, prefix, vid) -> vid``
+(see :class:`DeployEmitter`) — the model zoo's residual and concat
+blocks do; user blocks can too. The hook must mirror the block's
+``forward`` exactly (guard against subclasses that override forward).
 """
 from __future__ import annotations
 
@@ -19,76 +32,110 @@ class _Unmappable(Exception):
     pass
 
 
-def deploy_graph(block) -> Optional[List[Dict[str, Any]]]:
-    """Best-effort layer-op list for ``block``; None when any layer has
-    no native-runtime mapping (the StableHLO payload still covers it)."""
-    from .nn.basic_layers import (Dense, Dropout, Flatten, BatchNorm,
-                                  HybridSequential)
-    from .nn.activations import Activation
-    from .nn.conv_layers import (Conv2D, MaxPool2D, AvgPool2D,
-                                 GlobalMaxPool2D, GlobalAvgPool2D)
+class DeployEmitter:
+    """The SSA-builder surface handed to a block's ``deploy_emit`` hook.
 
-    nodes: List[Dict[str, Any]] = []
+    * ``emit(child, prefix, vid) -> vid`` — recursively emit a child
+      block applied to value ``vid``; parameter names are keyed
+      ``prefix + <param name>``.
+    * ``push(node, ins) -> vid`` — append one raw graph node reading
+      the value ids ``ins``; returns the produced value id.
+    * ``bn(block, prefix)`` — a batchnorm (inference) node dict for a
+      BatchNorm block.
+    * ``act_ok(name)`` — validate an activation against the native set.
+    * ``fail(reason)`` — abort emission; export falls back to
+      ``deploy_graph = null`` (Python/StableHLO-only model).
+    """
 
-    def act_ok(a: Optional[str]) -> Optional[str]:
+    def __init__(self) -> None:
+        self.nodes: List[Dict[str, Any]] = []
+
+    def push(self, node: Dict[str, Any], ins: List[int]) -> int:
+        node["in"] = list(ins)
+        self.nodes.append(node)
+        return len(self.nodes)          # produced value id (0 = input)
+
+    def fail(self, reason: str) -> None:
+        raise _Unmappable(reason)
+
+    def act_ok(self, a: Optional[str]) -> Optional[str]:
         # the native runtime implements exactly these (src/ndarray.cc)
         if a not in (None, "relu", "sigmoid", "tanh"):
             raise _Unmappable(f"activation {a!r}")
         return a
 
-    def emit(b, prefix: str) -> None:
+    def bn(self, b, pfx: str) -> Dict[str, Any]:
+        if b._axis not in (1, -3):
+            raise _Unmappable(repr(b))
+        return {"op": "batchnorm", "gamma": pfx + "gamma",
+                "beta": pfx + "beta", "mean": pfx + "running_mean",
+                "var": pfx + "running_var", "eps": float(b._epsilon)}
+
+    def emit(self, b, prefix: str, vid: int) -> int:
+        """Emit ops computing ``b(value vid)``; returns the output id."""
+        from .nn.basic_layers import (Dense, Dropout, Flatten, BatchNorm,
+                                      HybridSequential)
+        from .nn.activations import Activation
+        from .nn.conv_layers import (Conv2D, MaxPool2D, AvgPool2D,
+                                     GlobalMaxPool2D, GlobalAvgPool2D)
+
+        hook = getattr(type(b), "deploy_emit", None)
+        if hook is not None:
+            return hook(b, self, prefix, vid)
         if isinstance(b, HybridSequential):
+            if type(b).forward is not HybridSequential.forward:
+                raise _Unmappable(type(b).__name__)   # custom dataflow
             for name, child in b._children.items():
-                emit(child, f"{prefix}{name}.")
-            return
+                vid = self.emit(child, f"{prefix}{name}.", vid)
+            return vid
         if isinstance(b, Dense):
-            nodes.append({
+            return self.push({
                 "op": "dense", "weight": prefix + "weight",
                 "bias": prefix + "bias" if b.bias is not None else None,
                 "flatten": int(b._flatten),
-                "activation": act_ok(b._activation)})
-            return
+                "activation": self.act_ok(b._activation)}, [vid])
         if isinstance(b, Conv2D):
             if (b._transpose or b._groups != 1 or b._layout != "NCHW"
                     or tuple(b._dilation) != (1, 1)):
                 raise _Unmappable(repr(b))
-            nodes.append({
+            return self.push({
                 "op": "conv2d", "weight": prefix + "weight",
                 "bias": prefix + "bias" if b.bias is not None else None,
                 "stride": list(b._strides), "pad": list(b._padding),
-                "activation": act_ok(b._activation)})
-            return
+                "activation": self.act_ok(b._activation)}, [vid])
         if isinstance(b, (MaxPool2D, AvgPool2D, GlobalMaxPool2D,
                           GlobalAvgPool2D)):
             if b._layout != "NCHW":
                 raise _Unmappable(repr(b))
-            nodes.append({
-                "op": "maxpool2d" if b._pool_type == "max" else "avgpool2d",
+            return self.push({
+                "op": "maxpool2d" if b._pool_type == "max"
+                else "avgpool2d",
                 "kernel": list(b._kernel), "stride": list(b._strides),
                 "pad": list(b._padding), "global": int(b._global),
-                "count_include_pad": int(b._count_include_pad)})
-            return
+                "count_include_pad": int(b._count_include_pad)}, [vid])
         if isinstance(b, BatchNorm):
-            if b._axis not in (1, -3):
-                raise _Unmappable(repr(b))
-            nodes.append({
-                "op": "batchnorm", "gamma": prefix + "gamma",
-                "beta": prefix + "beta",
-                "mean": prefix + "running_mean",
-                "var": prefix + "running_var", "eps": float(b._epsilon)})
-            return
+            return self.push(self.bn(b, prefix), [vid])
         if isinstance(b, Activation):
-            nodes.append({"op": "activation", "act": act_ok(b._act)})
-            return
+            return self.push({"op": "activation",
+                              "act": self.act_ok(b._act)}, [vid])
         if isinstance(b, Flatten):
-            nodes.append({"op": "flatten"})
-            return
+            return self.push({"op": "flatten"}, [vid])
         if isinstance(b, Dropout):
-            return                      # identity at inference
+            return vid                  # identity at inference
         raise _Unmappable(type(b).__name__)
 
+
+def deploy_graph(block) -> Optional[List[Dict[str, Any]]]:
+    """Best-effort SSA op list for ``block``; None when any layer has
+    no native-runtime mapping (the StableHLO payload still covers it)."""
+    em = DeployEmitter()
     try:
-        emit(block, "")
+        out = em.emit(block, "", 0)
+        if out != len(em.nodes):
+            # the C runtime returns the LAST node's value; when the
+            # logical output is an earlier value (trailing Dropout
+            # identity), alias it through a no-op activation
+            em.push({"op": "activation", "act": None}, [out])
     except _Unmappable:
         return None
-    return nodes
+    return em.nodes

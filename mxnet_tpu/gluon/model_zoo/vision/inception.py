@@ -34,6 +34,17 @@ class _Concurrent(HybridSequential):
     def _children_list(self):
         return list(self._children.values())
 
+    def deploy_emit(self, em, prefix, vid):
+        """Native C-deployment emission (gluon.deploy SSA hook): fan the
+        input to every child, concat outputs on channels."""
+        if type(self).forward is not _Concurrent.forward:
+            em.fail(f"{type(self).__name__} overrides forward")
+        outs = [em.emit(child, f"{prefix}{name}.", vid)
+                for name, child in self._children.items()]
+        if len(outs) < 2:
+            em.fail("concat of < 2 branches")
+        return em.push({"op": "concat", "axis": 1}, outs)
+
 
 def _make_A(pool_features: int) -> _Concurrent:
     out = _Concurrent()
@@ -102,6 +113,15 @@ class _SplitConcat(HybridBlock):
         outs = [getattr(self, f"arm{i}")(x) for i in range(self._n_arms)]
         return ndops.concat(*outs, dim=1)
 
+    def deploy_emit(self, em, prefix, vid):
+        if type(self).forward is not _SplitConcat.forward:
+            em.fail(f"{type(self).__name__} overrides forward")
+        h = (em.emit(self.reduce, prefix + "reduce.", vid)
+             if self.reduce is not None else vid)
+        outs = [em.emit(getattr(self, f"arm{i}"), f"{prefix}arm{i}.", h)
+                for i in range(self._n_arms)]
+        return em.push({"op": "concat", "axis": 1}, outs)
+
 
 def _make_E() -> _Concurrent:
     out = _Concurrent()
@@ -145,6 +165,12 @@ class Inception3(HybridBlock):
 
     def forward(self, x):
         return self.output(self.features(x))
+
+    def deploy_emit(self, em, prefix, vid):
+        if type(self).forward is not Inception3.forward:
+            em.fail(f"{type(self).__name__} overrides forward")
+        h = em.emit(self.features, prefix + "features.", vid)
+        return em.emit(self.output, prefix + "output.", h)
 
 
 def inception_v3(classes: int = 1000, **kwargs: Any) -> Inception3:

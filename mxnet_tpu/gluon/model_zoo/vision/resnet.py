@@ -51,6 +51,9 @@ class BasicBlockV1(HybridBlock):
         from .... import npx
         return npx.relu(out + residual)
 
+    def deploy_emit(self, em, prefix, vid):
+        return _emit_v1_block(self, BasicBlockV1, em, prefix, vid)
+
 
 class BottleneckV1(HybridBlock):
     def __init__(self, channels: int, stride: int, downsample: bool = False,
@@ -82,6 +85,21 @@ class BottleneckV1(HybridBlock):
         from .... import npx
         return npx.relu(out + residual)
 
+    def deploy_emit(self, em, prefix, vid):
+        return _emit_v1_block(self, BottleneckV1, em, prefix, vid)
+
+
+def _emit_v1_block(self, cls, em, prefix, vid):
+    """Native C-deployment emission (gluon.deploy SSA hook):
+    ``relu(body(x) + downsample(x))`` — exactly ``forward`` above."""
+    if type(self).forward is not cls.forward:
+        em.fail(f"{type(self).__name__} overrides forward")
+    body = em.emit(self.body, prefix + "body.", vid)
+    res = (em.emit(self.downsample, prefix + "downsample.", vid)
+           if self.downsample is not None else vid)
+    s = em.push({"op": "add"}, [body, res])
+    return em.push({"op": "activation", "act": "relu"}, [s])
+
 
 class BasicBlockV2(HybridBlock):
     def __init__(self, channels: int, stride: int, downsample: bool = False,
@@ -107,6 +125,21 @@ class BasicBlockV2(HybridBlock):
         out = npx.relu(self.bn2(out))
         out = self.conv2(out)
         return out + residual
+
+    def deploy_emit(self, em, prefix, vid):
+        """Pre-activation residual (matches ``forward``: residual taken
+        at relu(bn1(x)) when downsampling, at x otherwise)."""
+        if type(self).forward is not BasicBlockV2.forward:
+            em.fail(f"{type(self).__name__} overrides forward")
+        h = em.push(em.bn(self.bn1, prefix + "bn1."), [vid])
+        h = em.push({"op": "activation", "act": "relu"}, [h])
+        res = (em.emit(self.downsample, prefix + "downsample.", h)
+               if self.downsample is not None else vid)
+        o = em.emit(self.conv1, prefix + "conv1.", h)
+        o = em.push(em.bn(self.bn2, prefix + "bn2."), [o])
+        o = em.push({"op": "activation", "act": "relu"}, [o])
+        o = em.emit(self.conv2, prefix + "conv2.", o)
+        return em.push({"op": "add"}, [o, res])
 
 
 class BottleneckV2(HybridBlock):
@@ -137,6 +170,22 @@ class BottleneckV2(HybridBlock):
         out = npx.relu(self.bn3(out))
         out = self.conv3(out)
         return out + residual
+
+    def deploy_emit(self, em, prefix, vid):
+        if type(self).forward is not BottleneckV2.forward:
+            em.fail(f"{type(self).__name__} overrides forward")
+        h = em.push(em.bn(self.bn1, prefix + "bn1."), [vid])
+        h = em.push({"op": "activation", "act": "relu"}, [h])
+        res = (em.emit(self.downsample, prefix + "downsample.", h)
+               if self.downsample is not None else vid)
+        o = em.emit(self.conv1, prefix + "conv1.", h)
+        o = em.push(em.bn(self.bn2, prefix + "bn2."), [o])
+        o = em.push({"op": "activation", "act": "relu"}, [o])
+        o = em.emit(self.conv2, prefix + "conv2.", o)
+        o = em.push(em.bn(self.bn3, prefix + "bn3."), [o])
+        o = em.push({"op": "activation", "act": "relu"}, [o])
+        o = em.emit(self.conv3, prefix + "conv3.", o)
+        return em.push({"op": "add"}, [o, res])
 
 
 _BLOCK_V1 = {"basic_block": BasicBlockV1, "bottle_neck": BottleneckV1}
@@ -187,6 +236,13 @@ class ResNetV1(HybridBlock):
         x = self.features(x)
         return self.output(Flatten()(x))
 
+    def deploy_emit(self, em, prefix, vid):
+        if type(self).forward is not ResNetV1.forward:
+            em.fail(f"{type(self).__name__} overrides forward")
+        h = em.emit(self.features, prefix + "features.", vid)
+        h = em.push({"op": "flatten"}, [h])
+        return em.emit(self.output, prefix + "output.", h)
+
 
 class ResNetV2(HybridBlock):
     def __init__(self, block: type, layers: List[int], channels: List[int],
@@ -220,6 +276,13 @@ class ResNetV2(HybridBlock):
     def forward(self, x):
         x = self.features(x)
         return self.output(Flatten()(x))
+
+    def deploy_emit(self, em, prefix, vid):
+        if type(self).forward is not ResNetV2.forward:
+            em.fail(f"{type(self).__name__} overrides forward")
+        h = em.emit(self.features, prefix + "features.", vid)
+        h = em.push({"op": "flatten"}, [h])
+        return em.emit(self.output, prefix + "output.", h)
 
 
 def get_resnet(version: int, num_layers: int, pretrained: bool = False,

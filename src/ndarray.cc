@@ -457,8 +457,64 @@ static void Pool2DOp(const std::vector<Array *> &in,
         }
 }
 
+static void ValidateConcat(const std::vector<Array *> &in,
+                           const std::vector<Array *> &out) {
+  /* inputs: >=2 data arrays + one int32 axis attr (last) */
+  if (in.size() < 3)
+    throw std::runtime_error("concat: need >=2 inputs + axis attr");
+  const Array *at = in.back();
+  if (at->dtype != 4 || NumElems(at) != 1)
+    throw std::runtime_error("concat: axis attr must be one int32");
+  int axis = static_cast<const int32_t *>(at->data)[0];
+  const Array *a0 = in[0];
+  size_t nd = a0->shape.size();
+  if (axis < 0 || static_cast<size_t>(axis) >= nd)
+    throw std::runtime_error("concat: axis out of range");
+  int64_t ax_sum = 0;
+  for (size_t t = 0; t + 1 < in.size(); ++t) {
+    const Array *a = in[t];
+    if (a->dtype != 0)
+      throw std::runtime_error("concat: float32 only");
+    if (a->shape.size() != nd)
+      throw std::runtime_error("concat: rank mismatch");
+    for (size_t d = 0; d < nd; ++d)
+      if (d != static_cast<size_t>(axis) && a->shape[d] != a0->shape[d])
+        throw std::runtime_error("concat: non-axis dim mismatch");
+    ax_sum += a->shape[axis];
+  }
+  if (out[0]->dtype != 0 || out[0]->shape.size() != nd ||
+      out[0]->shape[axis] != ax_sum)
+    throw std::runtime_error("concat: bad output shape");
+  for (size_t d = 0; d < nd; ++d)
+    if (d != static_cast<size_t>(axis) &&
+        out[0]->shape[d] != a0->shape[d])
+      throw std::runtime_error("concat: bad output shape");
+}
+
+static void ConcatOp(const std::vector<Array *> &in,
+                     const std::vector<Array *> &out) {
+  const Array *at = in.back();
+  int axis = static_cast<const int32_t *>(at->data)[0];
+  Array *O = out[0];
+  size_t nd = O->shape.size();
+  int64_t outer = 1, inner = 1;
+  for (int d = 0; d < axis; ++d) outer *= O->shape[d];
+  for (size_t d = axis + 1; d < nd; ++d) inner *= O->shape[d];
+  float *o = static_cast<float *>(O->data);
+  int64_t out_ax = O->shape[axis], off_ax = 0;
+  for (size_t t = 0; t + 1 < in.size(); ++t) {
+    const Array *A = in[t];
+    int64_t ax = A->shape[axis];
+    const float *a = static_cast<const float *>(A->data);
+    for (int64_t ou = 0; ou < outer; ++ou)
+      std::memcpy(o + (ou * out_ax + off_ax) * inner,
+                  a + ou * ax * inner, sizeof(float) * ax * inner);
+    off_ax += ax;
+  }
+}
+
 struct OpEntry {
-  int n_in, n_out;
+  int n_in, n_out;                 /* n_in < 0: variable (>= -n_in) */
   Validator validate;
   OpFn fn;
 };
@@ -509,6 +565,8 @@ static const std::map<std::string, OpEntry> &Ops() {
       {"conv2d", {4, 1, ValidateConv2D, Conv2DOp}},
       {"maxpool2d", {2, 1, ValidatePool2D, Pool2DOp<true>}},
       {"avgpool2d", {2, 1, ValidatePool2D, Pool2DOp<false>}},
+      /* variable arity: N>=2 data inputs + int32 axis attr */
+      {"concat", {-3, 1, ValidateConcat, ConcatOp}},
   };
   return ops;
 }
@@ -692,7 +750,10 @@ int MXImperativeInvoke(const char *op_name, NDArrayHandle *inputs, int n_in,
   if (it == ops.end())
     throw std::runtime_error(std::string("unknown native op '") +
                              (op_name ? op_name : "<null>") + "'");
-  if (n_in != it->second.n_in || n_out != it->second.n_out)
+  if (it->second.n_in >= 0 ? n_in != it->second.n_in
+                           : n_in < -it->second.n_in)
+    throw std::runtime_error("op arity mismatch");
+  if (n_out != it->second.n_out)
     throw std::runtime_error("op arity mismatch");
   {
     /* synchronous shape/dtype validation — errors must surface through

@@ -39,6 +39,10 @@ struct Node {
   std::string weight, bias, gamma, beta, mean, var;
   std::string activation, act;
   int flatten = 0, global_pool = 0, include_pad = 1;
+  int axis = 1;                 /* concat */
+  std::vector<int> in;          /* SSA input value ids (r4); empty =
+                                 * consume the previous node's output
+                                 * (pre-r4 sequential exports) */
   int64_t kernel[2] = {0, 0}, stride[2] = {1, 1}, pad[2] = {0, 0};
   float eps = 1e-5f;
 };
@@ -143,7 +147,39 @@ NDArrayHandle ApplyAct(Predictor *p, const std::string &act,
   return o;
 }
 
-NDArrayHandle RunNode(Predictor *p, const Node &n, NDArrayHandle h) {
+NDArrayHandle RunNode(Predictor *p, const Node &n,
+                      const std::vector<NDArrayHandle> &ins) {
+  if (n.op == "add") {
+    if (ins.size() != 2)
+      throw std::runtime_error("add: expected 2 inputs");
+    NDArrayHandle o = Temp(p, ShapeOf(ins[0]));
+    Invoke("add", {ins[0], ins[1]}, o);
+    return o;
+  }
+  if (n.op == "concat") {
+    if (ins.size() < 2)
+      throw std::runtime_error("concat: expected >=2 inputs");
+    std::vector<int64_t> os = ShapeOf(ins[0]);
+    if (n.axis < 0 || static_cast<size_t>(n.axis) >= os.size())
+      throw std::runtime_error("concat: axis out of range");
+    os[n.axis] = 0;
+    for (NDArrayHandle h2 : ins) {
+      std::vector<int64_t> s2 = ShapeOf(h2);
+      if (s2.size() != os.size())
+        throw std::runtime_error("concat: input rank mismatch");
+      os[n.axis] += s2[n.axis];
+    }
+    NDArrayHandle at = IntAttrArray(p, {static_cast<int32_t>(n.axis)});
+    NDArrayHandle o = Temp(p, os);
+    std::vector<NDArrayHandle> args(ins);
+    args.push_back(at);
+    Invoke("concat", args, o);
+    return o;
+  }
+  if (ins.size() != 1)
+    throw std::runtime_error("node '" + n.op +
+                             "': expected exactly 1 input");
+  NDArrayHandle h = ins[0];
   std::vector<int64_t> s = ShapeOf(h);
   if (n.op == "dense") {
     if (n.flatten && s.size() != 2) {
@@ -235,8 +271,8 @@ void *BuildPredictorFromMeta(const JValue &meta, const char *param_file,
     throw std::runtime_error(
         "this export has no native deploy_graph (the model contains "
         "layers outside the C-deployable set: dense/conv2d/batchnorm/"
-        "pool2d/activation/flatten/dropout) — run it via the Python/"
-        "StableHLO path instead");
+        "pool2d/activation/flatten/dropout/add/concat) — run it via "
+        "the Python/StableHLO path instead");
 
   auto pred = std::unique_ptr<Predictor>(new Predictor());
   for (const JValue &jn : graph->arr) {
@@ -258,6 +294,18 @@ void *BuildPredictorFromMeta(const JValue &meta, const char *param_file,
       n.include_pad = static_cast<int>(v->num);
     if (const JValue *v = jn.get("eps"))
       n.eps = static_cast<float>(v->num);
+    if (const JValue *v = jn.get("axis"))
+      n.axis = static_cast<int>(v->num);
+    if (const JValue *v = jn.get("in")) {
+      if (v->kind != JValue::ARR)
+        throw std::runtime_error("node 'in': expected an array");
+      for (const JValue &e : v->arr) {
+        if (e.kind != JValue::NUM)
+          throw std::runtime_error(
+              "node 'in': expected value ids (numbers)");
+        n.in.push_back(static_cast<int>(e.num));
+      }
+    }
     if (jn.get("kernel")) JInt2(jn.get("kernel"), n.kernel, "kernel");
     if (jn.get("stride")) JInt2(jn.get("stride"), n.stride, "stride");
     if (jn.get("pad")) JInt2(jn.get("pad"), n.pad, "pad");
@@ -311,8 +359,25 @@ int MXPredForward(PredictorHandle h) {
   API_BEGIN();
   auto *p = static_cast<Predictor *>(h);
   p->FreeTemps();
-  NDArrayHandle cur = p->input;
-  for (const Node &n : p->nodes) cur = RunNode(p, n, cur);
+  /* SSA value table: values[0] = input, values[k+1] = node k's output.
+   * Nodes without "in" chain off the latest value (legacy exports). */
+  std::vector<NDArrayHandle> values;
+  values.push_back(p->input);
+  for (const Node &n : p->nodes) {
+    std::vector<NDArrayHandle> ins;
+    if (n.in.empty()) {
+      ins.push_back(values.back());
+    } else {
+      for (int v : n.in) {
+        if (v < 0 || static_cast<size_t>(v) >= values.size())
+          throw std::runtime_error("node '" + n.op +
+                                   "': input value out of range");
+        ins.push_back(values[static_cast<size_t>(v)]);
+      }
+    }
+    values.push_back(RunNode(p, n, ins));
+  }
+  NDArrayHandle cur = values.back();
   if (MXNDArrayWaitToRead(cur) != 0)
     throw std::runtime_error(MXGetLastError());
   p->output = cur;
