@@ -116,6 +116,8 @@ class _SplitConcat(HybridBlock):
     def deploy_emit(self, em, prefix, vid):
         if type(self).forward is not _SplitConcat.forward:
             em.fail(f"{type(self).__name__} overrides forward")
+        if self._n_arms < 2:
+            em.fail("concat of < 2 arms")
         h = (em.emit(self.reduce, prefix + "reduce.", vid)
              if self.reduce is not None else vid)
         outs = [em.emit(getattr(self, f"arm{i}"), f"{prefix}arm{i}.", h)

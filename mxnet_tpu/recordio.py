@@ -217,11 +217,25 @@ def unpack(s: bytes) -> Tuple[IRHeader, bytes]:
     return IRHeader(flag, label, id_, id2), s
 
 
+_RAW_MAGIC = b"RAW0"
+
+
 def pack_img(header: IRHeader, img: Any, quality: int = 95,
              img_fmt: str = ".jpg") -> bytes:
-    """JPEG/PNG-encode an HWC uint8 image and pack it."""
+    """Encode an HWC uint8 image and pack it. ``img_fmt``: '.jpg' /
+    '.png' (PIL-encoded, the reference formats) or '.raw' — an
+    uncompressed ``RAW0 + u16 h,w,c + bytes`` payload whose decode is a
+    frombuffer (the high-throughput packing for hosts where JPEG decode,
+    not the wire, is the bottleneck)."""
     from PIL import Image
     arr = img.asnumpy() if hasattr(img, "asnumpy") else _np.asarray(img)
+    if img_fmt.lower() == ".raw":
+        a = _np.ascontiguousarray(arr, dtype=_np.uint8)
+        if a.ndim == 2:
+            a = a[:, :, None]
+        h, w, c = a.shape
+        payload = _RAW_MAGIC + struct.pack("<HHH", h, w, c) + a.tobytes()
+        return pack(header, payload)
     if arr.ndim == 3 and arr.shape[2] == 1:
         arr = arr[:, :, 0]
     pil = Image.fromarray(arr)
@@ -235,6 +249,19 @@ def unpack_img(s: bytes, iscolor: int = -1, flag: int = 1
                ) -> Tuple[IRHeader, _np.ndarray]:
     from PIL import Image
     header, img_bytes = unpack(s)
+    if img_bytes[:4] == _RAW_MAGIC:
+        h, w, c = struct.unpack("<HHH", img_bytes[4:10])
+        arr = _np.frombuffer(img_bytes, dtype=_np.uint8,
+                             offset=10).reshape(h, w, c)
+        if flag and c == 1:
+            arr = _np.repeat(arr, 3, axis=2)
+        elif not flag and c == 3:
+            # ITU-R 601 luma, same as the PIL path's convert('L') — the
+            # pack format must not change grayscale pixel values
+            luma = (arr[..., 0] * 0.299 + arr[..., 1] * 0.587
+                    + arr[..., 2] * 0.114)
+            arr = _np.rint(luma).astype(_np.uint8)[..., None]
+        return header, arr
     pil = Image.open(io.BytesIO(img_bytes))
     pil = pil.convert("RGB" if flag else "L")
     arr = _np.asarray(pil, dtype=_np.uint8)

@@ -283,3 +283,44 @@ def test_inception_v3_hybridize_equivalence():
     net.hybridize()
     out = net(x).asnumpy()
     _onp.testing.assert_allclose(out, ref, rtol=2e-4, atol=2e-4)
+
+
+def test_recordio_raw_format_roundtrip(tmp_path):
+    """r4 '.raw' packing: frombuffer decode (the high-throughput option
+    when JPEG decode, not the wire, is the bottleneck), byte-exact
+    roundtrip, and grayscale conversion matching the PIL path's ITU-R
+    601 luma so pack format never changes pixel values."""
+    import numpy as onp
+    from PIL import Image
+    from mxnet_tpu import recordio
+
+    rs = onp.random.RandomState(0)
+    img = rs.randint(0, 256, (24, 20, 3)).astype("uint8")
+    header = recordio.IRHeader(0, 7.0, 3, 0)
+    packed = recordio.pack_img(header, img, img_fmt=".raw")
+    h2, back = recordio.unpack_img(packed)
+    assert float(h2.label) == 7.0
+    onp.testing.assert_array_equal(back, img)
+
+    _, gray = recordio.unpack_img(packed, flag=0)
+    ref = onp.asarray(Image.fromarray(img).convert("L"))
+    assert int(onp.abs(gray[:, :, 0].astype(int)
+                       - ref.astype(int)).max()) <= 1
+
+    # grayscale source replicates to RGB on color decode
+    g1 = rs.randint(0, 256, (8, 8, 1)).astype("uint8")
+    p1 = recordio.pack_img(recordio.IRHeader(0, 1.0, 0, 0), g1,
+                           img_fmt=".raw")
+    _, rgb = recordio.unpack_img(p1, flag=1)
+    assert rgb.shape == (8, 8, 3)
+    onp.testing.assert_array_equal(rgb[:, :, 0], g1[:, :, 0])
+
+    # file roundtrip through the indexed record container
+    rec = recordio.MXIndexedRecordIO(str(tmp_path / "a.idx"),
+                                     str(tmp_path / "a.rec"), "w")
+    rec.write_idx(0, packed)
+    rec.close()
+    rd = recordio.MXIndexedRecordIO(str(tmp_path / "a.idx"),
+                                    str(tmp_path / "a.rec"), "r")
+    _, again = recordio.unpack_img(rd.read_idx(0))
+    onp.testing.assert_array_equal(again, img)
