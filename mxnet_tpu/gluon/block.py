@@ -28,14 +28,84 @@ import numpy as _np
 
 from .. import engine
 from .._tape import is_recording, is_training, set_training
-from ..base import MXNetError
+from ..base import MXNetError, getenv, register_env
 from ..context import Context, cpu, current_context
+from ..ndarray import random as _nd_random
 from ..ndarray.ndarray import NDArray, from_jax
 from ..ndarray.register import invoke
 from ..ndarray import random as _random
 from .parameter import Constant, DeferredInitializationError, Parameter
 
-__all__ = ["Block", "HybridBlock", "SymbolBlock", "nn_block_summary"]
+__all__ = ["Block", "HybridBlock", "SymbolBlock", "nn_block_summary",
+           "remat_call", "remat_stack"]
+
+register_env(
+    "MXNET_REMAT", 0,
+    "Rematerialize (activation-checkpoint) transformer layers: forward "
+    "saves only each layer's INPUT and backward recomputes its "
+    "interior, cutting live-activation memory ~L-fold for ~1 extra "
+    "forward of compute (jax.checkpoint per layer — the TPU-native "
+    "memory/FLOPs trade). Engaged by the model-zoo encoder stacks "
+    "(BERT, GPT) when set.")
+
+_REMAT_LAST: List[Optional[bool]] = [None]
+
+
+def _remat_enabled() -> bool:
+    cur = bool(getenv("MXNET_REMAT", 0))
+    if _REMAT_LAST[0] is None:
+        _REMAT_LAST[0] = cur
+    elif _REMAT_LAST[0] != cur:
+        # toggling after a program compiled must re-trace, not replay
+        # the stale executable (the same invariant the flash knobs keep
+        # by resolving env outside the cached closure)
+        _REMAT_LAST[0] = cur
+        invalidate_cached_graphs()
+    return cur
+
+
+def remat_call(block, *args, key=None):
+    """Run ``block(*args)`` under ``jax.checkpoint``: backward recomputes
+    the block's interior from its inputs instead of saving every
+    intermediate. ``args`` are NDArrays (or None placeholders, which
+    pass through). ``key``: an explicit PRNG key scoped around the call
+    so in-block dropout draws IDENTICAL randomness in the recompute —
+    ambient stateful key draws would advance again and silently corrupt
+    gradients, so callers with dropout must pass one."""
+    present = [a is not None for a in args]
+    arrays = [a._data for a in args if a is not None]
+
+    def body(*arrs):
+        it = iter(arrs)
+        nd_args = [from_jax(next(it)) if p else None for p in present]
+        if key is not None:
+            with _nd_random.trace_key_scope(key):
+                out = block(*nd_args)
+        else:
+            out = block(*nd_args)
+        return out._data
+
+    return from_jax(jax.checkpoint(body)(*arrays))
+
+
+def remat_stack(layers, x, *extra, dropout: float = 0.0):
+    """Apply ``layers`` sequentially, each under :func:`remat_call` when
+    ``MXNET_REMAT`` is set (plain loop otherwise). ``extra`` args (an
+    attention mask, say) pass to every layer. ``dropout``: the layers'
+    dropout rate — when active in training, each layer gets a
+    deterministic folded key so the backward recompute draws identical
+    masks. The single shared implementation behind the model-zoo
+    encoder stacks."""
+    if not _remat_enabled():
+        for layer in layers:
+            x = layer(x, *extra)
+        return x
+    base = (_nd_random.split_key()
+            if dropout and is_training() else None)
+    for i, layer in enumerate(layers):
+        key = jax.random.fold_in(base, i) if base is not None else None
+        x = remat_call(layer, x, *extra, key=key)
+    return x
 
 
 class _ParamDict(OrderedDict):
@@ -226,6 +296,9 @@ _GRAPH_EPOCH = [0]
 
 
 def graph_epoch() -> int:
+    # poll env-dependent trace knobs: a toggle between calls must bump
+    # the epoch even though no trace (where the knob is read) has run
+    _remat_enabled()
     return _GRAPH_EPOCH[0]
 
 

@@ -89,9 +89,10 @@ class BERTEncoder(HybridBlock):
         x = self.ln(x)
         if self._dropout:
             x = npx.dropout(x, self._dropout)
-        for layer in self.layers:
-            x = layer(x, mask)
-        return x
+        # activation checkpointing per layer under MXNET_REMAT
+        from ..block import remat_stack
+        return remat_stack(list(self.layers), x, mask,
+                           dropout=self._dropout)
 
 
 class BERTModel(HybridBlock):

@@ -142,7 +142,9 @@ class GPTModel(HybridBlock):
         x = x + pos.expand_dims(0)
         if self._dropout:
             x = npx.dropout(x, self._dropout)
-        x = self.blocks(x)
+        # activation checkpointing per block under MXNET_REMAT
+        from ..block import remat_stack
+        x = remat_stack(list(self.blocks), x, dropout=self._dropout)
         x = self.ln_f(x)
         # weight-tied LM head: logits = x @ E^T
         w = self.word_embed.weight.data()

@@ -33,9 +33,12 @@ register_env("MXNET_FLASH_MIN_SEQ", 512,
              "Sequence length at/above which attention auto-routes to "
              "the Pallas flash kernel (the measured v5e crossover vs "
              "XLA materialized-scores attention).")
-register_env("MXNET_FLASH_BLOCK_Q", 256,
-             "Flash-attention query-block rows (v5e-tuned default; "
-             "clamped to the sequence length per call).")
+register_env("MXNET_FLASH_BLOCK_Q", 0,
+             "Flash-attention query-block rows. 0 (default) = "
+             "shape-aware auto: the FULL sequence as one block at "
+             "T<=512 (one grid row per head — measured +5.5% BERT-base "
+             "step throughput vs 256-row blocks at T=512), 256-row "
+             "blocks (the attn_probe sweep's pick) from T=1024 up.")
 register_env("MXNET_FLASH_BLOCK_K", 1024,
              "Flash-attention key-block rows (v5e-tuned default; "
              "clamped to the sequence length per call).")
@@ -90,7 +93,8 @@ def dot_product_attention(query, key, value, mask=None,
     # MXNET_ATTENTION_USE_PALLAS / MXNET_FLASH_BLOCK_* at runtime must
     # re-dispatch, not silently hit a stale executable
     use_flash = _use_pallas_len(inputs[0].shape[1])
-    blk_q, blk_k = _flash_block("Q"), _flash_block("K")
+    blk_q = _flash_block("Q", seq=inputs[0].shape[1])
+    blk_k = _flash_block("K")
 
     def impl(q, k, v, *rest):
         rest = list(rest)
@@ -132,10 +136,21 @@ def dot_product_attention(query, key, value, mask=None,
     return invoke("dot_product_attention", impl, inputs)
 
 
-def _flash_block(which: str) -> int:
+def _flash_block(which: str, seq: int = 0) -> int:
     from .pallas.attention import DEFAULT_BLOCK_Q, DEFAULT_BLOCK_K
-    return int(getenv(f"MXNET_FLASH_BLOCK_{which}",
-                      DEFAULT_BLOCK_Q if which == "Q" else DEFAULT_BLOCK_K))
+    env = int(getenv(f"MXNET_FLASH_BLOCK_{which}", 0))
+    if env:
+        return env
+    if which == "Q":
+        # shape-aware default (r4 measured, BERT-base b64xT=512:
+        # 138.6k tok/s with a full-T block vs 131.4k with 256): at
+        # T<=512 one query block per (B,H) head removes per-block grid
+        # overhead; at 1024+ the 256-row blocks from the attn_probe
+        # sweep win.
+        if 0 < seq <= 512:
+            return seq
+        return DEFAULT_BLOCK_Q
+    return DEFAULT_BLOCK_K
 
 
 def _flash_bias_ok(bias, q, k) -> bool:
@@ -190,7 +205,21 @@ def _flash_threshold() -> int:
     materialized-scores attention. Measured crossover on v5e (r3 kernel:
     input-dtype MXU matmuls, causal tile skip, grid semantics): GPT-2
     tok/s pallas-vs-xla is 104k/115k at T=256, 101k/97k at 512,
-    94k/71k at 1024, 81k/50k at 2048 — flash wins from 512 up."""
+    94k/71k at 1024, 81k/50k at 2048 — flash wins from 512 up.
+
+    Why 256 stays on XLA (r4 analysis, re-measured 104.5k/117.4k at
+    b32x256 with the tuned kernel): isolated A/B probes show BOTH paths
+    latency-floored (~3 ms/layer-step, <1 TFLOP/s) at T<=256 — the
+    attention op is too small to fill the chip either way, so the
+    winner is decided by fixed per-pass costs. XLA runs ONE fused
+    program; our backward runs separate dq and dkv kernel passes (each
+    re-reading q/k/v and recomputing probabilities), whose extra fixed
+    cost outweighs the O(T^2) HBM traffic it avoids — at b32xT=256 the
+    materialized score matrix is ~100 MB/layer, comfortably within HBM
+    bandwidth at these sizes. The flash win requires the score matrix
+    to dominate, which starts near T=512. A fused single-pass dq+dkv
+    backward could move the crossover; the auto-threshold keeps every
+    config on its measured-faster path meanwhile."""
     return int(getenv("MXNET_FLASH_MIN_SEQ", 512))
 
 
@@ -222,7 +251,8 @@ def multi_head_attention(query, key, value, num_heads: int, mask=None,
     # resolved outside impl (exec-cache closure token) — see
     # dot_product_attention
     use_flash = _use_pallas_len(inputs[0].shape[1])
-    blk_q, blk_k = _flash_block("Q"), _flash_block("K")
+    blk_q = _flash_block("Q", seq=inputs[0].shape[1])
+    blk_k = _flash_block("K")
 
     def impl(q, k, v, *rest):
         rest = list(rest)

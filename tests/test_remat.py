@@ -1,0 +1,107 @@
+"""Activation rematerialization (MXNET_REMAT): per-layer
+jax.checkpoint in the model-zoo encoder stacks — the TPU-native
+memory/FLOPs trade (SURVEY section 7 design stance)."""
+import os
+
+import jax
+import numpy as onp
+import pytest
+
+import mxnet_tpu as mx
+from mxnet_tpu.parallel import SPMDTrainer, make_mesh, DATA_PARALLEL_RULES
+
+
+def _bert_losses(remat, dropout=0.0, steps=4):
+    os.environ["MXNET_REMAT"] = "1" if remat else "0"
+    try:
+        from mxnet_tpu.gluon.model_zoo.bert import get_bert
+        mx.random.seed(0)
+        net = get_bert("bert_12_768_12", vocab_size=128, num_layers=3,
+                       units=32, hidden_size=64, num_heads=4,
+                       max_length=32, dropout=dropout, use_pooler=False,
+                       use_decoder=True, use_classifier=False)
+        net.initialize()
+        net(mx.np.zeros((2, 16), dtype="int32"), None, None,
+            mx.np.zeros((2, 2), dtype="int32"))
+        loss_fn = mx.gluon.loss.SoftmaxCrossEntropyLoss(axis=-1)
+        mesh = make_mesh({"dp": 1}, devices=jax.devices()[:1])
+        tr = SPMDTrainer(net, lambda o, l: loss_fn(o, l), optimizer="sgd",
+                         optimizer_params={"learning_rate": 0.1},
+                         mesh=mesh, rules=DATA_PARALLEL_RULES,
+                         output_transform=lambda out: out[-1])
+        rng = onp.random.RandomState(0)
+        x = [mx.np.array(rng.randint(0, 128, (4, 16)).astype("int32")),
+             mx.np.array(onp.zeros((4, 16), "int32")),
+             mx.np.array(onp.full((4,), 16, "int32")),
+             mx.np.array(rng.randint(0, 16, (4, 2)).astype("int32"))]
+        y = mx.np.array(rng.randint(0, 128, (4, 2)).astype("int32"))
+        return [float(tr.step(x, y).asnumpy()) for _ in range(steps)]
+    finally:
+        os.environ.pop("MXNET_REMAT", None)
+
+
+def test_remat_bert_loss_exact():
+    """Remat must not change the math: per-step losses identical with
+    and without MXNET_REMAT."""
+    plain = _bert_losses(False)
+    remat = _bert_losses(True)
+    for a, b in zip(plain, remat):
+        assert abs(a - b) < 1e-5, (plain, remat)
+
+
+def test_remat_dropout_trains():
+    """Dropout under remat: per-layer explicit keys keep the recompute's
+    masks identical to the forward's (ambient stateful draws would
+    corrupt gradients) — training still converges."""
+    losses = _bert_losses(True, dropout=0.2, steps=6)
+    assert losses[-1] < losses[0], losses
+
+
+def test_remat_gpt_loss_exact():
+    os.environ["MXNET_REMAT"] = "0"
+    from mxnet_tpu.gluon.model_zoo.gpt import GPTModel
+
+    def run(remat):
+        os.environ["MXNET_REMAT"] = "1" if remat else "0"
+        try:
+            mx.random.seed(1)
+            net = GPTModel(vocab_size=64, num_layers=3, units=32,
+                           hidden_size=48, num_heads=2, max_length=16,
+                           dropout=0.0)
+            net.initialize()
+            net(mx.np.zeros((2, 8), dtype="int32"))
+            lf = mx.gluon.loss.SoftmaxCrossEntropyLoss(axis=-1)
+            mesh = make_mesh({"dp": 1}, devices=jax.devices()[:1])
+            tr = SPMDTrainer(net, lambda o, l: lf(o, l), optimizer="sgd",
+                             optimizer_params={"learning_rate": 0.1},
+                             mesh=mesh, rules=DATA_PARALLEL_RULES)
+            rng = onp.random.RandomState(2)
+            x = mx.np.array(rng.randint(0, 64, (4, 8)).astype("int32"))
+            y = mx.np.array(rng.randint(0, 64, (4, 8)).astype("int32"))
+            return [float(tr.step(x, y).asnumpy()) for _ in range(3)]
+        finally:
+            os.environ.pop("MXNET_REMAT", None)
+
+    plain = run(False)
+    remat = run(True)
+    for a, b in zip(plain, remat):
+        assert abs(a - b) < 1e-5, (plain, remat)
+
+
+def test_remat_toggle_retraces_compiled_step():
+    """Toggling MXNET_REMAT after a trainer compiled must re-trace (the
+    stale-executable invariant): graph_epoch polls the knob, so the
+    cached program is dropped on the next step."""
+    from mxnet_tpu.gluon.block import graph_epoch
+    os.environ["MXNET_REMAT"] = "0"
+    try:
+        graph_epoch()                      # settle the poll state
+        e0 = graph_epoch()
+        os.environ["MXNET_REMAT"] = "1"
+        e1 = graph_epoch()
+        assert e1 != e0, "toggle did not bump the graph epoch"
+        os.environ["MXNET_REMAT"] = "0"
+        assert graph_epoch() != e1
+    finally:
+        os.environ.pop("MXNET_REMAT", None)
+        graph_epoch()
