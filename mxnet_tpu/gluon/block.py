@@ -30,7 +30,6 @@ from .. import engine
 from .._tape import is_recording, is_training, set_training
 from ..base import MXNetError, getenv, register_env
 from ..context import Context, cpu, current_context
-from ..ndarray import random as _nd_random
 from ..ndarray.ndarray import NDArray, from_jax
 from ..ndarray.register import invoke
 from ..ndarray import random as _random
@@ -79,7 +78,7 @@ def remat_call(block, *args, key=None):
         it = iter(arrs)
         nd_args = [from_jax(next(it)) if p else None for p in present]
         if key is not None:
-            with _nd_random.trace_key_scope(key):
+            with _random.trace_key_scope(key):
                 out = block(*nd_args)
         else:
             out = block(*nd_args)
@@ -100,7 +99,7 @@ def remat_stack(layers, x, *extra, dropout: float = 0.0):
         for layer in layers:
             x = layer(x, *extra)
         return x
-    base = (_nd_random.split_key()
+    base = (_random.split_key()
             if dropout and is_training() else None)
     for i, layer in enumerate(layers):
         key = jax.random.fold_in(base, i) if base is not None else None
@@ -460,8 +459,11 @@ class HybridBlock(Block):
             if self._epoch_sensitive():
                 self._cached_graph.clear()
             self._cache_epoch = _GRAPH_EPOCH[0]
+        # the remat flag joins the key: its value changes the traced
+        # program for every remat-capable model, independent of the
+        # BatchNorm-only epoch filter above
         key_sig = (tuple((tuple(a.shape), str(a.dtype)) for a in nd_args),
-                   train, amp_key)
+                   train, amp_key, _remat_enabled())
         entry = self._cached_graph.get(key_sig)
         if entry is None:
             cell: Dict[str, Any] = {}  # filled with treedef at trace time

@@ -228,14 +228,15 @@ class TransformerModel(HybridBlock):
                   eos_token: Optional[int] = None,
                   src_valid_length=None, method: str = "greedy",
                   temperature: float = 1.0, top_k: int = 40,
-                  seed: int = 0):
-        """KV-cache incremental decoding from ``bos_token``. Returns
-        (B, max_new_tokens) int32 target tokens."""
+                  seed: int = 0, top_p: float = 0.9):
+        """KV-cache incremental decoding from ``bos_token`` (greedy /
+        sample / top_k / top_p nucleus). Returns (B, max_new_tokens)
+        int32 target tokens."""
         from .transformer_generation import translate as _tr
         return _tr(self, src, max_new_tokens, bos_token,
                    eos_token=eos_token, src_valid_length=src_valid_length,
                    method=method, temperature=temperature, top_k=top_k,
-                   seed=seed)
+                   seed=seed, top_p=top_p)
 
     def beam_translate(self, src, max_new_tokens: int, bos_token: int,
                        beam_size: int = 4,

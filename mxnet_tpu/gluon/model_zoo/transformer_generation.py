@@ -200,8 +200,9 @@ def _empty_caches(params, B, L, nh):
 def translate(model, src, max_new_tokens: int, bos_token: int,
               eos_token: Optional[int] = None, src_valid_length=None,
               method: str = "greedy", temperature: float = 1.0,
-              top_k: int = 40, seed: int = 0):
-    """Decode target tokens for ``src`` starting from ``bos_token``."""
+              top_k: int = 40, seed: int = 0, top_p: float = 0.9):
+    """Decode target tokens for ``src`` starting from ``bos_token``.
+    ``method``: greedy / sample / top_k / top_p (nucleus)."""
     import numpy as onp
     s, vl, params, nh, eps = _prepare(model, src, max_new_tokens,
                                       src_valid_length)
@@ -212,11 +213,14 @@ def translate(model, src, max_new_tokens: int, bos_token: int,
         if top_k < 1:
             raise MXNetError(f"top_k must be >= 1, got {top_k}")
         top_k = min(int(top_k), params["tgt_embed"].shape[0])
+    if method == "top_p" and not 0.0 < top_p <= 1.0:
+        raise MXNetError(f"top_p must be in (0, 1], got {top_p}")
     has_vl = vl is not None
     L = max_new_tokens
 
     sig = ("tr", _model_sig(params, nh, eps), B, Ts, max_new_tokens,
-           method, float(temperature), int(top_k), eos, bos, has_vl)
+           method, float(temperature), int(top_k), float(top_p), eos,
+           bos, has_vl)
     prog = _PROG_CACHE.get(sig)
     if prog is None:
         def run(params, s, vl, key):
@@ -228,7 +232,8 @@ def translate(model, src, max_new_tokens: int, bos_token: int,
                 logits, caches = _dec_step(params, tok, caches, cross,
                                            src_bias, i, nh, eps, L)
                 key, sub = jax.random.split(key)
-                nxt = _select(logits, method, temperature, top_k, sub)
+                nxt = _select(logits, method, temperature, top_k, top_p,
+                              sub)
                 if eos >= 0:
                     nxt = jnp.where(done, eos, nxt)
                     done = done | (nxt == eos)

@@ -241,12 +241,15 @@ class Module(BaseModule):
             raise MXNetError("call bind before init_params")
         self._block.initialize(init=initializer, ctx=self._contexts[0],
                                force_reinit=force_init)
-        # materialize deferred shapes with one dummy forward
+        # materialize deferred shapes with one dummy forward — on the
+        # MODULE's context (the accelerator default ctx would mix
+        # devices with cpu-bound parameters)
         def _desc_to_dummy(desc):
             shape = tuple(desc.shape) if hasattr(desc, "shape") else \
                 tuple(desc[1])
             dtype = getattr(desc, "dtype", _np.float32)
-            return NDArray(_np.zeros(shape, dtype=dtype))
+            return NDArray(_np.zeros(shape, dtype=dtype),
+                           ctx=self._contexts[0])
 
         dummies = [_desc_to_dummy(d) for d in self._data_shapes]
         if self._sym_mode and self._used_labels:
@@ -264,7 +267,8 @@ class Module(BaseModule):
                     dummies.append(_desc_to_dummy(desc))
                 else:
                     dummies.append(NDArray(_np.zeros((batch,),
-                                                     dtype=_np.float32)))
+                                                     dtype=_np.float32),
+                                           ctx=self._contexts[0]))
         self._block(*dummies)
         if arg_params or aux_params:
             merged = dict(arg_params or {})
@@ -307,9 +311,14 @@ class Module(BaseModule):
     # -- execution ----------------------------------------------------------
     def forward(self, data_batch: DataBatch,
                 is_train: Optional[bool] = None) -> None:
-        data = [d if isinstance(d, NDArray) else NDArray(d)
-                for d in _as_list(data_batch.data)]
-        labels = [l if isinstance(l, NDArray) else NDArray(l)
+        # batches land on the MODULE's context — under the accelerator
+        # default-ctx, iterator-produced arrays would otherwise mix
+        # devices with a cpu-bound module's parameters
+        ctx = self._contexts[0]
+        data = [(d if isinstance(d, NDArray) else NDArray(d, ctx=ctx))
+                .as_in_context(ctx) for d in _as_list(data_batch.data)]
+        labels = [(l if isinstance(l, NDArray) else NDArray(l, ctx=ctx))
+                  .as_in_context(ctx)
                   for l in _as_list(data_batch.label)]
         is_train = self.binded if is_train is None else is_train
         self._cur_batch_size = data[0].shape[0] if data else 0
@@ -356,7 +365,8 @@ class Module(BaseModule):
                     feeds.append(labels[pos])
             else:   # inference without labels: heads ignore label values
                 feeds += [NDArray(_np.zeros((self._cur_batch_size,),
-                                            dtype=_np.float32))
+                                            dtype=_np.float32),
+                                  ctx=self._contexts[0])
                           for _ in self._used_labels]
         if is_train and self._head_op is not None:
             with autograd.record():

@@ -393,7 +393,17 @@ class SPMDTrainer:
         """Invalidate the compiled step when host-side layer state changed
         the traced program (BatchNorm cold-start bootstrap runs exactly
         once: the step after it must re-trace to the blend graph)."""
-        from ..gluon.block import graph_epoch
+        from ..gluon.block import graph_epoch, _remat_enabled
+        # env knobs that change the traced program invalidate
+        # UNCONDITIONALLY — the _epoch_sensitive filter below only
+        # covers layer-state epochs (BatchNorm), not trace-time flags
+        remat = _remat_enabled()
+        if getattr(self, "_remat_flag", None) != remat:
+            self._remat_flag = remat
+            self._step_fn = None
+            self._multi_fn = None
+            if hasattr(self, "_raw_step_fn"):
+                del self._raw_step_fn
         epoch = graph_epoch()
         if getattr(self, "_graph_epoch", None) != epoch:
             self._graph_epoch = epoch

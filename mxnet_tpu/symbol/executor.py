@@ -163,14 +163,24 @@ class Executor:
         if not self.outputs:
             raise MXNetError("backward: call forward(is_train=True) first")
         from .._tape import backward_arrays
+
+        def wrap(g):
+            # head grads must land on the EXECUTOR's context, not the
+            # process default (under the accelerator ctx-flip a raw
+            # numpy out_grad would otherwise mix devices with
+            # cpu-bound executors)
+            if g is None:
+                return None
+            if isinstance(g, NDArray):
+                return g.as_in_context(self._ctx)
+            return NDArray(g, ctx=self._ctx)
+
         if out_grads is None:
             grads = [None] * len(self.outputs)
         elif isinstance(out_grads, (list, tuple)):
-            grads = [g if (g is None or isinstance(g, NDArray))
-                     else NDArray(g) for g in out_grads]
+            grads = [wrap(g) for g in out_grads]
         else:
-            grads = [out_grads if isinstance(out_grads, NDArray)
-                     else NDArray(out_grads)]
+            grads = [wrap(out_grads)]
         backward_arrays(self.outputs, grads)
         # sparse-grad leaves rebind arr._grad to a fresh RowSparseNDArray;
         # keep grad_dict pointing at the live gradient object

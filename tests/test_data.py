@@ -187,6 +187,7 @@ def test_mobilenet_squeezenet_densenet_construct():
         assert net(x).shape == (1, 10), name
 
 
+@pytest.mark.host_mesh   # forks DataLoader worker processes — skipped under the chip ctx-flip
 def test_dataloader_custom_batchify_multiworker():
     """Custom batchify_fn must run in workers too (pads ragged samples)."""
     from mxnet_tpu.gluon.data import SimpleDataset

@@ -89,19 +89,43 @@ def test_remat_gpt_loss_exact():
 
 
 def test_remat_toggle_retraces_compiled_step():
-    """Toggling MXNET_REMAT after a trainer compiled must re-trace (the
-    stale-executable invariant): graph_epoch polls the knob, so the
-    cached program is dropped on the next step."""
-    from mxnet_tpu.gluon.block import graph_epoch
+    """Toggling MXNET_REMAT after a trainer compiled must RE-TRACE the
+    step program — on a transformer (no BatchNorm), so the invalidation
+    cannot ride the BatchNorm-only epoch filter. The compiled step
+    object must be rebuilt across the toggle and training must stay
+    loss-exact."""
+    from mxnet_tpu.gluon.model_zoo.gpt import GPTModel
     os.environ["MXNET_REMAT"] = "0"
     try:
-        graph_epoch()                      # settle the poll state
-        e0 = graph_epoch()
+        mx.random.seed(3)
+        net = GPTModel(vocab_size=64, num_layers=2, units=32,
+                       hidden_size=48, num_heads=2, max_length=16,
+                       dropout=0.0)
+        net.initialize()
+        net(mx.np.zeros((2, 8), dtype="int32"))
+        lf = mx.gluon.loss.SoftmaxCrossEntropyLoss(axis=-1)
+        mesh = make_mesh({"dp": 1}, devices=jax.devices()[:1])
+        tr = SPMDTrainer(net, lambda o, l: lf(o, l), optimizer="sgd",
+                         optimizer_params={"learning_rate": 0.1},
+                         mesh=mesh, rules=DATA_PARALLEL_RULES)
+        rng = onp.random.RandomState(4)
+        x = mx.np.array(rng.randint(0, 64, (4, 8)).astype("int32"))
+        y = mx.np.array(rng.randint(0, 64, (4, 8)).astype("int32"))
+        l0 = float(tr.step(x, y).asnumpy())
+        f0 = tr._step_fn
+        assert f0 is not None
+
         os.environ["MXNET_REMAT"] = "1"
-        e1 = graph_epoch()
-        assert e1 != e0, "toggle did not bump the graph epoch"
+        l1 = float(tr.step(x, y).asnumpy())
+        assert tr._step_fn is not f0, \
+            "toggle did not rebuild the compiled step"
+        f1 = tr._step_fn
+
         os.environ["MXNET_REMAT"] = "0"
-        assert graph_epoch() != e1
+        l2 = float(tr.step(x, y).asnumpy())
+        assert tr._step_fn is not f1
+        assert onp.isfinite([l0, l1, l2]).all() and l2 < l0
     finally:
         os.environ.pop("MXNET_REMAT", None)
-        graph_epoch()
+        from mxnet_tpu.gluon.block import _remat_enabled
+        _remat_enabled()                  # settle the poll state

@@ -27,11 +27,14 @@ def test_pallas_module_grid():
         o_ref[...] = x_ref[...] * 3.0
 
     mod = rtc.PallasModule(scale_kernel)
+    # TPU lowering requires block rows divisible by 8 (the sublane
+    # tile) — (8, 128) blocks over a (16, 128) array are legal on real
+    # hardware AND in CPU interpret mode
     f = mod.get_kernel(
-        out_shapes=[((8, 128), "float32")], grid=(2,),
-        in_specs=[pl.BlockSpec((4, 128), lambda i: (i, 0))],
-        out_specs=pl.BlockSpec((4, 128), lambda i: (i, 0)))
-    x = onp.random.RandomState(1).uniform(-1, 1, (8, 128)) \
+        out_shapes=[((16, 128), "float32")], grid=(2,),
+        in_specs=[pl.BlockSpec((8, 128), lambda i: (i, 0))],
+        out_specs=pl.BlockSpec((8, 128), lambda i: (i, 0)))
+    x = onp.random.RandomState(1).uniform(-1, 1, (16, 128)) \
         .astype("float32")
     out = f(mx.np.array(x))
     onp.testing.assert_allclose(out.asnumpy(), x * 3.0, rtol=1e-6)
