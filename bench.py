@@ -382,6 +382,9 @@ def main() -> None:
         return bench_bert(batch, steps, dtype,
                           int(os.environ.get("MXNET_BENCH_SEQLEN", "512")))
     if model_name.startswith("gpt"):
+        if "MXNET_BENCH_BATCH" not in os.environ:
+            batch = 8            # BASELINE config 6 (b128 at T=1024
+            #                      wants 63G HBM — not a gpt config)
         return bench_gpt(batch, steps, dtype,
                          int(os.environ.get("MXNET_BENCH_SEQLEN", "1024")))
     if model_name.startswith("lstm"):

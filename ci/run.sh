@@ -19,6 +19,9 @@
 #   ci/run.sh tpu-unit      # the WHOLE suite with default ctx = tpu
 #                           #   (test_operator_gpu.py "rerun everything
 #                           #   on the accelerator" analog)
+#   ci/run.sh tpu-unit-batched  # same gate file-by-file with an
+#                           #   incremental log (partial evidence
+#                           #   survives tunnel hiccups)
 #   ci/run.sh all           # native + unit + dist + exec-cache +
 #                           #   naive-engine + dryrun
 set -euo pipefail
@@ -83,6 +86,35 @@ run_tpu_unit() {
   MXNET_TEST_CTX=tpu python -m pytest tests/ -q
 }
 
+run_tpu_unit_batched() {
+  # the same exhaustive gate run FILE BY FILE with an incremental
+  # result log — survives tunnel hiccups with partial evidence and
+  # yields the per-file pass counts PARITY records (r4: 725 green).
+  # Per-file exit codes are the pass/fail signal (the summary-line grep
+  # would miss collection errors, timeouts, and crashes), and a failing
+  # file must NOT abort the loop (set -e would otherwise drop the
+  # failing file's line and skip the rest — the opposite of
+  # incremental evidence).
+  echo "== tpu-unit-batched: whole suite on the chip, one file at a"
+  echo "   time, incremental log in ci/tpu_unit_results.txt"
+  : > ci/tpu_unit_results.txt
+  bad=0
+  for f in tests/test_*.py; do
+    rc=0
+    out=$(MXNET_TEST_CTX=tpu timeout 2400 python -m pytest "$f" -q \
+          2>&1 | tail -1) || rc=$?
+    if [ "$rc" -ne 0 ]; then
+      bad=1
+      out="$out [exit $rc]"
+    fi
+    echo "$f: $out" | tee -a ci/tpu_unit_results.txt
+  done
+  if [ "$bad" -ne 0 ]; then
+    echo "tpu-unit-batched: FAILURES above" >&2
+    exit 1
+  fi
+}
+
 case "$variant" in
   native)       run_native ;;
   unit)         run_unit ;;
@@ -93,6 +125,7 @@ case "$variant" in
   tpu-sweep)    run_tpu_sweep ;;
   tpu-core)     run_tpu_core ;;
   tpu-unit)     run_tpu_unit ;;
+  tpu-unit-batched) run_tpu_unit_batched ;;
   all)
     run_native
     run_unit
