@@ -231,13 +231,20 @@ class _RecordBatcher:
     NHWC (4x less tunnel traffic than f32) and normalize/transpose/cast
     run in-graph on the chip."""
 
-    def __init__(self, rec_path: str, batch: int, img: int) -> None:
+    def __init__(self, rec_path: str, batch: int, img: int,
+                 pack_size: int = 256) -> None:
         import numpy as onp
         from mxnet_tpu._native import NativePrefetcher
         from mxnet_tpu import recordio
+        if img > pack_size:
+            raise ValueError(
+                f"MXNET_BENCH_IMAGE={img} exceeds the packed image size "
+                f"{pack_size} — the random crop needs source images at "
+                "least as large as the crop")
         self._unpack = recordio.unpack_img
         self._pf = NativePrefetcher(rec_path, batch, capacity=8)
         self._batch, self._img = batch, img
+        self._pack_size = pack_size
         self._rng = onp.random.RandomState(7)
         self._onp = onp
 
@@ -255,8 +262,8 @@ class _RecordBatcher:
         B, S = self._batch, self._img
         out = onp.empty((B, S, S, 3), "uint8")
         labels = onp.empty((B,), "int32")
-        ys = self._rng.randint(0, 257 - S, size=B)
-        xs = self._rng.randint(0, 257 - S, size=B)
+        ys = self._rng.randint(0, self._pack_size + 1 - S, size=B)
+        xs = self._rng.randint(0, self._pack_size + 1 - S, size=B)
         flips = self._rng.rand(B) < 0.5
         for i, r in enumerate(recs):
             hdr, arr = self._unpack(r)
@@ -283,8 +290,12 @@ def bench_resnet_recordio(batch: int, steps: int, dtype: str, img: int,
 
     fmt = os.environ.get("MXNET_BENCH_RECORD_FMT", "raw")
     n_rec = int(os.environ.get("MXNET_BENCH_RECORD_N", "512"))
-    pack = _build_bench_pack(f"/tmp/mxtpu_bench_{fmt}_{n_rec}",
-                             n_rec, 256, fmt)
+    # pack images sized to the requested crop (+32 jitter margin) so
+    # MXNET_BENCH_IMAGE > 224 works; size in the cache name keeps packs
+    # of different sizes from colliding
+    pack_size = max(256, img + 32)
+    pack = _build_bench_pack(f"/tmp/mxtpu_bench_{fmt}_{n_rec}_{pack_size}",
+                             n_rec, pack_size, fmt)
 
     mx.random.seed(0)
     inner = zoo.get_model(model_name, classes=1000)
@@ -325,7 +336,7 @@ def bench_resnet_recordio(batch: int, steps: int, dtype: str, img: int,
         optimizer_params={"learning_rate": 0.1, "momentum": 0.9},
         mesh=mesh, rules=DATA_PARALLEL_RULES)
 
-    loader = _RecordBatcher(pack, batch, img)
+    loader = _RecordBatcher(pack, batch, img, pack_size=pack_size)
 
     # loader-only rate (decode+augment, no device work) — the IO bound
     t0 = time.perf_counter()

@@ -14,7 +14,7 @@ from __future__ import annotations
 
 import os
 import threading
-from typing import Any, Callable, Dict, Optional
+from typing import Any, Callable, Dict, List, Optional
 
 __all__ = [
     "MXNetError",
@@ -136,6 +136,27 @@ def list_env() -> Dict[str, Dict[str, Any]]:
     """Return the registered env-var config surface (name -> default/doc)."""
     with _ENV_LOCK:
         return {k: dict(v) for k, v in _ENV_REGISTRY.items()}
+
+
+# Env-dependent TRACE knobs (modules whose env var changes the traced
+# program) register a poller here; gluon's graph_epoch() runs them all so
+# a toggle between calls bumps the epoch — and thus every cached
+# executable's key — even though no trace (where the knob would be read)
+# has run.  Lives in base because every module can import base without a
+# cycle.
+_GRAPH_KNOB_POLLERS: List[Any] = []
+
+
+def register_graph_knob(poll) -> None:
+    """Register a zero-arg callable polled by ``gluon.block.graph_epoch``.
+    It should compare the knob's current value to its last seen value and
+    call ``gluon.block.invalidate_cached_graphs()`` on change."""
+    _GRAPH_KNOB_POLLERS.append(poll)
+
+
+def poll_graph_knobs() -> None:
+    for _poll in _GRAPH_KNOB_POLLERS:
+        _poll()
 
 
 # Core runtime vars (more are registered at their use sites).

@@ -26,7 +26,7 @@ import jax
 import jax.numpy as jnp
 import numpy as _np
 
-from .. import engine
+from .. import base, engine
 from .._tape import is_recording, is_training, set_training
 from ..base import MXNetError, getenv, register_env
 from ..context import Context, cpu, current_context
@@ -48,7 +48,6 @@ register_env(
     "(BERT, GPT) when set.")
 
 _REMAT_LAST: List[Optional[bool]] = [None]
-
 
 def _remat_enabled() -> bool:
     cur = bool(getenv("MXNET_REMAT", 0))
@@ -298,6 +297,7 @@ def graph_epoch() -> int:
     # poll env-dependent trace knobs: a toggle between calls must bump
     # the epoch even though no trace (where the knob is read) has run
     _remat_enabled()
+    base.poll_graph_knobs()
     return _GRAPH_EPOCH[0]
 
 

@@ -192,8 +192,14 @@ class Trainer:
                     "parameter server lost its state (restart?) — "
                     "re-seeding from this worker's current weights; "
                     "server-side optimizer state resets")
-                for i in keys:
-                    self._kvstore.init(i, self._params[i].data())
+                # re-seed the FULL key set _init_kvstore seeds, not just
+                # the keys in this push: with ignore_stale_grad, params
+                # whose grads are stale right now would otherwise stay
+                # uninitialized on the restarted server and re-trigger
+                # this recovery (resetting momentum) on every later push
+                for i, p in enumerate(self._params):
+                    if p.grad_req != "null" and p.is_initialized:
+                        self._kvstore.init(i, p.data())
                 self._kvstore.set_optimizer(self._optimizer)
                 self._kvstore.push(keys, grads)
             if self._update_on_kvstore:

@@ -54,6 +54,11 @@ from .base import MXNetError
 __all__ = ["PSServer", "KVStoreDistAsync", "run_server"]
 
 _MAGIC = b"MXPS"
+# Slice-subkey separator for PSKV big-array slicing.  Contains the ASCII
+# unit-separator control char so no printable user key can collide with
+# the slice-routing rule (a user key named 'w@s1' used to be routed as a
+# slice subkey on some paths and by hash on others).
+_SLICE_SEP = "\x1fs"
 
 
 # ---------------------------------------------------------------------------
@@ -568,10 +573,14 @@ class KVStoreDistAsync:
         return zlib.crc32(str(key).encode()) % self.num_servers
 
     def _server_of_wire(self, wk: str) -> int:
-        """Server of a WIRE key: slice subkeys (``base@sJ``) route by
-        the slicing rule, plain keys by hash."""
-        if "@s" in wk:
-            base_key, _, j = wk.rpartition("@s")
+        """Server of a WIRE key: slice subkeys (``base<US>sJ``) route by
+        the slicing rule, plain keys by hash.  The separator contains
+        the ASCII unit-separator control char, which cannot appear in a
+        user key name, so a user key like ``'w@s1'`` can never be
+        mistaken for a slice subkey (it routes by plain hash on every
+        path — init/push/pull/load_optimizer_states agree)."""
+        if _SLICE_SEP in wk:
+            base_key, _, j = wk.rpartition(_SLICE_SEP)
             if j.isdigit():
                 return (self._server_of(base_key) + int(j)) \
                     % self.num_servers
@@ -593,7 +602,8 @@ class KVStoreDistAsync:
             return None
         base = self._server_of(key)
         cuts = [size * j // n for j in range(n + 1)]
-        return [(f"{key}@s{j}", (base + j) % n, cuts[j], cuts[j + 1])
+        return [(f"{key}{_SLICE_SEP}{j}", (base + j) % n,
+                 cuts[j], cuts[j + 1])
                 for j in range(n) if cuts[j + 1] > cuts[j]]
 
     def _encode_entry(self, wire_key: str, a: onp.ndarray):

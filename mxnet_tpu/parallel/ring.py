@@ -20,17 +20,43 @@ from __future__ import annotations
 
 import functools
 import math
-import os
 from typing import Optional
 
 import jax
 import jax.numpy as jnp
 from jax.sharding import PartitionSpec as P
 
+from ..base import getenv, register_env, register_graph_knob
+
 __all__ = ["ring_attention", "local_ring_attention", "sequence_parallel",
            "current_sequence_parallel"]
 
 _NEG_INF = -1e30
+
+register_env("MXNET_RING_FLASH", 1,
+             "Route eligible ring-attention blocks through the Pallas "
+             "flash kernels (0 disables; falls back to the dense online-"
+             "softmax block update).")
+
+_RING_FLASH_LAST = [None]
+
+
+def _ring_flash_enabled() -> bool:
+    """Resolve MXNET_RING_FLASH OUTSIDE traced closures.  Toggling after
+    a program compiled must re-trace, not silently replay the stale
+    executable, so a change bumps the gluon graph epoch (the same
+    invariant the remat/flash knobs keep)."""
+    cur = bool(int(getenv("MXNET_RING_FLASH", 1)))
+    if _RING_FLASH_LAST[0] is None:
+        _RING_FLASH_LAST[0] = cur
+    elif _RING_FLASH_LAST[0] != cur:
+        _RING_FLASH_LAST[0] = cur
+        from ..gluon.block import invalidate_cached_graphs
+        invalidate_cached_graphs()
+    return cur
+
+
+register_graph_knob(_ring_flash_enabled)
 
 # Active sequence-parallel context: attention ops consult this to route
 # through ring attention (set by SPMDTrainer or the user context manager).
@@ -285,7 +311,7 @@ def local_ring_attention(q, k, v, axis_name: str, n_shards: int,
                          scale: Optional[float] = None,
                          causal: bool = False, kv_len: Optional[int] = None,
                          bias=None, dropout: float = 0.0,
-                         dropout_key=None):
+                         dropout_key=None, use_flash: Optional[bool] = None):
     """Per-device body: exact attention with K/V rotating around the ring.
 
     Call inside ``shard_map`` with the sequence axis sharded over
@@ -314,8 +340,14 @@ def local_ring_attention(q, k, v, axis_name: str, n_shards: int,
     # on-chip and across the ring. Fallback cases keep the dense block
     # update: ragged kv_len (the flash kernel's kv mask is static),
     # attention dropout (no interpret-mode PRNG for the CPU tests), and
-    # unequal q/k shards (the diagonal case needs alignment).
-    if (os.environ.get("MXNET_RING_FLASH", "1") != "0"
+    # unequal q/k shards (the diagonal case needs alignment).  The knob
+    # resolves through the graph-epoch poller (never os.environ inside
+    # the trace): callers that cache executables pass use_flash from
+    # outside; the default still re-dispatches on toggle because
+    # _ring_flash_enabled bumps the epoch the caches key on.
+    if use_flash is None:
+        use_flash = _ring_flash_enabled()
+    if (use_flash
             and rate == 0.0 and kv_len == n_shards * Tk
             and Tl == Tk and Tl >= 8):
         return _flash_ring(q, k, v, bias, axis_name, n_shards,
@@ -408,11 +440,13 @@ def ring_attention(q, k, v, mesh: "jax.sharding.Mesh", axis: str = "sp",
             hax if bias_t.shape[2] > 1 else None, None))
         args.append(bias_t)
 
+    use_flash = _ring_flash_enabled()   # resolved OUTSIDE the traced fn
+
     def fn(qq, kk, vv, *rest):
         return local_ring_attention(
             qq, kk, vv, axis_name=axis, n_shards=n, scale=scale,
             causal=causal, bias=rest[0] if rest else None,
-            dropout=dropout, dropout_key=key)
+            dropout=dropout, dropout_key=key, use_flash=use_flash)
 
     try:
         from jax import shard_map

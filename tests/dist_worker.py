@@ -187,7 +187,9 @@ def async_sliced_main(out_dir: str) -> None:
     # holds the whole array
     stats = kv.server_stats()
     for s in stats:
-        assert any(k.startswith("big@s") for k in s["keys"]), stats
+        from mxnet_tpu.kvstore_async import _SLICE_SEP
+        assert any(k.startswith("big" + _SLICE_SEP)
+                   for k in s["keys"]), stats
         assert "big" not in s["keys"], stats
     line0 = "sliced-ok"
 

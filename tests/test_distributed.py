@@ -282,3 +282,17 @@ def test_async_server_restart(tmp_path, monkeypatch):
     finally:
         srv.kill()
         srv.wait()
+
+
+def test_wire_key_routing_no_user_collision():
+    """A user key literally named 'w@s1' must route by plain hash on
+    EVERY path — the slice-subkey rule uses a control-char separator no
+    printable user key can contain (ADVICE r4)."""
+    from mxnet_tpu.kvstore_async import KVStoreDistAsync, _SLICE_SEP
+    kv = KVStoreDistAsync.__new__(KVStoreDistAsync)
+    kv.num_servers = 4
+    for user_key in ["w@s1", "layer@s0", "big@s12"]:
+        assert kv._server_of_wire(user_key) == kv._server_of(user_key)
+    # real slice subkeys still route by the slicing rule
+    wk = f"big{_SLICE_SEP}2"
+    assert kv._server_of_wire(wk) == (kv._server_of("big") + 2) % 4
