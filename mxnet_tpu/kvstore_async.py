@@ -879,12 +879,20 @@ class KVStoreDistAsync:
 
     def load_optimizer_states(self, fname: str) -> None:
         import pickle
+        import re
         with open(fname, "rb") as f:
             payload = pickle.load(f)
         by_server: Dict[int, Dict[str, Any]] = {}
         for k, s in payload["states"].items():
-            by_server.setdefault(self._server_of_wire(str(k)),
-                                 {})[str(k)] = s
+            k = str(k)
+            # migrate state files saved before the control-char slice
+            # separator: a trailing '@s<digits>' was the old slice
+            # subkey form (user keys can't be disambiguated in old
+            # files; slice subkeys vastly dominate, so rewrite)
+            m = re.fullmatch(r"(.+)@s(\d+)", k)
+            if m and _SLICE_SEP not in k:
+                k = f"{m.group(1)}{_SLICE_SEP}{m.group(2)}"
+            by_server.setdefault(self._server_of_wire(k), {})[k] = s
         counts = {"num_update": payload.get("num_update", 0),
                   "index_update_count":
                       {str(k): v for k, v in

@@ -355,6 +355,105 @@ def _flash_bwd_dq_kernel(*refs, scale: float, causal: bool, block_q: int,
         dq_ref[0, 0] = dq_acc[...].astype(dq_ref.dtype)
 
 
+def _flash_bwd_fused_kernel(*refs, scale: float, causal: bool,
+                            block_q: int, block_k: int, kv_len: int,
+                            num_q_blocks: int, has_bias: bool,
+                            rate: float, emit_ds: bool):
+    """Single-pass backward for the n_k == 1 regime (Tk fits one k-block
+    — every T <= block_k, i.e. all BERT/GPT headline shapes under the
+    default 1024 block).  The two-pass recipe pays two kernel launches
+    that each re-read q/k/v and re-compute the probabilities; here one
+    grid (B, H, n_q) computes s and p ONCE per q-tile, emits dq directly
+    (the whole K is resident, so dq needs no cross-block accumulation),
+    and accumulates dk/dv in VMEM scratch over the sequential q axis.
+    K/V block specs are constant in iq, so Mosaic keeps them in VMEM
+    across the whole (b, h) pass — q/k/v stream exactly once."""
+    q_ref, k_ref, v_ref, do_ref, lse_ref, delta_ref = refs[:6]
+    i = 6
+    bias_ref = refs[i] if has_bias else None
+    i += 1 if has_bias else 0
+    seed_ref = refs[i] if rate > 0 else None
+    i += 1 if rate > 0 else 0
+    dq_ref, dk_ref, dv_ref = refs[i:i + 3]
+    i += 3
+    ds_ref = refs[i] if emit_ds else None
+    i += 1 if emit_ds else 0
+    dk_acc, dv_acc = refs[i:i + 2]
+
+    b = pl.program_id(0)
+    h = pl.program_id(1)
+    iq = pl.program_id(2)
+    ik = 0                          # the single k block
+
+    @pl.when(iq == 0)
+    def _init():
+        dk_acc[...] = jnp.zeros_like(dk_acc)
+        dv_acc[...] = jnp.zeros_like(dv_acc)
+
+    def tile(apply_mask):
+        q = q_ref[0, 0]                                # (bq, d) input dtype
+        k = k_ref[0, 0]                                # (Tk, d)
+        v = v_ref[0, 0]
+        do = do_ref[0, 0]
+        lse = lse_ref[0, 0]                            # (bq, 1)
+        delta = delta_ref[0, 0]
+
+        s = jax.lax.dot_general(q, k, (((1,), (1,)), ((), ())),
+                                preferred_element_type=jnp.float32,
+                                precision=_prec(q.dtype)) * scale
+        if has_bias:
+            s = s + bias_ref[0, 0].astype(jnp.float32)
+        p = jnp.exp(s - lse)                           # (bq, Tk) f32
+        if apply_mask:
+            col = jax.lax.broadcasted_iota(jnp.int32, s.shape, 1)
+            mask = col < kv_len
+            if causal:
+                row = iq * block_q + jax.lax.broadcasted_iota(
+                    jnp.int32, s.shape, 0)
+                mask = jnp.logical_and(mask, col <= row)
+            p = jnp.where(mask, p, 0.0)
+        p_drop = p
+        dp = jax.lax.dot_general(do, v, (((1,), (1,)), ((), ())),
+                                 preferred_element_type=jnp.float32,
+                                 precision=_prec(v.dtype))
+        if rate > 0:
+            keep = _dropout_keep(seed_ref, b, h, iq, ik, rate, p.shape)
+            inv = 1.0 / (1.0 - rate)
+            p_drop = jnp.where(keep, p * inv, 0.0)
+            dp = jnp.where(keep, dp * inv, 0.0)
+        # dv += p_drop^T do
+        dv_acc[...] += jax.lax.dot_general(
+            p_drop.astype(do.dtype), do, (((0,), (0,)), ((), ())),
+            preferred_element_type=jnp.float32,
+            precision=_prec(do.dtype))
+        ds0 = p * (dp - delta)                         # dsoftmax (no scale)
+        if emit_ds:
+            ds_ref[0, 0] = ds0.astype(ds_ref.dtype)
+        ds = (ds0 * scale).astype(k.dtype)
+        # dq for this q-tile is COMPLETE (all of K is here): write direct
+        dq_ref[0, 0] = jax.lax.dot_general(
+            ds, k, (((1,), (0,)), ((), ())),
+            preferred_element_type=jnp.float32,
+            precision=_prec(k.dtype)).astype(dq_ref.dtype)
+        # dk += ds^T q
+        dk_acc[...] += jax.lax.dot_general(
+            ds, q, (((0,), (0,)), ((), ())),
+            preferred_element_type=jnp.float32,
+            precision=_prec(q.dtype))
+
+    # every q-tile is live against the single k block (causal row 0 still
+    # sees column 0), so no skipped branch exists — dq/ds are written on
+    # every grid step.  ik rides as a traced 0 so the branch predicates
+    # stay scalar-traced like the two-pass kernels'.
+    _causal_branches(causal, iq, jnp.int32(0), block_q, block_k, kv_len,
+                     tile)
+
+    @pl.when(iq == num_q_blocks - 1)
+    def _finish():
+        dk_ref[0, 0] = dk_acc[...].astype(dk_ref.dtype)
+        dv_ref[0, 0] = dv_acc[...].astype(dv_ref.dtype)
+
+
 def _flash_bwd_dkv_kernel(*refs, scale: float, causal: bool, block_q: int,
                           block_k: int, kv_len: int, num_q_blocks: int,
                           has_bias: bool, rate: float):
@@ -466,6 +565,89 @@ def _flash_backward(q, k, v, bias, seed, o, lse, g, scale: float,
     if rate > 0:
         in_specs.append(pl.BlockSpec(memory_space=pltpu.SMEM))
         args.append(seed)
+
+    if n_k == 1:
+        # single k-block regime (every T <= block_k): ONE fused pass
+        # computes dq/dk/dv — halves the backward's kernel launches,
+        # q/k/v reads, and probability recomputes.  This is what moves
+        # the flash-vs-XLA crossover down to BERT fine-tuning lengths
+        # (VERDICT r4 directive 3).
+        fused_in_specs = [
+            pl.BlockSpec((1, 1, block_q, D),
+                         lambda b, h, i: (b, h, i, 0)),      # q
+            pl.BlockSpec((1, 1, Tk_p, D),
+                         lambda b, h, i: (b, h, 0, 0)),      # k (resident)
+            pl.BlockSpec((1, 1, Tk_p, D),
+                         lambda b, h, i: (b, h, 0, 0)),      # v (resident)
+            pl.BlockSpec((1, 1, block_q, D),
+                         lambda b, h, i: (b, h, i, 0)),      # do
+            pl.BlockSpec((1, 1, block_q, 1),
+                         lambda b, h, i: (b, h, i, 0)),      # lse
+            pl.BlockSpec((1, 1, block_q, 1),
+                         lambda b, h, i: (b, h, i, 0)),      # delta
+        ]
+        fused_args = [qp, kp, vp, dop, lsep, deltap]
+        if has_bias:
+            Bb, Hb, Tqb = bias.shape[0], bias.shape[1], bias.shape[2]
+            bshape = ((1, 1, 1, Tk_p) if Tqb == 1
+                      else (1, 1, block_q, Tk_p))
+            fused_in_specs.append(pl.BlockSpec(
+                bshape,
+                lambda b, h, i, Bb=Bb, Hb=Hb, Tqb=Tqb: (
+                    b if Bb > 1 else 0, h if Hb > 1 else 0,
+                    0 if Tqb == 1 else i, 0)))
+            fused_args.append(_pad_bias(bias, block_q, block_k))
+        if rate > 0:
+            fused_in_specs.append(pl.BlockSpec(memory_space=pltpu.SMEM))
+            fused_args.append(seed)
+
+        fused_out_specs = [
+            pl.BlockSpec((1, 1, block_q, D),
+                         lambda b, h, i: (b, h, i, 0)),      # dq
+            pl.BlockSpec((1, 1, Tk_p, D),
+                         lambda b, h, i: (b, h, 0, 0)),      # dk
+            pl.BlockSpec((1, 1, Tk_p, D),
+                         lambda b, h, i: (b, h, 0, 0)),      # dv
+        ]
+        fused_out_shape = [
+            jax.ShapeDtypeStruct((B, H, Tq_p, D), q.dtype),
+            jax.ShapeDtypeStruct((B, H, Tk_p, D), k.dtype),
+            jax.ShapeDtypeStruct((B, H, Tk_p, D), v.dtype),
+        ]
+        if want_dbias:
+            fused_out_specs.append(pl.BlockSpec(
+                (1, 1, block_q, Tk_p), lambda b, h, i: (b, h, i, 0)))
+            fused_out_shape.append(
+                jax.ShapeDtypeStruct((B, H, Tq_p, Tk_p), jnp.float32))
+
+        outs = pl.pallas_call(
+            functools.partial(
+                _flash_bwd_fused_kernel, scale=scale, causal=causal,
+                block_q=block_q, block_k=block_k, kv_len=Tk,
+                num_q_blocks=n_q, has_bias=has_bias, rate=rate,
+                emit_ds=want_dbias),
+            grid=(B, H, n_q),
+            in_specs=fused_in_specs,
+            out_specs=fused_out_specs,
+            out_shape=fused_out_shape,
+            scratch_shapes=[pltpu.VMEM((Tk_p, D), jnp.float32),   # dk acc
+                            pltpu.VMEM((Tk_p, D), jnp.float32)],  # dv acc
+            interpret=interpret,
+            compiler_params=pltpu.CompilerParams(
+                dimension_semantics=("parallel", "parallel",
+                                     "arbitrary")),
+        )(*fused_args)
+        if want_dbias:
+            dq, dk, dv, ds_full = outs
+            ds_full = ds_full[:, :, :Tq, :Tk]
+            red = tuple(ax for ax, size in enumerate(bias.shape[:3])
+                        if size == 1)
+            d_bias = (ds_full.sum(axis=red, keepdims=True) if red
+                      else ds_full).astype(bias.dtype)
+        else:
+            dq, dk, dv = outs
+            d_bias = None
+        return dq[:, :, :Tq], dk[:, :, :Tk], dv[:, :, :Tk], d_bias
 
     out_specs = [q_spec]
     out_shape = [jax.ShapeDtypeStruct((B, H, Tq_p, D), q.dtype)]

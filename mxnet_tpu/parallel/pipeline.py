@@ -272,19 +272,35 @@ def pipeline_train_grads(stage_fn: Callable, loss_fn: Callable,
         raise ValueError(f"batch {B} not divisible into {n_micro} "
                          f"microbatches")
     mbs = B // n_micro
+    # 1F1B COMPOSES with dp (r5): the microbatch batch dim shards over
+    # the mesh's dp axis — each dp row pipelines its own batch slice
+    # through the same tick tables, and grads/loss psum over dp at the
+    # end of the sweep (GSPMD's allreduce analog, but explicit because
+    # the whole sweep lives inside one shard_map).
+    dp_ax = ("dp" if ("dp" in mesh.axis_names and axis != "dp"
+                      and mesh.shape["dp"] > 1) else None)
+    dpn = mesh.shape[dp_ax] if dp_ax else 1
+    if mbs % dpn:
+        raise ValueError(f"microbatch size {mbs} not divisible by "
+                         f"dp={dpn}")
     x_mb = x.reshape((n_micro, mbs) + x.shape[1:])
     y_mb = y.reshape((n_micro, mbs) + y.shape[1:])
     ftbl_np, btbl_np, af_np, ab_np = _simulate_1f1b(S, n_micro)
     T = ftbl_np.shape[0]
     perm_f = [(i, (i + 1) % S) for i in range(S)]
     perm_b = [((i + 1) % S, i) for i in range(S)]
-    act_shape = (mbs,) + x.shape[1:]
+    # shapes inside the shard_map are PER-DEVICE: dp splits the batch
+    act_shape = (mbs // dpn,) + x.shape[1:]
 
     def _stage(params, h, m):
         if rng_key is None:
             return stage_fn(params, h)
         stage = jax.lax.axis_index(axis)
         key = jax.random.fold_in(jax.random.fold_in(rng_key, m), stage)
+        if dp_ax:
+            # distinct dropout draws per dp row (rows hold different
+            # examples — replicated masks would correlate them)
+            key = jax.random.fold_in(key, jax.lax.axis_index(dp_ax))
         return stage_fn(params, h, key)
 
     def local(params, x_mb, y_mb, hparams=None):
@@ -406,14 +422,20 @@ def pipeline_train_grads(stage_fn: Callable, loss_fn: Callable,
                     gacc, hacc, dxacc, lacc), None
 
         gacc0 = jax.tree_util.tree_map(jnp.zeros_like, params)
+
+        def _dp_mean(v):
+            # mean over dp rows: the global loss is the mean of per-row
+            # slice losses, so row grads/losses scale by 1/dpn and sum
+            return jax.lax.psum(v, dp_ax) / dpn if dp_ax else v
+
         if head_params is None:
             carry0 = (zero_act, zero_act, ring0, ring0, ring0,
                       gacc0, jnp.float32(0))
             (*_, gacc, lacc), _ = jax.lax.scan(tick, carry0,
                                                jnp.arange(T))
-            loss = jax.lax.psum(lacc, axis) / n_micro
+            loss = _dp_mean(jax.lax.psum(lacc, axis)) / n_micro
             grads = jax.tree_util.tree_map(
-                lambda g: (g / n_micro)[None], gacc)
+                lambda g: (_dp_mean(g) / n_micro)[None], gacc)
             return loss, grads
         hacc0 = jax.tree_util.tree_map(jnp.zeros_like, hparams)
         dx0 = jnp.zeros((n_micro,) + act_shape, dt)
@@ -421,30 +443,33 @@ def pipeline_train_grads(stage_fn: Callable, loss_fn: Callable,
                   gacc0, hacc0, dx0, jnp.float32(0))
         (*_, gacc, hacc, dxacc, lacc), _ = jax.lax.scan(
             tick, carry0, jnp.arange(T))
-        loss = jax.lax.psum(lacc, axis) / n_micro
+        loss = _dp_mean(jax.lax.psum(lacc, axis)) / n_micro
         grads = jax.tree_util.tree_map(
-            lambda g: (g / n_micro)[None], gacc)
+            lambda g: (_dp_mean(g) / n_micro)[None], gacc)
         # head grads live only at the tail, dx only at stage 0 — psum
         # replicates both to every stage
         hgrads = jax.tree_util.tree_map(
-            lambda g: jax.lax.psum(g, axis) / n_micro, hacc)
+            lambda g: _dp_mean(jax.lax.psum(g, axis)) / n_micro, hacc)
         # the sweep seeds each microbatch loss with cotangent 1; the
         # returned total is the MEAN over microbatches, so dx needs the
         # same 1/n_micro the stage/head grads get
-        dx = jax.lax.psum(dxacc, axis) / n_micro
+        # dx stays SHARDED over dp (each row's slice cotangent) but
+        # scales by 1/dpn like everything else differentiating the mean
+        dx = jax.lax.psum(dxacc, axis) / n_micro / dpn
         return loss, grads, hgrads, dx
 
     pspec = jax.tree_util.tree_map(lambda _: P(axis), stage_params)
+    bspec = P(None, dp_ax)          # (n_micro, batch/dp, ...)
     if head_params is None:
         loss, grads = _shard_map(
-            local, mesh, in_specs=(pspec, P(), P()),
+            local, mesh, in_specs=(pspec, bspec, bspec),
             out_specs=(P(), pspec))(stage_params, x_mb, y_mb)
         return loss, grads
     hspec = jax.tree_util.tree_map(lambda _: P(), head_params)
     loss, grads, hgrads, dx = _shard_map(
         lambda sp, xm, ym, hp: local(sp, xm, ym, hp),
-        mesh, in_specs=(pspec, P(), P(), hspec),
-        out_specs=(P(), pspec, hspec, P()))(
+        mesh, in_specs=(pspec, bspec, bspec, hspec),
+        out_specs=(P(), pspec, hspec, bspec))(
             stage_params, x_mb, y_mb, head_params)
     dx = dx.reshape((B,) + x.shape[1:])
     return loss, grads, hgrads, dx
@@ -498,16 +523,17 @@ class GPTPipe(HybridBlock):
             raise ValueError(f"schedule must be 'gpipe' or '1f1b', "
                              f"got {schedule!r}")
         if schedule == "1f1b":
+            # r5: dp composes (the sweep shards the microbatch batch dim
+            # over dp and psums grads/loss — pipeline_train_grads).
+            # Other axes (tp/sp) would still be silently replicated: the
+            # sweep's stage math carries no in-stage sharding rules.
             extra = [a for a in mesh.axis_names
-                     if a != axis and mesh.shape[a] > 1]
+                     if a not in (axis, "dp") and mesh.shape[a] > 1]
             if extra:
-                # the sweep shard_maps the batch replicated (P()) over
-                # every axis: a dp axis would silently recompute the
-                # full batch per replica — no speedup, extra memory
                 raise ValueError(
-                    f"schedule='1f1b' supports a pure-{axis} mesh; "
+                    f"schedule='1f1b' supports a {axis}(+dp) mesh; "
                     f"axes {extra} would be silently replicated — use "
-                    "schedule='gpipe' to compose pp with dp")
+                    "schedule='gpipe' to compose pp with tp/sp")
         # '1f1b': SPMDTrainer routes gradients through the hand-scheduled
         # sweep (pipeline_loss_and_grads) — S-slot residual memory and
         # tail-ramp backward overlap instead of GPipe's M-microbatch
