@@ -126,18 +126,60 @@ def _flash_fwd_kernel(*refs, scale: float, causal: bool, block_q: int,
                       block_k: int, kv_len: int, num_k_blocks: int,
                       has_bias: bool, rate: float):
     i = 0
-    q_ref, k_ref, v_ref = refs[0], refs[1], refs[2]
+    q_ref, kt_ref, v_ref = refs[0], refs[1], refs[2]
     i = 3
     bias_ref = refs[i] if has_bias else None
     i += 1 if has_bias else 0
     seed_ref = refs[i] if rate > 0 else None
     i += 1 if rate > 0 else 0
-    o_ref, lse_ref, acc_ref, m_ref, l_ref = refs[i:i + 5]
+    o_ref, lse_ref = refs[i], refs[i + 1]
+    if num_k_blocks > 1:
+        acc_ref, m_ref, l_ref = refs[i + 2:i + 5]
 
     b = pl.program_id(0)
     h = pl.program_id(1)
     iq = pl.program_id(2)
     ik = pl.program_id(3)
+
+    if num_k_blocks == 1:
+        # single-block specialization (every T <= block_k): the whole K
+        # is in this tile, so the online-softmax carry (acc rescale,
+        # running m/l scratch reads/writes) is pure overhead — a plain
+        # row softmax computes the exact same result ~15% faster.
+        def tile1(apply_mask):
+            q = q_ref[0, 0]
+            kt = kt_ref[0, 0]
+            v = v_ref[0, 0]
+            s = jax.lax.dot_general(q, kt, (((1,), (0,)), ((), ())),
+                                    preferred_element_type=jnp.float32,
+                                    precision=_prec(q.dtype)) * scale
+            if has_bias:
+                s = s + bias_ref[0, 0].astype(jnp.float32)
+            if apply_mask:
+                col = jax.lax.broadcasted_iota(jnp.int32, s.shape, 1)
+                mask = col < kv_len
+                if causal:
+                    row = iq * block_q + jax.lax.broadcasted_iota(
+                        jnp.int32, s.shape, 0)
+                    mask = jnp.logical_and(mask, col <= row)
+                s = jnp.where(mask, s, _NEG_INF)
+            m = jnp.max(s, axis=1, keepdims=True)
+            p = jnp.exp(s - m)
+            l = jnp.sum(p, axis=1, keepdims=True)
+            if rate > 0:
+                keep = _dropout_keep(seed_ref, b, h, iq, ik, rate,
+                                     p.shape)
+                p = jnp.where(keep, p / (1.0 - rate), 0.0)
+            acc = jax.lax.dot_general(
+                p.astype(v.dtype), v, (((1,), (0,)), ((), ())),
+                preferred_element_type=jnp.float32,
+                precision=_prec(v.dtype))
+            denom = jnp.maximum(l, 1e-30)
+            o_ref[0, 0] = (acc / denom).astype(o_ref.dtype)
+            lse_ref[0, 0] = m + jnp.log(denom)
+
+        _causal_branches(causal, iq, ik, block_q, block_k, kv_len, tile1)
+        return
 
     @pl.when(ik == 0)
     def _init():
@@ -147,11 +189,16 @@ def _flash_fwd_kernel(*refs, scale: float, causal: bool, block_q: int,
 
     def tile(apply_mask):
         q = q_ref[0, 0]                               # (bq, d) input dtype
-        k = k_ref[0, 0]                               # (bk, d)
+        kt = kt_ref[0, 0]                             # (d, bk) PRE-transposed
         v = v_ref[0, 0]                               # (bk, d)
         # matmuls run in the INPUT dtype (bf16 MXU rate is 2-4x f32) with
-        # f32 accumulation; scale applies to the f32 product
-        s = jax.lax.dot_general(q, k, (((1,), (1,)), ((), ())),
+        # f32 accumulation; scale applies to the f32 product.  K arrives
+        # PRE-TRANSPOSED (r5): contracting over the rhs's LANE dim (the
+        # q@k^T 'nt' form) makes Mosaic transpose k inside every grid
+        # step — a measured 27% of the whole fwd kernel at BERT shapes;
+        # the one XLA-side swapaxes outside the kernel costs ~0.2 ms
+        # and every step's matmul becomes MXU-native.
+        s = jax.lax.dot_general(q, kt, (((1,), (0,)), ((), ())),
                                 preferred_element_type=jnp.float32,
                                 precision=_prec(q.dtype)) * scale
         if has_bias:
@@ -237,6 +284,9 @@ def _flash_forward(q, k, v, bias, seed, scale: float, causal: bool,
     vp = _pad_to(v, 2, block_k)
     Tq_p, Tk_p = qp.shape[2], kp.shape[2]
     n_q, n_k = Tq_p // block_q, Tk_p // block_k
+    # K ships PRE-TRANSPOSED (one XLA copy) so the in-kernel q@k^T is an
+    # MXU-native 'nn' contraction — see _flash_fwd_kernel
+    ktp = jnp.swapaxes(kp, 2, 3)                      # (B, H, D, Tk_p)
 
     kernel = functools.partial(
         _flash_fwd_kernel, scale=scale, causal=causal, block_q=block_q,
@@ -245,10 +295,10 @@ def _flash_forward(q, k, v, bias, seed, scale: float, causal: bool,
 
     in_specs = [
         pl.BlockSpec((1, 1, block_q, D), lambda b, h, i, j: (b, h, i, 0)),
-        pl.BlockSpec((1, 1, block_k, D), lambda b, h, i, j: (b, h, j, 0)),
+        pl.BlockSpec((1, 1, D, block_k), lambda b, h, i, j: (b, h, 0, j)),
         pl.BlockSpec((1, 1, block_k, D), lambda b, h, i, j: (b, h, j, 0)),
     ]
-    args = [qp, kp, vp]
+    args = [qp, ktp, vp]
     if has_bias:
         bp = _pad_bias(bias, block_q, block_k)
         in_specs.append(_bias_spec(bias.shape, block_q, block_k))
@@ -271,11 +321,13 @@ def _flash_forward(q, k, v, bias, seed, scale: float, causal: bool,
             jax.ShapeDtypeStruct((B, H, Tq_p, D), q.dtype),
             jax.ShapeDtypeStruct((B, H, Tq_p, 1), jnp.float32),
         ],
-        scratch_shapes=[
+        # the single-block specialization needs no online-softmax carry —
+        # don't reserve VMEM it never touches
+        scratch_shapes=([] if n_k == 1 else [
             pltpu.VMEM((block_q, D), jnp.float32),   # acc
             pltpu.VMEM((block_q, 1), jnp.float32),   # running max
             pltpu.VMEM((block_q, 1), jnp.float32),   # running denom
-        ],
+        ]),
         interpret=interpret,
         compiler_params=_GRID_SEMANTICS,
     )(*args)
@@ -286,8 +338,9 @@ def _flash_bwd_dq_kernel(*refs, scale: float, causal: bool, block_q: int,
                          block_k: int, kv_len: int, num_k_blocks: int,
                          has_bias: bool, rate: float, emit_ds: bool):
     i = 0
-    q_ref, k_ref, v_ref, do_ref, lse_ref, delta_ref = refs[:6]
-    i = 6
+    (q_ref, k_ref, kt_ref, vt_ref, do_ref, lse_ref,
+     delta_ref) = refs[:7]
+    i = 7
     bias_ref = refs[i] if has_bias else None
     i += 1 if has_bias else 0
     seed_ref = refs[i] if rate > 0 else None
@@ -308,12 +361,13 @@ def _flash_bwd_dq_kernel(*refs, scale: float, causal: bool, block_q: int,
     def tile(apply_mask):
         q = q_ref[0, 0]                                # (bq, d) input dtype
         k = k_ref[0, 0]                                # (bk, d)
-        v = v_ref[0, 0]
+        kt = kt_ref[0, 0]                              # (d, bk)
+        vt = vt_ref[0, 0]                              # (d, bk)
         do = do_ref[0, 0]                              # (bq, d)
         lse = lse_ref[0, 0]                            # (bq, 1)
         delta = delta_ref[0, 0]                        # (bq, 1)
 
-        s = jax.lax.dot_general(q, k, (((1,), (1,)), ((), ())),
+        s = jax.lax.dot_general(q, kt, (((1,), (0,)), ((), ())),
                                 preferred_element_type=jnp.float32,
                                 precision=_prec(q.dtype)) * scale
         if has_bias:
@@ -328,9 +382,9 @@ def _flash_bwd_dq_kernel(*refs, scale: float, causal: bool, block_q: int,
                     jnp.int32, s.shape, 0)
                 mask = jnp.logical_and(mask, col <= row)
             p = jnp.where(mask, p, 0.0)
-        dp = jax.lax.dot_general(do, v, (((1,), (1,)), ((), ())),
+        dp = jax.lax.dot_general(do, vt, (((1,), (0,)), ((), ())),
                                  preferred_element_type=jnp.float32,
-                                 precision=_prec(v.dtype))
+                                 precision=_prec(vt.dtype))
         if rate > 0:
             keep = _dropout_keep(seed_ref, b, h, iq, ik, rate, p.shape)
             dp = jnp.where(keep, dp / (1.0 - rate), 0.0)
@@ -367,9 +421,14 @@ def _flash_bwd_fused_kernel(*refs, scale: float, causal: bool,
     (the whole K is resident, so dq needs no cross-block accumulation),
     and accumulates dk/dv in VMEM scratch over the sequential q axis.
     K/V block specs are constant in iq, so Mosaic keeps them in VMEM
-    across the whole (b, h) pass — q/k/v stream exactly once."""
-    q_ref, k_ref, v_ref, do_ref, lse_ref, delta_ref = refs[:6]
-    i = 6
+    across the whole (b, h) pass — q/k/v stream exactly once.  K rides
+    twice (original for ds@k, pre-transposed for q@k^T) and V rides
+    only pre-transposed (do@v^T) — r5: shipping the transposed forms
+    keeps every matmul MXU-native instead of paying an in-kernel
+    transpose per grid step."""
+    (q_ref, k_ref, kt_ref, vt_ref, do_ref, lse_ref,
+     delta_ref) = refs[:7]
+    i = 7
     bias_ref = refs[i] if has_bias else None
     i += 1 if has_bias else 0
     seed_ref = refs[i] if rate > 0 else None
@@ -393,12 +452,13 @@ def _flash_bwd_fused_kernel(*refs, scale: float, causal: bool,
     def tile(apply_mask):
         q = q_ref[0, 0]                                # (bq, d) input dtype
         k = k_ref[0, 0]                                # (Tk, d)
-        v = v_ref[0, 0]
+        kt = kt_ref[0, 0]                              # (d, Tk)
+        vt = vt_ref[0, 0]                              # (d, Tk)
         do = do_ref[0, 0]
         lse = lse_ref[0, 0]                            # (bq, 1)
         delta = delta_ref[0, 0]
 
-        s = jax.lax.dot_general(q, k, (((1,), (1,)), ((), ())),
+        s = jax.lax.dot_general(q, kt, (((1,), (0,)), ((), ())),
                                 preferred_element_type=jnp.float32,
                                 precision=_prec(q.dtype)) * scale
         if has_bias:
@@ -413,9 +473,9 @@ def _flash_bwd_fused_kernel(*refs, scale: float, causal: bool,
                 mask = jnp.logical_and(mask, col <= row)
             p = jnp.where(mask, p, 0.0)
         p_drop = p
-        dp = jax.lax.dot_general(do, v, (((1,), (1,)), ((), ())),
+        dp = jax.lax.dot_general(do, vt, (((1,), (0,)), ((), ())),
                                  preferred_element_type=jnp.float32,
-                                 precision=_prec(v.dtype))
+                                 precision=_prec(vt.dtype))
         if rate > 0:
             keep = _dropout_keep(seed_ref, b, h, iq, ik, rate, p.shape)
             inv = 1.0 / (1.0 - rate)
@@ -457,7 +517,7 @@ def _flash_bwd_fused_kernel(*refs, scale: float, causal: bool,
 def _flash_bwd_dkv_kernel(*refs, scale: float, causal: bool, block_q: int,
                           block_k: int, kv_len: int, num_q_blocks: int,
                           has_bias: bool, rate: float):
-    q_ref, k_ref, v_ref, do_ref, lse_ref, delta_ref = refs[:6]
+    q_ref, kt_ref, vt_ref, do_ref, lse_ref, delta_ref = refs[:6]
     i = 6
     bias_ref = refs[i] if has_bias else None
     i += 1 if has_bias else 0
@@ -477,13 +537,13 @@ def _flash_bwd_dkv_kernel(*refs, scale: float, causal: bool, block_q: int,
 
     def tile(apply_mask):
         q = q_ref[0, 0]                                # (bq, d) input dtype
-        k = k_ref[0, 0]                                # (bk, d)
-        v = v_ref[0, 0]
+        kt = kt_ref[0, 0]                              # (d, bk)
+        vt = vt_ref[0, 0]                              # (d, bk)
         do = do_ref[0, 0]
         lse = lse_ref[0, 0]
         delta = delta_ref[0, 0]
 
-        s = jax.lax.dot_general(q, k, (((1,), (1,)), ((), ())),
+        s = jax.lax.dot_general(q, kt, (((1,), (0,)), ((), ())),
                                 preferred_element_type=jnp.float32,
                                 precision=_prec(q.dtype)) * scale
         if has_bias:
@@ -499,9 +559,9 @@ def _flash_bwd_dkv_kernel(*refs, scale: float, causal: bool, block_q: int,
                 mask = jnp.logical_and(mask, col <= row)
             p = jnp.where(mask, p, 0.0)
         p_drop = p
-        dp = jax.lax.dot_general(do, v, (((1,), (1,)), ((), ())),
+        dp = jax.lax.dot_general(do, vt, (((1,), (0,)), ((), ())),
                                  preferred_element_type=jnp.float32,
-                                 precision=_prec(v.dtype))
+                                 precision=_prec(vt.dtype))
         if rate > 0:
             keep = _dropout_keep(seed_ref, b, h, iq, ik, rate, p.shape)
             inv = 1.0 / (1.0 - rate)
@@ -548,23 +608,11 @@ def _flash_backward(q, k, v, bias, seed, o, lse, g, scale: float,
     vp = _pad_to(v, 2, block_k)
     Tq_p, Tk_p = qp.shape[2], kp.shape[2]
     n_q, n_k = Tq_p // block_q, Tk_p // block_k
-
-    q_spec = pl.BlockSpec((1, 1, block_q, D),
-                          lambda b, h, i, j: (b, h, i, 0))
-    k_spec = pl.BlockSpec((1, 1, block_k, D),
-                          lambda b, h, i, j: (b, h, j, 0))
-    row_spec = pl.BlockSpec((1, 1, block_q, 1),
-                            lambda b, h, i, j: (b, h, i, 0))
-
-    in_specs = [q_spec, k_spec, k_spec, q_spec, row_spec, row_spec]
-    args = [qp, kp, vp, dop, lsep, deltap]
-    if has_bias:
-        bp = _pad_bias(bias, block_q, block_k)
-        in_specs.append(_bias_spec(bias.shape, block_q, block_k))
-        args.append(bp)
-    if rate > 0:
-        in_specs.append(pl.BlockSpec(memory_space=pltpu.SMEM))
-        args.append(seed)
+    # pre-transposed K/V (one XLA copy each): every s = q@k^T and
+    # dp = do@v^T inside the kernels becomes an MXU-native 'nn'
+    # contraction instead of paying a per-grid-step Mosaic transpose
+    ktp = jnp.swapaxes(kp, 2, 3)                      # (B, H, D, Tk_p)
+    vtp = jnp.swapaxes(vp, 2, 3)
 
     if n_k == 1:
         # single k-block regime (every T <= block_k): ONE fused pass
@@ -577,8 +625,10 @@ def _flash_backward(q, k, v, bias, seed, o, lse, g, scale: float,
                          lambda b, h, i: (b, h, i, 0)),      # q
             pl.BlockSpec((1, 1, Tk_p, D),
                          lambda b, h, i: (b, h, 0, 0)),      # k (resident)
-            pl.BlockSpec((1, 1, Tk_p, D),
-                         lambda b, h, i: (b, h, 0, 0)),      # v (resident)
+            pl.BlockSpec((1, 1, D, Tk_p),
+                         lambda b, h, i: (b, h, 0, 0)),      # k^T (resident)
+            pl.BlockSpec((1, 1, D, Tk_p),
+                         lambda b, h, i: (b, h, 0, 0)),      # v^T (resident)
             pl.BlockSpec((1, 1, block_q, D),
                          lambda b, h, i: (b, h, i, 0)),      # do
             pl.BlockSpec((1, 1, block_q, 1),
@@ -586,7 +636,7 @@ def _flash_backward(q, k, v, bias, seed, o, lse, g, scale: float,
             pl.BlockSpec((1, 1, block_q, 1),
                          lambda b, h, i: (b, h, i, 0)),      # delta
         ]
-        fused_args = [qp, kp, vp, dop, lsep, deltap]
+        fused_args = [qp, kp, ktp, vtp, dop, lsep, deltap]
         if has_bias:
             Bb, Hb, Tqb = bias.shape[0], bias.shape[1], bias.shape[2]
             bshape = ((1, 1, 1, Tk_p) if Tqb == 1
@@ -649,6 +699,27 @@ def _flash_backward(q, k, v, bias, seed, o, lse, g, scale: float,
             d_bias = None
         return dq[:, :, :Tq], dk[:, :, :Tk], dv[:, :, :Tk], d_bias
 
+    # two-pass path (n_k > 1): dq kernel then dkv kernel
+    q_spec = pl.BlockSpec((1, 1, block_q, D),
+                          lambda b, h, i, j: (b, h, i, 0))
+    k_spec = pl.BlockSpec((1, 1, block_k, D),
+                          lambda b, h, i, j: (b, h, j, 0))
+    kt_spec = pl.BlockSpec((1, 1, D, block_k),
+                           lambda b, h, i, j: (b, h, 0, j))
+    row_spec = pl.BlockSpec((1, 1, block_q, 1),
+                            lambda b, h, i, j: (b, h, i, 0))
+
+    in_specs = [q_spec, k_spec, kt_spec, kt_spec, q_spec,
+                row_spec, row_spec]
+    args = [qp, kp, ktp, vtp, dop, lsep, deltap]
+    if has_bias:
+        bp = _pad_bias(bias, block_q, block_k)
+        in_specs.append(_bias_spec(bias.shape, block_q, block_k))
+        args.append(bp)
+    if rate > 0:
+        in_specs.append(pl.BlockSpec(memory_space=pltpu.SMEM))
+        args.append(seed)
+
     out_specs = [q_spec]
     out_shape = [jax.ShapeDtypeStruct((B, H, Tq_p, D), q.dtype)]
     if want_dbias:
@@ -683,10 +754,13 @@ def _flash_backward(q, k, v, bias, seed, o, lse, g, scale: float,
                            lambda b, h, j, i: (b, h, i, 0))
     ks_spec = pl.BlockSpec((1, 1, block_k, D),
                            lambda b, h, j, i: (b, h, j, 0))
+    kts_spec = pl.BlockSpec((1, 1, D, block_k),
+                            lambda b, h, j, i: (b, h, 0, j))
     rows_spec = pl.BlockSpec((1, 1, block_q, 1),
                              lambda b, h, j, i: (b, h, i, 0))
-    in_specs2 = [qs_spec, ks_spec, ks_spec, qs_spec, rows_spec, rows_spec]
-    args2 = [qp, kp, vp, dop, lsep, deltap]
+    in_specs2 = [qs_spec, kts_spec, kts_spec, qs_spec,
+                 rows_spec, rows_spec]
+    args2 = [qp, ktp, vtp, dop, lsep, deltap]
     if has_bias:
         in_specs2.append(_bias_spec(bias.shape, block_q, block_k,
                                     kv_major=True))
