@@ -25,6 +25,10 @@ import jax.numpy as jnp
 import numpy as _np
 
 from ..base import MXNetError
+
+# jax arrays are int32 by default; row/col ids past this need the
+# host-side int64 representation (the USE_INT64_TENSOR_SIZE analog)
+_INT32_MAX = 2 ** 31 - 1
 from ..context import Context, current_context
 from .ndarray import NDArray
 from .ops import _as_nd
@@ -129,7 +133,14 @@ class RowSparseNDArray(BaseSparseNDArray):
                  ctx: Optional[Context] = None, dtype: Any = None) -> None:
         super().__init__()
         vals = jnp.asarray(data, dtype=dtype)
-        idx = jnp.asarray(indices, dtype=jnp.int32)
+        if len(shape) and shape[0] > _INT32_MAX:
+            # INT64 regime (reference: USE_INT64_TENSOR_SIZE builds).
+            # jax arrays default to int32, which would silently WRAP row
+            # ids past 2^31 — keep the ids host-side in exact int64;
+            # a dense view is unmaterializable at this scale anyway.
+            idx = _np.ascontiguousarray(indices, dtype=_np.int64)
+        else:
+            idx = jnp.asarray(indices, dtype=jnp.int32)
         if vals.ndim != len(shape):
             raise MXNetError(
                 f"row_sparse data ndim {vals.ndim} must equal shape ndim "
@@ -160,6 +171,11 @@ class RowSparseNDArray(BaseSparseNDArray):
         return (self._sp_indices, self._sp_values)
 
     def _todense_impl(self):
+        if isinstance(self._sp_indices, _np.ndarray):
+            raise MXNetError(
+                f"row_sparse with {self._sp_shape[0]} rows (> int32) "
+                "cannot be densified — the dense view would exceed "
+                "addressable element counts; keep it sparse")
         dense = jnp.zeros(self._sp_shape, dtype=self._sp_dtype)
         if self._sp_values.shape[0] == 0:
             return dense
@@ -217,7 +233,12 @@ class CSRNDArray(BaseSparseNDArray):
         if len(shape) != 2:
             raise MXNetError("csr arrays are 2-D")
         self._sp_data = jnp.asarray(data, dtype=dtype)
-        self._sp_indices = jnp.asarray(indices, dtype=jnp.int32)
+        if shape[1] > _INT32_MAX:
+            # INT64 column regime: exact host-side ids (see RowSparse)
+            self._sp_indices = _np.ascontiguousarray(indices,
+                                                     dtype=_np.int64)
+        else:
+            self._sp_indices = jnp.asarray(indices, dtype=jnp.int32)
         self._sp_indptr = jnp.asarray(indptr, dtype=jnp.int32)
         if self._sp_indptr.shape[0] != shape[0] + 1:
             raise MXNetError(

@@ -1,0 +1,41 @@
+"""Generate the golden checkpoint fixtures (committed once, loaded by
+tests forever after — the nightly model-compat analog)."""
+import os, sys
+sys.path.insert(0, "/root/repo")
+import jax; jax.config.update('jax_platforms', 'cpu')
+import numpy as onp
+import mxnet_tpu as mx
+
+FIX = "/root/repo/tests/fixtures"
+mx.random.seed(42)
+net = mx.gluon.nn.HybridSequential()
+net.add(mx.gluon.nn.Dense(8, in_units=4, activation="relu"),
+        mx.gluon.nn.Dense(3, in_units=8))
+net.initialize()
+x = mx.np.array(onp.arange(8, dtype="float32").reshape(2, 4) / 10.0)
+net.hybridize()
+y = net(x)
+# .params
+net.save_parameters(os.path.join(FIX, "golden_r5.params"))
+# export json+params
+net.export(os.path.join(FIX, "golden_r5_export"), epoch=7)
+# trainer states (sgd momentum, after 3 steps so state is nonzero)
+tr = mx.gluon.Trainer(net.collect_params(), "sgd",
+                      {"learning_rate": 0.1, "momentum": 0.9})
+lf = mx.gluon.loss.L2Loss()
+t = mx.np.array(onp.ones((2, 3), dtype="float32"))
+for _ in range(3):
+    with mx.autograd.record():
+        l = lf(net(x), t).mean()
+    l.backward()
+    tr.step(1)
+tr.save_states(os.path.join(FIX, "golden_r5.states"))
+# reference outputs for exactness pinning (pre-training y from the saved
+# params: reload into a fresh net and record ITS output)
+net2 = mx.gluon.nn.HybridSequential()
+net2.add(mx.gluon.nn.Dense(8, in_units=4, activation="relu"),
+         mx.gluon.nn.Dense(3, in_units=8))
+net2.load_parameters(os.path.join(FIX, "golden_r5.params"))
+y2 = net2(x).asnumpy()
+onp.save(os.path.join(FIX, "golden_r5_output.npy"), y2)
+print("fixtures written:", sorted(os.listdir(FIX)))
