@@ -250,13 +250,16 @@ def _pad_to(x, axis, mult):
     return jnp.pad(x, widths)
 
 
-def _legal_blocks(block_q, block_k, Tq, Tk, has_bias, interpret):
-    """TPU tiling for the (block_q, block_k)-blocked bias (and the
-    learned-bias ds output): trailing dim must be a multiple of 128 or
-    the whole (padded) axis, second-to-last a multiple of 8 or whole —
-    odd tunable blocks collapse to whole-axis blocks. Interpret mode
-    (CPU) keeps the requested blocks for multi-block coverage."""
-    if has_bias and not interpret:
+def _legal_blocks(block_q, block_k, Tq, Tk, interpret):
+    """TPU tiling legality: a block's trailing dim must be a multiple
+    of 128 or the whole (padded) axis, second-to-last a multiple of 8
+    or whole.  Since r5 the K/V operands ship PRE-TRANSPOSED, putting
+    ``block_k`` on the LANE dim of the (D, block_k) kT/vT blocks — so
+    the constraint applies to EVERY call, not only blocked-bias ones:
+    odd tunable blocks collapse to whole-axis blocks (same math, one
+    block).  Interpret mode (CPU) keeps the requested blocks for
+    multi-block coverage."""
+    if not interpret:
         if block_k % 128:
             block_k = Tk
         if block_q % 8:
@@ -278,7 +281,7 @@ def _flash_forward(q, k, v, bias, seed, scale: float, causal: bool,
     Tk = k.shape[2]
     has_bias = bias is not None
     block_q, block_k = _legal_blocks(block_q, block_k, Tq, Tk,
-                                     has_bias, interpret)
+                                     interpret)
     qp = _pad_to(q, 2, block_q)
     kp = _pad_to(k, 2, block_k)
     vp = _pad_to(v, 2, block_k)
@@ -594,7 +597,7 @@ def _flash_backward(q, k, v, bias, seed, o, lse, g, scale: float,
     Tk = k.shape[2]
     has_bias = bias is not None
     block_q, block_k = _legal_blocks(block_q, block_k, Tq, Tk,
-                                     has_bias, interpret)
+                                     interpret)
     # a non-learned mask bias skips the O(B*H*T^2) ds materialization —
     # the whole point of a flash kernel for long contexts
     want_dbias = has_bias and bias_grad
