@@ -265,6 +265,9 @@ class Block:
 
     # -- execution ---------------------------------------------------------
     def __call__(self, *args: Any) -> Any:
+        if args and isinstance(args[0], PreActivation) \
+                and not getattr(type(self), "_consumes_preactivation", False):
+            args = (args[0].materialize(),) + args[1:]
         for hook in self._forward_pre_hooks:
             hook(self, args)
         out = self.forward(*args)
@@ -502,6 +505,10 @@ class HybridBlock(Block):
 
     def __call__(self, *args: Any) -> Any:
         if self._active and not _tracing_now(args):
+            if args and isinstance(args[0], PreActivation):
+                # the hybrid cache boundary speaks NDArray: a deferred
+                # epilogue materializes rather than crossing the jit
+                args = (args[0].materialize(),) + args[1:]
             for hook in self._forward_pre_hooks:
                 hook(self, args)
             out = self._call_cached(*args)
@@ -650,8 +657,29 @@ def _obj_to_treedef(obj: Any) -> Any:
     return jax.tree_util.tree_structure(dec(obj))
 
 
+class PreActivation:
+    """A residual-block output BEFORE its epilogue ReLU, deferred so a
+    consuming 1x1 conv can take the ReLU as a Pallas kernel prologue
+    (ops/pallas/conv_fused.py) — the activated tensor then never
+    round-trips HBM.  Blocks that understand the deferral set
+    ``_consumes_preactivation = True``; every other ``Block.__call__``
+    (and the hybrid cache boundary) materializes transparently, so the
+    box can never leak into user code or a jit signature."""
+
+    __slots__ = ("z",)
+
+    def __init__(self, z) -> None:
+        self.z = z
+
+    def materialize(self):
+        from .. import npx
+        return npx.relu(self.z)
+
+
 def _tracing_now(args) -> bool:
     for a in args:
+        if isinstance(a, PreActivation):
+            a = a.z
         data = a._data if isinstance(a, NDArray) else a
         if isinstance(data, jax.core.Tracer):
             return True

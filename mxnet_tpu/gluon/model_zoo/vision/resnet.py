@@ -56,6 +56,17 @@ class BasicBlockV1(HybridBlock):
 
 
 class BottleneckV1(HybridBlock):
+    """The deep-ResNet block.  Under MXNET_FUSE_BN_CONV both of its 1x1
+    junctions run as Pallas prologue-fused GEMMs (ops/pallas/
+    conv_fused.py): the (bn2, relu, conv3) triple fuses inside ``body``
+    (HybridSequential pattern), and the block's epilogue ReLU is
+    DEFERRED (gluon.block.PreActivation) so the next block's conv1
+    takes it as a kernel prologue — the activated tensors never
+    round-trip HBM.  Semantics are unchanged; the fusion is numerically
+    invisible (tests/test_fused_conv.py)."""
+
+    _consumes_preactivation = True
+
     def __init__(self, channels: int, stride: int, downsample: bool = False,
                  in_channels: int = 0, **kwargs: Any) -> None:
         super().__init__(**kwargs)
@@ -77,13 +88,65 @@ class BottleneckV1(HybridBlock):
         else:
             self.downsample = None
 
-    def forward(self, x):
-        residual = x
-        out = self.body(x)
-        if self.downsample is not None:
-            residual = self.downsample(x)
+    @staticmethod
+    def _head_fusable(conv) -> bool:
+        from ...nn.basic_layers import _conv1x1_fusable
+        return _conv1x1_fusable(conv)
+
+    def _block_out(self, x):
+        """out + residual BEFORE the epilogue ReLU (accepts a deferred
+        PreActivation input from the previous sibling)."""
         from .... import npx
-        return npx.relu(out + residual)
+        from ...block import PreActivation
+        from ...nn.basic_layers import _sequential_forward
+
+        from ...nn.basic_layers import _has_hooks
+        body = list(self.body._children.values())
+        if isinstance(x, PreActivation):
+            z = x.z
+            from ....ops.pallas.conv_fused import fusion_profitable
+            if (npx.conv_fusion_enabled() and self._head_fusable(body[0])
+                    and not _has_hooks(self.body)
+                    and fusion_profitable(z.shape[0], z.shape[1],
+                                          body[0]._channels,
+                                          z.shape[2] * z.shape[3])):
+                conv1 = body[0]
+                conv1._infer(z)
+                h = npx.relu_conv1x1(
+                    z, conv1.weight.data(),
+                    None if conv1.bias is None else conv1.bias.data())
+                out = _sequential_forward(body[1:], h)
+                xin = None      # activated input materialized lazily
+            else:
+                xin = x.materialize()
+                out = self.body(xin)
+        else:
+            z = None
+            xin = x
+            out = self.body(xin)
+        if self.downsample is not None:
+            residual = self.downsample(
+                xin if xin is not None else npx.relu(z))
+        else:
+            # XLA fuses the recomputed ReLU into the add's operand read
+            residual = xin if xin is not None else npx.relu(z)
+        return out + residual
+
+    def forward(self, x):
+        from .... import npx
+        return npx.relu(self._block_out(x))
+
+    def _forward_deferred(self, x):
+        """Like forward(), but hands the consumer the PRE-activation so
+        its 1x1 conv1 can take the ReLU as a kernel prologue.  Only
+        _ResidualStage calls this (the box never reaches user code)."""
+        from ...block import PreActivation
+        from ....ndarray.ndarray import NDArray
+        zsum = self._block_out(x)
+        if isinstance(zsum, NDArray):
+            return PreActivation(zsum)
+        from .... import npx
+        return npx.relu(zsum)
 
     def deploy_emit(self, em, prefix, vid):
         return _emit_v1_block(self, BottleneckV1, em, prefix, vid)
@@ -188,6 +251,43 @@ class BottleneckV2(HybridBlock):
         return em.push({"op": "add"}, [o, res])
 
 
+class _ResidualStage(HybridSequential):
+    """A stage of residual blocks that drives the epilogue-ReLU deferral
+    between siblings (BottleneckV1._forward_deferred): each non-final
+    block hands its successor the pre-activation sum so the successor's
+    1x1 conv1 fuses the ReLU as a Pallas prologue.  The stage always
+    RETURNS a materialized NDArray — the deferral box is an internal
+    protocol, invisible to user code.  With fusion disabled (or for
+    blocks without the protocol) this is exactly HybridSequential."""
+
+    def forward(self, x, *args):
+        from .... import npx
+        from ...block import PreActivation
+        children = list(self._children.values())
+        fuse = npx.conv_fusion_enabled() and not args
+        from ...nn.basic_layers import _has_hooks
+        for i, child in enumerate(children):
+            defer = (fuse and i + 1 < len(children)
+                     and hasattr(type(child), "_forward_deferred")
+                     and getattr(type(children[i + 1]),
+                                 "_consumes_preactivation", False)
+                     and not _has_hooks(child, children[i + 1]))
+            if defer:
+                x = child._forward_deferred(x)
+            else:
+                x = child(x, *args)
+            args = ()
+        if isinstance(x, PreActivation):   # safety: never leak the box
+            x = x.materialize()
+        return x
+
+    def deploy_emit(self, em, prefix, vid):
+        # the fusion is numerically invisible: emit as a plain chain
+        for name, child in self._children.items():
+            vid = em.emit(child, f"{prefix}{name}.", vid)
+        return vid
+
+
 _BLOCK_V1 = {"basic_block": BasicBlockV1, "bottle_neck": BottleneckV1}
 _BLOCK_V2 = {"basic_block": BasicBlockV2, "bottle_neck": BottleneckV2}
 
@@ -225,7 +325,7 @@ class ResNetV1(HybridBlock):
         self.output = Dense(classes, in_units=channels[-1])
 
     def _make_layer(self, block, layers, channels, stride, in_channels=0):
-        layer = HybridSequential()
+        layer = _ResidualStage()
         layer.add(block(channels, stride, channels != in_channels,
                         in_channels=in_channels))
         for _ in range(layers - 1):

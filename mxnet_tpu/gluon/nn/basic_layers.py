@@ -57,8 +57,74 @@ class Sequential(Block):
                 child.hybridize(active, **kwargs)
 
 
+def _has_hooks(*blocks) -> bool:
+    """Fused paths bypass the children's __call__, so any registered
+    hook disqualifies fusion (hooks must keep firing identically on
+    every backend)."""
+    return any(b._forward_pre_hooks or b._forward_hooks for b in blocks)
+
+
+def _conv1x1_fusable(conv) -> bool:
+    """Can this conv be the GEMM of a Pallas prologue-fused junction?
+    (1x1, stride 1, NCHW, no groups/dilation/activation, stock forward
+    — the kernel contract of ops/pallas/conv_fused.py.)  Shared by the
+    HybridSequential triple matcher and the resnet epilogue deferral."""
+    from .conv_layers import Conv2D, _Conv
+    return (isinstance(conv, Conv2D)
+            and type(conv).forward is _Conv.forward
+            and conv._kernel == (1, 1) and conv._strides == (1, 1)
+            and conv._padding == (0, 0) and conv._dilation == (1, 1)
+            and conv._groups == 1 and conv._layout == "NCHW"
+            and not conv._activation and not _has_hooks(conv))
+
+
+def _fusable_bn_relu_conv(children, i, x) -> bool:
+    """Is children[i:i+3] a (BatchNorm, relu, 1x1-s1 Conv2D) junction the
+    Pallas prologue-fused GEMM can take whole?  (NCHW, no groups/
+    dilation, stock forwards — see ops/pallas/conv_fused.py.)"""
+    if i + 3 > len(children):
+        return False
+    bn, act, conv = children[i], children[i + 1], children[i + 2]
+    from .activations import Activation
+    if not (isinstance(bn, BatchNorm) and type(bn).forward is BatchNorm.forward
+            and isinstance(act, Activation)
+            and type(act).forward is Activation.forward
+            and _conv1x1_fusable(conv)):
+        return False
+    if bn._axis != 1 or act._act != "relu" or _has_hooks(bn, act):
+        return False
+    if not (isinstance(x, NDArray) and x.ndim == 4):
+        return False
+    from ...ops.pallas.conv_fused import fusion_profitable
+    n, ci, h, w = x.shape
+    return fusion_profitable(n, ci, conv._channels, h * w)
+
+
+def _sequential_forward(children, x: Any, args: tuple = ()) -> Any:
+    """The HybridSequential chain with junction fusion — shared with
+    residual blocks that run a children suffix after a fused head
+    (model_zoo resnet BottleneckV1)."""
+    fuse = npx.conv_fusion_enabled() and not args
+    i = 0
+    while i < len(children):
+        if fuse and _fusable_bn_relu_conv(children, i, x):
+            x = children[i].fused_conv_forward(x, children[i + 2])
+            i += 3
+            continue
+        x = children[i](x, *args)
+        args = ()
+        i += 1
+    return x
+
+
 class HybridSequential(HybridBlock):
-    """Hybridizable Sequential — compiles to one XLA program."""
+    """Hybridizable Sequential — compiles to one XLA program.
+
+    With MXNET_FUSE_BN_CONV enabled ('auto' = single-device TPU; default
+    off), consecutive ``BatchNorm -> relu -> 1x1 Conv2D`` children
+    execute as one Pallas prologue-fused GEMM: the normalized/activated
+    tensor never round-trips HBM (the ResNet-50 bottleneck's hot
+    junction — BASELINE.md bandwidth roofline)."""
 
     def __init__(self, prefix: Optional[str] = None) -> None:
         super().__init__(prefix)
@@ -68,10 +134,7 @@ class HybridSequential(HybridBlock):
             self.register_child(b)
 
     def forward(self, x: Any, *args: Any) -> Any:
-        for child in self._children.values():
-            x = child(x, *args)
-            args = ()
-        return x
+        return _sequential_forward(list(self._children.values()), x, args)
 
     def __len__(self) -> int:
         return len(self._children)
@@ -224,7 +287,9 @@ class BatchNorm(HybridBlock):
                 self._stats_virgin = False
         return self._stats_virgin
 
-    def forward(self, x: NDArray) -> NDArray:
+    def _pre(self, x: NDArray) -> Tuple[bool, bool]:
+        """Deferred init + (training, virgin-shift) resolution — shared
+        by forward() and the fused-conv path."""
         from ... import autograd
         c = x.shape[self._axis]
         for p in (self.gamma, self.beta, self.running_mean,
@@ -233,6 +298,10 @@ class BatchNorm(HybridBlock):
                 p._finish_deferred_init((c,))
         training = autograd.is_training() and not self._use_global_stats
         virgin = training and self._resolve_virgin_stats()
+        return training, virgin
+
+    def forward(self, x: NDArray) -> NDArray:
+        training, virgin = self._pre(x)
         out, batch_mean, batch_var = npx.batch_norm(
             x, self.gamma.data(), self.beta.data(),
             self.running_mean.data(), self.running_var.data(),
@@ -241,6 +310,30 @@ class BatchNorm(HybridBlock):
             use_global_stats=self._use_global_stats,
             stats="centered" if virgin else None,
             shift=self.stat_shift.data())
+        self._post(training, virgin, batch_mean, batch_var)
+        return out
+
+    def fused_conv_forward(self, x: NDArray, conv) -> NDArray:
+        """``conv(relu(bn(x)))`` through the Pallas prologue-fused GEMM
+        (ops/pallas/conv_fused.py) — the BN statistics contract (shifted
+        one-pass, virgin step, moving-average update) is identical to
+        forward(); only the apply+ReLU+conv execute as one kernel."""
+        training, virgin = self._pre(x)
+        conv._infer(x)
+        out, batch_mean, batch_var = npx.batch_norm_relu_conv1x1(
+            x, self.gamma.data(), self.beta.data(),
+            self.running_mean.data(), self.running_var.data(),
+            conv.weight.data(),
+            conv_bias=None if conv.bias is None else conv.bias.data(),
+            eps=self._epsilon, fix_gamma=not self._scale,
+            use_global_stats=self._use_global_stats,
+            stats="centered" if virgin else None,
+            shift=self.stat_shift.data())
+        self._post(training, virgin, batch_mean, batch_var)
+        return out
+
+    def _post(self, training: bool, virgin: bool, batch_mean: NDArray,
+              batch_var: NDArray) -> None:
         if training:
             # side-effecting moving-average update, off the tape
             # (reference momentum recursion, preserved exactly)
@@ -258,7 +351,6 @@ class BatchNorm(HybridBlock):
                 # cached executables must re-trace onto the shifted path
                 from ..block import invalidate_cached_graphs
                 invalidate_cached_graphs()
-        return out
 
     def __repr__(self) -> str:
         return f"BatchNorm(axis={self._axis}, momentum={self._momentum})"

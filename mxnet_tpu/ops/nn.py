@@ -46,7 +46,8 @@ __all__ = [
     "hard_swish", "log_sigmoid",
     "softmax", "log_softmax", "masked_softmax", "masked_log_softmax",
     "fully_connected", "convolution", "deconvolution", "pooling",
-    "adaptive_avg_pool2d", "batch_norm", "layer_norm", "group_norm",
+    "adaptive_avg_pool2d", "batch_norm", "batch_norm_relu_conv1x1",
+    "relu_conv1x1", "conv_fusion_enabled", "layer_norm", "group_norm",
     "instance_norm", "rms_norm", "l2_normalization", "lrn",
     "dropout", "embedding", "pick", "take_positions", "sequence_mask",
     "sequence_last", "sequence_reverse", "topk_mask", "smooth_l1",
@@ -631,6 +632,142 @@ def batch_norm(data, gamma, beta, running_mean, running_var,
     if has_shift:
         inputs = inputs + (_as_nd(shift),)
     return invoke("batch_norm", impl, inputs)
+
+
+# ---------------------------------------------------------------------------
+# Prologue-fused 1x1 convolution (TPU bandwidth optimization): the BN
+# apply + ReLU run on the VMEM tile as the consuming conv reads it, so
+# the activated tensor never exists in HBM.  The reference materializes
+# every Convolution->BatchNorm->Activation junction (convolution.cc /
+# batch_norm.cc dispatch per-op); on TPU the ResNet step is HBM-bound
+# (BASELINE.md bandwidth roofline) and XLA cannot fuse producers into a
+# conv operand, so this is a Pallas kernel (ops/pallas/conv_fused.py).
+# ---------------------------------------------------------------------------
+
+register_env("MXNET_FUSE_BN_CONV", "0",
+             "Fuse BatchNorm-apply+ReLU (or a plain ReLU) into a consuming "
+             "1x1 stride-1 convolution as one Pallas GEMM. 0 (default) "
+             "disables; 'auto' enables on a single-device TPU backend; 1 "
+             "forces on (CPU runs the kernels in interpret mode). "
+             "Numerically invisible (tests/test_fused_conv.py); default-off "
+             "until the kernels beat XLA's convs at the gated shapes "
+             "(benchmark/fused_conv_probe.py).")
+
+_FUSE_BN_CONV_LAST: list = [None]
+
+
+def conv_fusion_enabled() -> bool:
+    """Resolve MXNET_FUSE_BN_CONV OUTSIDE traced closures (graph-knob
+    contract: a toggle bumps the gluon graph epoch rather than silently
+    replaying a stale executable).  'auto' restricts to single-device TPU
+    backends: the Pallas call is not SPMD-partitionable under a
+    multi-device pjit, and CPU interpret mode is for tests only."""
+    val = str(getenv("MXNET_FUSE_BN_CONV", "0")).lower()
+    if val == "auto":
+        cur = (jax.default_backend() == "tpu" and jax.device_count() == 1)
+    else:
+        cur = val not in ("0", "false", "off")
+    if _FUSE_BN_CONV_LAST[0] is None:
+        _FUSE_BN_CONV_LAST[0] = cur
+    elif _FUSE_BN_CONV_LAST[0] != cur:
+        _FUSE_BN_CONV_LAST[0] = cur
+        from ..gluon.block import invalidate_cached_graphs
+        invalidate_cached_graphs()
+    return cur
+
+
+from ..base import register_graph_knob as _register_graph_knob  # noqa: E402
+_register_graph_knob(conv_fusion_enabled)
+
+
+def _bn_batch_stats(xf, red_axes, centered_stats, shift):
+    """Differentiable batch mean/var — the same shifted one-pass scheme
+    as _bn_train_math, but in plain jnp so autodiff carries gradients
+    through the stats (the fused-conv op composes them with the Pallas
+    kernel's custom VJP; XLA fuses the resulting sweeps)."""
+    if centered_stats:
+        mean = jnp.mean(xf, axis=red_axes)
+        centered = xf - mean.reshape([1, -1] + [1] * (xf.ndim - 2))
+        var = jnp.mean(centered * centered, axis=red_axes)
+        return mean, var
+    s = lax.stop_gradient(shift.astype(jnp.float32))
+    sh = s.reshape([1, -1] + [1] * (xf.ndim - 2))
+    centered = xf - sh
+    mean_c = jnp.mean(centered, axis=red_axes)
+    m2 = jnp.mean(centered * centered, axis=red_axes)
+    var = jnp.maximum(m2 - mean_c * mean_c, 0.0)
+    return mean_c + s, var
+
+
+def batch_norm_relu_conv1x1(data, gamma, beta, running_mean, running_var,
+                            weight, conv_bias=None, eps: float = 1e-5,
+                            fix_gamma: bool = False,
+                            use_global_stats: bool = False,
+                            training: Optional[bool] = None,
+                            stats: Optional[str] = None, shift=None,
+                            relu: bool = True):
+    """``conv1x1(relu(batch_norm(data)))`` as ONE fused kernel, NCHW.
+
+    Same statistics contract as ``batch_norm`` (axis=1 only): shifted
+    one-pass batch stats (or 'centered' for the virgin step), moving-stat
+    update left to the caller.  Returns ``(out, batch_mean, batch_var)``
+    with out of shape (N, Co, H, W) from weight (Co, Ci, 1, 1).
+    """
+    from .pallas.conv_fused import fused_prologue_conv1x1
+    nd = _as_nd(data)
+    if nd.ndim != 4:
+        raise MXNetError("batch_norm_relu_conv1x1 expects NCHW data")
+    ep, fg = eps, fix_gamma
+    train = is_training() if training is None else training
+    use_batch_stats = train and not use_global_stats
+    if stats is None:
+        stats = getenv("MXNET_BN_STATS", "shifted")
+    centered_stats = stats == "centered"
+    has_shift = shift is not None
+    has_bias = conv_bias is not None
+    red_axes = (0, 2, 3)
+
+    def impl(x, g, b, rm, rv, w, *rest):
+        # optional operands ride at fixed slots: [conv_bias][shift]
+        cb = rest[0] if has_bias else None
+        sh_arr = rest[1 if has_bias else 0] if has_shift else rm
+        gg = jnp.ones_like(g) if fg else g
+        if use_batch_stats:
+            mean, var = _bn_batch_stats(x.astype(jnp.float32), red_axes,
+                                        centered_stats, sh_arr)
+        else:
+            mean = rm.astype(jnp.float32)
+            var = rv.astype(jnp.float32)
+        inv = lax.rsqrt(var + ep)
+        scale = gg.astype(jnp.float32) * inv
+        shiftv = b.astype(jnp.float32) - mean * scale
+        y = fused_prologue_conv1x1(x, w, scale, shiftv, relu=relu, bias=cb)
+        return y, mean.astype(rm.dtype), var.astype(rv.dtype)
+
+    inputs = (nd, _as_nd(gamma), _as_nd(beta),
+              _as_nd(running_mean), _as_nd(running_var), _as_nd(weight))
+    inputs = inputs + ((_as_nd(conv_bias),) if has_bias else ())
+    if has_shift:
+        inputs = inputs + (_as_nd(shift),)
+    return invoke("batch_norm_relu_conv1x1", impl, inputs)
+
+
+def relu_conv1x1(data, weight, conv_bias=None):
+    """``conv1x1(relu(data))`` as one fused Pallas GEMM (NCHW) — the
+    bottleneck-epilogue junction (see ops/pallas/conv_fused.py)."""
+    from .pallas.conv_fused import fused_prologue_conv1x1
+    nd = _as_nd(data)
+    if nd.ndim != 4:
+        raise MXNetError("relu_conv1x1 expects NCHW data")
+    has_bias = conv_bias is not None
+
+    def impl(x, w, *rest):
+        return fused_prologue_conv1x1(x, w, None, None, relu=True,
+                                      bias=rest[0] if has_bias else None)
+
+    inputs = (nd, _as_nd(weight)) + \
+        ((_as_nd(conv_bias),) if has_bias else ())
+    return invoke("relu_conv1x1", impl, inputs)
 
 
 def layer_norm(data, gamma, beta, axis: int = -1, eps: float = 1e-5):
