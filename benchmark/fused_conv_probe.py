@@ -29,14 +29,16 @@ ITERS = 20
 
 
 def timed(fn, *args, n=5, static=()):
+    import numpy as onp
     f = jax.jit(fn, static_argnums=static)
-    r = f(*args)
-    jax.tree_util.tree_leaves(r)[0].block_until_ready()
+    # device_get, not block_until_ready: axon results are lazy handles
+    # that only execute remotely when a value is actually fetched
+    onp.asarray(f(*args))
     t0 = time.perf_counter()
     for _ in range(n):
-        r = f(*args)
-    jax.tree_util.tree_leaves(r)[0].block_until_ready()
-    return (time.perf_counter() - t0) / n
+        onp.asarray(f(*args))   # fetch forces execution; RTT cancels
+    t1 = time.perf_counter()    # against the null-scan arm
+    return (t1 - t0) / n
 
 
 def unfused(x, w, scale, shift, affine):
@@ -57,7 +59,9 @@ def fused(x, w, scale, shift, affine):
 def scan_fwd(impl, x, w, scale, shift, affine):
     def body(c, _):
         y = impl(x + c.astype(x.dtype), w, scale, shift, affine)
-        return y.astype(jnp.float32)[0, 0, 0, 0] * 1e-9, None
+        # full-tensor reduction: a single-element carry lets XLA slice
+        # the whole conv away (the first version measured nothing)
+        return jnp.max(y).astype(jnp.float32) * 1e-9, None
     c, _ = lax.scan(body, jnp.float32(0), None, length=ITERS)
     return c
 
@@ -70,7 +74,9 @@ def scan_bwd(impl, x, w, scale, shift, affine, dy):
         g = jax.grad(f, argnums=(0, 1, 2, 3))
         def body(c, _):
             gx, gw, gs, gt = g(x + c.astype(x.dtype), w, scale, shift)
-            return gx.astype(jnp.float32)[0, 0, 0, 0] * 1e-9, None
+            return (jnp.max(gx).astype(jnp.float32)
+                    + jnp.max(gw).astype(jnp.float32)
+                    + jnp.max(gs) + jnp.max(gt)) * 1e-9, None
     else:
         def f(x, w):
             y = impl(x, w, None, None, False)
@@ -78,7 +84,8 @@ def scan_bwd(impl, x, w, scale, shift, affine, dy):
         g = jax.grad(f, argnums=(0, 1))
         def body(c, _):
             gx, gw = g(x + c.astype(x.dtype), w)
-            return gx.astype(jnp.float32)[0, 0, 0, 0] * 1e-9, None
+            return (jnp.max(gx).astype(jnp.float32)
+                    + jnp.max(gw).astype(jnp.float32)) * 1e-9, None
     c, _ = lax.scan(body, jnp.float32(0), None, length=ITERS)
     return c
 

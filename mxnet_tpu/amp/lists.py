@@ -14,6 +14,10 @@ centrally, replacing the reference's per-namespace monkey-patching.
 # MXU-bound ops: run in the low-precision target dtype.
 TARGET_DTYPE_FUNCS = [
     "fully_connected", "convolution", "deconvolution", "dot", "batch_dot",
+    # fused BN/ReLU->1x1-conv junctions: the GEMM runs at the data dtype
+    # (stats/prologue are f32 internally regardless — ops/pallas/
+    # conv_fused.py), so they cast like 'convolution'
+    "batch_norm_relu_conv1x1", "relu_conv1x1",
     "matmul", "linalg_gemm", "linalg_gemm2", "linalg_matmul", "tensordot",
     "inner", "outer", "kron", "einsum",
     "interleaved_matmul_selfatt_qk", "interleaved_matmul_selfatt_valatt",

@@ -242,7 +242,6 @@ def test_residual_stage_deferral_parity():
     yf, lf, gf = run("1", calls)
     yu, lu, gu = run("0")
     assert any(calls), "no fused kernel engaged in the stage"
-    assert any(c for c in calls), calls
     # relu-only heads (scale2 is None) prove the DEFERRED junction ran,
     # not just the in-body bn triple
     assert sum(1 for c in calls if c) >= 2, calls
@@ -277,6 +276,34 @@ def test_kernel_nondivisible_channels():
     onp.testing.assert_allclose(
         onp.asarray(dw), onp.asarray(jnp.einsum("nom,ncm->oc", dy, h)),
         rtol=1e-4, atol=1e-3)
+
+
+def test_amp_cast_policy_covers_fused_ops():
+    """Under amp.init, the fused junction must cast like the unfused
+    chain (data to the target dtype, like 'convolution') — toggling the
+    fusion knob may not change AMP dtype flow."""
+    from mxnet_tpu.amp.lists import TARGET_DTYPE_FUNCS
+    assert "batch_norm_relu_conv1x1" in TARGET_DTYPE_FUNCS
+    assert "relu_conv1x1" in TARGET_DTYPE_FUNCS
+
+    from mxnet_tpu import amp
+    x = mx.np.array(
+        onp.random.RandomState(6).randn(2, 4, 6, 6).astype("float32"))
+    outs = {}
+    for knob in ("1", "0"):
+        os.environ["MXNET_FUSE_BN_CONV"] = knob
+        try:
+            amp.init(target_dtype="bfloat16")
+            net = _bn_relu_conv_net(13)
+            y = net(x)
+            outs[knob] = y.asnumpy().astype("float32")
+        finally:
+            amp._STATE["active"] = False
+            from mxnet_tpu.ndarray.register import _amp_state
+            _amp_state["active"] = False
+            os.environ.pop("MXNET_FUSE_BN_CONV", None)
+            mx.npx.conv_fusion_enabled()
+    onp.testing.assert_allclose(outs["1"], outs["0"], rtol=2e-2, atol=2e-2)
 
 
 def test_bottleneck_resnet_slice_parity():
