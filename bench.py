@@ -207,6 +207,46 @@ def bench_lstm(batch: int, steps: int, dtype: str, seq_len: int) -> None:
         "vs_baseline": 0.0}))
 
 
+def bench_vit(batch: int, steps: int, dtype: str, img: int) -> None:
+    """Config 9 (beyond-reference): ViT-B/16 training, images/sec/chip —
+    the all-matmul vision model that rides the BERT attention path."""
+    import numpy as onp
+    import jax
+    import mxnet_tpu as mx
+    from mxnet_tpu.gluon.model_zoo.vision import vit_base_patch16
+    from mxnet_tpu.parallel import SPMDTrainer, make_mesh, \
+        DATA_PARALLEL_RULES
+
+    mx.random.seed(0)
+    net = vit_base_patch16(img_size=img, dropout=0.0)
+    net.initialize()
+    net(mx.np.zeros((2, 3, img, img), dtype="float32"))
+    if dtype != "float32":
+        net.cast(dtype)
+
+    loss_fn = mx.gluon.loss.SoftmaxCrossEntropyLoss()
+    mesh = make_mesh({"dp": 1}, devices=jax.devices()[:1])
+    trainer = SPMDTrainer(net, lambda o, l: loss_fn(o, l),
+                          optimizer="adamw",
+                          optimizer_params={"learning_rate": 1e-3},
+                          mesh=mesh, rules=DATA_PARALLEL_RULES)
+    rng = onp.random.RandomState(0)
+    x = mx.np.array(rng.randn(batch, 3, img, img).astype(dtype))
+    y = mx.np.array(rng.randint(0, 1000, (batch,)).astype("int32"))
+    float(trainer.step(x, y).asnumpy())
+    float(trainer.step(x, y).asnumpy())
+    t0 = time.perf_counter()
+    for _ in range(steps):
+        loss = trainer.step(x, y)
+    loss.asnumpy()
+    dt = time.perf_counter() - t0
+    img_s = batch * steps / dt
+    print(json.dumps({
+        "metric": f"vit_b16_{dtype}_b{batch}x{img}_train_throughput",
+        "value": round(img_s, 1), "unit": "images/sec/chip",
+        "vs_baseline": 0.0}))
+
+
 def _build_bench_pack(prefix: str, n_images: int, size: int,
                       fmt: str) -> str:
     """Synthetic im2rec-style pack, built once and cached (the bench
@@ -420,7 +460,7 @@ def run_all_configs() -> None:
     models, so no config inherits the previous one's memory pressure."""
     import subprocess
     failures = []
-    for model in ["bert", "gpt", "lstm", "resnet50_v1"]:
+    for model in ["bert", "gpt", "lstm", "vit", "resnet50_v1"]:
         env = dict(os.environ, MXNET_BENCH_MODEL=model)
         proc = subprocess.run([sys.executable, os.path.abspath(__file__)],
                               env=env, capture_output=True, text=True)
@@ -469,6 +509,8 @@ def main() -> None:
     if model_name.startswith("lstm"):
         return bench_lstm(batch, steps, dtype,
                           int(os.environ.get("MXNET_BENCH_SEQLEN", "35")))
+    if model_name.startswith("vit"):
+        return bench_vit(batch, steps, dtype, img)
     if os.environ.get("MXNET_BENCH_DATA", "synthetic") == "recordio":
         return bench_resnet_recordio(batch, steps, dtype, img, model_name)
 

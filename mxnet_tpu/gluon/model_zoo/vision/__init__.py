@@ -6,6 +6,8 @@ from .simple_nets import *  # noqa: F401,F403
 from .simple_nets import __all__ as _simple_all
 from .inception import *  # noqa: F401,F403
 from .inception import __all__ as _inception_all
+from .vit import *  # noqa: F401,F403
+from .vit import __all__ as _vit_all
 
 from ....base import MXNetError
 
@@ -28,6 +30,9 @@ _models = {
     "mobilenetv3_large": mobilenet_v3_large,
     "mobilenetv3_small": mobilenet_v3_small,
     "inceptionv3": inception_v3,
+    "vit_tiny_patch16": vit_tiny_patch16,
+    "vit_small_patch16": vit_small_patch16,
+    "vit_base_patch16": vit_base_patch16,
 }
 
 
@@ -41,4 +46,4 @@ def get_model(name: str, **kwargs):
 
 
 __all__ = (list(_resnet_all) + list(_simple_all) + list(_inception_all)
-           + ["get_model"])
+           + list(_vit_all) + ["get_model"])

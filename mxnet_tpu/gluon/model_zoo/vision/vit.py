@@ -1,0 +1,138 @@
+"""Vision Transformer (ViT) classification family.
+
+Beyond-reference model family (the reference's zoo predates ViT;
+``python/mxnet/gluon/model_zoo/vision`` stops at CNNs): ViT is the
+natural TPU citizen — the whole network is large batched matmuls, so it
+rides the same MXU-native attention path as BERT/GPT (flash kernels via
+``npx.multi_head_attention``, per-layer activation checkpointing under
+``MXNET_REMAT``).
+
+Architecture follows the original recipe (patchify-conv embedding, a
+learned class token + learned position embeddings, PRE-LayerNorm
+encoder blocks with GELU MLPs, classification off the class token).
+Factories: vit_tiny/small/base_patch16 (224 default, any multiple of
+the patch size works at construction time).
+"""
+from __future__ import annotations
+
+from typing import Any, Optional
+
+from .... import npx
+from .... import numpy as mxnp
+from ....ndarray import ops as ndops
+from ....ndarray.ndarray import NDArray
+from ...block import HybridBlock, remat_stack
+from ...nn import Conv2D, Dense, HybridSequential, LayerNorm
+from ...parameter import Parameter
+
+__all__ = ["VisionTransformer", "ViTEncoderLayer",
+           "vit_tiny_patch16", "vit_small_patch16", "vit_base_patch16"]
+
+
+class ViTEncoderLayer(HybridBlock):
+    """One pre-LN transformer block: x + attn(ln1(x)), x + mlp(ln2(x))."""
+
+    def __init__(self, units: int, hidden_size: int, num_heads: int,
+                 dropout: float = 0.0, layer_norm_eps: float = 1e-6,
+                 **kwargs: Any) -> None:
+        super().__init__(**kwargs)
+        self._num_heads = num_heads
+        self._dropout = dropout
+        self.ln1 = LayerNorm(epsilon=layer_norm_eps, in_channels=units)
+        self.attn_qkv = Dense(3 * units, in_units=units, flatten=False)
+        self.attn_out = Dense(units, in_units=units, flatten=False)
+        self.ln2 = LayerNorm(epsilon=layer_norm_eps, in_channels=units)
+        self.ffn1 = Dense(hidden_size, in_units=units, flatten=False)
+        self.ffn2 = Dense(units, in_units=hidden_size, flatten=False)
+
+    def forward(self, x: NDArray,
+                mask: Optional[NDArray] = None) -> NDArray:
+        h = self.ln1(x)
+        qkv = self.attn_qkv(h)
+        q, k, v = mxnp.split(qkv, 3, axis=-1)
+        att = npx.multi_head_attention(q, k, v, self._num_heads,
+                                       mask=mask, dropout=self._dropout)
+        att = self.attn_out(att)
+        if self._dropout:
+            att = npx.dropout(att, self._dropout)
+        x = x + att
+        h = self.ffn2(npx.gelu(self.ffn1(self.ln2(x))))
+        if self._dropout:
+            h = npx.dropout(h, self._dropout)
+        return x + h
+
+
+class VisionTransformer(HybridBlock):
+    """ViT classifier: patchify -> [cls | patches] + pos -> pre-LN
+    encoder stack -> final LN -> head(cls)."""
+
+    def __init__(self, img_size: int = 224, patch_size: int = 16,
+                 units: int = 768, num_layers: int = 12,
+                 num_heads: int = 12, hidden_size: int = 3072,
+                 classes: int = 1000, in_channels: int = 3,
+                 dropout: float = 0.0, layer_norm_eps: float = 1e-6,
+                 **kwargs: Any) -> None:
+        super().__init__(**kwargs)
+        if img_size % patch_size:
+            from ....base import MXNetError
+            raise MXNetError(f"img_size {img_size} not divisible by "
+                             f"patch_size {patch_size}")
+        self._units = units
+        self._dropout = dropout
+        self._num_patches = (img_size // patch_size) ** 2
+        self.patch_embed = Conv2D(units, kernel_size=patch_size,
+                                  strides=patch_size,
+                                  in_channels=in_channels)
+        self.cls_token = Parameter("cls_token", shape=(1, 1, units),
+                                   init="zeros")
+        self.pos_embed = Parameter(
+            "pos_embed", shape=(1, self._num_patches + 1, units),
+            init="normal")
+        self.blocks = HybridSequential()
+        for _ in range(num_layers):
+            self.blocks.add(ViTEncoderLayer(units, hidden_size, num_heads,
+                                            dropout, layer_norm_eps))
+        self.ln = LayerNorm(epsilon=layer_norm_eps, in_channels=units)
+        self.head = Dense(classes, in_units=units)
+
+    def forward(self, x: NDArray) -> NDArray:
+        for p in (self.cls_token, self.pos_embed):
+            if not p.is_initialized:
+                p._finish_deferred_init(p.shape)
+        B = x.shape[0]
+        h = self.patch_embed(x)                      # (B, C, H/p, W/p)
+        h = h.reshape(B, self._units, -1)            # (B, C, N)
+        h = mxnp.swapaxes(h, 1, 2)                   # (B, N, C)
+        cls = mxnp.broadcast_to(self.cls_token.data(),
+                                (B, 1, self._units))
+        h = mxnp.concatenate([cls, h], axis=1)
+        h = h + self.pos_embed.data()
+        if self._dropout:
+            h = npx.dropout(h, self._dropout)
+        # per-layer activation checkpointing under MXNET_REMAT, same as
+        # the BERT/GPT encoders
+        h = remat_stack(list(self.blocks), h, None,
+                        dropout=self._dropout)
+        h = self.ln(h)
+        return self.head(ndops.slice_axis(h, axis=1, begin=0, end=1)
+                         .reshape(B, self._units))
+
+
+def _vit(units, num_layers, num_heads, hidden_size, **kw):
+    kw.setdefault("units", units)
+    kw.setdefault("num_layers", num_layers)
+    kw.setdefault("num_heads", num_heads)
+    kw.setdefault("hidden_size", hidden_size)
+    return VisionTransformer(**kw)
+
+
+def vit_tiny_patch16(**kw):
+    return _vit(192, 12, 3, 768, **kw)
+
+
+def vit_small_patch16(**kw):
+    return _vit(384, 12, 6, 1536, **kw)
+
+
+def vit_base_patch16(**kw):
+    return _vit(768, 12, 12, 3072, **kw)

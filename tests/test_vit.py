@@ -1,0 +1,140 @@
+"""Vision Transformer family (gluon/model_zoo/vision/vit.py) — shapes,
+training convergence, hybridize parity, checkpoint roundtrip, remat,
+and SPMD dp x tp sharding (the blocks reuse the BERT layer parameter
+names, so DEFAULT_TRANSFORMER_RULES apply unchanged)."""
+import os
+
+import numpy as onp
+import pytest
+
+import mxnet_tpu as mx
+from mxnet_tpu import autograd
+from mxnet_tpu.gluon.model_zoo.vision import get_model
+from mxnet_tpu.gluon.model_zoo.vision.vit import (VisionTransformer,
+                                                  vit_tiny_patch16)
+
+
+def _tiny(classes=5, **kw):
+    kw.setdefault("img_size", 32)
+    kw.setdefault("patch_size", 8)
+    kw.setdefault("units", 32)
+    kw.setdefault("num_layers", 2)
+    kw.setdefault("num_heads", 2)
+    kw.setdefault("hidden_size", 64)
+    return VisionTransformer(classes=classes, **kw)
+
+
+def test_forward_shapes_and_registry():
+    mx.random.seed(0)
+    net = _tiny()
+    net.initialize()
+    x = mx.np.array(onp.random.RandomState(0)
+                    .randn(3, 3, 32, 32).astype("float32"))
+    y = net(x)
+    assert y.shape == (3, 5)
+    # factories + zoo registry
+    z = get_model("vit_tiny_patch16", img_size=32, classes=4)
+    z.initialize()
+    assert z(x).shape == (3, 4)
+    with pytest.raises(mx.MXNetError):
+        vit_tiny_patch16(img_size=30)   # not divisible by patch
+
+
+def test_trains_to_convergence():
+    mx.random.seed(1)
+    net = _tiny(classes=4)
+    net.initialize()
+    net.hybridize()
+    L = mx.gluon.loss.SoftmaxCrossEntropyLoss()
+    tr = mx.gluon.Trainer(net.collect_params(), "adamw",
+                          {"learning_rate": 1e-3})
+    rng = onp.random.RandomState(2)
+    x = mx.np.array(rng.randn(8, 3, 32, 32).astype("float32"))
+    y = mx.np.array(rng.randint(0, 4, (8,)).astype("int32"))
+    losses = []
+    for _ in range(20):
+        with autograd.record():
+            loss = L(net(x), y).mean()
+        loss.backward()
+        tr.step(8)
+        losses.append(float(loss.asnumpy()))
+    assert losses[-1] < 0.5 * losses[0], losses
+
+
+def test_hybridize_matches_imperative_and_roundtrips(tmp_path):
+    mx.random.seed(3)
+    net = _tiny()
+    net.initialize()
+    x = mx.np.array(onp.random.RandomState(4)
+                    .randn(2, 3, 32, 32).astype("float32"))
+    y_imp = net(x).asnumpy()
+    net.hybridize()
+    y_hyb = net(x).asnumpy()
+    onp.testing.assert_allclose(y_imp, y_hyb, rtol=1e-5, atol=1e-5)
+    p = str(tmp_path / "vit.params")
+    net.save_parameters(p)
+    net2 = _tiny()
+    net2.initialize()
+    net2.load_parameters(p)
+    onp.testing.assert_allclose(net2(x).asnumpy(), y_imp,
+                                rtol=1e-5, atol=1e-5)
+
+
+def test_remat_loss_exact():
+    """MXNET_REMAT per-layer checkpointing must not change the loss."""
+    x = onp.random.RandomState(5).randn(2, 3, 32, 32).astype("float32")
+    y = onp.random.RandomState(6).randint(0, 5, (2,)).astype("int32")
+    L = mx.gluon.loss.SoftmaxCrossEntropyLoss()
+
+    def run(remat):
+        os.environ["MXNET_REMAT"] = remat
+        try:
+            mx.random.seed(7)
+            net = _tiny()
+            net.initialize()
+            net.hybridize()
+            with autograd.record():
+                loss = L(net(mx.np.array(x)), mx.np.array(y)).mean()
+            loss.backward()
+            g = {k: p.grad().asnumpy()
+                 for k, p in net.collect_params().items()}
+            return float(loss.asnumpy()), g
+        finally:
+            os.environ.pop("MXNET_REMAT", None)
+
+    l1, g1 = run("1")
+    l0, g0 = run("0")
+    assert abs(l1 - l0) < 1e-6
+    for k in g0:
+        onp.testing.assert_allclose(g1[k], g0[k], rtol=1e-4, atol=1e-5)
+
+
+@pytest.mark.host_mesh
+def test_spmd_dp_tp_training():
+    """ViT trains under SPMDTrainer on a dp x tp mesh with the standard
+    transformer rules (same parameter names as the BERT layers)."""
+    import jax
+    from jax.sharding import PartitionSpec as P
+    from mxnet_tpu.parallel import (SPMDTrainer, make_mesh,
+                                    DEFAULT_TRANSFORMER_RULES)
+    mesh = make_mesh({"dp": 2, "tp": 2}, devices=jax.devices("cpu")[:4])
+    mx.random.seed(8)
+    net = _tiny(classes=4)
+    net.initialize()
+    warm = mx.np.zeros((2, 3, 32, 32), dtype="float32")
+    net(warm)
+    L = mx.gluon.loss.SoftmaxCrossEntropyLoss()
+    trainer = SPMDTrainer(net, lambda o, l: L(o, l),
+                          optimizer="adamw",
+                          optimizer_params={"learning_rate": 1e-3},
+                          mesh=mesh, rules=DEFAULT_TRANSFORMER_RULES,
+                          data_spec=P("dp"), label_spec=P("dp"))
+    rng = onp.random.RandomState(9)
+    x = mx.np.array(rng.randn(8, 3, 32, 32).astype("float32"))
+    y = mx.np.array(rng.randint(0, 4, (8,)).astype("int32"))
+    l1 = float(trainer.step(x, y).asnumpy())
+    l2 = float(trainer.step(x, y).asnumpy())
+    assert onp.isfinite(l1) and l2 < l1, (l1, l2)
+    # tp actually shards the qkv projection
+    qkv = net.blocks[0].attn_qkv.weight.data()._data
+    assert len(qkv.devices()) == 4
