@@ -1,10 +1,11 @@
 """Benchmark driver hook: prints one JSON line PER HEADLINE CONFIG.
 
-Default invocation (no MXNET_BENCH_MODEL) runs all four headline configs
-— BERT MLM, GPT, LSTM-PTB, then ResNet-50 LAST (the driver parses the
-last line as the metric of record, keeping config 2 continuous with
-prior rounds).  Each model runs in a fresh subprocess so HBM resets
-between configs.  Setting MXNET_BENCH_MODEL runs that single config.
+Default invocation (no MXNET_BENCH_MODEL) runs the five headline
+configs — BERT MLM, GPT, LSTM-PTB, ViT-B/16, then ResNet-50 LAST (the
+driver parses the last line as the metric of record, keeping config 2
+continuous with prior rounds).  Each model runs in a fresh subprocess
+so HBM resets between configs.  Setting MXNET_BENCH_MODEL runs that
+single config.
 
 Config 2 (BASELINE.md): ResNet-50 ImageNet-shape training throughput,
 images/sec/chip — hybridized fwd+bwd+update as one compiled XLA program
@@ -17,7 +18,8 @@ BASELINE.md provenance note).
 Env knobs: MXNET_BENCH_BATCH (default 128), MXNET_BENCH_STEPS (default 40 —
 short timed loops under-report: the ~120ms tunnel sync round-trip plus
 dispatch tails are fixed costs inside the timed region, ~26% at 10 steps),
-MXNET_BENCH_MODEL (resnet50_v1|bert|gpt|lstm), MXNET_BENCH_DTYPE
+MXNET_BENCH_MODEL (resnet50_v1|bert|gpt|lstm|vit),
+MXNET_BENCH_BERT_ARCH (base|large — BASELINE row 3c), MXNET_BENCH_DTYPE
 (default bfloat16), MXNET_BENCH_IMAGE (224), MXNET_BENCH_SEQLEN,
 MXNET_BENCH_DATA (synthetic|recordio — recordio feeds the model through
 the REAL IO stack: an im2rec-style pack read by the native C++
@@ -45,8 +47,14 @@ def bench_bert(batch: int, steps: int, dtype: str, seq_len: int) -> None:
 
     vocab = 30522
     n_mask = max(1, int(seq_len * 0.15))     # standard 15% MLM masking
+    arch = os.environ.get("MXNET_BENCH_BERT_ARCH", "base")
+    arches = {"base": "bert_12_768_12", "large": "bert_24_1024_16"}
+    if arch not in arches:
+        raise SystemExit(f"MXNET_BENCH_BERT_ARCH={arch!r}: "
+                         f"choose from {sorted(arches)}")
+    arch_name = arches[arch]
     mx.random.seed(0)
-    net = get_bert("bert_12_768_12", vocab_size=vocab, dropout=0.0,
+    net = get_bert(arch_name, vocab_size=vocab, dropout=0.0,
                    max_length=max(512, seq_len),
                    use_pooler=False, use_decoder=True,
                    use_classifier=False)
@@ -106,7 +114,7 @@ def bench_bert(batch: int, steps: int, dtype: str, seq_len: int) -> None:
         dt = time.perf_counter() - t0
         tok_s = batch * seq_len * steps / dt
     print(json.dumps({
-        "metric": f"bert_base_mlm_{dtype}_b{batch}x{seq_len}_train",
+        "metric": f"bert_{arch}_mlm_{dtype}_b{batch}x{seq_len}_train",
         "value": round(tok_s, 1), "unit": "tokens/sec/chip",
         "vs_baseline": 0.0}))
 
@@ -453,9 +461,9 @@ def bench_resnet_recordio(batch: int, steps: int, dtype: str, img: int,
 
 def run_all_configs() -> None:
     """Default driver mode (VERDICT r4 directive 5): one invocation
-    emits ALL FOUR headline configs — bert, gpt, lstm, then resnet50
-    LAST so the driver's last-line parse keeps the metric of record
-    continuous with prior rounds.  Each model runs in its own
+    emits all five headline configs — bert, gpt, lstm, vit (r5), then
+    resnet50 LAST so the driver's last-line parse keeps the metric of
+    record continuous with prior rounds.  Each model runs in its own
     subprocess: the chip's HBM and the compile cache reset between
     models, so no config inherits the previous one's memory pressure."""
     import subprocess

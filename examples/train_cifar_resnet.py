@@ -33,15 +33,23 @@ def main():
 
     from mxnet_tpu.gluon.model_zoo import vision as zoo
     mx.random.seed(0)
-    net = zoo.get_model(args.model, classes=10)
+    kw = {}
+    if args.model.startswith("vit"):
+        # ViT needs the position table sized at construction
+        kw = {"img_size": 32, "patch_size": 4}
+    net = zoo.get_model(args.model, classes=10, **kw)
     net.initialize()
     net(mx.np.zeros((1, 3, 32, 32)))
     if args.dtype != "float32":
         net.cast(args.dtype)
 
     mesh = make_mesh({"dp": 1}, devices=jax.devices()[:1])
+    if args.model.startswith("vit"):
+        opt, opt_args = "adamw", {"learning_rate": 1e-3}
+    else:
+        opt, opt_args = "sgd", {"learning_rate": 0.1, "momentum": 0.9}
     trainer = SPMDTrainer(net, mx.gluon.loss.SoftmaxCrossEntropyLoss(),
-                          "sgd", {"learning_rate": 0.1, "momentum": 0.9},
+                          opt, opt_args,
                           mesh=mesh, rules=DATA_PARALLEL_RULES)
 
     if args.rec:
