@@ -501,7 +501,11 @@ def main() -> None:
     img = int(os.environ.get("MXNET_BENCH_IMAGE", "224"))
 
     if model_name.startswith("bert"):
-        if "MXNET_BENCH_BATCH" not in os.environ:
+        if os.environ.get("MXNET_BENCH_BERT_ARCH", "base") == "large" \
+                and "MXNET_BENCH_BATCH" not in os.environ:
+            batch = 16   # measured best fit (BASELINE row 3c); b48 is
+            #              ~base-b128-equivalent and OOMs
+        elif "MXNET_BENCH_BATCH" not in os.environ:
             # measured best config (BASELINE 3, r4): b48 runs 143.9k
             # tok/s; the old b128 default OOMs in the r4 terminal env
             # (90 MB over; r3's own commit reproduces the OOM)

@@ -12,6 +12,7 @@ shapes; fwd and fwd+bwd arms, fused vs unfused.
 
 Usage: python benchmark/fused_conv_probe.py [batch]
 """
+import os
 import sys
 import time
 
@@ -19,7 +20,7 @@ import jax
 import jax.numpy as jnp
 from jax import lax
 
-sys.path.insert(0, "/root/repo")
+sys.path.insert(0, os.path.dirname(os.path.dirname(os.path.abspath(__file__))))
 from mxnet_tpu.ops.pallas.conv_fused import fused_prologue_conv1x1
 
 # (Ci, Co, HW) at b128 — junction 3 (affine+relu) then junction 1 (relu)
