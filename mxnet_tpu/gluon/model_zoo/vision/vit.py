@@ -34,10 +34,16 @@ class ViTEncoderLayer(HybridBlock):
 
     def __init__(self, units: int, hidden_size: int, num_heads: int,
                  dropout: float = 0.0, layer_norm_eps: float = 1e-6,
+                 gelu_approximate: bool = True,
                  **kwargs: Any) -> None:
         super().__init__(**kwargs)
         self._num_heads = num_heads
         self._dropout = dropout
+        # tanh-approx GELU by default (the flax-ViT convention): the
+        # exact-erf backward measures ~2 ms/block at B=128 T=197 on v5e
+        # (~15% of the whole train step); there is no pretrained-weight
+        # parity at stake in this zoo, so fast is the right default
+        self._gelu_approximate = gelu_approximate
         self.ln1 = LayerNorm(epsilon=layer_norm_eps, in_channels=units)
         self.attn_qkv = Dense(3 * units, in_units=units, flatten=False)
         self.attn_out = Dense(units, in_units=units, flatten=False)
@@ -56,7 +62,8 @@ class ViTEncoderLayer(HybridBlock):
         if self._dropout:
             att = npx.dropout(att, self._dropout)
         x = x + att
-        h = self.ffn2(npx.gelu(self.ffn1(self.ln2(x))))
+        h = self.ffn2(npx.gelu(self.ffn1(self.ln2(x)),
+                               approximate=self._gelu_approximate))
         if self._dropout:
             h = npx.dropout(h, self._dropout)
         return x + h
@@ -71,6 +78,7 @@ class VisionTransformer(HybridBlock):
                  num_heads: int = 12, hidden_size: int = 3072,
                  classes: int = 1000, in_channels: int = 3,
                  dropout: float = 0.0, layer_norm_eps: float = 1e-6,
+                 gelu_approximate: bool = True,
                  **kwargs: Any) -> None:
         super().__init__(**kwargs)
         if img_size % patch_size:
@@ -91,7 +99,8 @@ class VisionTransformer(HybridBlock):
         self.blocks = HybridSequential()
         for _ in range(num_layers):
             self.blocks.add(ViTEncoderLayer(units, hidden_size, num_heads,
-                                            dropout, layer_norm_eps))
+                                            dropout, layer_norm_eps,
+                                            gelu_approximate))
         self.ln = LayerNorm(epsilon=layer_norm_eps, in_channels=units)
         self.head = Dense(classes, in_units=units)
 
