@@ -293,6 +293,35 @@ def test_kernel_nondivisible_channels():
         rtol=1e-4, atol=1e-3)
 
 
+def test_npx_op_contracts():
+    """The npx-level fused ops reject non-NCHW ranks with MXNetError,
+    and the knob resolver honors explicit 0/1 and 'auto' semantics."""
+    x3 = mx.np.zeros((2, 4, 8))
+    w = mx.np.zeros((6, 4, 1, 1))
+    with pytest.raises(mx.MXNetError):
+        mx.npx.relu_conv1x1(x3, w)
+    with pytest.raises(mx.MXNetError):
+        mx.npx.batch_norm_relu_conv1x1(
+            x3, mx.np.ones((4,)), mx.np.zeros((4,)),
+            mx.np.zeros((4,)), mx.np.ones((4,)), w)
+    for val, want in (("0", False), ("1", True)):
+        os.environ["MXNET_FUSE_BN_CONV"] = val
+        try:
+            assert mx.npx.conv_fusion_enabled() is want
+        finally:
+            os.environ.pop("MXNET_FUSE_BN_CONV", None)
+    # 'auto' = single-device TPU backend only (off on the CPU virtual
+    # mesh, ON under the single-chip tpu-unit gate)
+    want_auto = (jax.default_backend() == "tpu"
+                 and jax.device_count() == 1)
+    os.environ["MXNET_FUSE_BN_CONV"] = "auto"
+    try:
+        assert mx.npx.conv_fusion_enabled() is want_auto
+    finally:
+        os.environ.pop("MXNET_FUSE_BN_CONV", None)
+        mx.npx.conv_fusion_enabled()
+
+
 def test_amp_cast_policy_covers_fused_ops():
     """Under amp.init, the fused junction must cast like the unfused
     chain (data to the target dtype, like 'convolution') — toggling the
