@@ -36,6 +36,29 @@ sys.path.insert(0, os.path.dirname(os.path.abspath(__file__)))
 BASELINE_IMG_S = 300.0  # midpoint of BASELINE.md sanity band (unverified)
 
 
+def _metrics_mark():
+    """Snapshot the step-phase histogram sums before a timed loop."""
+    from mxnet_tpu import metrics
+    return (metrics.hist_stats("mxnet_step_data_seconds")[0],
+            metrics.hist_stats("mxnet_step_dispatch_seconds")[0])
+
+
+def _step_breakdown(mark, dt, steps):
+    """Per-step {data, dispatch, sync} seconds over a timed loop of
+    ``steps`` steps taking ``dt`` wall seconds.  data/dispatch come from
+    the trainer's runtime-metrics histograms (mxnet_step_*_seconds);
+    sync is the remainder — the device-execution tail the end-of-loop
+    loss fetch blocks on.  The three components sum to dt/steps."""
+    d1, p1 = _metrics_mark()
+    data = max(d1 - mark[0], 0.0) / steps
+    disp = max(p1 - mark[1], 0.0) / steps
+    per = dt / steps
+    return {"data_s": round(data, 6),
+            "dispatch_s": round(disp, 6),
+            "sync_s": round(max(per - data - disp, 0.0), 6),
+            "step_s": round(per, 6)}
+
+
 def bench_bert(batch: int, steps: int, dtype: str, seq_len: int) -> None:
     """Config 3: BERT-base MLM step throughput, tokens/sec/chip."""
     import numpy as onp
@@ -96,27 +119,31 @@ def bench_bert(batch: int, steps: int, dtype: str, seq_len: int) -> None:
         trainer.run_steps(xk, yk).asnumpy()
         trainer.run_steps(xk, yk).asnumpy()
         n_calls = max(1, steps // multistep)
+        m0 = _metrics_mark()
         t0 = time.perf_counter()
         for _ in range(n_calls):
             losses = trainer.run_steps(xk, yk)
         losses.asnumpy()
         dt = time.perf_counter() - t0
+        breakdown = _step_breakdown(m0, dt, multistep * n_calls)
         tok_s = batch * seq_len * multistep * n_calls / dt
     else:
         # two warmup steps: the first compiles, the second recompiles
         # with the donated buffers' optimized on-device layouts
         float(trainer.step(x, y).asnumpy())
         float(trainer.step(x, y).asnumpy())
+        m0 = _metrics_mark()
         t0 = time.perf_counter()
         for _ in range(steps):
             loss = trainer.step(x, y)
         loss.asnumpy()
         dt = time.perf_counter() - t0
+        breakdown = _step_breakdown(m0, dt, steps)
         tok_s = batch * seq_len * steps / dt
     print(json.dumps({
         "metric": f"bert_{arch}_mlm_{dtype}_b{batch}x{seq_len}_train",
         "value": round(tok_s, 1), "unit": "tokens/sec/chip",
-        "vs_baseline": 0.0}))
+        "vs_baseline": 0.0, "step_breakdown": breakdown}))
 
 
 def bench_gpt(batch: int, steps: int, dtype: str, seq_len: int) -> None:
@@ -150,6 +177,7 @@ def bench_gpt(batch: int, steps: int, dtype: str, seq_len: int) -> None:
                     .astype("int32"))
     float(trainer.step(x, y).asnumpy())
     float(trainer.step(x, y).asnumpy())
+    m0 = _metrics_mark()
     t0 = time.perf_counter()
     for _ in range(steps):
         loss = trainer.step(x, y)
@@ -159,7 +187,8 @@ def bench_gpt(batch: int, steps: int, dtype: str, seq_len: int) -> None:
     print(json.dumps({
         "metric": f"gpt2_124m_lm_{dtype}_b{batch}x{seq_len}_train",
         "value": round(tok_s, 1), "unit": "tokens/sec/chip",
-        "vs_baseline": 0.0}))
+        "vs_baseline": 0.0,
+        "step_breakdown": _step_breakdown(m0, dt, steps)}))
 
 
 def bench_lstm(batch: int, steps: int, dtype: str, seq_len: int) -> None:
@@ -203,6 +232,7 @@ def bench_lstm(batch: int, steps: int, dtype: str, seq_len: int) -> None:
                     .astype("int32"))
     float(trainer.step(x, y).asnumpy())
     float(trainer.step(x, y).asnumpy())
+    m0 = _metrics_mark()
     t0 = time.perf_counter()
     for _ in range(steps):
         loss = trainer.step(x, y)
@@ -212,7 +242,8 @@ def bench_lstm(batch: int, steps: int, dtype: str, seq_len: int) -> None:
     print(json.dumps({
         "metric": f"lstm_ptb_{dtype}_b{batch}x{seq_len}_train",
         "value": round(tok_s, 1), "unit": "tokens/sec/chip",
-        "vs_baseline": 0.0}))
+        "vs_baseline": 0.0,
+        "step_breakdown": _step_breakdown(m0, dt, steps)}))
 
 
 def bench_vit(batch: int, steps: int, dtype: str, img: int) -> None:
@@ -243,6 +274,7 @@ def bench_vit(batch: int, steps: int, dtype: str, img: int) -> None:
     y = mx.np.array(rng.randint(0, 1000, (batch,)).astype("int32"))
     float(trainer.step(x, y).asnumpy())
     float(trainer.step(x, y).asnumpy())
+    m0 = _metrics_mark()
     t0 = time.perf_counter()
     for _ in range(steps):
         loss = trainer.step(x, y)
@@ -252,7 +284,8 @@ def bench_vit(batch: int, steps: int, dtype: str, img: int) -> None:
     print(json.dumps({
         "metric": f"vit_b16_{dtype}_b{batch}x{img}_train_throughput",
         "value": round(img_s, 1), "unit": "images/sec/chip",
-        "vs_baseline": 0.0}))
+        "vs_baseline": 0.0,
+        "step_breakdown": _step_breakdown(m0, dt, steps)}))
 
 
 def _build_bench_pack(prefix: str, n_images: int, size: int,
@@ -435,10 +468,17 @@ def bench_resnet_recordio(batch: int, steps: int, dtype: str, img: int,
         x_np, y_np = batch_np
         return (jax.device_put(x_np, dev), jax.device_put(y_np, dev))
 
+    from mxnet_tpu import metrics as _metrics
     cur = _put(fed.get())
+    m0 = _metrics_mark()
     t0 = time.perf_counter()
     for _ in range(steps):
+        td = time.perf_counter()
         nxt = _put(fed.get())          # start batch k+1's H2D ...
+        # the trainer can't see this wait (it receives device-resident
+        # arrays), so account the loader fetch + upload as data here —
+        # without it the breakdown folds loader stalls into sync_s
+        _metrics.STEP_DATA_SECONDS.observe(time.perf_counter() - td)
         loss = trainer.step(mx.np.array(cur[0]),
                             mx.np.array(cur[1]))  # ... under step k
         cur = nxt
@@ -456,6 +496,7 @@ def bench_resnet_recordio(batch: int, steps: int, dtype: str, img: int,
         "unit": "images/sec/chip",
         "vs_baseline": round(img_per_sec / BASELINE_IMG_S, 3),
         "loader_img_s": round(loader_img_s, 1),
+        "step_breakdown": _step_breakdown(m0, dt, steps),
     }))
 
 
@@ -566,6 +607,7 @@ def main() -> None:
     # timed: pipelined async step dispatches, one sync at the end.
     # (A fused lax.scan variant — trainer.run_steps — measured SLOWER
     # here: holding `steps` input batches on-device raises HBM pressure.)
+    m0 = _metrics_mark()
     t0 = time.perf_counter()
     for _ in range(steps):
         loss = trainer.step(x, y)
@@ -578,6 +620,7 @@ def main() -> None:
         "value": round(img_per_sec, 2),
         "unit": "images/sec/chip",
         "vs_baseline": round(img_per_sec / BASELINE_IMG_S, 3),
+        "step_breakdown": _step_breakdown(m0, dt, steps),
     }))
 
 

@@ -4,6 +4,9 @@
 # point. Usage:
 #
 #   ci/run.sh native        # build libmxtpu.so + run the C++ test binary
+#   ci/run.sh tier1         # docs-freshness gate + the tier-1 pytest
+#                           #   selection (the driver's acceptance run)
+#   ci/run.sh envdoc        # docs/env_vars.md staleness check alone
 #   ci/run.sh unit          # full Python suite on the 8-dev virtual mesh
 #   ci/run.sh dist          # real multi-process launcher tests
 #   ci/run.sh exec-cache    # suite subset with the per-op executable
@@ -33,6 +36,24 @@ run_native() {
   echo "== native: build libmxtpu.so + C++ tests"
   make -C src
   make -C src test
+}
+
+run_envdoc() {
+  echo "== envdoc: docs/env_vars.md must match the registered surface"
+  python tools/gen_env_doc.py
+  if ! git diff --exit-code -- docs/env_vars.md; then
+    echo "docs/env_vars.md is STALE: a module registered/changed an env" >&2
+    echo "var without regenerating — run 'python tools/gen_env_doc.py'" >&2
+    echo "and commit the result" >&2
+    exit 1
+  fi
+}
+
+run_tier1() {
+  echo "== tier1: env-doc freshness + the tier-1 pytest selection"
+  run_envdoc
+  JAX_PLATFORMS=cpu python -m pytest tests/ -q -m 'not slow' \
+    --continue-on-collection-errors -p no:cacheprovider
 }
 
 run_unit() {
@@ -117,6 +138,8 @@ run_tpu_unit_batched() {
 
 case "$variant" in
   native)       run_native ;;
+  tier1)        run_tier1 ;;
+  envdoc)       run_envdoc ;;
   unit)         run_unit ;;
   dist)         run_dist ;;
   exec-cache)   run_exec_cache ;;
@@ -128,6 +151,7 @@ case "$variant" in
   tpu-unit-batched) run_tpu_unit_batched ;;
   all)
     run_native
+    run_envdoc
     run_unit
     run_dist
     run_exec_cache

@@ -44,6 +44,7 @@ _LAZY = {
     "gluon": ".gluon",
     "optimizer": ".optimizer",
     "metric": ".metric",
+    "metrics": ".metrics",
     "initializer": ".initializer",
     "init": ".initializer",
     "kvstore": ".kvstore",

@@ -23,11 +23,13 @@ at sync points, matching the reference's rethrow-at-sync behavior
 """
 from __future__ import annotations
 
+import time
 import weakref
 from typing import Any, Dict, Iterable
 
 import jax
 
+from . import metrics as _metrics
 from .base import MXNetError, getenv
 
 __all__ = ["waitall", "is_naive", "set_bulk_size", "bulk",
@@ -55,6 +57,9 @@ def _weak_register(registry: Dict[int, "weakref.ref"], arr: Any) -> None:
     if len(registry) > _SWEEP_AT:
         for k in [k for k, r in registry.items() if r() is None]:
             del registry[k]
+        _metrics.ENGINE_SWEEPS.inc()
+    if registry is _LIVE:
+        _metrics.ENGINE_LIVE_BUFFERS.set(len(registry))
 
 
 def track(arr: Any) -> Any:
@@ -72,6 +77,7 @@ def _sync_and_translate(arr: Any) -> Any:
     except MXNetError:
         raise
     except Exception as exc:  # XLA raises XlaRuntimeError and friends
+        _metrics.ENGINE_SYNC_ERRORS.inc()
         raise MXNetError(str(exc)) from exc
 
 
@@ -137,11 +143,17 @@ def launder(arrays):
 
 def waitall() -> None:
     """Block until all pushed device work completes (``mx.nd.waitall``)."""
-    for key, ref in list(_LIVE.items()):
-        arr = ref()
-        if arr is not None:
-            _sync_and_translate(arr)
-        _LIVE.pop(key, None)
+    t0 = time.perf_counter()
+    try:
+        for key, ref in list(_LIVE.items()):
+            arr = ref()
+            if arr is not None:
+                _sync_and_translate(arr)
+            _LIVE.pop(key, None)
+    finally:
+        _metrics.ENGINE_WAITALL.inc()
+        _metrics.ENGINE_WAITALL_SECONDS.observe(time.perf_counter() - t0)
+        _metrics.ENGINE_LIVE_BUFFERS.set(len(_LIVE))
 
 
 def wait(arrs: Iterable[Any]) -> None:

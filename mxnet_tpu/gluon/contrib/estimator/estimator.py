@@ -112,12 +112,27 @@ class Estimator:
         for h in train_begin:
             h.train_begin(self)
 
+        import time
+        from .... import metrics as _metrics
+
         stop = False
         while not stop:
             for h in epoch_begin:
                 h.epoch_begin(self)
-            for batch in train_data:
+            # explicit iteration so the loader wait is a measured phase:
+            # per-step time splits into data-wait (next(it)), dispatch
+            # (forward/backward/update — returns with device work still
+            # in flight), and device-sync (batch_end handlers fetch loss
+            # and update metrics, blocking on results)
+            it = iter(train_data)
+            while True:
+                t0 = time.perf_counter()
+                try:
+                    batch = next(it)
+                except StopIteration:
+                    break
                 data, label = _as_nd(batch[0]), _as_nd(batch[1])
+                t_data = time.perf_counter()
                 for h in batch_begin:
                     h.batch_begin(self, batch=batch)
                 with autograd.record():
@@ -125,10 +140,17 @@ class Estimator:
                     loss = self.loss(pred, label)
                 loss.backward()
                 self.trainer.step(data.shape[0])
+                t_dispatch = time.perf_counter()
                 for h in batch_end:
                     if h.batch_end(self, batch=batch, pred=pred,
                                    label=label, loss=loss):
                         stop = True
+                t_end = time.perf_counter()
+                _metrics.record_step(t_end - t0,
+                                     data=t_data - t0,
+                                     dispatch=t_dispatch - t_data,
+                                     sync=t_end - t_dispatch)
+                _metrics.record_device_highwater()
                 if stop:
                     break
             for h in epoch_end:

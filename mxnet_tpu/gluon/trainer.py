@@ -214,6 +214,15 @@ class Trainer:
 
     def step(self, batch_size: int, ignore_stale_grad: bool = False) -> None:
         """Rescale grads by 1/batch_size and apply one optimizer update."""
+        import time
+        from .. import metrics as _metrics
+        t0 = time.perf_counter()
+        try:
+            self._step_impl(batch_size, ignore_stale_grad)
+        finally:
+            _metrics.TRAINER_STEP_SECONDS.observe(time.perf_counter() - t0)
+
+    def _step_impl(self, batch_size: int, ignore_stale_grad: bool) -> None:
         self._optimizer.rescale_grad = self._scale / batch_size
         if not self._kv_initialized:
             self._init_kvstore()

@@ -24,9 +24,12 @@ from __future__ import annotations
 
 from typing import Any, Callable, Dict, List, Optional, Sequence, Union
 
+import time
+
 import jax
 import jax.numpy as jnp
 
+from . import metrics as _metrics
 from .base import MXNetError, getenv, register_env
 from .ndarray.ndarray import NDArray
 
@@ -133,6 +136,16 @@ class KVStore:
 
     def push(self, key: Any, value: Union[NDArray, Sequence[NDArray]],
              priority: int = 0) -> None:
+        _metrics.KVSTORE_PUSHES.inc()
+        t0 = time.perf_counter()
+        try:
+            self._push(key, value, priority)
+        finally:
+            _metrics.COLLECTIVE_SECONDS.labels(collective="push") \
+                .observe(time.perf_counter() - t0)
+
+    def _push(self, key: Any, value: Union[NDArray, Sequence[NDArray]],
+              priority: int = 0) -> None:
         keys, vals = self._pair(key, value)
         # on the multi-host store the codec is applied at the wire (the
         # packed collective in _reduce_flat_compressed) — compressing
@@ -395,12 +408,20 @@ class KVStoreICI(KVStore):
             arrs = [jnp.asarray(vals[i]._data) for i in idxs]
             flat = arrs[0].ravel() if len(arrs) == 1 else \
                 jnp.concatenate([a.ravel() for a in arrs])
+            t0 = time.perf_counter()
             if ctype:
                 segs = [(keys[i], int(vals[i].size)) for i in idxs]
                 red = self._reduce_flat_compressed(flat, ctype, segs)
             else:
                 red = self._reduce_flat(flat)
             self.reduce_collectives += 1
+            _metrics.COLLECTIVE_CALLS.labels(
+                collective="allreduce", traced="0").inc()
+            _metrics.COLLECTIVE_BYTES.labels(
+                collective="allreduce", traced="0").inc(
+                int(flat.size) * flat.dtype.itemsize)
+            _metrics.COLLECTIVE_SECONDS.labels(
+                collective="allreduce").observe(time.perf_counter() - t0)
             off = 0
             for i, a in zip(idxs, arrs):
                 piece = red[off:off + a.size].reshape(a.shape)
@@ -507,9 +528,23 @@ class KVStoreICI(KVStore):
         sit inside the mesh collective, deadlocking the job on mismatched
         collective sequences. A probe failure is a deterministic property
         of the environment, so every rank reaches the same verdict."""
-        from jax.experimental import multihost_utils
+        t0 = time.perf_counter()
         for p in payloads:
-            self.reduce_wire_bytes += int(p.size) * p.dtype.itemsize
+            nbytes = int(p.size) * p.dtype.itemsize
+            self.reduce_wire_bytes += nbytes
+            _metrics.COLLECTIVE_BYTES.labels(
+                collective="allgather", traced="0").inc(nbytes)
+        _metrics.COLLECTIVE_CALLS.labels(
+            collective="allgather", traced="0").inc()
+        try:
+            return self._gather_decode_sum_impl(payloads, decode,
+                                                cache_key)
+        finally:
+            _metrics.COLLECTIVE_SECONDS.labels(
+                collective="allgather").observe(time.perf_counter() - t0)
+
+    def _gather_decode_sum_impl(self, payloads, decode, cache_key):
+        from jax.experimental import multihost_utils
         if self._use_mesh_reduce is None:
             try:
                 self._mesh_probe()

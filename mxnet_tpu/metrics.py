@@ -1,0 +1,721 @@
+"""Runtime metrics — process-wide counters, gauges, and histograms.
+
+NEW capability beyond the reference (no leezu/mxnet analog): the
+reference's observability stops at the profiler (one traced window) and
+``Monitor`` (per-op stats for one tic/toc span).  Neither answers "what
+has the runtime been doing over this whole training run" — recompiles,
+collective traffic, step-time composition.  This module is that
+substrate: a process-wide, thread-safe registry of labeled metric
+families, instrumented at the framework's existing choke points:
+
+* **dispatch** (``ndarray/register.py``): every op invocation counts
+  into ``mxnet_ops_dispatched_total{op=...}``; the per-op executable
+  cache reports hits (``mxnet_compile_hits_total``), and a
+  ``jax.monitoring`` listener counts real XLA backend compiles into
+  ``mxnet_compile_misses_total`` + ``mxnet_compile_seconds`` — a silent
+  recompile storm becomes a visible counter, not a mystery slowdown.
+* **engine** (``engine.py``): waitall barriers (count + latency),
+  live-buffer registry size and sweeps, async-error translations.
+* **collectives** (``kvstore.py`` / ``parallel/ring.py``): allreduce /
+  allgather calls, wire bytes, wall time.  Eager collectives (kvstore)
+  count per execution; traced collectives (ring attention inside a
+  compiled step) count at trace time — one count per compiled program,
+  noted under the ``traced="1"`` label.
+* **training loop** (``gluon/trainer.py``, ``parallel/spmd.py``, the
+  contrib estimator): per-step histograms split into data-wait /
+  dispatch / device-sync, a steps/sec gauge, and the device-memory
+  high-watermark where the backend exposes it.
+
+Exposition: :func:`dump_json` (machine-readable), :func:`render_text`
+(Prometheus text format), and an optional background logger thread
+(``MXNET_METRICS_LOG_INTERVAL`` seconds; 0 = off).  ``reset()`` zeroes
+every series so test suites stay order-independent.
+
+The registry is always on: an increment is a dict lookup plus a locked
+float add, orders of magnitude below the cost of the op dispatch it
+counts.  Label cardinality is bounded per family
+(``MXNET_METRICS_MAX_SERIES``): past the cap, new label combinations
+collapse into a single ``_other_`` series rather than growing without
+bound (a user loop dispatching generated op names must not OOM the
+registry).
+"""
+from __future__ import annotations
+
+import bisect
+import json
+import threading
+import time
+from typing import Any, Dict, Iterable, List, Optional, Sequence, Tuple
+
+from .base import MXNetError, getenv, register_env
+
+__all__ = [
+    "Counter", "Gauge", "Histogram", "MetricsRegistry", "REGISTRY",
+    "counter", "gauge", "histogram", "dump_json", "render_text",
+    "reset", "value", "start_logger", "stop_logger",
+    "DEFAULT_BUCKETS",
+]
+
+register_env("MXNET_METRICS_LOG_INTERVAL", 0,
+             "Seconds between background dumps of the runtime metrics "
+             "registry to the 'mxnet_tpu.metrics' logger (JSON, non-zero "
+             "series only). 0 (default) disables the logger thread.")
+register_env("MXNET_METRICS_MAX_SERIES", 512,
+             "Per-family label-cardinality bound for the runtime metrics "
+             "registry: past this many distinct label combinations, new "
+             "ones collapse into a single '_other_' series (guards "
+             "against unbounded registry growth from generated names).")
+
+# Fixed exponential buckets: 100us .. ~52s, factor 2 — wide enough for
+# everything from a single eager dispatch to a cold-compile train step.
+DEFAULT_BUCKETS: Tuple[float, ...] = tuple(
+    1e-4 * (2.0 ** i) for i in range(20))
+
+
+def _validate_name(name: str) -> None:
+    import re
+    if not re.match(r"^[a-zA-Z_:][a-zA-Z0-9_:]*$", name):
+        raise MXNetError(f"invalid metric name {name!r}")
+
+
+def _escape_label(v: str) -> str:
+    return v.replace("\\", "\\\\").replace('"', '\\"').replace("\n", "\\n")
+
+
+class _Family:
+    """Base: a named metric with a fixed label-key tuple and one child
+    per observed label-value combination."""
+
+    kind = "untyped"
+
+    def __init__(self, name: str, doc: str,
+                 labels: Sequence[str] = ()) -> None:
+        _validate_name(name)
+        self.name = name
+        self.doc = " ".join(doc.split())
+        self.label_names = tuple(labels)
+        self._lock = threading.Lock()
+        self._children: Dict[Tuple[str, ...], Any] = {}
+        if not self.label_names:
+            self._children[()] = self._new_child()
+
+    # -- child management --------------------------------------------------
+    def _new_child(self) -> Any:
+        raise NotImplementedError
+
+    def labels(self, *values: Any, **kv: Any) -> "_Family":
+        """Return a bound single-series view (prometheus-client style)."""
+        if kv:
+            if values:
+                raise MXNetError("pass label values positionally OR by "
+                                 "keyword, not both")
+            if set(kv) != set(self.label_names):
+                raise MXNetError(
+                    f"metric {self.name!r} expects labels "
+                    f"{self.label_names}, got {sorted(kv)}")
+            values = tuple(kv[k] for k in self.label_names)
+        vals = tuple(str(v) for v in values)
+        if len(vals) != len(self.label_names):
+            raise MXNetError(
+                f"metric {self.name!r} expects {len(self.label_names)} "
+                f"label values {self.label_names}, got {len(vals)}")
+        return _Bound(self, self._child(vals))
+
+    def _child(self, vals: Tuple[str, ...]) -> Any:
+        child = self._children.get(vals)
+        if child is None:
+            with self._lock:
+                child = self._children.get(vals)
+                if child is None:
+                    cap = int(getenv("MXNET_METRICS_MAX_SERIES", 512))
+                    if len(self._children) >= cap:
+                        # cardinality guard: collapse the overflow into
+                        # one sentinel series instead of growing forever
+                        vals = ("_other_",) * len(self.label_names)
+                        child = self._children.get(vals)
+                        if child is not None:
+                            return child
+                    child = self._children[vals] = self._new_child()
+        return child
+
+    def _default(self) -> Any:
+        if self.label_names:
+            raise MXNetError(
+                f"metric {self.name!r} has labels {self.label_names}; "
+                "bind them with .labels(...) first")
+        return self._children[()]
+
+    def reset(self) -> None:
+        with self._lock:
+            if self.label_names:
+                self._children.clear()
+            else:
+                self._children = {(): self._new_child()}
+
+    # -- exposition --------------------------------------------------------
+    def _series(self) -> List[Tuple[Tuple[str, ...], Any]]:
+        with self._lock:
+            return sorted(self._children.items())
+
+    def to_json(self) -> Dict[str, Any]:
+        out = {"type": self.kind, "doc": self.doc,
+               "labels": list(self.label_names), "series": []}
+        for vals, child in self._series():
+            out["series"].append(
+                {"labels": dict(zip(self.label_names, vals)),
+                 **child.to_json()})
+        return out
+
+    def render(self) -> List[str]:
+        lines = [f"# HELP {self.name} {self.doc}",
+                 f"# TYPE {self.name} {self.kind}"]
+        for vals, child in self._series():
+            lines.extend(child.render(self.name, self.label_names, vals))
+        return lines
+
+
+def _label_str(names: Sequence[str], vals: Sequence[str],
+               extra: str = "") -> str:
+    parts = [f'{k}="{_escape_label(v)}"' for k, v in zip(names, vals)]
+    if extra:
+        parts.append(extra)
+    return "{" + ",".join(parts) + "}" if parts else ""
+
+
+class _Bound:
+    """One series of a family, with the value methods of its kind."""
+
+    __slots__ = ("_family", "_child")
+
+    def __init__(self, family: _Family, child: Any) -> None:
+        self._family = family
+        self._child = child
+
+    def inc(self, amount: float = 1.0) -> None:
+        self._child.inc(self._family._lock, amount)
+
+    def dec(self, amount: float = 1.0) -> None:
+        self._child.inc(self._family._lock, -amount)
+
+    def set(self, v: float) -> None:
+        self._child.set(self._family._lock, v)
+
+    def observe(self, v: float) -> None:
+        self._child.observe(self._family._lock, v)
+
+    @property
+    def value(self) -> float:
+        return self._child.value
+
+
+class _CounterChild:
+    __slots__ = ("value",)
+
+    def __init__(self) -> None:
+        self.value = 0.0
+
+    def inc(self, lock: threading.Lock, amount: float) -> None:
+        if amount < 0:
+            raise MXNetError("counters only go up; use a gauge")
+        with lock:
+            self.value += amount
+
+    def to_json(self) -> Dict[str, Any]:
+        return {"value": self.value}
+
+    def render(self, name, label_names, vals) -> List[str]:
+        return [f"{name}{_label_str(label_names, vals)} "
+                f"{_format_value(self.value)}"]
+
+
+class _GaugeChild(_CounterChild):
+    def inc(self, lock: threading.Lock, amount: float) -> None:
+        with lock:
+            self.value += amount
+
+    def set(self, lock: threading.Lock, v: float) -> None:
+        with lock:
+            self.value = float(v)
+
+
+class _HistogramChild:
+    __slots__ = ("bounds", "counts", "sum", "count")
+
+    def __init__(self, bounds: Tuple[float, ...]) -> None:
+        self.bounds = bounds
+        self.counts = [0] * (len(bounds) + 1)  # +1: the +Inf bucket
+        self.sum = 0.0
+        self.count = 0
+
+    def observe(self, lock: threading.Lock, v: float) -> None:
+        v = float(v)
+        idx = bisect.bisect_left(self.bounds, v)
+        with lock:
+            self.counts[idx] += 1
+            self.sum += v
+            self.count += 1
+
+    def to_json(self) -> Dict[str, Any]:
+        return {"buckets": [[b, c] for b, c in
+                            zip(list(self.bounds) + ["+Inf"],
+                                _cumulative(self.counts))],
+                "sum": self.sum, "count": self.count}
+
+    def render(self, name, label_names, vals) -> List[str]:
+        lines = []
+        for b, c in zip(list(self.bounds) + ["+Inf"],
+                        _cumulative(self.counts)):
+            le = b if isinstance(b, str) else _format_value(b)
+            le_pair = 'le="%s"' % le
+            lines.append(
+                f"{name}_bucket"
+                f"{_label_str(label_names, vals, le_pair)} {c}")
+        lines.append(f"{name}_sum{_label_str(label_names, vals)} "
+                     f"{_format_value(self.sum)}")
+        lines.append(f"{name}_count{_label_str(label_names, vals)} "
+                     f"{self.count}")
+        return lines
+
+
+def _cumulative(counts: Sequence[int]) -> List[int]:
+    out, acc = [], 0
+    for c in counts:
+        acc += c
+        out.append(acc)
+    return out
+
+
+def _format_value(v: float) -> str:
+    if v == int(v) and abs(v) < 1e15:
+        return str(int(v))
+    return repr(float(v))
+
+
+class Counter(_Family):
+    kind = "counter"
+
+    def _new_child(self) -> _CounterChild:
+        return _CounterChild()
+
+    def inc(self, amount: float = 1.0) -> None:
+        self._default().inc(self._lock, amount)
+
+    @property
+    def value(self) -> float:
+        return self._default().value
+
+
+class Gauge(_Family):
+    kind = "gauge"
+
+    def _new_child(self) -> _GaugeChild:
+        return _GaugeChild()
+
+    def inc(self, amount: float = 1.0) -> None:
+        self._default().inc(self._lock, amount)
+
+    def dec(self, amount: float = 1.0) -> None:
+        self._default().inc(self._lock, -amount)
+
+    def set(self, v: float) -> None:
+        self._default().set(self._lock, v)
+
+    @property
+    def value(self) -> float:
+        return self._default().value
+
+
+class Histogram(_Family):
+    kind = "histogram"
+
+    def __init__(self, name: str, doc: str, labels: Sequence[str] = (),
+                 buckets: Optional[Sequence[float]] = None) -> None:
+        bounds = tuple(sorted(float(b) for b in
+                              (buckets if buckets is not None
+                               else DEFAULT_BUCKETS)))
+        if not bounds:
+            raise MXNetError("histogram needs at least one bucket bound")
+        self.bounds = bounds
+        super().__init__(name, doc, labels)
+
+    def _new_child(self) -> _HistogramChild:
+        return _HistogramChild(self.bounds)
+
+    def observe(self, v: float) -> None:
+        self._default().observe(self._lock, v)
+
+    @property
+    def sum(self) -> float:
+        return self._default().sum
+
+    @property
+    def count(self) -> int:
+        return self._default().count
+
+
+# Hot-path cache for the per-op dispatch counter: one dict lookup per
+# dispatch (see inc_op).  reset() must drop it — its bound children
+# point at pre-reset series objects.
+_OP_CHILDREN: Dict[str, _Bound] = {}
+
+
+class MetricsRegistry:
+    """Process-wide named family registry with exposition."""
+
+    def __init__(self) -> None:
+        self._lock = threading.Lock()
+        self._families: "Dict[str, _Family]" = {}
+
+    def _register(self, cls, name: str, doc: str, labels=(),
+                  **kw) -> _Family:
+        with self._lock:
+            fam = self._families.get(name)
+            if fam is not None:
+                if not isinstance(fam, cls) or \
+                        fam.label_names != tuple(labels):
+                    raise MXNetError(
+                        f"metric {name!r} already registered as "
+                        f"{fam.kind} with labels {fam.label_names}")
+                return fam
+            fam = cls(name, doc, labels, **kw)
+            self._families[name] = fam
+            return fam
+
+    def counter(self, name: str, doc: str = "",
+                labels: Sequence[str] = ()) -> Counter:
+        return self._register(Counter, name, doc, labels)
+
+    def gauge(self, name: str, doc: str = "",
+              labels: Sequence[str] = ()) -> Gauge:
+        return self._register(Gauge, name, doc, labels)
+
+    def histogram(self, name: str, doc: str = "",
+                  labels: Sequence[str] = (),
+                  buckets: Optional[Sequence[float]] = None) -> Histogram:
+        return self._register(Histogram, name, doc, labels,
+                              buckets=buckets)
+
+    def get(self, name: str) -> Optional[_Family]:
+        with self._lock:
+            return self._families.get(name)
+
+    def reset(self) -> None:
+        """Zero every series (registrations survive) — test isolation."""
+        with self._lock:
+            fams = list(self._families.values())
+        for f in fams:
+            f.reset()
+        _OP_CHILDREN.clear()
+
+    def dump_json(self) -> Dict[str, Any]:
+        with self._lock:
+            fams = sorted(self._families.items())
+        return {name: fam.to_json() for name, fam in fams}
+
+    def render_text(self) -> str:
+        """Prometheus text exposition format 0.0.4."""
+        with self._lock:
+            fams = sorted(self._families.items())
+        lines: List[str] = []
+        for _, fam in fams:
+            lines.extend(fam.render())
+        return "\n".join(lines) + "\n"
+
+
+REGISTRY = MetricsRegistry()
+
+
+def counter(name: str, doc: str = "",
+            labels: Sequence[str] = ()) -> Counter:
+    """Get-or-create a counter family on the global registry."""
+    return REGISTRY.counter(name, doc, labels)
+
+
+def gauge(name: str, doc: str = "", labels: Sequence[str] = ()) -> Gauge:
+    """Get-or-create a gauge family on the global registry."""
+    return REGISTRY.gauge(name, doc, labels)
+
+
+def histogram(name: str, doc: str = "", labels: Sequence[str] = (),
+              buckets: Optional[Sequence[float]] = None) -> Histogram:
+    """Get-or-create a histogram family on the global registry."""
+    return REGISTRY.histogram(name, doc, labels, buckets)
+
+
+def dump_json() -> Dict[str, Any]:
+    return REGISTRY.dump_json()
+
+
+def render_text() -> str:
+    return REGISTRY.render_text()
+
+
+def reset() -> None:
+    REGISTRY.reset()
+
+
+def _peek(fam: _Family, labels: Dict[str, Any]) -> Any:
+    """Read-only series lookup: unlike fam.labels(...), never
+    instantiates a child, so probing a never-observed combination does
+    not pollute the exposition or consume a cardinality slot."""
+    if labels:
+        if set(labels) != set(fam.label_names):
+            return None
+        vals = tuple(str(labels[k]) for k in fam.label_names)
+    else:
+        if fam.label_names:
+            return None
+        vals = ()
+    with fam._lock:
+        return fam._children.get(vals)
+
+
+def value(name: str, /, **labels: Any) -> float:
+    """Current value of a counter/gauge series (0.0 if never touched,
+    or if the name is a histogram — use :func:`hist_stats` there) — the
+    delta-reading helper tools build breakdowns from."""
+    fam = REGISTRY.get(name)
+    if fam is None or isinstance(fam, Histogram):
+        return 0.0
+    child = _peek(fam, labels)
+    return float(child.value) if child is not None else 0.0
+
+
+def hist_stats(name: str, /, **labels: Any) -> Tuple[float, int]:
+    """(sum, count) of a histogram series (zeros if never observed)."""
+    fam = REGISTRY.get(name)
+    if fam is None or not isinstance(fam, Histogram):
+        return 0.0, 0
+    child = _peek(fam, labels)
+    if child is None:
+        return 0.0, 0
+    return float(child.sum), int(child.count)
+
+
+# ---------------------------------------------------------------------------
+# Core instrumentation families (created eagerly so exposition shows the
+# full surface even before first use)
+# ---------------------------------------------------------------------------
+
+OPS_DISPATCHED = counter(
+    "mxnet_ops_dispatched_total",
+    "Imperative op dispatches through ndarray.register.invoke, by op "
+    "name.", labels=("op",))
+COMPILE_MISSES = counter(
+    "mxnet_compile_misses_total",
+    "XLA backend compilations (jax.monitoring backend_compile events): "
+    "every one is a traced program that missed all compile caches.")
+COMPILE_HITS = counter(
+    "mxnet_compile_hits_total",
+    "Per-op executable-cache hits on the eager dispatch path (the call "
+    "reused a compiled executable instead of tracing).")
+COMPILE_SECONDS = histogram(
+    "mxnet_compile_seconds",
+    "Wall time of XLA backend compilations (jax.monitoring).")
+EXEC_CACHE_SIZE = gauge(
+    "mxnet_exec_cache_size",
+    "Entries in the per-op executable cache (ndarray.register).")
+
+ENGINE_WAITALL = counter(
+    "mxnet_engine_waitall_total",
+    "waitall() barriers on outstanding device work.")
+ENGINE_WAITALL_SECONDS = histogram(
+    "mxnet_engine_waitall_seconds",
+    "Wall time blocked inside waitall() barriers.")
+ENGINE_LIVE_BUFFERS = gauge(
+    "mxnet_engine_live_buffers",
+    "Device arrays in the engine's live weak registry.")
+ENGINE_SWEEPS = counter(
+    "mxnet_engine_sweeps_total",
+    "Dead-entry sweeps of the engine's weak registries.")
+ENGINE_SYNC_ERRORS = counter(
+    "mxnet_engine_sync_errors_total",
+    "Async device errors translated to MXNetError at sync points.")
+
+COLLECTIVE_CALLS = counter(
+    "mxnet_collective_calls_total",
+    "Collective operations by kind. Eager collectives (kvstore) count "
+    "per execution; traced ones (ring attention) count per trace, "
+    "marked traced=\"1\".", labels=("collective", "traced"))
+COLLECTIVE_BYTES = counter(
+    "mxnet_collective_bytes_total",
+    "Payload bytes this process contributed to collectives, by kind.",
+    labels=("collective", "traced"))
+COLLECTIVE_SECONDS = histogram(
+    "mxnet_collective_seconds",
+    "Wall time of eager collective operations, by kind.",
+    labels=("collective",))
+KVSTORE_PUSHES = counter(
+    "mxnet_kvstore_pushes_total",
+    "KVStore push() calls (gradient reductions entering the store).")
+
+STEP_SECONDS = histogram(
+    "mxnet_step_seconds",
+    "Training-step wall time at the trainer boundary (dispatch side: "
+    "data placement + program dispatch; device sync is the separate "
+    "mxnet_step_sync_seconds component).")
+STEP_DATA_SECONDS = histogram(
+    "mxnet_step_data_seconds",
+    "Per-step time waiting on input data (loader wait + host->device "
+    "placement).")
+STEP_DISPATCH_SECONDS = histogram(
+    "mxnet_step_dispatch_seconds",
+    "Per-step time dispatching the training computation (returns before "
+    "the device finishes).")
+STEP_SYNC_SECONDS = histogram(
+    "mxnet_step_sync_seconds",
+    "Per-step time blocked on device results (loss fetch / metric "
+    "update).")
+TRAINER_STEP_SECONDS = histogram(
+    "mxnet_trainer_step_seconds",
+    "gluon.Trainer.step wall time (gradient reduction + optimizer "
+    "update dispatch). The estimator/SPMD loop-level view is "
+    "mxnet_step_seconds.")
+STEPS_TOTAL = counter(
+    "mxnet_steps_total", "Optimizer steps taken.")
+STEPS_PER_SECOND = gauge(
+    "mxnet_steps_per_second",
+    "Inverse wall time of the most recent training step.")
+DEVICE_MEM_HIGHWATER = gauge(
+    "mxnet_device_mem_highwater_bytes",
+    "Device memory high-watermark (peak_bytes_in_use) where the "
+    "backend exposes memory_stats; 0 elsewhere.")
+
+MONITOR_STAT = gauge(
+    "mxnet_monitor_stat",
+    "Latest scalar statistic per op output collected by mx.monitor."
+    "Monitor (set at toc()).", labels=("name",))
+
+
+def record_step(total: float, data: float = 0.0, dispatch: float = 0.0,
+                sync: Optional[float] = None, count: int = 1) -> None:
+    """Observe one training step's phase breakdown (seconds).  Called by
+    the loop owners (SPMDTrainer.step, the estimator fit loop); tools
+    read the sums back with :func:`hist_stats`.  ``count`` > 1 marks a
+    fused multi-step program (one observation, N optimizer steps)."""
+    STEP_SECONDS.observe(total)
+    STEP_DATA_SECONDS.observe(data)
+    STEP_DISPATCH_SECONDS.observe(dispatch)
+    if sync is not None:
+        STEP_SYNC_SECONDS.observe(sync)
+    STEPS_TOTAL.inc(count)
+    if total > 0:
+        STEPS_PER_SECOND.set(count / total)
+
+
+def record_device_highwater() -> None:
+    """Update the device-memory high-watermark gauge if the backend
+    exposes memory_stats (TPU does; XLA:CPU returns None)."""
+    try:
+        import jax
+        stats = jax.local_devices()[0].memory_stats()
+        if stats:
+            peak = stats.get("peak_bytes_in_use",
+                             stats.get("bytes_in_use", 0))
+            if peak:
+                DEVICE_MEM_HIGHWATER.set(float(peak))
+    except Exception:   # noqa: BLE001 - backend-dependent surface
+        pass
+
+
+def inc_op(name: str) -> None:
+    """Count one op dispatch (called from ndarray.register.invoke)."""
+    b = _OP_CHILDREN.get(name)
+    if b is None:
+        b = _OP_CHILDREN[name] = OPS_DISPATCHED.labels(op=name)
+    b.inc()
+
+
+# ---------------------------------------------------------------------------
+# jax.monitoring bridge: real XLA backend compiles -> compile-miss counter
+# ---------------------------------------------------------------------------
+
+_JAX_HOOK = {"installed": False}
+
+
+def _install_jax_hooks() -> None:
+    if _JAX_HOOK["installed"]:
+        return
+    _JAX_HOOK["installed"] = True
+    try:
+        from jax import monitoring as _mon
+
+        def _on_duration(event: str, duration: float, **kw: Any) -> None:
+            if event.endswith("backend_compile_duration") or \
+                    event.endswith("backend_compile_time_sec"):
+                COMPILE_MISSES.inc()
+                COMPILE_SECONDS.observe(duration)
+
+        _mon.register_event_duration_secs_listener(_on_duration)
+    except Exception:   # noqa: BLE001 - older jax without monitoring
+        pass
+
+
+_install_jax_hooks()
+
+
+# ---------------------------------------------------------------------------
+# Periodic logger thread (MXNET_METRICS_LOG_INTERVAL)
+# ---------------------------------------------------------------------------
+
+_LOGGER_STATE: Dict[str, Any] = {"thread": None, "stop": None}
+
+
+def _nonzero_summary() -> Dict[str, Any]:
+    out: Dict[str, Any] = {}
+    for name, fam in dump_json().items():
+        series = []
+        for s in fam["series"]:
+            if fam["type"] == "histogram":
+                if s.get("count"):
+                    series.append({"labels": s["labels"],
+                                   "sum": round(s["sum"], 6),
+                                   "count": s["count"]})
+            elif s.get("value"):
+                series.append({"labels": s["labels"],
+                               "value": s["value"]})
+        if series:
+            out[name] = series
+    return out
+
+
+def start_logger(interval: Optional[float] = None) -> bool:
+    """Start the background metrics logger (idempotent). Returns True if
+    a thread is running after the call."""
+    if interval is None:
+        interval = float(getenv("MXNET_METRICS_LOG_INTERVAL", 0))
+    if interval <= 0:
+        return False
+    if _LOGGER_STATE["thread"] is not None and \
+            _LOGGER_STATE["thread"].is_alive():
+        return True
+    import logging
+    log = logging.getLogger("mxnet_tpu.metrics")
+    stop = threading.Event()
+
+    def _run() -> None:
+        while not stop.wait(interval):
+            try:
+                log.info("metrics %s", json.dumps(_nonzero_summary()))
+            except Exception:   # noqa: BLE001 - never kill the app
+                pass
+
+    th = threading.Thread(target=_run, name="mxnet-metrics-logger",
+                          daemon=True)
+    _LOGGER_STATE["thread"], _LOGGER_STATE["stop"] = th, stop
+    th.start()
+    return True
+
+
+def stop_logger() -> None:
+    stop = _LOGGER_STATE["stop"]
+    if stop is not None:
+        stop.set()
+    th = _LOGGER_STATE["thread"]
+    if th is not None:
+        th.join(timeout=2.0)
+    _LOGGER_STATE["thread"] = _LOGGER_STATE["stop"] = None
+
+
+if float(getenv("MXNET_METRICS_LOG_INTERVAL", 0)) > 0:
+    start_logger()

@@ -89,14 +89,26 @@ class Monitor:
 
     def toc(self) -> List[Tuple[int, str, str]]:
         """Stop collecting; return [(step, name, stat_str)]
-        (reference: ``Monitor.toc``)."""
+        (reference: ``Monitor.toc``).
+
+        Scalar stats are additionally published to the runtime metrics
+        registry as ``mxnet_monitor_stat{name=...}`` gauges, so the last
+        collected value per op output is queryable alongside the rest of
+        the runtime metrics (docs/observability.md)."""
         if not self.activated:
             return []
+        from . import metrics as _metrics
         _register._monitor_state["hooks"].pop(id(self), None)
         self.activated = False
         res = []
         for step, name, stat in self.queue:
             arr = stat.asnumpy() if isinstance(stat, NDArray) else stat
+            try:
+                if getattr(arr, "size", 0) == 1:
+                    _metrics.MONITOR_STAT.labels(name=name).set(
+                        float(arr))
+            except (TypeError, ValueError):
+                pass   # non-numeric stat: exposition keeps the string only
             res.append((step, name, str(arr)))
         if self.sort:
             res.sort(key=lambda t: t[1])

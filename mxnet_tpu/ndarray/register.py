@@ -23,6 +23,7 @@ import jax
 import jax.numpy as jnp
 
 from .. import engine
+from .. import metrics as _metrics
 from .._tape import TapeNode, is_recording
 
 __all__ = ["invoke", "register_op", "get_op", "list_ops", "wrap_out"]
@@ -215,6 +216,8 @@ def _cached_exec(name: str, impl: Callable, arrays, record: bool):
         _CHURN_COUNT.pop(churn_key, None)
     if fn is _EAGER_ONLY:
         return None
+    if fn is not None:
+        _metrics.COMPILE_HITS.inc()
     if fn is None:
         n = _CHURN_COUNT[churn_key] = _CHURN_COUNT.get(churn_key, 0) + 1
         if n > _CHURN_LIMIT:
@@ -240,6 +243,7 @@ def _cached_exec(name: str, impl: Callable, arrays, record: bool):
                     break
             else:
                 _EXEC_CACHE.popitem(last=False)
+        _metrics.EXEC_CACHE_SIZE.set(len(_EXEC_CACHE))
     try:
         return fn(*arrays)
     except jax.errors.JAXTypeError:
@@ -338,6 +342,7 @@ def invoke_with_custom_vjp(name: str, impl: Callable,
     row-sparse embedding grad). ``vjp_fn(out_cot) -> per-input cotangents``
     (None entries are skipped). Single-output ops only."""
     arrays = [x._data for x in inputs]
+    _metrics.inc_op(name)
     if _mesh_state["active"]:
         arrays = _harmonize_mesh_placement(arrays)
 
@@ -377,6 +382,7 @@ def invoke(name: str, impl: Callable, inputs: Sequence[Any],
     validation with mode='raise') bypass the per-op executable cache.
     """
     arrays = [x._data for x in inputs]
+    _metrics.inc_op(name)
     if _mesh_state["active"]:
         arrays = _harmonize_mesh_placement(arrays)
 

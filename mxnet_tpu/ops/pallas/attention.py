@@ -34,9 +34,13 @@ from jax.experimental.pallas import tpu as pltpu
 
 _NEG_INF = -1e30
 
+# CompilerParams was named TPUCompilerParams before jax 0.5
+_CompilerParams = getattr(pltpu, "CompilerParams", None) or \
+    pltpu.TPUCompilerParams
+
 # all three kernels accumulate over their LAST grid axis only; telling
 # Mosaic the rest are parallel lets it pipeline/reorder grid steps
-_GRID_SEMANTICS = pltpu.CompilerParams(
+_GRID_SEMANTICS = _CompilerParams(
     dimension_semantics=("parallel", "parallel", "parallel", "arbitrary"))
 
 
@@ -686,7 +690,7 @@ def _flash_backward(q, k, v, bias, seed, o, lse, g, scale: float,
             scratch_shapes=[pltpu.VMEM((Tk_p, D), jnp.float32),   # dk acc
                             pltpu.VMEM((Tk_p, D), jnp.float32)],  # dv acc
             interpret=interpret,
-            compiler_params=pltpu.CompilerParams(
+            compiler_params=_CompilerParams(
                 dimension_semantics=("parallel", "parallel",
                                      "arbitrary")),
         )(*fused_args)

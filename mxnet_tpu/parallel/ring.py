@@ -412,6 +412,17 @@ def ring_attention(q, k, v, mesh: "jax.sharding.Mesh", axis: str = "sp",
               or bias.shape[3] != k.shape[1])):
         return _dense(q, k, v, scale, causal, bias, dropout, dropout_seed)
 
+    # Collective accounting (traced: this usually runs under jit, so one
+    # count per compiled program, not per executed step — the eager
+    # kvstore path is the per-step accounting). Wire bytes per rotation:
+    # each K/V element crosses the ring n-1 times.
+    from .. import metrics as _metrics
+    _metrics.COLLECTIVE_CALLS.labels(
+        collective="ring_attention", traced="1").inc()
+    _metrics.COLLECTIVE_BYTES.labels(
+        collective="ring_attention", traced="1").inc(
+        (n - 1) * (k.size * k.dtype.itemsize + v.size * v.dtype.itemsize))
+
     # carry the surrounding dp/tp layout through the shard_map so GSPMD
     # does not insert gathers around it (SPMDTrainer shards batch over dp
     # and heads over tp)

@@ -1,0 +1,300 @@
+"""Runtime metrics registry: semantics, exposition, and the dispatch /
+engine / collective / training-loop instrumentation (ISSUE 1).
+
+No reference analog — the reference's observability stops at the
+profiler and Monitor; this suite covers the new always-on registry.
+"""
+import json
+import re
+import threading
+
+import numpy as onp
+import pytest
+
+import mxnet_tpu as mx
+from mxnet_tpu import metrics
+
+
+@pytest.fixture(autouse=True)
+def _isolate():
+    """Each test starts from zeroed series and leaves none behind."""
+    metrics.reset()
+    yield
+    metrics.reset()
+
+
+# ---------------------------------------------------------------------------
+# registry semantics
+# ---------------------------------------------------------------------------
+
+def test_counter_gauge_basics():
+    c = metrics.counter("t_reqs_total", "requests")
+    c.inc()
+    c.inc(2.5)
+    assert c.value == 3.5
+    with pytest.raises(mx.MXNetError):
+        c.inc(-1)
+    g = metrics.gauge("t_depth", "queue depth")
+    g.set(5)
+    g.dec(2)
+    assert g.value == 3.0
+    # re-registration returns the same family; mismatched kind raises
+    assert metrics.counter("t_reqs_total", "requests") is c
+    with pytest.raises(mx.MXNetError):
+        metrics.gauge("t_reqs_total")
+
+
+def test_labeled_series_and_cardinality_guard(monkeypatch):
+    c = metrics.counter("t_by_op_total", "x", labels=("op",))
+    c.labels(op="dot").inc()
+    c.labels(op="dot").inc()
+    c.labels(op="add").inc()
+    assert metrics.value("t_by_op_total", op="dot") == 2
+    assert metrics.value("t_by_op_total", op="add") == 1
+    # unbound access on a labeled family is an error
+    with pytest.raises(mx.MXNetError):
+        c.inc()
+    with pytest.raises(mx.MXNetError):
+        c.labels(op="a", extra="b")
+    # cardinality guard: past the cap, new combos collapse into _other_
+    monkeypatch.setenv("MXNET_METRICS_MAX_SERIES", "4")
+    for i in range(20):
+        c.labels(op=f"gen{i}").inc()
+    series = {tuple(s["labels"].values())
+              for s in metrics.dump_json()["t_by_op_total"]["series"]}
+    assert len(series) <= 5  # 4 real + the _other_ sentinel
+    assert ("_other_",) in series
+    assert metrics.value("t_by_op_total", op="_other_") >= 16
+
+
+def test_histogram_bucket_edges():
+    h = metrics.histogram("t_lat", "x", buckets=(1.0, 2.0, 4.0))
+    for v in (0.5, 1.0, 3.0, 100.0):
+        h.observe(v)
+    text = metrics.render_text()
+    # cumulative counts; a value equal to a bound lands IN that bucket
+    assert 't_lat_bucket{le="1"} 2' in text
+    assert 't_lat_bucket{le="2"} 2' in text
+    assert 't_lat_bucket{le="4"} 3' in text
+    assert 't_lat_bucket{le="+Inf"} 4' in text
+    assert "t_lat_count 4" in text
+    assert h.sum == pytest.approx(104.5)
+    # default buckets are fixed exponential
+    ratios = {round(b / a, 6) for a, b in zip(metrics.DEFAULT_BUCKETS,
+                                              metrics.DEFAULT_BUCKETS[1:])}
+    assert ratios == {2.0}
+
+
+def test_thread_safety_under_concurrent_increments():
+    c = metrics.counter("t_conc_total", "x")
+    h = metrics.histogram("t_conc_h", "x", buckets=(0.5,))
+    N, T = 2000, 8
+
+    def work():
+        for _ in range(N):
+            c.inc()
+            h.observe(0.25)
+
+    threads = [threading.Thread(target=work) for _ in range(T)]
+    for t in threads:
+        t.start()
+    for t in threads:
+        t.join()
+    assert c.value == N * T
+    assert h.count == N * T
+
+
+def test_reset_isolation():
+    metrics.counter("t_r_total", "x").inc(7)
+    metrics.OPS_DISPATCHED.labels(op="anything").inc()
+    metrics.reset()
+    assert metrics.value("t_r_total") == 0
+    assert metrics.value("mxnet_ops_dispatched_total", op="anything") == 0
+    # families stay registered and usable after reset
+    metrics.counter("t_r_total", "x").inc()
+    assert metrics.value("t_r_total") == 1
+
+
+# ---------------------------------------------------------------------------
+# exposition formats
+# ---------------------------------------------------------------------------
+
+_LABEL = r'[a-zA-Z0-9_]+="(?:[^"\\\n]|\\.)*"'
+_PROM_LINE = re.compile(
+    r"^[a-zA-Z_:][a-zA-Z0-9_:]*(\{%s(,%s)*\})? -?[0-9.e+-]+(inf|nan)?$"
+    % (_LABEL, _LABEL))
+
+
+def test_prometheus_text_parses():
+    metrics.counter("t_p_total", "help text", labels=("k",)) \
+        .labels(k='weird "quoted\\name"\n').inc()
+    metrics.histogram("t_p_h", "h").observe(0.01)
+    metrics.gauge("t_p_g", "g").set(-2.5)
+    text = metrics.render_text()
+    assert text.endswith("\n")
+    for line in text.splitlines():
+        if not line or line.startswith("#"):
+            if line:
+                assert line.startswith(("# HELP ", "# TYPE "))
+            continue
+        assert _PROM_LINE.match(line), f"bad exposition line: {line!r}"
+    # every family has HELP and TYPE
+    assert "# HELP t_p_total help text" in text
+    assert "# TYPE t_p_total counter" in text
+    assert "# TYPE t_p_h histogram" in text
+    assert "# TYPE t_p_g gauge" in text
+
+
+def test_json_dump_round_trips():
+    metrics.counter("t_j_total", "x").inc(3)
+    metrics.histogram("t_j_h", "x", buckets=(1.0,)).observe(0.5)
+    blob = json.loads(json.dumps(metrics.dump_json()))
+    assert blob["t_j_total"]["series"][0]["value"] == 3
+    hs = blob["t_j_h"]["series"][0]
+    assert hs["count"] == 1 and hs["buckets"][0] == [1.0, 1]
+
+
+# ---------------------------------------------------------------------------
+# instrumentation: dispatch / engine / collectives / training loop
+# ---------------------------------------------------------------------------
+
+def test_dispatch_counters_advance():
+    a = mx.nd.ones((4, 4))
+    before = metrics.value("mxnet_ops_dispatched_total", op="dot")
+    for _ in range(3):
+        mx.nd.dot(a, a)
+    assert metrics.value("mxnet_ops_dispatched_total", op="dot") \
+        == before + 3
+
+
+def test_engine_counters_advance():
+    a = mx.nd.ones((2, 2))
+    (a + 1).asnumpy()
+    before = metrics.value("mxnet_engine_waitall_total")
+    mx.waitall()
+    assert metrics.value("mxnet_engine_waitall_total") == before + 1
+    s, n = metrics.hist_stats("mxnet_engine_waitall_seconds")
+    assert n >= 1 and s >= 0
+
+
+def test_kvstore_collective_counters_advance():
+    kv = mx.kv.create("device")
+    kv.init("w", mx.nd.ones((4,)))
+    before = metrics.value("mxnet_kvstore_pushes_total")
+    kv.push("w", [mx.nd.ones((4,)), mx.nd.ones((4,))])
+    assert metrics.value("mxnet_kvstore_pushes_total") == before + 1
+    s, n = metrics.hist_stats("mxnet_collective_seconds",
+                              collective="push")
+    assert n >= 1
+
+
+def test_counters_advance_under_train_step():
+    """A small CPU train step advances dispatch, step, and trainer-layer
+    counters in one pass."""
+    mx.random.seed(0)
+    net = mx.gluon.nn.Dense(4)
+    net.initialize()
+    trainer = mx.gluon.Trainer(net.collect_params(), "sgd",
+                               {"learning_rate": 0.1})
+    x = mx.np.array(onp.random.randn(8, 8).astype("float32"))
+    y = mx.np.array(onp.random.randn(8, 4).astype("float32"))
+    with mx.autograd.record():
+        loss = ((net(x) - y) ** 2).mean()
+    loss.backward()
+    trainer.step(8)
+    assert metrics.value("mxnet_kvstore_pushes_total") >= 1
+    s, n = metrics.hist_stats("mxnet_trainer_step_seconds")
+    assert n == 1 and s > 0
+    ops = metrics.dump_json()["mxnet_ops_dispatched_total"]["series"]
+    assert sum(s["value"] for s in ops) > 0
+
+
+def test_spmd_step_records_phase_breakdown():
+    import jax
+    from mxnet_tpu.parallel import (SPMDTrainer, make_mesh,
+                                    DATA_PARALLEL_RULES)
+    mx.random.seed(0)
+    net = mx.gluon.nn.Dense(4)
+    net.initialize()
+    net(mx.np.zeros((1, 8), dtype="float32"))
+    mesh = make_mesh({"dp": 1}, devices=jax.devices()[:1])
+    loss_fn = mx.gluon.loss.L2Loss()
+    trainer = SPMDTrainer(net, loss_fn, optimizer="sgd",
+                          optimizer_params={"learning_rate": 0.1},
+                          mesh=mesh, rules=DATA_PARALLEL_RULES)
+    x = mx.np.array(onp.random.randn(4, 8).astype("float32"))
+    y = mx.np.array(onp.random.randn(4, 4).astype("float32"))
+    steps0 = metrics.value("mxnet_steps_total")
+    misses0 = metrics.value("mxnet_compile_misses_total")
+    trainer.step(x, y).asnumpy()
+    trainer.step(x, y).asnumpy()
+    assert metrics.value("mxnet_steps_total") == steps0 + 2
+    # the first step compiled the train program
+    assert metrics.value("mxnet_compile_misses_total") > misses0
+    total_s, total_n = metrics.hist_stats("mxnet_step_seconds")
+    data_s, _ = metrics.hist_stats("mxnet_step_data_seconds")
+    disp_s, _ = metrics.hist_stats("mxnet_step_dispatch_seconds")
+    assert total_n == 2
+    # phases partition the step wall time
+    assert data_s + disp_s == pytest.approx(total_s, rel=1e-6, abs=1e-6)
+    assert metrics.value("mxnet_steps_per_second") > 0
+
+
+def test_estimator_fit_records_sync_phase():
+    from mxnet_tpu.gluon.contrib.estimator import Estimator
+    mx.random.seed(0)
+    net = mx.gluon.nn.Dense(4)
+    net.initialize()
+    batches = [(mx.np.array(onp.random.randn(4, 8).astype("float32")),
+                mx.np.array(onp.random.randint(0, 4, (4,))
+                            .astype("int32")))
+               for _ in range(3)]
+    est = Estimator(net, mx.gluon.loss.SoftmaxCrossEntropyLoss(),
+                    train_metrics="acc")
+    est.fit(batches, epochs=1)
+    _, n_sync = metrics.hist_stats("mxnet_step_sync_seconds")
+    _, n_total = metrics.hist_stats("mxnet_step_seconds")
+    assert n_total == 3 and n_sync == 3
+    assert metrics.value("mxnet_steps_total") == 3
+
+
+# ---------------------------------------------------------------------------
+# monitor integration (ISSUE 1 satellite): toc() stats become gauges,
+# and the documented pattern/sort semantics hold
+# ---------------------------------------------------------------------------
+
+def test_monitor_stats_published_as_gauges():
+    mon = mx.monitor.Monitor(interval=1, pattern=".*")
+    mon.tic()
+    a = mx.nd.ones((4, 4))
+    mx.nd.sum(a)
+    res = mon.toc()
+    assert res
+    names = {name for _, name, _ in res}
+    assert "sum" in names
+    # default stat is mean |x|; sum of a 4x4 of ones is the scalar 16
+    assert metrics.value("mxnet_monitor_stat", name="sum") == \
+        pytest.approx(16.0)
+
+
+def test_monitor_pattern_filter_and_sort():
+    """Regression: `pattern` filters by op name and `sort=True` orders
+    toc() results by name, as documented."""
+    mon = mx.monitor.Monitor(interval=1, pattern="^(dot|sum)", sort=True)
+    mon.tic()
+    a = mx.nd.ones((4, 4))
+    mx.nd.dot(a, a)      # matches
+    mx.nd.sum(a)         # matches
+    a + a                # does not match ("add")
+    res = mon.toc()
+    names = [name for _, name, _ in res]
+    assert all(n.startswith(("dot", "sum")) for n in names)
+    assert "dot" in names and "sum" in names
+    assert names == sorted(names)
+
+
+def test_logger_thread_start_stop():
+    assert metrics.start_logger(0) is False      # 0 = disabled
+    assert metrics.start_logger(0.05) is True
+    assert metrics.start_logger(0.05) is True    # idempotent
+    metrics.stop_logger()

@@ -89,3 +89,28 @@ def test_profiler_off_has_no_capture():
     a = mx.nd.ones((2, 2))
     mx.nd.dot(a, a)
     assert "dot" not in profiler.dumps()
+
+
+def test_profiler_and_metrics_coexist_without_double_counting():
+    """Profiler scopes and the runtime metrics registry hook the same
+    dispatch choke point independently: with both active, each op
+    dispatch is timed once by the profiler AND counted exactly once by
+    the metrics layer (ISSUE 1 satellite)."""
+    from mxnet_tpu import metrics
+    metrics.reset()
+    a = mx.nd.ones((4, 4))
+    profiler.start()
+    with profiler.ProfileTask("window"):
+        for _ in range(5):
+            mx.nd.dot(a, a)
+    profiler.stop()
+    # profiler saw all five...
+    table = profiler.dumps()
+    line = [l for l in table.splitlines() if l.startswith("dot")][0]
+    assert int(line.split()[1]) == 5
+    # ...and the metrics counter advanced by exactly five (not 10)
+    assert metrics.value("mxnet_ops_dispatched_total", op="dot") == 5
+    # metrics keep counting after the profiler stops
+    mx.nd.dot(a, a)
+    assert metrics.value("mxnet_ops_dispatched_total", op="dot") == 6
+    metrics.reset()

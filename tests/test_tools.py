@@ -101,6 +101,23 @@ def test_opperf_runs_subset(tmp_path):
         assert "mean_us" in r and r["mean_us"] > 0
 
 
+def test_metrics_dump_cli(capsys):
+    import metrics_dump
+    from mxnet_tpu import metrics
+    metrics.reset()
+    metrics_dump.main(["--workload", "eager", "--steps", "2",
+                       "--platform", "ambient"])
+    out = capsys.readouterr().out
+    assert "# TYPE mxnet_ops_dispatched_total counter" in out
+    assert 'mxnet_ops_dispatched_total{op="dot"} 2' in out
+    assert "mxnet_engine_waitall_total 1" in out
+    metrics_dump.main(["--workload", "eager", "--steps", "1",
+                       "--format", "json", "--platform", "ambient"])
+    blob = json.loads(capsys.readouterr().out)
+    assert blob["mxnet_ops_dispatched_total"]["type"] == "counter"
+    metrics.reset()
+
+
 def test_diagnose_smoke(capsys):
     import diagnose
     diagnose.main()

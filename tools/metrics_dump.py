@@ -1,0 +1,126 @@
+"""Run a small workload and print the runtime metrics exposition.
+
+The smoke-test entry point for the metrics subsystem
+(``mxnet_tpu/metrics.py``): drives a real workload through the
+instrumented layers (dispatch, engine, collectives, training loop) and
+prints what the registry saw — Prometheus text by default, JSON with
+``--format json``.
+
+    python tools/metrics_dump.py --workload resnet_step
+    python tools/metrics_dump.py --workload mlp_fit --format json
+
+Workloads:
+  resnet_step  ResNet-18 SPMDTrainer steps (compiled train step; shows
+               compile misses, step-phase histograms, dispatch counters
+               from the eager settle forward).
+  mlp_fit      tiny MLP through the gluon estimator fit loop (eager
+               dispatch per op, kvstore push, data/dispatch/sync split).
+  eager        a handful of eager ops + a waitall (dispatch and engine
+               counters only).
+
+Runs on the CPU backend by default so it works anywhere (pass
+``--platform ambient`` to keep the environment's backend, e.g. the TPU
+tunnel).
+"""
+import argparse
+import os
+import sys
+import time
+
+sys.path.insert(0, os.path.dirname(os.path.dirname(os.path.abspath(__file__))))
+
+
+def _workload_resnet_step(steps: int) -> None:
+    import numpy as onp
+    import jax
+    import mxnet_tpu as mx
+    from mxnet_tpu import metrics
+    from mxnet_tpu.gluon.model_zoo import vision as zoo
+    from mxnet_tpu.parallel import (SPMDTrainer, make_mesh,
+                                    DATA_PARALLEL_RULES)
+
+    mx.random.seed(0)
+    net = zoo.get_model("resnet18_v1", classes=10)
+    net.initialize()
+    net(mx.np.zeros((1, 3, 32, 32), dtype="float32"))   # eager settle
+    mesh = make_mesh({"dp": 1}, devices=jax.devices()[:1])
+    trainer = SPMDTrainer(
+        net, mx.gluon.loss.SoftmaxCrossEntropyLoss(),
+        optimizer="sgd", optimizer_params={"learning_rate": 0.1},
+        mesh=mesh, rules=DATA_PARALLEL_RULES)
+    rng = onp.random.RandomState(0)
+    x = mx.np.array(rng.uniform(-1, 1, (4, 3, 32, 32)).astype("float32"))
+    y = mx.np.array(rng.randint(0, 10, (4,)).astype("int32"))
+    for _ in range(steps):
+        loss = trainer.step(x, y)       # records data/dispatch phases
+        t1 = time.perf_counter()
+        loss.asnumpy()                  # device sync
+        metrics.STEP_SYNC_SECONDS.observe(time.perf_counter() - t1)
+    mx.waitall()
+
+
+def _workload_mlp_fit(steps: int) -> None:
+    import numpy as onp
+    import mxnet_tpu as mx
+    from mxnet_tpu.gluon.contrib.estimator import Estimator
+
+    mx.random.seed(0)
+    net = mx.gluon.nn.Sequential()
+    net.add(mx.gluon.nn.Dense(16, activation="relu"),
+            mx.gluon.nn.Dense(4))
+    net.initialize()
+    rng = onp.random.RandomState(0)
+    batches = [(mx.np.array(rng.randn(8, 8).astype("float32")),
+                mx.np.array(rng.randint(0, 4, (8,)).astype("int32")))
+               for _ in range(steps)]
+    est = Estimator(net, mx.gluon.loss.SoftmaxCrossEntropyLoss(),
+                    train_metrics="acc")
+    est.fit(batches, epochs=1)
+    mx.waitall()
+
+
+def _workload_eager(steps: int) -> None:
+    import mxnet_tpu as mx
+    a = mx.nd.ones((32, 32))
+    for _ in range(steps):
+        b = mx.nd.dot(a, a)
+        (b + 1).sum().asnumpy()
+    mx.waitall()
+
+
+WORKLOADS = {
+    "resnet_step": _workload_resnet_step,
+    "mlp_fit": _workload_mlp_fit,
+    "eager": _workload_eager,
+}
+
+
+def main(argv=None) -> None:
+    ap = argparse.ArgumentParser(description=__doc__.splitlines()[0])
+    ap.add_argument("--workload", choices=sorted(WORKLOADS),
+                    default="resnet_step")
+    ap.add_argument("--steps", type=int, default=3,
+                    help="training steps / repeats (default 3)")
+    ap.add_argument("--format", choices=("prom", "json"), default="prom")
+    ap.add_argument("--platform", choices=("cpu", "ambient"),
+                    default="cpu",
+                    help="force the CPU backend (default) or keep the "
+                         "environment's (e.g. the TPU tunnel)")
+    args = ap.parse_args(argv)
+
+    if args.platform == "cpu":
+        import jax
+        jax.config.update("jax_platforms", "cpu")
+
+    WORKLOADS[args.workload](args.steps)
+
+    from mxnet_tpu import metrics
+    if args.format == "json":
+        import json
+        print(json.dumps(metrics.dump_json(), indent=1))
+    else:
+        sys.stdout.write(metrics.render_text())
+
+
+if __name__ == "__main__":
+    main()

@@ -90,20 +90,35 @@ def profile_resnet(batch=128, dtype="bfloat16", steps=5):
                     .astype(dtype))
     y = mx.np.array(onp.random.randint(0, 1000, (batch,)).astype("int32"))
 
+    from mxnet_tpu import metrics
+
+    def _compiles():
+        return metrics.value("mxnet_compile_misses_total")
+
+    c0 = _compiles()
     t0 = time.perf_counter()
     float(trainer.step(x, y).asnumpy())
-    print(f"[rn50] warmup1 (compile): {time.perf_counter()-t0:.1f} s")
+    print(f"[rn50] warmup1 (compile): {time.perf_counter()-t0:.1f} s "
+          f"({_compiles()-c0:.0f} XLA compiles)")
+    c0 = _compiles()
     t0 = time.perf_counter()
     float(trainer.step(x, y).asnumpy())
-    print(f"[rn50] warmup2 (relayout): {time.perf_counter()-t0:.1f} s")
+    print(f"[rn50] warmup2 (relayout): {time.perf_counter()-t0:.1f} s "
+          f"({_compiles()-c0:.0f} XLA compiles)")
 
     for i in range(steps):
+        c0 = _compiles()
         t0 = time.perf_counter()
         loss = trainer.step(x, y)
         d1 = time.perf_counter() - t0
         loss.asnumpy()
         d2 = time.perf_counter() - t0
-        print(f"[rn50] step {i}: dispatch {d1*1e3:.1f} ms, +sync {d2*1e3:.1f} ms")
+        rc = _compiles() - c0
+        # a non-zero recompile count means this step's timing includes
+        # a silent re-trace+compile — discard it from averages
+        note = f", RECOMPILED x{rc:.0f} (timing skewed)" if rc else ""
+        print(f"[rn50] step {i}: dispatch {d1*1e3:.1f} ms, "
+              f"+sync {d2*1e3:.1f} ms{note}")
 
     # host-side cost: param list build only
     t0 = time.perf_counter()
