@@ -4,9 +4,13 @@
 # point. Usage:
 #
 #   ci/run.sh native        # build libmxtpu.so + run the C++ test binary
-#   ci/run.sh tier1         # docs-freshness gate + the tier-1 pytest
-#                           #   selection (the driver's acceptance run)
+#   ci/run.sh tier1         # docs-freshness gate + serving smoke + the
+#                           #   tier-1 pytest selection (the driver's
+#                           #   acceptance run)
 #   ci/run.sh envdoc        # docs/env_vars.md staleness check alone
+#   ci/run.sh serving-smoke # tools/serve_bench.py --smoke alone
+#                           #   (batching wins / bounded compiles /
+#                           #   shed-not-crash)
 #   ci/run.sh unit          # full Python suite on the 8-dev virtual mesh
 #   ci/run.sh dist          # real multi-process launcher tests
 #   ci/run.sh exec-cache    # suite subset with the per-op executable
@@ -49,9 +53,17 @@ run_envdoc() {
   fi
 }
 
+run_serving_smoke() {
+  echo "== serving-smoke: dynamic batching beats batch-1, bucketed"
+  echo "   compiles stay bounded, overload sheds without crashing"
+  JAX_PLATFORMS=cpu python tools/serve_bench.py --smoke
+}
+
 run_tier1() {
-  echo "== tier1: env-doc freshness + the tier-1 pytest selection"
+  echo "== tier1: env-doc freshness + serving smoke + the tier-1"
+  echo "   pytest selection"
   run_envdoc
+  run_serving_smoke
   JAX_PLATFORMS=cpu python -m pytest tests/ -q -m 'not slow' \
     --continue-on-collection-errors -p no:cacheprovider
 }
@@ -140,6 +152,7 @@ case "$variant" in
   native)       run_native ;;
   tier1)        run_tier1 ;;
   envdoc)       run_envdoc ;;
+  serving-smoke) run_serving_smoke ;;
   unit)         run_unit ;;
   dist)         run_dist ;;
   exec-cache)   run_exec_cache ;;

@@ -70,6 +70,7 @@ _LAZY = {
     "contrib": ".contrib",
     "subgraph": ".subgraph",
     "rtc": ".rtc",
+    "serving": ".serving",
     "checkpoint": ".checkpoint",
     "name": ".name",
     "attribute": ".attribute",

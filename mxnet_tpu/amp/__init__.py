@@ -42,6 +42,9 @@ _STATE = {
     "target_funcs": frozenset(),
     "fp32_funcs": frozenset(),
     "widest_funcs": frozenset(),
+    # op -> (idx, ndim) -> bool: which operands of a TARGET_DTYPE op
+    # cast down (fused ops mixing data with BN statistics)
+    "operand_policy": {},
 }
 
 
@@ -59,9 +62,11 @@ def apply_cast_policy(name: str, arrays: List[Any]) -> List[Any]:
         return arrays
     tgt = _STATE["target_dtype"]
     if name in _STATE["target_funcs"]:
+        pol = _STATE["operand_policy"].get(name)
         return [a.astype(tgt)
-                if _float_like(a) and a.dtype == jnp.float32 else a
-                for a in arrays]
+                if _float_like(a) and a.dtype == jnp.float32
+                and (pol is None or pol(i, a.ndim)) else a
+                for i, a in enumerate(arrays)]
     if name in _STATE["fp32_funcs"]:
         return [a.astype(jnp.float32)
                 if _float_like(a) and a.dtype in (tgt, jnp.float16) else a
@@ -102,6 +107,7 @@ def init(target_dtype: Union[str, Any] = "bfloat16",
         widest_funcs=frozenset(widest_dtype_ops
                                if widest_dtype_ops is not None
                                else lists.WIDEST_TYPE_CASTS),
+        operand_policy=dict(lists.TARGET_DTYPE_OPERAND_POLICY),
     )
 
 

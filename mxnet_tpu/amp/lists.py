@@ -41,6 +41,22 @@ FP32_FUNCS = [
     "smooth_l1", "pick",
 ]
 
+# Per-operand refinement for TARGET_DTYPE_FUNCS ops whose operand list
+# MIXES MXU data with normalization statistics (ADVICE r5): the fused
+# BN->ReLU->1x1-conv junction takes (data, gamma, beta, running_mean,
+# running_var, weight[, conv_bias][, shift]) — casting the five
+# BN-statistics vectors to bf16 would accrue rounding in the running
+# stats and eval-mode normalization that the UNFUSED chain (batch_norm
+# in FP32_FUNCS) never sees, breaking the fusion's numerically-invisible
+# contract under amp.init().  The predicate gets (operand_index, ndim)
+# and returns True for operands that cast to the target dtype; ndim >= 2
+# selects exactly the tensor operands (NCHW data, the conv weight) and
+# keeps every per-channel statistics/bias vector f32 (the kernel reads
+# scale/shift/bias in f32 regardless — ops/pallas/conv_fused.py).
+TARGET_DTYPE_OPERAND_POLICY = {
+    "batch_norm_relu_conv1x1": lambda idx, ndim: ndim >= 2,
+}
+
 # Elementwise combiners: promote all float inputs to the widest dtype.
 WIDEST_TYPE_CASTS = [
     "add", "subtract", "multiply", "true_divide", "divide", "mod",

@@ -519,8 +519,8 @@ class HybridBlock(Block):
 
     # -- export/deploy -----------------------------------------------------
     def export(self, path: str, epoch: int = 0,
-               input_signature: Optional[Sequence[tuple]] = None
-               ) -> Tuple[str, str]:
+               input_signature: Optional[Sequence[tuple]] = None,
+               dynamic_batch: bool = False) -> Tuple[str, str]:
         """Serialize a runnable program + params for deployment (reference:
         ``HybridBlock.export`` → ``prefix-symbol.json`` + ``.params``).
 
@@ -530,6 +530,12 @@ class HybridBlock(Block):
         per input; if omitted, the signature of the last hybridized call
         is used (so call the block once before exporting, as in the
         reference).
+
+        ``dynamic_batch=True`` traces the leading dim of every input as a
+        shape-polymorphic symbol: ONE serialized program answers every
+        batch size — what the serving layer's batch buckets run against
+        (a static artifact serves exactly its traced batch).  The batch
+        entry of ``input_signature`` is then only a placeholder.
         """
         import base64
         import json
@@ -558,8 +564,15 @@ class HybridBlock(Block):
         key_spec = jax.ShapeDtypeStruct((2,), jnp.uint32)
         param_specs = [jax.ShapeDtypeStruct(p.shape, p.dtype)
                        for p in param_list]
-        in_specs = [jax.ShapeDtypeStruct(tuple(s), d)
-                    for s, d in input_signature]
+        if dynamic_batch:
+            # one polymorphic symbol shared by every input's leading dim
+            # (inputs batch together); inner dims stay concrete
+            (bdim,) = jax_export.symbolic_shape("_b")
+            in_specs = [jax.ShapeDtypeStruct((bdim,) + tuple(s)[1:], d)
+                        for s, d in input_signature]
+        else:
+            in_specs = [jax.ShapeDtypeStruct(tuple(s), d)
+                        for s, d in input_signature]
         jitted = jax.jit(self._make_traced(param_list, False, cell))
         try:
             exp = jax_export.export(jitted, platforms=("cpu", "tpu"))(
@@ -580,6 +593,7 @@ class HybridBlock(Block):
             "framework": "mxnet_tpu",
             "format_version": 1,
             "block": type(self).__name__,
+            "dynamic_batch": bool(dynamic_batch),
             "inputs": [{"shape": list(s), "dtype": str(_np.dtype(d))}
                        for s, d in input_signature],
             "params": {k: {"shape": list(v.shape), "dtype": str(v.dtype)}

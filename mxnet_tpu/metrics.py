@@ -53,7 +53,7 @@ __all__ = [
     "Counter", "Gauge", "Histogram", "MetricsRegistry", "REGISTRY",
     "counter", "gauge", "histogram", "dump_json", "render_text",
     "reset", "value", "start_logger", "stop_logger",
-    "DEFAULT_BUCKETS",
+    "DEFAULT_BUCKETS", "exponential_buckets",
 ]
 
 register_env("MXNET_METRICS_LOG_INTERVAL", 0,
@@ -70,6 +70,18 @@ register_env("MXNET_METRICS_MAX_SERIES", 512,
 # everything from a single eager dispatch to a cold-compile train step.
 DEFAULT_BUCKETS: Tuple[float, ...] = tuple(
     1e-4 * (2.0 ** i) for i in range(20))
+
+
+def exponential_buckets(start: float, factor: float,
+                        count: int) -> Tuple[float, ...]:
+    """``count`` bucket bounds ``start, start*factor, ...`` — the
+    prometheus-client helper, for histograms whose domain is not the
+    DEFAULT_BUCKETS seconds range (e.g. serving batch sizes)."""
+    if start <= 0 or factor <= 1 or count < 1:
+        raise MXNetError(
+            f"exponential_buckets needs start>0, factor>1, count>=1; "
+            f"got ({start}, {factor}, {count})")
+    return tuple(start * (factor ** i) for i in range(count))
 
 
 def _validate_name(name: str) -> None:

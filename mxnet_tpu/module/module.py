@@ -432,6 +432,29 @@ class Module(BaseModule):
         eval_metric.update(_as_list(labels), outputs)
 
     # -- checkpointing ------------------------------------------------------
+    def export(self, prefix: str, epoch: int = 0,
+               dynamic_batch: bool = False) -> Tuple[str, str]:
+        """Write the serving/deploy artifact for this module's network
+        (``prefix-symbol.json`` + ``prefix-NNNN.params``) — the
+        inference-bind half of the classic workflow, aimed at
+        ``mxnet_tpu.serving.load_served`` / ``tools/serve.py``.  The
+        input signature comes from the bound data shapes;
+        ``dynamic_batch=True`` makes the artifact batch-polymorphic so
+        the serving batch buckets all run one program."""
+        if not self.params_initialized:
+            raise MXNetError("bind + init_params before export")
+        from ..gluon.block import HybridBlock
+        if not isinstance(self._block, HybridBlock):
+            raise MXNetError(
+                f"export needs a HybridBlock network; this module wraps "
+                f"a {type(self._block).__name__}")
+        sig = []
+        for d in self._data_shapes:
+            shape = tuple(d.shape) if hasattr(d, "shape") else tuple(d[1])
+            sig.append((shape, getattr(d, "dtype", _np.float32)))
+        return self._block.export(prefix, epoch, input_signature=sig,
+                                  dynamic_batch=dynamic_batch)
+
     def save_checkpoint(self, prefix: str, epoch: int,
                         save_optimizer_states: bool = False) -> None:
         arg, aux = self.get_params()

@@ -26,7 +26,8 @@ from .. import engine
 from .. import metrics as _metrics
 from .._tape import TapeNode, is_recording
 
-__all__ = ["invoke", "register_op", "get_op", "list_ops", "wrap_out"]
+__all__ = ["invoke", "register_op", "get_op", "list_ops", "wrap_out",
+           "exec_cache_stats"]
 
 # name -> {"fn": public python fn, "doc": ...}
 _OP_REGISTRY: Dict[str, Dict[str, Any]] = {}
@@ -303,6 +304,17 @@ def _harmonize_mesh_placement(arrays):
 def _fire_monitor_hooks(name, outputs) -> None:
     for hook in list(_monitor_state["hooks"].values()):
         hook(name, outputs)
+
+
+def exec_cache_stats() -> Dict[str, float]:
+    """Snapshot of the compile-cache surface for tools and the serving
+    health endpoint: per-op executable-cache size, eager-path hits, and
+    process-wide XLA backend compiles (the jax.monitoring miss counter —
+    covers hybridize/jit programs too, which is what serving warmup
+    bounds)."""
+    return {"size": len(_EXEC_CACHE),
+            "hits": _metrics.COMPILE_HITS.value,
+            "misses": _metrics.COMPILE_MISSES.value}
 
 
 def register_op(name: str, fn: Callable, doc: str = "") -> Callable:

@@ -1,0 +1,50 @@
+"""Inference serving — the missing half of the deployment story.
+
+Reference parity (leezu/mxnet): the reference pairs its training runtime
+with a standalone predict path (``src/c_predict_api.cc`` — load a
+symbol+params export, bind an inference-only executor, answer forwards
+with no Python).  It never shipped a *server*; model serving was left to
+MMS/TorchServe-era sidecars that called the predict API per request.
+
+Design (tpu-first): on TPU the two costs that dominate request serving
+are (1) per-request dispatch of tiny batches — the MXU is idle below
+batch ~8 — and (2) recompiles: every distinct input shape traced through
+XLA is a fresh multi-second compilation.  This subsystem addresses both
+in-process, with no dependencies beyond the stdlib:
+
+* :class:`~mxnet_tpu.serving.batching.BucketPolicy` — pad-to-bucket
+  shape policy: request batches round UP to a configured batch bucket
+  (and, opt-in, variable-length samples pad to a length bucket), so the
+  number of distinct compiled executables is bounded by the bucket grid,
+  not by traffic.
+* :class:`~mxnet_tpu.serving.batching.DynamicBatcher` — bounded request
+  queue + micro-batch assembly: flush on a full bucket or on the oldest
+  request's batching timeout; overload (queue full / deadline passed)
+  sheds requests with a structured :class:`OverloadError` instead of
+  piling latency onto everyone behind them.
+* :class:`~mxnet_tpu.serving.model.ServedModel` — the executable: an
+  ``export()`` artifact (StableHLO, incl. the ``dynamic_batch``
+  polymorphic form) or a live (Hybrid)Block/Module, behind one
+  ``predict(arrays) -> arrays`` surface with per-bucket compile
+  accounting and warmup.
+* :class:`~mxnet_tpu.serving.server.ModelServer` — composition +
+  lifecycle: worker thread, futures-based in-process API, metrics.
+* :mod:`~mxnet_tpu.serving.http` — a stdlib ``http.server`` front end
+  (``tools/serve.py``): POST /v1/inference, GET /metrics (Prometheus
+  text from the PR-1 registry), GET /healthz.
+
+Every stage publishes to :mod:`mxnet_tpu.metrics` (queue-depth gauge,
+batch-size / queue-wait / inference-latency histograms, shed counter by
+reason, per-bucket compile counter) — ``metrics_dump.py``-style
+observability works out of the box.
+"""
+from .batching import (BucketPolicy, DynamicBatcher, OverloadError,
+                       Request)
+from .model import ServedModel, load_served
+from .server import ModelServer
+from .http import make_http_server
+
+__all__ = [
+    "BucketPolicy", "DynamicBatcher", "OverloadError", "Request",
+    "ServedModel", "load_served", "ModelServer", "make_http_server",
+]

@@ -1,0 +1,195 @@
+"""Stdlib HTTP front end for :class:`~mxnet_tpu.serving.server.ModelServer`.
+
+Dependency-free by design (the container bakes no web framework): a
+``ThreadingHTTPServer`` whose per-connection threads block on serving
+futures — the batcher, not the HTTP layer, is the concurrency control.
+
+Endpoints:
+
+* ``POST /v1/inference`` — body ``{"instances": [sample, ...]}`` (each
+  sample a nested list matching the model's per-input sample shape; a
+  multi-input model takes ``[[in0, in1, ...], ...]``) or the one-sample
+  shorthand ``{"data": sample}``.  Optional ``"deadline_ms"``.  Replies
+  ``{"predictions": [...]}``.  Overload -> **429** with the structured
+  shed payload (reason, queue_depth, retry_after_ms) and a Retry-After
+  header; malformed input -> 400; model fault -> 500.
+* ``GET /metrics`` — Prometheus text from the process metrics registry
+  (queue depth, batch sizes, shed counts, per-bucket compiles, ...).
+* ``GET /healthz`` — liveness + queue/compile-cache snapshot.
+* ``GET /v1/model`` — model + bucket-policy description.
+"""
+from __future__ import annotations
+
+import json
+from http.server import BaseHTTPRequestHandler, ThreadingHTTPServer
+from typing import Any, List, Optional, Tuple
+
+import numpy as _np
+
+from ..base import MXNetError
+from .batching import OverloadError
+from .server import ModelServer
+
+__all__ = ["make_http_server"]
+
+_MAX_BODY = 64 * 1024 * 1024
+
+
+def _decode_samples(server: ModelServer, payload: Any
+                    ) -> Tuple[List[Tuple[_np.ndarray, ...]],
+                               Optional[float]]:
+    if not isinstance(payload, dict):
+        raise ValueError("body must be a JSON object")
+    deadline_ms = payload.get("deadline_ms")
+    if deadline_ms is not None and not isinstance(deadline_ms,
+                                                  (int, float)):
+        raise ValueError("deadline_ms must be a number")
+    if "instances" in payload:
+        raw = payload["instances"]
+        if not isinstance(raw, list) or not raw:
+            raise ValueError("'instances' must be a non-empty list")
+    elif "data" in payload:
+        raw = [payload["data"]]
+    else:
+        raise ValueError("body needs 'instances' or 'data'")
+    sig = server.model.input_signature
+    samples = []
+    for inst in raw:
+        parts = inst if len(sig) > 1 else [inst]
+        if len(parts) != len(sig):
+            raise ValueError(
+                f"each instance must carry {len(sig)} inputs")
+        samples.append(tuple(
+            _np.asarray(p, dtype=d) for p, (_, d) in zip(parts, sig)))
+    return samples, deadline_ms
+
+
+class _Handler(BaseHTTPRequestHandler):
+    server_version = "mxnet-tpu-serving/0.1"
+    protocol_version = "HTTP/1.1"
+
+    # the ModelServer rides on the HTTP server object (set in
+    # make_http_server)
+    @property
+    def _ms(self) -> ModelServer:
+        return self.server.model_server     # type: ignore[attr-defined]
+
+    def log_message(self, fmt: str, *args: Any) -> None:
+        if getattr(self.server, "verbose", False):
+            super().log_message(fmt, *args)
+
+    def _reply(self, code: int, body: Any,
+               content_type: str = "application/json",
+               headers: Optional[dict] = None) -> None:
+        data = body if isinstance(body, bytes) else \
+            json.dumps(body).encode()
+        self.send_response(code)
+        self.send_header("Content-Type", content_type)
+        self.send_header("Content-Length", str(len(data)))
+        for k, v in (headers or {}).items():
+            self.send_header(k, v)
+        self.end_headers()
+        self.wfile.write(data)
+
+    # -- GET ---------------------------------------------------------------
+    def do_GET(self) -> None:   # noqa: N802 - http.server API
+        try:
+            self._get()
+        except Exception as e:   # noqa: BLE001 - handler must answer
+            self._reply(500, {"error": "internal", "detail": str(e)})
+
+    def _get(self) -> None:
+        path = self.path.split("?", 1)[0]
+        if path == "/metrics":
+            from .. import metrics
+            self._reply(200, metrics.render_text().encode(),
+                        content_type="text/plain; version=0.0.4")
+        elif path == "/healthz":
+            d = self._ms.describe()
+            self._reply(200, {"status": "ok",
+                              "queue": d["queue"],
+                              "exec_cache": d["exec_cache"]})
+        elif path == "/v1/model":
+            self._reply(200, self._ms.describe())
+        else:
+            self._reply(404, {"error": "not_found", "path": path})
+
+    # -- POST --------------------------------------------------------------
+    def do_POST(self) -> None:  # noqa: N802 - http.server API
+        try:
+            self._post()
+        except Exception as e:   # noqa: BLE001 - handler must answer
+            self._reply(500, {"error": "internal", "detail": str(e)})
+
+    def _post(self) -> None:
+        path = self.path.split("?", 1)[0]
+        if path not in ("/v1/inference", "/invocations"):
+            self._reply(404, {"error": "not_found", "path": path})
+            return
+        try:
+            length = int(self.headers.get("Content-Length", 0))
+            if length <= 0 or length > _MAX_BODY:
+                raise ValueError(f"bad Content-Length {length}")
+            payload = json.loads(self.rfile.read(length))
+            samples, deadline_ms = _decode_samples(self._ms, payload)
+        except (TypeError, ValueError, json.JSONDecodeError) as e:
+            # TypeError covers valid-JSON-wrong-structure payloads
+            # (null data, scalar instances, ...): still the caller's bug
+            self._reply(400, {"error": "bad_request", "detail": str(e)})
+            return
+        futs: List[Any] = []
+
+        def _abandon() -> None:
+            # a partial failure abandons the sibling instances: cancel
+            # them so the worker skips the wasted compute
+            for f in futs:
+                f.cancel()
+
+        # submit phase: errors here are the CALLER's (shape/arity/
+        # over-long length -> 400) or backpressure (-> 429)
+        try:
+            for s in samples:
+                futs.append(self._ms.infer_async(
+                    *s, deadline_ms=deadline_ms))
+        except OverloadError as e:
+            _abandon()
+            self._reply(429, e.to_json(), headers={
+                "Retry-After": str(max(1, int(e.retry_after_ms / 1e3)))})
+            return
+        except MXNetError as e:
+            _abandon()
+            self._reply(400, {"error": "bad_request", "detail": str(e)})
+            return
+        # gather phase: deadline sheds are still 429; anything else is a
+        # server-side fault (500)
+        try:
+            preds = []
+            for f in futs:
+                out = f.result(timeout=60.0)
+                outs = out if isinstance(out, list) else [out]
+                vals = [o.tolist() for o in outs]
+                preds.append(vals[0] if len(vals) == 1 else vals)
+        except OverloadError as e:
+            _abandon()
+            self._reply(429, e.to_json(), headers={
+                "Retry-After": str(max(1, int(e.retry_after_ms / 1e3)))})
+            return
+        except Exception as e:   # noqa: BLE001 - request-scoped fault
+            _abandon()
+            self._reply(500, {"error": "inference_failed",
+                              "detail": str(e)})
+            return
+        self._reply(200, {"predictions": preds})
+
+
+def make_http_server(model_server: ModelServer, host: str = "127.0.0.1",
+                     port: int = 8080,
+                     verbose: bool = False) -> ThreadingHTTPServer:
+    """Bind the HTTP front end (``port=0`` picks a free port; the bound
+    address is ``httpd.server_address``).  Run with ``serve_forever()``;
+    the caller owns ``model_server.start()/stop()``."""
+    httpd = ThreadingHTTPServer((host, port), _Handler)
+    httpd.daemon_threads = True
+    httpd.model_server = model_server       # type: ignore[attr-defined]
+    httpd.verbose = verbose                 # type: ignore[attr-defined]
+    return httpd

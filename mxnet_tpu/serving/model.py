@@ -1,0 +1,243 @@
+"""ServedModel — one ``predict(arrays) -> arrays`` surface over every
+way a model reaches the server.
+
+Backends:
+
+* **export artifact** (``HybridBlock.export`` / ``Module.export`` output:
+  ``prefix-symbol.json`` + ``prefix-NNNN.params``): the StableHLO
+  program is deserialized once and called directly on raw arrays — the
+  ``c_predict_api`` analog, no gluon graph in the hot path.  An artifact
+  exported with ``dynamic_batch=True`` serves every batch bucket from
+  ONE serialized program (shape-polymorphic leading dim); a static
+  artifact pins the policy to its exported batch size.
+* **live block** (a (Hybrid)Block or Module): hybridized and driven in
+  predict mode — per-bucket executables appear through the normal jit
+  cache.  The path for models that never went through export (tests,
+  notebooks, zoo models).
+
+Both backends share per-bucket compile accounting: the first execution
+of each padded batch signature increments
+``mxnet_serving_bucket_compiles_total{bucket=...}`` — with a
+:class:`~mxnet_tpu.serving.batching.BucketPolicy` in front, that counter
+is bounded by the bucket grid, and :meth:`ServedModel.warmup` moves all
+of it to startup.
+"""
+from __future__ import annotations
+
+import json
+import os
+import threading
+import time
+from typing import Any, Dict, List, Optional, Sequence, Tuple
+
+import numpy as _np
+
+from ..base import MXNetError
+from .batching import BUCKET_COMPILES, BucketPolicy, INFER_SECONDS
+
+__all__ = ["ServedModel", "load_served"]
+
+
+def _sig_str(shapes: Sequence[Tuple[int, ...]]) -> str:
+    return ";".join("x".join(map(str, s)) for s in shapes)
+
+
+class ServedModel:
+    """A loaded inference model: ``predict`` over numpy batch arrays.
+
+    Build with :meth:`from_export`, :meth:`from_block`,
+    :meth:`from_module`, or the path-sniffing :func:`load_served`.
+    """
+
+    def __init__(self, fn: Any, input_signature: List[Tuple[Tuple[int, ...],
+                                                            Any]],
+                 fixed_batch: Optional[int], name: str) -> None:
+        self._fn = fn
+        # per-input (shape_without_batch, dtype) — what a single request
+        # sample must look like
+        self.input_signature = input_signature
+        # static exports serve exactly their traced batch size
+        self.fixed_batch = fixed_batch
+        self.name = name
+        # guarded: the worker thread adds while /healthz threads read
+        self._seen_lock = threading.Lock()
+        self._seen_buckets: set = set()
+
+    # -- constructors -------------------------------------------------------
+    @staticmethod
+    def from_export(symbol_file: str,
+                    param_file: Optional[str] = None) -> "ServedModel":
+        """Load an ``export()`` artifact for serving (the predict-API
+        path: StableHLO called directly, no gluon objects per request)."""
+        import base64
+
+        import jax
+        import jax.numpy as jnp
+        from jax import export as jax_export
+
+        with open(symbol_file) as f:
+            meta = json.load(f)
+        if meta.get("framework") != "mxnet_tpu" or "stablehlo" not in meta:
+            raise MXNetError(
+                f"{symbol_file} is not an mxnet_tpu export (re-export "
+                "with HybridBlock.export)")
+        if param_file is None:
+            param_file = _guess_param_file(symbol_file)
+        exp = jax_export.deserialize(
+            bytearray(base64.b64decode(meta["stablehlo"])))
+        order = meta["param_order"]
+        params: List[Any] = []
+        if order:
+            if param_file is None:
+                raise MXNetError(
+                    "this export has parameters — pass the "
+                    "prefix-NNNN.params file (or keep it next to the "
+                    "symbol json)")
+            from ..ndarray_io import load_params
+            loaded = load_params(param_file)
+            missing = [k for k in order if k not in loaded]
+            if missing:
+                raise MXNetError(
+                    f"{param_file} is missing exported params: {missing}")
+            params = [jnp.asarray(loaded[k]._data) for k in order]
+        key = jnp.zeros((2,), jnp.uint32)   # inference: dropout is off
+        dynamic = bool(meta.get("dynamic_batch"))
+        sig = [(tuple(i["shape"][1:]), _np.dtype(i["dtype"]))
+               for i in meta["inputs"]]
+        fixed = None if dynamic else int(meta["inputs"][0]["shape"][0])
+
+        def fn(arrays: Sequence[_np.ndarray]) -> List[_np.ndarray]:
+            jarrs = [jnp.asarray(a) for a in arrays]
+            leaves = exp.call(key, params, *jarrs)
+            return [_np.asarray(o) for o in leaves]
+
+        name = os.path.basename(symbol_file).replace("-symbol.json", "")
+        return ServedModel(fn, sig, fixed, name or "export")
+
+    @staticmethod
+    def from_block(block: Any,
+                   input_signature: Optional[Sequence[Tuple[
+                       Tuple[int, ...], Any]]] = None) -> "ServedModel":
+        """Serve a live (Hybrid)Block.  ``input_signature`` is per-input
+        (shape_without_batch, dtype); defaults to the block's last
+        hybridized call signature (run it once first)."""
+        from .. import autograd
+        from ..ndarray.ndarray import NDArray
+
+        if hasattr(block, "hybridize") and not getattr(block, "_active",
+                                                       False):
+            block.hybridize()
+        if input_signature is None:
+            last = getattr(block, "_last_sig", None)
+            if last is None:
+                raise MXNetError(
+                    "from_block needs the input signature: run the block "
+                    "once, or pass input_signature=[(sample_shape, "
+                    "dtype), ...] (shapes WITHOUT the batch dim)")
+            input_signature = [(tuple(s[1:]), d) for s, d in last]
+
+        def fn(arrays: Sequence[_np.ndarray]) -> List[_np.ndarray]:
+            import jax
+            nds = [NDArray(a) for a in arrays]
+            with autograd.predict_mode():
+                out = block(*nds)
+            leaves, _ = jax.tree_util.tree_flatten(
+                out, is_leaf=lambda o: isinstance(o, NDArray))
+            return [o.asnumpy() for o in leaves]
+
+        sig = [(tuple(s), _np.dtype(d)) for s, d in input_signature]
+        return ServedModel(fn, sig, None, type(block).__name__)
+
+    @staticmethod
+    def from_module(module: Any) -> "ServedModel":
+        """Serve a bound Module's network (inference half of the classic
+        workflow)."""
+        if not getattr(module, "params_initialized", False):
+            raise MXNetError("module must be bound + initialized before "
+                             "serving")
+        sig = [(tuple(d.shape[1:]) if hasattr(d, "shape")
+                else tuple(d[1][1:]),
+                getattr(d, "dtype", _np.float32))
+               for d in module._data_shapes]
+        return ServedModel.from_block(module.symbol, sig)
+
+    # -- execution ----------------------------------------------------------
+    def predict(self, arrays: Sequence[_np.ndarray]) -> List[_np.ndarray]:
+        """Run one padded batch; returns per-output numpy arrays (axis 0
+        = padded batch).  Tracks first-seen batch signatures as bucket
+        compiles and times the execution."""
+        shapes = tuple(tuple(a.shape) for a in arrays)
+        with self._seen_lock:
+            new = shapes not in self._seen_buckets
+            if new:
+                self._seen_buckets.add(shapes)
+        if new:
+            BUCKET_COMPILES.labels(bucket=_sig_str(shapes)).inc()
+        t0 = time.perf_counter()
+        out = self._fn(arrays)
+        INFER_SECONDS.observe(time.perf_counter() - t0)
+        return out
+
+    def warmup(self, policy: BucketPolicy) -> int:
+        """Pre-compile every bucket signature the policy can emit (zeros
+        input); returns how many signatures were warmed.  After this, a
+        request stream confined to the bucket grid never compiles."""
+        n = 0
+        for sig in policy.warmup_signatures(self.input_signature):
+            if self.fixed_batch is not None \
+                    and sig[0][0][0] != self.fixed_batch:
+                raise MXNetError(
+                    f"static export serves only batch={self.fixed_batch}; "
+                    f"configure BucketPolicy(batch_buckets="
+                    f"[{self.fixed_batch}]) (or re-export with "
+                    "dynamic_batch=True)")
+            self.predict([_np.zeros(s, d) for s, d in sig])
+            n += 1
+        return n
+
+    def default_policy(self, **kw: Any) -> BucketPolicy:
+        """A policy consistent with this model (static exports pin the
+        batch bucket to the exported batch)."""
+        if self.fixed_batch is not None and "batch_buckets" not in kw:
+            kw["batch_buckets"] = [self.fixed_batch]
+        return BucketPolicy(**kw)
+
+    def describe(self) -> Dict[str, Any]:
+        with self._seen_lock:
+            seen = list(self._seen_buckets)
+        return {
+            "name": self.name,
+            "inputs": [{"sample_shape": list(s), "dtype": str(d)}
+                       for s, d in self.input_signature],
+            "fixed_batch": self.fixed_batch,
+            "buckets_compiled": sorted(_sig_str(s) for s in seen),
+        }
+
+
+def _guess_param_file(symbol_file: str) -> Optional[str]:
+    """Newest ``prefix-NNNN.params`` next to ``prefix-symbol.json``."""
+    if not symbol_file.endswith("-symbol.json"):
+        return None
+    prefix = symbol_file[:-len("-symbol.json")]
+    cands = sorted(
+        f for f in (os.listdir(os.path.dirname(prefix) or ".") or [])
+        if f.startswith(os.path.basename(prefix) + "-")
+        and f.endswith(".params"))
+    if not cands:
+        return None
+    return os.path.join(os.path.dirname(prefix) or ".", cands[-1])
+
+
+def load_served(model: Any, param_file: Optional[str] = None,
+                **kw: Any) -> ServedModel:
+    """Sniff ``model`` into a :class:`ServedModel`: an export prefix or
+    ``-symbol.json`` path, a Module, or a (Hybrid)Block."""
+    if isinstance(model, str):
+        sym = model if model.endswith("-symbol.json") \
+            else f"{model}-symbol.json"
+        return ServedModel.from_export(sym, param_file)
+    if hasattr(model, "params_initialized"):        # Module duck-type
+        return ServedModel.from_module(model)
+    if hasattr(model, "collect_params"):            # gluon Block
+        return ServedModel.from_block(model, **kw)
+    raise MXNetError(f"cannot serve a {type(model).__name__}")

@@ -1,0 +1,229 @@
+"""ModelServer — batcher + model + one inference worker thread.
+
+The concurrency shape mirrors the device reality: ONE worker drains the
+queue and executes batches (a single accelerator runs one program at a
+time; a second in-flight batch would only queue inside the runtime),
+while any number of producer threads — the HTTP front end's
+per-connection threads, in-process callers — submit requests and wait on
+futures.  Backpressure is therefore explicit and bounded: the queue
+limit and the deadline are the only places a request can wait.
+"""
+from __future__ import annotations
+
+import threading
+import time
+from concurrent.futures import Future
+from typing import Any, Dict, List, Optional, Sequence
+
+import numpy as _np
+
+from ..base import MXNetError, getenv
+from .batching import (BucketPolicy, DynamicBatcher, OverloadError,
+                       REQUESTS_TOTAL, Request)
+from .model import ServedModel
+
+__all__ = ["ModelServer"]
+
+
+class ModelServer:
+    """Serve a :class:`~mxnet_tpu.serving.model.ServedModel` behind a
+    dynamic micro-batching queue.
+
+    In-process API::
+
+        server = ModelServer(load_served("model"), warmup=True)
+        server.start()
+        y = server.infer(x_np)               # blocking, one sample
+        fut = server.infer_async(x_np)       # concurrent.futures.Future
+        server.stop()
+
+    ``infer`` raises :class:`OverloadError` when the request is shed
+    (bounded queue / deadline) — callers back off; the server never
+    crashes or grows its queue without bound.
+    """
+
+    def __init__(self, model: ServedModel,
+                 policy: Optional[BucketPolicy] = None,
+                 timeout_ms: Optional[float] = None,
+                 queue_limit: Optional[int] = None,
+                 warmup: bool = False) -> None:
+        self.model = model
+        self.policy = policy if policy is not None \
+            else model.default_policy()
+        if model.fixed_batch is not None and \
+                tuple(self.policy.batch_buckets) != (model.fixed_batch,):
+            raise MXNetError(
+                f"static export serves only batch={model.fixed_batch}; "
+                f"the policy's batch_buckets must be "
+                f"[{model.fixed_batch}]")
+        self.batcher = DynamicBatcher(self.policy, timeout_ms=timeout_ms,
+                                      queue_limit=queue_limit)
+        self._default_deadline_s = \
+            float(getenv("MXNET_SERVING_DEADLINE_MS", 0)) / 1e3
+        self._thread: Optional[threading.Thread] = None
+        self._started = False
+        self.warmed = 0
+        if warmup:
+            self.warmed = model.warmup(self.policy)
+
+    # -- lifecycle ----------------------------------------------------------
+    def start(self) -> "ModelServer":
+        if self._started:
+            return self
+        if self.batcher._closed:
+            raise MXNetError(
+                "ModelServer cannot restart after stop(): the batcher is "
+                "closed (build a fresh ModelServer)")
+        self._started = True
+        self._thread = threading.Thread(target=self._run,
+                                        name="mxnet-serving-worker",
+                                        daemon=True)
+        self._thread.start()
+        return self
+
+    def stop(self, timeout: float = 10.0) -> None:
+        if not self._started:
+            return
+        self.batcher.close()
+        if self._thread is not None:
+            self._thread.join(timeout)
+        self._started = False
+
+    def __enter__(self) -> "ModelServer":
+        return self.start()
+
+    def __exit__(self, *exc: Any) -> None:
+        self.stop()
+
+    # -- request API --------------------------------------------------------
+    def infer_async(self, *sample: _np.ndarray,
+                    deadline_ms: Optional[float] = None) -> Future:
+        """Submit one sample (per-input arrays WITHOUT the batch dim);
+        returns a Future of the per-output arrays (list, or the single
+        array for single-output models)."""
+        if not self._started:
+            raise MXNetError("ModelServer.start() first")
+        arrays = [_np.asarray(a) for a in sample]
+        sig = self.model.input_signature
+        if len(arrays) != len(sig):
+            raise MXNetError(
+                f"model {self.model.name} takes {len(sig)} inputs, "
+                f"got {len(arrays)}")
+        for i, (a, (shape, dtype)) in enumerate(zip(arrays, sig)):
+            got = tuple(a.shape)
+            if i == 0 and self.policy.pad_axis is not None:
+                # only the bucketed axis may vary — every other dim must
+                # match, or each distinct wrong shape would become a
+                # fresh bucket key (an unbounded-compile hole) or be
+                # silently zero-padded into wrong answers
+                ax = self.policy.pad_axis
+                if len(got) != len(shape) or any(
+                        g != s for j, (g, s) in enumerate(zip(got, shape))
+                        if j != ax):
+                    raise MXNetError(
+                        f"sample shape {got} != model input {shape} "
+                        f"(batch dim excluded; only axis {ax} is "
+                        "length-bucketed)")
+            elif got != tuple(shape):
+                raise MXNetError(
+                    f"sample shape {got} != model input {tuple(shape)} "
+                    "(batch dim excluded); enable length bucketing "
+                    "(pad_axis/length_buckets) for variable-shape "
+                    "requests")
+        key = self.policy.bucket_key(arrays)
+        if deadline_ms is None and self._default_deadline_s > 0:
+            deadline_ms = self._default_deadline_s * 1e3
+        deadline_t = (time.monotonic() + deadline_ms / 1e3
+                      if deadline_ms else None)
+        fut: Future = Future()
+        self.batcher.submit(Request(arrays, key, fut, deadline_t))
+        return fut
+
+    def infer(self, *sample: _np.ndarray,
+              deadline_ms: Optional[float] = None,
+              timeout: float = 60.0) -> Any:
+        """Blocking single-sample inference (the in-process API)."""
+        return self.infer_async(*sample,
+                                deadline_ms=deadline_ms).result(timeout)
+
+    # -- worker -------------------------------------------------------------
+    def _run(self) -> None:
+        while True:
+            batch = self.batcher.next_batch()
+            if batch is None:
+                return
+            try:
+                self._execute(batch)
+            except Exception:   # noqa: BLE001 - the worker must outlive
+                # any per-batch surprise (a dead worker is a silently
+                # wedged server); per-request faults were already set
+                pass
+
+    def _execute(self, batch: List[Request]) -> None:
+        try:
+            arrays, _nb = self.policy.assemble(
+                [r.sample for r in batch], batch[0].key)
+            outs = self.model.predict(arrays)
+        except Exception as e:   # noqa: BLE001 - worker must survive
+            for r in batch:
+                if not r.future.done():
+                    r.future.set_exception(e)
+                    REQUESTS_TOTAL.labels(status="error").inc()
+            return
+        for i, r in enumerate(batch):
+            if r.future.done():
+                # cancelled (or shed) while queued/executing: a result
+                # set now would raise InvalidStateError
+                continue
+            rows = [o[i] for o in outs]
+            if self.policy.pad_axis is not None:
+                # slice length padding back off axis pad_axis of each
+                # output that still carries the padded extent
+                rows = self._strip_length(rows, r)
+            try:
+                r.future.set_result(rows[0] if len(rows) == 1 else rows)
+            except Exception:   # noqa: BLE001 - cancelled in the
+                continue        # done()->here window; keep distributing
+            REQUESTS_TOTAL.labels(status="ok").inc()
+
+    def _strip_length(self, rows: List[_np.ndarray],
+                      req: Request) -> List[_np.ndarray]:
+        """Heuristic by necessity: outputs carry no axis metadata, so an
+        output is taken to keep the length axis when it has at least the
+        sample's rank AND the padded extent at pad_axis.  Requiring the
+        full rank keeps reduced outputs (a pooled logits vector whose
+        size merely equals a bucket length) untouched."""
+        real = req.sample[0].shape[self.policy.pad_axis]
+        padded = req.key[0][0][self.policy.pad_axis]
+        if real == padded:
+            return rows
+        sample_ndim = req.sample[0].ndim
+        out = []
+        for o in rows:
+            ax = self.policy.pad_axis
+            if o.ndim >= sample_ndim and o.ndim > ax \
+                    and o.shape[ax] == padded:
+                sl = [slice(None)] * o.ndim
+                sl[ax] = slice(0, real)
+                o = o[tuple(sl)]
+            out.append(o)
+        return out
+
+    # -- introspection ------------------------------------------------------
+    def describe(self) -> Dict[str, Any]:
+        from ..ndarray.register import exec_cache_stats
+        return {
+            "model": self.model.describe(),
+            "policy": {
+                "batch_buckets": list(self.policy.batch_buckets),
+                "pad_axis": self.policy.pad_axis,
+                "length_buckets": (list(self.policy.length_buckets)
+                                   if self.policy.length_buckets else None),
+                "n_buckets": self.policy.n_buckets(),
+            },
+            "queue": {"depth": len(self.batcher),
+                      "limit": self.batcher.queue_limit,
+                      "batch_timeout_ms": self.batcher.timeout_s * 1e3},
+            "warmed_buckets": self.warmed,
+            "exec_cache": exec_cache_stats(),
+        }

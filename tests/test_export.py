@@ -131,6 +131,26 @@ def test_export_dict_output_structure(tmp_path):
                         expected["pair"][1].asnumpy(), rtol=1e-5, atol=1e-6)
 
 
+def test_export_dynamic_batch_roundtrip(tmp_path):
+    """dynamic_batch=True traces a shape-polymorphic leading dim: ONE
+    artifact answers every batch size through SymbolBlock.imports (and
+    the serving layer's batch buckets)."""
+    mx.random.seed(1)
+    net = _make_net()
+    x = mx.nd.random.normal(shape=(5, 12))
+    net.hybridize()
+    net(x)
+    sym_file, param_file = net.export(str(tmp_path / "dyn"),
+                                      dynamic_batch=True)
+    meta = json.load(open(sym_file))
+    assert meta["dynamic_batch"] is True
+    loaded = gluon.SymbolBlock.imports(sym_file, ["data"], param_file)
+    for n in (1, 3, 8):
+        xn = mx.nd.random.normal(shape=(n, 12))
+        assert_almost_equal(loaded(xn).asnumpy(), net(xn).asnumpy(),
+                            rtol=1e-5, atol=1e-6)
+
+
 def test_hybridize_cache_respects_amp_toggle():
     from mxnet_tpu import amp
     import numpy as onp2

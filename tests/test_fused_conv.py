@@ -293,6 +293,35 @@ def test_kernel_nondivisible_channels():
         rtol=1e-4, atol=1e-3)
 
 
+@pytest.mark.parametrize("affine", [True, False])
+def test_wgrad_ragged_lane_masking_large_m(affine):
+    """M>4096 takes _choose_blocks' tiled-m branch (block_m=512), and
+    M=4100 leaves a ragged 4-lane last block: m IS contracted in wgrad,
+    so garbage lanes must be zero-masked on BOTH operands or they enter
+    the dw sum (the branch at _wgrad_kernel's `if m_total % block_m`).
+    Previously only exercised implicitly; this pins it at the exact
+    shape class the issue names (interpret mode)."""
+    rng = onp.random.RandomState(12)
+    N, Ci, Co, M = 1, 8, 8, 4100
+    x3 = jnp.asarray(rng.randn(N, Ci, M).astype("float32"))
+    dy = jnp.asarray(rng.randn(N, Co, M).astype("float32") * 0.1)
+    if affine:
+        scale2 = jnp.asarray(
+            (rng.rand(Ci) + 0.5).astype("float32")).reshape(Ci, 1)
+        shift2 = jnp.asarray(
+            (rng.randn(Ci) * 0.1).astype("float32")).reshape(Ci, 1)
+        a = x3 * scale2.reshape(1, Ci, 1) + shift2.reshape(1, Ci, 1)
+    else:
+        scale2 = shift2 = None
+        a = x3
+    h = jnp.maximum(a, 0.0)
+    dw = cf._wgrad(x3, scale2, shift2, dy, True, True, jnp.float32)
+    dw_ref = jnp.einsum("nom,ncm->oc", dy, h)
+    assert onp.isfinite(onp.asarray(dw)).all()
+    onp.testing.assert_allclose(onp.asarray(dw), onp.asarray(dw_ref),
+                                rtol=1e-4, atol=1e-3)
+
+
 def test_npx_op_contracts():
     """The npx-level fused ops reject non-NCHW ranks with MXNetError,
     and the knob resolver honors explicit 0/1 and 'auto' semantics."""
@@ -324,11 +353,18 @@ def test_npx_op_contracts():
 
 def test_amp_cast_policy_covers_fused_ops():
     """Under amp.init, the fused junction must cast like the unfused
-    chain (data to the target dtype, like 'convolution') — toggling the
-    fusion knob may not change AMP dtype flow."""
-    from mxnet_tpu.amp.lists import TARGET_DTYPE_FUNCS
+    chain — data/weight to the target dtype (like 'convolution') but the
+    five BN-statistics operands kept f32 (like 'batch_norm' in
+    FP32_FUNCS; per-operand policy, ADVICE r5) — so toggling the fusion
+    knob may not change AMP dtype flow.  Tolerance is one bf16 ulp
+    (4e-3): stats rounding under the OLD whole-op cast showed up at
+    ~2e-2; kernel-vs-XLA accumulation order on chip stays within a
+    final-cast ulp."""
+    from mxnet_tpu.amp.lists import (TARGET_DTYPE_FUNCS,
+                                     TARGET_DTYPE_OPERAND_POLICY)
     assert "batch_norm_relu_conv1x1" in TARGET_DTYPE_FUNCS
     assert "relu_conv1x1" in TARGET_DTYPE_FUNCS
+    assert "batch_norm_relu_conv1x1" in TARGET_DTYPE_OPERAND_POLICY
 
     from mxnet_tpu import amp
     x = mx.np.array(
@@ -347,7 +383,29 @@ def test_amp_cast_policy_covers_fused_ops():
             _amp_state["active"] = False
             os.environ.pop("MXNET_FUSE_BN_CONV", None)
             mx.npx.conv_fusion_enabled()
-    onp.testing.assert_allclose(outs["1"], outs["0"], rtol=2e-2, atol=2e-2)
+    onp.testing.assert_allclose(outs["1"], outs["0"], rtol=4e-3, atol=1e-3)
+
+
+def test_amp_fused_op_keeps_bn_stats_f32():
+    """The per-operand policy in action: under amp the fused op's batch
+    mean/var come back f32 (running-stat precision), while the conv
+    output runs at the target dtype."""
+    from mxnet_tpu import amp
+    amp.init(target_dtype="bfloat16")
+    try:
+        out, mean, var = mx.npx.batch_norm_relu_conv1x1(
+            mx.np.array(onp.random.RandomState(9)
+                        .randn(1, 4, 5, 5).astype("float32")),
+            mx.np.ones((4,)), mx.np.zeros((4,)),
+            mx.np.zeros((4,)), mx.np.ones((4,)),
+            mx.np.array(onp.random.RandomState(10)
+                        .randn(6, 4, 1, 1).astype("float32")),
+            training=True)
+        assert "bfloat16" in str(out.dtype)
+        assert str(mean.dtype) == "float32"
+        assert str(var.dtype) == "float32"
+    finally:
+        amp.disable()
 
 
 def test_bottleneck_resnet_slice_parity():

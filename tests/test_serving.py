@@ -1,0 +1,601 @@
+"""Inference serving subsystem (mxnet_tpu/serving/): bucket policy,
+dynamic batcher + load shedding, ServedModel backends (live block /
+static + dynamic-batch export), ModelServer end to end, the stdlib HTTP
+front end, and the metrics it publishes.
+
+Reference analog: the c_predict_api tests covered load->forward->output
+parity; everything above that (batching, bucketing, backpressure) is
+beyond-reference serving behavior specified by ISSUE 2.
+"""
+import json
+import threading
+import time
+import urllib.error
+import urllib.request
+
+import numpy as onp
+import pytest
+
+import mxnet_tpu as mx
+from mxnet_tpu import metrics, serving
+from mxnet_tpu.gluon import nn
+from mxnet_tpu.serving import (BucketPolicy, DynamicBatcher, ModelServer,
+                               OverloadError, Request, ServedModel)
+from mxnet_tpu.serving.batching import REQUESTS_TOTAL
+from mxnet_tpu.test_utils import assert_almost_equal
+
+
+def _mlp(out=4, dim=12, seed=0):
+    mx.random.seed(seed)
+    net = nn.HybridSequential()
+    net.add(nn.Dense(8, activation="relu"), nn.Dense(out))
+    net.initialize()
+    net.hybridize()
+    net(mx.np.zeros((2, dim), dtype="float32"))
+    return net
+
+
+# ---------------------------------------------------------------------------
+# BucketPolicy
+# ---------------------------------------------------------------------------
+
+def test_bucket_policy_round_and_grid():
+    p = BucketPolicy(max_batch=8)
+    assert p.batch_buckets == (1, 2, 4, 8)
+    assert [p.round_batch(n) for n in (1, 2, 3, 5, 8)] == [1, 2, 4, 8, 8]
+    with pytest.raises(mx.MXNetError):
+        p.round_batch(9)
+    assert p.n_buckets() == 4
+    p2 = BucketPolicy(batch_buckets=(4, 1, 4), pad_axis=0,
+                      length_buckets=(16, 8))
+    assert p2.batch_buckets == (1, 4)
+    assert p2.n_buckets() == 4   # 2 batch x 2 length
+    with pytest.raises(mx.MXNetError):
+        BucketPolicy(pad_axis=0)             # buckets go together
+    with pytest.raises(mx.MXNetError):
+        BucketPolicy(batch_buckets=(0, 2))
+
+
+def test_bucket_policy_length_padding_and_assemble():
+    p = BucketPolicy(batch_buckets=(1, 2, 4), pad_axis=0,
+                     length_buckets=(4, 8))
+    s1 = (onp.ones((3, 5), "float32"),)
+    s2 = (onp.ones((4, 5), "float32") * 2,)
+    k1, k2 = p.bucket_key(s1), p.bucket_key(s2)
+    assert k1 == k2 == (((4, 5), "float32"),)
+    # over-long samples are rejected, not silently compiled
+    with pytest.raises(mx.MXNetError, match="length"):
+        p.bucket_key((onp.ones((9, 5), "float32"),))
+    arrays, nb = p.assemble([s1, s2, s1], k1)
+    assert nb == 4 and arrays[0].shape == (4, 4, 5)
+    # sample padding is pad_value (0); row padding repeats sample 0
+    assert arrays[0][0, 3].sum() == 0.0          # s1 padded 3->4
+    assert_almost_equal(arrays[0][3], arrays[0][0])   # repeated row
+    sigs = p.warmup_signatures([((4, 5), onp.float32)])
+    assert len(sigs) == p.n_buckets() == 6
+    assert sigs[0][0][0] == (1, 4, 5)
+
+
+# ---------------------------------------------------------------------------
+# DynamicBatcher
+# ---------------------------------------------------------------------------
+
+def _req(policy, val=1.0, shape=(3,), deadline_t=None):
+    from concurrent.futures import Future
+    sample = (onp.full(shape, val, "float32"),)
+    return Request(sample, policy.bucket_key(sample), Future(), deadline_t)
+
+
+def test_batcher_flushes_full_bucket_immediately():
+    p = BucketPolicy(batch_buckets=(1, 2, 4))
+    b = DynamicBatcher(p, timeout_ms=10_000, queue_limit=16)
+    for i in range(4):
+        b.submit(_req(p, i))
+    t0 = time.monotonic()
+    batch = b.next_batch()
+    assert len(batch) == 4               # full top bucket: no window wait
+    assert time.monotonic() - t0 < 1.0
+    assert len(b) == 0
+
+
+def test_batcher_flushes_partial_on_timeout():
+    p = BucketPolicy(batch_buckets=(1, 2, 4))
+    b = DynamicBatcher(p, timeout_ms=30, queue_limit=16)
+    b.submit(_req(p))
+    t0 = time.monotonic()
+    batch = b.next_batch()
+    assert len(batch) == 1
+    assert 0.02 <= time.monotonic() - t0 < 2.0
+
+
+def test_batcher_groups_by_bucket_key():
+    p = BucketPolicy(batch_buckets=(1, 2, 4))
+    b = DynamicBatcher(p, timeout_ms=1, queue_limit=16)
+    b.submit(_req(p, 1, shape=(3,)))
+    b.submit(_req(p, 2, shape=(5,)))     # different key
+    b.submit(_req(p, 3, shape=(3,)))
+    first = b.next_batch()
+    assert [r.sample[0].shape for r in first] == [(3,), (3,)]
+    second = b.next_batch()
+    assert [r.sample[0].shape for r in second] == [(5,)]
+
+
+def test_batcher_full_bucket_behind_head_flushes_first():
+    """A rare-shape head request must not hold a FULL common-shape
+    bucket hostage for its whole batching window."""
+    p = BucketPolicy(batch_buckets=(1, 2))
+    b = DynamicBatcher(p, timeout_ms=10_000, queue_limit=16)
+    b.submit(_req(p, 0, shape=(7,)))         # rare head
+    b.submit(_req(p, 1, shape=(3,)))
+    b.submit(_req(p, 2, shape=(3,)))         # fills the (3,) bucket
+    t0 = time.monotonic()
+    batch = b.next_batch()
+    assert time.monotonic() - t0 < 1.0       # no 10 s window wait
+    assert [r.sample[0].shape for r in batch] == [(3,), (3,)]
+    assert len(b) == 1                       # rare head still queued
+
+
+def test_batcher_sheds_on_queue_limit():
+    p = BucketPolicy(batch_buckets=(1,))
+    b = DynamicBatcher(p, timeout_ms=1000, queue_limit=2)
+    b.submit(_req(p))
+    b.submit(_req(p))
+    shed_before = metrics.value("mxnet_serving_shed_total",
+                                reason="queue_full")
+    r3 = _req(p)
+    with pytest.raises(OverloadError) as ei:
+        b.submit(r3)
+    assert ei.value.reason == "queue_full"
+    assert ei.value.queue_depth == 2
+    assert ei.value.to_json()["error"] == "overloaded"
+    assert r3.future.exception() is ei.value     # future carries it too
+    assert metrics.value("mxnet_serving_shed_total",
+                         reason="queue_full") == shed_before + 1
+
+
+def test_batcher_sheds_expired_deadline_at_dequeue():
+    p = BucketPolicy(batch_buckets=(1, 2))
+    b = DynamicBatcher(p, timeout_ms=1, queue_limit=8)
+    dead = _req(p, deadline_t=time.monotonic() - 0.01)   # already late
+    live = _req(p)
+    b.submit(dead)
+    b.submit(live)
+    batch = b.next_batch()
+    assert batch == [live]
+    assert isinstance(dead.future.exception(), OverloadError)
+    assert dead.future.exception().reason == "deadline"
+
+
+def test_batcher_close_fails_queued_requests():
+    p = BucketPolicy(batch_buckets=(1,))
+    b = DynamicBatcher(p, timeout_ms=10_000, queue_limit=8)
+    r = _req(p)
+    b.submit(r)
+    b.close()
+    assert isinstance(r.future.exception(), mx.MXNetError)
+    with pytest.raises(mx.MXNetError):
+        b.submit(_req(p))
+
+
+# ---------------------------------------------------------------------------
+# ServedModel + ModelServer end to end
+# ---------------------------------------------------------------------------
+
+def test_server_batches_concurrent_requests_exactly():
+    net = _mlp()
+    x = onp.random.RandomState(0).randn(16, 12).astype("float32")
+    ref = net(mx.np.array(x)).asnumpy()
+    model = serving.load_served(net)
+    srv = ModelServer(model, model.default_policy(max_batch=8),
+                      timeout_ms=5, warmup=True)
+    assert srv.warmed == 4
+    c0 = metrics.hist_stats("mxnet_serving_batch_size")
+    with srv:
+        futs = [srv.infer_async(x[i]) for i in range(16)]
+        for i, f in enumerate(futs):
+            assert_almost_equal(f.result(30.0), ref[i], rtol=1e-5,
+                                atol=1e-5)
+    c1 = metrics.hist_stats("mxnet_serving_batch_size")
+    n_batches = c1[1] - c0[1]
+    assert n_batches < 16                  # actually batched
+    assert (c1[0] - c0[0]) == 16           # every request in some batch
+
+
+def test_server_infer_rejects_wrong_shape_and_arity():
+    net = _mlp()
+    model = serving.load_served(net)
+    with ModelServer(model, model.default_policy(max_batch=2)) as srv:
+        with pytest.raises(mx.MXNetError, match="sample shape"):
+            srv.infer(onp.zeros((7,), "float32"))
+        with pytest.raises(mx.MXNetError, match="inputs"):
+            srv.infer(onp.zeros((12,), "float32"),
+                      onp.zeros((12,), "float32"))
+
+
+def test_server_survives_model_fault():
+    calls = {"n": 0}
+
+    class Faulty:
+        input_signature = [((3,), onp.dtype("float32"))]
+        fixed_batch = None
+        name = "faulty"
+
+        def default_policy(self, **kw):
+            return BucketPolicy(batch_buckets=(1, 2), **kw)
+
+        def predict(self, arrays):
+            calls["n"] += 1
+            if calls["n"] == 1:
+                raise RuntimeError("boom")
+            return [arrays[0] * 2]
+
+    with ModelServer(Faulty(), timeout_ms=1) as srv:
+        with pytest.raises(RuntimeError, match="boom"):
+            srv.infer(onp.ones((3,), "float32"))
+        out = srv.infer(onp.ones((3,), "float32"))   # server still up
+        assert_almost_equal(out, onp.full((3,), 2.0, "float32"))
+
+
+def test_server_overload_sheds_and_recovers():
+    net = _mlp()
+    inner = serving.load_served(net)
+
+    class Slow:
+        def __getattr__(self, k):
+            return getattr(inner, k)
+
+        def predict(self, arrays):
+            time.sleep(0.03)
+            return inner.predict(arrays)
+
+    x = onp.zeros((12,), "float32")
+    srv = ModelServer(Slow(), inner.default_policy(batch_buckets=(1, 2)),
+                      timeout_ms=1, queue_limit=4)
+    with srv:
+        futs, shed = [], 0
+        for _ in range(16):      # 4x the queue limit
+            try:
+                futs.append(srv.infer_async(x))
+            except OverloadError as e:
+                assert e.reason == "queue_full" and e.retry_after_ms >= 0
+                shed += 1
+        assert shed > 0
+        done = [f for f in futs if f.exception(timeout=60.0) is None]
+        assert len(done) == len(futs)      # queued ones all served
+        srv.infer(x, timeout=60.0)          # alive after the flood
+    assert metrics.value("mxnet_serving_requests_total",
+                         status="shed") >= shed
+
+
+def test_server_deadline_sheds_queued_request():
+    net = _mlp()
+    inner = serving.load_served(net)
+
+    class Slow:
+        def __getattr__(self, k):
+            return getattr(inner, k)
+
+        def predict(self, arrays):
+            time.sleep(0.05)
+            return inner.predict(arrays)
+
+    x = onp.zeros((12,), "float32")
+    srv = ModelServer(Slow(), inner.default_policy(batch_buckets=(1,)),
+                      timeout_ms=0, queue_limit=32)
+    with srv:
+        first = srv.infer_async(x)                       # occupies worker
+        doomed = srv.infer_async(x, deadline_ms=1.0)     # expires queued
+        assert first.exception(timeout=60.0) is None
+        exc = doomed.exception(timeout=60.0)
+        if exc is not None:   # served only if the worker beat the clock
+            assert isinstance(exc, OverloadError)
+            assert exc.reason == "deadline"
+
+
+def test_server_survives_cancelled_future():
+    """A caller cancelling a pending future must not kill the worker
+    (set_result on a done future raises InvalidStateError)."""
+    net = _mlp()
+    inner = serving.load_served(net)
+
+    class Slow:
+        def __getattr__(self, k):
+            return getattr(inner, k)
+
+        def predict(self, arrays):
+            time.sleep(0.02)
+            return inner.predict(arrays)
+
+    x = onp.zeros((12,), "float32")
+    with ModelServer(Slow(), inner.default_policy(batch_buckets=(1,)),
+                     timeout_ms=0) as srv:
+        srv.infer_async(x)                  # occupies the worker
+        doomed = srv.infer_async(x)
+        assert doomed.cancel()              # pending -> cancellable
+        out = srv.infer(x, timeout=60.0)    # worker still alive
+        assert out.shape == (4,)
+
+
+def test_server_rejects_non_bucketed_dim_mismatch():
+    """With length bucketing on, every NON-bucketed dim is still
+    validated — a stream of wrong widths must not mint unbounded bucket
+    keys (or silently zero-pad into wrong answers)."""
+    mx.random.seed(8)
+    net = nn.HybridSequential()
+    net.add(nn.Dense(3, flatten=False))
+    net.initialize()
+    net.hybridize()
+    net(mx.np.zeros((1, 4, 5), dtype="float32"))
+    model = ServedModel.from_block(
+        net, input_signature=[((4, 5), "float32")])
+    policy = model.default_policy(batch_buckets=(1, 2), pad_axis=0,
+                                  length_buckets=(4, 8))
+    with ModelServer(model, policy, timeout_ms=1) as srv:
+        with pytest.raises(mx.MXNetError, match="length-bucketed"):
+            srv.infer(onp.zeros((4, 7), "float32"))   # wrong width
+        with pytest.raises(mx.MXNetError, match="length-bucketed"):
+            srv.infer(onp.zeros((4,), "float32"))     # wrong rank
+
+
+def test_server_refuses_restart_after_stop():
+    net = _mlp()
+    model = serving.load_served(net)
+    srv = ModelServer(model, model.default_policy(batch_buckets=(1,)))
+    srv.start()
+    srv.stop()
+    with pytest.raises(mx.MXNetError, match="restart"):
+        srv.start()
+
+
+# ---------------------------------------------------------------------------
+# export artifacts: static + dynamic batch
+# ---------------------------------------------------------------------------
+
+def test_static_export_serves_its_batch_only(tmp_path):
+    net = _mlp()
+    x = onp.random.RandomState(1).randn(4, 12).astype("float32")
+    ref = net(mx.np.array(x)).asnumpy()
+    net.export(str(tmp_path / "m"), input_signature=[((4, 12),
+                                                      "float32")])
+    model = serving.load_served(str(tmp_path / "m"))
+    assert model.fixed_batch == 4
+    policy = model.default_policy()
+    assert policy.batch_buckets == (4,)
+    with pytest.raises(mx.MXNetError, match="static export"):
+        ModelServer(model, BucketPolicy(batch_buckets=(1, 4)))
+    with ModelServer(model, policy, timeout_ms=2, warmup=True) as srv:
+        futs = [srv.infer_async(x[i]) for i in range(4)]
+        for i, f in enumerate(futs):
+            assert_almost_equal(f.result(30.0), ref[i], rtol=1e-5,
+                                atol=1e-5)
+        # a lone request still answers: padded up to the export batch
+        assert_almost_equal(srv.infer(x[0]), ref[0], rtol=1e-5,
+                            atol=1e-5)
+
+
+def test_dynamic_batch_export_serves_all_buckets(tmp_path):
+    net = _mlp()
+    x = onp.random.RandomState(2).randn(8, 12).astype("float32")
+    ref = net(mx.np.array(x)).asnumpy()
+    sym, par = net.export(str(tmp_path / "d"), dynamic_batch=True)
+    assert json.load(open(sym))["dynamic_batch"] is True
+    model = serving.load_served(str(tmp_path / "d"))
+    assert model.fixed_batch is None
+    policy = model.default_policy(batch_buckets=(1, 2, 4))
+    with ModelServer(model, policy, timeout_ms=4, warmup=True) as srv:
+        assert srv.warmed == 3
+        misses0 = metrics.value("mxnet_compile_misses_total")
+        futs = [srv.infer_async(x[i]) for i in range(8)]
+        for i, f in enumerate(futs):
+            assert_almost_equal(f.result(30.0), ref[i], rtol=1e-5,
+                                atol=1e-5)
+        # the bucket grid was warmed: the mixed stream compiled NOTHING
+        assert metrics.value("mxnet_compile_misses_total") == misses0
+
+
+def test_length_bucketing_pads_and_strips(tmp_path):
+    """Variable-length requests pad to length buckets and outputs slice
+    back to the real extent; a padding-insensitive model (row-wise Dense)
+    returns identical rows."""
+    mx.random.seed(3)
+    net = nn.HybridSequential()
+    net.add(nn.Dense(6, activation="relu", flatten=False),
+            nn.Dense(3, flatten=False))
+    net.initialize()
+    net.hybridize()
+    net(mx.np.zeros((1, 4, 5), dtype="float32"))
+    model = ServedModel.from_block(
+        net, input_signature=[((4, 5), "float32")])
+    policy = model.default_policy(batch_buckets=(1, 2, 4), pad_axis=0,
+                                  length_buckets=(4, 8))
+    with ModelServer(model, policy, timeout_ms=3, warmup=True) as srv:
+        assert srv.warmed == 6
+        rng = onp.random.RandomState(4)
+        for L in (2, 4, 5, 8):
+            x = rng.randn(L, 5).astype("float32")
+            out = srv.infer(x)
+            assert out.shape == (L, 3)
+            ref = net(mx.np.array(x[None])).asnumpy()[0]
+            assert_almost_equal(out, ref, rtol=1e-5, atol=1e-5)
+        with pytest.raises(mx.MXNetError, match="length"):
+            srv.infer(rng.randn(9, 5).astype("float32"))
+
+
+def test_module_export_roundtrips_through_serving(tmp_path):
+    """Module.export -> load_served: the classic-workflow inference
+    artifact feeds the server."""
+    from mxnet_tpu.io import DataDesc
+    net = nn.HybridSequential()
+    net.add(nn.Dense(5))
+    mod = mx.mod.Module(net, context=mx.cpu())
+    mod.bind(data_shapes=[DataDesc("data", (2, 7))],
+             label_shapes=[DataDesc("softmax_label", (2,))])
+    mod.init_params()
+    sym, par = mod.export(str(tmp_path / "mod"), dynamic_batch=True)
+    x = onp.random.RandomState(5).randn(3, 7).astype("float32")
+    ref = net(mx.np.array(x)).asnumpy()
+    model = serving.load_served(str(tmp_path / "mod"))
+    with ModelServer(model, model.default_policy(batch_buckets=(1, 2, 4)),
+                     warmup=True) as srv:
+        for i in range(3):
+            assert_almost_equal(srv.infer(x[i]), ref[i], rtol=1e-5,
+                                atol=1e-5)
+
+
+# ---------------------------------------------------------------------------
+# HTTP front end
+# ---------------------------------------------------------------------------
+
+@pytest.fixture
+def http_server():
+    net = _mlp()
+    model = serving.load_served(net)
+    srv = ModelServer(model, model.default_policy(max_batch=4),
+                      timeout_ms=3, warmup=True).start()
+    httpd = serving.make_http_server(srv, port=0)
+    th = threading.Thread(target=httpd.serve_forever, daemon=True)
+    th.start()
+    base = f"http://127.0.0.1:{httpd.server_address[1]}"
+    yield base, srv, net
+    httpd.shutdown()
+    srv.stop()
+
+
+def _post(url, payload):
+    req = urllib.request.Request(
+        url, data=json.dumps(payload).encode(),
+        headers={"Content-Type": "application/json"})
+    with urllib.request.urlopen(req, timeout=30) as r:
+        return r.status, json.loads(r.read())
+
+
+def test_http_inference_and_introspection(http_server):
+    base, srv, net = http_server
+    x = onp.random.RandomState(6).randn(3, 12).astype("float32")
+    ref = net(mx.np.array(x)).asnumpy()
+    code, body = _post(f"{base}/v1/inference",
+                       {"instances": x.tolist()})
+    assert code == 200
+    assert_almost_equal(onp.asarray(body["predictions"], "float32"), ref,
+                        rtol=1e-5, atol=1e-5)
+    # one-sample shorthand
+    code, body = _post(f"{base}/v1/inference", {"data": x[0].tolist()})
+    assert code == 200
+    assert_almost_equal(onp.asarray(body["predictions"], "float32"),
+                        ref[0], rtol=1e-5, atol=1e-5)
+
+    with urllib.request.urlopen(f"{base}/metrics", timeout=30) as r:
+        text = r.read().decode()
+    for fam in ("mxnet_serving_queue_depth", "mxnet_serving_batch_size",
+                "mxnet_serving_requests_total",
+                "mxnet_serving_bucket_compiles_total"):
+        assert fam in text, fam
+
+    with urllib.request.urlopen(f"{base}/healthz", timeout=30) as r:
+        h = json.loads(r.read())
+    assert h["status"] == "ok" and "exec_cache" in h
+
+    with urllib.request.urlopen(f"{base}/v1/model", timeout=30) as r:
+        info = json.loads(r.read())
+    assert info["policy"]["batch_buckets"] == [1, 2, 4]
+    assert info["model"]["inputs"][0]["sample_shape"] == [12]
+
+
+def test_http_bad_request_and_not_found(http_server):
+    base, _, _ = http_server
+    with pytest.raises(urllib.error.HTTPError) as ei:
+        _post(f"{base}/v1/inference", {"wrong": 1})
+    assert ei.value.code == 400
+    assert json.loads(ei.value.read())["error"] == "bad_request"
+    # submit-phase MXNetError (wrong sample shape) is a CALLER bug: 400,
+    # not a retryable 500
+    with pytest.raises(urllib.error.HTTPError) as ei:
+        _post(f"{base}/v1/inference", {"data": [1.0, 2.0]})
+    assert ei.value.code == 400
+    assert json.loads(ei.value.read())["error"] == "bad_request"
+    # valid JSON, wrong structure (null data): 400, not a dropped socket
+    with pytest.raises(urllib.error.HTTPError) as ei:
+        _post(f"{base}/v1/inference", {"data": None})
+    assert ei.value.code == 400
+    with pytest.raises(urllib.error.HTTPError) as ei:
+        urllib.request.urlopen(f"{base}/nope", timeout=30)
+    assert ei.value.code == 404
+
+
+def test_http_overload_returns_429_with_retry_after():
+    net = _mlp()
+    inner = serving.load_served(net)
+
+    class Slow:
+        def __getattr__(self, k):
+            return getattr(inner, k)
+
+        def predict(self, arrays):
+            time.sleep(0.05)
+            return inner.predict(arrays)
+
+    srv = ModelServer(Slow(), inner.default_policy(batch_buckets=(1,)),
+                      timeout_ms=0, queue_limit=1).start()
+    httpd = serving.make_http_server(srv, port=0)
+    th = threading.Thread(target=httpd.serve_forever, daemon=True)
+    th.start()
+    base = f"http://127.0.0.1:{httpd.server_address[1]}"
+    try:
+        x = onp.zeros((12,), "float32").tolist()
+        codes = []
+
+        def hit():
+            try:
+                codes.append(_post(f"{base}/v1/inference",
+                                   {"data": x})[0])
+            except urllib.error.HTTPError as e:
+                body = json.loads(e.read())
+                codes.append((e.code, body.get("reason"),
+                              e.headers.get("Retry-After")))
+
+        ts = [threading.Thread(target=hit) for _ in range(8)]
+        for t in ts:
+            t.start()
+        for t in ts:
+            t.join()
+        sheds = [c for c in codes if isinstance(c, tuple)]
+        assert any(c == 200 for c in codes)
+        assert sheds, codes
+        code, reason, retry = sheds[0]
+        assert code == 429 and reason == "queue_full"
+        assert retry is not None and int(retry) >= 1
+    finally:
+        httpd.shutdown()
+        srv.stop()
+
+
+# ---------------------------------------------------------------------------
+# metrics helper + counters
+# ---------------------------------------------------------------------------
+
+def test_exponential_buckets_helper():
+    assert metrics.exponential_buckets(1, 2, 4) == (1, 2, 4, 8)
+    with pytest.raises(mx.MXNetError):
+        metrics.exponential_buckets(0, 2, 4)
+    with pytest.raises(mx.MXNetError):
+        metrics.exponential_buckets(1, 1, 4)
+
+
+def test_serving_metrics_account_every_request():
+    net = _mlp()
+    model = serving.load_served(net)
+    base_ok = metrics.value("mxnet_serving_requests_total", status="ok")
+    wait0 = metrics.hist_stats("mxnet_serving_queue_wait_seconds")
+    inf0 = metrics.hist_stats("mxnet_serving_inference_seconds")
+    with ModelServer(model, model.default_policy(max_batch=4),
+                     timeout_ms=2) as srv:
+        x = onp.zeros((12,), "float32")
+        for _ in range(5):
+            srv.infer(x)
+    assert metrics.value("mxnet_serving_requests_total",
+                         status="ok") == base_ok + 5
+    assert metrics.hist_stats(
+        "mxnet_serving_queue_wait_seconds")[1] == wait0[1] + 5
+    assert metrics.hist_stats(
+        "mxnet_serving_inference_seconds")[1] > inf0[1]
+    assert metrics.value("mxnet_serving_queue_depth") == 0.0

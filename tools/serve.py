@@ -1,0 +1,125 @@
+"""Serve an exported model over HTTP — the production front door.
+
+Loads an ``export()`` artifact (or a freshly-initialized zoo model, for
+tire-kicking without a training run), wraps it in the serving
+subsystem's dynamically-batched, shape-bucketed, load-shedding
+``ModelServer`` (``mxnet_tpu/serving/``), pre-compiles every configured
+bucket, and answers on a stdlib HTTP server:
+
+    python tools/serve.py model                 # model-symbol.json + .params
+    python tools/serve.py --zoo resnet18_v1 --input-shape 3,32,32
+    python tools/serve.py model --port 8080 --max-batch 16 \
+        --batch-timeout-ms 3 --queue-limit 512
+
+    curl -s localhost:8080/v1/inference -d '{"instances": [[...]]}'
+    curl -s localhost:8080/metrics          # Prometheus text
+    curl -s localhost:8080/healthz
+
+Knobs default from the MXNET_SERVING_* env tier (docs/serving.md).
+Static exports serve exactly their traced batch size; export with
+``dynamic_batch=True`` for the full bucket grid.
+"""
+import argparse
+import os
+import sys
+
+sys.path.insert(0, os.path.dirname(os.path.dirname(os.path.abspath(__file__))))
+
+
+def main(argv=None) -> None:
+    ap = argparse.ArgumentParser(description=__doc__.splitlines()[0])
+    ap.add_argument("model", nargs="?",
+                    help="export prefix (or the -symbol.json path)")
+    ap.add_argument("--params", default=None,
+                    help="explicit .params file (default: newest next to "
+                         "the symbol json)")
+    ap.add_argument("--zoo", default=None,
+                    help="serve a freshly-initialized model_zoo model "
+                         "instead of an export (smoke/demo)")
+    ap.add_argument("--classes", type=int, default=10)
+    ap.add_argument("--input-shape", default="3,32,32",
+                    help="zoo sample shape WITHOUT batch (default "
+                         "3,32,32)")
+    ap.add_argument("--host", default="0.0.0.0")
+    ap.add_argument("--port", type=int, default=8080)
+    ap.add_argument("--max-batch", type=int, default=None)
+    ap.add_argument("--batch-buckets", default=None,
+                    help="comma list, e.g. 1,2,4,8 (default: powers of "
+                         "two up to --max-batch)")
+    ap.add_argument("--batch-timeout-ms", type=float, default=None)
+    ap.add_argument("--queue-limit", type=int, default=None)
+    ap.add_argument("--pad-axis", type=int, default=None,
+                    help="sample axis for length bucketing (variable-"
+                         "shape requests; model must tolerate padding)")
+    ap.add_argument("--length-buckets", default=None,
+                    help="comma list of padded lengths for --pad-axis")
+    ap.add_argument("--no-warmup", action="store_true",
+                    help="skip pre-compiling the bucket grid at startup")
+    ap.add_argument("--platform", choices=("cpu", "ambient"),
+                    default="ambient",
+                    help="force the CPU backend, or keep the "
+                         "environment's (default)")
+    ap.add_argument("--verbose", action="store_true",
+                    help="log every HTTP request")
+    args = ap.parse_args(argv)
+
+    if args.platform == "cpu":
+        import jax
+        jax.config.update("jax_platforms", "cpu")
+
+    from mxnet_tpu import serving
+
+    if args.zoo:
+        import mxnet_tpu as mx
+        from mxnet_tpu.gluon.model_zoo import vision as zoo
+        shape = tuple(int(s) for s in args.input_shape.split(","))
+        net = zoo.get_model(args.zoo, classes=args.classes)
+        net.initialize()
+        net.hybridize()
+        net(mx.np.zeros((1,) + shape, dtype="float32"))
+        model = serving.load_served(net)
+    elif args.model:
+        model = serving.load_served(args.model, param_file=args.params)
+    else:
+        ap.error("pass an export prefix or --zoo NAME")
+
+    kw = {}
+    if args.batch_buckets:
+        kw["batch_buckets"] = [int(b) for b in
+                               args.batch_buckets.split(",")]
+    elif args.max_batch:
+        kw["max_batch"] = args.max_batch
+    if args.length_buckets:
+        kw["pad_axis"] = args.pad_axis if args.pad_axis is not None else 0
+        kw["length_buckets"] = [int(b) for b in
+                                args.length_buckets.split(",")]
+    policy = model.default_policy(**kw)
+
+    print(f"model: {model.name}  inputs: "
+          f"{[list(s) for s, _ in model.input_signature]}  "
+          f"batch buckets: {list(policy.batch_buckets)}"
+          + (f"  length buckets: {list(policy.length_buckets)}"
+             if policy.length_buckets else ""))
+    server = serving.ModelServer(model, policy,
+                                 timeout_ms=args.batch_timeout_ms,
+                                 queue_limit=args.queue_limit,
+                                 warmup=not args.no_warmup)
+    if server.warmed:
+        print(f"warmup: {server.warmed} bucket signatures pre-compiled")
+    server.start()
+    httpd = serving.make_http_server(server, args.host, args.port,
+                                     verbose=args.verbose)
+    host, port = httpd.server_address[:2]
+    print(f"serving on http://{host}:{port}  "
+          f"(POST /v1/inference, GET /metrics, /healthz, /v1/model)")
+    try:
+        httpd.serve_forever()
+    except KeyboardInterrupt:
+        pass
+    finally:
+        httpd.shutdown()
+        server.stop()
+
+
+if __name__ == "__main__":
+    main()
