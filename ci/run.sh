@@ -4,13 +4,19 @@
 # point. Usage:
 #
 #   ci/run.sh native        # build libmxtpu.so + run the C++ test binary
-#   ci/run.sh tier1         # docs-freshness gate + serving smoke + the
-#                           #   tier-1 pytest selection (the driver's
-#                           #   acceptance run)
+#   ci/run.sh tier1         # docs-freshness gates + serving smoke +
+#                           #   chaos smoke + the tier-1 pytest
+#                           #   selection (the driver's acceptance run)
 #   ci/run.sh envdoc        # docs/env_vars.md staleness check alone
+#   ci/run.sh faultdoc      # every faults.py site named in
+#                           #   docs/fault_tolerance.md
 #   ci/run.sh serving-smoke # tools/serve_bench.py --smoke alone
 #                           #   (batching wins / bounded compiles /
 #                           #   shed-not-crash)
+#   ci/run.sh chaos-smoke   # bounded fault-injection/preemption proof
+#                           #   (tests/test_faults.py -k smoke)
+#   ci/run.sh chaos         # full chaos suite incl. SIGKILL/SIGTERM
+#                           #   subprocess resume proofs
 #   ci/run.sh unit          # full Python suite on the 8-dev virtual mesh
 #   ci/run.sh dist          # real multi-process launcher tests
 #   ci/run.sh exec-cache    # suite subset with the per-op executable
@@ -59,11 +65,44 @@ run_serving_smoke() {
   JAX_PLATFORMS=cpu python tools/serve_bench.py --smoke
 }
 
+run_faultdoc() {
+  echo "== faultdoc: every fault-injection site documented in"
+  echo "   docs/fault_tolerance.md"
+  JAX_PLATFORMS=cpu python - <<'EOF'
+import sys
+from mxnet_tpu import faults
+with open("docs/fault_tolerance.md") as f:
+    doc = f.read()
+missing = sorted(s for s in faults.known_sites() if s not in doc)
+if missing:
+    sys.exit(f"fault sites missing from docs/fault_tolerance.md: "
+             f"{missing} - document them (the site table is "
+             f"faults.known_sites())")
+print(f"faultdoc: all {len(faults.known_sites())} sites documented")
+EOF
+}
+
+run_chaos_smoke() {
+  echo "== chaos-smoke: bounded (~60s) fault-injection / preemption /"
+  echo "   checkpoint-fallback / kvstore-timeout proof"
+  JAX_PLATFORMS=cpu timeout 300 python -m pytest tests/test_faults.py \
+    -k smoke -q -p no:cacheprovider
+}
+
+run_chaos() {
+  echo "== chaos: the full fault-tolerance suite, including the"
+  echo "   SIGKILL/SIGTERM subprocess resume proofs"
+  JAX_PLATFORMS=cpu python -m pytest tests/test_faults.py -q \
+    -p no:cacheprovider
+}
+
 run_tier1() {
-  echo "== tier1: env-doc freshness + serving smoke + the tier-1"
-  echo "   pytest selection"
+  echo "== tier1: env-doc freshness + fault-site doc lint + serving"
+  echo "   smoke + chaos smoke + the tier-1 pytest selection"
   run_envdoc
+  run_faultdoc
   run_serving_smoke
+  run_chaos_smoke
   JAX_PLATFORMS=cpu python -m pytest tests/ -q -m 'not slow' \
     --continue-on-collection-errors -p no:cacheprovider
 }
@@ -152,7 +191,10 @@ case "$variant" in
   native)       run_native ;;
   tier1)        run_tier1 ;;
   envdoc)       run_envdoc ;;
+  faultdoc)     run_faultdoc ;;
   serving-smoke) run_serving_smoke ;;
+  chaos-smoke)  run_chaos_smoke ;;
+  chaos)        run_chaos ;;
   unit)         run_unit ;;
   dist)         run_dist ;;
   exec-cache)   run_exec_cache ;;

@@ -72,6 +72,9 @@ _LAZY = {
     "rtc": ".rtc",
     "serving": ".serving",
     "checkpoint": ".checkpoint",
+    "faults": ".faults",
+    "retry": ".retry",
+    "preemption": ".preemption",
     "name": ".name",
     "attribute": ".attribute",
     "visualization": ".visualization",

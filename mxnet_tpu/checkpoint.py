@@ -1,10 +1,24 @@
-"""Checkpoint management — restart-safe training state.
+"""Checkpoint management — restart-safe, corruption-detecting training
+state.
 
 Reference parity (leezu/mxnet): ``mod.save_checkpoint`` / epoch-numbered
 ``prefix-000N.params`` files + ``Trainer.save_states`` (SURVEY.md 5.4),
 and the 5.3 blueprint note that the TPU build's failure story is
-checkpoint-restart: this manager adds atomicity (tmp + rename), a
-``latest`` pointer, keep-last-k retention, and one-call resume.
+checkpoint-restart: this manager adds atomicity (tmp + fsync + rename),
+a ``latest`` pointer, keep-last-k retention, one-call resume — and,
+because preemption/crash mid-save is a ROUTINE event on preemptible TPU
+capacity, durability hardening:
+
+* every staged file (and the directory) is **fsynced** before the
+  rename, so a power cut after ``save()`` returns cannot surface a
+  half-written checkpoint;
+* ``checkpoint.json`` records a **SHA-256 per file**; ``restore()``
+  verifies the digests and falls back to the newest checkpoint that
+  verifies (``mxnet_checkpoint_restore_fallbacks_total`` counts this) —
+  a truncated latest checkpoint is a recoverable event, not a dead run;
+* retention never prunes the **last verified-good** checkpoint;
+* orphaned staging tempdirs left by a crash between ``mkdtemp`` and the
+  renames are swept on ``__init__``.
 
 Works with anything exposing ``save_checkpoint(prefix)`` /
 ``load_checkpoint(prefix)`` (SPMDTrainer), or a (block, trainer) pair
@@ -12,19 +26,79 @@ Works with anything exposing ``save_checkpoint(prefix)`` /
 """
 from __future__ import annotations
 
+import hashlib
 import json
 import os
+import re
 import shutil
 import tempfile
-from typing import Any, List, Optional, Tuple
+import time
+from typing import Any, Dict, List, Optional
 
 from .base import MXNetError
+from . import metrics as _metrics
+from . import faults as _faults
 
 __all__ = ["CheckpointManager"]
 
+# Staging dirs carry a recognizable prefix so the orphan sweep can never
+# touch user data; plain 'tmpXXXXXXXX' dirs (pre-hardening staging) are
+# swept too.  Only dirs older than _ORPHAN_MIN_AGE_S are swept: a
+# preempted trainer may still be writing its final checkpoint while the
+# replacement process constructs its manager — a LIVE staging dir has a
+# fresh mtime and must survive; a genuinely crash-orphaned one is swept
+# by any later manager init.
+_STAGING_PREFIX = "ckpt-staging-"
+_LEGACY_STAGING = re.compile(r"^tmp[a-z0-9_]{8}$")
+_ORPHAN_MIN_AGE_S = 300.0
+
+CHECKPOINT_SAVES = _metrics.counter(
+    "mxnet_checkpoint_saves_total",
+    "Checkpoints written by CheckpointManager.save.")
+CHECKPOINT_SAVE_SECONDS = _metrics.histogram(
+    "mxnet_checkpoint_save_seconds",
+    "Wall time of CheckpointManager.save (stage + fsync + rename + "
+    "prune).")
+CHECKPOINT_CORRUPT = _metrics.counter(
+    "mxnet_checkpoint_corrupt_total",
+    "Checkpoints that failed SHA-256 verification on restore (missing "
+    "or truncated/garbled files).")
+CHECKPOINT_FALLBACKS = _metrics.counter(
+    "mxnet_checkpoint_restore_fallbacks_total",
+    "restore() calls that skipped a corrupt newer checkpoint and loaded "
+    "an older verified one.")
+CHECKPOINT_ORPHANS = _metrics.counter(
+    "mxnet_checkpoint_orphan_sweeps_total",
+    "Orphaned staging tempdirs (crash mid-save) removed by the "
+    "CheckpointManager __init__ sweep.")
+
+
+def _fsync_dir(path: str) -> None:
+    """fsync a directory so renames/creates inside it are durable; best
+    effort on filesystems without directory fds."""
+    try:
+        fd = os.open(path, os.O_RDONLY)
+    except OSError:
+        return
+    try:
+        os.fsync(fd)
+    except OSError:
+        pass
+    finally:
+        os.close(fd)
+
+
+def _sha256_file(path: str) -> str:
+    h = hashlib.sha256()
+    with open(path, "rb") as f:
+        for chunk in iter(lambda: f.read(1 << 20), b""):
+            h.update(chunk)
+    return h.hexdigest()
+
 
 class CheckpointManager:
-    """Numbered, atomic, self-pruning checkpoints under ``directory``."""
+    """Numbered, atomic, self-pruning, self-verifying checkpoints under
+    ``directory``."""
 
     def __init__(self, directory: str, max_to_keep: int = 5) -> None:
         if max_to_keep < 1:
@@ -32,6 +106,28 @@ class CheckpointManager:
         self.directory = directory
         self.max_to_keep = max_to_keep
         os.makedirs(directory, exist_ok=True)
+        self._sweep_orphan_staging()
+
+    def _sweep_orphan_staging(self) -> None:
+        """Remove staging dirs a crashed save() left behind (nothing in
+        them was ever referenced by checkpoint.json).  Dirs younger than
+        ``_ORPHAN_MIN_AGE_S`` are left alone — they may belong to a
+        preempted process still finishing its final save."""
+        now = time.time()
+        for entry in os.listdir(self.directory):
+            if not (entry.startswith(_STAGING_PREFIX)
+                    or _LEGACY_STAGING.match(entry)):
+                continue
+            path = os.path.join(self.directory, entry)
+            if not os.path.isdir(path):
+                continue
+            try:
+                if now - os.path.getmtime(path) < _ORPHAN_MIN_AGE_S:
+                    continue
+            except OSError:
+                continue                # vanished mid-scan: done
+            shutil.rmtree(path, ignore_errors=True)
+            CHECKPOINT_ORPHANS.inc()
 
     # -- bookkeeping -------------------------------------------------------
     def _meta_path(self) -> str:
@@ -40,15 +136,21 @@ class CheckpointManager:
     def _read_meta(self) -> dict:
         try:
             with open(self._meta_path()) as f:
-                return json.load(f)
+                meta = json.load(f)
         except (OSError, ValueError):
-            return {"checkpoints": []}
+            meta = {}
+        meta.setdefault("checkpoints", [])
+        meta.setdefault("digests", {})
+        return meta
 
     def _write_meta(self, meta: dict) -> None:
         tmp = self._meta_path() + ".tmp"
         with open(tmp, "w") as f:
             json.dump(meta, f)
+            f.flush()
+            os.fsync(f.fileno())
         os.replace(tmp, self._meta_path())
+        _fsync_dir(self.directory)
 
     @property
     def checkpoints(self) -> List[int]:
@@ -62,6 +164,35 @@ class CheckpointManager:
     def _prefix(self, step: int) -> str:
         return os.path.join(self.directory, f"ckpt-{step:07d}")
 
+    # -- verification ------------------------------------------------------
+    def verify(self, step: int, meta: Optional[dict] = None) -> bool:
+        """True when checkpoint ``step``'s files are present and match
+        their recorded SHA-256 digests.  Pre-hardening checkpoints
+        (no digest record) verify by file existence alone."""
+        if meta is None:
+            meta = self._read_meta()
+        digests: Dict[str, str] = meta["digests"].get(str(step), {})
+        prefix = self._prefix(step)
+        if not digests:
+            # legacy checkpoint: any file with this prefix counts
+            stem = os.path.basename(prefix)
+            return any(f.startswith(stem + ".")
+                       for f in os.listdir(self.directory))
+        for suffix, want in digests.items():
+            path = prefix + suffix
+            try:
+                if _sha256_file(path) != want:
+                    return False
+            except OSError:
+                return False
+        return True
+
+    def _last_verified(self, meta: dict) -> Optional[int]:
+        for step in reversed(meta["checkpoints"]):
+            if self.verify(step, meta):
+                return step
+        return None
+
     # -- save / restore ----------------------------------------------------
     def save(self, target: Any, step: int,
              block: Optional[Any] = None) -> str:
@@ -71,9 +202,13 @@ class CheckpointManager:
         or a gluon Trainer when ``block`` is given (block params +
         trainer states).
         """
+        t0 = time.perf_counter()
         # stage into a temp dir in the same filesystem, then rename files
-        staging = tempfile.mkdtemp(dir=self.directory)
+        staging = tempfile.mkdtemp(prefix=_STAGING_PREFIX,
+                                   dir=self.directory)
+        digests: Dict[str, str] = {}
         try:
+            _faults.maybe_fault("checkpoint.write", step=step)
             stage_prefix = os.path.join(staging, "ckpt")
             if hasattr(target, "save_checkpoint"):
                 target.save_checkpoint(stage_prefix)
@@ -84,37 +219,85 @@ class CheckpointManager:
                 raise MXNetError(
                     "target needs save_checkpoint(), or pass block=")
             final = self._prefix(step)
-            for fname in os.listdir(staging):
+            for fname in sorted(os.listdir(staging)):
+                path = os.path.join(staging, fname)
+                # digest + fsync BEFORE the rename: after save()
+                # returns, the bytes the digest covers are the bytes on
+                # disk, crash or no crash
+                digests[fname[len("ckpt"):]] = _sha256_file(path)
+                with open(path, "rb") as f:
+                    os.fsync(f.fileno())
+            for fname in sorted(os.listdir(staging)):
                 suffix = fname[len("ckpt"):]
                 os.replace(os.path.join(staging, fname), final + suffix)
+            _fsync_dir(self.directory)
         finally:
             shutil.rmtree(staging, ignore_errors=True)
 
         meta = self._read_meta()
         meta["checkpoints"] = [s for s in meta["checkpoints"]
                                if s != step] + [step]
+        meta["digests"][str(step)] = digests
+        # retention: the just-saved step is verified-good by construction
+        # (its digests were computed from the staged, fsynced bytes), so
+        # pruning oldest-first while keeping it can never remove the last
+        # verified checkpoint
         while len(meta["checkpoints"]) > self.max_to_keep:
-            old = meta["checkpoints"].pop(0)
+            old = next(s for s in meta["checkpoints"] if s != step)
+            meta["checkpoints"].remove(old)
+            meta["digests"].pop(str(old), None)
             for f in os.listdir(self.directory):
                 # match 'ckpt-NNNNNNN.<suffix>' exactly — a bare prefix
                 # would also delete longer step numbers it prefixes
                 if f.startswith(f"ckpt-{old:07d}."):
-                    os.remove(os.path.join(self.directory, f))
+                    try:
+                        os.remove(os.path.join(self.directory, f))
+                    except FileNotFoundError:
+                        # pruned concurrently / already gone: retention
+                        # is best-effort, never fatal to a save
+                        pass
         self._write_meta(meta)
+        CHECKPOINT_SAVES.inc()
+        CHECKPOINT_SAVE_SECONDS.observe(time.perf_counter() - t0)
         return self._prefix(step)
 
     def restore(self, target: Any, step: Optional[int] = None,
                 block: Optional[Any] = None) -> Optional[int]:
-        """Load checkpoint ``step`` (default: latest). Returns the step
-        restored, or None if the directory has no checkpoints (fresh
-        start)."""
+        """Load checkpoint ``step`` (default: newest VERIFIED).  Returns
+        the step restored, or None if the directory has no checkpoints
+        (fresh start).  A corrupt newer checkpoint (crash mid-write,
+        truncation) is skipped with a fallback counter bump; if every
+        checkpoint fails verification, raises."""
+        meta = self._read_meta()
         if step is None:
-            step = self.latest_step
-            if step is None:
+            cks = meta["checkpoints"]
+            if not cks:
                 return None
-        elif step not in self.checkpoints:
-            raise MXNetError(f"no checkpoint for step {step}; have "
-                             f"{self.checkpoints}")
+            step = self._last_verified(meta)
+            if step is None:
+                CHECKPOINT_CORRUPT.inc(len(cks))
+                raise MXNetError(
+                    f"all {len(cks)} checkpoints in {self.directory} "
+                    "failed SHA-256 verification — no safe state to "
+                    "resume from")
+            if step != cks[-1]:
+                skipped = [s for s in cks if s > step]
+                CHECKPOINT_CORRUPT.inc(len(skipped))
+                CHECKPOINT_FALLBACKS.inc()
+                import logging
+                logging.getLogger("mxnet_tpu.checkpoint").warning(
+                    "checkpoint(s) %s failed verification (truncated or "
+                    "garbled); falling back to verified step %d",
+                    skipped, step)
+        else:
+            if step not in meta["checkpoints"]:
+                raise MXNetError(f"no checkpoint for step {step}; have "
+                                 f"{meta['checkpoints']}")
+            if not self.verify(step, meta):
+                CHECKPOINT_CORRUPT.inc()
+                raise MXNetError(
+                    f"checkpoint {step} failed SHA-256 verification "
+                    "(truncated or garbled on disk)")
         prefix = self._prefix(step)
         if hasattr(target, "load_checkpoint"):
             target.load_checkpoint(prefix)

@@ -89,9 +89,33 @@ class Estimator:
     def fit(self, train_data: Any, val_data: Any = None,
             epochs: Optional[int] = None,
             event_handlers: Optional[Sequence[Any]] = None,
-            batches: Optional[int] = None) -> None:
+            batches: Optional[int] = None,
+            checkpoint_manager: Any = None,
+            checkpoint_every: int = 0) -> None:
+        """Train; with ``checkpoint_manager`` the call is preemption-
+        safe: the newest verified checkpoint is restored before the
+        first batch, a checkpoint is written every ``checkpoint_every``
+        steps (0: only at the end / on preemption), and a
+        SIGTERM/SIGINT finishes the in-flight batch, checkpoints, and
+        returns cleanly.  Idempotence under kill-and-restart holds for
+        ``batches``-mode, where ``batches`` counts TOTAL optimizer
+        steps across restarts; ``epochs``-mode resumes the weights and
+        optimizer state but restarts its epoch count (epoch progress is
+        not recorded in the checkpoint) — prefer ``batches`` for
+        preemptible jobs."""
         if epochs is None and batches is None:
             raise MXNetError("fit: specify epochs or batches")
+        resumed = 0
+        if checkpoint_manager is not None:
+            if checkpoint_manager.restore(self.trainer,
+                                          block=self.net) is not None:
+                # Trainer.load_states restored the optimizer's schedule
+                # clock — the global step across restarts
+                resumed = int(self.trainer._optimizer.num_update)
+            if batches is not None:
+                batches = batches - resumed
+                if batches <= 0:
+                    return      # a completed run's rerun is a no-op
         self.max_epoch = epochs
         self.max_batch = batches
 
@@ -114,50 +138,81 @@ class Estimator:
 
         import time
         from .... import metrics as _metrics
+        from ....preemption import PreemptionGuard
+
+        last_saved = [-1]
+
+        def _save_checkpoint() -> None:
+            step = int(self.trainer._optimizer.num_update)
+            if step == last_saved[0]:
+                return                  # already checkpointed this step
+            checkpoint_manager.save(self.trainer, step=step,
+                                    block=self.net)
+            last_saved[0] = step
 
         stop = False
-        while not stop:
-            for h in epoch_begin:
-                h.epoch_begin(self)
-            # explicit iteration so the loader wait is a measured phase:
-            # per-step time splits into data-wait (next(it)), dispatch
-            # (forward/backward/update — returns with device work still
-            # in flight), and device-sync (batch_end handlers fetch loss
-            # and update metrics, blocking on results)
-            it = iter(train_data)
-            while True:
-                t0 = time.perf_counter()
-                try:
-                    batch = next(it)
-                except StopIteration:
-                    break
-                data, label = _as_nd(batch[0]), _as_nd(batch[1])
-                t_data = time.perf_counter()
-                for h in batch_begin:
-                    h.batch_begin(self, batch=batch)
-                with autograd.record():
-                    pred = self.net(data)
-                    loss = self.loss(pred, label)
-                loss.backward()
-                self.trainer.step(data.shape[0])
-                t_dispatch = time.perf_counter()
-                for h in batch_end:
-                    if h.batch_end(self, batch=batch, pred=pred,
-                                   label=label, loss=loss):
+        with PreemptionGuard() as guard:
+            while not stop:
+                for h in epoch_begin:
+                    h.epoch_begin(self)
+                # explicit iteration so the loader wait is a measured
+                # phase: per-step time splits into data-wait (next(it)),
+                # dispatch (forward/backward/update — returns with
+                # device work still in flight), and device-sync
+                # (batch_end handlers fetch loss and update metrics,
+                # blocking on results)
+                it = iter(train_data)
+                while True:
+                    t0 = time.perf_counter()
+                    try:
+                        batch = next(it)
+                    except StopIteration:
+                        break
+                    data, label = _as_nd(batch[0]), _as_nd(batch[1])
+                    t_data = time.perf_counter()
+                    for h in batch_begin:
+                        h.batch_begin(self, batch=batch)
+                    with autograd.record():
+                        pred = self.net(data)
+                        loss = self.loss(pred, label)
+                    loss.backward()
+                    self.trainer.step(data.shape[0])
+                    t_dispatch = time.perf_counter()
+                    for h in batch_end:
+                        if h.batch_end(self, batch=batch, pred=pred,
+                                       label=label, loss=loss):
+                            stop = True
+                    t_end = time.perf_counter()
+                    _metrics.record_step(t_end - t0,
+                                         data=t_data - t0,
+                                         dispatch=t_dispatch - t_data,
+                                         sync=t_end - t_dispatch)
+                    _metrics.record_device_highwater()
+                    if guard.requested:
+                        # preemption: the in-flight batch finished —
+                        # checkpoint and leave cleanly; the next
+                        # incarnation of fit() resumes here
+                        if checkpoint_manager is not None:
+                            _save_checkpoint()
                         stop = True
-                t_end = time.perf_counter()
-                _metrics.record_step(t_end - t0,
-                                     data=t_data - t0,
-                                     dispatch=t_dispatch - t_data,
-                                     sync=t_end - t_dispatch)
-                _metrics.record_device_highwater()
-                if stop:
+                    elif (checkpoint_manager is not None
+                          and checkpoint_every > 0
+                          and int(self.trainer._optimizer.num_update)
+                          % checkpoint_every == 0):
+                        _save_checkpoint()
+                    if stop:
+                        break
+                for h in epoch_end:
+                    if h.epoch_end(self):
+                        stop = True
+                if self.max_epoch is None and self.max_batch is None:
                     break
-            for h in epoch_end:
-                if h.epoch_end(self):
-                    stop = True
-            if self.max_epoch is None and self.max_batch is None:
-                break
+            if checkpoint_manager is not None:
+                # final checkpoint (dedup'd by step): covers BOTH normal
+                # completion and a signal landing after the last batch's
+                # in-loop guard check — the run must never finish N
+                # batches yet leave zero checkpoints behind
+                _save_checkpoint()
 
         for h in train_end:
             h.train_end(self)

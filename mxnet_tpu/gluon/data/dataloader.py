@@ -165,6 +165,11 @@ def _batch_to_np(batch: Any) -> Any:
 
 
 def _worker_fn(indices: List[int]) -> Any:
+    # chaos site: MXNET_FAULT_PLAN rides into spawned workers via the
+    # env snapshot, so 'dataloader.worker:kind=crash' kills a real
+    # worker process mid-job (kind=error propagates through the pool)
+    from ...faults import maybe_fault
+    maybe_fault("dataloader.worker", batch_size=len(indices))
     samples = [_WORKER_DATASET[i] for i in indices]
     if _WORKER_BATCHIFY is not None:
         return _batch_to_np(_WORKER_BATCHIFY(samples))
@@ -266,6 +271,8 @@ class DataLoader:
                 return False
             if self._thread_pool:
                 def thread_job(idx):
+                    from ...faults import maybe_fault
+                    maybe_fault("dataloader.worker", batch_size=len(idx))
                     samples = [self._dataset[i] for i in idx]
                     if self._custom_batchify is not None:
                         return self._custom_batchify(samples)
@@ -281,7 +288,21 @@ class DataLoader:
                 break
         while pending:
             job = pending.popleft()
-            batch = job.get(self._timeout)
+            try:
+                batch = job.get(self._timeout)
+            except multiprocessing.TimeoutError:
+                # a worker killed (OOM, SIGKILL) while holding this job
+                # loses it forever — the pool respawns the worker but
+                # never re-runs in-flight work.  Translate the bare
+                # TimeoutError into a structured, actionable error
+                # instead of letting the caller guess.
+                raise MXNetError(
+                    f"DataLoader batch not ready after {self._timeout}s "
+                    "(DataLoader(timeout=...)): a worker process likely "
+                    "died mid-job (killed/OOM) and its batch is lost; "
+                    "the pool respawned the worker but in-flight jobs "
+                    "do not recover — re-create the DataLoader iterator "
+                    "to retry this epoch")
             submit()
             yield _to_ndarray(batch)
 

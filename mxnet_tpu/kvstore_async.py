@@ -49,9 +49,26 @@ from typing import Any, Dict, List, Optional, Sequence
 
 import numpy as onp
 
-from .base import MXNetError
+from .base import MXNetError, getenv, register_env
+from . import faults as _faults
+from . import metrics as _metrics
+from .retry import retry_call
 
 __all__ = ["PSServer", "KVStoreDistAsync", "run_server"]
+
+register_env(
+    "MXNET_PS_RECV_TIMEOUT", 300,
+    "Per-reply socket timeout (seconds) for dist_async worker RPCs: a "
+    "silently dead parameter server surfaces as a structured, "
+    "rank-naming error after this long instead of hanging the worker "
+    "forever. Generous by default; 0 restores the old infinite wait. "
+    "Barrier RPCs automatically widen to MXNET_PS_BARRIER_TIMEOUT.")
+
+PS_RECV_TIMEOUTS = _metrics.counter(
+    "mxnet_ps_recv_timeouts_total",
+    "dist_async worker RPCs that timed out waiting for a parameter-"
+    "server reply (MXNET_PS_RECV_TIMEOUT) and raised a structured "
+    "error.")
 
 _MAGIC = b"MXPS"
 # Slice-subkey separator for PSKV big-array slicing.  Contains the ASCII
@@ -317,6 +334,7 @@ class PSServer:
         self._barrier_cv = threading.Condition(self._barrier_lock)
         self._barrier_count = 0
         self._barrier_gen = 0
+        self._barrier_ranks: set = set()
         self.pushes = 0
 
     def _lock_for(self, key: str) -> threading.Lock:
@@ -439,11 +457,23 @@ class PSServer:
         if cmd == b"B":                          # barrier over all workers
             timeout = float(os.environ.get(
                 "MXNET_PS_BARRIER_TIMEOUT", "600"))
+            rank = header.get("rank")
             with self._barrier_cv:
                 gen = self._barrier_gen
                 self._barrier_count += 1
-                if self._barrier_count >= self.num_workers:
+                if rank is not None:
+                    self._barrier_ranks.add(int(rank))
+                # release on DISTINCT ranks when clients send them: a
+                # replayed 'B' after a transient connection drop must
+                # not double-count one worker and release the barrier
+                # early (raw count is the pre-hardening fallback)
+                arrived_all = (len(self._barrier_ranks)
+                               if self._barrier_ranks
+                               else self._barrier_count) \
+                    >= self.num_workers
+                if arrived_all:
                     self._barrier_count = 0
+                    self._barrier_ranks = set()
                     self._barrier_gen += 1
                     self._barrier_cv.notify_all()
                 else:
@@ -451,9 +481,18 @@ class PSServer:
                         lambda: self._barrier_gen != gen, timeout=timeout)
                     if not ok:
                         self._barrier_count -= 1
+                        # name the missing ranks: "who is holding the
+                        # job up" is THE question during an incident
+                        arrived = sorted(self._barrier_ranks)
+                        if rank is not None:
+                            self._barrier_ranks.discard(int(rank))
+                        missing = sorted(
+                            set(range(self.num_workers)) - set(arrived))
                         raise MXNetError(
-                            f"barrier timed out after {timeout:.0f}s "
-                            f"waiting for {self.num_workers} workers "
+                            f"barrier timed out after {timeout:.0f}s: "
+                            f"{len(arrived)}/{self.num_workers} workers "
+                            f"arrived (ranks {arrived}), missing ranks "
+                            f"{missing} "
                             "(MXNET_PS_BARRIER_TIMEOUT to raise)")
             return b"K", {}, b""
         if cmd == b"Q":                          # stats (introspection)
@@ -541,31 +580,46 @@ class KVStoreDistAsync:
         self.push_wire_bytes = 0
 
     # -- plumbing ----------------------------------------------------------
+    @staticmethod
+    def _recv_timeout() -> float:
+        return float(getenv("MXNET_PS_RECV_TIMEOUT", 300))
+
+    def _drop_sock(self, sidx: int) -> None:
+        if self._socks[sidx] is not None:
+            try:
+                self._socks[sidx].close()
+            except OSError:
+                pass
+            self._socks[sidx] = None
+
     def _sock(self, sidx: int) -> socket.socket:
         s = self._socks[sidx]
         if s is None:
             # the server process imports the framework (jax) before it
-            # listens — allow for a slow cold start on a loaded machine
-            deadline = time.time() + float(
+            # listens — allow for a slow cold start on a loaded machine,
+            # with jittered exponential backoff so a worker fleet does
+            # not hammer a restarting server in lockstep
+            connect_s = float(
                 os.environ.get("MXNET_PS_CONNECT_TIMEOUT", "120"))
-            last: Optional[Exception] = None
-            while time.time() < deadline:
-                try:
-                    s = socket.create_connection(
-                        (self.uri, self.port + sidx), timeout=30)
-                    # blocking from here on: a barrier reply may take up
-                    # to MXNET_PS_BARRIER_TIMEOUT, far past any sane
-                    # per-recv timeout
-                    s.settimeout(None)
-                    s.setsockopt(socket.IPPROTO_TCP, socket.TCP_NODELAY, 1)
-                    self._socks[sidx] = s
-                    return s
-                except OSError as e:             # server still starting
-                    last = e
-                    time.sleep(0.2)
-            raise MXNetError(
-                f"cannot reach parameter server at "
-                f"{self.uri}:{self.port + sidx}: {last}")
+            try:
+                s = retry_call(
+                    lambda: socket.create_connection(
+                        (self.uri, self.port + sidx), timeout=30),
+                    site="kvstore.connect", retryable=(OSError,),
+                    attempts=1_000_000, base_ms=100, max_ms=2000,
+                    deadline_s=connect_s)
+            except OSError as e:                 # budget spent
+                raise MXNetError(
+                    f"rank {self._rank}: cannot reach parameter server "
+                    f"at {self.uri}:{self.port + sidx} after "
+                    f"{connect_s:.0f}s (MXNET_PS_CONNECT_TIMEOUT): {e}")
+            # bounded per-reply wait (MXNET_PS_RECV_TIMEOUT): a silently
+            # dead server surfaces as a structured timeout error instead
+            # of wedging the worker forever.  Barrier RPCs widen the
+            # window per-exchange in _rpc_server.
+            s.settimeout(self._recv_timeout() or None)
+            s.setsockopt(socket.IPPROTO_TCP, socket.TCP_NODELAY, 1)
+            self._socks[sidx] = s
         return s
 
     def _server_of(self, key: Any) -> int:
@@ -642,30 +696,71 @@ class KVStoreDistAsync:
                     payload: bytes = b""):
         if self._token:
             header = dict(header, tok=self._token)
-        for attempt in (0, 1):
+        cmd_name = cmd.decode("latin1")
+
+        def _exchange():
             with self._locks[sidx]:
+                s = self._sock(sidx)
+                widened = False
+                if cmd == b"B":
+                    # a barrier reply legitimately takes up to the
+                    # server-side barrier timeout — widen this
+                    # exchange's recv window past it
+                    rt = self._recv_timeout()
+                    if rt:
+                        widened = True
+                        s.settimeout(float(os.environ.get(
+                            "MXNET_PS_BARRIER_TIMEOUT", "600")) + rt)
                 try:
-                    s = self._sock(sidx)
+                    _faults.maybe_fault("kvstore.send", cmd=cmd_name,
+                                        server=sidx, rank=self._rank)
                     _send_frame(s, cmd, header, payload)
-                    rcmd, rhdr, rpayload = _recv_frame(s)
-                    break
+                    _faults.maybe_fault("kvstore.recv", cmd=cmd_name,
+                                        server=sidx, rank=self._rank)
+                    return _recv_frame(s)
+                except socket.timeout as e:
+                    # dead-or-wedged server: ONE bounded wait, then a
+                    # structured rank-naming error — never retried (a
+                    # replay would silently double the hang) and never
+                    # an infinite recv
+                    self._drop_sock(sidx)
+                    PS_RECV_TIMEOUTS.inc()
+                    raise MXNetError(
+                        f"rank {self._rank}/{self._num_workers}: "
+                        f"parameter-server RPC {cmd_name!r} to "
+                        f"{self.uri}:{self.port + sidx} timed out after "
+                        f"{self._recv_timeout():.0f}s "
+                        "(MXNET_PS_RECV_TIMEOUT) — the server is dead "
+                        "or wedged; restart it (workers reconnect with "
+                        "backoff) or raise the timeout") from e
                 except (ConnectionError, OSError):
                     # a half-done exchange leaves the stream desynced —
                     # drop the socket so the next attempt reconnects
-                    if self._socks[sidx] is not None:
-                        try:
-                            self._socks[sidx].close()
-                        except OSError:
-                            pass
-                        self._socks[sidx] = None
-                    # one reconnect retry: a restarted server accepts
-                    # fresh connections; if it lost its state the retry
-                    # fails loudly ('uninitialized key') instead of the
-                    # worker dying on a transient drop. A push the dead
-                    # server applied but never acknowledged may apply
-                    # twice — tolerated by Hogwild semantics.
-                    if attempt == 1 or cmd == b"S":
-                        raise
+                    self._drop_sock(sidx)
+                    raise
+                except BaseException:
+                    # same desync risk for ANY mid-exchange raise (an
+                    # injected kind=error fault after the send, a
+                    # KeyboardInterrupt between frames): the server's
+                    # reply would be read as the NEXT call's reply —
+                    # drop so the next RPC starts on a clean stream
+                    self._drop_sock(sidx)
+                    raise
+                finally:
+                    if widened and self._socks[sidx] is not None:
+                        self._socks[sidx].settimeout(
+                            self._recv_timeout() or None)
+
+        # bounded replay with jittered backoff: a restarted server
+        # accepts fresh connections; if it lost its state the retry
+        # fails loudly ('uninitialized key') instead of the worker dying
+        # on a transient drop. A push the dead server applied but never
+        # acknowledged may apply twice — tolerated by Hogwild semantics.
+        # STOP frames never retry (a dead server is already stopped).
+        rcmd, rhdr, rpayload = retry_call(
+            _exchange, site="kvstore.rpc",
+            retryable=(ConnectionError, OSError),
+            attempts=1 if cmd == b"S" else 2)
         if rcmd == b"E":
             raise MXNetError(f"parameter server: {rhdr.get('error')}")
         return rcmd, rhdr, rpayload
@@ -923,8 +1018,10 @@ class KVStoreDistAsync:
         self._residuals = {}
 
     def barrier(self) -> None:
+        # the rank rides the frame so a barrier timeout can NAME the
+        # missing workers in the server's error
         for sidx in range(self.num_servers):
-            self._rpc_server(sidx, b"B", {})
+            self._rpc_server(sidx, b"B", {"rank": self._rank})
 
     def server_stats(self) -> List[Dict[str, Any]]:
         return [self._rpc_server(sidx, b"Q", {})[1]

@@ -23,6 +23,7 @@ import jax
 import jax.numpy as jnp
 
 from .. import engine
+from .. import faults as _faults
 from .. import metrics as _metrics
 from .._tape import TapeNode, is_recording
 
@@ -355,6 +356,8 @@ def invoke_with_custom_vjp(name: str, impl: Callable,
     (None entries are skipped). Single-output ops only."""
     arrays = [x._data for x in inputs]
     _metrics.inc_op(name)
+    if _faults._ARMED:
+        _faults.maybe_fault("dispatch.op", op=name)
     if _mesh_state["active"]:
         arrays = _harmonize_mesh_placement(arrays)
 
@@ -395,6 +398,8 @@ def invoke(name: str, impl: Callable, inputs: Sequence[Any],
     """
     arrays = [x._data for x in inputs]
     _metrics.inc_op(name)
+    if _faults._ARMED:
+        _faults.maybe_fault("dispatch.op", op=name)
     if _mesh_state["active"]:
         arrays = _harmonize_mesh_placement(arrays)
 

@@ -596,6 +596,69 @@ class SPMDTrainer:
     def learning_rate(self) -> float:
         return self.optimizer.learning_rate
 
+    # -- preemption-safe training loop ---------------------------------
+    def fit(self, batch_fn: Any, num_steps: int,
+            checkpoint_manager: Any = None,
+            checkpoint_every: int = 10) -> Optional[NDArray]:
+        """Run up to ``num_steps`` steps with auto-resume and graceful
+        preemption — the kill-and-restart-safe loop.
+
+        ``batch_fn``: a callable ``step -> (data, labels)`` (preferred —
+        resume re-derives the exact batch for any step), or an iterable
+        of ``(data, labels)`` (on resume, the first ``restored_step``
+        batches are consumed and discarded to stay on-schedule).
+
+        With ``checkpoint_manager``: restores the newest verified
+        checkpoint before the first step (making the call idempotent
+        under kill-and-restart — a rerun continues where the kill
+        landed, and a completed run is a no-op), saves every
+        ``checkpoint_every`` steps, and saves a final checkpoint at
+        ``num_steps``.  A SIGTERM/SIGINT during the loop finishes the
+        in-flight step, writes a checkpoint, and returns cleanly
+        (:class:`~mxnet_tpu.preemption.PreemptionGuard`); the next
+        incarnation resumes from it.
+
+        Returns the loss of the last executed step (``None`` if there
+        was nothing left to run).  Only that one loss is fetched — the
+        loop itself never syncs on the device.
+        """
+        from ..preemption import PreemptionGuard
+        if checkpoint_manager is not None:
+            checkpoint_manager.restore(self)
+        start = self._step_count
+        if callable(batch_fn):
+            get_batch = batch_fn
+        else:
+            it = iter(batch_fn)
+
+            def get_batch(step, _it=it):
+                try:
+                    return next(_it)
+                except StopIteration:
+                    raise MXNetError(
+                        f"batch iterable exhausted at step {step} "
+                        f"(num_steps={num_steps}); pass a callable "
+                        "batch_fn (step -> batch) or a long-enough "
+                        "iterable") from None
+
+            for s in range(start):      # skip batches already trained on
+                get_batch(s)
+        loss: Optional[NDArray] = None
+        with PreemptionGuard() as guard:
+            for step in range(start, num_steps):
+                data, labels = get_batch(step)
+                loss = self.step(data, labels)
+                done = self._step_count
+                preempted = guard.requested
+                if checkpoint_manager is not None and (
+                        preempted or done == num_steps
+                        or (checkpoint_every > 0
+                            and done % checkpoint_every == 0)):
+                    checkpoint_manager.save(self, step=done)
+                if preempted:
+                    break
+        return loss
+
     # -- checkpoint / resume (reference SURVEY.md 5.4: .params format +
     # sharded device-resident trainer state keyed by param names) --------
     def save_checkpoint(self, prefix: str) -> None:

@@ -1,0 +1,99 @@
+"""Preemption handling — SIGTERM/SIGINT as routine training events.
+
+TPU capacity is economically preemptible (PAPERS.md: the serving
+comparison's spot-capacity arithmetic); a production trainer must treat
+"the scheduler wants this host back" as a normal control path, not a
+crash.  :class:`PreemptionGuard` converts the first SIGTERM/SIGINT into
+a cooperative flag the training loop polls between steps: the in-flight
+step finishes, a final checkpoint is written, and the process exits
+cleanly so the next incarnation auto-resumes (see
+``SPMDTrainer.fit(checkpoint_manager=...)`` and
+``Estimator.fit(checkpoint_manager=...)``).
+
+A SECOND signal escalates: the original handler runs (normally: die) —
+the operator mashing Ctrl-C twice must still win over a wedged step.
+
+Signal handlers only install from the main thread (a Python
+constraint); elsewhere the guard degrades to an inert flag so library
+code can use it unconditionally.
+"""
+from __future__ import annotations
+
+import signal
+import threading
+from typing import Any, Iterable, Optional
+
+from . import metrics as _metrics
+
+__all__ = ["PreemptionGuard"]
+
+PREEMPTION_SIGNALS = _metrics.counter(
+    "mxnet_preemption_signals_total",
+    "SIGTERM/SIGINT deliveries converted into cooperative shutdown "
+    "requests by PreemptionGuard, by signal name.", labels=("signal",))
+
+
+class PreemptionGuard:
+    """Context manager: convert termination signals into a poll-able
+    flag for the duration of a training loop.
+
+    ::
+
+        with PreemptionGuard() as guard:
+            for step in ...:
+                trainer.step(...)
+                if guard.requested:
+                    manager.save(trainer, step=...)
+                    break
+    """
+
+    def __init__(self, signals: Iterable[int] = (signal.SIGTERM,
+                                                 signal.SIGINT)) -> None:
+        self._signals = tuple(signals)
+        self._previous: dict = {}
+        self._installed = False
+        self._event = threading.Event()
+        self.signal_name: Optional[str] = None
+
+    @property
+    def requested(self) -> bool:
+        """True once a termination signal arrived (sticky)."""
+        return self._event.is_set()
+
+    def wait(self, timeout: Optional[float] = None) -> bool:
+        return self._event.wait(timeout)
+
+    def _handler(self, signum: int, frame: Any) -> None:
+        if self._event.is_set():
+            # second signal: escalate to the pre-existing behavior —
+            # a wedged loop must still be killable
+            prev = self._previous.get(signum)
+            if callable(prev):
+                prev(signum, frame)
+                return
+            if signum == signal.SIGINT:
+                raise KeyboardInterrupt
+            raise SystemExit(128 + signum)
+        try:
+            self.signal_name = signal.Signals(signum).name
+        except ValueError:
+            self.signal_name = str(signum)
+        PREEMPTION_SIGNALS.labels(signal=self.signal_name).inc()
+        self._event.set()
+
+    def __enter__(self) -> "PreemptionGuard":
+        if threading.current_thread() is threading.main_thread():
+            for s in self._signals:
+                self._previous[s] = signal.signal(s, self._handler)
+            self._installed = True
+        return self
+
+    def __exit__(self, *exc: Any) -> None:
+        if self._installed:
+            for s, prev in self._previous.items():
+                try:
+                    signal.signal(s, prev)
+                except (ValueError, TypeError):
+                    pass
+            self._previous.clear()
+            self._installed = False

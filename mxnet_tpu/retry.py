@@ -1,0 +1,123 @@
+"""Jittered exponential backoff with deadline — the retry substrate the
+distributed stack shares.
+
+Reference parity (leezu/mxnet): ps-lite's van retried sends with a fixed
+schedule buried in C++; here retry policy is one auditable helper with a
+uniform env tier and per-site metrics, used by the dist_async client
+(reconnects, RPC replays) and available to anything else that talks to a
+peer that can die.
+
+Policy: attempt ``fn``; on a retryable exception sleep
+``min(max_ms, base_ms * 2**attempt)`` scaled by a random jitter factor
+in ``[1 - jitter, 1]`` (decorrelates a fleet of workers hammering a
+restarting server), then try again — up to ``attempts`` total tries or
+until ``deadline_s`` of wall time has elapsed, whichever comes first.
+The LAST exception is re-raised, so call sites keep their structured
+errors.
+
+Metrics (PR-1 registry): ``mxnet_retry_attempts_total{site}`` counts
+retries (not first tries), ``mxnet_retry_backoff_seconds{site}``
+observes each sleep, ``mxnet_retry_exhausted_total{site}`` counts
+giving up.  A healthy system shows zeros; a flapping dependency shows up
+as a marching per-site counter before anyone reads a log.
+"""
+from __future__ import annotations
+
+import random
+import time
+from typing import Any, Callable, Iterator, Optional, Tuple, Type
+
+from .base import MXNetError, getenv, register_env
+from . import metrics as _metrics
+
+__all__ = ["retry_call", "backoff_delays", "RETRY_ATTEMPTS",
+           "RETRY_EXHAUSTED", "RETRY_BACKOFF_SECONDS"]
+
+register_env(
+    "MXNET_RETRY_MAX_ATTEMPTS", 4,
+    "Default total tries (first try + retries) for retry_call sites "
+    "(dist_async reconnect/RPC replay) when the call site does not pass "
+    "its own budget.")
+register_env(
+    "MXNET_RETRY_BASE_MS", 50,
+    "First-retry backoff for retry_call sites; doubles per retry up to "
+    "MXNET_RETRY_MAX_MS, scaled by a random jitter factor.")
+register_env(
+    "MXNET_RETRY_MAX_MS", 2000,
+    "Backoff ceiling per retry for retry_call sites.")
+
+RETRY_ATTEMPTS = _metrics.counter(
+    "mxnet_retry_attempts_total",
+    "Retries taken (excludes first tries), by retry site.",
+    labels=("site",))
+RETRY_EXHAUSTED = _metrics.counter(
+    "mxnet_retry_exhausted_total",
+    "retry_call gave up (attempt or deadline budget spent) and "
+    "re-raised, by retry site.", labels=("site",))
+RETRY_BACKOFF_SECONDS = _metrics.histogram(
+    "mxnet_retry_backoff_seconds",
+    "Backoff sleeps between retries, by retry site.", labels=("site",))
+
+_JITTER_RNG = random.Random()
+
+
+def backoff_delays(attempts: Optional[int] = None,
+                   base_ms: Optional[float] = None,
+                   max_ms: Optional[float] = None,
+                   jitter: float = 0.5,
+                   rng: Optional[random.Random] = None
+                   ) -> Iterator[float]:
+    """Yield the sleep (seconds) before retry 1, 2, ... — at most
+    ``attempts - 1`` values (one fewer sleep than tries)."""
+    if attempts is None:
+        attempts = int(getenv("MXNET_RETRY_MAX_ATTEMPTS", 4))
+    if base_ms is None:
+        base_ms = float(getenv("MXNET_RETRY_BASE_MS", 50))
+    if max_ms is None:
+        max_ms = float(getenv("MXNET_RETRY_MAX_MS", 2000))
+    if attempts < 1:
+        raise MXNetError(f"retry attempts must be >= 1, got {attempts}")
+    r = rng if rng is not None else _JITTER_RNG
+    for i in range(max(0, attempts - 1)):
+        d = min(max_ms, base_ms * (2.0 ** i)) / 1e3
+        yield d * (1.0 - jitter * r.random())
+
+
+def retry_call(fn: Callable[[], Any], *, site: str,
+               retryable: Tuple[Type[BaseException], ...] = (
+                   ConnectionError, OSError),
+               attempts: Optional[int] = None,
+               base_ms: Optional[float] = None,
+               max_ms: Optional[float] = None,
+               deadline_s: Optional[float] = None,
+               jitter: float = 0.5,
+               on_retry: Optional[Callable[[BaseException, int, float],
+                                           Any]] = None,
+               rng: Optional[random.Random] = None) -> Any:
+    """Call ``fn()`` under the backoff policy; re-raise the last
+    retryable exception once the budget is spent.  ``site`` labels the
+    retry metrics; ``on_retry(exc, attempt_index, delay_s)`` observes
+    each retry decision (diagnostics/logging hooks)."""
+    deadline = (time.monotonic() + deadline_s
+                if deadline_s is not None else None)
+    delays = backoff_delays(attempts=attempts, base_ms=base_ms,
+                            max_ms=max_ms, jitter=jitter, rng=rng)
+    attempt = 0
+    while True:
+        try:
+            return fn()
+        except retryable as e:
+            delay = next(delays, None)
+            if delay is None or (deadline is not None
+                                 and time.monotonic() >= deadline):
+                RETRY_EXHAUSTED.labels(site=site).inc()
+                raise
+            if deadline is not None:
+                delay = min(delay, max(0.0,
+                                       deadline - time.monotonic()))
+            RETRY_ATTEMPTS.labels(site=site).inc()
+            RETRY_BACKOFF_SECONDS.labels(site=site).observe(delay)
+            if on_retry is not None:
+                on_retry(e, attempt, delay)
+            time.sleep(delay)
+            attempt += 1

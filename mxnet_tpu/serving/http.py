@@ -28,7 +28,7 @@ import numpy as _np
 
 from ..base import MXNetError
 from .batching import OverloadError
-from .server import ModelServer
+from .server import DegradedError, ModelServer
 
 __all__ = ["make_http_server"]
 
@@ -106,9 +106,17 @@ class _Handler(BaseHTTPRequestHandler):
                         content_type="text/plain; version=0.0.4")
         elif path == "/healthz":
             d = self._ms.describe()
-            self._reply(200, {"status": "ok",
-                              "queue": d["queue"],
-                              "exec_cache": d["exec_cache"]})
+            if not self._ms.healthy():
+                # dead worker thread: requests would queue forever —
+                # tell the load balancer to stop sending traffic
+                self._reply(503, {"status": "degraded",
+                                  "detail": "serving worker thread has "
+                                            "died; restart the server",
+                                  "queue": d["queue"]})
+            else:
+                self._reply(200, {"status": "ok",
+                                  "queue": d["queue"],
+                                  "exec_cache": d["exec_cache"]})
         elif path == "/v1/model":
             self._reply(200, self._ms.describe())
         else:
@@ -155,6 +163,13 @@ class _Handler(BaseHTTPRequestHandler):
             _abandon()
             self._reply(429, e.to_json(), headers={
                 "Retry-After": str(max(1, int(e.retry_after_ms / 1e3)))})
+            return
+        except DegradedError as e:
+            # server-side incapacity (dead worker / stopped), NOT the
+            # caller's bug: 503 tells the balancer to fail over
+            _abandon()
+            self._reply(503, {"error": "degraded", "detail": str(e)},
+                        headers={"Retry-After": "1"})
             return
         except MXNetError as e:
             _abandon()

@@ -18,11 +18,17 @@ from typing import Any, Dict, List, Optional, Sequence
 import numpy as _np
 
 from ..base import MXNetError, getenv
+from .. import faults as _faults
 from .batching import (BucketPolicy, DynamicBatcher, OverloadError,
                        REQUESTS_TOTAL, Request)
 from .model import ServedModel
 
-__all__ = ["ModelServer"]
+__all__ = ["ModelServer", "DegradedError"]
+
+
+class DegradedError(MXNetError):
+    """The server cannot take requests (worker dead or stopped) — the
+    HTTP front end maps this to 503, distinct from caller errors."""
 
 
 class ModelServer:
@@ -62,6 +68,11 @@ class ModelServer:
             float(getenv("MXNET_SERVING_DEADLINE_MS", 0)) / 1e3
         self._thread: Optional[threading.Thread] = None
         self._started = False
+        self._worker_died = False
+        # the batch currently executing (worker-owned): stop() fails
+        # these futures after the join so no caller blocks forever on a
+        # result that will never come
+        self._inflight: List[Request] = []
         self.warmed = 0
         if warmup:
             self.warmed = model.warmup(self.policy)
@@ -87,7 +98,33 @@ class ModelServer:
         self.batcher.close()
         if self._thread is not None:
             self._thread.join(timeout)
+        # strand nothing: a batch still executing when the join timed
+        # out (or whose worker died) holds futures no one will ever
+        # complete — fail them with a structured shutdown error so HTTP
+        # clients and in-process callers unblock deterministically
+        self._fail_inflight(MXNetError(
+            "ModelServer stopped with the request still in flight "
+            "(shutdown)"))
         self._started = False
+
+    def _fail_inflight(self, exc: Exception) -> None:
+        for r in list(self._inflight):
+            if not r.future.done():
+                try:
+                    r.future.set_exception(exc)
+                except Exception:   # noqa: BLE001 - done() race
+                    continue
+                REQUESTS_TOTAL.labels(status="error").inc()
+        self._inflight = []
+
+    def healthy(self) -> bool:
+        """Ready to serve: started AND the worker thread is alive.  A
+        dead worker or a stopped/never-started server reports False, so
+        /healthz goes non-200 the moment requests would stall or fail —
+        not only in the died-mid-run case."""
+        return bool(self._started and not self._worker_died
+                    and self._thread is not None
+                    and self._thread.is_alive())
 
     def __enter__(self) -> "ModelServer":
         return self.start()
@@ -103,6 +140,12 @@ class ModelServer:
         array for single-output models)."""
         if not self._started:
             raise MXNetError("ModelServer.start() first")
+        if not self.healthy():
+            # a dead worker would park this future forever — fail the
+            # submit instead so clients back off / failover
+            raise DegradedError(
+                "ModelServer worker thread has died; the server is "
+                "degraded (healthz reports 503) — restart it")
         arrays = [_np.asarray(a) for a in sample]
         sig = self.model.input_signature
         if len(arrays) != len(sig):
@@ -148,19 +191,40 @@ class ModelServer:
 
     # -- worker -------------------------------------------------------------
     def _run(self) -> None:
-        while True:
-            batch = self.batcher.next_batch()
-            if batch is None:
-                return
-            try:
-                self._execute(batch)
-            except Exception:   # noqa: BLE001 - the worker must outlive
-                # any per-batch surprise (a dead worker is a silently
-                # wedged server); per-request faults were already set
-                pass
+        try:
+            while True:
+                batch = self.batcher.next_batch()
+                if batch is None:
+                    return
+                self._inflight = batch
+                try:
+                    self._execute(batch)
+                except Exception:   # noqa: BLE001 - the worker must
+                    # outlive any per-batch surprise (a dead worker is a
+                    # silently wedged server); per-request faults were
+                    # already set
+                    pass
+                # cleared only on survival: a BaseException must leave
+                # the batch visible to the death handler below
+                self._inflight = []
+        except BaseException as e:   # noqa: BLE001 - worker death is a
+            # server-level event: mark degraded and unblock EVERY waiter
+            # — the in-flight batch the dying worker held AND everything
+            # still queued (close() fails those); re-raising inside a
+            # worker thread would only reach threading.excepthook
+            self._worker_died = True
+            self._fail_inflight(MXNetError(
+                f"ModelServer worker thread died: {e!r}; the server is "
+                "degraded — restart it"))
+            self.batcher.close()
+            import logging
+            logging.getLogger("mxnet_tpu.serving").error(
+                "serving worker thread died: %r — /healthz now reports "
+                "degraded (503); restart the server", e)
 
     def _execute(self, batch: List[Request]) -> None:
         try:
+            _faults.maybe_fault("serving.execute", batch=len(batch))
             arrays, _nb = self.policy.assemble(
                 [r.sample for r in batch], batch[0].key)
             outs = self.model.predict(arrays)
@@ -225,5 +289,6 @@ class ModelServer:
                       "limit": self.batcher.queue_limit,
                       "batch_timeout_ms": self.batcher.timeout_s * 1e3},
             "warmed_buckets": self.warmed,
+            "worker_alive": self.healthy(),
             "exec_cache": exec_cache_stats(),
         }

@@ -1,0 +1,63 @@
+"""Chaos-test subprocess: a deterministic SPMD training run with
+auto-resume (tests/test_faults.py SIGKILL/SIGTERM choreography).
+
+Usage: python tests/chaos_train.py CKPT_DIR OUT_JSON NUM_STEPS [READY_FILE]
+
+Runs ``SPMDTrainer.fit`` with a CheckpointManager (checkpoint_every=1)
+over batches derived purely from the step index, so any incarnation of
+this process — fresh, resumed after SIGKILL, resumed after a graceful
+SIGTERM — walks the identical loss trajectory.  Writes
+``{"final_loss": ..., "step_count": ...}`` to OUT_JSON on clean exit.
+READY_FILE (optional) is created when step 1 begins (step 0 done and
+checkpointed) — the parent's kill signal; MXNET_CHAOS_STEP_DELAY
+(seconds) slows steps so the kill lands mid-run.
+"""
+import json
+import os
+import sys
+import time
+
+os.environ.setdefault("JAX_PLATFORMS", "cpu")
+
+import numpy as onp  # noqa: E402
+import jax  # noqa: E402
+import mxnet_tpu as mx  # noqa: E402
+from mxnet_tpu.checkpoint import CheckpointManager  # noqa: E402
+from mxnet_tpu.parallel import SPMDTrainer, make_mesh  # noqa: E402
+
+
+def main() -> None:
+    ckdir, out_path, num_steps = sys.argv[1], sys.argv[2], int(sys.argv[3])
+    ready_path = sys.argv[4] if len(sys.argv) > 4 else None
+    delay = float(os.environ.get("MXNET_CHAOS_STEP_DELAY", "0"))
+
+    mx.random.seed(0)
+    net = mx.gluon.nn.Dense(4)
+    net.initialize()
+    net(mx.np.zeros((2, 8)))
+    tr = SPMDTrainer(net, mx.gluon.loss.L2Loss(), "sgd",
+                     {"learning_rate": 0.05},
+                     mesh=make_mesh({"dp": 1}, devices=jax.devices()[:1]))
+    mgr = CheckpointManager(ckdir, max_to_keep=3)
+
+    def batch_fn(step):
+        if ready_path and step == 1:
+            with open(ready_path, "w") as f:
+                f.write("ready")
+        if delay:
+            time.sleep(delay)
+        rng = onp.random.RandomState(1234 + step)
+        X = mx.np.array(rng.uniform(-1, 1, (8, 8)).astype("float32"))
+        Y = mx.np.array(rng.uniform(-1, 1, (8, 4)).astype("float32"))
+        return X, Y
+
+    loss = tr.fit(batch_fn, num_steps, checkpoint_manager=mgr,
+                  checkpoint_every=1)
+    with open(out_path, "w") as f:
+        json.dump({"final_loss": (None if loss is None
+                                  else float(loss.asnumpy())),
+                   "step_count": tr._step_count}, f)
+
+
+if __name__ == "__main__":
+    main()

@@ -39,6 +39,10 @@ import pytest  # noqa: E402
 def pytest_configure(config):
     config.addinivalue_line(
         "markers",
+        "slow: excluded from the tier-1 time-budgeted selection "
+        "(-m 'not slow'); run via ci/run.sh chaos / unit variants.")
+    config.addinivalue_line(
+        "markers",
         "host_mesh: needs the multi-device virtual CPU mesh or spawns "
         "multi-process CPU jobs; skipped under the MXNET_TEST_CTX=tpu "
         "ctx-flip (one real chip in the bench env). Mark any new "

@@ -83,3 +83,59 @@ def test_gluon_trainer_pair(tmp_path):
     mgr.restore(tr, block=net)
     onp.testing.assert_allclose(net.weight.data().asnumpy(), ref,
                                 rtol=1e-6)
+
+
+def _gluon_setup(seed):
+    mx.random.seed(seed)
+    net = mx.gluon.nn.Dense(3)
+    net.initialize()
+    net(mx.np.zeros((1, 5)))
+    tr = mx.gluon.Trainer(net.collect_params(), "adam",
+                          {"learning_rate": 1e-2})
+    return net, tr
+
+
+def _gluon_steps(net, tr, batches):
+    lf = mx.gluon.loss.L2Loss()
+    for X, Y in batches:
+        with mx.autograd.record():
+            loss = lf(net(X), Y).mean()
+        loss.backward()
+        tr.step(X.shape[0])
+
+
+def test_gluon_pair_kill_and_resume(tmp_path):
+    """The (block, trainer) path survives kill-and-restart: a FRESH
+    net + Trainer (different init) restored mid-epoch continues to the
+    exact same weights as the uninterrupted run, and Trainer.save_states
+    round-trips after the restore (ISSUE 3 satellite)."""
+    rng = onp.random.RandomState(7)
+    batches = [(mx.np.array(rng.uniform(-1, 1, (4, 5)).astype("f4")),
+                mx.np.array(rng.uniform(-1, 1, (4, 3)).astype("f4")))
+               for _ in range(6)]
+
+    # uninterrupted reference: 3 steps, checkpoint, 3 more
+    net, tr = _gluon_setup(seed=1)
+    mgr = CheckpointManager(str(tmp_path), max_to_keep=2)
+    _gluon_steps(net, tr, batches[:3])
+    mgr.save(tr, step=3, block=net)
+    _gluon_steps(net, tr, batches[3:])
+    ref_w = net.weight.data().asnumpy().copy()
+    assert tr._optimizer.num_update == 6
+
+    # "new process": differently-initialized net + fresh trainer,
+    # restore mid-epoch, finish the epoch on the same remaining batches
+    net2, tr2 = _gluon_setup(seed=99)
+    assert not onp.allclose(net2.weight.data().asnumpy(),
+                            ref_w)
+    assert mgr.restore(tr2, block=net2) == 3
+    assert tr2._optimizer.num_update == 3    # schedule clock restored
+    # Trainer.save_states round-trip AFTER the mid-epoch restore
+    states_file = str(tmp_path / "roundtrip.states")
+    tr2.save_states(states_file)
+    net3, tr3 = _gluon_setup(seed=5)
+    tr3.load_states(states_file)
+    assert tr3._optimizer.num_update == 3
+    _gluon_steps(net2, tr2, batches[3:])
+    onp.testing.assert_allclose(net2.weight.data().asnumpy(), ref_w,
+                                rtol=1e-5, atol=1e-7)
