@@ -24,7 +24,12 @@ MXNET_BENCH_BERT_ARCH (base|large — BASELINE row 3c), MXNET_BENCH_DTYPE
 MXNET_BENCH_DATA (synthetic|recordio — recordio feeds the model through
 the REAL IO stack: an im2rec-style pack read by the native C++
 prefetcher, per-image random-crop+mirror augment, uint8 batches to the
-device, normalize/NCHW/cast in-graph), MXNET_BENCH_RECORD_FMT (raw|jpg).
+device, normalize/NCHW/cast in-graph), MXNET_BENCH_RECORD_FMT (raw|jpg),
+MXNET_BENCH_EAGER=1 (lstm/gpt only: run the NON-hybridized per-op
+dispatch path through the lazy bulking engine — pair with
+MXNET_BULK_MAX_OPS to compare bulked vs per-op dispatch), and
+MXNET_BENCH_MODEL=bulk_smoke (the CI acceptance micro-run: >=1.3x
+dispatch reduction + steady segment cache + loss parity).
 """
 import json
 import os
@@ -189,6 +194,226 @@ def bench_gpt(batch: int, steps: int, dtype: str, seq_len: int) -> None:
         "value": round(tok_s, 1), "unit": "tokens/sec/chip",
         "vs_baseline": 0.0,
         "step_breakdown": _step_breakdown(m0, dt, steps)}))
+
+
+def _eager_train_bench(net, x, y, loss_fn, steps: int, batch: int,
+                       optimizer: str, opt_params: dict):
+    """Shared eager (non-hybridized) training loop: per-op dispatch
+    through the lazy bulking engine (MXNET_BULK_MAX_OPS).  Returns
+    (wall_dt, metrics_mark_before) with the python dispatch time of
+    each step observed into mxnet_step_dispatch_seconds so
+    _step_breakdown splits dispatch from the device-execution tail."""
+    import time as _time
+    import mxnet_tpu as mx
+    from mxnet_tpu import autograd, metrics as _metrics
+
+    trainer = mx.gluon.Trainer(net.collect_params(), optimizer,
+                               opt_params, kvstore=None)
+
+    def one_step():
+        t0 = _time.perf_counter()
+        with autograd.record():
+            out = net(x)
+            loss = loss_fn(out, y).mean()
+        loss.backward()
+        trainer.step(batch)
+        _metrics.STEP_DISPATCH_SECONDS.observe(_time.perf_counter() - t0)
+        return loss
+
+    # warmup: segment-cache + per-op compile population (grad buffers
+    # materialize on the first step, which changes segment liveness, so
+    # two steps are needed before signatures are steady)
+    for _ in range(3):
+        one_step().asnumpy()
+
+    m0 = _metrics_mark()
+    t0 = _time.perf_counter()
+    for _ in range(steps):
+        loss = one_step()
+    loss.asnumpy()
+    return _time.perf_counter() - t0, m0
+
+
+def bench_lstm_eager(batch: int, steps: int, dtype: str,
+                     seq_len: int) -> None:
+    """Config 4 EAGER path (MXNET_BENCH_EAGER=1): the same LSTM LM run
+    non-hybridized — per-op imperative dispatch, the workload the lazy
+    bulking engine (ISSUE 4) exists for.  step_breakdown.dispatch_s is
+    the metric of interest: python dispatch time per step."""
+    import numpy as onp
+    import mxnet_tpu as mx
+    from mxnet_tpu import metrics as _metrics
+
+    vocab, embed, hidden = 10000, 650, 650
+    mx.random.seed(0)
+
+    class LM(mx.gluon.HybridBlock):
+        def __init__(self):
+            super().__init__()
+            self.emb = mx.gluon.nn.Embedding(vocab, embed)
+            self.rnn = mx.gluon.rnn.LSTM(hidden, num_layers=2,
+                                         layout="NTC")
+            self.out = mx.gluon.nn.Dense(vocab, flatten=False)
+
+        def forward(self, x):
+            return self.out(self.rnn(self.emb(x)))
+
+    net = LM()
+    net.initialize()
+    net(mx.np.zeros((2, 8), dtype="int32"))
+    if dtype != "float32":
+        net.cast(dtype)
+    loss_fn = mx.gluon.loss.SoftmaxCrossEntropyLoss(axis=-1)
+    rng = onp.random.RandomState(0)
+    x = mx.np.array(rng.randint(0, vocab, (batch, seq_len))
+                    .astype("int32"))
+    y = mx.np.array(rng.randint(0, vocab, (batch, seq_len))
+                    .astype("int32"))
+    dt, m0 = _eager_train_bench(net, x, y, loss_fn, steps, batch,
+                                "sgd", {"learning_rate": 1.0})
+    from mxnet_tpu import bulk
+    tok_s = batch * seq_len * steps / dt
+    print(json.dumps({
+        "metric": f"lstm_ptb_eager_{dtype}_b{batch}x{seq_len}_train",
+        "value": round(tok_s, 1), "unit": "tokens/sec/chip",
+        "vs_baseline": 0.0, "bulk_max_ops": bulk.max_ops(),
+        "step_breakdown": _step_breakdown(m0, dt, steps)}))
+
+
+def bench_gpt_eager(batch: int, steps: int, dtype: str,
+                    seq_len: int) -> None:
+    """GPT-2-124M EAGER path (MXNET_BENCH_EAGER=1): non-hybridized
+    causal-LM training — per-op dispatch through the bulking engine."""
+    import numpy as onp
+    import mxnet_tpu as mx
+    from mxnet_tpu.gluon.model_zoo.gpt import get_gpt
+
+    vocab = 50257
+    mx.random.seed(0)
+    net = get_gpt("gpt2_124m", vocab_size=vocab, dropout=0.0,
+                  max_length=max(1024, seq_len))
+    net.initialize()
+    net(mx.np.zeros((2, 16), dtype="int32"))
+    if dtype != "float32":
+        net.cast(dtype)
+    loss_fn = mx.gluon.loss.SoftmaxCrossEntropyLoss(axis=-1)
+    rng = onp.random.RandomState(0)
+    x = mx.np.array(rng.randint(0, vocab, (batch, seq_len))
+                    .astype("int32"))
+    y = mx.np.array(rng.randint(0, vocab, (batch, seq_len))
+                    .astype("int32"))
+    dt, m0 = _eager_train_bench(net, x, y, loss_fn, steps, batch,
+                                "adamw", {"learning_rate": 1e-4})
+    from mxnet_tpu import bulk
+    tok_s = batch * seq_len * steps / dt
+    print(json.dumps({
+        "metric": f"gpt2_124m_eager_{dtype}_b{batch}x{seq_len}_train",
+        "value": round(tok_s, 1), "unit": "tokens/sec/chip",
+        "vs_baseline": 0.0, "bulk_max_ops": bulk.max_ops(),
+        "step_breakdown": _step_breakdown(m0, dt, steps)}))
+
+
+def bench_bulk_smoke() -> None:
+    """CI acceptance micro-run (ci/run.sh bulk-smoke, ISSUE 4): a tiny
+    eager LSTM LM trained twice — bulked (MXNET_BULK_MAX_OPS=16) vs
+    per-op (=1) — asserting
+
+      * >= 1.3x eager->bulked python-dispatch-time reduction,
+      * 0 new segment compiles after warmup (steady-state cache), and
+      * loss parity within FMA-contraction tolerance (fused segments
+        may differ from per-op dispatch in the last ulp — see
+        docs/performance.md).
+    """
+    import time as _time
+    import numpy as onp
+    import mxnet_tpu as mx
+    from mxnet_tpu import autograd, bulk, metrics as _metrics
+
+    vocab, embed, hidden, batch, seq = 120, 16, 16, 4, 6
+    steps = int(os.environ.get("MXNET_BENCH_STEPS", "10"))
+
+    def build():
+        mx.random.seed(7)
+
+        class LM(mx.gluon.HybridBlock):
+            def __init__(self):
+                super().__init__()
+                self.emb = mx.gluon.nn.Embedding(vocab, embed)
+                self.rnn = mx.gluon.rnn.LSTM(hidden, num_layers=1,
+                                             layout="NTC")
+                self.out = mx.gluon.nn.Dense(vocab, flatten=False)
+
+            def forward(self, x):
+                return self.out(self.rnn(self.emb(x)))
+
+        net = LM()
+        net.initialize()
+        net(mx.np.zeros((2, 3), dtype="int32"))
+        return net
+
+    def train(net, n):
+        rng = onp.random.RandomState(0)
+        x = mx.np.array(rng.randint(0, vocab, (batch, seq))
+                        .astype("int32"))
+        y = mx.np.array(rng.randint(0, vocab, (batch, seq))
+                        .astype("int32"))
+        loss_fn = mx.gluon.loss.SoftmaxCrossEntropyLoss(axis=-1)
+        trainer = mx.gluon.Trainer(net.collect_params(), "sgd",
+                                   {"learning_rate": 0.5}, kvstore=None)
+        losses, t_disp = [], 0.0
+        for _ in range(n):
+            t0 = _time.perf_counter()
+            with autograd.record():
+                loss = loss_fn(net(x), y).mean()
+            loss.backward()
+            trainer.step(batch)
+            t_disp += _time.perf_counter() - t0
+            losses.append(float(loss.asnumpy()))
+        return losses, t_disp
+
+    failures = []
+
+    bulk.set_max_ops(16)
+    net = build()
+    train(net, 3)                      # warmup: compile the segments
+    m0 = _metrics.value("mxnet_bulk_seg_cache_misses_total")
+    losses_b, t_bulk = train(net, steps)
+    new_compiles = _metrics.value(
+        "mxnet_bulk_seg_cache_misses_total") - m0
+    if new_compiles != 0:
+        failures.append(f"segment cache not steady: {new_compiles:.0f} "
+                        f"new compiles after warmup")
+
+    bulk.set_max_ops(1)
+    net_e = build()
+    train(net_e, 3)
+    losses_e, t_eager = train(net_e, steps)
+    bulk.set_max_ops(16)
+
+    ratio = t_eager / t_bulk if t_bulk > 0 else float("inf")
+    if ratio < 1.3:
+        failures.append(f"dispatch reduction {ratio:.2f}x < 1.3x "
+                        f"(bulked {t_bulk:.3f}s vs per-op {t_eager:.3f}s)")
+
+    # NOTE: warmup diverges the weights between the two runs only
+    # through FMA-level differences, so per-step losses stay comparable
+    # at a tight relative tolerance
+    max_rel = max(abs(a - b) / max(abs(b), 1e-9)
+                  for a, b in zip(losses_b, losses_e))
+    if max_rel > 1e-4:
+        failures.append(f"loss parity {max_rel:.2e} > 1e-4 "
+                        f"(bulked vs per-op)")
+
+    print(json.dumps({
+        "metric": "bulk_smoke_lstm_micro",
+        "dispatch_reduction_x": round(ratio, 2),
+        "bulked_dispatch_s": round(t_bulk, 4),
+        "per_op_dispatch_s": round(t_eager, 4),
+        "new_compiles_after_warmup": new_compiles,
+        "max_loss_rel_diff": float(f"{max_rel:.3e}"),
+        "ok": not failures}))
+    if failures:
+        raise SystemExit("bulk smoke FAILED: " + "; ".join(failures))
 
 
 def bench_lstm(batch: int, steps: int, dtype: str, seq_len: int) -> None:
@@ -541,6 +766,22 @@ def main() -> None:
     dtype = os.environ.get("MXNET_BENCH_DTYPE", "bfloat16")
     img = int(os.environ.get("MXNET_BENCH_IMAGE", "224"))
 
+    if model_name == "bulk_smoke":
+        return bench_bulk_smoke()
+    eager = os.environ.get("MXNET_BENCH_EAGER", "0") == "1"
+    if eager and model_name.startswith("lstm"):
+        if "MXNET_BENCH_BATCH" not in os.environ:
+            batch = 20   # eager dispatch-bound: a smaller batch keeps
+            #              the per-step python op count the bottleneck
+        return bench_lstm_eager(batch, steps, dtype,
+                                int(os.environ.get("MXNET_BENCH_SEQLEN",
+                                                   "35")))
+    if eager and model_name.startswith("gpt"):
+        if "MXNET_BENCH_BATCH" not in os.environ:
+            batch = 4
+        return bench_gpt_eager(batch, steps, dtype,
+                               int(os.environ.get("MXNET_BENCH_SEQLEN",
+                                                  "256")))
     if model_name.startswith("bert"):
         if os.environ.get("MXNET_BENCH_BERT_ARCH", "base") == "large" \
                 and "MXNET_BENCH_BATCH" not in os.environ:

@@ -17,6 +17,11 @@
 #                           #   (tests/test_faults.py -k smoke)
 #   ci/run.sh chaos         # full chaos suite incl. SIGKILL/SIGTERM
 #                           #   subprocess resume proofs
+#   ci/run.sh bulk-smoke    # lazy-bulking acceptance: lstm micro-run
+#                           #   (dispatch reduction / steady cache /
+#                           #   loss parity)
+#   ci/run.sh bulk-off      # core suite with MXNET_BULK_MAX_OPS=1
+#                           #   (per-op dispatch sanitizer)
 #   ci/run.sh unit          # full Python suite on the 8-dev virtual mesh
 #   ci/run.sh dist          # real multi-process launcher tests
 #   ci/run.sh exec-cache    # suite subset with the per-op executable
@@ -89,6 +94,23 @@ run_chaos_smoke() {
     -k smoke -q -p no:cacheprovider
 }
 
+run_bulk_smoke() {
+  echo "== bulk-smoke: lazy eager-op bulking acceptance — lstm micro-run"
+  echo "   asserting >=1.3x eager->bulked dispatch reduction, 0 segment"
+  echo "   compiles after warmup, and loss parity"
+  JAX_PLATFORMS=cpu MXNET_BENCH_MODEL=bulk_smoke timeout 600 \
+    python bench.py
+}
+
+run_bulk_off() {
+  echo "== bulk-off: core suite with bulking DISABLED (per-op dispatch)"
+  echo "   — flushes out bulked-vs-eager divergence, the bulking analog"
+  echo "   of the exec-cache sanitizer"
+  MXNET_BULK_MAX_OPS=1 python -m pytest -q \
+    tests/test_bulk.py tests/test_autograd.py tests/test_ndarray.py \
+    tests/test_gluon.py tests/test_numpy.py tests/test_rnn.py
+}
+
 run_chaos() {
   echo "== chaos: the full fault-tolerance suite, including the"
   echo "   SIGKILL/SIGTERM subprocess resume proofs"
@@ -98,11 +120,13 @@ run_chaos() {
 
 run_tier1() {
   echo "== tier1: env-doc freshness + fault-site doc lint + serving"
-  echo "   smoke + chaos smoke + the tier-1 pytest selection"
+  echo "   smoke + chaos smoke + bulking smoke + the tier-1 pytest"
+  echo "   selection"
   run_envdoc
   run_faultdoc
   run_serving_smoke
   run_chaos_smoke
+  run_bulk_smoke
   JAX_PLATFORMS=cpu python -m pytest tests/ -q -m 'not slow' \
     --continue-on-collection-errors -p no:cacheprovider
 }
@@ -195,6 +219,8 @@ case "$variant" in
   serving-smoke) run_serving_smoke ;;
   chaos-smoke)  run_chaos_smoke ;;
   chaos)        run_chaos ;;
+  bulk-smoke)   run_bulk_smoke ;;
+  bulk-off)     run_bulk_off ;;
   unit)         run_unit ;;
   dist)         run_dist ;;
   exec-cache)   run_exec_cache ;;

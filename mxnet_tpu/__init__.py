@@ -29,6 +29,7 @@ from .base import MXNetError
 from .context import (Context, cpu, cpu_pinned, gpu, tpu, current_context,
                       num_gpus, num_tpus)
 from . import engine
+from . import bulk
 from . import ndarray
 from . import ndarray as nd
 from .ndarray import NDArray

@@ -174,6 +174,11 @@ def backward_arrays(heads: Sequence[Any],
     (``autograd.grad``).
     """
     from .base import MXNetError
+    from . import bulk as _bulk
+
+    # the autograd boundary: pending bulked segments must materialize
+    # (and install their fused TapeNodes) before the tape is walked
+    _bulk.flush_all("autograd")
 
     heads = list(heads)
     for h in heads:

@@ -165,10 +165,11 @@ register_env("MXNET_ENGINE_TYPE", "ThreadedEnginePerDevice",
              "execution (block_until_ready after every op) for debugging; "
              "anything else uses async XLA dispatch.")
 register_env("MXNET_EXEC_BULK_EXEC_TRAIN", 1,
-             "Parity shim, NO-OP under XLA: the reference bulked engine "
-             "segments; here jit compiles whole graphs and XLA fuses, so "
-             "this flag (and engine.set_bulk_size/bulk hints) is accepted "
-             "and recorded but not load-bearing.")
+             "Parity alias: the lazy bulking engine (mxnet_tpu/bulk.py, "
+             "MXNET_BULK_MAX_OPS) is the load-bearing control for eager "
+             "segment bulking; engine.set_bulk_size/engine.bulk scope it "
+             "at runtime. This reference-named flag remains accepted but "
+             "unread.")
 register_env("MXNET_ENFORCE_DETERMINISM", 0,
              "Restrict to deterministic kernels.")
 

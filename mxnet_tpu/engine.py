@@ -143,6 +143,8 @@ def launder(arrays):
 
 def waitall() -> None:
     """Block until all pushed device work completes (``mx.nd.waitall``)."""
+    from . import bulk as _bulk   # lazy: bulk imports engine
+    _bulk.flush_all("waitall")
     t0 = time.perf_counter()
     try:
         for key, ref in list(_LIVE.items()):
@@ -193,24 +195,22 @@ def push_host_async(fn, read_vars=(), write_vars=(), priority: int = 0,
 
 # ---------------------------------------------------------------------------
 # Bulking knobs (reference: MXNET_EXEC_BULK_EXEC_* + Engine::bulk_size).
-# Under XLA, "bulking" is jit fusion; these exist for API parity and to let
-# callers scope a hint. They are accepted and recorded, not load-bearing.
+# Since the lazy bulking engine (mxnet_tpu/bulk.py) these are LOAD-BEARING:
+# the size is the pending-segment cap (MXNET_BULK_MAX_OPS at runtime).
 # ---------------------------------------------------------------------------
 
-_bulk_size = 15
-
-
 def set_bulk_size(size: int) -> int:
-    """Set the bulk-execution segment-size hint; returns the previous
-    value. NO-OP parity shim: XLA fuses whole jitted graphs, so the hint
-    is recorded but never read by the executor (see docs/env_vars.md)."""
-    global _bulk_size
-    prev, _bulk_size = _bulk_size, size
-    return prev
+    """Set the bulk-execution segment size — how many eager ops the lazy
+    bulking engine fuses into one compiled dispatch; returns the previous
+    value. ``size <= 1`` disables bulking (per-op dispatch)."""
+    from . import bulk as _bulk_mod
+    return _bulk_mod.set_max_ops(size)
 
 
 class bulk:
-    """Context manager scoping a bulk-size hint (``mx.engine.bulk``)."""
+    """Context manager scoping the bulk segment size (``mx.engine.bulk``).
+    Exiting the scope flushes any segment still pending under it, so
+    promised buffers never outlive the requested bulking window."""
 
     def __init__(self, size: int) -> None:
         self._size = size
@@ -221,4 +221,6 @@ class bulk:
         return self
 
     def __exit__(self, *exc: Any) -> None:
+        from . import bulk as _bulk_mod
+        _bulk_mod.flush_current("waitall")
         set_bulk_size(self._prev)

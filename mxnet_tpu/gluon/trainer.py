@@ -255,6 +255,12 @@ class Trainer:
         self._update(ignore_stale_grad)
 
     def _update(self, ignore_stale_grad: bool = False) -> None:
+        # Both update paths (per-param Optimizer.update and the fused
+        # group below) donate weight/state buffers into jitted programs:
+        # any pending bulked segment still holding one of those buffers
+        # by value must materialize before the donation deletes it.
+        from .. import bulk as _bulk
+        _bulk.flush_all("mutation")
         updatable = []
         for i, p in enumerate(self._params):
             if p.grad_req == "null" or not p.is_initialized:

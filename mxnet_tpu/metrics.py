@@ -418,6 +418,7 @@ class MetricsRegistry:
         for f in fams:
             f.reset()
         _OP_CHILDREN.clear()
+        _BULK_REASON_CHILDREN.clear()
 
     def dump_json(self) -> Dict[str, Any]:
         with self._lock:
@@ -598,6 +599,33 @@ MONITOR_STAT = gauge(
     "Latest scalar statistic per op output collected by mx.monitor."
     "Monitor (set at toc()).", labels=("name",))
 
+BULK_SEGMENTS = counter(
+    "mxnet_bulk_segments_total",
+    "Pending eager-op segments flushed by the lazy bulking engine "
+    "(mxnet_tpu/bulk.py), by flush reason: host_read (asnumpy/item/"
+    "direct buffer access), max_ops (MXNET_BULK_MAX_OPS reached), "
+    "unjittable (an op that cannot trace arrived), mutation (in-place "
+    "write to a promised buffer), waitall (engine barrier), autograd "
+    "(backward boundary / record-scope transition), cross_thread "
+    "(another thread read a promised buffer).", labels=("reason",))
+BULK_CACHE_HITS = counter(
+    "mxnet_bulk_seg_cache_hits_total",
+    "Segment flushes that reused a compiled fused executable (segment-"
+    "signature cache hit).")
+BULK_CACHE_MISSES = counter(
+    "mxnet_bulk_seg_cache_misses_total",
+    "Segment flushes that traced + compiled a new fused executable. "
+    "Steady-state training should report 0 new misses after warmup.")
+BULK_CACHE_SIZE = gauge(
+    "mxnet_bulk_seg_cache_size",
+    "Compiled fused segment executables held by the bulking engine's "
+    "signature cache (LRU-bounded).")
+BULK_OPS_PER_SEGMENT = histogram(
+    "mxnet_bulk_ops_per_segment",
+    "Ops per flushed bulking segment (1 means the flush trigger arrived "
+    "before a second op could join).",
+    buckets=exponential_buckets(1.0, 2.0, 8))
+
 
 def record_step(total: float, data: float = 0.0, dispatch: float = 0.0,
                 sync: Optional[float] = None, count: int = 1) -> None:
@@ -635,6 +663,20 @@ def inc_op(name: str) -> None:
     b = _OP_CHILDREN.get(name)
     if b is None:
         b = _OP_CHILDREN[name] = OPS_DISPATCHED.labels(op=name)
+    b.inc()
+
+
+# Hot-path cache for per-reason segment-flush counters (same pattern as
+# _OP_CHILDREN; reset() drops it).
+_BULK_REASON_CHILDREN: Dict[str, _Bound] = {}
+
+
+def inc_bulk_segment(reason: str) -> None:
+    """Count one bulking-segment flush (called from bulk.Segment.flush)."""
+    b = _BULK_REASON_CHILDREN.get(reason)
+    if b is None:
+        b = _BULK_REASON_CHILDREN[reason] = BULK_SEGMENTS.labels(
+            reason=reason)
     b.inc()
 
 

@@ -24,6 +24,7 @@ import numpy as _np
 
 from .. import engine
 from ..base import MXNetError
+from ..bulk import PendingBuffer
 from ..context import Context, cpu, current_context
 
 __all__ = ["NDArray", "from_jax", "waitall"]
@@ -68,12 +69,57 @@ class NDArray:
     # _concrete_shadow: the concrete buffer while _data is temporarily a
     # tracer under gluon._bind_params (host-side layer logic — BatchNorm
     # virgin-stats resolution — inspects values mid-trace through it)
-    __slots__ = ("_data", "_ctx", "_ag_node", "_ag_out_idx", "_grad",
+    __slots__ = ("_buf", "_ctx", "_ag_node", "_ag_out_idx", "_grad",
                  "_grad_req", "_fresh_grad", "_concrete_shadow",
                  "__weakref__")
 
     # numpy interop priority (beats np.ndarray in mixed expressions)
     __array_priority__ = 1000.0
+
+    # ------------------------------------------------------------------
+    # The buffer slot. Under eager-op bulking (mxnet_tpu/bulk.py) _buf
+    # may hold a PendingBuffer promise instead of a concrete jax array;
+    # reading ._data is a materialization point (flushes the owning
+    # segment), which is what makes bulking transparent to every
+    # consumer in the codebase. Shape/dtype queries peek at _buf and
+    # never force.
+    # ------------------------------------------------------------------
+    @property
+    def _data(self) -> Any:
+        d = self._buf
+        if type(d) is PendingBuffer:
+            d = d.force("host_read")
+            self._buf = d
+        return d
+
+    @_data.setter
+    def _data(self, value: Any) -> None:
+        self._buf = value
+
+    def _materialize(self, reason: str) -> Any:
+        """Like reading ``._data`` but attributing the flush to
+        ``reason`` (e.g. 'mutation' for in-place writes)."""
+        d = self._buf
+        if type(d) is PendingBuffer:
+            d = d.force(reason)
+            self._buf = d
+        return d
+
+    def _adopt(self, other: "NDArray") -> "NDArray":
+        """In-place rebind to ``other``'s buffer WITHOUT forcing a
+        pending promise (the in-place operator sugar: ``x += y`` stays
+        bulked). Matches the historical ``self._data = other._data``
+        contract exactly: only the buffer moves — autograd attachments
+        of ``self`` are untouched.  A RECORDED pending value must
+        materialize here: leaving it promised would let a later bulked
+        consumer differentiate through the in-place op via the segment
+        node ref, where per-op dispatch kept that node unreachable."""
+        buf = other._buf
+        if type(buf) is PendingBuffer and buf.value is None \
+                and other._on_tape:
+            buf.force("autograd")
+        self._buf = other._buf
+        return self
 
     def __init__(self, data: Any, ctx: Optional[Context] = None,
                  dtype: Any = None, _wrap: bool = False) -> None:
@@ -107,11 +153,11 @@ class NDArray:
     # ------------------------------------------------------------------
     @property
     def shape(self) -> tuple:
-        return tuple(self._data.shape)
+        return tuple(self._buf.shape)   # peek: never forces a pending buf
 
     @property
     def dtype(self):
-        return _np.dtype(self._data.dtype)
+        return _np.dtype(self._buf.dtype)
 
     @property
     def size(self) -> int:
@@ -122,7 +168,7 @@ class NDArray:
 
     @property
     def ndim(self) -> int:
-        return self._data.ndim
+        return self._buf.ndim
 
     @property
     def context(self) -> Context:
@@ -149,7 +195,16 @@ class NDArray:
 
     @property
     def _on_tape(self) -> bool:
-        return self._ag_node is not None or self._grad_req != "null"
+        if self._ag_node is not None or self._grad_req != "null":
+            return True
+        # a promised buffer from a recorded bulked op joins the tape at
+        # flush time — report it as recorded already
+        buf = getattr(self, "_buf", None)   # sparse wrappers: no slot
+        if type(buf) is PendingBuffer and buf.value is None:
+            seg = buf.segment
+            if not seg.flushed and buf.ni < len(seg.nodes):
+                return seg.nodes[buf.ni].tainted
+        return False
 
     # ------------------------------------------------------------------
     # Sync / transfer (reference: WaitToRead / asnumpy / CopyFromTo)
@@ -204,7 +259,7 @@ class NDArray:
 
     def as_in_context(self, ctx: Context) -> "NDArray":
         """Return a copy on ``ctx`` (same array if already there)."""
-        if self.context == ctx and not _is_tracer(self._data):
+        if self.context == ctx and not _is_tracer(self._buf):
             return self
         from .._tape import is_recording
         from .register import invoke
@@ -314,12 +369,15 @@ class NDArray:
     def __setitem__(self, key, value) -> None:
         v = _raw(value)
         k = _raw_key(key)
+        # in-place write to a promised buffer: a mutation hazard — the
+        # pending segment flushes before the write lands
+        d = self._materialize("mutation")
         if isinstance(k, slice) and k == slice(None) and not isinstance(v, (int, float, complex)):
             # x[:] = v  — full overwrite, keep dtype
-            self._data = jnp.broadcast_to(jnp.asarray(v, dtype=self._data.dtype),
+            self._data = jnp.broadcast_to(jnp.asarray(v, dtype=d.dtype),
                                           self.shape)
         else:
-            self._data = self._data.at[k].set(v)
+            self._data = d.at[k].set(v)
         engine.track(self._data)
 
     def __len__(self) -> int:
@@ -399,20 +457,16 @@ class NDArray:
     def __ge__(self, o): return self._binop("greater_equal", o)
 
     def __iadd__(self, o):
-        self._data = (self._binop("add", o))._data
-        return self
+        return self._adopt(self._binop("add", o))
 
     def __isub__(self, o):
-        self._data = (self._binop("subtract", o))._data
-        return self
+        return self._adopt(self._binop("subtract", o))
 
     def __imul__(self, o):
-        self._data = (self._binop("multiply", o))._data
-        return self
+        return self._adopt(self._binop("multiply", o))
 
     def __itruediv__(self, o):
-        self._data = (self._binop("divide", o))._data
-        return self
+        return self._adopt(self._binop("divide", o))
 
     # ------------------------------------------------------------------
     # Method forms of common ops

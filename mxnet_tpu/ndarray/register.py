@@ -22,6 +22,7 @@ from typing import Any, Callable, Dict, List, Optional, Sequence
 import jax
 import jax.numpy as jnp
 
+from .. import bulk as _bulk
 from .. import engine
 from .. import faults as _faults
 from .. import metrics as _metrics
@@ -115,6 +116,10 @@ def _attr_token(v: Any, depth: int = 0) -> Any:
         raise _UnhashableAttr
     if v is None or isinstance(v, (str, bytes)):
         return v
+    if isinstance(v, slice):
+        return ("slice", _attr_token(v.start, depth + 1),
+                _attr_token(v.stop, depth + 1),
+                _attr_token(v.step, depth + 1))
     if isinstance(v, (bool, int, float)):
         # dict-key equality conflates 0 == 0.0 == False; the numeric TYPE
         # is part of the op's behavior (output dtype), so key it too
@@ -196,7 +201,25 @@ def _should_use_exec_cache(arrays) -> bool:
     return False
 
 
-_EAGER_ONLY = object()  # cache sentinel: op failed to trace once, stay eager
+# Trace-failure poison, keyed by the FULL signature including input
+# avals: a failure is often input-dependent (a weak-typed scalar, a
+# shape-special-cased host check), so poisoning the (op, attrs) key
+# alone would force ops eager forever even for inputs that trace fine.
+# _EAGER_OPS is the cheap first-level guard so the hot path only builds
+# an aval key for ops that have EVER failed.  Both are LRU-bounded
+# (incremental eviction — a wholesale clear would make every known-bad
+# signature re-pay a doomed trace at once); a stale _EAGER_OPS entry
+# after its signatures evicted only costs an extra aval-key probe.
+_EAGER_OPS: "OrderedDict[Any, None]" = OrderedDict()   # (name,tok,rec)
+_EAGER_SIGS: "OrderedDict[Any, None]" = OrderedDict()  # (..., avalkey)
+_EAGER_OPS_CAP = 1024
+_EAGER_SIGS_CAP = 4096
+
+
+def _aval_key(arrays) -> tuple:
+    return tuple((tuple(getattr(a, "shape", ())),
+                  str(getattr(a, "dtype", type(a).__name__)),
+                  bool(getattr(a, "weak_type", False))) for a in arrays)
 
 
 def _cached_exec(name: str, impl: Callable, arrays, record: bool):
@@ -210,15 +233,15 @@ def _cached_exec(name: str, impl: Callable, arrays, record: bool):
     if churn_key in _CHURN_EAGER:
         return None
     key = (name, token, record)
+    if key in _EAGER_OPS and \
+            (name, token, record, _aval_key(arrays)) in _EAGER_SIGS:
+        return None     # this exact signature failed to trace before
     fn = _EXEC_CACHE.get(key)
     if fn is not None:
         _EXEC_CACHE.move_to_end(key)
         # a hit means attrs repeat — not the per-call-varying pattern the
         # churn guard targets
         _CHURN_COUNT.pop(churn_key, None)
-    if fn is _EAGER_ONLY:
-        return None
-    if fn is not None:
         _metrics.COMPILE_HITS.inc()
     if fn is None:
         n = _CHURN_COUNT[churn_key] = _CHURN_COUNT.get(churn_key, 0) + 1
@@ -236,23 +259,21 @@ def _cached_exec(name: str, impl: Callable, arrays, record: bool):
             fn = jax.jit(impl)
         _EXEC_CACHE[key] = fn
         if len(_EXEC_CACHE) > _EXEC_CACHE_CAP:
-            # evict the oldest NON-poison entry: an evicted _EAGER_ONLY
-            # marker would make a known-unjittable op re-attempt (and
-            # re-fail) its trace
-            for k in _EXEC_CACHE:
-                if _EXEC_CACHE[k] is not _EAGER_ONLY:
-                    del _EXEC_CACHE[k]
-                    break
-            else:
-                _EXEC_CACHE.popitem(last=False)
+            _EXEC_CACHE.popitem(last=False)
         _metrics.EXEC_CACHE_SIZE.set(len(_EXEC_CACHE))
     try:
         return fn(*arrays)
     except jax.errors.JAXTypeError:
-        # op needs concrete values (data-dependent host checks, e.g.
-        # mode='raise' bounds validation) — permanently take the eager
-        # path for this op signature
-        _EXEC_CACHE[key] = _EAGER_ONLY
+        # op needs concrete values for THESE inputs (data-dependent host
+        # checks, e.g. mode='raise' bounds validation on a weak-typed
+        # scalar) — poison only this (op, attrs, avals) signature; other
+        # input signatures keep using the cached wrapper
+        _EAGER_OPS[key] = None
+        if len(_EAGER_OPS) > _EAGER_OPS_CAP:
+            _EAGER_OPS.popitem(last=False)
+        _EAGER_SIGS[(name, token, record, _aval_key(arrays))] = None
+        if len(_EAGER_SIGS) > _EAGER_SIGS_CAP:
+            _EAGER_SIGS.popitem(last=False)
         return None
 
 
@@ -313,9 +334,11 @@ def exec_cache_stats() -> Dict[str, float]:
     process-wide XLA backend compiles (the jax.monitoring miss counter —
     covers hybridize/jit programs too, which is what serving warmup
     bounds)."""
-    return {"size": len(_EXEC_CACHE),
-            "hits": _metrics.COMPILE_HITS.value,
-            "misses": _metrics.COMPILE_MISSES.value}
+    stats = {"size": len(_EXEC_CACHE),
+             "hits": _metrics.COMPILE_HITS.value,
+             "misses": _metrics.COMPILE_MISSES.value}
+    stats.update(_bulk.bulk_stats())
+    return stats
 
 
 def register_op(name: str, fn: Callable, doc: str = "") -> Callable:
@@ -396,10 +419,30 @@ def invoke(name: str, impl: Callable, inputs: Sequence[Any],
     ``eager_only`` ops (data-dependent host-side behavior, e.g. bounds
     validation with mode='raise') bypass the per-op executable cache.
     """
-    arrays = [x._data for x in inputs]
     _metrics.inc_op(name)
     if _faults._ARMED:
         _faults.maybe_fault("dispatch.op", op=name)
+
+    # Lazy bulking (mxnet_tpu/bulk.py): on the plain eager fast path the
+    # op joins the pending segment and returns promised NDArrays without
+    # dispatching anything. Paths that need per-op visibility or concrete
+    # per-op arrays (amp casts, profiler timers, monitor hooks, mesh
+    # harmonization, naive engine) keep per-op dispatch.
+    # MXNET_IMPERATIVE_EXEC_CACHE=1 (the forced per-op-cache sanitizer
+    # mode, ci/run.sh exec-cache) keeps per-op dispatch observable.
+    if (not eager_only and not _amp_state["active"]
+            and not _profiler_state["on"] and not _monitor_state["hooks"]
+            and not _mesh_state["active"] and _exec_cache_mode() != "1"
+            and _bulk.active()):
+        try:
+            token = _closure_token(impl)
+        except _UnhashableAttr:
+            token = None
+        out = _bulk.try_append(name, impl, token, inputs, ctx)
+        if out is not _bulk.NOT_BULKED:
+            return out
+
+    arrays = [x._data for x in inputs]
     if _mesh_state["active"]:
         arrays = _harmonize_mesh_placement(arrays)
 

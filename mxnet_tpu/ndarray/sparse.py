@@ -89,6 +89,12 @@ class BaseSparseNDArray(NDArray):
         return _np.dtype(self._sp_dtype)
 
     @property
+    def ndim(self) -> int:
+        # NDArray.ndim peeks at the dense _buf slot, which sparse
+        # wrappers never populate
+        return len(self._sp_shape)
+
+    @property
     def context(self) -> Context:
         return self._ctx or current_context()
 

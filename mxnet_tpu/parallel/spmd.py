@@ -235,17 +235,26 @@ class SPMDTrainer:
         # scalars). t lives on device and advances by a tiny jitted
         # increment; lr/wd are laundered once per distinct value.
         self._t_dev = None
-        self._scalar_cache: Dict[float, Any] = {}
+        # LRU, not clear-at-cap: a cyclic lr schedule (warm restarts)
+        # revisits values — a wholesale clear at overflow would re-pay
+        # the committed-transfer for EVERY schedule scalar each cycle,
+        # while LRU eviction only drops the coldest value
+        from collections import OrderedDict as _OD
+        self._scalar_cache: "_OD[float, Any]" = _OD()
+
+    _SCALAR_CACHE_CAP = 512
 
     def _committed_scalar(self, v: float) -> Any:
         key = float(v)
         a = self._scalar_cache.get(key)
         if a is None:
             from .. import engine as _engine
-            if len(self._scalar_cache) > 512:  # schedule-driven lr churn
-                self._scalar_cache.clear()
             a = _engine.launder([jnp.float32(key)])[0]
             self._scalar_cache[key] = a
+            if len(self._scalar_cache) > self._SCALAR_CACHE_CAP:
+                self._scalar_cache.popitem(last=False)
+        else:
+            self._scalar_cache.move_to_end(key)
         return a
 
     def _advance_t(self) -> Any:
@@ -528,6 +537,10 @@ class SPMDTrainer:
         lrs_a, wds_a, t0_a = _engine.launder(
             [jnp.asarray(lrs, jnp.float32), jnp.asarray(wds, jnp.float32),
              jnp.float32(base + 1)])
+        # donated param/state buffers: pending bulked segments holding
+        # them must materialize first
+        from .. import bulk as _bulk
+        _bulk.flush_all("mutation")
         new_params, new_states, losses = self._multi_fn(
             param_arrays, self._opt_states, keys,
             lrs_a, wds_a, t0_a, *arrays, label_arr)
@@ -573,6 +586,10 @@ class SPMDTrainer:
         wd = self.optimizer.wd
         rng = _random.split_key()
         param_arrays = [p.data()._data for p in self._params]
+        # the compiled step donates param/state buffers: any pending
+        # bulked segment still holding one must materialize first
+        from .. import bulk as _bulk
+        _bulk.flush_all("mutation")
         new_params, new_states, loss = self._step_fn(
             param_arrays, self._opt_states, rng,
             self._committed_scalar(lr), self._committed_scalar(wd),

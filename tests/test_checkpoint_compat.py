@@ -28,11 +28,17 @@ def _fresh_net():
 
 def test_golden_params_load_exact():
     """.params from r5 loads and reproduces the recorded forward output
-    bit-for-bit (f32 CPU math is deterministic)."""
+    bit-for-bit (f32 CPU math is deterministic).  The fixture was
+    recorded with per-op dispatch, so the forward pins
+    MXNET_BULK_MAX_OPS=1: fused bulked segments may FMA-contract and
+    differ in the last ulp (docs/performance.md numerics caveat) — that
+    is not the format drift this test exists to catch."""
+    from mxnet_tpu import engine
     net = _fresh_net()
     net.load_parameters(os.path.join(FIX, "golden_r5.params"))
     x = mx.np.array(onp.arange(8, dtype="float32").reshape(2, 4) / 10.0)
-    got = net(x).asnumpy()
+    with engine.bulk(1):
+        got = net(x).asnumpy()
     want = onp.load(os.path.join(FIX, "golden_r5_output.npy"))
     onp.testing.assert_array_equal(got, want)
 

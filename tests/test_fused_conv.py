@@ -160,7 +160,9 @@ def _clear_trace_caches():
     installed — clear both so the trace re-runs."""
     from mxnet_tpu.ndarray.register import _EXEC_CACHE
     from mxnet_tpu.gluon.block import invalidate_cached_graphs
+    from mxnet_tpu import bulk
     _EXEC_CACHE.clear()
+    bulk.reset_caches()     # compiled bulked segments replay impls too
     invalidate_cached_graphs()
 
 

@@ -24,6 +24,8 @@ def exec_cache():
     saved_cache = dict(reg._EXEC_CACHE)
     saved_count = dict(reg._CHURN_COUNT)
     saved_eager = set(reg._CHURN_EAGER)
+    saved_ops = dict(reg._EAGER_OPS)
+    saved_sigs = dict(reg._EAGER_SIGS)
     yield
     reg._exec_mode["value"] = prev
     reg._EXEC_CACHE.clear()
@@ -32,6 +34,10 @@ def exec_cache():
     reg._CHURN_COUNT.update(saved_count)
     reg._CHURN_EAGER.clear()
     reg._CHURN_EAGER.update(saved_eager)
+    reg._EAGER_OPS.clear()
+    reg._EAGER_OPS.update(saved_ops)
+    reg._EAGER_SIGS.clear()
+    reg._EAGER_SIGS.update(saved_sigs)
 
 
 def test_cache_hits_and_matches_eager(exec_cache):
@@ -167,11 +173,45 @@ def test_trace_failure_poisons_to_eager(exec_cache):
     assert onp.allclose(r1.asnumpy(), 2.0)
     r2 = reg.invoke("fake_concrete_op", impl, [x])
     assert onp.allclose(r2.asnumpy(), 2.0)
-    # the call-counting closure cell makes each call's key distinct; every
-    # entry for this op must have been poisoned to the eager sentinel
-    poisoned = [v for k, v in reg._EXEC_CACHE.items()
-                if k[0] == "fake_concrete_op"]
-    assert poisoned and all(v is reg._EAGER_ONLY for v in poisoned)
+    # every failed trace must have recorded an aval-keyed eager-only
+    # signature (the call-counting closure cell makes each key distinct)
+    poisoned = [s for s in reg._EAGER_SIGS if s[0] == "fake_concrete_op"]
+    assert poisoned
+    assert all(k[:3] in reg._EAGER_OPS for k in poisoned)
+
+
+def test_trace_failure_poison_is_aval_keyed(exec_cache):
+    """Regression (ISSUE 4 satellite): a trace failure that is INPUT-
+    dependent must poison only the failing (op, attrs, avals) signature.
+    The old sentinel lived in the cache keyed by (op, attrs) alone, so
+    one bad input (e.g. a weak-typed scalar taking a host branch) forced
+    the op eager for every other input shape forever."""
+    def impl(x):
+        # scalar inputs take a host-side value branch (concretizes the
+        # tracer under jit); any larger input is pure vectorized math
+        if x.size == 1 and bool(x[0] > 0):
+            return x * 2
+        return x * 2
+
+    scalar = mx.np.array(onp.ones((1,), "float32"))
+    big = mx.np.array(onp.ones((8,), "float32"))
+
+    r1 = reg.invoke("fake_aval_dep_op", impl, [scalar])
+    assert onp.allclose(r1.asnumpy(), 2.0)
+    assert any(s[0] == "fake_aval_dep_op" for s in reg._EAGER_SIGS)
+
+    # the non-failing shape must still compile and then hit the cache
+    hits0 = reg.exec_cache_stats()["hits"]
+    r2 = reg.invoke("fake_aval_dep_op", impl, [big])
+    r3 = reg.invoke("fake_aval_dep_op", impl, [big])
+    assert onp.allclose(r2.asnumpy(), 2.0)
+    assert onp.allclose(r3.asnumpy(), 2.0)
+    assert reg.exec_cache_stats()["hits"] > hits0, \
+        "input-dependent poison leaked to an unaffected input signature"
+
+    # and the poisoned shape keeps working eagerly
+    r4 = reg.invoke("fake_aval_dep_op", impl, [scalar])
+    assert onp.allclose(r4.asnumpy(), 2.0)
 
 
 def test_churning_attrs_fall_back_to_eager(exec_cache):

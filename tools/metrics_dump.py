@@ -17,6 +17,9 @@ Workloads:
                dispatch per op, kvstore push, data/dispatch/sync split).
   eager        a handful of eager ops + a waitall (dispatch and engine
                counters only).
+  bulk         an eager training micro-loop exercising the lazy
+               bulking engine: segment flush reasons, segment-cache
+               hits/misses, and the ops-per-segment histogram.
 
 Runs on the CPU backend by default so it works anywhere (pass
 ``--platform ambient`` to keep the environment's backend, e.g. the TPU
@@ -88,10 +91,38 @@ def _workload_eager(steps: int) -> None:
     mx.waitall()
 
 
+def _workload_bulk(steps: int) -> None:
+    import numpy as onp
+    import mxnet_tpu as mx
+    from mxnet_tpu import autograd
+
+    mx.random.seed(0)
+    net = mx.gluon.nn.Sequential()
+    net.add(mx.gluon.nn.Dense(32, activation="tanh"),
+            mx.gluon.nn.Dense(8))
+    net.initialize()
+    loss_fn = mx.gluon.loss.SoftmaxCrossEntropyLoss()
+    trainer = mx.gluon.Trainer(net.collect_params(), "sgd",
+                               {"learning_rate": 0.1}, kvstore=None)
+    rng = onp.random.RandomState(0)
+    x = mx.np.array(rng.randn(8, 16).astype("float32"))
+    y = mx.np.array(rng.randint(0, 8, (8,)).astype("int32"))
+    for _ in range(max(steps, 3)):
+        with autograd.record():
+            loss = loss_fn(net(x), y).mean()
+        loss.backward()
+        trainer.step(8)
+        loss.asnumpy()
+    # a host-read flush and a barrier flush for reason variety
+    (x * 2 + 1).asnumpy()
+    mx.waitall()
+
+
 WORKLOADS = {
     "resnet_step": _workload_resnet_step,
     "mlp_fit": _workload_mlp_fit,
     "eager": _workload_eager,
+    "bulk": _workload_bulk,
 }
 
 
