@@ -15,6 +15,10 @@
 #                           #   shed-not-crash)
 #   ci/run.sh chaos-smoke   # bounded fault-injection/preemption proof
 #                           #   (tests/test_faults.py -k smoke)
+#   ci/run.sh health-smoke  # training health guard acceptance: seeded
+#                           #   NaN plan -> exactly one skip + loss
+#                           #   recovery + budget; watchdog stack dump
+#                           #   on an injected stall; replay identical
 #   ci/run.sh chaos         # full chaos suite incl. SIGKILL/SIGTERM
 #                           #   subprocess resume proofs
 #   ci/run.sh bulk-smoke    # lazy-bulking acceptance: lstm micro-run
@@ -111,6 +115,12 @@ run_bulk_off() {
     tests/test_gluon.py tests/test_numpy.py tests/test_rnn.py
 }
 
+run_health_smoke() {
+  echo "== health-smoke: NaN sentry skip + loss recovery + budget,"
+  echo "   hang-watchdog stack dump, deterministic replay"
+  JAX_PLATFORMS=cpu timeout 300 python tools/health_smoke.py
+}
+
 run_chaos() {
   echo "== chaos: the full fault-tolerance suite, including the"
   echo "   SIGKILL/SIGTERM subprocess resume proofs"
@@ -120,12 +130,13 @@ run_chaos() {
 
 run_tier1() {
   echo "== tier1: env-doc freshness + fault-site doc lint + serving"
-  echo "   smoke + chaos smoke + bulking smoke + the tier-1 pytest"
-  echo "   selection"
+  echo "   smoke + chaos smoke + health smoke + bulking smoke + the"
+  echo "   tier-1 pytest selection"
   run_envdoc
   run_faultdoc
   run_serving_smoke
   run_chaos_smoke
+  run_health_smoke
   run_bulk_smoke
   JAX_PLATFORMS=cpu python -m pytest tests/ -q -m 'not slow' \
     --continue-on-collection-errors -p no:cacheprovider
@@ -218,6 +229,7 @@ case "$variant" in
   faultdoc)     run_faultdoc ;;
   serving-smoke) run_serving_smoke ;;
   chaos-smoke)  run_chaos_smoke ;;
+  health-smoke) run_health_smoke ;;
   chaos)        run_chaos ;;
   bulk-smoke)   run_bulk_smoke ;;
   bulk-off)     run_bulk_off ;;

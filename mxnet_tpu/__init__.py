@@ -76,6 +76,7 @@ _LAZY = {
     "faults": ".faults",
     "retry": ".retry",
     "preemption": ".preemption",
+    "health": ".health",
     "name": ".name",
     "attribute": ".attribute",
     "visualization": ".visualization",

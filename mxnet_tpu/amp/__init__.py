@@ -177,6 +177,12 @@ class DynamicLossScaler:
                 self.loss_scale *= self._factor
                 self._unskipped = 0
 
+    def decay(self) -> None:
+        """Treat the current step as an overflow: halve the scale and
+        reset the clean-step window (the health guard's skip policy
+        calls this so a dropped fp16 step also backs the scale off)."""
+        self.update_scale(True)
+
 
 def init_trainer(trainer: Any, init_scale: float = 2.0 ** 16,
                  scale_window: int = 2000) -> None:

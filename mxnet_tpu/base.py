@@ -27,6 +27,19 @@ __all__ = [
 ]
 
 
+def _distributed_initialized(jax) -> bool:
+    """``jax.distributed.is_initialized()`` with a fallback for jax
+    versions that predate it (<= 0.4.3x): the distributed global state
+    holds a live client exactly when initialize() ran."""
+    if hasattr(jax.distributed, "is_initialized"):
+        return bool(jax.distributed.is_initialized())
+    try:
+        from jax._src.distributed import global_state
+        return global_state.client is not None
+    except Exception:   # noqa: BLE001 - private-API drift
+        return False
+
+
 def join_distributed_job() -> bool:
     """Join the multi-process job described by the launcher env
     (``tools/launch.py`` sets ``JAX_COORDINATOR_ADDRESS`` /
@@ -42,7 +55,7 @@ def join_distributed_job() -> bool:
     if not coord or os.environ.get("MXNET_NO_AUTO_DISTRIBUTED") == "1":
         return False
     import jax
-    if jax.distributed.is_initialized():
+    if _distributed_initialized(jax):
         return True
     too_late = MXNetError(
         "the XLA backend was initialized before joining the "
@@ -59,6 +72,23 @@ def join_distributed_job() -> bool:
         if getattr(_xb, "_backends", None):
             raise too_late
     except ImportError:
+        pass
+    # CPU multi-process jobs need a cross-process collective backend:
+    # without one, XLA:CPU rejects any multiprocess computation
+    # ("Multiprocess computations aren't implemented on the CPU
+    # backend"). Select gloo where this jax exposes the knob; harmless
+    # before backend init, skipped for real accelerator jobs.
+    try:
+        platforms = (os.environ.get("JAX_PLATFORMS", "") or "").lower()
+        if ("cpu" in platforms
+                and "jax_cpu_collectives_implementation"
+                in jax.config.values
+                and jax.config.values[
+                    "jax_cpu_collectives_implementation"]
+                in (None, "none")):
+            jax.config.update("jax_cpu_collectives_implementation",
+                              "gloo")
+    except Exception:   # noqa: BLE001 - version-dependent config surface
         pass
     try:
         jax.distributed.initialize(

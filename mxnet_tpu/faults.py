@@ -20,6 +20,9 @@ documented in docs/fault_tolerance.md):
 * ``dataloader.worker`` — inside a DataLoader worker, per batch job
 * ``serving.execute``   — ModelServer worker, per assembled batch
 * ``dispatch.op``       — the imperative op dispatch path, per op
+* ``trainer.step``      — the optimizer-step boundary, per step (the
+  tensor-corrupting site: ``kind=nan`` plants a NaN via
+  :func:`maybe_corrupt`)
 
 Arming: the ``MXNET_FAULT_PLAN`` environment variable (parsed at import,
 so subprocess chaos tests arm via env alone), or the API::
@@ -34,10 +37,10 @@ Plan grammar — ``;``-separated clauses, each ``site:k=v:k=v...``::
     kvstore.recv:p=0.05:kind=timeout;checkpoint.write:p=1:times=2
 
 Clause fields: ``p`` (injection probability per hit, default 1),
-``kind`` (``error`` | ``timeout`` | ``crash`` | ``delay``, default
-error), ``after`` (skip the first N hits), ``times`` (stop after M
-injections; default unlimited), ``delay_ms`` (for kind=delay), ``seed``
-(per-clause RNG seed override).
+``kind`` (``error`` | ``timeout`` | ``crash`` | ``delay`` | ``nan``,
+default error), ``after`` (skip the first N hits), ``times`` (stop
+after M injections; default unlimited), ``delay_ms`` (for kind=delay),
+``seed`` (per-clause RNG seed override).
 
 Determinism: every clause draws from its own ``random.Random`` seeded by
 ``MXNET_FAULT_SEED`` (default 0) xor a stable hash of the site name —
@@ -52,6 +55,9 @@ Kinds:
 * ``crash``   — ``os._exit(17)``: the process dies NOW, no cleanup —
   the SIGKILL analog for in-process chaos
 * ``delay``   — sleep ``delay_ms`` then continue (slow-peer simulation)
+* ``nan``     — corrupt the first tensor at a :func:`maybe_corrupt`
+  site with NaN (the silent-numerics-failure simulation the health
+  sentry trains against; tensor-less sites reject it loudly)
 
 Every injection counts into the PR-1 metrics registry
 (``mxnet_faults_injected_total{site,kind}``), so a chaos run's metric
@@ -67,7 +73,7 @@ import os
 import threading
 import time
 import zlib
-from typing import Any, Dict, List, Optional
+from typing import Any, Dict, List, Optional, Sequence
 
 from .base import MXNetError, register_env
 from . import metrics as _metrics
@@ -75,16 +81,16 @@ from . import metrics as _metrics
 __all__ = [
     "FaultInjected", "FaultSpec", "arm", "disarm", "fault_plan",
     "parse_plan", "arm_from_env", "armed_sites", "known_sites",
-    "maybe_fault", "injected_count",
+    "maybe_fault", "maybe_corrupt", "injected_count",
 ]
 
 register_env(
     "MXNET_FAULT_PLAN", "",
     "Deterministic fault-injection plan, ';'-separated clauses of "
     "'site:p=0.05:kind=timeout' form (kinds: error, timeout, crash, "
-    "delay; fields: p, kind, after, times, delay_ms, seed). Sites: see "
-    "docs/fault_tolerance.md. Parsed once at import; empty (default) "
-    "disarms everything.")
+    "delay, nan; fields: p, kind, after, times, delay_ms, seed). "
+    "Sites: see docs/fault_tolerance.md. Parsed once at import; empty "
+    "(default) disarms everything.")
 register_env(
     "MXNET_FAULT_SEED", 0,
     "Base seed for the per-site fault-injection RNGs: the same "
@@ -119,9 +125,16 @@ _SITES: Dict[str, str] = {
     "dispatch.op":
         "the imperative op dispatch path (ndarray.register.invoke), "
         "per op call",
+    "trainer.step":
+        "the optimizer-step boundary (gluon Trainer.step before the "
+        "gradient reduction, SPMDTrainer.step before the compiled "
+        "program), per step — a tensor-corrupting site: kind=nan "
+        "poisons the first gradient (gluon) / the batch (SPMD) with "
+        "NaN so the health sentry's detect/skip/rewind schedule "
+        "replays deterministically",
 }
 
-_KINDS = ("error", "timeout", "crash", "delay")
+_KINDS = ("error", "timeout", "crash", "delay", "nan")
 
 _ARMED = False                       # hot-path gate, rebuilt on arm/disarm
 _PLAN: Dict[str, List["FaultSpec"]] = {}
@@ -185,7 +198,8 @@ class FaultSpec:
                 f":after={self.after}:times={self.times}"
                 f" hits={self.hits} injected={self.injected})")
 
-    def _check(self, ctx: Dict[str, Any]) -> None:
+    def _check(self, ctx: Dict[str, Any],
+               corrupt: Optional[Any] = None) -> None:
         with self._lock:
             self.hits += 1
             if self.hits <= self.after:
@@ -206,6 +220,17 @@ class FaultSpec:
                 "[mxnet_tpu.faults]")
         if self.kind == "crash":
             os._exit(17)
+        if self.kind == "nan":
+            # tensor corruption: only sites that pass arrays through
+            # maybe_corrupt can apply it — a kind=nan clause armed at a
+            # tensor-less site is a plan bug and fails loudly
+            if corrupt is None:
+                raise MXNetError(
+                    f"fault kind 'nan' armed at site {self.site!r}, "
+                    "which passes no tensor to corrupt — use a "
+                    "tensor-carrying site (trainer.step)")
+            corrupt()
+            return
         raise FaultInjected(self.site, ctx)
 
 
@@ -338,6 +363,65 @@ def maybe_fault(site: str, **ctx: Any) -> None:
         return
     for spec in list(specs):
         spec._check(ctx)
+
+
+def _float_idx(arrays: Sequence[Any]) -> Optional[int]:
+    """Index of the first float-dtype array (only floats can carry a
+    NaN; token-id int batches pass through).  jnp.issubdtype, not
+    numpy's: bfloat16 (the standard TPU training dtype) is an
+    ml_dtypes float that numpy refuses to classify as floating."""
+    import jax.numpy as jnp
+    for i, a in enumerate(arrays):
+        dt = getattr(a, "dtype", None)
+        if dt is not None and jnp.issubdtype(dt, jnp.floating):
+            return i
+    return None
+
+
+def _poison_nan(a: Any) -> Any:
+    """Return ``a`` with its first element overwritten by NaN."""
+    import numpy as onp
+    if isinstance(a, onp.ndarray):
+        a = a.copy()
+        a.reshape(-1)[0] = onp.nan
+        return a
+    import jax.numpy as jnp
+    idx = (0,) * a.ndim
+    return a.at[idx].set(jnp.nan)
+
+
+def maybe_corrupt(site: str, arrays: Sequence[Any], **ctx: Any) -> List[Any]:
+    """Tensor-carrying site call: like :func:`maybe_fault`, but a firing
+    ``kind=nan`` clause corrupts the first FLOAT array with NaN instead
+    of raising (other kinds behave exactly as at any site).  Returns
+    the (possibly corrupted) arrays; callers gate on ``_ARMED``
+    first."""
+    out = list(arrays)
+    if not _ARMED:
+        return out
+    specs = _PLAN.get(site)
+    if not specs:
+        return out
+    fire = []
+    fi = _float_idx(out)
+
+    def _do() -> None:
+        if fi is None:
+            # the clause fired but there is nothing that can carry a
+            # NaN (int-only tensors): a silent no-injection would make
+            # the plan's metrics lie — fail loudly instead
+            raise MXNetError(
+                f"fault kind 'nan' fired at site {site!r} but none of "
+                f"the {len(out)} tensors present has a float dtype — "
+                "nothing can carry a NaN (int token batches?); target "
+                "a float-input model or a different site")
+        fire.append(True)
+
+    for spec in list(specs):
+        spec._check(ctx, corrupt=_do)
+    if fire:
+        out[fi] = _poison_nan(out[fi])
+    return out
 
 
 # Arm from the environment at import: chaos subprocesses configure the

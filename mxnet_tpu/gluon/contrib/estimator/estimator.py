@@ -91,7 +91,8 @@ class Estimator:
             event_handlers: Optional[Sequence[Any]] = None,
             batches: Optional[int] = None,
             checkpoint_manager: Any = None,
-            checkpoint_every: int = 0) -> None:
+            checkpoint_every: int = 0,
+            health_guard: Any = None) -> None:
         """Train; with ``checkpoint_manager`` the call is preemption-
         safe: the newest verified checkpoint is restored before the
         first batch, a checkpoint is written every ``checkpoint_every``
@@ -102,7 +103,16 @@ class Estimator:
         steps across restarts; ``epochs``-mode resumes the weights and
         optimizer state but restarts its epoch count (epoch progress is
         not recorded in the checkpoint) — prefer ``batches`` for
-        preemptible jobs."""
+        preemptible jobs.
+
+        With ``health_guard`` (:class:`mxnet_tpu.health.HealthGuard`):
+        the trainer's step gains the fused numerics sentry
+        (``guard.install``) covering the loss (finiteness + EMA
+        divergence) and every gradient in ONE reduction before the
+        update, a bad batch is dropped or rewound per policy (rewind
+        needs ``checkpoint_manager``; the loop then continues with
+        subsequent batches), and the hang watchdog arms around every
+        batch."""
         if epochs is None and batches is None:
             raise MXNetError("fit: specify epochs or batches")
         resumed = 0
@@ -136,9 +146,17 @@ class Estimator:
         for h in train_begin:
             h.train_begin(self)
 
+        import contextlib
         import time
         from .... import metrics as _metrics
         from ....preemption import PreemptionGuard
+
+        if health_guard is not None:
+            health_guard.install(self.trainer)
+            if checkpoint_manager is not None:
+                health_guard.set_rewind(
+                    lambda: checkpoint_manager.restore(self.trainer,
+                                                       block=self.net))
 
         last_saved = [-1]
 
@@ -172,11 +190,21 @@ class Estimator:
                     t_data = time.perf_counter()
                     for h in batch_begin:
                         h.batch_begin(self, batch=batch)
-                    with autograd.record():
-                        pred = self.net(data)
-                        loss = self.loss(pred, label)
-                    loss.backward()
-                    self.trainer.step(data.shape[0])
+                    with (health_guard.watch("trainer.step")
+                          if health_guard is not None
+                          else contextlib.nullcontext()):
+                        with autograd.record():
+                            pred = self.net(data)
+                            loss = self.loss(pred, label)
+                        loss.backward()
+                        if health_guard is not None:
+                            # the installed _step_impl hook folds this
+                            # loss into its fused gradient check (after
+                            # the trainer.step fault site) — one
+                            # reduction, one readback per step; a bad
+                            # step skips/rewinds inside the hook
+                            health_guard.note_loss(loss)
+                        self.trainer.step(data.shape[0])
                     t_dispatch = time.perf_counter()
                     for h in batch_end:
                         if h.batch_end(self, batch=batch, pred=pred,

@@ -215,12 +215,36 @@ class Trainer:
     def step(self, batch_size: int, ignore_stale_grad: bool = False) -> None:
         """Rescale grads by 1/batch_size and apply one optimizer update."""
         import time
+        from .. import faults as _faults
         from .. import metrics as _metrics
+        if _faults._ARMED:
+            self._fault_site()
         t0 = time.perf_counter()
         try:
             self._step_impl(batch_size, ignore_stale_grad)
         finally:
             _metrics.TRAINER_STEP_SECONDS.observe(time.perf_counter() - t0)
+
+    def _fault_site(self) -> None:
+        """The ``trainer.step`` chaos site: ``kind=nan`` corrupts the
+        first fresh gradient BEFORE the reduction/update (and before
+        any health-guard check), so the sentry's recovery schedule
+        replays deterministically from ``MXNET_FAULT_PLAN``."""
+        from .. import faults as _faults
+        target = None
+        for p in self._params:
+            if p.grad_req != "null" and p.is_initialized:
+                w = p.data()
+                if w.grad is not None and w._fresh_grad:
+                    target = w
+                    break
+        if target is None:
+            _faults.maybe_fault("trainer.step")
+            return
+        out = _faults.maybe_corrupt("trainer.step", [target.grad._data])
+        if out[0] is not target.grad._data:
+            from ..ndarray.ndarray import from_jax
+            target._grad = from_jax(out[0])
 
     def _step_impl(self, batch_size: int, ignore_stale_grad: bool) -> None:
         self._optimizer.rescale_grad = self._scale / batch_size

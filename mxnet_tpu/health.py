@@ -1,0 +1,692 @@
+"""Training health guard — NaN/Inf sentry, divergence recovery, hang
+watchdog.
+
+NEW capability beyond the reference (no leezu/mxnet analog): PR 3 made
+*crash-shaped* failures routine (worker death, preemption, torn
+checkpoints), but the stack stayed blind to *silent* failures — a NaN
+gradient poisons every later step, a diverging loss burns the rest of
+the job's budget, and a hung collective stalls the whole fleet with no
+evidence of who stopped.  The asynchronous engine the MXNet paper
+describes makes exactly these failures hard to observe from Python
+(arXiv:1512.01274 §4), and collective-based distributed training turns
+one wedged rank into a whole-job hang (arXiv:1802.06949).  This module
+is the always-available answer, three cooperating pieces behind one
+:class:`HealthGuard`:
+
+1. **Numerics sentry** — ``guard.check(loss, grads)`` runs ONE fused
+   on-device finite/overflow reduction over the loss and every gradient
+   (no per-tensor host syncs; a single 3-scalar readback per step) plus
+   a windowed loss-divergence detector (EMA + spike threshold,
+   ``MXNET_HEALTH_LOSS_SPIKE``).  Under the PR-4 bulking engine the
+   check rides the step boundary's existing optimizer-donation barrier
+   — it never forces an extra segment flush (tests/test_health.py
+   asserts the flush count).
+
+2. **Recovery policy** (``MXNET_HEALTH_POLICY``) —
+
+   * ``skip``   — drop the step: the update is zeroed (on-device for
+     ``SPMDTrainer``'s gated step; by marking grads consumed on the
+     gluon path), and an attached AMP loss scaler decays;
+   * ``rewind`` — restore the newest verified checkpoint through PR 3's
+     ``CheckpointManager`` and replay with a perturbed data order
+     (``guard.replay_salt`` is passed to ``batch_fn(step, salt=...)``
+     when the callable accepts it);
+   * ``abort``  — raise a structured :class:`HealthError` naming the
+     first offending array (the fused reduction returns its index).
+
+   Budgets (``MXNET_HEALTH_MAX_SKIPS`` / ``MXNET_HEALTH_MAX_REWINDS``)
+   bound both recoveries: a truly broken run fails fast with a
+   structured error instead of looping forever.
+
+3. **Hang watchdog** — a lazy daemon thread armed per training step
+   (``MXNET_HEALTH_STEP_DEADLINE_S``) and around kvstore collectives /
+   barriers; serving's ``ModelServer`` arms it per executed batch.  On
+   deadline it dumps every thread's stack plus a metrics snapshot to a
+   diagnostics file (``MXNET_HEALTH_DIAG_DIR``), counts
+   ``mxnet_health_events_total{kind="hang"}``, and — when the guarded
+   section eventually completes under ``policy="abort"`` — raises.
+   A section that never completes cannot be recovered in-process; the
+   dump (who held the lock, which rank stalled) is the deliverable.
+
+All three training loops share this one implementation:
+``SPMDTrainer.fit(health_guard=)`` (the compiled step gates its own
+update on-device, so a skipped step never touches parameters),
+``Estimator.fit(health_guard=)``, and ``guard.install(trainer)`` for a
+hand-written gluon loop.
+
+Deterministic testing: the ``trainer.step`` fault site with
+``kind=nan`` (``mxnet_tpu.faults``) corrupts the tensors feeding the
+update, so a seeded ``MXNET_FAULT_PLAN`` replays the exact same
+detect/skip/rewind schedule on every run.
+"""
+from __future__ import annotations
+
+import contextlib
+import json
+import os
+import sys
+import threading
+import time
+import traceback
+from typing import Any, Callable, Dict, List, Optional, Sequence, Tuple
+
+from .base import MXNetError, getenv, register_env
+from . import metrics as _metrics
+
+__all__ = ["HealthError", "HealthVerdict", "HealthGuard", "HangWatchdog",
+           "watchdog", "watch_section", "fused_finite_check",
+           "last_dump_path"]
+
+register_env(
+    "MXNET_HEALTH_POLICY", "skip",
+    "Default recovery policy of mxnet_tpu.health.HealthGuard when a "
+    "training step goes bad (non-finite loss/gradients or a loss "
+    "spike): 'skip' drops the step (zero update, AMP loss-scale "
+    "decay), 'rewind' restores the newest verified checkpoint and "
+    "replays with a perturbed data order, 'abort' raises a structured "
+    "HealthError naming the first offending array.")
+register_env(
+    "MXNET_HEALTH_LOSS_SPIKE", 0.0,
+    "Loss-divergence spike threshold for the health guard: a finite "
+    "loss exceeding this factor times the windowed loss EMA (after "
+    "MXNET_HEALTH_LOSS_WINDOW warmup steps) triggers the recovery "
+    "policy. 0 (default) disables divergence detection; non-finite "
+    "detection is always on while a guard is attached.")
+register_env(
+    "MXNET_HEALTH_LOSS_WINDOW", 20,
+    "EMA window (steps) of the health guard's loss-divergence "
+    "detector; also the warmup step count before spike detection "
+    "arms.")
+register_env(
+    "MXNET_HEALTH_MAX_SKIPS", 10,
+    "Skip budget of the health guard: after this many dropped steps "
+    "in one run the guard aborts with a structured HealthError "
+    "instead of skipping forever.")
+register_env(
+    "MXNET_HEALTH_MAX_REWINDS", 2,
+    "Rewind budget of the health guard: after this many checkpoint "
+    "rewinds in one run the guard aborts with a structured "
+    "HealthError.")
+register_env(
+    "MXNET_HEALTH_STEP_DEADLINE_S", 0.0,
+    "Hang-watchdog deadline (seconds) armed around each training step, "
+    "kvstore collective/barrier, and served batch: past the deadline "
+    "the watchdog dumps all-thread stacks + a metrics snapshot to "
+    "MXNET_HEALTH_DIAG_DIR and counts mxnet_health_events_total"
+    "{kind=\"hang\"}. 0 (default) disarms the watchdog.")
+register_env(
+    "MXNET_HEALTH_DIAG_DIR", "",
+    "Directory for the hang watchdog's diagnostics dumps (all-thread "
+    "stacks + metrics snapshot). Empty (default) writes into the "
+    "current working directory.")
+
+HEALTH_EVENTS = _metrics.counter(
+    "mxnet_health_events_total",
+    "Training health events detected by mxnet_tpu.health, by kind: "
+    "nonfinite (NaN/Inf loss or gradient), loss_spike (finite loss "
+    "above the EMA spike threshold), hang (watchdog deadline "
+    "expired).", labels=("kind",))
+HEALTH_SKIPS = _metrics.counter(
+    "mxnet_health_skipped_steps_total",
+    "Training steps dropped by the health guard's skip policy (update "
+    "zeroed, AMP loss-scale decayed).")
+HEALTH_REWINDS = _metrics.counter(
+    "mxnet_health_rewinds_total",
+    "Checkpoint rewinds performed by the health guard's rewind "
+    "policy.")
+HEALTH_CHECK_SECONDS = _metrics.histogram(
+    "mxnet_health_check_seconds",
+    "Wall time of the health guard's fused numerics check (dispatch + "
+    "the single per-step scalar readback).")
+HEALTH_WATCHDOG_FIRES = _metrics.counter(
+    "mxnet_health_watchdog_fires_total",
+    "Hang-watchdog deadline expirations, by guarded site (each writes "
+    "one diagnostics dump).", labels=("site",))
+HEALTH_LOSS_EMA = _metrics.gauge(
+    "mxnet_health_loss_ema",
+    "The health guard's windowed loss EMA (divergence-detector "
+    "state).")
+
+_POLICIES = ("skip", "rewind", "abort")
+
+
+class HealthError(MXNetError):
+    """A health-guard abort: non-recoverable numerics, an exhausted
+    skip/rewind budget, or a deadline overrun under policy='abort'."""
+
+
+class HealthVerdict:
+    """One step's health decision.  ``ok`` is True for a clean step;
+    otherwise ``action`` ('skip' | 'rewind'), ``kind`` ('nonfinite' |
+    'loss_spike') and ``culprit`` (the first offending array's name, or
+    'loss') say what happened — aborts raise instead of returning."""
+
+    __slots__ = ("ok", "action", "kind", "culprit", "loss")
+
+    def __init__(self, ok: bool, action: str = "ok", kind: str = "",
+                 culprit: str = "", loss: float = float("nan")) -> None:
+        self.ok = ok
+        self.action = action
+        self.kind = kind
+        self.culprit = culprit
+        self.loss = loss
+
+    def __repr__(self) -> str:
+        if self.ok:
+            return f"HealthVerdict(ok, loss={self.loss:g})"
+        return (f"HealthVerdict({self.action}, kind={self.kind}, "
+                f"culprit={self.culprit!r}, loss={self.loss:g})")
+
+
+# ---------------------------------------------------------------------------
+# fused numerics check (eager path): ONE compiled reduction over loss +
+# every gradient, ONE small readback.  jax retraces per input signature
+# and caches the executable, so steady-state training reuses one program.
+# ---------------------------------------------------------------------------
+
+_CHECK_FN = None
+
+
+def fused_finite_check(loss: Any, arrays: Sequence[Any]) -> Any:
+    """Device-side [any_bad, first_bad_index, loss_value] over ``loss``
+    and ``arrays`` (index 0 is the loss; array i is index i+1).  Returns
+    the un-fetched (3,) f32 device array — the caller owns the single
+    readback."""
+    global _CHECK_FN
+    import jax
+    import jax.numpy as jnp
+    if _CHECK_FN is None:
+        def _impl(loss_a, arrs):
+            flags = [jnp.logical_not(jnp.all(jnp.isfinite(loss_a)))]
+            for a in arrs:
+                flags.append(jnp.logical_not(jnp.all(jnp.isfinite(a))))
+            bad = jnp.stack(flags)
+            lossv = jnp.mean(loss_a).astype(jnp.float32)
+            return jnp.stack([bad.any().astype(jnp.float32),
+                              jnp.argmax(bad).astype(jnp.float32),
+                              lossv])
+        _CHECK_FN = jax.jit(_impl)
+    return _CHECK_FN(loss, tuple(arrays))
+
+
+# ---------------------------------------------------------------------------
+# hang watchdog
+# ---------------------------------------------------------------------------
+
+_LAST_DUMP: Dict[str, Optional[str]] = {"path": None}
+
+
+def last_dump_path() -> Optional[str]:
+    """Path of the most recent watchdog diagnostics dump (None if the
+    watchdog never fired in this process)."""
+    return _LAST_DUMP["path"]
+
+
+def _write_dump(site: str, deadline_s: float, ctx: Dict[str, Any]) -> str:
+    """All-thread stacks + a metrics snapshot, atomically written to the
+    diagnostics dir.  This is the artifact an operator (or the chaos
+    suite) reads to answer 'who is holding the job up'."""
+    dirpath = str(getenv("MXNET_HEALTH_DIAG_DIR", "") or "") or os.getcwd()
+    os.makedirs(dirpath, exist_ok=True)
+    path = os.path.join(
+        dirpath,
+        f"mxnet-health-dump-{os.getpid()}-{int(time.time() * 1e3)}-"
+        f"{site.replace('.', '_')}.txt")
+    lines = [
+        "mxnet_tpu health watchdog diagnostics",
+        f"site: {site}",
+        f"deadline_s: {deadline_s}",
+        f"context: {ctx}",
+        f"time: {time.strftime('%Y-%m-%dT%H:%M:%S')}",
+        f"pid: {os.getpid()}",
+        "",
+        "== all-thread stacks ==",
+    ]
+    names = {t.ident: t.name for t in threading.enumerate()}
+    for tid, frame in sys._current_frames().items():
+        lines.append(f"-- thread {names.get(tid, '?')} (ident {tid}) --")
+        lines.extend(l.rstrip("\n")
+                     for l in traceback.format_stack(frame))
+        lines.append("")
+    lines.append("== metrics snapshot (non-zero series) ==")
+    try:
+        lines.append(json.dumps(_metrics._nonzero_summary(), indent=1))
+    except Exception:   # noqa: BLE001 - diagnostics must never raise
+        lines.append("(metrics snapshot unavailable)")
+    tmp = path + ".tmp"
+    with open(tmp, "w") as f:
+        f.write("\n".join(lines) + "\n")
+        f.flush()
+        os.fsync(f.fileno())
+    os.replace(tmp, path)
+    _LAST_DUMP["path"] = path
+    return path
+
+
+class _WatchSection:
+    __slots__ = ("watchdog", "site", "deadline_s", "deadline", "guard",
+                 "ctx", "fired", "fire_done", "dump_path", "key")
+
+    def __init__(self, wd: "HangWatchdog", site: str, deadline_s: float,
+                 guard: Optional["HealthGuard"],
+                 ctx: Dict[str, Any]) -> None:
+        self.watchdog = wd
+        self.site = site
+        self.deadline_s = deadline_s
+        self.guard = guard
+        self.ctx = ctx
+        self.fired = False
+        self.fire_done = threading.Event()
+        self.dump_path: Optional[str] = None
+        self.key = None
+
+    def __enter__(self) -> "_WatchSection":
+        self.deadline = time.monotonic() + self.deadline_s
+        self.watchdog._register(self)
+        return self
+
+    def __exit__(self, *exc: Any) -> None:
+        self.watchdog._unregister(self)
+        if self.fired and not any(exc) and self.guard is not None:
+            # the watchdog thread may still be writing the dump the
+            # escalation names — wait it out (bounded)
+            self.fire_done.wait(10.0)
+            # the section eventually completed: escalate per policy
+            self.guard.note_hang(self.site, self.dump_path)
+
+
+class HangWatchdog:
+    """One daemon thread that fires diagnostics when a guarded section
+    outlives its deadline.  Disarmed cost: ``watch`` returns a
+    nullcontext when the deadline is 0."""
+
+    def __init__(self) -> None:
+        self._cv = threading.Condition()
+        self._sections: Dict[int, _WatchSection] = {}
+        self._seq = 0
+        self._thread: Optional[threading.Thread] = None
+
+    def watch(self, site: str, deadline_s: Optional[float] = None,
+              guard: Optional["HealthGuard"] = None, **ctx: Any):
+        """Context manager arming ``site`` for ``deadline_s`` seconds
+        (default: ``MXNET_HEALTH_STEP_DEADLINE_S``; <=0 disarms)."""
+        if deadline_s is None:
+            deadline_s = float(getenv("MXNET_HEALTH_STEP_DEADLINE_S", 0.0))
+        if not deadline_s or deadline_s <= 0:
+            return contextlib.nullcontext()
+        return _WatchSection(self, site, float(deadline_s), guard, ctx)
+
+    # -- section registry ---------------------------------------------------
+    def _register(self, sec: _WatchSection) -> None:
+        with self._cv:
+            self._seq += 1
+            sec.key = self._seq
+            self._sections[sec.key] = sec
+            if self._thread is None or not self._thread.is_alive():
+                self._thread = threading.Thread(
+                    target=self._run, name="mxnet-health-watchdog",
+                    daemon=True)
+                self._thread.start()
+            self._cv.notify_all()
+
+    def _unregister(self, sec: _WatchSection) -> None:
+        with self._cv:
+            self._sections.pop(sec.key, None)
+            self._cv.notify_all()
+
+    # -- the watcher thread -------------------------------------------------
+    def _run(self) -> None:
+        while True:
+            fire: List[_WatchSection] = []
+            with self._cv:
+                now = time.monotonic()
+                nxt: Optional[float] = None
+                for sec in self._sections.values():
+                    if sec.fired:
+                        continue
+                    if sec.deadline <= now:
+                        sec.fired = True
+                        fire.append(sec)
+                    elif nxt is None or sec.deadline < nxt:
+                        nxt = sec.deadline
+                if not fire:
+                    # park until the nearest deadline or a registry change
+                    self._cv.wait(timeout=(None if nxt is None
+                                           else max(0.005, nxt - now)))
+            for sec in fire:
+                self._fire(sec)
+
+    def _fire(self, sec: _WatchSection) -> None:
+        try:
+            sec.dump_path = _write_dump(sec.site, sec.deadline_s, sec.ctx)
+        except Exception:   # noqa: BLE001 - diagnostics must never kill
+            sec.dump_path = None
+        HEALTH_EVENTS.labels(kind="hang").inc()
+        HEALTH_WATCHDOG_FIRES.labels(site=sec.site).inc()
+        sec.fire_done.set()
+        import logging
+        logging.getLogger("mxnet_tpu.health").error(
+            "watchdog: section %r exceeded its %.3gs deadline — "
+            "all-thread stack dump at %s", sec.site, sec.deadline_s,
+            sec.dump_path or "(dump failed)")
+
+
+_WATCHDOG = HangWatchdog()
+
+
+def watchdog() -> HangWatchdog:
+    """The process-wide watchdog instance (shared by training loops,
+    kvstore collectives, and the serving executor)."""
+    return _WATCHDOG
+
+
+def watch_section(site: str, deadline_s: Optional[float] = None,
+                  guard: Optional["HealthGuard"] = None, **ctx: Any):
+    """Arm the process watchdog around a with-block (module-level
+    convenience used by kvstore_async and serving)."""
+    return _WATCHDOG.watch(site, deadline_s=deadline_s, guard=guard,
+                           **ctx)
+
+
+# ---------------------------------------------------------------------------
+# the guard
+# ---------------------------------------------------------------------------
+
+class HealthGuard:
+    """Numerics sentry + recovery policy + watchdog handle for one
+    training run.
+
+    ::
+
+        guard = HealthGuard(policy="skip")
+        trainer.fit(batch_fn, steps, checkpoint_manager=mgr,
+                    health_guard=guard)             # SPMDTrainer
+        estimator.fit(data, batches=N, health_guard=guard)
+        guard.install(gluon_trainer)                # hand-written loop
+
+    Counters (``skips``, ``rewinds``, ``events``) are readable for
+    assertions; the same seeded fault plan replays the identical
+    decision sequence.
+    """
+
+    def __init__(self, policy: Optional[str] = None,
+                 loss_spike: Optional[float] = None,
+                 loss_window: Optional[int] = None,
+                 max_skips: Optional[int] = None,
+                 max_rewinds: Optional[int] = None,
+                 step_deadline_s: Optional[float] = None) -> None:
+        self.policy = (policy if policy is not None
+                       else str(getenv("MXNET_HEALTH_POLICY", "skip")))
+        if self.policy not in _POLICIES:
+            raise MXNetError(
+                f"unknown health policy {self.policy!r}; known: "
+                f"{_POLICIES}")
+        self.loss_spike = (float(loss_spike) if loss_spike is not None
+                           else float(getenv("MXNET_HEALTH_LOSS_SPIKE",
+                                             0.0)))
+        self.loss_window = int(loss_window if loss_window is not None
+                               else getenv("MXNET_HEALTH_LOSS_WINDOW", 20))
+        self.max_skips = int(max_skips if max_skips is not None
+                             else getenv("MXNET_HEALTH_MAX_SKIPS", 10))
+        self.max_rewinds = int(max_rewinds if max_rewinds is not None
+                               else getenv("MXNET_HEALTH_MAX_REWINDS", 2))
+        self.step_deadline_s = (
+            float(step_deadline_s) if step_deadline_s is not None
+            else float(getenv("MXNET_HEALTH_STEP_DEADLINE_S", 0.0)))
+        self.skips = 0
+        self.rewinds = 0
+        self.hangs = 0
+        self.replay_salt = 0
+        self.loss_ema: Optional[float] = None
+        self._steps_seen = 0
+        self._rewind_cb: Optional[Callable[[], Any]] = None
+        self._pending_loss: Any = None
+        self.last_verdict: Optional[HealthVerdict] = None
+        self.last_hang_dump: Optional[str] = None
+
+    # -- wiring --------------------------------------------------------------
+    def set_rewind(self, cb: Optional[Callable[[], Any]]) -> None:
+        """Attach the rewind action (normally
+        ``lambda: manager.restore(trainer)``); without one, policy
+        'rewind' degrades to 'skip'."""
+        self._rewind_cb = cb
+
+    def watch(self, site: str, **ctx: Any):
+        """Arm the process watchdog for one guarded section with this
+        guard's step deadline (and escalation policy).  The guard's
+        resolved deadline is passed verbatim: an explicit
+        ``step_deadline_s=0`` disarms even when the environment sets
+        one (constructor arguments always beat the env)."""
+        return _WATCHDOG.watch(site, deadline_s=self.step_deadline_s,
+                               guard=self, **ctx)
+
+    def install(self, trainer: Any) -> "HealthGuard":
+        """Hook a gluon ``Trainer``: every ``step()`` runs the fused
+        gradient sentry BEFORE the gradient reduction and optimizer
+        update (after the same bulking donation barrier the update
+        already takes), and a bad step is dropped per policy with AMP
+        loss-scale decay.  Hooking ``_step_impl`` (not ``_update``)
+        matters twice over: with ``update_on_kvstore`` the update runs
+        server-side and ``_update`` never executes, and on the local
+        path a NaN must be caught before ``allreduce_grads`` spreads it
+        through the collective."""
+        if getattr(trainer, "_health_guard", None) is self:
+            return self
+        orig_step = trainer._step_impl
+
+        def _step_impl(batch_size: int,
+                       ignore_stale_grad: bool = False) -> None:
+            # ONE fused check covers the announced loss (note_loss)
+            # AND every gradient — a single reduction, a single
+            # readback per step
+            loss, self._pending_loss = self._pending_loss, None
+            verdict = self.check(loss=loss, grads_of=trainer)
+            if verdict.ok:
+                orig_step(batch_size, ignore_stale_grad)
+                return
+            if verdict.action == "rewind":
+                self.do_rewind()
+            self.apply_skip(trainer)
+
+        trainer._step_impl = _step_impl
+        trainer._health_guard = self
+        return self
+
+    def note_loss(self, loss: Any) -> None:
+        """Announce the step's loss so the next installed-trainer check
+        folds it into the same fused reduction as the gradients
+        (``Estimator.fit`` calls this instead of running a separate
+        loss-only check — one readback per step, not two)."""
+        self._pending_loss = loss
+
+    # -- the sentry ----------------------------------------------------------
+    def check(self, loss: Any = None, grads: Optional[Sequence[Any]] = None,
+              names: Optional[Sequence[str]] = None,
+              grads_of: Any = None) -> HealthVerdict:
+        """Run the fused numerics check over ``loss`` and the gradients
+        and decide.  ``grads_of`` extracts fresh gradients (+ names)
+        from a gluon Trainer.  Raises :class:`HealthError` on policy
+        'abort' or an exhausted budget."""
+        import numpy as onp
+        import jax.numpy as jnp
+        t0 = time.perf_counter()
+        if grads_of is not None:
+            grads, names = [], []
+            for p in grads_of._params:
+                if p.grad_req == "null" or not p.is_initialized:
+                    continue
+                w = p.data()
+                if w.grad is not None and w._fresh_grad:
+                    grads.append(w.grad)
+                    names.append(p.name)
+        grads = list(grads or ())
+        # the bulking donation barrier the optimizer update takes anyway
+        # — flushing HERE (instead of letting the grad reads flush as
+        # host reads) keeps the total segment count identical with and
+        # without the guard
+        from . import bulk as _bulk
+        _bulk.flush_all("mutation")
+        from .ndarray.ndarray import NDArray
+        arrs = [g._data if isinstance(g, NDArray) else g for g in grads]
+        has_loss = loss is not None
+        loss_a = (loss._data if isinstance(loss, NDArray)
+                  else jnp.zeros((), jnp.float32) if loss is None
+                  else jnp.asarray(loss))
+        vec = onp.asarray(fused_finite_check(loss_a, arrs))
+        HEALTH_CHECK_SECONDS.observe(time.perf_counter() - t0)
+        return self._decide(bad=bool(vec[0] > 0), first=int(vec[1]),
+                            loss_value=float(vec[2]), names=names,
+                            has_loss=has_loss)
+
+    def check_device(self, health_vec: Any,
+                     names: Optional[Sequence[str]] = None
+                     ) -> HealthVerdict:
+        """Decide from a device-resident [any_bad, first_index, loss]
+        vector (``SPMDTrainer``'s in-program sentry output — this
+        fetch is the step's single scalar readback).
+
+        On this path the in-program gate covers FINITENESS only, so a
+        finite loss spike's update has already landed by the time the
+        verdict is read — a spike cannot be "skipped" retroactively
+        (``spike_droppable=False``): under policy='skip' it is
+        recorded as an advisory event (action='note'); use 'rewind' or
+        'abort' to enforce divergence recovery on the SPMD path."""
+        import numpy as onp
+        t0 = time.perf_counter()
+        vec = onp.asarray(health_vec)
+        HEALTH_CHECK_SECONDS.observe(time.perf_counter() - t0)
+        return self._decide(bad=bool(vec[0] > 0), first=int(vec[1]),
+                            loss_value=float(vec[2]), names=names,
+                            has_loss=True, spike_droppable=False)
+
+    # -- decisions -----------------------------------------------------------
+    def _decide(self, bad: bool, first: int, loss_value: float,
+                names: Optional[Sequence[str]], has_loss: bool,
+                spike_droppable: bool = True) -> HealthVerdict:
+        if bad:
+            if has_loss and first == 0:
+                culprit = "loss"
+            else:
+                gi = first - 1      # vector index 0 is always the loss
+                culprit = (names[gi] if names and 0 <= gi < len(names)
+                           else f"gradient[{gi}]")
+            return self._recover("nonfinite", culprit, loss_value)
+        if has_loss:
+            spiked = (self.loss_spike > 0
+                      and self.loss_ema is not None
+                      and self._steps_seen >= self.loss_window
+                      and loss_value > self.loss_spike * abs(self.loss_ema))
+            if spiked:
+                if not spike_droppable and self.policy == "skip":
+                    # the update already landed (SPMD deferred path):
+                    # claiming a "skip" would lie — record the event as
+                    # advisory and keep the spiked value out of the EMA
+                    HEALTH_EVENTS.labels(kind="loss_spike").inc()
+                    v = HealthVerdict(False, action="note",
+                                      kind="loss_spike", culprit="loss",
+                                      loss=loss_value)
+                    self.last_verdict = v
+                    return v
+                return self._recover("loss_spike", "loss", loss_value)
+            # only accepted values feed the EMA: a diverging tail must
+            # not drag the baseline up after itself
+            alpha = 2.0 / (self.loss_window + 1.0)
+            self.loss_ema = (loss_value if self.loss_ema is None
+                             else (1 - alpha) * self.loss_ema
+                             + alpha * loss_value)
+            self._steps_seen += 1
+            HEALTH_LOSS_EMA.set(self.loss_ema)
+        v = HealthVerdict(True, loss=loss_value)
+        self.last_verdict = v
+        return v
+
+    def _recover(self, kind: str, culprit: str,
+                 loss_value: float) -> HealthVerdict:
+        HEALTH_EVENTS.labels(kind=kind).inc()
+        detail = (f"non-finite values first appeared in {culprit!r}"
+                  if kind == "nonfinite" else
+                  f"loss {loss_value:g} spiked past "
+                  f"{self.loss_spike:g}x the EMA {self.loss_ema:g}")
+        if self.policy == "abort":
+            raise HealthError(
+                f"training health abort ({kind}): {detail} "
+                "[MXNET_HEALTH_POLICY=abort]")
+        action = self.policy
+        if action == "rewind" and self._rewind_cb is None:
+            action = "skip"          # nothing to rewind to — degrade
+        if action == "rewind":
+            if self.rewinds >= self.max_rewinds:
+                raise HealthError(
+                    f"training health abort: {detail}, and the rewind "
+                    f"budget ({self.max_rewinds}, "
+                    "MXNET_HEALTH_MAX_REWINDS) is exhausted")
+            # budget charged at decide time (deterministic replay); the
+            # metric counts in do_rewind, which refunds the charge when
+            # there was nothing to restore to
+            self.rewinds += 1
+        else:
+            if self.skips >= self.max_skips:
+                raise HealthError(
+                    f"training health abort: {detail}, and the skip "
+                    f"budget ({self.max_skips}, MXNET_HEALTH_MAX_SKIPS) "
+                    "is exhausted")
+            self.skips += 1
+            HEALTH_SKIPS.inc()
+        v = HealthVerdict(False, action=action, kind=kind,
+                          culprit=culprit, loss=loss_value)
+        self.last_verdict = v
+        return v
+
+    # -- recovery actions ----------------------------------------------------
+    def apply_skip(self, trainer: Any) -> None:
+        """Zero the pending update on a gluon trainer: mark every fresh
+        gradient consumed and decay an attached AMP loss scale."""
+        for p in getattr(trainer, "_params", ()):
+            if p.is_initialized and p.data().grad is not None:
+                p.data()._fresh_grad = False
+        scaler = getattr(trainer, "_amp_scaler", None)
+        if scaler is not None:
+            scaler.decay()
+
+    def do_rewind(self) -> Any:
+        """Run the attached rewind action and perturb the replay salt
+        (``batch_fn(step, salt=...)`` consumers re-order their data).
+        Returns what the rewind action returned — ``None`` means the
+        checkpoint directory was empty (``restore``'s fresh-start
+        contract): nothing was restored, so the rewind charge is
+        refunded and a SKIP is accounted instead (a bad run before its
+        first checkpoint must not burn the rewind budget on no-ops)."""
+        if self._rewind_cb is None:
+            raise MXNetError("no rewind action attached "
+                             "(HealthGuard.set_rewind)")
+        result = self._rewind_cb()
+        if result is None:
+            self.rewinds = max(0, self.rewinds - 1)
+            if self.skips >= self.max_skips:
+                raise HealthError(
+                    "training health abort: rewind found no checkpoint "
+                    f"to restore and the skip budget ({self.max_skips},"
+                    " MXNET_HEALTH_MAX_SKIPS) is exhausted")
+            self.skips += 1
+            HEALTH_SKIPS.inc()
+            return None
+        self.replay_salt += 1
+        # the rewound stretch replays: its EMA state belongs to the
+        # abandoned trajectory
+        self.loss_ema = None
+        self._steps_seen = 0
+        HEALTH_REWINDS.inc()
+        return result
+
+    def note_hang(self, site: str, dump_path: Optional[str]) -> None:
+        """Watchdog escalation hook: the guarded section finished after
+        its deadline.  policy='abort' raises; other policies keep the
+        event (already counted) as diagnostics."""
+        self.hangs += 1
+        self.last_hang_dump = dump_path
+        if self.policy == "abort":
+            raise HealthError(
+                f"training health abort (hang): section {site!r} "
+                f"exceeded its {self.step_deadline_s:g}s deadline "
+                f"(MXNET_HEALTH_STEP_DEADLINE_S); stack dump: "
+                f"{dump_path or '(dump failed)'}")

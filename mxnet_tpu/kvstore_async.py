@@ -64,6 +64,16 @@ register_env(
     "forever. Generous by default; 0 restores the old infinite wait. "
     "Barrier RPCs automatically widen to MXNET_PS_BARRIER_TIMEOUT.")
 
+register_env(
+    "MXNET_PS_PORT_FILE", "",
+    "Path prefix for dist_async parameter-server port publication: "
+    "server ID s binds its requested port (or an OS-assigned one when "
+    "DMLC_PS_ROOT_PORT=0) and atomically writes the chosen port to "
+    "'<prefix>.<s>'; workers resolve each server's port from that file "
+    "instead of DMLC_PS_ROOT_PORT+s. Eliminates launcher port-range "
+    "races (tools/launch.py sets it automatically for local jobs). "
+    "Empty (default) keeps the fixed base-port+offset contract.")
+
 PS_RECV_TIMEOUTS = _metrics.counter(
     "mxnet_ps_recv_timeouts_total",
     "dist_async worker RPCs that timed out waiting for a parameter-"
@@ -529,12 +539,43 @@ def _bind_host() -> str:
     return "127.0.0.1" if root in ("127.0.0.1", "localhost") else "0.0.0.0"
 
 
+def _publish_port(port: int) -> None:
+    """Write this server's chosen port to '<MXNET_PS_PORT_FILE>.<sid>'
+    (atomic tmp+rename, fsynced) so workers can resolve it without a
+    pre-agreed port — the fix for bind-probe races in the launcher."""
+    prefix = os.environ.get("MXNET_PS_PORT_FILE", "")
+    if not prefix:
+        return
+    sid = os.environ.get("DMLC_SERVER_ID", "0")
+    path = f"{prefix}.{sid}"
+    tmp = f"{path}.tmp.{os.getpid()}"
+    with open(tmp, "w") as f:
+        f.write(str(port))
+        f.flush()
+        os.fsync(f.fileno())
+    os.replace(tmp, path)
+
+
 def run_server(port: int, num_workers: int,
                ready_event: Optional[threading.Event] = None) -> None:
-    """Serve until a STOP frame arrives (blocking)."""
+    """Serve until a STOP frame arrives (blocking).
+
+    ``port=0`` binds an OS-assigned free port (never collides); the
+    chosen port is published via ``MXNET_PS_PORT_FILE`` when set.  A
+    fixed port retries briefly on ``EADDRINUSE`` (a just-killed
+    predecessor's socket lingering in TIME_WAIT)."""
     ps = PSServer(num_workers)
-    with _TCPServer((_bind_host(), port), _Handler) as server:
+    host = _bind_host()
+    if port:
+        server = retry_call(
+            lambda: _TCPServer((host, port), _Handler),
+            site="kvstore.bind", retryable=(OSError,),
+            attempts=8, base_ms=100, max_ms=1000)
+    else:
+        server = _TCPServer((host, 0), _Handler)
+    with server:
         server.ps = ps                           # type: ignore[attr-defined]
+        _publish_port(server.server_address[1])
         if ready_event is not None:
             ready_event.set()
         server.serve_forever(poll_interval=0.1)
@@ -584,6 +625,33 @@ class KVStoreDistAsync:
     def _recv_timeout() -> float:
         return float(getenv("MXNET_PS_RECV_TIMEOUT", 300))
 
+    def _server_port(self, sidx: int, wait: bool = False) -> int:
+        """The port server ``sidx`` listens on: the published port file
+        entry when ``MXNET_PS_PORT_FILE`` is set (``wait=True`` rides
+        out a slow server start), else ``DMLC_PS_ROOT_PORT + sidx``.
+        Deliberately NOT cached: a restarted server republishes a NEW
+        OS-assigned port, and a reconnect must pick it up — resolution
+        only happens at (re)connect time, never per RPC."""
+        prefix = os.environ.get("MXNET_PS_PORT_FILE", "")
+        if not prefix:
+            return self.port + sidx
+        path = f"{prefix}.{sidx}"
+        deadline = time.monotonic() + (float(
+            os.environ.get("MXNET_PS_CONNECT_TIMEOUT", "120"))
+            if wait else 0.0)
+        while True:
+            try:
+                with open(path) as f:
+                    return int(f.read().strip())
+            except (OSError, ValueError):
+                if time.monotonic() >= deadline:
+                    raise MXNetError(
+                        f"rank {self._rank}: parameter server "
+                        f"{sidx} never published its port to "
+                        f"{path} (MXNET_PS_PORT_FILE) — is the "
+                        "server process up?") from None
+                time.sleep(0.05)
+
     def _drop_sock(self, sidx: int) -> None:
         if self._socks[sidx] is not None:
             try:
@@ -601,17 +669,24 @@ class KVStoreDistAsync:
             # not hammer a restarting server in lockstep
             connect_s = float(
                 os.environ.get("MXNET_PS_CONNECT_TIMEOUT", "120"))
+            port = self._server_port(sidx, wait=True)
+
+            def _connect():
+                # re-resolve INSIDE the retry: a restarting server may
+                # republish a new port between attempts
+                return socket.create_connection(
+                    (self.uri, self._server_port(sidx)), timeout=30)
+
             try:
                 s = retry_call(
-                    lambda: socket.create_connection(
-                        (self.uri, self.port + sidx), timeout=30),
+                    _connect,
                     site="kvstore.connect", retryable=(OSError,),
                     attempts=1_000_000, base_ms=100, max_ms=2000,
                     deadline_s=connect_s)
             except OSError as e:                 # budget spent
                 raise MXNetError(
                     f"rank {self._rank}: cannot reach parameter server "
-                    f"at {self.uri}:{self.port + sidx} after "
+                    f"at {self.uri}:{port} after "
                     f"{connect_s:.0f}s (MXNET_PS_CONNECT_TIMEOUT): {e}")
             # bounded per-reply wait (MXNET_PS_RECV_TIMEOUT): a silently
             # dead server surfaces as a structured timeout error instead
@@ -728,8 +803,8 @@ class KVStoreDistAsync:
                     raise MXNetError(
                         f"rank {self._rank}/{self._num_workers}: "
                         f"parameter-server RPC {cmd_name!r} to "
-                        f"{self.uri}:{self.port + sidx} timed out after "
-                        f"{self._recv_timeout():.0f}s "
+                        f"{self.uri}:{self._server_port(sidx)} timed "
+                        f"out after {self._recv_timeout():.0f}s "
                         "(MXNET_PS_RECV_TIMEOUT) — the server is dead "
                         "or wedged; restart it (workers reconnect with "
                         "backoff) or raise the timeout") from e
@@ -799,6 +874,11 @@ class KVStoreDistAsync:
                 self._rpc_server(sidx, b"I", hdr, raw)
 
     def push(self, key, value, priority: int = 0) -> None:
+        from . import health as _health
+        with _health.watch_section("kvstore.push", rank=self._rank):
+            self._push_impl(key, value)
+
+    def _push_impl(self, key, value) -> None:
         keys, vals = self._pair(key, value)
         entries = []                     # (wire_key, server, flat array)
         for k, v in zip(keys, vals):
@@ -842,6 +922,11 @@ class KVStoreDistAsync:
 
     def pull(self, key, out=None, priority: int = 0,
              ignore_sparse: bool = True):
+        from . import health as _health
+        with _health.watch_section("kvstore.pull", rank=self._rank):
+            return self._pull_impl(key, out)
+
+    def _pull_impl(self, key, out=None):
         from .ndarray.ops import array
         keys, outs = self._pair(key, out)
         # resolve each logical key's wire layout: sliced keys expand to
@@ -1019,9 +1104,14 @@ class KVStoreDistAsync:
 
     def barrier(self) -> None:
         # the rank rides the frame so a barrier timeout can NAME the
-        # missing workers in the server's error
-        for sidx in range(self.num_servers):
-            self._rpc_server(sidx, b"B", {"rank": self._rank})
+        # missing workers in the server's error; the health watchdog
+        # (when armed via MXNET_HEALTH_STEP_DEADLINE_S) dumps all-thread
+        # stacks if the barrier outlives the deadline — the "which rank
+        # is holding the job up" diagnostic for a wedged fleet
+        from . import health as _health
+        with _health.watch_section("kvstore.barrier", rank=self._rank):
+            for sidx in range(self.num_servers):
+                self._rpc_server(sidx, b"B", {"rank": self._rank})
 
     def server_stats(self) -> List[Dict[str, Any]]:
         return [self._rpc_server(sidx, b"Q", {})[1]
@@ -1059,7 +1149,10 @@ KVStoreDistAsync.pushpull = _KVStoreBase.pushpull    # type: ignore
 def main() -> None:
     """Server-process entry (``DMLC_ROLE=server``):
     ``python -m mxnet_tpu.kvstore_async``."""
-    port = int(os.environ.get("DMLC_PS_ROOT_PORT", "9876")) + \
+    root = int(os.environ.get("DMLC_PS_ROOT_PORT", "9876"))
+    # root port 0 = OS-assigned per server (published via
+    # MXNET_PS_PORT_FILE); a fixed root keeps the +server_id contract
+    port = 0 if root == 0 else root + \
         int(os.environ.get("DMLC_SERVER_ID", "0"))
     nw = int(os.environ.get("DMLC_NUM_WORKER", "1"))
     run_server(port, nw)

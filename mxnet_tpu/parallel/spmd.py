@@ -227,6 +227,13 @@ class SPMDTrainer:
         self._multi_fn = None
         self._step_count = 0
         self._donate = donate
+        # health-sentry gate: when on, the compiled step computes a
+        # fused finite-check over loss+grads, gates the whole update on
+        # it (a bad step leaves params/state untouched ON DEVICE), and
+        # returns a [any_bad, first_bad_index, loss] vector — the
+        # guard's single per-step readback (mxnet_tpu.health)
+        self._health_gate = False
+        self._last_health = None
         # device-resident step counter + value-keyed scalar cache: a host
         # scalar whose VALUE changes every call (e.g. jnp.float32(t))
         # misses jax's constant cache and, on the axon remote backend,
@@ -268,13 +275,29 @@ class SPMDTrainer:
             self._t_dev = _INCR_FN(self._t_dev)
         return self._t_dev
 
+    def set_health_gate(self, on: bool) -> None:
+        """Toggle the in-program health sentry (``fit(health_guard=)``
+        flips it).  Changing the flag changes the traced program, so the
+        compiled step is invalidated."""
+        on = bool(on)
+        if self._health_gate == on:
+            return
+        self._health_gate = on
+        self._last_health = None
+        self._step_fn = None
+        self._multi_fn = None
+        if hasattr(self, "_raw_step_fn"):
+            del self._raw_step_fn
+
     # ------------------------------------------------------------------
     def _build_step(self, n_inputs: int) -> Callable:
         donate = (0, 1) if self._donate else ()
-        return jax.jit(self._build_step_body(n_inputs),
-                       donate_argnums=donate)
+        return jax.jit(self._build_step_body(
+            n_inputs, health_gate=self._health_gate),
+            donate_argnums=donate)
 
-    def _build_step_body(self, n_inputs: int) -> Callable:
+    def _build_step_body(self, n_inputs: int,
+                         health_gate: bool = False) -> Callable:
         block, loss_fn = self.block, self.loss_fn
         mesh = self.mesh
         params = self._params
@@ -344,23 +367,61 @@ class SPMDTrainer:
                         "forward; only non-differentiable state may be "
                         "mutated in-trace — its optimizer update would "
                         "be silently discarded")
-            new_params, new_states = [], []
-            for i, (w, g, st) in enumerate(zip(param_arrays, grads,
-                                               opt_states)):
-                if i in mut:
-                    # forward-mutated state advances by its traced update;
-                    # it must NOT get an optimizer step (wd would decay
-                    # BN running stats — zero grad does not mean no-op)
-                    new_params.append(mut[i])
-                    new_states.append(st)
-                elif params[i].grad_req == "null":
-                    new_params.append(w)
-                    new_states.append(st)
-                else:
-                    nw, ns = opt_cls._step(w, g, st, lr, wd, t, hp[i])
-                    new_params.append(nw)
-                    new_states.append(ns)
-            return new_params, new_states, loss
+            ok = None
+            health = None
+            if health_gate:
+                # fused finite/overflow reduction over the loss and
+                # every live gradient — ONE traced reduction, no
+                # per-tensor host syncs; index 0 is the loss, i+1 is
+                # parameter i (for the guard's culprit naming)
+                flags = [jnp.logical_not(jnp.all(jnp.isfinite(loss)))]
+                for i, g in enumerate(grads):
+                    if params[i].grad_req != "null" and i not in mut:
+                        flags.append(jnp.logical_not(
+                            jnp.all(jnp.isfinite(g))))
+                    else:
+                        flags.append(jnp.zeros((), jnp.bool_))
+                badv = jnp.stack(flags)
+                any_bad = badv.any()
+                ok = jnp.logical_not(any_bad)
+                health = jnp.stack([any_bad.astype(jnp.float32),
+                                    jnp.argmax(badv).astype(jnp.float32),
+                                    loss.astype(jnp.float32)])
+            def apply_updates(args):
+                pa, sts, gs, mt = args
+                new_params, new_states = [], []
+                for i, (w, g, st) in enumerate(zip(pa, gs, sts)):
+                    if i in mt:
+                        # forward-mutated state advances by its traced
+                        # update; it must NOT get an optimizer step (wd
+                        # would decay BN running stats — zero grad does
+                        # not mean no-op)
+                        new_params.append(mt[i])
+                        new_states.append(st)
+                    elif params[i].grad_req == "null":
+                        new_params.append(w)
+                        new_states.append(st)
+                    else:
+                        nw, ns = opt_cls._step(w, g, st, lr, wd, t,
+                                               hp[i])
+                        new_params.append(nw)
+                        new_states.append(ns)
+                return new_params, new_states
+
+            operands = (list(param_arrays), list(opt_states),
+                        list(grads), mut)
+            if ok is None:
+                new_params, new_states = apply_updates(operands)
+                return new_params, new_states, loss
+            # gate the whole update on the sentry verdict with ONE
+            # lax.cond: a bad step takes the identity branch (params,
+            # optimizer state, and BN running stats all untouched —
+            # buffer-forwarded, no per-tensor where doubling the
+            # update's memory traffic on the common clean path)
+            new_params, new_states = jax.lax.cond(
+                ok, apply_updates,
+                lambda args: (list(args[0]), list(args[1])), operands)
+            return new_params, new_states, loss, health
 
         return step
 
@@ -577,6 +638,16 @@ class SPMDTrainer:
         arrays = [self._place(x, self._data_spec) for x in inputs]
         label_arr = self._place(labels, self._label_spec)
         t_data = time.perf_counter() - t0
+        from .. import faults as _faults
+        if _faults._ARMED:
+            # tensor-corrupting chaos site: kind=nan poisons the first
+            # float tensor among data + labels, making the compiled
+            # step's gradients non-finite — the deterministic trigger
+            # the health sentry trains against
+            corr = _faults.maybe_corrupt(
+                "trainer.step", list(arrays) + [label_arr],
+                step=self._step_count)
+            arrays, label_arr = corr[:-1], corr[-1]
         self._check_graph_epoch()
         if self._step_fn is None:
             self._step_fn = self._build_step(len(arrays))
@@ -590,11 +661,15 @@ class SPMDTrainer:
         # bulked segment still holding one must materialize first
         from .. import bulk as _bulk
         _bulk.flush_all("mutation")
-        new_params, new_states, loss = self._step_fn(
+        out = self._step_fn(
             param_arrays, self._opt_states, rng,
             self._committed_scalar(lr), self._committed_scalar(wd),
             self._advance_t(),
             *arrays, label_arr)
+        if self._health_gate:
+            new_params, new_states, loss, self._last_health = out
+        else:
+            new_params, new_states, loss = out
         from .. import engine as _engine
         _engine.mark_clean(new_params)
         for p, a in zip(self._params, new_params):
@@ -616,7 +691,8 @@ class SPMDTrainer:
     # -- preemption-safe training loop ---------------------------------
     def fit(self, batch_fn: Any, num_steps: int,
             checkpoint_manager: Any = None,
-            checkpoint_every: int = 10) -> Optional[NDArray]:
+            checkpoint_every: int = 10,
+            health_guard: Any = None) -> Optional[NDArray]:
         """Run up to ``num_steps`` steps with auto-resume and graceful
         preemption — the kill-and-restart-safe loop.
 
@@ -635,16 +711,38 @@ class SPMDTrainer:
         (:class:`~mxnet_tpu.preemption.PreemptionGuard`); the next
         incarnation resumes from it.
 
+        With ``health_guard`` (:class:`mxnet_tpu.health.HealthGuard`):
+        the compiled step gains an in-program numerics sentry that
+        gates the whole update on-device (a NaN/Inf step never touches
+        parameters or optimizer state), the guard reads one small
+        health vector per step and applies its skip/rewind/abort
+        policy, and the hang watchdog arms around every step.  Rewind
+        needs BOTH a ``checkpoint_manager`` and a callable ``batch_fn``
+        (an iterable cannot replay); ``batch_fn(step, salt=...)`` is
+        used when the callable accepts a ``salt`` keyword, so replays
+        after a rewind perturb the data order.
+
         Returns the loss of the last executed step (``None`` if there
         was nothing left to run).  Only that one loss is fetched — the
-        loop itself never syncs on the device.
+        loop itself never syncs on the device (a ``health_guard`` adds
+        its single per-step readback).
         """
         from ..preemption import PreemptionGuard
         if checkpoint_manager is not None:
             checkpoint_manager.restore(self)
         start = self._step_count
         if callable(batch_fn):
-            get_batch = batch_fn
+            import inspect
+            try:
+                takes_salt = "salt" in inspect.signature(
+                    batch_fn).parameters
+            except (TypeError, ValueError):
+                takes_salt = False
+            if takes_salt and health_guard is not None:
+                def get_batch(step):
+                    return batch_fn(step, salt=health_guard.replay_salt)
+            else:
+                get_batch = batch_fn
         else:
             it = iter(batch_fn)
 
@@ -660,20 +758,110 @@ class SPMDTrainer:
 
             for s in range(start):      # skip batches already trained on
                 get_batch(s)
+        import contextlib
+        if health_guard is not None:
+            self.set_health_gate(True)
+            if checkpoint_manager is not None and callable(batch_fn):
+                health_guard.set_rewind(
+                    lambda: checkpoint_manager.restore(self))
         loss: Optional[NDArray] = None
-        with PreemptionGuard() as guard:
-            for step in range(start, num_steps):
-                data, labels = get_batch(step)
-                loss = self.step(data, labels)
-                done = self._step_count
-                preempted = guard.requested
-                if checkpoint_manager is not None and (
-                        preempted or done == num_steps
-                        or (checkpoint_every > 0
-                            and done % checkpoint_every == 0)):
-                    checkpoint_manager.save(self, step=done)
-                if preempted:
-                    break
+        try:
+            with PreemptionGuard() as guard:
+                # the sentry verdict for step N is read while step N+1
+                # is already in flight (`prev` holds the un-verified
+                # step's health vector + loss): the readback then
+                # overlaps device compute instead of stalling the
+                # pipeline every step.  Verifying one step late is
+                # sound BECAUSE the update is gated on-device — a bad
+                # step never touched parameters, so any checkpoint
+                # written in the detection gap is still clean.
+                prev = None
+                while True:
+                    cur = None
+                    ran = self._step_count < num_steps
+                    if ran:
+                        step = self._step_count
+                        data, labels = get_batch(step)
+                        with (health_guard.watch("trainer.step",
+                                                 step=step)
+                              if health_guard is not None
+                              else contextlib.nullcontext()):
+                            step_loss = self.step(data, labels)
+                        if health_guard is None:
+                            loss = step_loss
+                        else:
+                            cur = (self._last_health, step_loss)
+                            try:     # start the readback without blocking
+                                cur[0].copy_to_host_async()
+                            except Exception:   # noqa: BLE001 - backend-
+                                pass            # dependent surface
+                    if health_guard is not None and prev is not None:
+                        verdict = health_guard.check_device(
+                            prev[0], names=self._names)
+                        if verdict.action == "rewind":
+                            if health_guard.do_rewind() is not None:
+                                # restored the newest verified
+                                # checkpoint (replay gets a perturbed
+                                # salt); the in-flight step built on
+                                # abandoned state — discard it, restore
+                                # overwrites everything
+                                prev = None
+                                continue
+                            # nothing to restore to (no checkpoint yet;
+                            # accounted as a skip): the gated bad step
+                            # never landed, the in-flight step is still
+                            # valid — keep pipelining
+                        elif verdict.ok:
+                            # a skipped step's loss is the garbage that
+                            # triggered the skip — the returned "last
+                            # loss" tracks accepted steps only
+                            loss = prev[1]
+                    prev = cur
+                    done = self._step_count
+                    preempted = guard.requested
+                    need_ckpt = ran and checkpoint_manager is not None \
+                        and (preempted or done == num_steps
+                             or (checkpoint_every > 0
+                                 and done % checkpoint_every == 0))
+                    if need_ckpt and health_guard is not None \
+                            and prev is not None:
+                        # a checkpoint must never capture an UNVERIFIED
+                        # step: it would become the newest "verified"
+                        # rewind target, and a rewind to it would
+                        # silently never replay the bad step.  Drain
+                        # this step's verdict synchronously (only
+                        # checkpoint-boundary steps pay the stall).
+                        hv, pl = prev
+                        prev = None
+                        verdict = health_guard.check_device(
+                            hv, names=self._names)
+                        if verdict.action == "rewind":
+                            if health_guard.do_rewind() is not None:
+                                continue      # restored: skip the save
+                            # no-op rewind (no checkpoint yet, counted
+                            # as a skip): state is clean — save anyway
+                        elif verdict.ok:
+                            loss = pl
+                    if need_ckpt:
+                        checkpoint_manager.save(self, step=done)
+                    if preempted:
+                        # drain the pending verdict so accounting and
+                        # the returned loss cover the final step.  Only
+                        # the manager-less path can still hold one here,
+                        # and without a rewind action the policy already
+                        # degrades to skip — no rewind can be decided
+                        # during shutdown.
+                        if health_guard is not None and prev is not None:
+                            verdict = health_guard.check_device(
+                                prev[0], names=self._names)
+                            if verdict.ok:
+                                loss = prev[1]
+                        break
+                    if self._step_count >= num_steps and prev is None:
+                        break
+        finally:
+            if health_guard is not None:
+                self.set_health_gate(False)
         return loss
 
     # -- checkpoint / resume (reference SURVEY.md 5.4: .params format +

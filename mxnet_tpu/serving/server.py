@@ -227,7 +227,14 @@ class ModelServer:
             _faults.maybe_fault("serving.execute", batch=len(batch))
             arrays, _nb = self.policy.assemble(
                 [r.sample for r in batch], batch[0].key)
-            outs = self.model.predict(arrays)
+            # per-batch execute deadline: the training hang watchdog
+            # reused for serving (MXNET_HEALTH_STEP_DEADLINE_S) — a
+            # wedged model execute dumps all-thread stacks instead of
+            # silently eating the queue's deadline budget
+            from .. import health as _health
+            with _health.watch_section("serving.execute",
+                                       batch=len(batch)):
+                outs = self.model.predict(arrays)
         except Exception as e:   # noqa: BLE001 - worker must survive
             for r in batch:
                 if not r.future.done():

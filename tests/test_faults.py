@@ -103,7 +103,53 @@ def test_smoke_plan_parse_and_env(monkeypatch):
     # every known site is a real registered name
     assert set(faults.known_sites()) == {
         "checkpoint.write", "kvstore.send", "kvstore.recv",
-        "dataloader.worker", "serving.execute", "dispatch.op"}
+        "dataloader.worker", "serving.execute", "dispatch.op",
+        "trainer.step"}
+
+
+def test_smoke_nan_kind_corrupts_tensor_sites_only():
+    import jax.numpy as jnp
+    # maybe_corrupt: a firing nan clause poisons the FIRST array
+    with faults.fault_plan("trainer.step:kind=nan:times=1"):
+        a = jnp.ones((2, 3))
+        b = jnp.ones((4,))
+        out = faults.maybe_corrupt("trainer.step", [a, b])
+        assert bool(jnp.isnan(out[0]).any())
+        assert not bool(jnp.isnan(out[1]).any())
+        # times=1: the second hit passes clean
+        out2 = faults.maybe_corrupt("trainer.step", [a])
+        assert not bool(jnp.isnan(out2[0]).any())
+    assert metrics.value("mxnet_faults_injected_total",
+                         site="trainer.step", kind="nan") >= 1
+    # numpy arrays corrupt too (the gluon grad path); the first FLOAT
+    # tensor is the target — int token ids are skipped over
+    with faults.fault_plan("trainer.step:kind=nan:times=2"):
+        f = faults.maybe_corrupt("trainer.step",
+                                 [onp.ones(3, "f4")])[0]
+        assert onp.isnan(f[0])
+        ints, flt = faults.maybe_corrupt(
+            "trainer.step", [onp.ones(3, "i4"), onp.ones(3, "f4")])
+        assert (ints == 1).all() and onp.isnan(flt[0])
+    # bfloat16 (the standard TPU training dtype) IS a float target —
+    # numpy refuses to classify ml_dtypes floats, jnp.issubdtype knows
+    with faults.fault_plan("trainer.step:kind=nan:times=1"):
+        bf = faults.maybe_corrupt(
+            "trainer.step", [jnp.ones(3, jnp.bfloat16)])[0]
+        assert bool(jnp.isnan(bf).any())
+    # a firing nan clause with NOTHING float to corrupt fails loudly
+    # (a silent no-injection would make the plan's metrics lie)
+    with faults.fault_plan("trainer.step:kind=nan:times=1"):
+        with pytest.raises(MXNetError, match="float dtype"):
+            faults.maybe_corrupt("trainer.step", [onp.ones(3, "i4")])
+    # a tensor-less site rejects kind=nan loudly instead of silently
+    # injecting nothing
+    with faults.fault_plan("dispatch.op:kind=nan:times=1"):
+        with pytest.raises(MXNetError, match="no tensor to corrupt"):
+            faults.maybe_fault("dispatch.op")
+    # non-nan kinds behave identically through maybe_corrupt
+    with faults.fault_plan("trainer.step:kind=error:times=1"):
+        with pytest.raises(faults.FaultInjected, match="trainer.step"):
+            faults.maybe_corrupt("trainer.step", [onp.ones(2, "f4")])
 
 
 def test_smoke_seeded_fault_schedule_is_deterministic():
@@ -327,6 +373,47 @@ def test_smoke_kvstore_server_restart_midrun_reconnects(monkeypatch):
         th2.join(10)
 
 
+def test_smoke_kvstore_portfile_restart_gets_new_port(monkeypatch,
+                                                      tmp_path):
+    """Port-file mode (the launcher default): a killed-and-restarted
+    server binds a DIFFERENT OS-assigned port and republishes it — the
+    client's reconnect must re-resolve from the file, not a cached
+    port (the restart advice in the RPC-timeout error depends on
+    it)."""
+    from mxnet_tpu.kvstore_async import run_server
+    monkeypatch.setenv("MXNET_PS_PORT_FILE", str(tmp_path / "port"))
+    monkeypatch.setenv("DMLC_SERVER_ID", "0")
+    ev = threading.Event()
+    th = threading.Thread(target=run_server, args=(0, 1, ev),
+                          daemon=True)
+    th.start()
+    assert ev.wait(20)
+    first_port = int((tmp_path / "port.0").read_text())
+    kv = _ps_client(monkeypatch, 0)      # base port unused in this mode
+    kv.init("w", mx.np.zeros(4))
+    kv.push("w", mx.np.array(onp.ones(4, "f4")))
+    kv.stop_servers()
+    th.join(10)
+    ev2 = threading.Event()
+    th2 = threading.Thread(target=run_server, args=(0, 1, ev2),
+                           daemon=True)
+    th2.start()
+    assert ev2.wait(20)
+    try:
+        # almost surely a different port; either way the client must
+        # follow the republished file, and state re-seeds cleanly
+        kv.init("w", mx.np.zeros(4))
+        kv.push("w", mx.np.array(2 * onp.ones(4, "f4")))
+        got = kv.pull("w", out=mx.np.zeros(4)).asnumpy()
+        onp.testing.assert_allclose(got, 2.0)
+        second_port = int((tmp_path / "port.0").read_text())
+        assert isinstance(second_port, int) and second_port > 0
+        del first_port
+    finally:
+        kv.stop_servers()
+        th2.join(10)
+
+
 def test_smoke_kvstore_barrier_timeout_names_missing_rank(monkeypatch):
     port = _free_port()
     monkeypatch.setenv("MXNET_PS_BARRIER_TIMEOUT", "1")
@@ -510,6 +597,37 @@ def test_smoke_preemption_guard_flag_and_restore():
     assert signal.getsignal(signal.SIGTERM) in (signal.SIG_DFL,
                                                 signal.default_int_handler,
                                                 signal.Handlers.SIG_DFL)
+
+
+def test_smoke_preemption_second_signal_escalates():
+    """The escalation contract: signal one sets the cooperative flag;
+    signal two must still kill a wedged loop — SystemExit(128+sig) for
+    SIGTERM (default prior handler), KeyboardInterrupt for SIGINT, and
+    a callable prior handler runs instead when one was installed."""
+    with PreemptionGuard(signals=(signal.SIGTERM,)) as guard:
+        signal.raise_signal(signal.SIGTERM)
+        assert guard.requested
+        with pytest.raises(SystemExit) as ei:
+            signal.raise_signal(signal.SIGTERM)
+        assert ei.value.code == 128 + int(signal.SIGTERM)
+    # SIGINT escalates to KeyboardInterrupt (the Ctrl-C-twice contract;
+    # python's default SIGINT handler is callable, raising it)
+    with PreemptionGuard(signals=(signal.SIGINT,)) as guard:
+        signal.raise_signal(signal.SIGINT)
+        assert guard.requested
+        with pytest.raises(KeyboardInterrupt):
+            signal.raise_signal(signal.SIGINT)
+    # a custom prior handler wins on the second signal
+    seen = []
+    prev = signal.signal(signal.SIGTERM, lambda s, f: seen.append(s))
+    try:
+        with PreemptionGuard(signals=(signal.SIGTERM,)) as guard:
+            signal.raise_signal(signal.SIGTERM)
+            assert guard.requested and not seen
+            signal.raise_signal(signal.SIGTERM)
+            assert seen == [signal.SIGTERM]
+    finally:
+        signal.signal(signal.SIGTERM, prev)
 
 
 def test_smoke_spmd_fit_resume_is_idempotent(tmp_path):

@@ -1,0 +1,559 @@
+"""Training health guard suite (ISSUE 5).
+
+Proves the stack detects and recovers from the *silent* failure classes
+PR 3's crash-shaped chaos left uncovered: NaN/Inf gradients are caught
+by one fused on-device reduction and the bad update never lands, a
+diverging loss trips the EMA spike detector, recovery policies
+(skip / rewind / abort) respect their budgets, the hang watchdog dumps
+all-thread stacks on deadline, and the whole schedule replays
+deterministically from a seeded ``MXNET_FAULT_PLAN``.
+"""
+import os
+import tempfile
+import time
+
+import numpy as onp
+import pytest
+
+import mxnet_tpu as mx
+from mxnet_tpu import faults, health, metrics
+from mxnet_tpu.base import MXNetError
+from mxnet_tpu.checkpoint import CheckpointManager
+from mxnet_tpu.health import HealthError, HealthGuard
+
+# SPMD trainers + watchdog threads: virtual-CPU-mesh territory
+pytestmark = pytest.mark.host_mesh
+
+
+@pytest.fixture(autouse=True)
+def _disarm_faults():
+    faults.disarm()
+    yield
+    faults.disarm()
+
+
+@pytest.fixture(autouse=True)
+def _diag_dir(tmp_path, monkeypatch):
+    monkeypatch.setenv("MXNET_HEALTH_DIAG_DIR", str(tmp_path / "diag"))
+    yield
+
+
+def _spmd_trainer(seed=0):
+    import jax
+    from mxnet_tpu.parallel import SPMDTrainer, make_mesh
+    mx.random.seed(seed)
+    net = mx.gluon.nn.Dense(4)
+    net.initialize()
+    net(mx.np.zeros((2, 8)))
+    return SPMDTrainer(net, mx.gluon.loss.L2Loss(), "sgd",
+                       {"learning_rate": 0.05},
+                       mesh=make_mesh({"dp": 1},
+                                      devices=jax.devices()[:1]))
+
+
+def _batch_fn(step, salt=0):
+    rng = onp.random.RandomState(100 + step + 1000 * salt)
+    return (mx.np.array(rng.uniform(-1, 1, (8, 8)).astype("f4")),
+            mx.np.array(rng.uniform(-1, 1, (8, 4)).astype("f4")))
+
+
+# ---------------------------------------------------------------------------
+# the fused sentry
+# ---------------------------------------------------------------------------
+
+def test_smoke_fused_check_and_culprit_naming():
+    import jax.numpy as jnp
+    good = [jnp.ones((3, 3)), jnp.zeros((2,))]
+    vec = onp.asarray(health.fused_finite_check(jnp.float32(1.5), good))
+    assert vec[0] == 0 and vec[2] == pytest.approx(1.5)
+    bad = [jnp.ones((3, 3)),
+           jnp.array([1.0, onp.nan], jnp.float32)]
+    vec = onp.asarray(health.fused_finite_check(jnp.float32(1.5), bad))
+    assert vec[0] == 1 and int(vec[1]) == 2   # index 0 = loss, 2 = arr 1
+    vec = onp.asarray(health.fused_finite_check(
+        jnp.float32(onp.inf), good))
+    assert vec[0] == 1 and int(vec[1]) == 0   # the loss itself
+
+
+def test_smoke_guard_check_verdicts_and_ema_spike():
+    metrics.reset()
+    guard = HealthGuard(policy="skip", loss_spike=3.0, loss_window=3,
+                        max_skips=10)
+    for v in (1.0, 1.0, 1.0, 1.1):
+        assert guard.check(loss=mx.np.array(v)).ok
+    assert guard.loss_ema == pytest.approx(1.0, rel=0.1)
+    verdict = guard.check(loss=mx.np.array(50.0))
+    assert not verdict.ok and verdict.kind == "loss_spike" \
+        and verdict.action == "skip"
+    assert metrics.value("mxnet_health_events_total",
+                         kind="loss_spike") == 1
+    # the spike did NOT poison the EMA baseline
+    assert guard.loss_ema < 2.0
+    # non-finite loss names the loss
+    verdict = guard.check(loss=mx.np.array(onp.nan),
+                          grads=[mx.np.ones(3)], names=["w"])
+    assert verdict.kind == "nonfinite" and verdict.culprit == "loss"
+    # non-finite gradient names the parameter
+    verdict = guard.check(loss=mx.np.array(1.0),
+                          grads=[mx.np.ones(3),
+                                 mx.np.array([onp.inf, 0, 0])],
+                          names=["a", "b"])
+    assert verdict.culprit == "b"
+    assert metrics.value("mxnet_health_events_total",
+                         kind="nonfinite") == 2
+
+
+def test_smoke_policy_abort_and_budgets():
+    guard = HealthGuard(policy="abort")
+    with pytest.raises(HealthError, match="nonfinite.*'g0'"):
+        guard.check(loss=mx.np.array(1.0),
+                    grads=[mx.np.array([onp.nan])], names=["g0"])
+    guard = HealthGuard(policy="skip", max_skips=2)
+    bad = dict(loss=mx.np.array(onp.nan))
+    assert guard.check(**bad).action == "skip"
+    assert guard.check(**bad).action == "skip"
+    with pytest.raises(HealthError, match="skip budget"):
+        guard.check(**bad)
+    assert guard.skips == 2
+    # rewind without an attached rewind action degrades to skip
+    guard = HealthGuard(policy="rewind", max_rewinds=2)
+    assert guard.check(**bad).action == "skip"
+
+
+def test_smoke_spmd_spike_is_advisory_under_skip():
+    """The deferred SPMD verdict cannot retroactively drop a FINITE
+    spiked step (only non-finite steps gate on-device): policy=skip
+    records the spike as an advisory 'note' without lying about a
+    skip."""
+    metrics.reset()
+    guard = HealthGuard(policy="skip", loss_spike=2.0, loss_window=2)
+    for v in (1.0, 1.0, 1.0):
+        assert guard.check_device(onp.array([0.0, 0.0, v], "f4")).ok
+    verdict = guard.check_device(onp.array([0.0, 0.0, 50.0], "f4"))
+    assert not verdict.ok and verdict.action == "note" \
+        and verdict.kind == "loss_spike"
+    assert guard.skips == 0               # nothing was (or could be) dropped
+    assert metrics.value("mxnet_health_events_total",
+                         kind="loss_spike") == 1
+    # non-finite steps on the same path still skip for real
+    assert guard.check_device(
+        onp.array([1.0, 0.0, onp.nan], "f4")).action == "skip"
+    assert guard.skips == 1
+
+
+def test_smoke_rewind_without_checkpoint_refunds_budget():
+    """A rewind action that finds nothing to restore (restore() ->
+    None, the empty-directory fresh-start contract) must not burn the
+    rewind budget on a no-op — it refunds the charge and accounts a
+    skip."""
+    metrics.reset()
+    guard = HealthGuard(policy="rewind", max_rewinds=1, max_skips=3)
+    guard.set_rewind(lambda: None)        # empty checkpoint dir
+    bad = dict(loss=mx.np.array(onp.nan))
+    for _ in range(2):                    # would exhaust max_rewinds=1
+        verdict = guard.check(**bad)      # if no-op rewinds were charged
+        assert verdict.action == "rewind"
+        assert guard.do_rewind() is None
+    assert guard.rewinds == 0 and guard.skips == 2
+    assert metrics.value("mxnet_health_rewinds_total") == 0
+    assert metrics.value("mxnet_health_skipped_steps_total") == 2
+    # a real restore counts (and perturbs the salt)
+    guard.set_rewind(lambda: 7)
+    assert guard.check(**bad).action == "rewind"
+    assert guard.do_rewind() == 7
+    assert guard.rewinds == 1 and guard.replay_salt == 1
+    assert metrics.value("mxnet_health_rewinds_total") == 1
+
+
+def test_smoke_explicit_zero_deadline_disarms_despite_env(monkeypatch):
+    monkeypatch.setenv("MXNET_HEALTH_STEP_DEADLINE_S", "0.05")
+    fired = metrics.value("mxnet_health_events_total", kind="hang")
+    guard = HealthGuard(policy="skip", step_deadline_s=0)
+    with guard.watch("unit.disarmed"):
+        time.sleep(0.2)
+    assert guard.hangs == 0
+    assert metrics.value("mxnet_health_events_total",
+                         kind="hang") == fired
+
+
+# ---------------------------------------------------------------------------
+# SPMDTrainer: on-device gated step
+# ---------------------------------------------------------------------------
+
+def test_smoke_spmd_gated_step_never_updates_on_nan():
+    tr = _spmd_trainer()
+    tr.set_health_gate(True)
+    X, Y = _batch_fn(0)
+    tr.step(X, Y)                        # clean warmup
+    before = [p.data().asnumpy().copy() for p in tr._params]
+    with faults.fault_plan("trainer.step:kind=nan:times=1"):
+        tr.step(X, Y)                    # corrupted batch
+    vec = onp.asarray(tr._last_health)
+    assert vec[0] == 1                   # sentry saw it
+    for p, b in zip(tr._params, before):
+        onp.testing.assert_array_equal(p.data().asnumpy(), b)
+    # the next clean step updates again
+    tr.step(X, Y)
+    changed = any(not onp.array_equal(p.data().asnumpy(), b)
+                  for p, b in zip(tr._params, before))
+    assert changed
+
+
+def test_smoke_spmd_fit_skip_recovers_loss():
+    metrics.reset()
+    guard = HealthGuard(policy="skip", max_skips=3)
+    tr = _spmd_trainer()
+    with faults.fault_plan("trainer.step:kind=nan:times=1:after=2"):
+        loss = tr.fit(_batch_fn, 6, health_guard=guard)
+    assert guard.skips == 1
+    assert tr._step_count == 6
+    final = float(loss.asnumpy())
+    clean = float(_spmd_trainer().fit(_batch_fn, 6).asnumpy())
+    # one dropped step: the trajectory stays within a loose tolerance
+    assert onp.isfinite(final) and abs(final - clean) < 0.1 * clean + 0.05
+    assert metrics.value("mxnet_health_events_total",
+                         kind="nonfinite") == 1
+    assert metrics.value("mxnet_health_skipped_steps_total") == 1
+    # the gate is restored off after fit
+    assert not tr._health_gate
+
+
+def test_spmd_fit_rewind_restores_and_perturbs(tmp_path):
+    guard = HealthGuard(policy="rewind", max_rewinds=2)
+    mgr = CheckpointManager(str(tmp_path), max_to_keep=3)
+    tr = _spmd_trainer()
+    with faults.fault_plan("trainer.step:kind=nan:times=1:after=3"):
+        loss = tr.fit(_batch_fn, 6, checkpoint_manager=mgr,
+                      checkpoint_every=2, health_guard=guard)
+    assert guard.rewinds == 1
+    assert guard.replay_salt == 1        # data order perturbed
+    assert tr._step_count == 6
+    assert onp.isfinite(float(loss.asnumpy()))
+    for p in tr._params:
+        assert onp.isfinite(p.data().asnumpy()).all()
+
+
+def test_spmd_rewind_at_checkpoint_boundary_replays(tmp_path):
+    """checkpoint_every=1 puts every step on a checkpoint boundary:
+    the bad step's verdict must drain BEFORE its checkpoint is
+    written, so the rewind restores the pre-bad step and actually
+    replays (a post-bad-step checkpoint would silently turn rewind
+    into skip while consuming the budget)."""
+    guard = HealthGuard(policy="rewind", max_rewinds=2)
+    mgr = CheckpointManager(str(tmp_path), max_to_keep=5)
+    tr = _spmd_trainer()
+    seen = []
+
+    def batch_fn(step, salt=0):
+        seen.append((step, salt))
+        return _batch_fn(step, salt)
+
+    with faults.fault_plan("trainer.step:kind=nan:times=1:after=2"):
+        loss = tr.fit(batch_fn, 5, checkpoint_manager=mgr,
+                      checkpoint_every=1, health_guard=guard)
+    assert guard.rewinds == 1
+    assert tr._step_count == 5
+    assert onp.isfinite(float(loss.asnumpy()))
+    # the bad step (index 2) was REPLAYED with the perturbed salt
+    assert (2, 1) in seen, seen
+    # no checkpoint captured the bad step's index before verification:
+    # checkpoints resume monotonically to 5
+    assert mgr.latest_step == 5
+
+
+def test_smoke_spmd_fit_replay_is_deterministic():
+    def run_once():
+        tr = _spmd_trainer()
+        guard = HealthGuard(policy="skip", max_skips=8)
+        with faults.fault_plan("trainer.step:kind=nan:p=0.5:seed=42"):
+            loss = tr.fit(_batch_fn, 8, health_guard=guard)
+        return guard.skips, float(loss.asnumpy())
+
+    a, b = run_once(), run_once()
+    assert a == b and a[0] > 0
+
+
+# ---------------------------------------------------------------------------
+# gluon Trainer + Estimator
+# ---------------------------------------------------------------------------
+
+def _gluon_setup(seed=5):
+    mx.random.seed(seed)
+    net = mx.gluon.nn.Dense(3)
+    net.initialize()
+    net(mx.np.zeros((1, 6)))
+    tr = mx.gluon.Trainer(net.collect_params(), "sgd",
+                          {"learning_rate": 0.05})
+    return net, tr
+
+
+def test_smoke_gluon_install_skips_bad_update_and_decays_amp():
+    from mxnet_tpu import amp
+    net, tr = _gluon_setup()
+    amp.init_trainer(tr, init_scale=64.0)
+    guard = HealthGuard(policy="skip", max_skips=3)
+    guard.install(tr)
+    assert guard.install(tr) is guard    # idempotent
+    loss_fn = mx.gluon.loss.L2Loss()
+    x = mx.np.array(onp.ones((2, 6), "f4"))
+    y = mx.np.array(onp.zeros((2, 3), "f4"))
+    before = net.weight.data().asnumpy().copy()
+    with faults.fault_plan("trainer.step:kind=nan:times=1"):
+        with mx.autograd.record():
+            loss = loss_fn(net(x), y)
+        loss.backward()
+        tr.step(2)
+    assert guard.skips == 1
+    onp.testing.assert_array_equal(net.weight.data().asnumpy(), before)
+    assert tr._amp_scaler.loss_scale == 32.0       # decayed on skip
+    # clean step still applies
+    with mx.autograd.record():
+        loss = loss_fn(net(x), y)
+    loss.backward()
+    tr.step(2)
+    assert not onp.array_equal(net.weight.data().asnumpy(), before)
+
+
+def test_estimator_fit_health_guard_skips_and_stays_finite():
+    from mxnet_tpu.gluon.contrib.estimator import Estimator
+    rng = onp.random.RandomState(3)
+    data = [(mx.np.array(rng.uniform(-1, 1, (4, 6)).astype("f4")),
+             mx.np.array(rng.uniform(-1, 1, (4, 3)).astype("f4")))
+            for _ in range(6)]
+    net, tr = _gluon_setup()
+    est = Estimator(net, mx.gluon.loss.L2Loss(), trainer=tr)
+    guard = HealthGuard(policy="skip", max_skips=3)
+    with faults.fault_plan("trainer.step:kind=nan:times=1:after=1"):
+        est.fit(data, batches=6, health_guard=guard)
+    assert guard.skips == 1
+    assert onp.isfinite(net.weight.data().asnumpy()).all()
+
+
+def test_estimator_fit_health_rewind_via_manager(tmp_path):
+    from mxnet_tpu.gluon.contrib.estimator import Estimator
+    rng = onp.random.RandomState(3)
+    data = [(mx.np.array(rng.uniform(-1, 1, (4, 6)).astype("f4")),
+             mx.np.array(rng.uniform(-1, 1, (4, 3)).astype("f4")))
+            for _ in range(8)]
+    net, tr = _gluon_setup()
+    est = Estimator(net, mx.gluon.loss.L2Loss(), trainer=tr)
+    mgr = CheckpointManager(str(tmp_path), max_to_keep=2)
+    guard = HealthGuard(policy="rewind", max_rewinds=2)
+    with faults.fault_plan("trainer.step:kind=nan:times=1:after=3"):
+        est.fit(data, batches=8, health_guard=guard,
+                checkpoint_manager=mgr, checkpoint_every=2)
+    assert guard.rewinds == 1
+    assert onp.isfinite(net.weight.data().asnumpy()).all()
+
+
+# ---------------------------------------------------------------------------
+# hang watchdog
+# ---------------------------------------------------------------------------
+
+def test_smoke_watchdog_fires_dumps_and_counts(tmp_path, monkeypatch):
+    metrics.reset()
+    monkeypatch.setenv("MXNET_HEALTH_DIAG_DIR", str(tmp_path))
+    with health.watch_section("unit.test", deadline_s=0.05):
+        time.sleep(0.3)
+    # guard-less sections don't block on the dump write at exit — the
+    # watchdog thread may still be fsyncing it; wait it out
+    deadline = time.monotonic() + 10
+    while (metrics.value("mxnet_health_events_total", kind="hang") < 1
+           and time.monotonic() < deadline):
+        time.sleep(0.02)
+    path = health.last_dump_path()
+    assert path and os.path.dirname(path) == str(tmp_path)
+    text = open(path).read()
+    assert "site: unit.test" in text
+    assert "all-thread stacks" in text and "thread" in text
+    assert "metrics snapshot" in text
+    assert metrics.value("mxnet_health_events_total", kind="hang") == 1
+    assert metrics.value("mxnet_health_watchdog_fires_total",
+                         site="unit.test") == 1
+    # disarmed: no section, no fire
+    fired = metrics.value("mxnet_health_events_total", kind="hang")
+    with health.watch_section("unit.test", deadline_s=0):
+        time.sleep(0.05)
+    assert metrics.value("mxnet_health_events_total",
+                         kind="hang") == fired
+    # a fast section never fires
+    with health.watch_section("unit.fast", deadline_s=5.0):
+        pass
+    assert metrics.value("mxnet_health_watchdog_fires_total",
+                         site="unit.fast") == 0
+
+
+def test_smoke_watchdog_guard_abort_policy_escalates():
+    guard = HealthGuard(policy="abort", step_deadline_s=0.05)
+    with pytest.raises(HealthError, match="hang.*deadline"):
+        with guard.watch("unit.abort"):
+            time.sleep(0.3)
+    assert guard.hangs == 1 and guard.last_hang_dump
+    # non-abort policies record the event without raising
+    guard2 = HealthGuard(policy="skip", step_deadline_s=0.05)
+    with guard2.watch("unit.skip"):
+        time.sleep(0.3)
+    assert guard2.hangs == 1
+
+
+def test_watchdog_step_deadline_in_spmd_fit(monkeypatch):
+    metrics.reset()
+    guard = HealthGuard(policy="skip", step_deadline_s=0.1)
+    tr = _spmd_trainer()
+    # a 400ms stall injected at the step site, inside the armed window
+    with faults.fault_plan(
+            "trainer.step:kind=delay:delay_ms=400:times=1:after=1"):
+        tr.fit(_batch_fn, 3, health_guard=guard)
+    assert guard.hangs >= 1
+    assert guard.last_hang_dump and os.path.exists(guard.last_hang_dump)
+    assert metrics.value("mxnet_health_events_total", kind="hang") >= 1
+
+
+def test_serving_execute_watchdog(monkeypatch, tmp_path):
+    from mxnet_tpu import serving
+    from mxnet_tpu.serving import BucketPolicy, ModelServer
+    metrics.reset()
+    monkeypatch.setenv("MXNET_HEALTH_DIAG_DIR", str(tmp_path))
+    monkeypatch.setenv("MXNET_HEALTH_STEP_DEADLINE_S", "0.05")
+    mx.random.seed(0)
+    net = mx.gluon.nn.Dense(3)
+    net.initialize()
+    net.hybridize()
+    net(mx.np.zeros((1, 6), dtype="float32"))
+    model = serving.load_served(net)
+    real_predict = model.predict
+
+    def slow_predict(arrays):
+        time.sleep(0.3)
+        return real_predict(arrays)
+
+    model.predict = slow_predict
+    srv = ModelServer(model, policy=BucketPolicy(batch_buckets=(1,)),
+                      timeout_ms=1.0).start()
+    try:
+        out = srv.infer(onp.ones(6, "f4"), timeout=20.0)
+        assert out.shape == (3,)        # the slow batch still completed
+        deadline = time.monotonic() + 10
+        while (metrics.value("mxnet_health_watchdog_fires_total",
+                             site="serving.execute") < 1
+               and time.monotonic() < deadline):
+            time.sleep(0.02)
+        assert metrics.value("mxnet_health_watchdog_fires_total",
+                             site="serving.execute") == 1
+        assert health.last_dump_path()
+    finally:
+        srv.stop()
+
+
+def test_kvstore_barrier_watchdog(monkeypatch, tmp_path):
+    """A wedged barrier trips the watchdog dump before the (much
+    longer) barrier timeout error — the 'which rank is missing' +
+    'what is every thread doing' diagnostics pair."""
+    import threading
+    from mxnet_tpu.kvstore_async import run_server, KVStoreDistAsync
+    metrics.reset()
+    monkeypatch.setenv("MXNET_HEALTH_DIAG_DIR", str(tmp_path))
+    monkeypatch.setenv("MXNET_PS_PORT_FILE", str(tmp_path / "port"))
+    monkeypatch.setenv("DMLC_SERVER_ID", "0")
+    monkeypatch.setenv("MXNET_PS_BARRIER_TIMEOUT", "1")
+    ev = threading.Event()
+    th = threading.Thread(target=run_server, args=(0, 2, ev), daemon=True)
+    th.start()
+    assert ev.wait(20)
+    monkeypatch.setenv("DMLC_PS_ROOT_URI", "127.0.0.1")
+    monkeypatch.setenv("DMLC_PS_ROOT_PORT", "0")
+    monkeypatch.setenv("DMLC_NUM_SERVER", "1")
+    monkeypatch.setenv("DMLC_NUM_WORKER", "2")
+    monkeypatch.setenv("DMLC_WORKER_ID", "0")
+    monkeypatch.setenv("MXNET_HEALTH_STEP_DEADLINE_S", "0.2")
+    kv = KVStoreDistAsync()
+    try:
+        # rank 1 never arrives: the barrier times out server-side after
+        # 1s; the watchdog fired its dump at 0.2s
+        with pytest.raises(MXNetError, match="barrier timed out"):
+            kv.barrier()
+        deadline = time.monotonic() + 10
+        while (metrics.value("mxnet_health_watchdog_fires_total",
+                             site="kvstore.barrier") < 1
+               and time.monotonic() < deadline):
+            time.sleep(0.02)
+        assert metrics.value("mxnet_health_watchdog_fires_total",
+                             site="kvstore.barrier") == 1
+    finally:
+        kv.stop_servers()
+
+
+# ---------------------------------------------------------------------------
+# bulking interaction: the sentry must not add segment flushes
+# ---------------------------------------------------------------------------
+
+def test_smoke_sentry_adds_no_extra_bulk_flushes():
+    """The guard's check rides the optimizer-donation barrier the
+    update already takes: total flushed segments over an eager training
+    loop are identical with and without the guard."""
+    loss_fn = mx.gluon.loss.L2Loss()
+    rng = onp.random.RandomState(0)
+    x = mx.np.array(rng.uniform(-1, 1, (4, 6)).astype("f4"))
+    y = mx.np.array(rng.uniform(-1, 1, (4, 3)).astype("f4"))
+
+    def run(with_guard):
+        net, tr = _gluon_setup()
+        if with_guard:
+            HealthGuard(policy="skip").install(tr)
+        metrics.reset()
+        for _ in range(4):
+            with mx.autograd.record():
+                loss = loss_fn(net(x), y)
+            loss.backward()
+            tr.step(4)
+        mx.waitall()
+        total = 0.0
+        fam = metrics.REGISTRY.get("mxnet_bulk_segments_total")
+        for _vals, child in fam._series():
+            total += child.value
+        return total
+
+    base = run(False)
+    guarded = run(True)
+    assert guarded == base, (base, guarded)
+
+
+# ---------------------------------------------------------------------------
+# the chaos acceptance: one NaN + one stall in one seeded plan
+# ---------------------------------------------------------------------------
+
+def test_chaos_nan_plus_stall_acceptance(monkeypatch, tmp_path):
+    """ISSUE 5 acceptance: a seeded plan injecting one NaN gradient and
+    one stalled step — fit finishes, final loss within tolerance of a
+    clean run, mxnet_health_events_total records both kinds, the
+    watchdog dump exists, and the same plan replays to identical
+    decisions."""
+    metrics.reset()
+    monkeypatch.setenv("MXNET_HEALTH_DIAG_DIR", str(tmp_path))
+    # the deadline must clear the first step's compile (which IS a
+    # legitimately slow step) while the injected stall far exceeds it
+    plan = ("trainer.step:kind=nan:times=1:after=2;"
+            "trainer.step:kind=delay:delay_ms=2500:times=1:after=4")
+
+    def run_once():
+        tr = _spmd_trainer()
+        guard = HealthGuard(policy="skip", max_skips=3,
+                            step_deadline_s=1.5)
+        with faults.fault_plan(plan):
+            loss = tr.fit(_batch_fn, 6, health_guard=guard)
+        return guard, float(loss.asnumpy())
+
+    guard, final = run_once()
+    assert guard.skips == 1                      # exactly one skip
+    assert guard.skips < guard.max_skips         # budget respected
+    assert guard.hangs == 1
+    assert guard.last_hang_dump and os.path.exists(guard.last_hang_dump)
+    assert metrics.value("mxnet_health_events_total",
+                         kind="nonfinite") == 1
+    assert metrics.value("mxnet_health_events_total", kind="hang") == 1
+    clean = float(_spmd_trainer().fit(_batch_fn, 6).asnumpy())
+    assert abs(final - clean) < 0.1 * clean + 0.05
+    # replay: identical skip/hang decisions and identical loss
+    guard2, final2 = run_once()
+    assert (guard2.skips, guard2.hangs) == (guard.skips, guard.hangs)
+    assert final2 == final

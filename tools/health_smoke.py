@@ -1,0 +1,103 @@
+"""Health-guard acceptance smoke (ci/run.sh health-smoke, in tier-1).
+
+Bounded (~30s) proof of the ISSUE-5 training-health contract on a tiny
+SPMD run:
+
+1. a seeded ``MXNET_FAULT_PLAN`` NaN injection produces EXACTLY one
+   skipped step, the update never lands (params stay finite), the
+   final loss recovers to within tolerance of a clean run, and the
+   skip budget is respected;
+2. the hang watchdog fires on an injected stall and writes an
+   all-thread stack dump + metrics snapshot;
+3. ``mxnet_health_events_total`` records both event kinds;
+4. the same plan replays to the identical decision sequence.
+
+Exit code 0 = all assertions held.
+"""
+import os
+import sys
+import tempfile
+
+sys.path.insert(0, os.path.dirname(os.path.dirname(os.path.abspath(__file__))))
+
+PLAN = ("trainer.step:kind=nan:times=1:after=2;"
+        "trainer.step:kind=delay:delay_ms=2500:times=1:after=4")
+STEPS = 6
+DEADLINE_S = 1.5
+
+
+def _trainer():
+    import jax
+    import mxnet_tpu as mx
+    from mxnet_tpu.parallel import SPMDTrainer, make_mesh
+    mx.random.seed(0)
+    net = mx.gluon.nn.Dense(4)
+    net.initialize()
+    net(mx.np.zeros((2, 8)))
+    return SPMDTrainer(net, mx.gluon.loss.L2Loss(), "sgd",
+                       {"learning_rate": 0.05},
+                       mesh=make_mesh({"dp": 1},
+                                      devices=jax.devices()[:1]))
+
+
+def _batch_fn(step, salt=0):
+    import numpy as onp
+    import mxnet_tpu as mx
+    rng = onp.random.RandomState(100 + step + 1000 * salt)
+    return (mx.np.array(rng.uniform(-1, 1, (8, 8)).astype("f4")),
+            mx.np.array(rng.uniform(-1, 1, (8, 4)).astype("f4")))
+
+
+def main() -> None:
+    import jax
+    jax.config.update("jax_platforms", "cpu")
+    import numpy as onp
+    from mxnet_tpu import faults, metrics
+    from mxnet_tpu.health import HealthGuard
+
+    os.environ["MXNET_HEALTH_DIAG_DIR"] = tempfile.mkdtemp(
+        prefix="health-smoke-")
+
+    def run_guarded():
+        tr = _trainer()
+        guard = HealthGuard(policy="skip", max_skips=3,
+                            step_deadline_s=DEADLINE_S)
+        with faults.fault_plan(PLAN):
+            loss = tr.fit(_batch_fn, STEPS, health_guard=guard)
+        for p in tr._params:
+            assert onp.isfinite(p.data().asnumpy()).all(), \
+                "a NaN update reached the parameters"
+        return guard, float(loss.asnumpy())
+
+    guard, final = run_guarded()
+    clean = float(_trainer().fit(_batch_fn, STEPS).asnumpy())
+
+    assert guard.skips == 1, f"want exactly 1 skip, got {guard.skips}"
+    assert guard.skips < guard.max_skips, "skip budget violated"
+    assert guard.hangs == 1, f"want 1 watchdog fire, got {guard.hangs}"
+    assert guard.last_hang_dump and os.path.exists(guard.last_hang_dump), \
+        "watchdog stack dump missing"
+    dump = open(guard.last_hang_dump).read()
+    assert "all-thread stacks" in dump and "metrics snapshot" in dump
+    nonfinite = metrics.value("mxnet_health_events_total",
+                              kind="nonfinite")
+    hang = metrics.value("mxnet_health_events_total", kind="hang")
+    assert nonfinite == 1 and hang == 1, (nonfinite, hang)
+    assert onp.isfinite(final), "guarded run ended non-finite"
+    tol = 0.1 * clean + 0.05
+    assert abs(final - clean) < tol, \
+        f"loss did not recover: guarded {final:.5f} vs clean " \
+        f"{clean:.5f} (tol {tol:.5f})"
+
+    guard2, final2 = run_guarded()
+    assert (guard2.skips, guard2.hangs) == (guard.skips, guard.hangs), \
+        "replay diverged"
+    assert final2 == final, "replayed loss differs"
+
+    print(f"health-smoke PASS: 1 NaN skipped (budget {guard.max_skips}), "
+          f"loss {final:.5f} vs clean {clean:.5f}, watchdog dump at "
+          f"{guard.last_hang_dump}, replay identical")
+
+
+if __name__ == "__main__":
+    main()

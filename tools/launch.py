@@ -24,6 +24,7 @@ import sys
 def launch_local(args, cmd):
     procs = []
     servers = []
+    port_dir = None
     base_env = dict(os.environ)
     coord = f"127.0.0.1:{args.port}"
     ps_port = args.port + 1
@@ -36,6 +37,13 @@ def launch_local(args, cmd):
         if "MXNET_PS_TOKEN" not in base_env:
             import secrets
             base_env["MXNET_PS_TOKEN"] = secrets.token_hex(16)
+        # local servers bind OS-assigned ports (DMLC_PS_ROOT_PORT=0) and
+        # publish them through a per-job port file — no pre-picked port
+        # range to collide with other jobs or the kernel's ephemeral
+        # allocator (workers resolve MXNET_PS_PORT_FILE.<sid>)
+        import tempfile
+        port_dir = tempfile.mkdtemp(prefix="mxps-ports-")
+        base_env["MXNET_PS_PORT_FILE"] = os.path.join(port_dir, "port")
         for sid in range(args.num_servers):
             env = dict(base_env)
             env.update({
@@ -44,7 +52,7 @@ def launch_local(args, cmd):
                 "DMLC_NUM_SERVER": str(args.num_servers),
                 "DMLC_NUM_WORKER": str(args.num_workers),
                 "DMLC_PS_ROOT_URI": "127.0.0.1",
-                "DMLC_PS_ROOT_PORT": str(ps_port),
+                "DMLC_PS_ROOT_PORT": "0",
             })
             servers.append(subprocess.Popen(
                 [sys.executable, "-m", "mxnet_tpu.kvstore_async"],
@@ -85,6 +93,9 @@ def launch_local(args, cmd):
             p.terminate()
     for p in servers:
         p.wait()
+    if port_dir is not None:
+        import shutil
+        shutil.rmtree(port_dir, ignore_errors=True)
     return rc
 
 

@@ -20,6 +20,9 @@ Workloads:
   bulk         an eager training micro-loop exercising the lazy
                bulking engine: segment flush reasons, segment-cache
                hits/misses, and the ops-per-segment histogram.
+  health       an SPMD micro-fit under a seeded NaN fault plan with a
+               HealthGuard: health event counters, skip totals, the
+               loss EMA gauge, and the fused-check latency histogram.
 
 Runs on the CPU backend by default so it works anywhere (pass
 ``--platform ambient`` to keep the environment's backend, e.g. the TPU
@@ -118,11 +121,41 @@ def _workload_bulk(steps: int) -> None:
     mx.waitall()
 
 
+def _workload_health(steps: int) -> None:
+    import numpy as onp
+    import jax
+    import mxnet_tpu as mx
+    from mxnet_tpu import faults
+    from mxnet_tpu.health import HealthGuard
+    from mxnet_tpu.parallel import SPMDTrainer, make_mesh
+
+    mx.random.seed(0)
+    net = mx.gluon.nn.Dense(4)
+    net.initialize()
+    net(mx.np.zeros((2, 8)))
+    trainer = SPMDTrainer(net, mx.gluon.loss.L2Loss(), "sgd",
+                          {"learning_rate": 0.05},
+                          mesh=make_mesh({"dp": 1},
+                                         devices=jax.devices()[:1]))
+
+    def batch_fn(step):
+        rng = onp.random.RandomState(100 + step)
+        return (mx.np.array(rng.uniform(-1, 1, (8, 8)).astype("f4")),
+                mx.np.array(rng.uniform(-1, 1, (8, 4)).astype("f4")))
+
+    guard = HealthGuard(policy="skip", max_skips=4)
+    n = max(steps, 4)
+    with faults.fault_plan("trainer.step:kind=nan:times=1:after=1"):
+        trainer.fit(batch_fn, n, health_guard=guard)
+    mx.waitall()
+
+
 WORKLOADS = {
     "resnet_step": _workload_resnet_step,
     "mlp_fit": _workload_mlp_fit,
     "eager": _workload_eager,
     "bulk": _workload_bulk,
+    "health": _workload_health,
 }
 
 
