@@ -13,6 +13,12 @@
 #   ci/run.sh serving-smoke # tools/serve_bench.py --smoke alone
 #                           #   (batching wins / bounded compiles /
 #                           #   shed-not-crash)
+#   ci/run.sh generation-smoke # continuous-batching generation gate:
+#                           #   mixed prompt-length traffic at 8
+#                           #   clients, >=2x tokens/sec vs sequential
+#                           #   one-shot-per-token, 0 decode compiles
+#                           #   after warmup, clean shed under a
+#                           #   2x-slot flood
 #   ci/run.sh chaos-smoke   # bounded fault-injection/preemption proof
 #                           #   (tests/test_faults.py -k smoke)
 #   ci/run.sh health-smoke  # training health guard acceptance: seeded
@@ -74,6 +80,14 @@ run_serving_smoke() {
   JAX_PLATFORMS=cpu python tools/serve_bench.py --smoke
 }
 
+run_generation_smoke() {
+  echo "== generation-smoke: continuous batching >=2x sequential"
+  echo "   one-shot-per-token, 0 decode recompiles after warmup,"
+  echo "   2x-slot flood sheds cleanly (tokens/sec + TTFT reported)"
+  JAX_PLATFORMS=cpu timeout 600 python tools/serve_bench.py \
+    --generate --smoke
+}
+
 run_faultdoc() {
   echo "== faultdoc: every fault-injection site documented in"
   echo "   docs/fault_tolerance.md"
@@ -130,11 +144,12 @@ run_chaos() {
 
 run_tier1() {
   echo "== tier1: env-doc freshness + fault-site doc lint + serving"
-  echo "   smoke + chaos smoke + health smoke + bulking smoke + the"
-  echo "   tier-1 pytest selection"
+  echo "   smoke + generation smoke + chaos smoke + health smoke +"
+  echo "   bulking smoke + the tier-1 pytest selection"
   run_envdoc
   run_faultdoc
   run_serving_smoke
+  run_generation_smoke
   run_chaos_smoke
   run_health_smoke
   run_bulk_smoke
@@ -228,6 +243,7 @@ case "$variant" in
   envdoc)       run_envdoc ;;
   faultdoc)     run_faultdoc ;;
   serving-smoke) run_serving_smoke ;;
+  generation-smoke) run_generation_smoke ;;
   chaos-smoke)  run_chaos_smoke ;;
   health-smoke) run_health_smoke ;;
   chaos)        run_chaos ;;

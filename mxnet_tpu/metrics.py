@@ -626,6 +626,59 @@ BULK_OPS_PER_SEGMENT = histogram(
     "before a second op could join).",
     buckets=exponential_buckets(1.0, 2.0, 8))
 
+# -- continuous-batching generation engine (serving/generation.py) ----------
+GEN_SLOTS_ACTIVE = gauge(
+    "mxnet_gen_slots_active",
+    "Decode slots currently occupied by an in-flight generation "
+    "sequence (<= MXNET_GEN_MAX_SLOTS).")
+GEN_QUEUE_DEPTH = gauge(
+    "mxnet_gen_queue_depth",
+    "Generation requests waiting in the prefill admission queue (the "
+    "decode 'queue' is the slot table itself — see "
+    "mxnet_gen_slots_active).")
+GEN_TOKENS_TOTAL = counter(
+    "mxnet_gen_tokens_total",
+    "Tokens produced by the generation engine, by phase: 'prefill' "
+    "(the first token of each sequence, emitted by the prompt pass) "
+    "and 'decode' (every token from the resident decode step).",
+    labels=("phase",))
+GEN_STEP_SECONDS = histogram(
+    "mxnet_gen_step_seconds",
+    "Wall time of one generation-engine model execution, by phase "
+    "(prefill = one prompt admitted; decode = one iteration over ALL "
+    "active slots) — the prefill/decode split of engine time.",
+    labels=("phase",),
+    buckets=exponential_buckets(0.0005, 2.0, 14))
+GEN_TTFT_SECONDS = histogram(
+    "mxnet_gen_ttft_seconds",
+    "Time-to-first-token per generation request: submit to the first "
+    "streamed token (queue wait + prefill).",
+    buckets=exponential_buckets(0.001, 2.0, 14))
+GEN_ITERATIONS_TOTAL = counter(
+    "mxnet_gen_iterations_total",
+    "Decode-loop iterations executed (each runs the resident decode "
+    "step once over every active slot).")
+GEN_ADMISSIONS_TOTAL = counter(
+    "mxnet_gen_admissions_total",
+    "Generation requests admitted into a decode slot (prefill ran).")
+GEN_RETIREMENTS_TOTAL = counter(
+    "mxnet_gen_retirements_total",
+    "Generation sequences retired from their slot, by reason: eos / "
+    "length (max-tokens) / error / cancelled.", labels=("reason",))
+GEN_TOKENS_PER_SECOND = gauge(
+    "mxnet_gen_tokens_per_second",
+    "Aggregate decode throughput over the engine's most recent "
+    "iteration window (streamed tokens across all slots).")
+GEN_KV_BUCKET_LEN = gauge(
+    "mxnet_gen_kv_bucket_len",
+    "Current KV-cache capacity bucket (padded sequence length every "
+    "slot's cache is allocated at).")
+GEN_KV_MIGRATIONS_TOTAL = counter(
+    "mxnet_gen_kv_migrations_total",
+    "KV-cache capacity-bucket migrations (cache grew to the next "
+    "power-of-two length bucket; each switches the engine to that "
+    "bucket's pre-compiled decode step).")
+
 
 def record_step(total: float, data: float = 0.0, dispatch: float = 0.0,
                 sync: Optional[float] = None, count: int = 1) -> None:

@@ -30,8 +30,17 @@ in-process, with no dependencies beyond the stdlib:
 * :class:`~mxnet_tpu.serving.server.ModelServer` — composition +
   lifecycle: worker thread, futures-based in-process API, metrics.
 * :mod:`~mxnet_tpu.serving.http` — a stdlib ``http.server`` front end
-  (``tools/serve.py``): POST /v1/inference, GET /metrics (Prometheus
-  text from the PR-1 registry), GET /healthz.
+  (``tools/serve.py``): POST /v1/inference, POST /v1/generate (chunked
+  per-token streaming), GET /metrics (Prometheus text from the PR-1
+  registry), GET /healthz.
+* :class:`~mxnet_tpu.serving.generation.GenerationEngine` +
+  :class:`~mxnet_tpu.serving.kv_cache.PagedKVCache` +
+  :class:`~mxnet_tpu.serving.model.DecodeModel` — iteration-level
+  CONTINUOUS BATCHING for autoregressive LLM generation: a resident,
+  bucket-compiled decode step over a slot-based KV cache, admission
+  between decode iterations, per-step EOS/max-token retirement, and
+  per-token streaming (:class:`TokenStream`), hosted by
+  :class:`~mxnet_tpu.serving.server.GenerationServer`.
 
 Every stage publishes to :mod:`mxnet_tpu.metrics` (queue-depth gauge,
 batch-size / queue-wait / inference-latency histograms, shed counter by
@@ -39,12 +48,16 @@ reason, per-bucket compile counter) — ``metrics_dump.py``-style
 observability works out of the box.
 """
 from .batching import (BucketPolicy, DynamicBatcher, OverloadError,
-                       Request)
-from .model import ServedModel, load_served
-from .server import ModelServer
+                       Request, SlotScheduler)
+from .model import DecodeModel, ServedModel, load_served
+from .kv_cache import PagedKVCache
+from .generation import GenerationEngine, TokenStream
+from .server import GenerationServer, ModelServer
 from .http import make_http_server
 
 __all__ = [
     "BucketPolicy", "DynamicBatcher", "OverloadError", "Request",
-    "ServedModel", "load_served", "ModelServer", "make_http_server",
+    "SlotScheduler", "ServedModel", "DecodeModel", "PagedKVCache",
+    "GenerationEngine", "TokenStream", "GenerationServer", "load_served",
+    "ModelServer", "make_http_server",
 ]

@@ -25,7 +25,8 @@ import numpy as _np
 from ..base import MXNetError, getenv, register_env
 from .. import metrics as _metrics
 
-__all__ = ["BucketPolicy", "DynamicBatcher", "OverloadError", "Request"]
+__all__ = ["BucketPolicy", "DynamicBatcher", "OverloadError", "Request",
+           "SlotScheduler"]
 
 register_env("MXNET_SERVING_MAX_BATCH", 32,
              "Largest micro-batch the serving batcher assembles (also the "
@@ -366,3 +367,143 @@ class DynamicBatcher:
                 # empty queue: nothing to age out — block until submit()
                 # or close() notifies (no idle busy-poll)
                 self._nonempty.wait()
+
+
+# ---------------------------------------------------------------------------
+# Two-queue scheduler for the generation engine (iteration-level
+# continuous batching)
+# ---------------------------------------------------------------------------
+
+class SlotScheduler:
+    """Prefill queue + decode slot table — the iteration-level
+    scheduler behind :class:`~mxnet_tpu.serving.generation.
+    GenerationEngine`.
+
+    Two queues, two service disciplines:
+
+    * **prefill** — a BOUNDED FIFO of not-yet-admitted requests with
+      the one-shot path's exact shed semantics: a full queue sheds the
+      newcomer at submit (``queue_full``); a request whose deadline
+      passed while waiting for a slot is shed at admission time
+      (``deadline``) — "no slot freed within the deadline" is the
+      generation-side overload signal.
+    * **decode** — the slot table itself: admitted requests occupy a
+      slot until retirement (EOS / max-tokens / error) frees it.  The
+      engine drains admissions BETWEEN decode iterations, so new
+      requests join mid-flight without perturbing resident sequences.
+
+    Requests are duck-typed: they carry ``deadline_t`` (monotonic or
+    None), ``enqueue_t``, and ``fail(exc)`` / ``is_cancelled()`` (the
+    generation request routes these to its token stream).
+    """
+
+    def __init__(self, max_slots: int,
+                 queue_limit: Optional[int] = None) -> None:
+        if queue_limit is None:
+            queue_limit = int(getenv("MXNET_SERVING_QUEUE_LIMIT", 256))
+        self.max_slots = int(max_slots)
+        self.queue_limit = int(queue_limit)
+        self._q: List[Any] = []
+        self._active: Dict[int, Any] = {}       # slot -> request
+        self._lock = threading.Lock()
+        self._work = threading.Condition(self._lock)
+        self._closed = False
+
+    # -- prefill queue ------------------------------------------------------
+    def __len__(self) -> int:
+        with self._lock:
+            return len(self._q)
+
+    def submit(self, req: Any) -> None:
+        """Enqueue for admission, or shed immediately (OverloadError
+        failed onto the request AND raised, mirroring
+        :meth:`DynamicBatcher.submit`)."""
+        with self._lock:
+            if self._closed:
+                raise MXNetError("generation scheduler is closed")
+            if len(self._q) >= self.queue_limit:
+                depth = len(self._q)
+                err = OverloadError("queue_full", queue_depth=depth,
+                                    retry_after_ms=100.0 * max(1, depth))
+                SHED_TOTAL.labels(reason="queue_full").inc()
+                REQUESTS_TOTAL.labels(status="shed").inc()
+                req.fail(err)
+                raise err
+            self._q.append(req)
+            _metrics.GEN_QUEUE_DEPTH.set(len(self._q))
+            self._work.notify_all()
+
+    def pop_admissions(self, free_slots: int,
+                       now: Optional[float] = None) -> List[Any]:
+        """Up to ``free_slots`` admissible requests, FIFO; expired or
+        cancelled entries are shed/dropped in passing (the deadline
+        check at the admission boundary IS the "no slot freed in time"
+        shed)."""
+        if now is None:
+            now = time.monotonic()
+        out: List[Any] = []
+        with self._lock:
+            keep: List[Any] = []
+            for r in self._q:
+                if r.is_cancelled():
+                    continue
+                if r.deadline_t is not None and now > r.deadline_t:
+                    err = OverloadError("deadline",
+                                        queue_depth=len(self._q),
+                                        retry_after_ms=100.0)
+                    SHED_TOTAL.labels(reason="deadline").inc()
+                    REQUESTS_TOTAL.labels(status="shed").inc()
+                    r.fail(err)
+                    continue
+                if len(out) < free_slots:
+                    out.append(r)
+                else:
+                    keep.append(r)
+            self._q[:] = keep
+            _metrics.GEN_QUEUE_DEPTH.set(len(self._q))
+        return out
+
+    # -- decode slot table --------------------------------------------------
+    def activate(self, slot: int, req: Any) -> None:
+        with self._lock:
+            self._active[int(slot)] = req
+
+    def release(self, slot: int) -> Any:
+        with self._lock:
+            return self._active.pop(int(slot), None)
+
+    def active(self) -> Dict[int, Any]:
+        with self._lock:
+            return dict(self._active)
+
+    def n_active(self) -> int:
+        with self._lock:
+            return len(self._active)
+
+    # -- engine-loop blocking ----------------------------------------------
+    def wait_for_work(self, timeout: float) -> bool:
+        """Block until there is anything to do (queued request, active
+        slot, or close); returns False once closed AND drained."""
+        with self._lock:
+            if not self._q and not self._active and not self._closed:
+                self._work.wait(timeout)
+            return not (self._closed and not self._q
+                        and not self._active)
+
+    def close(self) -> None:
+        """Stop admissions; queued requests fail with a shutdown error.
+        Active slots are the engine's to fail (it owns the streams)."""
+        with self._lock:
+            self._closed = True
+            for r in self._q:
+                r.fail(MXNetError(
+                    "generation scheduler closed with the request "
+                    "still queued (shutdown)"))
+                REQUESTS_TOTAL.labels(status="error").inc()
+            self._q.clear()
+            _metrics.GEN_QUEUE_DEPTH.set(0)
+            self._work.notify_all()
+
+    @property
+    def closed(self) -> bool:
+        return self._closed

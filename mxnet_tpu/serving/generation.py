@@ -1,0 +1,510 @@
+"""Continuous-batching generation engine: resident decode loop,
+slot-based KV cache, per-token streaming.
+
+PR 2's serving core batches INDEPENDENT one-shot forward passes; the
+largest production traffic class — autoregressive LLM generation — is a
+different shape entirely: each request is a long-lived *sequence* whose
+per-token cost is tiny but whose lifetime spans thousands of model
+invocations.  Batching at request granularity (wait for a full batch of
+prompts, decode them lock-step to completion) wastes the machine twice:
+short sequences pad out to the longest one, and new arrivals wait for
+the whole batch to drain.
+
+This engine implements **iteration-level scheduling** (the Orca /
+vLLM-style discipline, via the Gemma-on-TPU serving comparison in
+PAPERS.md) on top of the pieces PRs 2-5 built:
+
+* the **decode inner loop is ONE compiled, shape-stable program**
+  (`DecodeModel.step`) over every slot of a
+  :class:`~mxnet_tpu.serving.kv_cache.PagedKVCache` — compiled once per
+  KV capacity bucket and resident across requests (the Julia->TPU
+  full-compilation lesson: never re-trace the hot loop);
+* **admission happens BETWEEN decode iterations**: prefill (a separate
+  per-prompt-bucket program) runs for the newcomers, their KV rows are
+  written into free slots, and the very next iteration decodes old and
+  new sequences together — no resident sequence ever stalls or changes
+  its tokens because of an arrival;
+* **retirement is per-step**: a sequence that emits EOS or reaches its
+  max-tokens budget frees its slot at the END of that iteration, and
+  the slot is admissible on the next one;
+* **tokens stream out as they exist**: each iteration's (S,) token
+  readback is pushed into per-request :class:`TokenStream` queues the
+  HTTP layer drains as chunked responses.
+
+Overload keeps PR-2 semantics: the admission queue is bounded
+(queue_full shed at submit) and a request that cannot get a slot within
+its deadline sheds with the same structured
+:class:`~mxnet_tpu.serving.batching.OverloadError` the one-shot path
+raises.  Faults at the PR-3 ``serving.execute`` site fail only the
+sequences in flight at that iteration; the engine survives and keeps
+serving.  Each iteration runs under the PR-5 hang watchdog.
+"""
+from __future__ import annotations
+
+import collections
+import itertools as _itertools
+import threading
+import time
+from typing import Any, Deque, Dict, List, Optional, Sequence, Tuple
+
+import numpy as _np
+
+from ..base import MXNetError, getenv, register_env
+from .. import metrics as _metrics
+from .batching import REQUESTS_TOTAL, SlotScheduler
+from .kv_cache import PagedKVCache, round_up_bucket
+from .model import DecodeModel
+
+__all__ = ["GenerationEngine", "GenRequest", "TokenStream"]
+
+register_env("MXNET_GEN_MAX_SLOTS", 8,
+             "Decode slots in the generation engine: the number of "
+             "sequences decoded concurrently by the resident "
+             "continuous-batching step (the KV cache allocates this "
+             "many rows).")
+register_env("MXNET_GEN_MAX_TOKENS", 256,
+             "Server-side cap on new tokens per generation request "
+             "(a request asking for more is clamped; 0 disables the "
+             "cap). Bounds slot hold time, which bounds admission "
+             "latency under load.")
+register_env("MXNET_GEN_STREAM", 1,
+             "Default for per-token HTTP streaming on /v1/generate: 1 "
+             "streams each token as a chunk the moment the decode "
+             "iteration produces it; 0 answers with the full "
+             "completion. Per-request 'stream' overrides.")
+
+
+class TokenStream:
+    """Per-request token channel: the engine produces, exactly one
+    consumer (HTTP handler or in-process caller) drains.
+
+    Iterate for per-token streaming (``for tok in stream``), or call
+    :meth:`result` for collect-all.  A failed request raises its error
+    from whichever call observes it (structured ``OverloadError`` for
+    sheds — HTTP maps those to 429 even mid-stream-setup)."""
+
+    def __init__(self) -> None:
+        self._buf: Deque[Any] = collections.deque()
+        self._lock = threading.Lock()
+        self._ready = threading.Condition(self._lock)
+        self._done = False
+        self._error: Optional[BaseException] = None
+        self._cancelled = False
+        self.finish_reason: Optional[str] = None
+        self.tokens: List[int] = []     # producer-side transcript
+
+    # -- producer (engine) --------------------------------------------------
+    def put(self, token: int) -> None:
+        with self._lock:
+            if self._done:
+                return
+            self.tokens.append(int(token))
+            self._buf.append(int(token))
+            self._ready.notify_all()
+
+    def close(self, finish_reason: str) -> None:
+        with self._lock:
+            if self._done:
+                return
+            self.finish_reason = finish_reason
+            self._done = True
+            self._ready.notify_all()
+
+    def fail(self, exc: BaseException) -> None:
+        with self._lock:
+            if self._done:
+                return
+            self._error = exc
+            self.finish_reason = "error"
+            self._done = True
+            self._ready.notify_all()
+
+    # -- consumer -----------------------------------------------------------
+    def cancel(self) -> None:
+        """Consumer gave up (client disconnect): the engine retires the
+        sequence at the next iteration boundary."""
+        with self._lock:
+            self._cancelled = True
+            self._done = True
+            self._ready.notify_all()
+
+    def is_cancelled(self) -> bool:
+        with self._lock:
+            return self._cancelled
+
+    @property
+    def finished(self) -> bool:
+        """Producer-side: the engine closed/failed/cancelled this
+        sequence (tokens may still be buffered for the consumer)."""
+        with self._lock:
+            return self._done
+
+    @property
+    def done(self) -> bool:
+        """Consumer-side: finished AND fully drained."""
+        with self._lock:
+            return self._done and not self._buf
+
+    def next_token(self, timeout: float = 60.0) -> Any:
+        """The next streamed token, or ``None`` at end-of-stream;
+        raises the request's error if it failed."""
+        deadline = time.monotonic() + timeout
+        with self._lock:
+            while True:
+                if self._buf:
+                    return self._buf.popleft()
+                if self._done:
+                    if self._error is not None:
+                        raise self._error
+                    return None
+                left = deadline - time.monotonic()
+                if left <= 0:
+                    raise MXNetError(
+                        "timed out waiting for the next generated "
+                        f"token ({timeout}s)")
+                self._ready.wait(left)
+
+    def __iter__(self):
+        while True:
+            t = self.next_token()
+            if t is None:
+                return
+            yield t
+
+    def result(self, timeout: float = 120.0) -> List[int]:
+        """Block until the sequence finishes; returns all tokens."""
+        deadline = time.monotonic() + timeout
+        out: List[int] = []
+        while True:
+            t = self.next_token(timeout=max(0.001,
+                                            deadline - time.monotonic()))
+            if t is None:
+                return out
+            out.append(t)
+
+
+class GenRequest:
+    """One generation request riding the scheduler: prompt, budget,
+    stream, timing/slot bookkeeping."""
+
+    __slots__ = ("tokens", "max_new_tokens", "eos_token", "stream",
+                 "enqueue_t", "deadline_t", "slot", "emitted",
+                 "t_first", "request_id")
+
+    _SEQ = _itertools.count(1)
+
+    def __init__(self, tokens: _np.ndarray, max_new_tokens: int,
+                 eos_token: Optional[int],
+                 deadline_t: Optional[float]) -> None:
+        self.tokens = tokens
+        self.max_new_tokens = int(max_new_tokens)
+        self.eos_token = eos_token
+        self.stream = TokenStream()
+        self.enqueue_t = time.monotonic()
+        self.deadline_t = deadline_t
+        self.slot: Optional[int] = None
+        self.emitted = 0
+        self.t_first: Optional[float] = None
+        self.request_id = next(GenRequest._SEQ)
+
+    # scheduler duck-type
+    def fail(self, exc: BaseException) -> None:
+        self.stream.fail(exc)
+
+    def is_cancelled(self) -> bool:
+        return self.stream.is_cancelled()
+
+
+class GenerationEngine:
+    """The resident decode loop over a slot table.
+
+    Drive it from one owner thread (``ModelServer``'s generation worker
+    in production, the test directly otherwise)::
+
+        eng = GenerationEngine(DecodeModel.from_block(gpt))
+        eng.warmup()
+        stream = eng.submit(prompt_ids, max_new_tokens=32)
+        while eng.run_iteration():   # or let GenerationServer loop
+            pass
+        print(stream.result())
+
+    ``run_iteration`` is ONE scheduling quantum: retire finished
+    sequences, admit newcomers into freed slots (prefill), then execute
+    one decode step over every active slot.  Everything the iteration
+    does is recorded in :attr:`iteration_log` (bounded ring) — the
+    continuous-batching invariant ("admission changes no resident
+    sequence's tokens") is asserted against these per-iteration slot
+    logs in CI.
+    """
+
+    LOG_KEEP = 4096
+
+    def __init__(self, model: DecodeModel,
+                 max_slots: Optional[int] = None,
+                 kv_buckets: Optional[Sequence[int]] = None,
+                 queue_limit: Optional[int] = None,
+                 max_tokens: Optional[int] = None,
+                 default_deadline_ms: Optional[float] = None) -> None:
+        self.model = model
+        if max_slots is None:
+            max_slots = int(getenv("MXNET_GEN_MAX_SLOTS", 8))
+        self.max_slots = int(max_slots)
+        # the position table bounds everything: a position past
+        # max_length would silently clamp-gather the embedding, so the
+        # cache only ever allocates buckets the model can address
+        from .kv_cache import kv_bucket_grid
+        full = kv_bucket_grid(kv_buckets)
+        self.grid = tuple(b for b in full if b <= model.max_length)
+        if not self.grid:
+            raise MXNetError(
+                f"no KV bucket <= model max_length {model.max_length} "
+                f"(grid {full})")
+        self.cache = PagedKVCache(
+            model.n_layers, model.num_heads, model.head_dim,
+            self.max_slots, buckets=self.grid, dtype=model.dtype)
+        # prompt pad grid: powers of two up to the top usable bucket —
+        # mixed prompt lengths land on a handful of prefill programs
+        top = self.grid[-1]
+        pb, b = [], 8
+        while b < top:
+            pb.append(b)
+            b *= 2
+        pb.append(top)
+        self.prompt_buckets = tuple(sorted(set(pb)))
+        self.scheduler = SlotScheduler(self.max_slots,
+                                       queue_limit=queue_limit)
+        self.max_tokens_cap = int(
+            max_tokens if max_tokens is not None
+            else getenv("MXNET_GEN_MAX_TOKENS", 256))
+        self._default_deadline_s = (
+            float(default_deadline_ms) / 1e3 if default_deadline_ms
+            is not None
+            else float(getenv("MXNET_SERVING_DEADLINE_MS", 0)) / 1e3)
+        # host mirrors of the per-slot step inputs
+        self._last_tok = _np.zeros((self.max_slots,), _np.int32)
+        self.iteration_log: Deque[Dict[str, Any]] = collections.deque(
+            maxlen=self.LOG_KEEP)
+        self._iter = 0
+        self.warmed = 0
+        self._tps_window: Deque[Tuple[float, int]] = collections.deque(
+            maxlen=64)
+
+    # -- lifecycle ----------------------------------------------------------
+    def warmup(self) -> int:
+        """Pre-compile the full program grid — prefill x prompt
+        buckets, decode x KV buckets, admission row-writes x both —
+        so steady-state traffic never compiles."""
+        self.warmed = self.model.warmup(self.cache, self.prompt_buckets)
+        self.warmed += self.cache.warmup_writes(self.prompt_buckets)
+        return self.warmed
+
+    def close(self) -> None:
+        """Fail everything in flight and stop admissions."""
+        self.scheduler.close()
+        for slot, req in self.scheduler.active().items():
+            self.scheduler.release(slot)
+            self.cache.free(slot)
+            req.fail(MXNetError(
+                "generation engine stopped with the sequence still "
+                "decoding (shutdown)"))
+            _metrics.GEN_RETIREMENTS_TOTAL.labels(reason="error").inc()
+        _metrics.GEN_SLOTS_ACTIVE.set(0)
+
+    # -- request API --------------------------------------------------------
+    def submit(self, tokens: Any, max_new_tokens: int = 64,
+               eos_token: Optional[int] = None,
+               deadline_ms: Optional[float] = None) -> TokenStream:
+        """Queue one prompt; returns its :class:`TokenStream`.  Sheds
+        with :class:`OverloadError` when the admission queue is full;
+        rejects (plain ``MXNetError``) prompts whose budget cannot fit
+        the KV/position ceiling — that is the caller's bug, not load."""
+        toks = _np.asarray(tokens, _np.int32).reshape(-1)
+        if toks.size < 1:
+            raise MXNetError("empty prompt")
+        if self.max_tokens_cap > 0:
+            max_new_tokens = min(int(max_new_tokens),
+                                 self.max_tokens_cap)
+        if max_new_tokens < 1:
+            raise MXNetError("max_new_tokens must be >= 1")
+        need = int(toks.size) + int(max_new_tokens)
+        if need > self.grid[-1]:
+            raise MXNetError(
+                f"prompt ({toks.size}) + max_new_tokens "
+                f"({max_new_tokens}) needs {need} positions; the top "
+                f"KV bucket / model ceiling is {self.grid[-1]} "
+                "(raise MXNET_GEN_KV_BUCKETS or shorten the request)")
+        if deadline_ms is None and self._default_deadline_s > 0:
+            deadline_ms = self._default_deadline_s * 1e3
+        deadline_t = (time.monotonic() + deadline_ms / 1e3
+                      if deadline_ms else None)
+        req = GenRequest(toks, max_new_tokens, eos_token, deadline_t)
+        self.scheduler.submit(req)      # raises OverloadError on shed
+        return req.stream
+
+    # -- the scheduling quantum ---------------------------------------------
+    def run_iteration(self) -> bool:
+        """Retire -> admit -> decode, once.  Returns True when any work
+        happened (False = idle: nothing active, nothing admissible)."""
+        from .. import faults as _faults
+        from .. import health as _health
+
+        self._iter += 1
+        log: Dict[str, Any] = {"iter": self._iter, "admitted": [],
+                               "retired": [], "decoded": []}
+
+        # 1. retire: EOS/max-tokens were marked at the previous decode;
+        #    cancelled consumers release their slot here too.  The
+        #    producer-side `finished` flag, NOT `done`: a finished
+        #    sequence must free its slot even while its consumer is
+        #    still draining buffered tokens
+        for slot, req in self.scheduler.active().items():
+            if req.stream.finished or req.is_cancelled():
+                self._retire(slot, req,
+                             req.stream.finish_reason or "cancelled")
+                log["retired"].append(slot)
+
+        # 2. admit into free slots (prefill, one compiled program per
+        #    prompt bucket).  Always visit the queue — with zero free
+        #    slots pop_admissions(0) admits nothing but STILL sheds
+        #    queued requests whose deadline passed ("no slot freed
+        #    within the deadline" is the generation overload signal)
+        free = self.cache.free_slots()
+        for req in self.scheduler.pop_admissions(len(free)):
+            try:
+                slot = self._admit(req)
+            except Exception as e:   # noqa: BLE001 - a poisoned
+                # prompt (or an injected prefill fault) fails ONLY
+                # its own request; the engine keeps serving
+                req.fail(e)
+                REQUESTS_TOTAL.labels(status="error").inc()
+                _metrics.GEN_RETIREMENTS_TOTAL.labels(
+                    reason="error").inc()
+                continue
+            log["admitted"].append(slot)
+
+        active = self.scheduler.active()
+        _metrics.GEN_SLOTS_ACTIVE.set(len(active))
+        if not active:
+            self.cache.reset_if_empty()
+            self.iteration_log.append(log)
+            return bool(log["admitted"] or log["retired"])
+
+        # 3. one resident decode step over EVERY active slot
+        try:
+            _faults.maybe_fault("serving.execute", phase="decode",
+                                slots=len(active))
+            self.cache.ensure_capacity(self.cache.needed_capacity())
+            pos = _np.maximum(self.cache.positions, 0).astype(_np.int32)
+            with _health.watch_section("generation.step",
+                                       slots=len(active)):
+                next_tok = self.model.step(self.cache, self._last_tok,
+                                           pos)
+        except Exception as e:   # noqa: BLE001 - an iteration fault
+            # fails exactly the sequences IN FLIGHT at this iteration
+            # (their kv rows are suspect); queued requests and the
+            # engine itself are unaffected.  The step consumed the KV
+            # buffers by donation, so a raise AFTER dispatch leaves the
+            # cache holding deleted arrays — reallocate before the next
+            # admission touches them
+            self.cache.reset_buffers()
+            for slot, req in active.items():
+                req.fail(e)              # before close(): the consumer
+                #                          must observe the fault, not
+                #                          a clean end-of-stream
+                self._retire(slot, req, "error")
+                REQUESTS_TOTAL.labels(status="error").inc()
+                log["retired"].append(slot)
+            self.iteration_log.append(log)
+            return True
+
+        now = time.monotonic()
+        n_streamed = 0
+        for slot, req in active.items():
+            tok = int(next_tok[slot])
+            self.cache.positions[slot] += 1
+            self._last_tok[slot] = tok
+            req.emitted += 1
+            n_streamed += 1
+            req.stream.put(tok)
+            log["decoded"].append(slot)
+            finished = None
+            if req.eos_token is not None and tok == int(req.eos_token):
+                finished = "eos"
+            elif req.emitted >= req.max_new_tokens:
+                finished = "length"
+            elif int(self.cache.positions[slot]) >= self.grid[-1]:
+                finished = "length"
+            if finished:
+                # mark done now; the slot frees at the next iteration's
+                # retire phase (keeps this loop allocation-free)
+                req.stream.close(finished)
+        _metrics.GEN_TOKENS_TOTAL.labels(phase="decode").inc(n_streamed)
+        _metrics.GEN_ITERATIONS_TOTAL.inc()
+        self._tps_window.append((now, n_streamed))
+        if len(self._tps_window) >= 2:
+            t0, _ = self._tps_window[0]
+            span = now - t0
+            if span > 0:
+                total = sum(n for _, n in self._tps_window) \
+                    - self._tps_window[0][1]
+                _metrics.GEN_TOKENS_PER_SECOND.set(total / span)
+        self.iteration_log.append(log)
+        return True
+
+    def _admit(self, req: GenRequest) -> int:
+        """Prefill one request and install it in a slot.  The prompt
+        pass emits the FIRST generated token (TTFT ends here)."""
+        from .. import faults as _faults
+        _faults.maybe_fault("serving.execute", phase="prefill",
+                            prompt=int(req.tokens.size))
+        slot = self.cache.alloc()
+        if slot is None:                     # caller checked free_slots
+            raise MXNetError("no free decode slot (admission race)")
+        try:
+            t0 = int(req.tokens.size)
+            pb = round_up_bucket(t0, self.prompt_buckets)
+            logits, ks, vs = self.model.prefill(req.tokens, pb)
+            self.cache.write_prompt(slot, ks, vs, t0)
+            first = int(_np.argmax(logits))
+        except Exception:
+            self.cache.free(slot)
+            raise
+        self.scheduler.activate(slot, req)
+        req.slot = slot
+        self._last_tok[slot] = first
+        req.t_first = time.monotonic()
+        req.emitted = 1
+        req.stream.put(first)
+        _metrics.GEN_TTFT_SECONDS.observe(req.t_first - req.enqueue_t)
+        _metrics.GEN_TOKENS_TOTAL.labels(phase="prefill").inc()
+        _metrics.GEN_ADMISSIONS_TOTAL.inc()
+        if req.eos_token is not None and first == int(req.eos_token):
+            req.stream.close("eos")
+        elif req.emitted >= req.max_new_tokens:
+            req.stream.close("length")
+        return slot
+
+    def _retire(self, slot: int, req: GenRequest, reason: str) -> None:
+        self.scheduler.release(slot)
+        self.cache.free(slot)
+        req.stream.close(reason)         # no-op if already closed
+        if reason in ("eos", "length"):
+            REQUESTS_TOTAL.labels(status="ok").inc()
+        _metrics.GEN_RETIREMENTS_TOTAL.labels(reason=reason).inc()
+
+    # -- introspection ------------------------------------------------------
+    def describe(self) -> Dict[str, Any]:
+        return {
+            "model": self.model.describe(),
+            "cache": self.cache.describe(),
+            "slots": {"max": self.max_slots,
+                      "active": self.scheduler.n_active(),
+                      "free": len(self.cache.free_slots())},
+            "queue": {"depth": len(self.scheduler),
+                      "limit": self.scheduler.queue_limit},
+            "prompt_buckets": list(self.prompt_buckets),
+            "kv_buckets": list(self.grid),
+            "max_tokens_cap": self.max_tokens_cap,
+            "warmed_programs": self.warmed,
+            "iterations": self._iter,
+        }

@@ -13,10 +13,22 @@ Endpoints:
   ``{"predictions": [...]}``.  Overload -> **429** with the structured
   shed payload (reason, queue_depth, retry_after_ms) and a Retry-After
   header; malformed input -> 400; model fault -> 500.
+* ``POST /v1/generate`` — body ``{"tokens": [id, ...]}`` (the prompt;
+  ``"prompt"`` is an accepted alias) + optional ``"max_new_tokens"``,
+  ``"eos_token"``, ``"deadline_ms"``, ``"stream"``.  Streaming (the
+  default, ``MXNET_GEN_STREAM``) answers **chunked**: one NDJSON line
+  per token (``{"token": id, "index": i}``) the moment the decode
+  iteration produces it, then a ``{"done": true, ...}`` trailer line.
+  ``"stream": false`` answers one JSON object after the sequence
+  finishes.  No slot within the deadline / queue full -> **429** with
+  the same structured shed payload; dead decode worker -> 503.
 * ``GET /metrics`` — Prometheus text from the process metrics registry
-  (queue depth, batch sizes, shed counts, per-bucket compiles, ...).
-* ``GET /healthz`` — liveness + queue/compile-cache snapshot.
-* ``GET /v1/model`` — model + bucket-policy description.
+  (queue depth, batch sizes, shed counts, per-bucket compiles, slot
+  occupancy, tokens/sec, TTFT, ...).
+* ``GET /healthz`` — liveness + queue/compile-cache snapshot (degraded
+  when EITHER the one-shot worker or the generation worker died).
+* ``GET /v1/model`` — model + bucket-policy (+ generation engine)
+  description.
 """
 from __future__ import annotations
 
@@ -68,11 +80,15 @@ class _Handler(BaseHTTPRequestHandler):
     server_version = "mxnet-tpu-serving/0.1"
     protocol_version = "HTTP/1.1"
 
-    # the ModelServer rides on the HTTP server object (set in
-    # make_http_server)
+    # the ModelServer / GenerationServer ride on the HTTP server object
+    # (set in make_http_server); either may be absent
     @property
-    def _ms(self) -> ModelServer:
+    def _ms(self) -> Optional[ModelServer]:
         return self.server.model_server     # type: ignore[attr-defined]
+
+    @property
+    def _gs(self) -> Any:
+        return getattr(self.server, "generation_server", None)
 
     def log_message(self, fmt: str, *args: Any) -> None:
         if getattr(self.server, "verbose", False):
@@ -105,20 +121,34 @@ class _Handler(BaseHTTPRequestHandler):
             self._reply(200, metrics.render_text().encode(),
                         content_type="text/plain; version=0.0.4")
         elif path == "/healthz":
-            d = self._ms.describe()
-            if not self._ms.healthy():
+            degraded = []
+            if self._ms is not None and not self._ms.healthy():
+                degraded.append("serving worker thread has died")
+            if self._gs is not None and not self._gs.healthy():
+                degraded.append("generation worker thread has died")
+            body: dict = {}
+            if self._ms is not None:
+                d = self._ms.describe()
+                body["queue"] = d["queue"]
+                body["exec_cache"] = d["exec_cache"]
+            if self._gs is not None:
+                g = self._gs.describe()
+                body["generation"] = {"slots": g["slots"],
+                                      "queue": g["queue"]}
+            if degraded:
                 # dead worker thread: requests would queue forever —
                 # tell the load balancer to stop sending traffic
-                self._reply(503, {"status": "degraded",
-                                  "detail": "serving worker thread has "
-                                            "died; restart the server",
-                                  "queue": d["queue"]})
+                body.pop("exec_cache", None)
+                self._reply(503, dict(body, status="degraded",
+                                      detail="; ".join(degraded)
+                                      + "; restart the server"))
             else:
-                self._reply(200, {"status": "ok",
-                                  "queue": d["queue"],
-                                  "exec_cache": d["exec_cache"]})
+                self._reply(200, dict(body, status="ok"))
         elif path == "/v1/model":
-            self._reply(200, self._ms.describe())
+            out = (self._ms.describe() if self._ms is not None else {})
+            if self._gs is not None:
+                out["generation"] = self._gs.describe()
+            self._reply(200, out)
         else:
             self._reply(404, {"error": "not_found", "path": path})
 
@@ -131,8 +161,16 @@ class _Handler(BaseHTTPRequestHandler):
 
     def _post(self) -> None:
         path = self.path.split("?", 1)[0]
+        if path == "/v1/generate":
+            self._post_generate()
+            return
         if path not in ("/v1/inference", "/invocations"):
             self._reply(404, {"error": "not_found", "path": path})
+            return
+        if self._ms is None:
+            self._reply(404, {"error": "not_found", "path": path,
+                              "detail": "this server hosts only "
+                                        "/v1/generate"})
             return
         try:
             length = int(self.headers.get("Content-Length", 0))
@@ -196,15 +234,152 @@ class _Handler(BaseHTTPRequestHandler):
             return
         self._reply(200, {"predictions": preds})
 
+    # -- generation (continuous batching, per-token streaming) -------------
+    def _post_generate(self) -> None:
+        from ..base import getenv
+        gs = self._gs
+        if gs is None:
+            self._reply(404, {"error": "not_found",
+                              "path": "/v1/generate",
+                              "detail": "no generation engine is "
+                                        "hosted (serve a decoder LM "
+                                        "with --generate)"})
+            return
+        try:
+            length = int(self.headers.get("Content-Length", 0))
+            if length <= 0 or length > _MAX_BODY:
+                raise ValueError(f"bad Content-Length {length}")
+            payload = json.loads(self.rfile.read(length))
+            if not isinstance(payload, dict):
+                raise ValueError("body must be a JSON object")
+            toks = payload.get("tokens", payload.get("prompt"))
+            if not isinstance(toks, list) or not toks or \
+                    not all(isinstance(t, int) for t in toks):
+                raise ValueError(
+                    "'tokens' (or 'prompt') must be a non-empty list "
+                    "of token ids")
+            max_new = int(payload.get("max_new_tokens", 64))
+            eos = payload.get("eos_token")
+            if eos is not None:
+                eos = int(eos)
+            deadline_ms = payload.get("deadline_ms")
+            if deadline_ms is not None and not isinstance(
+                    deadline_ms, (int, float)):
+                raise ValueError("deadline_ms must be a number")
+            stream_mode = bool(payload.get(
+                "stream", int(getenv("MXNET_GEN_STREAM", 1))))
+        except (TypeError, ValueError, json.JSONDecodeError) as e:
+            self._reply(400, {"error": "bad_request", "detail": str(e)})
+            return
+        # submit: backpressure -> 429, dead worker -> 503, a budget
+        # that cannot fit the KV ceiling -> 400 (the caller's bug)
+        try:
+            stream = gs.generate(toks, max_new_tokens=max_new,
+                                 eos_token=eos,
+                                 deadline_ms=deadline_ms)
+        except OverloadError as e:
+            self._reply(429, e.to_json(), headers={
+                "Retry-After": str(max(1, int(e.retry_after_ms / 1e3)))})
+            return
+        except DegradedError as e:
+            self._reply(503, {"error": "degraded", "detail": str(e)},
+                        headers={"Retry-After": "1"})
+            return
+        except MXNetError as e:
+            self._reply(400, {"error": "bad_request", "detail": str(e)})
+            return
+        if not stream_mode:
+            try:
+                tokens = stream.result(timeout=300.0)
+            except OverloadError as e:
+                # no slot freed within the deadline: still a shed
+                self._reply(429, e.to_json(), headers={
+                    "Retry-After": str(max(1, int(e.retry_after_ms
+                                                  / 1e3)))})
+                return
+            except Exception as e:   # noqa: BLE001 - request-scoped
+                self._reply(500, {"error": "generation_failed",
+                                  "detail": str(e)})
+                return
+            self._reply(200, {"tokens": tokens,
+                              "finish_reason": stream.finish_reason})
+            return
+        self._stream_tokens(stream)
 
-def make_http_server(model_server: ModelServer, host: str = "127.0.0.1",
+    def _stream_tokens(self, stream: Any) -> None:
+        """Chunked NDJSON: one line per token AS the decode loop emits
+        it, then a done trailer.  The status line is DEFERRED until the
+        first token exists: every shed (queue_full at submit, deadline
+        at the admission boundary) happens strictly before any token is
+        produced, so waiting for token #1 preserves the documented
+        429/500 contract for streaming requests.  A failure after that
+        becomes an error line on the already-committed 200 (the nature
+        of streaming); a client disconnect cancels the sequence so its
+        slot frees at the next iteration."""
+        try:
+            first = stream.next_token(timeout=300.0)
+        except OverloadError as e:
+            # no slot freed within the deadline — still a 429
+            self._reply(429, e.to_json(), headers={
+                "Retry-After": str(max(1, int(e.retry_after_ms / 1e3)))})
+            return
+        except Exception as e:   # noqa: BLE001 - request-scoped fault
+            self._reply(500, {"error": "generation_failed",
+                              "detail": str(e)})
+            return
+        if first is None:        # closed with zero tokens (shutdown)
+            self._reply(500, {"error": "generation_failed",
+                              "detail": "sequence closed before its "
+                                        "first token"})
+            return
+        self.send_response(200)
+        self.send_header("Content-Type", "application/x-ndjson")
+        self.send_header("Transfer-Encoding", "chunked")
+        self.send_header("Cache-Control", "no-store")
+        self.end_headers()
+
+        def chunk(obj: Any) -> None:
+            data = (json.dumps(obj) + "\n").encode()
+            self.wfile.write(f"{len(data):X}\r\n".encode() + data
+                             + b"\r\n")
+            self.wfile.flush()
+
+        i = 0
+        try:
+            try:
+                chunk({"token": int(first), "index": i})
+                i += 1
+                for tok in stream:
+                    chunk({"token": int(tok), "index": i})
+                    i += 1
+            except MXNetError as e:
+                chunk({"error": "generation_failed", "detail": str(e),
+                       "done": True})
+                self.wfile.write(b"0\r\n\r\n")
+                return
+            chunk({"done": True, "n_tokens": i,
+                   "finish_reason": stream.finish_reason})
+            self.wfile.write(b"0\r\n\r\n")
+        except (BrokenPipeError, ConnectionResetError):
+            stream.cancel()
+
+
+def make_http_server(model_server: Optional[ModelServer],
+                     host: str = "127.0.0.1",
                      port: int = 8080,
-                     verbose: bool = False) -> ThreadingHTTPServer:
+                     verbose: bool = False,
+                     generation_server: Any = None
+                     ) -> ThreadingHTTPServer:
     """Bind the HTTP front end (``port=0`` picks a free port; the bound
     address is ``httpd.server_address``).  Run with ``serve_forever()``;
-    the caller owns ``model_server.start()/stop()``."""
+    the caller owns the model/generation servers' ``start()/stop()``.
+    Either server may be omitted; its endpoints then answer 404."""
+    if model_server is None and generation_server is None:
+        raise MXNetError("make_http_server needs a ModelServer and/or "
+                         "a GenerationServer")
     httpd = ThreadingHTTPServer((host, port), _Handler)
     httpd.daemon_threads = True
     httpd.model_server = model_server       # type: ignore[attr-defined]
+    httpd.generation_server = generation_server  # type: ignore[attr-defined]
     httpd.verbose = verbose                 # type: ignore[attr-defined]
     return httpd

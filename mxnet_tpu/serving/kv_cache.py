@@ -1,0 +1,286 @@
+"""Slot-based, capacity-bucketed KV cache for continuous-batching decode.
+
+The decode inner loop must be ONE compiled, shape-stable program that
+stays resident across requests (the Julia->TPU full-compilation lesson,
+PAPERS.md): every tensor the step touches therefore has a fixed shape.
+This cache provides that shape discipline:
+
+* **Slots** — the cache is a fixed ``(S, L, heads, d)`` buffer per
+  layer, ``S = max_slots``.  A sequence owns one slot row for its whole
+  lifetime; admission writes its prefilled keys/values into the row,
+  retirement simply frees the slot id (no copy, no compaction — the
+  row's stale contents are masked off by the per-slot position mask).
+* **Capacity buckets** — ``L`` is drawn from a power-of-two-style grid
+  (``MXNET_GEN_KV_BUCKETS``).  The decode step compiles once per
+  bucket; when any live sequence needs a position ``>= L`` the whole
+  cache pads up to the next bucket (`grow`), switching the engine to
+  that bucket's pre-compiled step.  Steady-state traffic confined to
+  the warmed grid therefore triggers ZERO XLA compiles.
+* **Donation-friendly** — the engine replaces the layer buffers with
+  the decode step's outputs each iteration, so XLA can update the
+  cache in place (the buffers are donated to the compiled step).
+
+Positions/occupancy are host-side numpy bookkeeping: the device only
+ever sees the fixed-shape buffers plus an ``(S,)`` position vector.
+"""
+from __future__ import annotations
+
+from typing import Any, List, Optional, Sequence, Tuple
+
+import numpy as _np
+
+from ..base import MXNetError, getenv, register_env
+from .. import metrics as _metrics
+
+__all__ = ["PagedKVCache", "kv_bucket_grid", "round_up_bucket"]
+
+register_env("MXNET_GEN_KV_BUCKETS", "128,256,512,1024",
+             "KV-cache capacity bucket grid for the generation engine "
+             "(comma list of padded sequence lengths). The resident "
+             "decode step compiles once per bucket; a sequence whose "
+             "prompt+new-tokens budget exceeds the top bucket is "
+             "rejected at submit.")
+
+
+def kv_bucket_grid(buckets: Optional[Sequence[int]] = None
+                   ) -> Tuple[int, ...]:
+    """The configured KV capacity grid, sorted ascending."""
+    if buckets is None:
+        raw = str(getenv("MXNET_GEN_KV_BUCKETS", "128,256,512,1024"))
+        buckets = [int(b) for b in raw.split(",") if b.strip()]
+    out = tuple(sorted({int(b) for b in buckets}))
+    if not out or out[0] < 1:
+        raise MXNetError(f"bad KV bucket grid {buckets!r}")
+    return out
+
+
+def round_up_bucket(n: int, grid: Sequence[int]) -> int:
+    """Smallest grid bucket >= n (raises past the top — an unbounded
+    length would reopen the compile hole the grid exists to close)."""
+    for b in grid:
+        if b >= n:
+            return b
+    raise MXNetError(
+        f"required capacity {n} exceeds the top KV bucket {grid[-1]}; "
+        "reject the request (or raise MXNET_GEN_KV_BUCKETS)")
+
+
+class PagedKVCache:
+    """Per-layer ``(max_slots, L, heads, head_dim)`` K/V buffers plus
+    host-side slot bookkeeping.
+
+    ``layers`` buffers live as jax arrays (device-resident); ``k(i)`` /
+    ``v(i)`` hand them to the decode step and :meth:`replace` swaps in
+    the step's outputs (donation-compatible).
+    """
+
+    def __init__(self, n_layers: int, n_heads: int, head_dim: int,
+                 max_slots: int,
+                 buckets: Optional[Sequence[int]] = None,
+                 dtype: Any = None) -> None:
+        import jax.numpy as jnp
+        self.grid = kv_bucket_grid(buckets)
+        self.n_layers = int(n_layers)
+        self.n_heads = int(n_heads)
+        self.head_dim = int(head_dim)
+        self.max_slots = int(max_slots)
+        if self.max_slots < 1:
+            raise MXNetError(f"max_slots must be >= 1, got {max_slots}")
+        self.dtype = jnp.dtype(dtype) if dtype is not None \
+            else jnp.float32
+        self.bucket = self.grid[0]
+        self._k: List[Any] = []
+        self._v: List[Any] = []
+        self._alloc_buffers(self.bucket)
+        # host bookkeeping: next write position per slot (== tokens
+        # resident in the row), -1 marks a free slot
+        self.positions = _np.full((self.max_slots,), -1, _np.int64)
+        _metrics.GEN_KV_BUCKET_LEN.set(self.bucket)
+
+    # -- buffers ------------------------------------------------------------
+    def _alloc_buffers(self, L: int) -> None:
+        import jax
+        import jax.numpy as jnp
+        shape = (self.max_slots, L, self.n_heads, self.head_dim)
+        # device_put COMMITS the buffers: a jitted call keys its cache
+        # on input committed-ness, so fresh uncommitted zeros would
+        # make the first post-reset admission recompile the row write
+        # even at an identical shape
+        dev = jax.local_devices()[0]
+        self._k = [jax.device_put(jnp.zeros(shape, self.dtype), dev)
+                   for _ in range(self.n_layers)]
+        self._v = [jax.device_put(jnp.zeros(shape, self.dtype), dev)
+                   for _ in range(self.n_layers)]
+
+    def k(self, layer: int) -> Any:
+        return self._k[layer]
+
+    def v(self, layer: int) -> Any:
+        return self._v[layer]
+
+    def layers(self) -> List[Tuple[Any, Any]]:
+        return list(zip(self._k, self._v))
+
+    def replace(self, new_k: Sequence[Any], new_v: Sequence[Any]) -> None:
+        """Swap in the decode step's updated buffers (the old ones were
+        donated to the compiled call)."""
+        self._k = list(new_k)
+        self._v = list(new_v)
+
+    # -- slots --------------------------------------------------------------
+    def free_slots(self) -> List[int]:
+        return [i for i in range(self.max_slots)
+                if self.positions[i] < 0]
+
+    def occupancy(self) -> int:
+        return int((self.positions >= 0).sum())
+
+    def alloc(self) -> Optional[int]:
+        for i in range(self.max_slots):
+            if self.positions[i] < 0:
+                self.positions[i] = 0
+                return i
+        return None
+
+    def free(self, slot: int) -> None:
+        self.positions[slot] = -1
+
+    # -- admission write ----------------------------------------------------
+    def write_prompt(self, slot: int, ks: Sequence[Any],
+                     vs: Sequence[Any], t0: int) -> None:
+        """Install a prefilled prompt into ``slot``: ``ks[l]``/``vs[l]``
+        are ``(Lp, heads, d)`` (prompt padded to a length bucket; the
+        pad rows carry garbage KV that stays masked until the decode
+        loop overwrites them position by position).  Grows the cache
+        first if the padded prompt exceeds the current bucket."""
+        Lp = int(ks[0].shape[0])
+        if Lp > self.bucket:
+            self.grow(round_up_bucket(Lp, self.grid))
+        slot_j = _np.int32(slot)
+        for li in range(self.n_layers):
+            self._k[li] = _write_row_jit(self._k[li], ks[li], slot_j)
+            self._v[li] = _write_row_jit(self._v[li], vs[li], slot_j)
+        self.positions[slot] = int(t0)
+
+    # -- capacity -----------------------------------------------------------
+    def needed_capacity(self) -> int:
+        """Positions the next decode step will write: max live position
+        + 1 (0 when idle)."""
+        live = self.positions[self.positions >= 0]
+        return int(live.max()) + 1 if live.size else 0
+
+    def ensure_capacity(self, pos_needed: int) -> bool:
+        """Grow to the bucket covering ``pos_needed`` write positions;
+        returns True when a migration happened."""
+        if pos_needed <= self.bucket:
+            return False
+        self.grow(round_up_bucket(pos_needed, self.grid))
+        return True
+
+    def grow(self, new_bucket: int) -> None:
+        if new_bucket <= self.bucket:
+            return
+        self._k = [_grow_rows(k, new_bucket) for k in self._k]
+        self._v = [_grow_rows(v, new_bucket) for v in self._v]
+        self.bucket = new_bucket
+        _metrics.GEN_KV_MIGRATIONS_TOTAL.inc()
+        _metrics.GEN_KV_BUCKET_LEN.set(new_bucket)
+
+    def warmup_writes(self, prompt_buckets: Sequence[int]) -> int:
+        """Pre-compile every admission/migration executable: the
+        prompt-row write per (capacity bucket x prompt bucket) pair and
+        the grow pad per (bucket -> larger bucket) pair — so
+        steady-state traffic never compiles them."""
+        import jax
+        import jax.numpy as jnp
+        dev = jax.local_devices()[0]
+        n = 0
+        for i, L in enumerate(self.grid):
+            self.bucket = int(L)
+            self._alloc_buffers(self.bucket)
+            for Lp in prompt_buckets:
+                if Lp > L:
+                    continue
+                row = jax.device_put(
+                    jnp.zeros((int(Lp), self.n_heads, self.head_dim),
+                              self.dtype), dev)
+                # one write warms the executable for every layer (they
+                # share shapes); zeros into zeros is a no-op in content
+                self._k[0] = _write_row_jit(self._k[0], row,
+                                            _np.int32(0))
+                n += 1
+            for L2 in self.grid[i + 1:]:
+                # live migrations may leap buckets (a long-prompt
+                # admission), so warm every ordered pair
+                _grow_rows(self._k[0], int(L2))
+                n += 1
+        self.bucket = self.grid[0]
+        self._alloc_buffers(self.bucket)
+        return n
+
+    def reset_buffers(self) -> None:
+        """Reallocate the K/V buffers at the current bucket.  Needed
+        after a decode-step FAILURE: the step consumed the old buffers
+        by donation, so a raise after dispatch leaves ``_k``/``_v``
+        pointing at deleted arrays — without this, every later
+        admission would fail on them forever."""
+        self._alloc_buffers(self.bucket)
+
+    def reset_if_empty(self) -> None:
+        """Shrink back to the smallest bucket once no sequence is live
+        (only then: shrinking under live traffic would thrash)."""
+        if self.occupancy() == 0 and self.bucket != self.grid[0]:
+            self.bucket = self.grid[0]
+            self._alloc_buffers(self.bucket)
+            _metrics.GEN_KV_BUCKET_LEN.set(self.bucket)
+
+    def describe(self) -> dict:
+        return {
+            "max_slots": self.max_slots,
+            "bucket": self.bucket,
+            "buckets": list(self.grid),
+            "occupancy": self.occupancy(),
+            "layers": self.n_layers,
+            "heads": self.n_heads,
+            "head_dim": self.head_dim,
+            "dtype": str(self.dtype),
+        }
+
+
+# jitted helpers — one executable per (cache shape, prompt shape) pair,
+# all drawn from the bucket grid (warmable, bounded)
+
+def _grow_rows(buf: Any, new_len: int) -> Any:
+    import jax.numpy as jnp
+    pad = new_len - buf.shape[1]
+    return jnp.pad(buf, ((0, 0), (0, pad), (0, 0), (0, 0)))
+
+
+def _make_write_row():
+    import jax
+    from jax import lax
+
+    @jax.jit
+    def write(buf, row, slot):
+        # buf (S, L, h, d), row (Lp, h, d), slot scalar: place the
+        # prompt KV at [slot, 0:Lp] without materializing a copy chain
+        return lax.dynamic_update_slice(
+            buf, row[None].astype(buf.dtype),
+            (slot, _np.int32(0), _np.int32(0), _np.int32(0)))
+    return write
+
+
+class _LazyWrite:
+    """Defer the jax import to first use (the serving package must stay
+    importable without touching the backend)."""
+
+    def __init__(self) -> None:
+        self._fn = None
+
+    def __call__(self, buf, row, slot):
+        if self._fn is None:
+            self._fn = _make_write_row()
+        return self._fn(buf, row, slot)
+
+
+_write_row_jit = _LazyWrite()

@@ -35,7 +35,7 @@ import numpy as _np
 from ..base import MXNetError
 from .batching import BUCKET_COMPILES, BucketPolicy, INFER_SECONDS
 
-__all__ = ["ServedModel", "load_served"]
+__all__ = ["ServedModel", "DecodeModel", "load_served"]
 
 
 def _sig_str(shapes: Sequence[Tuple[int, ...]]) -> str:
@@ -211,6 +211,245 @@ class ServedModel:
                        for s, d in self.input_signature],
             "fixed_batch": self.fixed_batch,
             "buckets_compiled": sorted(_sig_str(s) for s in seen),
+        }
+
+
+# ---------------------------------------------------------------------------
+# DecodeModel — the stateful autoregressive path (continuous batching)
+# ---------------------------------------------------------------------------
+
+def _slot_block_step(p, x, ck, cv, pos, nh: int, ga):
+    """One decode token for EVERY slot: ``x`` (S, 1, C), caches
+    (S, L, nh, d), ``pos`` (S,) int32 — the per-slot-position variant
+    of ``model_zoo.generation._block_step`` (which shares one scalar
+    position across the batch; continuous batching cannot)."""
+    import math as _math
+    import jax
+    import jax.numpy as jnp
+
+    gelu_approx, eps = ga
+    S, _, C = x.shape
+    d = C // nh
+    L = ck.shape[1]
+    h = _pure_ln(x, p["ln1_g"], p["ln1_b"], eps)
+    qkv = h @ p["qkv_w"].T + p["qkv_b"]
+    q, k, v = jnp.split(qkv, 3, axis=-1)
+    qh = q.reshape(S, 1, nh, d)
+    rows = jnp.arange(S)
+    # per-slot scatter: slot i writes its k/v at ITS position pos[i]
+    ck = ck.at[rows, pos].set(k.reshape(S, nh, d))
+    cv = cv.at[rows, pos].set(v.reshape(S, nh, d))
+    scores = jnp.einsum("sqhd,skhd->shqk", qh, ck) / _math.sqrt(d)
+    # slot i sees cache positions 0..pos[i] (its prompt + its decoded
+    # tokens); pad garbage beyond pos[i] stays invisible until the loop
+    # overwrites it position by position
+    visible = jnp.arange(L)[None, :] <= pos[:, None]          # (S, L)
+    scores = jnp.where(visible[:, None, None, :], scores,
+                       jnp.float32(-jnp.inf).astype(scores.dtype))
+    probs = jax.nn.softmax(scores, axis=-1)
+    out = jnp.einsum("shqk,skhd->sqhd", probs, cv).reshape(S, 1, C)
+    x = x + (out @ p["out_w"].T + p["out_b"])
+    h = _pure_ln(x, p["ln2_g"], p["ln2_b"], eps)
+    ffn = jax.nn.gelu(h @ p["f1_w"].T + p["f1_b"],
+                      approximate=gelu_approx)
+    return x + (ffn @ p["f2_w"].T + p["f2_b"]), ck, cv
+
+
+def _pure_ln(x, g, b, eps):
+    import jax.numpy as jnp
+    from jax import lax
+    mean = jnp.mean(x, axis=-1, keepdims=True)
+    var = jnp.var(x, axis=-1, keepdims=True)
+    return (x - mean) * lax.rsqrt(var + eps) * g + b
+
+
+class DecodeModel:
+    """The decode-capable serving path: a stateful
+    ``(params, kv_cache, positions) -> next tokens`` step over slot
+    rows, compiled ONCE per KV capacity bucket, plus a per-prompt-bucket
+    prefill — the two programs the continuous-batching
+    :class:`~mxnet_tpu.serving.generation.GenerationEngine` runs
+    resident.
+
+    Built from a live :class:`~mxnet_tpu.gluon.model_zoo.gpt.GPTModel`
+    (the zoo's decoder-only family): parameters are extracted once into
+    a pure pytree (``model_zoo.generation._collect``) and the decode
+    math mirrors the zoo's KV-cache step, extended to per-slot
+    positions.  Compile accounting rides the SAME per-bucket counter as
+    the one-shot path (``mxnet_serving_bucket_compiles_total``, labels
+    ``decode:SxL`` / ``prefill:Lp``), so warmup moves every compile to
+    startup and the smoke gate can pin "0 after warmup".
+    """
+
+    def __init__(self, params: Any, num_heads: int, ga: Tuple[Any, Any],
+                 max_length: int, name: str) -> None:
+        import jax
+
+        self.params = params
+        self.num_heads = int(num_heads)
+        self.ga = (bool(ga[0]), float(ga[1]))
+        self.max_length = int(max_length)
+        self.name = name
+        self.vocab_size, self.units = params["embed"].shape
+        self.head_dim = self.units // self.num_heads
+        self.n_layers = len(params["blocks"])
+        self.dtype = params["blocks"][0]["qkv_w"].dtype
+        self._seen_lock = threading.Lock()
+        self._seen: set = set()
+        nh, ga_s = self.num_heads, self.ga
+
+        def _prefill(params, toks, t0):
+            # toks (Lp,) int32 (pad tokens after t0), t0 traced scalar;
+            # returns (last-real-token logits (V,), ks/vs lists of
+            # (Lp, nh, d)) — garbage pad KV past t0 is masked by the
+            # decode position mask until overwritten
+            from jax import lax
+            from ..gluon.model_zoo.generation import _block_prefill
+            Lp = toks.shape[0]
+            x = params["embed"][toks][None] + params["pos"][None, :Lp]
+            ks, vs = [], []
+            for p in params["blocks"]:
+                x, ck, cv = _block_prefill(p, x, nh, Lp, ga_s)
+                ks.append(ck[0])
+                vs.append(cv[0])
+            x = _pure_ln(x, params["lnf_g"], params["lnf_b"], ga_s[1])
+            h = lax.dynamic_slice_in_dim(x[0], t0 - 1, 1, axis=0)[0]
+            return h @ params["embed"].T, ks, vs
+
+        def _step(params, ks, vs, toks, pos):
+            # toks (S,) int32 last emitted per slot, pos (S,) int32
+            # write positions; free slots ride along with pos=0 and
+            # their outputs are ignored on the host
+            import jax.numpy as jnp
+            x = (params["embed"][toks][:, None, :]
+                 + params["pos"][pos][:, None, :])
+            new_ks, new_vs = [], []
+            for p, ck, cv in zip(params["blocks"], ks, vs):
+                x, ck, cv = _slot_block_step(p, x, ck, cv, pos, nh, ga_s)
+                new_ks.append(ck)
+                new_vs.append(cv)
+            x = _pure_ln(x, params["lnf_g"], params["lnf_b"], ga_s[1])
+            logits = x[:, 0, :] @ params["embed"].T
+            # greedy argmax ON DEVICE: the host reads back (S,) int32
+            # per iteration, not (S, V) logits
+            return jnp.argmax(logits, axis=-1).astype(jnp.int32), \
+                new_ks, new_vs
+
+        self._prefill_fn = jax.jit(_prefill)
+        # the KV buffers are DONATED: XLA updates the resident cache in
+        # place instead of allocating a fresh (S, L, h, d) per layer
+        # every token
+        self._step_fn = jax.jit(_step, donate_argnums=(1, 2))
+
+    # -- constructors -------------------------------------------------------
+    @staticmethod
+    def from_block(block: Any) -> "DecodeModel":
+        """Build from a live zoo ``GPTModel`` (weights as currently
+        initialized/loaded; MoE decode is not supported yet — same
+        restriction as ``model_zoo.generation``)."""
+        from ..gluon.model_zoo.generation import _collect
+        if not hasattr(block, "blocks") or not hasattr(block,
+                                                       "word_embed"):
+            raise MXNetError(
+                f"DecodeModel serves decoder-only zoo LMs (GPTModel); "
+                f"got {type(block).__name__}")
+        params = _collect(block)
+        ga = (params.pop("gelu_approx"), params.pop("ln_eps"))
+        nh = next(iter(block.blocks._children.values()))._num_heads
+        return DecodeModel(params, nh, ga, block._max_length,
+                           type(block).__name__)
+
+    # -- execution ----------------------------------------------------------
+    def _account(self, tag: str) -> None:
+        with self._seen_lock:
+            new = tag not in self._seen
+            if new:
+                self._seen.add(tag)
+        if new:
+            BUCKET_COMPILES.labels(bucket=tag).inc()
+
+    def prefill(self, tokens: _np.ndarray, bucket_len: int
+                ) -> Tuple[_np.ndarray, List[Any], List[Any]]:
+        """Run the prompt pass padded to ``bucket_len``; returns
+        (last-token logits (V,) numpy, per-layer ks/vs device arrays
+        (bucket_len, nh, d))."""
+        import jax.numpy as jnp
+        toks = _np.asarray(tokens, _np.int32).reshape(-1)
+        t0 = toks.shape[0]
+        if t0 < 1:
+            raise MXNetError("empty prompt")
+        if bucket_len < t0:
+            raise MXNetError(
+                f"prompt length {t0} exceeds its bucket {bucket_len}")
+        padded = _np.zeros((bucket_len,), _np.int32)
+        padded[:t0] = toks
+        self._account(f"prefill:{bucket_len}")
+        t = time.perf_counter()
+        logits, ks, vs = self._prefill_fn(
+            self.params, jnp.asarray(padded), _np.int32(t0))
+        out = _np.asarray(logits)
+        from .. import metrics as _metrics
+        _metrics.GEN_STEP_SECONDS.labels(phase="prefill").observe(
+            time.perf_counter() - t)
+        return out, ks, vs
+
+    def step(self, cache: Any, tokens: _np.ndarray,
+             positions: _np.ndarray) -> _np.ndarray:
+        """One resident decode iteration over every slot: consumes the
+        cache's buffers (donated), installs the updated ones, returns
+        the (S,) int32 greedy next-token vector."""
+        import jax.numpy as jnp
+        S = cache.max_slots
+        self._account(f"decode:{S}x{cache.bucket}")
+        t = time.perf_counter()
+        toks, new_ks, new_vs = self._step_fn(
+            self.params, cache._k, cache._v,
+            jnp.asarray(_np.asarray(tokens, _np.int32)),
+            jnp.asarray(_np.asarray(positions, _np.int32)))
+        cache.replace(new_ks, new_vs)
+        out = _np.asarray(toks)
+        from .. import metrics as _metrics
+        _metrics.GEN_STEP_SECONDS.labels(phase="decode").observe(
+            time.perf_counter() - t)
+        return out
+
+    def warmup(self, cache: Any, prompt_buckets: Sequence[int]) -> int:
+        """Pre-compile the full program grid: one prefill per prompt
+        bucket + one decode step per KV capacity bucket (run on the
+        cache's own buffer shapes).  After this, traffic confined to
+        the grids never compiles."""
+        n = 0
+        for pb in prompt_buckets:
+            self.prefill(_np.zeros((1,), _np.int32), int(pb))
+            n += 1
+        S = cache.max_slots
+        toks = _np.zeros((S,), _np.int32)
+        pos = _np.zeros((S,), _np.int32)
+        for b in cache.grid:
+            # walk the bucket grid directly (not via grow(): warmup
+            # must not count as live migrations)
+            cache.bucket = int(b)
+            cache._alloc_buffers(cache.bucket)
+            self.step(cache, toks, pos)
+            n += 1
+        # hand the cache back at rest on the smallest bucket
+        cache.bucket = cache.grid[0]
+        cache._alloc_buffers(cache.bucket)
+        return n
+
+    def describe(self) -> Dict[str, Any]:
+        with self._seen_lock:
+            seen = sorted(self._seen)
+        return {
+            "name": self.name,
+            "kind": "decode",
+            "vocab_size": int(self.vocab_size),
+            "units": int(self.units),
+            "layers": self.n_layers,
+            "heads": self.num_heads,
+            "max_length": self.max_length,
+            "dtype": str(self.dtype),
+            "programs_compiled": seen,
         }
 
 

@@ -23,7 +23,7 @@ from .batching import (BucketPolicy, DynamicBatcher, OverloadError,
                        REQUESTS_TOTAL, Request)
 from .model import ServedModel
 
-__all__ = ["ModelServer", "DegradedError"]
+__all__ = ["ModelServer", "GenerationServer", "DegradedError"]
 
 
 class DegradedError(MXNetError):
@@ -299,3 +299,117 @@ class ModelServer:
             "worker_alive": self.healthy(),
             "exec_cache": exec_cache_stats(),
         }
+
+
+class GenerationServer:
+    """Host a :class:`~mxnet_tpu.serving.generation.GenerationEngine`
+    on a worker thread — the continuous-batching sibling of
+    :class:`ModelServer`.
+
+    The same concurrency shape: ONE worker owns the device (it runs
+    the resident decode loop, one iteration at a time, each iteration
+    watchdog-armed inside the engine), while any number of producer
+    threads submit prompts and drain their
+    :class:`~mxnet_tpu.serving.generation.TokenStream`.  Unlike the
+    one-shot worker, this one never blocks per-request: it parks only
+    when NOTHING is queued or decoding, and a submit wakes it.
+
+    ::
+
+        server = GenerationServer(engine, warmup=True).start()
+        stream = server.generate(prompt_ids, max_new_tokens=64)
+        for tok in stream: ...
+        server.stop()
+    """
+
+    def __init__(self, engine: Any, warmup: bool = False) -> None:
+        self.engine = engine
+        self._thread: Optional[threading.Thread] = None
+        self._started = False
+        self._worker_died = False
+        self._stop = threading.Event()
+        if warmup:
+            engine.warmup()
+
+    # -- lifecycle ----------------------------------------------------------
+    def start(self) -> "GenerationServer":
+        if self._started:
+            return self
+        if self.engine.scheduler.closed:
+            raise MXNetError(
+                "GenerationServer cannot restart after stop(): build a "
+                "fresh engine")
+        self._started = True
+        self._thread = threading.Thread(target=self._run,
+                                        name="mxnet-generation-worker",
+                                        daemon=True)
+        self._thread.start()
+        return self
+
+    def stop(self, timeout: float = 10.0) -> None:
+        if not self._started:
+            return
+        self._stop.set()
+        # close the admission queue: sheds queued requests with a
+        # structured shutdown error and wakes a parked worker
+        self.engine.scheduler.close()
+        if self._thread is not None:
+            self._thread.join(timeout)
+        # whether the worker exited cleanly or not, no stream may be
+        # left to block forever
+        self.engine.close()
+        self._started = False
+
+    def healthy(self) -> bool:
+        return bool(self._started and not self._worker_died
+                    and self._thread is not None
+                    and self._thread.is_alive())
+
+    def __enter__(self) -> "GenerationServer":
+        return self.start()
+
+    def __exit__(self, *exc: Any) -> None:
+        self.stop()
+
+    # -- request API --------------------------------------------------------
+    def generate(self, tokens: Any, max_new_tokens: int = 64,
+                 eos_token: Optional[int] = None,
+                 deadline_ms: Optional[float] = None) -> Any:
+        """Submit one prompt; returns its ``TokenStream``.  Sheds with
+        ``OverloadError`` (queue full / no slot within deadline) and
+        refuses with :class:`DegradedError` when the decode worker is
+        dead — the same 429-vs-503 split as the one-shot path."""
+        if not self._started:
+            raise MXNetError("GenerationServer.start() first")
+        if not self.healthy():
+            raise DegradedError(
+                "generation worker thread has died; the server is "
+                "degraded (healthz reports 503) — restart it")
+        return self.engine.submit(tokens, max_new_tokens=max_new_tokens,
+                                  eos_token=eos_token,
+                                  deadline_ms=deadline_ms)
+
+    # -- worker -------------------------------------------------------------
+    def _run(self) -> None:
+        try:
+            while not self._stop.is_set():
+                if not self.engine.scheduler.wait_for_work(0.5):
+                    return               # closed and fully drained
+                self.engine.run_iteration()
+        except BaseException as e:   # noqa: BLE001 - worker death is a
+            # server-level event: mark degraded, unblock every waiter
+            self._worker_died = True
+            try:
+                self.engine.close()
+            except Exception:   # noqa: BLE001 - already dying
+                pass
+            import logging
+            logging.getLogger("mxnet_tpu.serving").error(
+                "generation worker thread died: %r — /healthz now "
+                "reports degraded (503); restart the server", e)
+
+    # -- introspection ------------------------------------------------------
+    def describe(self) -> Dict[str, Any]:
+        d = self.engine.describe()
+        d["worker_alive"] = self.healthy()
+        return d

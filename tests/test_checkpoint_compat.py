@@ -28,19 +28,37 @@ def _fresh_net():
 
 def test_golden_params_load_exact():
     """.params from r5 loads and reproduces the recorded forward output
-    bit-for-bit (f32 CPU math is deterministic).  The fixture was
-    recorded with per-op dispatch, so the forward pins
-    MXNET_BULK_MAX_OPS=1: fused bulked segments may FMA-contract and
-    differ in the last ulp (docs/performance.md numerics caveat) — that
-    is not the format drift this test exists to catch."""
+    to last-ulp tolerance.  The fixture was recorded with per-op
+    dispatch, so the forward pins MXNET_BULK_MAX_OPS=1 (fused bulked
+    segments may FMA-contract — docs/performance.md numerics caveat).
+
+    Tolerance rationale (r6): bit-equality additionally pinned the XLA
+    CPU backend's instruction selection, which drifts across rig/XLA
+    updates (observed: 1.2e-10 abs / 1.6e-5 rel on near-zero logits —
+    last-ulp FMA/reassociation differences in the dot kernels, failing
+    identically on the seed).  The FORMAT drift this test exists to
+    catch (key loss, dtype/shape change, de/serialization corruption)
+    shows up orders of magnitude larger or as a load error, so a tight
+    rtol keeps the guard without pinning codegen: params themselves
+    must still load EXACTLY (asserted bit-for-bit below)."""
     from mxnet_tpu import engine
+    from mxnet_tpu.ndarray_io import load_params
     net = _fresh_net()
-    net.load_parameters(os.path.join(FIX, "golden_r5.params"))
+    params_file = os.path.join(FIX, "golden_r5.params")
+    # format guard proper: the deserialized tensors are bit-exact and
+    # complete (this is what a serialization break would corrupt)
+    raw = load_params(params_file)
+    assert sorted(raw) == ["0.bias", "0.weight", "1.bias", "1.weight"]
+    assert all(a._data.dtype == onp.float32 for a in raw.values())
+    net.load_parameters(params_file)
+    for name, arr in raw.items():
+        got_p = dict(net.collect_params())[name].data().asnumpy()
+        onp.testing.assert_array_equal(got_p, arr.asnumpy())
     x = mx.np.array(onp.arange(8, dtype="float32").reshape(2, 4) / 10.0)
     with engine.bulk(1):
         got = net(x).asnumpy()
     want = onp.load(os.path.join(FIX, "golden_r5_output.npy"))
-    onp.testing.assert_array_equal(got, want)
+    onp.testing.assert_allclose(got, want, rtol=1e-4, atol=1e-8)
 
 
 def test_golden_export_symbol_json_loads():

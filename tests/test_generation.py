@@ -1,0 +1,346 @@
+"""Continuous-batching generation engine (mxnet_tpu/serving/generation.py):
+slot/bucket KV cache, greedy parity vs an uncompiled reference loop, the
+iteration-level scheduling invariant (mid-flight admission changes no
+resident sequence's tokens), EOS/max-token retirement, structured
+overload sheds, decode-fault blast radius, per-token HTTP streaming.
+
+ISSUE 6 specifies the cases; the invariant assertions run against the
+engine's per-iteration slot logs (`iteration_log`), not just final
+outputs.
+"""
+import json
+import socket
+import threading
+import time
+import urllib.error
+import urllib.request
+
+import numpy as onp
+import pytest
+
+import mxnet_tpu as mx
+from mxnet_tpu import faults, metrics, serving
+from mxnet_tpu.serving import (DecodeModel, GenerationEngine,
+                               GenerationServer, OverloadError,
+                               PagedKVCache)
+from mxnet_tpu.serving.kv_cache import round_up_bucket
+
+VOCAB = 97
+PROMPT_A = onp.array([5, 9, 3, 17], dtype="int32")
+PROMPT_B = onp.array([1, 2], dtype="int32")
+
+
+@pytest.fixture(scope="module")
+def gpt():
+    """Tiny decoder LM with a strong init: random-init GPTs collapse to
+    one token; Normal(1.0) gives varied, deterministic-greedy output so
+    positional bugs can't hide behind a constant sequence."""
+    from mxnet_tpu.gluon.model_zoo.gpt import GPTModel
+    mx.random.seed(0)
+    net = GPTModel(vocab_size=VOCAB, num_layers=2, units=32,
+                   hidden_size=48, num_heads=4, max_length=64,
+                   dropout=0.0)
+    net.initialize(mx.init.Normal(1.0))
+    net(mx.np.zeros((1, 4), dtype="int32"))
+    return net
+
+
+@pytest.fixture(scope="module")
+def decode_model(gpt):
+    return DecodeModel.from_block(gpt)
+
+
+def _reference_greedy(gpt, prompt, n):
+    """The uncompiled reference loop: a full forward over the whole
+    sequence per token, host argmax, append — no KV cache, none of the
+    engine's programs.  The sequence rides padded to one fixed length
+    (causal attention: positions past the real length cannot influence
+    the read position), so the reference itself stays one compiled
+    shape instead of one per length."""
+    PAD = 64
+    toks = [int(t) for t in prompt]
+    out = []
+    for _ in range(n):
+        padded = toks + [0] * (PAD - len(toks))
+        logits = gpt(mx.np.array(
+            onp.asarray([padded], "int32"))).asnumpy()
+        nxt = int(logits[0, len(toks) - 1].argmax())
+        out.append(nxt)
+        toks.append(nxt)
+    return out
+
+
+def _engine(decode_model, **kw):
+    kw.setdefault("max_slots", 2)
+    kw.setdefault("kv_buckets", (16, 32, 64))
+    kw.setdefault("max_tokens", 48)
+    eng = GenerationEngine(decode_model, **kw)
+    eng.warmup()
+    return eng
+
+
+def _drain(eng, *streams, max_iters=200):
+    it = 0
+    while not all(s.finished for s in streams) and it < max_iters:
+        eng.run_iteration()
+        it += 1
+    assert it < max_iters, "engine did not finish the sequences"
+
+
+# ---------------------------------------------------------------------------
+# KV cache
+# ---------------------------------------------------------------------------
+
+def test_kv_cache_slots_and_buckets():
+    c = PagedKVCache(n_layers=2, n_heads=2, head_dim=4, max_slots=3,
+                     buckets=(8, 16, 32))
+    assert c.bucket == 8 and c.free_slots() == [0, 1, 2]
+    s0, s1 = c.alloc(), c.alloc()
+    assert (s0, s1) == (0, 1) and c.occupancy() == 2
+    c.positions[s0], c.positions[s1] = 5, 7
+    assert c.needed_capacity() == 8
+    assert not c.ensure_capacity(8)          # fits the current bucket
+    assert c.ensure_capacity(9)              # 9 > 8 -> migrate to 16
+    assert c.bucket == 16
+    assert c.k(0).shape == (3, 16, 2, 4)
+    c.free(s0)
+    assert c.free_slots() == [0, 2]
+    c.free(s1)
+    c.reset_if_empty()
+    assert c.bucket == 8                     # shrinks only when empty
+    assert round_up_bucket(17, (8, 16, 32)) == 32
+    with pytest.raises(mx.MXNetError):
+        round_up_bucket(33, (8, 16, 32))
+    with pytest.raises(mx.MXNetError):
+        c.ensure_capacity(40)                # past the top bucket
+
+
+# ---------------------------------------------------------------------------
+# greedy parity (incl. a KV-bucket migration mid-decode)
+# ---------------------------------------------------------------------------
+
+def test_greedy_parity_vs_uncompiled_reference(gpt, decode_model):
+    eng = _engine(decode_model)
+    # 24 new tokens from a 4-token prompt crosses the 16-bucket: the
+    # parity window covers prefill, steady decode, AND a live cache
+    # migration
+    m0 = metrics.value("mxnet_gen_kv_migrations_total")
+    s = eng.submit(PROMPT_A, max_new_tokens=24)
+    _drain(eng, s)
+    got = s.result(timeout=10)
+    assert got == _reference_greedy(gpt, PROMPT_A, 24)
+    assert s.finish_reason == "length"
+    assert metrics.value("mxnet_gen_kv_migrations_total") == m0 + 1
+
+
+def test_decode_zero_compiles_after_warmup(gpt, decode_model):
+    eng = _engine(decode_model)
+    # one full traffic wave to settle anything first-use
+    _drain(eng, eng.submit(PROMPT_A, max_new_tokens=4))
+    c0 = metrics.value("mxnet_compile_misses_total")
+    streams = [eng.submit(p, max_new_tokens=6) for p in
+               (PROMPT_A, PROMPT_B, onp.arange(1, 8, dtype="int32"))]
+    _drain(eng, *streams)
+    assert all(len(s.result(timeout=10)) == 6 for s in streams)
+    assert metrics.value("mxnet_compile_misses_total") == c0, \
+        "steady-state decode recompiled"
+
+
+# ---------------------------------------------------------------------------
+# the continuous-batching invariant
+# ---------------------------------------------------------------------------
+
+def test_midflight_admission_changes_no_resident_tokens(gpt,
+                                                        decode_model):
+    want_a = _reference_greedy(gpt, PROMPT_A, 20)
+    want_b = _reference_greedy(gpt, PROMPT_B, 10)
+    eng = _engine(decode_model)
+    sa = eng.submit(PROMPT_A, max_new_tokens=20)
+    for _ in range(6):                       # A is mid-decode...
+        eng.run_iteration()
+    sb = eng.submit(PROMPT_B, max_new_tokens=10)   # ...when B arrives
+    _drain(eng, sa, sb)
+    # neither sequence's tokens moved for the other
+    assert sa.result(timeout=10) == want_a
+    assert sb.result(timeout=10) == want_b
+    # per-iteration slot logs prove B was admitted while A was decoding
+    # and the two then shared iterations
+    log = list(eng.iteration_log)
+    b_admit = next(l["iter"] for l in log[1:] if l["admitted"])
+    assert any(l["decoded"] for l in log if l["iter"] < b_admit), \
+        "A was not mid-decode at B's admission"
+    assert sum(1 for l in log if len(l["decoded"]) == 2) >= 5, \
+        "A and B never actually decoded in the same iterations"
+
+
+# ---------------------------------------------------------------------------
+# retirement
+# ---------------------------------------------------------------------------
+
+def test_eos_and_max_token_retirement_free_slots(gpt, decode_model):
+    base = _reference_greedy(gpt, PROMPT_A, 12)
+    assert len(set(base)) > 1, "degenerate fixture: constant sequence"
+    eos = base[3]
+    stop_at = base.index(eos)                # its FIRST occurrence
+    eng = _engine(decode_model, max_slots=1)
+    s = eng.submit(PROMPT_A, max_new_tokens=12, eos_token=eos)
+    _drain(eng, s)
+    got = s.result(timeout=10)
+    assert s.finish_reason == "eos"
+    assert got == base[:stop_at + 1]         # stops AT the eos token
+    # the slot frees at the next iteration's retire phase
+    eng.run_iteration()
+    assert eng.cache.free_slots() == [0]
+    s2 = eng.submit(PROMPT_B, max_new_tokens=3)
+    _drain(eng, s2)
+    assert s2.finish_reason == "length"      # max-token retirement
+    assert len(s2.result(timeout=10)) == 3
+    eng.run_iteration()
+    assert eng.cache.free_slots() == [0]
+    assert metrics.value("mxnet_gen_retirements_total",
+                         reason="eos") >= 1
+    assert metrics.value("mxnet_gen_retirements_total",
+                         reason="length") >= 1
+
+
+# ---------------------------------------------------------------------------
+# overload
+# ---------------------------------------------------------------------------
+
+def test_shed_paths_raise_structured_overload(decode_model):
+    eng = _engine(decode_model, max_slots=1, queue_limit=2)
+    # fill the slot and the bounded admission queue
+    s1 = eng.submit(PROMPT_A, max_new_tokens=40)
+    eng.run_iteration()                      # s1 occupies the slot
+    eng.submit(PROMPT_B, max_new_tokens=4)
+    eng.submit(PROMPT_B, max_new_tokens=4)
+    with pytest.raises(OverloadError) as ei:
+        eng.submit(PROMPT_B, max_new_tokens=4)
+    assert ei.value.reason == "queue_full"
+    j = ei.value.to_json()
+    assert j["error"] == "overloaded" and j["queue_depth"] >= 2 \
+        and "retry_after_ms" in j
+    # deadline shed: no slot frees within the request's deadline
+    eng2 = _engine(decode_model, max_slots=1, queue_limit=4)
+    sa = eng2.submit(PROMPT_A, max_new_tokens=40)
+    eng2.run_iteration()
+    sb = eng2.submit(PROMPT_B, max_new_tokens=4, deadline_ms=5.0)
+    time.sleep(0.02)                         # deadline passes queued
+    eng2.run_iteration()                     # admission boundary sheds
+    with pytest.raises(OverloadError) as ei2:
+        sb.result(timeout=5)
+    assert ei2.value.reason == "deadline"
+    assert not sa.finished                   # the resident one decodes on
+
+
+# ---------------------------------------------------------------------------
+# fault blast radius (PR-3 plan grammar at the serving.execute site)
+# ---------------------------------------------------------------------------
+
+def test_decode_fault_fails_only_affected_sequences(gpt, decode_model):
+    want_b = _reference_greedy(gpt, PROMPT_B, 5)
+    eng = _engine(decode_model, max_slots=1)
+    # site hit #1 is A's prefill, #2/#3 its first decode iterations;
+    # after=3:times=1 detonates ONE decode step while A holds the slot
+    with faults.fault_plan("serving.execute:after=3:times=1"):
+        sa = eng.submit(PROMPT_A, max_new_tokens=30)
+        sb = eng.submit(PROMPT_B, max_new_tokens=5)   # queued behind A
+        _drain(eng, sa, sb)
+    with pytest.raises(mx.MXNetError, match="injected"):
+        sa.result(timeout=5)
+    assert sa.finish_reason == "error"
+    # the queued sequence admitted after the blast and decoded clean
+    assert sb.result(timeout=10) == want_b
+    assert sb.finish_reason == "length"
+    # the engine survived: a fresh request still serves
+    s3 = eng.submit(PROMPT_A, max_new_tokens=3)
+    _drain(eng, s3)
+    assert len(s3.result(timeout=10)) == 3
+
+
+# ---------------------------------------------------------------------------
+# server thread + HTTP streaming
+# ---------------------------------------------------------------------------
+
+def test_generation_server_http_stream_and_errors(decode_model):
+    eng = _engine(decode_model, max_slots=2)
+    with GenerationServer(eng) as gs:
+        httpd = serving.make_http_server(None, port=0,
+                                         generation_server=gs)
+        th = threading.Thread(target=httpd.serve_forever, daemon=True)
+        th.start()
+        host, port = httpd.server_address
+        try:
+            # per-token streaming is OBSERVABLE: read the raw chunked
+            # wire and require at least one token line to arrive before
+            # the done trailer
+            body = json.dumps({"tokens": [int(t) for t in PROMPT_A],
+                               "max_new_tokens": 5}).encode()
+            with socket.create_connection((host, port),
+                                          timeout=30) as sk:
+                sk.sendall(
+                    b"POST /v1/generate HTTP/1.1\r\n"
+                    + f"Host: {host}\r\n".encode()
+                    + f"Content-Length: {len(body)}\r\n".encode()
+                    + b"Content-Type: application/json\r\n\r\n" + body)
+                raw = b""
+                sk.settimeout(30)
+                while b"\"done\": true" not in raw:
+                    chunk = sk.recv(4096)
+                    assert chunk, "connection closed before trailer"
+                    raw += chunk
+            head, _, payload = raw.partition(b"\r\n\r\n")
+            assert b"200" in head.split(b"\r\n", 1)[0]
+            assert b"chunked" in head.lower()
+            lines = [json.loads(l) for l in payload.decode()
+                     .replace("\r\n", "\n").split("\n")
+                     if l.strip().startswith("{")]
+            toks = [l["token"] for l in lines if "token" in l]
+            assert len(toks) == 5
+            assert lines[-1]["done"] and \
+                lines[-1]["finish_reason"] == "length"
+            # non-stream mode
+            req = urllib.request.Request(
+                f"http://{host}:{port}/v1/generate",
+                data=json.dumps({"tokens": [1, 2, 3],
+                                 "max_new_tokens": 4,
+                                 "stream": False}).encode())
+            with urllib.request.urlopen(req, timeout=30) as r:
+                out = json.loads(r.read())
+            assert len(out["tokens"]) == 4
+            assert out["finish_reason"] == "length"
+            # malformed -> 400; an over-long PROMPT (past the KV/
+            # position ceiling; max_new_tokens is merely clamped) -> 400
+            for bad in ({"tokens": []},
+                        {"tokens": [1] * 100, "max_new_tokens": 4}):
+                req = urllib.request.Request(
+                    f"http://{host}:{port}/v1/generate",
+                    data=json.dumps(bad).encode())
+                with pytest.raises(urllib.error.HTTPError) as he:
+                    urllib.request.urlopen(req, timeout=30)
+                assert he.value.code == 400
+            # healthz reports generation slots
+            with urllib.request.urlopen(
+                    f"http://{host}:{port}/healthz", timeout=10) as r:
+                h = json.loads(r.read())
+            assert h["status"] == "ok"
+            assert h["generation"]["slots"]["max"] == 2
+        finally:
+            httpd.shutdown()
+    # stopped server refuses with a structured state error
+    with pytest.raises(mx.MXNetError):
+        gs.generate([1, 2])
+
+
+def test_generation_server_shutdown_fails_inflight(decode_model):
+    eng = _engine(decode_model, max_slots=1)
+    gs = GenerationServer(eng).start()
+    s = gs.generate(PROMPT_A, max_new_tokens=40)
+    t0 = time.monotonic()
+    while s.tokens == [] and time.monotonic() - t0 < 10:
+        time.sleep(0.005)                    # admitted and decoding
+    gs.stop()
+    with pytest.raises(mx.MXNetError, match="shutdown|stopped"):
+        # drain whatever streamed, then observe the structured error
+        while s.next_token(timeout=5) is not None:
+            pass

@@ -10,14 +10,20 @@ bucket, and answers on a stdlib HTTP server:
     python tools/serve.py --zoo resnet18_v1 --input-shape 3,32,32
     python tools/serve.py model --port 8080 --max-batch 16 \
         --batch-timeout-ms 3 --queue-limit 512
+    python tools/serve.py --generate --zoo-gpt gpt2_124m   # decoder LM:
+        # continuous-batching /v1/generate with per-token streaming
 
     curl -s localhost:8080/v1/inference -d '{"instances": [[...]]}'
+    curl -sN localhost:8080/v1/generate \
+        -d '{"tokens": [464, 2068], "max_new_tokens": 32}'
     curl -s localhost:8080/metrics          # Prometheus text
     curl -s localhost:8080/healthz
 
-Knobs default from the MXNET_SERVING_* env tier (docs/serving.md).
-Static exports serve exactly their traced batch size; export with
-``dynamic_batch=True`` for the full bucket grid.
+Knobs default from the MXNET_SERVING_* env tier, plus MXNET_GEN_* for
+--generate (docs/serving.md).  Static exports serve exactly their
+traced batch size; export with ``dynamic_batch=True`` for the full
+bucket grid.  --generate serves a LIVE decoder LM (zoo GPT, optionally
+with --gpt-params weights) through the resident decode loop.
 """
 import argparse
 import os
@@ -55,6 +61,25 @@ def main(argv=None) -> None:
                     help="comma list of padded lengths for --pad-axis")
     ap.add_argument("--no-warmup", action="store_true",
                     help="skip pre-compiling the bucket grid at startup")
+    ap.add_argument("--generate", action="store_true",
+                    help="serve a decoder LM through the continuous-"
+                         "batching generation engine (POST /v1/generate "
+                         "with per-token streaming) instead of one-shot "
+                         "inference")
+    ap.add_argument("--zoo-gpt", default="gpt2_124m",
+                    help="GPT zoo spec for --generate (default "
+                         "gpt2_124m; 'tiny' builds a 2-layer demo LM "
+                         "that boots in seconds on CPU; weights are "
+                         "random unless --gpt-params is given)")
+    ap.add_argument("--gpt-params", default=None,
+                    help="a .params file to load into the --zoo-gpt "
+                         "model before serving")
+    ap.add_argument("--max-slots", type=int, default=None,
+                    help="decode slots for --generate "
+                         "(MXNET_GEN_MAX_SLOTS)")
+    ap.add_argument("--kv-buckets", default=None,
+                    help="comma list of KV capacity buckets for "
+                         "--generate (MXNET_GEN_KV_BUCKETS)")
     ap.add_argument("--platform", choices=("cpu", "ambient"),
                     default="ambient",
                     help="force the CPU backend, or keep the "
@@ -68,6 +93,9 @@ def main(argv=None) -> None:
         jax.config.update("jax_platforms", "cpu")
 
     from mxnet_tpu import serving
+
+    if args.generate:
+        return _serve_generate(args, serving)
 
     if args.zoo:
         import mxnet_tpu as mx
@@ -119,6 +147,56 @@ def main(argv=None) -> None:
     finally:
         httpd.shutdown()
         server.stop()
+
+
+def _serve_generate(args, serving) -> None:
+    """--generate mode: host a zoo GPT behind the continuous-batching
+    engine (resident decode loop, paged KV cache, token streaming)."""
+    import mxnet_tpu as mx
+    from mxnet_tpu.gluon.model_zoo.gpt import GPTModel, get_gpt
+
+    mx.random.seed(0)
+    if args.zoo_gpt == "tiny":       # CPU tire-kicking: boots fast
+        net = GPTModel(vocab_size=503, num_layers=2, units=64,
+                       hidden_size=128, num_heads=4, max_length=256,
+                       dropout=0.0)
+    else:
+        net = get_gpt(args.zoo_gpt, dropout=0.0)
+    net.initialize()
+    net(mx.np.zeros((1, 4), dtype="int32"))
+    if args.gpt_params:
+        net.load_parameters(args.gpt_params)
+        print(f"loaded weights: {args.gpt_params}")
+    else:
+        print("NOTE: serving RANDOM weights (pass --gpt-params for a "
+              "trained model)")
+
+    model = serving.DecodeModel.from_block(net)
+    kv = ([int(b) for b in args.kv_buckets.split(",")]
+          if args.kv_buckets else None)
+    engine = serving.GenerationEngine(model, max_slots=args.max_slots,
+                                      kv_buckets=kv,
+                                      queue_limit=args.queue_limit)
+    gs = serving.GenerationServer(engine, warmup=not args.no_warmup)
+    if engine.warmed:
+        print(f"warmup: {engine.warmed} programs pre-compiled "
+              f"(prefill buckets {list(engine.prompt_buckets)}, "
+              f"KV buckets {list(engine.grid)}, "
+              f"{engine.max_slots} slots)")
+    gs.start()
+    httpd = serving.make_http_server(None, args.host, args.port,
+                                     verbose=args.verbose,
+                                     generation_server=gs)
+    host, port = httpd.server_address[:2]
+    print(f"serving on http://{host}:{port}  (POST /v1/generate "
+          "[streaming], GET /metrics, /healthz, /v1/model)")
+    try:
+        httpd.serve_forever()
+    except KeyboardInterrupt:
+        pass
+    finally:
+        httpd.shutdown()
+        gs.stop()
 
 
 if __name__ == "__main__":

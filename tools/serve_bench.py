@@ -18,8 +18,17 @@ acceptance behaviors and prints a JSON report:
 ``--smoke`` shrinks the workload and turns the three behaviors into
 hard asserts — the ``ci/run.sh tier1`` serving gate.
 
+``--generate`` benches the CONTINUOUS-BATCHING generation engine
+instead (ISSUE 6): aggregate tokens/sec and TTFT for mixed-prompt
+traffic at N concurrent streaming clients vs the sequential
+one-shot-forward-per-token baseline, steady-state decode compile count,
+and a 2x-slot flood shed check.  ``--generate --smoke`` is the
+``ci/run.sh generation-smoke`` gate (>=2x tokens/sec, 0 decode
+recompiles after warmup, clean structured sheds).
+
     python tools/serve_bench.py              # full report (JSON)
     python tools/serve_bench.py --smoke      # CI gate, exit 1 on violation
+    python tools/serve_bench.py --generate [--smoke]
 """
 import argparse
 import json
@@ -220,10 +229,204 @@ def bench_overload(dim, hidden, queue_limit):
     }
 
 
+def _build_gpt(vocab=211, units=64, layers=2, heads=4, max_length=128):
+    import mxnet_tpu as mx
+    from mxnet_tpu.gluon.model_zoo.gpt import GPTModel
+
+    mx.random.seed(11)
+    net = GPTModel(vocab_size=vocab, num_layers=layers, units=units,
+                   hidden_size=2 * units, num_heads=heads,
+                   max_length=max_length, dropout=0.0)
+    # strong init: a default-init GPT collapses to one repeated token,
+    # which would let positional bugs hide behind a constant stream
+    net.initialize(mx.init.Normal(1.0))
+    net(mx.np.zeros((1, 4), dtype="int32"))
+    return net
+
+
+def bench_generation(n_clients: int, reqs: int, new_tokens: int,
+                     max_slots: int):
+    """ISSUE 6 acceptance: continuous batching must beat the
+    sequential one-shot-per-token baseline >=2x on aggregate
+    tokens/sec, decode steady state must not compile, and a 2x-slot
+    flood must shed cleanly.  Reports tokens/sec + TTFT."""
+    import numpy as onp
+    from mxnet_tpu import metrics, serving
+    from mxnet_tpu.serving import DecodeModel, GenerationEngine, \
+        GenerationServer, OverloadError
+    from mxnet_tpu.serving.kv_cache import round_up_bucket
+
+    net = _build_gpt()
+    dm = DecodeModel.from_block(net)
+    lengths = [4, 7, 12, 20, 27]            # mixed prompt-length traffic
+    rng = onp.random.RandomState(0)
+    prompts = [rng.randint(1, 200, (lengths[i % len(lengths)],))
+               .astype("int32") for i in range(max(n_clients * reqs, 8))]
+
+    # -- baseline: SEQUENTIAL one-shot generation — every token is a
+    # full forward over the growing sequence (prompt-bucket padded, so
+    # its compiles are bounded and warmed too), one request at a time
+    eng = GenerationEngine(dm, max_slots=max_slots,
+                           kv_buckets=(32, 64), max_tokens=new_tokens)
+    eng.warmup()
+    base_tokens = 0
+    n_base = max(2, n_clients // 4)
+    t0 = time.perf_counter()
+    for p in prompts[:n_base]:
+        seq = list(p)
+        for _ in range(new_tokens):
+            pb = round_up_bucket(len(seq), eng.prompt_buckets)
+            logits, _, _ = dm.prefill(
+                onp.asarray(seq, "int32"), pb)
+            seq.append(int(logits.argmax()))
+            base_tokens += 1
+    dt_base = time.perf_counter() - t0
+    base_tps = base_tokens / dt_base
+
+    # -- continuous batching: N concurrent streaming clients
+    server = GenerationServer(eng).start()
+    lock = threading.Lock()
+    stats = {"tokens": 0, "ok": 0, "shed": 0, "error": 0}
+    ttfts = []
+
+    def client(ci):
+        for r in range(reqs):
+            p = prompts[(ci * reqs + r) % len(prompts)]
+            t_sub = time.perf_counter()
+            first = True
+            try:
+                stream = server.generate(p, max_new_tokens=new_tokens)
+                n = 0
+                for _tok in stream:
+                    if first:
+                        first = False
+                        with lock:
+                            ttfts.append(time.perf_counter() - t_sub)
+                    n += 1
+                with lock:
+                    stats["tokens"] += n
+                    stats["ok"] += 1
+            except OverloadError:
+                with lock:
+                    stats["shed"] += 1
+            except Exception:   # noqa: BLE001 - counted, not fatal
+                with lock:
+                    stats["error"] += 1
+
+    compiles_before = metrics.value("mxnet_compile_misses_total")
+    threads = [threading.Thread(target=client, args=(i,))
+               for i in range(n_clients)]
+    t0 = time.perf_counter()
+    for t in threads:
+        t.start()
+    for t in threads:
+        t.join()
+    dt_eng = time.perf_counter() - t0
+    compiles_during = metrics.value("mxnet_compile_misses_total") \
+        - compiles_before
+    eng_tps = stats["tokens"] / dt_eng
+    # per-iteration slot logs: admissions must interleave with decodes
+    # of RESIDENT sequences, and iterations must batch multiple slots
+    log = list(eng.iteration_log)
+    midflight = sum(1 for l in log if l["admitted"] and l["decoded"])
+    multi = sum(1 for l in log if len(l["decoded"]) > 1)
+    ttfts.sort()
+
+    # -- overload: flood 2x the slot count against a tiny queue
+    flood_stats = {"ok": 0, "shed": 0, "error": 0}
+    eng.scheduler.queue_limit = max(1, max_slots // 2)
+    streams = []
+    for i in range(2 * max_slots + eng.scheduler.queue_limit):
+        try:
+            streams.append(server.generate(
+                prompts[i % len(prompts)], max_new_tokens=new_tokens))
+        except OverloadError:
+            flood_stats["shed"] += 1
+    for s in streams:
+        try:
+            s.result(timeout=120.0)
+            flood_stats["ok"] += 1
+        except OverloadError:
+            flood_stats["shed"] += 1
+        except Exception:   # noqa: BLE001 - counted below
+            flood_stats["error"] += 1
+    alive = server.healthy()
+    server.stop()
+
+    def pct(q):
+        return round(ttfts[min(len(ttfts) - 1,
+                               int(q * len(ttfts)))] * 1e3, 1) \
+            if ttfts else None
+
+    return {
+        "sequential_oneshot_tokens_per_s": round(base_tps, 1),
+        "engine_tokens_per_s": round(eng_tps, 1),
+        "speedup": round(eng_tps / base_tps, 2),
+        "clients": n_clients,
+        "requests_ok": stats["ok"], "shed": stats["shed"],
+        "errors": stats["error"],
+        "new_tokens_per_request": new_tokens,
+        "prompt_lengths": lengths,
+        "ttft_ms_p50": pct(0.50), "ttft_ms_p95": pct(0.95),
+        "decode_compiles_after_warmup": compiles_during,
+        "iters_with_midflight_admission": midflight,
+        "iters_decoding_multiple_slots": multi,
+        "warmed_programs": eng.warmed,
+        "flood": flood_stats,
+        "alive_after_flood": alive,
+    }
+
+
+def run_generate(args) -> int:
+    rep = bench_generation(args.clients,
+                           args.requests or (3 if args.smoke else 6),
+                           new_tokens=16 if args.smoke else 32,
+                           max_slots=8)
+    print(json.dumps({"generation": rep}, indent=1))
+    if not args.smoke:
+        return 0
+    failures = []
+    if rep["speedup"] < 2.0:
+        failures.append(
+            f"continuous batching {rep['speedup']}x < 2x the "
+            "sequential one-shot-per-token baseline")
+    if rep["decode_compiles_after_warmup"] > 0:
+        failures.append(
+            f"{rep['decode_compiles_after_warmup']} XLA compiles "
+            "during steady-state decode (grid not warm?)")
+    if rep["shed"] or rep["errors"]:
+        failures.append("sheds/errors at nominal load")
+    if rep["iters_with_midflight_admission"] < 1:
+        failures.append("no mid-flight admission observed in the "
+                        "iteration slot logs")
+    if rep["iters_decoding_multiple_slots"] < 1:
+        failures.append("no iteration decoded multiple slots")
+    if rep["flood"]["shed"] == 0:
+        failures.append("2x-slot flood shed nothing")
+    if rep["flood"]["error"]:
+        failures.append(f"{rep['flood']['error']} hard errors in the "
+                        "flood (sheds must be structured)")
+    if not rep["alive_after_flood"]:
+        failures.append("engine worker died under flood")
+    if failures:
+        print("GENERATION SMOKE FAILED:", "; ".join(failures),
+              file=sys.stderr)
+        return 1
+    print("generation smoke OK: continuous batching "
+          f"{rep['speedup']}x sequential, 0 steady-state compiles, "
+          "flood sheds cleanly")
+    return 0
+
+
 def main(argv=None) -> int:
     ap = argparse.ArgumentParser(description=__doc__.splitlines()[0])
     ap.add_argument("--smoke", action="store_true",
                     help="small sizes + hard asserts (the CI gate)")
+    ap.add_argument("--generate", action="store_true",
+                    help="bench the continuous-batching generation "
+                         "engine (tokens/sec + TTFT vs the sequential "
+                         "one-shot-per-token baseline) instead of the "
+                         "one-shot phases")
     ap.add_argument("--clients", type=int, default=8)
     ap.add_argument("--requests", type=int, default=None,
                     help="per client (default 40; 12 under --smoke)")
@@ -239,6 +442,8 @@ def main(argv=None) -> int:
     if args.platform == "cpu":
         import jax
         jax.config.update("jax_platforms", "cpu")
+    if args.generate:
+        return run_generate(args)
     reqs = args.requests or (12 if args.smoke else 40)
 
     report = {"throughput": bench_throughput(
