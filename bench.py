@@ -51,6 +51,12 @@ ROUND_BASELINES = {
     "gpt2_124m_lm_bfloat16_b8x1024_train": 104679.8,
     "lstm_ptb_bfloat16_b128x35_train": 433096.2,
     "vit_b16_bfloat16_b128x224_train_throughput": 865.2,
+    # generation-serving baselines (r7 on this rig, 2026-08-03):
+    # serve_bench --generate at 8 clients (tiny CPU GPT). NOISY on the
+    # shared-CPU rig (~±40% run-to-run); treat vs_baseline as a trend
+    # indicator, not a gate. TTFT: vs_baseline < 1.0 is an improvement.
+    "gen_serving_tokens_per_s": 1599.1,
+    "gen_serving_ttft_ms_p50": 18.2,
 }
 
 
@@ -80,6 +86,36 @@ def _step_breakdown(mark, dt, steps):
             "dispatch_s": round(disp, 6),
             "sync_s": round(max(per - data - disp, 0.0), 6),
             "step_s": round(per, 6)}
+
+
+def bench_gen_serving() -> None:
+    """Config 7 (ISSUE 7 satellite): continuous-batching generation
+    SERVING throughput + TTFT — serve_bench --generate's numbers as
+    round-JSON metric lines, so serving regressions trend against a
+    recorded baseline instead of being write-only."""
+    sys.path.insert(0, os.path.join(os.path.dirname(
+        os.path.abspath(__file__)), "tools"))
+    import serve_bench
+    rep = serve_bench.bench_generation(n_clients=8, reqs=2,
+                                       new_tokens=24, max_slots=8)
+    tps = float(rep["engine_tokens_per_s"])
+    ttft = rep["ttft_ms_p50"]
+    print(json.dumps({
+        "metric": "gen_serving_tokens_per_s",
+        "value": round(tps, 1), "unit": "tokens/sec",
+        "vs_baseline": _vs_baseline("gen_serving_tokens_per_s", tps),
+        "speedup_vs_oneshot": rep["speedup"],
+        "clients": rep["clients"],
+    }), flush=True)
+    if ttft is not None:
+        # latency: vs_baseline < 1.0 is an IMPROVEMENT for this metric
+        print(json.dumps({
+            "metric": "gen_serving_ttft_ms_p50",
+            "value": float(ttft), "unit": "ms",
+            "vs_baseline": _vs_baseline("gen_serving_ttft_ms_p50",
+                                        float(ttft)),
+            "ttft_ms_p95": rep["ttft_ms_p95"],
+        }), flush=True)
 
 
 def bench_bert(batch: int, steps: int, dtype: str, seq_len: int) -> None:
@@ -759,20 +795,22 @@ def run_all_configs() -> None:
     models, so no config inherits the previous one's memory pressure."""
     import subprocess
     failures = []
-    for model in ["bert", "gpt", "lstm", "vit", "resnet50_v1"]:
+    for model in ["bert", "gpt", "lstm", "vit", "gen", "resnet50_v1"]:
         env = dict(os.environ, MXNET_BENCH_MODEL=model)
         proc = subprocess.run([sys.executable, os.path.abspath(__file__)],
                               env=env, capture_output=True, text=True)
-        line = ""
-        for ln in proc.stdout.splitlines():
-            if ln.startswith("{"):
-                line = ln
-        if proc.returncode != 0 or not line:
+        # a config may emit SEVERAL metric lines (gen: tokens/sec +
+        # TTFT); forward each, in order — resnet50 stays the last
+        # config, so the driver's last-line parse is unchanged
+        lines = [ln for ln in proc.stdout.splitlines()
+                 if ln.startswith('{"metric"')]
+        if proc.returncode != 0 or not lines:
             failures.append(model)
             sys.stderr.write(f"[bench] {model} FAILED rc={proc.returncode}\n"
                              f"{proc.stderr[-2000:]}\n")
             continue
-        print(line, flush=True)
+        for line in lines:
+            print(line, flush=True)
     if failures:
         raise SystemExit(f"bench configs failed: {failures}")
 
@@ -793,6 +831,8 @@ def main() -> None:
 
     if model_name == "bulk_smoke":
         return bench_bulk_smoke()
+    if model_name == "gen":
+        return bench_gen_serving()
     eager = os.environ.get("MXNET_BENCH_EAGER", "0") == "1"
     if eager and model_name.startswith("lstm"):
         if "MXNET_BENCH_BATCH" not in os.environ:

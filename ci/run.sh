@@ -19,6 +19,13 @@
 #                           #   one-shot-per-token, 0 decode compiles
 #                           #   after warmup, clean shed under a
 #                           #   2x-slot flood
+#   ci/run.sh resilience-smoke # serving resilience gate: seeded
+#                           #   worker-kill mid-stream -> every stream
+#                           #   completes token-identical to the
+#                           #   fault-free run on the raw wire;
+#                           #   SIGTERM under 8-client load -> clean
+#                           #   drain (429 sheds, readiness 503 /
+#                           #   liveness 200, exit 0)
 #   ci/run.sh chaos-smoke   # bounded fault-injection/preemption proof
 #                           #   (tests/test_faults.py -k smoke)
 #   ci/run.sh health-smoke  # training health guard acceptance: seeded
@@ -105,6 +112,14 @@ print(f"faultdoc: all {len(faults.known_sites())} sites documented")
 EOF
 }
 
+run_resilience_smoke() {
+  echo "== resilience-smoke: worker-kill mid-stream recovers token-"
+  echo "   identical (exactly-once on the chunked wire); SIGTERM under"
+  echo "   8-client load drains clean (429 sheds, ready 503/live 200,"
+  echo "   exit 0)"
+  JAX_PLATFORMS=cpu timeout 600 python tools/resilience_smoke.py
+}
+
 run_chaos_smoke() {
   echo "== chaos-smoke: bounded (~60s) fault-injection / preemption /"
   echo "   checkpoint-fallback / kvstore-timeout proof"
@@ -144,12 +159,13 @@ run_chaos() {
 
 run_tier1() {
   echo "== tier1: env-doc freshness + fault-site doc lint + serving"
-  echo "   smoke + generation smoke + chaos smoke + health smoke +"
-  echo "   bulking smoke + the tier-1 pytest selection"
+  echo "   smoke + generation smoke + resilience smoke + chaos smoke +"
+  echo "   health smoke + bulking smoke + the tier-1 pytest selection"
   run_envdoc
   run_faultdoc
   run_serving_smoke
   run_generation_smoke
+  run_resilience_smoke
   run_chaos_smoke
   run_health_smoke
   run_bulk_smoke
@@ -244,6 +260,7 @@ case "$variant" in
   faultdoc)     run_faultdoc ;;
   serving-smoke) run_serving_smoke ;;
   generation-smoke) run_generation_smoke ;;
+  resilience-smoke) run_resilience_smoke ;;
   chaos-smoke)  run_chaos_smoke ;;
   health-smoke) run_health_smoke ;;
   chaos)        run_chaos ;;

@@ -19,6 +19,9 @@ documented in docs/fault_tolerance.md):
 * ``kvstore.recv``      — dist_async client, before a reply is read
 * ``dataloader.worker`` — inside a DataLoader worker, per batch job
 * ``serving.execute``   — ModelServer worker, per assembled batch
+* ``serving.worker``    — the serving worker loop itself (worker-death
+  chaos: an error here kills the worker thread, exercising the replica
+  supervisor's requeue/recover/restart/breaker path)
 * ``dispatch.op``       — the imperative op dispatch path, per op
 * ``trainer.step``      — the optimizer-step boundary, per step (the
   tensor-corrupting site: ``kind=nan`` plants a NaN via
@@ -122,6 +125,13 @@ _SITES: Dict[str, str] = {
     "serving.execute":
         "ModelServer worker thread, per assembled batch, before the "
         "model executes",
+    "serving.worker":
+        "the serving worker loop itself (ModelServer per dequeued "
+        "batch, GenerationServer per decode-loop pass), OUTSIDE the "
+        "per-request error handling — an injected error here kills the "
+        "worker thread, the in-process worker-death analog the replica "
+        "supervisor trains against (requeue/recover + restart + "
+        "circuit breaker)",
     "dispatch.op":
         "the imperative op dispatch path (ndarray.register.invoke), "
         "per op call",

@@ -679,6 +679,37 @@ GEN_KV_MIGRATIONS_TOTAL = counter(
     "power-of-two length bucket; each switches the engine to that "
     "bucket's pre-compiled decode step).")
 
+# -- serving resilience (serving/server.py + serving/replica.py) ------------
+SERVING_RECOVERIES_TOTAL = counter(
+    "mxnet_serving_recoveries_total",
+    "Generation sequences resurrected after a fault, by recovery site: "
+    "'decode' (a decode-step fault — the sequence re-prefills "
+    "prompt+emitted on a healthy replica and resumes), 'worker' (a "
+    "slot-resident sequence evacuated from a dead worker replica), "
+    "'queue' (a not-yet-admitted request requeued from a dead "
+    "replica's admission queue).", labels=("site",))
+SERVING_RECOVERED_TOKENS = counter(
+    "mxnet_serving_recovered_tokens_total",
+    "Tokens already emitted by sequences at the moment they were "
+    "resurrected (the re-prefill work recovery pays; the TokenStream "
+    "index dedupe guarantees clients never see them twice).")
+SERVING_RECOVERY_SECONDS = histogram(
+    "mxnet_serving_recovery_seconds",
+    "Per-sequence recovery latency: fault observed to the resurrected "
+    "sequence's next streamed token (re-queue wait + re-prefill).",
+    buckets=exponential_buckets(0.001, 2.0, 14))
+SERVING_STREAM_DUPES_DROPPED = counter(
+    "mxnet_serving_stream_dupes_dropped_total",
+    "Duplicate tokens dropped at the TokenStream index boundary (a "
+    "recovered producer re-emitted an index the consumer already has). "
+    "Nonzero means the dedupe guard did real work; clients still see "
+    "each index exactly once.")
+SERVING_DRAINING = gauge(
+    "mxnet_serving_draining",
+    "1 while the serving process is draining (SIGTERM received: "
+    "admissions shed with 429, resident work finishing, readiness "
+    "503 / liveness 200).")
+
 
 def record_step(total: float, data: float = 0.0, dispatch: float = 0.0,
                 sync: Optional[float] = None, count: int = 1) -> None:

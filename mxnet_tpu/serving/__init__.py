@@ -42,22 +42,38 @@ in-process, with no dependencies beyond the stdlib:
   per-token streaming (:class:`TokenStream`), hosted by
   :class:`~mxnet_tpu.serving.server.GenerationServer`.
 
+* :mod:`~mxnet_tpu.serving.replica` + the server-side resilience layer
+  (ISSUE 7): both servers host ``MXNET_SERVING_REPLICAS`` worker
+  replicas behind a router — a dead worker's requests requeue (and
+  in-flight generation streams resume **token-identical**, exactly-once
+  at the :class:`TokenStream` index boundary) on the survivors while a
+  :class:`~mxnet_tpu.serving.replica.ReplicaSupervisor` restarts it
+  with jittered backoff behind a circuit breaker (explicit
+  :class:`DegradedError` degraded mode past the budget); SIGTERM
+  drains gracefully (:func:`serve_until_preempted`: 429 sheds,
+  readiness 503 / liveness 200, bounded by
+  ``MXNET_SERVING_DRAIN_DEADLINE_S``, exit 0).
+
 Every stage publishes to :mod:`mxnet_tpu.metrics` (queue-depth gauge,
 batch-size / queue-wait / inference-latency histograms, shed counter by
-reason, per-bucket compile counter) — ``metrics_dump.py``-style
-observability works out of the box.
+reason, per-bucket compile counter, recovery/restart/breaker/drain
+families) — ``metrics_dump.py``-style observability works out of the
+box.
 """
 from .batching import (BucketPolicy, DynamicBatcher, OverloadError,
                        Request, SlotScheduler)
 from .model import DecodeModel, ServedModel, load_served
 from .kv_cache import PagedKVCache
-from .generation import GenerationEngine, TokenStream
-from .server import GenerationServer, ModelServer
+from .generation import GenerationEngine, StreamTimeout, TokenStream
+from .replica import ReplicaSupervisor
+from .server import (DegradedError, GenerationServer, ModelServer,
+                     serve_until_preempted)
 from .http import make_http_server
 
 __all__ = [
     "BucketPolicy", "DynamicBatcher", "OverloadError", "Request",
     "SlotScheduler", "ServedModel", "DecodeModel", "PagedKVCache",
-    "GenerationEngine", "TokenStream", "GenerationServer", "load_served",
-    "ModelServer", "make_http_server",
+    "GenerationEngine", "StreamTimeout", "TokenStream",
+    "GenerationServer", "load_served", "ModelServer", "DegradedError",
+    "ReplicaSupervisor", "make_http_server", "serve_until_preempted",
 ]

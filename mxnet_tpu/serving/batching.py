@@ -51,9 +51,13 @@ class OverloadError(MXNetError):
     """A request was shed by the serving layer (NOT a server fault).
 
     ``reason`` is ``"queue_full"`` (shed at submit: the bounded queue is
-    at ``MXNET_SERVING_QUEUE_LIMIT``) or ``"deadline"`` (shed at dequeue:
-    the request's deadline passed while it waited).  ``retry_after_ms``
-    is a backoff hint derived from the current queue depth.
+    at ``MXNET_SERVING_QUEUE_LIMIT``), ``"deadline"`` (shed at dequeue:
+    the request's deadline passed while it waited), ``"draining"``
+    (shed at submit: the process received SIGTERM and is finishing
+    resident work before exiting — retry against another replica), or
+    ``"restarting"`` (every worker replica is mid-restart; retry after
+    the backoff).  ``retry_after_ms`` is a backoff hint derived from
+    the current queue depth.
     """
 
     def __init__(self, reason: str, queue_depth: int = 0,
@@ -265,10 +269,53 @@ class DynamicBatcher:
         self._lock = threading.Lock()
         self._nonempty = threading.Condition(self._lock)
         self._closed = False
+        self._draining = False
 
     def __len__(self) -> int:
         with self._lock:
             return len(self._q)
+
+    @property
+    def draining(self) -> bool:
+        return self._draining
+
+    def start_drain(self) -> None:
+        """Stop ADMITTING: new submits shed with a structured
+        ``OverloadError(reason="draining")`` while already-queued
+        requests keep flowing to the workers (graceful drain)."""
+        with self._lock:
+            self._draining = True
+
+    def requeue(self, reqs: Sequence[Request]) -> None:
+        """Front-insert requests a dying worker abandoned mid-batch.
+        No queue_full shed — they were already accepted — and completed
+        futures are skipped (a partially-distributed batch re-executes
+        only its unresolved requests: the future is the exactly-once
+        boundary)."""
+        live = [r for r in reqs if not r.future.done()]
+        if not live:
+            return
+        with self._lock:
+            if self._closed:
+                for r in live:
+                    try:
+                        r.future.set_exception(MXNetError(
+                            "serving batcher closed with the request "
+                            "still queued"))
+                    except Exception:   # noqa: BLE001 - done() race
+                        continue
+                    REQUESTS_TOTAL.labels(status="error").inc()
+                return
+            self._q[:0] = live
+            QUEUE_DEPTH.set(len(self._q))
+            self._nonempty.notify_all()
+
+    def reopen(self) -> None:
+        """Clear the closed/draining flags (the manual breaker-reset
+        path re-admits traffic through the same batcher)."""
+        with self._lock:
+            self._closed = False
+            self._draining = False
 
     def submit(self, req: Request) -> None:
         """Enqueue or shed-immediately (OverloadError set on the future
@@ -276,6 +323,19 @@ class DynamicBatcher:
         with self._lock:
             if self._closed:
                 raise MXNetError("serving batcher is closed")
+            if self._draining:
+                err = OverloadError("draining", queue_depth=len(self._q),
+                                    retry_after_ms=1e3)
+                SHED_TOTAL.labels(reason="draining").inc()
+                REQUESTS_TOTAL.labels(status="shed").inc()
+                req.future.set_exception(err)
+                raise err
+            if len(self._q) >= self.queue_limit:
+                # abandoned requests (future already cancelled/done)
+                # must not hold queue_full sheds high: purge before
+                # deciding to shed the live newcomer
+                self._q[:] = [r for r in self._q if not r.future.done()]
+                QUEUE_DEPTH.set(len(self._q))
             if len(self._q) >= self.queue_limit:
                 depth = len(self._q)
                 err = OverloadError(
@@ -290,15 +350,19 @@ class DynamicBatcher:
             QUEUE_DEPTH.set(len(self._q))
             self._nonempty.notify()
 
-    def close(self) -> None:
-        """Stop accepting work and wake the consumer; queued requests
-        fail with a server-stopped error."""
+    def close(self, error: Optional[Exception] = None) -> None:
+        """Stop accepting work and wake the consumers; queued requests
+        fail with a server-stopped error (or ``error`` — the breaker
+        trip passes its structured degradation error through)."""
+        exc = error if error is not None else MXNetError(
+            "serving batcher closed with the request still queued")
         with self._lock:
             self._closed = True
             for r in self._q:
-                r.future.set_exception(
-                    MXNetError("serving batcher closed with the request "
-                               "still queued"))
+                try:
+                    r.future.set_exception(exc)
+                except Exception:   # noqa: BLE001 - done() race
+                    continue
                 REQUESTS_TOTAL.labels(status="error").inc()
             self._q.clear()
             QUEUE_DEPTH.set(0)
@@ -325,10 +389,15 @@ class DynamicBatcher:
         self._q[:] = keep
         QUEUE_DEPTH.set(len(self._q))
 
-    def next_batch(self) -> Optional[List[Request]]:
+    def next_batch(self, on_take: Optional[Callable[[List[Request]],
+                                                    Any]] = None
+                   ) -> Optional[List[Request]]:
         """Block until a batch is ready (bucket full, or the oldest
         request aged past the batching window); None once closed and
-        drained.  Called by the server's single worker thread."""
+        drained.  Called by the server's worker threads.  ``on_take``
+        runs UNDER the queue lock on the taken batch, so the caller's
+        in-flight bookkeeping has no queued-nor-inflight gap for a
+        drain poll to mistake for idleness."""
         with self._lock:
             while True:
                 self._shed_expired(time.monotonic())
@@ -359,6 +428,8 @@ class DynamicBatcher:
                         for r in take:
                             QUEUE_WAIT_SECONDS.observe(now - r.enqueue_t)
                         BATCH_SIZE.observe(len(take))
+                        if on_take is not None:
+                            on_take(take)
                         return take
                     self._nonempty.wait(self.timeout_s - age)
                     continue
@@ -405,6 +476,9 @@ class SlotScheduler:
         self.queue_limit = int(queue_limit)
         self._q: List[Any] = []
         self._active: Dict[int, Any] = {}       # slot -> request
+        # popped for admission but not yet slot-resident (prefill in
+        # flight): counted so a drain poll never sees a false idle
+        self._mid_admission = 0
         self._lock = threading.Lock()
         self._work = threading.Condition(self._lock)
         self._closed = False
@@ -414,14 +488,23 @@ class SlotScheduler:
         with self._lock:
             return len(self._q)
 
-    def submit(self, req: Any) -> None:
+    def submit(self, req: Any, front: bool = False,
+               force: bool = False) -> None:
         """Enqueue for admission, or shed immediately (OverloadError
         failed onto the request AND raised, mirroring
-        :meth:`DynamicBatcher.submit`)."""
+        :meth:`DynamicBatcher.submit`).  ``force`` bypasses the
+        queue_full shed and ``front`` queue-jumps — the recovery path:
+        a resurrected sequence was already accepted and already waited
+        its turn once."""
         with self._lock:
             if self._closed:
                 raise MXNetError("generation scheduler is closed")
-            if len(self._q) >= self.queue_limit:
+            if not force and len(self._q) >= self.queue_limit:
+                # abandoned (cancelled-while-queued) entries must not
+                # hold queue_full sheds high
+                self._q[:] = [r for r in self._q
+                              if not r.is_cancelled()]
+            if not force and len(self._q) >= self.queue_limit:
                 depth = len(self._q)
                 err = OverloadError("queue_full", queue_depth=depth,
                                     retry_after_ms=100.0 * max(1, depth))
@@ -429,9 +512,36 @@ class SlotScheduler:
                 REQUESTS_TOTAL.labels(status="shed").inc()
                 req.fail(err)
                 raise err
-            self._q.append(req)
+            if front:
+                self._q.insert(0, req)
+            else:
+                self._q.append(req)
             _metrics.GEN_QUEUE_DEPTH.set(len(self._q))
             self._work.notify_all()
+
+    def discard(self, req: Any) -> bool:
+        """Evict a still-queued request NOW (consumer cancelled): the
+        queue budget frees immediately instead of at the next admission
+        pass.  Returns True when the request was found queued."""
+        with self._lock:
+            try:
+                self._q.remove(req)
+            except ValueError:
+                return False
+            _metrics.GEN_QUEUE_DEPTH.set(len(self._q))
+        REQUESTS_TOTAL.labels(status="cancelled").inc()
+        return True
+
+    def drain_queue(self) -> List[Any]:
+        """Pop every queued request WITHOUT failing it (worker-death
+        evacuation: the supervisor requeues them elsewhere).  Also
+        clears the mid-admission count — the engine hands those
+        requests over separately."""
+        with self._lock:
+            out, self._q[:] = list(self._q), []
+            self._mid_admission = 0
+            _metrics.GEN_QUEUE_DEPTH.set(0)
+            return out
 
     def pop_admissions(self, free_slots: int,
                        now: Optional[float] = None) -> List[Any]:
@@ -460,8 +570,22 @@ class SlotScheduler:
                 else:
                     keep.append(r)
             self._q[:] = keep
+            self._mid_admission += len(out)
             _metrics.GEN_QUEUE_DEPTH.set(len(self._q))
         return out
+
+    def admission_done(self) -> None:
+        """One popped request landed (activated or failed): it is no
+        longer mid-admission."""
+        with self._lock:
+            self._mid_admission = max(0, self._mid_admission - 1)
+
+    def busy(self) -> bool:
+        """Anything queued, slot-resident, or mid-admission — the
+        drain-idleness check (a request being prefilled is in neither
+        queue nor slot table, but it is NOT done)."""
+        with self._lock:
+            return bool(self._q or self._active or self._mid_admission)
 
     # -- decode slot table --------------------------------------------------
     def activate(self, slot: int, req: Any) -> None:

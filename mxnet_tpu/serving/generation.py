@@ -55,7 +55,8 @@ from .batching import REQUESTS_TOTAL, SlotScheduler
 from .kv_cache import PagedKVCache, round_up_bucket
 from .model import DecodeModel
 
-__all__ = ["GenerationEngine", "GenRequest", "TokenStream"]
+__all__ = ["GenerationEngine", "GenRequest", "StreamTimeout",
+           "TokenStream", "make_recovery_request"]
 
 register_env("MXNET_GEN_MAX_SLOTS", 8,
              "Decode slots in the generation engine: the number of "
@@ -74,6 +75,12 @@ register_env("MXNET_GEN_STREAM", 1,
              "completion. Per-request 'stream' overrides.")
 
 
+class StreamTimeout(MXNetError):
+    """``TokenStream.next_token`` gave up waiting (NOT a request
+    failure: the sequence may still produce — the HTTP layer uses short
+    timeouts to poll for client disconnects while queued)."""
+
+
 class TokenStream:
     """Per-request token channel: the engine produces, exactly one
     consumer (HTTP handler or in-process caller) drains.
@@ -81,7 +88,15 @@ class TokenStream:
     Iterate for per-token streaming (``for tok in stream``), or call
     :meth:`result` for collect-all.  A failed request raises its error
     from whichever call observes it (structured ``OverloadError`` for
-    sheds — HTTP maps those to 429 even mid-stream-setup)."""
+    sheds — HTTP maps those to 429 even mid-stream-setup).
+
+    The stream is the **exactly-once boundary** for recovery: every
+    producer-side :meth:`put` carries the token's absolute index, and
+    an index the transcript already holds is dropped (a resurrected
+    producer replaying the join point), while an index PAST the
+    transcript fails the stream loudly (a gap would silently corrupt
+    the completion).  Consumers therefore see each index exactly once,
+    in order, across any number of worker deaths."""
 
     def __init__(self) -> None:
         self._buf: Deque[Any] = collections.deque()
@@ -92,15 +107,35 @@ class TokenStream:
         self._cancelled = False
         self.finish_reason: Optional[str] = None
         self.tokens: List[int] = []     # producer-side transcript
+        # notified on consumer cancel (the scheduler hooks this to
+        # evict still-queued requests and free queue budget immediately)
+        self._on_cancel: Optional[Any] = None
 
     # -- producer (engine) --------------------------------------------------
-    def put(self, token: int) -> None:
+    def put(self, token: int, index: Optional[int] = None) -> None:
+        gap: Optional[int] = None
         with self._lock:
             if self._done:
                 return
-            self.tokens.append(int(token))
-            self._buf.append(int(token))
-            self._ready.notify_all()
+            if index is not None:
+                if index < len(self.tokens):
+                    # duplicate from a recovered producer: the dedupe
+                    # guard earns its keep
+                    _metrics.SERVING_STREAM_DUPES_DROPPED.inc()
+                    return
+                if index > len(self.tokens):
+                    gap = index
+            if gap is None:
+                self.tokens.append(int(token))
+                self._buf.append(int(token))
+                self._ready.notify_all()
+        if gap is not None:
+            # outside the lock: fail() retakes it
+            self.fail(MXNetError(
+                f"token stream gap: producer emitted index {gap} but "
+                f"the transcript holds {len(self.tokens)} tokens — a "
+                "recovery dropped tokens (exactly-once invariant "
+                "violated)"))
 
     def close(self, finish_reason: str) -> None:
         with self._lock:
@@ -121,12 +156,20 @@ class TokenStream:
 
     # -- consumer -----------------------------------------------------------
     def cancel(self) -> None:
-        """Consumer gave up (client disconnect): the engine retires the
-        sequence at the next iteration boundary."""
+        """Consumer gave up (client disconnect): a still-queued request
+        is evicted immediately (freeing queue budget); a slot-resident
+        sequence retires at the next iteration boundary."""
         with self._lock:
+            already = self._cancelled or self._done
             self._cancelled = True
             self._done = True
             self._ready.notify_all()
+            cb = self._on_cancel
+        if cb is not None and not already:
+            try:
+                cb()
+            except Exception:   # noqa: BLE001 - eviction is advisory
+                pass
 
     def is_cancelled(self) -> bool:
         with self._lock:
@@ -159,7 +202,7 @@ class TokenStream:
                     return None
                 left = deadline - time.monotonic()
                 if left <= 0:
-                    raise MXNetError(
+                    raise StreamTimeout(
                         "timed out waiting for the next generated "
                         f"token ({timeout}s)")
                 self._ready.wait(left)
@@ -185,27 +228,52 @@ class TokenStream:
 
 class GenRequest:
     """One generation request riding the scheduler: prompt, budget,
-    stream, timing/slot bookkeeping."""
+    stream, timing/slot bookkeeping.
+
+    Recovery reincarnates a request as a NEW ``GenRequest`` carrying
+    the SAME :class:`TokenStream`: ``tokens`` becomes the original
+    prompt plus every token already emitted, ``max_new_tokens`` the
+    remaining budget, and ``offset`` the absolute index of the next
+    token — greedy decode is deterministic, so the resurrected
+    sequence is token-identical to a fault-free run and the stream's
+    index dedupe makes the join exactly-once.  ``orig_prompt`` and
+    ``total_new_tokens`` stay absolute so a second death recovers from
+    the stream transcript again."""
 
     __slots__ = ("tokens", "max_new_tokens", "eos_token", "stream",
                  "enqueue_t", "deadline_t", "slot", "emitted",
-                 "t_first", "request_id")
+                 "t_first", "request_id", "orig_prompt",
+                 "total_new_tokens", "offset", "recover_t0",
+                 "recoveries")
 
     _SEQ = _itertools.count(1)
 
     def __init__(self, tokens: _np.ndarray, max_new_tokens: int,
                  eos_token: Optional[int],
-                 deadline_t: Optional[float]) -> None:
+                 deadline_t: Optional[float],
+                 stream: Optional[TokenStream] = None,
+                 orig_prompt: Optional[_np.ndarray] = None,
+                 total_new_tokens: Optional[int] = None,
+                 offset: int = 0) -> None:
         self.tokens = tokens
         self.max_new_tokens = int(max_new_tokens)
         self.eos_token = eos_token
-        self.stream = TokenStream()
+        self.stream = stream if stream is not None else TokenStream()
         self.enqueue_t = time.monotonic()
         self.deadline_t = deadline_t
         self.slot: Optional[int] = None
         self.emitted = 0
         self.t_first: Optional[float] = None
         self.request_id = next(GenRequest._SEQ)
+        self.orig_prompt = orig_prompt if orig_prompt is not None \
+            else tokens
+        self.total_new_tokens = int(
+            total_new_tokens if total_new_tokens is not None
+            else max_new_tokens)
+        self.offset = int(offset)
+        self.recover_t0: Optional[float] = None
+        self.recoveries = 0     # resurrections so far (budgeted by the
+        #                         server against restart churn)
 
     # scheduler duck-type
     def fail(self, exc: BaseException) -> None:
@@ -213,6 +281,36 @@ class GenRequest:
 
     def is_cancelled(self) -> bool:
         return self.stream.is_cancelled()
+
+
+def make_recovery_request(req: GenRequest) -> GenRequest:
+    """Reincarnate ``req`` at its stream's current transcript: the
+    resubmitted prompt is ``original prompt + tokens already emitted``
+    (deterministic greedy decode continues exactly where the dead
+    worker left off), the budget is what remains, and the SAME stream
+    rides along with its index offset advanced.  No deadline: the
+    request was already admitted once — shedding it now would drop an
+    accepted stream."""
+    emitted = len(req.stream.tokens)
+    if emitted:
+        prompt = _np.concatenate(
+            [_np.asarray(req.orig_prompt, _np.int32),
+             _np.asarray(req.stream.tokens, _np.int32)])
+    else:
+        prompt = _np.asarray(req.orig_prompt, _np.int32)
+    remaining = req.total_new_tokens - emitted
+    if remaining < 1:
+        raise MXNetError(
+            f"request {req.request_id} has no remaining budget "
+            f"({emitted}/{req.total_new_tokens} emitted) — it should "
+            "have been closed, not recovered")
+    r = GenRequest(prompt, remaining, req.eos_token, None,
+                   stream=req.stream, orig_prompt=req.orig_prompt,
+                   total_new_tokens=req.total_new_tokens,
+                   offset=emitted)
+    r.recover_t0 = time.monotonic()
+    r.recoveries = req.recoveries + 1
+    return r
 
 
 class GenerationEngine:
@@ -282,12 +380,18 @@ class GenerationEngine:
             else float(getenv("MXNET_SERVING_DEADLINE_MS", 0)) / 1e3)
         # host mirrors of the per-slot step inputs
         self._last_tok = _np.zeros((self.max_slots,), _np.int32)
+        self._in_admission: List[GenRequest] = []
         self.iteration_log: Deque[Dict[str, Any]] = collections.deque(
             maxlen=self.LOG_KEEP)
         self._iter = 0
         self.warmed = 0
         self._tps_window: Deque[Tuple[float, int]] = collections.deque(
             maxlen=64)
+        # worker-death/decode-fault recovery hook: when set (by
+        # GenerationServer), sequences hit by a decode-step fault are
+        # handed to it for resurrection instead of failed terminally;
+        # signature sink(victims: List[GenRequest], exc, site: str)
+        self.recovery_sink: Optional[Any] = None
 
     # -- lifecycle ----------------------------------------------------------
     def warmup(self) -> int:
@@ -309,6 +413,35 @@ class GenerationEngine:
                 "decoding (shutdown)"))
             _metrics.GEN_RETIREMENTS_TOTAL.labels(reason="error").inc()
         _metrics.GEN_SLOTS_ACTIVE.set(0)
+
+    def evacuate(self) -> Tuple[List[GenRequest], List[GenRequest]]:
+        """Strip every request out of the engine WITHOUT failing its
+        stream — the worker-death path: the supervisor resurrects them
+        on a healthy replica.  Returns ``(queued, resident)``; resident
+        entries still carry their emitted-token transcript on their
+        streams.  The engine is left empty with fresh KV buffers (the
+        death may have landed mid-step, after the old buffers were
+        donated)."""
+        queued = [r for r in self.scheduler.drain_queue()
+                  if not r.is_cancelled()]
+        resident: List[GenRequest] = []
+        for slot, req in self.scheduler.active().items():
+            self.scheduler.release(slot)
+            self.cache.free(slot)
+            if req.stream.finished or req.is_cancelled():
+                continue
+            resident.append(req)
+        # a death mid-prefill strands its request in neither queue nor
+        # slot table — it is recoverable all the same (a death between
+        # activate and the bookkeeping line can leave it in both: dedup)
+        for req in self._in_admission:
+            if req not in resident and not req.stream.finished \
+                    and not req.is_cancelled():
+                resident.append(req)
+        self._in_admission = []
+        self.cache.reset_buffers()
+        _metrics.GEN_SLOTS_ACTIVE.set(0)
+        return queued, resident
 
     # -- request API --------------------------------------------------------
     def submit(self, tokens: Any, max_new_tokens: int = 64,
@@ -338,8 +471,19 @@ class GenerationEngine:
         deadline_t = (time.monotonic() + deadline_ms / 1e3
                       if deadline_ms else None)
         req = GenRequest(toks, max_new_tokens, eos_token, deadline_t)
+        # consumer cancel while still queued -> evict NOW (queue budget
+        # frees immediately; an abandoned-request flood cannot hold
+        # queue_full sheds high until the next admission pass)
+        req.stream._on_cancel = lambda: self.scheduler.discard(req)
         self.scheduler.submit(req)      # raises OverloadError on shed
         return req.stream
+
+    def submit_request(self, req: GenRequest, front: bool = False) -> None:
+        """Install an already-accepted request (the recovery path):
+        bypasses the queue_full shed — the request was admitted once
+        and must complete or fail structurally, never re-shed."""
+        req.stream._on_cancel = lambda: self.scheduler.discard(req)
+        self.scheduler.submit(req, front=front, force=True)
 
     # -- the scheduling quantum ---------------------------------------------
     def run_iteration(self) -> bool:
@@ -367,9 +511,15 @@ class GenerationEngine:
         #    prompt bucket).  Always visit the queue — with zero free
         #    slots pop_admissions(0) admits nothing but STILL sheds
         #    queued requests whose deadline passed ("no slot freed
-        #    within the deadline" is the generation overload signal)
+        #    within the deadline" is the generation overload signal).
+        #    Mid-admission requests ride self._in_admission so a
+        #    worker death during prefill still evacuates them (they
+        #    are in neither the queue nor the slot table), and the
+        #    scheduler's mid-admission count keeps drain polls honest.
         free = self.cache.free_slots()
-        for req in self.scheduler.pop_admissions(len(free)):
+        pending = self.scheduler.pop_admissions(len(free))
+        self._in_admission = list(pending)
+        for req in pending:
             try:
                 slot = self._admit(req)
             except Exception as e:   # noqa: BLE001 - a poisoned
@@ -379,8 +529,12 @@ class GenerationEngine:
                 REQUESTS_TOTAL.labels(status="error").inc()
                 _metrics.GEN_RETIREMENTS_TOTAL.labels(
                     reason="error").inc()
-                continue
-            log["admitted"].append(slot)
+            else:
+                log["admitted"].append(slot)
+            # NOT in a finally: a BaseException mid-prefill must leave
+            # the request visible to evacuate()
+            self._in_admission.remove(req)
+            self.scheduler.admission_done()
 
         active = self.scheduler.active()
         _metrics.GEN_SLOTS_ACTIVE.set(len(active))
@@ -400,21 +554,36 @@ class GenerationEngine:
                 next_tok = self.model.step(self.cache, self._last_tok,
                                            pos)
         except Exception as e:   # noqa: BLE001 - an iteration fault
-            # fails exactly the sequences IN FLIGHT at this iteration
+            # hits exactly the sequences IN FLIGHT at this iteration
             # (their kv rows are suspect); queued requests and the
             # engine itself are unaffected.  The step consumed the KV
             # buffers by donation, so a raise AFTER dispatch leaves the
             # cache holding deleted arrays — reallocate before the next
             # admission touches them
             self.cache.reset_buffers()
+            victims: List[GenRequest] = []
             for slot, req in active.items():
-                req.fail(e)              # before close(): the consumer
-                #                          must observe the fault, not
-                #                          a clean end-of-stream
-                self._retire(slot, req, "error")
-                REQUESTS_TOTAL.labels(status="error").inc()
+                if self.recovery_sink is not None \
+                        and not req.stream.finished \
+                        and not req.is_cancelled():
+                    # managed engine: the sequence is resurrected from
+                    # its stream transcript (exactly-once recovery) —
+                    # release the slot WITHOUT closing the stream
+                    self.scheduler.release(slot)
+                    self.cache.free(slot)
+                    _metrics.GEN_RETIREMENTS_TOTAL.labels(
+                        reason="recovered").inc()
+                    victims.append(req)
+                else:
+                    req.fail(e)          # before close(): the consumer
+                    #                      must observe the fault, not
+                    #                      a clean end-of-stream
+                    self._retire(slot, req, "error")
+                    REQUESTS_TOTAL.labels(status="error").inc()
                 log["retired"].append(slot)
             self.iteration_log.append(log)
+            if victims:
+                self.recovery_sink(victims, e, "decode")
             return True
 
         now = time.monotonic()
@@ -423,9 +592,11 @@ class GenerationEngine:
             tok = int(next_tok[slot])
             self.cache.positions[slot] += 1
             self._last_tok[slot] = tok
+            # absolute index rides along: the stream dedupes replays
+            # from recovered producers at this boundary
+            req.stream.put(tok, index=req.offset + req.emitted)
             req.emitted += 1
             n_streamed += 1
-            req.stream.put(tok)
             log["decoded"].append(slot)
             finished = None
             if req.eos_token is not None and tok == int(req.eos_token):
@@ -473,11 +644,16 @@ class GenerationEngine:
         req.slot = slot
         self._last_tok[slot] = first
         req.t_first = time.monotonic()
+        req.stream.put(first, index=req.offset)
         req.emitted = 1
-        req.stream.put(first)
         _metrics.GEN_TTFT_SECONDS.observe(req.t_first - req.enqueue_t)
         _metrics.GEN_TOKENS_TOTAL.labels(phase="prefill").inc()
         _metrics.GEN_ADMISSIONS_TOTAL.inc()
+        if req.recover_t0 is not None:
+            # recovery ends when the resurrected sequence streams again
+            _metrics.SERVING_RECOVERY_SECONDS.observe(
+                req.t_first - req.recover_t0)
+            req.recover_t0 = None
         if req.eos_token is not None and first == int(req.eos_token):
             req.stream.close("eos")
         elif req.emitted >= req.max_new_tokens:

@@ -24,15 +24,23 @@ Endpoints:
   the same structured shed payload; dead decode worker -> 503.
 * ``GET /metrics`` — Prometheus text from the process metrics registry
   (queue depth, batch sizes, shed counts, per-bucket compiles, slot
-  occupancy, tokens/sec, TTFT, ...).
-* ``GET /healthz`` — liveness + queue/compile-cache snapshot (degraded
-  when EITHER the one-shot worker or the generation worker died).
+  occupancy, tokens/sec, TTFT, recoveries, restarts, ...).
+* ``GET /healthz`` (alias ``/readyz``) — **readiness**: 200 only while
+  the process should receive NEW traffic; 503 when degraded (circuit
+  breaker open / every worker replica dead) or draining (SIGTERM
+  received).  Wire the load balancer here.
+* ``GET /livez`` — **liveness**: 200 as long as the process answers,
+  INCLUDING while draining or degraded.  Wire the orchestrator's
+  restart probe here — killing a pod because its dependency broke, or
+  mid-drain, would turn graceful restarts into outages.
 * ``GET /v1/model`` — model + bucket-policy (+ generation engine)
   description.
 """
 from __future__ import annotations
 
 import json
+import socket
+import time
 from http.server import BaseHTTPRequestHandler, ThreadingHTTPServer
 from typing import Any, List, Optional, Tuple
 
@@ -40,6 +48,7 @@ import numpy as _np
 
 from ..base import MXNetError
 from .batching import OverloadError
+from .generation import StreamTimeout
 from .server import DegradedError, ModelServer
 
 __all__ = ["make_http_server"]
@@ -120,30 +129,57 @@ class _Handler(BaseHTTPRequestHandler):
             from .. import metrics
             self._reply(200, metrics.render_text().encode(),
                         content_type="text/plain; version=0.0.4")
-        elif path == "/healthz":
+        elif path in ("/healthz", "/readyz"):
+            draining = any(
+                s is not None and getattr(s, "draining", False)
+                for s in (self._ms, self._gs))
             degraded = []
             if self._ms is not None and not self._ms.healthy():
-                degraded.append("serving worker thread has died")
+                degraded.append(
+                    "serving worker replicas are not serving")
             if self._gs is not None and not self._gs.healthy():
-                degraded.append("generation worker thread has died")
+                degraded.append(
+                    "generation worker replicas are not serving")
             body: dict = {}
             if self._ms is not None:
                 d = self._ms.describe()
                 body["queue"] = d["queue"]
                 body["exec_cache"] = d["exec_cache"]
+                body["resilience"] = d["resilience"]
             if self._gs is not None:
                 g = self._gs.describe()
                 body["generation"] = {"slots": g["slots"],
-                                      "queue": g["queue"]}
-            if degraded:
-                # dead worker thread: requests would queue forever —
+                                      "queue": g["queue"],
+                                      "resilience": g["resilience"]}
+            if draining:
+                # readiness drops out of rotation FIRST; resident work
+                # is still finishing and liveness (/livez) stays 200
+                body.pop("exec_cache", None)
+                self._reply(503, dict(body, status="draining",
+                                      detail="draining: admissions "
+                                      "shed; resident work finishing"))
+            elif degraded:
+                # no serving capacity: requests would queue forever —
                 # tell the load balancer to stop sending traffic
                 body.pop("exec_cache", None)
                 self._reply(503, dict(body, status="degraded",
                                       detail="; ".join(degraded)
-                                      + "; restart the server"))
+                                      + "; reset the breaker or "
+                                      "restart the server"))
             else:
                 self._reply(200, dict(body, status="ok"))
+        elif path == "/livez":
+            # liveness: the process answers — even degraded or draining
+            # (the orchestrator must NOT kill a draining pod)
+            self._reply(200, {
+                "status": "alive",
+                "draining": any(
+                    s is not None and getattr(s, "draining", False)
+                    for s in (self._ms, self._gs)),
+                "degraded": any(
+                    s is not None and getattr(s, "degraded", False)
+                    for s in (self._ms, self._gs)),
+            })
         elif path == "/v1/model":
             out = (self._ms.describe() if self._ms is not None else {})
             if self._gs is not None:
@@ -306,6 +342,19 @@ class _Handler(BaseHTTPRequestHandler):
             return
         self._stream_tokens(stream)
 
+    def _client_gone(self) -> bool:
+        """Peek the connection without consuming: a readable socket
+        that yields EOF means the client hung up while its request was
+        still queued."""
+        import select
+        try:
+            r, _, _ = select.select([self.connection], [], [], 0)
+            if r:
+                return self.connection.recv(1, socket.MSG_PEEK) == b""
+        except (OSError, ValueError):
+            return True
+        return False
+
     def _stream_tokens(self, stream: Any) -> None:
         """Chunked NDJSON: one line per token AS the decode loop emits
         it, then a done trailer.  The status line is DEFERRED until the
@@ -315,18 +364,36 @@ class _Handler(BaseHTTPRequestHandler):
         429/500 contract for streaming requests.  A failure after that
         becomes an error line on the already-committed 200 (the nature
         of streaming); a client disconnect cancels the sequence so its
-        slot frees at the next iteration."""
-        try:
-            first = stream.next_token(timeout=300.0)
-        except OverloadError as e:
-            # no slot freed within the deadline — still a 429
-            self._reply(429, e.to_json(), headers={
-                "Retry-After": str(max(1, int(e.retry_after_ms / 1e3)))})
-            return
-        except Exception as e:   # noqa: BLE001 - request-scoped fault
-            self._reply(500, {"error": "generation_failed",
-                              "detail": str(e)})
-            return
+        slot frees at the next iteration.
+
+        The first-token wait POLLS for disconnects: a client that hangs
+        up while its request is still in the prefill queue is evicted
+        immediately (the queue budget frees NOW), so a flood of
+        abandoned requests cannot hold queue_full sheds high."""
+        deadline = time.monotonic() + 300.0
+        while True:
+            try:
+                first = stream.next_token(timeout=0.25)
+                break
+            except StreamTimeout:
+                if self._client_gone():
+                    stream.cancel()      # evicts a queued request NOW
+                    return
+                if time.monotonic() >= deadline:
+                    self._reply(500, {"error": "generation_failed",
+                                      "detail": "timed out waiting "
+                                                "for the first token"})
+                    return
+            except OverloadError as e:
+                # no slot freed within the deadline — still a 429
+                self._reply(429, e.to_json(), headers={
+                    "Retry-After": str(max(1,
+                                           int(e.retry_after_ms / 1e3)))})
+                return
+            except Exception as e:   # noqa: BLE001 - request-scoped
+                self._reply(500, {"error": "generation_failed",
+                                  "detail": str(e)})
+                return
         if first is None:        # closed with zero tokens (shutdown)
             self._reply(500, {"error": "generation_failed",
                               "detail": "sequence closed before its "
@@ -364,6 +431,21 @@ class _Handler(BaseHTTPRequestHandler):
             stream.cancel()
 
 
+class _QuietThreadingHTTPServer(ThreadingHTTPServer):
+    """Client hangups (reset/broken pipe mid-request) are ROUTINE for
+    a streaming server under chaos or drain — swallow them instead of
+    printing a traceback per abandoned connection; everything else
+    still reports."""
+
+    def handle_error(self, request: Any, client_address: Any) -> None:
+        import sys as _sys
+        exc = _sys.exc_info()[1]
+        if isinstance(exc, (ConnectionResetError, BrokenPipeError,
+                            ConnectionAbortedError, TimeoutError)):
+            return
+        super().handle_error(request, client_address)
+
+
 def make_http_server(model_server: Optional[ModelServer],
                      host: str = "127.0.0.1",
                      port: int = 8080,
@@ -377,7 +459,7 @@ def make_http_server(model_server: Optional[ModelServer],
     if model_server is None and generation_server is None:
         raise MXNetError("make_http_server needs a ModelServer and/or "
                          "a GenerationServer")
-    httpd = ThreadingHTTPServer((host, port), _Handler)
+    httpd = _QuietThreadingHTTPServer((host, port), _Handler)
     httpd.daemon_threads = True
     httpd.model_server = model_server       # type: ignore[attr-defined]
     httpd.generation_server = generation_server  # type: ignore[attr-defined]

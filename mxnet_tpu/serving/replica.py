@@ -1,0 +1,234 @@
+"""Replica supervision — the serving layer's restart policy.
+
+A production serving process treats a dead worker the way PR 3 taught
+the training stack to treat a dead peer: as a routine, *bounded* event.
+:class:`ReplicaSupervisor` owns that policy for both servers
+(:class:`~mxnet_tpu.serving.server.ModelServer` and
+:class:`~mxnet_tpu.serving.server.GenerationServer`):
+
+* a dead worker replica is **restarted** after a jittered exponential
+  backoff (the same :func:`mxnet_tpu.retry.backoff_delays` schedule the
+  dist_async client uses — a fleet of replicas crashing on the same
+  poisoned input must not restart in lockstep);
+* each replica carries a **restart budget**
+  (``MXNET_SERVING_MAX_RESTARTS``): past it the replica's circuit
+  breaker trips and it leaves the rotation for good — a crash-looping
+  worker burns CPU, floods logs, and churns every queued request, so
+  explicit degradation beats optimistic retry number N+1;
+* when **no** replica remains in rotation (alive, restarting, or
+  waiting), the supervisor reports the server degraded: submits fail
+  fast with a structured ``DegradedError`` and readiness goes 503 while
+  liveness stays 200 — the load balancer routes away, the orchestrator
+  does NOT kill the pod for a dependency fault;
+* a **manual reset** (``reset()`` — surfaced as the servers'
+  ``reset_breaker()``) refills every budget and re-admits traffic,
+  the operator acknowledging the underlying cause is gone.
+
+The supervisor is policy only: the owning server supplies ``spawn``
+(bring replica ``rid`` back) and ``on_degraded`` (no rotation left).
+"""
+from __future__ import annotations
+
+import threading
+from typing import Any, Callable, Dict, Iterator, Optional
+
+from ..base import getenv, register_env
+from .. import metrics as _metrics
+from ..retry import backoff_delays
+
+__all__ = ["ReplicaSupervisor"]
+
+register_env(
+    "MXNET_SERVING_REPLICAS", 1,
+    "Worker replicas hosted by each serving server (ModelServer worker "
+    "threads draining the shared batcher; GenerationServer decode "
+    "engines behind the admission router). A dead replica's requests "
+    "requeue to the survivors while the supervisor restarts it.")
+register_env(
+    "MXNET_SERVING_DRAIN_DEADLINE_S", 30,
+    "Graceful-drain budget: on the first SIGTERM/SIGINT a serving "
+    "process stops admissions (429 draining), finishes resident "
+    "requests for at most this long, then stops. Readiness reports 503 "
+    "for the whole window; liveness stays 200.")
+register_env(
+    "MXNET_SERVING_MAX_RESTARTS", 3,
+    "Restart budget per serving worker replica: past this many "
+    "restarts the replica's circuit breaker trips and it leaves the "
+    "rotation (no more restart churn); when no replica remains the "
+    "server degrades explicitly (DegradedError / readiness 503). "
+    "reset_breaker() refills the budget.")
+register_env(
+    "MXNET_SERVING_RESTART_BACKOFF_MS", 100,
+    "First-restart backoff after a serving worker replica dies; "
+    "doubles per restart (jittered, shared schedule with "
+    "MXNET_RETRY_* via retry.backoff_delays).")
+
+WORKER_RESTARTS = _metrics.counter(
+    "mxnet_serving_worker_restarts_total",
+    "Serving worker replicas restarted by the replica supervisor after "
+    "a worker death, by server kind (oneshot = ModelServer, generation "
+    "= GenerationServer).", labels=("server",))
+BREAKER_OPEN = _metrics.gauge(
+    "mxnet_serving_breaker_open",
+    "1 while a serving server's circuit breaker is open (every replica "
+    "exhausted its MXNET_SERVING_MAX_RESTARTS budget — the server is "
+    "degraded and sheds with DegradedError until reset_breaker()), by "
+    "server kind.", labels=("server",))
+
+
+class _ReplicaState:
+    __slots__ = ("delays", "pending", "tripped")
+
+    def __init__(self, delays: Iterator[float]) -> None:
+        self.delays = delays
+        self.pending = False      # a restart is scheduled/backing off
+        self.tripped = False      # budget exhausted: out of rotation
+
+
+class ReplicaSupervisor:
+    """Restart/breaker policy for one server's replica set.
+
+    ``spawn(rid)`` is called (from a supervisor-owned thread, after the
+    backoff sleep) to bring a replica back; ``on_degraded(exc)`` fires
+    exactly once when the last replica leaves the rotation.  The server
+    reports ``alive_fn(rid) -> bool`` so rotation checks see reality,
+    not bookkeeping.
+    """
+
+    def __init__(self, server_label: str, n_replicas: int,
+                 spawn: Callable[[int], None],
+                 on_degraded: Callable[[BaseException], None],
+                 alive_fn: Callable[[int], bool],
+                 max_restarts: Optional[int] = None,
+                 backoff_ms: Optional[float] = None) -> None:
+        self.label = server_label
+        if max_restarts is None:
+            max_restarts = int(getenv("MXNET_SERVING_MAX_RESTARTS", 3))
+        if backoff_ms is None:
+            backoff_ms = float(
+                getenv("MXNET_SERVING_RESTART_BACKOFF_MS", 100))
+        self.max_restarts = int(max_restarts)
+        self.backoff_ms = float(backoff_ms)
+        self._spawn = spawn
+        self._on_degraded = on_degraded
+        self._alive = alive_fn
+        self._lock = threading.Lock()
+        self._stopped = False
+        self._degraded = False
+        self._state: Dict[int, _ReplicaState] = {
+            rid: _ReplicaState(self._fresh_delays())
+            for rid in range(int(n_replicas))}
+        BREAKER_OPEN.labels(server=self.label).set(0)
+
+    def _fresh_delays(self) -> Iterator[float]:
+        # max_restarts restarts => max_restarts backoff sleeps
+        return backoff_delays(attempts=self.max_restarts + 1,
+                              base_ms=self.backoff_ms)
+
+    # -- state queries -------------------------------------------------------
+    @property
+    def degraded(self) -> bool:
+        return self._degraded
+
+    def restart_pending(self, rid: int) -> bool:
+        with self._lock:
+            st = self._state.get(rid)
+            return bool(st and st.pending)
+
+    def any_pending(self) -> bool:
+        with self._lock:
+            return any(st.pending for st in self._state.values())
+
+    def tripped(self, rid: int) -> bool:
+        with self._lock:
+            st = self._state.get(rid)
+            return bool(st and st.tripped)
+
+    def in_rotation(self) -> int:
+        """Replicas still serving or coming back: alive, or restart
+        pending. Tripped replicas are out until reset()."""
+        with self._lock:
+            return sum(1 for rid, st in self._state.items()
+                       if st.pending or (not st.tripped
+                                         and self._alive(rid)))
+
+    # -- the death event -----------------------------------------------------
+    def notify_death(self, rid: int, exc: BaseException) -> bool:
+        """A replica's worker died.  Returns True when a restart was
+        scheduled; False when the replica's breaker tripped (and, if it
+        was the last one in rotation, after ``on_degraded`` ran)."""
+        with self._lock:
+            if self._stopped or self._degraded:
+                return False
+            st = self._state[rid]
+            delay = next(st.delays, None)
+            if delay is None:
+                st.tripped = True
+                st.pending = False
+                last = not any(
+                    s.pending or (not s.tripped and self._alive(r))
+                    for r, s in self._state.items())
+                if last:
+                    self._degraded = True
+            else:
+                st.pending = True
+        if delay is None:
+            if self._degraded:
+                BREAKER_OPEN.labels(server=self.label).set(1)
+                self._on_degraded(exc)
+            return False
+        t = threading.Thread(
+            target=self._restart_after, args=(rid, delay),
+            name=f"mxnet-serving-restart-{self.label}-{rid}",
+            daemon=True)
+        t.start()
+        return True
+
+    def _restart_after(self, rid: int, delay: float) -> None:
+        import time
+        time.sleep(delay)
+        with self._lock:
+            st = self._state.get(rid)
+            if self._stopped or self._degraded or st is None \
+                    or not st.pending:
+                return
+            st.pending = False
+        WORKER_RESTARTS.labels(server=self.label).inc()
+        try:
+            self._spawn(rid)
+        except Exception as e:   # noqa: BLE001 - a failed respawn is
+            # one more death: spend another unit of the budget
+            self.notify_death(rid, e)
+
+    # -- operator controls ---------------------------------------------------
+    def reset(self) -> None:
+        """Refill every replica's restart budget and clear the breaker
+        (the servers' ``reset_breaker()``).  The server re-spawns dead
+        replicas itself after calling this."""
+        with self._lock:
+            self._degraded = False
+            for st in self._state.values():
+                st.delays = self._fresh_delays()
+                st.pending = False
+                st.tripped = False
+        BREAKER_OPEN.labels(server=self.label).set(0)
+
+    def stop(self) -> None:
+        """Server shutdown: cancel pending restarts."""
+        with self._lock:
+            self._stopped = True
+            for st in self._state.values():
+                st.pending = False
+
+    def describe(self) -> Dict[str, Any]:
+        with self._lock:
+            return {
+                "max_restarts": self.max_restarts,
+                "backoff_ms": self.backoff_ms,
+                "degraded": self._degraded,
+                "replicas": {
+                    rid: {"alive": self._alive(rid),
+                          "restart_pending": st.pending,
+                          "breaker_tripped": st.tripped}
+                    for rid, st in self._state.items()},
+            }

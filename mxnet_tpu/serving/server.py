@@ -1,34 +1,66 @@
-"""ModelServer — batcher + model + one inference worker thread.
+"""ModelServer / GenerationServer — batcher + model + replicated workers.
 
-The concurrency shape mirrors the device reality: ONE worker drains the
+The concurrency shape mirrors the device reality: a worker drains the
 queue and executes batches (a single accelerator runs one program at a
 time; a second in-flight batch would only queue inside the runtime),
 while any number of producer threads — the HTTP front end's
 per-connection threads, in-process callers — submit requests and wait on
 futures.  Backpressure is therefore explicit and bounded: the queue
 limit and the deadline are the only places a request can wait.
+
+Since ISSUE 7 the worker is no longer a single point of failure.  Both
+servers host ``MXNET_SERVING_REPLICAS`` worker replicas behind a
+router, and worker death is a *routine, bounded* event:
+
+* a dead ``ModelServer`` worker's in-flight batch **requeues** to the
+  surviving workers (unresolved futures only — the future is the
+  exactly-once boundary for one-shot inference);
+* a dead ``GenerationServer`` worker's engine is **evacuated**: queued
+  requests requeue, and slot-resident sequences are **resurrected** on
+  a healthy replica by re-prefilling ``prompt + tokens already
+  emitted`` — greedy decode is deterministic, so the recovered stream
+  is token-identical to a fault-free run, and the
+  :class:`~mxnet_tpu.serving.generation.TokenStream` index dedupe makes
+  the join exactly-once on the wire;
+* the :class:`~mxnet_tpu.serving.replica.ReplicaSupervisor` restarts
+  the dead replica with jittered backoff behind a per-replica circuit
+  breaker; when every replica exhausts its budget the server degrades
+  EXPLICITLY (structured :class:`DegradedError`, readiness 503,
+  liveness 200) instead of crash-looping;
+* SIGTERM triggers a **graceful drain**
+  (:func:`serve_until_preempted`): admissions shed with 429, resident
+  work finishes within ``MXNET_SERVING_DRAIN_DEADLINE_S``, readiness
+  drops out of rotation first, and the process exits 0.
 """
 from __future__ import annotations
 
+import logging
 import threading
 import time
 from concurrent.futures import Future
-from typing import Any, Dict, List, Optional, Sequence
+from typing import Any, Callable, Dict, List, Optional, Sequence
 
 import numpy as _np
 
 from ..base import MXNetError, getenv
 from .. import faults as _faults
+from .. import metrics as _metrics
 from .batching import (BucketPolicy, DynamicBatcher, OverloadError,
                        REQUESTS_TOTAL, Request)
+from .generation import GenRequest, make_recovery_request
 from .model import ServedModel
+from .replica import ReplicaSupervisor
 
-__all__ = ["ModelServer", "GenerationServer", "DegradedError"]
+__all__ = ["ModelServer", "GenerationServer", "DegradedError",
+           "serve_until_preempted"]
+
+_LOG = logging.getLogger("mxnet_tpu.serving")
 
 
 class DegradedError(MXNetError):
-    """The server cannot take requests (worker dead or stopped) — the
-    HTTP front end maps this to 503, distinct from caller errors."""
+    """The server cannot take requests (circuit breaker open, every
+    worker replica dead, or stopped) — the HTTP front end maps this to
+    503, distinct from caller errors."""
 
 
 class ModelServer:
@@ -44,15 +76,21 @@ class ModelServer:
         server.stop()
 
     ``infer`` raises :class:`OverloadError` when the request is shed
-    (bounded queue / deadline) — callers back off; the server never
-    crashes or grows its queue without bound.
+    (bounded queue / deadline / draining) — callers back off; the
+    server never crashes or grows its queue without bound.
+    ``replicas`` worker threads (default ``MXNET_SERVING_REPLICAS``)
+    drain the shared queue; a dead worker's batch requeues to the
+    survivors while the supervisor restarts it.
     """
 
     def __init__(self, model: ServedModel,
                  policy: Optional[BucketPolicy] = None,
                  timeout_ms: Optional[float] = None,
                  queue_limit: Optional[int] = None,
-                 warmup: bool = False) -> None:
+                 warmup: bool = False,
+                 replicas: Optional[int] = None,
+                 max_restarts: Optional[int] = None,
+                 restart_backoff_ms: Optional[float] = None) -> None:
         self.model = model
         self.policy = policy if policy is not None \
             else model.default_policy()
@@ -66,13 +104,21 @@ class ModelServer:
                                       queue_limit=queue_limit)
         self._default_deadline_s = \
             float(getenv("MXNET_SERVING_DEADLINE_MS", 0)) / 1e3
-        self._thread: Optional[threading.Thread] = None
+        if replicas is None:
+            replicas = int(getenv("MXNET_SERVING_REPLICAS", 1))
+        self.replicas = max(1, int(replicas))
+        self._workers: Dict[int, threading.Thread] = {}
         self._started = False
-        self._worker_died = False
-        # the batch currently executing (worker-owned): stop() fails
-        # these futures after the join so no caller blocks forever on a
-        # result that will never come
-        self._inflight: List[Request] = []
+        self._stopping = False
+        self._degraded = False
+        # per-worker batch currently executing: a dying worker's batch
+        # requeues to the survivors; stop() fails whatever remains
+        self._inflight: Dict[int, List[Request]] = {}
+        self._lock = threading.Lock()
+        self.supervisor = ReplicaSupervisor(
+            "oneshot", self.replicas, self._spawn_worker,
+            self._on_degraded, self._worker_alive,
+            max_restarts=max_restarts, backoff_ms=restart_backoff_ms)
         self.warmed = 0
         if warmup:
             self.warmed = model.warmup(self.policy)
@@ -86,18 +132,31 @@ class ModelServer:
                 "ModelServer cannot restart after stop(): the batcher is "
                 "closed (build a fresh ModelServer)")
         self._started = True
-        self._thread = threading.Thread(target=self._run,
-                                        name="mxnet-serving-worker",
-                                        daemon=True)
-        self._thread.start()
+        for wid in range(self.replicas):
+            self._spawn_worker(wid)
         return self
+
+    def _spawn_worker(self, wid: int) -> None:
+        t = threading.Thread(target=self._run, args=(wid,),
+                             name=f"mxnet-serving-worker-{wid}",
+                             daemon=True)
+        with self._lock:
+            self._workers[wid] = t
+        t.start()
+
+    def _worker_alive(self, wid: int) -> bool:
+        t = self._workers.get(wid)
+        return bool(t is not None and t.is_alive())
 
     def stop(self, timeout: float = 10.0) -> None:
         if not self._started:
             return
+        self._stopping = True
+        self.supervisor.stop()
         self.batcher.close()
-        if self._thread is not None:
-            self._thread.join(timeout)
+        deadline = time.monotonic() + timeout
+        for t in list(self._workers.values()):
+            t.join(max(0.0, deadline - time.monotonic()))
         # strand nothing: a batch still executing when the join timed
         # out (or whose worker died) holds futures no one will ever
         # complete — fail them with a structured shutdown error so HTTP
@@ -108,29 +167,109 @@ class ModelServer:
         self._started = False
 
     def _fail_inflight(self, exc: Exception) -> None:
-        for r in list(self._inflight):
-            if not r.future.done():
-                try:
-                    r.future.set_exception(exc)
-                except Exception:   # noqa: BLE001 - done() race
-                    continue
-                REQUESTS_TOTAL.labels(status="error").inc()
-        self._inflight = []
+        with self._lock:
+            batches = list(self._inflight.values())
+            self._inflight.clear()
+        for batch in batches:
+            for r in batch:
+                if not r.future.done():
+                    try:
+                        r.future.set_exception(exc)
+                    except Exception:   # noqa: BLE001 - done() race
+                        continue
+                    REQUESTS_TOTAL.labels(status="error").inc()
+
+    # -- health split -------------------------------------------------------
+    @property
+    def draining(self) -> bool:
+        return self.batcher.draining
+
+    @property
+    def degraded(self) -> bool:
+        return self._degraded
+
+    def ready(self) -> bool:
+        """Readiness: in rotation for NEW traffic — started, breaker
+        closed, not draining, and at least one worker serving or coming
+        back.  The load balancer keys on this."""
+        return bool(self._started and not self._degraded
+                    and not self.draining
+                    and self.supervisor.in_rotation() > 0)
 
     def healthy(self) -> bool:
-        """Ready to serve: started AND the worker thread is alive.  A
-        dead worker or a stopped/never-started server reports False, so
-        /healthz goes non-200 the moment requests would stall or fail —
-        not only in the died-mid-run case."""
-        return bool(self._started and not self._worker_died
-                    and self._thread is not None
-                    and self._thread.is_alive())
+        """Back-compat alias for :meth:`ready` (pre-replica callers)."""
+        return self.ready()
 
     def __enter__(self) -> "ModelServer":
         return self.start()
 
     def __exit__(self, *exc: Any) -> None:
         self.stop()
+
+    # -- drain --------------------------------------------------------------
+    def start_drain(self) -> None:
+        """Stop admissions (new submits shed 429 ``draining``); queued
+        and in-flight work keeps executing."""
+        _metrics.SERVING_DRAINING.set(1)
+        self.batcher.start_drain()
+
+    def await_drained(self, timeout: float = 1.0) -> bool:
+        """Poll until no request is queued or in flight (or timeout)."""
+        deadline = time.monotonic() + timeout
+        while True:
+            with self._lock:
+                idle = not any(self._inflight.values())
+            if idle and len(self.batcher) == 0:
+                return True
+            if time.monotonic() >= deadline:
+                return False
+            time.sleep(0.01)
+
+    def drain(self, deadline_s: Optional[float] = None,
+              stop_timeout: float = 10.0) -> bool:
+        """Graceful shutdown: stop admissions, finish resident work
+        within ``deadline_s`` (default
+        ``MXNET_SERVING_DRAIN_DEADLINE_S``), then stop.  Returns True
+        when everything finished inside the budget."""
+        if deadline_s is None:
+            deadline_s = float(
+                getenv("MXNET_SERVING_DRAIN_DEADLINE_S", 30))
+        self.start_drain()
+        drained = self.await_drained(float(deadline_s))
+        self.stop(timeout=stop_timeout)
+        return drained
+
+    # -- breaker ------------------------------------------------------------
+    def _on_degraded(self, exc: BaseException) -> None:
+        """Every replica exhausted its restart budget: explicit
+        degraded mode — fail everything held, refuse new work."""
+        self._degraded = True
+        err = MXNetError(
+            f"ModelServer worker thread died repeatedly "
+            f"({self.supervisor.max_restarts} restarts per replica "
+            f"spent); circuit breaker tripped — the server is degraded "
+            f"(last error: {exc!r}); reset_breaker() or restart")
+        self._fail_inflight(err)
+        self.batcher.close(error=err)
+        _LOG.error(
+            "serving worker crash-loop: breaker tripped after %d "
+            "restarts/replica — /healthz now reports degraded (503); "
+            "reset_breaker() re-admits traffic (last error: %r)",
+            self.supervisor.max_restarts, exc)
+
+    def reset_breaker(self) -> None:
+        """Operator acknowledgement that the crash cause is gone:
+        refill every restart budget, reopen the queue, and respawn dead
+        workers — traffic re-admits immediately."""
+        if not self._started:
+            raise MXNetError("reset_breaker() on a stopped server — "
+                             "build and start a fresh one")
+        self.supervisor.reset()
+        self._degraded = False
+        self.batcher.reopen()
+        for wid in range(self.replicas):
+            if not self._worker_alive(wid):
+                self._spawn_worker(wid)
 
     # -- request API --------------------------------------------------------
     def infer_async(self, *sample: _np.ndarray,
@@ -140,12 +279,13 @@ class ModelServer:
         array for single-output models)."""
         if not self._started:
             raise MXNetError("ModelServer.start() first")
-        if not self.healthy():
-            # a dead worker would park this future forever — fail the
-            # submit instead so clients back off / failover
+        if self._degraded:
+            # a tripped breaker would park this future forever — fail
+            # the submit instead so clients back off / fail over
             raise DegradedError(
-                "ModelServer worker thread has died; the server is "
-                "degraded (healthz reports 503) — restart it")
+                "ModelServer worker replicas are crash-looping and the "
+                "circuit breaker is open; the server is degraded "
+                "(healthz reports 503) — reset_breaker() or restart it")
         arrays = [_np.asarray(a) for a in sample]
         sig = self.model.input_signature
         if len(arrays) != len(sig):
@@ -190,37 +330,57 @@ class ModelServer:
                                 deadline_ms=deadline_ms).result(timeout)
 
     # -- worker -------------------------------------------------------------
-    def _run(self) -> None:
+    def _run(self, wid: int) -> None:
+        def take(batch: List[Request]) -> None:
+            # runs under the batcher lock: no queued-nor-inflight gap
+            # for a drain poll to mistake for idleness
+            with self._lock:
+                self._inflight[wid] = batch
+
         try:
             while True:
-                batch = self.batcher.next_batch()
+                batch = self.batcher.next_batch(on_take=take)
                 if batch is None:
                     return
-                self._inflight = batch
+                # the worker-death chaos site: an injected error here
+                # (NOT per-request handling) kills this worker thread
+                _faults.maybe_fault("serving.worker", worker=wid,
+                                    batch=len(batch))
                 try:
                     self._execute(batch)
                 except Exception:   # noqa: BLE001 - the worker must
                     # outlive any per-batch surprise (a dead worker is a
-                    # silently wedged server); per-request faults were
+                    # wedged replica); per-request faults were
                     # already set
                     pass
                 # cleared only on survival: a BaseException must leave
                 # the batch visible to the death handler below
-                self._inflight = []
+                with self._lock:
+                    self._inflight.pop(wid, None)
         except BaseException as e:   # noqa: BLE001 - worker death is a
-            # server-level event: mark degraded and unblock EVERY waiter
-            # — the in-flight batch the dying worker held AND everything
-            # still queued (close() fails those); re-raising inside a
+            # replica-level event: requeue its batch to the survivors
+            # and let the supervisor restart it; re-raising inside a
             # worker thread would only reach threading.excepthook
-            self._worker_died = True
+            self._on_worker_death(wid, e)
+
+    def _on_worker_death(self, wid: int, exc: BaseException) -> None:
+        if self._stopping or self.batcher._closed:
+            # shutdown races a death: keep the old deterministic
+            # behavior — fail this worker's batch so no caller blocks
             self._fail_inflight(MXNetError(
-                f"ModelServer worker thread died: {e!r}; the server is "
-                "degraded — restart it"))
-            self.batcher.close()
-            import logging
-            logging.getLogger("mxnet_tpu.serving").error(
-                "serving worker thread died: %r — /healthz now reports "
-                "degraded (503); restart the server", e)
+                f"ModelServer worker thread died: {exc!r}; the server "
+                "is stopping"))
+            return
+        with self._lock:
+            batch = self._inflight.pop(wid, None)
+        if batch:
+            # the future is the exactly-once boundary: only unresolved
+            # requests re-execute
+            self.batcher.requeue(batch)
+        _LOG.error(
+            "serving worker %d died: %r — batch requeued to surviving "
+            "replicas; supervisor restarting with backoff", wid, exc)
+        self.supervisor.notify_death(wid, exc)
 
     def _execute(self, batch: List[Request]) -> None:
         try:
@@ -296,23 +456,46 @@ class ModelServer:
                       "limit": self.batcher.queue_limit,
                       "batch_timeout_ms": self.batcher.timeout_s * 1e3},
             "warmed_buckets": self.warmed,
-            "worker_alive": self.healthy(),
+            "worker_alive": self.ready(),
+            "resilience": {
+                "replicas": self.replicas,
+                "workers_alive": sum(
+                    1 for wid in range(self.replicas)
+                    if self._worker_alive(wid)),
+                "draining": self.draining,
+                "supervisor": self.supervisor.describe(),
+            },
             "exec_cache": exec_cache_stats(),
         }
 
 
+class _GenReplica:
+    """One generation worker replica: its engine, its thread.
+    ``dead`` flips the moment the death handler starts so the router
+    stops feeding an engine that is being evacuated."""
+
+    __slots__ = ("idx", "engine", "thread", "dead")
+
+    def __init__(self, idx: int, engine: Any) -> None:
+        self.idx = idx
+        self.engine = engine
+        self.thread: Optional[threading.Thread] = None
+        self.dead = False
+
+
 class GenerationServer:
-    """Host a :class:`~mxnet_tpu.serving.generation.GenerationEngine`
-    on a worker thread — the continuous-batching sibling of
+    """Host :class:`~mxnet_tpu.serving.generation.GenerationEngine`
+    replicas on worker threads — the continuous-batching sibling of
     :class:`ModelServer`.
 
-    The same concurrency shape: ONE worker owns the device (it runs
-    the resident decode loop, one iteration at a time, each iteration
-    watchdog-armed inside the engine), while any number of producer
-    threads submit prompts and drain their
-    :class:`~mxnet_tpu.serving.generation.TokenStream`.  Unlike the
-    one-shot worker, this one never blocks per-request: it parks only
-    when NOTHING is queued or decoding, and a submit wakes it.
+    The same concurrency shape per replica: ONE worker owns its engine
+    (it runs the resident decode loop, one iteration at a time, each
+    iteration watchdog-armed inside the engine), while any number of
+    producer threads submit prompts and drain their
+    :class:`~mxnet_tpu.serving.generation.TokenStream`.  A router picks
+    the least-loaded healthy replica per request; worker death
+    evacuates the replica's engine and resurrects its sequences on the
+    survivors (exactly-once, token-identical — see the module doc).
 
     ::
 
@@ -320,16 +503,57 @@ class GenerationServer:
         stream = server.generate(prompt_ids, max_new_tokens=64)
         for tok in stream: ...
         server.stop()
+
+    Pass ``engine_factory=`` (and optionally ``replicas=``, default
+    ``MXNET_SERVING_REPLICAS``) to host N independent engines; dead
+    replicas are then rebuilt from the factory on restart.  Passing a
+    single ``engine`` keeps the pre-replica behavior (one replica,
+    restart reuses the evacuated engine).
     """
 
-    def __init__(self, engine: Any, warmup: bool = False) -> None:
-        self.engine = engine
-        self._thread: Optional[threading.Thread] = None
+    def __init__(self, engine: Any = None, warmup: bool = False,
+                 engine_factory: Optional[Callable[[], Any]] = None,
+                 replicas: Optional[int] = None,
+                 max_restarts: Optional[int] = None,
+                 restart_backoff_ms: Optional[float] = None) -> None:
+        if (engine is None) == (engine_factory is None):
+            raise MXNetError(
+                "GenerationServer takes an engine OR an engine_factory")
+        self._factory = engine_factory
+        self._warmup = bool(warmup)
+        if engine is not None:
+            engines = [engine]
+        else:
+            if replicas is None:
+                replicas = int(getenv("MXNET_SERVING_REPLICAS", 1))
+            engines = [engine_factory() for _ in range(max(1,
+                                                           int(replicas)))]
+        self.replicas = len(engines)
+        self._replicas = [
+            _GenReplica(i, eng) for i, eng in enumerate(engines)]
         self._started = False
-        self._worker_died = False
+        self._degraded = False
+        self._draining = False
         self._stop = threading.Event()
-        if warmup:
-            engine.warmup()
+        self._lock = threading.Lock()
+        # accepted requests waiting for a replica to come back (every
+        # replica dead/restarting): flushed on restart, failed on
+        # degrade/stop — never silently dropped
+        self._pending: List[GenRequest] = []
+        self.supervisor = ReplicaSupervisor(
+            "generation", self.replicas, self._spawn_replica,
+            self._on_degraded, self._replica_alive,
+            max_restarts=max_restarts, backoff_ms=restart_backoff_ms)
+        for rep in self._replicas:
+            rep.engine.recovery_sink = self._recover
+            if warmup:
+                rep.engine.warmup()
+
+    # -- compat surface ------------------------------------------------------
+    @property
+    def engine(self) -> Any:
+        """The first replica's engine (pre-replica API compat)."""
+        return self._replicas[0].engine
 
     # -- lifecycle ----------------------------------------------------------
     def start(self) -> "GenerationServer":
@@ -340,30 +564,69 @@ class GenerationServer:
                 "GenerationServer cannot restart after stop(): build a "
                 "fresh engine")
         self._started = True
-        self._thread = threading.Thread(target=self._run,
-                                        name="mxnet-generation-worker",
-                                        daemon=True)
-        self._thread.start()
+        for rep in self._replicas:
+            self._spawn_thread(rep)
         return self
+
+    def _spawn_thread(self, rep: _GenReplica) -> None:
+        t = threading.Thread(
+            target=self._run, args=(rep,),
+            name=f"mxnet-generation-worker-{rep.idx}", daemon=True)
+        rep.thread = t
+        t.start()
+
+    def _replica_alive(self, rid: int) -> bool:
+        rep = self._replicas[rid]
+        # the death handler runs ON the dying thread, so is_alive() is
+        # still True mid-evacuation — the dead flag closes that window
+        return bool(not rep.dead and rep.thread is not None
+                    and rep.thread.is_alive())
 
     def stop(self, timeout: float = 10.0) -> None:
         if not self._started:
             return
         self._stop.set()
-        # close the admission queue: sheds queued requests with a
-        # structured shutdown error and wakes a parked worker
-        self.engine.scheduler.close()
-        if self._thread is not None:
-            self._thread.join(timeout)
-        # whether the worker exited cleanly or not, no stream may be
+        self.supervisor.stop()
+        # close the admission queues: sheds queued requests with a
+        # structured shutdown error and wakes parked workers
+        for rep in self._replicas:
+            rep.engine.scheduler.close()
+        deadline = time.monotonic() + timeout
+        for rep in self._replicas:
+            if rep.thread is not None:
+                rep.thread.join(max(0.0, deadline - time.monotonic()))
+        # whether the workers exited cleanly or not, no stream may be
         # left to block forever
-        self.engine.close()
+        for rep in self._replicas:
+            rep.engine.close()
+        err = MXNetError("generation server stopped with the request "
+                         "still pending (shutdown)")
+        with self._lock:
+            pending, self._pending = self._pending, []
+        for req in pending:
+            req.fail(err)
         self._started = False
 
+    # -- health split -------------------------------------------------------
+    @property
+    def draining(self) -> bool:
+        return self._draining
+
+    @property
+    def degraded(self) -> bool:
+        return self._degraded
+
+    def ready(self) -> bool:
+        """Readiness: in rotation for NEW prompts — started, breaker
+        closed, not draining, at least one replica serving or coming
+        back."""
+        return bool(self._started and not self._degraded
+                    and not self._draining
+                    and self.supervisor.in_rotation() > 0)
+
     def healthy(self) -> bool:
-        return bool(self._started and not self._worker_died
-                    and self._thread is not None
-                    and self._thread.is_alive())
+        """Back-compat alias for :meth:`ready`."""
+        return self.ready()
 
     def __enter__(self) -> "GenerationServer":
         return self.start()
@@ -371,45 +634,345 @@ class GenerationServer:
     def __exit__(self, *exc: Any) -> None:
         self.stop()
 
+    # -- drain --------------------------------------------------------------
+    def start_drain(self) -> None:
+        """Stop admitting NEW prompts (429 ``draining``); queued and
+        slot-resident sequences decode to completion."""
+        _metrics.SERVING_DRAINING.set(1)
+        self._draining = True
+
+    def await_drained(self, timeout: float = 1.0) -> bool:
+        deadline = time.monotonic() + timeout
+        while True:
+            with self._lock:
+                pending = bool(self._pending)
+            idle = not pending and not any(
+                rep.engine.scheduler.busy() for rep in self._replicas)
+            if idle:
+                return True
+            if time.monotonic() >= deadline:
+                return False
+            time.sleep(0.01)
+
+    def drain(self, deadline_s: Optional[float] = None,
+              stop_timeout: float = 10.0) -> bool:
+        """Stop admissions, finish every accepted sequence within
+        ``deadline_s`` (default ``MXNET_SERVING_DRAIN_DEADLINE_S``),
+        then stop.  Returns True when everything finished in budget
+        (leftovers fail with the structured shutdown error)."""
+        if deadline_s is None:
+            deadline_s = float(
+                getenv("MXNET_SERVING_DRAIN_DEADLINE_S", 30))
+        self.start_drain()
+        drained = self.await_drained(float(deadline_s))
+        self.stop(timeout=stop_timeout)
+        return drained
+
     # -- request API --------------------------------------------------------
     def generate(self, tokens: Any, max_new_tokens: int = 64,
                  eos_token: Optional[int] = None,
                  deadline_ms: Optional[float] = None) -> Any:
         """Submit one prompt; returns its ``TokenStream``.  Sheds with
-        ``OverloadError`` (queue full / no slot within deadline) and
-        refuses with :class:`DegradedError` when the decode worker is
-        dead — the same 429-vs-503 split as the one-shot path."""
+        ``OverloadError`` (queue full / no slot within deadline /
+        draining / every replica mid-restart) and refuses with
+        :class:`DegradedError` when the breaker is open — the same
+        429-vs-503 split as the one-shot path."""
         if not self._started:
             raise MXNetError("GenerationServer.start() first")
-        if not self.healthy():
+        if self._degraded:
             raise DegradedError(
-                "generation worker thread has died; the server is "
+                "generation worker replicas are crash-looping and the "
+                "circuit breaker is open; the server is degraded "
+                "(healthz reports 503) — reset_breaker() or restart it")
+        if self._draining:
+            from .batching import SHED_TOTAL
+            SHED_TOTAL.labels(reason="draining").inc()
+            REQUESTS_TOTAL.labels(status="shed").inc()
+            raise OverloadError("draining", retry_after_ms=1e3)
+        reps = sorted(
+            (rep for rep in self._replicas
+             if self._replica_alive(rep.idx)
+             and not rep.engine.scheduler.closed),
+            key=lambda rep: (len(rep.engine.scheduler)
+                             + rep.engine.scheduler.n_active()))
+        if not reps:
+            if self.supervisor.any_pending():
+                # transient: every replica is mid-restart — structured
+                # backpressure, not a fake acceptance that could die
+                raise OverloadError(
+                    "restarting",
+                    retry_after_ms=self.supervisor.backoff_ms)
+            raise DegradedError(
+                "no generation worker replica is alive; the server is "
                 "degraded (healthz reports 503) — restart it")
-        return self.engine.submit(tokens, max_new_tokens=max_new_tokens,
-                                  eos_token=eos_token,
-                                  deadline_ms=deadline_ms)
+        last: Optional[OverloadError] = None
+        for rep in reps:
+            try:
+                return rep.engine.submit(
+                    tokens, max_new_tokens=max_new_tokens,
+                    eos_token=eos_token, deadline_ms=deadline_ms)
+            except OverloadError as e:
+                last = e                 # replica full: try the next
+        raise last if last is not None else MXNetError(
+            "no replica accepted the request")
 
     # -- worker -------------------------------------------------------------
-    def _run(self) -> None:
+    def _run(self, rep: _GenReplica) -> None:
         try:
             while not self._stop.is_set():
-                if not self.engine.scheduler.wait_for_work(0.5):
+                if not rep.engine.scheduler.wait_for_work(0.5):
                     return               # closed and fully drained
-                self.engine.run_iteration()
+                if len(rep.engine.scheduler) \
+                        or rep.engine.scheduler.n_active():
+                    # the worker-death chaos site, hit only on passes
+                    # with work so seeded after=N plans count decode
+                    # activity, not idle parks
+                    _faults.maybe_fault("serving.worker",
+                                        replica=rep.idx)
+                rep.engine.run_iteration()
         except BaseException as e:   # noqa: BLE001 - worker death is a
-            # server-level event: mark degraded, unblock every waiter
-            self._worker_died = True
+            # replica-level event: evacuate + resurrect elsewhere
+            self._on_worker_death(rep, e)
+
+    def _on_worker_death(self, rep: _GenReplica, exc: BaseException) -> None:
+        if self._stop.is_set():
             try:
-                self.engine.close()
+                rep.engine.close()
             except Exception:   # noqa: BLE001 - already dying
                 pass
-            import logging
-            logging.getLogger("mxnet_tpu.serving").error(
-                "generation worker thread died: %r — /healthz now "
-                "reports degraded (503); restart the server", e)
+            return
+        _LOG.error(
+            "generation worker %d died: %r — evacuating its sequences "
+            "to surviving replicas; supervisor restarting with backoff",
+            rep.idx, exc)
+        rep.dead = True          # router must not feed a dying engine
+        try:
+            queued, resident = rep.engine.evacuate()
+        except Exception:   # noqa: BLE001 - the engine is too broken
+            # even to evacuate: strand nothing — close() fails every
+            # stream it still holds so waiters unblock deterministically
+            queued, resident = [], []
+            try:
+                rep.engine.close()
+            except Exception:   # noqa: BLE001 - already beyond help
+                pass
+        for req in queued:
+            _metrics.SERVING_RECOVERIES_TOTAL.labels(site="queue").inc()
+            self._route(req, exclude=rep)
+        self._recover(resident, exc, "worker", exclude=rep)
+        self.supervisor.notify_death(rep.idx, exc)
+
+    def _recover(self, victims: Sequence[GenRequest],
+                 exc: BaseException, site: str,
+                 exclude: Optional[_GenReplica] = None) -> None:
+        """Resurrect slot-resident sequences from their stream
+        transcripts (exactly-once: deterministic greedy re-prefill +
+        the TokenStream index dedupe).  Each sequence carries a
+        recovery budget (the supervisor's restart budget, reused): a
+        deterministically-poisoned sequence that crashes every decode
+        step it joins must eventually FAIL with the underlying error,
+        not resurrect forever while churning its slot-mates."""
+        for req in victims:
+            if req.recoveries >= self.supervisor.max_restarts:
+                req.fail(MXNetError(
+                    f"sequence recovered {req.recoveries} times and "
+                    f"failed again ({exc!r}); recovery budget spent — "
+                    "failing it instead of resurrecting forever"))
+                REQUESTS_TOTAL.labels(status="error").inc()
+                continue
+            try:
+                r = make_recovery_request(req)
+            except MXNetError as e:
+                req.fail(e)
+                REQUESTS_TOTAL.labels(status="error").inc()
+                continue
+            _metrics.SERVING_RECOVERIES_TOTAL.labels(site=site).inc()
+            _metrics.SERVING_RECOVERED_TOKENS.inc(len(req.stream.tokens))
+            self._route(r, exclude=exclude)
+
+    def _route(self, req: GenRequest,
+               exclude: Optional[_GenReplica] = None) -> None:
+        """Hand an already-accepted request to a healthy replica, or
+        park it for the next restart — never shed, never drop."""
+        reps = sorted(
+            (rep for rep in self._replicas
+             if rep is not exclude and self._replica_alive(rep.idx)
+             and not rep.engine.scheduler.closed),
+            key=lambda rep: (len(rep.engine.scheduler)
+                             + rep.engine.scheduler.n_active()))
+        for rep in reps:
+            try:
+                rep.engine.submit_request(req, front=True)
+                return
+            except MXNetError:
+                continue                 # closed in a race: next
+        with self._lock:
+            if not self._degraded and not self._stop.is_set():
+                self._pending.append(req)
+                return
+        req.fail(DegradedError(
+            "sequence lost its worker and no replica is available "
+            "(server degraded/stopping)"))
+        REQUESTS_TOTAL.labels(status="error").inc()
+
+    def _spawn_replica(self, rid: int) -> None:
+        """Supervisor callback (after backoff): bring replica ``rid``
+        back — fresh engine from the factory when there is one, the
+        evacuated engine otherwise — and flush parked requests into
+        it."""
+        if self._stop.is_set() or self._degraded:
+            return
+        rep = self._replicas[rid]
+        late_q: List[GenRequest] = []
+        late_r: List[GenRequest] = []
+        if self._factory is not None:
+            eng = self._factory()
+            if self._warmup:
+                eng.warmup()
+            # a generate() that read the replica as alive just before
+            # the dead flag flipped may have queued into the old engine
+            # AFTER its evacuation — sweep once more before orphaning it
+            try:
+                late_q, late_r = rep.engine.evacuate()
+            except Exception:   # noqa: BLE001 - poisoned old engine
+                pass
+        else:
+            eng = rep.engine             # evacuated + buffers reset
+        eng.recovery_sink = self._recover
+        with self._lock:
+            if self._stop.is_set() or self._degraded:
+                return
+            rep.engine = eng
+            rep.dead = False
+            pending, self._pending = self._pending, []
+        self._spawn_thread(rep)
+        for req in late_q + pending:
+            try:
+                rep.engine.submit_request(req, front=True)
+            except MXNetError as e:
+                req.fail(e)
+                REQUESTS_TOTAL.labels(status="error").inc()
+        if late_r:
+            self._recover(late_r, MXNetError(
+                "worker died while the sequence was being admitted"),
+                "worker")
+
+    # -- breaker ------------------------------------------------------------
+    def _on_degraded(self, exc: BaseException) -> None:
+        self._degraded = True
+        err = DegradedError(
+            f"generation worker replicas died repeatedly "
+            f"({self.supervisor.max_restarts} restarts per replica "
+            f"spent); circuit breaker tripped — the server is degraded "
+            f"(last error: {exc!r}); reset_breaker() or restart")
+        with self._lock:
+            pending, self._pending = self._pending, []
+        for req in pending:
+            req.fail(err)
+            REQUESTS_TOTAL.labels(status="error").inc()
+        for rep in self._replicas:
+            try:
+                queued, resident = rep.engine.evacuate()
+            except Exception:   # noqa: BLE001 - poisoned engine
+                continue
+            for req in queued + resident:
+                req.fail(err)
+                REQUESTS_TOTAL.labels(status="error").inc()
+        _LOG.error(
+            "generation worker crash-loop: breaker tripped after %d "
+            "restarts/replica — /healthz now reports degraded (503); "
+            "reset_breaker() re-admits traffic (last error: %r)",
+            self.supervisor.max_restarts, exc)
+
+    def reset_breaker(self) -> None:
+        """Refill every restart budget and bring dead replicas back —
+        the operator's re-admit-traffic lever."""
+        if not self._started:
+            raise MXNetError("reset_breaker() on a stopped server — "
+                             "build and start a fresh one")
+        self.supervisor.reset()
+        self._degraded = False
+        for rep in self._replicas:
+            if not self._replica_alive(rep.idx):
+                self._spawn_replica(rep.idx)
 
     # -- introspection ------------------------------------------------------
     def describe(self) -> Dict[str, Any]:
         d = self.engine.describe()
-        d["worker_alive"] = self.healthy()
+        if self.replicas > 1:
+            d["slots"] = {
+                "max": sum(rep.engine.max_slots
+                           for rep in self._replicas),
+                "active": sum(rep.engine.scheduler.n_active()
+                              for rep in self._replicas),
+                "free": sum(len(rep.engine.cache.free_slots())
+                            for rep in self._replicas),
+            }
+            d["queue"] = {
+                "depth": sum(len(rep.engine.scheduler)
+                             for rep in self._replicas),
+                "limit": sum(rep.engine.scheduler.queue_limit
+                             for rep in self._replicas),
+            }
+        d["worker_alive"] = self.ready()
+        d["resilience"] = {
+            "replicas": self.replicas,
+            "workers_alive": sum(
+                1 for rep in self._replicas
+                if self._replica_alive(rep.idx)),
+            "draining": self._draining,
+            "pending_recoveries": len(self._pending),
+            "supervisor": self.supervisor.describe(),
+        }
         return d
+
+
+def serve_until_preempted(httpd: Any, *servers: Any,
+                          deadline_s: Optional[float] = None,
+                          poll_s: float = 0.2) -> bool:
+    """Run the HTTP front end until SIGTERM/SIGINT, then drain
+    gracefully — the zero-downtime rolling-restart contract:
+
+    1. the first signal (via :class:`~mxnet_tpu.preemption.
+       PreemptionGuard`) stops admissions: readiness flips 503 so the
+       balancer routes away, new requests shed 429 ``draining`` —
+       never a connection reset;
+    2. resident sequences/batches finish within ``deadline_s``
+       (default ``MXNET_SERVING_DRAIN_DEADLINE_S``) while liveness
+       stays 200;
+    3. the HTTP listener closes, the servers stop, and the caller
+       exits 0 (a second signal escalates through the guard — a wedged
+       drain is still killable).
+
+    Returns True when every accepted request finished inside the
+    budget (leftovers failed with structured shutdown errors).
+    """
+    from ..preemption import PreemptionGuard
+
+    if deadline_s is None:
+        deadline_s = float(getenv("MXNET_SERVING_DRAIN_DEADLINE_S", 30))
+    t = threading.Thread(target=httpd.serve_forever, daemon=True)
+    t.start()
+    drained = True
+    with PreemptionGuard() as guard:
+        while not guard.wait(poll_s):
+            pass
+        _LOG.warning(
+            "%s received: draining — admissions shed (429), readiness "
+            "503, finishing resident work within %.0fs",
+            guard.signal_name or "signal", deadline_s)
+        for s in servers:
+            s.start_drain()
+        deadline = time.monotonic() + float(deadline_s)
+        drained = False
+        while time.monotonic() < deadline:
+            if all(s.await_drained(0.2) for s in servers):
+                drained = True
+                break
+        httpd.shutdown()
+        for s in servers:
+            s.stop()
+    _LOG.warning("drain %s; exiting",
+                 "complete" if drained else
+                 "deadline exceeded (leftovers failed structurally)")
+    return drained

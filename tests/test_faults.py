@@ -103,8 +103,8 @@ def test_smoke_plan_parse_and_env(monkeypatch):
     # every known site is a real registered name
     assert set(faults.known_sites()) == {
         "checkpoint.write", "kvstore.send", "kvstore.recv",
-        "dataloader.worker", "serving.execute", "dispatch.op",
-        "trainer.step"}
+        "dataloader.worker", "serving.execute", "serving.worker",
+        "dispatch.op", "trainer.step"}
 
 
 def test_smoke_nan_kind_corrupts_tensor_sites_only():

@@ -23,6 +23,10 @@ Workloads:
   health       an SPMD micro-fit under a seeded NaN fault plan with a
                HealthGuard: health event counters, skip totals, the
                loss EMA gauge, and the fused-check latency histogram.
+  resilience   a replicated ModelServer plus a supervised
+               GenerationServer under seeded worker-kill / decode-fault
+               plans: recovery counters (by site), recovered tokens,
+               recovery latency, worker restarts, breaker gauge.
 
 Runs on the CPU backend by default so it works anywhere (pass
 ``--platform ambient`` to keep the environment's backend, e.g. the TPU
@@ -150,12 +154,57 @@ def _workload_health(steps: int) -> None:
     mx.waitall()
 
 
+def _workload_resilience(steps: int) -> None:
+    import numpy as onp
+    import mxnet_tpu as mx
+    from mxnet_tpu import faults, serving
+    from mxnet_tpu.gluon.model_zoo.gpt import GPTModel
+    from mxnet_tpu.serving import (DecodeModel, GenerationEngine,
+                                   GenerationServer)
+
+    # one-shot path: a seeded worker kill mid-batch — the request
+    # requeues, the worker restarts (restart + requeue families)
+    mx.random.seed(0)
+    net = mx.gluon.nn.Dense(4)
+    net.initialize()
+    net.hybridize()
+    net(mx.np.zeros((1, 8), dtype="float32"))
+    srv = serving.ModelServer(serving.load_served(net),
+                              policy=serving.BucketPolicy(
+                                  batch_buckets=(1, 2)),
+                              timeout_ms=1.0, restart_backoff_ms=10.0)
+    srv.start()
+    x = onp.ones(8, "f4")
+    with faults.fault_plan("serving.worker:times=1"):
+        for _ in range(max(steps, 2)):
+            srv.infer(x, timeout=30.0)
+    srv.stop()
+
+    # generation path: a seeded decode fault mid-stream — the sequence
+    # resurrects token-identically (recovery counters + latency)
+    gpt = GPTModel(vocab_size=97, num_layers=2, units=32,
+                   hidden_size=48, num_heads=4, max_length=64,
+                   dropout=0.0)
+    gpt.initialize(mx.init.Normal(1.0))
+    gpt(mx.np.zeros((1, 4), dtype="int32"))
+    eng = GenerationEngine(DecodeModel.from_block(gpt), max_slots=2,
+                           kv_buckets=(16, 32, 64), max_tokens=16)
+    eng.warmup()
+    with GenerationServer(eng) as gs:
+        with faults.fault_plan("serving.execute:after=3:times=1"):
+            stream = gs.generate(onp.arange(1, 5, dtype="int32"),
+                                 max_new_tokens=12)
+            stream.result(timeout=60)
+    mx.waitall()
+
+
 WORKLOADS = {
     "resnet_step": _workload_resnet_step,
     "mlp_fit": _workload_mlp_fit,
     "eager": _workload_eager,
     "bulk": _workload_bulk,
     "health": _workload_health,
+    "resilience": _workload_resilience,
 }
 
 

@@ -24,6 +24,13 @@ Knobs default from the MXNET_SERVING_* env tier, plus MXNET_GEN_* for
 traced batch size; export with ``dynamic_batch=True`` for the full
 bucket grid.  --generate serves a LIVE decoder LM (zoo GPT, optionally
 with --gpt-params weights) through the resident decode loop.
+
+Resilience (docs/serving.md#resilience): --replicas N hosts N worker
+replicas (dead workers requeue/recover their requests and restart with
+backoff behind a circuit breaker), and SIGTERM/SIGINT triggers a
+graceful drain — admissions shed 429, resident work finishes inside
+MXNET_SERVING_DRAIN_DEADLINE_S, readiness 503 / liveness 200
+throughout, exit 0.
 """
 import argparse
 import os
@@ -61,6 +68,13 @@ def main(argv=None) -> None:
                     help="comma list of padded lengths for --pad-axis")
     ap.add_argument("--no-warmup", action="store_true",
                     help="skip pre-compiling the bucket grid at startup")
+    ap.add_argument("--replicas", type=int, default=None,
+                    help="worker replicas (MXNET_SERVING_REPLICAS): a "
+                         "dead worker's requests requeue/recover onto "
+                         "the survivors while it restarts")
+    ap.add_argument("--drain-deadline-s", type=float, default=None,
+                    help="graceful-drain budget on SIGTERM/SIGINT "
+                         "(MXNET_SERVING_DRAIN_DEADLINE_S)")
     ap.add_argument("--generate", action="store_true",
                     help="serve a decoder LM through the continuous-"
                          "batching generation engine (POST /v1/generate "
@@ -131,7 +145,8 @@ def main(argv=None) -> None:
     server = serving.ModelServer(model, policy,
                                  timeout_ms=args.batch_timeout_ms,
                                  queue_limit=args.queue_limit,
-                                 warmup=not args.no_warmup)
+                                 warmup=not args.no_warmup,
+                                 replicas=args.replicas)
     if server.warmed:
         print(f"warmup: {server.warmed} bucket signatures pre-compiled")
     server.start()
@@ -139,14 +154,17 @@ def main(argv=None) -> None:
                                      verbose=args.verbose)
     host, port = httpd.server_address[:2]
     print(f"serving on http://{host}:{port}  "
-          f"(POST /v1/inference, GET /metrics, /healthz, /v1/model)")
-    try:
-        httpd.serve_forever()
-    except KeyboardInterrupt:
-        pass
-    finally:
-        httpd.shutdown()
-        server.stop()
+          f"(POST /v1/inference, GET /metrics, /healthz, /livez, "
+          f"/v1/model; {server.replicas} worker replica(s))",
+          flush=True)
+    # SIGTERM/SIGINT drains: admissions shed 429, resident work
+    # finishes inside the deadline, readiness 503 / liveness 200, then
+    # a clean exit — the zero-downtime rolling-restart contract
+    drained = serving.serve_until_preempted(
+        httpd, server, deadline_s=args.drain_deadline_s)
+    print(f"drain {'complete' if drained else 'deadline exceeded'}; "
+          "bye", flush=True)
+    sys.exit(0 if drained else 1)
 
 
 def _serve_generate(args, serving) -> None:
@@ -174,29 +192,36 @@ def _serve_generate(args, serving) -> None:
     model = serving.DecodeModel.from_block(net)
     kv = ([int(b) for b in args.kv_buckets.split(",")]
           if args.kv_buckets else None)
-    engine = serving.GenerationEngine(model, max_slots=args.max_slots,
-                                      kv_buckets=kv,
-                                      queue_limit=args.queue_limit)
-    gs = serving.GenerationServer(engine, warmup=not args.no_warmup)
+
+    def engine_factory():
+        # one engine per worker replica; the shared DecodeModel means
+        # replicas (and restarts) reuse the same compiled programs
+        return serving.GenerationEngine(model, max_slots=args.max_slots,
+                                        kv_buckets=kv,
+                                        queue_limit=args.queue_limit)
+
+    gs = serving.GenerationServer(engine_factory=engine_factory,
+                                  replicas=args.replicas,
+                                  warmup=not args.no_warmup)
+    engine = gs.engine
     if engine.warmed:
         print(f"warmup: {engine.warmed} programs pre-compiled "
               f"(prefill buckets {list(engine.prompt_buckets)}, "
               f"KV buckets {list(engine.grid)}, "
-              f"{engine.max_slots} slots)")
+              f"{engine.max_slots} slots x {gs.replicas} replica(s))")
     gs.start()
     httpd = serving.make_http_server(None, args.host, args.port,
                                      verbose=args.verbose,
                                      generation_server=gs)
     host, port = httpd.server_address[:2]
     print(f"serving on http://{host}:{port}  (POST /v1/generate "
-          "[streaming], GET /metrics, /healthz, /v1/model)")
-    try:
-        httpd.serve_forever()
-    except KeyboardInterrupt:
-        pass
-    finally:
-        httpd.shutdown()
-        gs.stop()
+          "[streaming], GET /metrics, /healthz, /livez, /v1/model; "
+          f"{gs.replicas} worker replica(s))", flush=True)
+    drained = serving.serve_until_preempted(
+        httpd, gs, deadline_s=args.drain_deadline_s)
+    print(f"drain {'complete' if drained else 'deadline exceeded'}; "
+          "bye", flush=True)
+    sys.exit(0 if drained else 1)
 
 
 if __name__ == "__main__":
