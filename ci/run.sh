@@ -26,6 +26,13 @@
 #                           #   SIGTERM under 8-client load -> clean
 #                           #   drain (429 sheds, readiness 503 /
 #                           #   liveness 200, exit 0)
+#   ci/run.sh dist-resilience-smoke # elastic distributed training
+#                           #   gate: seeded ps.server crash mid-
+#                           #   training at 2 workers -> supervised
+#                           #   restart + snapshot restore + exactly-
+#                           #   once parity; worker kill -> auto-
+#                           #   resume completes exactly; restart-
+#                           #   budget exhaustion degrades (exit 70)
 #   ci/run.sh chaos-smoke   # bounded fault-injection/preemption proof
 #                           #   (tests/test_faults.py -k smoke)
 #   ci/run.sh health-smoke  # training health guard acceptance: seeded
@@ -120,6 +127,13 @@ run_resilience_smoke() {
   JAX_PLATFORMS=cpu timeout 600 python tools/resilience_smoke.py
 }
 
+run_dist_resilience_smoke() {
+  echo "== dist-resilience-smoke: seeded PS crash -> supervised restart"
+  echo "   + snapshot restore + exactly-once parity; worker kill ->"
+  echo "   auto-resume exact; budget exhaustion degrades explicitly"
+  JAX_PLATFORMS=cpu timeout 600 python tools/dist_resilience_smoke.py
+}
+
 run_chaos_smoke() {
   echo "== chaos-smoke: bounded (~60s) fault-injection / preemption /"
   echo "   checkpoint-fallback / kvstore-timeout proof"
@@ -159,13 +173,15 @@ run_chaos() {
 
 run_tier1() {
   echo "== tier1: env-doc freshness + fault-site doc lint + serving"
-  echo "   smoke + generation smoke + resilience smoke + chaos smoke +"
-  echo "   health smoke + bulking smoke + the tier-1 pytest selection"
+  echo "   smoke + generation smoke + resilience smoke + dist-"
+  echo "   resilience smoke + chaos smoke + health smoke + bulking"
+  echo "   smoke + the tier-1 pytest selection"
   run_envdoc
   run_faultdoc
   run_serving_smoke
   run_generation_smoke
   run_resilience_smoke
+  run_dist_resilience_smoke
   run_chaos_smoke
   run_health_smoke
   run_bulk_smoke
@@ -261,6 +277,7 @@ case "$variant" in
   serving-smoke) run_serving_smoke ;;
   generation-smoke) run_generation_smoke ;;
   resilience-smoke) run_resilience_smoke ;;
+  dist-resilience-smoke) run_dist_resilience_smoke ;;
   chaos-smoke)  run_chaos_smoke ;;
   health-smoke) run_health_smoke ;;
   chaos)        run_chaos ;;

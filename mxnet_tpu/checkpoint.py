@@ -23,6 +23,14 @@ capacity, durability hardening:
 Works with anything exposing ``save_checkpoint(prefix)`` /
 ``load_checkpoint(prefix)`` (SPMDTrainer), or a (block, trainer) pair
 (gluon save_parameters + Trainer.save_states).
+
+:class:`CoordinatedCheckpointManager` extends the manager to a
+**cluster**: a two-phase mark-then-commit rendezvous (backed by the
+dist_async parameter service's ``C`` command, or any object with
+``ckpt_mark(step) -> agreed`` / ``ckpt_commit(step)``) makes every
+rank agree on ONE checkpoint step before any rank treats it as
+resumable — a restarted cluster always resumes from one consistent
+step, never a mix.
 """
 from __future__ import annotations
 
@@ -39,7 +47,7 @@ from .base import MXNetError
 from . import metrics as _metrics
 from . import faults as _faults
 
-__all__ = ["CheckpointManager"]
+__all__ = ["CheckpointManager", "CoordinatedCheckpointManager"]
 
 # Staging dirs carry a recognizable prefix so the orphan sweep can never
 # touch user data; plain 'tmpXXXXXXXX' dirs (pre-hardening staging) are
@@ -71,6 +79,12 @@ CHECKPOINT_ORPHANS = _metrics.counter(
     "mxnet_checkpoint_orphan_sweeps_total",
     "Orphaned staging tempdirs (crash mid-save) removed by the "
     "CheckpointManager __init__ sweep.")
+CKPT_COORD_SECONDS = _metrics.histogram(
+    "mxnet_ckpt_coordination_seconds",
+    "Wall time this rank spent blocked in the coordinated-checkpoint "
+    "rendezvous (CoordinatedCheckpointManager), by phase: mark = "
+    "agreeing on the step, commit = waiting for every rank's save, "
+    "restore = agreeing on the resume step.", labels=("phase",))
 
 
 def _fsync_dir(path: str) -> None:
@@ -193,6 +207,10 @@ class CheckpointManager:
                 return step
         return None
 
+    def _protected_steps(self, meta: dict, just_saved: int) -> set:
+        """Steps retention must never prune (see save())."""
+        return {just_saved}
+
     # -- save / restore ----------------------------------------------------
     def save(self, target: Any, step: int,
              block: Optional[Any] = None) -> str:
@@ -241,9 +259,14 @@ class CheckpointManager:
         # retention: the just-saved step is verified-good by construction
         # (its digests were computed from the staged, fsynced bytes), so
         # pruning oldest-first while keeping it can never remove the last
-        # verified checkpoint
+        # verified checkpoint.  Subclasses can protect more steps (the
+        # coordinated manager keeps the newest cluster-committed step).
+        protected = self._protected_steps(meta, step)
         while len(meta["checkpoints"]) > self.max_to_keep:
-            old = next(s for s in meta["checkpoints"] if s != step)
+            old = next((s for s in meta["checkpoints"]
+                        if s not in protected), None)
+            if old is None:
+                break
             meta["checkpoints"].remove(old)
             meta["digests"].pop(str(old), None)
             for f in os.listdir(self.directory):
@@ -308,3 +331,113 @@ class CheckpointManager:
             raise MXNetError(
                 "target needs load_checkpoint(), or pass block=")
         return step
+
+
+class CoordinatedCheckpointManager(CheckpointManager):
+    """Cluster-consistent checkpoints: two-phase mark-then-commit over a
+    coordinator (the dist_async kvstore client, or anything exposing
+    ``ckpt_mark(step) -> agreed_step`` and ``ckpt_commit(step)``).
+
+    * ``save(target, step)``: **mark** — block until every rank
+      proposed its step, all ranks receive the agreed step (the min
+      proposed); save locally under the agreed label; **commit** —
+      block until every rank's save is durably on disk, then record
+      the step as *committed* in this rank's ``checkpoint.json``.
+      Until a step commits, no rank treats it as resumable, so a
+      crash between any two ranks' saves can never strand the cluster
+      on a half-written cluster checkpoint.
+    * ``restore(target)``: each rank proposes its newest committed
+      (falling back to newest verified) local step through the same
+      mark rendezvous; everyone restores the agreed **min** — one
+      consistent step cluster-wide, or a cluster-wide fresh start
+      when any rank has nothing (a half-resumed cluster is worse
+      than a restart).
+    * retention additionally protects the newest committed step, so a
+      rank can never prune the only state the *cluster* can agree on.
+
+    All ranks must call save/restore in the same order (the SPMD
+    discipline both ``fit`` loops already follow); a dead rank is
+    named in a structured error instead of hanging the rendezvous
+    (heartbeat lease, ``MXNET_PS_HEARTBEAT_DEADLINE_S``).
+    """
+
+    def __init__(self, directory: str, coordinator: Any,
+                 max_to_keep: int = 5) -> None:
+        super().__init__(directory, max_to_keep=max_to_keep)
+        for attr in ("ckpt_mark", "ckpt_commit"):
+            if not callable(getattr(coordinator, attr, None)):
+                raise MXNetError(
+                    "coordinator needs ckpt_mark(step)/ckpt_commit"
+                    "(step) — pass the dist_async kvstore client")
+        self.coordinator = coordinator
+
+    # -- committed bookkeeping ---------------------------------------------
+    def _committed(self, meta: dict) -> List[int]:
+        return [s for s in meta.get("committed", [])
+                if s in meta["checkpoints"]]
+
+    @property
+    def committed_steps(self) -> List[int]:
+        return self._committed(self._read_meta())
+
+    def _protected_steps(self, meta: dict, just_saved: int) -> set:
+        protected = {just_saved}
+        committed = self._committed(meta)
+        if committed:
+            protected.add(max(committed))
+        return protected
+
+    # -- save / restore ----------------------------------------------------
+    def save(self, target: Any, step: int,
+             block: Optional[Any] = None) -> str:
+        t0 = time.perf_counter()
+        agreed = int(self.coordinator.ckpt_mark(int(step)))
+        CKPT_COORD_SECONDS.labels(phase="mark").observe(
+            time.perf_counter() - t0)
+        prefix = super().save(target, agreed, block=block)
+        t1 = time.perf_counter()
+        self.coordinator.ckpt_commit(agreed)
+        CKPT_COORD_SECONDS.labels(phase="commit").observe(
+            time.perf_counter() - t1)
+        # only now — every rank's save is on disk — the step becomes
+        # resumable on this rank
+        meta = self._read_meta()
+        committed = self._committed(meta)
+        if agreed not in committed:
+            committed.append(agreed)
+        meta["committed"] = sorted(committed)
+        self._write_meta(meta)
+        return prefix
+
+    def restore(self, target: Any, step: Optional[int] = None,
+                block: Optional[Any] = None) -> Optional[int]:
+        if step is not None:             # explicit step: no rendezvous
+            return super().restore(target, step, block=block)
+        meta = self._read_meta()
+        candidate: Optional[int] = None
+        for s in reversed(self._committed(meta)):
+            if self.verify(s, meta):
+                candidate = s
+                break
+        if candidate is None:
+            # no committed step on this rank (first run, or a crash
+            # before any commit): offer the newest verified local step
+            # — the min rule still yields a cluster-consistent answer
+            candidate = self._last_verified(meta)
+        t0 = time.perf_counter()
+        agreed = int(self.coordinator.ckpt_mark(
+            -1 if candidate is None else candidate))
+        CKPT_COORD_SECONDS.labels(phase="restore").observe(
+            time.perf_counter() - t0)
+        if agreed < 0:
+            return None                  # cluster-wide fresh start
+        if agreed not in meta["checkpoints"] \
+                or not self.verify(agreed, meta):
+            raise MXNetError(
+                f"coordinated restore: the cluster agreed on step "
+                f"{agreed} but this rank's directory {self.directory} "
+                f"has no verified checkpoint for it (have "
+                f"{meta['checkpoints']}) — restore the rank's state "
+                "or clear every rank's checkpoint directory for a "
+                "clean cluster restart")
+        return super().restore(target, agreed, block=block)

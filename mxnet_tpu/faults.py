@@ -22,6 +22,11 @@ documented in docs/fault_tolerance.md):
 * ``serving.worker``    — the serving worker loop itself (worker-death
   chaos: an error here kills the worker thread, exercising the replica
   supervisor's requeue/recover/restart/breaker path)
+* ``ps.server``         — the dist_async parameter-server serve loop
+  (``kind=crash`` kills the server process, the chaos lever behind the
+  durable-PS / supervised-restart proof)
+* ``worker.heartbeat``  — the dist_async worker heartbeat thread (an
+  error here suppresses the beat: the wedged-not-dead rank simulation)
 * ``dispatch.op``       — the imperative op dispatch path, per op
 * ``trainer.step``      — the optimizer-step boundary, per step (the
   tensor-corrupting site: ``kind=nan`` plants a NaN via
@@ -132,6 +137,20 @@ _SITES: Dict[str, str] = {
         "worker thread, the in-process worker-death analog the replica "
         "supervisor trains against (requeue/recover + restart + "
         "circuit breaker)",
+    "ps.server":
+        "the dist_async parameter-server serve loop (per received "
+        "frame, OUTSIDE the per-request error handling that would "
+        "convert an exception into an error reply): kind=crash "
+        "os._exits the server process — the SIGKILL analog the launch "
+        "supervisor + durable snapshot restore train against — and "
+        "kind=error kills the serve loop itself; seedable like "
+        "serving.worker",
+    "worker.heartbeat":
+        "the dist_async worker heartbeat thread, per (tick, server): "
+        "an injected error SUPPRESSES that beat, simulating a "
+        "wedged-not-dead rank whose lease expires so barriers and "
+        "coordinated checkpoints name it DEAD within "
+        "MXNET_PS_HEARTBEAT_DEADLINE_S",
     "dispatch.op":
         "the imperative op dispatch path (ndarray.register.invoke), "
         "per op call",

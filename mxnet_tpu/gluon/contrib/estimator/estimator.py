@@ -98,7 +98,15 @@ class Estimator:
         first batch, a checkpoint is written every ``checkpoint_every``
         steps (0: only at the end / on preemption), and a
         SIGTERM/SIGINT finishes the in-flight batch, checkpoints, and
-        returns cleanly.  Idempotence under kill-and-restart holds for
+        returns cleanly.  A
+        :class:`~mxnet_tpu.checkpoint.CoordinatedCheckpointManager`
+        (over a ``dist_async`` kvstore) slots in unchanged: the
+        cluster then agrees on one checkpoint step via the two-phase
+        mark/commit rendezvous before any rank commits it — for
+        Hogwild ranks running at different paces the agreed label is
+        the min proposed step (the cluster-consistent floor) — and a
+        restarted cluster resumes every rank from the same committed
+        step.  Idempotence under kill-and-restart holds for
         ``batches``-mode, where ``batches`` counts TOTAL optimizer
         steps across restarts; ``epochs``-mode resumes the weights and
         optimizer state but restarts its epoch count (epoch progress is
@@ -164,8 +172,13 @@ class Estimator:
             step = int(self.trainer._optimizer.num_update)
             if step == last_saved[0]:
                 return                  # already checkpointed this step
-            checkpoint_manager.save(self.trainer, step=step,
-                                    block=self.net)
+            # watchdog-armed: a coordinated save blocks in the cluster
+            # rendezvous until every rank arrives — a wedged peer dumps
+            # stacks (and a DEAD one is named) instead of hanging here
+            from ....health import watch_section
+            with watch_section("checkpoint.save", step=step):
+                checkpoint_manager.save(self.trainer, step=step,
+                                        block=self.net)
             last_saved[0] = step
 
         stop = False

@@ -35,6 +35,34 @@ deferred mass), blockwise int8, or bf16/fp16. The server decodes before
 applying. Servers bind the interface implied by ``DMLC_PS_ROOT_URI``
 (loopback under the local launcher) and, when ``MXNET_PS_TOKEN`` is
 set, reject frames without the shared token.
+
+Elastic-training contract (the MXNet paper's PS rationale — durable
+server state, restartable workers — made real; docs/fault_tolerance.md
+"Elastic distributed training"):
+
+* **Durable PS**: with ``MXNET_PS_SNAPSHOT_DIR`` set, each server
+  snapshots its key table + server-side optimizer state + push-dedupe
+  table through :class:`~mxnet_tpu.checkpoint.CheckpointManager`
+  (fsync + SHA-256) every ``MXNET_PS_SNAPSHOT_EVERY`` applied pushes,
+  and ``run_server`` restores the newest verified snapshot on start —
+  a restarted server comes back with its weights, not empty.
+* **Generation token**: every reply frame carries the server's
+  ``gen`` (a snapshot-persisted incarnation counter).  Workers detect
+  a restart as a generation change, re-``init`` any keys the snapshot
+  missed (init is first-wins, so restored keys are untouched),
+  re-ship the optimizer config if the snapshot predates it, and their
+  per-worker push ``seq`` numbers (persisted in the snapshot) let the
+  server drop replayed pushes instead of double-applying them.
+* **Liveness**: workers piggyback their rank on every frame and send
+  idle-period ``HEARTBEAT`` frames on a dedicated connection; a rank
+  whose lease goes stale past ``MXNET_PS_HEARTBEAT_DEADLINE_S`` is
+  named **dead** in structured barrier / coordinated-checkpoint
+  errors long before the full recv timeout would expire.
+* **Coordinated checkpoints**: the ``C`` command is a two-phase
+  mark-then-commit rendezvous (:meth:`KVStoreDistAsync.ckpt_mark` /
+  :meth:`~KVStoreDistAsync.ckpt_commit`) backing
+  :class:`~mxnet_tpu.checkpoint.CoordinatedCheckpointManager` — all
+  ranks agree on one checkpoint step before any rank commits it.
 """
 from __future__ import annotations
 
@@ -65,6 +93,57 @@ register_env(
     "Barrier RPCs automatically widen to MXNET_PS_BARRIER_TIMEOUT.")
 
 register_env(
+    "MXNET_PS_SNAPSHOT_DIR", "",
+    "Durable parameter-server state: when set, each dist_async server "
+    "snapshots its key table + server-side optimizer state + push-"
+    "dedupe table into '<dir>/server-<sid>/' through CheckpointManager "
+    "(fsync + SHA-256 verified) and restores the newest verified "
+    "snapshot on start, so a restarted server resumes with state "
+    "instead of empty.  Workers in the same job (same env) detect the "
+    "restart via the server generation token and transparently re-init "
+    "only the keys the snapshot missed.  Empty (default) keeps the "
+    "PR-3 loud-failure behavior: a restarted server raises "
+    "'uninitialized key' on the first push.")
+
+register_env(
+    "MXNET_PS_SNAPSHOT_EVERY", 200,
+    "Applied pushes between automatic parameter-server snapshots when "
+    "MXNET_PS_SNAPSHOT_DIR is set (plus one snapshot at startup to "
+    "persist the new generation).  Smaller = tighter bound on the "
+    "update window a server crash can lose, at more disk traffic.")
+
+register_env(
+    "MXNET_PS_HEARTBEAT_INTERVAL_S", 2.0,
+    "Period of the dist_async worker heartbeat thread (rank -> every "
+    "server, a dedicated connection so a long barrier wait cannot "
+    "starve the lease).  Every ordinary frame also refreshes the "
+    "lease.  0 disables heartbeats (dead ranks are then only surfaced "
+    "by the full recv/barrier timeouts).")
+
+register_env(
+    "MXNET_PS_HEARTBEAT_DEADLINE_S", 10.0,
+    "Heartbeat lease: a worker rank not heard from (heartbeat or any "
+    "frame) for this long is declared DEAD, and blocked barrier / "
+    "coordinated-checkpoint waits abandon with a structured error "
+    "naming the dead rank(s) instead of waiting out "
+    "MXNET_PS_BARRIER_TIMEOUT or the 300 s recv timeout.  0 disables "
+    "the early naming.")
+
+register_env(
+    "MXNET_LAUNCH_MAX_RESTARTS", 3,
+    "Per-process restart budget for tools/launch.py --supervise: a "
+    "dead server or worker child is restarted (jittered exponential "
+    "backoff, MXNET_LAUNCH_RESTART_BACKOFF_MS) at most this many "
+    "times; past it the launcher degrades explicitly — structured "
+    "error, whole job terminated — instead of crash-looping.")
+
+register_env(
+    "MXNET_LAUNCH_RESTART_BACKOFF_MS", 500,
+    "First-restart backoff for tools/launch.py --supervise child "
+    "restarts; doubles per restart of the same process (jittered, "
+    "shared schedule with MXNET_RETRY_* via retry.backoff_delays).")
+
+register_env(
     "MXNET_PS_PORT_FILE", "",
     "Path prefix for dist_async parameter-server port publication: "
     "server ID s binds its requested port (or an OS-assigned one when "
@@ -79,6 +158,44 @@ PS_RECV_TIMEOUTS = _metrics.counter(
     "dist_async worker RPCs that timed out waiting for a parameter-"
     "server reply (MXNET_PS_RECV_TIMEOUT) and raised a structured "
     "error.")
+PS_SNAPSHOTS = _metrics.counter(
+    "mxnet_ps_snapshots_total",
+    "Durable parameter-server state snapshots written "
+    "(MXNET_PS_SNAPSHOT_DIR / MXNET_PS_SNAPSHOT_EVERY).")
+PS_RESTORES = _metrics.counter(
+    "mxnet_ps_restores_total",
+    "Parameter-server starts that restored a verified state snapshot "
+    "(a restart came back with weights instead of empty).")
+PS_GENERATION = _metrics.gauge(
+    "mxnet_ps_server_generation",
+    "This parameter-server process's generation token (snapshot-"
+    "persisted incarnation counter; workers detect a restart as a "
+    "change).")
+PS_DEDUPED_PUSHES = _metrics.counter(
+    "mxnet_ps_deduped_pushes_total",
+    "Replayed worker pushes the server acknowledged but did NOT apply "
+    "(per-worker seq already seen — exactly-once across reconnects "
+    "and snapshot-restored restarts).")
+PS_HEARTBEAT_AGE = _metrics.gauge(
+    "mxnet_ps_heartbeat_age_seconds",
+    "Seconds since the parameter server last heard from each worker "
+    "rank (heartbeat or any frame); refreshed when liveness is "
+    "checked.", labels=("rank",))
+DIST_DEAD_RANKS = _metrics.gauge(
+    "mxnet_dist_dead_ranks",
+    "Ranks currently past the heartbeat lease "
+    "(MXNET_PS_HEARTBEAT_DEADLINE_S) as seen by this parameter "
+    "server, by role.", labels=("role",))
+DIST_RANK_RESTARTS = _metrics.counter(
+    "mxnet_dist_rank_restarts_total",
+    "Dead server/worker processes restarted by the launch supervisor "
+    "(tools/launch.py --supervise), by role.", labels=("role",))
+
+# Per-stream cap on the out-of-order push dedupe window (gap seqs kept
+# applicable below the high-water mark).  Far above any real in-flight
+# window — the wire is serialized per (client, server) — so only
+# phantom gaps from a snapshot older than the live stream ever hit it.
+_SEQ_GAP_CAP = 512
 
 _MAGIC = b"MXPS"
 # Slice-subkey separator for PSKV big-array slicing.  Contains the ASCII
@@ -300,6 +417,23 @@ class _Handler(socketserver.BaseRequestHandler):
         try:
             while True:
                 cmd, header, payload = _recv_frame(self.request)
+                # the ps.server chaos site fires OUTSIDE the per-request
+                # error handling below: kind=crash os._exits the server
+                # process (the SIGKILL analog the supervisor + snapshot
+                # restore train against), kind=error kills the serve
+                # loop itself.  Seedable like serving.worker: hits count
+                # per received frame, EXCLUDING heartbeats — their
+                # cadence is wall-clock-dependent and would perturb the
+                # deterministic schedule (the serving.worker busy-pass
+                # gate precedent).
+                if _faults._ARMED and cmd != b"T":
+                    try:
+                        _faults.maybe_fault("ps.server",
+                                            cmd=cmd.decode("latin1"))
+                    except Exception:
+                        threading.Thread(target=self.server.shutdown,
+                                         daemon=True).start()
+                        return
                 import hmac
                 if srv.token and not hmac.compare_digest(
                         str(header.pop("tok", "") or ""), srv.token):
@@ -310,8 +444,12 @@ class _Handler(socketserver.BaseRequestHandler):
                     _send_frame(self.request, b"E",
                                 {"error": "bad or missing auth token"})
                     return
+                srv.note_heard(header.get("wrank"))
                 if cmd == b"S":
-                    _send_frame(self.request, b"K", {})
+                    srv.stop_requested = True
+                    srv.snapshot()        # graceful stop is lossless
+                    _send_frame(self.request, b"K",
+                                {"gen": srv.generation})
                     threading.Thread(target=self.server.shutdown,
                                      daemon=True).start()
                     return
@@ -319,7 +457,12 @@ class _Handler(socketserver.BaseRequestHandler):
                     reply = srv.handle(cmd, header, payload)
                 except Exception as e:   # report, keep the connection
                     reply = (b"E", {"error": str(e)}, b"")
-                _send_frame(self.request, *reply)
+                rcmd, rhdr, rpayload = reply
+                # every reply carries the server's generation token so
+                # workers detect a restarted server on their next RPC
+                rhdr = dict(rhdr)
+                rhdr.setdefault("gen", srv.generation)
+                _send_frame(self.request, rcmd, rhdr, rpayload)
         except (ConnectionError, OSError):
             return
 
@@ -329,16 +472,39 @@ class _TCPServer(socketserver.ThreadingTCPServer):
     daemon_threads = True
 
 
+class _PSSnapshotIO:
+    """``save_checkpoint``/``load_checkpoint`` adapter so a PSServer
+    state snapshot rides :class:`~mxnet_tpu.checkpoint.CheckpointManager`
+    unchanged (staging + fsync + per-file SHA-256 + verified-fallback
+    restore)."""
+
+    def __init__(self, ps: "PSServer") -> None:
+        self.ps = ps
+        self.loaded: Optional[Dict[str, Any]] = None
+
+    def save_checkpoint(self, prefix: str) -> None:
+        import pickle
+        with open(prefix + ".psstate", "wb") as f:
+            pickle.dump(self.ps._snapshot_payload(), f)
+
+    def load_checkpoint(self, prefix: str) -> None:
+        import pickle
+        with open(prefix + ".psstate", "rb") as f:
+            self.loaded = pickle.load(f)
+
+
 class PSServer:
     """In-process parameter server state + request handler
     (``KVStoreDistServer`` analog)."""
 
-    def __init__(self, num_workers: int) -> None:
+    def __init__(self, num_workers: int, server_id: int = 0) -> None:
         self.num_workers = num_workers
+        self.server_id = int(server_id)
         self.token = os.environ.get("MXNET_PS_TOKEN", "")
         self.store: Dict[str, onp.ndarray] = {}
         self.locks: Dict[str, threading.Lock] = {}
         self.updater = None                      # optimizer.Updater
+        self._opt_config: Optional[tuple] = None  # (name, params) as set
         self._global_lock = threading.Lock()
         self._barrier_lock = threading.Lock()
         self._barrier_cv = threading.Condition(self._barrier_lock)
@@ -346,12 +512,290 @@ class PSServer:
         self._barrier_gen = 0
         self._barrier_ranks: set = set()
         self.pushes = 0
+        # -- elastic-training state ----------------------------------------
+        self.generation = 1           # incarnation token (snapshot-persisted)
+        # push dedupe per "rank:cid" stream: high-water mark of applied
+        # seqs + the (small, transient) set of gap seqs below it still
+        # outstanding — concurrent client pushes can legitimately land
+        # out of order, and a reordered or retried lower seq must apply
+        # exactly once, not be mistaken for a replay
+        self.last_seq: Dict[str, int] = {}
+        self.seq_gaps: Dict[str, set] = {}
+        self.gap_evictions = 0
+        self._ckpt_committed = -1     # _restore_snapshot may overwrite
+        self.stop_requested = False   # set by a deliberate STOP ('S')
+        self.last_heard: Dict[int, float] = {}  # rank -> time.monotonic()
+        self._snap_lock = threading.Lock()
+        self._dirty_pushes = 0
+        self._snapshot_mgr = None
+        snap_dir = os.environ.get("MXNET_PS_SNAPSHOT_DIR", "")
+        if snap_dir:
+            from .checkpoint import CheckpointManager
+            self._snapshot_mgr = CheckpointManager(
+                os.path.join(snap_dir, f"server-{self.server_id}"),
+                max_to_keep=2)
+            self._restore_snapshot()
+        PS_GENERATION.set(self.generation)
+        # coordinated-checkpoint rendezvous (cmd 'C'): per-phase
+        # {rank: step} tables released barrier-style on the min step
+        self._ckpt_cv = threading.Condition()
+        self._ckpt_state: Dict[str, Dict[str, Any]] = {
+            "mark": {"vals": {}, "gen": 0, "agreed": None, "done": {}},
+            "commit": {"vals": {}, "gen": 0, "agreed": None, "done": {}}}
 
     def _lock_for(self, key: str) -> threading.Lock:
         with self._global_lock:
             if key not in self.locks:
                 self.locks[key] = threading.Lock()
             return self.locks[key]
+
+    # -- durable state -----------------------------------------------------
+    def _snapshot_payload(self) -> Dict[str, Any]:
+        """One consistent host-side copy of everything a restarted
+        server needs: key table, optimizer config + states + schedule
+        counts, the push-dedupe table, and the generation.  Taken under
+        ``_global_lock`` — store values are replaced (never mutated in
+        place) by updates, so a shallow dict copy is a consistent cut
+        even while Hogwild pushes continue on other keys."""
+        leaves: List[onp.ndarray] = []
+        with self._global_lock:
+            payload: Dict[str, Any] = {
+                "format": 1,
+                "generation": self.generation,
+                "pushes": self.pushes,
+                "last_seq": dict(self.last_seq),
+                "seq_gaps": {k: sorted(v)
+                             for k, v in self.seq_gaps.items()},
+                "store": dict(self.store),
+                "opt_config": self._opt_config,
+                "states": None, "specs": [], "raw": b"", "counts": None,
+                "ckpt_committed": self._ckpt_committed,
+            }
+            if self.updater is not None:
+                enc = {str(k): _enc_state(s, leaves)
+                       for k, s in self.updater.states.items()}
+                o = self.updater.optimizer
+                payload.update(
+                    states=enc,
+                    counts={"num_update": o.num_update,
+                            "index_update_count":
+                                {str(k): v for k, v
+                                 in o._index_update_count.items()}})
+        if leaves:
+            # the O(model-bytes) flatten happens OUTSIDE the lock:
+            # the refs collected above are immutable (updates rebind
+            # store values and state leaves, never write in place), so
+            # concurrent Hogwild pushes don't block on the encode and
+            # the cut stays consistent
+            specs, raw = _pack_leaves(leaves)
+            payload.update(specs=specs, raw=raw)
+        return payload
+
+    def snapshot(self) -> None:
+        """Write a durable state snapshot (no-op without
+        ``MXNET_PS_SNAPSHOT_DIR``).  Serialized: one snapshot at a
+        time; the step label is the applied-push count."""
+        if self._snapshot_mgr is None:
+            return
+        with self._snap_lock:
+            io = _PSSnapshotIO(self)
+            self._snapshot_mgr.save(io, step=self.pushes)
+            with self._global_lock:
+                self._dirty_pushes = 0
+        PS_SNAPSHOTS.inc()
+
+    def _restore_snapshot(self) -> None:
+        """Load the newest verified snapshot (if any) and advance the
+        generation past the incarnation that wrote it."""
+        io = _PSSnapshotIO(self)
+        if self._snapshot_mgr.restore(io) is None or io.loaded is None:
+            return                                # fresh start, gen 1
+        p = io.loaded
+        self.store = dict(p["store"])
+        self.last_seq = dict(p.get("last_seq", {}))
+        self.seq_gaps = {k: set(v)
+                         for k, v in p.get("seq_gaps", {}).items() if v}
+        self.pushes = int(p.get("pushes", 0))
+        self._ckpt_committed = int(p.get("ckpt_committed", -1))
+        cfg = p.get("opt_config")
+        if cfg is not None:
+            from . import optimizer as opt
+            name, params = cfg
+            self.updater = opt.get_updater(opt.create(name, **params))
+            self._opt_config = (name, dict(params))
+            if p.get("states"):
+                leaves = _unpack_leaves(p["specs"], p["raw"])
+                self.updater.states = {
+                    k: _dec_state(obj, leaves)
+                    for k, obj in p["states"].items()}
+            counts = p.get("counts")
+            if counts:
+                o = self.updater.optimizer
+                o.num_update = counts.get("num_update", 0)
+                o._index_update_count.update(
+                    counts.get("index_update_count", {}))
+        self.generation = int(p.get("generation", 0)) + 1
+        PS_RESTORES.inc()
+
+    def _note_push(self) -> None:
+        with self._global_lock:
+            self.pushes += 1
+            self._dirty_pushes += 1
+            due = (self._snapshot_mgr is not None
+                   and self._dirty_pushes >= int(
+                       getenv("MXNET_PS_SNAPSHOT_EVERY", 200)))
+        if due:
+            self.snapshot()
+
+    @staticmethod
+    def _seq_key(header: Dict[str, Any]) -> Optional[str]:
+        """Dedupe stream identity: rank + client incarnation id.  The
+        cid keeps a RESTARTED worker's fresh seq 1..N from colliding
+        with its dead predecessor's snapshot-persisted entries."""
+        rank, cid = header.get("wrank"), header.get("cid")
+        if rank is None or cid is None:
+            return None
+        return f"{rank}:{cid}"
+
+    def _seq_is_fresh(self, header: Dict[str, Any]) -> bool:
+        """True when this push was not applied before (by this or a
+        snapshot-restored previous incarnation); a replay is
+        acknowledged, not re-applied.  Sliding-window semantics: fresh
+        means above the stream's high-water mark OR one of the gap
+        seqs an out-of-order arrival left open below it.  The seq is
+        recorded AFTER the update lands (:meth:`_seq_record`) so a
+        snapshot can never capture the seq without its update — the
+        failure mode then degrades to the pre-dedupe double-apply
+        Hogwild tolerates, never to a lost update."""
+        key, seq = self._seq_key(header), header.get("seq")
+        if key is None or seq is None:
+            return True
+        seq = int(seq)
+        with self._global_lock:
+            fresh = seq > self.last_seq.get(key, 0) \
+                or seq in self.seq_gaps.get(key, ())
+        if not fresh:
+            PS_DEDUPED_PUSHES.inc()
+        return fresh
+
+    def _seq_record(self, header: Dict[str, Any]) -> None:
+        key, seq = self._seq_key(header), header.get("seq")
+        if key is None or seq is None:
+            return
+        seq = int(seq)
+        with self._global_lock:
+            hw = self.last_seq.get(key, 0)   # seq streams are 1-based
+            if seq > hw:
+                if seq > hw + 1:
+                    # arrivals the stream skipped over: keep them
+                    # applicable.  Real gaps are bounded by the
+                    # client's concurrently-pushing threads (the wire
+                    # is serialized per server) and resolve fast;
+                    # PHANTOM gaps — a restored snapshot older than
+                    # the live stream leaves seqs the dead incarnation
+                    # applied and will never re-send — would persist
+                    # forever, so the set is capped: the oldest
+                    # entries are evicted as already-applied.
+                    gaps = self.seq_gaps.setdefault(key, set())
+                    gaps.update(range(hw + 1, seq))
+                    if len(gaps) > _SEQ_GAP_CAP:
+                        for s in sorted(gaps)[:len(gaps)
+                                              - _SEQ_GAP_CAP]:
+                            gaps.discard(s)
+                            self.gap_evictions += 1
+                self.last_seq[key] = seq
+            else:
+                gaps = self.seq_gaps.get(key)
+                if gaps is not None:
+                    gaps.discard(seq)
+                    if not gaps:
+                        del self.seq_gaps[key]
+
+    # -- liveness ----------------------------------------------------------
+    def note_heard(self, rank: Any) -> None:
+        if rank is None:
+            return
+        with self._global_lock:
+            self.last_heard[int(rank)] = time.monotonic()
+
+    def _dead_ranks(self) -> List[int]:
+        """Ranks whose heartbeat lease expired.  A rank never heard
+        from at all is NOT dead (it may still be importing jax); the
+        lease only starts ticking after first contact."""
+        deadline = float(getenv("MXNET_PS_HEARTBEAT_DEADLINE_S", 10.0))
+        if deadline <= 0:
+            return []
+        now = time.monotonic()
+        with self._global_lock:
+            heard = dict(self.last_heard)
+        dead = []
+        for r, t in sorted(heard.items()):
+            age = now - t
+            PS_HEARTBEAT_AGE.labels(rank=str(r)).set(age)
+            if age > deadline:
+                dead.append(r)
+        DIST_DEAD_RANKS.labels(role="worker").set(len(dead))
+        return dead
+
+    # -- coordinated checkpoints (cmd 'C') ---------------------------------
+    def _ckpt_round(self, phase: str, rank: int, step: int,
+                    timeout: float, cround: Any = None) -> int:
+        """Barrier-style rendezvous: block until every worker proposed
+        a step for this ``phase`` round, then release everyone with the
+        agreed step (the min proposed — the cluster-consistent floor).
+        A dead rank abandons the round with a structured error naming
+        it; so does the timeout.  ``cround`` (the client's per-phase
+        round counter) makes the RPC idempotent: a replay whose round
+        already completed — the reply was lost on the wire — is
+        answered from the recorded result instead of re-proposing into
+        the NEXT round, which would strand every healthy rank across
+        two rounds that can each never fill."""
+        st = self._ckpt_state[phase]
+        rank = int(rank)
+        with self._ckpt_cv:
+            done = st["done"]
+            if cround is not None and \
+                    done.get(rank, (None, None))[0] == cround:
+                return done[rank][1]
+            st["vals"][rank] = int(step)
+            gen = st["gen"]
+            if len(st["vals"]) >= self.num_workers:
+                agreed = min(st["vals"].values())
+                st["agreed"] = agreed
+                st["vals"] = {}
+                st["gen"] += 1
+                done[rank] = (cround, agreed)
+                self._ckpt_cv.notify_all()
+                return agreed
+            deadline = time.monotonic() + timeout
+            while True:
+                remaining = deadline - time.monotonic()
+                if self._ckpt_cv.wait_for(
+                        lambda: st["gen"] != gen,
+                        timeout=min(1.0, max(0.0, remaining))):
+                    done[rank] = (cround, st["agreed"])
+                    return st["agreed"]
+                dead = sorted(set(self._dead_ranks())
+                              - set(st["vals"]))
+                if dead:
+                    st["vals"].pop(int(rank), None)
+                    raise MXNetError(
+                        f"coordinated checkpoint {phase} abandoned: "
+                        f"rank(s) {dead} are DEAD (no heartbeat for > "
+                        f"{getenv('MXNET_PS_HEARTBEAT_DEADLINE_S', 10.0)}"
+                        "s, MXNET_PS_HEARTBEAT_DEADLINE_S) — restart "
+                        "them (tools/launch.py --supervise does this "
+                        "automatically) and retry")
+                if remaining <= 0:
+                    st["vals"].pop(int(rank), None)
+                    arrived = sorted(st["vals"])
+                    missing = sorted(set(range(self.num_workers))
+                                     - set(arrived) - {int(rank)})
+                    raise MXNetError(
+                        f"coordinated checkpoint {phase} timed out "
+                        f"after {timeout:.0f}s: ranks {arrived} + "
+                        f"{rank} arrived, missing ranks {missing} "
+                        "(MXNET_PS_BARRIER_TIMEOUT to raise)")
 
     def handle(self, cmd: bytes, header: Dict[str, Any], payload: bytes):
         if cmd == b"I":                          # init (first wins)
@@ -362,6 +806,8 @@ class PSServer:
             return b"K", {}, b""
         if cmd == b"P":                          # push
             key = header["key"]
+            if not self._seq_is_fresh(header):
+                return b"K", {"dup": 1}, b""     # replay: ack, don't apply
             grad = _decode_entry(header, payload)
             with self._lock_for(key):
                 if key not in self.store:
@@ -374,8 +820,8 @@ class PSServer:
                     # no server-side optimizer: running sum (the pulled
                     # value is the sum of everything pushed since init)
                     self.store[key] = self.store[key] + grad
-            with self._global_lock:
-                self.pushes += 1
+            self._seq_record(header)
+            self._note_push()
             return b"K", {}, b""
         if cmd == b"G":                          # pull
             key = header["key"]
@@ -385,6 +831,8 @@ class PSServer:
                 hdr, raw = _arr_payload(self.store[key])
             return b"V", hdr, raw
         if cmd == b"p":                          # multi-key push
+            if not self._seq_is_fresh(header):
+                return b"K", {"dup": 1}, b""     # replay: ack, don't apply
             keys = header["keys"]
             grads = _unpack_leaves(header["specs"], payload)
             for key, grad in zip(keys, grads):
@@ -396,8 +844,8 @@ class PSServer:
                         self._apply_update(key, grad)
                     else:
                         self.store[key] = self.store[key] + grad
-                with self._global_lock:
-                    self.pushes += 1
+                self._note_push()
+            self._seq_record(header)
             return b"K", {}, b""
         if cmd == b"g":                          # multi-key pull
             keys = header["keys"]
@@ -415,12 +863,21 @@ class PSServer:
                 if self.updater is None:
                     raise MXNetError("no optimizer on this server")
                 o = self.updater.optimizer
+                applied = {}
                 for k, v in header.get("params", {}).items():
                     if k == "learning_rate":
                         o.lr = v
+                        applied[k] = v
                     elif hasattr(o, k) and isinstance(
                             getattr(o, k), (int, float, bool, type(None))):
                         setattr(o, k, v)
+                        applied[k] = v
+                # fold into the persisted optimizer config: a snapshot-
+                # restored server must come back with the LIVE schedule
+                # (lr decay etc.), not the job-start hyperparams
+                if self._opt_config is not None and applied:
+                    name, params = self._opt_config
+                    self._opt_config = (name, dict(params, **applied))
             return b"K", {}, b""
         if cmd == b"X":                          # fetch optimizer states
             with self._global_lock:
@@ -463,7 +920,31 @@ class PSServer:
             with self._global_lock:
                 o = opt.create(header["name"], **header.get("params", {}))
                 self.updater = opt.get_updater(o)
+                self._opt_config = (header["name"],
+                                    dict(header.get("params", {})))
             return b"K", {}, b""
+        if cmd == b"T":                          # heartbeat (lease refresh
+            return b"K", {}, b""                 # recorded in _Handler)
+        if cmd == b"C":                          # coordinated checkpoint
+            timeout = float(os.environ.get(
+                "MXNET_PS_BARRIER_TIMEOUT", "600"))
+            phase = header["phase"]
+            if phase not in ("mark", "commit"):
+                raise MXNetError(f"bad checkpoint phase {phase!r}")
+            agreed = self._ckpt_round(phase, int(header.get("rank", 0)),
+                                      int(header["step"]), timeout,
+                                      cround=header.get("cround"))
+            if phase == "commit":
+                with self._global_lock:
+                    newly = agreed > self._ckpt_committed
+                    if newly:
+                        self._ckpt_committed = agreed
+                if newly:
+                    # persist the commit record so a restarted server
+                    # still knows the cluster's consistent step
+                    self.snapshot()
+                return b"K", {"committed": agreed}, b""
+            return b"K", {"step": agreed}, b""
         if cmd == b"B":                          # barrier over all workers
             timeout = float(os.environ.get(
                 "MXNET_PS_BARRIER_TIMEOUT", "600"))
@@ -487,27 +968,62 @@ class PSServer:
                     self._barrier_gen += 1
                     self._barrier_cv.notify_all()
                 else:
-                    ok = self._barrier_cv.wait_for(
-                        lambda: self._barrier_gen != gen, timeout=timeout)
-                    if not ok:
-                        self._barrier_count -= 1
-                        # name the missing ranks: "who is holding the
-                        # job up" is THE question during an incident
+                    deadline = time.monotonic() + timeout
+                    while True:
+                        remaining = deadline - time.monotonic()
+                        if self._barrier_cv.wait_for(
+                                lambda: self._barrier_gen != gen,
+                                timeout=min(1.0, max(0.0, remaining))):
+                            break
                         arrived = sorted(self._barrier_ranks)
-                        if rank is not None:
-                            self._barrier_ranks.discard(int(rank))
-                        missing = sorted(
-                            set(range(self.num_workers)) - set(arrived))
-                        raise MXNetError(
-                            f"barrier timed out after {timeout:.0f}s: "
-                            f"{len(arrived)}/{self.num_workers} workers "
-                            f"arrived (ranks {arrived}), missing ranks "
-                            f"{missing} "
-                            "(MXNET_PS_BARRIER_TIMEOUT to raise)")
+                        # heartbeat lease: a DEAD missing rank is named
+                        # within MXNET_PS_HEARTBEAT_DEADLINE_S — the
+                        # waiters learn who to restart in seconds, not
+                        # after the full barrier/recv timeout
+                        dead = sorted(set(self._dead_ranks())
+                                      - set(arrived))
+                        if dead:
+                            self._barrier_count -= 1
+                            if rank is not None:
+                                self._barrier_ranks.discard(int(rank))
+                            raise MXNetError(
+                                f"barrier abandoned: rank(s) {dead} "
+                                "are DEAD (heartbeat lease > "
+                                f"{getenv('MXNET_PS_HEARTBEAT_DEADLINE_S', 10.0)}"
+                                "s old, MXNET_PS_HEARTBEAT_DEADLINE_S); "
+                                f"{len(arrived)}/{self.num_workers} "
+                                f"arrived (ranks {arrived}) — restart "
+                                "the dead rank(s) (tools/launch.py "
+                                "--supervise does this automatically)")
+                        if remaining <= 0:
+                            self._barrier_count -= 1
+                            # name the missing ranks: "who is holding
+                            # the job up" is THE question during an
+                            # incident
+                            if rank is not None:
+                                self._barrier_ranks.discard(int(rank))
+                            missing = sorted(
+                                set(range(self.num_workers))
+                                - set(arrived))
+                            raise MXNetError(
+                                f"barrier timed out after "
+                                f"{timeout:.0f}s: {len(arrived)}/"
+                                f"{self.num_workers} workers arrived "
+                                f"(ranks {arrived}), missing ranks "
+                                f"{missing} "
+                                "(MXNET_PS_BARRIER_TIMEOUT to raise)")
             return b"K", {}, b""
         if cmd == b"Q":                          # stats (introspection)
+            with self._global_lock:
+                seqs = dict(self.last_seq)
             return b"K", {"pushes": self.pushes,
-                          "keys": sorted(self.store)}, b""
+                          "keys": sorted(self.store),
+                          "has_optimizer": self.updater is not None,
+                          "generation": self.generation,
+                          "snapshots": self._snapshot_mgr is not None,
+                          "push_streams": seqs,
+                          "gap_evictions": self.gap_evictions,
+                          "ckpt_committed": self._ckpt_committed}, b""
         raise MXNetError(f"unknown PS command {cmd!r}")
 
     def _apply_update(self, key: str, grad: onp.ndarray) -> None:
@@ -563,8 +1079,16 @@ def run_server(port: int, num_workers: int,
     ``port=0`` binds an OS-assigned free port (never collides); the
     chosen port is published via ``MXNET_PS_PORT_FILE`` when set.  A
     fixed port retries briefly on ``EADDRINUSE`` (a just-killed
-    predecessor's socket lingering in TIME_WAIT)."""
-    ps = PSServer(num_workers)
+    predecessor's socket lingering in TIME_WAIT).
+
+    With ``MXNET_PS_SNAPSHOT_DIR`` set, the newest verified state
+    snapshot is restored before serving (generation bumps past the
+    incarnation that wrote it) and the new generation is persisted
+    immediately, so even a crash before the first periodic snapshot
+    cannot reuse a generation token."""
+    ps = PSServer(num_workers,
+                  server_id=int(os.environ.get("DMLC_SERVER_ID", "0")))
+    ps.snapshot()                 # durable: persist the new generation
     host = _bind_host()
     if port:
         server = retry_call(
@@ -579,6 +1103,16 @@ def run_server(port: int, num_workers: int,
         if ready_event is not None:
             ready_event.set()
         server.serve_forever(poll_interval=0.1)
+    if not ps.stop_requested:
+        # the serve loop died WITHOUT a deliberate STOP ('S') — an
+        # internal error or the ps.server chaos site.  Exit nonzero so
+        # a supervisor can tell this death from rank 0's graceful
+        # stop_servers by rc alone; SystemExit stays silent in the
+        # in-thread test harness but gives a server PROCESS rc=1 plus
+        # this line on stderr.
+        raise SystemExit(
+            f"parameter server {ps.server_id}: serve loop ended "
+            "without a STOP — treating as a death")
 
 
 # ---------------------------------------------------------------------------
@@ -619,6 +1153,38 @@ class KVStoreDistAsync:
         # payload bytes this worker pushed (post-compression) — the
         # wire-traffic introspection the tests assert against
         self.push_wire_bytes = 0
+        # -- elastic-training state ----------------------------------------
+        # restart recovery only engages when the job runs a durable PS
+        # (same env on workers and servers via the launcher); without
+        # it a restarted server keeps the PR-3 loud-failure contract
+        self._durable = bool(os.environ.get("MXNET_PS_SNAPSHOT_DIR", ""))
+        self._server_gen: List[Optional[int]] = [None] * self.num_servers
+        self._gen_lock = threading.Lock()
+        self._inits: Dict[str, tuple] = {}   # wire_key->(sidx, hdr, raw)
+        self._shipped_opt: Optional[tuple] = None      # (name, params)
+        # push-dedupe identity: one seq stream per (CLIENT INCARNATION,
+        # server).  Per incarnation because a restarted worker's fresh
+        # seq 1..N must not collide with its dead predecessor's entries
+        # in the server's snapshot-persisted table; per SERVER so each
+        # server sees a dense stream (a shared counter would leave
+        # permanent gaps for seqs routed elsewhere and grow the
+        # server's reorder window without bound)
+        self._client_id = os.urandom(8).hex()
+        self._seqs = [0] * self.num_servers
+        self._seq_lock = threading.Lock()
+        # per-phase coordinated-checkpoint round counters: ride every
+        # 'C' frame so a replayed RPC is answered idempotently (cid-
+        # prefixed — a restarted worker's counter restarts from 1)
+        self._ckpt_rounds = {"mark": 0, "commit": 0}
+        self._hb_thread: Optional[threading.Thread] = None
+        self._hb_stop = threading.Event()
+        self._hb_lock = threading.Lock()
+        # snapshot-gap recovery claim: thread ident of the one thread
+        # recovering each server (None = idle) + the Event concurrent
+        # RPC threads wait on before their one warranted replay
+        self._recovering: List[Optional[int]] = [None] * self.num_servers
+        self._recover_done: List[Optional[threading.Event]] = \
+            [None] * self.num_servers
 
     # -- plumbing ----------------------------------------------------------
     @staticmethod
@@ -697,6 +1263,198 @@ class KVStoreDistAsync:
             self._socks[sidx] = s
         return s
 
+    # -- elastic-training plumbing -----------------------------------------
+    def _next_seq(self, sidx: int) -> int:
+        with self._seq_lock:
+            self._seqs[sidx] += 1
+            return self._seqs[sidx]
+
+    def _remember_init(self, wire_key: str, sidx: int,
+                       hdr: Dict[str, Any], raw: bytes) -> None:
+        """Keep the init value so a restarted durable server's snapshot
+        gap can be re-seeded (init is first-wins: keys the snapshot
+        restored are untouched by a re-init).  Durable mode therefore
+        costs one host-side copy of the INIT values per worker for the
+        life of the client — the price of recovering a server that
+        died between a key's init and its first covering snapshot
+        (documented in docs/fault_tolerance.md)."""
+        if not self._durable:
+            return
+        with self._gen_lock:
+            self._inits[wire_key] = (sidx, dict(hdr), raw)
+
+    def _note_generation(self, sidx: int, gen: Any,
+                         failed: bool = False) -> bool:
+        """Record the server's generation token from a reply; on a
+        change (the server restarted) run snapshot-gap recovery.
+        Returns True when recovery ran — the caller's cue that one
+        replay of its failed RPC on the recovered state is warranted.
+        A FAILED recovery (e.g. the server died again mid-re-init)
+        rolls the recorded generation back, so the next reply
+        re-detects the change and retries — latching the new token up
+        front would silently disable recovery for that incarnation
+        forever.
+
+        Concurrency: exactly one thread claims the recovery (atomically
+        with the token latch, under ``_gen_lock``); a concurrently-
+        pushing peer whose RPC FAILED (``failed=True``) against the
+        restarted server waits out that recovery instead of surfacing
+        a spurious 'uninitialized key' error that recovery was about
+        to cure — peers whose RPC succeeded don't wait at all.  The
+        claimant's OWN inner RPCs (Q/O/I inside
+        :meth:`_recover_server`) see ``owner == me`` and fall through
+        — waiting there would deadlock on ourselves."""
+        if gen is None:
+            return False
+        me = threading.get_ident()
+        with self._gen_lock:
+            old = self._server_gen[sidx]
+            self._server_gen[sidx] = gen
+            claimed = (self._durable and old is not None and old != gen
+                       and self._recovering[sidx] is None)
+            if claimed:
+                self._recovering[sidx] = me
+                self._recover_done[sidx] = threading.Event()
+            owner = self._recovering[sidx]
+            done = self._recover_done[sidx]
+        if not self._durable or old is None:
+            return False
+        if claimed:
+            try:
+                self._recover_server(sidx, old, gen)
+            except BaseException:
+                with self._gen_lock:
+                    if self._server_gen[sidx] == gen:
+                        self._server_gen[sidx] = old
+                raise
+            finally:
+                with self._gen_lock:
+                    self._recovering[sidx] = None
+                done.set()
+            return True
+        if not failed or owner is None or owner == me:
+            return False
+        done.wait(timeout=self._recv_timeout() or 60)
+        return True
+
+    def _recover_server(self, sidx: int, old: Any, gen: Any) -> None:
+        """A durable server restarted: its snapshot restored most
+        state; re-seed exactly what the snapshot can miss — the
+        optimizer config if it predates set_optimizer, and any keys
+        initialized after the last snapshot (first-wins init makes
+        this idempotent)."""
+        import logging
+        logging.getLogger("mxnet_tpu.kvstore_async").warning(
+            "parameter server %d restarted (generation %s -> %s): "
+            "re-seeding keys/optimizer missing from its snapshot",
+            sidx, old, gen)
+        _, stats, _ = self._rpc_server(sidx, b"Q", {})
+        if not stats.get("has_optimizer") and self._shipped_opt:
+            name, params = self._shipped_opt
+            self._rpc_server(sidx, b"O",
+                             {"name": name, "params": dict(params)})
+        with self._gen_lock:
+            items = [(wk, hdr, raw)
+                     for wk, (si, hdr, raw) in self._inits.items()
+                     if si == sidx]
+        for wk, hdr, raw in items:
+            self._rpc_server(sidx, b"I", dict(hdr), raw)
+
+    def _ensure_heartbeat(self) -> None:
+        """Start the per-worker heartbeat thread on first RPC: a
+        dedicated connection per server (a minutes-long barrier
+        exchange on the main socket must not starve the lease).
+        ``_hb_lock`` serializes the check-then-spawn — two pusher
+        threads making their first RPCs concurrently must not each
+        start a beat loop."""
+        interval = float(getenv("MXNET_PS_HEARTBEAT_INTERVAL_S", 2.0))
+        if interval <= 0:
+            return
+        with self._hb_lock:
+            if self._hb_thread is not None or self._hb_stop.is_set():
+                return
+            t = threading.Thread(target=self._hb_loop,
+                                 args=(interval, self._hb_stop),
+                                 name=f"mxps-heartbeat-r{self._rank}",
+                                 daemon=True)
+            self._hb_thread = t
+            t.start()
+
+    def _hb_loop(self, interval: float, stop: threading.Event) -> None:
+        # the stop Event is CAPTURED, not re-read from self: if
+        # restart_heartbeat's bounded join expires while this loop is
+        # blocked in a connect/recv and then swaps self._hb_stop, the
+        # old loop must still see its own (set) event and exit on the
+        # next tick instead of beating forever beside its replacement
+        socks: List[Optional[socket.socket]] = [None] * self.num_servers
+        while not stop.wait(interval):
+            for sidx in range(self.num_servers):
+                try:
+                    # worker.heartbeat chaos site: an injected error
+                    # SUPPRESSES this beat (the wedged-not-dead
+                    # simulation the dead-rank lease trains against)
+                    _faults.maybe_fault("worker.heartbeat",
+                                        rank=self._rank, server=sidx)
+                except MXNetError:
+                    continue
+                except OSError:          # kind=timeout: also suppress
+                    continue
+                try:
+                    s = socks[sidx]
+                    if s is None:
+                        s = socket.create_connection(
+                            (self.uri, self._server_port(sidx)),
+                            timeout=5)
+                        s.settimeout(5)
+                        socks[sidx] = s
+                    hdr: Dict[str, Any] = {"wrank": self._rank}
+                    if self._token:
+                        hdr["tok"] = self._token
+                    _send_frame(s, b"T", hdr)
+                    _recv_frame(s)
+                    # deliberately NOT noting the reply's generation:
+                    # recovery from this thread would block on the main
+                    # RPC locks (up to a full recv timeout) and starve
+                    # the beats to every OTHER server — expiring the
+                    # very lease this thread exists to keep fresh.  A
+                    # restart is recovered on the next real RPC, which
+                    # is also the first moment recovery matters.
+                except (OSError, MXNetError, ValueError):
+                    # dead/restarting server: drop and re-dial next tick
+                    if socks[sidx] is not None:
+                        try:
+                            socks[sidx].close()
+                        except OSError:
+                            pass
+                        socks[sidx] = None
+        for s in socks:
+            if s is not None:
+                try:
+                    s.close()
+                except OSError:
+                    pass
+
+    def stop_heartbeat(self) -> None:
+        with self._hb_lock:
+            self._hb_stop.set()
+
+    def restart_heartbeat(self) -> None:
+        """Inverse of :meth:`stop_heartbeat`, for in-process reuse
+        (tools/tests that stop the servers, restart them, and keep the
+        client): joins the old beat thread BEFORE re-arming, so the
+        next RPC's :meth:`_ensure_heartbeat` can never race a stale
+        loop into two beat threads.  Even if the bounded join expires
+        (old loop blocked in a 5s connect/recv), the old loop holds a
+        reference to the OLD — set — stop event and exits on its next
+        tick."""
+        self.stop_heartbeat()
+        t = self._hb_thread
+        if t is not None and t.is_alive():
+            t.join(timeout=10)
+        with self._hb_lock:
+            self._hb_stop = threading.Event()
+            self._hb_thread = None
+
     def _server_of(self, key: Any) -> int:
         import zlib
         return zlib.crc32(str(key).encode()) % self.num_servers
@@ -769,18 +1527,24 @@ class KVStoreDistAsync:
 
     def _rpc_server(self, sidx: int, cmd: bytes, header: Dict[str, Any],
                     payload: bytes = b""):
+        self._ensure_heartbeat()
+        # every frame carries the rank: it refreshes this worker's
+        # heartbeat lease on the server and lets push frames dedupe
+        header = dict(header)
+        header.setdefault("wrank", self._rank)
         if self._token:
-            header = dict(header, tok=self._token)
+            header["tok"] = self._token
         cmd_name = cmd.decode("latin1")
 
         def _exchange():
             with self._locks[sidx]:
                 s = self._sock(sidx)
                 widened = False
-                if cmd == b"B":
-                    # a barrier reply legitimately takes up to the
-                    # server-side barrier timeout — widen this
-                    # exchange's recv window past it
+                if cmd in (b"B", b"C"):
+                    # a barrier / checkpoint-rendezvous reply
+                    # legitimately takes up to the server-side barrier
+                    # timeout — widen this exchange's recv window past
+                    # it
                     rt = self._recv_timeout()
                     if rt:
                         widened = True
@@ -829,13 +1593,31 @@ class KVStoreDistAsync:
         # bounded replay with jittered backoff: a restarted server
         # accepts fresh connections; if it lost its state the retry
         # fails loudly ('uninitialized key') instead of the worker dying
-        # on a transient drop. A push the dead server applied but never
-        # acknowledged may apply twice — tolerated by Hogwild semantics.
-        # STOP frames never retry (a dead server is already stopped).
+        # on a transient drop. A replayed push carries its seq, so a
+        # server that already applied it (or restored a snapshot
+        # covering it) acks without re-applying; a push the dead server
+        # applied AFTER its last snapshot may still apply twice —
+        # tolerated by Hogwild semantics.  STOP frames never retry (a
+        # dead server is already stopped).  Three attempts, not two: a
+        # dying serve loop resets peers for up to its ~100ms shutdown
+        # poll, and the first backoff sleep (~25-50ms) can land the
+        # replay back inside that window — the third attempt outlasts
+        # it into either a served frame or the connect-retry path.
         rcmd, rhdr, rpayload = retry_call(
             _exchange, site="kvstore.rpc",
             retryable=(ConnectionError, OSError),
-            attempts=1 if cmd == b"S" else 2)
+            attempts=1 if cmd == b"S" else 3)
+        if self._note_generation(sidx, rhdr.get("gen"),
+                                 failed=rcmd == b"E") and rcmd == b"E":
+            # the reply came from a RESTARTED durable server and the
+            # RPC failed — recovery just re-initialized the keys its
+            # snapshot missed, so one replay on the recovered state is
+            # warranted (e.g. 'uninitialized key' for a key created
+            # after the last snapshot)
+            rcmd, rhdr, rpayload = retry_call(
+                _exchange, site="kvstore.rpc",
+                retryable=(ConnectionError, OSError), attempts=2)
+            self._note_generation(sidx, rhdr.get("gen"))
         if rcmd == b"E":
             raise MXNetError(f"parameter server: {rhdr.get('error')}")
         return rcmd, rhdr, rpayload
@@ -865,6 +1647,7 @@ class KVStoreDistAsync:
                 hdr, raw = _arr_payload(a)
                 hdr["key"] = str(k)
                 self._rpc(k, b"I", hdr, raw)
+                self._remember_init(str(k), self._server_of(k), hdr, raw)
                 continue
             self._shapes[str(k)] = tuple(a.shape)
             flat = onp.ascontiguousarray(a).ravel()
@@ -872,6 +1655,7 @@ class KVStoreDistAsync:
                 hdr, raw = _arr_payload(flat[st:sp])
                 hdr["key"] = wk
                 self._rpc_server(sidx, b"I", hdr, raw)
+                self._remember_init(wk, sidx, hdr, raw)
 
     def push(self, key, value, priority: int = 0) -> None:
         from . import health as _health
@@ -911,13 +1695,22 @@ class KVStoreDistAsync:
                 self._push_group(sidx, group)
 
     def _push_group(self, sidx: int, enc) -> None:
+        # each push frame carries a per-worker seq: a replay (RPC retry
+        # across a reconnect or a snapshot-restored server restart) is
+        # acknowledged but never double-applied
         if len(enc) == 1:
             wk, spec, raw = enc[0]
-            self._rpc_server(sidx, b"P", dict(spec, key=wk), raw)
+            self._rpc_server(sidx, b"P",
+                             dict(spec, key=wk,
+                                  seq=self._next_seq(sidx),
+                                  cid=self._client_id),
+                             raw)
             return
         self._rpc_server(sidx, b"p",
                          {"keys": [e[0] for e in enc],
-                          "specs": [e[1] for e in enc]},
+                          "specs": [e[1] for e in enc],
+                          "seq": self._next_seq(sidx),
+                          "cid": self._client_id},
                          b"".join(e[2] for e in enc))
 
     def pull(self, key, out=None, priority: int = 0,
@@ -1021,6 +1814,7 @@ class KVStoreDistAsync:
         for sidx in range(self.num_servers):
             self._rpc_server(sidx, b"O", {"name": name, "params": params})
         self._shipped_params = dict(params)
+        self._shipped_opt = (name, dict(params))
 
     def update_optimizer_params(self, params: Dict[str, Any]) -> None:
         """Push changed scalar hyperparams (lr, rescale_grad, wd, ...) to
@@ -1033,6 +1827,12 @@ class KVStoreDistAsync:
         for sidx in range(self.num_servers):
             self._rpc_server(sidx, b"H", {"params": changed})
         self._shipped_params.update(changed)
+        if self._shipped_opt is not None:
+            # keep the restart re-ship config current: a server restarted
+            # with a pre-optimizer snapshot must receive the LIVE
+            # hyperparams, not the job-start ones
+            name, params = self._shipped_opt
+            self._shipped_opt = (name, dict(params, **changed))
 
     def save_optimizer_states(self, fname: str,
                               dump_weight: bool = False) -> None:
@@ -1117,8 +1917,47 @@ class KVStoreDistAsync:
         return [self._rpc_server(sidx, b"Q", {})[1]
                 for sidx in range(self.num_servers)]
 
+    def _next_ckpt_round(self, phase: str) -> str:
+        with self._seq_lock:
+            self._ckpt_rounds[phase] += 1
+            return f"{self._client_id}:{self._ckpt_rounds[phase]}"
+
+    def ckpt_mark(self, step: int) -> int:
+        """Phase 1 of the coordinated cluster checkpoint: propose
+        ``step`` and block until every worker proposed; returns the
+        agreed step (the min proposed — the cluster-consistent floor).
+        Server 0 is the coordinator.  A dead rank abandons the round
+        with a structured error naming it."""
+        from . import health as _health
+        with _health.watch_section("kvstore.ckpt_mark", rank=self._rank):
+            _, hdr, _ = self._rpc_server(
+                0, b"C", {"phase": "mark", "step": int(step),
+                          "rank": self._rank,
+                          "cround": self._next_ckpt_round("mark")})
+        return int(hdr["step"])
+
+    def ckpt_commit(self, step: int) -> int:
+        """Phase 2: report this rank's checkpoint for ``step`` is
+        durably on disk; blocks until every rank committed, after
+        which the cluster as a whole can resume from ``step``."""
+        from . import health as _health
+        with _health.watch_section("kvstore.ckpt_commit",
+                                   rank=self._rank):
+            _, hdr, _ = self._rpc_server(
+                0, b"C", {"phase": "commit", "step": int(step),
+                          "rank": self._rank,
+                          "cround": self._next_ckpt_round("commit")})
+        return int(hdr.get("committed", step))
+
+    def ckpt_last_committed(self) -> int:
+        """The coordinator's record of the newest fully committed
+        cluster checkpoint step (-1: none)."""
+        _, hdr, _ = self._rpc_server(0, b"Q", {})
+        return int(hdr.get("ckpt_committed", -1))
+
     def stop_servers(self) -> None:
         """Ask every server process to exit (rank 0, end of job)."""
+        self.stop_heartbeat()
         for sidx in range(self.num_servers):
             try:
                 self._rpc_server(sidx, b"S", {})

@@ -706,7 +706,14 @@ class SPMDTrainer:
         under kill-and-restart — a rerun continues where the kill
         landed, and a completed run is a no-op), saves every
         ``checkpoint_every`` steps, and saves a final checkpoint at
-        ``num_steps``.  A SIGTERM/SIGINT during the loop finishes the
+        ``num_steps``.  A
+        :class:`~mxnet_tpu.checkpoint.CoordinatedCheckpointManager`
+        slots in unchanged: every rank then agrees on the checkpoint
+        step through the two-phase cluster rendezvous before any rank
+        commits, and the restore resumes the whole cluster from one
+        consistent step; the rendezvous is hang-watchdog-armed
+        (``checkpoint.save`` site) and a dead rank is named in a
+        structured error instead of stalling the save.  A SIGTERM/SIGINT during the loop finishes the
         in-flight step, writes a checkpoint, and returns cleanly
         (:class:`~mxnet_tpu.preemption.PreemptionGuard`); the next
         incarnation resumes from it.
@@ -843,7 +850,13 @@ class SPMDTrainer:
                         elif verdict.ok:
                             loss = pl
                     if need_ckpt:
-                        checkpoint_manager.save(self, step=done)
+                        # watchdog-armed: a coordinated save blocks in
+                        # the cluster rendezvous — a hang here (wedged
+                        # peer) dumps stacks instead of stalling silent
+                        from .. import health as _health
+                        with _health.watch_section("checkpoint.save",
+                                                   step=done):
+                            checkpoint_manager.save(self, step=done)
                     if preempted:
                         # drain the pending verdict so accounting and
                         # the returned loss cover the final step.  Only

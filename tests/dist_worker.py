@@ -358,6 +358,120 @@ def compress_main(out_dir: str) -> None:
         f.write("\n".join(lines) + "\n")
 
 
+def resilient_sum_main(out_dir: str) -> None:
+    """Exactly-once proof for the durable PS (-n 2 -s 1 --supervise,
+    MXNET_PS_SNAPSHOT_DIR + MXNET_PS_SNAPSHOT_EVERY=1, a seeded
+    ps.server:kind=crash plan): each rank pushes 40 integer-valued
+    vectors in sum mode while the server is crash-killed mid-stream and
+    supervisor-restarted; integer-valued float adds are exact and
+    commutative, so the final pulled value equals the exact sum IFF no
+    push was lost (RPC replay across the restart) AND none was
+    double-applied (snapshot-persisted per-worker seq dedupe)."""
+    import time
+    import numpy as onp
+    # pure PS job: no collectives, so do NOT join jax.distributed (the
+    # launcher exports the coordinator env to every worker).  A killed
+    # rank must be a PS-layer event only — with a live coordination
+    # service the surviving rank's process ABORTS at exit when its
+    # peer vanished, cascading supervisor restarts through the job.
+    os.environ["MXNET_NO_AUTO_DISTRIBUTED"] = "1"
+    import mxnet_tpu as mx
+
+    rank = int(os.environ["DMLC_WORKER_ID"])
+    kv = mx.kvstore.create("dist_async")
+    nw = kv.num_workers
+    if rank == 0:
+        kv.init("acc", mx.np.zeros(8))
+    kv.barrier()
+    for _ in range(40):
+        kv.push("acc", mx.np.array(
+            onp.full(8, float(rank + 1), "float32")))
+        time.sleep(0.005)        # spread pushes: the crash lands mid-run
+    kv.barrier()
+    got = kv.pull("acc", out=mx.np.zeros(8)).asnumpy()
+    expect = 40.0 * sum(r + 1 for r in range(nw))
+    assert (got == expect).all(), (got, expect)   # EXACT, not allclose
+    stats = kv.server_stats()[0]
+    # applied-push accounting survives the restart (snapshot-restored
+    # counter + exactly-once): 40 per worker, no more, no less
+    assert stats["pushes"] == 40 * nw, stats
+    assert stats["generation"] >= 2, stats        # it really restarted
+    with open(os.path.join(out_dir, f"worker{rank}.txt"), "w") as f:
+        f.write("sum-exact\n")
+        f.write(f"{stats['generation']}\n")
+    kv.barrier()
+    if rank == 0:
+        kv.stop_servers()
+
+
+class _StepCounter:
+    """Tiny worker-side resume state for the worker-kill leg: the PR-3
+    CheckpointManager target (save_checkpoint/load_checkpoint)."""
+
+    def __init__(self) -> None:
+        self.step = 0
+
+    def save_checkpoint(self, prefix: str) -> None:
+        with open(prefix + ".step", "w") as f:
+            f.write(str(self.step))
+
+    def load_checkpoint(self, prefix: str) -> None:
+        with open(prefix + ".step") as f:
+            self.step = int(f.read())
+
+
+def resilient_worker_kill_main(out_dir: str) -> None:
+    """Worker-rank death under supervision (-n 2 -s 1 --supervise):
+    rank 1 os._exits once at the top of step 12 (after checkpointing
+    step 11), the supervisor restarts it, and the PR-3 auto-resume path
+    (CheckpointManager restore of the step counter; weights live on
+    the durable server) continues EXACTLY at step 12 — so each rank
+    lands exactly 30 pushes and the Hogwild quadratic converges."""
+    import numpy as onp
+    # PS-only job: stay out of jax.distributed (see resilient_sum_main)
+    os.environ["MXNET_NO_AUTO_DISTRIBUTED"] = "1"
+    import mxnet_tpu as mx
+    from mxnet_tpu.checkpoint import CheckpointManager
+
+    rank = int(os.environ["DMLC_WORKER_ID"])
+    kv = mx.kvstore.create("dist_async")
+    target = onp.arange(8, dtype="float32") / 4.0
+    mgr = CheckpointManager(os.path.join(out_dir, f"resume-r{rank}"),
+                            max_to_keep=2)
+    counter = _StepCounter()
+    resumed = mgr.restore(counter)
+    if resumed is None:
+        if rank == 0:
+            kv.init("w", mx.np.zeros(8))
+            kv.set_optimizer(mx.optimizer.create("sgd",
+                                                 learning_rate=0.2))
+        kv.barrier()             # first incarnation only: init rendezvous
+    kill_marker = os.path.join(out_dir, "killed-once")
+    for step in range(counter.step, 30):
+        if rank == 1 and step == 12 and not os.path.exists(kill_marker):
+            with open(kill_marker, "w") as f:
+                f.write("x")
+            os._exit(17)         # SIGKILL analog: no cleanup, no ack
+        w = kv.pull("w", out=mx.np.zeros(8)).asnumpy()
+        kv.push("w", mx.np.array(w - target))       # grad of 1/2|w-t|^2
+        counter.step = step + 1
+        mgr.save(counter, step=counter.step)
+    kv.barrier()
+    final = kv.pull("w", out=mx.np.zeros(8)).asnumpy()
+    err = float(onp.abs(final - target).max())
+    stats = kv.server_stats()[0]
+    assert stats["pushes"] == 60, stats   # exactly 30 per rank: the
+    #                                       kill point is checkpointed,
+    #                                       so no step reruns
+    assert err < 0.1, (final, target)
+    with open(os.path.join(out_dir, f"worker{rank}.txt"), "w") as f:
+        f.write(f"{err:.6f}\n")
+        f.write(f"{stats['pushes']}\n")
+    kv.barrier()
+    if rank == 0:
+        kv.stop_servers()
+
+
 def dptp_main(out_dir: str) -> None:
     """dp x tp over 2 processes x 2 local devices: one SPMD program
     shards the batch over dp AND the layer weights over tp across the
@@ -420,6 +534,12 @@ def main() -> None:
         return
     if len(sys.argv) > 2 and sys.argv[2] == "async_compress":
         async_compress_main(out_dir)
+        return
+    if len(sys.argv) > 2 and sys.argv[2] == "resilient_sum":
+        resilient_sum_main(out_dir)
+        return
+    if len(sys.argv) > 2 and sys.argv[2] == "resilient_worker_kill":
+        resilient_worker_kill_main(out_dir)
         return
     import mxnet_tpu as mx
     from mxnet_tpu import kvstore as kvs

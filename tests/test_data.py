@@ -178,6 +178,7 @@ def test_model_zoo_constructs():
         zoo.get_model("resnet13_v9")
 
 
+@pytest.mark.slow    # tier-1 time budget (r8)
 def test_mobilenet_squeezenet_densenet_construct():
     from mxnet_tpu.gluon.model_zoo import vision as zoo
     x = mx.np.ones((1, 3, 64, 64))
@@ -305,6 +306,7 @@ def test_random_hue():
     assert len(jit._ts) == 1
 
 
+@pytest.mark.slow    # tier-1 time budget (r8): zoo construction stays tier-1 via test_model_zoo_constructs
 def test_mobilenet_v3_constructs():
     from mxnet_tpu.gluon.model_zoo import vision as zoo
     x = mx.np.ones((1, 3, 64, 64))
@@ -314,6 +316,7 @@ def test_mobilenet_v3_constructs():
         assert net(x).shape == (1, 10), name
 
 
+@pytest.mark.slow    # tier-1 time budget (r8)
 def test_inception_v3_constructs():
     from mxnet_tpu.gluon.model_zoo import vision as zoo
     net = zoo.get_model("inceptionv3", classes=10)

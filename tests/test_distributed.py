@@ -119,6 +119,7 @@ def test_two_process_two_devices_each(tmp_path):
     assert r0[1] == r1[1]
 
 
+@pytest.mark.slow    # tier-1 time budget (r8): the 2-process contract test stays; ci/run.sh dist runs this one
 def test_four_process_kvstore_bucketed(tmp_path):
     """dp=4 launcher job: the dist_sync invariant (pulled == sum over the
     4 workers of pushed), fused bucket collectives for multi-key pushes,

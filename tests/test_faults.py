@@ -104,7 +104,7 @@ def test_smoke_plan_parse_and_env(monkeypatch):
     assert set(faults.known_sites()) == {
         "checkpoint.write", "kvstore.send", "kvstore.recv",
         "dataloader.worker", "serving.execute", "serving.worker",
-        "dispatch.op", "trainer.step"}
+        "ps.server", "worker.heartbeat", "dispatch.op", "trainer.step"}
 
 
 def test_smoke_nan_kind_corrupts_tensor_sites_only():
@@ -552,6 +552,7 @@ class _NpDataset(mx.gluon.data.dataset.Dataset):
         return onp.full((3,), i, dtype="float32")
 
 
+@pytest.mark.slow    # tier-1 time budget (r8): chaos-smoke (-k smoke, no slow filter) still gates it in tier 1
 def test_smoke_dataloader_worker_crash_is_structured(monkeypatch):
     from mxnet_tpu.gluon.data import DataLoader
     # fork: instant workers (pure-numpy dataset) that inherit the armed

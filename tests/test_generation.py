@@ -119,6 +119,7 @@ def test_kv_cache_slots_and_buckets():
 # greedy parity (incl. a KV-bucket migration mid-decode)
 # ---------------------------------------------------------------------------
 
+@pytest.mark.slow    # tier-1 time budget (r8): generation-smoke gates greedy parity end-to-end in tier 1
 def test_greedy_parity_vs_uncompiled_reference(gpt, decode_model):
     eng = _engine(decode_model)
     # 24 new tokens from a 4-token prompt crosses the 16-bucket: the

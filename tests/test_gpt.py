@@ -86,6 +86,7 @@ def _tiny_gpt(vocab=97, layers=2, units=32, heads=4, max_len=64):
     return net
 
 
+@pytest.mark.slow    # tier-1 time budget (r8): greedy decode parity is gated end-to-end by generation-smoke
 def test_generate_greedy_matches_full_forward():
     """The cached incremental decoder must produce exactly the tokens a
     naive full-recompute greedy decode produces (cache math == forward
@@ -109,6 +110,7 @@ def test_generate_greedy_matches_full_forward():
     onp.testing.assert_array_equal(got, onp.stack(want, axis=1))
 
 
+@pytest.mark.slow    # tier-1 time budget (r8): decode-path numerics ride the generation-smoke zoo decode gate
 def test_generate_respects_layer_norm_eps():
     """A non-default layer_norm_eps must flow into the decode path (the
     pure-jax mirror reads the model's epsilon, not a constant)."""
@@ -131,6 +133,7 @@ def test_generate_respects_layer_norm_eps():
     onp.testing.assert_array_equal(got, toks[:, 4:])
 
 
+@pytest.mark.slow    # tier-1 time budget (r8)
 def test_generate_sampling_and_eos():
     import numpy as onp
     net = _tiny_gpt()
@@ -175,6 +178,7 @@ def test_generate_validates_args():
     assert out.asnumpy().shape == (1, 2)
 
 
+@pytest.mark.slow    # tier-1 time budget (r8)
 def test_beam_search_beats_greedy_and_matches_at_k1():
     """beam_size=1 must equal greedy; larger beams never score worse
     than the greedy sequence under the same (alpha=1) normalization."""
@@ -197,6 +201,7 @@ def test_beam_search_beats_greedy_and_matches_at_k1():
     assert (onp.diff(s4, axis=1) <= 1e-5).all()
 
 
+@pytest.mark.slow    # tier-1 time budget (r8)
 def test_beam_search_eos_normalization():
     import numpy as onp
     net = _tiny_gpt()
@@ -214,6 +219,7 @@ def test_beam_search_eos_normalization():
             assert (row[hit:] == eos).all()
 
 
+@pytest.mark.slow    # tier-1 time budget (r8)
 def test_generate_top_p_nucleus():
     """Nucleus sampling (r4): a tiny top_p is greedy (only the argmax
     survives the nucleus), top_p=1.0 equals plain sampling at the same

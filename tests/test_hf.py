@@ -28,6 +28,7 @@ def _gpt2_pair(layers=2, units=32, heads=4, vocab=211, positions=64):
     return m, hf.convert_gpt2(m)
 
 
+@pytest.mark.slow    # tier-1 time budget (r8)
 def test_gpt2_logits_parity():
     m, net = _gpt2_pair()
     ids = onp.random.RandomState(0).randint(0, 211, (2, 10))

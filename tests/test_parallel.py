@@ -142,6 +142,7 @@ def test_sp_sequence_sharding_runs():
     assert l2 < l1  # optimizing
 
 
+@pytest.mark.slow    # tier-1 time budget (r8): ci/run.sh dryrun runs __graft_entry__.py itself
 def test_graft_entry_hooks():
     import __graft_entry__ as ge
     fn, args = ge.entry()

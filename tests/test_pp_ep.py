@@ -152,6 +152,7 @@ def test_moe_capacity_overflow_drops_tokens():
     assert nonzero_rows == 1, nonzero_rows
 
 
+@pytest.mark.slow    # tier-1 time budget (r8): MoE training is dryrun-gated (MULTICHIP top-2 EP)
 def test_moe_trains_and_aux_loss():
     mx.random.seed(2)
     net = mx.gluon.nn.Sequential()
@@ -265,6 +266,7 @@ def _make_pipe_and_ref(n_micro=4):
     return pipe, ref, toks
 
 
+@pytest.mark.slow    # tier-1 time budget (r8): pipeline numerics stay via test_pipeline_gradients_match
 def test_gpt_pipeline_logit_parity():
     """GPTPipe (4 stages x 4 microbatches over a pp mesh) must produce the
     sequential GPTModel's logits exactly (same weights, same math)."""
@@ -274,6 +276,7 @@ def test_gpt_pipeline_logit_parity():
     assert float(onp.abs(o_pipe - o_ref).max()) < 1e-4
 
 
+@pytest.mark.slow    # tier-1 time budget (r8)
 def test_gpt_pipeline_trains_with_spmdtrainer():
     """A REAL model (GPT blocks) trains through pipeline_apply under
     SPMDTrainer with >= 4 microbatches, loss-parity vs the non-pp run."""
@@ -385,6 +388,7 @@ def test_1f1b_uneven_micro_and_stages():
                                     rtol=1e-4, atol=1e-6)
 
 
+@pytest.mark.slow    # tier-1 time budget (r8): pipeline parity stays tier-1 via the logit/gradient parity tests
 def test_gpt_pipeline_dropout_trains():
     """GPTPipe(dropout>0): per-(microbatch, stage) keys thread through
     the schedule — train-mode forwards differ run to run, eval is
@@ -484,6 +488,7 @@ def test_moe_router_z_loss_term():
                                 rtol=1e-4)
 
 
+@pytest.mark.slow    # tier-1 time budget (r8): ep x dp mesh composition is dryrun-gated (MULTICHIP)
 def test_moe_gpt_trains_ep_dp_mesh():
     """GPTModel(moe_every_n=2, top-2 experts) trains under SPMDTrainer on
     a COMBINED ep x dp mesh with the aux losses in the objective; the
@@ -520,6 +525,7 @@ def test_moe_gpt_trains_ep_dp_mesh():
     onp.testing.assert_allclose(losses, ref, rtol=5e-3, atol=5e-4)
 
 
+@pytest.mark.slow    # tier-1 time budget (r8)
 def test_pipeline_composes_with_dp():
     """pp x dp in ONE program (VERDICT r2 weak 9): each dp row pipelines
     its own batch slice; results match the sequential reference, and a
@@ -554,6 +560,7 @@ def test_pipeline_composes_with_dp():
     assert ls[-1] < ls[0], ls
 
 
+@pytest.mark.slow    # tier-1 time budget (r8): 1f1b parity stays tier-1 via test_1f1b_matches_gpipe_autodiff
 def test_1f1b_full_model_trainer_parity():
     """Full-model 1F1B through SPMDTrainer (r4): GPTPipe(schedule='1f1b')
     routes gradients through the hand-scheduled sweep — embedding
@@ -631,6 +638,7 @@ def test_1f1b_head_grads_and_dx():
                                     rtol=1e-4, atol=1e-5)
 
 
+@pytest.mark.slow    # tier-1 time budget (r8)
 def test_1f1b_dropout_applies():
     """schedule='1f1b' runs in train mode through SPMDTrainer: dropout
     masks engage inside the sweep (regression: the hook once ran outside
@@ -666,6 +674,7 @@ def test_1f1b_dropout_applies():
     assert onp.mean(dropped[-2:]) < onp.mean(dropped[:2]), dropped
 
 
+@pytest.mark.slow    # tier-1 time budget (r8)
 def test_1f1b_composes_with_dp():
     """1F1B x dp in ONE program (VERDICT r4 directive 8): the sweep
     shards the microbatch batch dim over dp, psums grads/loss, and must

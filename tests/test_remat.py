@@ -40,6 +40,7 @@ def _bert_losses(remat, dropout=0.0, steps=4):
         os.environ.pop("MXNET_REMAT", None)
 
 
+@pytest.mark.slow    # tier-1 time budget (r8): remat exactness stays tier-1 via the gpt/toggle variants
 def test_remat_bert_loss_exact():
     """Remat must not change the math: per-step losses identical with
     and without MXNET_REMAT."""
@@ -49,6 +50,7 @@ def test_remat_bert_loss_exact():
         assert abs(a - b) < 1e-5, (plain, remat)
 
 
+@pytest.mark.slow    # tier-1 time budget (r8)
 def test_remat_dropout_trains():
     """Dropout under remat: per-layer explicit keys keep the recompute's
     masks identical to the forward's (ambient stateful draws would
@@ -88,6 +90,7 @@ def test_remat_gpt_loss_exact():
         assert abs(a - b) < 1e-5, (plain, remat)
 
 
+@pytest.mark.slow    # tier-1 time budget (r8)
 def test_remat_toggle_retraces_compiled_step():
     """Toggling MXNET_REMAT after a trainer compiled must RE-TRACE the
     step program — on a transformer (no BatchNorm), so the invalidation

@@ -195,6 +195,7 @@ def test_crash_loop_trips_breaker_reset_readmits():
 # exactly-once recovery: decode fault mid-stream, token-identical resume
 # ---------------------------------------------------------------------------
 
+@pytest.mark.slow    # tier-1 time budget (r8): resilience-smoke gates token-identical recovery in tier 1
 def test_decode_fault_recovers_token_identical(gpt, decode_model):
     want = _reference_greedy(gpt, PROMPT_A, 16)
     rec0 = metrics.value("mxnet_serving_recoveries_total", site="decode")
@@ -215,6 +216,7 @@ def test_decode_fault_recovers_token_identical(gpt, decode_model):
     assert faults.injected_count("serving.execute") == 0  # plan left scope
 
 
+@pytest.mark.slow    # tier-1 time budget (r8): resilience-smoke gates worker-death recovery in tier 1
 def test_worker_death_recovers_on_surviving_replica(gpt, decode_model):
     prompts = [PROMPT_A, PROMPT_B, PROMPT_C, PROMPT_A]
     budgets = [14, 10, 12, 8]

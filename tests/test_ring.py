@@ -46,6 +46,7 @@ def test_ring_under_jit_with_dp_axis():
 
 
 @pytest.mark.parametrize("causal", [False, True])
+@pytest.mark.slow    # tier-1 time budget (r8): grad coverage stays via test_ring_flash_path_bias_and_grads
 def test_ring_gradients_match_dense(causal):
     mesh = make_mesh({"sp": 4}, devices=jax.devices()[:4])
     q, k, v = _rand_qkv(T=16)
@@ -155,6 +156,7 @@ def test_causal_cross_attention_alignment_consistent(engaged):
 
 @pytest.mark.parametrize("bias_shape", [(2, 1, 1, 32), (1, 1, 32, 32),
                                         (2, 4, 32, 32)])
+@pytest.mark.slow    # tier-1 time budget (r8): bias coverage stays tier-1 via test_ring_flash_path_bias_and_grads
 def test_ring_bias_matches_dense(bias_shape):
     """Additive biases — key-padding rows, score masks, full dense — ride
     the ring (row stripe sharded, columns sliced per step) and match the
@@ -198,6 +200,7 @@ def test_ring_key_padding_mask_zeroes_padded_keys():
                                 rtol=2e-5, atol=2e-5)
 
 
+@pytest.mark.slow    # tier-1 time budget (r8): ring correctness stays tier-1 via the bias/grads/flash parity tests
 def test_ring_dropout_semantics():
     """Ring dropout: deterministic per seed, different across seeds, and
     the kept-probability mass is unbiased (inverted dropout)."""
@@ -274,6 +277,7 @@ def test_spmd_masked_dropout_bert_stays_on_ring():
 
 
 @pytest.mark.parametrize("causal", [False, True])
+@pytest.mark.slow    # tier-1 time budget (r8): flash-path coverage stays via test_ring_flash_path_bias_and_grads
 def test_ring_flash_path_matches_dense(causal, monkeypatch):
     """r4: per-shard blocks route through the Pallas flash kernel when
     Tl >= 8 (the _flash_ring custom-vjp path) — outputs AND gradients

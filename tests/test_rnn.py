@@ -176,6 +176,7 @@ def test_bert_forward_and_mlm():
     assert mlm.shape == (B, 3, 100)
 
 
+@pytest.mark.slow    # tier-1 time budget (r8)
 def test_bert_trains():
     from mxnet_tpu.gluon.model_zoo.bert import get_bert
     net = get_bert(vocab_size=50, num_layers=1, units=16, hidden_size=32,
@@ -274,6 +275,7 @@ def test_attention_padding_mask_2d():
     assert onp.isfinite(n).all()
 
 
+@pytest.mark.slow    # tier-1 time budget (r8): flash-attention grads stay tier-1 via tests/test_flash_attention.py
 def test_flash_attention_backward_matches_dense():
     """Blockwise backward kernels (dq + dk/dv with saved LSE) vs dense
     reference gradients, incl. causal and ragged lengths."""
@@ -364,6 +366,7 @@ def test_npx_rnn_gru_bidirectional():
     assert_almost_equal(out, out_ref, rtol=1e-5, atol=1e-6)
 
 
+@pytest.mark.slow    # tier-1 time budget (r8)
 def test_npx_rnn_variable_length():
     """use_sequence_length (reference RNN op + cuDNN packed sequences):
     per-sequence results must equal running each sequence alone at its

@@ -44,6 +44,7 @@ def test_forward_shapes_and_spec():
         get_transformer("transformer_tiny")
 
 
+@pytest.mark.slow    # tier-1 time budget (r8): train-to-convergence stays gated by health/bulk smokes + cheaper tests
 def test_copy_task_trains():
     """The seq2seq stack learns an identity mapping (teacher forcing +
     Trainer) — the end-to-end train contract."""
@@ -71,6 +72,7 @@ def test_copy_task_trains():
     assert last < first * 0.7, (first, last)
 
 
+@pytest.mark.slow    # tier-1 time budget (r8)
 def test_translate_greedy_matches_full_recompute():
     """KV-cache decode == naive per-step full decoder recompute."""
     net = _tiny()
@@ -135,6 +137,7 @@ def test_beam_translate_matches_greedy_at_k1():
     assert (onp.diff(s4, axis=1) <= 1e-5).all()
 
 
+@pytest.mark.slow    # tier-1 time budget (r8): tp parity stays tier-1 via test_parallel's tp tests
 def test_seq2seq_tp_training_matches_replicated():
     """The encoder-decoder family under SPMDTrainer: Megatron tp rules
     (incl. the cross-attention split) must reproduce the replicated
@@ -184,6 +187,7 @@ def test_seq2seq_tp_training_matches_replicated():
     assert abs(outs[0] - outs[1]) < 1e-4, outs
 
 
+@pytest.mark.slow    # tier-1 time budget (r8): export is exercised by the serving/generation smokes
 def test_shared_embedding_hybridize_and_export(tmp_path):
     """Tied src/tgt embeddings (one Parameter under two names) must
     hybridize and export/reimport cleanly — the trace binds each

@@ -24,6 +24,7 @@ def _tiny(classes=5, **kw):
     return VisionTransformer(classes=classes, **kw)
 
 
+@pytest.mark.slow    # tier-1 time budget (r8)
 def test_forward_shapes_and_registry():
     mx.random.seed(0)
     net = _tiny()
@@ -40,6 +41,7 @@ def test_forward_shapes_and_registry():
         vit_tiny_patch16(img_size=30)   # not divisible by patch
 
 
+@pytest.mark.slow    # tier-1 time budget (r8)
 def test_trains_to_convergence():
     mx.random.seed(1)
     net = _tiny(classes=4)
@@ -80,6 +82,7 @@ def test_hybridize_matches_imperative_and_roundtrips(tmp_path):
                                 rtol=1e-5, atol=1e-5)
 
 
+@pytest.mark.slow    # tier-1 time budget (r8): remat exactness stays tier-1 via test_remat gpt/toggle
 def test_remat_loss_exact():
     """MXNET_REMAT per-layer checkpointing must not change the loss."""
     x = onp.random.RandomState(5).randn(2, 3, 32, 32).astype("float32")

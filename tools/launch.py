@@ -14,20 +14,49 @@ analog of a multi-host TPU pod slice, and the same env contract
 
     python tools/launch.py -n 4 python train.py        # 4 local workers
     python tools/launch.py -n 16 -H hosts.txt ...      # ssh multi-host
+
+``--supervise`` turns the local launcher into a rank supervisor: a
+dead server or worker child is restarted with jittered exponential
+backoff behind a per-process budget (``MXNET_LAUNCH_MAX_RESTARTS`` /
+``MXNET_LAUNCH_RESTART_BACKOFF_MS``); restarted servers restore their
+durable snapshot (``MXNET_PS_SNAPSHOT_DIR``), restarted workers resume
+via their CheckpointManager auto-resume path.  A process that exhausts
+its budget degrades the whole job EXPLICITLY — a structured error on
+stderr and a clean teardown, exit code 70 — never a crash loop.
 """
 import argparse
 import os
 import subprocess
 import sys
+import time
+
+DEGRADED_EXIT = 70          # EX_SOFTWARE: restart budget exhausted
 
 
-def launch_local(args, cmd):
-    procs = []
-    servers = []
-    port_dir = None
+class _Child(object):
+    """One supervised process slot: respawnable spec + restart budget."""
+
+    def __init__(self, role, idx, argv, env, delays):
+        self.role = role            # 'server' | 'worker'
+        self.idx = idx
+        self.argv = argv
+        self.env = env
+        self.delays = delays        # iterator of backoff sleeps
+        self.proc = subprocess.Popen(argv, env=env)
+        self.restarts = 0
+        self.restart_at = None      # monotonic time of a pending respawn
+        self.done = False           # exited 0: job item complete / stopped
+
+
+def _spawn_specs(args, cmd):
+    """(server_specs, worker_specs, port_dir): the respawnable command +
+    env of every child — restarts reuse the exact env (same rank, same
+    port file, same token, same fault plan)."""
     base_env = dict(os.environ)
     coord = f"127.0.0.1:{args.port}"
     ps_port = args.port + 1
+    servers = []
+    port_dir = None
     if args.num_servers:
         # parameter-server processes (kvstore='dist_async'): role env per
         # the reference DMLC contract, entry = mxnet_tpu.kvstore_async.
@@ -54,9 +83,10 @@ def launch_local(args, cmd):
                 "DMLC_PS_ROOT_URI": "127.0.0.1",
                 "DMLC_PS_ROOT_PORT": "0",
             })
-            servers.append(subprocess.Popen(
-                [sys.executable, "-m", "mxnet_tpu.kvstore_async"],
-                env=env))
+            servers.append(("server", sid,
+                            [sys.executable, "-m",
+                             "mxnet_tpu.kvstore_async"], env))
+    workers = []
     for rank in range(args.num_workers):
         env = dict(base_env)
         env.update({
@@ -78,7 +108,34 @@ def launch_local(args, cmd):
                 f" --xla_force_host_platform_device_count="
                 f"{args.cpu_devices_per_worker}").strip()
             env["JAX_PLATFORMS"] = "cpu"
-        procs.append(subprocess.Popen(cmd, env=env))
+        workers.append(("worker", rank, list(cmd), env))
+    return servers, workers, port_dir
+
+
+def _cleanup(children, port_dir, rc):
+    for c in children:
+        if c.proc.poll() is None:
+            c.proc.terminate()
+    for c in children:
+        try:
+            c.proc.wait(timeout=10)
+        except subprocess.TimeoutExpired:
+            c.proc.kill()
+            c.proc.wait()
+    if port_dir is not None:
+        import shutil
+        shutil.rmtree(port_dir, ignore_errors=True)
+    return rc
+
+
+def launch_local(args, cmd):
+    server_specs, worker_specs, port_dir = _spawn_specs(args, cmd)
+    if args.supervise:
+        return _supervise(args, server_specs, worker_specs, port_dir)
+    servers = [subprocess.Popen(argv, env=env)
+               for _, _, argv, env in server_specs]
+    procs = [subprocess.Popen(argv, env=env)
+             for _, _, argv, env in worker_specs]
     rc = 0
     for p in procs:
         rc = p.wait() or rc
@@ -97,6 +154,81 @@ def launch_local(args, cmd):
         import shutil
         shutil.rmtree(port_dir, ignore_errors=True)
     return rc
+
+
+def _supervise(args, server_specs, worker_specs, port_dir):
+    """Run the job under rank supervision: any child death before the
+    job completes is a routine, bounded event — restart with jittered
+    backoff behind MXNET_LAUNCH_MAX_RESTARTS, then explicit
+    degradation."""
+    # the backoff schedule and restart metric ride the framework's
+    # shared substrate (retry.backoff_delays / the PR-1 registry);
+    # imported lazily so the plain launcher path stays dependency-free
+    sys.path.insert(0, os.path.dirname(os.path.dirname(
+        os.path.abspath(__file__))))
+    from mxnet_tpu.retry import backoff_delays
+    from mxnet_tpu.kvstore_async import DIST_RANK_RESTARTS
+
+    max_restarts = int(os.environ.get("MXNET_LAUNCH_MAX_RESTARTS", "3"))
+    backoff_ms = float(os.environ.get(
+        "MXNET_LAUNCH_RESTART_BACKOFF_MS", "500"))
+
+    def fresh_delays():
+        return backoff_delays(attempts=max_restarts + 1,
+                              base_ms=backoff_ms)
+
+    children = [
+        _Child(role, idx, argv, env, fresh_delays())
+        for role, idx, argv, env in server_specs + worker_specs]
+
+    def log(msg):
+        print(f"[launch.supervise] {msg}", file=sys.stderr, flush=True)
+
+    while True:
+        workers = [c for c in children if c.role == "worker"]
+        if all(c.done for c in workers):
+            return _cleanup(children, port_dir, 0)
+        now = time.monotonic()
+        for c in children:
+            if c.done:
+                continue
+            if c.restart_at is not None:
+                if now >= c.restart_at:
+                    c.restart_at = None
+                    c.restarts += 1
+                    DIST_RANK_RESTARTS.labels(role=c.role).inc()
+                    log(f"restarting {c.role} {c.idx} "
+                        f"(restart {c.restarts}/{max_restarts})")
+                    c.proc = subprocess.Popen(c.argv, env=c.env)
+                continue
+            rc = c.proc.poll()
+            if rc is None:
+                continue
+            if rc == 0:
+                # worker: finished its job.  server: a deliberate stop
+                # (rank 0's stop_servers) — trustworthy by rc alone,
+                # because run_server exits NONZERO whenever its serve
+                # loop dies without a STOP frame (e.g. the ps.server
+                # error-kind chaos site), so a mid-job serve-loop
+                # death is never mistaken for a clean stop, and a
+                # clean stop racing the workers' own teardown is never
+                # mistaken for a death (a phantom restart — or, with
+                # the budget spent, a spurious DEGRADED exit)
+                c.done = True
+                continue
+            # a dead server, or a worker that died nonzero (SIGKILL,
+            # crash, preemption): spend one unit of its budget
+            delay = next(c.delays, None)
+            if delay is None:
+                log(f"DEGRADED: {c.role} {c.idx} exited rc={rc} and "
+                    f"exhausted its restart budget "
+                    f"({max_restarts}, MXNET_LAUNCH_MAX_RESTARTS) — "
+                    "terminating the job instead of crash-looping")
+                return _cleanup(children, port_dir, DEGRADED_EXIT)
+            log(f"{c.role} {c.idx} exited rc={rc}; restart in "
+                f"{delay * 1e3:.0f}ms")
+            c.restart_at = now + delay
+        time.sleep(0.05)
 
 
 def launch_ssh(args, cmd):
@@ -141,6 +273,13 @@ def main(argv=None):
     ap.add_argument("--cpu-devices-per-worker", type=int, default=0,
                     help="force each worker onto N virtual CPU devices "
                          "(testing without TPU hardware)")
+    ap.add_argument("--supervise", action="store_true",
+                    help="restart dead server/worker children with "
+                         "jittered backoff behind "
+                         "MXNET_LAUNCH_MAX_RESTARTS; budget exhaustion "
+                         "degrades the job explicitly (exit 70) "
+                         "instead of crash-looping (local launcher "
+                         "only)")
     ap.add_argument("command", nargs=argparse.REMAINDER)
     args = ap.parse_args(argv)
     if not args.command:

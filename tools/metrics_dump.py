@@ -198,6 +198,73 @@ def _workload_resilience(steps: int) -> None:
     mx.waitall()
 
 
+def _workload_dist_resilience(steps: int) -> None:
+    """Elastic-distributed-training families in one process: a durable
+    PS snapshot/restore cycle with replayed-push dedupe (generation
+    bump, restore counter), heartbeat lease ages, and a coordinated
+    two-phase cluster checkpoint."""
+    import tempfile
+    import numpy as onp
+    import mxnet_tpu as mx
+    from mxnet_tpu.checkpoint import CoordinatedCheckpointManager
+
+    tmp = tempfile.mkdtemp(prefix="mxps-dist-resilience-")
+    os.environ["MXNET_PS_SNAPSHOT_DIR"] = os.path.join(tmp, "snap")
+    os.environ["MXNET_PS_SNAPSHOT_EVERY"] = "2"
+    os.environ["MXNET_PS_HEARTBEAT_INTERVAL_S"] = "0.2"
+    from mxnet_tpu.kvstore_async import PSServer, run_server, \
+        KVStoreDistAsync
+    import threading
+
+    from tests.test_distributed import _free_port
+    port = _free_port()
+    os.environ.update(DMLC_PS_ROOT_URI="127.0.0.1",
+                      DMLC_PS_ROOT_PORT=str(port),
+                      DMLC_NUM_SERVER="1", DMLC_NUM_WORKER="1",
+                      DMLC_WORKER_ID="0")
+    ev = threading.Event()
+    th = threading.Thread(target=run_server, args=(port, 1, ev),
+                          daemon=True)
+    th.start()
+    ev.wait(20)
+    kv = KVStoreDistAsync()
+    kv.init("w", mx.np.zeros(8))
+    for _ in range(max(steps, 3)):
+        kv.push("w", mx.np.array(onp.ones(8, "f4")))
+    kv.barrier()
+
+    class _Counter:
+        step = 0
+
+        def save_checkpoint(self, prefix):
+            with open(prefix + ".step", "w") as f:
+                f.write(str(self.step))
+
+        def load_checkpoint(self, prefix):
+            with open(prefix + ".step") as f:
+                self.step = int(f.read())
+
+    mgr = CoordinatedCheckpointManager(os.path.join(tmp, "ckpt"), kv)
+    mgr.save(_Counter(), step=max(steps, 3))
+    mgr.restore(_Counter())
+    # restart cycle: graceful stop (lossless snapshot) + fresh server
+    # restoring it — generation bumps, the restore counter ticks, and
+    # a replayed frame would dedupe
+    kv.stop_servers()
+    th.join(10)
+    ev2 = threading.Event()
+    th2 = threading.Thread(target=run_server, args=(port, 1, ev2),
+                           daemon=True)
+    th2.start()
+    ev2.wait(20)
+    kv.restart_heartbeat()
+    kv.push("w", mx.np.array(onp.ones(8, "f4")))   # detects the new gen
+    kv.pull("w", out=mx.np.zeros(8))
+    kv.server_stats()
+    kv.stop_servers()
+    th2.join(10)
+
+
 WORKLOADS = {
     "resnet_step": _workload_resnet_step,
     "mlp_fit": _workload_mlp_fit,
@@ -205,6 +272,7 @@ WORKLOADS = {
     "bulk": _workload_bulk,
     "health": _workload_health,
     "resilience": _workload_resilience,
+    "dist-resilience": _workload_dist_resilience,
 }
 
 
