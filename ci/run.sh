@@ -39,6 +39,17 @@
 #                           #   NaN plan -> exactly one skip + loss
 #                           #   recovery + budget; watchdog stack dump
 #                           #   on an injected stall; replay identical
+#   ci/run.sh input-pipeline-smoke # async device-prefetch gate:
+#                           #   synthetic slow loader + real step ->
+#                           #   steps/sec ~ max(loader, step) not the
+#                           #   sum, <10% stall with a hidden loader,
+#                           #   majority-stall demonstrated unpiped,
+#                           #   0 compiles after warmup, loss parity
+#   ci/run.sh bench-check   # bench regression gate (bench.py --check):
+#                           #   deterministic metrics (compiles after
+#                           #   warmup, flush growth, stall fraction)
+#                           #   FAIL; wall-clock vs ROUND_BASELINES
+#                           #   only WARNS (rig noise is +/-25-40%)
 #   ci/run.sh chaos         # full chaos suite incl. SIGKILL/SIGTERM
 #                           #   subprocess resume proofs
 #   ci/run.sh bulk-smoke    # lazy-bulking acceptance: lstm micro-run
@@ -164,6 +175,20 @@ run_health_smoke() {
   JAX_PLATFORMS=cpu timeout 300 python tools/health_smoke.py
 }
 
+run_input_pipeline_smoke() {
+  echo "== input-pipeline-smoke: prefetched steps/sec ~ max(loader,"
+  echo "   step) not their sum, stall <10% with a hidden loader vs"
+  echo "   majority-stall unpiped, 0 compiles after warmup, loss parity"
+  JAX_PLATFORMS=cpu timeout 300 python tools/input_smoke.py
+}
+
+run_bench_check() {
+  echo "== bench-check: deterministic bench regressions fail (compiles"
+  echo "   after warmup / flush growth / stall fraction); wall-clock"
+  echo "   deltas vs ROUND_BASELINES only warn (rig noise +/-25-40%)"
+  JAX_PLATFORMS=cpu timeout 600 python bench.py --check BENCH_r0*.json
+}
+
 run_chaos() {
   echo "== chaos: the full fault-tolerance suite, including the"
   echo "   SIGKILL/SIGTERM subprocess resume proofs"
@@ -175,7 +200,8 @@ run_tier1() {
   echo "== tier1: env-doc freshness + fault-site doc lint + serving"
   echo "   smoke + generation smoke + resilience smoke + dist-"
   echo "   resilience smoke + chaos smoke + health smoke + bulking"
-  echo "   smoke + the tier-1 pytest selection"
+  echo "   smoke + input-pipeline smoke + bench regression check +"
+  echo "   the tier-1 pytest selection"
   run_envdoc
   run_faultdoc
   run_serving_smoke
@@ -185,6 +211,8 @@ run_tier1() {
   run_chaos_smoke
   run_health_smoke
   run_bulk_smoke
+  run_input_pipeline_smoke
+  run_bench_check
   JAX_PLATFORMS=cpu python -m pytest tests/ -q -m 'not slow' \
     --continue-on-collection-errors -p no:cacheprovider
 }
@@ -280,6 +308,8 @@ case "$variant" in
   dist-resilience-smoke) run_dist_resilience_smoke ;;
   chaos-smoke)  run_chaos_smoke ;;
   health-smoke) run_health_smoke ;;
+  input-pipeline-smoke) run_input_pipeline_smoke ;;
+  bench-check)  run_bench_check ;;
   chaos)        run_chaos ;;
   bulk-smoke)   run_bulk_smoke ;;
   bulk-off)     run_bulk_off ;;

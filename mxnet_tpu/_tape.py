@@ -176,9 +176,13 @@ def backward_arrays(heads: Sequence[Any],
     from .base import MXNetError
     from . import bulk as _bulk
 
-    # the autograd boundary: pending bulked segments must materialize
-    # (and install their fused TapeNodes) before the tape is walked
-    _bulk.flush_all("autograd")
+    # the autograd boundary: pending bulked segments holding RECORDED
+    # ops must materialize (and install their fused TapeNodes) before
+    # the tape is walked.  Targeted, not flush_all: an unrecorded
+    # segment on another thread (async input prefetch, serving workers)
+    # has nothing on this tape and keeps building — cutting it at step
+    # cadence re-serialized exactly the work it overlaps
+    _bulk.flush_recorded("autograd")
 
     heads = list(heads)
     for h in heads:

@@ -67,7 +67,8 @@ from ._tape import TapeNode, is_recording
 from .base import MXNetError, getenv, register_env
 
 __all__ = ["PendingBuffer", "NOT_BULKED", "active", "max_ops",
-           "set_max_ops", "flush_all", "flush_current", "bulk_stats",
+           "set_max_ops", "flush_all", "flush_current", "flush_holding",
+           "flush_recorded", "bulk_stats",
            "reset_caches"]
 
 register_env("MXNET_BULK_MAX_OPS", 16,
@@ -692,6 +693,56 @@ def flush_all(reason: str = "waitall") -> None:
         segs = list(_LIVE_SEGMENTS.values())
     for seg in segs:
         seg.flush(reason)
+
+
+def flush_holding(arrays: Any, reason: str = "mutation") -> None:
+    """Targeted donation barrier: flush only the live segments that
+    captured any of ``arrays`` (raw device buffers, matched by identity)
+    as an external input, plus the calling thread's own segment.
+
+    The per-step donation barriers (``SPMDTrainer.step``/``run_steps``,
+    the gluon trainer's fused update) used to ``flush_all``: sound, but
+    it force-segmented EVERY thread's pending work once per step —
+    with the async input pipeline that meant the prefetch thread's
+    in-build preprocessing segment was cut mid-batch at step cadence
+    (serializing exactly the work the pipeline exists to overlap, and
+    churning the segment cache with truncated signatures).  A segment
+    that never captured a donated buffer cannot read deleted memory, so
+    it may keep building; the caller's own segment is always flushed —
+    it is the one that traced through the params being donated, and the
+    id-scan would miss a buffer captured between scan and donation on
+    this same thread."""
+    ids = {id(a) for a in arrays if a is not None}
+    flush_current(reason)
+    with _REG_LOCK:
+        segs = list(_LIVE_SEGMENTS.values())
+    own = getattr(_TLS, "segment", None)
+    for seg in segs:
+        if seg is own or seg.flushed:
+            continue
+        with seg.lock:
+            if any(id(raw) in ids for raw in seg.ext):
+                seg.flush(reason)
+
+
+def flush_recorded(reason: str = "autograd") -> None:
+    """Autograd barrier: flush the calling thread's segment plus every
+    live segment holding a RECORDED (tainted) node — those must install
+    their fused TapeNodes before the tape is walked.  An unrecorded
+    segment on another thread (the prefetch thread's in-build
+    preprocessing, a serving worker between requests) has nothing on
+    the tape and may keep building; any value of theirs this thread's
+    graph consumed was already forced at the cross-thread read."""
+    flush_current(reason)
+    with _REG_LOCK:
+        segs = list(_LIVE_SEGMENTS.values())
+    own = getattr(_TLS, "segment", None)
+    for seg in segs:
+        if seg is own or seg.flushed:
+            continue
+        with seg.lock:
+            if any(n.tainted for n in seg.nodes):
+                seg.flush(reason)
 
 
 def bulk_stats() -> Dict[str, float]:

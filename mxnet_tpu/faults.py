@@ -18,6 +18,7 @@ documented in docs/fault_tolerance.md):
 * ``kvstore.send``      — dist_async client, before a frame is sent
 * ``kvstore.recv``      — dist_async client, before a reply is read
 * ``dataloader.worker`` — inside a DataLoader worker, per batch job
+  (also fires inside the ``DevicePrefetcher`` background thread)
 * ``serving.execute``   — ModelServer worker, per assembled batch
 * ``serving.worker``    — the serving worker loop itself (worker-death
   chaos: an error here kills the worker thread, exercising the replica

@@ -181,7 +181,30 @@ class Estimator:
                                         block=self.net)
             last_saved[0] = step
 
+        def _start_async_read(*arrays) -> None:
+            # begin the device->host transfers WITHOUT blocking: by the
+            # time the one-step-late handlers read these values, the
+            # copy has ridden under the next step's device execution
+            for a in arrays:
+                try:
+                    a._data.copy_to_host_async()
+                except Exception:   # noqa: BLE001 - backend-dependent
+                    pass            # surface (and non-NDArray labels)
+
         stop = False
+        dispatched = 0      # optimizer steps dispatched by THIS call
+        # one-step-late READS: only the handlers whose batch_end is a
+        # pure device->host read (metric update, logging) defer a step —
+        # their asnumpy() then lands on an already-transferred value
+        # while the NEXT step executes, instead of serializing the
+        # device every batch.  Control handlers (stopping, validation,
+        # checkpoints, user hooks — including SUBCLASSES of the metric/
+        # logging handlers, which may stop or mutate) keep their exact
+        # pre-deferral timing: they observe each optimizer state once,
+        # at the original point.
+        deferred_ends = [h for h in batch_end
+                         if type(h) in (MetricHandler, LoggingHandler)]
+        immediate_ends = [h for h in batch_end if h not in deferred_ends]
         with PreemptionGuard() as guard:
             while not stop:
                 for h in epoch_begin:
@@ -189,11 +212,20 @@ class Estimator:
                 # explicit iteration so the loader wait is a measured
                 # phase: per-step time splits into data-wait (next(it)),
                 # dispatch (forward/backward/update — returns with
-                # device work still in flight), and device-sync
-                # (batch_end handlers fetch loss and update metrics,
-                # blocking on results)
+                # device work still in flight), and device-sync (the
+                # ONE-STEP-LATE batch_end handlers: batch N's metric /
+                # logging reads run while step N+1 is in flight, so the
+                # asnumpy() that used to serialize the device every
+                # batch now lands on an already-transferred value)
+                pending = None      # batch_end kwargs for batch N-1
                 it = iter(train_data)
                 while True:
+                    if self.max_batch is not None \
+                            and dispatched >= self.max_batch:
+                        # belt-and-braces: batches-mode must stay EXACT
+                        # even if a custom stopping handler is built on
+                        # the deferred (one-step-late) read path
+                        break
                     t0 = time.perf_counter()
                     try:
                         batch = next(it)
@@ -218,11 +250,20 @@ class Estimator:
                             # step skips/rewinds inside the hook
                             health_guard.note_loss(loss)
                         self.trainer.step(data.shape[0])
+                    dispatched += 1
                     t_dispatch = time.perf_counter()
-                    for h in batch_end:
+                    _start_async_read(loss, pred, label)
+                    for h in immediate_ends:
                         if h.batch_end(self, batch=batch, pred=pred,
                                        label=label, loss=loss):
                             stop = True
+                    if pending is not None:
+                        for h in deferred_ends:
+                            if h.batch_end(self, **pending):
+                                stop = True
+                    pending = (dict(batch=batch, pred=pred, label=label,
+                                    loss=loss)
+                               if deferred_ends else None)
                     t_end = time.perf_counter()
                     _metrics.record_step(t_end - t0,
                                          data=t_data - t0,
@@ -243,6 +284,13 @@ class Estimator:
                         _save_checkpoint()
                     if stop:
                         break
+                if pending is not None:
+                    # drain the deferred batch so epoch-end metrics and
+                    # logging cover EVERY batch, including the last
+                    for h in deferred_ends:
+                        if h.batch_end(self, **pending):
+                            stop = True
+                    pending = None
                 for h in epoch_end:
                     if h.epoch_end(self):
                         stop = True

@@ -279,12 +279,6 @@ class Trainer:
         self._update(ignore_stale_grad)
 
     def _update(self, ignore_stale_grad: bool = False) -> None:
-        # Both update paths (per-param Optimizer.update and the fused
-        # group below) donate weight/state buffers into jitted programs:
-        # any pending bulked segment still holding one of those buffers
-        # by value must materialize before the donation deletes it.
-        from .. import bulk as _bulk
-        _bulk.flush_all("mutation")
         updatable = []
         for i, p in enumerate(self._params):
             if p.grad_req == "null" or not p.is_initialized:
@@ -303,6 +297,19 @@ class Trainer:
                 self._states[i] = \
                     self._optimizer.create_state_multi_precision(i, w)
             updatable.append((i, w, g))
+        # Both update paths (per-param Optimizer.update and the fused
+        # group below) donate weight/state buffers into jitted programs:
+        # any pending bulked segment still holding one of those buffers
+        # BY VALUE must materialize before the donation deletes it.
+        # Targeted (flush_holding, not flush_all): a segment that never
+        # captured a donated buffer — the prefetch thread's in-build
+        # preprocessing — keeps building.
+        import jax as _jax
+        from .. import bulk as _bulk
+        donated = [w._data for _, w, _ in updatable]
+        for i, _, _ in updatable:
+            donated.extend(_jax.tree_util.tree_leaves(self._states[i]))
+        _bulk.flush_holding(donated, "mutation")
         agg = self._optimizer.aggregate_num
         if len(updatable) > 1 and agg > 1 and self._fused_optimizer_ok():
             # reference semantics: MXNET_OPTIMIZER_AGGREGATION_SIZE bounds
@@ -349,6 +356,56 @@ class Trainer:
         return (getattr(g, "stype", "default") != "row_sparse" and
                 not isinstance(self._states[i], opt.MasterWeightState))
 
+    _HYPER_CACHE_CAP = 512
+
+    def _committed_hypers(self, lrs, wds, rescale, clip):
+        """Value-keyed LRU of committed device hyperparameter arrays.
+
+        The fused update used to build fresh ``jnp.asarray`` host arrays
+        for lr/wd/rescale/clip EVERY step — on a remote accelerator
+        backend each varying-value host argument pays the slow
+        uncommitted-argument dispatch path per call (the same plateau
+        ``SPMDTrainer._committed_scalar`` exists for).  Hyperparameters
+        revisit a small value set (constant, or a cyclic schedule), so
+        an LRU by value makes the steady state zero-transfer."""
+        import jax.numpy as jnp
+        from .. import engine
+        key = (tuple(lrs), tuple(wds), float(rescale), float(clip))
+        cache = getattr(self, "_hyper_cache", None)
+        if cache is None:
+            from collections import OrderedDict
+            cache = self._hyper_cache = OrderedDict()
+        hit = cache.get(key)
+        if hit is None:
+            hit = tuple(engine.launder(
+                [jnp.asarray(lrs, jnp.float32),
+                 jnp.asarray(wds, jnp.float32),
+                 jnp.float32(rescale), jnp.float32(clip)]))
+            cache[key] = hit
+            if len(cache) > self._HYPER_CACHE_CAP:
+                cache.popitem(last=False)
+        else:
+            cache.move_to_end(key)
+        return hit
+
+    def _fused_ts(self, key, ts):
+        """Device-resident per-group schedule clock.  The counts
+        increment every step, so a host-built array would never cache —
+        instead the fused program returns ``ts + 1`` and the device copy
+        advances in-program; the host-side expected-value check resyncs
+        after ``load_states``/rewind (and a skipped update, which never
+        calls this, leaves both sides untouched)."""
+        import jax.numpy as jnp
+        from .. import engine
+        expected = tuple(float(t) for t in ts)
+        clock = getattr(self, "_fused_clock", None)
+        if clock is None:
+            clock = self._fused_clock = {}
+        hit = clock.get(key)
+        if hit is not None and hit[1] == expected:
+            return hit[0]
+        return engine.launder([jnp.asarray(ts, jnp.float32)])[0]
+
     def _fused_update(self, group) -> None:
         """One compiled program applying a group of parameter updates —
         the TPU-native form of the reference's multi-tensor ops
@@ -386,17 +443,22 @@ class Trainer:
                                        hps[k])
                     new_ws.append(nw)
                     new_sts.append(ns)
-                return new_ws, new_sts
+                # the schedule clock advances IN-PROGRAM (fed back as
+                # the next step's ts_): the loop never ships a fresh
+                # varying-value host array per step
+                return new_ws, new_sts, ts_ + 1.0
 
-            fn = cache[key] = jax.jit(raw, donate_argnums=(0, 2))
+            fn = cache[key] = jax.jit(raw, donate_argnums=(0, 2, 5))
         clip = o.clip_gradient if o.clip_gradient is not None else 0.0
-        new_ws, new_sts = fn(
+        lrs_a, wds_a, rescale_a, clip_a = self._committed_hypers(
+            lrs, wds, o.rescale_grad, clip)
+        new_ws, new_sts, ts_next = fn(
             [w._data for _, w, _ in group],
             [g._data for _, _, g in group],
             [self._states[i] for i, _, _ in group],
-            jnp.asarray(lrs, jnp.float32), jnp.asarray(wds, jnp.float32),
-            jnp.asarray(ts, jnp.float32), jnp.float32(o.rescale_grad),
-            jnp.float32(clip))
+            lrs_a, wds_a, self._fused_ts(key, ts), rescale_a, clip_a)
+        self._fused_clock[key] = (
+            ts_next, tuple(float(t) + 1.0 for t in ts))
         from .. import engine
         for (i, w, _), nw, ns in zip(group, new_ws, new_sts):
             w._data = nw

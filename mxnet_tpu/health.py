@@ -523,9 +523,12 @@ class HealthGuard:
         # the bulking donation barrier the optimizer update takes anyway
         # — flushing HERE (instead of letting the grad reads flush as
         # host reads) keeps the total segment count identical with and
-        # without the guard
+        # without the guard.  Only the CALLING thread's segment: the
+        # optimizer's own barrier is now the targeted flush_holding, so
+        # a global flush here would cut unrelated threads (the prefetch
+        # thread's in-build segment) that the update path leaves alone
         from . import bulk as _bulk
-        _bulk.flush_all("mutation")
+        _bulk.flush_current("mutation")
         from .ndarray.ndarray import NDArray
         arrs = [g._data if isinstance(g, NDArray) else g for g in grads]
         has_loss = loss is not None

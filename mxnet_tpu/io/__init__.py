@@ -6,7 +6,8 @@ from .io import (DataBatch, DataDesc, DataIter, NDArrayIter, ResizeIter,
                  PrefetchingIter)
 from .iters import (ImageRecordIter, CSVIter, LibSVMIter, MNISTIter,
                     create, register_iter)
+from .prefetch import DevicePrefetcher
 
 __all__ = ["DataBatch", "DataDesc", "DataIter", "NDArrayIter", "ResizeIter",
            "PrefetchingIter", "ImageRecordIter", "CSVIter", "LibSVMIter",
-           "MNISTIter", "create", "register_iter"]
+           "MNISTIter", "create", "register_iter", "DevicePrefetcher"]

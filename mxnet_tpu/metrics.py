@@ -679,6 +679,41 @@ GEN_KV_MIGRATIONS_TOTAL = counter(
     "power-of-two length bucket; each switches the engine to that "
     "bucket's pre-compiled decode step).")
 
+# -- async device-prefetch input pipeline (io/prefetch.py) ------------------
+PREFETCH_QUEUE_DEPTH = gauge(
+    "mxnet_prefetch_queue_depth",
+    "Device-resident batches currently queued ahead of the training "
+    "step by the DevicePrefetcher (<= MXNET_PREFETCH_DEPTH). Pinned at "
+    "0 while the consumer outruns the loader — pair with "
+    "mxnet_prefetch_stall_seconds to tell which side is the "
+    "bottleneck.")
+PREFETCH_H2D_SECONDS = histogram(
+    "mxnet_prefetch_h2d_seconds",
+    "Per-batch host->device placement time inside the prefetch thread "
+    "(sharded device_put / commit of the already-fetched batch). This "
+    "work overlaps the in-flight step; it only costs wall-clock when "
+    "it exceeds the step time.",
+    buckets=exponential_buckets(0.0005, 2.0, 14))
+PREFETCH_STALL_SECONDS = histogram(
+    "mxnet_prefetch_stall_seconds",
+    "Per-step time the TRAINING LOOP spent blocked waiting for the "
+    "prefetcher to produce its batch — the key input-pipeline number: "
+    "~0 means input is fully hidden behind device compute; a majority "
+    "share of mxnet_step_seconds means the loader (or H2D) is the "
+    "bottleneck.",
+    buckets=exponential_buckets(0.0005, 2.0, 14))
+PREFETCH_BATCHES_TOTAL = counter(
+    "mxnet_prefetch_batches_total",
+    "Batches fetched, placed on device, and queued by the "
+    "DevicePrefetcher background thread.")
+PREFETCH_INVALIDATED = counter(
+    "mxnet_prefetch_invalidated_total",
+    "Prefetched-batch invalidations (the queue is flushed and the "
+    "producer reseeks), by reason: 'seek' (non-consecutive step "
+    "request — checkpoint restore / resume), 'salt' (HealthGuard "
+    "rewind perturbed the replay salt), 'close' (pipeline shutdown).",
+    labels=("reason",))
+
 # -- serving resilience (serving/server.py + serving/replica.py) ------------
 SERVING_RECOVERIES_TOTAL = counter(
     "mxnet_serving_recoveries_total",
@@ -727,10 +762,23 @@ def record_step(total: float, data: float = 0.0, dispatch: float = 0.0,
         STEPS_PER_SECOND.set(count / total)
 
 
+_HIGHWATER_LAST = [0.0]      # monotonic seconds of the last real query
+_HIGHWATER_MIN_INTERVAL_S = 1.0
+
+
 def record_device_highwater() -> None:
     """Update the device-memory high-watermark gauge if the backend
-    exposes memory_stats (TPU does; XLA:CPU returns None)."""
+    exposes memory_stats (TPU does; XLA:CPU returns None).
+
+    Sampled at most once per second: the peak is monotonic within a
+    run, and on remote backends ``memory_stats()`` is a host<->device
+    round-trip — per-step it re-serializes the very loop the async
+    input pipeline unblocks."""
     try:
+        now = time.monotonic()
+        if now - _HIGHWATER_LAST[0] < _HIGHWATER_MIN_INTERVAL_S:
+            return
+        _HIGHWATER_LAST[0] = now
         import jax
         stats = jax.local_devices()[0].memory_stats()
         if stats:

@@ -189,9 +189,11 @@ class Optimizer:
         t = self._index_update_count.get(index, self.begin_num_update)
         clip_val = self.clip_gradient if self.clip_gradient is not None else 0.0
         # stepfn donates the weight/state buffers: a pending bulked
-        # segment still holding the old weight must materialize first
+        # segment still holding the old weight BY VALUE must materialize
+        # first (targeted — unrelated threads' segments keep building)
         from .. import bulk as _bulk
-        _bulk.flush_all("mutation")
+        _bulk.flush_holding(
+            [weight._data] + jax.tree_util.tree_leaves(state), "mutation")
         new_w, new_state = stepfn(weight._data, grad._data, state,
                                   jnp.float32(lr), jnp.float32(wd),
                                   jnp.float32(t),

@@ -35,8 +35,6 @@ from .mesh import make_mesh
 
 P = jax.sharding.PartitionSpec
 
-_INCR_FN = None  # jitted t+1 for the device-resident step counter
-
 register_env(
     "MXNET_SPMD_REBIND_INPUTS", 0,
     "Multi-process SPMDTrainer jobs: rebind caller NDArrays in place to "
@@ -227,6 +225,19 @@ class SPMDTrainer:
         self._multi_fn = None
         self._step_count = 0
         self._donate = donate
+        # prefetched fit() loops may donate batch buffers too (every
+        # step gets a fresh batch); toggled by _set_input_donation
+        self._donate_inputs = False
+        # (spec, shape, leading, host_local) -> NamedSharding: _place
+        # runs per input per step — the filtered-spec + sharding build
+        # is pure and repeats endlessly for steady-shape training
+        self._spec_cache: Dict[Any, Any] = {}
+        # (n_inputs, donate_inputs, health_gate) -> jitted step: flag
+        # toggles (fit entering/leaving prefetch donation or the health
+        # gate) swap back to the SAME jit wrapper instead of re-jitting
+        # — a fresh jax.jit wrapper retraces and recompiles even for an
+        # identical program
+        self._built_steps: Dict[Any, Any] = {}
         # health-sentry gate: when on, the compiled step computes a
         # fused finite-check over loss+grads, gates the whole update on
         # it (a bad step leaves params/state untouched ON DEVICE), and
@@ -264,17 +275,6 @@ class SPMDTrainer:
             self._scalar_cache.move_to_end(key)
         return a
 
-    def _advance_t(self) -> Any:
-        """Device-side step counter matching ``self._step_count``."""
-        global _INCR_FN
-        if self._t_dev is None:
-            self._t_dev = self._committed_scalar(float(self._step_count))
-        else:
-            if _INCR_FN is None:
-                _INCR_FN = jax.jit(lambda t: t + 1.0)
-            self._t_dev = _INCR_FN(self._t_dev)
-        return self._t_dev
-
     def set_health_gate(self, on: bool) -> None:
         """Toggle the in-program health sentry (``fit(health_guard=)``
         flips it).  Changing the flag changes the traced program, so the
@@ -289,12 +289,47 @@ class SPMDTrainer:
         if hasattr(self, "_raw_step_fn"):
             del self._raw_step_fn
 
+    def _set_input_donation(self, on: bool) -> None:
+        """Donate batch buffers into the compiled step.  Only valid for
+        loops that feed every step a FRESH batch (the prefetched fit
+        path): donation deletes the input buffer after the call, so a
+        re-used batch would read dead memory.  Changing the flag
+        changes the jit donation signature, invalidating the step."""
+        on = bool(on)
+        if self._donate_inputs == on:
+            return
+        self._donate_inputs = on
+        self._step_fn = None
+
     # ------------------------------------------------------------------
     def _build_step(self, n_inputs: int) -> Callable:
+        body = self._build_step_body(n_inputs,
+                                     health_gate=self._health_gate)
+
+        def step(param_arrays, opt_states, rng, lr, wd, t, *batch):
+            # the device-side step counter advances INSIDE the program
+            # (trailing t+1 output fed back as next step's t): the loop
+            # used to dispatch a separate tiny increment program per
+            # step — a fixed host round-trip on remote backends
+            return body(param_arrays, opt_states, rng, lr, wd, t,
+                        *batch) + (t + 1.0,)
+
         donate = (0, 1) if self._donate else ()
-        return jax.jit(self._build_step_body(
-            n_inputs, health_gate=self._health_gate),
-            donate_argnums=donate)
+        if not self._donate_inputs:
+            return jax.jit(step, donate_argnums=donate)
+        # batch args start at position 6; n_inputs data arrays plus
+        # the label array.  Batch buffers rarely alias an output shape
+        # (params/states/loss) — the donation win is the EARLY release
+        # of the consumed batch's device memory, so XLA's "donated
+        # buffers were not usable" aliasing warning is expected noise:
+        # filter it ONCE, message-scoped, at build time (a per-call
+        # warnings.catch_warnings() mutates process-global state and is
+        # documented thread-unsafe against the prefetch thread)
+        import warnings as _warnings
+        _warnings.filterwarnings(
+            "ignore", message="Some donated buffers were not usable")
+        donate = donate + tuple(range(6, 6 + n_inputs + 1))
+        return jax.jit(step, donate_argnums=donate)
 
     def _build_step_body(self, n_inputs: int,
                          health_gate: bool = False) -> Callable:
@@ -472,6 +507,7 @@ class SPMDTrainer:
             self._remat_flag = remat
             self._step_fn = None
             self._multi_fn = None
+            self._built_steps.clear()
             if hasattr(self, "_raw_step_fn"):
                 del self._raw_step_fn
         epoch = graph_epoch()
@@ -481,6 +517,7 @@ class SPMDTrainer:
                 return      # traced program cannot have changed
             self._step_fn = None
             self._multi_fn = None
+            self._built_steps.clear()
             if hasattr(self, "_raw_step_fn"):
                 del self._raw_step_fn
 
@@ -497,33 +534,42 @@ class SPMDTrainer:
         multi = jax.process_count() > 1
         host_local = multi and not (
             isinstance(a, jax.Array) and not a.is_fully_addressable)
-        # a host-local batch is a PER-PROCESS shard: its dims must divide
-        # the per-process mesh extent, not the global axis size (a local
-        # batch of 2 on a dp=4 mesh over 2 processes is valid — 2 local
-        # devices each)
-        sizes = (dict(zip(self.mesh.axis_names,
-                          self.mesh.local_mesh.devices.shape))
-                 if host_local else None)
-        orig, shape = spec, tuple(a.shape[1:] if leading_step_dim
-                                  else a.shape)
-        spec = _filter_spec(orig, shape, self.mesh, axis_sizes=sizes)
-        if host_local:
-            # for host-local data a dropped-for-divisibility axis CHANGES
-            # MEANING (shard of the global batch -> claimed copy of it),
-            # so it must error, not silently replicate inconsistent data
-            membership = _filter_spec(
-                orig, shape, self.mesh,
-                axis_sizes={n: 1 for n in self.mesh.axis_names})
-            if tuple(spec) != tuple(membership):
-                raise MXNetError(
-                    f"per-process batch shape {shape} does not divide "
-                    f"the local mesh extent "
-                    f"{dict((k, v) for k, v in sizes.items())} for spec "
-                    f"{orig}; each process's local batch must split "
-                    "evenly over its own devices")
-        if leading_step_dim:
-            spec = P(*((None,) + tuple(spec)))
-        sh = jax.sharding.NamedSharding(self.mesh, spec)
+        orig = spec
+        cache_key = (orig, tuple(a.shape), leading_step_dim, host_local)
+        cached = self._spec_cache.get(cache_key)
+        if cached is not None:
+            spec, sh = cached
+        else:
+            # a host-local batch is a PER-PROCESS shard: its dims must
+            # divide the per-process mesh extent, not the global axis
+            # size (a local batch of 2 on a dp=4 mesh over 2 processes
+            # is valid — 2 local devices each)
+            sizes = (dict(zip(self.mesh.axis_names,
+                              self.mesh.local_mesh.devices.shape))
+                     if host_local else None)
+            shape = tuple(a.shape[1:] if leading_step_dim else a.shape)
+            spec = _filter_spec(orig, shape, self.mesh, axis_sizes=sizes)
+            if host_local:
+                # for host-local data a dropped-for-divisibility axis
+                # CHANGES MEANING (shard of the global batch -> claimed
+                # copy of it), so it must error, not silently replicate
+                # inconsistent data
+                membership = _filter_spec(
+                    orig, shape, self.mesh,
+                    axis_sizes={n: 1 for n in self.mesh.axis_names})
+                if tuple(spec) != tuple(membership):
+                    raise MXNetError(
+                        f"per-process batch shape {shape} does not "
+                        f"divide the local mesh extent "
+                        f"{dict((k, v) for k, v in sizes.items())} for "
+                        f"spec {orig}; each process's local batch must "
+                        "split evenly over its own devices")
+            if leading_step_dim:
+                spec = P(*((None,) + tuple(spec)))
+            sh = jax.sharding.NamedSharding(self.mesh, spec)
+            if len(self._spec_cache) > 64:     # few live shapes; bound it
+                self._spec_cache.clear()
+            self._spec_cache[cache_key] = (spec, sh)
         cur = getattr(a, "sharding", None)
         if cur is not None and (cur == sh or (
                 hasattr(cur, "is_equivalent_to") and
@@ -599,9 +645,12 @@ class SPMDTrainer:
             [jnp.asarray(lrs, jnp.float32), jnp.asarray(wds, jnp.float32),
              jnp.float32(base + 1)])
         # donated param/state buffers: pending bulked segments holding
-        # them must materialize first
+        # them BY VALUE must materialize first (targeted — the prefetch
+        # thread's in-build segment never captured them and keeps going)
         from .. import bulk as _bulk
-        _bulk.flush_all("mutation")
+        _bulk.flush_holding(
+            param_arrays + jax.tree_util.tree_leaves(self._opt_states),
+            "mutation")
         new_params, new_states, losses = self._multi_fn(
             param_arrays, self._opt_states, keys,
             lrs_a, wds_a, t0_a, *arrays, label_arr)
@@ -650,26 +699,44 @@ class SPMDTrainer:
             arrays, label_arr = corr[:-1], corr[-1]
         self._check_graph_epoch()
         if self._step_fn is None:
-            self._step_fn = self._build_step(len(arrays))
+            key = (len(arrays), self._donate_inputs, self._health_gate)
+            fn = self._built_steps.get(key)
+            if fn is None:
+                fn = self._built_steps[key] = \
+                    self._build_step(len(arrays))
+            self._step_fn = fn
         self._step_count += 1
         self.optimizer.num_update = self._step_count
         lr = self.optimizer.learning_rate
         wd = self.optimizer.wd
         rng = _random.split_key()
         param_arrays = [p.data()._data for p in self._params]
-        # the compiled step donates param/state buffers: any pending
-        # bulked segment still holding one must materialize first
+        if self._t_dev is None:
+            # (re-)sync the device-resident step counter; afterwards it
+            # advances inside the compiled step (trailing t+1 output)
+            self._t_dev = self._committed_scalar(float(self._step_count))
+        # the compiled step donates param/state buffers (and, on the
+        # prefetched fit path, the batch buffers): any pending bulked
+        # segment still holding one BY VALUE must materialize first.
+        # Targeted — NOT flush_all: a global flush here cut the prefetch
+        # thread's in-build preprocessing segment once per step,
+        # re-serializing exactly the work the input pipeline overlaps
         from .. import bulk as _bulk
-        _bulk.flush_all("mutation")
+        donated = param_arrays + jax.tree_util.tree_leaves(
+            self._opt_states)
+        if self._donate_inputs:
+            donated = donated + list(arrays) + [label_arr]
+        _bulk.flush_holding(donated, "mutation")
         out = self._step_fn(
             param_arrays, self._opt_states, rng,
             self._committed_scalar(lr), self._committed_scalar(wd),
-            self._advance_t(),
+            self._t_dev,
             *arrays, label_arr)
         if self._health_gate:
-            new_params, new_states, loss, self._last_health = out
+            new_params, new_states, loss, self._last_health, \
+                self._t_dev = out
         else:
-            new_params, new_states, loss = out
+            new_params, new_states, loss, self._t_dev = out
         from .. import engine as _engine
         _engine.mark_clean(new_params)
         for p, a in zip(self._params, new_params):
@@ -688,6 +755,44 @@ class SPMDTrainer:
     def learning_rate(self) -> float:
         return self.optimizer.learning_rate
 
+    def input_placement(self) -> Callable[[Any], Any]:
+        """A ``(data, labels) -> (data, labels)`` callable committing a
+        batch onto this trainer's mesh shardings.
+
+        ``DevicePrefetcher.attach(trainer)`` installs it as the
+        prefetcher's placement: the background thread then pays the
+        host->device transfer of batch N+1 while step N executes, and
+        ``step()``'s own ``_place`` short-circuits on the already-
+        matching sharding (no second copy).
+
+        Multi-process jobs keep placement at step time (identity here):
+        ``_place`` there runs ``host_local_array_to_global_array`` — a
+        cross-process collective that must interleave identically on
+        every process, which a background thread cannot guarantee
+        against the step's own collectives — and skips the in-place
+        rebind, so prefetch-thread placement work would be discarded
+        anyway.  The prefetcher still overlaps the host fetch +
+        preprocessing."""
+        if jax.process_count() > 1:
+            return lambda batch: batch
+
+        def one(x: Any, spec: "P") -> Any:
+            if not isinstance(x, NDArray):
+                x = from_jax(jnp.asarray(x))
+            self._place(x, spec)       # rebinds x._data mesh-resident
+            return x
+
+        def place(batch: Any) -> Any:
+            data, labels = batch
+            if isinstance(data, (list, tuple)):
+                data = type(data)(one(x, self._data_spec) for x in data)
+            else:
+                data = one(data, self._data_spec)
+            labels = one(labels, self._label_spec)
+            return data, labels
+
+        return place
+
     # -- preemption-safe training loop ---------------------------------
     def fit(self, batch_fn: Any, num_steps: int,
             checkpoint_manager: Any = None,
@@ -697,9 +802,15 @@ class SPMDTrainer:
         preemption — the kill-and-restart-safe loop.
 
         ``batch_fn``: a callable ``step -> (data, labels)`` (preferred —
-        resume re-derives the exact batch for any step), or an iterable
+        resume re-derives the exact batch for any step), an iterable
         of ``(data, labels)`` (on resume, the first ``restored_step``
-        batches are consumed and discarded to stay on-schedule).
+        batches are consumed and discarded to stay on-schedule), or a
+        :class:`~mxnet_tpu.io.DevicePrefetcher` wrapping either.  A
+        callable-mode prefetcher is driven directly: host fetch +
+        sharded device placement of batch N+1 overlap step N on the
+        prefetch thread, batch buffers are donated to the compiled step
+        (``MXNET_PREFETCH_DONATE``), and checkpoint resume / HealthGuard
+        rewind invalidate queued batches transparently.
 
         With ``checkpoint_manager``: restores the newest verified
         checkpoint before the first step (making the call idempotent
@@ -735,17 +846,34 @@ class SPMDTrainer:
         its single per-step readback).
         """
         from ..preemption import PreemptionGuard
+        from ..io.prefetch import DevicePrefetcher
         if checkpoint_manager is not None:
             checkpoint_manager.restore(self)
         start = self._step_count
-        if callable(batch_fn):
-            import inspect
-            try:
-                takes_salt = "salt" in inspect.signature(
-                    batch_fn).parameters
-            except (TypeError, ValueError):
-                takes_salt = False
-            if takes_salt and health_guard is not None:
+        prefetcher: Optional[DevicePrefetcher] = None
+        if isinstance(batch_fn, DevicePrefetcher) and batch_fn.is_callable:
+            # the prefetched loop: batch N+1 is fetched, preprocessed,
+            # and committed to this trainer's mesh shardings on the
+            # prefetcher's background thread WHILE step N executes —
+            # get() below is a queue pop of a device-resident batch.
+            # A resume (non-consecutive step) or a HealthGuard rewind
+            # (changed salt) invalidates queued batches automatically.
+            prefetcher = batch_fn.attach(self)
+            if prefetcher.takes_salt and health_guard is not None:
+                def get_batch(step):
+                    return prefetcher.get(
+                        step, salt=health_guard.replay_salt)
+            else:
+                def get_batch(step):
+                    return prefetcher.get(step)
+            if prefetcher.donate:
+                # every step gets a FRESH device-resident batch, so its
+                # buffers can be donated into the compiled step (XLA
+                # reuses the input memory for outputs)
+                self._set_input_donation(True)
+        elif callable(batch_fn):
+            from ..io.prefetch import takes_salt as _takes_salt
+            if _takes_salt(batch_fn) and health_guard is not None:
                 def get_batch(step):
                     return batch_fn(step, salt=health_guard.replay_salt)
             else:
@@ -768,7 +896,11 @@ class SPMDTrainer:
         import contextlib
         if health_guard is not None:
             self.set_health_gate(True)
-            if checkpoint_manager is not None and callable(batch_fn):
+            if checkpoint_manager is not None and (
+                    prefetcher is not None or callable(batch_fn)):
+                # a callable-mode prefetcher replays like a bare
+                # batch_fn: the rewind's non-consecutive step (and
+                # perturbed salt) invalidates its queue and reseeks
                 health_guard.set_rewind(
                     lambda: checkpoint_manager.restore(self))
         loss: Optional[NDArray] = None
@@ -875,6 +1007,10 @@ class SPMDTrainer:
         finally:
             if health_guard is not None:
                 self.set_health_gate(False)
+            if prefetcher is not None and prefetcher.donate:
+                # manual step() calls after fit must not have their
+                # batch buffers deleted under them
+                self._set_input_donation(False)
         return loss
 
     # -- checkpoint / resume (reference SURVEY.md 5.4: .params format +

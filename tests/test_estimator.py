@@ -90,3 +90,18 @@ def test_fit_requires_duration():
     est = _estimator()
     with pytest.raises(mx.MXNetError, match="epochs or batches"):
         est.fit(train_data=_toy_loader())
+
+
+def test_custom_handler_subclass_keeps_immediate_timing():
+    """One-step-late deferral applies ONLY to the exact framework
+    metric/logging handlers: a user SUBCLASS (which may stop or mutate)
+    runs at the original point — its stop verdict after batch N must
+    not buy an extra optimizer step (epochs-mode, no batches guard)."""
+    class StopAtTwo(LoggingHandler):
+        def batch_end(self, estimator, *args, **kwargs):
+            return estimator.trainer._optimizer.num_update >= 2
+
+    est = _estimator()
+    est.fit(train_data=_toy_loader(), epochs=5,
+            event_handlers=[StopAtTwo()])
+    assert est.trainer._optimizer.num_update == 2

@@ -1,0 +1,186 @@
+"""Input-pipeline acceptance smoke (ci/run.sh input-pipeline-smoke,
+in tier-1).
+
+Bounded (~30s) proof of the ISSUE-9 async-prefetch contract on a tiny
+SPMD run with a SYNTHETIC SLOW LOADER (fixed per-batch sleep) feeding a
+real compiled step:
+
+1. **overlap**: with the prefetcher on, steps/sec tracks
+   ``max(loader, step)`` — the wall clock of the slower side — not
+   their sum; the unpiped loop pays the sum.
+2. **stall accounting**: with a loader FASTER than the step the
+   prefetched run's ``mxnet_prefetch_stall_seconds`` is <10% of wall
+   time (input fully hidden); the unpiped run under the SLOW loader
+   demonstrably spends the majority of its wall time waiting on input.
+3. **steady state**: 0 XLA compiles after warmup across the timed
+   prefetched windows.
+4. **determinism**: the prefetched run's final loss is bit-identical
+   to the unpiped run of the same seed.
+
+Exit code 0 = all assertions held.
+"""
+import os
+import sys
+import time
+
+sys.path.insert(0, os.path.dirname(os.path.dirname(os.path.abspath(__file__))))
+
+STEPS = 14
+WARM = 4
+
+
+def _trainer():
+    import jax
+    import mxnet_tpu as mx
+    from mxnet_tpu.parallel import (SPMDTrainer, make_mesh,
+                                    DATA_PARALLEL_RULES)
+    mx.random.seed(0)
+    net = mx.gluon.nn.Sequential()
+    net.add(mx.gluon.nn.Dense(512, activation="relu"),
+            mx.gluon.nn.Dense(512, activation="relu"),
+            mx.gluon.nn.Dense(64))
+    net.initialize()
+    net(mx.np.zeros((2, 256)))
+    return SPMDTrainer(net, mx.gluon.loss.L2Loss(), "sgd",
+                       {"learning_rate": 0.01},
+                       mesh=make_mesh({"dp": 1},
+                                      devices=jax.devices()[:1]),
+                       rules=DATA_PARALLEL_RULES)
+
+
+def _make_batch_fn(sleep_s, spent=None):
+    import numpy as onp
+    import mxnet_tpu as mx
+
+    def batch_fn(step):
+        t0 = time.perf_counter()
+        time.sleep(sleep_s)                    # the synthetic host work
+        rng = onp.random.RandomState(step)
+        b = (mx.np.array(rng.uniform(-1, 1, (256, 256)).astype("f4")),
+             mx.np.array(rng.uniform(-1, 1, (256, 64)).astype("f4")))
+        if spent is not None:
+            spent[0] += time.perf_counter() - t0
+        return b
+
+    return batch_fn
+
+
+def _timed_fit(trainer, source, upto):
+    t0 = time.perf_counter()
+    loss = trainer.fit(source, upto)
+    val = float(loss.asnumpy())
+    return time.perf_counter() - t0, val
+
+
+def main() -> None:
+    import jax
+    jax.config.update("jax_platforms", "cpu")
+    from mxnet_tpu import metrics
+    from mxnet_tpu.io import DevicePrefetcher
+
+    # calibrate the compiled step time with an instant loader so the
+    # sleep-based legs scale to this rig's actual step cost
+    def calibrate():
+        tr = _trainer()
+        tr.fit(_make_batch_fn(0.0), WARM)
+        cal0 = time.perf_counter()
+        tr.fit(_make_batch_fn(0.0), WARM + 6).asnumpy()
+        return max((time.perf_counter() - cal0) / 6, 0.004)
+
+    step_s = calibrate()
+
+    # -- leg 1: loader FASTER than the step -> prefetch hides it -------------
+    # the loader sleep is derived from the CALIBRATED step time with a
+    # 0.3x margin: on this rig host load swings ±25-40% between the
+    # calibration window and the timed leg, and a calibration taken
+    # under load would otherwise hand leg 1 a loader genuinely SLOWER
+    # than the realized step (a true stall, not a gate miss).  One
+    # recalibrated retry absorbs a load spike; the deterministic gates
+    # (compiles, loss parity) are never retried.
+    for attempt in range(2):
+        fast = 0.3 * step_s
+        tr1 = _trainer()
+        pf1 = DevicePrefetcher(_make_batch_fn(fast), depth=2)
+        tr1.fit(pf1, WARM)                         # warmup: compile
+        c0 = metrics.value("mxnet_compile_misses_total")
+        s0 = metrics.hist_stats("mxnet_prefetch_stall_seconds")[0]
+        wall1, loss1 = _timed_fit(tr1, pf1, WARM + STEPS)
+        pf1.close()
+        compiles1 = metrics.value("mxnet_compile_misses_total") - c0
+        stall1 = metrics.hist_stats("mxnet_prefetch_stall_seconds")[0] - s0
+        frac1 = stall1 / wall1
+        if frac1 < 0.10 or attempt:
+            break
+        print(f"leg 1 stall {frac1:.3f} over the 10% gate — "
+              "recalibrating and retrying once (load spike between "
+              "calibration and the timed leg, not a verdict)")
+        step_s = calibrate()
+
+    # the same seed unpiped pays loader + step per step — and must land
+    # on the SAME loss (prefetch is a scheduling change, not a numeric
+    # one)
+    spent = [0.0]
+    tr1u = _trainer()
+    tr1u.fit(_make_batch_fn(fast), WARM)
+    spent[0] = 0.0
+    wall1u, loss1u = _timed_fit(tr1u, _make_batch_fn(fast, spent),
+                                WARM + STEPS)
+
+    assert loss1 == loss1u, \
+        f"prefetched loss {loss1!r} != unpiped loss {loss1u!r}"
+    assert compiles1 == 0, \
+        f"{compiles1:.0f} XLA compiles after warmup (want 0)"
+    assert frac1 < 0.10, \
+        f"stall fraction {frac1:.3f} with a loader 0.3x the step — " \
+        "the prefetcher is not hiding input"
+    # stall ~0 IS the step-bound half of "steps/sec ~ max(loader,
+    # step)": the loop waited on input for <10% of the wall, so its
+    # rate is the step's.  A leg-1 wall-clock A/B would re-prove the
+    # same thing through ±25-40% rig noise (on CPU the prefetch
+    # thread's numpy work also CONTENDS with the XLA step for cores,
+    # shrinking the visible gap); the loader-bound direction, where
+    # the effect dwarfs the noise, is asserted on wall clock in leg 2.
+
+    # -- leg 2: loader SLOWER than the step -> loader-bound, metric says so --
+    slow = 2.5 * step_s
+    spent2 = [0.0]
+    tr2u = _trainer()
+    tr2u.fit(_make_batch_fn(slow), WARM)
+    spent2[0] = 0.0
+    wall2u, _ = _timed_fit(tr2u, _make_batch_fn(slow, spent2),
+                           WARM + STEPS)
+    unpiped_input_frac = spent2[0] / wall2u
+
+    tr2 = _trainer()
+    pf2 = DevicePrefetcher(_make_batch_fn(slow), depth=2)
+    tr2.fit(pf2, WARM)
+    s0 = metrics.hist_stats("mxnet_prefetch_stall_seconds")[0]
+    wall2, _ = _timed_fit(tr2, pf2, WARM + STEPS)
+    pf2.close()
+    stall2 = metrics.hist_stats("mxnet_prefetch_stall_seconds")[0] - s0
+
+    assert unpiped_input_frac > 0.5, \
+        f"unpiped slow-loader run only {unpiped_input_frac:.0%} " \
+        "input-bound — the synthetic loader is not slow enough to " \
+        "prove anything"
+    # loader-bound: wall ~ N * loader, NOT N * (loader + step); the
+    # step rides entirely under the loader sleep
+    assert wall2 < wall2u - 0.5 * STEPS * step_s, \
+        f"prefetched wall {wall2:.3f}s vs unpiped {wall2u:.3f}s with " \
+        f"a {slow * 1000:.1f}ms loader — the step is not hidden"
+    # and the stall metric must EXPOSE the loader as the bottleneck
+    assert stall2 / wall2 > 0.4, \
+        f"loader-bound run shows only {stall2 / wall2:.0%} stall — " \
+        "the metric is not surfacing the input bottleneck"
+
+    print(f"input-pipeline-smoke PASS: step {step_s * 1000:.1f}ms | "
+          f"fast loader {fast * 1000:.1f}ms: stall {frac1:.1%}, "
+          f"wall {wall1:.2f}s vs unpiped {wall1u:.2f}s, 0 compiles, "
+          f"loss bit-identical | slow loader {slow * 1000:.1f}ms: "
+          f"wall {wall2:.2f}s vs unpiped {wall2u:.2f}s "
+          f"(unpiped {unpiped_input_frac:.0%} input-bound, prefetched "
+          f"stall {stall2 / wall2:.0%} names the loader)")
+
+
+if __name__ == "__main__":
+    main()

@@ -23,6 +23,10 @@ Workloads:
   health       an SPMD micro-fit under a seeded NaN fault plan with a
                HealthGuard: health event counters, skip totals, the
                loss EMA gauge, and the fused-check latency histogram.
+  input        a prefetched SPMD micro-fit with a deliberately slow
+               host loader: prefetch queue depth, per-batch H2D
+               seconds, per-step stall seconds (the input-pipeline
+               number of record), batch/invalidated counters.
   resilience   a replicated ModelServer plus a supervised
                GenerationServer under seeded worker-kill / decode-fault
                plans: recovery counters (by site), recovered tokens,
@@ -154,6 +158,39 @@ def _workload_health(steps: int) -> None:
     mx.waitall()
 
 
+def _workload_input(steps: int) -> None:
+    """Async input-pipeline families: a prefetched SPMD fit whose
+    loader sleeps per batch (stall + h2d + queue depth), then a seek
+    (resume-style) pull to tick the invalidation counter."""
+    import numpy as onp
+    import jax
+    import mxnet_tpu as mx
+    from mxnet_tpu.io import DevicePrefetcher
+    from mxnet_tpu.parallel import SPMDTrainer, make_mesh
+
+    mx.random.seed(0)
+    net = mx.gluon.nn.Dense(4)
+    net.initialize()
+    net(mx.np.zeros((2, 8)))
+    trainer = SPMDTrainer(net, mx.gluon.loss.L2Loss(), "sgd",
+                          {"learning_rate": 0.05},
+                          mesh=make_mesh({"dp": 1},
+                                         devices=jax.devices()[:1]))
+
+    def batch_fn(step):
+        time.sleep(0.002)
+        rng = onp.random.RandomState(step)
+        return (mx.np.array(rng.uniform(-1, 1, (8, 8)).astype("f4")),
+                mx.np.array(rng.uniform(-1, 1, (8, 4)).astype("f4")))
+
+    pf = DevicePrefetcher(batch_fn, depth=2)
+    n = max(steps, 3)
+    trainer.fit(pf, n)
+    pf.get(0)           # non-consecutive step: invalidation ('seek')
+    pf.close()
+    mx.waitall()
+
+
 def _workload_resilience(steps: int) -> None:
     import numpy as onp
     import mxnet_tpu as mx
@@ -271,6 +308,7 @@ WORKLOADS = {
     "eager": _workload_eager,
     "bulk": _workload_bulk,
     "health": _workload_health,
+    "input": _workload_input,
     "resilience": _workload_resilience,
     "dist-resilience": _workload_dist_resilience,
 }
