@@ -35,6 +35,14 @@
 #                           #   budget exhaustion degrades (exit 70)
 #   ci/run.sh chaos-smoke   # bounded fault-injection/preemption proof
 #                           #   (tests/test_faults.py -k smoke)
+#   ci/run.sh cache-smoke   # persistent compile cache warm-restart
+#                           #   gate: cold run compiles N + persists,
+#                           #   restarted training job and serving
+#                           #   replica compile 0 with bit-identical
+#                           #   losses/tokens, a fully poisoned cache
+#                           #   + seeded read/write fault plan
+#                           #   degrades to quarantine+recompile with
+#                           #   0 caller-visible errors
 #   ci/run.sh health-smoke  # training health guard acceptance: seeded
 #                           #   NaN plan -> exactly one skip + loss
 #                           #   recovery + budget; watchdog stack dump
@@ -152,6 +160,14 @@ run_chaos_smoke() {
     -k smoke -q -p no:cacheprovider
 }
 
+run_cache_smoke() {
+  echo "== cache-smoke: persistent compile cache — cold compiles N +"
+  echo "   durable writes, restarted training job and serving replica"
+  echo "   compile 0 with bit-identical losses/tokens, poisoned cache"
+  echo "   + seeded fault plan degrades to recompile with 0 errors"
+  JAX_PLATFORMS=cpu timeout 600 python tools/cache_smoke.py
+}
+
 run_bulk_smoke() {
   echo "== bulk-smoke: lazy eager-op bulking acceptance — lstm micro-run"
   echo "   asserting >=1.3x eager->bulked dispatch reduction, 0 segment"
@@ -199,9 +215,9 @@ run_chaos() {
 run_tier1() {
   echo "== tier1: env-doc freshness + fault-site doc lint + serving"
   echo "   smoke + generation smoke + resilience smoke + dist-"
-  echo "   resilience smoke + chaos smoke + health smoke + bulking"
-  echo "   smoke + input-pipeline smoke + bench regression check +"
-  echo "   the tier-1 pytest selection"
+  echo "   resilience smoke + chaos smoke + cache smoke + health"
+  echo "   smoke + bulking smoke + input-pipeline smoke + bench"
+  echo "   regression check + the tier-1 pytest selection"
   run_envdoc
   run_faultdoc
   run_serving_smoke
@@ -209,6 +225,7 @@ run_tier1() {
   run_resilience_smoke
   run_dist_resilience_smoke
   run_chaos_smoke
+  run_cache_smoke
   run_health_smoke
   run_bulk_smoke
   run_input_pipeline_smoke
@@ -307,6 +324,7 @@ case "$variant" in
   resilience-smoke) run_resilience_smoke ;;
   dist-resilience-smoke) run_dist_resilience_smoke ;;
   chaos-smoke)  run_chaos_smoke ;;
+  cache-smoke)  run_cache_smoke ;;
   health-smoke) run_health_smoke ;;
   input-pipeline-smoke) run_input_pipeline_smoke ;;
   bench-check)  run_bench_check ;;

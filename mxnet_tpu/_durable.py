@@ -1,0 +1,138 @@
+"""Shared durable-write recipe — ONE hardened implementation of the
+atomic stage/fsync/rename/verify discipline, used by every subsystem
+that persists restart-critical state.
+
+Extracted from :mod:`mxnet_tpu.checkpoint` (the PR-3 hardening) so the
+checkpoint manager and the persistent compile cache cannot drift apart:
+
+* :func:`fsync_dir` — make renames/creates inside a directory durable;
+* :func:`sha256_file` / :func:`sha256_bytes` — the manifest digests;
+* :func:`write_bytes_durable` — stage into a same-directory temp file,
+  flush + fsync, then atomically rename into place (and fsync the
+  directory), so a crash at ANY point leaves either the old file or the
+  complete new one — never a torn write.  Returns the staged content's
+  SHA-256 so callers record exactly the bytes that hit the disk;
+* :func:`sweep_orphans` — remove staging leftovers a crashed writer
+  abandoned, with an age guard so a LIVE writer's staging entry (a
+  preempted process still finishing its final write) always survives.
+
+The invariants every caller gets for free:
+
+1. after the write returns, the bytes the recorded digest covers are
+   the bytes on disk, crash or no crash (fsync BEFORE rename);
+2. a reader either sees the complete previous value or the complete new
+   value (atomic ``os.replace`` within one filesystem);
+3. concurrent writers of the same path are safe: both stage privately,
+   the last rename wins wholesale — no interleaving;
+4. crash debris is bounded: any later process sweeps aged-out staging
+   entries carrying the caller's prefix (the prefix scoping means the
+   sweep can never touch user data).
+"""
+from __future__ import annotations
+
+import hashlib
+import os
+import shutil
+import tempfile
+import time
+from typing import Iterable, Optional
+
+__all__ = ["fsync_dir", "sha256_file", "sha256_bytes",
+           "write_bytes_durable", "sweep_orphans", "ORPHAN_MIN_AGE_S"]
+
+# A staging entry younger than this is presumed to belong to a live
+# writer (e.g. a preempted trainer finishing its final checkpoint while
+# the replacement process starts up) and is never swept.
+ORPHAN_MIN_AGE_S = 300.0
+
+
+def fsync_dir(path: str) -> None:
+    """fsync a directory so renames/creates inside it are durable; best
+    effort on filesystems without directory fds."""
+    try:
+        fd = os.open(path, os.O_RDONLY)
+    except OSError:
+        return
+    try:
+        os.fsync(fd)
+    except OSError:
+        pass
+    finally:
+        os.close(fd)
+
+
+def sha256_file(path: str) -> str:
+    h = hashlib.sha256()
+    with open(path, "rb") as f:
+        for chunk in iter(lambda: f.read(1 << 20), b""):
+            h.update(chunk)
+    return h.hexdigest()
+
+
+def sha256_bytes(data: bytes) -> str:
+    return hashlib.sha256(data).hexdigest()
+
+
+def write_bytes_durable(path: str, data: bytes,
+                        staging_prefix: str = "stage-") -> str:
+    """Atomically, durably write ``data`` to ``path``; returns the
+    content SHA-256.
+
+    Stages into a ``staging_prefix``-named temp file in the SAME
+    directory (os.replace must not cross filesystems), fsyncs the file,
+    renames it into place, then fsyncs the directory.  On any failure
+    the staged file is removed and ``path`` is untouched."""
+    directory = os.path.dirname(path) or "."
+    fd, tmp = tempfile.mkstemp(prefix=staging_prefix,
+                               dir=directory)
+    try:
+        with os.fdopen(fd, "wb") as f:
+            f.write(data)
+            f.flush()
+            os.fsync(f.fileno())
+        os.replace(tmp, path)
+    except BaseException:
+        try:
+            os.remove(tmp)
+        except OSError:
+            pass
+        raise
+    fsync_dir(directory)
+    return sha256_bytes(data)
+
+
+def sweep_orphans(directory: str, prefixes: Iterable[str],
+                  min_age_s: float = ORPHAN_MIN_AGE_S,
+                  match: Optional[callable] = None) -> int:
+    """Remove staging files/dirs under ``directory`` whose names start
+    with one of ``prefixes`` (or satisfy ``match``) and whose mtime is
+    older than ``min_age_s``.  Returns how many entries were removed.
+
+    Nothing a completed write references ever carries a staging prefix,
+    so the sweep can only ever reclaim crash debris."""
+    prefixes = tuple(prefixes)
+    try:
+        entries = os.listdir(directory)
+    except OSError:
+        return 0
+    now = time.time()
+    removed = 0
+    for entry in entries:
+        if not (entry.startswith(prefixes)
+                or (match is not None and match(entry))):
+            continue
+        path = os.path.join(directory, entry)
+        try:
+            if now - os.path.getmtime(path) < min_age_s:
+                continue
+        except OSError:
+            continue                # vanished mid-scan: done
+        if os.path.isdir(path):
+            shutil.rmtree(path, ignore_errors=True)
+        else:
+            try:
+                os.remove(path)
+            except OSError:
+                continue
+        removed += 1
+    return removed

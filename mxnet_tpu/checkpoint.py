@@ -34,7 +34,6 @@ step, never a mix.
 """
 from __future__ import annotations
 
-import hashlib
 import json
 import os
 import re
@@ -46,19 +45,17 @@ from typing import Any, Dict, List, Optional
 from .base import MXNetError
 from . import metrics as _metrics
 from . import faults as _faults
+from ._durable import (fsync_dir as _fsync_dir,
+                       sha256_file as _sha256_file, sweep_orphans)
 
 __all__ = ["CheckpointManager", "CoordinatedCheckpointManager"]
 
 # Staging dirs carry a recognizable prefix so the orphan sweep can never
 # touch user data; plain 'tmpXXXXXXXX' dirs (pre-hardening staging) are
-# swept too.  Only dirs older than _ORPHAN_MIN_AGE_S are swept: a
-# preempted trainer may still be writing its final checkpoint while the
-# replacement process constructs its manager — a LIVE staging dir has a
-# fresh mtime and must survive; a genuinely crash-orphaned one is swept
-# by any later manager init.
+# swept too.  The sweep discipline (age guard, prefix scoping) lives in
+# mxnet_tpu._durable, shared with the persistent compile cache.
 _STAGING_PREFIX = "ckpt-staging-"
 _LEGACY_STAGING = re.compile(r"^tmp[a-z0-9_]{8}$")
-_ORPHAN_MIN_AGE_S = 300.0
 
 CHECKPOINT_SAVES = _metrics.counter(
     "mxnet_checkpoint_saves_total",
@@ -87,29 +84,6 @@ CKPT_COORD_SECONDS = _metrics.histogram(
     "restore = agreeing on the resume step.", labels=("phase",))
 
 
-def _fsync_dir(path: str) -> None:
-    """fsync a directory so renames/creates inside it are durable; best
-    effort on filesystems without directory fds."""
-    try:
-        fd = os.open(path, os.O_RDONLY)
-    except OSError:
-        return
-    try:
-        os.fsync(fd)
-    except OSError:
-        pass
-    finally:
-        os.close(fd)
-
-
-def _sha256_file(path: str) -> str:
-    h = hashlib.sha256()
-    with open(path, "rb") as f:
-        for chunk in iter(lambda: f.read(1 << 20), b""):
-            h.update(chunk)
-    return h.hexdigest()
-
-
 class CheckpointManager:
     """Numbered, atomic, self-pruning, self-verifying checkpoints under
     ``directory``."""
@@ -124,24 +98,14 @@ class CheckpointManager:
 
     def _sweep_orphan_staging(self) -> None:
         """Remove staging dirs a crashed save() left behind (nothing in
-        them was ever referenced by checkpoint.json).  Dirs younger than
-        ``_ORPHAN_MIN_AGE_S`` are left alone — they may belong to a
-        preempted process still finishing its final save."""
-        now = time.time()
-        for entry in os.listdir(self.directory):
-            if not (entry.startswith(_STAGING_PREFIX)
-                    or _LEGACY_STAGING.match(entry)):
-                continue
-            path = os.path.join(self.directory, entry)
-            if not os.path.isdir(path):
-                continue
-            try:
-                if now - os.path.getmtime(path) < _ORPHAN_MIN_AGE_S:
-                    continue
-            except OSError:
-                continue                # vanished mid-scan: done
-            shutil.rmtree(path, ignore_errors=True)
-            CHECKPOINT_ORPHANS.inc()
+        them was ever referenced by checkpoint.json); young dirs are
+        left alone — they may belong to a preempted process still
+        finishing its final save (see _durable.sweep_orphans)."""
+        removed = sweep_orphans(
+            self.directory, (_STAGING_PREFIX,),
+            match=lambda e: bool(_LEGACY_STAGING.match(e)))
+        if removed:
+            CHECKPOINT_ORPHANS.inc(removed)
 
     # -- bookkeeping -------------------------------------------------------
     def _meta_path(self) -> str:

@@ -589,6 +589,8 @@ class HybridBlock(Block):
                 "export traced a forward that mutates parameter state "
                 "(training-mode BatchNorm?); export runs in inference "
                 "mode — check autograd/use_global_stats configuration")
+        program = bytes(exp.serialize())
+        from .._durable import sha256_bytes, sha256_file
         meta = {
             "framework": "mxnet_tpu",
             "format_version": 1,
@@ -600,8 +602,11 @@ class HybridBlock(Block):
                        for k, v in params.items()},
             "param_order": list(params.keys()),
             "out_treedef": _treedef_to_obj(cell["treedef"]),
-            "stablehlo": base64.b64encode(bytes(exp.serialize())).decode(
-                "ascii"),
+            "stablehlo": base64.b64encode(program).decode("ascii"),
+            # the serving load path verifies these BEFORE deserializing:
+            # a truncated/garbled artifact is named in a structured
+            # error instead of an opaque deserializer crash
+            "stablehlo_sha256": sha256_bytes(program),
         }
         # native-runtime deploy graph (c_predict_api analog): a layer-op
         # list MXPredCreate can execute with no Python, emitted whenever
@@ -618,6 +623,7 @@ class HybridBlock(Block):
         save_params(param_file,
                     {k: p.data() for k, p in self.collect_params().items()
                      if p.is_initialized})
+        meta["params_sha256"] = sha256_file(param_file)
         sym_file = f"{path}-symbol.json"
         with open(sym_file, "w") as f:
             json.dump(meta, f, indent=2)

@@ -100,16 +100,19 @@ class PagedKVCache:
     # -- buffers ------------------------------------------------------------
     def _alloc_buffers(self, L: int) -> None:
         import jax
-        import jax.numpy as jnp
         shape = (self.max_slots, L, self.n_heads, self.head_dim)
         # device_put COMMITS the buffers: a jitted call keys its cache
         # on input committed-ness, so fresh uncommitted zeros would
         # make the first post-reset admission recompile the row write
-        # even at an identical shape
+        # even at an identical shape.  HOST zeros, not jnp.zeros: an
+        # eager jnp.zeros compiles a tiny program per shape — a pure
+        # transfer keeps restart warmup (which walks every bucket
+        # shape) at zero XLA compiles
+        zeros = _np.zeros(shape, self.dtype)
         dev = jax.local_devices()[0]
-        self._k = [jax.device_put(jnp.zeros(shape, self.dtype), dev)
+        self._k = [jax.device_put(zeros, dev)
                    for _ in range(self.n_layers)]
-        self._v = [jax.device_put(jnp.zeros(shape, self.dtype), dev)
+        self._v = [jax.device_put(zeros, dev)
                    for _ in range(self.n_layers)]
 
     def k(self, layer: int) -> Any:
@@ -192,7 +195,6 @@ class PagedKVCache:
         the grow pad per (bucket -> larger bucket) pair — so
         steady-state traffic never compiles them."""
         import jax
-        import jax.numpy as jnp
         dev = jax.local_devices()[0]
         n = 0
         for i, L in enumerate(self.grid):
@@ -202,7 +204,7 @@ class PagedKVCache:
                 if Lp > L:
                     continue
                 row = jax.device_put(
-                    jnp.zeros((int(Lp), self.n_heads, self.head_dim),
+                    _np.zeros((int(Lp), self.n_heads, self.head_dim),
                               self.dtype), dev)
                 # one write warms the executable for every layer (they
                 # share shapes); zeros into zeros is a no-op in content
@@ -248,26 +250,43 @@ class PagedKVCache:
 
 
 # jitted helpers — one executable per (cache shape, prompt shape) pair,
-# all drawn from the bucket grid (warmable, bounded)
+# all drawn from the bucket grid (warmable, bounded).  Both persist
+# through the compile cache (surface serving.kv, pinned) so a restarted
+# replica's warmup re-loads the whole admission/migration grid from
+# disk instead of recompiling it.
 
 def _grow_rows(buf: Any, new_len: int) -> Any:
-    import jax.numpy as jnp
-    pad = new_len - buf.shape[1]
-    return jnp.pad(buf, ((0, 0), (0, pad), (0, 0), (0, 0)))
+    fn = _grow_jits.get(int(new_len))
+    if fn is None:
+        import jax
+        import jax.numpy as jnp
+        from .. import compile_cache as _cc
+
+        def grow(b, _L=int(new_len)):
+            return jnp.pad(
+                b, ((0, 0), (0, _L - b.shape[1]), (0, 0), (0, 0)))
+
+        fn = _grow_jits[int(new_len)] = _cc.persistently_cached(
+            jax.jit(grow), surface="serving.kv", pin=True)
+    return fn(buf)
+
+
+_grow_jits: dict = {}
 
 
 def _make_write_row():
     import jax
     from jax import lax
+    from .. import compile_cache as _cc
 
-    @jax.jit
     def write(buf, row, slot):
         # buf (S, L, h, d), row (Lp, h, d), slot scalar: place the
         # prompt KV at [slot, 0:Lp] without materializing a copy chain
         return lax.dynamic_update_slice(
             buf, row[None].astype(buf.dtype),
             (slot, _np.int32(0), _np.int32(0), _np.int32(0)))
-    return write
+    return _cc.persistently_cached(jax.jit(write), surface="serving.kv",
+                                   pin=True)
 
 
 class _LazyWrite:

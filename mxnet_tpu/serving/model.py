@@ -68,12 +68,24 @@ class ServedModel:
     def from_export(symbol_file: str,
                     param_file: Optional[str] = None) -> "ServedModel":
         """Load an ``export()`` artifact for serving (the predict-API
-        path: StableHLO called directly, no gluon objects per request)."""
+        path: StableHLO called directly, no gluon objects per request).
+
+        Artifacts carrying digests (``export()`` emits them) are
+        **checksum-verified before deserialization**: a truncated or
+        bit-flipped program/params file raises a structured error
+        naming the artifact and the expected/actual digests, instead
+        of an opaque deserializer crash (or, worse, a model that loads
+        and serves garbage).  Per-bucket executables go through the
+        persistent compile cache (pinned — a live server's grid is
+        never evicted), so a restarted replica re-warms from disk with
+        zero XLA compiles."""
         import base64
 
         import jax
         import jax.numpy as jnp
         from jax import export as jax_export
+        from .. import compile_cache as _cc
+        from .._durable import sha256_bytes, sha256_file
 
         with open(symbol_file) as f:
             meta = json.load(f)
@@ -83,8 +95,17 @@ class ServedModel:
                 "with HybridBlock.export)")
         if param_file is None:
             param_file = _guess_param_file(symbol_file)
-        exp = jax_export.deserialize(
-            bytearray(base64.b64decode(meta["stablehlo"])))
+        program = base64.b64decode(meta["stablehlo"])
+        want = meta.get("stablehlo_sha256")
+        if want is not None:
+            got = sha256_bytes(program)
+            if got != want:
+                raise MXNetError(
+                    f"export artifact {symbol_file} failed its program "
+                    f"checksum (stablehlo_sha256 {want[:12]}…, file "
+                    f"digests to {got[:12]}…) — the artifact is "
+                    "truncated or garbled; re-export or restore it "
+                    "before serving")
         order = meta["param_order"]
         params: List[Any] = []
         if order:
@@ -93,6 +114,17 @@ class ServedModel:
                     "this export has parameters — pass the "
                     "prefix-NNNN.params file (or keep it next to the "
                     "symbol json)")
+            want = meta.get("params_sha256")
+            if want is not None:
+                got = sha256_file(param_file)
+                if got != want:
+                    raise MXNetError(
+                        f"export artifact {param_file} failed its "
+                        f"checksum (params_sha256 {want[:12]}…, file "
+                        f"digests to {got[:12]}…) — the weights are "
+                        "truncated or garbled (or not the file this "
+                        "symbol json was exported with); re-export or "
+                        "restore them before serving")
             from ..ndarray_io import load_params
             loaded = load_params(param_file)
             missing = [k for k in order if k not in loaded]
@@ -100,15 +132,25 @@ class ServedModel:
                 raise MXNetError(
                     f"{param_file} is missing exported params: {missing}")
             params = [jnp.asarray(loaded[k]._data) for k in order]
+        exp = jax_export.deserialize(bytearray(program))
         key = jnp.zeros((2,), jnp.uint32)   # inference: dropout is off
         dynamic = bool(meta.get("dynamic_batch"))
         sig = [(tuple(i["shape"][1:]), _np.dtype(i["dtype"]))
                for i in meta["inputs"]]
         fixed = None if dynamic else int(meta["inputs"][0]["shape"][0])
 
+        # params ride as ARGUMENTS (not closure constants): the lowered
+        # program — and so the persistent-cache key and the serialized
+        # executable — is weight-independent, shared across re-exports
+        # of the same architecture
+        aot = _cc.persistently_cached(
+            jax.jit(lambda ps, *xs: exp.call(key, list(ps), *xs)),
+            surface="serving.export", pin=True)
+        params_t = tuple(params)
+
         def fn(arrays: Sequence[_np.ndarray]) -> List[_np.ndarray]:
             jarrs = [jnp.asarray(a) for a in arrays]
-            leaves = exp.call(key, params, *jarrs)
+            leaves = aot(params_t, *jarrs)
             return [_np.asarray(o) for o in leaves]
 
         name = os.path.basename(symbol_file).replace("-symbol.json", "")
@@ -335,11 +377,18 @@ class DecodeModel:
             return jnp.argmax(logits, axis=-1).astype(jnp.int32), \
                 new_ks, new_vs
 
-        self._prefill_fn = jax.jit(_prefill)
+        # both programs persist through the compile cache (pinned: a
+        # live server's decode grid is never evicted) so a restarted
+        # replica re-warms its whole bucket grid with zero XLA compiles
+        from .. import compile_cache as _cc
+        self._prefill_fn = _cc.persistently_cached(
+            jax.jit(_prefill), surface="serving.decode", pin=True)
         # the KV buffers are DONATED: XLA updates the resident cache in
         # place instead of allocating a fresh (S, L, h, d) per layer
         # every token
-        self._step_fn = jax.jit(_step, donate_argnums=(1, 2))
+        self._step_fn = _cc.persistently_cached(
+            jax.jit(_step, donate_argnums=(1, 2)),
+            surface="serving.decode", pin=True)
 
     # -- constructors -------------------------------------------------------
     @staticmethod

@@ -57,6 +57,17 @@ __all__ = ["ModelServer", "GenerationServer", "DegradedError",
 _LOG = logging.getLogger("mxnet_tpu.serving")
 
 
+def _compile_cache_stats() -> Dict[str, Any]:
+    """Persistent compile-cache stats for /v1/model ({} when the cache
+    is disabled) — operators see at a glance whether a restarted
+    replica's warmup came from disk."""
+    from .. import compile_cache as _cc
+    try:
+        return _cc.cache_stats()
+    except Exception:   # noqa: BLE001 - introspection must never fail
+        return {}
+
+
 class DegradedError(MXNetError):
     """The server cannot take requests (circuit breaker open, every
     worker replica dead, or stopped) — the HTTP front end maps this to
@@ -119,9 +130,17 @@ class ModelServer:
             "oneshot", self.replicas, self._spawn_worker,
             self._on_degraded, self._worker_alive,
             max_restarts=max_restarts, backoff_ms=restart_backoff_ms)
+        # warmup runs BEFORE start()/ready(): a prewarming server never
+        # flips /healthz ready with an un-compiled bucket grid.  With
+        # the persistent compile cache populated, this is a disk reload
+        # (seconds), not a compile storm — warmup_seconds in /v1/model
+        # is the number that proves it
         self.warmed = 0
+        self.warmup_seconds = 0.0
         if warmup:
+            t0 = time.perf_counter()
             self.warmed = model.warmup(self.policy)
+            self.warmup_seconds = time.perf_counter() - t0
 
     # -- lifecycle ----------------------------------------------------------
     def start(self) -> "ModelServer":
@@ -456,6 +475,8 @@ class ModelServer:
                       "limit": self.batcher.queue_limit,
                       "batch_timeout_ms": self.batcher.timeout_s * 1e3},
             "warmed_buckets": self.warmed,
+            "warmup_seconds": round(self.warmup_seconds, 6),
+            "compile_cache": _compile_cache_stats(),
             "worker_alive": self.ready(),
             "resilience": {
                 "replicas": self.replicas,
@@ -544,10 +565,18 @@ class GenerationServer:
             "generation", self.replicas, self._spawn_replica,
             self._on_degraded, self._replica_alive,
             max_restarts=max_restarts, backoff_ms=restart_backoff_ms)
+        # prewarm BEFORE any replica thread exists or ready() can flip:
+        # a restarted replica re-populates its whole program grid from
+        # the persistent compile cache here, and /v1/model reports how
+        # long that took (warmup_seconds)
+        self.warmup_seconds = 0.0
+        t0 = time.perf_counter()
         for rep in self._replicas:
             rep.engine.recovery_sink = self._recover
             if warmup:
                 rep.engine.warmup()
+        if warmup:
+            self.warmup_seconds = time.perf_counter() - t0
 
     # -- compat surface ------------------------------------------------------
     @property
@@ -915,6 +944,8 @@ class GenerationServer:
                              for rep in self._replicas),
             }
         d["worker_alive"] = self.ready()
+        d["warmup_seconds"] = round(self.warmup_seconds, 6)
+        d["compile_cache"] = _compile_cache_stats()
         d["resilience"] = {
             "replicas": self.replicas,
             "workers_alive": sum(

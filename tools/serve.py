@@ -31,6 +31,12 @@ backoff behind a circuit breaker), and SIGTERM/SIGINT triggers a
 graceful drain — admissions shed 429, resident work finishes inside
 MXNET_SERVING_DRAIN_DEADLINE_S, readiness 503 / liveness 200
 throughout, exit 0.
+
+Warm restarts (docs/performance.md#persistent-compile-cache): with
+MXNET_COMPILE_CACHE_DIR set, --prewarm populates every bucket grid
+from the persistent compile cache BEFORE /healthz flips ready (zero
+XLA compiles on a restart) and /v1/model reports warmup_seconds +
+cache stats.
 """
 import argparse
 import os
@@ -68,6 +74,15 @@ def main(argv=None) -> None:
                     help="comma list of padded lengths for --pad-axis")
     ap.add_argument("--no-warmup", action="store_true",
                     help="skip pre-compiling the bucket grid at startup")
+    ap.add_argument("--prewarm", action="store_true",
+                    help="populate the bucket grids BEFORE /healthz "
+                         "flips ready (the default behavior, made "
+                         "explicit for launch scripts) and print the "
+                         "warmup report — with MXNET_COMPILE_CACHE_DIR "
+                         "set, a restarted server re-warms from the "
+                         "persistent compile cache with zero XLA "
+                         "compiles; warmup seconds are also reported "
+                         "in /v1/model")
     ap.add_argument("--replicas", type=int, default=None,
                     help="worker replicas (MXNET_SERVING_REPLICAS): a "
                          "dead worker's requests requeue/recover onto "
@@ -101,6 +116,8 @@ def main(argv=None) -> None:
     ap.add_argument("--verbose", action="store_true",
                     help="log every HTTP request")
     args = ap.parse_args(argv)
+    if args.prewarm and args.no_warmup:
+        ap.error("--prewarm and --no-warmup are contradictory")
 
     if args.platform == "cpu":
         import jax
@@ -148,7 +165,8 @@ def main(argv=None) -> None:
                                  warmup=not args.no_warmup,
                                  replicas=args.replicas)
     if server.warmed:
-        print(f"warmup: {server.warmed} bucket signatures pre-compiled")
+        print(f"warmup: {server.warmed} bucket signatures ready in "
+              f"{server.warmup_seconds:.2f}s" + _cache_note())
     server.start()
     httpd = serving.make_http_server(server, args.host, args.port,
                                      verbose=args.verbose)
@@ -165,6 +183,17 @@ def main(argv=None) -> None:
     print(f"drain {'complete' if drained else 'deadline exceeded'}; "
           "bye", flush=True)
     sys.exit(0 if drained else 1)
+
+
+def _cache_note() -> str:
+    """One-line persistent-cache summary for the startup banner."""
+    from mxnet_tpu import compile_cache
+    stats = compile_cache.cache_stats()
+    if not stats:
+        return ""
+    return (f"  [compile cache: {stats['entries']} entries, "
+            f"{stats['bytes'] / 1e6:.1f} MB, {int(stats['hits'])} hits "
+            f"/ {int(stats['misses'])} misses this boot]")
 
 
 def _serve_generate(args, serving) -> None:
@@ -205,10 +234,12 @@ def _serve_generate(args, serving) -> None:
                                   warmup=not args.no_warmup)
     engine = gs.engine
     if engine.warmed:
-        print(f"warmup: {engine.warmed} programs pre-compiled "
+        print(f"warmup: {engine.warmed} programs ready in "
+              f"{gs.warmup_seconds:.2f}s "
               f"(prefill buckets {list(engine.prompt_buckets)}, "
               f"KV buckets {list(engine.grid)}, "
-              f"{engine.max_slots} slots x {gs.replicas} replica(s))")
+              f"{engine.max_slots} slots x {gs.replicas} replica(s))"
+              + _cache_note())
     gs.start()
     httpd = serving.make_http_server(None, args.host, args.port,
                                      verbose=args.verbose,
