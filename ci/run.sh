@@ -7,9 +7,16 @@
 #   ci/run.sh tier1         # docs-freshness gates + serving smoke +
 #                           #   chaos smoke + the tier-1 pytest
 #                           #   selection (the driver's acceptance run)
-#   ci/run.sh envdoc        # docs/env_vars.md staleness check alone
-#   ci/run.sh faultdoc      # every faults.py site named in
-#                           #   docs/fault_tolerance.md
+#   ci/run.sh mxlint        # the AST concurrency/invariant analyzer
+#                           #   (lock discipline, determinism hygiene,
+#                           #   donation safety, registration
+#                           #   completeness + doc freshness) — fails
+#                           #   on any unwaived finding or stale
+#                           #   waiver; ci/mxlint_waivers.toml
+#   ci/run.sh envdoc        # thin alias: the analyzer's env-surface
+#                           #   rules alone (MX-R001 + MX-R004)
+#   ci/run.sh faultdoc      # thin alias: the analyzer's fault-site
+#                           #   doc rule alone (MX-R003)
 #   ci/run.sh serving-smoke # tools/serve_bench.py --smoke alone
 #                           #   (batching wins / bounded compiles /
 #                           #   shed-not-crash)
@@ -96,15 +103,26 @@ run_native() {
   make -C src test
 }
 
+run_mxlint() {
+  echo "== mxlint: AST concurrency & invariant analyzer — lock"
+  echo "   discipline (blocking-under-lock, lock-order cycles),"
+  echo "   determinism hygiene on seeded fault paths, donation safety,"
+  echo "   registration completeness (env vars, metric families, fault"
+  echo "   sites) + docs/env_vars.md freshness.  Waivers:"
+  echo "   ci/mxlint_waivers.toml (unused waivers are errors)"
+  # MXNET_NO_AUTO_DISTRIBUTED: the lint must never join a training
+  # job's coordinator just because the env leaked into this shell
+  JAX_PLATFORMS=cpu MXNET_NO_AUTO_DISTRIBUTED=1 timeout 120 \
+    python -m mxnet_tpu.analysis
+}
+
 run_envdoc() {
-  echo "== envdoc: docs/env_vars.md must match the registered surface"
-  python tools/gen_env_doc.py
-  if ! git diff --exit-code -- docs/env_vars.md; then
-    echo "docs/env_vars.md is STALE: a module registered/changed an env" >&2
-    echo "var without regenerating — run 'python tools/gen_env_doc.py'" >&2
-    echo "and commit the result" >&2
-    exit 1
-  fi
+  # thin alias kept for existing invocations — the analyzer subsumed
+  # the old regen+git-diff check (MX-R004 render-compares, so a dirty
+  # tree lints the same as a clean one)
+  echo "== envdoc: env-var surface rules (mxlint MX-R001 + MX-R004)"
+  JAX_PLATFORMS=cpu MXNET_NO_AUTO_DISTRIBUTED=1 \
+    python -m mxnet_tpu.analysis --rules MX-R001,MX-R004
 }
 
 run_serving_smoke() {
@@ -122,28 +140,20 @@ run_generation_smoke() {
 }
 
 run_faultdoc() {
-  echo "== faultdoc: every fault-injection site documented in"
-  echo "   docs/fault_tolerance.md"
-  JAX_PLATFORMS=cpu python - <<'EOF'
-import sys
-from mxnet_tpu import faults
-with open("docs/fault_tolerance.md") as f:
-    doc = f.read()
-missing = sorted(s for s in faults.known_sites() if s not in doc)
-if missing:
-    sys.exit(f"fault sites missing from docs/fault_tolerance.md: "
-             f"{missing} - document them (the site table is "
-             f"faults.known_sites())")
-print(f"faultdoc: all {len(faults.known_sites())} sites documented")
-EOF
+  # thin alias kept for existing invocations — the analyzer's static
+  # MX-R003 rule subsumed the old runtime known_sites() grep
+  echo "== faultdoc: fault-site doc rule (mxlint MX-R003)"
+  JAX_PLATFORMS=cpu MXNET_NO_AUTO_DISTRIBUTED=1 \
+    python -m mxnet_tpu.analysis --rules MX-R003
 }
 
 run_resilience_smoke() {
   echo "== resilience-smoke: worker-kill mid-stream recovers token-"
   echo "   identical (exactly-once on the chunked wire); SIGTERM under"
   echo "   8-client load drains clean (429 sheds, ready 503/live 200,"
-  echo "   exit 0)"
-  JAX_PLATFORMS=cpu timeout 600 python tools/resilience_smoke.py
+  echo "   exit 0) — lock-order sanitizer armed (MXNET_SANITIZE=locks)"
+  JAX_PLATFORMS=cpu MXNET_SANITIZE=locks timeout 600 \
+    python tools/resilience_smoke.py
 }
 
 run_dist_resilience_smoke() {
@@ -155,8 +165,10 @@ run_dist_resilience_smoke() {
 
 run_chaos_smoke() {
   echo "== chaos-smoke: bounded (~60s) fault-injection / preemption /"
-  echo "   checkpoint-fallback / kvstore-timeout proof"
-  JAX_PLATFORMS=cpu timeout 300 python -m pytest tests/test_faults.py \
+  echo "   checkpoint-fallback / kvstore-timeout proof — lock-order"
+  echo "   sanitizer armed (MXNET_SANITIZE=locks)"
+  JAX_PLATFORMS=cpu MXNET_SANITIZE=locks timeout 300 \
+    python -m pytest tests/test_faults.py \
     -k smoke -q -p no:cacheprovider
 }
 
@@ -213,13 +225,13 @@ run_chaos() {
 }
 
 run_tier1() {
-  echo "== tier1: env-doc freshness + fault-site doc lint + serving"
-  echo "   smoke + generation smoke + resilience smoke + dist-"
-  echo "   resilience smoke + chaos smoke + cache smoke + health"
-  echo "   smoke + bulking smoke + input-pipeline smoke + bench"
-  echo "   regression check + the tier-1 pytest selection"
-  run_envdoc
-  run_faultdoc
+  echo "== tier1: mxlint (concurrency/invariant analyzer, subsumes the"
+  echo "   old envdoc+faultdoc gates) + serving smoke + generation"
+  echo "   smoke + resilience smoke + dist-resilience smoke + chaos"
+  echo "   smoke + cache smoke + health smoke + bulking smoke +"
+  echo "   input-pipeline smoke + bench regression check + the tier-1"
+  echo "   pytest selection"
+  run_mxlint
   run_serving_smoke
   run_generation_smoke
   run_resilience_smoke
@@ -317,6 +329,7 @@ run_tpu_unit_batched() {
 case "$variant" in
   native)       run_native ;;
   tier1)        run_tier1 ;;
+  mxlint)       run_mxlint ;;
   envdoc)       run_envdoc ;;
   faultdoc)     run_faultdoc ;;
   serving-smoke) run_serving_smoke ;;

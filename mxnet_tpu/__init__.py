@@ -17,6 +17,26 @@ Usage mirrors the reference::
 """
 __version__ = "0.1.0"
 
+# Install the runtime lock-order sanitizer BEFORE any submodule import
+# allocates a lock (MXNET_SANITIZE=locks; see
+# docs/static_analysis.md#lockdep) — lockdep tracks only locks created
+# after the factories are patched, and analysis.lockdep is stdlib-only
+# so this costs nothing when the env is unset.
+import os as _os
+_sanitizers = {t.strip()
+               for t in _os.environ.get("MXNET_SANITIZE", "").split(",")
+               if t.strip()}
+if _sanitizers - {"locks"}:
+    # a typo must not silently disarm a sanitizer the user asked for
+    raise ValueError(
+        f"unknown MXNET_SANITIZE value(s) {sorted(_sanitizers - {'locks'})}"
+        " — supported: 'locks' (see docs/static_analysis.md)")
+if "locks" in _sanitizers:
+    from .analysis.lockdep import install as _lockdep_install
+    _lockdep_install()
+    del _lockdep_install
+del _sanitizers
+
 # Join a launcher-described multi-process job BEFORE anything touches the
 # XLA backend (jax.distributed.initialize must run first) — the analog of
 # the reference reading DMLC_* rendezvous env at import. No-op when the

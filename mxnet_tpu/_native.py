@@ -18,7 +18,17 @@ import subprocess
 import threading
 from typing import Callable, Dict, List, Optional, Sequence
 
-from .base import MXNetError
+from .base import MXNetError, register_env
+
+register_env("MXNET_NATIVE_BUILD", 1,
+             "Set to 0 to skip the automatic 'make -C src' rebuild of "
+             "libmxtpu.so when the shared library is missing; the "
+             "native engine then stays unavailable and pure-Python "
+             "paths serve instead.")
+register_env("MXNET_CPU_WORKER_NTHREADS", 0,
+             "Worker threads for the native C++ engine's CPU pool "
+             "(libmxtpu.so). 0 (default) sizes the pool from the "
+             "machine; mirrors the reference's knob of the same name.")
 
 __all__ = ["LIB", "check_call", "NativeEngine", "NativeRecordWriter",
            "NativeRecordReader", "NativePrefetcher", "storage_stats",

@@ -1,0 +1,57 @@
+"""mxlint — the repo's own static concurrency & invariant analyzer.
+
+PRs 6-10 turned this reproduction into a genuinely concurrent system
+(supervisor/worker threads in serving, heartbeat daemons and socket
+loops in kvstore_async, a prefetch producer, cross-process compile-cache
+writers) and the recurring review findings all fell into a handful of
+mechanical classes: blocking calls made while holding a lock, wall-clock
+or global-RNG reads on seeded-deterministic fault paths, reads of a
+buffer after it was donated, and config/metric/fault surfaces added
+without their registration or docs.  This package holds those invariants
+by tooling instead of vigilance — the same correctness-tooling instinct
+as the reference's cpplint/pylint/sanitizer CI tiers, specialized to
+this repo (paper §runtime: the dependency engine's safety rests on
+exactly the lock/async discipline we reimplement in Python threads).
+
+Two halves:
+
+* the **static analyzer** (``python -m mxnet_tpu.analysis``): parses the
+  whole ``mxnet_tpu/`` + ``tools/`` tree with ``ast`` and reports typed
+  findings (rule id, file:line, message, fix hint), gated in tier-1 CI
+  with a checked-in waiver file (``ci/mxlint_waivers.toml``).  Rule
+  catalog: docs/static_analysis.md.
+
+* the **runtime lock-order sanitizer** (:mod:`.lockdep`, enabled via
+  ``MXNET_SANITIZE=locks``): patches ``threading.Lock``/``RLock``
+  creation to record per-thread acquisition stacks and asserts a
+  globally consistent acquisition order, reporting inversions with both
+  stacks.  It runs under the chaos/resilience smokes, where the thread
+  interleavings actually happen.
+
+Imports stay lazy: production processes that only enable the sanitizer
+must not pay for the ast machinery, and the sanitizer must be
+installable before the rest of the package creates its locks.
+"""
+from typing import Any
+
+__all__ = [
+    "Finding", "Waiver", "run_analysis", "load_waivers", "lockdep",
+]
+
+_LAZY = {
+    "Finding": ("mxnet_tpu.analysis.core", "Finding"),
+    "Waiver": ("mxnet_tpu.analysis.core", "Waiver"),
+    "run_analysis": ("mxnet_tpu.analysis.core", "run_analysis"),
+    "load_waivers": ("mxnet_tpu.analysis.core", "load_waivers"),
+    "lockdep": ("mxnet_tpu.analysis.lockdep", None),
+}
+
+
+def __getattr__(name: str) -> Any:
+    try:
+        mod_name, attr = _LAZY[name]
+    except KeyError:
+        raise AttributeError(f"module {__name__!r} has no attribute {name!r}")
+    import importlib
+    mod = importlib.import_module(mod_name)
+    return mod if attr is None else getattr(mod, attr)

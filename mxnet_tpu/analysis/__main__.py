@@ -1,0 +1,81 @@
+"""``python -m mxnet_tpu.analysis`` — the mxlint CI driver.
+
+Default run (no arguments): analyze ``mxnet_tpu/`` + ``tools/`` with
+every rule, apply ``ci/mxlint_waivers.toml``, fail (exit 1) on any
+unwaived finding or any unused waiver.  This is the tier-1 gate
+(``ci/run.sh mxlint``); the old ``envdoc``/``faultdoc`` variants are
+thin aliases onto ``--rules`` subsets.
+"""
+from __future__ import annotations
+
+import argparse
+import json
+import sys
+from pathlib import Path
+
+from .core import (RULES, WaiverError, load_waivers, repo_root,
+                   run_analysis)
+
+
+def main(argv=None) -> int:
+    ap = argparse.ArgumentParser(
+        prog="python -m mxnet_tpu.analysis",
+        description="mxlint: the repo's AST concurrency & invariant "
+                    "analyzer (rule catalog: docs/static_analysis.md)")
+    ap.add_argument("paths", nargs="*", type=Path,
+                    help="files/dirs to analyze (default: mxnet_tpu/ "
+                         "and tools/ under the repo root)")
+    ap.add_argument("--rules", default=None,
+                    help="comma-separated rule ids to run (default: all)")
+    ap.add_argument("--waivers", type=Path, default=None,
+                    help="waiver file (default: ci/mxlint_waivers.toml; "
+                         "missing file = no waivers)")
+    ap.add_argument("--json", action="store_true",
+                    help="machine-readable findings on stdout")
+    ap.add_argument("--list-rules", action="store_true")
+    args = ap.parse_args(argv)
+
+    if args.list_rules:
+        for rid, desc in sorted(RULES.items()):
+            print(f"{rid}  {desc}")
+        return 0
+
+    root = repo_root()
+    rules = ([r.strip() for r in args.rules.split(",") if r.strip()]
+             if args.rules else None)
+    waiver_path = args.waivers or (root / "ci" / "mxlint_waivers.toml")
+    try:
+        waivers = load_waivers(waiver_path)
+        report = run_analysis(paths=args.paths or None, root=root,
+                              rules=rules, waivers=waivers)
+    except (WaiverError, ValueError) as e:
+        print(f"mxlint: {e}", file=sys.stderr)
+        return 1
+
+    if args.json:
+        print(json.dumps({
+            "findings": [f.as_dict() for f in report.findings],
+            "waived": [{"finding": f.as_dict(),
+                        "justification": w.justification}
+                       for f, w in report.waived],
+            "unused_waivers": [
+                {"rule": w.rule, "path": w.path,
+                 "line": w.source_line} for w in report.unused_waivers],
+        }, indent=2))
+    else:
+        for f in report.findings:
+            print(f.format())
+        for w in report.unused_waivers:
+            print(f"{waiver_path}:{w.source_line}: unused waiver "
+                  f"({w.rule} on {w.path}) — the finding it suppressed "
+                  "is gone; delete the waiver so the baseline shrinks")
+        n, w_n, u = (len(report.findings), len(report.waived),
+                     len(report.unused_waivers))
+        verdict = "PASS" if report.ok else "FAIL"
+        print(f"mxlint: {verdict} — {n} finding(s), {w_n} waived, "
+              f"{u} unused waiver(s)")
+    return 0 if report.ok else 1
+
+
+if __name__ == "__main__":
+    sys.exit(main())

@@ -1,0 +1,368 @@
+"""mxlint core: source collection, findings, waivers, and the rule runner.
+
+Everything here is stdlib-only (``ast`` + ``dataclasses``): the analyzer
+must run in a bare CI interpreter in well under the 30s tier-1 budget.
+Rule implementations live in sibling modules (locks, determinism,
+donation, registration); this module owns the shared machinery:
+
+* :class:`Source` — one parsed file (path, AST, text).
+* :class:`Finding` — one typed report: rule id, file:line, message, hint.
+* :class:`Waiver` + :func:`load_waivers` — the checked-in suppression
+  list (``ci/mxlint_waivers.toml``).  A waiver must carry a
+  justification, and a waiver that matches nothing is itself an error,
+  so the baseline only ever shrinks.
+* :func:`run_analysis` — collect sources, run rules, apply waivers.
+"""
+from __future__ import annotations
+
+import ast
+import os
+from dataclasses import dataclass, field
+from pathlib import Path
+from typing import Dict, List, Optional, Sequence, Tuple
+
+#: rule id -> one-line description (the catalog; docs/static_analysis.md
+#: is the long-form version and tests assert the two stay in sync).
+RULES: Dict[str, str] = {
+    "MX-E000": "source file failed to parse (syntax error)",
+    "MX-L001": "blocking call while holding a lock",
+    "MX-L002": "inconsistent lock acquisition order (potential deadlock)",
+    "MX-D001": "wall-clock or global-RNG read on a seeded fault path",
+    "MX-N001": "read of a buffer binding after it was donated",
+    "MX-R001": "MXNET_* env var read without register_env registration",
+    "MX-R002": "metric family not documented in docs/observability.md",
+    "MX-R003": "fault site not documented in docs/fault_tolerance.md",
+    "MX-R004": "docs/env_vars.md is stale vs the registered env surface",
+}
+
+#: rule-group -> rule ids it can emit (drives --rules group skipping).
+RULE_GROUPS: Dict[str, Tuple[str, ...]] = {
+    "locks": ("MX-L001", "MX-L002"),
+    "determinism": ("MX-D001",),
+    "donation": ("MX-N001",),
+    "registration": ("MX-R001", "MX-R002", "MX-R003", "MX-R004"),
+}
+
+
+def dotted(node: ast.AST) -> Optional[str]:
+    """Dotted source name of an attribute chain rooted at a Name —
+    'self._lock', 'threading.Lock', 'os.environ.get' — or None.
+    Shared by every rule module so they stay consistent in what
+    expressions they can name."""
+    parts: List[str] = []
+    while isinstance(node, ast.Attribute):
+        parts.append(node.attr)
+        node = node.value
+    if isinstance(node, ast.Name):
+        parts.append(node.id)
+        return ".".join(reversed(parts))
+    return None
+
+
+@dataclass(frozen=True)
+class Finding:
+    rule: str
+    path: str          # repo-root-relative, forward slashes
+    line: int
+    message: str
+    hint: str = ""
+
+    def format(self) -> str:
+        s = f"{self.path}:{self.line}: {self.rule} {self.message}"
+        if self.hint:
+            s += f"\n    hint: {self.hint}"
+        return s
+
+    def as_dict(self) -> Dict[str, object]:
+        return {"rule": self.rule, "path": self.path, "line": self.line,
+                "message": self.message, "hint": self.hint}
+
+
+@dataclass
+class Waiver:
+    rule: str
+    path: str
+    justification: str
+    contains: str = ""     # substring of the finding message ("" = any)
+    source_line: int = 0   # where in the waiver file (for errors)
+    used: int = 0
+
+    def matches(self, f: Finding) -> bool:
+        return (f.rule == self.rule and f.path == self.path
+                and (not self.contains or self.contains in f.message))
+
+
+class WaiverError(ValueError):
+    """Malformed waiver file — fails the run regardless of findings."""
+
+
+def _parse_toml_subset(text: str, origin: str) -> List[Dict[str, object]]:
+    """Parse the waiver file's TOML subset: ``[[waiver]]`` tables of
+    ``key = "string" | int | bool`` pairs plus comments.  This rig's
+    interpreter predates :mod:`tomllib`; the subset keeps the checked-in
+    format standard TOML so the file survives an interpreter upgrade."""
+    tables: List[Dict[str, object]] = []
+    current: Optional[Dict[str, object]] = None
+    for lineno, raw in enumerate(text.splitlines(), 1):
+        line = raw.strip()
+        if not line or line.startswith("#"):
+            continue
+        if line == "[[waiver]]":
+            current = {"__line__": lineno}
+            tables.append(current)
+            continue
+        if line.startswith("["):
+            raise WaiverError(
+                f"{origin}:{lineno}: only [[waiver]] tables are "
+                f"recognized, got {line!r}")
+        if "=" not in line:
+            raise WaiverError(f"{origin}:{lineno}: expected key = value, "
+                              f"got {line!r}")
+        if current is None:
+            raise WaiverError(f"{origin}:{lineno}: key outside a "
+                              "[[waiver]] table")
+        key, _, val = line.partition("=")
+        key = key.strip()
+        val = val.strip()
+        if val.startswith('"'):
+            # scan for the UNESCAPED closing quote — rfind would let a
+            # trailing comment containing a quote corrupt the value
+            end = -1
+            i = 1
+            while i < len(val):
+                if val[i] == "\\":
+                    i += 2
+                    continue
+                if val[i] == '"':
+                    end = i
+                    break
+                i += 1
+            if end < 0:
+                raise WaiverError(
+                    f"{origin}:{lineno}: unterminated string")
+            rest = val[end + 1:].strip()
+            if rest and not rest.startswith("#"):
+                raise WaiverError(
+                    f"{origin}:{lineno}: unexpected text after "
+                    f"closing quote: {rest!r}")
+            parsed: object = (val[1:end]
+                              .replace('\\"', '"').replace("\\\\", "\\"))
+        elif val in ("true", "false"):
+            parsed = val == "true"
+        else:
+            val = val.split("#", 1)[0].strip()
+            try:
+                parsed = int(val)
+            except ValueError:
+                raise WaiverError(
+                    f"{origin}:{lineno}: unsupported value {val!r} "
+                    "(strings must be double-quoted)") from None
+        current[key] = parsed
+    return tables
+
+
+def load_waivers(path: Path) -> List[Waiver]:
+    """Load ``ci/mxlint_waivers.toml``.  Missing file means no waivers;
+    a present-but-malformed file is an error (a silently ignored waiver
+    file would un-gate the lint)."""
+    if not path.exists():
+        return []
+    tables = _parse_toml_subset(path.read_text(), str(path))
+    waivers: List[Waiver] = []
+    for t in tables:
+        line = int(t.pop("__line__", 0))
+        missing = [k for k in ("rule", "path", "justification")
+                   if not t.get(k)]
+        if missing:
+            raise WaiverError(
+                f"{path}:{line}: waiver missing required field(s) "
+                f"{missing} — every waiver needs rule, path, and a "
+                "justification")
+        rule = str(t["rule"])
+        if rule not in RULES:
+            raise WaiverError(
+                f"{path}:{line}: unknown rule id {rule!r} "
+                f"(known: {sorted(RULES)})")
+        unknown = set(t) - {"rule", "path", "justification", "contains"}
+        if unknown:
+            raise WaiverError(
+                f"{path}:{line}: unknown waiver field(s) "
+                f"{sorted(unknown)}")
+        waivers.append(Waiver(
+            rule=rule, path=str(t["path"]),
+            justification=str(t["justification"]),
+            contains=str(t.get("contains", "")), source_line=line))
+    return waivers
+
+
+@dataclass
+class Source:
+    path: Path
+    rel: str                 # root-relative posix path
+    text: str
+    tree: ast.Module
+    modname: str             # dotted, e.g. mxnet_tpu.kvstore_async
+
+
+def repo_root() -> Path:
+    return Path(__file__).resolve().parents[2]
+
+
+_SKIP_DIRS = {"__pycache__", ".git", "fixtures"}
+
+
+def collect_sources(paths: Sequence[Path], root: Path
+                    ) -> Tuple[List[Source], List[Finding]]:
+    """Parse every ``*.py`` under ``paths``.  Unparseable files become
+    MX-E000 findings rather than crashing the run — a syntax error in
+    one module must not hide findings in the rest."""
+    sources: List[Source] = []
+    errors: List[Finding] = []
+    files: List[Path] = []
+    for p in paths:
+        if p.is_file():
+            files.append(p)
+            continue
+        for dirpath, dirnames, filenames in os.walk(p):
+            dirnames[:] = sorted(d for d in dirnames if d not in _SKIP_DIRS)
+            for fn in sorted(filenames):
+                if fn.endswith(".py"):
+                    files.append(Path(dirpath) / fn)
+    for f in files:
+        try:
+            rel = f.resolve().relative_to(root).as_posix()
+        except ValueError:
+            rel = f.as_posix()
+        text = f.read_text()
+        try:
+            tree = ast.parse(text, filename=str(f))
+        except SyntaxError as e:
+            errors.append(Finding(
+                "MX-E000", rel, e.lineno or 1,
+                f"syntax error: {e.msg}",
+                "fix the syntax error; the analyzer skipped this file"))
+            continue
+        modname = rel[:-3].replace("/", ".")
+        if modname.endswith(".__init__"):
+            modname = modname[: -len(".__init__")]
+        sources.append(Source(f, rel, text, tree, modname))
+    return sources, errors
+
+
+@dataclass
+class AnalysisContext:
+    """Everything a rule module needs: the parsed tree plus doc texts."""
+    root: Path
+    sources: List[Source]
+    docs_root: Path
+    check_env_doc: bool = True   # MX-R004 imports the full package; off
+    #                              for fixture-dir runs in tests
+    #: sources whose register_env calls define the registered set for
+    #: MX-R001 — the whole default tree even on explicit-path runs
+    registration_sources: Optional[List[Source]] = None
+    _docs: Dict[str, str] = field(default_factory=dict)
+
+    def doc(self, name: str) -> str:
+        if name not in self._docs:
+            p = self.docs_root / name
+            self._docs[name] = p.read_text() if p.exists() else ""
+        return self._docs[name]
+
+
+@dataclass
+class Report:
+    findings: List[Finding]           # unwaived — these fail the run
+    waived: List[Tuple[Finding, Waiver]]
+    unused_waivers: List[Waiver]
+
+    @property
+    def ok(self) -> bool:
+        return not self.findings and not self.unused_waivers
+
+
+def _severity_key(f: Finding) -> Tuple:
+    return (f.rule, f.path, f.line)
+
+
+def run_analysis(paths: Optional[Sequence[Path]] = None,
+                 root: Optional[Path] = None,
+                 rules: Optional[Sequence[str]] = None,
+                 waivers: Optional[Sequence[Waiver]] = None,
+                 docs_root: Optional[Path] = None,
+                 check_env_doc: Optional[bool] = None) -> Report:
+    """Run the analyzer.
+
+    ``paths`` defaults to ``mxnet_tpu/`` + ``tools/`` under the repo
+    root.  ``rules`` filters to a subset of rule ids (a rule group whose
+    ids are all filtered out is skipped entirely).  When a rule filter
+    is active, unused-waiver enforcement only applies to waivers for the
+    selected rules — a partial run must not flag the other waivers as
+    stale.
+    """
+    root = (root or repo_root()).resolve()
+    default_paths = paths is None
+    if paths is None:
+        paths = [root / "mxnet_tpu", root / "tools"]
+
+    selected = set(rules) if rules else set(RULES)
+    unknown = selected - set(RULES)
+    if unknown:
+        raise ValueError(f"unknown rule id(s): {sorted(unknown)} "
+                         f"(known: {sorted(RULES)})")
+    if check_env_doc is None:
+        # fixture-dir runs (tests) must not import the whole package
+        check_env_doc = default_paths
+    # the render imports the full package (jax included): skip it when
+    # MX-R004 findings would be filtered out anyway
+    check_env_doc = check_env_doc and "MX-R004" in selected
+
+    sources, findings = collect_sources([Path(p) for p in paths], root)
+    reg_sources = sources
+    if not default_paths:
+        # MX-R001 must judge reads against the WHOLE tree's
+        # register_env surface, or a single-file run reports vars
+        # registered elsewhere as unregistered
+        default_dirs = [p for p in (root / "mxnet_tpu", root / "tools")
+                        if p.is_dir()]
+        if default_dirs:
+            reg_sources, _ = collect_sources(default_dirs, root)
+            reg_sources = reg_sources + sources
+    ctx = AnalysisContext(root=root, sources=sources,
+                          docs_root=docs_root or (root / "docs"),
+                          check_env_doc=check_env_doc,
+                          registration_sources=reg_sources)
+
+    from . import locks, determinism, donation, registration
+    groups = {"locks": locks.analyze, "determinism": determinism.analyze,
+              "donation": donation.analyze,
+              "registration": registration.analyze}
+    for gname, fn in groups.items():
+        if selected.intersection(RULE_GROUPS[gname]):
+            findings.extend(fn(ctx))
+    # MX-E000 bypasses the rule filter: a subset run that silently
+    # skipped an unparseable file would report PASS having checked
+    # nothing in it
+    findings = sorted(
+        (f for f in findings
+         if f.rule in selected or f.rule == "MX-E000"),
+        key=_severity_key)
+
+    wlist = list(waivers or [])
+    unwaived: List[Finding] = []
+    waived: List[Tuple[Finding, Waiver]] = []
+    for f in findings:
+        w = next((w for w in wlist if w.matches(f)), None)
+        if w is None:
+            unwaived.append(f)
+        else:
+            w.used += 1
+            waived.append((f, w))
+    # Unused-waiver enforcement is scoped to what this run could have
+    # matched: a --rules or explicit-path subset run must not flag the
+    # other waivers as stale (only the full default run shrinks the
+    # baseline).
+    analyzed = {s.rel for s in sources}
+    unused = [w for w in wlist
+              if not w.used and (rules is None or w.rule in selected)
+              and (default_paths or w.path in analyzed)]
+    return Report(unwaived, waived, unused)

@@ -1,0 +1,167 @@
+"""MX-D001 — determinism hygiene on seeded fault paths.
+
+The chaos layer's contract (faults.py docstring) is that a plan + seed
+replays the identical fault schedule in every process.  That contract
+dies the moment plan evaluation — or control flow in a function hosting
+a fault-injection site — depends on the wall clock or the global RNG:
+the serving.worker / ps.server busy-pass-gate hardening in PRs 7-8 both
+started as exactly this bug (a wall-clock deadline deciding whether the
+loop made the pass on which a seeded fault would have fired).
+
+Scope, tuned for signal:
+
+* In ``faults.py`` (plan evaluation) every clock read and every
+  global-RNG draw is flagged — evaluation must be a pure function of
+  (plan, seed, hit count).
+* Elsewhere, only functions that contain a ``maybe_fault(...)`` /
+  ``maybe_corrupt(...)`` call are examined, and only *gating* reads are
+  flagged: a clock read or RNG draw that occurs inside a branch/loop
+  test or comparison, or whose assigned name feeds one later in the
+  same function.  Pure measurement (``t0 = perf_counter()`` ...
+  ``observe(perf_counter() - t0)``) around a fault site is fine — it
+  cannot change how many times the site is hit.
+
+``time.sleep`` is exempt (a delay injects latency, it does not *read*
+the clock), and ``random.Random(seed)`` is exempt (constructing a
+seeded stream is the fix, not the bug).
+"""
+from __future__ import annotations
+
+import ast
+from typing import List, Optional, Set, Tuple
+
+from .core import AnalysisContext, Finding, Source, dotted as _dotted
+
+_CLOCK_READS = {
+    "time.time", "time.monotonic", "time.perf_counter",
+    "time.time_ns", "time.monotonic_ns", "time.perf_counter_ns",
+    "datetime.now", "datetime.utcnow", "datetime.datetime.now",
+    "datetime.datetime.utcnow",
+}
+_GLOBAL_RNG_EXEMPT = {"Random", "SystemRandom", "seed", "getstate",
+                      "setstate"}
+_FAULT_SITE_CALLS = {"maybe_fault", "maybe_corrupt"}
+
+
+def _nondet_desc(call: ast.Call) -> Optional[str]:
+    d = _dotted(call.func)
+    if not d:
+        return None
+    if d in _CLOCK_READS:
+        return f"wall-clock read {d}()"
+    head, _, leaf = d.rpartition(".")
+    if head == "random" and leaf not in _GLOBAL_RNG_EXEMPT:
+        return f"global-RNG draw random.{leaf}()"
+    return None
+
+
+def _test_exprs(func: ast.AST) -> List[ast.AST]:
+    """Every expression that gates control flow in ``func``."""
+    tests: List[ast.AST] = []
+    for sub in ast.walk(func):
+        if isinstance(sub, (ast.If, ast.While, ast.IfExp)):
+            tests.append(sub.test)
+        elif isinstance(sub, ast.Assert):
+            tests.append(sub.test)
+        elif isinstance(sub, ast.Compare):
+            tests.append(sub)
+        elif isinstance(sub, ast.comprehension):
+            tests.extend(sub.ifs)
+    return tests
+
+
+class _FuncChecker:
+    def __init__(self, src: Source, findings: List[Finding]) -> None:
+        self.src = src
+        self.findings = findings
+        self.strict = src.rel.endswith("faults.py")
+
+    def check(self, func: ast.AST) -> None:
+        # walk the function's own code only — nested defs/lambdas run
+        # later, outside this function's fault-path dynamic extent
+        own: List[ast.AST] = []
+        stack: List[ast.AST] = [func]
+        while stack:
+            n = stack.pop()
+            own.append(n)
+            for child in ast.iter_child_nodes(n):
+                if isinstance(child, (ast.FunctionDef,
+                                      ast.AsyncFunctionDef, ast.Lambda)):
+                    continue
+                stack.append(child)
+        calls = [n for n in own if isinstance(n, ast.Call)]
+        has_site = any(
+            (_dotted(c.func) or "").rsplit(".", 1)[-1]
+            in _FAULT_SITE_CALLS for c in calls)
+        if not (self.strict or has_site):
+            return
+        nondet: List[Tuple[ast.Call, str]] = []
+        for c in calls:
+            desc = _nondet_desc(c)
+            if desc:
+                nondet.append((c, desc))
+        if not nondet:
+            return
+        if self.strict:
+            gating = set(id(c) for c, _ in nondet)
+            tainted_names: Set[str] = set()
+        else:
+            tests = _test_exprs(func)
+            in_tests = {id(n) for t in tests for n in ast.walk(t)}
+            # names assigned from a nondet call, then used in a test
+            tainted_names = set()
+            for n in own:
+                if isinstance(n, ast.Assign) and isinstance(
+                        n.value, ast.Call) and _nondet_desc(n.value):
+                    for t in n.targets:
+                        if isinstance(t, ast.Name):
+                            tainted_names.add(t.id)
+                elif (isinstance(n, ast.Assign)
+                      and isinstance(n.value, ast.BinOp)):
+                    # deadline = time.monotonic() + budget
+                    for sub in ast.walk(n.value):
+                        if isinstance(sub, ast.Call) and _nondet_desc(
+                                sub):
+                            for t in n.targets:
+                                if isinstance(t, ast.Name):
+                                    tainted_names.add(t.id)
+            test_names = {n.id for t in tests for n in ast.walk(t)
+                          if isinstance(n, ast.Name)}
+            gating = {id(c) for c, _ in nondet if id(c) in in_tests}
+            if tainted_names & test_names:
+                gating |= {id(c) for c, _ in nondet}
+        fn_name = getattr(func, "name", "<lambda>")
+        for c, desc in nondet:
+            if id(c) not in gating:
+                continue
+            where = ("plan evaluation (faults.py)" if self.strict
+                     else f"{fn_name}(), which hosts a seeded fault "
+                          "site")
+            self.findings.append(Finding(
+                "MX-D001", self.src.rel, c.lineno,
+                f"{desc} gates control flow in {where}",
+                "a plan + seed must replay the identical fault "
+                "schedule: derive randomness from the clause's seeded "
+                "random.Random, and keep wall-clock deadlines out of "
+                "the path that decides whether the site is hit (count "
+                "passes/steps instead)"))
+
+
+class _Visitor(ast.NodeVisitor):
+    def __init__(self, src: Source, findings: List[Finding]) -> None:
+        self.checker = _FuncChecker(src, findings)
+
+    def _visit_func(self, node) -> None:
+        self.checker.check(node)
+        for child in ast.iter_child_nodes(node):
+            self.visit(child)
+
+    visit_FunctionDef = _visit_func
+    visit_AsyncFunctionDef = _visit_func
+
+
+def analyze(ctx: AnalysisContext) -> List[Finding]:
+    findings: List[Finding] = []
+    for src in ctx.sources:
+        _Visitor(src, findings).visit(src.tree)
+    return findings

@@ -1,0 +1,199 @@
+"""MX-N001 — donation safety: no reads of a buffer binding after the
+call that donated it.
+
+The repo's donation idiom (PR 9's targeted barriers) is a two-beat
+sequence::
+
+    _bulk.flush_holding(donated, "mutation")   # barrier: materialize
+    out = self._step_fn(param_arrays, ...)     # donate_argnums call
+
+``flush_holding(arrays)`` names exactly the buffers the *next* compiled
+call donates; after that call runs, XLA has deleted their backing
+memory and any further host read of the same bindings is a
+use-after-free that jax reports (when it does) as a cryptic "donated
+buffer was deleted" far from the cause.  The rule therefore keys on the
+``flush_holding`` marker: the donated set is the flush argument
+(expanded one level through ``a + b`` concatenation, ``[x, y]``
+literals, and ``list(x)`` copies, following a local ``donated = ...``
+assignment); the donating call is the first later statement that passes
+any of those bindings into a non-builtin call (a ``len(params)``
+between barrier and step reads still-live buffers and is fine); every
+read *after that statement* is flagged, unless the binding was
+reassigned in between.
+
+Computed arguments the expansion cannot name (comprehensions, attribute
+chains) are skipped — re-reading the same binding is the pattern that
+bites, and alias chasing would drown the signal.
+"""
+from __future__ import annotations
+
+import ast
+from typing import Dict, Iterable, List, Optional, Set
+
+from .core import AnalysisContext, Finding, Source
+
+#: call leaf names that mark the donated set (their first positional
+#: argument names the buffers the next compiled call donates)
+DONATION_MARKERS = {"flush_holding"}
+
+#: builtins whose calls cannot be the donating compiled call — a
+#: len(params) between the barrier and the step call is a legal read
+#: of still-live buffers, not the donation point
+_BENIGN_CALLS = {
+    "len", "id", "isinstance", "repr", "str", "print", "type", "bool",
+    "sum", "min", "max", "sorted", "enumerate", "zip", "list", "tuple",
+    "set", "dict", "iter", "next", "format", "hash", "any", "all",
+}
+
+
+def _call_leaf(func: ast.AST) -> Optional[str]:
+    if isinstance(func, ast.Name):
+        return func.id
+    if isinstance(func, ast.Attribute):
+        return func.attr
+    return None
+
+
+def _names_in_value(expr: ast.AST) -> Set[str]:
+    """Names of array bindings in a donated-set expression: handles
+    Name, a + b chains, [x, y] literals, and list(x) copies."""
+    if isinstance(expr, ast.Name):
+        return {expr.id}
+    if isinstance(expr, ast.BinOp) and isinstance(expr.op, ast.Add):
+        return _names_in_value(expr.left) | _names_in_value(expr.right)
+    if isinstance(expr, (ast.List, ast.Tuple)):
+        out: Set[str] = set()
+        for elt in expr.elts:
+            if isinstance(elt, ast.Name):
+                out.add(elt.id)
+        return out
+    if (isinstance(expr, ast.Call) and isinstance(expr.func, ast.Name)
+            and expr.func.id == "list" and len(expr.args) == 1):
+        return _names_in_value(expr.args[0])
+    return set()
+
+
+def _assigned_names(stmt: ast.stmt) -> Set[str]:
+    out: Set[str] = set()
+    targets: Iterable[ast.AST] = ()
+    if isinstance(stmt, ast.Assign):
+        targets = stmt.targets
+    elif isinstance(stmt, (ast.AugAssign, ast.AnnAssign)):
+        targets = (stmt.target,)
+    elif isinstance(stmt, ast.For):
+        targets = (stmt.target,)
+    elif isinstance(stmt, ast.With):
+        targets = tuple(i.optional_vars for i in stmt.items
+                        if i.optional_vars is not None)
+    for t in targets:
+        for sub in ast.walk(t):
+            if isinstance(sub, ast.Name):
+                out.add(sub.id)
+    return out
+
+
+def _is_donating_stmt(stmt: ast.stmt, names: Set[str]) -> bool:
+    """Does this statement pass a donated binding as an argument to a
+    call that could be the donate_argnums-compiled call?"""
+    for sub in ast.walk(stmt):
+        if isinstance(sub, (ast.FunctionDef, ast.AsyncFunctionDef,
+                            ast.Lambda)):
+            continue
+        if not isinstance(sub, ast.Call):
+            continue
+        leaf = _call_leaf(sub.func)
+        if leaf in _BENIGN_CALLS or leaf in DONATION_MARKERS:
+            continue
+        args = list(sub.args) + [k.value for k in sub.keywords]
+        for a in args:
+            for n in ast.walk(a):
+                if (isinstance(n, ast.Name)
+                        and isinstance(n.ctx, ast.Load)
+                        and n.id in names):
+                    return True
+    return False
+
+
+def _reads_in(stmt: ast.stmt, names: Set[str]) -> List[ast.Name]:
+    reads = []
+    for sub in ast.walk(stmt):
+        if isinstance(sub, (ast.FunctionDef, ast.AsyncFunctionDef,
+                            ast.Lambda)):
+            continue  # deferred execution — out of scope for the rule
+        if (isinstance(sub, ast.Name) and isinstance(sub.ctx, ast.Load)
+                and sub.id in names):
+            reads.append(sub)
+    return reads
+
+
+class _BodyWalker(ast.NodeVisitor):
+    def __init__(self, src: Source, findings: List[Finding]) -> None:
+        self.src = src
+        self.findings = findings
+
+    def _scan_body(self, body: List[ast.stmt]) -> None:
+        # local one-level expansion: donated = param_arrays + list(arrays)
+        local_defs: Dict[str, Set[str]] = {}
+        for stmt in body:
+            if (isinstance(stmt, ast.Assign) and len(stmt.targets) == 1
+                    and isinstance(stmt.targets[0], ast.Name)):
+                local_defs[stmt.targets[0].id] = _names_in_value(
+                    stmt.value)
+        for i, stmt in enumerate(body):
+            donated: Set[str] = set()
+            don_line = stmt.lineno
+            for sub in ast.walk(stmt):
+                if (isinstance(sub, ast.Call)
+                        and _call_leaf(sub.func) in DONATION_MARKERS
+                        and sub.args):
+                    direct = _names_in_value(sub.args[0])
+                    donated |= direct
+                    for n in list(direct):
+                        donated |= local_defs.get(n, set())
+                    don_line = sub.lineno
+            if not donated:
+                continue
+            live = set(donated)
+            donating_stmt_seen = False
+            donate_line = don_line
+            for later in body[i + 1:]:
+                if not live:
+                    break
+                if not donating_stmt_seen:
+                    # buffers stay live until the donate_argnums call
+                    # actually runs: benign reads (len(params), ...)
+                    # before it are fine — the anchor is the first
+                    # non-builtin call fed a donated binding
+                    if _is_donating_stmt(later, live):
+                        donating_stmt_seen = True
+                        donate_line = later.lineno
+                else:
+                    reads = _reads_in(later, live)
+                    for read in reads:
+                        self.findings.append(Finding(
+                            "MX-N001", self.src.rel, read.lineno,
+                            f"read of {read.id!r} after its buffers "
+                            f"were donated by the call at line "
+                            f"{donate_line} (donation barrier "
+                            f"flush_holding at line {don_line}): the "
+                            "backing memory may already be deleted",
+                            "donate last — reorder so every read "
+                            "happens before the donating call, or "
+                            "rebind the name to the fresh outputs "
+                            "first"))
+                live -= _assigned_names(later)
+
+    def generic_visit(self, node: ast.AST) -> None:
+        for field_body in ("body", "orelse", "finalbody"):
+            body = getattr(node, field_body, None)
+            if isinstance(body, list) and body and isinstance(
+                    body[0], ast.stmt):
+                self._scan_body(body)
+        super().generic_visit(node)
+
+
+def analyze(ctx: AnalysisContext) -> List[Finding]:
+    findings: List[Finding] = []
+    for src in ctx.sources:
+        _BodyWalker(src, findings).visit(src.tree)
+    return findings

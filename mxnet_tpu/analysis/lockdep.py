@@ -1,0 +1,299 @@
+"""Runtime lock-order sanitizer (``MXNET_SANITIZE=locks``).
+
+The static MX-L002 rule sees only syntactic nesting; real inversions
+happen across call chains and threads the AST cannot follow.  This is
+the dynamic half, modeled on Linux lockdep: patch ``threading.Lock`` /
+``threading.RLock`` *creation* so every lock allocated from this repo's
+code is wrapped, record per-thread acquisition stacks, and maintain a
+global acquired-while-holding graph keyed by the lock's allocation site
+(its "lock class", so the per-key lock instances in kvstore collapse
+into one node).  The first time an edge B -> A appears whose reverse
+A -> B was already observed, the sanitizer reports the inversion with
+both acquisition stacks — the exact two code paths that can deadlock —
+and (by default) raises :class:`LockOrderViolation`.
+
+Enablement: ``MXNET_SANITIZE=locks`` in the environment before
+``import mxnet_tpu`` (the package installs the patch first thing, so
+every lock the runtime creates afterwards is tracked), or
+:func:`install` programmatically in tests.  CI runs the chaos and
+resilience smokes under it — the legs whose thread interleavings
+actually exercise the lock graph.
+
+Scope and cost: only locks *allocated from files under this repo* are
+wrapped — jax/XLA internals keep raw ``_thread`` locks (zero overhead,
+no foreign-code false positives).  Tracked acquisition captures a
+~10-frame summary per acquire; that is microseconds, fine for smokes,
+not meant for production serving (which is why it is an opt-in
+sanitizer, not a default).
+
+``cv.wait()`` on a tracked lock releases and reacquires through the
+lock's own ``acquire``/``release`` (plain Lock) or the C-level
+``_release_save`` protocol (RLock); the RLock fast path bypasses the
+tracker for the duration of the wait, which is sound — the waiting
+thread acquires nothing while blocked.
+"""
+from __future__ import annotations
+
+import os
+import sys
+import threading
+import _thread
+from pathlib import Path
+from typing import Any, Dict, List, Optional, Tuple
+
+__all__ = ["install", "uninstall", "installed", "reset",
+           "violations", "LockOrderViolation"]
+
+_REPO_ROOT = str(Path(__file__).resolve().parents[2])
+_SELF_FILE = str(Path(__file__).resolve())
+
+
+def _internal_frame(fn: str) -> bool:
+    return fn == _SELF_FILE or fn.endswith("threading.py")
+
+_real_lock = _thread.allocate_lock
+_real_rlock = getattr(_thread, "RLock")
+
+# raw (untracked) lock guarding the sanitizer's own state
+_STATE_LOCK = _real_lock()
+_installed = False
+_action = "raise"
+
+# (site_a, site_b) -> (stack_held, stack_acquired, thread_name): the
+# first observation of "site_b acquired while site_a held"
+_EDGES: Dict[Tuple[str, str], Tuple[str, str, str]] = {}
+_VIOLATIONS: List[str] = []
+_TLS = threading.local()
+# id(inner lock) -> the ACQUIRING thread's held list: a plain Lock may
+# legally be released from another thread (handoff patterns) and the
+# stale entry must come off the owner's list, not the releaser's
+_OWNER_HELD: Dict[int, List] = {}
+
+
+class LockOrderViolation(AssertionError):
+    """Two code paths acquire the same two lock classes in opposite
+    orders — a latent deadlock.  The message carries both stacks."""
+
+
+def _held() -> List[Tuple[str, Any, str]]:
+    """Per-thread held list: (site, lock instance, acquisition stack)."""
+    h = getattr(_TLS, "held", None)
+    if h is None:
+        h = _TLS.held = []
+    return h
+
+
+def _interesting(filename: str) -> bool:
+    return filename.startswith(_REPO_ROOT) and filename != _SELF_FILE
+
+
+def _alloc_site() -> Optional[str]:
+    """Allocation site of the lock being created: the nearest caller
+    frame outside threading.py and this module.  None = don't track."""
+    f = sys._getframe(2)
+    while f is not None:
+        fn = f.f_code.co_filename
+        if not _internal_frame(fn):
+            if _interesting(fn):
+                rel = fn[len(_REPO_ROOT):].lstrip("/")
+                return f"{rel}:{f.f_lineno}"
+            return None
+        f = f.f_back
+    return None
+
+
+def _light_stack(limit: int = 10) -> str:
+    frames: List[str] = []
+    f: Any = sys._getframe(2)
+    while f is not None and len(frames) < limit:
+        fn = f.f_code.co_filename
+        if not _internal_frame(fn):
+            short = (fn[len(_REPO_ROOT):].lstrip("/")
+                     if fn.startswith(_REPO_ROOT) else fn)
+            frames.append(f"{short}:{f.f_lineno} in {f.f_code.co_name}")
+        f = f.f_back
+    return "\n        ".join(frames) or "<no frames>"
+
+
+def _record_acquire(site: str, inst: Any, reentrant: bool) -> None:
+    held = _held()
+    if reentrant and any(h[1] is inst for h in held):
+        held.append((site, inst, ""))   # reentrant: no new edges
+        return
+    stack = _light_stack()
+    tname = threading.current_thread().name
+    problems: List[str] = []
+    with _STATE_LOCK:
+        for held_site, held_inst, held_stack in held:
+            if held_inst is inst or held_site == site:
+                continue   # same instance / same class: unorderable
+            edge = (held_site, site)
+            if edge not in _EDGES:
+                _EDGES[edge] = (held_stack, stack, tname)
+                rev = (site, held_site)
+                if rev in _EDGES:
+                    r_held, r_acq, r_thread = _EDGES[rev]
+                    msg = (
+                        "lock-order inversion (potential deadlock):\n"
+                        f"  lock classes (by allocation site): "
+                        f"A={held_site}  B={site}\n"
+                        f"  this thread ({tname}) acquires B while "
+                        "holding A:\n"
+                        f"    A held since:\n        {held_stack}\n"
+                        f"    B acquired at:\n        {stack}\n"
+                        f"  but thread {r_thread!r} earlier acquired A "
+                        "while holding B:\n"
+                        f"    B held since:\n        {r_held}\n"
+                        f"    A acquired at:\n        {r_acq}\n"
+                        "  fix: pick one global order for these locks "
+                        "and restructure one site; see "
+                        "docs/static_analysis.md#lockdep")
+                    _VIOLATIONS.append(msg)
+                    problems.append(msg)
+    held.append((site, inst, stack))
+    with _STATE_LOCK:
+        _OWNER_HELD[id(inst)] = held
+    for msg in problems:
+        if _action == "raise":
+            # undo this acquisition before raising out of acquire()/
+            # __enter__: the with-body will never run and __exit__ will
+            # never fire, so leaving the lock held would convert the
+            # report into a process-wide deadlock
+            with _STATE_LOCK:
+                _forget(held, inst)
+            inst.release()
+            raise LockOrderViolation(msg)
+        print(f"mxnet_tpu.analysis.lockdep: {msg}", file=sys.stderr)
+
+
+def _forget(lst: List, inst: Any) -> None:
+    """Remove the newest entry for ``inst`` from ``lst``; must be
+    called with ``_STATE_LOCK`` held."""
+    for i in range(len(lst) - 1, -1, -1):
+        if lst[i][1] is inst:
+            del lst[i]
+            break
+    if not any(h[1] is inst for h in lst):
+        _OWNER_HELD.pop(id(inst), None)
+
+
+def _record_release(inst: Any) -> None:
+    held = _held()
+    with _STATE_LOCK:
+        if any(h[1] is inst for h in held):
+            _forget(held, inst)
+            return
+        # released by a different thread than acquired it (Lock
+        # handoff): clean the ACQUIRER's list or it would carry a
+        # stale entry recording false edges forever
+        owner = _OWNER_HELD.get(id(inst))
+        if owner is not None:
+            _forget(owner, inst)
+
+
+class _TrackedLockBase:
+    _reentrant = False
+
+    def __init__(self, inner: Any, site: str) -> None:
+        self._inner = inner
+        self._site = site
+
+    def acquire(self, blocking: bool = True, timeout: float = -1) -> bool:
+        got = self._inner.acquire(blocking, timeout)
+        if got:
+            _record_acquire(self._site, self._inner, self._reentrant)
+        return got
+
+    acquire_lock = acquire   # _thread.lock alias
+
+    def release(self) -> None:
+        self._inner.release()
+        _record_release(self._inner)
+
+    release_lock = release
+
+    def locked(self) -> bool:
+        return self._inner.locked()
+
+    def __enter__(self) -> bool:
+        return self.acquire()
+
+    def __exit__(self, *exc: Any) -> None:
+        self.release()
+
+    def _at_fork_reinit(self) -> None:
+        self._inner._at_fork_reinit()
+        _TLS.held = []
+
+    def __getattr__(self, name: str) -> Any:
+        # Condition's C-protocol hooks (_release_save/_acquire_restore/
+        # _is_owned) and anything else forward to the real lock
+        return getattr(self._inner, name)
+
+    def __repr__(self) -> str:
+        return (f"<lockdep-tracked {self._inner!r} "
+                f"allocated at {self._site}>")
+
+
+class _TrackedLock(_TrackedLockBase):
+    _reentrant = False
+
+
+class _TrackedRLock(_TrackedLockBase):
+    _reentrant = True
+
+
+def _make_lock() -> Any:
+    inner = _real_lock()
+    site = _alloc_site()
+    return inner if site is None else _TrackedLock(inner, site)
+
+
+def _make_rlock() -> Any:
+    inner = _real_rlock()
+    site = _alloc_site()
+    return inner if site is None else _TrackedRLock(inner, site)
+
+
+def install(action: Optional[str] = None) -> None:
+    """Patch ``threading.Lock``/``RLock`` so repo-allocated locks are
+    order-tracked.  ``action``: 'raise' (default) or 'warn'; the env
+    override is ``MXNET_SANITIZE_LOCKS_ACTION``."""
+    global _installed, _action
+    _action = (action
+               or os.environ.get("MXNET_SANITIZE_LOCKS_ACTION", "")
+               or "raise")
+    if _action not in ("raise", "warn"):
+        raise ValueError("MXNET_SANITIZE_LOCKS_ACTION must be 'raise' "
+                         f"or 'warn', got {_action!r}")
+    if _installed:
+        return
+    threading.Lock = _make_lock            # type: ignore[misc]
+    threading.RLock = _make_rlock          # type: ignore[misc]
+    _installed = True
+
+
+def uninstall() -> None:
+    """Restore the real factories (tests).  Already-wrapped locks keep
+    working — only new allocations stop being tracked."""
+    global _installed
+    threading.Lock = _real_lock            # type: ignore[misc]
+    threading.RLock = _real_rlock          # type: ignore[misc]
+    _installed = False
+
+
+def installed() -> bool:
+    return _installed
+
+
+def reset() -> None:
+    """Drop the observed edge graph and violation log (tests)."""
+    with _STATE_LOCK:
+        _EDGES.clear()
+        _VIOLATIONS.clear()
+        _OWNER_HELD.clear()
+
+
+def violations() -> List[str]:
+    with _STATE_LOCK:
+        return list(_VIOLATIONS)

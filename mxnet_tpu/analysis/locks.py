@@ -1,0 +1,581 @@
+"""Lock-discipline rules.
+
+MX-L001 — blocking call while a lock is held.  The recurring PR-6..10
+review class: a ``with self._lock:`` body that does socket I/O, joins a
+thread, sleeps, does a blocking queue op, waits on a foreign condition,
+compiles, or forces a host read (``asnumpy``/``block_until_ready``/
+``.item()``).  Every such call serializes unrelated threads behind the
+lock — the PR-8 snapshot-leaf-flatten-under-``_global_lock`` bug, found
+then only by review.  Detection is per-module with a bounded
+call-graph closure: a call made while holding a lock to a local
+function/method that (transitively, within the module) performs a
+blocking op is flagged at the call site with the witness chain.
+
+MX-L002 — inconsistent lock acquisition order.  Nested ``with`` blocks
+(directly, or via a one-module call chain) define directed edges
+lock_A -> lock_B; a cycle in the global graph across all modules means
+two threads can deadlock.  Lock identity is the *definition site*
+(``module.Class.attr``), the same "lock class" generalization Linux
+lockdep uses, so per-key lock instances created at one site collapse
+into one node.
+
+Known limits (documented in docs/static_analysis.md): blocking ops
+reached through cross-module calls are not propagated (the runtime
+lockdep sanitizer covers the dynamic side), and a ``cond.wait()`` on the
+condition guarding the innermost ``with`` is correctly treated as
+*releasing* that lock — it only flags when some other lock stays held.
+"""
+from __future__ import annotations
+
+import ast
+from dataclasses import dataclass, field
+from typing import Dict, List, Optional, Sequence, Set, Tuple
+
+from .core import AnalysisContext, Finding, Source, dotted as _dotted
+
+_LOCK_FACTORIES = {"Lock", "RLock"}
+_COND_FACTORIES = {"Condition"}
+
+#: attribute names that end a thread-join heuristic discussion: str.join
+#: always takes exactly one iterable positional; Thread.join takes none
+#: or a numeric timeout.
+_SOCKET_BLOCKING = {"recv", "recvfrom", "recv_into", "accept", "sendall"}
+_SUBPROCESS_FUNCS = {"run", "call", "check_call", "check_output"}
+
+
+@dataclass
+class _FuncInfo:
+    qual: str                      # module.Class.fn or module.fn
+    rel: str
+    node: ast.AST
+    cls: Optional[str]
+    blocking: List[Tuple[int, str]] = field(default_factory=list)
+    acquires: List[Tuple[str, int]] = field(default_factory=list)
+    calls: List[Tuple[str, int]] = field(default_factory=list)  # local
+
+
+@dataclass
+class _ModuleLocks:
+    defs: Dict[str, Tuple[str, int]] = field(default_factory=dict)
+    # condition name -> underlying lock name (Condition(self._lock))
+    cond_underlying: Dict[str, str] = field(default_factory=dict)
+
+
+class _DefCollector(ast.NodeVisitor):
+    """Pass A: find every lock/condition definition site in a module."""
+
+    def __init__(self, src: Source, mod: _ModuleLocks,
+                 attr_index: Dict[str, Set[str]]) -> None:
+        self.src = src
+        self.mod = mod
+        self.attr_index = attr_index
+        self.cls: List[str] = []
+
+    def visit_ClassDef(self, node: ast.ClassDef) -> None:
+        self.cls.append(node.name)
+        self.generic_visit(node)
+        self.cls.pop()
+
+    def _target_name(self, target: ast.AST) -> Optional[str]:
+        m = self.src.modname
+        if isinstance(target, ast.Name) and not self.cls:
+            return f"{m}.{target.id}"
+        if (isinstance(target, ast.Attribute)
+                and isinstance(target.value, ast.Name)
+                and target.value.id == "self" and self.cls):
+            return f"{m}.{self.cls[-1]}.{target.attr}"
+        if isinstance(target, ast.Subscript):
+            inner = self._target_name(target.value)
+            return f"{inner}[]" if inner else None
+        return None
+
+    def visit_Assign(self, node: ast.Assign) -> None:
+        val = node.value
+        calls = []
+        if isinstance(val, ast.Call):
+            calls = [val]
+        elif isinstance(val, (ast.ListComp, ast.List)):
+            # self._locks = [threading.Lock() for _ in ...]
+            elt = (val.elt if isinstance(val, ast.ListComp)
+                   else (val.elts[0] if val.elts else None))
+            if isinstance(elt, ast.Call):
+                calls = [elt]
+        for call in calls:
+            d = _dotted(call.func) or ""
+            leaf = d.rsplit(".", 1)[-1]
+            if leaf in _LOCK_FACTORIES | _COND_FACTORIES:
+                for t in node.targets:
+                    name = self._target_name(t)
+                    if not name:
+                        continue
+                    if isinstance(val, (ast.ListComp, ast.List)):
+                        name += "[]"
+                    self.mod.defs[name] = (self.src.rel, node.lineno)
+                    self.attr_index.setdefault(
+                        name.rsplit(".", 1)[-1].rstrip("[]"),
+                        set()).add(name)
+                    if leaf in _COND_FACTORIES and call.args:
+                        u = _dotted(call.args[0])
+                        if u:
+                            self.mod.cond_underlying[name] = u
+        self.generic_visit(node)
+
+
+def _blocking_desc(call: ast.Call) -> Optional[str]:
+    """Why this call can block (or force a host/device sync), or None."""
+    func = call.func
+    if isinstance(func, ast.Name):
+        if func.id == "sleep":
+            return "time.sleep"
+        return None
+    if not isinstance(func, ast.Attribute):
+        return None
+    attr = func.attr
+    recv = _dotted(func.value)
+    if recv == "time" and attr == "sleep":
+        return "time.sleep"
+    if recv == "subprocess" and attr in _SUBPROCESS_FUNCS:
+        return f"subprocess.{attr} (waits for the child)"
+    if recv == "re" and attr == "compile":
+        return None
+    if attr in _SOCKET_BLOCKING:
+        return f"socket .{attr}()"
+    if attr == "connect" and recv and "sock" in recv.lower():
+        return "socket .connect()"
+    if attr == "communicate":
+        return ".communicate() (waits for the child)"
+    if attr == "join":
+        # str.join always takes exactly one iterable positional;
+        # Thread.join takes none, or a numeric timeout
+        if not call.args and not any(k.arg == "timeout"
+                                     for k in call.keywords):
+            if call.keywords and all(k.arg != "timeout"
+                                     for k in call.keywords):
+                return None
+            return "Thread.join()"
+        if (len(call.args) == 1
+                and isinstance(call.args[0], ast.Constant)
+                and isinstance(call.args[0].value, (int, float))):
+            return "Thread.join(timeout)"
+        if any(k.arg == "timeout" for k in call.keywords):
+            return "Thread.join(timeout=...)"
+        return None
+    if attr in ("wait", "wait_for"):
+        return f".{attr}() (Condition/Event/process wait)"
+    if attr == "get":
+        if call.args:
+            return None  # dict.get / os.environ.get style
+        for k in call.keywords:
+            if (k.arg == "block" and isinstance(k.value, ast.Constant)
+                    and k.value.value is False):
+                return None
+        return "blocking queue .get()"
+    if attr == "put":
+        for k in call.keywords:
+            if (k.arg == "block" and isinstance(k.value, ast.Constant)
+                    and k.value.value is False):
+                return None
+        if call.args:
+            return "blocking queue .put()"
+        return None
+    if attr == "block_until_ready":
+        return ".block_until_ready() (device sync)"
+    if attr == "asnumpy":
+        return ".asnumpy() (host read, device sync)"
+    if attr == "item" and not call.args and not call.keywords:
+        return ".item() (host read, device sync)"
+    if attr == "lower" and (call.args or call.keywords):
+        # jit lowering always takes the example args; str.lower() never
+        return ".lower() (jit trace/lower)"
+    if attr == "compile":
+        return ".compile() (jit compile)"
+    return None
+
+
+class _FuncScanner(ast.NodeVisitor):
+    """Pass B: per-function summaries (direct blocking ops, direct lock
+    acquisitions, local calls) used by the bounded closure."""
+
+    def __init__(self, src: Source, resolver: "_Resolver",
+                 out: Dict[str, _FuncInfo]) -> None:
+        self.src = src
+        self.resolver = resolver
+        self.out = out
+        self.cls: List[str] = []
+        self.fn: List[_FuncInfo] = []
+
+    def visit_ClassDef(self, node: ast.ClassDef) -> None:
+        self.cls.append(node.name)
+        self.generic_visit(node)
+        self.cls.pop()
+
+    def _visit_func(self, node) -> None:
+        cls = self.cls[-1] if self.cls else None
+        qual = (f"{self.src.modname}.{cls}.{node.name}" if cls
+                else f"{self.src.modname}.{node.name}")
+        info = _FuncInfo(qual=qual, rel=self.src.rel, node=node, cls=cls)
+        self.out[qual] = info
+        self.fn.append(info)
+        self.generic_visit(node)
+        self.fn.pop()
+
+    visit_FunctionDef = _visit_func
+    visit_AsyncFunctionDef = _visit_func
+
+    def visit_With(self, node: ast.With) -> None:
+        if self.fn:
+            for item in node.items:
+                name = self.resolver.resolve(item.context_expr,
+                                             self.src, self.fn[-1].cls)
+                if name:
+                    self.fn[-1].acquires.append((name, node.lineno))
+        self.generic_visit(node)
+
+    def visit_Call(self, node: ast.Call) -> None:
+        if self.fn:
+            info = self.fn[-1]
+            desc = _blocking_desc(node)
+            if desc:
+                info.blocking.append((node.lineno, desc))
+            callee = self.resolver.local_callee(node.func, self.src,
+                                                info.cls)
+            if callee:
+                info.calls.append((callee, node.lineno))
+        self.generic_visit(node)
+
+
+class _Resolver:
+    """Map a lock expression / call target to a canonical name."""
+
+    def __init__(self, mods: Dict[str, _ModuleLocks],
+                 attr_index: Dict[str, Set[str]],
+                 funcs: Dict[str, _FuncInfo]) -> None:
+        self.mods = mods
+        self.attr_index = attr_index
+        self.funcs = funcs
+
+    def resolve(self, expr: ast.AST, src: Source,
+                cls: Optional[str]) -> Optional[str]:
+        """Resolve to a lock name, following Condition -> underlying."""
+        name = self._raw(expr, src, cls)
+        if name is None:
+            return None
+        mod = self.mods.get(src.modname)
+        if mod:
+            seen = set()
+            while name in mod.cond_underlying and name not in seen:
+                seen.add(name)
+                under = mod.cond_underlying[name]
+                resolved = self._raw_dotted(under, src, cls)
+                if resolved is None:
+                    break
+                name = resolved
+        return name
+
+    def _raw(self, expr: ast.AST, src: Source,
+             cls: Optional[str]) -> Optional[str]:
+        if isinstance(expr, ast.Call):
+            # with self._lock_for(key): — a lock-returning helper is one
+            # lock class per helper
+            d = _dotted(expr.func)
+            if d and ("lock" in d.rsplit(".", 1)[-1].lower()):
+                leaf = d.rsplit(".", 1)[-1]
+                if d.startswith("self.") and cls:
+                    return f"{src.modname}.{cls}.{leaf}()"
+                return f"{src.modname}.{leaf}()"
+            return None
+        if isinstance(expr, ast.Subscript):
+            inner = self._raw(expr.value, src, cls)
+            if inner is None:
+                return None
+            if inner.endswith("[]"):       # lock-list attr resolved
+                return inner
+            if f"{inner}[]" in self._defs(src):
+                return f"{inner}[]"
+            return None
+        d = _dotted(expr)
+        if d is None:
+            return None
+        return self._raw_dotted(d, src, cls)
+
+    def _raw_dotted(self, d: str, src: Source,
+                    cls: Optional[str]) -> Optional[str]:
+        defs = self._defs(src)
+        if d.startswith("self.") and cls:
+            cand = f"{src.modname}.{cls}.{d[5:]}"
+            if cand in defs or f"{cand}[]" in defs:
+                return cand if cand in defs else f"{cand}[]"
+        elif "." not in d:
+            cand = f"{src.modname}.{d}"
+            if cand in defs:
+                return cand
+        # foreign attribute (reg.lock): unique attr name across the tree
+        leaf = d.rsplit(".", 1)[-1]
+        hits = self.attr_index.get(leaf, set())
+        if len(hits) == 1:
+            return next(iter(hits))
+        if len(hits) > 1:
+            return f"*.{leaf}"      # ambiguous lock class
+        return None
+
+    def _defs(self, src: Source) -> Dict[str, Tuple[str, int]]:
+        mod = self.mods.get(src.modname)
+        return mod.defs if mod else {}
+
+    def local_callee(self, func: ast.AST, src: Source,
+                     cls: Optional[str]) -> Optional[str]:
+        if isinstance(func, ast.Name):
+            cand = f"{src.modname}.{func.id}"
+            return cand if cand in self.funcs else None
+        if (isinstance(func, ast.Attribute)
+                and isinstance(func.value, ast.Name)
+                and func.value.id == "self" and cls):
+            cand = f"{src.modname}.{cls}.{func.attr}"
+            return cand if cand in self.funcs else None
+        return None
+
+
+def _closure(funcs: Dict[str, _FuncInfo], depth: int = 3
+             ) -> Dict[str, Tuple[str, List[Tuple[str, int]]]]:
+    """may_block[qual] = (desc, witness chain of (qual, line)).  Bounded
+    fixed point over the per-module call graph."""
+    may: Dict[str, Tuple[str, List[Tuple[str, int]]]] = {}
+    for q, info in funcs.items():
+        if info.blocking:
+            line, desc = info.blocking[0]
+            may[q] = (desc, [(q, line)])
+    for _ in range(depth):
+        changed = False
+        for q, info in funcs.items():
+            if q in may:
+                continue
+            for callee, line in info.calls:
+                if callee in may and callee != q:
+                    desc, chain = may[callee]
+                    # chain entries are (function, line IN that
+                    # function): the call line belongs to q, not callee
+                    may[q] = (desc, [(q, line)] + chain)
+                    changed = True
+                    break
+        if not changed:
+            break
+    return may
+
+
+class _RegionWalker(ast.NodeVisitor):
+    """Pass C: walk each function with a held-lock stack; emit MX-L001
+    findings and lock-order edges."""
+
+    def __init__(self, src: Source, resolver: _Resolver,
+                 funcs: Dict[str, _FuncInfo],
+                 may_block: Dict[str, Tuple[str, List[Tuple[str, int]]]],
+                 findings: List[Finding],
+                 edges: Dict[Tuple[str, str],
+                             List[Tuple[str, int]]]) -> None:
+        self.src = src
+        self.resolver = resolver
+        self.funcs = funcs
+        self.may_block = may_block
+        self.findings = findings
+        self.edges = edges
+        self.cls: List[str] = []
+        # held: (lockname, acquired-src-text)
+        self.held: List[Tuple[str, str]] = []
+
+    def visit_ClassDef(self, node: ast.ClassDef) -> None:
+        self.cls.append(node.name)
+        self.generic_visit(node)
+        self.cls.pop()
+
+    def _visit_func(self, node) -> None:
+        saved, self.held = self.held, []
+        self.generic_visit(node)
+        self.held = saved
+
+    visit_FunctionDef = _visit_func
+    visit_AsyncFunctionDef = _visit_func
+
+    def visit_Lambda(self, node: ast.Lambda) -> None:
+        pass  # deferred execution: not under the enclosing lock
+
+    def _edge(self, a: str, b: str, line: int) -> None:
+        if a != b:
+            self.edges.setdefault((a, b), []).append((self.src.rel, line))
+
+    def visit_With(self, node: ast.With) -> None:
+        cls = self.cls[-1] if self.cls else None
+        pushed = 0
+        for item in node.items:
+            name = self.resolver.resolve(item.context_expr, self.src, cls)
+            if name is None:
+                # a non-lock context expression still EVALUATES under
+                # whatever locks item(s) to its left already hold —
+                # 'with self._lock, closing(sock.accept()[0]):' blocks
+                # in the header, not the body
+                self.visit(item.context_expr)
+            else:
+                for held_name, _src in self.held:
+                    self._edge(held_name, name, node.lineno)
+                try:
+                    src_txt = ast.unparse(item.context_expr)
+                except Exception:
+                    src_txt = ""
+                self.held.append((name, src_txt))
+                pushed += 1
+        for stmt in node.body:
+            self.visit(stmt)
+        for _ in range(pushed):
+            self.held.pop()
+
+    def _wait_releases(self, call: ast.Call) -> Set[int]:
+        """Indices in ``self.held`` that a cond.wait() call releases."""
+        func = call.func
+        if not (isinstance(func, ast.Attribute)
+                and func.attr in ("wait", "wait_for")):
+            return set()
+        cls = self.cls[-1] if self.cls else None
+        resolved = self.resolver.resolve(func.value, self.src, cls)
+        try:
+            recv_src = ast.unparse(func.value)
+        except Exception:
+            recv_src = None
+        out = set()
+        for i, (name, src_txt) in enumerate(self.held):
+            if (resolved and name == resolved) or (
+                    recv_src and src_txt == recv_src):
+                out.add(i)
+        return out
+
+    def visit_Call(self, node: ast.Call) -> None:
+        if self.held:
+            desc = _blocking_desc(node)
+            if desc:
+                released = self._wait_releases(node)
+                held = [n for i, (n, _s) in enumerate(self.held)
+                        if i not in released]
+                if held:
+                    self.findings.append(Finding(
+                        "MX-L001", self.src.rel, node.lineno,
+                        f"{desc} while holding {', '.join(held)}",
+                        "move the blocking call outside the critical "
+                        "section (snapshot under the lock, block "
+                        "outside), or use a non-blocking variant"))
+            else:
+                cls = self.cls[-1] if self.cls else None
+                callee = self.resolver.local_callee(node.func, self.src,
+                                                    cls)
+                if callee and callee in self.may_block:
+                    bdesc, chain = self.may_block[callee]
+                    path = " -> ".join(
+                        f"{q.rsplit('.', 1)[-1]}:{ln}" for q, ln in chain)
+                    held = [n for n, _s in self.held]
+                    self.findings.append(Finding(
+                        "MX-L001", self.src.rel, node.lineno,
+                        f"call to {callee.rsplit('.', 1)[-1]}() which "
+                        f"does {bdesc} (via {path}) while holding "
+                        f"{', '.join(held)}",
+                        "hoist the blocking work out of the locked "
+                        "region or split the callee so the lock is "
+                        "dropped first"))
+                elif callee:
+                    info = self.funcs.get(callee)
+                    if info:
+                        for lname, ln in info.acquires:
+                            for held_name, _s in self.held:
+                                self._edge(held_name, lname, node.lineno)
+        self.generic_visit(node)
+
+
+def _cycles(edges: Dict[Tuple[str, str], List[Tuple[str, int]]]
+            ) -> List[List[str]]:
+    """Strongly connected components of size > 1 (Tarjan, iterative)."""
+    adj: Dict[str, List[str]] = {}
+    for a, b in edges:
+        adj.setdefault(a, []).append(b)
+        adj.setdefault(b, [])
+    index: Dict[str, int] = {}
+    low: Dict[str, int] = {}
+    on_stack: Set[str] = set()
+    stack: List[str] = []
+    sccs: List[List[str]] = []
+    counter = [0]
+
+    def strongconnect(root: str) -> None:
+        work = [(root, iter(adj[root]))]
+        index[root] = low[root] = counter[0]
+        counter[0] += 1
+        stack.append(root)
+        on_stack.add(root)
+        while work:
+            v, it = work[-1]
+            advanced = False
+            for w in it:
+                if w not in index:
+                    index[w] = low[w] = counter[0]
+                    counter[0] += 1
+                    stack.append(w)
+                    on_stack.add(w)
+                    work.append((w, iter(adj[w])))
+                    advanced = True
+                    break
+                elif w in on_stack:
+                    low[v] = min(low[v], index[w])
+            if advanced:
+                continue
+            work.pop()
+            if work:
+                pv = work[-1][0]
+                low[pv] = min(low[pv], low[v])
+            if low[v] == index[v]:
+                scc = []
+                while True:
+                    w = stack.pop()
+                    on_stack.discard(w)
+                    scc.append(w)
+                    if w == v:
+                        break
+                if len(scc) > 1:
+                    sccs.append(sorted(scc))
+
+    for v in sorted(adj):
+        if v not in index:
+            strongconnect(v)
+    return sccs
+
+
+def analyze(ctx: AnalysisContext) -> List[Finding]:
+    mods: Dict[str, _ModuleLocks] = {}
+    attr_index: Dict[str, Set[str]] = {}
+    for src in ctx.sources:
+        mod = mods.setdefault(src.modname, _ModuleLocks())
+        _DefCollector(src, mod, attr_index).visit(src.tree)
+
+    funcs: Dict[str, _FuncInfo] = {}
+    resolver = _Resolver(mods, attr_index, funcs)
+    for src in ctx.sources:
+        _FuncScanner(src, resolver, funcs).visit(src.tree)
+    may_block = _closure(funcs)
+
+    findings: List[Finding] = []
+    edges: Dict[Tuple[str, str], List[Tuple[str, int]]] = {}
+    for src in ctx.sources:
+        _RegionWalker(src, resolver, funcs, may_block, findings,
+                      edges).visit(src.tree)
+
+    for scc in _cycles(edges):
+        in_cycle = [(e, sites) for e, sites in sorted(edges.items())
+                    if e[0] in scc and e[1] in scc]
+        if not in_cycle:
+            continue
+        first_site = in_cycle[0][1][0]
+        detail = "; ".join(
+            f"{a} -> {b} at {sites[0][0]}:{sites[0][1]}"
+            for (a, b), sites in in_cycle)
+        findings.append(Finding(
+            "MX-L002", first_site[0], first_site[1],
+            f"lock-order cycle between {', '.join(scc)}: {detail}",
+            "pick one global acquisition order for these locks and "
+            "restructure the out-of-order site(s); the runtime "
+            "sanitizer (MXNET_SANITIZE=locks) confirms the fix "
+            "dynamically"))
+    return findings

@@ -190,6 +190,30 @@ def poll_graph_knobs() -> None:
 
 
 # Core runtime vars (more are registered at their use sites).
+register_env("MXNET_NO_AUTO_DISTRIBUTED", 0,
+             "Set to 1 to skip the automatic jax.distributed.initialize "
+             "at import even when JAX_COORDINATOR_ADDRESS is present in "
+             "the environment (single-process debugging of a node from "
+             "a launcher-described job).")
+register_env("MXNET_DIST_INIT_TIMEOUT", 120,
+             "Seconds the import-time join of a launcher-described "
+             "multi-process job waits for the coordinator before "
+             "failing loudly — a stale JAX_COORDINATOR_ADDRESS cannot "
+             "hang an import forever.")
+register_env("MXNET_SANITIZE", "",
+             "Comma-separated runtime sanitizers to install at import. "
+             "'locks' patches threading.Lock/RLock creation so every "
+             "lock allocated from this repo records per-thread "
+             "acquisition stacks and a global acquired-while-holding "
+             "graph; a lock-order inversion (the A/B-B/A deadlock "
+             "pattern) is reported with both stacks. CI enables it on "
+             "the chaos and resilience smokes. See "
+             "docs/static_analysis.md.")
+register_env("MXNET_SANITIZE_LOCKS_ACTION", "raise",
+             "What the lock-order sanitizer does on an inversion: "
+             "'raise' (default) raises LockOrderViolation at the "
+             "offending acquisition; 'warn' prints the report to "
+             "stderr and continues (for surveying a long run).")
 register_env("MXNET_ENGINE_TYPE", "ThreadedEnginePerDevice",
              "Execution mode: 'NaiveEngine' forces synchronous per-op "
              "execution (block_until_ready after every op) for debugging; "

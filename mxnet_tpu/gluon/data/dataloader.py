@@ -53,6 +53,14 @@ register_env("MXNET_DATALOADER_START_METHOD", "spawn",
              "that never touch jax, e.g. pure numpy/PIL). "
              "'forkserver' is also accepted.")
 
+register_env("MXNET_DATALOADER_IN_WORKER", 0,
+             "Internal guard, set to 1 by the DataLoader in the "
+             "environment of its spawned worker processes: a "
+             "DataLoader constructed inside a worker (a guard-less "
+             "script re-executing under spawn) degrades to in-process "
+             "loading instead of recursively spawning nested pools. "
+             "Not meant to be set by hand.")
+
 
 def _as_numpy(sample: Any) -> Any:
     if isinstance(sample, NDArray):

@@ -85,6 +85,27 @@ from .retry import retry_call
 __all__ = ["PSServer", "KVStoreDistAsync", "run_server"]
 
 register_env(
+    "MXNET_PS_TOKEN", "",
+    "Shared-secret frame token for dist_async parameter-server RPCs: "
+    "when set (the launcher exports one token to every rank), each "
+    "frame carries it and servers reject mismatches — a stray client "
+    "from another job cannot corrupt the key table. Empty (default) "
+    "disables the check for single-job local runs.")
+
+register_env(
+    "MXNET_PS_BIND_URI", "",
+    "Interface a dist_async parameter server listens on. Empty "
+    "(default) picks loopback when DMLC_PS_ROOT_URI names a local "
+    "root and 0.0.0.0 for a genuinely remote job; set explicitly to "
+    "pin a specific interface on multi-homed hosts.")
+
+register_env(
+    "MXNET_PS_FRAME_CAP", 1 << 30,
+    "Soft byte cap for one dist_async multi-key push/pull frame: "
+    "batched key groups split so no frame approaches the u32 framing "
+    "limit. Lower it to bound per-RPC memory on busy servers.")
+
+register_env(
     "MXNET_PS_RECV_TIMEOUT", 300,
     "Per-reply socket timeout (seconds) for dist_async worker RPCs: a "
     "silently dead parameter server surfaces as a structured, "
@@ -431,6 +452,16 @@ class _Handler(socketserver.BaseRequestHandler):
                         _faults.maybe_fault("ps.server",
                                             cmd=cmd.decode("latin1"))
                     except Exception:
+                        # close the LISTENER synchronously before the
+                        # (async, up-to-poll_interval-late) shutdown:
+                        # a client reconnecting into the dying window
+                        # must get ECONNREFUSED now, not a zombie
+                        # connection that only surfaces as a 120 s
+                        # MXNET_PS_CONNECT_TIMEOUT recv hang
+                        try:
+                            self.server.socket.close()
+                        except OSError:
+                            pass
                         threading.Thread(target=self.server.shutdown,
                                          daemon=True).start()
                         return
@@ -1102,7 +1133,13 @@ def run_server(port: int, num_workers: int,
         _publish_port(server.server_address[1])
         if ready_event is not None:
             ready_event.set()
-        server.serve_forever(poll_interval=0.1)
+        try:
+            server.serve_forever(poll_interval=0.1)
+        except OSError:
+            # a dying handler (ps.server chaos kill) closed the
+            # listener under the poll loop so reconnects get refused
+            # immediately; the death itself is reported below
+            pass
     if not ps.stop_requested:
         # the serve loop died WITHOUT a deliberate STOP ('S') — an
         # internal error or the ps.server chaos site.  Exit nonzero so

@@ -27,9 +27,17 @@ from .. import engine
 from .. import faults as _faults
 from .. import metrics as _metrics
 from .._tape import TapeNode, is_recording
+from ..base import register_env
 
 __all__ = ["invoke", "register_op", "get_op", "list_ops", "wrap_out",
            "exec_cache_stats"]
+
+register_env("MXNET_IMPERATIVE_EXEC_CACHE", "auto",
+             "Per-op executable cache for imperative dispatch: 1 "
+             "forces it on (the exec-cache CI sanitizer), 0 forces it "
+             "off, 'auto' (default) lets the runtime decide per op. "
+             "Read once per process; the CI 'exec-cache' variant runs "
+             "the core suite with it forced on.")
 
 # name -> {"fn": public python fn, "doc": ...}
 _OP_REGISTRY: Dict[str, Dict[str, Any]] = {}

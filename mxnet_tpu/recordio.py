@@ -24,7 +24,13 @@ from typing import Any, Dict, List, Optional, Tuple, Union
 
 import numpy as _np
 
-from .base import MXNetError
+from .base import MXNetError, register_env
+
+register_env("MXNET_NATIVE_RECORDIO", 1,
+             "Set to 0 to bypass the libmxtpu.so C RecordIO "
+             "reader/writer and use the pure-Python implementation "
+             "even when the native library is loaded (debugging / "
+             "byte-level parity checks).")
 
 __all__ = ["MXRecordIO", "MXIndexedRecordIO", "IRHeader", "pack", "unpack",
            "pack_img", "unpack_img"]

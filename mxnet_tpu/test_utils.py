@@ -12,6 +12,7 @@ from typing import Any, Callable, Dict, List, Optional, Sequence
 
 import numpy as _np
 
+from .base import register_env
 from .context import Context, cpu, tpu
 from .ndarray.ndarray import NDArray
 
@@ -20,6 +21,12 @@ __all__ = [
     "almost_equal", "same", "rand_ndarray", "rand_shape_nd",
     "check_numeric_gradient", "check_consistency", "default_rtols",
 ]
+
+register_env("MXNET_TEST_CTX", "cpu",
+             "Default context the test suite runs on: 'cpu' (default) "
+             "or 'tpu'/'gpu' for the accelerator ctx-flip gates "
+             "(ci/run.sh tpu-sweep / tpu-core / tpu-unit — the "
+             "reference's test_operator_gpu.py analog).")
 
 _DEFAULT_CTX: Optional[Context] = None
 

@@ -211,6 +211,11 @@ def test_ps_generation_reinit_covers_snapshot_gap(monkeypatch, tmp_path):
     monkeypatch.setenv("MXNET_PS_SNAPSHOT_DIR", str(tmp_path / "snap"))
     monkeypatch.setenv("MXNET_PS_SNAPSHOT_EVERY", "1000")  # startup only
     monkeypatch.setenv("MXNET_PS_HEARTBEAT_INTERVAL_S", "0.2")
+    # the unclean death leaves NO server until this test restarts one:
+    # don't sit out the full 120 s supervised-restart connect budget
+    # (the dying handler closes its listener, so the reconnect loop
+    # spins on instant ECONNREFUSED until this deadline)
+    monkeypatch.setenv("MXNET_PS_CONNECT_TIMEOUT", "3")
     port = _free_port()
     th = _start_ps(port)
     kv = _ps_client(monkeypatch, port)

@@ -3,7 +3,9 @@
 The reference documents its ~80 MXNET_* variables in
 docs/static_site/src/pages/api/faq/env_var.md; here the registry itself
 (mxnet_tpu.base.register_env, the dmlc::GetEnv analog) is the source of
-truth and this script renders it. Run: python tools/gen_env_doc.py
+truth.  The rendering lives in mxnet_tpu.analysis.registration — shared
+with mxlint rule MX-R004, which asserts the checked-in file matches —
+and this script just writes it.  Run: python tools/gen_env_doc.py
 """
 import os
 import sys
@@ -14,43 +16,15 @@ sys.path.insert(0, os.path.dirname(os.path.dirname(os.path.abspath(__file__))))
 def main() -> None:
     import jax
     jax.config.update("jax_platforms", "cpu")
-    # import every module that registers vars at its use site
-    import mxnet_tpu as mx  # noqa: F401
-    import mxnet_tpu.kvstore  # noqa: F401
-    import mxnet_tpu.metrics  # noqa: F401
-    import mxnet_tpu.profiler  # noqa: F401
-    import mxnet_tpu.subgraph  # noqa: F401
-    import mxnet_tpu.optimizer  # noqa: F401
-    import mxnet_tpu.ops.pallas.attention  # noqa: F401
-    import mxnet_tpu.parallel  # noqa: F401
-    import mxnet_tpu.gluon.data.dataloader  # noqa: F401
-    import mxnet_tpu.serving  # noqa: F401
-    import mxnet_tpu.faults  # noqa: F401
-    import mxnet_tpu.retry  # noqa: F401
-    import mxnet_tpu.kvstore_async  # noqa: F401
-    import mxnet_tpu.health  # noqa: F401
-    import mxnet_tpu.io  # noqa: F401
-    import mxnet_tpu.compile_cache  # noqa: F401
-    from mxnet_tpu.base import list_env
-
-    rows = ["# Environment variables",
-            "",
-            "Runtime configuration surface (reference analog: "
-            "`docs/.../env_var.md`). Generated by `tools/gen_env_doc.py` "
-            "from `mxnet_tpu.base.register_env` registrations — "
-            "`mx.runtime.list_env()` returns the same data at runtime.",
-            "",
-            "| Variable | Default | Effect |",
-            "|---|---|---|"]
-    for name, meta in sorted(list_env().items()):
-        doc = " ".join(str(meta["doc"]).split())
-        rows.append(f"| `{name}` | `{meta['default']}` | {doc} |")
+    from mxnet_tpu.analysis.registration import render_env_doc
+    content = render_env_doc()
     out = os.path.join(os.path.dirname(os.path.dirname(
         os.path.abspath(__file__))), "docs", "env_vars.md")
     os.makedirs(os.path.dirname(out), exist_ok=True)
     with open(out, "w") as f:
-        f.write("\n".join(rows) + "\n")
-    print(f"wrote {out} ({len(rows) - 6} vars)")
+        f.write(content)
+    nvars = sum(1 for ln in content.splitlines() if ln.startswith("| `"))
+    print(f"wrote {out} ({nvars} vars)")
 
 
 if __name__ == "__main__":
