@@ -66,8 +66,38 @@ ROUND_BASELINES = {
 # across BENCH_r02-r05 and the r7 gen baselines), so --check treats
 # throughput deltas as trend WARNINGS, never failures; only the
 # deterministic gates below (compile counts, flush counts, stall
-# fraction) can fail the check.
+# fraction) and the step-time gate can fail the check.
 CHECK_NOISE_BAND = 0.40
+
+# Per-model step-time baselines (BENCH_r06, 2026-08-04): the
+# step_breakdown.step_s of each headline config's timed loop.  Promoted
+# from warn-only to a GATED check: a round whose per-model step time
+# lands past STEP_TIME_GATE_RATIO x the recorded baseline FAILS
+# bench.py --check.  The band is deliberately generous — rig noise is
+# ±25-40% run-to-run, and a fast-day baseline against a slow-day check
+# compounds to ~2.3x — so only a real in-program regression (3x+ step
+# time) can trip it while kernel wins stay held, not just landed.
+# Each entry pairs the step_s baseline with the SAME run's headline
+# value: the gate engages only when a round's own value lands within
+# STEP_RIG_CLASS_WINDOW of the baseline's companion value (evidence of
+# a comparable rig class).  A round from a different host class (the
+# checked-in rounds span a ~600x rig spread) warns that the baseline
+# needs re-recording instead of tripping a hard gate on hardware —
+# absolute wall-clock across rig classes is exactly what this module
+# refuses to gate.  A real in-program regression moves step_s ~2.5x
+# and throughput ~2.5x, both well inside the 10x class window, so it
+# still fails.
+# r06 ran on a 2-core CPU container (see BENCH_r06.json's note): only
+# lstm fit the compile+step budget there; the other headline configs'
+# entries get recorded at the next full round on the bench rig, and
+# until then those metrics stay warn-only (an absent entry skips the
+# gate, it never fakes one).
+STEP_BASELINES = {
+    "lstm_ptb_bfloat16_b128x35_train": {"step_s": 6.4274,
+                                        "value": 697.0},
+}
+STEP_TIME_GATE_RATIO = 2.5
+STEP_RIG_CLASS_WINDOW = 10.0
 
 # Deterministic regression gates for bench.py --check: these numbers do
 # not move with host load, so a miss is a real regression, not noise.
@@ -266,9 +296,14 @@ def bench_check(paths) -> None:
 
     Deterministic regressions FAIL (exit 1): XLA compiles after warmup,
     segment-flush growth, input-stall fraction with prefetch on.
-    Wall-clock deltas against ROUND_BASELINES only WARN — this rig's
-    run-to-run noise is ±25-40% (CHECK_NOISE_BAND), so a throughput dip
-    is a trend signal for a human, not a gate."""
+    Per-model STEP TIME is gated too (promoted from warn-only at r06):
+    a round's step_breakdown.step_s past STEP_TIME_GATE_RATIO x its
+    recorded STEP_BASELINES entry fails — the band is generous enough
+    that rig noise cannot trip it, so a trip is an in-program
+    regression.  Raw throughput deltas against ROUND_BASELINES still
+    only WARN — this rig's run-to-run noise is ±25-40%
+    (CHECK_NOISE_BAND), so a throughput dip is a trend signal for a
+    human, not a gate."""
     failures = []
     report = {"input_pipeline": _check_input_pipeline(failures),
               "dispatch": _check_dispatch_flush(failures)}
@@ -291,6 +326,10 @@ def bench_check(paths) -> None:
         if isinstance(doc, dict):
             if isinstance(doc.get("parsed"), dict):
                 records.append(doc["parsed"])
+            if "metric" in doc:
+                # a bare one-record file IS the record (a single-line
+                # bench JSONL parses as a whole-file JSON doc)
+                records.append(doc)
             lines = str(doc.get("tail", "")).splitlines()
         for line in lines:
             line = line.strip().rstrip(",")
@@ -304,7 +343,43 @@ def bench_check(paths) -> None:
                 records.append(rec)
     seen = set()
     for rec in records:
-        name, value = rec.get("metric"), rec.get("value")
+        name = rec.get("metric")
+        # step-time gate: the one wall-clock number that FAILS (with
+        # the generous band) — per-model step_s is the in-program cost
+        # kernel work attacks, so losing it must stop the line
+        bd = rec.get("step_breakdown")
+        step_base = STEP_BASELINES.get(name)
+        if isinstance(bd, dict) and step_base:
+            step_s = bd.get("step_s")
+            val = rec.get("value")
+            same_class = (
+                isinstance(val, (int, float)) and val > 0
+                and step_base["value"] / STEP_RIG_CLASS_WINDOW
+                <= val <= step_base["value"] * STEP_RIG_CLASS_WINDOW)
+            if isinstance(step_s, (int, float)) \
+                    and (name, "step", step_s) not in seen:
+                seen.add((name, "step", step_s))
+                sratio = step_s / step_base["step_s"]
+                if not same_class:
+                    warnings.append(
+                        f"step-time gate SKIPPED for {name}: the "
+                        f"round's throughput ({val}) is outside "
+                        f"{STEP_RIG_CLASS_WINDOW:.0f}x of the "
+                        f"baseline's rig ({step_base['value']}) — "
+                        "different host class; re-record "
+                        "STEP_BASELINES on the current rig")
+                elif sratio > STEP_TIME_GATE_RATIO:
+                    failures.append(
+                        f"step-time: {name} step_s {step_s:.4f} is "
+                        f"{sratio:.2f}x the recorded baseline "
+                        f"{step_base['step_s']:.4f} (gate "
+                        f"{STEP_TIME_GATE_RATIO}x)")
+                elif sratio > 1 + CHECK_NOISE_BAND:
+                    warnings.append(
+                        f"step-time within the gate but beyond noise: "
+                        f"{name} step_s {step_s:.4f} = {sratio:.2f}x "
+                        f"baseline {step_base['step_s']:.4f}")
+        value = rec.get("value")
         base = ROUND_BASELINES.get(name)
         if not base or not isinstance(value, (int, float)) \
                 or (name, value) in seen:

@@ -678,6 +678,31 @@ GEN_KV_MIGRATIONS_TOTAL = counter(
     "KV-cache capacity-bucket migrations (cache grew to the next "
     "power-of-two length bucket; each switches the engine to that "
     "bucket's pre-compiled decode step).")
+GEN_SAMPLED_TOKENS_TOTAL = counter(
+    "mxnet_gen_sampled_tokens_total",
+    "Tokens emitted by the generation engine, by decode method "
+    "(greedy / sample / top_k / top_p) — the on-device sampler keeps "
+    "every method inside the compiled step, so the split is free to "
+    "observe.", labels=("method",))
+GEN_PREFIX_HITS_TOTAL = counter(
+    "mxnet_gen_prefix_cache_hits_total",
+    "Generation admissions that reused a resident shared-prefix KV "
+    "entry (rows copied into the slot instead of re-running prefill "
+    "over the prefix).")
+GEN_PREFIX_MISSES_TOTAL = counter(
+    "mxnet_gen_prefix_cache_misses_total",
+    "Generation admissions that found no resident prefix for a "
+    "cacheable prompt and ran a full cold prefill (the prefix rows "
+    "are inserted for the next request).")
+GEN_PREFIX_EVICTIONS_TOTAL = counter(
+    "mxnet_gen_prefix_cache_evictions_total",
+    "Shared-prefix KV entries evicted (LRU among unreferenced entries "
+    "once the cache exceeds MXNET_GEN_PREFIX_CACHE_SLOTS).")
+GEN_PREFIX_ROWS = gauge(
+    "mxnet_gen_prefix_cache_rows",
+    "KV positions (padded prefix rows, summed over resident entries) "
+    "currently held in the shared-prefix cache — the device-memory "
+    "footprint is rows x layers x heads x head_dim x 2 (K and V).")
 
 # -- async device-prefetch input pipeline (io/prefetch.py) ------------------
 PREFETCH_QUEUE_DEPTH = gauge(

@@ -63,7 +63,7 @@ box.
 from .batching import (BucketPolicy, DynamicBatcher, OverloadError,
                        Request, SlotScheduler)
 from .model import DecodeModel, ServedModel, load_served
-from .kv_cache import PagedKVCache
+from .kv_cache import PagedKVCache, PrefixCache
 from .generation import GenerationEngine, StreamTimeout, TokenStream
 from .replica import ReplicaSupervisor
 from .server import (DegradedError, GenerationServer, ModelServer,
@@ -73,7 +73,7 @@ from .http import make_http_server
 __all__ = [
     "BucketPolicy", "DynamicBatcher", "OverloadError", "Request",
     "SlotScheduler", "ServedModel", "DecodeModel", "PagedKVCache",
-    "GenerationEngine", "StreamTimeout", "TokenStream",
+    "PrefixCache", "GenerationEngine", "StreamTimeout", "TokenStream",
     "GenerationServer", "load_served", "ModelServer", "DegradedError",
     "ReplicaSupervisor", "make_http_server", "serve_until_preempted",
 ]

@@ -52,8 +52,9 @@ import numpy as _np
 from ..base import MXNetError, getenv, register_env
 from .. import metrics as _metrics
 from .batching import REQUESTS_TOTAL, SlotScheduler
-from .kv_cache import PagedKVCache, round_up_bucket
-from .model import DecodeModel
+from .kv_cache import (PagedKVCache, PrefixCache, prefix_key,
+                       round_up_bucket, _shrink_rows)
+from .model import DecodeModel, METHOD_CODES
 
 __all__ = ["GenerationEngine", "GenRequest", "StreamTimeout",
            "TokenStream", "make_recovery_request"]
@@ -73,6 +74,24 @@ register_env("MXNET_GEN_STREAM", 1,
              "streams each token as a chunk the moment the decode "
              "iteration produces it; 0 answers with the full "
              "completion. Per-request 'stream' overrides.")
+register_env("MXNET_GEN_METHOD", "greedy",
+             "Default decode method for generation requests that name "
+             "none: greedy | sample | top_k | top_p. Sampling runs "
+             "inside the compiled decode step (per-slot counter-PRNG "
+             "keys), so the method never changes the readback shape "
+             "or recompiles.")
+register_env("MXNET_GEN_TEMPERATURE", 1.0,
+             "Default sampling temperature for generation requests "
+             "that name none (must be > 0; greedy ignores it). "
+             "Per-request 'temperature' overrides.")
+register_env("MXNET_GEN_TOP_K", 40,
+             "Default k for top_k decoding when the request names "
+             "none (>= 1, clamped to the vocab size). Per-request "
+             "'top_k' overrides.")
+register_env("MXNET_GEN_TOP_P", 0.9,
+             "Default nucleus mass for top_p decoding when the "
+             "request names none (0 < top_p <= 1). Per-request "
+             "'top_p' overrides.")
 
 
 class StreamTimeout(MXNetError):
@@ -234,9 +253,11 @@ class GenRequest:
     the SAME :class:`TokenStream`: ``tokens`` becomes the original
     prompt plus every token already emitted, ``max_new_tokens`` the
     remaining budget, and ``offset`` the absolute index of the next
-    token — greedy decode is deterministic, so the resurrected
-    sequence is token-identical to a fault-free run and the stream's
-    index dedupe makes the join exactly-once.  ``orig_prompt`` and
+    token — decode is deterministic (greedy by definition; sampling by
+    seed: token ``i`` draws under ``fold_in(PRNGKey(seed), i)`` no
+    matter which program emits it), so the resurrected sequence is
+    token-identical to a fault-free run and the stream's index dedupe
+    makes the join exactly-once.  ``orig_prompt`` and
     ``total_new_tokens`` stay absolute so a second death recovers from
     the stream transcript again."""
 
@@ -244,7 +265,8 @@ class GenRequest:
                  "enqueue_t", "deadline_t", "slot", "emitted",
                  "t_first", "request_id", "orig_prompt",
                  "total_new_tokens", "offset", "recover_t0",
-                 "recoveries")
+                 "recoveries", "method", "temperature", "top_k",
+                 "top_p", "seed")
 
     _SEQ = _itertools.count(1)
 
@@ -254,10 +276,20 @@ class GenRequest:
                  stream: Optional[TokenStream] = None,
                  orig_prompt: Optional[_np.ndarray] = None,
                  total_new_tokens: Optional[int] = None,
-                 offset: int = 0) -> None:
+                 offset: int = 0,
+                 method: str = "greedy",
+                 temperature: float = 1.0,
+                 top_k: int = 40,
+                 top_p: float = 0.9,
+                 seed: int = 0) -> None:
         self.tokens = tokens
         self.max_new_tokens = int(max_new_tokens)
         self.eos_token = eos_token
+        self.method = str(method)
+        self.temperature = float(temperature)
+        self.top_k = int(top_k)
+        self.top_p = float(top_p)
+        self.seed = int(seed)
         self.stream = stream if stream is not None else TokenStream()
         self.enqueue_t = time.monotonic()
         self.deadline_t = deadline_t
@@ -286,11 +318,12 @@ class GenRequest:
 def make_recovery_request(req: GenRequest) -> GenRequest:
     """Reincarnate ``req`` at its stream's current transcript: the
     resubmitted prompt is ``original prompt + tokens already emitted``
-    (deterministic greedy decode continues exactly where the dead
-    worker left off), the budget is what remains, and the SAME stream
-    rides along with its index offset advanced.  No deadline: the
-    request was already admitted once — shedding it now would drop an
-    accepted stream."""
+    (deterministic decode continues exactly where the dead worker left
+    off — greedy trivially, sampling by replaying the request's
+    counter-key stream from ``seed`` at the emitted-token offset), the
+    budget is what remains, and the SAME stream rides along with its
+    index offset advanced.  No deadline: the request was already
+    admitted once — shedding it now would drop an accepted stream."""
     emitted = len(req.stream.tokens)
     if emitted:
         prompt = _np.concatenate(
@@ -307,7 +340,9 @@ def make_recovery_request(req: GenRequest) -> GenRequest:
     r = GenRequest(prompt, remaining, req.eos_token, None,
                    stream=req.stream, orig_prompt=req.orig_prompt,
                    total_new_tokens=req.total_new_tokens,
-                   offset=emitted)
+                   offset=emitted, method=req.method,
+                   temperature=req.temperature, top_k=req.top_k,
+                   top_p=req.top_p, seed=req.seed)
     r.recover_t0 = time.monotonic()
     r.recoveries = req.recoveries + 1
     return r
@@ -342,11 +377,36 @@ class GenerationEngine:
                  kv_buckets: Optional[Sequence[int]] = None,
                  queue_limit: Optional[int] = None,
                  max_tokens: Optional[int] = None,
-                 default_deadline_ms: Optional[float] = None) -> None:
+                 default_deadline_ms: Optional[float] = None,
+                 prefix_slots: Optional[int] = None,
+                 prefix_cache: Optional[PrefixCache] = None,
+                 default_method: Optional[str] = None,
+                 default_temperature: Optional[float] = None,
+                 default_top_k: Optional[int] = None,
+                 default_top_p: Optional[float] = None) -> None:
         self.model = model
         if max_slots is None:
             max_slots = int(getenv("MXNET_GEN_MAX_SLOTS", 8))
         self.max_slots = int(max_slots)
+        # server-side sampling defaults (per-request values override);
+        # validated HERE so a bad env/CLI default fails at startup,
+        # not per-request
+        self.default_method = str(
+            default_method if default_method is not None
+            else getenv("MXNET_GEN_METHOD", "greedy"))
+        self.default_temperature = float(
+            default_temperature if default_temperature is not None
+            else getenv("MXNET_GEN_TEMPERATURE", 1.0))
+        self.default_top_k = int(
+            default_top_k if default_top_k is not None
+            else getenv("MXNET_GEN_TOP_K", 40))
+        self.default_top_p = float(
+            default_top_p if default_top_p is not None
+            else getenv("MXNET_GEN_TOP_P", 0.9))
+        self._validate_sampling(self.default_method,
+                                self.default_temperature,
+                                self.default_top_k,
+                                self.default_top_p, seed=0)
         # the position table bounds everything: a position past
         # max_length would silently clamp-gather the embedding, so the
         # cache only ever allocates buckets the model can address
@@ -359,7 +419,8 @@ class GenerationEngine:
                 f"(grid {full})")
         self.cache = PagedKVCache(
             model.n_layers, model.num_heads, model.head_dim,
-            self.max_slots, buckets=self.grid, dtype=model.dtype)
+            self.max_slots, buckets=self.grid, dtype=model.dtype,
+            prefix=prefix_cache, prefix_slots=prefix_slots)
         # prompt pad grid: powers of two up to the top usable bucket —
         # mixed prompt lengths land on a handful of prefill programs
         top = self.grid[-1]
@@ -378,8 +439,16 @@ class GenerationEngine:
             float(default_deadline_ms) / 1e3 if default_deadline_ms
             is not None
             else float(getenv("MXNET_SERVING_DEADLINE_MS", 0)) / 1e3)
-        # host mirrors of the per-slot step inputs
+        # host mirrors of the per-slot step inputs: last token plus the
+        # (seed, counter base, temperature, top_k, top_p, method)
+        # sampling vectors — all traced operands of the ONE decode
+        # executable.  The lanes change only at admission/retirement,
+        # so their device mirrors (_samp_dev) are cached across
+        # iterations; the per-token key counter is derived in-program
+        # from the position operand
         self._last_tok = _np.zeros((self.max_slots,), _np.int32)
+        self._samp = model.greedy_sampling(self.max_slots)
+        self._samp_dev: Optional[Any] = None
         self._in_admission: List[GenRequest] = []
         self.iteration_log: Deque[Dict[str, Any]] = collections.deque(
             maxlen=self.LOG_KEEP)
@@ -396,9 +465,14 @@ class GenerationEngine:
     # -- lifecycle ----------------------------------------------------------
     def warmup(self) -> int:
         """Pre-compile the full program grid — prefill x prompt
-        buckets, decode x KV buckets, admission row-writes x both —
-        so steady-state traffic never compiles."""
-        self.warmed = self.model.warmup(self.cache, self.prompt_buckets)
+        buckets, suffix prefill x (prefix, suffix) bucket pairs, the
+        first-token selector, decode x KV buckets, admission
+        row-writes x both, prefix-row shrinks — so steady-state
+        traffic never compiles, including across per-request sampling
+        parameter changes and shared-prefix admissions."""
+        self.warmed = self.model.warmup(
+            self.cache, self.prompt_buckets,
+            suffix_pairs=self.cache.prefix.slots > 0)
         self.warmed += self.cache.warmup_writes(self.prompt_buckets)
         return self.warmed
 
@@ -440,20 +514,69 @@ class GenerationEngine:
                 resident.append(req)
         self._in_admission = []
         self.cache.reset_buffers()
+        # fresh lanes: stale sampling methods on freed slots would
+        # keep steering the step into its sampler branch for nothing
+        self._samp = self.model.greedy_sampling(self.max_slots)
+        self._samp_dev = None
         _metrics.GEN_SLOTS_ACTIVE.set(0)
         return queued, resident
 
     # -- request API --------------------------------------------------------
+    def _validate_sampling(self, method: str, temperature: float,
+                           top_k: int, top_p: float, seed: int) -> int:
+        """The zoo's validation rules (``model_zoo.generation``), so
+        the HTTP layer's 400s match the in-process API: method must be
+        known, temperature > 0, top_k >= 1 (clamped to the vocab),
+        0 < top_p <= 1.  Returns the clamped top_k."""
+        if method not in METHOD_CODES:
+            raise MXNetError(
+                f"unknown generation method {method!r} (expected "
+                "greedy, sample, top_k, or top_p)")
+        if not temperature > 0.0:
+            raise MXNetError(
+                f"temperature must be > 0, got {temperature}")
+        if not 1 <= top_k:
+            raise MXNetError(f"top_k must be >= 1, got {top_k}")
+        if not 0.0 < top_p <= 1.0:
+            raise MXNetError(f"top_p must be in (0, 1], got {top_p}")
+        if not -2**31 <= int(seed) < 2**31:
+            # the seed rides the compiled step as an int32 operand; an
+            # out-of-range value must be the caller's 400, not a
+            # mid-admission numpy OverflowError retiring the stream as
+            # a server error
+            raise MXNetError(
+                f"seed must fit int32 (got {seed})")
+        return min(int(top_k), int(self.model.vocab_size))
+
     def submit(self, tokens: Any, max_new_tokens: int = 64,
                eos_token: Optional[int] = None,
-               deadline_ms: Optional[float] = None) -> TokenStream:
+               deadline_ms: Optional[float] = None,
+               method: Optional[str] = None,
+               temperature: Optional[float] = None,
+               top_k: Optional[int] = None,
+               top_p: Optional[float] = None,
+               seed: Optional[int] = None) -> TokenStream:
         """Queue one prompt; returns its :class:`TokenStream`.  Sheds
         with :class:`OverloadError` when the admission queue is full;
         rejects (plain ``MXNetError``) prompts whose budget cannot fit
-        the KV/position ceiling — that is the caller's bug, not load."""
+        the KV/position ceiling, or whose sampling parameters are out
+        of range — those are the caller's bugs, not load.  Sampling
+        (``method`` sample/top_k/top_p with ``temperature``/``top_k``/
+        ``top_p``) runs on the device under per-slot counter-PRNG keys
+        derived from ``seed``: same seed => same stream, across
+        worker-death resurrection included."""
         toks = _np.asarray(tokens, _np.int32).reshape(-1)
         if toks.size < 1:
             raise MXNetError("empty prompt")
+        method = str(method) if method is not None \
+            else self.default_method
+        temperature = float(temperature) if temperature is not None \
+            else self.default_temperature
+        top_k = int(top_k) if top_k is not None else self.default_top_k
+        top_p = float(top_p) if top_p is not None else self.default_top_p
+        seed = int(seed) if seed is not None else 0
+        top_k = self._validate_sampling(method, temperature, top_k,
+                                        top_p, seed)
         if self.max_tokens_cap > 0:
             max_new_tokens = min(int(max_new_tokens),
                                  self.max_tokens_cap)
@@ -470,7 +593,9 @@ class GenerationEngine:
             deadline_ms = self._default_deadline_s * 1e3
         deadline_t = (time.monotonic() + deadline_ms / 1e3
                       if deadline_ms else None)
-        req = GenRequest(toks, max_new_tokens, eos_token, deadline_t)
+        req = GenRequest(toks, max_new_tokens, eos_token, deadline_t,
+                         method=method, temperature=temperature,
+                         top_k=top_k, top_p=top_p, seed=seed)
         # consumer cancel while still queued -> evict NOW (queue budget
         # frees immediately; an abandoned-request flood cannot hold
         # queue_full sheds high until the next admission pass)
@@ -549,10 +674,12 @@ class GenerationEngine:
                                 slots=len(active))
             self.cache.ensure_capacity(self.cache.needed_capacity())
             pos = _np.maximum(self.cache.positions, 0).astype(_np.int32)
+            if self._samp_dev is None:
+                self._samp_dev = self.model.device_sampling(self._samp)
             with _health.watch_section("generation.step",
                                        slots=len(active)):
                 next_tok = self.model.step(self.cache, self._last_tok,
-                                           pos)
+                                           pos, self._samp_dev)
         except Exception as e:   # noqa: BLE001 - an iteration fault
             # hits exactly the sequences IN FLIGHT at this iteration
             # (their kv rows are suspect); queued requests and the
@@ -571,6 +698,9 @@ class GenerationEngine:
                     # release the slot WITHOUT closing the stream
                     self.scheduler.release(slot)
                     self.cache.free(slot)
+                    if self._samp[5][slot]:
+                        self._samp[5][slot] = 0
+                        self._samp_dev = None
                     _metrics.GEN_RETIREMENTS_TOTAL.labels(
                         reason="recovered").inc()
                     victims.append(req)
@@ -592,6 +722,8 @@ class GenerationEngine:
             tok = int(next_tok[slot])
             self.cache.positions[slot] += 1
             self._last_tok[slot] = tok
+            _metrics.GEN_SAMPLED_TOKENS_TOTAL.labels(
+                method=req.method).inc()
             # absolute index rides along: the stream dedupes replays
             # from recovered producers at this boundary
             req.stream.put(tok, index=req.offset + req.emitted)
@@ -622,30 +754,145 @@ class GenerationEngine:
         self.iteration_log.append(log)
         return True
 
+    def _lookup_prefix(self, req: GenRequest) -> Optional[Any]:
+        """The longest resident prefix of ``req``'s prompt (pinned —
+        the caller unpins), or None.  Candidates are the bucket-aligned
+        prefix lengths: the prompt-bucket grid values <= the prompt
+        length, longest first.  A whole-prompt entry only counts when
+        it carries its prefill logits (nothing left to prefill), and a
+        partial prefix only when the padded layout it forces
+        (``q + round_up(suffix)`` rows) needs no more capacity than a
+        cold prefill's own padded prompt — a SHORT resident prefix
+        under a LONG prompt would otherwise pad past the cold layout
+        (ballooning the whole cache's bucket, or, past the top bucket,
+        hard-failing a request a cold prefill serves fine)."""
+        t0 = int(req.tokens.size)
+        for q in reversed(self.prompt_buckets):
+            if q > t0:
+                continue
+            key = prefix_key(req.tokens, q)
+            e = self.cache.prefix.lookup(key, pin=True)
+            if e is None:
+                continue
+            if e.q == t0:
+                if e.logits is None:
+                    self.cache.prefix.unpin(key)
+                    continue
+                return e
+            sb = round_up_bucket(t0 - q, self.prompt_buckets)
+            if q + sb > round_up_bucket(t0, self.prompt_buckets):
+                self.cache.prefix.unpin(key)
+                continue        # reuse must never cost more than cold
+            return e
+        return None
+
+    def _insert_prefix(self, req: GenRequest, ks: Sequence[Any],
+                       vs: Sequence[Any], logits: _np.ndarray) -> None:
+        """After a cold prefill, park the longest bucket-aligned prefix
+        of the prompt in the pinned region (rows sliced off the
+        prefill output — a warmable shape-pair program).  When the
+        prefix IS the whole prompt, the prefill logits ride along so
+        an identical prompt admits with no model call at all."""
+        t0 = int(req.tokens.size)
+        q = max((b for b in self.prompt_buckets if b <= t0),
+                default=None)
+        if q is None:
+            return
+        key = prefix_key(req.tokens, q)
+        if self.cache.prefix.lookup(key) is not None:
+            if q == t0:
+                # the resident entry was cut from a longer prompt and
+                # carries no logits; this cold prefill just computed
+                # them for exactly this prefix — attach, so identical
+                # prompts now admit with no model call
+                self.cache.prefix.attach_logits(key, logits)
+            return
+        if q < int(ks[0].shape[0]):
+            cut = _shrink_rows(list(ks) + list(vs), q)
+            pks, pvs = cut[:len(ks)], cut[len(ks):]
+        else:
+            pks, pvs = list(ks), list(vs)
+        self.cache.prefix.insert(
+            key, pks, pvs, q, logits=(logits if q == t0 else None))
+
     def _admit(self, req: GenRequest) -> int:
-        """Prefill one request and install it in a slot.  The prompt
-        pass emits the FIRST generated token (TTFT ends here)."""
+        """Install one request in a slot.  A cold prompt runs prefill
+        (and parks its bucket-aligned prefix for the next request); a
+        prompt whose prefix is resident COPIES the shared rows into
+        the slot (one fused row-write over every layer) and prefills only the
+        suffix — or nothing at all for an identical prompt.  Either
+        way the pass emits the FIRST generated token (TTFT ends
+        here)."""
         from .. import faults as _faults
         _faults.maybe_fault("serving.execute", phase="prefill",
                             prompt=int(req.tokens.size))
         slot = self.cache.alloc()
         if slot is None:                     # caller checked free_slots
             raise MXNetError("no free decode slot (admission race)")
+        entry = None
         try:
             t0 = int(req.tokens.size)
-            pb = round_up_bucket(t0, self.prompt_buckets)
-            logits, ks, vs = self.model.prefill(req.tokens, pb)
-            self.cache.write_prompt(slot, ks, vs, t0)
-            first = int(_np.argmax(logits))
+            cacheable = (self.cache.prefix.slots > 0
+                         and t0 >= self.prompt_buckets[0])
+            if cacheable:
+                entry = self._lookup_prefix(req)
+            if entry is not None and entry.q == t0:
+                # identical prompt: pure row copy + cached logits —
+                # no model invocation on the admission path
+                self.cache.write_prompt(slot, entry.ks, entry.vs, t0)
+                logits = entry.logits
+                _metrics.GEN_PREFIX_HITS_TOTAL.inc()
+            elif entry is not None:
+                # shared prefix: copy the resident rows, prefill only
+                # the suffix against them
+                q = entry.q
+                sb = round_up_bucket(t0 - q, self.prompt_buckets)
+                logits, sks, svs = self.model.prefill_suffix(
+                    req.tokens[q:], entry.ks, entry.vs, q, sb)
+                self.cache.write_prompt(slot, entry.ks, entry.vs, q)
+                self.cache.write_prompt(slot, sks, svs, t0, start=q)
+                _metrics.GEN_PREFIX_HITS_TOTAL.inc()
+            else:
+                pb = round_up_bucket(t0, self.prompt_buckets)
+                logits, ks, vs = self.model.prefill(req.tokens, pb)
+                self.cache.write_prompt(slot, ks, vs, t0)
+                if cacheable:
+                    _metrics.GEN_PREFIX_MISSES_TOTAL.inc()
+                    self._insert_prefix(req, ks, vs, logits)
+            # first token through the same fused sampler as the step
+            # (key = fold_in(PRNGKey(seed), offset)): one key stream
+            # per request no matter which program emits which token
+            first = self.model.select(
+                logits, req.seed, req.offset, req.temperature,
+                req.top_k, req.top_p, METHOD_CODES[req.method])
         except Exception:
             self.cache.free(slot)
             raise
+        finally:
+            if entry is not None:
+                self.cache.prefix.unpin(entry.key)
         self.scheduler.activate(slot, req)
         req.slot = slot
         self._last_tok[slot] = first
+        # arm the slot's sampling lane.  The counter base makes the
+        # in-program key counter (pos - base) equal the token's
+        # absolute stream index: at the request's decode step number e
+        # (tokens emitted so far, prefill's included), pos is
+        # t0 + e - 1, and the token being drawn is index offset + e —
+        # so base = t0 - offset - 1, a per-request constant (for a
+        # resurrection, exactly the original prompt length minus one)
+        self._samp[0][slot] = req.seed
+        self._samp[1][slot] = t0 - req.offset - 1
+        self._samp[2][slot] = req.temperature
+        self._samp[3][slot] = req.top_k
+        self._samp[4][slot] = req.top_p
+        self._samp[5][slot] = METHOD_CODES[req.method]
+        self._samp_dev = None        # lanes changed: remirror once
         req.t_first = time.monotonic()
         req.stream.put(first, index=req.offset)
         req.emitted = 1
+        _metrics.GEN_SAMPLED_TOKENS_TOTAL.labels(
+            method=req.method).inc()
         _metrics.GEN_TTFT_SECONDS.observe(req.t_first - req.enqueue_t)
         _metrics.GEN_TOKENS_TOTAL.labels(phase="prefill").inc()
         _metrics.GEN_ADMISSIONS_TOTAL.inc()
@@ -663,6 +910,9 @@ class GenerationEngine:
     def _retire(self, slot: int, req: GenRequest, reason: str) -> None:
         self.scheduler.release(slot)
         self.cache.free(slot)
+        if self._samp[5][slot]:
+            self._samp[5][slot] = 0      # freed lanes ride greedy
+            self._samp_dev = None
         req.stream.close(reason)         # no-op if already closed
         if reason in ("eos", "length"):
             REQUESTS_TOTAL.labels(status="ok").inc()
@@ -683,4 +933,11 @@ class GenerationEngine:
             "max_tokens_cap": self.max_tokens_cap,
             "warmed_programs": self.warmed,
             "iterations": self._iter,
+            "sampling_defaults": {
+                "method": self.default_method,
+                "temperature": self.default_temperature,
+                "top_k": self.default_top_k,
+                "top_p": self.default_top_p,
+            },
+            "prefix_cache": self.cache.prefix.describe(),
         }

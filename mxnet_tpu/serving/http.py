@@ -15,7 +15,13 @@ Endpoints:
   header; malformed input -> 400; model fault -> 500.
 * ``POST /v1/generate`` — body ``{"tokens": [id, ...]}`` (the prompt;
   ``"prompt"`` is an accepted alias) + optional ``"max_new_tokens"``,
-  ``"eos_token"``, ``"deadline_ms"``, ``"stream"``.  Streaming (the
+  ``"eos_token"``, ``"deadline_ms"``, ``"stream"``, and the sampling
+  controls ``"method"`` (``greedy`` | ``sample`` | ``top_k`` |
+  ``top_p``), ``"temperature"`` (> 0), ``"top_k"`` (>= 1),
+  ``"top_p"`` (in (0, 1]), ``"seed"`` (same seed => same stream, the
+  determinism contract recovery relies on).  Out-of-range values ->
+  **400** with the offending rule named, on the stream and collect
+  paths alike.  Streaming (the
   default, ``MXNET_GEN_STREAM``) answers **chunked**: one NDJSON line
   per token (``{"token": id, "index": i}``) the moment the decode
   iteration produces it, then a ``{"done": true, ...}`` trailer line.
@@ -302,17 +308,44 @@ class _Handler(BaseHTTPRequestHandler):
             if deadline_ms is not None and not isinstance(
                     deadline_ms, (int, float)):
                 raise ValueError("deadline_ms must be a number")
+            # sampling parameters: type errors are caught HERE (400);
+            # range errors (top_k < 1, top_p outside (0,1], bad
+            # method, temperature <= 0) raise MXNetError from the
+            # engine's zoo-rule validation below — also 400, on both
+            # the stream and collect paths (validation precedes any
+            # token)
+            method = payload.get("method")
+            if method is not None and not isinstance(method, str):
+                raise ValueError("method must be a string (greedy / "
+                                 "sample / top_k / top_p)")
+            temperature = payload.get("temperature")
+            if temperature is not None and not isinstance(
+                    temperature, (int, float)):
+                raise ValueError("temperature must be a number")
+            top_k = payload.get("top_k")
+            if top_k is not None and not isinstance(top_k, int):
+                raise ValueError("top_k must be an integer")
+            top_p = payload.get("top_p")
+            if top_p is not None and not isinstance(top_p,
+                                                    (int, float)):
+                raise ValueError("top_p must be a number")
+            seed = payload.get("seed")
+            if seed is not None and not isinstance(seed, int):
+                raise ValueError("seed must be an integer")
             stream_mode = bool(payload.get(
                 "stream", int(getenv("MXNET_GEN_STREAM", 1))))
         except (TypeError, ValueError, json.JSONDecodeError) as e:
             self._reply(400, {"error": "bad_request", "detail": str(e)})
             return
         # submit: backpressure -> 429, dead worker -> 503, a budget
-        # that cannot fit the KV ceiling -> 400 (the caller's bug)
+        # that cannot fit the KV ceiling (or out-of-range sampling
+        # params) -> 400 (the caller's bug)
         try:
             stream = gs.generate(toks, max_new_tokens=max_new,
                                  eos_token=eos,
-                                 deadline_ms=deadline_ms)
+                                 deadline_ms=deadline_ms,
+                                 method=method, temperature=temperature,
+                                 top_k=top_k, top_p=top_p, seed=seed)
         except OverloadError as e:
             self._reply(429, e.to_json(), headers={
                 "Retry-After": str(max(1, int(e.retry_after_ms / 1e3)))})

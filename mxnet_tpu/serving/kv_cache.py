@@ -25,14 +25,18 @@ ever sees the fixed-shape buffers plus an ``(S,)`` position vector.
 """
 from __future__ import annotations
 
-from typing import Any, List, Optional, Sequence, Tuple
+import collections
+import hashlib
+import threading
+from typing import Any, Dict, List, Optional, Sequence, Tuple
 
 import numpy as _np
 
 from ..base import MXNetError, getenv, register_env
 from .. import metrics as _metrics
 
-__all__ = ["PagedKVCache", "kv_bucket_grid", "round_up_bucket"]
+__all__ = ["PagedKVCache", "PrefixCache", "kv_bucket_grid",
+           "round_up_bucket"]
 
 register_env("MXNET_GEN_KV_BUCKETS", "128,256,512,1024",
              "KV-cache capacity bucket grid for the generation engine "
@@ -40,6 +44,13 @@ register_env("MXNET_GEN_KV_BUCKETS", "128,256,512,1024",
              "decode step compiles once per bucket; a sequence whose "
              "prompt+new-tokens budget exceeds the top bucket is "
              "rejected at submit.")
+register_env("MXNET_GEN_PREFIX_CACHE_SLOTS", 8,
+             "Resident entries in the generation engine's shared-prefix "
+             "KV cache: bucket-aligned prompt prefixes (e.g. a common "
+             "system prompt) keep their K/V rows on the device and "
+             "admissions COPY them into the slot instead of re-running "
+             "prefill, collapsing TTFT for the dominant traffic class. "
+             "LRU eviction past this bound; 0 disables prefix caching.")
 
 
 def kv_bucket_grid(buckets: Optional[Sequence[int]] = None
@@ -77,7 +88,9 @@ class PagedKVCache:
     def __init__(self, n_layers: int, n_heads: int, head_dim: int,
                  max_slots: int,
                  buckets: Optional[Sequence[int]] = None,
-                 dtype: Any = None) -> None:
+                 dtype: Any = None,
+                 prefix_slots: Optional[int] = None,
+                 prefix: Optional["PrefixCache"] = None) -> None:
         import jax.numpy as jnp
         self.grid = kv_bucket_grid(buckets)
         self.n_layers = int(n_layers)
@@ -95,6 +108,13 @@ class PagedKVCache:
         # host bookkeeping: next write position per slot (== tokens
         # resident in the row), -1 marks a free slot
         self.positions = _np.full((self.max_slots,), -1, _np.int64)
+        # the pinned shared-prefix region: hot prompt-prefix K/V rows
+        # resident beside the slot buffers, copied (never re-prefilled)
+        # into slots at admission.  Pass ``prefix`` to SHARE one store
+        # across engines (replicas on one device hit each other's
+        # inserts — a resurrected sequence lands on a warm prefix)
+        self.prefix = prefix if prefix is not None \
+            else PrefixCache(prefix_slots)
         _metrics.GEN_KV_BUCKET_LEN.set(self.bucket)
 
     # -- buffers ------------------------------------------------------------
@@ -150,19 +170,31 @@ class PagedKVCache:
 
     # -- admission write ----------------------------------------------------
     def write_prompt(self, slot: int, ks: Sequence[Any],
-                     vs: Sequence[Any], t0: int) -> None:
-        """Install a prefilled prompt into ``slot``: ``ks[l]``/``vs[l]``
-        are ``(Lp, heads, d)`` (prompt padded to a length bucket; the
-        pad rows carry garbage KV that stays masked until the decode
-        loop overwrites them position by position).  Grows the cache
-        first if the padded prompt exceeds the current bucket."""
+                     vs: Sequence[Any], t0: int,
+                     start: int = 0) -> None:
+        """Install prefilled rows into ``slot``: ``ks[l]``/``vs[l]``
+        are ``(Lp, heads, d)`` (padded to a length bucket; the pad rows
+        carry garbage KV that stays masked until the decode loop
+        overwrites them position by position).  ``start`` places the
+        rows at positions ``start..start+Lp`` — the shared-prefix
+        admission writes the copied prefix at 0 and the suffix prefill
+        at the prefix length (``start`` is a traced operand, so every
+        offset shares one compiled write per shape pair).  ``t0`` is
+        the slot's resident-token count after the write.  Grows the
+        cache first if the rows exceed the current bucket."""
         Lp = int(ks[0].shape[0])
-        if Lp > self.bucket:
-            self.grow(round_up_bucket(Lp, self.grid))
-        slot_j = _np.int32(slot)
-        for li in range(self.n_layers):
-            self._k[li] = _write_row_jit(self._k[li], ks[li], slot_j)
-            self._v[li] = _write_row_jit(self._v[li], vs[li], slot_j)
+        if int(start) + Lp > self.bucket:
+            self.grow(round_up_bucket(int(start) + Lp, self.grid))
+        # ONE dispatch writes every layer's K and V row: per-call
+        # dispatch overhead is what dominates a row copy on small
+        # hosts, so 2L separate writes would bury the prefix cache's
+        # TTFT win under launch latency (see _make_write_rows for why
+        # the write is not donated)
+        out = _write_rows_jit(self._k + self._v,
+                              list(ks) + list(vs),
+                              _np.int32(slot), _np.int32(start))
+        self._k = out[:self.n_layers]
+        self._v = out[self.n_layers:]
         self.positions[slot] = int(t0)
 
     # -- capacity -----------------------------------------------------------
@@ -191,9 +223,10 @@ class PagedKVCache:
 
     def warmup_writes(self, prompt_buckets: Sequence[int]) -> int:
         """Pre-compile every admission/migration executable: the
-        prompt-row write per (capacity bucket x prompt bucket) pair and
-        the grow pad per (bucket -> larger bucket) pair — so
-        steady-state traffic never compiles them."""
+        prompt-row write per (capacity bucket x prompt bucket) pair,
+        the grow pad per (bucket -> larger bucket) pair, and the
+        prefix-row shrink per (prompt bucket -> smaller prompt bucket)
+        pair — so steady-state traffic never compiles them."""
         import jax
         dev = jax.local_devices()[0]
         n = 0
@@ -203,19 +236,35 @@ class PagedKVCache:
             for Lp in prompt_buckets:
                 if Lp > L:
                     continue
-                row = jax.device_put(
+                rows = [jax.device_put(
                     _np.zeros((int(Lp), self.n_heads, self.head_dim),
                               self.dtype), dev)
-                # one write warms the executable for every layer (they
-                # share shapes); zeros into zeros is a no-op in content
-                self._k[0] = _write_row_jit(self._k[0], row,
-                                            _np.int32(0))
+                    for _ in range(2 * self.n_layers)]
+                # one fused write covers every layer's K and V; zeros
+                # into zeros is a no-op in content
+                out = _write_rows_jit(self._k + self._v, rows,
+                                      _np.int32(0), _np.int32(0))
+                self._k = out[:self.n_layers]
+                self._v = out[self.n_layers:]
                 n += 1
             for L2 in self.grid[i + 1:]:
                 # live migrations may leap buckets (a long-prompt
                 # admission), so warm every ordered pair
                 _grow_rows(self._k[0], int(L2))
                 n += 1
+        if self.prefix.slots > 0:
+            # prefix insertion slices a prefill's (Lp, h, d) rows down
+            # to the bucket-aligned prefix length: warm each ordered
+            # (larger -> smaller) prompt-bucket pair
+            pbs = sorted(int(b) for b in prompt_buckets)
+            for i, Lp in enumerate(pbs):
+                rows = [jax.device_put(
+                    _np.zeros((Lp, self.n_heads, self.head_dim),
+                              self.dtype), dev)
+                    for _ in range(2 * self.n_layers)]
+                for Pb in pbs[:i]:
+                    _shrink_rows(rows, Pb)
+                    n += 1
         self.bucket = self.grid[0]
         self._alloc_buffers(self.bucket)
         return n
@@ -246,6 +295,7 @@ class PagedKVCache:
             "heads": self.n_heads,
             "head_dim": self.head_dim,
             "dtype": str(self.dtype),
+            "prefix_cache": self.prefix.describe(),
         }
 
 
@@ -274,19 +324,33 @@ def _grow_rows(buf: Any, new_len: int) -> Any:
 _grow_jits: dict = {}
 
 
-def _make_write_row():
+def _make_write_rows():
     import jax
     from jax import lax
     from .. import compile_cache as _cc
 
-    def write(buf, row, slot):
-        # buf (S, L, h, d), row (Lp, h, d), slot scalar: place the
-        # prompt KV at [slot, 0:Lp] without materializing a copy chain
-        return lax.dynamic_update_slice(
-            buf, row[None].astype(buf.dtype),
-            (slot, _np.int32(0), _np.int32(0), _np.int32(0)))
-    return _cc.persistently_cached(jax.jit(write), surface="serving.kv",
-                                   pin=True)
+    def write(bufs, rows, slot, start):
+        # bufs: every layer's K then V buffer (S, L, h, d); rows: the
+        # matching (Lp, h, d) rows; slot/start scalars: place each
+        # row-set at [slot, start:start+Lp] in ONE executable (per-
+        # dispatch overhead dominates a row copy, so one call per
+        # layer per K/V would bury the admission in launch latency).
+        # start is a traced operand (prefix copies write at 0, suffix
+        # prefills at the prefix length) so every offset shares this
+        # one executable per shape pair.  NOT donated: a donated
+        # multi-buffer write deserialized from the persistent compile
+        # cache mis-aliases on this jax/XLA version — a warm-restarted
+        # replica then decodes corrupted KV rows and double-frees at
+        # teardown (observed live; the in-process jit was fine).  The
+        # un-donated form matches the pre-prefix-cache write's
+        # semantics and keeps warm restarts at 0 compiles
+        return [lax.dynamic_update_slice(
+            b, r[None].astype(b.dtype),
+            (slot, start, _np.int32(0), _np.int32(0)))
+            for b, r in zip(bufs, rows)]
+    return _cc.persistently_cached(
+        jax.jit(write), surface="serving.kv",
+        pin=True)
 
 
 class _LazyWrite:
@@ -296,10 +360,168 @@ class _LazyWrite:
     def __init__(self) -> None:
         self._fn = None
 
-    def __call__(self, buf, row, slot):
+    def __call__(self, bufs, rows, slot, start):
         if self._fn is None:
-            self._fn = _make_write_row()
-        return self._fn(buf, row, slot)
+            self._fn = _make_write_rows()
+        return self._fn(bufs, rows, slot, start)
 
 
-_write_row_jit = _LazyWrite()
+_write_rows_jit = _LazyWrite()
+
+
+def _shrink_rows(rows: List[Any], new_len: int) -> List[Any]:
+    """Slice every (Lp, h, d) row-set in ``rows`` down to its first
+    ``new_len`` rows in ONE executable — the prefix-insertion path (a
+    prefill's K and V rows cut to the bucket-aligned prefix).  One
+    executable per (Lp, new_len) pair, all drawn from the
+    prompt-bucket grid (warmable, bounded)."""
+    fn = _shrink_jits.get(int(new_len))
+    if fn is None:
+        import jax
+        from .. import compile_cache as _cc
+
+        def shrink(bs, _n=int(new_len)):
+            return [b[:_n] for b in bs]
+
+        fn = _shrink_jits[int(new_len)] = _cc.persistently_cached(
+            jax.jit(shrink), surface="serving.kv", pin=True)
+    return fn(list(rows))
+
+
+_shrink_jits: dict = {}
+
+
+# ---------------------------------------------------------------------------
+# shared-prefix KV cache (the pinned region)
+# ---------------------------------------------------------------------------
+
+class _PrefixEntry:
+    """One resident prefix: per-layer K/V rows (Pb, heads, d) on the
+    device, the real prefix length ``q`` (rows past it are pad
+    garbage, masked by slot positions like any admission), and — when
+    the prefix IS a whole prompt — the prefill's last-token logits, so
+    an identical-prompt admission emits its first token without any
+    model call."""
+
+    __slots__ = ("key", "ks", "vs", "q", "bucket", "logits", "refs")
+
+    def __init__(self, key: str, ks: List[Any], vs: List[Any], q: int,
+                 logits: Optional[_np.ndarray]) -> None:
+        self.key = key
+        self.ks = ks
+        self.vs = vs
+        self.q = int(q)
+        self.bucket = int(ks[0].shape[0])
+        self.logits = logits
+        self.refs = 0
+
+
+def prefix_key(tokens: _np.ndarray, q: int) -> str:
+    """Content hash of the first ``q`` tokens (int32-canonical)."""
+    raw = _np.ascontiguousarray(
+        _np.asarray(tokens, _np.int32)[:q]).tobytes()
+    return f"{q}:{hashlib.sha1(raw).hexdigest()}"
+
+
+class PrefixCache:
+    """Ref-counted, LRU-bounded store of hot prompt-prefix K/V rows.
+
+    One store may be SHARED by engines serving the same
+    :class:`~mxnet_tpu.serving.model.DecodeModel` (replicas on one
+    device — ``tools/serve.py`` does this) so any replica's cold
+    prefill warms them all; entries are model-specific, so never share
+    a store across different models/weights.
+
+    Engine threads probe/pin/insert concurrently under the shared
+    store, so every method is lock-guarded; nothing under the lock
+    touches the device (entries hold already-built arrays — eviction
+    just drops the references).  ``refs`` counts admissions currently
+    copying from the entry: eviction only ever removes unreferenced
+    entries, so rows cannot vanish out from under an admission on a
+    sibling engine."""
+
+    def __init__(self, slots: Optional[int] = None) -> None:
+        if slots is None:
+            slots = int(getenv("MXNET_GEN_PREFIX_CACHE_SLOTS", 8))
+        self.slots = max(0, int(slots))
+        self._entries: "collections.OrderedDict[str, _PrefixEntry]" = \
+            collections.OrderedDict()
+        self._lock = threading.Lock()
+
+    def __len__(self) -> int:
+        with self._lock:
+            return len(self._entries)
+
+    def lookup(self, key: str, pin: bool = False
+               ) -> Optional[_PrefixEntry]:
+        """The entry for ``key`` (refreshing recency), or None.
+        ``pin=True`` bumps the refcount — pair with :meth:`unpin`."""
+        with self._lock:
+            e = self._entries.get(key)
+            if e is None:
+                return None
+            self._entries.move_to_end(key)
+            if pin:
+                e.refs += 1
+            return e
+
+    def unpin(self, key: str) -> None:
+        with self._lock:
+            e = self._entries.get(key)
+            if e is not None and e.refs > 0:
+                e.refs -= 1
+
+    def insert(self, key: str, ks: List[Any], vs: List[Any], q: int,
+               logits: Optional[_np.ndarray] = None) -> bool:
+        """Install a prefix (idempotent: an existing key only refreshes
+        recency — concurrent admissions of the same prefix must not
+        churn the rows).  Evicts LRU unreferenced entries past the
+        ``slots`` bound; returns False when the cache is disabled or
+        every resident entry is pinned."""
+        if self.slots == 0:
+            return False
+        with self._lock:
+            if key in self._entries:
+                self._entries.move_to_end(key)
+                return True
+            while len(self._entries) >= self.slots:
+                victim = next((k for k, e in self._entries.items()
+                               if e.refs == 0), None)
+                if victim is None:
+                    return False        # everything pinned: skip insert
+                del self._entries[victim]
+                _metrics.GEN_PREFIX_EVICTIONS_TOTAL.inc()
+            self._entries[key] = _PrefixEntry(key, list(ks), list(vs),
+                                              q, logits)
+            _metrics.GEN_PREFIX_ROWS.set(
+                sum(e.bucket for e in self._entries.values()))
+            return True
+
+    def attach_logits(self, key: str, logits: _np.ndarray) -> None:
+        """Upgrade a resident entry with whole-prompt prefill logits
+        (an entry first inserted from a LONGER prompt carries none;
+        once some request's full prompt IS the prefix, its logits make
+        every identical prompt admit with zero model calls)."""
+        with self._lock:
+            e = self._entries.get(key)
+            if e is not None and e.logits is None:
+                e.logits = logits
+
+    def clear(self) -> None:
+        with self._lock:
+            self._entries.clear()
+        _metrics.GEN_PREFIX_ROWS.set(0)
+
+    def rows_resident(self) -> int:
+        with self._lock:
+            return sum(e.bucket for e in self._entries.values())
+
+    def describe(self) -> dict:
+        with self._lock:
+            return {
+                "slots": self.slots,
+                "entries": len(self._entries),
+                "rows": sum(e.bucket for e in self._entries.values()),
+                "pinned": sum(1 for e in self._entries.values()
+                              if e.refs > 0),
+            }

@@ -260,6 +260,55 @@ class ServedModel:
 # DecodeModel — the stateful autoregressive path (continuous batching)
 # ---------------------------------------------------------------------------
 
+# decode-method codes: the sampler rides INSIDE the compiled step, so
+# the method travels as a traced (S,) int32 operand, never a Python
+# constant (a constant would recompile the step per method mix)
+METHOD_CODES = {"greedy": 0, "sample": 1, "top_k": 2, "top_p": 3}
+
+
+def _sample_tokens(logits, seeds, ctrs, temps, topks, topps, methods):
+    """Fused per-slot token selection over (S, V) logits — the
+    on-device sampler.  Per slot: temperature scaling, then the
+    method's filter (top-k kth-largest threshold / top-p nucleus
+    threshold), then a categorical draw under the slot's counter-PRNG
+    key ``fold_in(PRNGKey(seed), counter)``; greedy slots take the raw
+    argmax.  Every parameter is a traced operand, so one executable
+    serves every per-request method/parameter mix, and the math
+    mirrors ``model_zoo.generation._select`` exactly — the zoo stays
+    the host-side parity oracle (pinned in tests)."""
+    import jax
+    import jax.numpy as jnp
+
+    V = logits.shape[-1]
+    greedy = jnp.argmax(logits, axis=-1).astype(jnp.int32)
+    scaled = logits / jnp.maximum(temps, 1e-6)[:, None]
+    neg = jnp.float32(-jnp.inf).astype(scaled.dtype)
+    asc = jnp.sort(scaled, axis=-1)
+    # top-k: the kth-largest value is asc[V - k] (k pre-clamped to
+    # [1, V] at submit, clipped again here so free slots riding along
+    # with k=0 stay finite)
+    kidx = jnp.clip(V - topks, 0, V - 1)
+    kth_k = jnp.take_along_axis(asc, kidx[:, None], axis=-1)
+    # top-p: smallest probability-sorted prefix reaching mass top_p
+    # (the most probable token is always kept)
+    desc = asc[:, ::-1]
+    probs = jax.nn.softmax(desc, axis=-1)
+    cum = jnp.cumsum(probs, axis=-1)
+    keep = (cum - probs) < topps[:, None]
+    kth_p = jnp.min(jnp.where(keep, desc, jnp.inf), axis=-1,
+                    keepdims=True)
+    m = methods[:, None]
+    filt = jnp.where((m == 2) & (scaled < kth_k), neg, scaled)
+    filt = jnp.where((m == 3) & (filt < kth_p), neg, filt)
+
+    def draw(seed, ctr, row):
+        key = jax.random.fold_in(jax.random.PRNGKey(seed), ctr)
+        return jax.random.categorical(key, row, axis=-1)
+
+    sampled = jax.vmap(draw)(seeds, ctrs, filt).astype(jnp.int32)
+    return jnp.where(methods == 0, greedy, sampled)
+
+
 def _slot_block_step(p, x, ck, cv, pos, nh: int, ga):
     """One decode token for EVERY slot: ``x`` (S, 1, C), caches
     (S, L, nh, d), ``pos`` (S,) int32 — the per-slot-position variant
@@ -303,6 +352,48 @@ def _pure_ln(x, g, b, eps):
     mean = jnp.mean(x, axis=-1, keepdims=True)
     var = jnp.var(x, axis=-1, keepdims=True)
     return (x - mean) * lax.rsqrt(var + eps) * g + b
+
+
+def _block_suffix(p, x, pk, pv, q, nh: int, ga):
+    """Causal pass over a prompt SUFFIX against resident prefix KV:
+    ``x`` (1, Sb, C) embeds suffix tokens at absolute positions
+    ``q..q+Sb``, ``pk``/``pv`` (Pb, nh, d) hold the shared prefix's
+    rows (valid through traced ``q``; pad garbage past it is masked).
+    Returns (x_out, suffix ck/cv (Sb, nh, d)) — the prefix rows are
+    already in the cache, only the suffix rows are new."""
+    import math as _math
+    import jax
+    import jax.numpy as jnp
+
+    gelu_approx, eps = ga
+    _, T, C = x.shape
+    d = C // nh
+    Pb = pk.shape[0]
+    h = _pure_ln(x, p["ln1_g"], p["ln1_b"], eps)
+    qkv = h @ p["qkv_w"].T + p["qkv_b"]
+    qq, kk, vv = jnp.split(qkv, 3, axis=-1)
+    qh = qq.reshape(T, nh, d)
+    kh = kk.reshape(T, nh, d)
+    vh = vv.reshape(T, nh, d)
+    k_all = jnp.concatenate([pk, kh], axis=0)       # (Pb + T, nh, d)
+    v_all = jnp.concatenate([pv, vh], axis=0)
+    scores = jnp.einsum("qhd,khd->hqk", qh, k_all) / _math.sqrt(d)
+    cols = jnp.arange(Pb + T)
+    # suffix position i (absolute q+i) sees: real prefix rows (< q)
+    # and suffix rows up to itself (causal); prefix pad garbage in
+    # q..Pb stays invisible
+    vis = (cols[None, :] < q) | (
+        (cols[None, :] >= Pb)
+        & (cols[None, :] - Pb <= jnp.arange(T)[:, None]))
+    scores = jnp.where(vis[None, :, :], scores,
+                       jnp.float32(-jnp.inf).astype(scores.dtype))
+    probs = jax.nn.softmax(scores, axis=-1)
+    out = jnp.einsum("hqk,khd->qhd", probs, v_all).reshape(1, T, C)
+    x = x + (out @ p["out_w"].T + p["out_b"])
+    h = _pure_ln(x, p["ln2_g"], p["ln2_b"], eps)
+    ffn = jax.nn.gelu(h @ p["f1_w"].T + p["f1_b"],
+                      approximate=gelu_approx)
+    return x + (ffn @ p["f2_w"].T + p["f2_b"]), kh, vh
 
 
 class DecodeModel:
@@ -358,11 +449,19 @@ class DecodeModel:
             h = lax.dynamic_slice_in_dim(x[0], t0 - 1, 1, axis=0)[0]
             return h @ params["embed"].T, ks, vs
 
-        def _step(params, ks, vs, toks, pos):
+        def _step(params, ks, vs, toks, pos, seeds, bases, temps,
+                  topks, topps, methods):
             # toks (S,) int32 last emitted per slot, pos (S,) int32
             # write positions; free slots ride along with pos=0 and
-            # their outputs are ignored on the host
-            import jax.numpy as jnp
+            # their outputs are ignored on the host.  The sampling
+            # vectors (seed/base/temperature/top-k/top-p/method, all
+            # (S,)) are traced operands: per-request parameter changes
+            # never recompile the step — and they change only at
+            # admission, so the engine reuses their device mirrors
+            # across iterations.  The key COUNTER is derived
+            # in-program (ctr = pos - base: base is the slot's
+            # original prompt length minus its stream offset, minus
+            # one) so no per-token host vector rides the hot loop
             x = (params["embed"][toks][:, None, :]
                  + params["pos"][pos][:, None, :])
             new_ks, new_vs = [], []
@@ -372,17 +471,70 @@ class DecodeModel:
                 new_vs.append(cv)
             x = _pure_ln(x, params["lnf_g"], params["lnf_b"], ga_s[1])
             logits = x[:, 0, :] @ params["embed"].T
-            # greedy argmax ON DEVICE: the host reads back (S,) int32
-            # per iteration, not (S, V) logits
-            return jnp.argmax(logits, axis=-1).astype(jnp.int32), \
-                new_ks, new_vs
 
-        # both programs persist through the compile cache (pinned: a
+            # token selection ON DEVICE (greedy argmax or the fused
+            # temperature/top-k/top-p sampler under per-slot counter
+            # keys): the host reads back (S,) int32 per iteration,
+            # never (S, V) logits.  The sampler rides behind a
+            # runtime lax.cond: an all-greedy iteration (the default
+            # traffic) executes only the argmax branch, so sampling
+            # support costs nothing until a slot actually samples —
+            # and it stays ONE executable, so greedy tokens are
+            # bit-identical whichever branch the batch composition
+            # selects (argmax is comparison-only, no FP reassociation)
+            def _mixed(lg):
+                return _sample_tokens(lg, seeds, pos - bases, temps,
+                                      topks, topps, methods)
+
+            def _greedy(lg):
+                import jax.numpy as jnp
+                return jnp.argmax(lg, axis=-1).astype(jnp.int32)
+
+            from jax import lax
+            import jax.numpy as jnp
+            next_tok = lax.cond(jnp.any(methods != 0), _mixed,
+                                _greedy, logits)
+            return next_tok, new_ks, new_vs
+
+        def _prefill_sfx(params, pre_ks, pre_vs, toks, q, t0):
+            # suffix pass for shared-prefix admissions: pre_ks/pre_vs
+            # are the resident prefix rows (Pb, nh, d) per layer, toks
+            # (Sb,) the pad-bucketed suffix, q the traced real prefix
+            # length, t0 the traced real suffix length.  Returns the
+            # last-real-suffix-token logits + the SUFFIX KV rows only
+            from jax import lax
+            Sb = toks.shape[0]
+            x = (params["embed"][toks][None]
+                 + lax.dynamic_slice_in_dim(params["pos"], q, Sb,
+                                            axis=0)[None])
+            ks_o, vs_o = [], []
+            for p, pk, pv in zip(params["blocks"], pre_ks, pre_vs):
+                x, ck, cv = _block_suffix(p, x, pk, pv, q, nh, ga_s)
+                ks_o.append(ck)
+                vs_o.append(cv)
+            x = _pure_ln(x, params["lnf_g"], params["lnf_b"], ga_s[1])
+            h = lax.dynamic_slice_in_dim(x[0], t0 - 1, 1, axis=0)[0]
+            return h @ params["embed"].T, ks_o, vs_o
+
+        def _select_one(logits, seed, ctr, temp, topk, topp, method):
+            # the first-token selector (prefill logits -> token): the
+            # SAME fused sampler on one row, so host-emitted first
+            # tokens and step-emitted tokens share one code path and
+            # one key-stream discipline
+            return _sample_tokens(
+                logits[None], seed[None], ctr[None], temp[None],
+                topk[None], topp[None], method[None])[0]
+
+        # all programs persist through the compile cache (pinned: a
         # live server's decode grid is never evicted) so a restarted
         # replica re-warms its whole bucket grid with zero XLA compiles
         from .. import compile_cache as _cc
         self._prefill_fn = _cc.persistently_cached(
             jax.jit(_prefill), surface="serving.decode", pin=True)
+        self._prefill_sfx_fn = _cc.persistently_cached(
+            jax.jit(_prefill_sfx), surface="serving.decode", pin=True)
+        self._select_fn = _cc.persistently_cached(
+            jax.jit(_select_one), surface="serving.decode", pin=True)
         # the KV buffers are DONATED: XLA updates the resident cache in
         # place instead of allocating a fresh (S, L, h, d) per layer
         # every token
@@ -442,19 +594,60 @@ class DecodeModel:
             time.perf_counter() - t)
         return out, ks, vs
 
+    def greedy_sampling(self, n_slots: int) -> Tuple[_np.ndarray, ...]:
+        """All-greedy per-slot sampling vectors (seed, counter base,
+        temperature, top_k, top_p, method) — the default when no slot
+        asked for sampling."""
+        return (_np.zeros((n_slots,), _np.int32),
+                _np.zeros((n_slots,), _np.int32),
+                _np.ones((n_slots,), _np.float32),
+                _np.ones((n_slots,), _np.int32),
+                _np.ones((n_slots,), _np.float32),
+                _np.zeros((n_slots,), _np.int32))
+
+    def device_sampling(self, sampling: Sequence[_np.ndarray]
+                        ) -> Tuple[Any, ...]:
+        """Device mirrors of the per-slot sampling vectors, dtype
+        canonicalized.  The engine caches the result across
+        iterations (the lanes change only at admission/retirement),
+        keeping the per-iteration host->device traffic at exactly the
+        pre-sampling two arrays (tokens + positions)."""
+        import jax.numpy as jnp
+        seeds, bases, temps, topks, topps, methods = sampling
+        return (jnp.asarray(_np.asarray(seeds, _np.int32)),
+                jnp.asarray(_np.asarray(bases, _np.int32)),
+                jnp.asarray(_np.asarray(temps, _np.float32)),
+                jnp.asarray(_np.asarray(topks, _np.int32)),
+                jnp.asarray(_np.asarray(topps, _np.float32)),
+                jnp.asarray(_np.asarray(methods, _np.int32)))
+
     def step(self, cache: Any, tokens: _np.ndarray,
-             positions: _np.ndarray) -> _np.ndarray:
+             positions: _np.ndarray,
+             sampling: Optional[Sequence[Any]] = None
+             ) -> _np.ndarray:
         """One resident decode iteration over every slot: consumes the
         cache's buffers (donated), installs the updated ones, returns
-        the (S,) int32 greedy next-token vector."""
+        the (S,) int32 next-token vector (greedy or sampled per slot —
+        ``sampling`` is the (seeds, counter bases, temperatures,
+        top_ks, top_ps, methods) vectors, host or device
+        (:meth:`device_sampling`); None means all-greedy)."""
+        import jax
         import jax.numpy as jnp
         S = cache.max_slots
+        if sampling is None:
+            sampling = self.greedy_sampling(S)
+        if not isinstance(sampling[0], jax.Array):
+            # host vectors: one-shot callers; the engine hands in its
+            # cached device mirrors instead
+            sampling = self.device_sampling(sampling)
+        seeds, bases, temps, topks, topps, methods = sampling
         self._account(f"decode:{S}x{cache.bucket}")
         t = time.perf_counter()
         toks, new_ks, new_vs = self._step_fn(
             self.params, cache._k, cache._v,
             jnp.asarray(_np.asarray(tokens, _np.int32)),
-            jnp.asarray(_np.asarray(positions, _np.int32)))
+            jnp.asarray(_np.asarray(positions, _np.int32)),
+            seeds, bases, temps, topks, topps, methods)
         cache.replace(new_ks, new_vs)
         out = _np.asarray(toks)
         from .. import metrics as _metrics
@@ -462,15 +655,98 @@ class DecodeModel:
             time.perf_counter() - t)
         return out
 
-    def warmup(self, cache: Any, prompt_buckets: Sequence[int]) -> int:
+    def prefill_suffix(self, tokens: _np.ndarray, prefix_ks: List[Any],
+                       prefix_vs: List[Any], q: int, bucket_len: int
+                       ) -> Tuple[_np.ndarray, List[Any], List[Any]]:
+        """Run the prompt pass over only the SUFFIX ``tokens`` (real
+        positions ``q..q+len``) against resident prefix K/V rows —
+        the shared-prefix admission path.  Returns (last-real-token
+        logits (V,) numpy, per-layer suffix ks/vs (bucket_len, nh,
+        d)).  One compiled program per (prefix bucket, suffix bucket)
+        pair; ``q`` and the real suffix length are traced operands."""
+        import jax.numpy as jnp
+        toks = _np.asarray(tokens, _np.int32).reshape(-1)
+        t0 = toks.shape[0]
+        if t0 < 1:
+            raise MXNetError("empty prompt suffix")
+        if bucket_len < t0:
+            raise MXNetError(
+                f"suffix length {t0} exceeds its bucket {bucket_len}")
+        padded = _np.zeros((bucket_len,), _np.int32)
+        padded[:t0] = toks
+        Pb = int(prefix_ks[0].shape[0])
+        self._account(f"prefill_sfx:{Pb}x{bucket_len}")
+        t = time.perf_counter()
+        logits, ks, vs = self._prefill_sfx_fn(
+            self.params, list(prefix_ks), list(prefix_vs),
+            jnp.asarray(padded), _np.int32(q), _np.int32(t0))
+        out = _np.asarray(logits)
+        from .. import metrics as _metrics
+        _metrics.GEN_STEP_SECONDS.labels(phase="prefill").observe(
+            time.perf_counter() - t)
+        return out, ks, vs
+
+    def select(self, logits: _np.ndarray, seed: int, counter: int,
+               temperature: float, top_k: int, top_p: float,
+               method: int) -> int:
+        """First-token selection over prefill logits — the single-row
+        twin of the in-step sampler (same fused code path, same
+        ``fold_in(PRNGKey(seed), counter)`` key stream), so a
+        sequence's token at index ``i`` is identical whether the
+        prefill or the decode step emitted it (the resurrection
+        replay-from-transcript contract extends to sampling)."""
+        import jax.numpy as jnp
+        # logits keep the model dtype: the step's sampler sees the
+        # same representation, so the two paths stay bit-identical
+        tok = self._select_fn(
+            jnp.asarray(logits),
+            _np.int32(seed), _np.int32(counter),
+            _np.float32(temperature), _np.int32(top_k),
+            _np.float32(top_p), _np.int32(method))
+        return int(tok)
+
+    def warmup(self, cache: Any, prompt_buckets: Sequence[int],
+               suffix_pairs: bool = True) -> int:
         """Pre-compile the full program grid: one prefill per prompt
-        bucket + one decode step per KV capacity bucket (run on the
-        cache's own buffer shapes).  After this, traffic confined to
-        the grids never compiles."""
+        bucket, one suffix prefill per (prefix bucket, suffix bucket)
+        pair (the shared-prefix admission path; skipped when the
+        prefix cache is disabled), the first-token selector, and one
+        decode step per KV capacity bucket (run on the cache's own
+        buffer shapes).  After this, traffic confined to the grids
+        never compiles."""
+        import jax
         n = 0
         for pb in prompt_buckets:
             self.prefill(_np.zeros((1,), _np.int32), int(pb))
             n += 1
+        # one call warms the selector for every method (the method is
+        # a traced operand — a single executable)
+        self.select(_np.zeros((self.vocab_size,), self.dtype),
+                    seed=0, counter=0, temperature=1.0, top_k=1,
+                    top_p=1.0, method=0)
+        n += 1
+        if suffix_pairs:
+            dev = jax.local_devices()[0]
+            top = max(int(pb) for pb in prompt_buckets)
+            rows = {int(pb): [jax.device_put(
+                _np.zeros((int(pb), self.num_heads, self.head_dim),
+                          self.dtype), dev)
+                for _ in range(self.n_layers)]
+                for pb in prompt_buckets}
+            for Pb in prompt_buckets:
+                for Sb in prompt_buckets:
+                    if int(Pb) + int(Sb) > top:
+                        # unreachable at runtime: entries store
+                        # bucket-aligned prefixes (Pb == q) and the
+                        # admission capacity rule bounds q + Sb by the
+                        # top prompt bucket — compiling these pairs
+                        # would only inflate warmup and the persistent
+                        # cache
+                        continue
+                    self.prefill_suffix(
+                        _np.zeros((1,), _np.int32), rows[int(Pb)],
+                        rows[int(Pb)], q=1, bucket_len=int(Sb))
+                    n += 1
         S = cache.max_slots
         toks = _np.zeros((S,), _np.int32)
         pos = _np.zeros((S,), _np.int32)

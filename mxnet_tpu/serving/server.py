@@ -700,12 +700,19 @@ class GenerationServer:
     # -- request API --------------------------------------------------------
     def generate(self, tokens: Any, max_new_tokens: int = 64,
                  eos_token: Optional[int] = None,
-                 deadline_ms: Optional[float] = None) -> Any:
-        """Submit one prompt; returns its ``TokenStream``.  Sheds with
-        ``OverloadError`` (queue full / no slot within deadline /
-        draining / every replica mid-restart) and refuses with
-        :class:`DegradedError` when the breaker is open — the same
-        429-vs-503 split as the one-shot path."""
+                 deadline_ms: Optional[float] = None,
+                 method: Optional[str] = None,
+                 temperature: Optional[float] = None,
+                 top_k: Optional[int] = None,
+                 top_p: Optional[float] = None,
+                 seed: Optional[int] = None) -> Any:
+        """Submit one prompt; returns its ``TokenStream``.  Sampling
+        parameters pass through to the engine (on-device sampling,
+        deterministic by ``seed`` — including across worker-death
+        resurrection).  Sheds with ``OverloadError`` (queue full / no
+        slot within deadline / draining / every replica mid-restart)
+        and refuses with :class:`DegradedError` when the breaker is
+        open — the same 429-vs-503 split as the one-shot path."""
         if not self._started:
             raise MXNetError("GenerationServer.start() first")
         if self._degraded:
@@ -739,7 +746,9 @@ class GenerationServer:
             try:
                 return rep.engine.submit(
                     tokens, max_new_tokens=max_new_tokens,
-                    eos_token=eos_token, deadline_ms=deadline_ms)
+                    eos_token=eos_token, deadline_ms=deadline_ms,
+                    method=method, temperature=temperature,
+                    top_k=top_k, top_p=top_p, seed=seed)
             except OverloadError as e:
                 last = e                 # replica full: try the next
         raise last if last is not None else MXNetError(

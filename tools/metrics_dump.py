@@ -241,6 +241,51 @@ def _workload_resilience(steps: int) -> None:
     mx.waitall()
 
 
+def _workload_generation(steps: int) -> None:
+    """Production-decoding families in one process: sampled decode
+    (on-device temperature/top-k/top-p under per-slot counter keys —
+    mxnet_gen_sampled_tokens_total{method}) and shared-prefix
+    admissions (a common system prompt inserted cold, then hit by
+    suffix-bearing and identical prompts — prefix hit/miss/eviction
+    counters + the resident-rows gauge), on top of the PR-6 engine
+    families (slots, TTFT, tokens/sec, prefill/decode split)."""
+    import numpy as onp
+    import mxnet_tpu as mx
+    from mxnet_tpu.gluon.model_zoo.gpt import GPTModel
+    from mxnet_tpu.serving import DecodeModel, GenerationEngine
+
+    mx.random.seed(0)
+    gpt = GPTModel(vocab_size=97, num_layers=2, units=32,
+                   hidden_size=48, num_heads=4, max_length=64,
+                   dropout=0.0)
+    gpt.initialize(mx.init.Normal(1.0))
+    gpt(mx.np.zeros((1, 4), dtype="int32"))
+    eng = GenerationEngine(DecodeModel.from_block(gpt), max_slots=4,
+                           kv_buckets=(32, 64), max_tokens=16,
+                           prefix_slots=2)
+    eng.warmup()
+    rng = onp.random.RandomState(0)
+    system = rng.randint(1, 90, (16,)).astype("int32")
+    streams = []
+    for i in range(max(steps, 3)):
+        # one shared-prefix family (first admission inserts, the rest
+        # hit) + rotating sampled methods
+        prompt = onp.concatenate(
+            [system, rng.randint(1, 90, (1 + i % 3,)).astype("int32")])
+        method = ("greedy", "sample", "top_k", "top_p")[i % 4]
+        streams.append(eng.submit(
+            prompt, max_new_tokens=8, method=method, seed=i,
+            temperature=0.9, top_k=8, top_p=0.9))
+    # a distinct-prefix flood forces LRU evictions through the bound
+    for i in range(3):
+        streams.append(eng.submit(
+            rng.randint(1, 90, (18,)).astype("int32"),
+            max_new_tokens=4))
+    while not all(s.finished for s in streams):
+        eng.run_iteration()
+    mx.waitall()
+
+
 def _workload_dist_resilience(steps: int) -> None:
     """Elastic-distributed-training families in one process: a durable
     PS snapshot/restore cycle with replayed-push dedupe (generation
@@ -361,6 +406,7 @@ WORKLOADS = {
     "health": _workload_health,
     "input": _workload_input,
     "resilience": _workload_resilience,
+    "generation": _workload_generation,
     "dist-resilience": _workload_dist_resilience,
     "compile-cache": _workload_compile_cache,
 }

@@ -109,6 +109,25 @@ def main(argv=None) -> None:
     ap.add_argument("--kv-buckets", default=None,
                     help="comma list of KV capacity buckets for "
                          "--generate (MXNET_GEN_KV_BUCKETS)")
+    ap.add_argument("--method", default=None,
+                    choices=("greedy", "sample", "top_k", "top_p"),
+                    help="default decode method for --generate "
+                         "requests that name none (MXNET_GEN_METHOD); "
+                         "sampling runs on-device, deterministic per "
+                         "request seed")
+    ap.add_argument("--temperature", type=float, default=None,
+                    help="default sampling temperature for --generate "
+                         "(MXNET_GEN_TEMPERATURE; > 0)")
+    ap.add_argument("--top-k", type=int, default=None,
+                    help="default k for top_k decoding "
+                         "(MXNET_GEN_TOP_K; >= 1)")
+    ap.add_argument("--top-p", type=float, default=None,
+                    help="default nucleus mass for top_p decoding "
+                         "(MXNET_GEN_TOP_P; in (0, 1])")
+    ap.add_argument("--prefix-cache-slots", type=int, default=None,
+                    help="resident shared-prefix KV entries for "
+                         "--generate (MXNET_GEN_PREFIX_CACHE_SLOTS; "
+                         "0 disables prefix caching)")
     ap.add_argument("--platform", choices=("cpu", "ambient"),
                     default="ambient",
                     help="force the CPU backend, or keep the "
@@ -221,13 +240,22 @@ def _serve_generate(args, serving) -> None:
     model = serving.DecodeModel.from_block(net)
     kv = ([int(b) for b in args.kv_buckets.split(",")]
           if args.kv_buckets else None)
+    # ONE shared prefix store across replicas (same device, same
+    # DecodeModel): a prefix any replica prefilled is hot for all of
+    # them, and a resurrected sequence lands on warm rows
+    prefix = serving.PrefixCache(args.prefix_cache_slots)
 
     def engine_factory():
         # one engine per worker replica; the shared DecodeModel means
         # replicas (and restarts) reuse the same compiled programs
         return serving.GenerationEngine(model, max_slots=args.max_slots,
                                         kv_buckets=kv,
-                                        queue_limit=args.queue_limit)
+                                        queue_limit=args.queue_limit,
+                                        prefix_cache=prefix,
+                                        default_method=args.method,
+                                        default_temperature=args.temperature,
+                                        default_top_k=args.top_k,
+                                        default_top_p=args.top_p)
 
     gs = serving.GenerationServer(engine_factory=engine_factory,
                                   replicas=args.replicas,
@@ -238,7 +266,9 @@ def _serve_generate(args, serving) -> None:
               f"{gs.warmup_seconds:.2f}s "
               f"(prefill buckets {list(engine.prompt_buckets)}, "
               f"KV buckets {list(engine.grid)}, "
-              f"{engine.max_slots} slots x {gs.replicas} replica(s))"
+              f"{engine.max_slots} slots x {gs.replicas} replica(s), "
+              f"{engine.cache.prefix.slots} prefix-cache slots, "
+              f"default method {engine.default_method})"
               + _cache_note())
     gs.start()
     httpd = serving.make_http_server(None, args.host, args.port,
