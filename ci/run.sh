@@ -54,6 +54,12 @@
 #                           #   NaN plan -> exactly one skip + loss
 #                           #   recovery + budget; watchdog stack dump
 #                           #   on an injected stall; replay identical
+#   ci/run.sh dist-comm-smoke # overlapped-collectives gate: bucketed
+#                           #   priority-scheduled gradient reduction
+#                           #   >=1.3x steps/sec vs serialized on a
+#                           #   calibrated synthetic-slow wire, loss
+#                           #   bit-parity / 2bit replay determinism,
+#                           #   0 compiles after warmup
 #   ci/run.sh input-pipeline-smoke # async device-prefetch gate:
 #                           #   synthetic slow loader + real step ->
 #                           #   steps/sec ~ max(loader, step) not the
@@ -214,6 +220,15 @@ run_input_pipeline_smoke() {
   JAX_PLATFORMS=cpu timeout 300 python tools/input_smoke.py
 }
 
+run_dist_comm_smoke() {
+  echo "== dist-comm-smoke: bucketed+overlapped gradient reduction"
+  echo "   >=1.3x steps/sec vs the serialized push-all/pull-all path"
+  echo "   on a calibrated synthetic-slow wire, losses bit-identical"
+  echo "   (lossless ctypes) / replay-identical (2bit), 0 compiles"
+  echo "   after warmup"
+  JAX_PLATFORMS=cpu timeout 600 python tools/dist_comm_smoke.py
+}
+
 run_bench_check() {
   echo "== bench-check: deterministic bench regressions fail (compiles"
   echo "   after warmup / flush growth / stall fraction); wall-clock"
@@ -233,8 +248,8 @@ run_tier1() {
   echo "   old envdoc+faultdoc gates) + serving smoke + generation"
   echo "   smoke + resilience smoke + dist-resilience smoke + chaos"
   echo "   smoke + cache smoke + health smoke + bulking smoke +"
-  echo "   input-pipeline smoke + bench regression check + the tier-1"
-  echo "   pytest selection"
+  echo "   input-pipeline smoke + dist-comm smoke + bench regression"
+  echo "   check + the tier-1 pytest selection"
   run_mxlint
   run_serving_smoke
   run_generation_smoke
@@ -245,6 +260,7 @@ run_tier1() {
   run_health_smoke
   run_bulk_smoke
   run_input_pipeline_smoke
+  run_dist_comm_smoke
   run_bench_check
   JAX_PLATFORMS=cpu python -m pytest tests/ -q -m 'not slow' \
     --continue-on-collection-errors -p no:cacheprovider
@@ -344,6 +360,7 @@ case "$variant" in
   cache-smoke)  run_cache_smoke ;;
   health-smoke) run_health_smoke ;;
   input-pipeline-smoke) run_input_pipeline_smoke ;;
+  dist-comm-smoke) run_dist_comm_smoke ;;
   bench-check)  run_bench_check ;;
   chaos)        run_chaos ;;
   bulk-smoke)   run_bulk_smoke ;;

@@ -135,12 +135,109 @@ class Trainer:
         self._optimizer.set_learning_rate(lr)
 
     # -- core step ----------------------------------------------------------
+    def _overlap_enabled(self) -> bool:
+        """Overlapped (bucketed, priority-scheduled, comm-thread)
+        gradient reduction — MXNET_KV_OVERLAP (default on), engaged
+        only when the store has an actual wire to hide: a
+        multi-process collective store, the dist_async parameter
+        service, or the synthetic-slow-wire knob.  A single-process
+        'local'/'device' store's reduction is a pure no-op — routing
+        it through the comm thread would add cross-thread handshakes
+        per step for nothing.  See kvstore_sched.py and
+        docs/performance.md 'Overlapped collectives'."""
+        from ..base import getenv
+        kv = self._kvstore
+        if kv is None or int(getenv("MXNET_KV_OVERLAP", 1)) == 0:
+            return False
+        if float(getenv("MXNET_KV_SYNTH_WIRE_GBPS", 0.0)) > 0:
+            return True
+        ktype = getattr(kv, "type", "")
+        if ktype == "dist_async":
+            return True
+        if ktype in ("ici", "dist", "dist_sync", "dist_device_sync",
+                     "dist_sync_device", "horovod"):
+            try:
+                import jax
+                return jax.process_count() > 1
+            except Exception:   # noqa: BLE001 - no backend yet
+                return False
+        return False
+
+    def _push_with_recovery(self, keys, grads, priority=0,
+                            reserved_seqs=None) -> None:
+        """One kvstore push with the restarted-empty-server recovery
+        (shared by the serialized path and the scheduler's per-bucket
+        comm-thread dispatch)."""
+        kw = {}
+        if reserved_seqs is not None:
+            kw["_reserved_seqs"] = reserved_seqs
+        try:
+            self._kvstore.push(keys, grads, priority, **kw)
+        except MXNetError as e:
+            if not (getattr(self._kvstore, "type", "") == "dist_async"
+                    and "uninitialized" in str(e)):
+                raise
+            # a parameter server restarted with empty state: resume
+            # from this worker's current weights (pulled from the
+            # server at most one step ago) and re-ship the optimizer.
+            # Server-side momentum resets — announce it.
+            import warnings
+            warnings.warn(
+                "parameter server lost its state (restart?) — "
+                "re-seeding from this worker's current weights; "
+                "server-side optimizer state resets")
+            # re-seed the FULL key set _init_kvstore seeds, not just
+            # the keys in this push: with ignore_stale_grad, params
+            # whose grads are stale right now would otherwise stay
+            # uninitialized on the restarted server and re-trigger
+            # this recovery (resetting momentum) on every later push
+            for i, p in enumerate(self._params):
+                if p.grad_req != "null" and p.is_initialized:
+                    self._kvstore.init(i, p.data())
+            self._kvstore.set_optimizer(self._optimizer)
+            self._kvstore.push(keys, grads, priority)
+
     def allreduce_grads(self, ignore_stale_grad: bool = False) -> None:
         """Sum gradients across data-parallel workers (kvstore push+pull).
+
+        Gradients are fully reduced when this returns — the documented
+        allreduce_grads -> inspect/modify grads -> update() pattern
+        stays valid under the overlapped scheduler (``step()`` uses
+        the internal async variant, where the per-parameter waits move
+        into the optimizer update so wire time hides under compute).
 
         With a sharded SPMD train step this is a no-op: the psum is inside
         the compiled program (kvstore='ici' path, SURVEY.md section 3.5 TPU
         MAPPING)."""
+        self._allreduce_grads_async(ignore_stale_grad)
+        rnd = getattr(self, "_sched_round", None)
+        if rnd is not None:
+            # called directly (not via step): honor the public
+            # contract — drain the round before handing grads back
+            self._sched_round = None
+            try:
+                for b in rnd.buckets:
+                    rnd.wait(b)
+            except BaseException:
+                rnd.abort()
+                raise
+            rnd.finish()
+
+    def _allreduce_grads_async(self, ignore_stale_grad: bool = False) \
+            -> None:
+        """The scheduler-aware reduction ``step()`` drives.
+
+        With MXNET_KV_OVERLAP=1 (default) and a real wire, the
+        reduction is bucketed (MXNET_KV_BUCKET_BYTES, composition
+        fixed by parameter registration order) and dispatched on the
+        scheduler's comm thread in priority order
+        (priority=-param_index: the params the next forward needs
+        first reduce first); ``self._sched_round`` is left pending and
+        the optimizer update for a parameter blocks only on ITS
+        bucket, so wire time hides under the remaining
+        backward/update compute.  Grads are NOT yet reduced when this
+        returns — ``_update`` (or the public wrapper above) consumes
+        the round."""
         if not self._kv_initialized:
             self._init_kvstore()
         if self._kvstore is None:
@@ -174,43 +271,100 @@ class Trainer:
                     continue
                 keys.append(i)
                 grads.append(g)
-        if keys:
-            # one batched push: KVStoreICI fuses the small gradients into
-            # bucket collectives instead of one collective per parameter
+        if not keys:
+            return
+        # reference trainer.py semantics: priority = -param_index, so
+        # the parameters the next forward consumes first reduce first
+        prios = [-i for i in keys]
+        if self._overlap_enabled():
+            self._allreduce_scheduled(keys, grads, prios)
+            return
+        # serialized path: one batched push (KVStoreICI fuses the small
+        # gradients into bucket collectives instead of one per param),
+        # then one batched pull — wire time adds to step time
+        self._push_with_recovery(keys, grads, prios)
+        if self._update_on_kvstore:
+            # the store applied the optimizer — pull WEIGHTS back and
+            # mark grads consumed; _update is skipped
+            ws = [self._params[i].data() for i in keys]
+            self._kvstore.pull(keys, out=ws)
+            for i in keys:
+                self._params[i].data()._fresh_grad = False
+        else:
+            self._kvstore.pull(keys, out=grads)
+
+    def _allreduce_scheduled(self, keys, grads, prios) -> None:
+        """Submit the gradient set to the bucketed comm-thread
+        scheduler.  Worker-side-update stores leave the round pending
+        for ``_update`` to consume bucket by bucket (the overlap);
+        server-side-update stores (dist_async) pull each bucket's
+        WEIGHTS back on the comm thread and drain here — bucketed,
+        priority-ordered, replay-safe sends, with the per-bucket seqs
+        reserved at enqueue."""
+        from .. import kvstore_sched as _ks
+        kv = self._kvstore
+        # a round left over from an aborted step (exception between
+        # allreduce and update) must drain before its grad arrays are
+        # re-submitted — finish() cancels queued buckets and re-raises
+        # any reduce error the aborted step never consumed
+        stale = getattr(self, "_sched_round", None)
+        if stale is not None:
+            self._sched_round = None
+            stale.finish()
+        if self._update_on_kvstore:
+            prepare = None
+            if hasattr(kv, "reserve_push_seqs"):
+                def prepare(bucket):
+                    bucket.ctx["seqs"] = kv.reserve_push_seqs(
+                        bucket.keys,
+                        [int(v.size) for v in bucket.vals])
+
+            def reduce_fn(bucket):
+                self._push_with_recovery(
+                    bucket.keys, bucket.vals, bucket.priority,
+                    reserved_seqs=bucket.ctx.get("seqs"))
+                ws = [self._params[i].data() for i in bucket.keys]
+                kv.pull(bucket.keys, out=ws)
+
+            rnd = _ks.submit(keys, grads, prios, reduce_fn,
+                             prepare_fn=prepare)
             try:
-                self._kvstore.push(keys, grads)
-            except MXNetError as e:
-                if not (getattr(self._kvstore, "type", "") == "dist_async"
-                        and "uninitialized" in str(e)):
-                    raise
-                # a parameter server restarted with empty state: resume
-                # from this worker's current weights (pulled from the
-                # server at most one step ago) and re-ship the optimizer.
-                # Server-side momentum resets — announce it.
-                import warnings
-                warnings.warn(
-                    "parameter server lost its state (restart?) — "
-                    "re-seeding from this worker's current weights; "
-                    "server-side optimizer state resets")
-                # re-seed the FULL key set _init_kvstore seeds, not just
-                # the keys in this push: with ignore_stale_grad, params
-                # whose grads are stale right now would otherwise stay
-                # uninitialized on the restarted server and re-trigger
-                # this recovery (resetting momentum) on every later push
-                for i, p in enumerate(self._params):
-                    if p.grad_req != "null" and p.is_initialized:
-                        self._kvstore.init(i, p.data())
-                self._kvstore.set_optimizer(self._optimizer)
-                self._kvstore.push(keys, grads)
-            if self._update_on_kvstore:
-                # the store applied the optimizer — pull WEIGHTS back and
-                # mark grads consumed; _update is skipped
-                ws = [self._params[i].data() for i in keys]
-                self._kvstore.pull(keys, out=ws)
+                for b in rnd.buckets:
+                    rnd.wait(b)
                 for i in keys:
                     self._params[i].data()._fresh_grad = False
-            else:
-                self._kvstore.pull(keys, out=grads)
+            except BaseException:
+                # drain without raising: a secondary bucket error must
+                # not mask the one already propagating
+                rnd.abort()
+                raise
+            rnd.finish()
+            return
+
+        def reduce_fn(bucket):
+            self._push_with_recovery(bucket.keys, bucket.vals,
+                                     bucket.priority)
+            kv.pull(bucket.keys, out=bucket.vals)
+
+        self._sched_round = _ks.submit(
+            keys, grads, prios, reduce_fn,
+            strict_order=self._strict_collective_order())
+
+    def _strict_collective_order(self) -> bool:
+        """Multi-process collective stores need every rank to issue the
+        identical reduction sequence — the scheduler must dispatch in
+        pure priority order, never readiness order (readiness timing
+        differs per rank and a mismatched collective sequence deadlocks
+        the job)."""
+        if getattr(self._kvstore, "type", "") not in (
+                "ici", "dist", "dist_sync", "dist_device_sync",
+                "dist_sync_device", "horovod"):
+            return False
+        try:
+            import jax
+            return jax.process_count() > 1
+        except Exception:   # noqa: BLE001 - no backend: stay safe
+            return True
 
     def step(self, batch_size: int, ignore_stale_grad: bool = False) -> None:
         """Rescale grads by 1/batch_size and apply one optimizer update."""
@@ -262,7 +416,9 @@ class Trainer:
                 "learning_rate": float(self._optimizer.learning_rate),
                 "rescale_grad": float(self._optimizer.rescale_grad),
                 "wd": float(self._optimizer.wd)})
-        self.allreduce_grads(ignore_stale_grad)
+        # the async variant: a scheduled round stays pending so
+        # _update's per-bucket waits overlap wire with update compute
+        self._allreduce_grads_async(ignore_stale_grad)
         if not self._update_on_kvstore:
             self._update(ignore_stale_grad)
 
@@ -310,6 +466,55 @@ class Trainer:
         for i, _, _ in updatable:
             donated.extend(_jax.tree_util.tree_leaves(self._states[i]))
         _bulk.flush_holding(donated, "mutation")
+        rnd = getattr(self, "_sched_round", None)
+        if rnd is not None:
+            # overlapped reduction: walk buckets in registration order
+            # (composition IS registration-contiguous), waiting only on
+            # the bucket whose parameters update next — the wire for
+            # later buckets keeps running under this compute.  Params
+            # outside the round (row_sparse grads reduced elsewhere)
+            # update in a final chunk.
+            self._sched_round = None
+            try:
+                done = set()
+
+                def chunk(b):
+                    members = set(b.keys)
+                    done.update(members)
+                    self._update_entries(
+                        [t for t in updatable if t[0] in members])
+
+                if self._fused_optimizer_ok():
+                    # per-param updates are order-independent for
+                    # functional optimizers: consume buckets as they
+                    # ARRIVE, updating early winners while later
+                    # buckets are still on the wire
+                    for b in rnd.as_completed():
+                        chunk(b)
+                else:
+                    # order-sensitive optimizers (eager RNG noise in
+                    # update, e.g. SGLD) keep registration order so
+                    # replays stay deterministic
+                    for b in rnd.buckets:
+                        rnd.wait(b)
+                        chunk(b)
+                self._update_entries(
+                    [t for t in updatable if t[0] not in done])
+            except BaseException:
+                # drain without raising: a secondary bucket error must
+                # not mask the one already propagating
+                rnd.abort()
+                raise
+            rnd.finish()
+        else:
+            self._update_entries(updatable)
+        for _, w, _ in updatable:
+            w._fresh_grad = False
+
+    def _update_entries(self, updatable) -> None:
+        """Apply the optimizer to one list of (idx, weight, grad)
+        entries — the fused-group batching below is unchanged from the
+        pre-scheduler path, it just runs per bucket now."""
         agg = self._optimizer.aggregate_num
         if len(updatable) > 1 and agg > 1 and self._fused_optimizer_ok():
             # reference semantics: MXNET_OPTIMIZER_AGGREGATION_SIZE bounds
@@ -336,8 +541,6 @@ class Trainer:
         for i, w, g in rest:
             self._states[i] = self._optimizer.update_multi_precision(
                 i, w, g, self._states[i])
-        for _, w, _ in updatable:
-            w._fresh_grad = False
 
     def _fused_optimizer_ok(self) -> bool:
         """Optimizers fully described by the functional ``_step`` core can

@@ -42,6 +42,21 @@ register_env("MXNET_KVSTORE_BIGARRAY_BOUND", 1000000,
              "sliced big arrays across servers at this bound; here it "
              "bounds the fusion buffer), larger arrays reduce alone.")
 
+KV_RAW_BYTES = _metrics.counter(
+    "mxnet_kv_raw_bytes_total",
+    "Raw (uncompressed f32/bf16) gradient bytes offered to the "
+    "cross-process wire, by configured compression codec — the "
+    "denominator of the EQuARX compression win.  Fed by the ICI "
+    "packed collectives and the dist_async push encoders.",
+    labels=("ctype",))
+KV_COMPRESSED_BYTES = _metrics.counter(
+    "mxnet_kv_compressed_bytes_total",
+    "Post-codec payload bytes that actually crossed the wire, by "
+    "compression codec (equals mxnet_kv_raw_bytes_total for "
+    "ctype='none').  compressed/raw is the effective wire compression "
+    "ratio; tools/bandwidth.py --compression reports it per ctype "
+    "offline.", labels=("ctype",))
+
 register_env("MXNET_PS_CONNECT_TIMEOUT", 120,
              "Seconds a dist_async worker retries connecting to its "
              "parameter server before failing (server cold start).")
@@ -136,6 +151,16 @@ class KVStore:
 
     def push(self, key: Any, value: Union[NDArray, Sequence[NDArray]],
              priority: int = 0) -> None:
+        """Push value(s) into the store (gradient reduction entry).
+
+        ``priority`` orders reduction DISPATCH, reference-style: higher
+        values cross the wire first (the gluon Trainer passes
+        ``-param_index`` so the parameters the next forward needs first
+        arrive first).  It may be an int applied to every key of a
+        batched push, or a per-key list.  Bucket *composition* never
+        depends on it — membership is fixed by key order and the byte
+        budget, which keeps the 2-bit error-feedback residuals
+        deterministic — only the order buckets execute in does."""
         _metrics.KVSTORE_PUSHES.inc()
         t0 = time.perf_counter()
         try:
@@ -143,6 +168,39 @@ class KVStore:
         finally:
             _metrics.COLLECTIVE_SECONDS.labels(collective="push") \
                 .observe(time.perf_counter() - t0)
+            self._synth_wire_sleep(key, value)
+
+    @staticmethod
+    def _synth_wire_sleep(key: Any, value: Any) -> None:
+        """The calibrated synthetic-slow-wire knob
+        (``MXNET_KV_SYNTH_WIRE_GBPS``): model a wire of that many
+        gigabytes/sec by sleeping raw_bytes / rate after the push.
+        Charged identically on the serialized and the overlapped
+        (comm-thread) paths, so the dist-comm-smoke ratio measures the
+        schedule, not a bookkeeping asymmetry."""
+        gbps = float(getenv("MXNET_KV_SYNTH_WIRE_GBPS", 0.0))
+        if gbps <= 0:
+            return
+        vals = value if isinstance(key, (list, tuple)) else [value]
+        nbytes = 0
+        for v in vals:
+            v0 = v[0] if isinstance(v, (list, tuple)) else v
+            try:
+                # a real wire cannot transmit an unmaterialized
+                # gradient: block until THIS call's payload exists on
+                # the host side (exactly what the dist_async client's
+                # asnumpy does), then charge the transmission time.
+                # Serialized pushes therefore block on the whole
+                # backward; scheduled per-bucket pushes block only on
+                # their bucket's segments — the overlap being measured.
+                import jax as _jax
+                _jax.block_until_ready(v0._data)
+                nbytes += int(v0.size) * int(
+                    getattr(v0.dtype, "itemsize", 4))
+            except Exception:   # noqa: BLE001 - sizeless value
+                pass
+        if nbytes:
+            time.sleep(nbytes / (gbps * 1e9))
 
     def _push(self, key: Any, value: Union[NDArray, Sequence[NDArray]],
               priority: int = 0) -> None:
@@ -168,14 +226,32 @@ class KVStore:
             merged.append(v)
         # a multi-key push crosses the process boundary as a handful of
         # fused bucket collectives, not one collective per key
-        for k, reduced in zip(keys, self._allreduce_many(keys, merged)):
+        prios = self._norm_priorities(keys, priority)
+        for k, reduced in zip(keys,
+                              self._allreduce_many(keys, merged, prios)):
             if self._updater is not None and k in self._store:
                 self._updater(k, reduced, self._store[k])
             else:
                 self._store[k] = reduced
 
+    @staticmethod
+    def _norm_priorities(keys: Sequence[Any], priority: Any) -> List[int]:
+        """Normalize the push/pull ``priority`` argument (int, or a
+        per-key list for batched calls) to one int per key."""
+        if isinstance(priority, (list, tuple)):
+            if len(priority) != len(keys):
+                raise MXNetError(
+                    f"priority list length {len(priority)} does not "
+                    f"match {len(keys)} keys")
+            return [int(p) for p in priority]
+        return [int(priority)] * len(keys)
+
     def pull(self, key: Any, out: Union[NDArray, Sequence[NDArray], None] = None,
              priority: int = 0, ignore_sparse: bool = True) -> Optional[NDArray]:
+        """Pull value(s) out of the store.  ``priority`` is accepted for
+        API parity with the reference (and the scheduler's push
+        ordering); pulls here are synchronous local reads, so it has
+        no effect."""
         keys, outs = self._pair(key, out)
         results = []
         for k, o in zip(keys, outs):
@@ -229,7 +305,9 @@ class KVStore:
         return v  # single process: reduction already local
 
     def _allreduce_many(self, keys: Sequence[Any],
-                        vals: Sequence[NDArray]) -> List[NDArray]:
+                        vals: Sequence[NDArray],
+                        priorities: Optional[Sequence[int]] = None
+                        ) -> List[NDArray]:
         return [self._allreduce(v) for v in vals]
 
     # -- config ------------------------------------------------------------
@@ -369,7 +447,9 @@ class KVStoreICI(KVStore):
         return self._allreduce_many([0], [v])[0]
 
     def _allreduce_many(self, keys: Sequence[Any],
-                        vals: Sequence[NDArray]) -> List[NDArray]:
+                        vals: Sequence[NDArray],
+                        priorities: Optional[Sequence[int]] = None
+                        ) -> List[NDArray]:
         """Cross-process sum of each value, bucketed: values needing
         reduction flatten/concat (per dtype) into fusion buffers of up to
         ``MXNET_KVSTORE_BIGARRAY_BOUND`` elements and each bucket crosses
@@ -378,7 +458,13 @@ class KVStoreICI(KVStore):
         larger arrays reduce alone. All workers compute a bit-identical
         result — the reduction is one SPMD program over the global device
         mesh (or an ordered allgather+sum fallback), the dist_sync
-        server-aggregation analog with no server processes."""
+        server-aggregation analog with no server processes.
+
+        ``priorities`` (per key, higher first) order bucket DISPATCH
+        only: composition stays a pure function of key order + sizes
+        (the 2-bit residual determinism contract), and the order is the
+        same deterministic function of (keys, priorities) on every
+        rank, so SPMD collective sequences still match."""
         out: List[Optional[NDArray]] = [None] * len(vals)
         todo: List[int] = []
         for i, v in enumerate(vals):
@@ -404,16 +490,32 @@ class KVStoreICI(KVStore):
             cur[dt].append(i)
             fill[dt] += n
         ctype = (self._compression or {}).get("type")
+        if priorities is not None and len(buckets) > 1:
+            # dispatch order: highest priority first, stable on the
+            # original bucket sequence — deterministic across ranks
+            order = sorted(range(len(buckets)),
+                           key=lambda bi: (-max(priorities[i]
+                                                for i in buckets[bi]),
+                                           bi))
+            buckets = [buckets[bi] for bi in order]
         for idxs in buckets:
             arrs = [jnp.asarray(vals[i]._data) for i in idxs]
             flat = arrs[0].ravel() if len(arrs) == 1 else \
                 jnp.concatenate([a.ravel() for a in arrs])
             t0 = time.perf_counter()
+            wire0 = self.reduce_wire_bytes
             if ctype:
                 segs = [(keys[i], int(vals[i].size)) for i in idxs]
                 red = self._reduce_flat_compressed(flat, ctype, segs)
             else:
                 red = self._reduce_flat(flat)
+            # compressed-vs-raw wire accounting (the EQuARX win, per
+            # codec): raw is what an uncompressed reduce would have
+            # gathered, compressed is what this one actually did
+            KV_RAW_BYTES.labels(ctype=ctype or "none").inc(
+                int(flat.size) * flat.dtype.itemsize)
+            KV_COMPRESSED_BYTES.labels(ctype=ctype or "none").inc(
+                self.reduce_wire_bytes - wire0)
             self.reduce_collectives += 1
             _metrics.COLLECTIVE_CALLS.labels(
                 collective="allreduce", traced="0").inc()

@@ -1560,6 +1560,9 @@ class KVStoreDistAsync:
             spec.update(codec="2bit", n=int(flat.size), thr=thr)
         spec["nbytes"] = len(raw)
         self.push_wire_bytes += len(raw)
+        from .kvstore import KV_COMPRESSED_BYTES, KV_RAW_BYTES
+        KV_RAW_BYTES.labels(ctype=ctype or "none").inc(a.nbytes)
+        KV_COMPRESSED_BYTES.labels(ctype=ctype or "none").inc(len(raw))
         return spec, raw
 
     def _rpc_server(self, sidx: int, cmd: bytes, header: Dict[str, Any],
@@ -1694,59 +1697,106 @@ class KVStoreDistAsync:
                 self._rpc_server(sidx, b"I", hdr, raw)
                 self._remember_init(wk, sidx, hdr, raw)
 
-    def push(self, key, value, priority: int = 0) -> None:
+    def push(self, key, value, priority: int = 0,
+             _reserved_seqs: Optional[Dict[int, int]] = None) -> None:
+        """Push gradient(s) to the parameter service.
+
+        ``priority`` (int, or a per-key list on batched pushes; higher
+        first) orders the per-server frame layout so the
+        highest-priority keys land in the earliest frames when a big
+        push chunks at ``MXNET_PS_FRAME_CAP`` — the scheduler
+        (kvstore_sched.py) additionally orders whole buckets by it.
+        ``_reserved_seqs`` carries seqs pre-drawn at enqueue time by
+        :meth:`reserve_push_seqs` (one per server), so a push pipelined
+        onto the comm thread replays with the seq it was ENQUEUED
+        with — exactly-once no matter how dispatch reorders or retries
+        the sends."""
         from . import health as _health
         with _health.watch_section("kvstore.push", rank=self._rank):
-            self._push_impl(key, value)
+            self._push_impl(key, value, priority, _reserved_seqs)
 
-    def _push_impl(self, key, value) -> None:
+    def reserve_push_seqs(self, keys, sizes) -> Dict[int, int]:
+        """Pre-draw one push seq per server the given key set will
+        touch (``sizes`` = element counts, for the big-array slicing
+        rule).  Called at ENQUEUE time by the gradient-reduction
+        scheduler: the dedupe identity of a scheduled bucket is fixed
+        before the comm thread ever runs it, so a bucket retried after
+        a reconnect — or one whose sends the schedule reordered — is
+        acknowledged, never double-applied (the PR-8 (cid,seq)
+        exactly-once contract).  Chunk-overflow frames past the first
+        per server draw fresh seqs at send time; the server's
+        out-of-order window absorbs the gap either way."""
+        sidxs = set()
+        for k, n in zip(keys, sizes):
+            parts = self._plan(k, int(n))
+            if parts is None:
+                sidxs.add(self._server_of(k))
+            else:
+                sidxs.update(s for _, s, _, _ in parts)
+        return {s: self._next_seq(s) for s in sorted(sidxs)}
+
+    def _push_impl(self, key, value, priority: Any = 0,
+                   reserved_seqs: Optional[Dict[int, int]] = None) -> None:
         keys, vals = self._pair(key, value)
-        entries = []                     # (wire_key, server, flat array)
-        for k, v in zip(keys, vals):
+        prios = self._norm_priorities(keys, priority)
+        entries = []            # (wire_key, server, flat array, prio)
+        for k, v, p in zip(keys, vals, prios):
             a = self._to_numpy(v)
             parts = self._plan(k, int(a.size))
             if parts is None:
-                entries.append((str(k), self._server_of(k), a))
+                entries.append((str(k), self._server_of(k), a, p))
             else:
                 flat = onp.ascontiguousarray(a).ravel()
                 for wk, sidx, st, sp in parts:
-                    entries.append((wk, sidx, flat[st:sp]))
+                    entries.append((wk, sidx, flat[st:sp], p))
         # group by server: a multi-key push crosses the wire as one
         # frame per server (the ICI path's bucketing analog), chunked so
-        # no frame approaches the u32 framing cap
+        # no frame approaches the u32 framing cap.  Within a server the
+        # highest-priority keys go first, so when the cap splits the
+        # group the urgent keys ride the first frame (stable sort:
+        # equal priorities keep key order).
         by_server: Dict[int, List[Any]] = {}
-        for wk, sidx, a in entries:
-            by_server.setdefault(sidx, []).append((wk, a))
+        for wk, sidx, a, p in entries:
+            by_server.setdefault(sidx, []).append((wk, a, p))
         cap = int(os.environ.get("MXNET_PS_FRAME_CAP", str(1 << 30)))
+        reserved = dict(reserved_seqs or {})
         for sidx, items in by_server.items():
-            enc = [(wk,) + self._encode_entry(wk, a) for wk, a in items]
+            items.sort(key=lambda e: -e[2])
+            enc = [(wk,) + self._encode_entry(wk, a)
+                   for wk, a, _ in items]
             group: List[Any] = []
             size = 0
             for e in enc:
                 if group and size + len(e[2]) > cap:
-                    self._push_group(sidx, group)
+                    self._push_group(sidx, group,
+                                     seq=reserved.pop(sidx, None))
                     group, size = [], 0
                 group.append(e)
                 size += len(e[2])
             if group:
-                self._push_group(sidx, group)
+                self._push_group(sidx, group,
+                                 seq=reserved.pop(sidx, None))
 
-    def _push_group(self, sidx: int, enc) -> None:
+    def _push_group(self, sidx: int, enc,
+                    seq: Optional[int] = None) -> None:
         # each push frame carries a per-worker seq: a replay (RPC retry
         # across a reconnect or a snapshot-restored server restart) is
-        # acknowledged but never double-applied
+        # acknowledged but never double-applied.  ``seq`` is the
+        # enqueue-time reservation when the scheduler pipelined this
+        # push; frames without one draw at send time.
+        if seq is None:
+            seq = self._next_seq(sidx)
         if len(enc) == 1:
             wk, spec, raw = enc[0]
             self._rpc_server(sidx, b"P",
-                             dict(spec, key=wk,
-                                  seq=self._next_seq(sidx),
+                             dict(spec, key=wk, seq=seq,
                                   cid=self._client_id),
                              raw)
             return
         self._rpc_server(sidx, b"p",
                          {"keys": [e[0] for e in enc],
                           "specs": [e[1] for e in enc],
-                          "seq": self._next_seq(sidx),
+                          "seq": seq,
                           "cid": self._client_id},
                          b"".join(e[2] for e in enc))
 
@@ -2019,6 +2069,8 @@ class KVStoreDistAsync:
 # implementation, one behavior (kvstore.py)
 from .kvstore import KVStore as _KVStoreBase
 KVStoreDistAsync._pair = staticmethod(_KVStoreBase._pair)  # type: ignore
+KVStoreDistAsync._norm_priorities = \
+    staticmethod(_KVStoreBase._norm_priorities)      # type: ignore
 KVStoreDistAsync.pushpull = _KVStoreBase.pushpull    # type: ignore
 
 

@@ -367,7 +367,12 @@ def test_pinned_wrapper_refreshes_markers(tmp_path, monkeypatch):
     marker = cache._pin_path(key)
     old = time.time() - cc.PIN_TTL_S - 60
     os.utime(marker, (old, old))            # pretend 24h passed
-    fn._pin_refresh_t = 0.0                 # ...for the wrapper clock
+    # ...for the wrapper clock too.  Relative to monotonic NOW, not an
+    # absolute 0.0: time.monotonic() is boot-relative, so on a machine
+    # up for less than _PIN_REFRESH_S (3h) a zeroed stamp would read as
+    # "recently refreshed" and the wrapper would legitimately skip the
+    # re-touch (this test used to fail on freshly booted CI containers)
+    fn._pin_refresh_t = time.monotonic() - cc.PIN_TTL_S
     fn(X)                                   # memo hit still refreshes
     assert time.time() - os.path.getmtime(marker) < 60
     assert key in cache._disk_pins()

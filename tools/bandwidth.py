@@ -30,7 +30,15 @@ def main(argv=None):
                     help="measure KVStoreICI push of KEYS small gradients "
                          "— fused bucket collectives vs per-key (run "
                          "under tools/launch.py with >= 2 processes)")
+    ap.add_argument("--compression", action="store_true",
+                    help="report per-ctype compressed-vs-raw wire bytes "
+                         "and effective compression ratio through the "
+                         "kvstore encoders (single process, no job "
+                         "needed — the offline EQuARX-win measurement)")
     args = ap.parse_args(argv)
+
+    if args.compression:
+        return _compression_mode(args.sizes)
 
     if args.kvstore:
         return _kvstore_mode(args.kvstore, args.iters)
@@ -92,6 +100,55 @@ def main(argv=None):
         results.append(row)
         print(f"{mb:8.1f} MB  " + "  ".join(
             f"{k}={row[k]:7.2f} GB/s" for k in ops))
+    return 0
+
+
+def _compression_mode(sizes_mb) -> int:
+    """Run each gradient codec over synthetic gradients through BOTH
+    kvstore encoders — the dist_async wire codec (``_encode_entry``,
+    what a PS push sends) and the ICI packed-collective payload
+    (``_reduce_flat_compressed``'s quantizers) — and report compressed
+    vs raw bytes plus the effective ratio per ctype.  Measures the
+    EQuARX wire win offline, without launching a training job; the
+    same numbers accumulate at runtime in
+    ``mxnet_kv_{raw,compressed}_bytes_total``."""
+    import numpy as onp
+    os.environ.setdefault("JAX_PLATFORMS", "cpu")
+    from mxnet_tpu import kvstore as kvs
+    from mxnet_tpu.kvstore_async import KVStoreDistAsync
+
+    enc = KVStoreDistAsync.__new__(KVStoreDistAsync)   # encoder only:
+    enc._residuals = {}                                # no job env
+    enc.push_wire_bytes = 0
+    rng = onp.random.RandomState(0)
+    print(f"{'size':>8}  {'ctype':<6} {'raw':>12}  {'ps_wire':>12} "
+          f"{'ratio':>6}   {'ici_wire':>12} {'ratio':>6}")
+    for mb in sizes_mb:
+        n = max(1, int(mb * 1e6 / 4))
+        g = rng.normal(0, 0.01, n).astype(onp.float32)
+        raw = g.nbytes
+        for ctype in ("none", "fp16", "bf16", "int8", "2bit"):
+            enc._compression = {} if ctype == "none" else \
+                {"type": ctype, "threshold": 0.01}
+            spec, payload = enc._encode_entry(f"g{mb}", g)
+            ps_bytes = len(payload)
+            enc._residuals.clear()
+            # ICI packed-collective payload for the same flat gradient
+            if ctype == "none":
+                ici_bytes = raw
+            elif ctype in ("fp16", "bf16"):
+                ici_bytes = n * 2
+            elif ctype == "int8":
+                import jax.numpy as jnp
+                codes, scales, _ = kvs._quantize_int8(jnp.asarray(g))
+                ici_bytes = int(codes.size) + int(scales.size) * 4
+            else:
+                import jax.numpy as jnp
+                packed, _ = kvs._quantize_2bit(jnp.asarray(g), 0.01)
+                ici_bytes = int(packed.size)
+            print(f"{mb:6.1f}MB  {ctype:<6} {raw:>12}  {ps_bytes:>12} "
+                  f"{raw / ps_bytes:>5.1f}x   {ici_bytes:>12} "
+                  f"{raw / ici_bytes:>5.1f}x")
     return 0
 
 

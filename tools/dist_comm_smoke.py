@@ -1,0 +1,165 @@
+"""Overlapped-collectives acceptance smoke (ci/run.sh dist-comm-smoke,
+in tier-1).
+
+Bounded (~60s) proof of the ISSUE-14 contract on a CALIBRATED
+SYNTHETIC-SLOW WIRE (``MXNET_KV_SYNTH_WIRE_GBPS``: every kvstore push
+blocks until its payload is materialized — what any real wire must do —
+then charges raw_bytes/rate of transmission time):
+
+1. **overlap**: with the bucketed comm-thread scheduler on
+   (``MXNET_KV_OVERLAP=1``, the default), steps/sec reaches >= 1.3x
+   the serialized push-all/pull-all path on a wire calibrated so comm
+   time ~ per-step compute — step time approaches max(compute, comm)
+   instead of their sum.  The workload is update-heavy (16 adam
+   parameters of 4 MB, a cheap scalar loss) because the optimizer
+   update is exactly the compute the per-bucket wait frees the
+   schedule to hide wire under.  Wall clocks take the min of two runs
+   per leg (this rig's host-load swings are +/-25-40%), and the whole
+   wire calibration gets one retry on a miss; the deterministic gates
+   below are never retried.
+2. **losses bit-identical** for the lossless ctype (none): the
+   overlapped run's per-step losses equal the serialized run's exactly
+   — only the schedule moved, never the math.
+3. **replay-identical for 2bit**: two overlapped runs under 2-bit
+   error-feedback compression produce bit-identical loss sequences —
+   bucket composition is fixed by registration order, so the per-key
+   residuals are deterministic under scheduling.
+4. **steady state**: 0 XLA compiles after warmup across the timed
+   overlapped windows.
+
+Exit code 0 = all assertions held.
+"""
+import os
+import sys
+import time
+
+sys.path.insert(0, os.path.dirname(os.path.dirname(os.path.abspath(__file__))))
+
+N_PARAMS = 16
+PARAM_ELEMS = 1024 * 1024            # 4 MB f32 each
+BUCKET_BYTES = 8 * 1024 * 1024       # 2 params per bucket -> 8 buckets
+STEPS = 6
+WARM = 3
+
+
+def _params(seed=0):
+    import mxnet_tpu as mx
+    mx.random.seed(seed)
+    ps = {}
+    for j in range(N_PARAMS):
+        p = mx.gluon.Parameter(f"w{j}", shape=(PARAM_ELEMS,))
+        p.initialize()
+        ps[f"w{j}"] = p
+    return ps
+
+
+def _run(steps=STEPS, compression=None, seed=0):
+    """One fresh training leg; returns (timed wall seconds, per-step
+    loss bytes).  The loss reads a tiny slice of every parameter, so
+    backward is cheap while the adam update sweeps the full 64 MB —
+    the update-dominated regime the scheduler hides wire under."""
+    import mxnet_tpu as mx
+    from mxnet_tpu import metrics
+    from mxnet_tpu.ndarray import ops
+    ps = _params(seed)
+    tr = mx.gluon.Trainer(ps, "adam", {"learning_rate": 1e-3},
+                          compression_params=compression)
+    losses = []
+    t0 = c0 = None
+    for s in range(WARM + steps):
+        if s == WARM:
+            # warmup compiled this fresh trainer's programs; the timed
+            # window must see none
+            mx.waitall()
+            c0 = metrics.value("mxnet_compile_misses_total")
+            t0 = time.perf_counter()
+        with mx.autograd.record():
+            loss = ops.add_n(
+                *[p.data()[:256] for p in ps.values()]).mean()
+        loss.backward()
+        tr.step(1)
+        if s >= WARM:
+            losses.append(loss.asnumpy().tobytes())
+    mx.waitall()
+    wall = time.perf_counter() - t0
+    return wall, losses,         metrics.value("mxnet_compile_misses_total") - c0
+
+
+def main() -> None:
+    import jax
+    jax.config.update("jax_platforms", "cpu")
+    from mxnet_tpu import metrics
+
+    os.environ["MXNET_KV_BUCKET_BYTES"] = str(BUCKET_BYTES)
+    push_bytes = N_PARAMS * PARAM_ELEMS * 4
+
+    failures = []
+    ratio = serial_s = overlap_s = wire_ms = 0.0
+    compiles = 0.0
+    for attempt in range(2):
+        # -- calibrate the wire to ~0.8x the compute-only step: comm
+        # comparable to compute, the regime the scheduler exists for ---
+        os.environ["MXNET_KV_OVERLAP"] = "0"
+        os.environ["MXNET_KV_SYNTH_WIRE_GBPS"] = "0"
+        t_nowire, _, _ = _run()
+        step_s = max(t_nowire / STEPS, 0.004)
+        wire_ms = 0.8 * step_s * 1e3
+        os.environ["MXNET_KV_SYNTH_WIRE_GBPS"] = \
+            f"{push_bytes / (0.8 * step_s * 1e9):.9f}"
+
+        # -- serialized vs overlapped on the same slow wire (min of 2) ------
+        s1, losses_serial, _ = _run()
+        s2, _, _ = _run()
+        serial_s = min(s1, s2)
+        os.environ["MXNET_KV_OVERLAP"] = "1"
+        o1, losses_overlap, comp1 = _run()
+        o2, _, comp2 = _run()
+        compiles = comp1 + comp2
+        overlap_s = min(o1, o2)
+        ratio = serial_s / overlap_s if overlap_s > 0 else float("inf")
+        if ratio >= 1.3:
+            break
+        print(f"attempt {attempt}: ratio {ratio:.2f}x < 1.3x "
+              f"(serial {serial_s:.2f}s, overlapped {overlap_s:.2f}s) "
+              "— recalibrating once", flush=True)
+    if ratio < 1.3:
+        failures.append(
+            f"overlapped speedup {ratio:.2f}x < 1.3x on the calibrated "
+            f"slow wire (serial {serial_s:.2f}s vs overlapped "
+            f"{overlap_s:.2f}s for {STEPS} steps)")
+
+    # losses bit-identical: same seed, same math — only scheduling moved
+    if losses_serial != losses_overlap:
+        failures.append("overlapped losses diverged from serialized "
+                        "(lossless ctype must be bit-identical)")
+
+    # deterministic gate: steady-state compiles across the overlapped
+    # timed windows (the two legs share every program shape)
+    if compiles != 0:
+        failures.append(f"{compiles:.0f} XLA compiles after warmup in "
+                        "the overlapped windows (want 0)")
+
+    # 2bit error-feedback replay determinism under scheduling
+    _, l2a, _ = _run(steps=4, compression={"type": "2bit",
+                                           "threshold": 1e-4}, seed=1)
+    _, l2b, _ = _run(steps=4, compression={"type": "2bit",
+                                           "threshold": 1e-4}, seed=1)
+    if l2a != l2b:
+        failures.append("2bit overlapped replay diverged (per-key "
+                        "residuals must be deterministic under the "
+                        "scheduler)")
+
+    os.environ["MXNET_KV_SYNTH_WIRE_GBPS"] = "0"
+    overlap_frac = metrics.value("mxnet_kv_overlap_fraction")
+    buckets = metrics.value("mxnet_kv_buckets_total")
+    print(f"dist-comm-smoke: {ratio:.2f}x steps/sec overlapped vs "
+          f"serialized (wire {wire_ms:.0f}ms/step, {buckets:.0f} "
+          f"buckets total, last-round overlap fraction "
+          f"{overlap_frac:.2f}), loss parity bit-exact, 2bit replay "
+          f"identical, {compiles:.0f} compiles after warmup")
+    if failures:
+        raise SystemExit("dist-comm-smoke FAILED: " + "; ".join(failures))
+
+
+if __name__ == "__main__":
+    main()
