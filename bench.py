@@ -29,7 +29,10 @@ MXNET_BENCH_EAGER=1 (lstm/gpt only: run the NON-hybridized per-op
 dispatch path through the lazy bulking engine — pair with
 MXNET_BULK_MAX_OPS to compare bulked vs per-op dispatch), and
 MXNET_BENCH_MODEL=bulk_smoke (the CI acceptance micro-run: >=1.3x
-dispatch reduction + steady segment cache + loss parity).
+dispatch reduction + steady segment cache + loss parity), and
+MXNET_BENCH_MODEL=dist_comm (overlapped-collectives ratios on the
+calibrated synthetic wire: serialized vs optimizer-phase overlap vs
+backward-streamed overlap, trended against recorded ROUND_BASELINES).
 """
 import json
 import os
@@ -60,6 +63,16 @@ ROUND_BASELINES = {
     # the headline config (r5 plateau midpoint, BENCH_r02-r05): recorded
     # so bench.py --check can trend the metric of record too
     "resnet50_v1_bfloat16_b128_train_throughput": 2450.0,
+    # overlapped-collectives ratios on the calibrated synthetic wire,
+    # measured ad hoc on this rig (2026-08-04, MXNET_BENCH_MODEL=
+    # dist_comm: optimizer-phase 1.47x with streaming pinned off,
+    # backward-streamed 1.50-1.64x) — no checked-in BENCH round carries
+    # them yet; the next recorded bench round lands them in its JSON.
+    # PR 14 measured 1.35-1.67x for the optimizer-phase overlap but
+    # never recorded it; these are RATIOS against a per-run-calibrated
+    # wire, so rig noise largely divides out (~±15%).
+    "dist_comm_overlap_ratio": 1.5,
+    "dist_comm_backward_overlap_ratio": 1.6,
 }
 
 # Wall-clock numbers on this rig swing ±25-40% run-to-run (documented
@@ -171,6 +184,47 @@ def bench_gen_serving() -> None:
                                         float(ttft)),
             "ttft_ms_p95": rep["ttft_ms_p95"],
         }), flush=True)
+
+
+def bench_dist_comm() -> None:
+    """Config 8 (ISSUE 15 satellite): the overlapped-vs-serialized
+    steps/sec ratios from the dist-comm smoke's calibrated synthetic
+    wire, landed as round-JSON metric lines — PR 14 measured 1.35-1.67x
+    but never recorded it, so future rounds trend both the
+    optimizer-phase overlap (PR 14) and the backward-streaming overlap
+    (ISSUE 15) against recorded baselines.  Ratios, not wall clocks:
+    the wire is calibrated per run, so they are far less rig-noise
+    sensitive than absolute throughput."""
+    sys.path.insert(0, os.path.join(os.path.dirname(
+        os.path.abspath(__file__)), "tools"))
+    import dist_comm_smoke as dcs
+    failures = []
+
+    # the PR-14 update-heavy leg: serialized vs optimizer-phase
+    # overlap, streaming + segmentation pinned off inside the shared
+    # helper so this ratio isolates the PR-14 scheduler
+    opt = dcs.optimizer_leg_ratio()
+    print(json.dumps({
+        "metric": "dist_comm_overlap_ratio",
+        "value": round(opt["ratio"], 3), "unit": "x vs serialized",
+        "vs_baseline": _vs_baseline("dist_comm_overlap_ratio",
+                                    opt["ratio"]),
+        "wire_ms_per_step": round(opt["wire_ms"], 1),
+    }), flush=True)
+
+    # the ISSUE-15 backward-streaming leg (its own calibration + the
+    # optimizer-only comparison ride along in the report)
+    rep = dcs.backward_leg(failures)
+    print(json.dumps({
+        "metric": "dist_comm_backward_overlap_ratio",
+        "value": round(rep.get("ratio", 0.0), 3),
+        "unit": "x vs serialized",
+        "vs_baseline": _vs_baseline("dist_comm_backward_overlap_ratio",
+                                    rep.get("ratio", 0.0)),
+        "optimizer_only_ratio": round(rep.get("opt_ratio", 0.0), 3),
+        "wire_ms_per_step": round(rep.get("wire_ms", 0.0), 1),
+        "gates_failed": failures,
+    }), flush=True)
 
 
 def _check_input_pipeline(failures) -> dict:
@@ -1114,6 +1168,8 @@ def main() -> None:
         return bench_bulk_smoke()
     if model_name == "gen":
         return bench_gen_serving()
+    if model_name == "dist_comm":
+        return bench_dist_comm()
     eager = os.environ.get("MXNET_BENCH_EAGER", "0") == "1"
     if eager and model_name.startswith("lstm"):
         if "MXNET_BENCH_BATCH" not in os.environ:

@@ -212,6 +212,40 @@ def backward_arrays(heads: Sequence[Any],
 
     order = _toposort(heads)
 
+    # Incremental leaf finalization (leaf-write mode only): count the
+    # remaining tape uses of every attached leaf so its gradient can be
+    # written — and its grad-ready hook fired — the moment the LAST
+    # node consuming it has contributed, instead of after the whole
+    # walk.  With per-layer backward segmentation
+    # (MXNET_BULK_BACKWARD_SEGMENTS=param) the tape is a chain of
+    # per-layer fused nodes walked heads-first, so parameter gradients
+    # finalize in reverse registration order WHILE later pullbacks are
+    # still dispatching — the window the overlapped kvstore scheduler's
+    # event-driven enqueue (Parameter._grad_ready_cb -> Round.offer)
+    # streams reduction buckets into.  The written value is identical
+    # to the end-of-walk write: zero remaining uses means no further
+    # cotangent can accumulate.
+    # Error-path caveat: a pullback raising MID-walk now leaves the
+    # already-finalized leaves written (and their hooks fired), where
+    # the end-of-walk write left none — the tape is equally consumed
+    # either way (retry requires a fresh forward+backward), but
+    # grad_req='add' users retrying after a mid-backward error should
+    # zero_grad first to avoid double-accumulating the partial walk.
+    leaf_uses: dict = {}
+    if variables is None:
+        for node in order:
+            for x in node.inputs:
+                if x._grad_req != "null":
+                    leaf_uses[id(x)] = leaf_uses.get(id(x), 0) + 1
+    written: set = set()
+
+    def _finalize_leaf(x: Any) -> None:
+        written.add(id(x))
+        x._write_grad(cots.get(id(x)))
+        cb = getattr(x, "_grad_ready_cb", None)
+        if cb is not None:
+            cb(x)
+
     # Map node -> the output NDArrays it produced. Outputs hold a reference
     # to their node; we need the reverse to gather cotangents, so each
     # NDArray carries (_ag_node, _ag_out_idx) and nodes carry weak output
@@ -271,6 +305,13 @@ def backward_arrays(heads: Sequence[Any],
             if c is None:
                 continue
             _add_cot(x, c)
+        if variables is None:
+            for x in node.inputs:
+                if x._grad_req != "null":
+                    n = leaf_uses[id(x)] - 1
+                    leaf_uses[id(x)] = n
+                    if n == 0 and id(x) not in written:
+                        _finalize_leaf(x)
 
     if variables is not None:
         result = []
@@ -283,16 +324,11 @@ def backward_arrays(heads: Sequence[Any],
             result.append(c)
         return result
 
-    # Write into attached leaves — only after ALL nodes have contributed,
-    # since a leaf feeding several ops accumulates across them.
-    leaves: dict = {}
-    for node in order:
-        for x in node.inputs:
-            if x._grad_req != "null":
-                leaves[id(x)] = x
-    for h in heads:  # a head can itself be an attached leaf
-        if h._grad_req != "null":
-            leaves.setdefault(id(h), h)
-    for x in leaves.values():
-        x._write_grad(cots.get(id(x)))
+    # Every node-input leaf was finalized incrementally above (its use
+    # count reached zero when its last consumer contributed); what
+    # remains is a head that is itself an attached leaf feeding no
+    # node — its gradient is just the accumulated seed.
+    for h in heads:
+        if h._grad_req != "null" and id(h) not in written:
+            _finalize_leaf(h)
     return None

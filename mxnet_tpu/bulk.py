@@ -73,7 +73,7 @@ from .base import MXNetError, getenv, register_env
 
 __all__ = ["PendingBuffer", "NOT_BULKED", "active", "max_ops",
            "set_max_ops", "flush_all", "flush_current", "flush_holding",
-           "flush_recorded", "bulk_stats",
+           "flush_recorded", "backward_segments_mode", "bulk_stats",
            "reset_caches"]
 
 register_env("MXNET_BULK_MAX_OPS", 16,
@@ -88,6 +88,25 @@ register_env("MXNET_BULK_AUTOGRAD", "fused",
              "(default) keeps recorded ops bulked and differentiates the "
              "whole segment with one jax.vjp (one fused TapeNode); 'off' "
              "forces per-op dispatch while recording.")
+register_env("MXNET_BULK_BACKWARD_SEGMENTS", "param",
+             "Backward granularity of fused-autograd bulking: 'param' "
+             "(default) closes the recorded segment whenever the op "
+             "stream crosses a parameter boundary (a recorded op "
+             "consuming a fresh attach_grad leaf) once the segment has "
+             "captured at least MXNET_KV_BUCKET_BYTES of parameter "
+             "bytes (the coalescing floor: layers smaller than one "
+             "reduction bucket share a segment, so tiny models keep one "
+             "fused backward and deep models cannot blow the segment "
+             "cache).  The resulting chain of per-layer TapeNodes "
+             "replays backward layer-by-layer in reverse, so parameter "
+             "gradients materialize incrementally and the overlapped "
+             "kvstore scheduler can stream reduction buckets DURING "
+             "backward instead of only under optimizer compute.  'off' "
+             "keeps the whole recorded run as one fused segment "
+             "(pre-segmentation behavior).  Re-cut segments move XLA "
+             "fusion (FMA) boundaries: losses match the monolithic "
+             "backward to float ulp, replays of the same mode are "
+             "bit-identical (see docs/performance.md).")
 
 # runtime-settable copies of the env knobs (env read once, lazily)
 _state: Dict[str, Any] = {"max_ops": None, "autograd": None}
@@ -126,6 +145,28 @@ def _autograd_mode() -> str:
     if m is None:
         m = _state["autograd"] = getenv("MXNET_BULK_AUTOGRAD", "fused")
     return m
+
+
+def backward_segments_mode() -> str:
+    """'param' cuts recorded segments at parameter boundaries (subject
+    to the coalescing floor), 'off' keeps one fused backward segment.
+    Read live (not cached like max_ops): the dist-comm smoke and tests
+    A/B the modes within one process."""
+    m = getenv("MXNET_BULK_BACKWARD_SEGMENTS", "param")
+    return m if m in ("param", "off") else "param"
+
+
+def _segment_floor_bytes() -> int:
+    """The coalescing floor for param-boundary cuts: segments keep
+    absorbing layers until they hold one reduction bucket's worth of
+    parameter bytes (MXNET_KV_BUCKET_BYTES), so per-layer cutting on a
+    deep model of small layers neither blows the segment LRU nor
+    recompiles per step — the segment grid stays O(model_bytes /
+    bucket_bytes)."""
+    try:
+        return max(1, int(getenv("MXNET_KV_BUCKET_BYTES", 4 << 20)))
+    except (TypeError, ValueError):
+        return 4 << 20
 
 
 def active() -> bool:
@@ -212,7 +253,8 @@ class Segment:
     ``lock``."""
 
     __slots__ = ("nodes", "ext", "ext_wrappers", "ext_ids", "flushed",
-                 "lock", "error", "__weakref__")
+                 "lock", "error", "leaf_ids", "param_bytes", "n_tainted",
+                 "bwd_mode", "bwd_floor", "__weakref__")
 
     def __init__(self) -> None:
         self.nodes: List[_Node] = []
@@ -222,6 +264,19 @@ class Segment:
         self.flushed = False
         self.lock = threading.RLock()
         self.error: Optional[str] = None
+        # backward segmentation bookkeeping: which attach_grad leaves
+        # (parameters) this segment captured, and their raw byte total —
+        # the param-boundary cut in try_append fires only once
+        # param_bytes clears the coalescing floor
+        self.leaf_ids: set = set()
+        self.param_bytes = 0
+        self.n_tainted = 0                  # recorded nodes appended
+        # segmentation knobs resolved lazily, ONCE per segment (a
+        # per-op env read would tax the whole dispatch hot path; a
+        # segment's mode must not flip mid-build anyway, and tests
+        # that monkeypatch the env get fresh segments constantly)
+        self.bwd_mode: Optional[str] = None
+        self.bwd_floor = 0
         with _REG_LOCK:
             _LIVE_SEGMENTS[id(self)] = self
 
@@ -238,6 +293,14 @@ class Segment:
             self.ext_ids[key] = idx
             self.ext.append(raw)
             self.ext_wrappers.append(wrapper)
+            if getattr(wrapper, "_grad_req", "null") != "null" and \
+                    id(wrapper) not in self.leaf_ids:
+                self.leaf_ids.add(id(wrapper))
+                try:
+                    self.param_bytes += int(raw.size) * int(
+                        getattr(raw.dtype, "itemsize", 4))
+                except Exception:   # noqa: BLE001 - sizeless capture
+                    pass
         return idx
 
     # -- flush ---------------------------------------------------------
@@ -633,6 +696,34 @@ def try_append(name: str, impl: Callable, token: Any,
             seg.flush("autograd")
             return try_append(name, impl, token, inputs, ctx)
 
+        # per-layer backward segmentation (MXNET_BULK_BACKWARD_SEGMENTS
+        # =param): a recorded op consuming a FRESH attach_grad leaf (a
+        # parameter this segment has not captured) marks a layer
+        # boundary.  Once the segment holds a reduction bucket's worth
+        # of parameter bytes (the coalescing floor), close it — the
+        # fused vjp chain then replays backward layer-by-layer in
+        # reverse, each sub-segment's parameter gradients materialize
+        # individually, and the overlapped kvstore scheduler streams
+        # their buckets while the rest of backward still runs.
+        if seg.n_tainted:
+            mode = seg.bwd_mode
+            if mode is None:
+                mode = seg.bwd_mode = backward_segments_mode()
+                seg.bwd_floor = _segment_floor_bytes()
+            if mode == "param":
+                fresh = any(
+                    d[0] == "e"
+                    and getattr(d[1], "_grad_req", "null") != "null"
+                    and id(d[1]) not in seg.leaf_ids
+                    for d in resolved)
+                if fresh:
+                    if seg.param_bytes >= seg.bwd_floor:
+                        _metrics.inc_backward_segment("param_boundary")
+                        seg.flush("param_boundary")
+                        return try_append(name, impl, token, inputs,
+                                          ctx)
+                    _metrics.inc_backward_segment("coalesced")
+
     got = _out_avals(name, impl, token, in_sds)
     if got is _AVAL_BAD:
         _flush_pending_inputs(inputs, "unjittable")
@@ -672,6 +763,8 @@ def try_append(name: str, impl: Callable, token: Any,
         node = _Node(name, impl, token, ins, single, out_sds, tainted,
                      ctx=ctx)
         seg.nodes.append(node)
+        if tainted:
+            seg.n_tainted += 1
         ni = len(seg.nodes) - 1
         wrapped = []
         for oi, sds in enumerate(out_sds):

@@ -88,6 +88,7 @@ class Parameter:
         self._deferred_init: Optional[tuple] = None  # (init, ctx, default_init)
         # attribute path set by Block registration, e.g. "dense0.weight"
         self._uuid = name
+        self._grad_ready_cb: Optional[Callable] = None
 
     # ------------------------------------------------------------------
     @property
@@ -227,6 +228,19 @@ class Parameter:
                 self._data.attach_grad(self._grad_req)
         else:
             self._data._data = nd._data  # keep NDArray identity (grad stays)
+
+    def set_grad_ready_cb(self, cb: Optional[Callable]) -> None:
+        """Install (or clear, with ``None``) this parameter's grad-ready
+        hook: ``backward()`` calls ``cb(data_ndarray)`` the moment this
+        parameter's gradient has received its final contribution —
+        while later pullbacks of the same backward are still running.
+        The gluon ``Trainer`` uses it to submit gradients to the
+        overlapped kvstore scheduler DURING backward (per-layer
+        streaming); re-installed every step, so parameter re-binds
+        (``reset_ctx``/``cast``) self-heal at the next arm."""
+        self._grad_ready_cb = cb
+        if self._data is not None:
+            self._data._grad_ready_cb = cb
 
     def zero_grad(self) -> None:
         if self._data is not None and self._data.grad is not None:

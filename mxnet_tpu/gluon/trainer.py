@@ -13,6 +13,7 @@ from __future__ import annotations
 
 import io
 import pickle
+import weakref
 from typing import Any, Dict, List, Optional, Sequence, Union
 
 from ..base import MXNetError
@@ -64,6 +65,16 @@ class Trainer:
         self._kvstore = None
         self._kv_initialized = False
         self._scale = 1.0
+        # event-driven gradient streaming (per-layer backward overlap):
+        # the round armed for the NEXT step, its planned grad wrappers,
+        # the per-key staging buffers reduced values land in, and the
+        # dirty latch a second backward-before-step trips
+        self._stream_round = None
+        self._stream_vals: Dict[int, Any] = {}
+        self._stream_bufs: Dict[int, Any] = {}
+        self._stream_staging: Dict[int, NDArray] = {}
+        self._stream_dirty = False
+        self._stream_cbs_installed = False
 
     # -- kvstore ------------------------------------------------------------
     def _init_kvstore(self) -> None:
@@ -216,8 +227,11 @@ class Trainer:
             # contract — drain the round before handing grads back
             self._sched_round = None
             try:
+                streamed = getattr(rnd, "_streaming", False)
                 for b in rnd.buckets:
                     rnd.wait(b)
+                    if streamed:
+                        self._absorb_streamed(b)
             except BaseException:
                 rnd.abort()
                 raise
@@ -277,8 +291,17 @@ class Trainer:
         # the parameters the next forward consumes first reduce first
         prios = [-i for i in keys]
         if self._overlap_enabled():
+            # an armed streaming round (grad-ready hooks fed it during
+            # backward) becomes this step's scheduled round; a dirty or
+            # mismatched one is discarded and re-reduced fresh
+            if not self._update_on_kvstore and \
+                    self._consume_stream(keys, grads):
+                return
             self._allreduce_scheduled(keys, grads, prios)
             return
+        # overlap got disabled between arming and this step: any armed
+        # round only ever touched staging — drop it before serializing
+        self._discard_stream()
         # serialized path: one batched push (KVStoreICI fuses the small
         # gradients into bucket collectives instead of one per param),
         # then one batched pull — wire time adds to step time
@@ -349,6 +372,181 @@ class Trainer:
         self._sched_round = _ks.submit(
             keys, grads, prios, reduce_fn,
             strict_order=self._strict_collective_order())
+
+    # -- event-driven streaming (per-layer backward overlap) ----------------
+    def _stream_enabled(self) -> bool:
+        """The grad-ready streaming path (ISSUE 15): engages exactly
+        where the scheduled worker-side path would, minus the cases
+        whose contracts it cannot keep — server-side updates apply the
+        optimizer AT push (a streamed push is an uncancellable training
+        update, so a second backward before step would corrupt it),
+        strict-order collective stores need rank-identical dispatch
+        sequences (seal order is readiness timing), gradient
+        compression mutates per-key error-feedback residuals AT push
+        (a dirty round's discarded pushes would leave the residuals
+        advanced, and the fallback re-reduction would compress the
+        same keys twice in one step — compressed trainers keep the
+        step-time submission, where every key compresses exactly
+        once), and armed fault plans corrupt gradients at the
+        trainer.step site, which must happen BEFORE anything reaches
+        the wire."""
+        from ..base import getenv
+        from .. import faults as _faults
+        return (self._overlap_enabled()
+                and not self._update_on_kvstore
+                and not self._strict_collective_order()
+                and not self._compression_params
+                and not getattr(self._kvstore, "_compression", None)
+                and int(getenv("MXNET_KV_BACKWARD_STREAM", 1)) != 0
+                and not _faults._ARMED)
+
+    def _arm_stream(self) -> None:
+        """Open next step's streaming round and install the grad-ready
+        hooks: backward will ``Round.offer`` each parameter as its
+        gradient finalizes, sealing and dispatching reduction buckets
+        while the rest of backward still runs.  Re-armed every step —
+        cheap (one pass over the params), and it self-heals across
+        parameter re-binds, env flips, and fault-plan arming."""
+        stale, self._stream_round = self._stream_round, None
+        if stale is not None:
+            # a skipped/aborted step never consumed its round; sealed
+            # buckets only ever reduced into staging, so discarding is
+            # free of user-visible effects
+            stale.abort()
+        self._stream_dirty = False
+        if not self._stream_enabled():
+            if self._stream_cbs_installed:
+                for p in self._params:
+                    p.set_grad_ready_cb(None)
+                self._stream_cbs_installed = False
+            return
+        keys, vals, prios = [], [], []
+        for i, p in enumerate(self._params):
+            if p.grad_req == "null" or not p.is_initialized:
+                continue
+            if getattr(p, "grad_stype", "default") != "default":
+                continue   # row-sparse grads never join dense rounds
+            g = p.data().grad
+            if g is None or getattr(g, "stype", "default") != "default":
+                continue
+            keys.append(i)
+            vals.append(g)
+            prios.append(-i)
+        if not keys:
+            return
+        import jax.numpy as jnp
+        staging = self._stream_staging
+        for i in keys:
+            if i not in staging:
+                # a shell for kvstore.pull to rebind — never read until
+                # the pull of its bucket landed
+                staging[i] = NDArray(jnp.zeros((1,), "float32"),
+                                     _wrap=True)
+        wself = weakref.ref(self)
+
+        def reduce_fn(bucket):
+            tr = wself()
+            if tr is None:
+                raise MXNetError(
+                    "trainer was garbage-collected with a streaming "
+                    "gradient-reduction round in flight")
+            tr._push_with_recovery(bucket.keys, bucket.vals,
+                                   bucket.priority)
+            tr._kvstore.pull(
+                bucket.keys,
+                out=[tr._stream_staging[k] for k in bucket.keys])
+
+        from .. import kvstore_sched as _ks
+        self._stream_round = _ks.open_round(keys, vals, prios, reduce_fn)
+        self._stream_vals = dict(zip(keys, vals))
+        self._stream_bufs = {}
+
+        def make_cb(k):
+            def _cb(_arr):
+                tr = wself()
+                if tr is not None:
+                    tr._stream_offer(k)
+            return _cb
+
+        keyset = set(keys)
+        for i, p in enumerate(self._params):
+            p.set_grad_ready_cb(make_cb(i) if i in keyset else None)
+        self._stream_cbs_installed = True
+
+    def _stream_offer(self, key: int) -> None:
+        """The grad-ready hook body (fires inside backward)."""
+        rnd = self._stream_round
+        if rnd is None:
+            return
+        p = self._params[key]
+        cur = p._data._grad if p._data is not None else None
+        if cur is not self._stream_vals.get(key):
+            # the grad wrapper was rebound since arming (a row_sparse
+            # cotangent materialized, attach_grad re-ran): the planned
+            # value is stale — poison the round, step re-reduces fresh
+            self._stream_dirty = True
+            return
+        if not rnd.offer(key):
+            self._stream_dirty = True
+            return
+        # snapshot the grad's raw buffer: the value that streams is the
+        # one backward wrote, and any later rebind (user clipping/
+        # scaling between backward and step, zero_grad) must invalidate
+        # the round or the modification would be silently discarded
+        self._stream_bufs[key] = cur._buf
+
+    def _discard_stream(self) -> None:
+        """Drop an armed streaming round (never raising): sealed
+        buckets only ever reduced into staging, so there is nothing to
+        undo."""
+        rnd, self._stream_round = self._stream_round, None
+        if rnd is not None:
+            rnd.abort()
+        self._stream_dirty = False
+
+    def _consume_stream(self, keys, grads) -> bool:
+        """At step time: adopt the armed streaming round as this step's
+        ``_sched_round`` when it is still sound — otherwise discard it
+        (sealed buckets only touched staging) and let the caller run a
+        fresh post-backward reduction of the accumulated gradients."""
+        rnd, self._stream_round = self._stream_round, None
+        if rnd is None:
+            return False
+        dirty, self._stream_dirty = self._stream_dirty, False
+        if dirty or self._update_on_kvstore:
+            rnd.abort()
+            return False
+        actual = set(keys)
+        if not actual <= set(rnd.planned_keys):
+            rnd.abort()   # a parameter initialized after arming
+            return False
+        for k, g in zip(keys, grads):
+            if self._stream_vals.get(k) is not g:
+                rnd.abort()
+                return False
+            buf = self._stream_bufs.get(k)
+            if buf is not None and g._buf is not buf:
+                # the grad VALUE was rebound after it streamed (user
+                # clipped/scaled it between backward and step): the
+                # wire carries the pre-modification value — discard
+                # and re-reduce the current gradients
+                rnd.abort()
+                return False
+        rnd.seal_remaining(actual)
+        self._sched_round = rnd
+        return True
+
+    def _absorb_streamed(self, bucket) -> None:
+        """Move one reduced bucket from staging into the user-visible
+        grad buffers (called after waiting the bucket): after step, a
+        parameter's ``.grad`` holds the reduced gradient exactly as the
+        non-streaming paths leave it."""
+        for k, v in zip(bucket.keys, bucket.vals):
+            p = self._params[k]
+            g = p._data._grad if p._data is not None else None
+            s = self._stream_staging.get(k)
+            if g is v and s is not None:
+                g._data = s._data
 
     def _strict_collective_order(self) -> bool:
         """Multi-process collective stores need every rank to issue the
@@ -421,6 +619,9 @@ class Trainer:
         self._allreduce_grads_async(ignore_stale_grad)
         if not self._update_on_kvstore:
             self._update(ignore_stale_grad)
+        # arm the NEXT step's streaming round: its grad-ready hooks
+        # will stream buckets onto the wire during the next backward
+        self._arm_stream()
 
     def update(self, batch_size: int, ignore_stale_grad: bool = False) -> None:
         """Apply the optimizer without gradient reduction (caller already
@@ -477,8 +678,14 @@ class Trainer:
             self._sched_round = None
             try:
                 done = set()
+                streamed = getattr(rnd, "_streaming", False)
 
                 def chunk(b):
+                    if streamed:
+                        # a streamed bucket reduced into staging —
+                        # land it in the user-visible grad buffers
+                        # before the optimizer reads them
+                        self._absorb_streamed(b)
                     members = set(b.keys)
                     done.update(members)
                     self._update_entries(

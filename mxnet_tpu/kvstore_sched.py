@@ -36,6 +36,18 @@ Design (tpu-first):
   site with ``side=wait``.  All blocking work (collectives, sockets,
   the synthetic wire) happens OUTSIDE the scheduler lock (mxlint
   MX-L001 is a tier-1 gate on this file).
+* **Event-driven streaming (beside the poll)** — :func:`open_round`
+  plans a round whose buckets start un-queued; the gluon Trainer's
+  grad-ready hooks (``Parameter._grad_ready_cb``, fired by backward
+  the moment a parameter's gradient finalizes) ``Round.offer`` keys,
+  and a bucket seals + dispatches when its last key arrives.  With
+  per-layer backward segmentation (``MXNET_BULK_BACKWARD_SEGMENTS=
+  param``) gradients finalize in reverse registration order WHILE
+  backward still runs, so buckets hit the wire during backward itself
+  — the readiness probe then still gates actual dispatch (a sealed
+  bucket whose payload is an in-flight pullback is not popped until
+  it materializes).  ``Round.seal_remaining`` at step time enqueues
+  whatever never streamed.
 * **Per-bucket blocking** — ``Round.wait`` blocks only on one bucket,
   so the optimizer update for a parameter starts as soon as *its*
   bucket arrives while later buckets are still on the wire
@@ -68,7 +80,7 @@ from typing import Any, Callable, Dict, List, Optional, Sequence
 from . import metrics as _metrics
 from .base import MXNetError, getenv, register_env
 
-__all__ = ["Bucket", "Round", "submit", "plan_buckets"]
+__all__ = ["Bucket", "Round", "submit", "open_round", "plan_buckets"]
 
 register_env(
     "MXNET_KV_BUCKET_BYTES", 4 << 20,
@@ -90,6 +102,26 @@ register_env(
     "MXNET_KV_SYNTH_WIRE_GBPS > 0; a single-process local store's "
     "no-op reduction never pays the comm-thread handoff.  0 forces "
     "the serialized push-all/pull-all path everywhere.")
+
+register_env(
+    "MXNET_KV_BACKWARD_STREAM", 1,
+    "Event-driven gradient streaming: 1 (default) lets the gluon "
+    "Trainer open its reduction round BEFORE backward and submit "
+    "buckets from grad-ready hooks as each parameter's gradient "
+    "finalizes, so with per-layer backward segmentation "
+    "(MXNET_BULK_BACKWARD_SEGMENTS=param) wire time hides under "
+    "backward itself, not just under the optimizer update.  Engages "
+    "only where the PR-14 scheduler would (a real wire, worker-side "
+    "updates, non-strict collective order) and never with gradient "
+    "compression — lossy codecs mutate per-key error-feedback "
+    "residuals at push, and a discarded streamed round must be free "
+    "of side effects, so compressed trainers keep the step-time "
+    "submission (optimizer-phase overlap).  Reduced values land in a "
+    "per-key staging buffer and are absorbed at step time, so "
+    "gradients a second backward() accumulates before step() are "
+    "never overwritten mid-flight (such rounds are discarded and "
+    "re-reduced post-backward).  0 keeps the round submission at "
+    "step time (optimizer-phase overlap only).")
 
 register_env(
     "MXNET_KV_SYNTH_WIRE_GBPS", 0.0,
@@ -123,8 +155,25 @@ KV_OVERLAP_FRACTION = _metrics.gauge(
     "busy time), clamped to [0, 1] — the share of communication the "
     "schedule hid under compute.  ~1 means the wire is fully hidden; "
     "~0 means the round ran serialized.")
+KV_PHASE_OVERLAP_FRACTION = _metrics.gauge(
+    "mxnet_kv_phase_overlap_fraction",
+    "The per-round overlap split by WHERE the wire hid, phase="
+    "'backward' (comm-thread busy time that completed before the "
+    "trainer first BLOCKED on the round — i.e. concurrent with "
+    "backward's host walk and device tail; only the event-driven "
+    "streaming path, fed by per-layer backward segmentation, can make "
+    "this nonzero) vs 'optimizer' (comm hidden under the per-bucket "
+    "optimizer updates, the PR-14 overlap).  Fractions of the round's "
+    "total comm time; their sum plus the exposed fraction "
+    "(mxnet_kv_bucket_wait_seconds) is ~1.", labels=("phase",))
+KV_STREAM_ENQUEUES = _metrics.counter(
+    "mxnet_kv_stream_enqueues_total",
+    "Reduction buckets sealed and handed to the comm thread by the "
+    "event-driven path (Round.offer from a grad-ready hook) BEFORE the "
+    "trainer's step consumed the round — buckets whose wire time could "
+    "start under backward itself.")
 
-_QUEUED, _RUNNING, _DONE, _CANCELLED = range(4)
+_QUEUED, _RUNNING, _DONE, _CANCELLED, _PLANNED = range(5)
 
 # polls of an all-unready queue before the scheduler gives up on the
 # readiness probe and head-of-line-blocks on the best bucket anyway (a
@@ -229,7 +278,8 @@ class Round:
     in-flight bucket, re-raises the first unconsumed error, and
     publishes the round's overlap fraction."""
 
-    def __init__(self, buckets: List[Bucket]) -> None:
+    def __init__(self, buckets: List[Bucket],
+                 streaming: bool = False) -> None:
         self.buckets = buckets
         self._by_key: Dict[Any, Bucket] = {}
         for b in buckets:
@@ -237,8 +287,89 @@ class Round:
             for k in b.keys:
                 self._by_key[k] = b
         self.comm_seconds = 0.0     # comm-thread busy time (all buckets)
+        self.comm_backward_seconds = 0.0  # ...accrued during backward
         self.wait_seconds = 0.0     # main-thread exposed stalls
         self._finished = False
+        # streaming (event-driven) rounds: buckets start PLANNED and are
+        # sealed one by one as grad-ready hooks offer their keys — see
+        # open_round
+        self._streaming = streaming
+        self._reduce_fn: Optional[Callable] = None
+        self._prepare_fn: Optional[Callable] = None
+        self._strict = False
+        self._backward_done = not streaming
+        self._pending: Dict[int, set] = {}
+        if streaming:
+            for b in buckets:
+                b.state = _PLANNED
+                self._pending[b.bid] = set(b.keys)
+
+    @property
+    def planned_keys(self) -> List[Any]:
+        return list(self._by_key)
+
+    def mark_backward_end(self) -> None:
+        """The driving thread is about to BLOCK on this round (first
+        ``wait``/``as_completed`` — the consumption phase): comm-thread
+        busy time from here on counts as optimizer-phase.  Everything
+        before ran concurrently with backward's host walk and device
+        tail, i.e. was hidden under backward — the backward-phase
+        share of the overlap-split gauges."""
+        self._backward_done = True
+
+    def offer(self, key: Any) -> bool:
+        """Event-driven enqueue (grad-ready hook -> here): mark ``key``
+        ready; when the last key of its bucket arrives the bucket is
+        sealed — prepare_fn runs on THIS thread, then the bucket joins
+        the comm queue, dispatching while backward still runs.
+
+        Returns False when the key's value may already be on the wire
+        (its bucket was sealed before this offer — a SECOND backward
+        wrote the grad after the first one streamed it); the trainer
+        treats that as a dirty round and falls back to a fresh
+        post-backward reduction of the accumulated gradients."""
+        b = self._by_key.get(key)
+        if b is None:
+            return False
+        pend = self._pending.get(b.bid)
+        if pend is None or key not in pend:
+            # re-offer: benign while the bucket is still unsealed (the
+            # push will read the latest value), dirty once sealed
+            return b.state == _PLANNED
+        pend.discard(key)
+        if not pend:
+            del self._pending[b.bid]
+            self._seal(b, streamed=True)
+        return True
+
+    def _seal(self, bucket: Bucket, streamed: bool = False) -> None:
+        if self._prepare_fn is not None:
+            self._prepare_fn(bucket)
+        if streamed:
+            KV_STREAM_ENQUEUES.inc()
+        _scheduler().enqueue_bucket(bucket, self._reduce_fn,
+                                    self._strict)
+
+    def seal_remaining(self, eligible: Optional[set] = None) -> None:
+        """Enqueue every still-planned bucket in registration order
+        (the trainer calls this at step time for keys whose grad-ready
+        hooks never fired).  ``eligible`` filters keys that turned out
+        not to participate (a gradient that materialized row_sparse);
+        a bucket left empty completes immediately."""
+        for b in self.buckets:
+            if b.state != _PLANNED:
+                continue
+            self._pending.pop(b.bid, None)
+            if eligible is not None and not set(b.keys) <= eligible:
+                keep = [(k, v) for k, v in zip(b.keys, b.vals)
+                        if k in eligible]
+                b.keys = [k for k, _ in keep]
+                b.vals = [v for _, v in keep]
+                if not b.keys:
+                    with _scheduler().cv:
+                        b.state = _DONE
+                    continue
+            self._seal(b)
 
     def bucket_of(self, key: Any) -> Optional[Bucket]:
         return self._by_key.get(key)
@@ -246,6 +377,7 @@ class Round:
     def wait(self, bucket: Bucket) -> None:
         """Block until ``bucket`` finished reducing; re-raise its
         error on this (the caller's) thread."""
+        self.mark_backward_end()        # consumption phase begins
         if bucket.state == _DONE and bucket.error is None:
             return
         from . import health as _health
@@ -280,6 +412,7 @@ class Round:
         consumers; order-sensitive ones (optimizers with eager
         global-RNG noise) should walk ``buckets`` with :meth:`wait`
         instead.  Errors re-raise at the failing bucket's yield turn."""
+        self.mark_backward_end()        # consumption phase begins
         remaining = list(self.buckets)
         sched = _scheduler()
         while remaining:
@@ -344,13 +477,22 @@ class Round:
         sched = _scheduler()
         with sched.cv:
             for b in self.buckets:
-                if b.state == _QUEUED:
+                if b.state in (_QUEUED, _PLANNED):
                     b.state = _CANCELLED
             while any(b.state == _RUNNING for b in self.buckets):
                 sched.cv.wait()
         if self.comm_seconds > 0:
             frac = 1.0 - min(self.wait_seconds / self.comm_seconds, 1.0)
             KV_OVERLAP_FRACTION.set(max(0.0, frac))
+            # the phase split: comm that ran under backward is hidden by
+            # construction; the optimizer-phase share is whatever else
+            # was hidden (total comm - backward comm - exposed wait)
+            bwd = min(self.comm_backward_seconds, self.comm_seconds)
+            opt = max(0.0, self.comm_seconds - bwd - self.wait_seconds)
+            KV_PHASE_OVERLAP_FRACTION.labels(phase="backward").set(
+                bwd / self.comm_seconds)
+            KV_PHASE_OVERLAP_FRACTION.labels(phase="optimizer").set(
+                opt / self.comm_seconds)
         return False
 
 
@@ -379,11 +521,32 @@ class _Scheduler:
                 b.ctx["strict"] = strict_order
                 self._queue.append((-b.priority, self._seq, b))
             self._queue.sort()
-            if self._thread is None or not self._thread.is_alive():
-                self._thread = threading.Thread(
-                    target=self._loop, name="mxnet-kv-comm", daemon=True)
-                self._thread.start()
+            self._ensure_thread()
             self.cv.notify_all()
+
+    def enqueue_bucket(self, bucket: Bucket, reduce_fn: Callable,
+                       strict_order: bool) -> None:
+        """The event-driven enqueue path: one sealed bucket of a
+        streaming round joins the queue immediately (Round.offer calls
+        this from the grad-ready hook, i.e. from inside backward), so
+        its reduction can dispatch while the rest of backward is still
+        producing gradients.  Never used with ``strict_order`` rounds —
+        seal order is readiness timing, which differs per rank."""
+        with self.cv:
+            self._seq += 1
+            bucket.ctx["_reduce_fn"] = reduce_fn
+            bucket.ctx["strict"] = strict_order
+            bucket.state = _QUEUED
+            self._queue.append((-bucket.priority, self._seq, bucket))
+            self._queue.sort()
+            self._ensure_thread()
+            self.cv.notify_all()
+
+    def _ensure_thread(self) -> None:
+        if self._thread is None or not self._thread.is_alive():
+            self._thread = threading.Thread(
+                target=self._loop, name="mxnet-kv-comm", daemon=True)
+            self._thread.start()
 
     def _pop_locked(self, ignore_ready: bool) -> Optional[Bucket]:
         """Highest-priority queued bucket, readiness-filtered unless
@@ -447,6 +610,8 @@ class _Scheduler:
             rnd = bucket.round
             if rnd is not None:
                 rnd.comm_seconds += dt
+                if not rnd._backward_done:
+                    rnd.comm_backward_seconds += dt
             with self.cv:
                 bucket.state = _DONE
                 self.cv.notify_all()
@@ -489,4 +654,29 @@ def submit(keys: Sequence[Any], vals: Sequence[Any],
         for b in rnd.buckets:
             prepare_fn(b)
     _scheduler().enqueue_round(rnd, reduce_fn, strict_order)
+    return rnd
+
+
+def open_round(keys: Sequence[Any], vals: Sequence[Any],
+               priorities: Sequence[int],
+               reduce_fn: Callable[[Bucket], None],
+               prepare_fn: Optional[Callable[[Bucket], None]] = None,
+               bucket_bytes: Optional[int] = None) -> Round:
+    """Plan a STREAMING round: buckets are composed exactly as
+    :func:`submit` would (pure function of registration order + sizes,
+    so 2-bit error-feedback residual determinism survives) but start
+    un-queued.  The caller's grad-ready hooks :meth:`Round.offer` keys
+    as backward finalizes their gradients; each bucket seals — and its
+    reduction dispatches — the moment its last key arrives, which in
+    reverse-registration backward order means buckets stream onto the
+    wire DURING backward.  :meth:`Round.seal_remaining` at step time
+    enqueues whatever never streamed; from there the round is consumed
+    like any other (``wait``/``as_completed``/``finish``).  Never
+    strict-order: multi-process collective stores need rank-identical
+    dispatch sequences, which seal timing is not — callers keep those
+    on :func:`submit`."""
+    rnd = Round(plan_buckets(keys, vals, priorities, bucket_bytes),
+                streaming=True)
+    rnd._reduce_fn = reduce_fn
+    rnd._prepare_fn = prepare_fn
     return rnd

@@ -419,6 +419,7 @@ class MetricsRegistry:
             f.reset()
         _OP_CHILDREN.clear()
         _BULK_REASON_CHILDREN.clear()
+        _BWD_SEG_CHILDREN.clear()
 
     def dump_json(self) -> Dict[str, Any]:
         with self._lock:
@@ -607,7 +608,10 @@ BULK_SEGMENTS = counter(
     "unjittable (an op that cannot trace arrived), mutation (in-place "
     "write to a promised buffer), waitall (engine barrier), autograd "
     "(backward boundary / record-scope transition), cross_thread "
-    "(another thread read a promised buffer).", labels=("reason",))
+    "(another thread read a promised buffer), param_boundary (per-"
+    "layer backward segmentation closed the recorded segment at a "
+    "parameter boundary — MXNET_BULK_BACKWARD_SEGMENTS=param).",
+    labels=("reason",))
 BULK_CACHE_HITS = counter(
     "mxnet_bulk_seg_cache_hits_total",
     "Segment flushes that reused a compiled fused executable (segment-"
@@ -625,6 +629,19 @@ BULK_OPS_PER_SEGMENT = histogram(
     "Ops per flushed bulking segment (1 means the flush trigger arrived "
     "before a second op could join).",
     buckets=exponential_buckets(1.0, 2.0, 8))
+BULK_BACKWARD_SEGMENTS = counter(
+    "mxnet_bulk_backward_segments_total",
+    "Per-layer backward-segmentation events under "
+    "MXNET_BULK_BACKWARD_SEGMENTS=param (bulk.py), by reason: "
+    "param_boundary (a recorded segment was cut because the op stream "
+    "crossed a fresh attach_grad leaf with the coalescing floor met — "
+    "its gradients will stream during backward; moves in lockstep "
+    "with mxnet_bulk_segments_total{reason=param_boundary}, which "
+    "counts the same cuts as flushes), coalesced (a parameter "
+    "boundary was crossed but the segment's captured parameter bytes "
+    "were still under the MXNET_KV_BUCKET_BYTES floor, so the layers "
+    "share a segment — the decision the flush counter cannot see).",
+    labels=("reason",))
 
 # -- continuous-batching generation engine (serving/generation.py) ----------
 GEN_SLOTS_ACTIVE = gauge(
@@ -833,6 +850,21 @@ def inc_bulk_segment(reason: str) -> None:
     b = _BULK_REASON_CHILDREN.get(reason)
     if b is None:
         b = _BULK_REASON_CHILDREN[reason] = BULK_SEGMENTS.labels(
+            reason=reason)
+    b.inc()
+
+
+# Hot-path cache for the backward-segmentation event counter (the cut
+# decision runs once per recorded op append).
+_BWD_SEG_CHILDREN: Dict[str, _Bound] = {}
+
+
+def inc_backward_segment(reason: str) -> None:
+    """Count one backward-segmentation event (bulk.try_append's
+    param-boundary cut decision)."""
+    b = _BWD_SEG_CHILDREN.get(reason)
+    if b is None:
+        b = _BWD_SEG_CHILDREN[reason] = BULK_BACKWARD_SEGMENTS.labels(
             reason=reason)
     b.inc()
 

@@ -69,9 +69,14 @@ class NDArray:
     # _concrete_shadow: the concrete buffer while _data is temporarily a
     # tracer under gluon._bind_params (host-side layer logic — BatchNorm
     # virgin-stats resolution — inspects values mid-trace through it)
+    # _grad_ready_cb: per-leaf grad-ready hook — backward_arrays calls
+    # it (with this array) the moment this leaf's gradient finalizes
+    # mid-backward; installed by gluon.Parameter.set_grad_ready_cb so
+    # the overlapped kvstore scheduler can stream reduction buckets
+    # while backward is still running
     __slots__ = ("_buf", "_ctx", "_ag_node", "_ag_out_idx", "_grad",
-                 "_grad_req", "_fresh_grad", "_concrete_shadow",
-                 "__weakref__")
+                 "_grad_req", "_fresh_grad", "_grad_ready_cb",
+                 "_concrete_shadow", "__weakref__")
 
     # numpy interop priority (beats np.ndarray in mixed expressions)
     __array_priority__ = 1000.0
@@ -147,6 +152,7 @@ class NDArray:
         self._grad = None
         self._grad_req = "null"
         self._fresh_grad = False
+        self._grad_ready_cb = None
 
     # ------------------------------------------------------------------
     # Basic properties

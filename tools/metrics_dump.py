@@ -409,8 +409,12 @@ def _workload_dist_comm(steps: int) -> None:
     16-parameter adam micro-fit through the bucketed comm-thread
     scheduler (kvstore_sched.py), showing the mxnet_kv_* families —
     buckets dispatched, per-bucket comm latency, the exposed wait,
-    the per-round overlap fraction, and compressed-vs-raw wire bytes
-    (the second fit runs 2bit error-feedback compression)."""
+    the per-round overlap fraction and its backward/optimizer phase
+    split, buckets event-enqueued during backward
+    (mxnet_kv_stream_enqueues_total, fed by per-layer backward
+    segmentation — mxnet_bulk_backward_segments_total{reason}), and
+    compressed-vs-raw wire bytes (the second fit runs 2bit
+    error-feedback compression)."""
     import os as _os
     import mxnet_tpu as mx
     from mxnet_tpu.ndarray import ops
@@ -418,6 +422,8 @@ def _workload_dist_comm(steps: int) -> None:
     _os.environ["MXNET_KV_OVERLAP"] = "1"
     _os.environ["MXNET_KV_BUCKET_BYTES"] = str(512 * 1024)
     _os.environ["MXNET_KV_SYNTH_WIRE_GBPS"] = "2.0"
+    _os.environ["MXNET_BULK_BACKWARD_SEGMENTS"] = "param"
+    _os.environ["MXNET_KV_BACKWARD_STREAM"] = "1"
     try:
         for compression in (None, {"type": "2bit", "threshold": 1e-4}):
             mx.random.seed(0)
