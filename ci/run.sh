@@ -66,6 +66,16 @@
 #                           #   sum, <10% stall with a hidden loader,
 #                           #   majority-stall demonstrated unpiped,
 #                           #   0 compiles after warmup, loss parity
+#   ci/run.sh trace-smoke   # distributed-tracing gate: a traced
+#                           #   generation request shows HTTP -> queue
+#                           #   -> prefill -> >=1 linked iteration ->
+#                           #   first-token spans under ONE trace id on
+#                           #   the raw /v1/traces wire; traced train
+#                           #   steps show prefetch / backward-segment
+#                           #   / bucket / optimizer children and a
+#                           #   ps.handle remote child across the PS
+#                           #   frame; 1%-sampling steps/sec >=0.97x
+#                           #   tracing-off, 0 compiles after warmup
 #   ci/run.sh bench-check   # bench regression gate (bench.py --check):
 #                           #   deterministic metrics (compiles after
 #                           #   warmup, flush growth, stall fraction)
@@ -235,6 +245,17 @@ run_dist_comm_smoke() {
   JAX_PLATFORMS=cpu timeout 900 python tools/dist_comm_smoke.py
 }
 
+run_trace_smoke() {
+  echo "== trace-smoke: end-to-end distributed tracing — one trace id"
+  echo "   spans HTTP front end -> batcher queue -> engine prefill ->"
+  echo "   linked iterations -> token stream on the raw /v1/traces"
+  echo "   wire; train steps carry prefetch/backward-segment/bucket/"
+  echo "   optimizer children + a ps.handle remote child via the PS"
+  echo "   frame traceparent; 1%-sampled steps/sec >=0.97x tracing-off"
+  echo "   with 0 compiles after warmup"
+  JAX_PLATFORMS=cpu timeout 600 python tools/trace_smoke.py
+}
+
 run_bench_check() {
   echo "== bench-check: deterministic bench regressions fail (compiles"
   echo "   after warmup / flush growth / stall fraction); wall-clock"
@@ -254,8 +275,8 @@ run_tier1() {
   echo "   old envdoc+faultdoc gates) + serving smoke + generation"
   echo "   smoke + resilience smoke + dist-resilience smoke + chaos"
   echo "   smoke + cache smoke + health smoke + bulking smoke +"
-  echo "   input-pipeline smoke + dist-comm smoke + bench regression"
-  echo "   check + the tier-1 pytest selection"
+  echo "   input-pipeline smoke + dist-comm smoke + trace smoke +"
+  echo "   bench regression check + the tier-1 pytest selection"
   run_mxlint
   run_serving_smoke
   run_generation_smoke
@@ -267,6 +288,7 @@ run_tier1() {
   run_bulk_smoke
   run_input_pipeline_smoke
   run_dist_comm_smoke
+  run_trace_smoke
   run_bench_check
   JAX_PLATFORMS=cpu python -m pytest tests/ -q -m 'not slow' \
     --continue-on-collection-errors -p no:cacheprovider
@@ -367,6 +389,7 @@ case "$variant" in
   health-smoke) run_health_smoke ;;
   input-pipeline-smoke) run_input_pipeline_smoke ;;
   dist-comm-smoke) run_dist_comm_smoke ;;
+  trace-smoke)  run_trace_smoke ;;
   bench-check)  run_bench_check ;;
   chaos)        run_chaos ;;
   bulk-smoke)   run_bulk_smoke ;;

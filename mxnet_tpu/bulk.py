@@ -68,6 +68,7 @@ import numpy as _onp
 
 from . import engine
 from . import metrics as _metrics
+from . import tracing as _tracing
 from ._tape import TapeNode, is_recording
 from .base import MXNetError, getenv, register_env
 
@@ -328,7 +329,13 @@ class Segment:
                         phs.append(ph)
             try:
                 if returns:
-                    self._execute(nodes, returns, phs)
+                    # child of whatever step/backward span is active;
+                    # reason="param_boundary" marks the per-layer
+                    # backward segments
+                    with _tracing.child_span("bulk.segment",
+                                             reason=reason,
+                                             ops=len(nodes)):
+                        self._execute(nodes, returns, phs)
             except BaseException as exc:
                 self.error = f"{type(exc).__name__}: {exc}"
                 for ph in phs:

@@ -19,6 +19,7 @@ from typing import Any, Dict, List, Optional, Sequence, Union
 from ..base import MXNetError
 from ..ndarray.ndarray import NDArray
 from .. import optimizer as opt
+from .. import tracing as _tracing
 from .parameter import Parameter
 
 __all__ = ["Trainer"]
@@ -541,12 +542,14 @@ class Trainer:
         grad buffers (called after waiting the bucket): after step, a
         parameter's ``.grad`` holds the reduced gradient exactly as the
         non-streaming paths leave it."""
-        for k, v in zip(bucket.keys, bucket.vals):
-            p = self._params[k]
-            g = p._data._grad if p._data is not None else None
-            s = self._stream_staging.get(k)
-            if g is v and s is not None:
-                g._data = s._data
+        with _tracing.child_span("bucket.absorb",
+                                 keys=len(bucket.keys)):
+            for k, v in zip(bucket.keys, bucket.vals):
+                p = self._params[k]
+                g = p._data._grad if p._data is not None else None
+                s = self._stream_staging.get(k)
+                if g is v and s is not None:
+                    g._data = s._data
 
     def _strict_collective_order(self) -> bool:
         """Multi-process collective stores need every rank to issue the
@@ -573,7 +576,11 @@ class Trainer:
             self._fault_site()
         t0 = time.perf_counter()
         try:
-            self._step_impl(batch_size, ignore_stale_grad)
+            # per-step root span: reduction buckets (seal/dispatch/
+            # wire/absorb), PS-side handling, and optimizer updates
+            # all land as children in this trace
+            with _tracing.span("trainer.step", batch_size=batch_size):
+                self._step_impl(batch_size, ignore_stale_grad)
         finally:
             _metrics.TRAINER_STEP_SECONDS.observe(time.perf_counter() - t0)
 
@@ -722,6 +729,13 @@ class Trainer:
         """Apply the optimizer to one list of (idx, weight, grad)
         entries — the fused-group batching below is unchanged from the
         pre-scheduler path, it just runs per bucket now."""
+        if not updatable:
+            return
+        with _tracing.child_span("optimizer.update",
+                                 params=len(updatable)):
+            self._update_entries_impl(updatable)
+
+    def _update_entries_impl(self, updatable) -> None:
         agg = self._optimizer.aggregate_num
         if len(updatable) > 1 and agg > 1 and self._fused_optimizer_ok():
             # reference semantics: MXNET_OPTIMIZER_AGGREGATION_SIZE bounds

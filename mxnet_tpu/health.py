@@ -248,6 +248,14 @@ def _write_dump(site: str, deadline_s: float, ctx: Dict[str, Any]) -> str:
         lines.extend(l.rstrip("\n")
                      for l in traceback.format_stack(frame))
         lines.append("")
+    lines.append("== active spans ==")
+    try:
+        from . import tracing as _tracing
+        tree = _tracing.active_spans_tree()
+        lines.extend(tree if tree else ["(no active spans)"])
+    except Exception:   # noqa: BLE001 - diagnostics must never raise
+        lines.append("(active spans unavailable)")
+    lines.append("")
     lines.append("== metrics snapshot (non-zero series) ==")
     try:
         lines.append(json.dumps(_metrics._nonzero_summary(), indent=1))

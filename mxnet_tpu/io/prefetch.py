@@ -379,8 +379,10 @@ class DevicePrefetcher:
             self._seek(step, salt)
         self._ensure_started()
         from .. import health as _health
+        from .. import tracing as _tracing
         t0 = time.perf_counter()
-        with _health.watch_section("prefetch.get", step=step):
+        with _tracing.child_span("prefetch.get", step=step), \
+                _health.watch_section("prefetch.get", step=step):
             while True:
                 if self._dead is not None and self._q.empty():
                     _raise_producer_error(self._dead)

@@ -80,6 +80,7 @@ import numpy as onp
 from .base import MXNetError, getenv, register_env
 from . import faults as _faults
 from . import metrics as _metrics
+from . import tracing as _tracing
 from .retry import retry_call
 
 __all__ = ["PSServer", "KVStoreDistAsync", "run_server"]
@@ -476,6 +477,12 @@ class _Handler(socketserver.BaseRequestHandler):
                                 {"error": "bad or missing auth token"})
                     return
                 srv.note_heard(header.get("wrank"))
+                # remote child span: a frame that carries the worker's
+                # traceparent parents the server-side handling under
+                # the worker's trace id (popped — srv.handle's header
+                # contract is unchanged)
+                rctx = _tracing.parse_traceparent(
+                    header.pop("traceparent", None))
                 if cmd == b"S":
                     srv.stop_requested = True
                     srv.snapshot()        # graceful stop is lossless
@@ -485,7 +492,14 @@ class _Handler(socketserver.BaseRequestHandler):
                                      daemon=True).start()
                     return
                 try:
-                    reply = srv.handle(cmd, header, payload)
+                    if rctx is not None:
+                        with _tracing.attach(rctx), _tracing.span(
+                                "ps.handle",
+                                cmd=cmd.decode("latin1"),
+                                wrank=header.get("wrank")):
+                            reply = srv.handle(cmd, header, payload)
+                    else:
+                        reply = srv.handle(cmd, header, payload)
                 except Exception as e:   # report, keep the connection
                     reply = (b"E", {"error": str(e)}, b"")
                 rcmd, rhdr, rpayload = reply
@@ -1574,6 +1588,12 @@ class KVStoreDistAsync:
         header.setdefault("wrank", self._rank)
         if self._token:
             header["tok"] = self._token
+        # cross-wire trace propagation: the active span's W3C
+        # traceparent rides the frame header, so the PS-side handling
+        # shows up as a remote child span in this worker's trace
+        tp = _tracing.traceparent()
+        if tp is not None:
+            header["traceparent"] = tp
         cmd_name = cmd.decode("latin1")
 
         def _exchange():

@@ -78,6 +78,7 @@ import time
 from typing import Any, Callable, Dict, List, Optional, Sequence
 
 from . import metrics as _metrics
+from . import tracing as _tracing
 from .base import MXNetError, getenv, register_env
 
 __all__ = ["Bucket", "Round", "submit", "open_round", "plan_buckets"]
@@ -383,8 +384,9 @@ class Round:
         from . import health as _health
         t0 = time.perf_counter()
         sched = _scheduler()
-        with _health.watch_section("kvstore.bucket", side="wait",
-                                   bucket=bucket.bid):
+        with _tracing.child_span("bucket.wait", bucket=bucket.bid), \
+                _health.watch_section("kvstore.bucket", side="wait",
+                                      bucket=bucket.bid):
             with sched.cv:
                 while bucket.state not in (_DONE, _CANCELLED):
                     sched.cv.wait()
@@ -514,11 +516,17 @@ class _Scheduler:
         only ever sees the complete round, so its pops are a
         deterministic function of priorities and — unless
         ``strict_order`` — payload readiness)."""
+        # the enqueuing (trainer) thread's trace context rides in the
+        # bucket's ctx scratch: the comm thread re-attaches it so the
+        # wire span lands in the training step's trace
+        tr = _tracing.capture()
         with self.cv:
             for b in rnd.buckets:
                 self._seq += 1
                 b.ctx["_reduce_fn"] = reduce_fn
                 b.ctx["strict"] = strict_order
+                b.ctx["trace"] = tr
+                b.ctx["t_enq"] = time.perf_counter()
                 self._queue.append((-b.priority, self._seq, b))
             self._queue.sort()
             self._ensure_thread()
@@ -536,6 +544,11 @@ class _Scheduler:
             self._seq += 1
             bucket.ctx["_reduce_fn"] = reduce_fn
             bucket.ctx["strict"] = strict_order
+            # sealed from a grad-ready hook during backward: whatever
+            # trace is active on the offering thread (none, when
+            # backward runs outside a step span) parents the wire span
+            bucket.ctx["trace"] = _tracing.capture()
+            bucket.ctx["t_enq"] = time.perf_counter()
             bucket.state = _QUEUED
             self._queue.append((-bucket.priority, self._seq, bucket))
             self._queue.sort()
@@ -594,12 +607,23 @@ class _Scheduler:
     def _run(self, bucket: Bucket) -> None:
         from . import health as _health
         reduce_fn = bucket.ctx.pop("_reduce_fn")
+        tr = bucket.ctx.pop("trace", None)
+        t_enq = bucket.ctx.pop("t_enq", None)
         t0 = time.perf_counter()
+        if t_enq is not None:
+            # queue time: seal/enqueue -> comm-thread pop
+            _tracing.record_span("bucket.dispatch", t_enq, t0, ctx=tr,
+                                 bucket=bucket.bid)
         try:
-            with _health.watch_section("kvstore.bucket",
-                                       bucket=bucket.bid,
-                                       keys=len(bucket.keys),
-                                       nbytes=bucket.nbytes):
+            with _tracing.attach(tr), \
+                    _tracing.child_span("bucket.wire",
+                                        bucket=bucket.bid,
+                                        keys=len(bucket.keys),
+                                        nbytes=bucket.nbytes), \
+                    _health.watch_section("kvstore.bucket",
+                                          bucket=bucket.bid,
+                                          keys=len(bucket.keys),
+                                          nbytes=bucket.nbytes):
                 reduce_fn(bucket)
         except BaseException as exc:   # noqa: BLE001 - handed to waiter
             bucket.error = exc

@@ -212,8 +212,8 @@ class _Bound:
     def set(self, v: float) -> None:
         self._child.set(self._family._lock, v)
 
-    def observe(self, v: float) -> None:
-        self._child.observe(self._family._lock, v)
+    def observe(self, v: float, exemplar: Optional[str] = None) -> None:
+        self._child.observe(self._family._lock, v, exemplar)
 
     @property
     def value(self) -> float:
@@ -250,28 +250,50 @@ class _GaugeChild(_CounterChild):
             self.value = float(v)
 
 
+#: an exemplar older than this is replaced by the next offered one even
+#: when slower observations were seen since — "most recent slow", not
+#: "all-time max", so a bad p99 points at a trace that still exists
+_EXEMPLAR_TTL_S = 60.0
+
+
 class _HistogramChild:
-    __slots__ = ("bounds", "counts", "sum", "count")
+    __slots__ = ("bounds", "counts", "sum", "count", "exemplar")
 
     def __init__(self, bounds: Tuple[float, ...]) -> None:
         self.bounds = bounds
         self.counts = [0] * (len(bounds) + 1)  # +1: the +Inf bucket
         self.sum = 0.0
         self.count = 0
+        # (trace_id, value, unix time) of the slowest recent observation
+        # that carried a trace id (tracing exemplar linkage)
+        self.exemplar: Optional[Tuple[str, float, float]] = None
 
-    def observe(self, lock: threading.Lock, v: float) -> None:
+    def observe(self, lock: threading.Lock, v: float,
+                exemplar: Optional[str] = None) -> None:
         v = float(v)
         idx = bisect.bisect_left(self.bounds, v)
         with lock:
             self.counts[idx] += 1
             self.sum += v
             self.count += 1
+            if exemplar is not None:
+                ex = self.exemplar
+                now = time.time()
+                if ex is None or v >= ex[1] \
+                        or now - ex[2] > _EXEMPLAR_TTL_S:
+                    self.exemplar = (str(exemplar), v, now)
 
     def to_json(self) -> Dict[str, Any]:
-        return {"buckets": [[b, c] for b, c in
-                            zip(list(self.bounds) + ["+Inf"],
-                                _cumulative(self.counts))],
-                "sum": self.sum, "count": self.count}
+        out: Dict[str, Any] = {
+            "buckets": [[b, c] for b, c in
+                        zip(list(self.bounds) + ["+Inf"],
+                            _cumulative(self.counts))],
+            "sum": self.sum, "count": self.count}
+        ex = self.exemplar
+        if ex is not None:
+            out["exemplar"] = {"trace_id": ex[0], "value": ex[1],
+                               "ts": ex[2]}
+        return out
 
     def render(self, name, label_names, vals) -> List[str]:
         lines = []
@@ -353,8 +375,8 @@ class Histogram(_Family):
     def _new_child(self) -> _HistogramChild:
         return _HistogramChild(self.bounds)
 
-    def observe(self, v: float) -> None:
-        self._default().observe(self._lock, v)
+    def observe(self, v: float, exemplar: Optional[str] = None) -> None:
+        self._default().observe(self._lock, v, exemplar)
 
     @property
     def sum(self) -> float:

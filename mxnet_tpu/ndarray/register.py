@@ -16,6 +16,7 @@ fusion the reference got from pointwise-fusion RTC codegen.
 from __future__ import annotations
 
 import functools
+import threading
 import weakref
 from typing import Any, Callable, Dict, List, Optional, Sequence
 
@@ -331,9 +332,25 @@ def _harmonize_mesh_placement(arrays):
     return out
 
 
+# Re-entrancy guard for monitor hooks (per thread): a hook's own
+# stat_func dispatches ops (abs/mean) through invoke(), and without the
+# guard those instrumentation-internal dispatches re-fire every OTHER
+# registered hook (Monitor._in_hook only protects the monitor against
+# itself) — their stats then publish into mxnet_monitor_stat as if they
+# were model ops.  Same rule the tracing layer follows by mirroring
+# spans into the profiler via a direct event append instead of dispatch.
+_monitor_tls = threading.local()
+
+
 def _fire_monitor_hooks(name, outputs) -> None:
-    for hook in list(_monitor_state["hooks"].values()):
-        hook(name, outputs)
+    if getattr(_monitor_tls, "active", False):
+        return
+    _monitor_tls.active = True
+    try:
+        for hook in list(_monitor_state["hooks"].values()):
+            hook(name, outputs)
+    finally:
+        _monitor_tls.active = False
 
 
 def exec_cache_stats() -> Dict[str, float]:

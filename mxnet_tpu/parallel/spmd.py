@@ -28,6 +28,7 @@ import jax.numpy as jnp
 from ..base import MXNetError, getenv, register_env
 from ..ndarray.ndarray import NDArray, from_jax
 from ..ndarray import random as _random
+from .. import tracing as _tracing
 from .. import optimizer as opt_mod
 from ..gluon.block import _bind_params
 from ..gluon.parameter import Parameter
@@ -690,8 +691,9 @@ class SPMDTrainer:
         inputs = data if isinstance(data, (list, tuple)) else [data]
 
         t0 = time.perf_counter()
-        arrays = [self._place(x, self._data_spec) for x in inputs]
-        label_arr = self._place(labels, self._label_spec)
+        with _tracing.child_span("step.place"):
+            arrays = [self._place(x, self._data_spec) for x in inputs]
+            label_arr = self._place(labels, self._label_spec)
         t_data = time.perf_counter() - t0
         from .. import faults as _faults
         if _faults._ARMED:
@@ -733,11 +735,12 @@ class SPMDTrainer:
         if self._donate_inputs:
             donated = donated + list(arrays) + [label_arr]
         _bulk.flush_holding(donated, "mutation")
-        out = self._step_fn(
-            param_arrays, self._opt_states, rng,
-            self._committed_scalar(lr), self._committed_scalar(wd),
-            self._t_dev,
-            *arrays, label_arr)
+        with _tracing.child_span("step.dispatch"):
+            out = self._step_fn(
+                param_arrays, self._opt_states, rng,
+                self._committed_scalar(lr), self._committed_scalar(wd),
+                self._t_dev,
+                *arrays, label_arr)
         if self._health_gate:
             new_params, new_states, loss, self._last_health, \
                 self._t_dev = out
@@ -926,12 +929,17 @@ class SPMDTrainer:
                     ran = self._step_count < num_steps
                     if ran:
                         step = self._step_count
-                        data, labels = get_batch(step)
-                        with (health_guard.watch("trainer.step",
-                                                 step=step)
-                              if health_guard is not None
-                              else contextlib.nullcontext()):
-                            step_loss = self.step(data, labels)
+                        # per-step root span: batch get (prefetch pop
+                        # or host fetch) and the step dispatch are its
+                        # children — a slow step tail-upgrades the
+                        # whole tree into the trace ring
+                        with _tracing.span("train.step", step=step):
+                            data, labels = get_batch(step)
+                            with (health_guard.watch("trainer.step",
+                                                     step=step)
+                                  if health_guard is not None
+                                  else contextlib.nullcontext()):
+                                step_loss = self.step(data, labels)
                         if health_guard is None:
                             loss = step_loss
                         else:
@@ -992,8 +1000,10 @@ class SPMDTrainer:
                         # the cluster rendezvous — a hang here (wedged
                         # peer) dumps stacks instead of stalling silent
                         from .. import health as _health
-                        with _health.watch_section("checkpoint.save",
-                                                   step=done):
+                        with _tracing.span("checkpoint.save",
+                                           step=done), \
+                                _health.watch_section("checkpoint.save",
+                                                      step=done):
                             checkpoint_manager.save(self, step=done)
                     if preempted:
                         # drain the pending verdict so accounting and

@@ -141,6 +141,24 @@ def record_op(name: str, begin_us: float, end_us: float,
         a["max"] = max(a["max"], d)
 
 
+def record_span(name: str, begin_us: float, end_us: float,
+                tid: Optional[int] = None,
+                args: Optional[Dict[str, Any]] = None) -> None:
+    """Mirror one finished tracing span into the profiler's event list
+    (category ``"trace"``) so a single dump shows spans and ops on one
+    timeline.  This is a direct event append — it never goes through
+    the op-dispatch layer, so spans cannot fire monitor hooks, count as
+    dispatched ops, or double-publish into ``mxnet_monitor_stat``."""
+    ev: Dict[str, Any] = {
+        "name": name, "cat": "trace", "ph": "X", "ts": begin_us,
+        "dur": max(0.0, end_us - begin_us), "pid": 0,
+        "tid": threading.get_ident() % 100000 if tid is None else tid}
+    if args:
+        ev["args"] = args
+    with _LOCK:
+        _P.events.append(ev)
+
+
 class _OpTimer:
     """Context used by the dispatch hook."""
 

@@ -24,6 +24,7 @@ import numpy as _np
 
 from ..base import MXNetError, getenv, register_env
 from .. import metrics as _metrics
+from .. import tracing as _tracing
 
 __all__ = ["BucketPolicy", "DynamicBatcher", "OverloadError", "Request",
            "SlotScheduler"]
@@ -241,7 +242,8 @@ class Request:
     """One queued inference request: the sample (tuple of per-input
     arrays WITHOUT the batch dim), its future, and timing metadata."""
 
-    __slots__ = ("sample", "key", "future", "enqueue_t", "deadline_t")
+    __slots__ = ("sample", "key", "future", "enqueue_t", "deadline_t",
+                 "trace")
 
     def __init__(self, sample: Sequence[_np.ndarray], key: Any,
                  future: Any, deadline_t: Optional[float]) -> None:
@@ -250,6 +252,10 @@ class Request:
         self.future = future
         self.enqueue_t = time.monotonic()
         self.deadline_t = deadline_t
+        # trace context captured at submit: the worker thread that
+        # eventually executes this request attaches it so its spans
+        # parent under the submitting request's trace
+        self.trace = _tracing.capture()
 
 
 class DynamicBatcher:
@@ -425,8 +431,17 @@ class DynamicBatcher:
                                       if id(r) not in taken]
                         QUEUE_DEPTH.set(len(self._q))
                         now = time.monotonic()
+                        pc = time.perf_counter()
                         for r in take:
-                            QUEUE_WAIT_SECONDS.observe(now - r.enqueue_t)
+                            wait = now - r.enqueue_t
+                            QUEUE_WAIT_SECONDS.observe(
+                                wait,
+                                exemplar=r.trace.trace_id
+                                if r.trace is not None else None)
+                            # retroactive span: submit -> batch take
+                            _tracing.record_span(
+                                "queue.wait", pc - wait, pc,
+                                ctx=r.trace)
                         BATCH_SIZE.observe(len(take))
                         if on_take is not None:
                             on_take(take)
@@ -567,6 +582,13 @@ class SlotScheduler:
                     continue
                 if len(out) < free_slots:
                     out.append(r)
+                    tr = getattr(r, "trace", None)
+                    if tr is not None:
+                        # submit -> admission pop = the slot wait
+                        pc = time.perf_counter()
+                        _tracing.record_span(
+                            "queue.wait", pc - (now - r.enqueue_t),
+                            pc, ctx=tr)
                 else:
                     keep.append(r)
             self._q[:] = keep

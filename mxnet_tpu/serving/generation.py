@@ -51,6 +51,7 @@ import numpy as _np
 
 from ..base import MXNetError, getenv, register_env
 from .. import metrics as _metrics
+from .. import tracing as _tracing
 from .batching import REQUESTS_TOTAL, SlotScheduler
 from .kv_cache import (PagedKVCache, PrefixCache, prefix_key,
                        round_up_bucket, _shrink_rows)
@@ -266,7 +267,7 @@ class GenRequest:
                  "t_first", "request_id", "orig_prompt",
                  "total_new_tokens", "offset", "recover_t0",
                  "recoveries", "method", "temperature", "top_k",
-                 "top_p", "seed")
+                 "top_p", "seed", "trace")
 
     _SEQ = _itertools.count(1)
 
@@ -306,6 +307,9 @@ class GenRequest:
         self.recover_t0: Optional[float] = None
         self.recoveries = 0     # resurrections so far (budgeted by the
         #                         server against restart churn)
+        # trace context captured at submit; the engine thread attaches
+        # it so queue-wait/prefill spans land in the request's trace
+        self.trace = _tracing.capture()
 
     # scheduler duck-type
     def fail(self, exc: BaseException) -> None:
@@ -345,6 +349,8 @@ def make_recovery_request(req: GenRequest) -> GenRequest:
                    top_p=req.top_p, seed=req.seed)
     r.recover_t0 = time.monotonic()
     r.recoveries = req.recoveries + 1
+    r.trace = req.trace      # the resurrection stays in the original
+    #                          request's trace (recovery spans included)
     return r
 
 
@@ -646,7 +652,13 @@ class GenerationEngine:
         self._in_admission = list(pending)
         for req in pending:
             try:
-                slot = self._admit(req)
+                # prefill lands in the REQUEST's trace (attach), not an
+                # engine-iteration trace; a failed prefill marks the
+                # span errored, which tail-upgrades the whole trace
+                with _tracing.attach(req.trace), _tracing.child_span(
+                        "engine.prefill", request_id=req.request_id,
+                        prompt=int(req.tokens.size)):
+                    slot = self._admit(req)
             except Exception as e:   # noqa: BLE001 - a poisoned
                 # prompt (or an injected prefill fault) fails ONLY
                 # its own request; the engine keeps serving
@@ -668,18 +680,33 @@ class GenerationEngine:
             self.iteration_log.append(log)
             return bool(log["admitted"] or log["retired"])
 
-        # 3. one resident decode step over EVERY active slot
+        # 3. one resident decode step over EVERY active slot.  The
+        #    iteration span is its own (head-sampled) trace — one step
+        #    serves MANY requests, so it cannot be a child of any one
+        #    of them; instead it LINKS every resident request's trace
+        #    id, and a request's trace finds "its" decode steps by
+        #    searching iteration spans that link it.
         try:
-            _faults.maybe_fault("serving.execute", phase="decode",
-                                slots=len(active))
-            self.cache.ensure_capacity(self.cache.needed_capacity())
-            pos = _np.maximum(self.cache.positions, 0).astype(_np.int32)
-            if self._samp_dev is None:
-                self._samp_dev = self.model.device_sampling(self._samp)
-            with _health.watch_section("generation.step",
-                                       slots=len(active)):
-                next_tok = self.model.step(self.cache, self._last_tok,
-                                           pos, self._samp_dev)
+            with _tracing.span("engine.iteration", iter=self._iter,
+                               slots=len(active)) as isp:
+                for _r in active.values():
+                    _tr = getattr(_r, "trace", None)
+                    if _tr is not None:
+                        isp.add_link(_tr.trace_id)
+                _faults.maybe_fault("serving.execute", phase="decode",
+                                    slots=len(active))
+                self.cache.ensure_capacity(
+                    self.cache.needed_capacity())
+                pos = _np.maximum(self.cache.positions,
+                                  0).astype(_np.int32)
+                if self._samp_dev is None:
+                    self._samp_dev = self.model.device_sampling(
+                        self._samp)
+                with _health.watch_section("generation.step",
+                                           slots=len(active)):
+                    next_tok = self.model.step(self.cache,
+                                               self._last_tok,
+                                               pos, self._samp_dev)
         except Exception as e:   # noqa: BLE001 - an iteration fault
             # hits exactly the sequences IN FLIGHT at this iteration
             # (their kv rows are suspect); queued requests and the
@@ -893,7 +920,10 @@ class GenerationEngine:
         req.emitted = 1
         _metrics.GEN_SAMPLED_TOKENS_TOTAL.labels(
             method=req.method).inc()
-        _metrics.GEN_TTFT_SECONDS.observe(req.t_first - req.enqueue_t)
+        _metrics.GEN_TTFT_SECONDS.observe(
+            req.t_first - req.enqueue_t,
+            exemplar=req.trace.trace_id if req.trace is not None
+            else None)
         _metrics.GEN_TOKENS_TOTAL.labels(phase="prefill").inc()
         _metrics.GEN_ADMISSIONS_TOTAL.inc()
         if req.recover_t0 is not None:

@@ -52,6 +52,7 @@ from typing import Any, List, Optional, Tuple
 
 import numpy as _np
 
+from .. import tracing as _tracing
 from ..base import MXNetError
 from .batching import OverloadError
 from .generation import StreamTimeout
@@ -115,6 +116,11 @@ class _Handler(BaseHTTPRequestHandler):
         data = body if isinstance(body, bytes) else \
             json.dumps(body).encode()
         self.send_response(code)
+        tp = _tracing.traceparent()
+        if tp is not None:
+            # echo the request's trace context so the caller can join
+            # its client-side span to what GET /v1/traces will show
+            self.send_header("traceparent", tp)
         self.send_header("Content-Type", content_type)
         self.send_header("Content-Length", str(len(data)))
         for k, v in (headers or {}).items():
@@ -186,6 +192,11 @@ class _Handler(BaseHTTPRequestHandler):
                     s is not None and getattr(s, "degraded", False)
                     for s in (self._ms, self._gs)),
             })
+        elif path == "/v1/traces":
+            # the span ring buffer as Chrome/Perfetto trace-event JSON
+            # (same shape the profiler dumps — one chrome://tracing
+            # load shows both)
+            self._reply(200, _tracing.export_trace_events())
         elif path == "/v1/model":
             out = (self._ms.describe() if self._ms is not None else {})
             if self._gs is not None:
@@ -197,7 +208,18 @@ class _Handler(BaseHTTPRequestHandler):
     # -- POST --------------------------------------------------------------
     def do_POST(self) -> None:  # noqa: N802 - http.server API
         try:
-            self._post()
+            # trace context: continue the caller's trace when the
+            # request carries a W3C traceparent header, else start a
+            # fresh (head-sampled) one.  Everything downstream —
+            # batcher queue wait, prefill, token stream — parents
+            # under this span.
+            rctx = _tracing.parse_traceparent(
+                self.headers.get("traceparent"))
+            with _tracing.attach(rctx):
+                with _tracing.span(
+                        "http.request", method="POST",
+                        path=self.path.split("?", 1)[0]):
+                    self._post()
         except Exception as e:   # noqa: BLE001 - handler must answer
             self._reply(500, {"error": "internal", "detail": str(e)})
 
@@ -404,35 +426,40 @@ class _Handler(BaseHTTPRequestHandler):
         immediately (the queue budget frees NOW), so a flood of
         abandoned requests cannot hold queue_full sheds high."""
         deadline = time.monotonic() + 300.0
-        while True:
-            try:
-                first = stream.next_token(timeout=0.25)
-                break
-            except StreamTimeout:
-                if self._client_gone():
-                    stream.cancel()      # evicts a queued request NOW
+        with _tracing.child_span("stream.first_token"):
+            while True:
+                try:
+                    first = stream.next_token(timeout=0.25)
+                    break
+                except StreamTimeout:
+                    if self._client_gone():
+                        stream.cancel()  # evicts a queued request NOW
+                        return
+                    if time.monotonic() >= deadline:
+                        self._reply(500, {
+                            "error": "generation_failed",
+                            "detail": "timed out waiting for the "
+                                      "first token"})
+                        return
+                except OverloadError as e:
+                    # no slot freed within the deadline — still a 429
+                    self._reply(429, e.to_json(), headers={
+                        "Retry-After": str(max(
+                            1, int(e.retry_after_ms / 1e3)))})
                     return
-                if time.monotonic() >= deadline:
+                except Exception as e:  # noqa: BLE001 - request-scoped
                     self._reply(500, {"error": "generation_failed",
-                                      "detail": "timed out waiting "
-                                                "for the first token"})
+                                      "detail": str(e)})
                     return
-            except OverloadError as e:
-                # no slot freed within the deadline — still a 429
-                self._reply(429, e.to_json(), headers={
-                    "Retry-After": str(max(1,
-                                           int(e.retry_after_ms / 1e3)))})
-                return
-            except Exception as e:   # noqa: BLE001 - request-scoped
-                self._reply(500, {"error": "generation_failed",
-                                  "detail": str(e)})
-                return
         if first is None:        # closed with zero tokens (shutdown)
             self._reply(500, {"error": "generation_failed",
                               "detail": "sequence closed before its "
                                         "first token"})
             return
         self.send_response(200)
+        tp = _tracing.traceparent()
+        if tp is not None:
+            self.send_header("traceparent", tp)
         self.send_header("Content-Type", "application/x-ndjson")
         self.send_header("Transfer-Encoding", "chunked")
         self.send_header("Cache-Control", "no-store")
@@ -445,23 +472,27 @@ class _Handler(BaseHTTPRequestHandler):
             self.wfile.flush()
 
         i = 0
-        try:
+        with _tracing.child_span("stream.completion") as csp:
             try:
-                chunk({"token": int(first), "index": i})
-                i += 1
-                for tok in stream:
-                    chunk({"token": int(tok), "index": i})
+                try:
+                    chunk({"token": int(first), "index": i})
                     i += 1
-            except MXNetError as e:
-                chunk({"error": "generation_failed", "detail": str(e),
-                       "done": True})
+                    for tok in stream:
+                        chunk({"token": int(tok), "index": i})
+                        i += 1
+                except MXNetError as e:
+                    chunk({"error": "generation_failed",
+                           "detail": str(e), "done": True})
+                    self.wfile.write(b"0\r\n\r\n")
+                    return
+                chunk({"done": True, "n_tokens": i,
+                       "finish_reason": stream.finish_reason})
                 self.wfile.write(b"0\r\n\r\n")
-                return
-            chunk({"done": True, "n_tokens": i,
-                   "finish_reason": stream.finish_reason})
-            self.wfile.write(b"0\r\n\r\n")
-        except (BrokenPipeError, ConnectionResetError):
-            stream.cancel()
+            except (BrokenPipeError, ConnectionResetError):
+                stream.cancel()
+            finally:
+                csp.set_attr(n_tokens=i,
+                             finish_reason=stream.finish_reason)
 
 
 class _QuietThreadingHTTPServer(ThreadingHTTPServer):

@@ -217,7 +217,9 @@ class ServedModel:
             BUCKET_COMPILES.labels(bucket=_sig_str(shapes)).inc()
         t0 = time.perf_counter()
         out = self._fn(arrays)
-        INFER_SECONDS.observe(time.perf_counter() - t0)
+        from .. import tracing as _tracing
+        INFER_SECONDS.observe(time.perf_counter() - t0,
+                              exemplar=_tracing.current_trace_id())
         return out
 
     def warmup(self, policy: BucketPolicy) -> int:
@@ -590,8 +592,10 @@ class DecodeModel:
             self.params, jnp.asarray(padded), _np.int32(t0))
         out = _np.asarray(logits)
         from .. import metrics as _metrics
+        from .. import tracing as _tracing
         _metrics.GEN_STEP_SECONDS.labels(phase="prefill").observe(
-            time.perf_counter() - t)
+            time.perf_counter() - t,
+            exemplar=_tracing.current_trace_id())
         return out, ks, vs
 
     def greedy_sampling(self, n_slots: int) -> Tuple[_np.ndarray, ...]:
@@ -651,8 +655,10 @@ class DecodeModel:
         cache.replace(new_ks, new_vs)
         out = _np.asarray(toks)
         from .. import metrics as _metrics
+        from .. import tracing as _tracing
         _metrics.GEN_STEP_SECONDS.labels(phase="decode").observe(
-            time.perf_counter() - t)
+            time.perf_counter() - t,
+            exemplar=_tracing.current_trace_id())
         return out
 
     def prefill_suffix(self, tokens: _np.ndarray, prefix_ks: List[Any],
@@ -682,8 +688,10 @@ class DecodeModel:
             jnp.asarray(padded), _np.int32(q), _np.int32(t0))
         out = _np.asarray(logits)
         from .. import metrics as _metrics
+        from .. import tracing as _tracing
         _metrics.GEN_STEP_SECONDS.labels(phase="prefill").observe(
-            time.perf_counter() - t)
+            time.perf_counter() - t,
+            exemplar=_tracing.current_trace_id())
         return out, ks, vs
 
     def select(self, logits: _np.ndarray, seed: int, counter: int,

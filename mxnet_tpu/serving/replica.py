@@ -195,7 +195,13 @@ class ReplicaSupervisor:
             st.pending = False
         WORKER_RESTARTS.labels(server=self.label).inc()
         try:
-            self._spawn(rid)
+            # a restart is its own trace root: no request context
+            # survives to the supervisor thread, but the span still
+            # lands in the ring (error/slow restarts tail-upgrade)
+            from .. import tracing as _tracing
+            with _tracing.span("replica.restart", server=self.label,
+                               replica=rid, delay_s=delay):
+                self._spawn(rid)
         except Exception as e:   # noqa: BLE001 - a failed respawn is
             # one more death: spend another unit of the budget
             self.notify_death(rid, e)

@@ -45,6 +45,7 @@ import numpy as _np
 from ..base import MXNetError, getenv
 from .. import faults as _faults
 from .. import metrics as _metrics
+from .. import tracing as _tracing
 from .batching import (BucketPolicy, DynamicBatcher, OverloadError,
                        REQUESTS_TOTAL, Request)
 from .generation import GenRequest, make_recovery_request
@@ -403,17 +404,27 @@ class ModelServer:
 
     def _execute(self, batch: List[Request]) -> None:
         try:
-            _faults.maybe_fault("serving.execute", batch=len(batch))
-            arrays, _nb = self.policy.assemble(
-                [r.sample for r in batch], batch[0].key)
-            # per-batch execute deadline: the training hang watchdog
-            # reused for serving (MXNET_HEALTH_STEP_DEADLINE_S) — a
-            # wedged model execute dumps all-thread stacks instead of
-            # silently eating the queue's deadline budget
-            from .. import health as _health
-            with _health.watch_section("serving.execute",
-                                       batch=len(batch)):
-                outs = self.model.predict(arrays)
+            # the execute span is its own (head-sampled) trace — a
+            # batch serves many requests, so it LINKS each request's
+            # trace id instead of parenting under any one of them
+            with _tracing.span("serving.execute",
+                               batch=len(batch)) as xsp:
+                for _r in batch:
+                    _tr = getattr(_r, "trace", None)
+                    if _tr is not None:
+                        xsp.add_link(_tr.trace_id)
+                _faults.maybe_fault("serving.execute", batch=len(batch))
+                arrays, _nb = self.policy.assemble(
+                    [r.sample for r in batch], batch[0].key)
+                # per-batch execute deadline: the training hang
+                # watchdog reused for serving
+                # (MXNET_HEALTH_STEP_DEADLINE_S) — a wedged model
+                # execute dumps all-thread stacks instead of silently
+                # eating the queue's deadline budget
+                from .. import health as _health
+                with _health.watch_section("serving.execute",
+                                           batch=len(batch)):
+                    outs = self.model.predict(arrays)
         except Exception as e:   # noqa: BLE001 - worker must survive
             for r in batch:
                 if not r.future.done():
@@ -819,7 +830,15 @@ class GenerationServer:
                 REQUESTS_TOTAL.labels(status="error").inc()
                 continue
             try:
-                r = make_recovery_request(req)
+                # the resurrection stays inside the original request's
+                # trace: attach its captured context so the recovery
+                # span (and the re-prefill that follows on the new
+                # replica) share the request's trace id
+                with _tracing.attach(req.trace), _tracing.child_span(
+                        "serving.recover", site=site,
+                        request_id=req.request_id,
+                        recovered_tokens=len(req.stream.tokens)):
+                    r = make_recovery_request(req)
             except MXNetError as e:
                 req.fail(e)
                 REQUESTS_TOTAL.labels(status="error").inc()

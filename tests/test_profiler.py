@@ -114,3 +114,57 @@ def test_profiler_and_metrics_coexist_without_double_counting():
     mx.nd.dot(a, a)
     assert metrics.value("mxnet_ops_dispatched_total", op="dot") == 6
     metrics.reset()
+
+
+def test_monitors_profiler_and_tracing_instrument_each_op_once(tmp_path):
+    """Every observability layer at once — two Monitors, the profiler,
+    and a tracing span — sees each model op exactly once (ISSUE 16
+    satellite).  A Monitor's stat_func runs abs/mean through the same
+    dispatch layer; those instrumentation-internal dispatches must not
+    re-fire into the OTHER monitor (each monitor's _in_hook only guards
+    against itself), and the span must mirror into the profiler as a
+    direct event append — never as a dispatched op a monitor could see."""
+    from mxnet_tpu import metrics, monitor, tracing
+    metrics.reset()
+    tracing.configure(sample=1.0)
+    out = tmp_path / "both.json"
+    try:
+        m1 = monitor.Monitor(interval=1, pattern=".*")
+        m2 = monitor.Monitor(interval=1, pattern=".*")
+        a = mx.nd.ones((4, 4))
+        m1.tic()
+        m2.tic()
+        profiler.set_config(filename=str(out))
+        profiler.start()
+        with tracing.span("profiled.window"):
+            for _ in range(5):
+                mx.nd.dot(a, a)
+        profiler.stop()
+        r1, r2 = m1.toc(), m2.toc()
+    finally:
+        tracing.configure()
+
+    # each monitor collected exactly the five model ops: no abs/mean
+    # entries re-fired by the other monitor's stat computation, and no
+    # entry for the span
+    for res in (r1, r2):
+        names = [n for _, n, _ in res]
+        assert names == ["dot"] * 5, names
+    exposition = metrics.render_text()
+    assert 'mxnet_monitor_stat{name="dot"}' in exposition
+    assert 'name="abs"' not in exposition
+    assert 'name="mean"' not in exposition
+
+    # the profiler timed each dot once (not once per monitor) and holds
+    # the span as a category-"trace" event alongside the op events
+    table = profiler.dumps()
+    line = [l for l in table.splitlines() if l.startswith("dot")][0]
+    assert int(line.split()[1]) == 5
+    trace = json.load(open(profiler.dump()))
+    span_events = [e for e in trace["traceEvents"]
+                   if e.get("cat") == "trace"]
+    assert any(e["name"] == "profiled.window" for e in span_events)
+
+    # the op dispatch counter also advanced by exactly five for dot
+    assert metrics.value("mxnet_ops_dispatched_total", op="dot") == 5
+    metrics.reset()

@@ -37,6 +37,12 @@ Workloads:
                per-bucket comm latency vs exposed wait, the per-round
                overlap fraction, and compressed-vs-raw wire bytes
                (second fit under 2bit error feedback).
+  trace        a traced generation workload (MXNET_TRACE_SAMPLE=1):
+               the serving/generation latency histograms record the
+               trace id of their slowest recent observation — the
+               ``exemplar`` field in the JSON exposition links a bad
+               histogram straight to the trace that caused it (use
+               ``--format json``; the Prometheus text is unchanged).
   compile-cache  SPMD steps against a fresh persistent compile cache:
                miss + durable write, a second trainer replaying the
                same program from disk (hit), a truncated entry
@@ -446,6 +452,40 @@ def _workload_dist_comm(steps: int) -> None:
         _os.environ["MXNET_KV_SYNTH_WIRE_GBPS"] = "0"
 
 
+def _workload_trace(steps: int) -> None:
+    """Exemplar linkage: a fully-sampled traced generation workload —
+    the serving/gen latency histograms capture the trace id of their
+    slowest recent observation, surfaced as ``exemplar`` in the JSON
+    exposition (``--format json``)."""
+    import numpy as onp
+    import mxnet_tpu as mx
+    from mxnet_tpu import tracing
+    from mxnet_tpu.gluon.model_zoo.gpt import GPTModel
+    from mxnet_tpu.serving import (DecodeModel, GenerationEngine,
+                                   GenerationServer)
+
+    tracing.configure(sample=1.0)
+    mx.random.seed(0)
+    gpt = GPTModel(vocab_size=97, num_layers=2, units=32,
+                   hidden_size=48, num_heads=4, max_length=64,
+                   dropout=0.0)
+    gpt.initialize(mx.init.Normal(1.0))
+    gpt(mx.np.zeros((1, 4), dtype="int32"))
+    eng = GenerationEngine(DecodeModel.from_block(gpt), max_slots=2,
+                           kv_buckets=(16, 32), max_tokens=16)
+    eng.warmup()
+    rng = onp.random.RandomState(0)
+    with GenerationServer(eng) as gs:
+        for i in range(max(steps, 2)):
+            # the client-side root span is what the histograms link to
+            with tracing.span("client.request", i=i):
+                stream = gs.generate(
+                    rng.randint(1, 90, (4 + i % 3,)).astype("int32"),
+                    max_new_tokens=6)
+                stream.result(timeout=60)
+    mx.waitall()
+
+
 WORKLOADS = {
     "resnet_step": _workload_resnet_step,
     "mlp_fit": _workload_mlp_fit,
@@ -458,6 +498,7 @@ WORKLOADS = {
     "dist-resilience": _workload_dist_resilience,
     "compile-cache": _workload_compile_cache,
     "dist-comm": _workload_dist_comm,
+    "trace": _workload_trace,
 }
 
 

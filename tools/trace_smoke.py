@@ -1,0 +1,344 @@
+"""End-to-end distributed-tracing acceptance smoke (ci/run.sh
+trace-smoke, in tier-1).
+
+Bounded (~60s) proof of the ISSUE-16 tracing contract:
+
+1. **serving**: a traced generation request over the real HTTP wire
+   (client-sent W3C ``traceparent``) shows http.request -> queue.wait
+   -> engine.prefill -> stream.first_token/completion all under the
+   CLIENT's trace id on the raw ``GET /v1/traces`` payload, plus >=1
+   engine.iteration span whose ``links`` carry that trace id — and the
+   response echoes the traceparent header.
+2. **training**: a traced SPMD fit step shows prefetch.get and
+   step.dispatch children under one train.step trace; a traced gluon
+   step on a synthetic-slow wire with per-layer backward segmentation
+   shows backward-segment (bulk.segment reason=param_boundary),
+   bucket dispatch/wire, and optimizer.update children in one trace.
+3. **PS propagation**: a gluon step against a live dist_async
+   parameter server produces ``ps.handle`` remote child spans with the
+   worker step's trace id (the traceparent rode the frame header).
+4. **overhead**: on the calibrated micro config, steps/sec traced at
+   1% sampling >= 0.97x tracing-off (median of interleaved windows;
+   one re-measure on a miss), with 0 XLA compiles after warmup.
+
+Exit code 0 = all assertions held.
+"""
+import json
+import os
+import statistics
+import sys
+import time
+
+sys.path.insert(0, os.path.dirname(os.path.dirname(os.path.abspath(__file__))))
+
+CLIENT_TRACE = "4bf92f3577b34da6a3ce929d0e0e4736"
+CLIENT_SPAN = "00f067aa0ba902b7"
+
+
+def _span_events(payload):
+    return [e for e in payload["traceEvents"] if e.get("ph") == "X"
+            and e.get("cat") == "trace"]
+
+
+def _leg_serving():
+    import http.client
+    import numpy as onp
+    import mxnet_tpu as mx
+    from mxnet_tpu import tracing
+    from mxnet_tpu.gluon.model_zoo.gpt import GPTModel
+    from mxnet_tpu.serving import (DecodeModel, GenerationEngine,
+                                   GenerationServer)
+    from mxnet_tpu.serving.http import make_http_server
+    import threading
+
+    tracing.configure(sample=1.0)
+    mx.random.seed(0)
+    gpt = GPTModel(vocab_size=97, num_layers=2, units=32,
+                   hidden_size=48, num_heads=4, max_length=64,
+                   dropout=0.0)
+    gpt.initialize(mx.init.Normal(1.0))
+    gpt(mx.np.zeros((1, 4), dtype="int32"))
+    eng = GenerationEngine(DecodeModel.from_block(gpt), max_slots=2,
+                           kv_buckets=(16, 32), max_tokens=16)
+    eng.warmup()
+    with GenerationServer(eng) as gs:
+        httpd = make_http_server(None, port=0, generation_server=gs)
+        th = threading.Thread(target=httpd.serve_forever, daemon=True)
+        th.start()
+        try:
+            host, port = httpd.server_address[:2]
+            tp = f"00-{CLIENT_TRACE}-{CLIENT_SPAN}-01"
+            conn = http.client.HTTPConnection(host, port, timeout=60)
+            body = json.dumps({
+                "tokens": onp.arange(1, 6).tolist(),
+                "max_new_tokens": 6, "stream": True})
+            conn.request("POST", "/v1/generate", body,
+                         {"Content-Type": "application/json",
+                          "traceparent": tp})
+            resp = conn.getresponse()
+            lines = resp.read().decode().strip().splitlines()
+            assert resp.status == 200, resp.status
+            echo = resp.getheader("traceparent")
+            assert echo is not None and \
+                echo.split("-")[1] == CLIENT_TRACE, \
+                f"traceparent not echoed: {echo!r}"
+            toks = [json.loads(l)["token"] for l in lines
+                    if "token" in json.loads(l)]
+            assert len(toks) == 6, lines
+
+            conn.request("GET", "/v1/traces", headers={})
+            tresp = conn.getresponse()
+            payload = json.loads(tresp.read())
+            conn.close()
+        finally:
+            httpd.shutdown()
+            httpd.server_close()
+
+    events = _span_events(payload)
+    mine = [e for e in events
+            if e["args"]["trace_id"] == CLIENT_TRACE]
+    names = {e["name"] for e in mine}
+    need = {"http.request", "queue.wait", "engine.prefill",
+            "stream.first_token", "stream.completion"}
+    assert need <= names, \
+        f"client trace misses spans: {sorted(need - names)} " \
+        f"(has {sorted(names)})"
+    # the request's subsystems under ONE trace id on the raw wire:
+    # HTTP front end, batcher queue, engine admission, token stream
+    assert len(names) >= 4, names
+    # the http.request span is the remote child of the CLIENT's span
+    root = [e for e in mine if e["name"] == "http.request"]
+    assert root and root[0]["args"]["parent_id"] == CLIENT_SPAN, root
+    linked = [e for e in events if e["name"] == "engine.iteration"
+              and CLIENT_TRACE in (e["args"].get("links") or [])]
+    assert linked, "no engine.iteration span links the request trace"
+    print(f"serving leg OK: {sorted(names)} under one trace id; "
+          f"{len(linked)} iteration span(s) link it")
+
+
+def _leg_training_spmd():
+    import jax
+    import numpy as onp
+    import mxnet_tpu as mx
+    from mxnet_tpu import tracing
+    from mxnet_tpu.io import DevicePrefetcher
+    from mxnet_tpu.parallel import SPMDTrainer, make_mesh
+
+    tracing.configure(sample=1.0)
+    mx.random.seed(0)
+    net = mx.gluon.nn.Dense(4)
+    net.initialize()
+    net(mx.np.zeros((2, 8)))
+    trainer = SPMDTrainer(net, mx.gluon.loss.L2Loss(), "sgd",
+                          {"learning_rate": 0.05},
+                          mesh=make_mesh({"dp": 1},
+                                         devices=jax.devices()[:1]))
+
+    def batch_fn(step):
+        rng = onp.random.RandomState(step)
+        return (mx.np.array(rng.uniform(-1, 1, (8, 8)).astype("f4")),
+                mx.np.array(rng.uniform(-1, 1, (8, 4)).astype("f4")))
+
+    pf = DevicePrefetcher(batch_fn, depth=2)
+    trainer.fit(pf, 3)
+    pf.close()
+    mx.waitall()
+
+    roots = [r for r in tracing.spans() if r["name"] == "train.step"]
+    assert roots, "no train.step root spans recorded"
+    tid = roots[-1]["trace_id"]
+    kids = {r["name"] for r in tracing.spans(tid)}
+    need = {"train.step", "prefetch.get", "step.dispatch"}
+    assert need <= kids, f"train.step trace misses: {need - kids} " \
+                         f"(has {sorted(kids)})"
+    print(f"training leg (spmd fit) OK: {sorted(kids)}")
+
+
+def _leg_training_gluon():
+    import mxnet_tpu as mx
+    from mxnet_tpu import tracing
+    from mxnet_tpu.ndarray import ops
+
+    os.environ["MXNET_KV_OVERLAP"] = "1"
+    os.environ["MXNET_KV_BUCKET_BYTES"] = str(256 * 1024)
+    os.environ["MXNET_KV_SYNTH_WIRE_GBPS"] = "4.0"
+    os.environ["MXNET_BULK_BACKWARD_SEGMENTS"] = "param"
+    os.environ["MXNET_KV_BACKWARD_STREAM"] = "1"
+    try:
+        tracing.configure(sample=1.0)
+        mx.random.seed(0)
+        ps = {}
+        for j in range(8):
+            p = mx.gluon.Parameter(f"w{j}", shape=(128 * 1024,))
+            p.initialize()
+            ps[f"w{j}"] = p
+        tr = mx.gluon.Trainer(ps, "sgd", {"learning_rate": 1e-3})
+        tid = None
+        for _ in range(2):
+            # the smoke's own root: backward runs before Trainer.step,
+            # so the backward-segment and streamed-bucket spans need a
+            # trace already open when they fire
+            with tracing.span("train.step") as sp:
+                with mx.autograd.record():
+                    loss = ops.add_n(
+                        *[p.data()[:64] for p in ps.values()]).mean()
+                loss.backward()
+                tr.step(1)
+                loss.asnumpy()
+                tid = sp.trace_id
+        mx.waitall()
+    finally:
+        os.environ["MXNET_KV_SYNTH_WIRE_GBPS"] = "0"
+        os.environ.pop("MXNET_BULK_BACKWARD_SEGMENTS", None)
+
+    recs = tracing.spans(tid)
+    names = {r["name"] for r in recs}
+    need = {"train.step", "trainer.step", "bucket.wire",
+            "bucket.dispatch", "optimizer.update"}
+    assert need <= names, f"gluon trace misses: {need - names} " \
+                          f"(has {sorted(names)})"
+    segs = [r for r in recs if r["name"] == "bulk.segment"
+            and r["attrs"].get("reason") == "param_boundary"]
+    assert segs, f"no per-layer backward-segment spans (has {names})"
+    print(f"training leg (gluon, synth wire) OK: {sorted(names)}")
+
+
+def _leg_ps_remote_child():
+    import threading
+    import numpy as onp
+    import mxnet_tpu as mx
+    from mxnet_tpu import tracing
+    from mxnet_tpu.kvstore_async import run_server, KVStoreDistAsync
+    from tests.test_distributed import _free_port
+
+    port = _free_port()
+    os.environ.update(DMLC_PS_ROOT_URI="127.0.0.1",
+                      DMLC_PS_ROOT_PORT=str(port),
+                      DMLC_NUM_SERVER="1", DMLC_NUM_WORKER="1",
+                      DMLC_WORKER_ID="0")
+    ev = threading.Event()
+    th = threading.Thread(target=run_server, args=(port, 1, ev),
+                          daemon=True)
+    th.start()
+    assert ev.wait(20), "PS server did not come up"
+    tracing.configure(sample=1.0)
+    kv = KVStoreDistAsync()
+    try:
+        mx.random.seed(0)
+        net = mx.gluon.nn.Dense(4, in_units=8)
+        net.initialize()
+        tr = mx.gluon.Trainer(net.collect_params(), "sgd",
+                              {"learning_rate": 0.1}, kvstore=kv)
+        x = mx.nd.array(onp.random.RandomState(0)
+                        .rand(2, 8).astype("f4"))
+        with mx.autograd.record():
+            loss = (net(x) ** 2).sum()
+        loss.backward()
+        tr.step(batch_size=2)
+        mx.waitall()
+    finally:
+        kv.stop_servers()
+        th.join(10)
+
+    roots = [r for r in tracing.spans()
+             if r["name"] == "trainer.step"]
+    assert roots, "no trainer.step root spans recorded"
+    tid = roots[-1]["trace_id"]
+    recs = tracing.spans(tid)
+    ps_spans = [r for r in recs if r["name"] == "ps.handle"]
+    assert ps_spans, \
+        "no ps.handle remote child span in the step trace " \
+        f"(has {sorted({r['name'] for r in recs})})"
+    # remote child: same trace id, parented by a worker-side span id
+    worker_ids = {r["span_id"] for r in recs}
+    assert any(r["parent_id"] in worker_ids or r["parent_id"]
+               for r in ps_spans)
+    subsystems = {r["name"] for r in recs}
+    assert len(subsystems) >= 4, subsystems
+    print(f"PS leg OK: {sorted(subsystems)} under one trace id "
+          f"({len(ps_spans)} ps.handle remote child spans)")
+
+
+def _leg_overhead():
+    import jax
+    import numpy as onp
+    import mxnet_tpu as mx
+    from mxnet_tpu import metrics, tracing
+    from mxnet_tpu.parallel import (SPMDTrainer, make_mesh,
+                                    DATA_PARALLEL_RULES)
+
+    mx.random.seed(0)
+    net = mx.gluon.nn.Sequential()
+    net.add(mx.gluon.nn.Dense(512, activation="relu"),
+            mx.gluon.nn.Dense(64))
+    net.initialize()
+    net(mx.np.zeros((2, 256)))
+    trainer = SPMDTrainer(net, mx.gluon.loss.L2Loss(), "sgd",
+                          {"learning_rate": 0.01},
+                          mesh=make_mesh({"dp": 1},
+                                         devices=jax.devices()[:1]),
+                          rules=DATA_PARALLEL_RULES)
+    rng = onp.random.RandomState(0)
+    batch = (mx.np.array(rng.uniform(-1, 1, (256, 256)).astype("f4")),
+             mx.np.array(rng.uniform(-1, 1, (256, 64)).astype("f4")))
+
+    def batch_fn(step):
+        return batch
+
+    # 30-step windows (~70ms) vary ~10% between identical back-to-back
+    # runs — the window must be long enough that scheduler noise sits
+    # well under the 3% overhead budget being gated
+    STEPS, WINDOWS = 120, 3
+    trainer.fit(batch_fn, 8)                       # warmup: compile
+    c0 = metrics.value("mxnet_compile_misses_total")
+
+    def window():
+        start = trainer._step_count
+        t0 = time.perf_counter()
+        trainer.fit(batch_fn, start + STEPS)
+        mx.waitall()
+        return STEPS / (time.perf_counter() - t0)
+
+    def measure():
+        off, on = [], []
+        for _ in range(WINDOWS):                   # interleaved
+            tracing.configure(sample=0.0)
+            off.append(window())
+            tracing.configure(sample=0.01, slow_ms=10_000.0)
+            on.append(window())
+        tracing.configure()                        # back to env values
+        return statistics.median(on) / statistics.median(off)
+
+    ratio = measure()
+    if ratio < 0.97:                               # noisy host: one
+        ratio = max(ratio, measure())              # re-measure
+    compiles = metrics.value("mxnet_compile_misses_total") - c0
+    assert compiles == 0, \
+        f"{compiles:.0f} XLA compiles after warmup (want 0)"
+    assert ratio >= 0.97, \
+        f"traced-at-1% steps/sec is {ratio:.3f}x tracing-off " \
+        "(gate: >= 0.97x)"
+    print(f"overhead leg OK: traced/off steps-per-sec ratio "
+          f"{ratio:.3f} (>= 0.97), 0 compiles after warmup")
+
+
+def main() -> None:
+    import jax
+    jax.config.update("jax_platforms", "cpu")
+    os.environ.setdefault("MXNET_TRACE_BUFFER_SPANS", "8192")
+    from mxnet_tpu import tracing
+
+    _leg_serving()
+    tracing.reset()
+    _leg_training_spmd()
+    tracing.reset()
+    _leg_training_gluon()
+    tracing.reset()
+    _leg_ps_remote_child()
+    tracing.reset()
+    _leg_overhead()
+    print("trace smoke PASSED")
+
+
+if __name__ == "__main__":
+    main()
