@@ -25,7 +25,10 @@
 #                           #   clients, >=2x tokens/sec vs sequential
 #                           #   one-shot-per-token, 0 decode compiles
 #                           #   after warmup, clean shed under a
-#                           #   2x-slot flood
+#                           #   2x-slot flood; PLUS the speculative
+#                           #   leg: draft/verify >=1.3x tokens/sec,
+#                           #   accepted/step >1.0, byte-identical
+#                           #   streams, rollback + worker-kill legs
 #   ci/run.sh resilience-smoke # serving resilience gate: seeded
 #                           #   worker-kill mid-stream -> every stream
 #                           #   completes token-identical to the
@@ -157,6 +160,15 @@ run_generation_smoke() {
   echo "   the noisy throughput gate gets one re-measure on a miss)"
   JAX_PLATFORMS=cpu timeout 900 python tools/serve_bench.py \
     --generate --smoke
+  echo "== generation-smoke (speculative): draft/verify decoding"
+  echo "   >=1.3x tokens/sec over the non-speculative engine,"
+  echo "   accepted-tokens/step >1.0, greedy AND sampled streams"
+  echo "   byte-identical at the same seeds, truncated-draft leg"
+  echo "   rejects+rolls back KV rows without changing a byte, seeded"
+  echo "   worker-kill replays speculative streams token-identically,"
+  echo "   0 XLA compiles after warmup"
+  JAX_PLATFORMS=cpu timeout 900 python tools/serve_bench.py \
+    --generate --speculative --smoke
 }
 
 run_faultdoc() {

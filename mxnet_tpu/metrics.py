@@ -257,16 +257,22 @@ _EXEMPLAR_TTL_S = 60.0
 
 
 class _HistogramChild:
-    __slots__ = ("bounds", "counts", "sum", "count", "exemplar")
+    __slots__ = ("bounds", "counts", "sum", "count", "exemplar", "pref")
 
-    def __init__(self, bounds: Tuple[float, ...]) -> None:
+    def __init__(self, bounds: Tuple[float, ...],
+                 pref: str = "max") -> None:
         self.bounds = bounds
         self.counts = [0] * (len(bounds) + 1)  # +1: the +Inf bucket
         self.sum = 0.0
         self.count = 0
-        # (trace_id, value, unix time) of the slowest recent observation
-        # that carried a trace id (tracing exemplar linkage)
+        # (trace_id, value, unix time) of the most-extreme recent
+        # observation that carried a trace id (tracing exemplar
+        # linkage).  ``pref`` picks the direction: "max" keeps the
+        # slowest/largest recent value (latency histograms), "min" the
+        # smallest (e.g. the worst-accepting speculative step, where
+        # LOW is the pathology worth a trace)
         self.exemplar: Optional[Tuple[str, float, float]] = None
+        self.pref = pref
 
     def observe(self, lock: threading.Lock, v: float,
                 exemplar: Optional[str] = None) -> None:
@@ -279,7 +285,9 @@ class _HistogramChild:
             if exemplar is not None:
                 ex = self.exemplar
                 now = time.time()
-                if ex is None or v >= ex[1] \
+                extreme = ex is not None and (
+                    v <= ex[1] if self.pref == "min" else v >= ex[1])
+                if ex is None or extreme \
                         or now - ex[2] > _EXEMPLAR_TTL_S:
                     self.exemplar = (str(exemplar), v, now)
 
@@ -363,17 +371,23 @@ class Histogram(_Family):
     kind = "histogram"
 
     def __init__(self, name: str, doc: str, labels: Sequence[str] = (),
-                 buckets: Optional[Sequence[float]] = None) -> None:
+                 buckets: Optional[Sequence[float]] = None,
+                 exemplar_pref: str = "max") -> None:
         bounds = tuple(sorted(float(b) for b in
                               (buckets if buckets is not None
                                else DEFAULT_BUCKETS)))
         if not bounds:
             raise MXNetError("histogram needs at least one bucket bound")
+        if exemplar_pref not in ("max", "min"):
+            raise MXNetError(
+                f"exemplar_pref must be 'max' or 'min', got "
+                f"{exemplar_pref!r}")
         self.bounds = bounds
+        self.exemplar_pref = exemplar_pref
         super().__init__(name, doc, labels)
 
     def _new_child(self) -> _HistogramChild:
-        return _HistogramChild(self.bounds)
+        return _HistogramChild(self.bounds, self.exemplar_pref)
 
     def observe(self, v: float, exemplar: Optional[str] = None) -> None:
         self._default().observe(self._lock, v, exemplar)
@@ -425,9 +439,11 @@ class MetricsRegistry:
 
     def histogram(self, name: str, doc: str = "",
                   labels: Sequence[str] = (),
-                  buckets: Optional[Sequence[float]] = None) -> Histogram:
+                  buckets: Optional[Sequence[float]] = None,
+                  exemplar_pref: str = "max") -> Histogram:
         return self._register(Histogram, name, doc, labels,
-                              buckets=buckets)
+                              buckets=buckets,
+                              exemplar_pref=exemplar_pref)
 
     def get(self, name: str) -> Optional[_Family]:
         with self._lock:
@@ -473,9 +489,10 @@ def gauge(name: str, doc: str = "", labels: Sequence[str] = ()) -> Gauge:
 
 
 def histogram(name: str, doc: str = "", labels: Sequence[str] = (),
-              buckets: Optional[Sequence[float]] = None) -> Histogram:
+              buckets: Optional[Sequence[float]] = None,
+              exemplar_pref: str = "max") -> Histogram:
     """Get-or-create a histogram family on the global registry."""
-    return REGISTRY.histogram(name, doc, labels, buckets)
+    return REGISTRY.histogram(name, doc, labels, buckets, exemplar_pref)
 
 
 def dump_json() -> Dict[str, Any]:
@@ -742,6 +759,43 @@ GEN_PREFIX_ROWS = gauge(
     "KV positions (padded prefix rows, summed over resident entries) "
     "currently held in the shared-prefix cache — the device-memory "
     "footprint is rows x layers x heads x head_dim x 2 (K and V).")
+GEN_SPEC_PROPOSED_TOKENS_TOTAL = counter(
+    "mxnet_gen_spec_proposed_tokens_total",
+    "Draft tokens proposed by the speculative-decoding subsystem "
+    "(serving/speculation.py): k per speculative slot per iteration, "
+    "before the target model's verify pass accepts or rejects them.")
+GEN_SPEC_ACCEPTED_TOKENS_TOTAL = counter(
+    "mxnet_gen_spec_accepted_tokens_total",
+    "Draft tokens ACCEPTED by the verify pass: the draft token equaled "
+    "the target's own sampled token at that position under the "
+    "request's counter-PRNG key (greedy requests compare against the "
+    "argmax). The accept rule makes speculative output byte-identical "
+    "to non-speculative output at the same seed.")
+GEN_SPEC_REJECTED_TOKENS_TOTAL = counter(
+    "mxnet_gen_spec_rejected_tokens_total",
+    "Draft tokens rejected by the verify pass (everything proposed "
+    "after the first mismatch is discarded and the KV rows it wrote "
+    "roll back — see mxnet_gen_kv_rollbacks_total).")
+GEN_SPEC_ACCEPT_RATE = gauge(
+    "mxnet_gen_spec_accept_rate",
+    "Fraction of proposed draft tokens accepted over the engine's "
+    "lifetime (accepted / proposed; 0 until the first speculative "
+    "iteration). The economics dial of speculative decoding: uplift "
+    "~ (1 + k * accept_rate) tokens per target step minus draft cost.")
+GEN_SPEC_ACCEPTED_PER_STEP = histogram(
+    "mxnet_gen_spec_accepted_per_step",
+    "Tokens emitted per speculative slot-step (1 bonus token + the "
+    "accepted draft prefix; 1 means every draft was rejected). The "
+    "exemplar carries the trace id of the WORST-accepting recent step "
+    "(lowest value), so a sagging accept rate points at a concrete "
+    "iteration trace.",
+    buckets=exponential_buckets(1.0, 2.0, 6), exemplar_pref="min")
+GEN_KV_ROLLBACKS_TOTAL = counter(
+    "mxnet_gen_kv_rollbacks_total",
+    "PagedKVCache.truncate() rollbacks: slot positions rewound after "
+    "the verify pass rejected draft tokens (their speculatively "
+    "written KV rows become invisible to the position mask and are "
+    "overwritten by the next accepted token).")
 
 # -- async device-prefetch input pipeline (io/prefetch.py) ------------------
 PREFETCH_QUEUE_DEPTH = gauge(

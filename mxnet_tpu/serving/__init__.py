@@ -42,6 +42,17 @@ in-process, with no dependencies beyond the stdlib:
   per-token streaming (:class:`TokenStream`), hosted by
   :class:`~mxnet_tpu.serving.server.GenerationServer`.
 
+* :mod:`~mxnet_tpu.serving.speculation` (ISSUE 17) — SPECULATIVE
+  DECODING: a :class:`DraftModel` (either a truncated-layer
+  :class:`SelfSpeculativeDraft` reusing the target's own weights and KV
+  rows, or an :class:`IndependentDraft` wrapping a small same-tokenizer
+  model with its own mirrored :class:`PagedKVCache`) proposes ``k``
+  tokens per engine iteration; a single bucket-compiled ``verify_k``
+  target pass scores all of them at once, the accept rule replays the
+  per-slot counter-PRNG lanes so output stays **byte-identical** to
+  non-speculative decoding at the same seed, and rejected rows roll
+  back via ``PagedKVCache.truncate``.
+
 * :mod:`~mxnet_tpu.serving.replica` + the server-side resilience layer
   (ISSUE 7): both servers host ``MXNET_SERVING_REPLICAS`` worker
   replicas behind a router — a dead worker's requests requeue (and
@@ -68,6 +79,8 @@ from .generation import GenerationEngine, StreamTimeout, TokenStream
 from .replica import ReplicaSupervisor
 from .server import (DegradedError, GenerationServer, ModelServer,
                      serve_until_preempted)
+from .speculation import (DraftModel, IndependentDraft,
+                          SelfSpeculativeDraft)
 from .http import make_http_server
 
 __all__ = [
@@ -75,5 +88,6 @@ __all__ = [
     "SlotScheduler", "ServedModel", "DecodeModel", "PagedKVCache",
     "PrefixCache", "GenerationEngine", "StreamTimeout", "TokenStream",
     "GenerationServer", "load_served", "ModelServer", "DegradedError",
+    "DraftModel", "SelfSpeculativeDraft", "IndependentDraft",
     "ReplicaSupervisor", "make_http_server", "serve_until_preempted",
 ]

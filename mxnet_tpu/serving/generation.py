@@ -93,6 +93,25 @@ register_env("MXNET_GEN_TOP_P", 0.9,
              "Default nucleus mass for top_p decoding when the "
              "request names none (0 < top_p <= 1). Per-request "
              "'top_p' overrides.")
+register_env("MXNET_GEN_SPEC_MODE", "off",
+             "Speculative decoding mode for the generation engine: "
+             "'off' (one token per slot per iteration), 'self' (the "
+             "target's own bottom MXNET_GEN_SPEC_DRAFT_LAYERS layers "
+             "draft), or 'draft' (a separate small model passed to "
+             "the engine as draft_model= drafts). Output is "
+             "byte-identical to 'off' at the same seed — speculation "
+             "only changes how many tokens an iteration emits. "
+             "Per-request 'speculative': false opts a request out.")
+register_env("MXNET_GEN_SPEC_K", 4,
+             "Draft tokens proposed per slot per iteration when "
+             "speculative decoding is on (>= 1). The target verifies "
+             "k proposals plus its own next token in one pass, so an "
+             "iteration emits 1..k+1 tokens per speculative slot.")
+register_env("MXNET_GEN_SPEC_DRAFT_LAYERS", 0,
+             "Transformer layers the self-speculative draft keeps "
+             "from the target model (spec mode 'self'; 0 = half the "
+             "target's layers). Fewer layers = cheaper proposals but "
+             "lower acceptance.")
 
 
 class StreamTimeout(MXNetError):
@@ -151,6 +170,36 @@ class TokenStream:
                 self._ready.notify_all()
         if gap is not None:
             # outside the lock: fail() retakes it
+            self.fail(MXNetError(
+                f"token stream gap: producer emitted index {gap} but "
+                f"the transcript holds {len(self.tokens)} tokens — a "
+                "recovery dropped tokens (exactly-once invariant "
+                "violated)"))
+
+    def put_many(self, tokens: Sequence[int], start_index: int) -> None:
+        """Append a CONTIGUOUS run of tokens whose first absolute index
+        is ``start_index`` — the speculative path's multi-token
+        emission.  Per-token semantics are identical to calling
+        :meth:`put` in a loop (an index the transcript holds is
+        dropped, an index past it fails the stream), but the whole run
+        lands under ONE lock pass with one consumer wakeup, so the
+        HTTP layer drains it as one chunked write instead of k."""
+        gap: Optional[int] = None
+        with self._lock:
+            if self._done:
+                return
+            for i, token in enumerate(tokens):
+                index = int(start_index) + i
+                if index < len(self.tokens):
+                    _metrics.SERVING_STREAM_DUPES_DROPPED.inc()
+                    continue
+                if index > len(self.tokens):
+                    gap = index
+                    break
+                self.tokens.append(int(token))
+                self._buf.append(int(token))
+            self._ready.notify_all()
+        if gap is not None:
             self.fail(MXNetError(
                 f"token stream gap: producer emitted index {gap} but "
                 f"the transcript holds {len(self.tokens)} tokens — a "
@@ -267,7 +316,7 @@ class GenRequest:
                  "t_first", "request_id", "orig_prompt",
                  "total_new_tokens", "offset", "recover_t0",
                  "recoveries", "method", "temperature", "top_k",
-                 "top_p", "seed", "trace")
+                 "top_p", "seed", "speculative", "trace")
 
     _SEQ = _itertools.count(1)
 
@@ -282,7 +331,8 @@ class GenRequest:
                  temperature: float = 1.0,
                  top_k: int = 40,
                  top_p: float = 0.9,
-                 seed: int = 0) -> None:
+                 seed: int = 0,
+                 speculative: bool = False) -> None:
         self.tokens = tokens
         self.max_new_tokens = int(max_new_tokens)
         self.eos_token = eos_token
@@ -291,6 +341,7 @@ class GenRequest:
         self.top_k = int(top_k)
         self.top_p = float(top_p)
         self.seed = int(seed)
+        self.speculative = bool(speculative)
         self.stream = stream if stream is not None else TokenStream()
         self.enqueue_t = time.monotonic()
         self.deadline_t = deadline_t
@@ -346,7 +397,8 @@ def make_recovery_request(req: GenRequest) -> GenRequest:
                    total_new_tokens=req.total_new_tokens,
                    offset=emitted, method=req.method,
                    temperature=req.temperature, top_k=req.top_k,
-                   top_p=req.top_p, seed=req.seed)
+                   top_p=req.top_p, seed=req.seed,
+                   speculative=req.speculative)
     r.recover_t0 = time.monotonic()
     r.recoveries = req.recoveries + 1
     r.trace = req.trace      # the resurrection stays in the original
@@ -389,7 +441,11 @@ class GenerationEngine:
                  default_method: Optional[str] = None,
                  default_temperature: Optional[float] = None,
                  default_top_k: Optional[int] = None,
-                 default_top_p: Optional[float] = None) -> None:
+                 default_top_p: Optional[float] = None,
+                 spec_mode: Optional[str] = None,
+                 spec_k: Optional[int] = None,
+                 spec_draft_layers: Optional[int] = None,
+                 draft_model: Any = None) -> None:
         self.model = model
         if max_slots is None:
             max_slots = int(getenv("MXNET_GEN_MAX_SLOTS", 8))
@@ -467,6 +523,27 @@ class GenerationEngine:
         # handed to it for resurrection instead of failed terminally;
         # signature sink(victims: List[GenRequest], exc, site: str)
         self.recovery_sink: Optional[Any] = None
+        # speculative decoding: a DraftModel (or None when off).
+        # Requests default to speculating whenever a draft exists;
+        # per-request speculative=False opts out and mixed iterations
+        # ride the verify program together (plain slots just keep only
+        # the first verified token)
+        self.spec_mode = str(
+            spec_mode if spec_mode is not None
+            else getenv("MXNET_GEN_SPEC_MODE", "off"))
+        self.spec_k = int(
+            spec_k if spec_k is not None
+            else getenv("MXNET_GEN_SPEC_K", 4))
+        spec_layers = int(
+            spec_draft_layers if spec_draft_layers is not None
+            else getenv("MXNET_GEN_SPEC_DRAFT_LAYERS", 0))
+        from .speculation import make_draft
+        self._draft = make_draft(
+            self.spec_mode, model, self.spec_k, layers=spec_layers,
+            draft_model=draft_model, max_slots=self.max_slots,
+            buckets=self.grid)
+        self._spec_proposed = 0
+        self._spec_accepted = 0
 
     # -- lifecycle ----------------------------------------------------------
     def warmup(self) -> int:
@@ -480,7 +557,31 @@ class GenerationEngine:
             self.cache, self.prompt_buckets,
             suffix_pairs=self.cache.prefix.slots > 0)
         self.warmed += self.cache.warmup_writes(self.prompt_buckets)
+        if self._draft is not None:
+            self.warmed += self._draft.warmup(self.prompt_buckets)
+            self.warmed += self._warmup_spec()
         return self.warmed
+
+    def _warmup_spec(self) -> int:
+        """Pre-compile the speculative pair — the draft-proposal chain
+        and the (k+1)-token verify pass — for every KV bucket, so
+        speculative steady-state traffic compiles nothing either."""
+        S = self.cache.max_slots
+        toks = _np.zeros((S,), _np.int32)
+        pos = _np.zeros((S,), _np.int32)
+        n = 0
+        for b in self.cache.grid:
+            self.cache.bucket = int(b)
+            self.cache._alloc_buffers(self.cache.bucket)
+            drafts = self._draft.propose(self.cache, toks, pos)
+            cand = _np.concatenate(
+                [toks[:, None], _np.asarray(drafts, _np.int32)],
+                axis=1)
+            self.model.verify(self.cache, cand, pos)
+            n += 2
+        self.cache.bucket = self.cache.grid[0]
+        self.cache._alloc_buffers(self.cache.bucket)
+        return n
 
     def close(self) -> None:
         """Fail everything in flight and stop admissions."""
@@ -520,6 +621,8 @@ class GenerationEngine:
                 resident.append(req)
         self._in_admission = []
         self.cache.reset_buffers()
+        if self._draft is not None:
+            self._draft.evacuate()
         # fresh lanes: stale sampling methods on freed slots would
         # keep steering the step into its sampler branch for nothing
         self._samp = self.model.greedy_sampling(self.max_slots)
@@ -561,7 +664,8 @@ class GenerationEngine:
                temperature: Optional[float] = None,
                top_k: Optional[int] = None,
                top_p: Optional[float] = None,
-               seed: Optional[int] = None) -> TokenStream:
+               seed: Optional[int] = None,
+               speculative: Optional[bool] = None) -> TokenStream:
         """Queue one prompt; returns its :class:`TokenStream`.  Sheds
         with :class:`OverloadError` when the admission queue is full;
         rejects (plain ``MXNetError``) prompts whose budget cannot fit
@@ -570,7 +674,11 @@ class GenerationEngine:
         (``method`` sample/top_k/top_p with ``temperature``/``top_k``/
         ``top_p``) runs on the device under per-slot counter-PRNG keys
         derived from ``seed``: same seed => same stream, across
-        worker-death resurrection included."""
+        worker-death resurrection included.  ``speculative`` defaults
+        to whether the engine has a draft (MXNET_GEN_SPEC_MODE);
+        ``False`` opts this request out of drafting, ``True`` on an
+        engine without a draft quietly decodes plain — either way the
+        token stream is the same bytes."""
         toks = _np.asarray(tokens, _np.int32).reshape(-1)
         if toks.size < 1:
             raise MXNetError("empty prompt")
@@ -599,9 +707,12 @@ class GenerationEngine:
             deadline_ms = self._default_deadline_s * 1e3
         deadline_t = (time.monotonic() + deadline_ms / 1e3
                       if deadline_ms else None)
+        spec = bool(speculative) if speculative is not None \
+            else self._draft is not None
         req = GenRequest(toks, max_new_tokens, eos_token, deadline_t,
                          method=method, temperature=temperature,
-                         top_k=top_k, top_p=top_p, seed=seed)
+                         top_k=top_k, top_p=top_p, seed=seed,
+                         speculative=spec)
         # consumer cancel while still queued -> evict NOW (queue budget
         # frees immediately; an abandoned-request flood cannot hold
         # queue_full sheds high until the next admission pass)
@@ -677,6 +788,8 @@ class GenerationEngine:
         _metrics.GEN_SLOTS_ACTIVE.set(len(active))
         if not active:
             self.cache.reset_if_empty()
+            if self._draft is not None:
+                self._draft.reset_if_empty()
             self.iteration_log.append(log)
             return bool(log["admitted"] or log["retired"])
 
@@ -686,27 +799,69 @@ class GenerationEngine:
         #    of them; instead it LINKS every resident request's trace
         #    id, and a request's trace finds "its" decode steps by
         #    searching iteration spans that link it.
+        #    When any resident request speculates, the WHOLE iteration
+        #    rides the draft+verify pair (one program each): the draft
+        #    proposes k tokens per slot, verify scores all k+1
+        #    positions in one target pass, and plain slots simply keep
+        #    only the first verified token — which is bit-identical to
+        #    what the plain step would have produced.
+        spec_k = self._draft.k if self._draft is not None else 0
+        spec_slots = frozenset(
+            s for s, r in active.items()
+            if spec_k and getattr(r, "speculative", False))
+        use_spec = bool(spec_slots)
+        iter_tid = None
         try:
             with _tracing.span("engine.iteration", iter=self._iter,
                                slots=len(active)) as isp:
+                iter_tid = _tracing.current_trace_id()
                 for _r in active.values():
                     _tr = getattr(_r, "trace", None)
                     if _tr is not None:
                         isp.add_link(_tr.trace_id)
                 _faults.maybe_fault("serving.execute", phase="decode",
                                     slots=len(active))
-                self.cache.ensure_capacity(
-                    self.cache.needed_capacity())
+                if use_spec:
+                    # verify scatters k rows past every slot's
+                    # position: grow for the worst case up front,
+                    # capped at the grid top (rows past it belong to
+                    # tokens the submit-time budget check proves are
+                    # never emitted)
+                    self.cache.ensure_capacity(
+                        min(self.cache.needed_capacity() + spec_k,
+                            self.grid[-1]))
+                else:
+                    self.cache.ensure_capacity(
+                        self.cache.needed_capacity())
                 pos = _np.maximum(self.cache.positions,
                                   0).astype(_np.int32)
                 if self._samp_dev is None:
                     self._samp_dev = self.model.device_sampling(
                         self._samp)
-                with _health.watch_section("generation.step",
-                                           slots=len(active)):
-                    next_tok = self.model.step(self.cache,
-                                               self._last_tok,
-                                               pos, self._samp_dev)
+                if use_spec:
+                    with _tracing.child_span(
+                            "engine.draft",
+                            slots=len(spec_slots), k=spec_k):
+                        drafts = self._draft.propose(
+                            self.cache, self._last_tok, pos,
+                            self._samp_dev)
+                    cand = _np.concatenate(
+                        [self._last_tok[:, None],
+                         _np.asarray(drafts, _np.int32)], axis=1)
+                    with _health.watch_section("generation.step",
+                                               slots=len(active)):
+                        with _tracing.child_span(
+                                "engine.verify",
+                                slots=len(active), k=spec_k):
+                            ver = self.model.verify(
+                                self.cache, cand, pos,
+                                self._samp_dev)
+                else:
+                    with _health.watch_section("generation.step",
+                                               slots=len(active)):
+                        next_tok = self.model.step(self.cache,
+                                                   self._last_tok,
+                                                   pos, self._samp_dev)
         except Exception as e:   # noqa: BLE001 - an iteration fault
             # hits exactly the sequences IN FLIGHT at this iteration
             # (their kv rows are suspect); queued requests and the
@@ -715,6 +870,10 @@ class GenerationEngine:
             # cache holding deleted arrays — reallocate before the next
             # admission touches them
             self.cache.reset_buffers()
+            if self._draft is not None:
+                # the draft's own buffers may have been donated to a
+                # dispatch this fault interrupted
+                self._draft.reset()
             victims: List[GenRequest] = []
             for slot, req in active.items():
                 if self.recovery_sink is not None \
@@ -725,6 +884,8 @@ class GenerationEngine:
                     # release the slot WITHOUT closing the stream
                     self.scheduler.release(slot)
                     self.cache.free(slot)
+                    if self._draft is not None:
+                        self._draft.release(slot)
                     if self._samp[5][slot]:
                         self._samp[5][slot] = 0
                         self._samp_dev = None
@@ -745,17 +906,81 @@ class GenerationEngine:
 
         now = time.monotonic()
         n_streamed = 0
+        it_proposed = it_accepted = 0
         for slot, req in active.items():
-            tok = int(next_tok[slot])
-            self.cache.positions[slot] += 1
-            self._last_tok[slot] = tok
-            _metrics.GEN_SAMPLED_TOKENS_TOTAL.labels(
-                method=req.method).inc()
-            # absolute index rides along: the stream dedupes replays
-            # from recovered producers at this boundary
-            req.stream.put(tok, index=req.offset + req.emitted)
-            req.emitted += 1
-            n_streamed += 1
+            if use_spec:
+                p = int(self.cache.positions[slot])
+                row = ver[slot]
+                if slot in spec_slots:
+                    # accept rule: keep the longest prefix of draft
+                    # proposals that MATCH the target's own tokens —
+                    # every emitted token is the target's, so the
+                    # stream is byte-identical to non-speculative
+                    a = 0
+                    while a < spec_k \
+                            and int(cand[slot, a + 1]) == int(row[a]):
+                        a += 1
+                    it_proposed += spec_k
+                    it_accepted += a
+                    _metrics.GEN_SPEC_PROPOSED_TOKENS_TOTAL.inc(spec_k)
+                    if a:
+                        _metrics.GEN_SPEC_ACCEPTED_TOKENS_TOTAL.inc(a)
+                    if spec_k - a:
+                        _metrics.GEN_SPEC_REJECTED_TOKENS_TOTAL.inc(
+                            spec_k - a)
+                    emit_n = a + 1
+                else:
+                    # plain slot riding a speculative iteration: its
+                    # verify column 0 IS the plain step's token
+                    emit_n = 1
+                emit_n = min(emit_n,
+                             req.max_new_tokens - req.emitted)
+                emit = [int(row[j]) for j in range(emit_n)]
+                if req.eos_token is not None:
+                    eos = int(req.eos_token)
+                    for j, t in enumerate(emit):
+                        if t == eos:
+                            del emit[j + 1:]
+                            break
+                m = len(emit)
+                # verify advanced every slot's device rows to p+k+1;
+                # adopt them, then roll the rejected/unemitted tail
+                # back.  Plain slots just take their one real row —
+                # the extra rows were never theirs (bookkeeping, not a
+                # rollback)
+                if slot in spec_slots and m < spec_k + 1:
+                    self.cache.positions[slot] = p + spec_k + 1
+                    self.cache.truncate(slot, p + m)
+                else:
+                    self.cache.positions[slot] = p + m
+                if self._draft is not None:
+                    self._draft.commit(slot, p + m)
+                self._last_tok[slot] = emit[-1]
+                _metrics.GEN_SAMPLED_TOKENS_TOTAL.labels(
+                    method=req.method).inc(m)
+                # ONE lock pass / consumer wakeup for the whole run;
+                # absolute indexes ride along as with put
+                req.stream.put_many(
+                    emit, start_index=req.offset + req.emitted)
+                req.emitted += m
+                n_streamed += m
+                tok = emit[-1]
+                if slot in spec_slots:
+                    # min-exemplar retention: the histogram keeps the
+                    # trace id of the WORST-accepting recent step
+                    _metrics.GEN_SPEC_ACCEPTED_PER_STEP.observe(
+                        float(m), exemplar=iter_tid)
+            else:
+                tok = int(next_tok[slot])
+                self.cache.positions[slot] += 1
+                self._last_tok[slot] = tok
+                _metrics.GEN_SAMPLED_TOKENS_TOTAL.labels(
+                    method=req.method).inc()
+                # absolute index rides along: the stream dedupes
+                # replays from recovered producers at this boundary
+                req.stream.put(tok, index=req.offset + req.emitted)
+                req.emitted += 1
+                n_streamed += 1
             log["decoded"].append(slot)
             finished = None
             if req.eos_token is not None and tok == int(req.eos_token):
@@ -768,6 +993,11 @@ class GenerationEngine:
                 # mark done now; the slot frees at the next iteration's
                 # retire phase (keeps this loop allocation-free)
                 req.stream.close(finished)
+        if it_proposed:
+            self._spec_proposed += it_proposed
+            self._spec_accepted += it_accepted
+            _metrics.GEN_SPEC_ACCEPT_RATE.set(
+                self._spec_accepted / self._spec_proposed)
         _metrics.GEN_TOKENS_TOTAL.labels(phase="decode").inc(n_streamed)
         _metrics.GEN_ITERATIONS_TOTAL.inc()
         self._tps_window.append((now, n_streamed))
@@ -892,8 +1122,15 @@ class GenerationEngine:
             first = self.model.select(
                 logits, req.seed, req.offset, req.temperature,
                 req.top_k, req.top_p, METHOD_CODES[req.method])
+            if req.speculative and self._draft is not None:
+                # the draft follows the same prompt: its cache rows
+                # mirror this slot from the first iteration on
+                self._draft.admit(slot, req.tokens,
+                                  self.prompt_buckets)
         except Exception:
             self.cache.free(slot)
+            if self._draft is not None:
+                self._draft.release(slot)
             raise
         finally:
             if entry is not None:
@@ -940,6 +1177,8 @@ class GenerationEngine:
     def _retire(self, slot: int, req: GenRequest, reason: str) -> None:
         self.scheduler.release(slot)
         self.cache.free(slot)
+        if self._draft is not None:
+            self._draft.release(slot)
         if self._samp[5][slot]:
             self._samp[5][slot] = 0      # freed lanes ride greedy
             self._samp_dev = None
@@ -970,4 +1209,7 @@ class GenerationEngine:
                 "top_p": self.default_top_p,
             },
             "prefix_cache": self.cache.prefix.describe(),
+            "speculation": (self._draft.describe()
+                            if self._draft is not None
+                            else {"mode": "off"}),
         }

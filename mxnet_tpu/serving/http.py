@@ -354,6 +354,10 @@ class _Handler(BaseHTTPRequestHandler):
             seed = payload.get("seed")
             if seed is not None and not isinstance(seed, int):
                 raise ValueError("seed must be an integer")
+            speculative = payload.get("speculative")
+            if speculative is not None and not isinstance(
+                    speculative, bool):
+                raise ValueError("speculative must be a boolean")
             stream_mode = bool(payload.get(
                 "stream", int(getenv("MXNET_GEN_STREAM", 1))))
         except (TypeError, ValueError, json.JSONDecodeError) as e:
@@ -367,7 +371,8 @@ class _Handler(BaseHTTPRequestHandler):
                                  eos_token=eos,
                                  deadline_ms=deadline_ms,
                                  method=method, temperature=temperature,
-                                 top_k=top_k, top_p=top_p, seed=seed)
+                                 top_k=top_k, top_p=top_p, seed=seed,
+                                 speculative=speculative)
         except OverloadError as e:
             self._reply(429, e.to_json(), headers={
                 "Retry-After": str(max(1, int(e.retry_after_ms / 1e3)))})
@@ -465,8 +470,16 @@ class _Handler(BaseHTTPRequestHandler):
         self.send_header("Cache-Control", "no-store")
         self.end_headers()
 
-        def chunk(obj: Any) -> None:
-            data = (json.dumps(obj) + "\n").encode()
+        def flush(lines: List[Any]) -> None:
+            # ONE chunk may carry many NDJSON lines: a speculative
+            # iteration lands its whole accepted run in one
+            # TokenStream wakeup, and it leaves the socket as one
+            # write too — per-token writes would hand the speculation
+            # win straight back to syscall overhead
+            if not lines:
+                return
+            data = b"".join((json.dumps(o) + "\n").encode()
+                            for o in lines)
             self.wfile.write(f"{len(data):X}\r\n".encode() + data
                              + b"\r\n")
             self.wfile.flush()
@@ -475,18 +488,41 @@ class _Handler(BaseHTTPRequestHandler):
         with _tracing.child_span("stream.completion") as csp:
             try:
                 try:
-                    chunk({"token": int(first), "index": i})
+                    pend = [{"token": int(first), "index": i}]
                     i += 1
-                    for tok in stream:
-                        chunk({"token": int(tok), "index": i})
-                        i += 1
+                    done = False
+                    while not done:
+                        # batch everything already buffered behind the
+                        # token in hand, flush once, then block for
+                        # the next iteration's output
+                        try:
+                            while True:
+                                tok = stream.next_token(timeout=0.0)
+                                if tok is None:
+                                    done = True
+                                    break
+                                pend.append({"token": int(tok),
+                                             "index": i})
+                                i += 1
+                        except StreamTimeout:
+                            pass             # drained; stream still live
+                        flush(pend)
+                        pend = []
+                        if done:
+                            break
+                        tok = stream.next_token()
+                        if tok is None:
+                            done = True
+                        else:
+                            pend.append({"token": int(tok), "index": i})
+                            i += 1
                 except MXNetError as e:
-                    chunk({"error": "generation_failed",
-                           "detail": str(e), "done": True})
+                    flush([{"error": "generation_failed",
+                            "detail": str(e), "done": True}])
                     self.wfile.write(b"0\r\n\r\n")
                     return
-                chunk({"done": True, "n_tokens": i,
-                       "finish_reason": stream.finish_reason})
+                flush([{"done": True, "n_tokens": i,
+                        "finish_reason": stream.finish_reason}])
                 self.wfile.write(b"0\r\n\r\n")
             except (BrokenPipeError, ConnectionResetError):
                 stream.cancel()

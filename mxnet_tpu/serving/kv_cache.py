@@ -197,6 +197,38 @@ class PagedKVCache:
         self._v = out[self.n_layers:]
         self.positions[slot] = int(t0)
 
+    # -- rollback -----------------------------------------------------------
+    def truncate(self, slot: int, position: int) -> int:
+        """Roll ``slot`` back so ``position`` is its next write index,
+        discarding every row at ``position..`` — the speculative-decode
+        rejection path.  No device work happens: rows past a slot's
+        position are already invisible to the decode/verify attention
+        mask, so rolling back is pure host bookkeeping and the next
+        accepted token's write makes the row bit-identical to one that
+        was never speculated into (CI pins this).  Only ever touches
+        the slot's own rows — shared-prefix entries hold their own
+        buffers (admission COPIES them in), so a rollback can never
+        corrupt a refcounted prefix.  Returns the number of rows
+        discarded."""
+        if not 0 <= int(slot) < self.max_slots:
+            raise MXNetError(
+                f"truncate: slot {slot} out of range "
+                f"(max_slots={self.max_slots})")
+        cur = int(self.positions[slot])
+        if cur < 0:
+            raise MXNetError(f"truncate: slot {slot} is free")
+        position = int(position)
+        if position < 0 or position > cur:
+            raise MXNetError(
+                f"truncate: position {position} outside the slot's "
+                f"resident range [0, {cur}] — rollback only ever "
+                "rewinds (forward motion is the decode loop's job)")
+        dropped = cur - position
+        if dropped:
+            self.positions[slot] = position
+            _metrics.GEN_KV_ROLLBACKS_TOTAL.inc()
+        return dropped
+
     # -- capacity -----------------------------------------------------------
     def needed_capacity(self) -> int:
         """Positions the next decode step will write: max live position

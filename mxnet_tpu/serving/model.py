@@ -498,6 +498,50 @@ class DecodeModel:
                                 _greedy, logits)
             return next_tok, new_ks, new_vs
 
+        def _verify(params, ks, vs, toks, pos, seeds, bases, temps,
+                    topks, topps, methods):
+            # speculative verification: toks (S, K1) int32 — column 0
+            # is each slot's last emitted token, columns 1.. the draft
+            # proposals; pos (S,) the write position of column 0.  The
+            # program is K1 UNROLLED repetitions of the single-token
+            # step (same ``_slot_block_step`` math, same shapes per
+            # sub-step, same lax.cond'd sampler), each scattering its
+            # K/V at pos+j and sampling under counter pos+j-base — so
+            # the token this pass computes at any position is
+            # BIT-IDENTICAL to what the sequential one-token step
+            # would have computed there (the byte-identical-streams
+            # contract CI pins).  Inputs past the accepted prefix feed
+            # garbage forward; the host discards those columns and
+            # rolls their KV rows back (PagedKVCache.truncate)
+            from jax import lax
+            import jax.numpy as jnp
+            K1 = toks.shape[1]
+            outs = []
+            for j in range(K1):
+                x = (params["embed"][toks[:, j]][:, None, :]
+                     + params["pos"][pos + j][:, None, :])
+                new_ks, new_vs = [], []
+                for p, ck, cv in zip(params["blocks"], ks, vs):
+                    x, ck, cv = _slot_block_step(p, x, ck, cv, pos + j,
+                                                 nh, ga_s)
+                    new_ks.append(ck)
+                    new_vs.append(cv)
+                ks, vs = new_ks, new_vs
+                x = _pure_ln(x, params["lnf_g"], params["lnf_b"],
+                             ga_s[1])
+                logits = x[:, 0, :] @ params["embed"].T
+
+                def _mixed(lg, _j=j):
+                    return _sample_tokens(lg, seeds, (pos + _j) - bases,
+                                          temps, topks, topps, methods)
+
+                def _greedy(lg):
+                    return jnp.argmax(lg, axis=-1).astype(jnp.int32)
+
+                outs.append(lax.cond(jnp.any(methods != 0), _mixed,
+                                     _greedy, logits))
+            return jnp.stack(outs, axis=1), ks, vs
+
         def _prefill_sfx(params, pre_ks, pre_vs, toks, q, t0):
             # suffix pass for shared-prefix admissions: pre_ks/pre_vs
             # are the resident prefix rows (Pb, nh, d) per layer, toks
@@ -542,6 +586,12 @@ class DecodeModel:
         # every token
         self._step_fn = _cc.persistently_cached(
             jax.jit(_step, donate_argnums=(1, 2)),
+            surface="serving.decode", pin=True)
+        # same donation contract as _step: verify scatters k+1 rows
+        # into the resident buffers in place; rejected rows are
+        # invisible (visibility mask <= pos) until overwritten
+        self._verify_fn = _cc.persistently_cached(
+            jax.jit(_verify, donate_argnums=(1, 2)),
             surface="serving.decode", pin=True)
 
     # -- constructors -------------------------------------------------------
@@ -657,6 +707,50 @@ class DecodeModel:
         from .. import metrics as _metrics
         from .. import tracing as _tracing
         _metrics.GEN_STEP_SECONDS.labels(phase="decode").observe(
+            time.perf_counter() - t,
+            exemplar=_tracing.current_trace_id())
+        return out
+
+    def verify(self, cache: Any, tokens: _np.ndarray,
+               positions: _np.ndarray,
+               sampling: Optional[Sequence[Any]] = None
+               ) -> _np.ndarray:
+        """One speculative verification pass over every slot:
+        ``tokens`` is (S, k+1) int32 — column 0 each slot's last
+        emitted token, columns 1.. the k draft proposals — and the
+        return is the (S, k+1) int32 target tokens for those
+        positions, each bit-identical to what ``step`` would have
+        produced sequentially (same kernel math, same counter-PRNG
+        lanes).  The cache's buffers gain k+1 rows per slot starting
+        at ``positions``; the caller owns acceptance and rolls back
+        rejected rows via ``cache.truncate``.  One compiled program
+        per (S, bucket, k+1) triple, persistently cached like the
+        decode grid."""
+        import jax
+        import jax.numpy as jnp
+        S = cache.max_slots
+        toks = _np.asarray(tokens, _np.int32)
+        if toks.ndim != 2 or toks.shape[0] != S or toks.shape[1] < 2:
+            raise MXNetError(
+                f"verify wants an (S, k+1) token matrix with k >= 1; "
+                f"got shape {toks.shape} for {S} slots")
+        if sampling is None:
+            sampling = self.greedy_sampling(S)
+        if not isinstance(sampling[0], jax.Array):
+            sampling = self.device_sampling(sampling)
+        seeds, bases, temps, topks, topps, methods = sampling
+        self._account(f"verify:{S}x{cache.bucket}x{toks.shape[1]}")
+        t = time.perf_counter()
+        out_toks, new_ks, new_vs = self._verify_fn(
+            self.params, cache._k, cache._v,
+            jnp.asarray(toks),
+            jnp.asarray(_np.asarray(positions, _np.int32)),
+            seeds, bases, temps, topks, topps, methods)
+        cache.replace(new_ks, new_vs)
+        out = _np.asarray(out_toks)
+        from .. import metrics as _metrics
+        from .. import tracing as _tracing
+        _metrics.GEN_STEP_SECONDS.labels(phase="verify").observe(
             time.perf_counter() - t,
             exemplar=_tracing.current_trace_id())
         return out

@@ -716,11 +716,15 @@ class GenerationServer:
                  temperature: Optional[float] = None,
                  top_k: Optional[int] = None,
                  top_p: Optional[float] = None,
-                 seed: Optional[int] = None) -> Any:
+                 seed: Optional[int] = None,
+                 speculative: Optional[bool] = None) -> Any:
         """Submit one prompt; returns its ``TokenStream``.  Sampling
         parameters pass through to the engine (on-device sampling,
         deterministic by ``seed`` — including across worker-death
-        resurrection).  Sheds with ``OverloadError`` (queue full / no
+        resurrection), as does ``speculative`` (None = the engine's
+        MXNET_GEN_SPEC_MODE default; the flag rides recovery, so a
+        resurrected sequence keeps its draft config and its bytes).
+        Sheds with ``OverloadError`` (queue full / no
         slot within deadline / draining / every replica mid-restart)
         and refuses with :class:`DegradedError` when the breaker is
         open — the same 429-vs-503 split as the one-shot path."""
@@ -759,7 +763,8 @@ class GenerationServer:
                     tokens, max_new_tokens=max_new_tokens,
                     eos_token=eos_token, deadline_ms=deadline_ms,
                     method=method, temperature=temperature,
-                    top_k=top_k, top_p=top_p, seed=seed)
+                    top_k=top_k, top_p=top_p, seed=seed,
+                    speculative=speculative)
             except OverloadError as e:
                 last = e                 # replica full: try the next
         raise last if last is not None else MXNetError(

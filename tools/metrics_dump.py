@@ -260,7 +260,12 @@ def _workload_generation(steps: int) -> None:
     admissions (a common system prompt inserted cold, then hit by
     suffix-bearing and identical prompts — prefix hit/miss/eviction
     counters + the resident-rows gauge), on top of the PR-6 engine
-    families (slots, TTFT, tokens/sec, prefill/decode split)."""
+    families (slots, TTFT, tokens/sec, prefill/decode split).  A
+    second pass re-runs the mix under a truncated-layer self-
+    speculative draft so the ISSUE-17 families light up too:
+    mxnet_gen_spec_{proposed,accepted,rejected}_tokens_total, the
+    mxnet_gen_spec_accept_rate gauge, the accepted-per-step histogram,
+    and mxnet_gen_kv_rollbacks_total from rejection rollbacks."""
     import numpy as onp
     import mxnet_tpu as mx
     from mxnet_tpu.gluon.model_zoo.gpt import GPTModel
@@ -295,6 +300,25 @@ def _workload_generation(steps: int) -> None:
             max_new_tokens=4))
     while not all(s.finished for s in streams):
         eng.run_iteration()
+
+    # speculative pass: a 1-of-2-layer self-draft proposes k=3 tokens
+    # per iteration; partial acceptance drives the spec counters, the
+    # accept-rate gauge, and KV rollbacks — streams stay byte-identical
+    # to the plain engine, so this is pure added observability
+    spec = GenerationEngine(DecodeModel.from_block(gpt), max_slots=4,
+                            kv_buckets=(32, 64), max_tokens=16,
+                            spec_mode="self", spec_k=3,
+                            spec_draft_layers=1)
+    spec.warmup()
+    streams = []
+    for i in range(max(steps, 3)):
+        method = ("greedy", "sample", "top_k", "top_p")[i % 4]
+        streams.append(spec.submit(
+            rng.randint(1, 90, (4 + i % 3,)).astype("int32"),
+            max_new_tokens=8, method=method, seed=100 + i,
+            temperature=0.9, top_k=8, top_p=0.9))
+    while not all(s.finished for s in streams):
+        spec.run_iteration()
     mx.waitall()
 
 

@@ -128,6 +128,21 @@ def main(argv=None) -> None:
                     help="resident shared-prefix KV entries for "
                          "--generate (MXNET_GEN_PREFIX_CACHE_SLOTS; "
                          "0 disables prefix caching)")
+    ap.add_argument("--spec-mode", default=None,
+                    choices=("off", "self", "draft"),
+                    help="speculative decoding for --generate "
+                         "(MXNET_GEN_SPEC_MODE): 'self' drafts with "
+                         "the target's own bottom layers; output "
+                         "stays byte-identical to 'off' at the same "
+                         "seed ('draft' needs an in-process draft "
+                         "model and is API-only here)")
+    ap.add_argument("--spec-k", type=int, default=None,
+                    help="draft tokens proposed per slot per "
+                         "iteration (MXNET_GEN_SPEC_K; >= 1)")
+    ap.add_argument("--spec-draft-layers", type=int, default=None,
+                    help="target layers the self-speculative draft "
+                         "keeps (MXNET_GEN_SPEC_DRAFT_LAYERS; 0 = "
+                         "half)")
     ap.add_argument("--platform", choices=("cpu", "ambient"),
                     default="ambient",
                     help="force the CPU backend, or keep the "
@@ -255,7 +270,10 @@ def _serve_generate(args, serving) -> None:
                                         default_method=args.method,
                                         default_temperature=args.temperature,
                                         default_top_k=args.top_k,
-                                        default_top_p=args.top_p)
+                                        default_top_p=args.top_p,
+                                        spec_mode=args.spec_mode,
+                                        spec_k=args.spec_k,
+                                        spec_draft_layers=args.spec_draft_layers)
 
     gs = serving.GenerationServer(engine_factory=engine_factory,
                                   replicas=args.replicas,
@@ -268,7 +286,10 @@ def _serve_generate(args, serving) -> None:
               f"KV buckets {list(engine.grid)}, "
               f"{engine.max_slots} slots x {gs.replicas} replica(s), "
               f"{engine.cache.prefix.slots} prefix-cache slots, "
-              f"default method {engine.default_method})"
+              f"default method {engine.default_method}, "
+              f"speculation {engine.spec_mode}"
+              + (f" k={engine.spec_k}" if engine._draft is not None
+                 else "") + ")"
               + _cache_note())
     gs.start()
     httpd = serving.make_http_server(None, args.host, args.port,

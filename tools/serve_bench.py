@@ -26,9 +26,19 @@ and a 2x-slot flood shed check.  ``--generate --smoke`` is the
 ``ci/run.sh generation-smoke`` gate (>=2x tokens/sec, 0 decode
 recompiles after warmup, clean structured sheds).
 
+``--generate --speculative`` benches the SPECULATIVE DECODING path
+(ISSUE 17) instead: draft/verify tokens/sec uplift over the same
+engine run non-speculatively (gated >=1.3x), accepted-tokens/step
+(gated >1.0), byte-identical greedy AND sampled streams vs the
+non-speculative run at the same seeds, 0 XLA compiles after warmup, a
+truncated-draft leg with REAL rejections (KV rollbacks > 0, streams
+still byte-identical), and a seeded worker-kill leg proving
+resurrection replays speculative streams token-identically.
+
     python tools/serve_bench.py              # full report (JSON)
     python tools/serve_bench.py --smoke      # CI gate, exit 1 on violation
     python tools/serve_bench.py --generate [--smoke]
+    python tools/serve_bench.py --generate --speculative [--smoke]
 """
 import argparse
 import json
@@ -526,6 +536,234 @@ def bench_prefix_cache(new_tokens: int = 16):
     }
 
 
+def bench_speculation(new_tokens: int = 16):
+    """ISSUE 17 acceptance: speculative decoding must MULTIPLY
+    tokens/sec past one-token-per-step without changing a single
+    byte of output.
+
+    Demo target: a 4-layer GPT whose TOP TWO blocks are residual
+    no-ops (attention/FFN output projections zeroed), so the 2-layer
+    self-speculative draft computes the target's logits EXACTLY —
+    every proposal accepts and the uplift gate measures the pure
+    draft/verify mechanics (one k-token verify dispatch per ~k+1
+    emitted tokens vs one dispatch per token).  A 1-layer draft on
+    the same target still sees the live second block and DIVERGES —
+    that leg proves real rejections roll the KV cache back while the
+    stream stays byte-identical.  A seeded worker kill
+    (``serving.worker:after=2:times=1``) proves the PR-7 resurrection
+    path replays speculative streams token-identically."""
+    import numpy as onp
+    import jax.numpy as jnp
+    import mxnet_tpu as mx
+    from mxnet_tpu import faults, metrics
+    from mxnet_tpu.gluon.model_zoo.gpt import GPTModel
+    from mxnet_tpu.serving import (DecodeModel, GenerationEngine,
+                                   GenerationServer)
+
+    mx.random.seed(17)
+    net = GPTModel(vocab_size=211, num_layers=4, units=64,
+                   hidden_size=128, num_heads=4, max_length=160,
+                   dropout=0.0)
+    net.initialize(mx.init.Normal(1.0))
+    net(mx.np.zeros((1, 4), dtype="int32"))
+    dm = DecodeModel.from_block(net)
+    for p in dm.params["blocks"][2:]:
+        for w in ("out_w", "out_b", "f2_w", "f2_b"):
+            p[w] = jnp.zeros_like(p[w])
+
+    rng = onp.random.RandomState(3)
+    lengths = [4, 7, 11, 16, 5, 9]
+    prompts = [rng.randint(1, 200, (n,)).astype("int32")
+               for n in lengths]
+    sam_grid = [("sample", 1.2, 40, 0.9), ("top_k", 0.8, 7, 0.9),
+                ("top_p", 1.1, 40, 0.8)]
+    SPEC_K = 4
+
+    def engine(mode, layers):
+        eng = GenerationEngine(dm, max_slots=4, kv_buckets=(32, 64),
+                               max_tokens=new_tokens, spec_mode=mode,
+                               spec_k=SPEC_K, spec_draft_layers=layers)
+        eng.warmup()
+        return eng
+
+    def drive(mode, layers, timed=False):
+        """One engine config through the greedy + sampled workload;
+        returns streams, tokens/sec, and the post-warmup compile
+        delta."""
+        server = GenerationServer(engine(mode, layers)).start()
+        c0 = metrics.value("mxnet_compile_misses_total")
+
+        def greedy_batch():
+            t0 = time.perf_counter()
+            streams = [server.generate(p, max_new_tokens=new_tokens)
+                       for p in prompts]
+            outs = [s.result(timeout=120.0) for s in streams]
+            return outs, time.perf_counter() - t0
+
+        greedy, dt = greedy_batch()
+        if timed:
+            # tokens/sec on the shared-CPU CI rig swings ±25-40%
+            # run-to-run; min-of-two wall clocks strips the additive
+            # scheduler noise (the recalibrated-retry precedent) while
+            # every deterministic gate is enforced on BOTH passes'
+            # outputs (identical by construction or the identity gates
+            # below fail)
+            _, dt2 = greedy_batch()
+            dt = min(dt, dt2)
+        sampled = []
+        for i, p in enumerate(prompts):
+            m, t, k, tp = sam_grid[i % len(sam_grid)]
+            sampled.append(server.generate(
+                p, max_new_tokens=new_tokens, method=m, temperature=t,
+                top_k=k, top_p=tp, seed=100 + i).result(timeout=120.0))
+        compiles = metrics.value("mxnet_compile_misses_total") - c0
+        server.stop()
+        return {"greedy": greedy, "sampled": sampled,
+                "tps": sum(len(o) for o in greedy) / dt,
+                "compiles": compiles}
+
+    # -- exact-draft leg: uplift + acceptance + byte identity
+    base = drive("off", 0, timed=True)
+    h0 = metrics.hist_stats("mxnet_gen_spec_accepted_per_step")
+    p0 = metrics.value("mxnet_gen_spec_proposed_tokens_total")
+    a0 = metrics.value("mxnet_gen_spec_accepted_tokens_total")
+    spec = drive("self", 2, timed=True)
+    h1 = metrics.hist_stats("mxnet_gen_spec_accepted_per_step")
+    proposed = metrics.value(
+        "mxnet_gen_spec_proposed_tokens_total") - p0
+    accepted = metrics.value(
+        "mxnet_gen_spec_accepted_tokens_total") - a0
+    accepted_per_step = (h1[0] - h0[0]) / max(1, h1[1] - h0[1])
+
+    # -- truncated-draft leg: real rejections must roll back KV rows
+    # and STILL not change a byte
+    r0 = metrics.value("mxnet_gen_kv_rollbacks_total")
+    j0 = metrics.value("mxnet_gen_spec_rejected_tokens_total")
+    trunc = drive("self", 1)
+    rollbacks = metrics.value("mxnet_gen_kv_rollbacks_total") - r0
+    rejected = metrics.value("mxnet_gen_spec_rejected_tokens_total") - j0
+
+    # -- seeded decode-fault leg: worker dies mid-speculation, victims
+    # resurrect (PR 7) and the replayed streams match the clean run
+    kws = [dict(method="sample", temperature=1.2, seed=21),
+           dict(method="top_k", top_k=7, temperature=0.9, seed=22)]
+    budgets = [10, 8]
+
+    def collect(with_kill):
+        factory = lambda: engine("self", 1)              # noqa: E731
+        gs = GenerationServer(engine_factory=factory, replicas=2,
+                              restart_backoff_ms=10)
+        gs.start()
+        try:
+            if with_kill:
+                with faults.fault_plan("serving.worker:after=2:times=1"):
+                    streams = [gs.generate(p, max_new_tokens=n, **kw)
+                               for p, n, kw in zip(prompts, budgets,
+                                                   kws)]
+                    return [s.result(timeout=120.0) for s in streams]
+            streams = [gs.generate(p, max_new_tokens=n, **kw)
+                       for p, n, kw in zip(prompts, budgets, kws)]
+            return [s.result(timeout=120.0) for s in streams]
+        finally:
+            gs.stop()
+
+    clean = collect(with_kill=False)
+    rec0 = (metrics.value("mxnet_serving_recoveries_total",
+                          site="worker")
+            + metrics.value("mxnet_serving_recoveries_total",
+                            site="queue"))
+    killed = collect(with_kill=True)
+    recoveries = (metrics.value("mxnet_serving_recoveries_total",
+                                site="worker")
+                  + metrics.value("mxnet_serving_recoveries_total",
+                                  site="queue")) - rec0
+
+    return {
+        "spec_k": SPEC_K,
+        "new_tokens_per_request": new_tokens,
+        "plain_tokens_per_s": round(base["tps"], 1),
+        "speculative_tokens_per_s": round(spec["tps"], 1),
+        "speedup": round(spec["tps"] / base["tps"], 2),
+        "accepted_per_step": round(accepted_per_step, 2),
+        "proposed_tokens": proposed,
+        "accepted_tokens": accepted,
+        "greedy_identical": spec["greedy"] == base["greedy"],
+        "sampled_identical": spec["sampled"] == base["sampled"],
+        "compiles_after_warmup": base["compiles"] + spec["compiles"],
+        "truncated_draft": {
+            "greedy_identical": trunc["greedy"] == base["greedy"],
+            "sampled_identical": trunc["sampled"] == base["sampled"],
+            "rejected_tokens": rejected,
+            "kv_rollbacks": rollbacks,
+            "compiles_after_warmup": trunc["compiles"],
+        },
+        "worker_kill": {
+            "recoveries": recoveries,
+            "streams_identical": killed == clean,
+        },
+    }
+
+
+def run_speculative(args) -> int:
+    rep = bench_speculation(new_tokens=16 if args.smoke else 32)
+    print(json.dumps({"speculation": rep}, indent=1))
+    if not args.smoke:
+        return 0
+    failures = []
+    if rep["speedup"] < 1.3:
+        failures.append(
+            f"speculative decoding {rep['speedup']}x < 1.3x the "
+            "non-speculative engine on the exact-draft demo config")
+    if rep["accepted_per_step"] <= 1.0:
+        failures.append(
+            f"accepted-tokens/step {rep['accepted_per_step']} <= 1.0 "
+            "— speculation is not multiplying tokens per step")
+    if not rep["greedy_identical"]:
+        failures.append("speculative greedy streams diverged from the "
+                        "non-speculative run")
+    if not rep["sampled_identical"]:
+        failures.append("speculative sampled streams diverged from "
+                        "the non-speculative run at the same seeds")
+    if rep["compiles_after_warmup"] > 0:
+        failures.append(
+            f"{rep['compiles_after_warmup']} XLA compiles during "
+            "steady-state speculative decode (draft/verify grid not "
+            "warm?)")
+    tr = rep["truncated_draft"]
+    if tr["rejected_tokens"] == 0 or tr["kv_rollbacks"] == 0:
+        failures.append(
+            "truncated-draft leg produced no rejections/rollbacks "
+            f"(rejected={tr['rejected_tokens']}, "
+            f"rollbacks={tr['kv_rollbacks']}) — the rollback path "
+            "went unexercised")
+    if not (tr["greedy_identical"] and tr["sampled_identical"]):
+        failures.append("truncated-draft streams diverged — rejection "
+                        "rollback corrupted the KV state")
+    if tr["compiles_after_warmup"] > 0:
+        failures.append(
+            f"{tr['compiles_after_warmup']} XLA compiles in the "
+            "truncated-draft leg after warmup")
+    wk = rep["worker_kill"]
+    if wk["recoveries"] < 1:
+        failures.append("worker kill recovered nothing (did the "
+                        "fault fire?)")
+    if not wk["streams_identical"]:
+        failures.append("speculative streams diverged across worker "
+                        "death — resurrection must replay the same "
+                        "counter-key lanes")
+    if failures:
+        print("SPECULATION SMOKE FAILED:", "; ".join(failures),
+              file=sys.stderr)
+        return 1
+    print("speculation smoke OK: "
+          f"{rep['speedup']}x tokens/sec, "
+          f"{rep['accepted_per_step']} accepted/step, byte-identical "
+          "greedy+sampled streams, rollback leg "
+          f"({tr['kv_rollbacks']} rollbacks) identical, worker-kill "
+          "replay identical, 0 steady-state compiles")
+    return 0
+
+
 def run_generate(args) -> int:
     rep = bench_generation(args.clients,
                            args.requests or (3 if args.smoke else 6),
@@ -630,6 +868,11 @@ def main(argv=None) -> int:
     ap.add_argument("--clients", type=int, default=8)
     ap.add_argument("--requests", type=int, default=None,
                     help="per client (default 40; 12 under --smoke)")
+    ap.add_argument("--speculative", action="store_true",
+                    help="with --generate: bench the speculative "
+                         "decoding path (draft/verify uplift, "
+                         "byte-identity, rollback + worker-kill legs) "
+                         "instead of the continuous-batching phases")
     ap.add_argument("--prefix-share", type=float, default=0.0,
                     help="with --generate: fraction of prompts that "
                          "open with a shared bucket-aligned system "
@@ -648,6 +891,8 @@ def main(argv=None) -> int:
         import jax
         jax.config.update("jax_platforms", "cpu")
     if args.generate:
+        if args.speculative:
+            return run_speculative(args)
         return run_generate(args)
     reqs = args.requests or (12 if args.smoke else 40)
 
